@@ -1,0 +1,11 @@
+"""nodexa-chain-core_tpu — clean-room TPU-augmented PoW blockchain node framework.
+
+Capabilities target the reference ``DeonDavisV/Nodexa-Chain-Core`` (Clore Core
+v4.4.4.2 lineage; surveyed in SURVEY.md).  Node logic lives in Python
+subpackages; batched PoW compute (SHA-256d / Keccak / ProgPoW) runs on TPU via
+JAX in :mod:`nodexa_chain_core_tpu.ops`, sharded over device meshes in
+:mod:`nodexa_chain_core_tpu.parallel`.
+"""
+
+__version__ = "0.1.0"
+CLIENT_NAME = "NodexaTPU"

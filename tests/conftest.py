@@ -1,0 +1,17 @@
+"""Pytest config: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of simulating multi-node setups locally
+(SURVEY.md §4: regtest nodes on localhost); here the analogue is a virtual
+multi-chip TPU mesh emulated on CPU so sharding/pjit paths are exercised
+without hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
