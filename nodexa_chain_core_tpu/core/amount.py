@@ -1,0 +1,43 @@
+"""Monetary amounts (parity: reference src/amount.h).
+
+COIN = 100,000,000 satoshi (amount.h:17); MAX_MONEY = 1.3e9 * COIN
+(amount.h:29 — Clore's cap, larger than Bitcoin's 21e6).
+"""
+
+COIN = 100_000_000
+CENT = 1_000_000
+MAX_MONEY = 1_300_000_000 * COIN
+
+
+def money_range(value: int) -> bool:
+    return 0 <= value <= MAX_MONEY
+
+
+def format_money(value: int) -> str:
+    """Right-trims excess zeros but keeps >=2 decimals (ref FormatMoney)."""
+    sign = "-" if value < 0 else ""
+    v = abs(value)
+    frac = f"{v % COIN:08d}"
+    while len(frac) > 2 and frac.endswith("0"):
+        frac = frac[:-1]
+    return f"{sign}{v // COIN}.{frac}"
+
+
+def parse_money(s: str) -> int:
+    s = s.strip()
+    if not s:
+        raise ValueError("empty amount")
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    if "." in s:
+        whole, frac = s.split(".", 1)
+        if len(frac) > 8 or not (frac.isascii() and frac.isdigit()):
+            raise ValueError(f"bad amount: {s}")
+        frac = frac.ljust(8, "0")
+    else:
+        whole, frac = s, "0" * 8
+    if not (whole.isascii() and whole.isdigit()):
+        raise ValueError(f"bad amount: {s}")
+    v = int(whole) * COIN + int(frac)
+    return -v if neg else v

@@ -1,0 +1,206 @@
+"""Wire serialization primitives.
+
+Behavioral parity with the reference's serializer (reference:
+``src/serialize.h`` — CompactSize, little-endian integer encodings,
+vector/string framing used by every consensus object).  The design here is a
+pair of explicit reader/writer cursors instead of the reference's templated
+stream operators; consensus byte-exactness is what matters, not the C++
+idiom.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, TypeVar
+
+T = TypeVar("T")
+
+MAX_SIZE = 0x02000000  # sanity bound on deserialized sizes (ref serialize.h MAX_SIZE)
+
+
+class SerializationError(Exception):
+    pass
+
+
+def ser_compact_size(n: int) -> bytes:
+    """Encode Bitcoin-style CompactSize (ref src/serialize.h WriteCompactSize)."""
+    if n < 0:
+        raise SerializationError("negative compact size")
+    if n < 253:
+        return struct.pack("<B", n)
+    if n <= 0xFFFF:
+        return b"\xfd" + struct.pack("<H", n)
+    if n <= 0xFFFFFFFF:
+        return b"\xfe" + struct.pack("<I", n)
+    return b"\xff" + struct.pack("<Q", n)
+
+
+class ByteReader:
+    """Cursor over immutable bytes; all integers little-endian."""
+
+    __slots__ = ("_mv", "pos")
+
+    def __init__(self, data: bytes | bytearray | memoryview, pos: int = 0):
+        self._mv = memoryview(data)
+        self.pos = pos
+
+    def remaining(self) -> int:
+        return len(self._mv) - self.pos
+
+    def read(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self._mv):
+            raise SerializationError(
+                f"read past end: want {n}, have {self.remaining()}"
+            )
+        out = bytes(self._mv[self.pos : self.pos + n])
+        self.pos += n
+        return out
+
+    def peek(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self._mv):
+            raise SerializationError("peek past end")
+        return bytes(self._mv[self.pos : self.pos + n])
+
+    def u8(self) -> int:
+        return self.read(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self.read(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.read(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.read(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.read(8))[0]
+
+    def boolean(self) -> bool:
+        return self.u8() != 0
+
+    def compact_size(self) -> int:
+        tag = self.u8()
+        if tag < 253:
+            n = tag
+        elif tag == 253:
+            n = self.u16()
+            if n < 253:
+                raise SerializationError("non-canonical compact size")
+        elif tag == 254:
+            n = self.u32()
+            if n <= 0xFFFF:
+                raise SerializationError("non-canonical compact size")
+        else:
+            n = self.u64()
+            if n <= 0xFFFFFFFF:
+                raise SerializationError("non-canonical compact size")
+        if n > MAX_SIZE:
+            raise SerializationError("compact size exceeds MAX_SIZE")
+        return n
+
+    def var_bytes(self) -> bytes:
+        return self.read(self.compact_size())
+
+    def var_str(self) -> str:
+        try:
+            return self.var_bytes().decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise SerializationError(f"invalid utf-8 in string: {e}") from e
+
+    def vector(self, elem: Callable[["ByteReader"], T]) -> List[T]:
+        return [elem(self) for _ in range(self.compact_size())]
+
+    def hash256(self) -> int:
+        """256-bit LE integer (uint256 wire form)."""
+        return int.from_bytes(self.read(32), "little")
+
+
+class ByteWriter:
+    """Append-only little-endian byte builder."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+
+    def getvalue(self) -> bytes:
+        return bytes(self.buf)
+
+    def write(self, b: bytes) -> "ByteWriter":
+        self.buf += b
+        return self
+
+    def u8(self, v: int) -> "ByteWriter":
+        self.buf.append(v & 0xFF)
+        return self
+
+    def u16(self, v: int) -> "ByteWriter":
+        self.buf += struct.pack("<H", v & 0xFFFF)
+        return self
+
+    def u32(self, v: int) -> "ByteWriter":
+        self.buf += struct.pack("<I", v & 0xFFFFFFFF)
+        return self
+
+    def u64(self, v: int) -> "ByteWriter":
+        self.buf += struct.pack("<Q", v & 0xFFFFFFFFFFFFFFFF)
+        return self
+
+    def i32(self, v: int) -> "ByteWriter":
+        self.buf += struct.pack("<i", v)
+        return self
+
+    def i64(self, v: int) -> "ByteWriter":
+        self.buf += struct.pack("<q", v)
+        return self
+
+    def boolean(self, v: bool) -> "ByteWriter":
+        return self.u8(1 if v else 0)
+
+    def compact_size(self, n: int) -> "ByteWriter":
+        self.buf += ser_compact_size(n)
+        return self
+
+    def var_bytes(self, b: bytes) -> "ByteWriter":
+        self.compact_size(len(b))
+        self.buf += b
+        return self
+
+    def var_str(self, s: str) -> "ByteWriter":
+        return self.var_bytes(s.encode("utf-8"))
+
+    def vector(self, items, elem: Callable[["ByteWriter", T], None]) -> "ByteWriter":
+        self.compact_size(len(items))
+        for it in items:
+            elem(self, it)
+        return self
+
+    def hash256(self, v: int) -> "ByteWriter":
+        self.buf += v.to_bytes(32, "little")
+        return self
+
+
+class Serializable:
+    """Mixin: objects define serialize(w) / deserialize(r)."""
+
+    def serialize(self, w: ByteWriter) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @classmethod
+    def deserialize(cls, r: ByteReader):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def to_bytes(self) -> bytes:
+        w = ByteWriter()
+        self.serialize(w)
+        return w.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes):
+        r = ByteReader(data)
+        obj = cls.deserialize(r)
+        return obj

@@ -1,0 +1,83 @@
+"""256-bit hash/arith helpers.
+
+Parity with reference ``src/uint256.{h,cpp}`` (opaque 256-bit blob, LE wire
+form, reversed-hex display) and ``src/arith_uint256.{h,cpp}`` (compact "nBits"
+encoding used for difficulty targets).  Python ints are the natural carrier;
+only the wire/display/compact conversions need care.
+"""
+
+from __future__ import annotations
+
+U256_MAX = (1 << 256) - 1
+
+
+def u256_from_le(b: bytes) -> int:
+    if len(b) != 32:
+        raise ValueError("uint256 needs 32 bytes")
+    return int.from_bytes(b, "little")
+
+
+def u256_to_le(v: int) -> bytes:
+    return v.to_bytes(32, "little")
+
+
+def u256_hex(v: int) -> str:
+    """Display hex (big-endian / byte-reversed, as RPC shows hashes)."""
+    return v.to_bytes(32, "big").hex()
+
+
+def u256_from_hex(s: str) -> int:
+    s = s.strip().removeprefix("0x")
+    return int(s, 16) if s else 0
+
+
+def bits_to_target(nbits: int):
+    """Decode compact target. Returns (target, negative, overflow).
+
+    Semantics match arith_uint256::SetCompact (ref src/arith_uint256.cpp):
+    high byte is a base-256 exponent, low 23 bits the mantissa, bit 0x00800000
+    the sign.
+    """
+    exponent = nbits >> 24
+    mantissa = nbits & 0x007FFFFF
+    if exponent <= 3:
+        word = mantissa >> (8 * (3 - exponent))
+        target = word
+        overflow = False
+    else:
+        word = mantissa
+        target = mantissa << (8 * (exponent - 3))
+        overflow = mantissa != 0 and (
+            exponent > 34
+            or (mantissa > 0xFF and exponent > 33)
+            or (mantissa > 0xFFFF and exponent > 32)
+        )
+    # Negative flag keys off the post-shift word, matching SetCompact.
+    negative = bool(nbits & 0x00800000) and word != 0
+    return target, negative, overflow
+
+
+def target_to_bits(target: int, negative: bool = False) -> int:
+    """Encode compact target (arith_uint256::GetCompact semantics)."""
+    if target == 0:
+        return 0
+    exponent = (target.bit_length() + 7) // 8
+    if exponent <= 3:
+        mantissa = target << (8 * (3 - exponent))
+    else:
+        mantissa = target >> (8 * (exponent - 3))
+    # Avoid the sign bit in the mantissa: shift one byte up if set.
+    if mantissa & 0x00800000:
+        mantissa >>= 8
+        exponent += 1
+    nbits = (exponent << 24) | mantissa
+    if negative and mantissa != 0:
+        nbits |= 0x00800000
+    return nbits
+
+
+def target_to_work(target: int) -> int:
+    """Block proof = ~target / (target+1) + 1 (ref GetBlockProof, chain.cpp)."""
+    if target <= 0 or target > U256_MAX:
+        return 0
+    return ((U256_MAX - target) // (target + 1)) + 1

@@ -1,0 +1,133 @@
+"""Hash primitives (parity: reference src/crypto/, src/hash.h).
+
+CPU-side single-shot hashing for consensus objects.  The batched/TPU variants
+live in :mod:`nodexa_chain_core_tpu.ops`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sha256d(data: bytes) -> bytes:
+    """Double SHA-256 (ref CHash256, src/hash.h)."""
+    return hashlib.sha256(hashlib.sha256(data).digest()).digest()
+
+
+def sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+def ripemd160(data: bytes) -> bytes:
+    try:
+        return hashlib.new("ripemd160", data).digest()
+    except (ValueError, TypeError):  # OpenSSL without legacy provider
+        from .ripemd160_py import ripemd160 as _rmd
+
+        return _rmd(data)
+
+
+def hash160(data: bytes) -> bytes:
+    """RIPEMD160(SHA256(x)) — address hashing (ref CHash160, src/hash.h)."""
+    return ripemd160(sha256(data))
+
+
+def hmac_sha512(key: bytes, data: bytes) -> bytes:
+    return _hmac.new(key, data, hashlib.sha512).digest()
+
+
+def hash256_int(data: bytes) -> int:
+    """sha256d as LE uint256 int (the txid/blockhash carrier used repo-wide)."""
+    return int.from_bytes(sha256d(data), "little")
+
+
+# --- SipHash-2-4 (ref src/crypto/siphash (hasher.h); used for short tx ids) ---
+
+_MASK64 = (1 << 64) - 1
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _MASK64
+
+
+def _sipround(v0, v1, v2, v3):
+    v0 = (v0 + v1) & _MASK64
+    v1 = _rotl64(v1, 13) ^ v0
+    v0 = _rotl64(v0, 32)
+    v2 = (v2 + v3) & _MASK64
+    v3 = _rotl64(v3, 16) ^ v2
+    v0 = (v0 + v3) & _MASK64
+    v3 = _rotl64(v3, 21) ^ v0
+    v2 = (v2 + v1) & _MASK64
+    v1 = _rotl64(v1, 17) ^ v2
+    v2 = _rotl64(v2, 32)
+    return v0, v1, v2, v3
+
+
+def siphash(k0: int, k1: int, data: bytes) -> int:
+    """SipHash-2-4 returning a 64-bit int."""
+    v0 = 0x736F6D6570736575 ^ k0
+    v1 = 0x646F72616E646F6D ^ k1
+    v2 = 0x6C7967656E657261 ^ k0
+    v3 = 0x7465646279746573 ^ k1
+    n = len(data)
+    full = n - (n % 8)
+    for i in range(0, full, 8):
+        m = int.from_bytes(data[i : i + 8], "little")
+        v3 ^= m
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+        v0 ^= m
+    tail = data[full:]
+    b = (n & 0xFF) << 56 | int.from_bytes(tail.ljust(8, b"\x00")[:7] + b"\x00", "little")
+    v3 ^= b
+    v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    v0 ^= b
+    v2 ^= 0xFF
+    for _ in range(4):
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    return (v0 ^ v1 ^ v2 ^ v3) & _MASK64
+
+
+def siphash_u256(k0: int, k1: int, u256: int) -> int:
+    """SipHash of a uint256 value (ref SipHashUint256) — keyed short ids."""
+    return siphash(k0, k1, u256.to_bytes(32, "little"))
+
+
+# --- MurmurHash3 32-bit (ref src/hash.cpp MurmurHash3; BIP37 bloom filters) ---
+
+
+def murmur3(seed: int, data: bytes) -> int:
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    full = len(data) - (len(data) % 4)
+    for i in range(0, full, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[full:]
+    for i in reversed(range(len(tail))):
+        k = (k << 8) | tail[i]
+    if tail:
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h

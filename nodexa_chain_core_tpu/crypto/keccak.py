@@ -1,0 +1,105 @@
+"""Keccak permutations + legacy-pad Keccak-256/512.
+
+Parity: reference src/crypto/ethash keccak (KawPow seed/final hashing uses
+keccak-f[800]; ethash cache/DAG uses keccak-f[1600] with the ORIGINAL Keccak
+0x01 domain padding, not SHA-3's 0x06).  CPU reference implementation; the
+batched TPU variant is in ops/keccak_jax.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+_ROUNDS_1600 = 24
+_ROUNDS_800 = 22
+
+# Round constants for keccak-f[1600]; f[800] uses the low 32 bits of the
+# first 22 of these.
+RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# Rotation offsets indexed [x][y] per the Keccak spec.
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+
+def _keccak_f(state: List[int], width_bits: int, lane_bits: int, rounds: int) -> None:
+    mask = (1 << lane_bits) - 1
+
+    def rotl(v: int, r: int) -> int:
+        r %= lane_bits
+        return ((v << r) | (v >> (lane_bits - r))) & mask
+
+    a = state
+    for rnd in range(rounds):
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], _ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & mask & b[(x + 2) % 5 + 5 * y])
+        # iota
+        a[0] ^= RC[rnd] & mask
+
+
+def keccak_f1600(state: List[int]) -> None:
+    """In-place permutation on 25 64-bit lanes."""
+    _keccak_f(state, 1600, 64, _ROUNDS_1600)
+
+
+def keccak_f800(state: List[int]) -> None:
+    """In-place permutation on 25 32-bit lanes (ProgPoW's permutation)."""
+    _keccak_f(state, 800, 32, _ROUNDS_800)
+
+
+def _keccak(data: bytes, rate_bytes: int, out_bytes: int) -> bytes:
+    state = [0] * 25
+    # absorb with original keccak 0x01 padding
+    padded = bytearray(data)
+    padded.append(0x01)
+    while len(padded) % rate_bytes:
+        padded.append(0x00)
+    padded[-1] |= 0x80
+    for off in range(0, len(padded), rate_bytes):
+        block = padded[off : off + rate_bytes]
+        for i in range(rate_bytes // 8):
+            state[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        keccak_f1600(state)
+    # squeeze
+    out = bytearray()
+    while len(out) < out_bytes:
+        for i in range(rate_bytes // 8):
+            out += state[i].to_bytes(8, "little")
+            if len(out) >= out_bytes:
+                break
+        if len(out) < out_bytes:
+            keccak_f1600(state)
+    return bytes(out[:out_bytes])
+
+
+def keccak256(data: bytes) -> bytes:
+    return _keccak(data, 136, 32)
+
+
+def keccak512(data: bytes) -> bytes:
+    return _keccak(data, 72, 64)

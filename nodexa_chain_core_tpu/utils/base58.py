@@ -1,0 +1,43 @@
+"""Base58 / Base58Check (parity: reference src/base58.{h,cpp})."""
+
+from __future__ import annotations
+
+from ..crypto.hashes import sha256d
+
+ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_INDEX = {c: i for i, c in enumerate(ALPHABET)}
+
+
+def b58encode(data: bytes) -> str:
+    zeros = len(data) - len(data.lstrip(b"\x00"))
+    num = int.from_bytes(data, "big")
+    out = []
+    while num:
+        num, rem = divmod(num, 58)
+        out.append(ALPHABET[rem])
+    return "1" * zeros + "".join(reversed(out))
+
+
+def b58decode(s: str) -> bytes:
+    num = 0
+    for c in s:
+        if c not in _INDEX:
+            raise ValueError(f"invalid base58 character {c!r}")
+        num = num * 58 + _INDEX[c]
+    zeros = len(s) - len(s.lstrip("1"))
+    body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
+    return b"\x00" * zeros + body
+
+
+def b58check_encode(payload: bytes) -> str:
+    return b58encode(payload + sha256d(payload)[:4])
+
+
+def b58check_decode(s: str) -> bytes:
+    raw = b58decode(s)
+    if len(raw) < 4:
+        raise ValueError("base58check too short")
+    payload, checksum = raw[:-4], raw[-4:]
+    if sha256d(payload)[:4] != checksum:
+        raise ValueError("base58check checksum mismatch")
+    return payload

@@ -1,0 +1,77 @@
+"""Bech32 (BIP173) encode/decode (parity: reference src/bech32.{h,cpp}).
+
+The reference chain does not activate segwit addresses, but ships the codec;
+capability parity keeps it available.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_GEN = [0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3]
+
+
+def _polymod(values: List[int]) -> int:
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = ((chk & 0x1FFFFFF) << 5) ^ v
+        for i in range(5):
+            chk ^= _GEN[i] if ((top >> i) & 1) else 0
+    return chk
+
+
+def _hrp_expand(hrp: str) -> List[int]:
+    return [ord(c) >> 5 for c in hrp] + [0] + [ord(c) & 31 for c in hrp]
+
+
+def bech32_create_checksum(hrp: str, data: List[int]) -> List[int]:
+    values = _hrp_expand(hrp) + data
+    polymod = _polymod(values + [0] * 6) ^ 1
+    return [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def bech32_encode(hrp: str, data: List[int]) -> str:
+    combined = data + bech32_create_checksum(hrp, data)
+    return hrp + "1" + "".join(CHARSET[d] for d in combined)
+
+
+def bech32_decode(bech: str) -> Tuple[Optional[str], Optional[List[int]]]:
+    if any(ord(x) < 33 or ord(x) > 126 for x in bech) or (
+        bech.lower() != bech and bech.upper() != bech
+    ):
+        return None, None
+    bech = bech.lower()
+    pos = bech.rfind("1")
+    if pos < 1 or pos + 7 > len(bech) or len(bech) > 90:
+        return None, None
+    if not all(x in CHARSET for x in bech[pos + 1 :]):
+        return None, None
+    hrp = bech[:pos]
+    data = [CHARSET.find(x) for x in bech[pos + 1 :]]
+    if _polymod(_hrp_expand(hrp) + data) != 1:
+        return None, None
+    return hrp, data[:-6]
+
+
+def convertbits(data, frombits: int, tobits: int, pad: bool = True) -> Optional[List[int]]:
+    acc = 0
+    bits = 0
+    ret = []
+    maxv = (1 << tobits) - 1
+    max_acc = (1 << (frombits + tobits - 1)) - 1
+    for value in data:
+        if value < 0 or (value >> frombits):
+            return None
+        acc = ((acc << frombits) | value) & max_acc
+        bits += frombits
+        while bits >= tobits:
+            bits -= tobits
+            ret.append((acc >> bits) & maxv)
+    if pad:
+        if bits:
+            ret.append((acc << (tobits - bits)) & maxv)
+    elif bits >= frombits or ((acc << (tobits - bits)) & maxv):
+        return None
+    return ret

@@ -1,0 +1,50 @@
+import pytest
+
+from nodexa_chain_core_tpu.utils.base58 import (
+    b58check_decode,
+    b58check_encode,
+    b58decode,
+    b58encode,
+)
+from nodexa_chain_core_tpu.utils.bech32 import bech32_decode, bech32_encode, convertbits
+
+
+def test_base58_roundtrip():
+    for data in [b"", b"\x00", b"\x00\x00abc", bytes(range(32))]:
+        assert b58decode(b58encode(data)) == data
+
+
+def test_base58_known():
+    assert b58encode(b"hello world") == "StV1DL6CwTryKyV"
+    assert b58encode(b"\x00\x00hello world") == "11StV1DL6CwTryKyV"
+
+
+def test_base58check():
+    payload = b"\x3c" + bytes(20)  # Clore-style P2PKH version byte + hash160
+    s = b58check_encode(payload)
+    assert b58check_decode(s) == payload
+    with pytest.raises(ValueError):
+        b58check_decode(s[:-1] + ("1" if s[-1] != "1" else "2"))
+
+
+def test_bech32_bip173_valid():
+    for addr in [
+        "A12UEL5L",
+        "an83characterlonghumanreadablepartthatcontainsthenumber1andtheexcludedcharactersbio1tt5tgs",
+        "abcdef1qpzry9x8gf2tvdw0s3jn54khce6mua7lmqqqxw",
+    ]:
+        hrp, data = bech32_decode(addr)
+        assert hrp is not None
+        assert bech32_encode(hrp, data) == addr.lower()
+
+
+def test_bech32_invalid():
+    for addr in ["split1cheo2y9e2w", "pzry9x0s0muk", "1pzry9x0s0muk"]:
+        hrp, data = bech32_decode(addr)
+        assert hrp is None
+
+
+def test_convertbits_roundtrip():
+    data = list(bytes(range(20)))
+    five = convertbits(data, 8, 5)
+    assert convertbits(five, 5, 8, pad=False) == data

@@ -1,0 +1,65 @@
+import pytest
+
+from nodexa_chain_core_tpu.core.serialize import (
+    ByteReader,
+    ByteWriter,
+    SerializationError,
+    ser_compact_size,
+)
+
+
+def test_compact_size_roundtrip():
+    for n in [0, 1, 252, 253, 254, 0xFFFF, 0x10000, 0xFFFFFFFF, 0x1000000]:
+        if n > 0x02000000:
+            continue
+        r = ByteReader(ser_compact_size(n))
+        assert r.compact_size() == n
+        assert r.remaining() == 0
+
+
+def test_compact_size_encodings():
+    assert ser_compact_size(0) == b"\x00"
+    assert ser_compact_size(252) == b"\xfc"
+    assert ser_compact_size(253) == b"\xfd\xfd\x00"
+    assert ser_compact_size(0xFFFF) == b"\xfd\xff\xff"
+    assert ser_compact_size(0x10000) == b"\xfe\x00\x00\x01\x00"
+
+
+def test_non_canonical_compact_size_rejected():
+    with pytest.raises(SerializationError):
+        ByteReader(b"\xfd\x10\x00").compact_size()  # 16 encoded wide
+    with pytest.raises(SerializationError):
+        ByteReader(b"\xfe\x10\x00\x00\x00").compact_size()
+
+
+def test_int_roundtrips():
+    w = ByteWriter()
+    w.u8(0xAB).u16(0xBEEF).u32(0xDEADBEEF).u64(2**63 + 5).i32(-7).i64(-(2**40))
+    r = ByteReader(w.getvalue())
+    assert r.u8() == 0xAB
+    assert r.u16() == 0xBEEF
+    assert r.u32() == 0xDEADBEEF
+    assert r.u64() == 2**63 + 5
+    assert r.i32() == -7
+    assert r.i64() == -(2**40)
+
+
+def test_var_bytes_and_vector():
+    w = ByteWriter()
+    w.var_bytes(b"hello").vector([1, 2, 3], lambda wr, v: wr.u32(v))
+    r = ByteReader(w.getvalue())
+    assert r.var_bytes() == b"hello"
+    assert r.vector(lambda rr: rr.u32()) == [1, 2, 3]
+
+
+def test_read_past_end():
+    with pytest.raises(SerializationError):
+        ByteReader(b"ab").read(3)
+
+
+def test_hash256_field():
+    v = int.from_bytes(bytes(range(32)), "little")
+    w = ByteWriter()
+    w.hash256(v)
+    assert w.getvalue() == bytes(range(32))
+    assert ByteReader(w.getvalue()).hash256() == v

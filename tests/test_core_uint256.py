@@ -1,0 +1,54 @@
+from nodexa_chain_core_tpu.core.uint256 import (
+    bits_to_target,
+    target_to_bits,
+    target_to_work,
+    u256_from_hex,
+    u256_from_le,
+    u256_hex,
+    u256_to_le,
+)
+
+
+def test_le_roundtrip():
+    b = bytes(range(32))
+    assert u256_to_le(u256_from_le(b)) == b
+
+
+def test_hex_display_reversed():
+    v = u256_from_le(b"\x01" + b"\x00" * 31)
+    assert u256_hex(v) == "00" * 31 + "01"
+    assert u256_from_hex(u256_hex(v)) == v
+
+
+def test_compact_bitcoin_vectors():
+    # Classic vectors from arith_uint256 SetCompact semantics.
+    t, neg, ovf = bits_to_target(0x01003456)
+    assert (t, neg, ovf) == (0x00, False, False)
+    t, neg, ovf = bits_to_target(0x01123456)
+    assert t == 0x12
+    t, neg, ovf = bits_to_target(0x02008000)
+    assert t == 0x80
+    t, neg, ovf = bits_to_target(0x05009234)
+    assert t == 0x92340000
+    t, neg, ovf = bits_to_target(0x04923456)
+    assert neg is True
+    t, neg, ovf = bits_to_target(0x04123456)
+    assert t == 0x12345600
+    assert target_to_bits(0x12345600) == 0x04123456
+    # overflow
+    _, _, ovf = bits_to_target(0xFF123456)
+    assert ovf is True
+
+
+def test_compact_roundtrip_mainnet_limits():
+    # Bitcoin genesis bits and Clore-style kawpow limit.
+    for nbits in [0x1D00FFFF, 0x1E00FFFF, 0x207FFFFF, 0x1B0404CB]:
+        t, neg, ovf = bits_to_target(nbits)
+        assert not neg and not ovf
+        assert target_to_bits(t) == nbits
+
+
+def test_work_monotonic():
+    t1, _, _ = bits_to_target(0x207FFFFF)
+    t2, _, _ = bits_to_target(0x1D00FFFF)
+    assert target_to_work(t2) > target_to_work(t1) > 0

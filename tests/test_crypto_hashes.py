@@ -1,0 +1,91 @@
+from nodexa_chain_core_tpu.crypto.hashes import (
+    hash160,
+    murmur3,
+    ripemd160,
+    sha256,
+    sha256d,
+    siphash,
+)
+from nodexa_chain_core_tpu.crypto.keccak import keccak256, keccak512
+from nodexa_chain_core_tpu.crypto.ripemd160_py import ripemd160 as ripemd160_py
+
+
+def test_sha256d_known():
+    # sha256d("hello") — standard cross-implementation vector.
+    assert (
+        sha256d(b"hello").hex()
+        == "9595c9df90075148eb06860365df33584b75bff782a510c6cd4883a419833d50"
+    )
+
+
+def test_ripemd160_vectors():
+    vectors = {
+        b"": "9c1185a5c5e9fc54612808977ee8f548b2258d31",
+        b"abc": "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc",
+        b"message digest": "5d0689ef49d2fae572b881b123a85ffa21595f36",
+    }
+    for msg, want in vectors.items():
+        assert ripemd160(msg).hex() == want
+        assert ripemd160_py(msg).hex() == want
+
+
+def test_hash160():
+    # hash160 of an empty pubkey-like string
+    assert hash160(b"") == ripemd160(sha256(b""))
+
+
+def test_keccak256_vectors():
+    # Original Keccak (pre-SHA3 padding) — the variant ethash uses.
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+
+
+def test_keccak512_vectors():
+    assert keccak512(b"").hex() == (
+        "0eab42de4c3ceb9235fc91acffe746b29c29a8c366b7c60e4e67c466f36a4304"
+        "c00fa9caf9d87976ba469bcbe06713b435f091ef2769fb160cdab33d3670680e"
+    )
+
+
+def test_siphash_reference_vector():
+    # SipHash-2-4 official test vector: key 0x0706...00, msg 0x00..0e
+    k0 = 0x0706050403020100
+    k1 = 0x0F0E0D0C0B0A0908
+    msg = bytes(range(15))
+    assert siphash(k0, k1, msg) == 0xA129CA6149BE45E5
+
+
+def test_murmur3_bip37_vectors():
+    # From Bitcoin Core's hash_tests (MurmurHash3 used by BIP37).
+    assert murmur3(0x00000000, b"") == 0x00000000
+    assert murmur3(0xFBA4C795, b"") == 0x6A396F08
+    assert murmur3(0x00000000, b"\x00") == 0x514E28B7
+    assert murmur3(0x00000000, b"test") == 0xBA6BD213
+    assert murmur3(0x00000000, b"Hello, world!") == 0xC0363E43
+    assert murmur3(0x9747B28C, b"The quick brown fox jumps over the lazy dog") == 0x2FA826CD
+
+
+def test_review_fixes():
+    # format_money trims to >=2 decimals (ref FormatMoney)
+    from nodexa_chain_core_tpu.core.amount import COIN, format_money, parse_money
+    assert format_money(COIN) == "1.00"
+    assert format_money(COIN + 50) == "1.0000005"
+    # unicode digits rejected
+    import pytest
+    with pytest.raises(ValueError):
+        parse_money("١٢")
+    # negative flag uses post-shift word
+    from nodexa_chain_core_tpu.core.uint256 import bits_to_target
+    assert bits_to_target(0x01803456) == (0, False, False)
+    # var_str raises SerializationError on bad utf-8
+    from nodexa_chain_core_tpu.core.serialize import ByteReader, SerializationError
+    with pytest.raises(SerializationError):
+        ByteReader(b"\x02\xff\xfe").var_str()
+    with pytest.raises(SerializationError):
+        ByteReader(b"ab").peek(-1)
