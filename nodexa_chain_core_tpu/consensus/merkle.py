@@ -1,0 +1,45 @@
+"""Merkle tree computation (parity: reference src/consensus/merkle.{h,cpp}).
+
+Bitcoin-style: pair-wise sha256d over LE hash concatenations, odd levels
+duplicate the last element.  The duplication makes trees malleable
+(CVE-2012-2459); ``mutated`` reports a detected duplication the same way the
+reference's ComputeMerkleRoot does.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..crypto.hashes import sha256d
+from ..primitives.block import Block
+
+
+def merkle_root(hashes: List[int]) -> Tuple[int, bool]:
+    """Root over LE uint256 leaves → (root, mutated)."""
+    if not hashes:
+        return 0, False
+    mutated = False
+    level = list(hashes)
+    while len(level) > 1:
+        # Duplicate-pair scan happens before padding (matches the reference:
+        # the odd-element self-duplication is legitimate and not flagged).
+        for i in range(0, len(level) - 1, 2):
+            if level[i] == level[i + 1]:
+                mutated = True
+        if len(level) % 2:
+            level.append(level[-1])
+        level = [
+            int.from_bytes(
+                sha256d(
+                    level[i].to_bytes(32, "little")
+                    + level[i + 1].to_bytes(32, "little")
+                ),
+                "little",
+            )
+            for i in range(0, len(level), 2)
+        ]
+    return level[0], mutated
+
+
+def block_merkle_root(block: Block) -> Tuple[int, bool]:
+    return merkle_root([tx.txid for tx in block.vtx])

@@ -1,0 +1,59 @@
+"""PoW hash algorithm registry.
+
+The reference dispatches the header hash on activation times
+(``src/primitives/block.h:95-100``, ``block.cpp:38-114``): X16R → X16RV2 →
+KawPow.  Here each algorithm registers a callable so the header-era dispatch
+in :mod:`..primitives.block` stays table-driven; native (C extension) and
+TPU-batched implementations plug into the same names.
+
+``sha256d`` is registered out of the box: it is the bootstrap algorithm used
+by this framework's regtest until the native X16R family / KawPow verifier
+are wired in (documented divergence; dispatch structure is identical).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .hashes import sha256d
+
+# name -> fn(header_bytes) -> 32-byte LE pow hash
+_REGISTRY: Dict[str, Callable[[bytes], bytes]] = {}
+
+
+class UnknownPowAlgo(Exception):
+    pass
+
+
+def register(name: str, fn: Callable[[bytes], bytes]) -> None:
+    _REGISTRY[name] = fn
+
+
+def get(name: str) -> Callable[[bytes], bytes]:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise UnknownPowAlgo(
+            f"pow algo {name!r} not available (registered: {sorted(_REGISTRY)})"
+        ) from None
+
+
+def available(name: str) -> bool:
+    return name in _REGISTRY
+
+
+register("sha256d", sha256d)
+
+
+def _try_register_native() -> None:
+    """X16R/X16RV2 come from the native extension when built (task: native/)."""
+    try:
+        from . import x16r_native  # type: ignore
+
+        register("x16r", x16r_native.x16r)
+        register("x16rv2", x16r_native.x16rv2)
+    except ImportError:
+        pass
+
+
+_try_register_native()

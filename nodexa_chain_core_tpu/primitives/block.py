@@ -1,0 +1,188 @@
+"""Block header / block wire types with tri-era PoW serialization.
+
+Parity: reference ``src/primitives/block.h`` — ``CBlockHeader`` (:36) with
+the KawPow fields ``nHeight``/``nNonce64``/``mix_hash`` and the
+era-switching serialization (:67: headers whose ``nTime`` is before the
+KawPow activation serialize the legacy 80-byte form with a 32-bit nonce;
+later headers serialize the 120-byte form).  Hash selection parity:
+``GetX16RHash/GetX16RV2Hash/GetKAWPOWHeaderHash/GetHashFull``
+(block.h:95-100, block.cpp:38-114) — realized here as a table-driven
+dispatch over :mod:`..crypto.powhash`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.serialize import ByteReader, ByteWriter, Serializable
+from ..core.uint256 import u256_hex
+from ..crypto import powhash
+from ..crypto.hashes import sha256d
+from .transaction import Transaction
+
+
+@dataclass
+class AlgoSchedule:
+    """Per-network PoW era schedule (ref chainparams' activation timestamps).
+
+    ``legacy``/``mid``/``pow`` name the registered algorithms for the three
+    eras (reference: X16R / X16RV2 / KawPow).  The framework's regtest
+    bootstrap uses sha256d for the legacy era until the native algos land.
+    """
+
+    mid_activation_time: int = 1 << 62  # X16RV2 era start (nTime-based)
+    kawpow_activation_time: int = 1 << 62  # KawPow era start
+    legacy_algo: str = "x16r"
+    mid_algo: str = "x16rv2"
+    pow_algo: str = "kawpow"
+
+    def era_algo(self, ntime: int) -> str:
+        if ntime >= self.kawpow_activation_time:
+            return self.pow_algo
+        if ntime >= self.mid_activation_time:
+            return self.mid_algo
+        return self.legacy_algo
+
+    def is_kawpow(self, ntime: int) -> bool:
+        return ntime >= self.kawpow_activation_time
+
+
+# Active schedule; selected by chainparams (mirrors the reference's global
+# activation-time variables consulted from CBlockHeader serialization).
+_ACTIVE = AlgoSchedule(legacy_algo="sha256d")
+
+
+def set_active_schedule(s: AlgoSchedule) -> None:
+    global _ACTIVE
+    _ACTIVE = s
+
+
+def active_schedule() -> AlgoSchedule:
+    return _ACTIVE
+
+
+@dataclass
+class BlockHeader(Serializable):
+    version: int = 0
+    hash_prev: int = 0
+    hash_merkle_root: int = 0
+    time: int = 0
+    bits: int = 0
+    nonce: int = 0  # legacy 32-bit nonce (pre-KawPow eras)
+    # KawPow-era fields (ref block.h:51-53)
+    height: int = 0
+    nonce64: int = 0
+    mix_hash: int = 0
+    _cached_hash: Optional[int] = field(default=None, repr=False, compare=False)
+
+    # -- serialization (era switch on nTime; ref block.h:67) --------------
+
+    def serialize(self, w: ByteWriter, schedule: Optional[AlgoSchedule] = None) -> None:
+        s = schedule or _ACTIVE
+        w.i32(self.version)
+        w.hash256(self.hash_prev)
+        w.hash256(self.hash_merkle_root)
+        w.u32(self.time)
+        w.u32(self.bits)
+        if s.is_kawpow(self.time):
+            w.u32(self.height)
+            w.u64(self.nonce64)
+            w.hash256(self.mix_hash)
+        else:
+            w.u32(self.nonce)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader, schedule: Optional[AlgoSchedule] = None) -> "BlockHeader":
+        s = schedule or _ACTIVE
+        h = cls(
+            version=r.i32(),
+            hash_prev=r.hash256(),
+            hash_merkle_root=r.hash256(),
+            time=r.u32(),
+            bits=r.u32(),
+        )
+        if s.is_kawpow(h.time):
+            h.height = r.u32()
+            h.nonce64 = r.u64()
+            h.mix_hash = r.hash256()
+        else:
+            h.nonce = r.u32()
+        return h
+
+    # -- hashing -----------------------------------------------------------
+
+    def pow_header_bytes(self, schedule: Optional[AlgoSchedule] = None) -> bytes:
+        """Bytes the era's PoW hash runs over.
+
+        Pre-KawPow: the full 80-byte header.  KawPow: the "header hash"
+        input excludes nonce64/mix_hash (ref GetKAWPOWHeaderHash,
+        block.cpp — sha256d over version..bits+height).
+        """
+        s = schedule or _ACTIVE
+        w = ByteWriter()
+        w.i32(self.version)
+        w.hash256(self.hash_prev)
+        w.hash256(self.hash_merkle_root)
+        w.u32(self.time)
+        w.u32(self.bits)
+        if s.is_kawpow(self.time):
+            w.u32(self.height)
+        else:
+            w.u32(self.nonce)
+        return w.getvalue()
+
+    def kawpow_header_hash(self, schedule: Optional[AlgoSchedule] = None) -> bytes:
+        """ProgPoW seed input (ref GetKAWPOWHeaderHash)."""
+        return sha256d(self.pow_header_bytes(schedule))
+
+    def get_hash(self, schedule: Optional[AlgoSchedule] = None) -> int:
+        """Block identity hash == era PoW hash (ref GetHashFull/GetHash)."""
+        if self._cached_hash is not None:
+            return self._cached_hash
+        s = schedule or _ACTIVE
+        algo = s.era_algo(self.time)
+        if algo == "kawpow":
+            from . import kawpow_glue  # lazy: needs DAG machinery
+
+            digest = kawpow_glue.block_hash(self, s)
+        else:
+            digest = powhash.get(algo)(self.pow_header_bytes(s))
+        self._cached_hash = int.from_bytes(digest, "little")
+        return self._cached_hash
+
+    def rehash(self) -> int:
+        self._cached_hash = None
+        return self.get_hash()
+
+    @property
+    def hash_hex(self) -> str:
+        return u256_hex(self.get_hash())
+
+    def is_null(self) -> bool:
+        return self.bits == 0
+
+
+@dataclass
+class Block(Serializable):
+    """Header + transactions (ref block.h:115)."""
+
+    header: BlockHeader = field(default_factory=BlockHeader)
+    vtx: List[Transaction] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter, schedule: Optional[AlgoSchedule] = None) -> None:
+        self.header.serialize(w, schedule)
+        w.vector(self.vtx, lambda wr, tx: tx.serialize(wr))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader, schedule: Optional[AlgoSchedule] = None) -> "Block":
+        header = BlockHeader.deserialize(r, schedule)
+        vtx = r.vector(Transaction.deserialize)
+        return cls(header=header, vtx=vtx)
+
+    def get_hash(self) -> int:
+        return self.header.get_hash()
+
+    @property
+    def hash_hex(self) -> str:
+        return self.header.hash_hex

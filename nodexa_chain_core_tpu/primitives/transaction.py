@@ -1,0 +1,162 @@
+"""Transaction wire types.
+
+Parity: reference ``src/primitives/transaction.h`` — ``COutPoint`` (:21),
+``CTxIn`` (:69), ``CTxOut`` (:139), ``CTransaction`` (:272).  Serialization
+is the Bitcoin format; witness framing (marker/flag) is supported for
+protocol parity even though segwit never activates on this chain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.serialize import ByteReader, ByteWriter, Serializable
+from ..core.uint256 import u256_hex
+from ..crypto.hashes import hash256_int
+
+SEQUENCE_FINAL = 0xFFFFFFFF
+
+
+@dataclass
+class OutPoint:
+    """Reference to a transaction output (ref transaction.h:21)."""
+
+    txid: int = 0
+    n: int = 0xFFFFFFFF
+
+    def is_null(self) -> bool:
+        return self.txid == 0 and self.n == 0xFFFFFFFF
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.hash256(self.txid).u32(self.n)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "OutPoint":
+        return cls(txid=r.hash256(), n=r.u32())
+
+    def __hash__(self):
+        return hash((self.txid, self.n))
+
+    def __repr__(self):
+        return f"OutPoint({u256_hex(self.txid)[:16]}…,{self.n})"
+
+
+@dataclass
+class TxIn:
+    """Transaction input (ref transaction.h:69)."""
+
+    prevout: OutPoint = field(default_factory=OutPoint)
+    script_sig: bytes = b""
+    sequence: int = SEQUENCE_FINAL
+    witness: List[bytes] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter) -> None:
+        self.prevout.serialize(w)
+        w.var_bytes(self.script_sig).u32(self.sequence)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "TxIn":
+        return cls(
+            prevout=OutPoint.deserialize(r),
+            script_sig=r.var_bytes(),
+            sequence=r.u32(),
+        )
+
+
+@dataclass
+class TxOut:
+    """Transaction output (ref transaction.h:139)."""
+
+    value: int = -1
+    script_pubkey: bytes = b""
+
+    def is_null(self) -> bool:
+        return self.value == -1
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.i64(self.value).var_bytes(self.script_pubkey)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "TxOut":
+        return cls(value=r.i64(), script_pubkey=r.var_bytes())
+
+
+@dataclass
+class Transaction(Serializable):
+    """Immutable-by-convention transaction (ref transaction.h:272).
+
+    ``txid`` is the sha256d of the no-witness serialization; cached after
+    first computation and invalidated via :meth:`rehash`.
+    """
+
+    version: int = 2
+    vin: List[TxIn] = field(default_factory=list)
+    vout: List[TxOut] = field(default_factory=list)
+    locktime: int = 0
+    _txid: Optional[int] = field(default=None, repr=False, compare=False)
+
+    # -- serialization ----------------------------------------------------
+
+    def serialize(self, w: ByteWriter, with_witness: bool = True) -> None:
+        has_wit = with_witness and any(i.witness for i in self.vin)
+        w.i32(self.version)
+        if has_wit:
+            w.u8(0).u8(1)  # segwit marker + flag
+        w.vector(self.vin, lambda wr, i: i.serialize(wr))
+        w.vector(self.vout, lambda wr, o: o.serialize(wr))
+        if has_wit:
+            for i in self.vin:
+                w.vector(i.witness, lambda wr, item: wr.var_bytes(item))
+        w.u32(self.locktime)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "Transaction":
+        version = r.i32()
+        vin = r.vector(TxIn.deserialize)
+        has_wit = False
+        if not vin and r.remaining() and r.peek(1) == b"\x01":
+            # empty-vin + flag byte => segwit framing
+            r.u8()
+            has_wit = True
+            vin = r.vector(TxIn.deserialize)
+        vout = r.vector(TxOut.deserialize)
+        if has_wit:
+            for i in vin:
+                i.witness = r.vector(lambda rr: rr.var_bytes())
+        return cls(version=version, vin=vin, vout=vout, locktime=r.u32())
+
+    def to_bytes(self, with_witness: bool = True) -> bytes:
+        w = ByteWriter()
+        self.serialize(w, with_witness=with_witness)
+        return w.getvalue()
+
+    # -- identity ---------------------------------------------------------
+
+    @property
+    def txid(self) -> int:
+        if self._txid is None:
+            self._txid = hash256_int(self.to_bytes(with_witness=False))
+        return self._txid
+
+    def rehash(self) -> int:
+        self._txid = None
+        return self.txid
+
+    @property
+    def txid_hex(self) -> str:
+        return u256_hex(self.txid)
+
+    # -- semantics --------------------------------------------------------
+
+    def is_coinbase(self) -> bool:
+        return len(self.vin) == 1 and self.vin[0].prevout.is_null()
+
+    def is_null(self) -> bool:
+        return not self.vin and not self.vout
+
+    def total_output_value(self) -> int:
+        return sum(o.value for o in self.vout)
+
+    def total_size(self) -> int:
+        return len(self.to_bytes())

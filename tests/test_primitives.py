@@ -1,0 +1,166 @@
+"""Primitives tests.
+
+The Bitcoin genesis block is used as a cross-implementation known vector: it
+exercises 80-byte header serialization, coinbase tx serialization, txid
+hashing, and merkle-root computation against universally published hashes.
+"""
+
+import pytest
+
+from nodexa_chain_core_tpu.consensus.merkle import block_merkle_root, merkle_root
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.core.uint256 import u256_from_hex, u256_hex
+from nodexa_chain_core_tpu.primitives.block import (
+    AlgoSchedule,
+    Block,
+    BlockHeader,
+)
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+
+PRE_KAWPOW = AlgoSchedule(
+    mid_activation_time=1 << 62, kawpow_activation_time=1 << 62, legacy_algo="sha256d"
+)
+ALL_KAWPOW = AlgoSchedule(
+    mid_activation_time=0, kawpow_activation_time=0, legacy_algo="sha256d"
+)
+
+
+def make_bitcoin_genesis() -> Block:
+    psz = b"The Times 03/Jan/2009 Chancellor on brink of second bailout for banks"
+    script_sig = (
+        bytes([0x04]) + (486604799).to_bytes(4, "little")
+        + bytes([0x01, 0x04])
+        + bytes([len(psz)]) + psz
+    )
+    pubkey = bytes.fromhex(
+        "04678afdb0fe5548271967f1a67130b7105cd6a828e03909a67962e0ea1f61deb6"
+        "49f6bc3f4cef38c4f35504e51ec112de5c384df7ba0b8d578a4c702b6bf11d5f"
+    )
+    spk = bytes([0x41]) + pubkey + bytes([0xAC])  # push65 <pubkey> OP_CHECKSIG
+    tx = Transaction(
+        version=1,
+        vin=[TxIn(prevout=OutPoint(), script_sig=script_sig, sequence=0xFFFFFFFF)],
+        vout=[TxOut(value=50 * 100_000_000, script_pubkey=spk)],
+        locktime=0,
+    )
+    header = BlockHeader(
+        version=1,
+        hash_prev=0,
+        hash_merkle_root=tx.txid,
+        time=1231006505,
+        bits=0x1D00FFFF,
+        nonce=2083236893,
+    )
+    return Block(header=header, vtx=[tx])
+
+
+def test_bitcoin_genesis_txid():
+    blk = make_bitcoin_genesis()
+    assert (
+        blk.vtx[0].txid_hex
+        == "4a5e1e4baab89f3a32518a88c31bc87f618f76673e2cc77ab2127b7afdeda33b"
+    )
+
+
+def test_bitcoin_genesis_header_hash():
+    blk = make_bitcoin_genesis()
+    assert (
+        u256_hex(blk.header.get_hash(PRE_KAWPOW))
+        == "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+    )
+    assert len(blk.header.pow_header_bytes(PRE_KAWPOW)) == 80
+
+
+def test_bitcoin_genesis_merkle():
+    blk = make_bitcoin_genesis()
+    root, mutated = block_merkle_root(blk)
+    assert root == blk.header.hash_merkle_root
+    assert not mutated
+
+
+def test_header_serialization_eras():
+    h = BlockHeader(
+        version=0x20000000,
+        hash_prev=u256_from_hex("aa" * 32),
+        hash_merkle_root=u256_from_hex("bb" * 32),
+        time=1700000000,
+        bits=0x1B0404CB,
+        nonce=42,
+        height=12345,
+        nonce64=0x1122334455667788,
+        mix_hash=u256_from_hex("cc" * 32),
+    )
+    w = ByteWriter()
+    h.serialize(w, PRE_KAWPOW)
+    assert len(w.getvalue()) == 80
+    back = BlockHeader.deserialize(ByteReader(w.getvalue()), PRE_KAWPOW)
+    assert back.nonce == 42 and back.height == 0
+
+    w = ByteWriter()
+    h.serialize(w, ALL_KAWPOW)
+    assert len(w.getvalue()) == 120  # ref block.h:67 post-KawPow form
+    back = BlockHeader.deserialize(ByteReader(w.getvalue()), ALL_KAWPOW)
+    assert back.height == 12345
+    assert back.nonce64 == 0x1122334455667788
+    assert back.mix_hash == u256_from_hex("cc" * 32)
+
+
+def test_kawpow_pow_header_excludes_nonce():
+    h = BlockHeader(version=2, time=100, bits=0x207FFFFF, height=7, nonce64=999)
+    b1 = h.pow_header_bytes(ALL_KAWPOW)
+    h.nonce64 = 123456
+    assert h.pow_header_bytes(ALL_KAWPOW) == b1  # nonce64 not in seed input
+    assert len(b1) == 80  # version..bits (76) + height (4); nonce64/mix excluded
+
+
+def test_tx_roundtrip_with_witness():
+    tx = Transaction(
+        version=2,
+        vin=[
+            TxIn(
+                prevout=OutPoint(txid=5, n=1),
+                script_sig=b"\x51",
+                sequence=0xFFFFFFFE,
+                witness=[b"w1", b"w22"],
+            )
+        ],
+        vout=[TxOut(value=1000, script_pubkey=b"\x76\xa9")],
+        locktime=99,
+    )
+    back = Transaction.from_bytes(tx.to_bytes())
+    assert back.vin[0].witness == [b"w1", b"w22"]
+    assert back.locktime == 99
+    # txid ignores witness
+    assert back.txid == Transaction.from_bytes(tx.to_bytes(with_witness=False)).txid
+
+
+def test_merkle_mutation_detection():
+    a, b = 111, 222
+    root2, mut2 = merkle_root([a, b])
+    assert not mut2
+    # duplicated pair => CVE-2012-2459-style mutation flagged
+    _, mut_dup = merkle_root([a, b, a, b])
+    root_dup, _ = merkle_root([a, b, a, b])
+    assert merkle_root([a, b])[0] != root_dup
+    _, mut_same = merkle_root([a, a])
+    assert mut_same
+    # odd duplication (legitimate padding) is NOT flagged
+    _, mut_odd = merkle_root([a, b, 333])
+    assert not mut_odd
+
+
+def test_merkle_single_and_empty():
+    assert merkle_root([]) == (0, False)
+    assert merkle_root([777]) == (777, False)
+
+
+def test_coinbase_detection():
+    blk = make_bitcoin_genesis()
+    assert blk.vtx[0].is_coinbase()
+    spend = Transaction(vin=[TxIn(prevout=OutPoint(txid=1, n=0))], vout=[TxOut(1, b"")])
+    assert not spend.is_coinbase()
