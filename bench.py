@@ -1,0 +1,80 @@
+"""Benchmark: batched SHA-256d PoW search throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference has no published numbers (BASELINE.md: its only analogue is the
+single-threaded C++ miner loop / bench_clore's scalar SHA256 microbench), so
+``vs_baseline`` is the measured speedup of the TPU batched kernel over a
+single-core CPU hashlib implementation of the exact same double-SHA256 header
+work, computed in-run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import time
+
+
+def cpu_rate(prefix: bytes, n: int = 30_000) -> float:
+    start = time.perf_counter()
+    for nonce in range(n):
+        h = prefix + nonce.to_bytes(4, "little")
+        hashlib.sha256(hashlib.sha256(h).digest()).digest()
+    return n / (time.perf_counter() - start)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from nodexa_chain_core_tpu.ops import sha256_jax as s256
+
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}", file=sys.stderr)
+
+    batch = 1 << 20
+    prefix = bytes(i % 251 for i in range(76))
+    words = [int.from_bytes(prefix[4 * i : 4 * i + 4], "big") for i in range(19)]
+    mid = s256.midstate(jnp.array(words[:16], dtype=jnp.uint32))
+    tail3 = jnp.array(words[16:19], dtype=jnp.uint32)
+    target_le = s256.target_to_le_words(1 << 220)
+
+    @jax.jit
+    def scan(nonce0):
+        nonces = nonce0.astype(jnp.uint32) + jnp.arange(batch, dtype=jnp.uint32)
+        block2 = s256.search_tail_block(tail3, nonces)
+        st = s256.compress(jnp.broadcast_to(mid, (batch, 8)), block2)
+        digest = s256.sha256_words(s256._digest_block(st)[..., None, :])
+        ok = s256.le256_leq(s256.digest_le_words(digest), target_le)
+        return jnp.any(ok), jnp.sum(ok)
+
+    # compile + warm up
+    jax.block_until_ready(scan(jnp.uint32(0)))
+
+    steps = 20
+    start = time.perf_counter()
+    for i in range(steps):
+        out = scan(jnp.uint32(i * batch))
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - start
+    tpu_hs = steps * batch / elapsed
+
+    cpu_hs = cpu_rate(prefix)
+    print(f"tpu: {tpu_hs:,.0f} H/s  cpu(1-core hashlib): {cpu_hs:,.0f} H/s", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "sha256d_pow_search_throughput",
+                "value": round(tpu_hs),
+                "unit": "hashes/s",
+                "vs_baseline": round(tpu_hs / cpu_hs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""In-memory block index (parity: reference src/chain.h CBlockIndex).
+
+Each entry owns the header fields plus chain bookkeeping (height, cumulative
+work, validity status, file positions come later with storage).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.uint256 import target_to_work, bits_to_target
+from ..primitives.block import AlgoSchedule, BlockHeader
+
+
+class BlockStatus(enum.IntFlag):
+    """Validity levels (ref chain.h BlockStatus)."""
+
+    VALID_UNKNOWN = 0
+    VALID_HEADER = 1
+    VALID_TREE = 2
+    VALID_TRANSACTIONS = 3
+    VALID_CHAIN = 4
+    VALID_SCRIPTS = 5
+    VALID_MASK = 7
+    HAVE_DATA = 8
+    HAVE_UNDO = 16
+    FAILED_VALID = 32
+    FAILED_CHILD = 64
+    FAILED_MASK = 96
+
+
+@dataclass
+class BlockIndex:
+    header: BlockHeader
+    prev: Optional["BlockIndex"] = None
+    height: int = 0
+    chain_work: int = 0
+    status: BlockStatus = BlockStatus.VALID_UNKNOWN
+    tx_count: int = 0
+    chain_tx_count: int = 0  # cumulative txs up to and including this block
+    _hash: Optional[int] = None
+    # skip-list pointer for O(log n) ancestor walks (ref chain.h pskip)
+    skip: Optional["BlockIndex"] = field(default=None, repr=False)
+
+    @property
+    def block_hash(self) -> int:
+        if self._hash is None:
+            self._hash = self.header.get_hash()
+        return self._hash
+
+    @property
+    def time(self) -> int:
+        return self.header.time
+
+    @property
+    def bits(self) -> int:
+        return self.header.bits
+
+    def build_from_prev(self) -> None:
+        """Fill height/work/skip from the prev pointer."""
+        if self.prev is not None:
+            self.height = self.prev.height + 1
+            target, neg, ovf = bits_to_target(self.header.bits)
+            work = 0 if (neg or ovf) else target_to_work(target)
+            self.chain_work = self.prev.chain_work + work
+            self.skip = self.prev.get_ancestor(_skip_height(self.height))
+        else:
+            target, neg, ovf = bits_to_target(self.header.bits)
+            self.chain_work = 0 if (neg or ovf) else target_to_work(target)
+
+    def get_ancestor(self, height: int) -> Optional["BlockIndex"]:
+        """Skip-list ancestor lookup (ref chain.cpp GetAncestor)."""
+        if height > self.height or height < 0:
+            return None
+        walk: BlockIndex = self
+        h = self.height
+        while h > height:
+            h_skip = _skip_height(h)
+            h_skip_prev = _skip_height(h - 1)
+            if walk.skip is not None and (
+                h_skip == height
+                or (
+                    h_skip > height
+                    and not (h_skip_prev < h_skip - 2 and h_skip_prev >= height)
+                )
+            ):
+                walk = walk.skip
+                h = h_skip
+            else:
+                assert walk.prev is not None
+                walk = walk.prev
+                h -= 1
+        return walk
+
+    def median_time_past(self, span: int = 11) -> int:
+        """Median of last `span` block times (ref chain.h GetMedianTimePast)."""
+        times: List[int] = []
+        idx: Optional[BlockIndex] = self
+        for _ in range(span):
+            if idx is None:
+                break
+            times.append(idx.time)
+            idx = idx.prev
+        times.sort()
+        return times[len(times) // 2]
+
+    def is_valid(self, up_to: BlockStatus = BlockStatus.VALID_TRANSACTIONS) -> bool:
+        if self.status & BlockStatus.FAILED_MASK:
+            return False
+        return (self.status & BlockStatus.VALID_MASK) >= up_to
+
+    def raise_validity(self, up_to: BlockStatus) -> None:
+        if self.status & BlockStatus.FAILED_MASK:
+            return
+        if (self.status & BlockStatus.VALID_MASK) < up_to:
+            self.status = BlockStatus(
+                (self.status & ~BlockStatus.VALID_MASK) | up_to
+            )
+
+
+def _skip_height(height: int) -> int:
+    """Skip-target heights, ~2 levels of ancestry jumps (ref chain.cpp)."""
+    if height < 2:
+        return 0
+    # invert lowest set bit pattern: same shape as the reference's
+    # GetSkipHeight, producing exponentially spaced jumps
+    if height & 1:
+        return _invert_lowest_one(_invert_lowest_one(height - 1)) + 1
+    return _invert_lowest_one(height)
+
+
+def _invert_lowest_one(n: int) -> int:
+    return n & (n - 1)
+
+
+class Chain:
+    """The active chain as a height-indexed array (ref chain.h CChain)."""
+
+    def __init__(self) -> None:
+        self._v: List[BlockIndex] = []
+
+    def genesis(self) -> Optional[BlockIndex]:
+        return self._v[0] if self._v else None
+
+    def tip(self) -> Optional[BlockIndex]:
+        return self._v[-1] if self._v else None
+
+    def height(self) -> int:
+        return len(self._v) - 1
+
+    def at(self, height: int) -> Optional[BlockIndex]:
+        if 0 <= height < len(self._v):
+            return self._v[height]
+        return None
+
+    def __contains__(self, index: BlockIndex) -> bool:
+        return self.at(index.height) is index
+
+    def __iter__(self):
+        return iter(self._v)
+
+    def set_tip(self, index: Optional[BlockIndex]) -> None:
+        """Rewrite the array to end at `index` (ref CChain::SetTip)."""
+        if index is None:
+            self._v = []
+            return
+        self._v = self._v[: index.height + 1] + [None] * max(
+            0, index.height + 1 - len(self._v)
+        )
+        walk: Optional[BlockIndex] = index
+        while walk is not None and (
+            walk.height >= len(self._v) or self._v[walk.height] is not walk
+        ):
+            if walk.height < len(self._v):
+                self._v[walk.height] = walk
+            walk = walk.prev
+
+    def find_fork(self, index: Optional[BlockIndex]) -> Optional[BlockIndex]:
+        """Last common ancestor with the active chain (ref FindFork)."""
+        if index is None:
+            return None
+        if index.height > self.height():
+            index = index.get_ancestor(self.height())
+        while index is not None and index not in self:
+            index = index.prev
+        return index
+
+    def next(self, index: BlockIndex) -> Optional[BlockIndex]:
+        if index in self:
+            return self.at(index.height + 1)
+        return None

@@ -1,0 +1,64 @@
+"""Consensus parameters (parity: reference src/consensus/params.h).
+
+Six BIP9 deployments (ref src/chainparams.cpp:124-153): TESTDUMMY (bit 28),
+ASSETS (6), MSG_REST_ASSETS (7), TRANSFER_SCRIPT_SIZE (8), ENFORCE_VALUE (9),
+COINBASE_ASSETS (10), each with optional per-deployment threshold/window
+overrides.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+# Deployment identifiers (ref consensus/params.h DeploymentPos)
+DEPLOYMENT_TESTDUMMY = "testdummy"
+DEPLOYMENT_ASSETS = "assets"
+DEPLOYMENT_MSG_REST_ASSETS = "msg_rest_assets"
+DEPLOYMENT_TRANSFER_SCRIPT_SIZE = "transfer_script_size"
+DEPLOYMENT_ENFORCE_VALUE = "enforce_value"
+DEPLOYMENT_COINBASE_ASSETS = "coinbase_assets"
+
+ALWAYS_ACTIVE = -1  # nStartTime sentinel
+NEVER_ACTIVE = 1 << 62
+
+
+@dataclass
+class Deployment:
+    """BIP9 deployment (ref consensus/params.h BIP9Deployment)."""
+
+    bit: int
+    start_time: int
+    timeout: int
+    override_threshold: Optional[int] = None
+    override_window: Optional[int] = None
+
+
+@dataclass
+class ConsensusParams:
+    subsidy_halving_interval: int = 2_100_000
+    bip34_enabled: bool = True
+    bip65_enabled: bool = True
+    bip66_enabled: bool = True
+    pow_limit: int = (1 << 248) - 1  # 0x00ff..ff (ref chainparams.cpp:116)
+    kawpow_limit: int = (1 << 248) - 1
+    pow_target_timespan: int = 2016 * 60
+    pow_target_spacing: int = 60
+    pow_allow_min_difficulty_blocks: bool = False
+    pow_no_retargeting: bool = False
+    rule_change_activation_threshold: int = 1613  # ~80% of 2016
+    miner_confirmation_window: int = 2016
+    deployments: Dict[str, Deployment] = field(default_factory=dict)
+    minimum_chain_work: int = 0
+    default_assume_valid: int = 0
+    # Fork heights / times (ref chainparams.cpp per-network fields)
+    dgw_activation_height: int = 1
+    asset_activation_height: int = 1
+    max_reorg_depth: int = 60
+    min_reorg_peers: int = 4
+    min_reorg_age: int = 60 * 60 * 12
+    x16rv2_activation_time: int = NEVER_ACTIVE
+    kawpow_activation_time: int = NEVER_ACTIVE
+
+    def difficulty_adjustment_interval(self) -> int:
+        return self.pow_target_timespan // self.pow_target_spacing

@@ -1,0 +1,142 @@
+"""Difficulty rules (parity: reference src/pow.cpp).
+
+``dark_gravity_wave`` mirrors DarkGravityWave v3 (ref pow.cpp:18-102):
+180-block recency-weighted target average, timespan clamped to [T/3, 3T],
+with the KawPow transition special case — while fewer than 180 KawPow-era
+blocks exist, a KawPow-era block retargets at ``kawpow_limit``.
+``get_next_work_required`` dispatches DGW vs the legacy Bitcoin 2016-block
+retarget on the DGW activation height (ref pow.cpp:140-155).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..chain.blockindex import BlockIndex
+from ..core.uint256 import bits_to_target, target_to_bits
+from .params import ConsensusParams
+
+DGW_PAST_BLOCKS = 180  # ref pow.cpp:24 (~3h at 60s spacing)
+
+
+def check_proof_of_work(hash_int: int, nbits: int, params: ConsensusParams) -> bool:
+    """ref pow.cpp:182-199."""
+    target, negative, overflow = bits_to_target(nbits)
+    if negative or target == 0 or overflow or target > params.pow_limit:
+        return False
+    return hash_int <= target
+
+
+def dark_gravity_wave(
+    tip: BlockIndex, new_block_time: int, params: ConsensusParams
+) -> int:
+    pow_limit_bits = target_to_bits(params.pow_limit)
+
+    if tip is None or tip.height < DGW_PAST_BLOCKS:
+        return pow_limit_bits
+
+    if params.pow_allow_min_difficulty_blocks and params.pow_no_retargeting:
+        # Regtest-style rule (ref pow.cpp:31-45): stale timestamp => min diff.
+        if new_block_time > tip.time + params.pow_target_spacing * 2:
+            return pow_limit_bits
+        idx: Optional[BlockIndex] = tip
+        while (
+            idx.prev is not None
+            and idx.height % params.difficulty_adjustment_interval() != 0
+            and idx.bits == pow_limit_bits
+        ):
+            idx = idx.prev
+        return idx.bits
+
+    # Recency-weighted rolling "average" of the last 180 targets
+    # (ref pow.cpp:47-69: avg = (avg*k + target) / (k+1), newest first).
+    idx = tip
+    avg = 0
+    kawpow_blocks_found = 0
+    for count in range(1, DGW_PAST_BLOCKS + 1):
+        target, _, _ = bits_to_target(idx.bits)
+        if count == 1:
+            avg = target
+        else:
+            avg = (avg * count + target) // (count + 1)
+        if idx.time >= params.kawpow_activation_time:
+            kawpow_blocks_found += 1
+        if count != DGW_PAST_BLOCKS:
+            assert idx.prev is not None
+            idx = idx.prev
+
+    # KawPow bootstrap: until a full window of KawPow blocks exists, pin to
+    # the kawpow limit (ref pow.cpp:71-80).
+    if new_block_time >= params.kawpow_activation_time:
+        if kawpow_blocks_found != DGW_PAST_BLOCKS:
+            return target_to_bits(params.kawpow_limit)
+
+    actual_timespan = tip.time - idx.time
+    target_timespan = DGW_PAST_BLOCKS * params.pow_target_spacing
+    actual_timespan = max(actual_timespan, target_timespan // 3)
+    actual_timespan = min(actual_timespan, target_timespan * 3)
+
+    new_target = avg * actual_timespan // target_timespan
+    if new_target > params.pow_limit:
+        new_target = params.pow_limit
+    return target_to_bits(new_target)
+
+
+def get_next_work_required_btc(
+    tip: BlockIndex, new_block_time: int, params: ConsensusParams
+) -> int:
+    """Legacy Bitcoin-style retarget (ref pow.cpp:104-138)."""
+    pow_limit_bits = target_to_bits(params.pow_limit)
+    interval = params.difficulty_adjustment_interval()
+
+    if (tip.height + 1) % interval != 0:
+        if params.pow_allow_min_difficulty_blocks:
+            if new_block_time > tip.time + params.pow_target_spacing * 2:
+                return pow_limit_bits
+            idx: Optional[BlockIndex] = tip
+            while (
+                idx.prev is not None
+                and idx.height % interval != 0
+                and idx.bits == pow_limit_bits
+            ):
+                idx = idx.prev
+            return idx.bits
+        return tip.bits
+
+    first = tip.get_ancestor(tip.height - (interval - 1))
+    assert first is not None
+    return calculate_next_work_required(tip, first.time, params)
+
+
+def calculate_next_work_required(
+    tip: BlockIndex, first_block_time: int, params: ConsensusParams
+) -> int:
+    """ref pow.cpp:157-180."""
+    if params.pow_no_retargeting:
+        return tip.bits
+    actual = tip.time - first_block_time
+    actual = max(actual, params.pow_target_timespan // 4)
+    actual = min(actual, params.pow_target_timespan * 4)
+    target, _, _ = bits_to_target(tip.bits)
+    new_target = target * actual // params.pow_target_timespan
+    if new_target > params.pow_limit:
+        new_target = params.pow_limit
+    return target_to_bits(new_target)
+
+
+def get_next_work_required(
+    tip: BlockIndex, new_block_time: int, params: ConsensusParams
+) -> int:
+    if tip.height + 1 >= params.dgw_activation_height:
+        return dark_gravity_wave(tip, new_block_time, params)
+    return get_next_work_required_btc(tip, new_block_time, params)
+
+
+def get_block_subsidy(height: int, params: ConsensusParams) -> int:
+    """5000 COIN halving every 2.1M blocks (ref validation.cpp GetBlockSubsidy)."""
+    from ..core.amount import COIN
+
+    halvings = height // params.subsidy_halving_interval
+    if halvings >= 64:
+        return 0
+    return (5000 * COIN) >> halvings

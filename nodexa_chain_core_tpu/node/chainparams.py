@@ -1,0 +1,314 @@
+"""Per-network chain parameters (parity: reference src/chainparams.{h,cpp}).
+
+Three networks — main / test / regtest — mirroring the reference's
+structure (ref chainparams.cpp:105-570): 60 s spacing, 2.1 M halving,
+DGW from height 1 (regtest: 200), six BIP9 asset deployments, magic
+"AIAI"-style 4-byte message start, max-reorg depth 60.
+
+This is a brand-new chain (clean-room framework), so genesis blocks,
+message magic, and address prefixes are this chain's own.  The PoW era
+schedule is table-driven (:class:`..primitives.block.AlgoSchedule`); the
+bootstrap legacy algorithm is sha256d until the native X16R family lands
+(same dispatch structure as ref block.h:95-100).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..consensus.params import (
+    ALWAYS_ACTIVE,
+    NEVER_ACTIVE,
+    ConsensusParams,
+    Deployment,
+    DEPLOYMENT_ASSETS,
+    DEPLOYMENT_COINBASE_ASSETS,
+    DEPLOYMENT_ENFORCE_VALUE,
+    DEPLOYMENT_MSG_REST_ASSETS,
+    DEPLOYMENT_TESTDUMMY,
+    DEPLOYMENT_TRANSFER_SCRIPT_SIZE,
+)
+from ..core.amount import COIN
+from ..core.uint256 import bits_to_target
+from ..crypto.hashes import sha256d
+from ..primitives.block import AlgoSchedule, Block, BlockHeader, set_active_schedule
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+
+GENESIS_MESSAGE = b"nodexa-chain-core_tpu 2026-07-29 clean-room genesis"
+# Arbitrary fixed key for the unspendable genesis output (public constant).
+GENESIS_PUBKEY = bytes.fromhex(
+    "04678afdb0fe5548271967f1a67130b7105cd6a828e03909a67962e0ea1f61deb6"
+    "49f6bc3f4cef38c4f35504e51ec112de5c384df7ba0b8d578a4c702b6bf11d5f"
+)
+
+
+def create_genesis_block(
+    time: int, nonce: int, bits: int, version: int = 4, reward: int = 5000 * COIN
+) -> Block:
+    """ref chainparams.cpp:24-50 CreateGenesisBlock."""
+    script_sig = (
+        bytes([0x04])
+        + (486604799).to_bytes(4, "little")
+        + bytes([0x01, 0x04])
+        + bytes([len(GENESIS_MESSAGE)])
+        + GENESIS_MESSAGE
+    )
+    spk = bytes([len(GENESIS_PUBKEY)]) + GENESIS_PUBKEY + b"\xac"  # <key> CHECKSIG
+    coinbase = Transaction(
+        version=1,
+        vin=[TxIn(prevout=OutPoint(), script_sig=script_sig)],
+        vout=[TxOut(value=reward, script_pubkey=spk)],
+        locktime=0,
+    )
+    header = BlockHeader(
+        version=version,
+        hash_prev=0,
+        hash_merkle_root=coinbase.txid,
+        time=time,
+        bits=bits,
+        nonce=nonce,
+    )
+    return Block(header=header, vtx=[coinbase])
+
+
+def mine_genesis_nonce(time: int, bits: int) -> int:
+    """Scan nonces until the sha256d genesis meets its own target.
+
+    Used once per network definition; results are pinned below.  Uses the
+    hashlib midstate trick (header prefix is constant).
+    """
+    import hashlib
+
+    blk = create_genesis_block(time, 0, bits)
+    hdr = bytearray(blk.header.pow_header_bytes(AlgoSchedule(legacy_algo="sha256d")))
+    target, _, _ = bits_to_target(bits)
+    mid = hashlib.sha256(bytes(hdr[:64]))
+    tail = bytes(hdr[64:76])
+    for nonce in range(1 << 32):
+        h1 = mid.copy()
+        h1.update(tail + nonce.to_bytes(4, "little"))
+        if int.from_bytes(hashlib.sha256(h1.digest()).digest(), "little") <= target:
+            return nonce
+    raise RuntimeError("nonce space exhausted")
+
+
+@dataclass
+class NetworkParams:
+    """ref chainparams.h CChainParams."""
+
+    network: str
+    consensus: ConsensusParams
+    algo_schedule: AlgoSchedule
+    message_start: bytes
+    default_port: int
+    prune_after_height: int
+    # base58 version bytes (ref chainparams.cpp:189-196)
+    prefix_pubkey: int
+    prefix_script: int
+    prefix_secret: int
+    ext_public_key: bytes
+    ext_secret_key: bytes
+    ext_coin_type: int
+    bech32_hrp: str
+    genesis_time: int
+    genesis_bits: int
+    genesis_nonce: int
+    genesis_hash: Optional[int] = None  # pinned after first mine
+    mining_requires_peers: bool = True
+    default_consistency_checks: bool = False
+    require_standard: bool = True
+    checkpoints: Dict[int, int] = field(default_factory=dict)
+    dns_seeds: tuple = ()
+    _genesis: Optional[Block] = field(default=None, repr=False)
+
+    @property
+    def genesis(self) -> Block:
+        if self._genesis is None:
+            blk = create_genesis_block(
+                self.genesis_time, self.genesis_nonce, self.genesis_bits
+            )
+            h = blk.header.get_hash(self.algo_schedule)
+            if self.genesis_hash is not None and h != self.genesis_hash:
+                raise AssertionError(
+                    f"{self.network} genesis hash mismatch: {h:#066x}"
+                )
+            self._genesis = blk
+        return self._genesis
+
+
+def _deployments(start: int, timeout: int) -> Dict[str, Deployment]:
+    """ref chainparams.cpp:124-153 (bits 28, 6..10 with overrides)."""
+    return {
+        DEPLOYMENT_TESTDUMMY: Deployment(28, start, timeout, 1814, 2016),
+        DEPLOYMENT_ASSETS: Deployment(6, start, timeout, 1814, 2016),
+        DEPLOYMENT_MSG_REST_ASSETS: Deployment(7, start, timeout, 1714, 2016),
+        DEPLOYMENT_TRANSFER_SCRIPT_SIZE: Deployment(8, start, timeout, 1714, 2016),
+        DEPLOYMENT_ENFORCE_VALUE: Deployment(9, start, timeout, 1411, 2016),
+        DEPLOYMENT_COINBASE_ASSETS: Deployment(10, start, timeout, 1411, 2016),
+    }
+
+
+_GENESIS_TIME = 1753747200  # 2026-07-29 00:00:00 UTC
+
+# Pinned genesis nonces/hashes (mined once via mine_genesis_nonce; verified
+# by tests/test_chainparams.py).  None => mined lazily on first access.
+_MAIN_GENESIS_NONCE: Optional[int] = 8293673
+_MAIN_GENESIS_HASH: Optional[int] = int(
+    "000000407bdbc54e47002e55cdbdf18e0db4eb7ac45423b21ba898f5725248c3", 16
+)
+_TEST_GENESIS_NONCE: Optional[int] = 7291348
+_TEST_GENESIS_HASH: Optional[int] = int(
+    "000000323bb02d3cbfae8ff8110d4c148477edc760bf2d8759b8089fc9270a91", 16
+)
+REGTEST_GENESIS_NONCE = 1  # trivially re-mined below if wrong
+
+
+def main_params() -> NetworkParams:
+    cons = ConsensusParams(
+        deployments=_deployments(1753747200, 1785283200),
+        dgw_activation_height=1,
+        asset_activation_height=1,
+        x16rv2_activation_time=NEVER_ACTIVE,  # native algos not yet wired
+        kawpow_activation_time=NEVER_ACTIVE,
+    )
+    nonce = _MAIN_GENESIS_NONCE
+    if nonce is None:
+        nonce = mine_genesis_nonce(_GENESIS_TIME, 0x1E00FFFF)
+    return NetworkParams(
+        network="main",
+        consensus=cons,
+        algo_schedule=AlgoSchedule(
+            mid_activation_time=cons.x16rv2_activation_time,
+            kawpow_activation_time=cons.kawpow_activation_time,
+            legacy_algo="sha256d",
+        ),
+        message_start=b"NDXA",
+        default_port=8788,
+        prune_after_height=100_000,
+        prefix_pubkey=53,  # 'N...'
+        prefix_script=122,
+        prefix_secret=112,
+        ext_public_key=bytes.fromhex("0488b21e"),
+        ext_secret_key=bytes.fromhex("0488ade4"),
+        ext_coin_type=1313,
+        bech32_hrp="ndx",
+        genesis_time=_GENESIS_TIME,
+        genesis_bits=0x1E00FFFF,
+        genesis_nonce=nonce,
+        genesis_hash=_MAIN_GENESIS_HASH,
+        mining_requires_peers=True,
+    )
+
+
+def test_params() -> NetworkParams:
+    cons = ConsensusParams(
+        deployments=_deployments(1753747200, 1785283200),
+        dgw_activation_height=1,
+        asset_activation_height=1,
+        x16rv2_activation_time=NEVER_ACTIVE,
+        kawpow_activation_time=NEVER_ACTIVE,
+    )
+    nonce = _TEST_GENESIS_NONCE
+    if nonce is None:
+        nonce = mine_genesis_nonce(_GENESIS_TIME + 1, 0x1E00FFFF)
+    return NetworkParams(
+        network="test",
+        consensus=cons,
+        algo_schedule=AlgoSchedule(
+            mid_activation_time=cons.x16rv2_activation_time,
+            kawpow_activation_time=cons.kawpow_activation_time,
+            legacy_algo="sha256d",
+        ),
+        message_start=b"ndxt",
+        default_port=4568,
+        prune_after_height=1000,
+        prefix_pubkey=111,  # testnet 'm/n'
+        prefix_script=196,
+        prefix_secret=239,
+        ext_public_key=bytes.fromhex("043587cf"),
+        ext_secret_key=bytes.fromhex("04358394"),
+        ext_coin_type=1,
+        bech32_hrp="tndx",
+        genesis_time=_GENESIS_TIME + 1,
+        genesis_bits=0x1E00FFFF,
+        genesis_nonce=nonce,
+        genesis_hash=_TEST_GENESIS_HASH,
+        mining_requires_peers=True,
+    )
+
+
+def regtest_params() -> NetworkParams:
+    cons = ConsensusParams(
+        pow_limit=(1 << 255) - 1,  # 0x7fff.. (bits 0x207fffff)
+        kawpow_limit=(1 << 255) - 1,
+        pow_allow_min_difficulty_blocks=True,
+        pow_no_retargeting=True,
+        rule_change_activation_threshold=108,
+        miner_confirmation_window=144,
+        deployments={
+            DEPLOYMENT_TESTDUMMY: Deployment(28, 0, NEVER_ACTIVE),
+            DEPLOYMENT_ASSETS: Deployment(6, 0, NEVER_ACTIVE, 108, 144),
+            DEPLOYMENT_MSG_REST_ASSETS: Deployment(7, 0, NEVER_ACTIVE, 108, 144),
+            DEPLOYMENT_TRANSFER_SCRIPT_SIZE: Deployment(8, 0, NEVER_ACTIVE, 108, 144),
+            DEPLOYMENT_ENFORCE_VALUE: Deployment(9, 0, NEVER_ACTIVE, 108, 144),
+            DEPLOYMENT_COINBASE_ASSETS: Deployment(10, 0, NEVER_ACTIVE, 108, 144),
+        },
+        dgw_activation_height=200,  # ref chainparams.cpp:556
+        asset_activation_height=0,
+        x16rv2_activation_time=NEVER_ACTIVE,
+        kawpow_activation_time=NEVER_ACTIVE,  # ref :569 (far future)
+    )
+    sched = AlgoSchedule(
+        mid_activation_time=cons.x16rv2_activation_time,
+        kawpow_activation_time=cons.kawpow_activation_time,
+        legacy_algo="sha256d",
+    )
+    nonce = REGTEST_GENESIS_NONCE
+    # Cheap: expected 2 attempts at 0x207fffff.
+    blk = create_genesis_block(_GENESIS_TIME, nonce, 0x207FFFFF)
+    target, _, _ = bits_to_target(0x207FFFFF)
+    if blk.header.get_hash(sched) > target:
+        nonce = mine_genesis_nonce(_GENESIS_TIME, 0x207FFFFF)
+    return NetworkParams(
+        network="regtest",
+        consensus=cons,
+        algo_schedule=sched,
+        message_start=b"ndxr",
+        default_port=19444,
+        prune_after_height=1000,
+        prefix_pubkey=111,
+        prefix_script=196,
+        prefix_secret=239,
+        ext_public_key=bytes.fromhex("043587cf"),
+        ext_secret_key=bytes.fromhex("04358394"),
+        ext_coin_type=1,
+        bech32_hrp="ndxrt",
+        genesis_time=_GENESIS_TIME,
+        genesis_bits=0x207FFFFF,
+        genesis_nonce=nonce,
+        mining_requires_peers=False,
+        default_consistency_checks=True,
+        require_standard=False,
+    )
+
+
+_FACTORIES = {"main": main_params, "test": test_params, "regtest": regtest_params}
+_active: Optional[NetworkParams] = None
+
+
+def select_params(network: str) -> NetworkParams:
+    """ref chainparams.cpp SelectParams: sets the process-wide network."""
+    global _active
+    if network not in _FACTORIES:
+        raise ValueError(f"unknown network {network!r}")
+    _active = _FACTORIES[network]()
+    set_active_schedule(_active.algo_schedule)
+    return _active
+
+
+def active_params() -> NetworkParams:
+    global _active
+    if _active is None:
+        select_params("main")
+    return _active

@@ -1,0 +1,202 @@
+"""Batched SHA-256 / SHA-256d on TPU via JAX.
+
+This is the framework's hot PoW kernel: the reference's equivalents are the
+scalar C++ ``CSHA256``/``CHash256`` (ref src/crypto/sha256.cpp, src/hash.h)
+driven one-hash-at-a-time from the CPU miner (ref src/miner.cpp:566-728).
+TPU-first design: hashing is *batched over headers/nonces* as uint32 lane
+arithmetic — thousands of independent hashes per XLA program, which is how a
+vector unit wants this workload (the MXU is irrelevant here; the VPU eats
+the bitwise rounds, HBM traffic is trivial since state lives in registers).
+
+All words are big-endian SHA-256 message words carried in uint32 lanes; the
+batch dimension is leading and fully data-parallel, so sharding it over a
+``jax.sharding.Mesh`` scales mining/verification linearly across chips (see
+:mod:`..parallel.pow_search`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_K = jnp.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=jnp.uint32,
+)
+
+IV = jnp.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=jnp.uint32,
+)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> n) | (x << (32 - n))
+
+
+def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression. state: (..., 8); block: (..., 16) BE words.
+
+    The 64 rounds are Python-unrolled: static control flow, XLA fuses the
+    whole round function into one kernel (no lax.scan overhead for a
+    fixed-trip tight loop).
+    """
+    w = [block[..., i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+
+    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
+    for i in range(64):
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + _K[i] + w[i]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+def sha256_words(blocks: jnp.ndarray) -> jnp.ndarray:
+    """Full SHA-256 over pre-padded BE word blocks: (..., nblk, 16) -> (..., 8)."""
+    state = jnp.broadcast_to(IV, blocks.shape[:-2] + (8,))
+    for i in range(blocks.shape[-2]):
+        state = compress(state, blocks[..., i, :])
+    return state
+
+
+def _digest_block(state_words: jnp.ndarray) -> jnp.ndarray:
+    """Pad an 8-word digest into one 16-word message block (for sha256d)."""
+    shape = state_words.shape[:-1]
+    pad = jnp.broadcast_to(
+        jnp.array(
+            [0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=jnp.uint32
+        ),
+        shape + (8,),
+    )
+    return jnp.concatenate([state_words, pad], axis=-1)
+
+
+def sha256d_words(blocks: jnp.ndarray) -> jnp.ndarray:
+    """Double SHA-256 over padded blocks -> (..., 8) BE digest words."""
+    first = sha256_words(blocks)
+    return sha256_words(_digest_block(first)[..., None, :])
+
+
+def bswap32(x: jnp.ndarray) -> jnp.ndarray:
+    # masks kept < 2**31 so weak-typed literals stay int32-safe
+    return (
+        (x << 24)
+        | ((x & 0x0000FF00) << 8)
+        | ((x >> 8) & 0x0000FF00)
+        | (x >> 24)
+    )
+
+
+def pad_header80(words20: jnp.ndarray) -> jnp.ndarray:
+    """Pad an 80-byte header (20 BE words) into two 64-byte blocks."""
+    shape = words20.shape[:-1]
+    pad = jnp.broadcast_to(
+        jnp.array([0x80000000] + [0] * 10 + [640], dtype=jnp.uint32), shape + (12,)
+    )
+    padded = jnp.concatenate([words20, pad], axis=-1)
+    return padded.reshape(shape + (2, 16))
+
+
+def sha256d_headers(words20: jnp.ndarray) -> jnp.ndarray:
+    """sha256d of 80-byte headers: (..., 20) BE words -> (..., 8) digest words."""
+    return sha256d_words(pad_header80(words20))
+
+
+def digest_le_words(digest_be_words: jnp.ndarray) -> jnp.ndarray:
+    """Digest as uint256 little-endian 32-bit limbs, limb j = bits [32j,32j+32).
+
+    The byte digest is the BE-word concatenation; interpreting those 32
+    bytes as a little-endian integer makes limb j the byteswap of word j.
+    """
+    return bswap32(digest_be_words)
+
+
+def le256_leq(hash_le: jnp.ndarray, target_le: jnp.ndarray) -> jnp.ndarray:
+    """hash <= target over (..., 8) LE limbs (limb 7 most significant)."""
+    less = jnp.zeros(hash_le.shape[:-1], dtype=bool)
+    eq = jnp.ones(hash_le.shape[:-1], dtype=bool)
+    for j in range(7, -1, -1):
+        hw = hash_le[..., j]
+        tw = target_le[..., j]
+        less = less | (eq & (hw < tw))
+        eq = eq & (hw == tw)
+    return less | eq
+
+
+def target_to_le_words(target: int) -> jnp.ndarray:
+    return jnp.array(
+        [(target >> (32 * j)) & 0xFFFFFFFF for j in range(8)], dtype=jnp.uint32
+    )
+
+
+def header_bytes_to_words(header: bytes) -> jnp.ndarray:
+    if len(header) != 80:
+        raise ValueError("header must be 80 bytes")
+    return jnp.array(
+        [int.from_bytes(header[4 * i : 4 * i + 4], "big") for i in range(20)],
+        dtype=jnp.uint32,
+    )
+
+
+# --- midstate-optimized nonce search ---------------------------------------
+
+
+def midstate(words16: jnp.ndarray) -> jnp.ndarray:
+    """State after the constant first block (header bytes 0..64)."""
+    state = jnp.broadcast_to(IV, words16.shape[:-1] + (8,))
+    return compress(state, words16)
+
+
+def search_tail_block(tail3: jnp.ndarray, nonces: jnp.ndarray) -> jnp.ndarray:
+    """Second message block for a batch of nonces.
+
+    tail3: (3,) header words 16..18 (bytes 64..76).  nonces: (B,) uint32,
+    serialized LE into bytes 76..80, hence byteswapped into the BE word.
+    """
+    b = nonces.shape[0]
+    t = jnp.broadcast_to(tail3, (b, 3))
+    w19 = bswap32(nonces)[:, None]
+    pad = jnp.broadcast_to(
+        jnp.array([0x80000000] + [0] * 10 + [640], dtype=jnp.uint32), (b, 12)
+    )
+    return jnp.concatenate([t, w19, pad], axis=-1)
+
+
+def pow_search_step(mid: jnp.ndarray, tail3: jnp.ndarray, nonce0: jnp.ndarray,
+                    target_le: jnp.ndarray, batch: int):
+    """Try `batch` consecutive nonces from nonce0. Fully jittable.
+
+    Returns (found: bool, nonce: uint32, hash_le: (8,) of the winning try —
+    arbitrary lane if none found).
+    """
+    nonces = nonce0.astype(jnp.uint32) + jnp.arange(batch, dtype=jnp.uint32)
+    block2 = search_tail_block(tail3, nonces)
+    st = compress(jnp.broadcast_to(mid, (batch, 8)), block2)
+    digest = sha256_words(_digest_block(st)[..., None, :])
+    hash_le = digest_le_words(digest)
+    ok = le256_leq(hash_le, target_le)
+    found = jnp.any(ok)
+    idx = jnp.argmax(ok)  # first winning lane
+    return found, nonces[idx], hash_le[idx]

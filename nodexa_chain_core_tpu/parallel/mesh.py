@@ -1,0 +1,51 @@
+"""Device-mesh helpers.
+
+The reference scales PoW by spawning N CPU miner threads over disjoint nonce
+ranges (ref src/miner.cpp:728-756) and verification by a script-check thread
+pool (ref src/checkqueue.h:33).  The TPU-native equivalent is SPMD: one
+program, batch dimensions sharded over a ``jax.sharding.Mesh``; XLA inserts
+the cross-chip collectives (the `any-found` / `argmin-nonce` reductions ride
+ICI as psums instead of pthread joins).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+HEADER_AXIS = "headers"  # data-parallel over independent headers
+LANE_AXIS = "lanes"  # parallel over the nonce space of one header
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """2D mesh (headers × lanes). Defaults: all devices on the lane axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if shape is None:
+        shape = (1, n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, (HEADER_AXIS, LANE_AXIS))
+
+
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(LANE_AXIS))
+
+
+def header_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(HEADER_AXIS))
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(HEADER_AXIS, LANE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -8,10 +8,18 @@ without hardware.
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before any backend is initialized.  The driver environment
+# presets JAX_PLATFORMS=axon (single real TPU chip) and something in the
+# axon plugin re-prepends itself over the env var, so the config update
+# below (not just the env var) is what actually pins tests to the virtual
+# 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,128 @@
+from nodexa_chain_core_tpu.chain.blockindex import BlockIndex, Chain
+from nodexa_chain_core_tpu.consensus.pow import (
+    DGW_PAST_BLOCKS,
+    check_proof_of_work,
+    dark_gravity_wave,
+    get_block_subsidy,
+    get_next_work_required,
+)
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.core.uint256 import bits_to_target, target_to_bits
+from nodexa_chain_core_tpu.node.chainparams import (
+    main_params,
+    regtest_params,
+    select_params,
+    test_params,
+)
+from nodexa_chain_core_tpu.primitives.block import BlockHeader
+
+
+def test_genesis_pinned_hashes():
+    mp = main_params()
+    g = mp.genesis
+    target, _, _ = bits_to_target(mp.genesis_bits)
+    assert g.header.get_hash(mp.algo_schedule) <= target
+    assert check_proof_of_work(
+        g.header.get_hash(mp.algo_schedule), mp.genesis_bits, mp.consensus
+    )
+    tp = test_params()
+    assert tp.genesis.header.get_hash(tp.algo_schedule) != g.header.get_hash(
+        mp.algo_schedule
+    )
+
+
+def test_regtest_genesis_trivial():
+    rp = regtest_params()
+    target, _, _ = bits_to_target(0x207FFFFF)
+    assert rp.genesis.header.get_hash(rp.algo_schedule) <= target
+
+
+def test_select_params_sets_schedule():
+    p = select_params("regtest")
+    from nodexa_chain_core_tpu.primitives.block import active_schedule
+
+    assert active_schedule() is p.algo_schedule
+    select_params("main")
+
+
+def test_subsidy_halving():
+    params = main_params().consensus
+    assert get_block_subsidy(0, params) == 5000 * COIN
+    assert get_block_subsidy(2_100_000 - 1, params) == 5000 * COIN
+    assert get_block_subsidy(2_100_000, params) == 2500 * COIN
+    assert get_block_subsidy(2_100_000 * 64, params) == 0
+
+
+def _build_chain(n, bits, spacing=60, start_time=1_700_000_000):
+    prev = None
+    for h in range(n):
+        hdr = BlockHeader(version=4, time=start_time + h * spacing, bits=bits)
+        idx = BlockIndex(header=hdr, prev=prev)
+        idx.build_from_prev()
+        prev = idx
+    return prev
+
+
+def test_dgw_below_window_returns_limit():
+    params = main_params().consensus
+    tip = _build_chain(50, 0x1E00FFFF)
+    assert dark_gravity_wave(tip, tip.time + 60, params) == target_to_bits(
+        params.pow_limit
+    )
+
+
+def test_dgw_steady_state_keeps_difficulty():
+    params = main_params().consensus
+    bits = 0x1C1FFFFF
+    tip = _build_chain(DGW_PAST_BLOCKS + 10, bits, spacing=60)
+    new_bits = dark_gravity_wave(tip, tip.time + 60, params)
+    t_old, _, _ = bits_to_target(bits)
+    t_new, _, _ = bits_to_target(new_bits)
+    # on-schedule blocks => target within a few percent of previous
+    assert abs(t_new - t_old) / t_old < 0.05
+
+
+def test_dgw_fast_blocks_harden_difficulty():
+    params = main_params().consensus
+    bits = 0x1C1FFFFF
+    fast = _build_chain(DGW_PAST_BLOCKS + 10, bits, spacing=10)
+    slow = _build_chain(DGW_PAST_BLOCKS + 10, bits, spacing=300)
+    t_fast, _, _ = bits_to_target(dark_gravity_wave(fast, fast.time + 10, params))
+    t_slow, _, _ = bits_to_target(dark_gravity_wave(slow, slow.time + 300, params))
+    t_old, _, _ = bits_to_target(bits)
+    assert t_fast < t_old < t_slow
+
+
+def test_dgw_regtest_no_retarget():
+    params = regtest_params().consensus
+    bits = target_to_bits(params.pow_limit)
+    tip = _build_chain(DGW_PAST_BLOCKS + 5, bits)
+    assert dark_gravity_wave(tip, tip.time + 60, params) == bits
+
+
+def test_check_proof_of_work_bounds():
+    params = main_params().consensus
+    assert not check_proof_of_work(0, 0, params)  # zero target
+    assert not check_proof_of_work(0, 0xFF123456, params)  # overflow
+    limit_bits = target_to_bits(params.pow_limit)
+    assert check_proof_of_work(0, limit_bits, params)
+    assert not check_proof_of_work(params.pow_limit + 1, limit_bits, params)
+
+
+def test_ancestor_skiplist():
+    tip = _build_chain(500, 0x207FFFFF)
+    assert tip.get_ancestor(0).height == 0
+    assert tip.get_ancestor(250).height == 250
+    assert tip.get_ancestor(499) is tip
+    assert tip.get_ancestor(1000) is None
+    chain = Chain()
+    chain.set_tip(tip)
+    assert chain.height() == 499
+    assert chain.at(123).height == 123
+    assert chain.tip() is tip
+
+
+def test_median_time_past():
+    tip = _build_chain(20, 0x207FFFFF, spacing=60)
+    # times increase monotonically; median of last 11 = 6th back
+    assert tip.median_time_past() == tip.get_ancestor(tip.height - 5).time

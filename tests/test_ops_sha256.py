@@ -1,0 +1,98 @@
+"""JAX SHA-256d ops vs hashlib ground truth, plus mesh-sharded variants."""
+
+import hashlib
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nodexa_chain_core_tpu.ops import sha256_jax as s256
+from nodexa_chain_core_tpu.parallel import mesh as meshlib
+from nodexa_chain_core_tpu.parallel.pow_search import (
+    Sha256dMiner,
+    batch_verify_headers,
+)
+
+
+def ref_sha256d(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def digest_words_to_bytes(words) -> bytes:
+    return b"".join(int(w).to_bytes(4, "big") for w in words)
+
+
+def test_sha256d_headers_match_hashlib():
+    rng = random.Random(1234)
+    headers = [bytes(rng.randrange(256) for _ in range(80)) for _ in range(32)]
+    words = jnp.stack([s256.header_bytes_to_words(h) for h in headers])
+    out = jax.device_get(s256.sha256d_headers(words))
+    for h, row in zip(headers, out):
+        assert digest_words_to_bytes(row) == ref_sha256d(h)
+
+
+def test_digest_le_words_int_equivalence():
+    h = bytes(range(80))
+    words = s256.header_bytes_to_words(h)
+    le = jax.device_get(s256.digest_le_words(s256.sha256d_headers(words)))
+    val = sum(int(limb) << (32 * j) for j, limb in enumerate(le))
+    assert val == int.from_bytes(ref_sha256d(h), "little")
+
+
+def test_le256_leq():
+    t = s256.target_to_le_words(10**60)
+    below = s256.target_to_le_words(10**60 - 1)[None, :]
+    equal = s256.target_to_le_words(10**60)[None, :]
+    above = s256.target_to_le_words(10**60 + 1)[None, :]
+    assert bool(s256.le256_leq(below, t)[0])
+    assert bool(s256.le256_leq(equal, t)[0])
+    assert not bool(s256.le256_leq(above, t)[0])
+
+
+def test_midstate_search_matches_full_hash():
+    rng = random.Random(7)
+    prefix = bytes(rng.randrange(256) for _ in range(76))
+    target = 1 << 248  # ~1/256 per nonce
+    miner = Sha256dMiner(prefix, target, batch=4096)
+    found, nonce, h = miner.scan(0)
+    assert found
+    full = prefix + int(nonce).to_bytes(4, "little")
+    assert h == int.from_bytes(ref_sha256d(full), "little")
+    assert h <= target
+
+
+def test_batch_verify_headers():
+    rng = random.Random(99)
+    headers = [bytes(rng.randrange(256) for _ in range(80)) for _ in range(16)]
+    # Loose target so some pass, tight so none pass.
+    loose = (1 << 256) - 1
+    ok, hashes = batch_verify_headers(headers, loose)
+    assert all(ok)
+    for h, v in zip(headers, hashes):
+        assert v == int.from_bytes(ref_sha256d(h), "little")
+    ok, _ = batch_verify_headers(headers, 0)
+    assert not any(ok)
+
+
+def test_mesh_sharded_search():
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    mesh = meshlib.make_mesh()
+    rng = random.Random(42)
+    prefix = bytes(rng.randrange(256) for _ in range(76))
+    miner = Sha256dMiner(prefix, 1 << 245, mesh=mesh, batch=1 << 13)
+    res = miner.mine(max_batches=64)
+    assert res is not None
+    nonce, h = res
+    full = prefix + int(nonce).to_bytes(4, "little")
+    assert h == int.from_bytes(ref_sha256d(full), "little")
+
+
+def test_mesh_sharded_batch_verify():
+    mesh = meshlib.make_mesh(shape=(2, 4))
+    rng = random.Random(5)
+    headers = [bytes(rng.randrange(256) for _ in range(80)) for _ in range(24)]
+    ok, hashes = batch_verify_headers(headers, (1 << 256) - 1, mesh=mesh)
+    assert all(ok)
+    assert hashes[3] == int.from_bytes(ref_sha256d(headers[3]), "little")
