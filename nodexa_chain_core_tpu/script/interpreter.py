@@ -1,0 +1,739 @@
+"""Script interpreter (parity: reference src/script/interpreter.{h,cpp}).
+
+``eval_script``/``verify_script`` implement the Bitcoin-lineage VM exactly as
+the reference runs it (Bitcoin 0.15 era + the asset no-op opcode,
+interpreter.cpp:1119), including: conditional stack, altstack, 201-op and
+520-byte limits, disabled opcodes failing even unexecuted, CScriptNum
+minimality, BIP65 CLTV, BIP112 CSV, strict-DER/low-S/nullfail signature
+policy flags, P2SH redemption, cleanstack, and the legacy sighash algorithm
+with its SIGHASH_SINGLE "hash of one" quirk.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..core.serialize import ByteWriter
+from ..crypto import secp256k1 as ec
+from ..crypto.hashes import hash160, ripemd160, sha256, sha256d
+from ..primitives.transaction import Transaction
+from . import opcodes as op
+from .script import (
+    MAX_OPS_PER_SCRIPT,
+    MAX_PUBKEYS_PER_MULTISIG,
+    MAX_SCRIPT_ELEMENT_SIZE,
+    MAX_SCRIPT_SIZE,
+    Script,
+    ScriptError,
+    decode_op_n,
+    script_num_decode,
+    script_num_encode,
+)
+
+# --- verification flags (ref interpreter.h) --------------------------------
+
+VERIFY_NONE = 0
+VERIFY_P2SH = 1 << 0
+VERIFY_STRICTENC = 1 << 1
+VERIFY_DERSIG = 1 << 2
+VERIFY_LOW_S = 1 << 3
+VERIFY_NULLDUMMY = 1 << 4
+VERIFY_SIGPUSHONLY = 1 << 5
+VERIFY_MINIMALDATA = 1 << 6
+VERIFY_DISCOURAGE_UPGRADABLE_NOPS = 1 << 7
+VERIFY_CLEANSTACK = 1 << 8
+VERIFY_CHECKLOCKTIMEVERIFY = 1 << 9
+VERIFY_CHECKSEQUENCEVERIFY = 1 << 10
+VERIFY_MINIMALIF = 1 << 13
+VERIFY_NULLFAIL = 1 << 14
+
+MANDATORY_SCRIPT_VERIFY_FLAGS = VERIFY_P2SH
+STANDARD_SCRIPT_VERIFY_FLAGS = (
+    MANDATORY_SCRIPT_VERIFY_FLAGS
+    | VERIFY_DERSIG
+    | VERIFY_STRICTENC
+    | VERIFY_MINIMALDATA
+    | VERIFY_NULLDUMMY
+    | VERIFY_DISCOURAGE_UPGRADABLE_NOPS
+    | VERIFY_CLEANSTACK
+    | VERIFY_MINIMALIF
+    | VERIFY_NULLFAIL
+    | VERIFY_CHECKLOCKTIMEVERIFY
+    | VERIFY_CHECKSEQUENCEVERIFY
+    | VERIFY_LOW_S
+)
+
+# sighash types (ref interpreter.h SigHashType)
+SIGHASH_ALL = 1
+SIGHASH_NONE = 2
+SIGHASH_SINGLE = 3
+SIGHASH_ANYONECANPAY = 0x80
+
+LOCKTIME_THRESHOLD = 500_000_000
+SEQUENCE_FINAL = 0xFFFFFFFF
+SEQUENCE_LOCKTIME_DISABLE_FLAG = 1 << 31
+SEQUENCE_LOCKTIME_TYPE_FLAG = 1 << 22
+SEQUENCE_LOCKTIME_MASK = 0x0000FFFF
+
+_DISABLED_OPCODES = frozenset(
+    [
+        op.OP_CAT, op.OP_SUBSTR, op.OP_LEFT, op.OP_RIGHT, op.OP_INVERT,
+        op.OP_AND, op.OP_OR, op.OP_XOR, op.OP_2MUL, op.OP_2DIV, op.OP_MUL,
+        op.OP_DIV, op.OP_MOD, op.OP_LSHIFT, op.OP_RSHIFT,
+    ]
+)
+
+
+class ScriptVerifyError(Exception):
+    """Raised internally; eval_script converts to a False return + err code."""
+
+    def __init__(self, code: str):
+        super().__init__(code)
+        self.code = code
+
+
+def _bool_from_stack(v: bytes) -> bool:
+    for i, b in enumerate(v):
+        if b != 0:
+            # negative zero is false
+            if i == len(v) - 1 and b == 0x80:
+                return False
+            return True
+    return False
+
+
+_TRUE = b"\x01"
+_FALSE = b""
+
+
+# --- signature hashing ------------------------------------------------------
+
+
+def signature_hash(
+    script_code: Script, tx: Transaction, in_idx: int, hashtype: int
+) -> bytes:
+    """Legacy sighash (ref interpreter.cpp SignatureHash / SignatureHashOld).
+
+    Returns the 32-byte digest; replicates the "hash of one" result when
+    in_idx is out of range or SIGHASH_SINGLE lacks a matching output.
+    """
+    one = (1).to_bytes(32, "little")
+    if in_idx >= len(tx.vin):
+        return one
+    base = hashtype & 0x1F
+    if base == SIGHASH_SINGLE and in_idx >= len(tx.vout):
+        return one
+
+    anyonecanpay = bool(hashtype & SIGHASH_ANYONECANPAY)
+    w = ByteWriter()
+    w.i32(tx.version)
+    # inputs
+    if anyonecanpay:
+        w.compact_size(1)
+        _ser_input(w, tx, in_idx, in_idx, script_code, base)
+    else:
+        w.compact_size(len(tx.vin))
+        for i in range(len(tx.vin)):
+            _ser_input(w, tx, i, in_idx, script_code, base)
+    # outputs
+    if base == SIGHASH_NONE:
+        w.compact_size(0)
+    elif base == SIGHASH_SINGLE:
+        w.compact_size(in_idx + 1)
+        for i in range(in_idx + 1):
+            if i == in_idx:
+                tx.vout[i].serialize(w)
+            else:
+                w.i64(-1).var_bytes(b"")  # null txout
+    else:
+        w.compact_size(len(tx.vout))
+        for o in tx.vout:
+            o.serialize(w)
+    w.u32(tx.locktime)
+    w.u32(hashtype & 0xFFFFFFFF)
+    return sha256d(w.getvalue())
+
+
+def _ser_input(
+    w: ByteWriter, tx: Transaction, i: int, sign_idx: int, script_code: Script, base: int
+) -> None:
+    txin = tx.vin[i]
+    txin.prevout.serialize(w)
+    if i == sign_idx:
+        w.var_bytes(script_code.raw)
+        w.u32(txin.sequence)
+    else:
+        w.var_bytes(b"")
+        if base in (SIGHASH_NONE, SIGHASH_SINGLE):
+            w.u32(0)
+        else:
+            w.u32(txin.sequence)
+
+
+# --- signature checker ------------------------------------------------------
+
+
+class BaseSignatureChecker:
+    def check_sig(self, sig: bytes, pubkey: bytes, script_code: Script) -> bool:
+        return False
+
+    def check_locktime(self, locktime: int) -> bool:
+        return False
+
+    def check_sequence(self, sequence: int) -> bool:
+        return False
+
+
+class TransactionSignatureChecker(BaseSignatureChecker):
+    """ref interpreter.h TransactionSignatureChecker."""
+
+    def __init__(self, tx: Transaction, in_idx: int, amount: int = 0):
+        self.tx = tx
+        self.in_idx = in_idx
+        self.amount = amount
+
+    def check_sig(self, sig: bytes, pubkey: bytes, script_code: Script) -> bool:
+        if not sig:
+            return False
+        hashtype = sig[-1]
+        raw_sig = sig[:-1]
+        try:
+            r, s = ec.sig_from_der(raw_sig, strict=False)
+            pub = ec.pubkey_parse(pubkey)
+        except ec.Secp256k1Error:
+            return False
+        # legacy quirk: the signature itself is deleted from scriptCode
+        cleaned = script_code.find_and_delete(Script.build(sig))
+        digest = signature_hash(cleaned, self.tx, self.in_idx, hashtype)
+        return ec.verify(pub, digest, r, s)
+
+    def check_locktime(self, locktime: int) -> bool:
+        """BIP65 semantics (ref interpreter.cpp CheckLockTime)."""
+        tx_lock = self.tx.locktime
+        if not (
+            (tx_lock < LOCKTIME_THRESHOLD and locktime < LOCKTIME_THRESHOLD)
+            or (tx_lock >= LOCKTIME_THRESHOLD and locktime >= LOCKTIME_THRESHOLD)
+        ):
+            return False
+        if locktime > tx_lock:
+            return False
+        if self.tx.vin[self.in_idx].sequence == SEQUENCE_FINAL:
+            return False
+        return True
+
+    def check_sequence(self, sequence: int) -> bool:
+        """BIP112 semantics (ref interpreter.cpp CheckSequence)."""
+        tx_seq = self.tx.vin[self.in_idx].sequence
+        if self.tx.version < 2:
+            return False
+        if tx_seq & SEQUENCE_LOCKTIME_DISABLE_FLAG:
+            return False
+        mask = SEQUENCE_LOCKTIME_TYPE_FLAG | SEQUENCE_LOCKTIME_MASK
+        masked_tx = tx_seq & mask
+        masked_op = sequence & mask
+        if not (
+            (
+                masked_tx < SEQUENCE_LOCKTIME_TYPE_FLAG
+                and masked_op < SEQUENCE_LOCKTIME_TYPE_FLAG
+            )
+            or (
+                masked_tx >= SEQUENCE_LOCKTIME_TYPE_FLAG
+                and masked_op >= SEQUENCE_LOCKTIME_TYPE_FLAG
+            )
+        ):
+            return False
+        return masked_op <= masked_tx
+
+
+# --- signature encoding policy checks ---------------------------------------
+
+
+def _is_valid_signature_encoding(sig: bytes) -> bool:
+    """BIP66 strict DER shape check (ref interpreter.cpp IsValidSignatureEncoding)."""
+    if len(sig) < 9 or len(sig) > 73:
+        return False
+    if sig[0] != 0x30 or sig[1] != len(sig) - 3:
+        return False
+    len_r = sig[3]
+    if 5 + len_r >= len(sig):
+        return False
+    len_s = sig[5 + len_r]
+    if len_r + len_s + 7 != len(sig):
+        return False
+    if sig[2] != 0x02 or len_r == 0 or (sig[4] & 0x80):
+        return False
+    if len_r > 1 and sig[4] == 0 and not (sig[5] & 0x80):
+        return False
+    if sig[4 + len_r] != 0x02 or len_s == 0 or (sig[6 + len_r] & 0x80):
+        return False
+    if len_s > 1 and sig[6 + len_r] == 0 and not (sig[7 + len_r] & 0x80):
+        return False
+    return True
+
+
+def _check_signature_encoding(sig: bytes, flags: int) -> None:
+    if len(sig) == 0:
+        return
+    if flags & (VERIFY_DERSIG | VERIFY_LOW_S | VERIFY_STRICTENC):
+        if not _is_valid_signature_encoding(sig):
+            raise ScriptVerifyError("sig_der")
+    if flags & VERIFY_LOW_S:
+        try:
+            _, s = ec.sig_from_der(sig[:-1], strict=False)
+        except ec.Secp256k1Error:
+            raise ScriptVerifyError("sig_der")
+        if not ec.is_low_s(s):
+            raise ScriptVerifyError("sig_high_s")
+    if flags & VERIFY_STRICTENC:
+        hashtype = sig[-1] & ~SIGHASH_ANYONECANPAY
+        if hashtype not in (SIGHASH_ALL, SIGHASH_NONE, SIGHASH_SINGLE):
+            raise ScriptVerifyError("sig_hashtype")
+
+
+def _check_pubkey_encoding(pubkey: bytes, flags: int) -> None:
+    if flags & VERIFY_STRICTENC:
+        if not (
+            (len(pubkey) == 33 and pubkey[0] in (2, 3))
+            or (len(pubkey) == 65 and pubkey[0] == 4)
+        ):
+            raise ScriptVerifyError("pubkey_type")
+
+
+def _check_minimal_push(data: bytes, opcode: int) -> bool:
+    if len(data) == 0:
+        return opcode == op.OP_0
+    if len(data) == 1 and 1 <= data[0] <= 16:
+        return opcode == op.OP_1 + data[0] - 1
+    if len(data) == 1 and data[0] == 0x81:
+        return opcode == op.OP_1NEGATE
+    if len(data) <= 75:
+        return opcode == len(data)
+    if len(data) <= 255:
+        return opcode == op.OP_PUSHDATA1
+    if len(data) <= 65535:
+        return opcode == op.OP_PUSHDATA2
+    return True
+
+
+# --- the VM -----------------------------------------------------------------
+
+
+def eval_script(
+    stack: List[bytes],
+    script: Script,
+    flags: int,
+    checker: BaseSignatureChecker,
+) -> tuple[bool, str]:
+    """ref interpreter.cpp EvalScript. Returns (ok, error_code)."""
+    try:
+        _eval(stack, script, flags, checker)
+        return True, ""
+    except ScriptVerifyError as e:
+        return False, e.code
+    except ScriptError:
+        return False, "bad_script"
+
+
+def _eval(
+    stack: List[bytes], script: Script, flags: int, checker: BaseSignatureChecker
+) -> None:
+    if len(script) > MAX_SCRIPT_SIZE:
+        raise ScriptVerifyError("script_size")
+    altstack: List[bytes] = []
+    vf_exec: List[bool] = []  # conditional execution stack
+    op_count = 0
+    require_minimal = bool(flags & VERIFY_MINIMALDATA)
+    begincode = 0  # offset of last OP_CODESEPARATOR + 1
+
+    def popstack() -> bytes:
+        if not stack:
+            raise ScriptVerifyError("invalid_stack_operation")
+        return stack.pop()
+
+    def popnum(max_size: int = 4) -> int:
+        try:
+            return script_num_decode(popstack(), max_size, require_minimal)
+        except ScriptError:
+            raise ScriptVerifyError("scriptnum")
+
+    for parsed in script.ops():
+        opcode, data = parsed.opcode, parsed.data
+        f_exec = all(vf_exec)
+
+        if data is not None and len(data) > MAX_SCRIPT_ELEMENT_SIZE:
+            raise ScriptVerifyError("push_size")
+        if opcode > op.OP_16 and opcode != op.OP_ASSET:
+            op_count += 1
+            if op_count > MAX_OPS_PER_SCRIPT:
+                raise ScriptVerifyError("op_count")
+        if opcode in _DISABLED_OPCODES:
+            raise ScriptVerifyError("disabled_opcode")
+
+        if f_exec and 0 <= opcode <= op.OP_PUSHDATA4:
+            if require_minimal and not _check_minimal_push(data, opcode):
+                raise ScriptVerifyError("minimaldata")
+            stack.append(data)
+            continue
+
+        if not (f_exec or op.OP_IF <= opcode <= op.OP_ENDIF):
+            continue
+
+        # -- control flow --
+        if opcode in (op.OP_IF, op.OP_NOTIF):
+            value = False
+            if f_exec:
+                top = popstack()
+                if flags & VERIFY_MINIMALIF and top not in (b"", b"\x01"):
+                    raise ScriptVerifyError("minimalif")
+                value = _bool_from_stack(top)
+                if opcode == op.OP_NOTIF:
+                    value = not value
+            vf_exec.append(value)
+        elif opcode == op.OP_ELSE:
+            if not vf_exec:
+                raise ScriptVerifyError("unbalanced_conditional")
+            vf_exec[-1] = not vf_exec[-1]
+        elif opcode == op.OP_ENDIF:
+            if not vf_exec:
+                raise ScriptVerifyError("unbalanced_conditional")
+            vf_exec.pop()
+        elif opcode in (op.OP_VERIF, op.OP_VERNOTIF):
+            raise ScriptVerifyError("bad_opcode")
+
+        elif opcode in (
+            op.OP_1NEGATE, op.OP_1, op.OP_2, op.OP_3, op.OP_4, op.OP_5, op.OP_6,
+            op.OP_7, op.OP_8, op.OP_9, op.OP_10, op.OP_11, op.OP_12, op.OP_13,
+            op.OP_14, op.OP_15, op.OP_16,
+        ):
+            n = -1 if opcode == op.OP_1NEGATE else opcode - (op.OP_1 - 1)
+            stack.append(script_num_encode(n))
+
+        elif opcode == op.OP_NOP:
+            pass
+        elif opcode == op.OP_CHECKLOCKTIMEVERIFY:
+            if not (flags & VERIFY_CHECKLOCKTIMEVERIFY):
+                if flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS:
+                    raise ScriptVerifyError("discourage_upgradable_nops")
+            else:
+                if not stack:
+                    raise ScriptVerifyError("invalid_stack_operation")
+                locktime = script_num_decode(stack[-1], 5, require_minimal)
+                if locktime < 0:
+                    raise ScriptVerifyError("negative_locktime")
+                if not checker.check_locktime(locktime):
+                    raise ScriptVerifyError("unsatisfied_locktime")
+        elif opcode == op.OP_CHECKSEQUENCEVERIFY:
+            if not (flags & VERIFY_CHECKSEQUENCEVERIFY):
+                if flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS:
+                    raise ScriptVerifyError("discourage_upgradable_nops")
+            else:
+                if not stack:
+                    raise ScriptVerifyError("invalid_stack_operation")
+                sequence = script_num_decode(stack[-1], 5, require_minimal)
+                if sequence < 0:
+                    raise ScriptVerifyError("negative_locktime")
+                if not (sequence & SEQUENCE_LOCKTIME_DISABLE_FLAG):
+                    if not checker.check_sequence(sequence):
+                        raise ScriptVerifyError("unsatisfied_locktime")
+        elif opcode in (
+            op.OP_NOP1, op.OP_NOP4, op.OP_NOP5, op.OP_NOP6, op.OP_NOP7,
+            op.OP_NOP8, op.OP_NOP9, op.OP_NOP10,
+        ):
+            if flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS:
+                raise ScriptVerifyError("discourage_upgradable_nops")
+
+        elif opcode == op.OP_VERIFY:
+            if not _bool_from_stack(popstack()):
+                raise ScriptVerifyError("verify")
+        elif opcode == op.OP_RETURN:
+            raise ScriptVerifyError("op_return")
+
+        # -- stack ops --
+        elif opcode == op.OP_TOALTSTACK:
+            altstack.append(popstack())
+        elif opcode == op.OP_FROMALTSTACK:
+            if not altstack:
+                raise ScriptVerifyError("invalid_altstack_operation")
+            stack.append(altstack.pop())
+        elif opcode == op.OP_2DROP:
+            popstack()
+            popstack()
+        elif opcode == op.OP_2DUP:
+            if len(stack) < 2:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.extend([stack[-2], stack[-1]])
+        elif opcode == op.OP_3DUP:
+            if len(stack) < 3:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.extend([stack[-3], stack[-2], stack[-1]])
+        elif opcode == op.OP_2OVER:
+            if len(stack) < 4:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.extend([stack[-4], stack[-3]])
+        elif opcode == op.OP_2ROT:
+            if len(stack) < 6:
+                raise ScriptVerifyError("invalid_stack_operation")
+            a, b = stack[-6], stack[-5]
+            del stack[-6:-4]
+            stack.extend([a, b])
+        elif opcode == op.OP_2SWAP:
+            if len(stack) < 4:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack[-4], stack[-3], stack[-2], stack[-1] = (
+                stack[-2], stack[-1], stack[-4], stack[-3],
+            )
+        elif opcode == op.OP_IFDUP:
+            if not stack:
+                raise ScriptVerifyError("invalid_stack_operation")
+            if _bool_from_stack(stack[-1]):
+                stack.append(stack[-1])
+        elif opcode == op.OP_DEPTH:
+            stack.append(script_num_encode(len(stack)))
+        elif opcode == op.OP_DROP:
+            popstack()
+        elif opcode == op.OP_DUP:
+            if not stack:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.append(stack[-1])
+        elif opcode == op.OP_NIP:
+            if len(stack) < 2:
+                raise ScriptVerifyError("invalid_stack_operation")
+            del stack[-2]
+        elif opcode == op.OP_OVER:
+            if len(stack) < 2:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.append(stack[-2])
+        elif opcode in (op.OP_PICK, op.OP_ROLL):
+            n = popnum()
+            if n < 0 or n >= len(stack):
+                raise ScriptVerifyError("invalid_stack_operation")
+            v = stack[-n - 1]
+            if opcode == op.OP_ROLL:
+                del stack[-n - 1]
+            stack.append(v)
+        elif opcode == op.OP_ROT:
+            if len(stack) < 3:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack[-3], stack[-2], stack[-1] = stack[-2], stack[-1], stack[-3]
+        elif opcode == op.OP_SWAP:
+            if len(stack) < 2:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack[-2], stack[-1] = stack[-1], stack[-2]
+        elif opcode == op.OP_TUCK:
+            if len(stack) < 2:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.insert(-2, stack[-1])
+        elif opcode == op.OP_SIZE:
+            if not stack:
+                raise ScriptVerifyError("invalid_stack_operation")
+            stack.append(script_num_encode(len(stack[-1])))
+
+        # -- equality --
+        elif opcode in (op.OP_EQUAL, op.OP_EQUALVERIFY):
+            b2 = popstack()
+            b1 = popstack()
+            equal = b1 == b2
+            if opcode == op.OP_EQUALVERIFY:
+                if not equal:
+                    raise ScriptVerifyError("equalverify")
+            else:
+                stack.append(_TRUE if equal else _FALSE)
+        elif opcode in (op.OP_RESERVED, op.OP_RESERVED1, op.OP_RESERVED2, op.OP_VER):
+            raise ScriptVerifyError("bad_opcode")
+
+        # -- numeric --
+        elif opcode in (
+            op.OP_1ADD, op.OP_1SUB, op.OP_NEGATE, op.OP_ABS, op.OP_NOT,
+            op.OP_0NOTEQUAL,
+        ):
+            n = popnum()
+            if opcode == op.OP_1ADD:
+                n += 1
+            elif opcode == op.OP_1SUB:
+                n -= 1
+            elif opcode == op.OP_NEGATE:
+                n = -n
+            elif opcode == op.OP_ABS:
+                n = abs(n)
+            elif opcode == op.OP_NOT:
+                n = int(n == 0)
+            else:
+                n = int(n != 0)
+            stack.append(script_num_encode(n))
+        elif opcode in (
+            op.OP_ADD, op.OP_SUB, op.OP_BOOLAND, op.OP_BOOLOR, op.OP_NUMEQUAL,
+            op.OP_NUMEQUALVERIFY, op.OP_NUMNOTEQUAL, op.OP_LESSTHAN,
+            op.OP_GREATERTHAN, op.OP_LESSTHANOREQUAL, op.OP_GREATERTHANOREQUAL,
+            op.OP_MIN, op.OP_MAX,
+        ):
+            n2 = popnum()
+            n1 = popnum()
+            if opcode == op.OP_ADD:
+                r: int = n1 + n2
+            elif opcode == op.OP_SUB:
+                r = n1 - n2
+            elif opcode == op.OP_BOOLAND:
+                r = int(n1 != 0 and n2 != 0)
+            elif opcode == op.OP_BOOLOR:
+                r = int(n1 != 0 or n2 != 0)
+            elif opcode in (op.OP_NUMEQUAL, op.OP_NUMEQUALVERIFY):
+                r = int(n1 == n2)
+            elif opcode == op.OP_NUMNOTEQUAL:
+                r = int(n1 != n2)
+            elif opcode == op.OP_LESSTHAN:
+                r = int(n1 < n2)
+            elif opcode == op.OP_GREATERTHAN:
+                r = int(n1 > n2)
+            elif opcode == op.OP_LESSTHANOREQUAL:
+                r = int(n1 <= n2)
+            elif opcode == op.OP_GREATERTHANOREQUAL:
+                r = int(n1 >= n2)
+            elif opcode == op.OP_MIN:
+                r = min(n1, n2)
+            else:
+                r = max(n1, n2)
+            if opcode == op.OP_NUMEQUALVERIFY:
+                if not r:
+                    raise ScriptVerifyError("numequalverify")
+            else:
+                stack.append(script_num_encode(r))
+        elif opcode == op.OP_WITHIN:
+            n3 = popnum()
+            n2 = popnum()
+            n1 = popnum()
+            stack.append(_TRUE if n2 <= n1 < n3 else _FALSE)
+
+        # -- crypto --
+        elif opcode in (
+            op.OP_RIPEMD160, op.OP_SHA1, op.OP_SHA256, op.OP_HASH160,
+            op.OP_HASH256,
+        ):
+            v = popstack()
+            if opcode == op.OP_RIPEMD160:
+                h = ripemd160(v)
+            elif opcode == op.OP_SHA1:
+                import hashlib
+
+                h = hashlib.sha1(v).digest()
+            elif opcode == op.OP_SHA256:
+                h = sha256(v)
+            elif opcode == op.OP_HASH160:
+                h = hash160(v)
+            else:
+                h = sha256d(v)
+            stack.append(h)
+        elif opcode == op.OP_CODESEPARATOR:
+            begincode = parsed.offset + 1
+        elif opcode in (op.OP_CHECKSIG, op.OP_CHECKSIGVERIFY):
+            pubkey = popstack()
+            sig = popstack()
+            subscript = Script(script.raw[begincode:])
+            subscript = subscript.find_and_delete(Script.build(sig))
+            _check_signature_encoding(sig, flags)
+            _check_pubkey_encoding(pubkey, flags)
+            ok = checker.check_sig(sig, pubkey, subscript)
+            if not ok and (flags & VERIFY_NULLFAIL) and len(sig):
+                raise ScriptVerifyError("nullfail")
+            if opcode == op.OP_CHECKSIGVERIFY:
+                if not ok:
+                    raise ScriptVerifyError("checksigverify")
+            else:
+                stack.append(_TRUE if ok else _FALSE)
+        elif opcode in (op.OP_CHECKMULTISIG, op.OP_CHECKMULTISIGVERIFY):
+            n_keys = popnum()
+            if n_keys < 0 or n_keys > MAX_PUBKEYS_PER_MULTISIG:
+                raise ScriptVerifyError("pubkey_count")
+            op_count += n_keys
+            if op_count > MAX_OPS_PER_SCRIPT:
+                raise ScriptVerifyError("op_count")
+            keys = [popstack() for _ in range(n_keys)]
+            n_sigs = popnum()
+            if n_sigs < 0 or n_sigs > n_keys:
+                raise ScriptVerifyError("sig_count")
+            sigs = [popstack() for _ in range(n_sigs)]
+            subscript = Script(script.raw[begincode:])
+            for sig in sigs:
+                subscript = subscript.find_and_delete(Script.build(sig))
+            ok = True
+            ikey = 0
+            isig = 0
+            while isig < len(sigs) and ok:
+                if ikey >= len(keys):
+                    ok = False
+                    break
+                sig = sigs[isig]
+                key = keys[ikey]
+                _check_signature_encoding(sig, flags)
+                _check_pubkey_encoding(key, flags)
+                if checker.check_sig(sig, key, subscript):
+                    isig += 1
+                ikey += 1
+                if len(sigs) - isig > len(keys) - ikey:
+                    ok = False
+            if not ok and (flags & VERIFY_NULLFAIL):
+                if any(len(s) for s in sigs):
+                    raise ScriptVerifyError("nullfail")
+            # the extra stack dummy (CHECKMULTISIG bug)
+            dummy = popstack()
+            if flags & VERIFY_NULLDUMMY and len(dummy):
+                raise ScriptVerifyError("sig_nulldummy")
+            if opcode == op.OP_CHECKMULTISIGVERIFY:
+                if not ok:
+                    raise ScriptVerifyError("checkmultisigverify")
+            else:
+                stack.append(_TRUE if ok else _FALSE)
+
+        elif opcode == op.OP_ASSET:
+            # asset envelope: no-op; trailing payload already consumed as
+            # data by the parser (ref interpreter.cpp:1119 "break")
+            pass
+        else:
+            raise ScriptVerifyError("bad_opcode")
+
+        if len(stack) + len(altstack) > 1000:
+            raise ScriptVerifyError("stack_size")
+
+    if vf_exec:
+        raise ScriptVerifyError("unbalanced_conditional")
+
+
+def verify_script(
+    script_sig: Script,
+    script_pubkey: Script,
+    flags: int,
+    checker: BaseSignatureChecker,
+) -> tuple[bool, str]:
+    """ref interpreter.cpp VerifyScript: scriptSig, scriptPubKey, P2SH,
+    cleanstack."""
+    if flags & VERIFY_SIGPUSHONLY and not script_sig.is_push_only():
+        return False, "sig_pushonly"
+
+    stack: List[bytes] = []
+    ok, err = eval_script(stack, script_sig, flags, checker)
+    if not ok:
+        return False, err
+    stack_copy = list(stack) if flags & VERIFY_P2SH else []
+    ok, err = eval_script(stack, script_pubkey, flags, checker)
+    if not ok:
+        return False, err
+    if not stack or not _bool_from_stack(stack[-1]):
+        return False, "eval_false"
+
+    if flags & VERIFY_P2SH and script_pubkey.is_pay_to_script_hash():
+        if not script_sig.is_push_only():
+            return False, "sig_pushonly"
+        stack = stack_copy
+        if not stack:
+            return False, "invalid_stack_operation"
+        redeem = Script(stack.pop())
+        ok, err = eval_script(stack, redeem, flags, checker)
+        if not ok:
+            return False, err
+        if not stack or not _bool_from_stack(stack[-1]):
+            return False, "eval_false"
+
+    if flags & VERIFY_CLEANSTACK:
+        if len(stack) != 1:
+            return False, "cleanstack"
+
+    return True, ""

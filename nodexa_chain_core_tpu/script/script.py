@@ -1,0 +1,385 @@
+"""CScript equivalent: byte container with opcode iteration and templates.
+
+Parity: reference src/script/script.{h,cpp} — GetOp consumption rules
+(including the asset-envelope rule that everything after OP_ASSET is data,
+script.h:582), push encoding, small-int codec, sigop counting, and the
+asset-script template probes (script.cpp:IsAssetScript — P2PKH prefix, 0xc0
+at byte 25, "rvn" marker then q/o/r/t type byte).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+from . import opcodes as op
+
+MAX_SCRIPT_SIZE = 10_000
+MAX_SCRIPT_ELEMENT_SIZE = 520
+MAX_OPS_PER_SCRIPT = 201
+MAX_PUBKEYS_PER_MULTISIG = 20
+
+# Asset envelope markers (wire-compatible with the reference chain:
+# assets.h:22-27 spells "rvn" in CLORE_N/E/X plus type chars q/o/r/t).
+ASSET_MARKER = b"rvn"
+ASSET_NEW = ord("q")
+ASSET_OWNER = ord("o")
+ASSET_REISSUE = ord("r")
+ASSET_TRANSFER = ord("t")
+
+
+class ScriptError(Exception):
+    pass
+
+
+def push_data(data: bytes) -> bytes:
+    """Minimal push encoding for arbitrary data."""
+    n = len(data)
+    if n == 0:
+        return bytes([op.OP_0])
+    if n == 1 and 1 <= data[0] <= 16:
+        return bytes([op.OP_1 + data[0] - 1])
+    if n == 1 and data[0] == 0x81:
+        return bytes([op.OP_1NEGATE])
+    if n < op.OP_PUSHDATA1:
+        return bytes([n]) + data
+    if n <= 0xFF:
+        return bytes([op.OP_PUSHDATA1, n]) + data
+    if n <= 0xFFFF:
+        return bytes([op.OP_PUSHDATA2]) + n.to_bytes(2, "little") + data
+    return bytes([op.OP_PUSHDATA4]) + n.to_bytes(4, "little") + data
+
+
+def push_int(n: int) -> bytes:
+    if n == 0:
+        return bytes([op.OP_0])
+    if 1 <= n <= 16:
+        return bytes([op.OP_1 + n - 1])
+    if n == -1:
+        return bytes([op.OP_1NEGATE])
+    return push_data(script_num_encode(n))
+
+
+def script_num_encode(n: int) -> bytes:
+    """CScriptNum serialization (ref script.h CScriptNum::serialize)."""
+    if n == 0:
+        return b""
+    negative = n < 0
+    absv = abs(n)
+    out = bytearray()
+    while absv:
+        out.append(absv & 0xFF)
+        absv >>= 8
+    if out[-1] & 0x80:
+        out.append(0x80 if negative else 0x00)
+    elif negative:
+        out[-1] |= 0x80
+    return bytes(out)
+
+
+def script_num_decode(data: bytes, max_size: int = 4, require_minimal: bool = False) -> int:
+    """CScriptNum deserialization with optional minimality (ref script.h)."""
+    if len(data) > max_size:
+        raise ScriptError("script number overflow")
+    if require_minimal and data:
+        if data[-1] & 0x7F == 0:
+            if len(data) <= 1 or not (data[-2] & 0x80):
+                raise ScriptError("non-minimal script number")
+    if not data:
+        return 0
+    v = int.from_bytes(data, "little")
+    if data[-1] & 0x80:
+        v &= (1 << (len(data) * 8 - 1)) - 1
+        return -v
+    return v
+
+
+@dataclass(frozen=True)
+class ParsedOp:
+    opcode: int
+    data: Optional[bytes]
+    offset: int  # byte offset where this op started
+
+
+class Script:
+    """Immutable script wrapper around bytes."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes = b""):
+        self.raw = bytes(raw)
+
+    def __len__(self) -> int:
+        return len(self.raw)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Script) and self.raw == other.raw
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def __repr__(self):
+        return f"Script({self.raw.hex()})"
+
+    def __add__(self, other: "Script") -> "Script":
+        return Script(self.raw + other.raw)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def build(cls, *items) -> "Script":
+        """items: int => opcode (or small-int push), bytes => data push."""
+        out = bytearray()
+        for it in items:
+            if isinstance(it, int):
+                if 0 <= it <= 0xFF:
+                    out.append(it)
+                else:
+                    out += push_int(it)
+            elif isinstance(it, (bytes, bytearray)):
+                out += push_data(bytes(it))
+            elif isinstance(it, Script):
+                out += it.raw
+            else:
+                raise TypeError(f"cannot build script from {type(it)}")
+        return cls(bytes(out))
+
+    # -- iteration -------------------------------------------------------
+
+    def ops(self) -> Iterator[ParsedOp]:
+        """Yield parsed operations; raises ScriptError on truncation.
+
+        Mirrors GetOp: after OP_ASSET the remainder of the script is one
+        data blob (ref script.h:582).
+        """
+        raw = self.raw
+        i = 0
+        n = len(raw)
+        while i < n:
+            start = i
+            opcode = raw[i]
+            i += 1
+            data = None
+            if opcode <= op.OP_PUSHDATA4:
+                if opcode < op.OP_PUSHDATA1:
+                    size = opcode
+                elif opcode == op.OP_PUSHDATA1:
+                    if i + 1 > n:
+                        raise ScriptError("truncated PUSHDATA1")
+                    size = raw[i]
+                    i += 1
+                elif opcode == op.OP_PUSHDATA2:
+                    if i + 2 > n:
+                        raise ScriptError("truncated PUSHDATA2")
+                    size = int.from_bytes(raw[i : i + 2], "little")
+                    i += 2
+                else:
+                    if i + 4 > n:
+                        raise ScriptError("truncated PUSHDATA4")
+                    size = int.from_bytes(raw[i : i + 4], "little")
+                    i += 4
+                if i + size > n:
+                    raise ScriptError("push past end")
+                data = raw[i : i + size]
+                i += size
+            elif opcode == op.OP_ASSET:
+                data = raw[i:]
+                i = n
+            yield ParsedOp(opcode, data, start)
+
+    def try_ops(self) -> Tuple[List[ParsedOp], bool]:
+        out: List[ParsedOp] = []
+        try:
+            for p in self.ops():
+                out.append(p)
+            return out, True
+        except ScriptError:
+            return out, False
+
+    # -- templates -------------------------------------------------------
+
+    def is_pay_to_script_hash(self) -> bool:
+        r = self.raw
+        return (
+            len(r) == 23
+            and r[0] == op.OP_HASH160
+            and r[1] == 20
+            and r[22] == op.OP_EQUAL
+        )
+
+    def is_pay_to_pubkey_hash(self) -> bool:
+        r = self.raw
+        return (
+            len(r) == 25
+            and r[0] == op.OP_DUP
+            and r[1] == op.OP_HASH160
+            and r[2] == 20
+            and r[23] == op.OP_EQUALVERIFY
+            and r[24] == op.OP_CHECKSIG
+        )
+
+    def is_push_only(self) -> bool:
+        try:
+            for p in self.ops():
+                if p.opcode > op.OP_16:
+                    return False
+        except ScriptError:
+            return False
+        return True
+
+    def is_unspendable(self) -> bool:
+        return (len(self.raw) > 0 and self.raw[0] == op.OP_RETURN) or len(
+            self.raw
+        ) > MAX_SCRIPT_SIZE
+
+    # -- asset templates (ref script.cpp IsAssetScript) -------------------
+
+    def asset_script_type(self) -> Optional[Tuple[str, int]]:
+        """Returns (kind, payload_start) for asset scripts, else None.
+
+        kind in {"new", "owner", "reissue", "transfer"}; payload_start is
+        the byte index where the serialized asset data begins (ref
+        script.cpp:IsAssetScript nStartingIndex).
+        """
+        r = self.raw
+        if len(r) <= 31 or r[25] != op.OP_ASSET:
+            return None
+        # marker at 27 (small scripts) or 28 (pushdata1 form)
+        for base in (27, 28):
+            if r[base : base + 3] == ASSET_MARKER:
+                t = r[base + 3]
+                start = base + 4
+                if t == ASSET_TRANSFER:
+                    return "transfer", start
+                if t == ASSET_NEW and len(r) > 39:
+                    return "new", start
+                if t == ASSET_OWNER:
+                    return "owner", start
+                if t == ASSET_REISSUE:
+                    return "reissue", start
+                return None
+        return None
+
+    def is_asset_script(self) -> bool:
+        return self.asset_script_type() is not None
+
+    def is_null_asset_tx_data_script(self) -> bool:
+        """ref script.cpp:352 — OP_ASSET OP_RESERVED <data>."""
+        r = self.raw
+        return (
+            len(r) > 23
+            and r[0] == op.OP_ASSET
+            and r[1] == op.OP_RESERVED
+            and r[2] != op.OP_RESERVED
+        )
+
+    def is_null_global_restriction_script(self) -> bool:
+        """ref script.cpp:342 — OP_ASSET OP_RESERVED OP_RESERVED <data>."""
+        r = self.raw
+        return (
+            len(r) > 6
+            and r[0] == op.OP_ASSET
+            and r[1] == op.OP_RESERVED
+            and r[2] == op.OP_RESERVED
+        )
+
+    def is_null_asset_verifier_script(self) -> bool:
+        return self.is_null_global_restriction_script()
+
+    # -- sigops ----------------------------------------------------------
+
+    def sigop_count(self, accurate: bool) -> int:
+        """ref script.cpp GetSigOpCount."""
+        count = 0
+        last = op.OP_INVALIDOPCODE
+        try:
+            for p in self.ops():
+                if p.opcode in (op.OP_CHECKSIG, op.OP_CHECKSIGVERIFY):
+                    count += 1
+                elif p.opcode in (op.OP_CHECKMULTISIG, op.OP_CHECKMULTISIGVERIFY):
+                    if accurate and op.OP_1 <= last <= op.OP_16:
+                        count += decode_op_n(last)
+                    else:
+                        count += MAX_PUBKEYS_PER_MULTISIG
+                last = p.opcode
+        except ScriptError:
+            pass
+        return count
+
+    def p2sh_sigop_count(self, script_sig: "Script") -> int:
+        if not self.is_pay_to_script_hash():
+            return self.sigop_count(True)
+        last_data = None
+        try:
+            for p in script_sig.ops():
+                if p.opcode > op.OP_16:
+                    return 0
+                last_data = p.data
+        except ScriptError:
+            return 0
+        if last_data is None:
+            return 0
+        return Script(last_data).sigop_count(True)
+
+    def find_and_delete(self, needle: "Script") -> "Script":
+        """Remove occurrences of `needle` at op boundaries (ref
+        script.h FindAndDelete — the legacy sighash quirk)."""
+        nb = needle.raw
+        if not nb:
+            return self
+        raw = self.raw
+        n = len(raw)
+        out = bytearray()
+        pc = 0
+        seg = 0  # start of the pending copy segment
+        while True:
+            # at an op boundary: skim any needle matches
+            if raw[pc : pc + len(nb)] == nb:
+                out += raw[seg:pc]
+                while raw[pc : pc + len(nb)] == nb:
+                    pc += len(nb)
+                seg = pc
+            if pc >= n:
+                break
+            # advance one operation
+            opcode = raw[pc]
+            pc += 1
+            if opcode <= op.OP_PUSHDATA4:
+                if opcode < op.OP_PUSHDATA1:
+                    size = opcode
+                elif opcode == op.OP_PUSHDATA1:
+                    if pc + 1 > n:
+                        break
+                    size = raw[pc]
+                    pc += 1
+                elif opcode == op.OP_PUSHDATA2:
+                    if pc + 2 > n:
+                        break
+                    size = int.from_bytes(raw[pc : pc + 2], "little")
+                    pc += 2
+                else:
+                    if pc + 4 > n:
+                        break
+                    size = int.from_bytes(raw[pc : pc + 4], "little")
+                    pc += 4
+                if pc + size > n:
+                    break
+                pc += size
+            elif opcode == op.OP_ASSET:
+                pc = n
+        out += raw[seg:]
+        return Script(bytes(out))
+
+
+def decode_op_n(opcode: int) -> int:
+    if opcode == op.OP_0:
+        return 0
+    if not op.OP_1 <= opcode <= op.OP_16:
+        raise ScriptError("not a small int opcode")
+    return opcode - (op.OP_1 - 1)
+
+
+def encode_op_n(n: int) -> int:
+    if not 0 <= n <= 16:
+        raise ScriptError("small int out of range")
+    return op.OP_0 if n == 0 else op.OP_1 + n - 1

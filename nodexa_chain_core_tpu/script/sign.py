@@ -1,0 +1,135 @@
+"""Transaction signing (parity: reference src/script/sign.{h,cpp}).
+
+``produce_signature``/``sign_tx_input`` cover P2PK, P2PKH, P2SH and
+bare multisig — the reference's SignStep/ProduceSignature surface.  Asset
+outputs embed a P2PKH prefix, so spending them is P2PKH signing over the
+full (asset-carrying) scriptPubKey.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..crypto import secp256k1 as ec
+from ..crypto.hashes import hash160
+from ..primitives.transaction import Transaction
+from . import opcodes as op
+from .interpreter import SIGHASH_ALL, signature_hash
+from .script import Script
+from .standard import (
+    TX_MULTISIG,
+    TX_NEW_ASSET,
+    TX_PUBKEY,
+    TX_PUBKEYHASH,
+    TX_REISSUE_ASSET,
+    TX_SCRIPTHASH,
+    TX_TRANSFER_ASSET,
+    solver,
+)
+
+
+class SigningError(Exception):
+    pass
+
+
+class KeyStore:
+    """Minimal in-memory key store (ref keystore.h CBasicKeyStore)."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[bytes, int] = {}  # hash160(pub) -> privkey
+        self._pubs: Dict[bytes, bytes] = {}  # hash160(pub) -> pub bytes
+        self._scripts: Dict[bytes, Script] = {}  # hash160(script) -> script
+
+    def add_key(self, priv: int, compressed: bool = True) -> bytes:
+        pub = ec.pubkey_serialize(ec.pubkey_create(priv), compressed)
+        kid = hash160(pub)
+        self._keys[kid] = priv
+        self._pubs[kid] = pub
+        return kid
+
+    def add_script(self, script: Script) -> bytes:
+        sid = hash160(script.raw)
+        self._scripts[sid] = script
+        return sid
+
+    def get_priv(self, keyid: bytes) -> Optional[int]:
+        return self._keys.get(keyid)
+
+    def get_pub(self, keyid: bytes) -> Optional[bytes]:
+        return self._pubs.get(keyid)
+
+    def priv_for_pub(self, pub: bytes) -> Optional[int]:
+        return self._keys.get(hash160(pub))
+
+    def get_script(self, scriptid: bytes) -> Optional[Script]:
+        return self._scripts.get(scriptid)
+
+    def keys(self):
+        return dict(self._keys)
+
+
+def _make_sig(
+    priv: int, script_code: Script, tx: Transaction, in_idx: int, hashtype: int
+) -> bytes:
+    digest = signature_hash(script_code, tx, in_idx, hashtype)
+    r, s = ec.sign(priv, digest)
+    return ec.sig_to_der(r, s) + bytes([hashtype])
+
+
+def _sign_step(
+    keystore: KeyStore,
+    script_pubkey: Script,
+    tx: Transaction,
+    in_idx: int,
+    hashtype: int,
+) -> List[bytes]:
+    """Solve one level; returns the scriptSig stack (ref sign.cpp SignStep)."""
+    kind, sols = solver(script_pubkey)
+    if kind == TX_PUBKEY:
+        priv = keystore.priv_for_pub(sols[0])
+        if priv is None:
+            raise SigningError("missing key for pay-to-pubkey")
+        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype)]
+    if kind in (TX_PUBKEYHASH, TX_NEW_ASSET, TX_TRANSFER_ASSET, TX_REISSUE_ASSET):
+        kid = sols[0]
+        priv = keystore.get_priv(kid)
+        pub = keystore.get_pub(kid)
+        if priv is None or pub is None:
+            raise SigningError("missing key for pubkeyhash")
+        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype), pub]
+    if kind == TX_MULTISIG:
+        m = sols[0][0]
+        pubkeys = sols[1:-1]
+        sigs: List[bytes] = [b""]  # CHECKMULTISIG dummy
+        count = 0
+        for pub in pubkeys:
+            if count >= m:
+                break
+            priv = keystore.priv_for_pub(pub)
+            if priv is None:
+                continue
+            sigs.append(_make_sig(priv, script_pubkey, tx, in_idx, hashtype))
+            count += 1
+        if count < m:
+            raise SigningError(f"have {count} of {m} multisig keys")
+        return sigs
+    if kind == TX_SCRIPTHASH:
+        redeem = keystore.get_script(sols[0])
+        if redeem is None:
+            raise SigningError("missing redeem script")
+        inner = _sign_step(keystore, redeem, tx, in_idx, hashtype)
+        return inner + [redeem.raw]
+    raise SigningError(f"cannot sign {kind} output")
+
+
+def sign_tx_input(
+    keystore: KeyStore,
+    tx: Transaction,
+    in_idx: int,
+    script_pubkey: Script,
+    hashtype: int = SIGHASH_ALL,
+) -> None:
+    """Sign input in place (ref sign.cpp SignSignature)."""
+    stack = _sign_step(keystore, script_pubkey, tx, in_idx, hashtype)
+    tx.vin[in_idx].script_sig = Script.build(*stack).raw
+    tx.rehash()

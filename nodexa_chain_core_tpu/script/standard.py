@@ -1,0 +1,192 @@
+"""Standard output templates and destinations.
+
+Parity: reference src/script/standard.{h,cpp} — Solver over TX_PUBKEY /
+TX_PUBKEYHASH / TX_SCRIPTHASH / TX_MULTISIG / TX_NULL_DATA plus the asset
+output classes (TX_NEW_ASSET / TX_TRANSFER_ASSET / TX_REISSUE_ASSET and the
+restricted-asset null-data kinds), and address <-> script conversion via the
+network's base58 version bytes (ref src/base58.cpp CCloreAddress).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+from ..crypto.hashes import hash160
+from ..utils.base58 import b58check_decode, b58check_encode
+from . import opcodes as op
+from .script import Script, ScriptError, decode_op_n
+
+# template class names (ref standard.h txnouttype)
+TX_NONSTANDARD = "nonstandard"
+TX_PUBKEY = "pubkey"
+TX_PUBKEYHASH = "pubkeyhash"
+TX_SCRIPTHASH = "scripthash"
+TX_MULTISIG = "multisig"
+TX_NULL_DATA = "nulldata"
+TX_NEW_ASSET = "new_asset"
+TX_TRANSFER_ASSET = "transfer_asset"
+TX_REISSUE_ASSET = "reissue_asset"
+TX_RESTRICTED_ASSET_DATA = "restricted_asset_data"
+
+MAX_OP_RETURN_RELAY = 83
+
+
+@dataclass(frozen=True)
+class KeyID:
+    """hash160 of a pubkey."""
+
+    h: bytes
+
+    def __post_init__(self):
+        assert len(self.h) == 20
+
+
+@dataclass(frozen=True)
+class ScriptID:
+    """hash160 of a redeem script."""
+
+    h: bytes
+
+    def __post_init__(self):
+        assert len(self.h) == 20
+
+
+Destination = Union[KeyID, ScriptID]
+
+
+def solver(script: Script) -> Tuple[str, List[bytes]]:
+    """Classify a scriptPubKey (ref standard.cpp Solver)."""
+    ast = script.asset_script_type()
+    if ast is not None:
+        kind, _ = ast
+        mapping = {
+            "new": TX_NEW_ASSET,
+            "owner": TX_NEW_ASSET,
+            "reissue": TX_REISSUE_ASSET,
+            "transfer": TX_TRANSFER_ASSET,
+        }
+        # solutions: the embedded P2PKH hash
+        return mapping[kind], [script.raw[3:23]]
+    if script.is_null_asset_tx_data_script() or script.is_null_global_restriction_script():
+        return TX_RESTRICTED_ASSET_DATA, []
+
+    if script.is_pay_to_script_hash():
+        return TX_SCRIPTHASH, [script.raw[2:22]]
+    if script.is_pay_to_pubkey_hash():
+        return TX_PUBKEYHASH, [script.raw[3:23]]
+
+    try:
+        parsed = list(script.ops())
+    except ScriptError:
+        return TX_NONSTANDARD, []
+
+    # data carrier: OP_RETURN followed by pushes only
+    if parsed and parsed[0].opcode == op.OP_RETURN:
+        if all(p.opcode <= op.OP_16 for p in parsed[1:]):
+            return TX_NULL_DATA, [p.data for p in parsed[1:] if p.data is not None]
+        return TX_NONSTANDARD, []
+
+    # pay-to-pubkey: <pubkey> OP_CHECKSIG
+    if (
+        len(parsed) == 2
+        and parsed[0].data is not None
+        and len(parsed[0].data) in (33, 65)
+        and parsed[1].opcode == op.OP_CHECKSIG
+    ):
+        return TX_PUBKEY, [parsed[0].data]
+
+    # multisig: m <pk..> n OP_CHECKMULTISIG
+    if (
+        len(parsed) >= 4
+        and parsed[-1].opcode == op.OP_CHECKMULTISIG
+        and op.OP_1 <= parsed[0].opcode <= op.OP_16
+        and op.OP_1 <= parsed[-2].opcode <= op.OP_16
+    ):
+        m = decode_op_n(parsed[0].opcode)
+        n = decode_op_n(parsed[-2].opcode)
+        keys = [p.data for p in parsed[1:-2]]
+        if (
+            len(keys) == n
+            and 1 <= m <= n
+            and all(k is not None and len(k) in (33, 65) for k in keys)
+        ):
+            return TX_MULTISIG, [bytes([m])] + keys + [bytes([n])]
+
+    return TX_NONSTANDARD, []
+
+
+def extract_destination(script: Script) -> Optional[Destination]:
+    """ref standard.cpp ExtractDestination (asset scripts resolve to the
+    embedded P2PKH destination)."""
+    kind, sols = solver(script)
+    if kind == TX_PUBKEY:
+        return KeyID(hash160(sols[0]))
+    if kind in (TX_PUBKEYHASH, TX_NEW_ASSET, TX_TRANSFER_ASSET, TX_REISSUE_ASSET):
+        return KeyID(sols[0])
+    if kind == TX_SCRIPTHASH:
+        return ScriptID(sols[0])
+    return None
+
+
+# --- script construction ----------------------------------------------------
+
+
+def p2pkh_script(keyid: KeyID) -> Script:
+    return Script.build(
+        op.OP_DUP, op.OP_HASH160, keyid.h, op.OP_EQUALVERIFY, op.OP_CHECKSIG
+    )
+
+
+def p2sh_script(scriptid: ScriptID) -> Script:
+    return Script.build(op.OP_HASH160, scriptid.h, op.OP_EQUAL)
+
+
+def p2pk_script(pubkey: bytes) -> Script:
+    return Script.build(pubkey, op.OP_CHECKSIG)
+
+
+def multisig_script(m: int, pubkeys: List[bytes]) -> Script:
+    from .script import encode_op_n
+
+    items: list = [encode_op_n(m)]
+    items.extend(pubkeys)
+    items.append(encode_op_n(len(pubkeys)))
+    items.append(op.OP_CHECKMULTISIG)
+    return Script.build(*items)
+
+
+def nulldata_script(data: bytes) -> Script:
+    return Script.build(op.OP_RETURN, data)
+
+
+def script_for_destination(dest: Destination) -> Script:
+    if isinstance(dest, KeyID):
+        return p2pkh_script(dest)
+    if isinstance(dest, ScriptID):
+        return p2sh_script(dest)
+    raise TypeError("unknown destination")
+
+
+# --- addresses --------------------------------------------------------------
+
+
+def encode_destination(dest: Destination, params) -> str:
+    """Destination -> base58check address using network prefixes."""
+    if isinstance(dest, KeyID):
+        return b58check_encode(bytes([params.prefix_pubkey]) + dest.h)
+    if isinstance(dest, ScriptID):
+        return b58check_encode(bytes([params.prefix_script]) + dest.h)
+    raise TypeError("unknown destination")
+
+
+def decode_destination(addr: str, params) -> Destination:
+    payload = b58check_decode(addr)
+    if len(payload) != 21:
+        raise ValueError("bad address length")
+    version, h = payload[0], payload[1:]
+    if version == params.prefix_pubkey:
+        return KeyID(h)
+    if version == params.prefix_script:
+        return ScriptID(h)
+    raise ValueError(f"address version {version} not valid for {params.network}")

@@ -1,0 +1,279 @@
+"""Script VM tests: sign/verify end-to-end, templates, VM semantics."""
+
+import pytest
+
+from nodexa_chain_core_tpu.crypto import secp256k1 as ec
+from nodexa_chain_core_tpu.crypto.hashes import hash160
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script import opcodes as op
+from nodexa_chain_core_tpu.script.interpreter import (
+    SIGHASH_ALL,
+    SIGHASH_ANYONECANPAY,
+    SIGHASH_NONE,
+    SIGHASH_SINGLE,
+    STANDARD_SCRIPT_VERIFY_FLAGS,
+    TransactionSignatureChecker,
+    VERIFY_CLEANSTACK,
+    VERIFY_MINIMALDATA,
+    VERIFY_P2SH,
+    eval_script,
+    signature_hash,
+    verify_script,
+)
+from nodexa_chain_core_tpu.script.script import (
+    Script,
+    script_num_decode,
+    script_num_encode,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore, SigningError, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import (
+    KeyID,
+    ScriptID,
+    TX_MULTISIG,
+    TX_NEW_ASSET,
+    TX_NULL_DATA,
+    TX_PUBKEY,
+    TX_PUBKEYHASH,
+    TX_SCRIPTHASH,
+    TX_TRANSFER_ASSET,
+    extract_destination,
+    multisig_script,
+    nulldata_script,
+    p2pkh_script,
+    p2sh_script,
+    script_for_destination,
+    solver,
+)
+
+
+def make_spend(script_pubkey: Script, value=10_000):
+    """A fake prev tx + a spending tx."""
+    prev = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(), script_sig=b"\x51")],
+        vout=[TxOut(value=value, script_pubkey=script_pubkey.raw)],
+    )
+    spend = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(txid=prev.txid, n=0))],
+        vout=[TxOut(value=value - 1000, script_pubkey=b"\x6a")],
+    )
+    return prev, spend
+
+
+def run_verify(spend, script_pubkey, flags=STANDARD_SCRIPT_VERIFY_FLAGS):
+    checker = TransactionSignatureChecker(spend, 0)
+    return verify_script(
+        Script(spend.vin[0].script_sig), script_pubkey, flags, checker
+    )
+
+
+def test_p2pkh_end_to_end():
+    ks = KeyStore()
+    kid = ks.add_key(0xDEAD1)
+    spk = p2pkh_script(KeyID(kid))
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk)
+    ok, err = run_verify(spend, spk)
+    assert ok, err
+
+
+def test_p2pkh_wrong_key_fails():
+    ks = KeyStore()
+    kid = ks.add_key(0xDEAD2)
+    spk = p2pkh_script(KeyID(kid))
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk)
+    other = p2pkh_script(KeyID(ks.add_key(0xBEEF)))
+    ok, err = run_verify(spend, other)
+    assert not ok
+
+
+def test_tampered_tx_fails():
+    ks = KeyStore()
+    kid = ks.add_key(0xDEAD3)
+    spk = p2pkh_script(KeyID(kid))
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk)
+    spend.vout[0].value += 1  # invalidate the signature
+    ok, err = run_verify(spend, spk)
+    assert not ok and err == "nullfail"
+
+
+def test_p2sh_multisig_end_to_end():
+    ks = KeyStore()
+    pubs = []
+    for d in (11, 22, 33):
+        kid = ks.add_key(d)
+        pubs.append(ks.get_pub(kid))
+    redeem = multisig_script(2, pubs)
+    sid = ks.add_script(redeem)
+    spk = p2sh_script(ScriptID(sid))
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk)
+    ok, err = run_verify(spend, spk)
+    assert ok, err
+
+
+def test_p2sh_missing_redeem():
+    ks = KeyStore()
+    spk = p2sh_script(ScriptID(b"\x11" * 20))
+    prev, spend = make_spend(spk)
+    with pytest.raises(SigningError):
+        sign_tx_input(ks, spend, 0, spk)
+
+
+def test_bare_multisig():
+    ks = KeyStore()
+    pubs = [ks.get_pub(ks.add_key(d)) for d in (5, 6)]
+    spk = multisig_script(1, pubs)
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk)
+    ok, err = run_verify(spend, spk)
+    assert ok, err
+
+
+def test_sighash_types_verify():
+    for ht in (
+        SIGHASH_ALL,
+        SIGHASH_NONE,
+        SIGHASH_SINGLE,
+        SIGHASH_ALL | SIGHASH_ANYONECANPAY,
+    ):
+        ks = KeyStore()
+        kid = ks.add_key(0xABC0 + ht)
+        spk = p2pkh_script(KeyID(kid))
+        prev, spend = make_spend(spk)
+        sign_tx_input(ks, spend, 0, spk, hashtype=ht)
+        ok, err = run_verify(spend, spk)
+        assert ok, (ht, err)
+
+
+def test_sighash_none_allows_output_change():
+    ks = KeyStore()
+    kid = ks.add_key(0x5151)
+    spk = p2pkh_script(KeyID(kid))
+    prev, spend = make_spend(spk)
+    sign_tx_input(ks, spend, 0, spk, hashtype=SIGHASH_NONE)
+    spend.vout[0].value = 1  # outputs not covered by NONE
+    ok, err = run_verify(spend, spk)
+    assert ok, err
+
+
+def test_sighash_single_out_of_range_is_one():
+    tx = Transaction(
+        vin=[TxIn(prevout=OutPoint(txid=1, n=0)), TxIn(prevout=OutPoint(txid=1, n=1))],
+        vout=[TxOut(value=1, script_pubkey=b"")],
+    )
+    h = signature_hash(Script(b""), tx, 1, SIGHASH_SINGLE)
+    assert h == (1).to_bytes(32, "little")
+
+
+def test_solver_classification():
+    ks = KeyStore()
+    kid = ks.add_key(7)
+    pub = ks.get_pub(kid)
+    assert solver(p2pkh_script(KeyID(kid)))[0] == TX_PUBKEYHASH
+    assert solver(p2sh_script(ScriptID(b"\x01" * 20)))[0] == TX_SCRIPTHASH
+    assert solver(Script.build(pub, op.OP_CHECKSIG))[0] == TX_PUBKEY
+    assert solver(multisig_script(1, [pub]))[0] == TX_MULTISIG
+    assert solver(nulldata_script(b"hello"))[0] == TX_NULL_DATA
+    assert solver(Script(b"\x99\x88"))[0] == "nonstandard"
+
+
+def test_asset_script_detection():
+    ks = KeyStore()
+    kid = ks.add_key(8)
+    base = p2pkh_script(KeyID(kid)).raw
+    payload = b"rvnt" + b"\x0bSOME_ASSET\x00" + (100).to_bytes(8, "little")
+    script = Script(base + bytes([op.OP_ASSET, len(payload)]) + payload + b"\x75")
+    kind = script.asset_script_type()
+    assert kind is not None and kind[0] == "transfer"
+    assert solver(script)[0] == TX_TRANSFER_ASSET
+    dest = extract_destination(script)
+    assert isinstance(dest, KeyID) and dest.h == kid
+
+
+def test_script_num_minimality():
+    assert script_num_encode(0) == b""
+    assert script_num_encode(1) == b"\x01"
+    assert script_num_encode(-1) == b"\x81"
+    assert script_num_encode(127) == b"\x7f"
+    assert script_num_encode(128) == b"\x80\x00"
+    assert script_num_encode(-255) == b"\xff\x80"
+    for n in [0, 1, -1, 127, 128, 255, 256, -256, 2**31 - 1]:
+        assert script_num_decode(script_num_encode(n), 5) == n
+    with pytest.raises(Exception):
+        script_num_decode(b"\x01\x00", require_minimal=True)
+
+
+def test_vm_conditionals_and_limits():
+    checker = TransactionSignatureChecker(Transaction(vin=[TxIn()]), 0)
+    stack = []
+    ok, _ = eval_script(
+        stack,
+        Script.build(op.OP_1, op.OP_IF, op.OP_2, op.OP_ELSE, op.OP_3, op.OP_ENDIF),
+        0,
+        checker,
+    )
+    assert ok and stack == [b"\x02"]
+    # unbalanced
+    ok, err = eval_script([], Script.build(op.OP_1, op.OP_IF), 0, checker)
+    assert not ok and err == "unbalanced_conditional"
+    # disabled opcode fails even unexecuted
+    ok, err = eval_script(
+        [],
+        Script.build(op.OP_0, op.OP_IF, op.OP_CAT, op.OP_ENDIF, op.OP_1),
+        0,
+        checker,
+    )
+    assert not ok and err == "disabled_opcode"
+
+
+def test_vm_arithmetic():
+    checker = TransactionSignatureChecker(Transaction(vin=[TxIn()]), 0)
+    stack = []
+    ok, _ = eval_script(
+        stack, Script.build(op.OP_2, op.OP_3, op.OP_ADD, op.OP_5, op.OP_NUMEQUAL),
+        0, checker,
+    )
+    assert ok and stack == [b"\x01"]
+    stack = []
+    ok, _ = eval_script(
+        stack,
+        Script.build(op.OP_4, op.OP_2, op.OP_6, op.OP_WITHIN),
+        0,
+        checker,
+    )
+    assert ok and stack == [b"\x01"]
+
+
+def test_cleanstack_flag():
+    checker = TransactionSignatureChecker(Transaction(vin=[TxIn()]), 0)
+    sig = Script.build(op.OP_1, op.OP_1)
+    ok, err = verify_script(sig, Script.build(op.OP_1), VERIFY_P2SH | VERIFY_CLEANSTACK, checker)
+    assert not ok and err == "cleanstack"
+
+
+def test_address_roundtrip():
+    from nodexa_chain_core_tpu.node.chainparams import main_params
+    from nodexa_chain_core_tpu.script.standard import (
+        decode_destination,
+        encode_destination,
+    )
+
+    params = main_params()
+    dest = KeyID(b"\x42" * 20)
+    addr = encode_destination(dest, params)
+    assert addr.startswith("N")
+    assert decode_destination(addr, params) == dest
+    sdest = ScriptID(b"\x43" * 20)
+    addr2 = encode_destination(sdest, params)
+    assert decode_destination(addr2, params) == sdest
+    assert script_for_destination(dest).is_pay_to_pubkey_hash()
+    assert script_for_destination(sdest).is_pay_to_script_hash()
