@@ -31,7 +31,7 @@ class BlockStatus(enum.IntFlag):
     FAILED_MASK = 96
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: index entries are unique objects
 class BlockIndex:
     header: BlockHeader
     prev: Optional["BlockIndex"] = None

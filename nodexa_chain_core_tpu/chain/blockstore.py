@@ -1,0 +1,114 @@
+"""Block and undo data storage.
+
+Parity: the reference's blk*.dat/rev*.dat append files + CBlockUndo journal
+(ref src/validation.cpp WriteBlockToDisk/UndoWriteToDisk, src/undo.h).
+Design: two append-only files per datadir (``blocks.dat``, ``undo.dat``)
+with magic+length framing; positions are returned to the caller (the block
+index persists them).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.serialize import ByteReader, ByteWriter, Serializable
+from ..primitives.block import AlgoSchedule, Block
+from .coins import Coin
+
+
+@dataclass
+class TxUndo:
+    """Spent coins of one tx's inputs (ref undo.h CTxUndo)."""
+
+    prevouts: List[Coin] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.vector(self.prevouts, lambda wr, c: c.serialize(wr))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "TxUndo":
+        return cls(prevouts=r.vector(Coin.deserialize))
+
+
+@dataclass
+class BlockUndo(Serializable):
+    """Undo records for all non-coinbase txs (ref undo.h CBlockUndo)."""
+
+    vtxundo: List[TxUndo] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.vector(self.vtxundo, lambda wr, u: u.serialize(wr))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "BlockUndo":
+        return cls(vtxundo=r.vector(TxUndo.deserialize))
+
+
+class AppendFile:
+    """Magic+length framed append-only record file."""
+
+    def __init__(self, path: str, magic: bytes):
+        self.path = path
+        self.magic = magic
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, "ab+")
+
+    def append(self, payload: bytes) -> int:
+        """Returns the byte offset of the record."""
+        self._f.seek(0, os.SEEK_END)
+        pos = self._f.tell()
+        self._f.write(self.magic)
+        self._f.write(len(payload).to_bytes(4, "little"))
+        self._f.write(payload)
+        self._f.flush()
+        return pos
+
+    def read(self, pos: int) -> bytes:
+        self._f.seek(pos)
+        magic = self._f.read(4)
+        if magic != self.magic:
+            raise IOError(f"bad record magic at {pos} in {self.path}")
+        size = int.from_bytes(self._f.read(4), "little")
+        data = self._f.read(size)
+        if len(data) != size:
+            raise IOError("truncated record")
+        return data
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class BlockStore:
+    """Blocks + undo journal on disk."""
+
+    def __init__(self, datadir: str, magic: bytes = b"NDXB"):
+        self.blocks = AppendFile(os.path.join(datadir, "blocks", "blocks.dat"), magic)
+        self.undos = AppendFile(os.path.join(datadir, "blocks", "undo.dat"), magic)
+
+    def write_block(self, block: Block, schedule: Optional[AlgoSchedule] = None) -> int:
+        w = ByteWriter()
+        block.serialize(w, schedule)
+        return self.blocks.append(w.getvalue())
+
+    def read_block(self, pos: int, schedule: Optional[AlgoSchedule] = None) -> Block:
+        return Block.deserialize(ByteReader(self.blocks.read(pos)), schedule)
+
+    def write_undo(self, undo: BlockUndo) -> int:
+        return self.undos.append(undo.to_bytes())
+
+    def read_undo(self, pos: int) -> BlockUndo:
+        return BlockUndo.from_bytes(self.undos.read(pos))
+
+    def sync(self) -> None:
+        self.blocks.sync()
+        self.undos.sync()
+
+    def close(self) -> None:
+        self.blocks.close()
+        self.undos.close()

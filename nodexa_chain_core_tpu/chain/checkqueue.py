@@ -1,0 +1,103 @@
+"""Parallel validation work queue.
+
+Parity: reference src/checkqueue.h CCheckQueue/CCheckQueueControl — the
+``-par`` script-verification worker pool that ConnectBlock fans per-input
+script checks onto (ref validation.cpp:9257,9301).
+
+Python build note: with the pure-Python ECDSA backend the GIL serializes
+CPU-bound checks, so the default is inline execution; a thread pool engages
+when the configured check function releases the GIL (native backend).  The
+control-object protocol (add / wait-all / collect failure) is identical
+either way, so swapping the backend doesn't touch ConnectBlock.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+
+class CheckQueue:
+    def __init__(self, n_threads: int = 0):
+        self.n_threads = n_threads
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._failed: Optional[str] = None
+        self._pending = 0
+        self._done = threading.Condition(self._lock)
+        if n_threads > 0:
+            for i in range(n_threads):
+                t = threading.Thread(
+                    target=self._worker, name=f"scriptcheck.{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self) -> None:
+        while True:
+            check = self._tasks.get()
+            if check is None:
+                return
+            self._run_one(check)
+
+    def _run_one(self, check: Callable[[], Optional[str]]) -> None:
+        err = None
+        try:
+            err = check()
+        except Exception as e:  # checks must not throw; belt-and-braces
+            err = f"exception: {e}"
+        with self._done:
+            if err and self._failed is None:
+                self._failed = err
+            self._pending -= 1
+            if self._pending == 0:
+                self._done.notify_all()
+
+    def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
+        with self._done:
+            self._pending += len(checks)
+        if self.n_threads > 0:
+            for c in checks:
+                self._tasks.put(c)
+        else:
+            for c in checks:
+                self._run_one(c)
+
+    def wait(self) -> Optional[str]:
+        """Block until all queued checks are done; returns failure or None."""
+        with self._done:
+            while self._pending:
+                self._done.wait()
+            failed, self._failed = self._failed, None
+            return failed
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._tasks.put(None)
+        for t in self._threads:
+            t.join(timeout=1)
+        self._threads.clear()
+
+
+class CheckQueueControl:
+    """RAII-style scope (ref checkqueue.h:177 CCheckQueueControl)."""
+
+    def __init__(self, q: Optional[CheckQueue]):
+        self.q = q
+        self._inline_err: Optional[str] = None
+
+    def add(self, checks) -> None:
+        if self.q is not None:
+            self.q.add(checks)
+        else:
+            for c in checks:
+                err = c()
+                if err and self._inline_err is None:
+                    self._inline_err = err
+
+    def wait(self) -> Optional[str]:
+        if self.q is not None:
+            return self.q.wait()
+        return self._inline_err

@@ -1,0 +1,278 @@
+"""UTXO model (parity: reference src/coins.{h,cpp}).
+
+``Coin`` = unspent output + height + coinbase flag (ref coins.h:30);
+``CoinsView`` → ``CoinsViewBacked`` → ``CoinsViewCache`` layering
+(ref coins.h:154,191,210) with dirty/fresh flag semantics so batched
+flushes write only net changes, and ``CoinsViewDB`` persisting through the
+KV store (ref txdb.h:73 CCoinsViewDB).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..primitives.transaction import OutPoint, Transaction, TxOut
+from .kvstore import KVStore, WriteBatch
+
+_KEY_PREFIX = b"C"
+_BEST_BLOCK_KEY = b"B"
+
+
+@dataclass
+class Coin:
+    out: TxOut
+    height: int = 0
+    coinbase: bool = False
+
+    def is_spent(self) -> bool:
+        return self.out.is_null()
+
+    def clone(self) -> "Coin":
+        return Coin(TxOut(self.out.value, self.out.script_pubkey), self.height, self.coinbase)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.u32(self.height * 2 + (1 if self.coinbase else 0))
+        self.out.serialize(w)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "Coin":
+        code = r.u32()
+        out = TxOut.deserialize(r)
+        return cls(out=out, height=code >> 1, coinbase=bool(code & 1))
+
+
+def _spent_coin() -> Coin:
+    return Coin(TxOut())  # value -1 => null/spent sentinel
+
+
+# cache entry flags (ref coins.h CCoinsCacheEntry)
+_FLAG_DIRTY = 1
+_FLAG_FRESH = 2
+
+
+@dataclass
+class _CacheEntry:
+    coin: Coin
+    flags: int = 0
+
+
+class CoinsView:
+    """Abstract base (ref coins.h:154 CCoinsView)."""
+
+    def get_coin(self, outpoint: OutPoint) -> Optional[Coin]:
+        return None
+
+    def have_coin(self, outpoint: OutPoint) -> bool:
+        return self.get_coin(outpoint) is not None
+
+    def get_best_block(self) -> int:
+        return 0
+
+    def batch_write(self, entries: Dict[OutPoint, _CacheEntry], best_block: int) -> None:
+        raise NotImplementedError
+
+
+class CoinsViewBacked(CoinsView):
+    """Forwards to a backing view (ref coins.h:191)."""
+
+    def __init__(self, base: CoinsView):
+        self.base = base
+
+    def get_coin(self, outpoint: OutPoint) -> Optional[Coin]:
+        return self.base.get_coin(outpoint)
+
+    def have_coin(self, outpoint: OutPoint) -> bool:
+        return self.base.have_coin(outpoint)
+
+    def get_best_block(self) -> int:
+        return self.base.get_best_block()
+
+    def batch_write(self, entries, best_block):
+        return self.base.batch_write(entries, best_block)
+
+
+class CoinsViewCache(CoinsViewBacked):
+    """Write-back cache with FRESH/DIRTY tracking (ref coins.h:210)."""
+
+    def __init__(self, base: CoinsView):
+        super().__init__(base)
+        self._cache: Dict[OutPoint, _CacheEntry] = {}
+        self._best_block: int = 0
+
+    # -- reads ------------------------------------------------------------
+
+    def _fetch(self, outpoint: OutPoint) -> Optional[_CacheEntry]:
+        e = self._cache.get(outpoint)
+        if e is not None:
+            return e
+        coin = self.base.get_coin(outpoint)
+        if coin is None:
+            return None
+        e = _CacheEntry(coin.clone(), 0)
+        self._cache[outpoint] = e
+        return e
+
+    def get_coin(self, outpoint: OutPoint) -> Optional[Coin]:
+        e = self._fetch(outpoint)
+        if e is None or e.coin.is_spent():
+            return None
+        return e.coin
+
+    def have_coin(self, outpoint: OutPoint) -> bool:
+        return self.get_coin(outpoint) is not None
+
+    def have_coin_in_cache(self, outpoint: OutPoint) -> bool:
+        e = self._cache.get(outpoint)
+        return e is not None and not e.coin.is_spent()
+
+    def get_best_block(self) -> int:
+        if self._best_block == 0:
+            self._best_block = self.base.get_best_block()
+        return self._best_block
+
+    def set_best_block(self, h: int) -> None:
+        self._best_block = h
+
+    # -- mutations --------------------------------------------------------
+
+    def add_coin(self, outpoint: OutPoint, coin: Coin, overwrite: bool = False) -> None:
+        """ref coins.cpp AddCoin: FRESH iff the parent has no unspent coin."""
+        assert not coin.is_spent()
+        e = self._cache.get(outpoint)
+        fresh = False
+        if e is None:
+            e = _CacheEntry(_spent_coin(), 0)
+            self._cache[outpoint] = e
+        if not overwrite and not e.coin.is_spent():
+            raise ValueError("adding coin over unspent coin")
+        if not (e.flags & _FLAG_DIRTY):
+            fresh = e.coin.is_spent()
+        e.coin = coin
+        e.flags |= _FLAG_DIRTY | (_FLAG_FRESH if fresh else 0)
+
+    def spend_coin(self, outpoint: OutPoint) -> Optional[Coin]:
+        """ref coins.cpp SpendCoin: returns the removed coin."""
+        e = self._fetch(outpoint)
+        if e is None or e.coin.is_spent():
+            return None
+        moved = e.coin
+        if e.flags & _FLAG_FRESH:
+            del self._cache[outpoint]
+        else:
+            e.flags |= _FLAG_DIRTY
+            e.coin = _spent_coin()
+        return moved
+
+    def flush(self) -> None:
+        """Push net changes to the parent (ref CCoinsViewCache::Flush)."""
+        dirty = {
+            k: e for k, e in self._cache.items() if e.flags & _FLAG_DIRTY
+        }
+        self.base.batch_write(dirty, self.get_best_block())
+        self._cache.clear()
+
+    def batch_write(self, entries: Dict[OutPoint, _CacheEntry], best_block: int) -> None:
+        """Absorb a child cache's changes (ref CCoinsViewCache::BatchWrite)."""
+        for outpoint, child in entries.items():
+            if not (child.flags & _FLAG_DIRTY):
+                continue
+            mine = self._cache.get(outpoint)
+            if mine is None:
+                if not (child.flags & _FLAG_FRESH and child.coin.is_spent()):
+                    self._cache[outpoint] = _CacheEntry(
+                        child.coin.clone(), child.flags & (_FLAG_DIRTY | _FLAG_FRESH)
+                    )
+            else:
+                if (
+                    child.flags & _FLAG_FRESH
+                    and not (mine.flags & _FLAG_DIRTY)
+                    and not mine.coin.is_spent()
+                ):
+                    raise ValueError("FRESH child overwrites unspent parent coin")
+                if mine.flags & _FLAG_FRESH and child.coin.is_spent():
+                    del self._cache[outpoint]
+                else:
+                    mine.coin = child.coin.clone()
+                    mine.flags |= _FLAG_DIRTY
+        self._best_block = best_block
+
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    # -- tx helpers --------------------------------------------------------
+
+    def add_tx_outputs(self, tx: Transaction, height: int) -> None:
+        overwrite = tx.is_coinbase()  # BIP30-style duplicate coinbases
+        for i, out in enumerate(tx.vout):
+            if not Script_is_unspendable(out.script_pubkey):
+                self.add_coin(
+                    OutPoint(tx.txid, i),
+                    Coin(TxOut(out.value, out.script_pubkey), height, tx.is_coinbase()),
+                    overwrite=overwrite,
+                )
+
+    def value_in(self, tx: Transaction) -> int:
+        total = 0
+        for txin in tx.vin:
+            c = self.get_coin(txin.prevout)
+            if c is None:
+                raise KeyError(f"missing input {txin.prevout}")
+            total += c.out.value
+        return total
+
+    def have_inputs(self, tx: Transaction) -> bool:
+        return all(self.have_coin(i.prevout) for i in tx.vin)
+
+
+def Script_is_unspendable(raw: bytes) -> bool:
+    from ..script.script import Script
+
+    return Script(raw).is_unspendable()
+
+
+class CoinsViewDB(CoinsView):
+    """KV-backed bottom view (ref txdb.h:73 CCoinsViewDB)."""
+
+    def __init__(self, db: KVStore):
+        self.db = db
+
+    @staticmethod
+    def _key(outpoint: OutPoint) -> bytes:
+        return _KEY_PREFIX + outpoint.txid.to_bytes(32, "little") + outpoint.n.to_bytes(
+            4, "little"
+        )
+
+    def get_coin(self, outpoint: OutPoint) -> Optional[Coin]:
+        raw = self.db.get(self._key(outpoint))
+        if raw is None:
+            return None
+        return Coin.deserialize(ByteReader(raw))
+
+    def have_coin(self, outpoint: OutPoint) -> bool:
+        return self.db.exists(self._key(outpoint))
+
+    def get_best_block(self) -> int:
+        raw = self.db.get(_BEST_BLOCK_KEY)
+        return int.from_bytes(raw, "little") if raw else 0
+
+    def batch_write(self, entries, best_block: int) -> None:
+        batch = WriteBatch()
+        for outpoint, e in entries.items():
+            if not (e.flags & _FLAG_DIRTY):
+                continue
+            if e.coin.is_spent():
+                batch.delete(self._key(outpoint))
+            else:
+                w = ByteWriter()
+                e.coin.serialize(w)
+                batch.put(self._key(outpoint), w.getvalue())
+        batch.put(_BEST_BLOCK_KEY, best_block.to_bytes(32, "little"))
+        self.db.write_batch(batch)
+
+    def cursor(self) -> Iterator[Tuple[OutPoint, Coin]]:
+        for k, v in self.db.iterate(_KEY_PREFIX):
+            txid = int.from_bytes(k[1:33], "little")
+            n = int.from_bytes(k[33:37], "little")
+            yield OutPoint(txid, n), Coin.deserialize(ByteReader(v))

@@ -1,0 +1,181 @@
+"""Embedded persistent key-value store.
+
+Role parity with the reference's LevelDB wrapper (ref src/dbwrapper.{h,cpp}
+CDBWrapper over vendored src/leveldb/): atomic batched writes, prefix
+iteration, crash consistency.  Design here is a write-ahead log with CRC'd
+records over an in-memory table, compacted to a snapshot when the log grows
+— the durability contract the chainstate needs (batch atomicity) without
+vendoring a full LSM tree.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, Optional, Tuple
+
+_MAGIC = b"NXKV"
+_REC_PUT = 1
+_REC_DEL = 2
+_REC_COMMIT = 3
+
+
+class KVError(Exception):
+    pass
+
+
+class WriteBatch:
+    """Atomic write set (ref dbwrapper.h CDBBatch)."""
+
+    def __init__(self) -> None:
+        self.ops: list[Tuple[int, bytes, bytes]] = []
+
+    def put(self, key: bytes, value: bytes) -> "WriteBatch":
+        self.ops.append((_REC_PUT, bytes(key), bytes(value)))
+        return self
+
+    def delete(self, key: bytes) -> "WriteBatch":
+        self.ops.append((_REC_DEL, bytes(key), b""))
+        return self
+
+
+class KVStore:
+    """get/put/delete/batch/prefix-scan store. path=None => memory only."""
+
+    def __init__(self, path: Optional[str] = None, compact_threshold: int = 1 << 24):
+        self._table: Dict[bytes, bytes] = {}
+        self._path = path
+        self._log = None
+        self._log_size = 0
+        self._compact_threshold = compact_threshold
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._snapshot_path = os.path.join(path, "snapshot.dat")
+            self._log_path = os.path.join(path, "wal.dat")
+            self._load()
+            self._log = open(self._log_path, "ab")
+            self._log_size = self._log.tell()
+
+    # -- recovery ---------------------------------------------------------
+
+    def _load(self) -> None:
+        if os.path.exists(self._snapshot_path):
+            with open(self._snapshot_path, "rb") as f:
+                data = f.read()
+            if data[:4] != _MAGIC:
+                raise KVError("bad snapshot magic")
+            i = 4
+            (count,) = struct.unpack_from("<Q", data, i)
+            i += 8
+            for _ in range(count):
+                klen, vlen = struct.unpack_from("<II", data, i)
+                i += 8
+                k = data[i : i + klen]
+                i += klen
+                v = data[i : i + vlen]
+                i += vlen
+                self._table[k] = v
+        # replay WAL; torn trailing records are discarded
+        if os.path.exists(self._log_path):
+            with open(self._log_path, "rb") as f:
+                log = f.read()
+            i = 0
+            pending: list[Tuple[int, bytes, bytes]] = []
+            while i + 9 <= len(log):
+                rec_type, klen, vlen = struct.unpack_from("<BII", log, i)
+                j = i + 9
+                if rec_type == _REC_COMMIT:
+                    for t, k, v in pending:
+                        if t == _REC_PUT:
+                            self._table[k] = v
+                        else:
+                            self._table.pop(k, None)
+                    pending = []
+                    i = j
+                    continue
+                if j + klen + vlen + 4 > len(log):
+                    break  # torn record
+                k = log[j : j + klen]
+                v = log[j + klen : j + klen + vlen]
+                (crc,) = struct.unpack_from("<I", log, j + klen + vlen)
+                if crc != zlib.crc32(log[i : j + klen + vlen]):
+                    break  # corruption: stop replay here
+                pending.append((rec_type, k, v))
+                i = j + klen + vlen + 4
+
+    # -- writes -----------------------------------------------------------
+
+    def _append_record(self, rec_type: int, key: bytes, value: bytes) -> None:
+        hdr = struct.pack("<BII", rec_type, len(key), len(value))
+        body = hdr + key + value
+        crc = zlib.crc32(body)
+        self._log.write(body + struct.pack("<I", crc))
+        self._log_size += len(body) + 4
+
+    def write_batch(self, batch: WriteBatch, sync: bool = False) -> None:
+        if self._log is not None:
+            for t, k, v in batch.ops:
+                self._append_record(t, k, v)
+            self._log.write(struct.pack("<BII", _REC_COMMIT, 0, 0))
+            self._log_size += 9
+            self._log.flush()
+            if sync:
+                os.fsync(self._log.fileno())
+        for t, k, v in batch.ops:
+            if t == _REC_PUT:
+                self._table[k] = v
+            else:
+                self._table.pop(k, None)
+        if self._log is not None and self._log_size > self._compact_threshold:
+            self.compact()
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.write_batch(WriteBatch().put(key, value))
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch(WriteBatch().delete(key))
+
+    # -- reads ------------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._table.get(bytes(key))
+
+    def exists(self, key: bytes) -> bool:
+        return bytes(key) in self._table
+
+    def iterate(self, prefix: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
+        """Sorted prefix scan (ref CDBIterator Seek/Next)."""
+        for k in sorted(self._table):
+            if k.startswith(prefix):
+                yield k, self._table[k]
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    # -- maintenance -------------------------------------------------------
+
+    def compact(self) -> None:
+        """Write snapshot, truncate WAL."""
+        if self._path is None:
+            return
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", len(self._table)))
+            for k, v in self._table.items():
+                f.write(struct.pack("<II", len(k), len(v)))
+                f.write(k)
+                f.write(v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snapshot_path)
+        self._log.close()
+        self._log = open(self._log_path, "wb")
+        self._log_size = 0
+
+    def close(self) -> None:
+        if self._log is not None:
+            self.compact()
+            self._log.close()
+            self._log = None

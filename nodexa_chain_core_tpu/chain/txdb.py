@@ -1,0 +1,92 @@
+"""Block-tree DB: persistent block index (parity: reference src/txdb.h:115
+CBlockTreeDB over LevelDB 'b'-keyed CDiskBlockIndex records)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..primitives.block import AlgoSchedule, BlockHeader
+from .blockindex import BlockIndex, BlockStatus
+from .kvstore import KVStore, WriteBatch
+
+_IDX_PREFIX = b"b"
+_TIP_KEY = b"T"
+
+
+@dataclass
+class DiskBlockIndex:
+    """Serialized form of one index entry (ref txdb.h CDiskBlockIndex)."""
+
+    header: BlockHeader
+    height: int
+    status: int
+    tx_count: int
+    data_pos: int  # -1 = absent
+    undo_pos: int
+
+    def serialize(self, w: ByteWriter, schedule: AlgoSchedule) -> None:
+        w.u32(self.height)
+        w.u32(self.status)
+        w.u32(self.tx_count)
+        w.i64(self.data_pos)
+        w.i64(self.undo_pos)
+        self.header.serialize(w, schedule)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader, schedule: AlgoSchedule) -> "DiskBlockIndex":
+        height = r.u32()
+        status = r.u32()
+        tx_count = r.u32()
+        data_pos = r.i64()
+        undo_pos = r.i64()
+        header = BlockHeader.deserialize(r, schedule)
+        return cls(header, height, status, tx_count, data_pos, undo_pos)
+
+
+class BlockTreeDB:
+    def __init__(self, db: KVStore, schedule: AlgoSchedule):
+        self.db = db
+        self.schedule = schedule
+
+    @staticmethod
+    def _key(block_hash: int) -> bytes:
+        return _IDX_PREFIX + block_hash.to_bytes(32, "little")
+
+    def write_index(self, entries, positions: Dict[int, Tuple[int, int]]) -> None:
+        """entries: iterable of BlockIndex; positions: hash -> (data, undo)."""
+        batch = WriteBatch()
+        for idx in entries:
+            data_pos, undo_pos = positions.get(idx.block_hash, (-1, -1))
+            d = DiskBlockIndex(
+                idx.header, idx.height, int(idx.status), idx.tx_count, data_pos, undo_pos
+            )
+            w = ByteWriter()
+            d.serialize(w, self.schedule)
+            batch.put(self._key(idx.block_hash), w.getvalue())
+        self.db.write_batch(batch)
+
+    def write_tip(self, block_hash: int) -> None:
+        self.db.put(_TIP_KEY, block_hash.to_bytes(32, "little"))
+
+    def read_tip(self) -> Optional[int]:
+        raw = self.db.get(_TIP_KEY)
+        return int.from_bytes(raw, "little") if raw else None
+
+    def load_index(self):
+        """Rebuild the in-memory index map: hash -> (BlockIndex, data, undo).
+
+        Prev pointers are linked by the caller once all entries exist
+        (ref LoadBlockIndexDB, validation.cpp).
+        """
+        out: Dict[int, Tuple[BlockIndex, int, int]] = {}
+        for k, v in self.db.iterate(_IDX_PREFIX):
+            h = int.from_bytes(k[1:33], "little")
+            d = DiskBlockIndex.deserialize(ByteReader(v), self.schedule)
+            idx = BlockIndex(header=d.header, height=d.height)
+            idx.status = BlockStatus(d.status)
+            idx.tx_count = d.tx_count
+            idx._hash = h
+            out[h] = (idx, d.data_pos, d.undo_pos)
+        return out

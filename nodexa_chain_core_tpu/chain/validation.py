@@ -1,0 +1,613 @@
+"""Validation core (parity: reference src/validation.{h,cpp} — the heart).
+
+ChainState owns the block index, the active chain, the UTXO cache, and
+block/undo storage, and implements the reference's entry points:
+
+- ``process_new_block``        (ref validation.cpp:12131 ProcessNewBlock)
+- ``process_new_block_headers``(ref :12017)
+- ``activate_best_chain``      (ref :11272; step logic :11164)
+- ``connect_block``            (ref :10052 ConnectBlock)
+- ``disconnect_block``         (undo journal replay)
+- ``check_block``              (ref :11667) + contextual checks (:11877)
+- ``flush_state_to_disk``      (ref :10570)
+
+The per-input script checks fan out through :mod:`.checkqueue` exactly as
+the reference's CScriptCheck batches do (ref validation.cpp:9217,9301).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..consensus import pow as powrules
+from ..consensus.consensus import (
+    COINBASE_MATURITY,
+    MAX_BLOCK_SERIALIZED_SIZE,
+    MAX_BLOCK_SIGOPS_COST,
+    LOCKTIME_MEDIAN_TIME_PAST,
+)
+from ..consensus.merkle import block_merkle_root
+from ..consensus.tx_verify import (
+    TxValidationError,
+    check_transaction,
+    check_tx_inputs,
+    get_legacy_sigop_count,
+    get_transaction_sigop_cost,
+    is_final_tx,
+)
+from ..core.uint256 import u256_hex
+from ..node.chainparams import NetworkParams
+from ..node.events import main_signals
+from ..primitives.block import Block, BlockHeader
+from ..primitives.transaction import OutPoint, Transaction
+from ..script.interpreter import (
+    MANDATORY_SCRIPT_VERIFY_FLAGS,
+    STANDARD_SCRIPT_VERIFY_FLAGS,
+    TransactionSignatureChecker,
+    VERIFY_P2SH,
+    verify_script,
+)
+from ..script.script import Script
+from .blockindex import BlockIndex, BlockStatus, Chain
+from .blockstore import BlockStore, BlockUndo, TxUndo
+from .checkqueue import CheckQueue, CheckQueueControl
+from .coins import Coin, CoinsViewCache, CoinsViewDB
+from .kvstore import KVStore
+from .txdb import BlockTreeDB
+
+MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60
+MEDIAN_TIME_SPAN = 11
+
+
+class BlockValidationError(Exception):
+    def __init__(self, code: str, reason: str = ""):
+        super().__init__(f"{code}: {reason}" if reason else code)
+        self.code = code
+        self.reason = reason
+
+
+class ChainState:
+    """ref validation.cpp's g_chainstate + mapBlockIndex + pcoinsTip."""
+
+    def __init__(
+        self,
+        params: NetworkParams,
+        datadir: Optional[str] = None,
+        script_check_threads: int = 0,
+    ):
+        self.params = params
+        self.datadir = datadir
+        self.block_index: Dict[int, BlockIndex] = {}
+        self.positions: Dict[int, Tuple[int, int]] = {}  # hash -> (data, undo)
+        self.active = Chain()
+        self.candidates: Set[BlockIndex] = set()  # setBlockIndexCandidates
+        self.invalid: Set[BlockIndex] = set()
+        self.mempool = None  # wired by the node after construction
+
+        if datadir is not None:
+            self._chainstate_db = KVStore(os.path.join(datadir, "chainstate"))
+            self._blocktree_db = KVStore(os.path.join(datadir, "blocks", "index"))
+            self.block_store: Optional[BlockStore] = BlockStore(datadir)
+            self.blocktree = BlockTreeDB(self._blocktree_db, params.algo_schedule)
+        else:
+            self._chainstate_db = KVStore(None)
+            self._blocktree_db = KVStore(None)
+            self.block_store = BlockStore_InMemory()
+            self.blocktree = BlockTreeDB(self._blocktree_db, params.algo_schedule)
+
+        self.coins_db = CoinsViewDB(self._chainstate_db)
+        self.coins = CoinsViewCache(self.coins_db)
+        self.checkqueue = (
+            CheckQueue(script_check_threads) if script_check_threads > 0 else None
+        )
+        self._load_or_init()
+
+    # ------------------------------------------------------------------ init
+
+    def _load_or_init(self) -> None:
+        """ref init.cpp Step 7 LoadBlockIndexDB / genesis bootstrap."""
+        loaded = self.blocktree.load_index()
+        if loaded:
+            # link prev pointers, rebuild work, restore chain to saved tip
+            for h, (idx, dpos, upos) in loaded.items():
+                self.block_index[h] = idx
+                self.positions[h] = (dpos, upos)
+            for h, (idx, _, _) in loaded.items():
+                prev_hash = idx.header.hash_prev
+                if prev_hash:
+                    idx.prev = self.block_index.get(prev_hash)
+            for idx in sorted(self.block_index.values(), key=lambda i: i.height):
+                idx.build_from_prev()
+                idx.chain_tx_count = (
+                    (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
+                )
+            tip_hash = self.blocktree.read_tip()
+            if tip_hash is not None and tip_hash in self.block_index:
+                self.active.set_tip(self.block_index[tip_hash])
+            for idx in self.block_index.values():
+                if idx.is_valid(BlockStatus.VALID_TRANSACTIONS) and (
+                    idx.status & BlockStatus.HAVE_DATA
+                ):
+                    self.candidates.add(idx)
+            return
+        # fresh datadir: install genesis
+        genesis = self.params.genesis
+        idx = self._add_to_block_index(genesis.header)
+        pos = self.block_store.write_block(genesis, self.params.algo_schedule)
+        self.positions[idx.block_hash] = (pos, -1)
+        idx.status |= BlockStatus.HAVE_DATA
+        idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+        idx.tx_count = len(genesis.vtx)
+        idx.chain_tx_count = idx.tx_count
+        self.candidates.add(idx)
+        self.activate_best_chain()
+
+    # -------------------------------------------------------------- helpers
+
+    def tip(self) -> Optional[BlockIndex]:
+        return self.active.tip()
+
+    def lookup(self, block_hash: int) -> Optional[BlockIndex]:
+        return self.block_index.get(block_hash)
+
+    def read_block(self, idx: BlockIndex) -> Block:
+        dpos, _ = self.positions.get(idx.block_hash, (-1, -1))
+        if dpos < 0:
+            raise BlockValidationError("no-data", u256_hex(idx.block_hash))
+        return self.block_store.read_block(dpos, self.params.algo_schedule)
+
+    def _add_to_block_index(self, header: BlockHeader) -> BlockIndex:
+        h = header.get_hash(self.params.algo_schedule)
+        existing = self.block_index.get(h)
+        if existing is not None:
+            return existing
+        idx = BlockIndex(header=header)
+        idx._hash = h
+        idx.prev = self.block_index.get(header.hash_prev)
+        idx.build_from_prev()
+        idx.raise_validity(BlockStatus.VALID_TREE)
+        self.block_index[h] = idx
+        return idx
+
+    # ------------------------------------------------------- header checks
+
+    def check_block_header(self, header: BlockHeader, check_pow: bool = True) -> None:
+        """ref validation.cpp CheckBlockHeader."""
+        if check_pow and not powrules.check_proof_of_work(
+            header.get_hash(self.params.algo_schedule),
+            header.bits,
+            self.params.consensus,
+        ):
+            raise BlockValidationError("high-hash", "proof of work failed")
+
+    def contextual_check_block_header(
+        self, header: BlockHeader, prev: BlockIndex, adjusted_time: int
+    ) -> None:
+        """ref validation.cpp ContextualCheckBlockHeader."""
+        expected_bits = powrules.get_next_work_required(
+            prev, header.time, self.params.consensus
+        )
+        if header.bits != expected_bits:
+            raise BlockValidationError(
+                "bad-diffbits", f"got {header.bits:#x} want {expected_bits:#x}"
+            )
+        if header.time <= prev.median_time_past(MEDIAN_TIME_SPAN):
+            raise BlockValidationError("time-too-old")
+        if header.time > adjusted_time + MAX_FUTURE_BLOCK_TIME:
+            raise BlockValidationError("time-too-new")
+        # checkpoint conformance (ref CheckIndexAgainstCheckpoint)
+        height = prev.height + 1
+        for cp_height, cp_hash in self.params.checkpoints.items():
+            if height == cp_height and header.get_hash(
+                self.params.algo_schedule
+            ) != cp_hash:
+                raise BlockValidationError("checkpoint-mismatch")
+
+    # --------------------------------------------------------- block checks
+
+    def check_block(self, block: Block, check_pow: bool = True,
+                    check_merkle: bool = True) -> None:
+        """ref validation.cpp:11667 CheckBlock."""
+        self.check_block_header(block.header, check_pow)
+        if check_merkle:
+            root, mutated = block_merkle_root(block)
+            if root != block.header.hash_merkle_root:
+                raise BlockValidationError("bad-txnmrklroot")
+            if mutated:
+                raise BlockValidationError("bad-txns-duplicate")
+        if not block.vtx:
+            raise BlockValidationError("bad-blk-length", "empty block")
+        if len(block.to_bytes()) > MAX_BLOCK_SERIALIZED_SIZE:
+            raise BlockValidationError("bad-blk-length", "oversize")
+        if not block.vtx[0].is_coinbase():
+            raise BlockValidationError("bad-cb-missing")
+        for tx in block.vtx[1:]:
+            if tx.is_coinbase():
+                raise BlockValidationError("bad-cb-multiple")
+        for tx in block.vtx:
+            try:
+                check_transaction(tx)
+            except TxValidationError as e:
+                raise BlockValidationError("bad-txns", e.code)
+        sigops = sum(get_legacy_sigop_count(tx) for tx in block.vtx)
+        if sigops * 4 > MAX_BLOCK_SIGOPS_COST:
+            raise BlockValidationError("bad-blk-sigops")
+
+    def contextual_check_block(self, block: Block, prev: Optional[BlockIndex]) -> None:
+        """ref validation.cpp:11877 ContextualCheckBlock (BIP34/finality)."""
+        height = prev.height + 1 if prev else 0
+        mtp = prev.median_time_past(MEDIAN_TIME_SPAN) if prev else 0
+        for tx in block.vtx:
+            cutoff = mtp  # locktime uses MTP (BIP113 behavior)
+            if not is_final_tx(tx, height, cutoff):
+                raise BlockValidationError("bad-txns-nonfinal")
+        if self.params.consensus.bip34_enabled and height > 0:
+            expect = Script.build(height).raw
+            script_sig = block.vtx[0].vin[0].script_sig
+            if len(script_sig) < len(expect) or script_sig[: len(expect)] != expect:
+                raise BlockValidationError("bad-cb-height")
+
+    # ------------------------------------------------------------- connect
+
+    def connect_block(
+        self,
+        block: Block,
+        idx: BlockIndex,
+        view: CoinsViewCache,
+        just_check: bool = False,
+    ) -> BlockUndo:
+        """ref validation.cpp:10052 ConnectBlock."""
+        assert idx.prev is None or view.get_best_block() == idx.prev.block_hash
+        undo = BlockUndo()
+        fees = 0
+        sigops_cost = 0
+        script_flags = self._script_flags(idx.height)
+        control = CheckQueueControl(self.checkqueue)
+
+        for i, tx in enumerate(block.vtx):
+            if not tx.is_coinbase():
+                try:
+                    fee = check_tx_inputs(tx, view, idx.height)
+                except TxValidationError as e:
+                    raise BlockValidationError(e.code, f"tx {i}")
+                fees += fee
+            sigops_cost += get_transaction_sigop_cost(tx, view, script_flags)
+            if sigops_cost > MAX_BLOCK_SIGOPS_COST:
+                raise BlockValidationError("bad-blk-sigops")
+            if not tx.is_coinbase():
+                # collect spent coins for the undo journal, queue script checks
+                txundo = TxUndo()
+                checks = []
+                for j, txin in enumerate(tx.vin):
+                    coin = view.get_coin(txin.prevout)
+                    assert coin is not None
+                    checks.append(
+                        _script_check(tx, j, coin, script_flags)
+                    )
+                    spent = view.spend_coin(txin.prevout)
+                    txundo.prevouts.append(spent)
+                undo.vtxundo.append(txundo)
+                control.add(checks)
+            view.add_tx_outputs(tx, idx.height)
+
+        # subsidy rule (ref ConnectBlock's GetBlockSubsidy check)
+        subsidy = powrules.get_block_subsidy(idx.height, self.params.consensus)
+        if block.vtx[0].total_output_value() > fees + subsidy:
+            raise BlockValidationError(
+                "bad-cb-amount",
+                f"{block.vtx[0].total_output_value()} > {fees + subsidy}",
+            )
+
+        err = control.wait()
+        if err:
+            raise BlockValidationError("blk-bad-inputs", err)
+
+        if just_check:
+            return undo
+        view.set_best_block(idx.block_hash)
+        return undo
+
+    def disconnect_block(
+        self, block: Block, idx: BlockIndex, view: CoinsViewCache
+    ) -> None:
+        """Replay the undo journal backwards (ref DisconnectBlock)."""
+        _, upos = self.positions.get(idx.block_hash, (-1, -1))
+        if upos < 0:
+            raise BlockValidationError("no-undo-data")
+        undo = self.block_store.read_undo(upos)
+        if len(undo.vtxundo) != len(block.vtx) - 1:
+            raise BlockValidationError("bad-undo-data")
+        # remove outputs created by this block, restore spent coins
+        for i in range(len(block.vtx) - 1, -1, -1):
+            tx = block.vtx[i]
+            for j, out in enumerate(tx.vout):
+                if not Script(out.script_pubkey).is_unspendable():
+                    view.spend_coin(OutPoint(tx.txid, j))
+            if i > 0:
+                txundo = undo.vtxundo[i - 1]
+                if len(txundo.prevouts) != len(tx.vin):
+                    raise BlockValidationError("bad-undo-data")
+                for j in range(len(tx.vin) - 1, -1, -1):
+                    view.add_coin(tx.vin[j].prevout, txundo.prevouts[j], overwrite=True)
+        view.set_best_block(idx.prev.block_hash if idx.prev else 0)
+
+    def _script_flags(self, height: int) -> int:
+        """ref GetBlockScriptFlags: this chain runs P2SH+DERSIG+CLTV+CSV from
+        genesis (all deployments buried)."""
+        from ..script.interpreter import (
+            VERIFY_CHECKLOCKTIMEVERIFY,
+            VERIFY_CHECKSEQUENCEVERIFY,
+            VERIFY_DERSIG,
+            VERIFY_NULLDUMMY,
+        )
+
+        return (
+            VERIFY_P2SH
+            | VERIFY_DERSIG
+            | VERIFY_CHECKLOCKTIMEVERIFY
+            | VERIFY_CHECKSEQUENCEVERIFY
+            | VERIFY_NULLDUMMY
+        )
+
+    # ------------------------------------------------- tip connect/disconnect
+
+    def _connect_tip(self, idx: BlockIndex, block: Optional[Block] = None) -> None:
+        """ref ConnectTip."""
+        if block is None:
+            block = self.read_block(idx)
+        view = CoinsViewCache(self.coins)
+        undo = self.connect_block(block, idx, view)
+        upos = self.block_store.write_undo(undo)
+        dpos, _ = self.positions[idx.block_hash]
+        self.positions[idx.block_hash] = (dpos, upos)
+        idx.status |= BlockStatus.HAVE_UNDO
+        view.flush()
+        idx.raise_validity(BlockStatus.VALID_SCRIPTS)
+        self.active.set_tip(idx)
+        if self.mempool is not None:
+            self.mempool.remove_for_block(block.vtx)
+        main_signals.block_connected(block, idx, [])
+
+    def _disconnect_tip(self) -> Block:
+        """ref DisconnectTip; returns the disconnected block."""
+        idx = self.tip()
+        assert idx is not None and idx.prev is not None
+        block = self.read_block(idx)
+        view = CoinsViewCache(self.coins)
+        self.disconnect_block(block, idx, view)
+        view.flush()
+        self.active.set_tip(idx.prev)
+        if self.mempool is not None:
+            self.mempool.add_disconnected_txs(block.vtx)
+        main_signals.block_disconnected(block)
+        return block
+
+    # --------------------------------------------------- best-chain logic
+
+    def _find_most_work_chain(self) -> Optional[BlockIndex]:
+        best: Optional[BlockIndex] = None
+        for cand in self.candidates:
+            if cand in self.invalid:
+                continue
+            if best is None or cand.chain_work > best.chain_work:
+                best = cand
+        return best
+
+    def activate_best_chain(self, new_block: Optional[Block] = None) -> None:
+        """ref validation.cpp:11272 ActivateBestChain + Step (:11164)."""
+        progressed = False
+        while True:
+            best = self._find_most_work_chain()
+            tip = self.tip()
+            if best is None or best is tip:
+                break
+            if tip is not None and best.chain_work <= tip.chain_work:
+                break
+            fork = self.active.find_fork(best)
+            # reorg bound (ref nMaxReorganizationDepth, chainparams.cpp:256)
+            if (
+                tip is not None
+                and fork is not None
+                and tip.height - fork.height > self.params.consensus.max_reorg_depth
+            ):
+                raise BlockValidationError(
+                    "bad-fork-too-deep",
+                    f"reorg depth {tip.height - fork.height}",
+                )
+            # disconnect down to the fork point
+            while self.tip() is not fork:
+                self._disconnect_tip()
+            # connect along the path fork -> best
+            path: List[BlockIndex] = []
+            walk: Optional[BlockIndex] = best
+            while walk is not None and walk is not self.tip():
+                path.append(walk)
+                walk = walk.prev
+            failed = False
+            for idx in reversed(path):
+                blk = (
+                    new_block
+                    if new_block is not None
+                    and new_block.get_hash() == idx.block_hash
+                    else None
+                )
+                try:
+                    self._connect_tip(idx, blk)
+                    progressed = True
+                except BlockValidationError:
+                    self._invalidate(idx)
+                    failed = True
+                    break
+            if not failed:
+                break  # reached `best`
+            # else: loop again; _invalidate removed the bad candidate
+        if progressed:
+            self._prune_candidates()
+            main_signals.updated_block_tip(self.tip(), None, False)
+            self.flush_state_to_disk()
+
+    def _invalidate(self, idx: BlockIndex) -> None:
+        idx.status |= BlockStatus.FAILED_VALID
+        self.invalid.add(idx)
+        self.candidates.discard(idx)
+        for other in self.block_index.values():
+            walk = other
+            while walk is not None:
+                if walk is idx:
+                    other.status |= BlockStatus.FAILED_CHILD
+                    self.invalid.add(other)
+                    self.candidates.discard(other)
+                    break
+                walk = walk.prev
+
+    def _prune_candidates(self) -> None:
+        tip = self.tip()
+        if tip is None:
+            return
+        for cand in list(self.candidates):
+            if cand.chain_work < tip.chain_work:
+                self.candidates.discard(cand)
+        self.candidates.add(tip)
+
+    # ------------------------------------------------------- public entry
+
+    def process_new_block_headers(
+        self, headers: List[BlockHeader], adjusted_time: Optional[int] = None
+    ) -> List[BlockIndex]:
+        """ref validation.cpp:12017 ProcessNewBlockHeaders."""
+        if adjusted_time is None:
+            adjusted_time = int(time.time())
+        out = []
+        for header in headers:
+            h = header.get_hash(self.params.algo_schedule)
+            existing = self.block_index.get(h)
+            if existing is not None:
+                if existing in self.invalid:
+                    raise BlockValidationError("duplicate-invalid")
+                out.append(existing)
+                continue
+            self.check_block_header(header)
+            prev = self.block_index.get(header.hash_prev)
+            if prev is None:
+                raise BlockValidationError("prev-blk-not-found")
+            if prev in self.invalid:
+                raise BlockValidationError("bad-prevblk")
+            self.contextual_check_block_header(header, prev, adjusted_time)
+            out.append(self._add_to_block_index(header))
+        return out
+
+    def process_new_block(self, block: Block, force: bool = False) -> BlockIndex:
+        """ref validation.cpp:12131 ProcessNewBlock."""
+        h = block.get_hash()
+        idx = self.block_index.get(h)
+        if idx is not None and idx.status & BlockStatus.HAVE_DATA:
+            if idx in self.invalid:
+                raise BlockValidationError("duplicate-invalid")
+            self.activate_best_chain(block)
+            return idx
+
+        self.check_block(block)
+        if block.header.hash_prev:
+            prev = self.block_index.get(block.header.hash_prev)
+            if prev is None:
+                raise BlockValidationError("prev-blk-not-found")
+            if prev in self.invalid:
+                raise BlockValidationError("bad-prevblk")
+            self.contextual_check_block_header(
+                block.header, prev, int(time.time())
+            )
+            self.contextual_check_block(block, prev)
+        idx = self._add_to_block_index(block.header)
+        pos = self.block_store.write_block(block, self.params.algo_schedule)
+        self.positions[idx.block_hash] = (pos, -1)
+        idx.status |= BlockStatus.HAVE_DATA
+        idx.tx_count = len(block.vtx)
+        idx.chain_tx_count = (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
+        idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+        self.candidates.add(idx)
+        main_signals.new_pow_valid_block(idx, block)
+        self.activate_best_chain(block)
+        return idx
+
+    def test_block_validity(self, block: Block, prev: BlockIndex) -> None:
+        """ref validation.cpp:12164 TestBlockValidity (miner pre-check)."""
+        self.check_block(block, check_pow=False)
+        self.contextual_check_block_header(
+            block.header, prev, int(time.time()) + MAX_FUTURE_BLOCK_TIME
+        )
+        self.contextual_check_block(block, prev)
+        idx = BlockIndex(header=block.header, prev=prev)
+        idx._hash = block.get_hash()
+        idx.build_from_prev()
+        view = CoinsViewCache(self.coins)
+        self.connect_block(block, idx, view, just_check=True)
+
+    # ------------------------------------------------------------- flush
+
+    def flush_state_to_disk(self) -> None:
+        """ref validation.cpp:10570 FlushStateToDisk."""
+        self.coins.flush()
+        self.blocktree.write_index(self.block_index.values(), self.positions)
+        tip = self.tip()
+        if tip is not None:
+            self.blocktree.write_tip(tip.block_hash)
+
+    def close(self) -> None:
+        self.flush_state_to_disk()
+        if self.checkqueue:
+            self.checkqueue.stop()
+        self._chainstate_db.close()
+        self._blocktree_db.close()
+        self.block_store.close()
+
+
+def _script_check(tx: Transaction, in_idx: int, coin: Coin, flags: int):
+    """One deferred script check (ref validation.cpp CScriptCheck)."""
+    spk = Script(coin.out.script_pubkey)
+    script_sig = Script(tx.vin[in_idx].script_sig)
+    checker = TransactionSignatureChecker(tx, in_idx, coin.out.value)
+
+    def run() -> Optional[str]:
+        ok, err = verify_script(script_sig, spk, flags, checker)
+        if not ok:
+            return f"input {in_idx}: {err}"
+        return None
+
+    return run
+
+
+class BlockStore_InMemory:
+    """Test fixture: block store without a filesystem (the reference's
+    analogue is the TestingSetup in-process node, ref src/test/test_clore.h)."""
+
+    def __init__(self) -> None:
+        self._blocks: List[bytes] = []
+        self._undos: List[bytes] = []
+
+    def write_block(self, block: Block, schedule=None) -> int:
+        from ..core.serialize import ByteWriter
+
+        w = ByteWriter()
+        block.serialize(w, schedule)
+        self._blocks.append(w.getvalue())
+        return len(self._blocks) - 1
+
+    def read_block(self, pos: int, schedule=None) -> Block:
+        from ..core.serialize import ByteReader
+
+        return Block.deserialize(ByteReader(self._blocks[pos]), schedule)
+
+    def write_undo(self, undo: BlockUndo) -> int:
+        self._undos.append(undo.to_bytes())
+        return len(self._undos) - 1
+
+    def read_undo(self, pos: int) -> BlockUndo:
+        return BlockUndo.from_bytes(self._undos[pos])
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

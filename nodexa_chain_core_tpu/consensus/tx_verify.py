@@ -1,0 +1,187 @@
+"""Stateless + contextual transaction checks.
+
+Parity: reference src/consensus/tx_verify.{h,cpp} — CheckTransaction,
+Consensus::CheckTxInputs (fees/maturity/amounts), IsFinalTx, sequence
+locks, and sigop accounting (legacy + P2SH).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..chain.coins import CoinsViewCache
+from ..core.amount import MAX_MONEY, money_range
+from ..primitives.transaction import OutPoint, Transaction
+from ..script.script import Script
+from .consensus import (
+    COINBASE_MATURITY,
+    LOCKTIME_MEDIAN_TIME_PAST,
+    LOCKTIME_VERIFY_SEQUENCE,
+    MAX_BLOCK_SERIALIZED_SIZE,
+    WITNESS_SCALE_FACTOR,
+)
+
+LOCKTIME_THRESHOLD = 500_000_000
+SEQUENCE_FINAL = 0xFFFFFFFF
+SEQUENCE_LOCKTIME_DISABLE_FLAG = 1 << 31
+SEQUENCE_LOCKTIME_TYPE_FLAG = 1 << 22
+SEQUENCE_LOCKTIME_MASK = 0x0000FFFF
+SEQUENCE_LOCKTIME_GRANULARITY = 9
+
+
+class TxValidationError(Exception):
+    def __init__(self, code: str, reason: str = ""):
+        super().__init__(f"{code}: {reason}" if reason else code)
+        self.code = code
+        self.reason = reason
+
+
+def check_transaction(tx: Transaction) -> None:
+    """Stateless checks (ref tx_verify.cpp CheckTransaction)."""
+    if not tx.vin:
+        raise TxValidationError("bad-txns-vin-empty")
+    if not tx.vout:
+        raise TxValidationError("bad-txns-vout-empty")
+    if len(tx.to_bytes(with_witness=False)) * WITNESS_SCALE_FACTOR > 4_000_000:
+        raise TxValidationError("bad-txns-oversize")
+
+    total_out = 0
+    for out in tx.vout:
+        if out.value < 0:
+            raise TxValidationError("bad-txns-vout-negative")
+        if out.value > MAX_MONEY:
+            raise TxValidationError("bad-txns-vout-toolarge")
+        total_out += out.value
+        if not money_range(total_out):
+            raise TxValidationError("bad-txns-txouttotal-toolarge")
+
+    seen: set = set()
+    for txin in tx.vin:
+        if txin.prevout in seen:
+            raise TxValidationError("bad-txns-inputs-duplicate")
+        seen.add(txin.prevout)
+
+    if tx.is_coinbase():
+        if not 2 <= len(tx.vin[0].script_sig) <= 100:
+            raise TxValidationError("bad-cb-length")
+    else:
+        for txin in tx.vin:
+            if txin.prevout.is_null():
+                raise TxValidationError("bad-txns-prevout-null")
+
+
+def check_tx_inputs(
+    tx: Transaction, view: CoinsViewCache, spend_height: int
+) -> int:
+    """Contextual input checks; returns the tx fee (ref
+    Consensus::CheckTxInputs)."""
+    if tx.is_coinbase():
+        return 0
+    if not view.have_inputs(tx):
+        raise TxValidationError("bad-txns-inputs-missingorspent")
+
+    value_in = 0
+    for txin in tx.vin:
+        coin = view.get_coin(txin.prevout)
+        assert coin is not None
+        if coin.coinbase and spend_height - coin.height < COINBASE_MATURITY:
+            raise TxValidationError(
+                "bad-txns-premature-spend-of-coinbase",
+                f"tried at depth {spend_height - coin.height}",
+            )
+        value_in += coin.out.value
+        if not money_range(coin.out.value) or not money_range(value_in):
+            raise TxValidationError("bad-txns-inputvalues-outofrange")
+
+    value_out = tx.total_output_value()
+    if value_in < value_out:
+        raise TxValidationError(
+            "bad-txns-in-belowout", f"{value_in} < {value_out}"
+        )
+    fee = value_in - value_out
+    if not money_range(fee):
+        raise TxValidationError("bad-txns-fee-outofrange")
+    return fee
+
+
+def is_final_tx(tx: Transaction, block_height: int, block_time: int) -> bool:
+    """ref tx_verify.cpp IsFinalTx."""
+    if tx.locktime == 0:
+        return True
+    threshold = block_height if tx.locktime < LOCKTIME_THRESHOLD else block_time
+    if tx.locktime < threshold:
+        return True
+    return all(txin.sequence == SEQUENCE_FINAL for txin in tx.vin)
+
+
+def calculate_sequence_locks(
+    tx: Transaction, flags: int, prev_heights: List[int], block_height: int,
+    median_time_past_fn,
+) -> Tuple[int, int]:
+    """BIP68 (ref tx_verify.cpp CalculateSequenceLocks): returns
+    (min_height, min_time) that must be surpassed before inclusion."""
+    assert len(prev_heights) == len(tx.vin)
+    min_height = -1
+    min_time = -1
+    enforce = tx.version >= 2 and (flags & LOCKTIME_VERIFY_SEQUENCE)
+    if not enforce:
+        return min_height, min_time
+    for i, txin in enumerate(tx.vin):
+        seq = txin.sequence
+        if seq & SEQUENCE_LOCKTIME_DISABLE_FLAG:
+            prev_heights[i] = 0
+            continue
+        coin_height = prev_heights[i]
+        if seq & SEQUENCE_LOCKTIME_TYPE_FLAG:
+            coin_time = median_time_past_fn(max(coin_height - 1, 0))
+            delta = ((seq & SEQUENCE_LOCKTIME_MASK) << SEQUENCE_LOCKTIME_GRANULARITY)
+            min_time = max(min_time, coin_time + delta - 1)
+        else:
+            min_height = max(min_height, coin_height + (seq & SEQUENCE_LOCKTIME_MASK) - 1)
+    return min_height, min_time
+
+
+def evaluate_sequence_locks(
+    block_height: int, median_time_past: int, locks: Tuple[int, int]
+) -> bool:
+    min_height, min_time = locks
+    return min_height < block_height and min_time < median_time_past
+
+
+def get_legacy_sigop_count(tx: Transaction) -> int:
+    """ref tx_verify.cpp GetLegacySigOpCount."""
+    count = 0
+    for txin in tx.vin:
+        count += Script(txin.script_sig).sigop_count(False)
+    for out in tx.vout:
+        count += Script(out.script_pubkey).sigop_count(False)
+    return count
+
+
+def get_p2sh_sigop_count(tx: Transaction, view: CoinsViewCache) -> int:
+    """ref tx_verify.cpp GetP2SHSigOpCount."""
+    if tx.is_coinbase():
+        return 0
+    count = 0
+    for txin in tx.vin:
+        coin = view.get_coin(txin.prevout)
+        if coin is None:
+            continue
+        spk = Script(coin.out.script_pubkey)
+        if spk.is_pay_to_script_hash():
+            count += spk.p2sh_sigop_count(Script(txin.script_sig))
+    return count
+
+
+def get_transaction_sigop_cost(
+    tx: Transaction, view: Optional[CoinsViewCache], flags: int
+) -> int:
+    """ref tx_verify.cpp GetTransactionSigOpCost (no witness on this chain)."""
+    cost = get_legacy_sigop_count(tx) * WITNESS_SCALE_FACTOR
+    if tx.is_coinbase() or view is None:
+        return cost
+    from ..script.interpreter import VERIFY_P2SH
+
+    if flags & VERIFY_P2SH:
+        cost += get_p2sh_sigop_count(tx, view) * WITNESS_SCALE_FACTOR
+    return cost

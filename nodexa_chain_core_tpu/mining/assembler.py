@@ -1,0 +1,135 @@
+"""Block template assembly (parity: reference src/miner.cpp).
+
+``BlockAssembler.create_new_block`` (ref miner.cpp:123) builds a block on
+the active tip: coinbase with BIP34 height push, mempool transactions
+selected by ancestor-feerate packages (ref addPackageTxs, miner.cpp:378 —
+wired once the mempool exists), correct subsidy+fees, DGW bits, and a
+median-time-past-respecting timestamp.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..chain.validation import ChainState
+from ..consensus import pow as powrules
+from ..consensus.consensus import MAX_BLOCK_SIGOPS_COST
+from ..consensus.merkle import merkle_root
+from ..consensus.tx_verify import get_legacy_sigop_count
+from ..primitives.block import Block, BlockHeader
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+from ..script.script import Script
+
+DEFAULT_BLOCK_MAX_SIZE = 2_000_000
+
+
+class BlockAssembler:
+    def __init__(self, chainstate: ChainState, max_size: int = DEFAULT_BLOCK_MAX_SIZE):
+        self.chainstate = chainstate
+        self.max_size = max_size
+
+    def create_new_block(
+        self, script_pubkey: bytes, ntime: Optional[int] = None
+    ) -> Block:
+        cs = self.chainstate
+        tip = cs.tip()
+        assert tip is not None
+        height = tip.height + 1
+        params = cs.params.consensus
+
+        if ntime is None:
+            ntime = int(time.time())
+        ntime = max(ntime, tip.median_time_past() + 1)
+
+        txs, fees = self._select_transactions(height)
+
+        subsidy = powrules.get_block_subsidy(height, params)
+        coinbase = Transaction(
+            version=2,
+            vin=[
+                TxIn(
+                    prevout=OutPoint(),
+                    script_sig=Script.build(height).raw + b"\x00",  # BIP34 + extranonce room
+                    sequence=0xFFFFFFFF,
+                )
+            ],
+            vout=[TxOut(value=subsidy + fees, script_pubkey=script_pubkey)],
+            locktime=0,
+        )
+        vtx = [coinbase] + txs
+        root, _ = merkle_root([t.txid for t in vtx])
+        header = BlockHeader(
+            version=0x20000000,
+            hash_prev=tip.block_hash,
+            hash_merkle_root=root,
+            time=ntime,
+            bits=powrules.get_next_work_required(tip, ntime, params),
+            height=height,  # used only in the KawPow era serialization
+        )
+        return Block(header=header, vtx=vtx)
+
+    def _select_transactions(self, height: int) -> tuple[List[Transaction], int]:
+        """Ancestor-feerate package selection over the mempool
+        (ref miner.cpp:378 addPackageTxs)."""
+        pool = self.chainstate.mempool
+        if pool is None:
+            return [], 0
+        txs: List[Transaction] = []
+        fees = 0
+        size = 1000  # coinbase + header headroom
+        sigops = 400
+        in_block: set = set()
+        for entry in pool.ordered_for_mining():
+            # all in-mempool parents must already be included
+            if any(
+                p not in in_block and pool.contains(p)
+                for p in entry.parents()
+            ):
+                continue
+            tx_size = entry.size
+            tx_sigops = entry.sigops
+            if size + tx_size > self.max_size:
+                continue
+            if (sigops + tx_sigops) * 4 > MAX_BLOCK_SIGOPS_COST:
+                continue
+            txs.append(entry.tx)
+            in_block.add(entry.tx.txid)
+            fees += entry.fee
+            size += tx_size
+            sigops += tx_sigops
+        return txs, fees
+
+
+def mine_block_cpu(block: Block, schedule, max_tries: int = 1 << 22) -> bool:
+    """Trivial-difficulty CPU nonce scan (regtest path; ref the
+    generatetoaddress regtest loop, rpc/mining.cpp:175)."""
+    from ..core.uint256 import bits_to_target
+
+    target, neg, ovf = bits_to_target(block.header.bits)
+    if neg or ovf or target == 0:
+        return False
+    for nonce in range(max_tries):
+        block.header.nonce = nonce
+        block.header._cached_hash = None
+        if block.header.get_hash(schedule) <= target:
+            return True
+    return False
+
+
+def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10) -> bool:
+    """TPU mesh nonce search for real difficulties (the reference's
+    equivalent is the external GPU miner via getblocktemplate)."""
+    from ..parallel.pow_search import Sha256dMiner
+    from ..core.uint256 import bits_to_target
+
+    target, _, _ = bits_to_target(block.header.bits)
+    prefix = block.header.pow_header_bytes(schedule)[:76]
+    miner = Sha256dMiner(prefix, target)
+    res = miner.mine(max_batches=max_batches)
+    if res is None:
+        return False
+    nonce, _ = res
+    block.header.nonce = nonce
+    block.header._cached_hash = None
+    return True

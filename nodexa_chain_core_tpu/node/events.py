@@ -1,0 +1,68 @@
+"""Validation signal bus.
+
+Parity: reference src/validationinterface.{h,cpp} — CValidationInterface
+virtuals + CMainSignals fan-out.  Subscribers (wallet, zmq, indexes, GUI
+models) register and receive chain events.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class ValidationInterface:
+    """Subclass and override the events you care about
+    (ref validationinterface.h:37-75)."""
+
+    def updated_block_tip(self, new_tip, fork_tip, initial_download: bool) -> None:
+        pass
+
+    def transaction_added_to_mempool(self, tx) -> None:
+        pass
+
+    def transaction_removed_from_mempool(self, tx, reason: str) -> None:
+        pass
+
+    def block_connected(self, block, index, txs_conflicted) -> None:
+        pass
+
+    def block_disconnected(self, block) -> None:
+        pass
+
+    def new_pow_valid_block(self, index, block) -> None:
+        pass
+
+    def block_checked(self, block, state) -> None:
+        pass
+
+    def new_asset_message(self, message) -> None:
+        pass
+
+
+class MainSignals:
+    """ref validationinterface.h:86 CMainSignals."""
+
+    def __init__(self) -> None:
+        self._subs: List[ValidationInterface] = []
+
+    def register(self, sub: ValidationInterface) -> None:
+        if sub not in self._subs:
+            self._subs.append(sub)
+
+    def unregister(self, sub: ValidationInterface) -> None:
+        if sub in self._subs:
+            self._subs.remove(sub)
+
+    def clear(self) -> None:
+        self._subs.clear()
+
+    def __getattr__(self, name: str):
+        # fan any event method out to all subscribers
+        def fire(*args, **kwargs):
+            for sub in list(self._subs):
+                getattr(sub, name)(*args, **kwargs)
+
+        return fire
+
+
+main_signals = MainSignals()

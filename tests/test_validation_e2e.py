@@ -1,0 +1,194 @@
+"""End-to-end regtest chain: genesis -> mine -> connect -> spend -> reorg
+-> restart.  This is the analogue of the reference's TestChain100Setup
+fixture tests (ref src/test/test_clore.h:95-104)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.validation import (
+    BlockValidationError,
+    ChainState,
+)
+from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.script import Script
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def setup():
+    params = regtest_params()
+    cs = ChainState(params)
+    ks = KeyStore()
+    kid = ks.add_key(0xA11CE)
+    spk = p2pkh_script(KeyID(kid))
+    return params, cs, ks, spk
+
+
+def mine_one(cs, params, spk, ntime=None):
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=ntime)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    return blk
+
+
+def mine_chain(cs, params, spk, n, start_time=None):
+    blocks = []
+    t = start_time or (params.genesis_time + 60)
+    for i in range(n):
+        blocks.append(mine_one(cs, params, spk, ntime=t))
+        t += 60
+    return blocks
+
+
+def test_genesis_is_tip(setup):
+    params, cs, ks, spk = setup
+    assert cs.tip() is not None
+    assert cs.tip().height == 0
+    assert cs.tip().block_hash == params.genesis.get_hash()
+
+
+def test_mine_and_connect_blocks(setup):
+    params, cs, ks, spk = setup
+    blocks = mine_chain(cs, params, spk, 10)
+    assert cs.tip().height == 10
+    assert cs.tip().block_hash == blocks[-1].get_hash()
+    # coin exists for each coinbase
+    cb = blocks[0].vtx[0]
+    assert cs.coins.get_coin(OutPoint(cb.txid, 0)) is not None
+
+
+def test_spend_coinbase_after_maturity(setup):
+    params, cs, ks, spk = setup
+    blocks = mine_chain(cs, params, spk, COINBASE_MATURITY + 1)
+    cb = blocks[0].vtx[0]
+
+    spend = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+        vout=[TxOut(value=cb.vout[0].value - 10000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, spend, 0, spk)
+
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=params.genesis_time + 60 * 200)
+    blk.vtx.append(spend)
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+
+    blk.header.hash_merkle_root = merkle_root([t.txid for t in blk.vtx])[0]
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    assert cs.tip().height == COINBASE_MATURITY + 2
+    # spent coin gone, new coin present
+    assert cs.coins.get_coin(OutPoint(cb.txid, 0)) is None
+    assert cs.coins.get_coin(OutPoint(spend.txid, 0)) is not None
+
+
+def test_premature_coinbase_spend_rejected(setup):
+    params, cs, ks, spk = setup
+    blocks = mine_chain(cs, params, spk, 5)
+    cb = blocks[0].vtx[0]
+    spend = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+        vout=[TxOut(value=cb.vout[0].value - 10000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, spend, 0, spk)
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    blk.vtx.append(spend)
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+
+    blk.header.hash_merkle_root = merkle_root([t.txid for t in blk.vtx])[0]
+    assert mine_block_cpu(blk, params.algo_schedule)
+    tip_before = cs.tip()
+    cs.process_new_block(blk)
+    # block was invalid; tip unchanged
+    assert cs.tip() is tip_before
+
+
+def test_bad_subsidy_rejected(setup):
+    params, cs, ks, spk = setup
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    blk.vtx[0].vout[0].value += 1  # overpay
+    blk.vtx[0].rehash()
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+
+    blk.header.hash_merkle_root = merkle_root([t.txid for t in blk.vtx])[0]
+    assert mine_block_cpu(blk, params.algo_schedule)
+    tip_before = cs.tip()
+    cs.process_new_block(blk)
+    assert cs.tip() is tip_before
+
+
+def test_reorg_to_longer_chain(setup):
+    params, cs, ks, spk = setup
+    # chain A: 3 blocks
+    a = mine_chain(cs, params, spk, 3)
+    tip_a = cs.tip()
+    assert tip_a.height == 3
+
+    # chain B: build 4 blocks from genesis on a second chainstate, feed in
+    cs2 = ChainState(params)
+    spk2 = p2pkh_script(KeyID(ks.add_key(0xB0B)))
+    b = mine_chain(cs2, params, spk2, 4, start_time=params.genesis_time + 30)
+    for blk in b:
+        cs.process_new_block(blk)
+    assert cs.tip().height == 4
+    assert cs.tip().block_hash == b[-1].get_hash()
+    # chain A coinbase coins rolled back, chain B coins present
+    assert cs.coins.get_coin(OutPoint(a[0].vtx[0].txid, 0)) is None
+    assert cs.coins.get_coin(OutPoint(b[0].vtx[0].txid, 0)) is not None
+
+
+def test_persistence_across_restart(tmp_path):
+    params = regtest_params()
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xCAFE)))
+    datadir = str(tmp_path / "node")
+    cs = ChainState(params, datadir=datadir)
+    blocks = mine_chain(cs, params, spk, 7)
+    tip_hash = cs.tip().block_hash
+    cs.close()
+
+    cs2 = ChainState(params, datadir=datadir)
+    assert cs2.tip() is not None
+    assert cs2.tip().height == 7
+    assert cs2.tip().block_hash == tip_hash
+    # UTXO set intact
+    assert cs2.coins.get_coin(OutPoint(blocks[0].vtx[0].txid, 0)) is not None
+    # and we can keep mining on it
+    mine_one(cs2, params, spk, ntime=params.genesis_time + 60 * 50)
+    assert cs2.tip().height == 8
+    cs2.close()
+
+
+def test_bad_pow_rejected(setup):
+    params, cs, ks, spk = setup
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    # don't mine; chances of valid pow at 0x207fffff are ~50% for nonce 0,
+    # so instead corrupt to guaranteed-high hash by picking a failing nonce
+    from nodexa_chain_core_tpu.core.uint256 import bits_to_target
+
+    target, _, _ = bits_to_target(blk.header.bits)
+    found = False
+    for nonce in range(1000):
+        blk.header.nonce = nonce
+        blk.header._cached_hash = None
+        if blk.header.get_hash(params.algo_schedule) > target:
+            found = True
+            break
+    assert found
+    with pytest.raises(BlockValidationError):
+        cs.process_new_block(blk)
