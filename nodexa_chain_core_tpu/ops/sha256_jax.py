@@ -50,25 +50,46 @@ def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
 def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     """One SHA-256 compression. state: (..., 8); block: (..., 16) BE words.
 
-    The 64 rounds are Python-unrolled: static control flow, XLA fuses the
-    whole round function into one kernel (no lax.scan overhead for a
-    fixed-trip tight loop).
+    Rounds run under ``lax.fori_loop`` (compiler-friendly control flow):
+    the graph stays tiny — a fully unrolled 64-round body makes XLA's
+    SPMD-partitioned CPU compile explode to tens of minutes — while the
+    leading batch dimension keeps each iteration a wide vector op, so loop
+    overhead is amortized at mining batch sizes.
     """
-    w = [block[..., i] for i in range(16)]
-    for i in range(16, 64):
-        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
-        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    lead = block.shape[:-1]
 
-    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
-    for i in range(64):
+    # message schedule: w[16..63] built in place
+    w0 = jnp.concatenate(
+        [block, jnp.zeros(lead + (48,), dtype=jnp.uint32)], axis=-1
+    )
+
+    def sched(i, w):
+        w15 = jax.lax.dynamic_index_in_dim(w, i - 15, axis=-1, keepdims=False)
+        w2 = jax.lax.dynamic_index_in_dim(w, i - 2, axis=-1, keepdims=False)
+        w16 = jax.lax.dynamic_index_in_dim(w, i - 16, axis=-1, keepdims=False)
+        w7 = jax.lax.dynamic_index_in_dim(w, i - 7, axis=-1, keepdims=False)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        return jax.lax.dynamic_update_index_in_dim(
+            w, w16 + s0 + w7 + s1, i, axis=-1
+        )
+
+    w = jax.lax.fori_loop(16, 64, sched, w0)
+
+    def round_fn(i, st):
+        a, b, c, d, e, f, g, h = st
+        wi = jax.lax.dynamic_index_in_dim(w, i, axis=-1, keepdims=False)
+        ki = jax.lax.dynamic_index_in_dim(_K, i, axis=0, keepdims=False)
         S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + S1 + ch + _K[i] + w[i]
+        t1 = h + S1 + ch + ki + wi
         S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = S0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    init = tuple(state[..., i] for i in range(8))
+    a, b, c, d, e, f, g, h = jax.lax.fori_loop(0, 64, round_fn, init)
     out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
     return state + out
 
