@@ -1,0 +1,266 @@
+"""Transaction memory pool.
+
+Parity: reference src/txmempool.{h,cpp} — CTxMemPoolEntry with ancestor /
+descendant package tracking (txmempool.h:68), the mapNextTx spender index,
+removeForBlock, reorg re-insertion, and the ancestor-score ordering the
+miner walks (ref miner.cpp:378).  The reference's boost multi-index becomes
+explicit dicts + on-demand sorts (pool sizes here don't justify incremental
+index maintenance).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..consensus.tx_verify import get_legacy_sigop_count
+from ..primitives.transaction import OutPoint, Transaction
+from .coins import Coin, CoinsView, CoinsViewBacked, CoinsViewCache
+
+DEFAULT_ANCESTOR_LIMIT = 25
+DEFAULT_DESCENDANT_LIMIT = 25
+DEFAULT_MEMPOOL_EXPIRY = 336 * 60 * 60  # 2 weeks (ref policy)
+
+
+@dataclass
+class MempoolEntry:
+    """ref txmempool.h:68 CTxMemPoolEntry."""
+
+    tx: Transaction
+    fee: int
+    time: float
+    height: int
+    size: int = 0
+    sigops: int = 0
+    # package totals including self (ref nCountWithDescendants etc.)
+    count_with_descendants: int = 1
+    size_with_descendants: int = 0
+    fees_with_descendants: int = 0
+    count_with_ancestors: int = 1
+    size_with_ancestors: int = 0
+    fees_with_ancestors: int = 0
+
+    def __post_init__(self):
+        if not self.size:
+            self.size = len(self.tx.to_bytes())
+        if not self.sigops:
+            self.sigops = get_legacy_sigop_count(self.tx)
+        self.size_with_descendants = self.size
+        self.fees_with_descendants = self.fee
+        self.size_with_ancestors = self.size
+        self.fees_with_ancestors = self.fee
+
+    @property
+    def fee_rate(self) -> float:
+        return self.fee / max(self.size, 1)
+
+    @property
+    def ancestor_score(self) -> float:
+        """Package feerate used by mining selection."""
+        return self.fees_with_ancestors / max(self.size_with_ancestors, 1)
+
+    def parents(self) -> Set[int]:
+        return {i.prevout.txid for i in self.tx.vin}
+
+
+class TxMemPool:
+    def __init__(self) -> None:
+        self._entries: Dict[int, MempoolEntry] = {}
+        self._spenders: Dict[OutPoint, int] = {}  # mapNextTx: prevout -> txid
+        self._disconnected: List[Transaction] = []
+
+    # -- queries -----------------------------------------------------------
+
+    def contains(self, txid: int) -> bool:
+        return txid in self._entries
+
+    def get(self, txid: int) -> Optional[MempoolEntry]:
+        return self._entries.get(txid)
+
+    def get_tx(self, txid: int) -> Optional[Transaction]:
+        e = self._entries.get(txid)
+        return e.tx if e else None
+
+    def size(self) -> int:
+        return len(self._entries)
+
+    def total_size_bytes(self) -> int:
+        return sum(e.size for e in self._entries.values())
+
+    def total_fees(self) -> int:
+        return sum(e.fee for e in self._entries.values())
+
+    def txids(self) -> List[int]:
+        return list(self._entries)
+
+    def spender_of(self, outpoint: OutPoint) -> Optional[int]:
+        return self._spenders.get(outpoint)
+
+    def has_conflict(self, tx: Transaction) -> bool:
+        return any(i.prevout in self._spenders for i in tx.vin)
+
+    # -- ancestry ----------------------------------------------------------
+
+    def calculate_ancestors(self, parents: Iterable[int]) -> Set[int]:
+        out: Set[int] = set()
+        stack = [p for p in parents if p in self._entries]
+        while stack:
+            txid = stack.pop()
+            if txid in out:
+                continue
+            out.add(txid)
+            stack.extend(
+                p for p in self._entries[txid].parents() if p in self._entries
+            )
+        return out
+
+    def calculate_descendants(self, txid: int) -> Set[int]:
+        out: Set[int] = set()
+        stack = [txid]
+        while stack:
+            cur = stack.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            e = self._entries.get(cur)
+            if e is None:
+                continue
+            for i in range(len(e.tx.vout)):
+                child = self._spenders.get(OutPoint(cur, i))
+                if child is not None:
+                    stack.append(child)
+        out.discard(txid)
+        return out
+
+    # -- mutation ----------------------------------------------------------
+
+    def add(self, entry: MempoolEntry) -> None:
+        """ref CTxMemPool::addUnchecked — caller has validated."""
+        txid = entry.tx.txid
+        ancestors = self.calculate_ancestors(entry.parents())
+        entry.count_with_ancestors = 1 + len(ancestors)
+        entry.size_with_ancestors = entry.size + sum(
+            self._entries[a].size for a in ancestors
+        )
+        entry.fees_with_ancestors = entry.fee + sum(
+            self._entries[a].fee for a in ancestors
+        )
+        self._entries[txid] = entry
+        for txin in entry.tx.vin:
+            self._spenders[txin.prevout] = txid
+        for a in ancestors:
+            ae = self._entries[a]
+            ae.count_with_descendants += 1
+            ae.size_with_descendants += entry.size
+            ae.fees_with_descendants += entry.fee
+
+    def remove(self, txid: int, reason: str = "unknown") -> None:
+        """Remove txid and all descendants (ref removeRecursive)."""
+        for d in sorted(
+            self.calculate_descendants(txid),
+            key=lambda t: -self._entries[t].count_with_ancestors
+            if t in self._entries
+            else 0,
+        ):
+            self._remove_single(d)
+        self._remove_single(txid)
+
+    def _remove_single(self, txid: int) -> None:
+        e = self._entries.pop(txid, None)
+        if e is None:
+            return
+        for txin in e.tx.vin:
+            if self._spenders.get(txin.prevout) == txid:
+                del self._spenders[txin.prevout]
+        ancestors = self.calculate_ancestors(e.parents())
+        for a in ancestors:
+            ae = self._entries.get(a)
+            if ae:
+                ae.count_with_descendants -= 1
+                ae.size_with_descendants -= e.size
+                ae.fees_with_descendants -= e.fee
+
+    def remove_for_block(self, vtx: List[Transaction]) -> None:
+        """ref removeForBlock: drop included + conflicted txs."""
+        for tx in vtx:
+            self._remove_single(tx.txid)
+            for txin in tx.vin:
+                conflict = self._spenders.get(txin.prevout)
+                if conflict is not None and conflict != tx.txid:
+                    self.remove(conflict, "conflict")
+
+    def add_disconnected_txs(self, vtx: List[Transaction]) -> None:
+        """Queue reorged-out txs for resubmission (ref DisconnectedBlockTransactions)."""
+        self._disconnected.extend(t for t in vtx if not t.is_coinbase())
+
+    def take_disconnected(self) -> List[Transaction]:
+        out, self._disconnected = self._disconnected, []
+        return out
+
+    def expire(self, cutoff_time: float) -> int:
+        stale = [t for t, e in self._entries.items() if e.time < cutoff_time]
+        for t in stale:
+            self.remove(t, "expiry")
+        return len(stale)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._spenders.clear()
+
+    # -- ordering ----------------------------------------------------------
+
+    def ordered_for_mining(self) -> List[MempoolEntry]:
+        """Descending ancestor-score (ref ancestor_score index + miner walk)."""
+        return sorted(
+            self._entries.values(),
+            key=lambda e: (-e.ancestor_score, e.time),
+        )
+
+    def ordered_by_descendant_score(self) -> List[MempoolEntry]:
+        return sorted(
+            self._entries.values(),
+            key=lambda e: e.fees_with_descendants / max(e.size_with_descendants, 1),
+        )
+
+    def trim_to_size(self, max_bytes: int) -> List[int]:
+        """Evict lowest descendant-score packages (ref TrimToSize)."""
+        removed = []
+        while self.total_size_bytes() > max_bytes and self._entries:
+            worst = self.ordered_by_descendant_score()[0]
+            txid = worst.tx.txid
+            removed.append(txid)
+            self.remove(txid, "size")
+        return removed
+
+    # -- consistency -------------------------------------------------------
+
+    def check(self, view: CoinsViewCache) -> None:
+        """ref CTxMemPool::check — every input is available from the view
+        or an in-pool parent; spender index consistent."""
+        for txid, e in self._entries.items():
+            for txin in e.tx.vin:
+                parent = self._entries.get(txin.prevout.txid)
+                if parent is not None:
+                    assert txin.prevout.n < len(parent.tx.vout)
+                else:
+                    assert view.have_coin(txin.prevout), f"missing {txin.prevout}"
+                assert self._spenders.get(txin.prevout) == txid
+
+
+class CoinsViewMemPool(CoinsViewBacked):
+    """Coins overlay exposing mempool outputs (ref txmempool.h CCoinsViewMemPool)."""
+
+    MEMPOOL_HEIGHT = 0x7FFFFFFF
+
+    def __init__(self, base: CoinsView, pool: TxMemPool):
+        super().__init__(base)
+        self.pool = pool
+
+    def get_coin(self, outpoint: OutPoint):
+        tx = self.pool.get_tx(outpoint.txid)
+        if tx is not None:
+            if outpoint.n < len(tx.vout):
+                return Coin(tx.vout[outpoint.n], self.MEMPOOL_HEIGHT, False)
+            return None
+        return self.base.get_coin(outpoint)

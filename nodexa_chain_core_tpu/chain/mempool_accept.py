@@ -1,0 +1,122 @@
+"""Mempool admission (parity: reference src/validation.cpp
+AcceptToMemoryPool (:1114) -> AcceptToMemoryPoolWorker (:525)).
+
+Pipeline: stateless checks -> standardness -> finality -> conflict scan ->
+input lookup through the mempool coins overlay -> fee floor -> sigops cap ->
+full script verification with STANDARD flags -> pool insert.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+from ..consensus.consensus import MAX_BLOCK_SIGOPS_COST
+from ..consensus.tx_verify import (
+    TxValidationError,
+    check_transaction,
+    check_tx_inputs,
+    get_transaction_sigop_cost,
+    is_final_tx,
+)
+from ..primitives.transaction import Transaction
+from ..script.interpreter import (
+    STANDARD_SCRIPT_VERIFY_FLAGS,
+    TransactionSignatureChecker,
+    verify_script,
+)
+from ..script.script import Script
+from .coins import CoinsViewCache
+from .mempool import CoinsViewMemPool, MempoolEntry, TxMemPool
+from .policy import MAX_STANDARD_TX_SIGOPS_COST, MIN_RELAY_FEE, is_standard_tx
+from .validation import ChainState
+
+
+class MempoolAcceptError(TxValidationError):
+    pass
+
+
+def accept_to_memory_pool(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool = False,
+    require_standard: Optional[bool] = None,
+) -> MempoolEntry:
+    """Validate and insert; raises MempoolAcceptError on rejection."""
+    if require_standard is None:
+        require_standard = chainstate.params.require_standard
+
+    try:
+        check_transaction(tx)
+    except TxValidationError as e:
+        raise MempoolAcceptError(e.code)
+
+    if tx.is_coinbase():
+        raise MempoolAcceptError("coinbase")
+
+    ok, reason = is_standard_tx(tx, require_standard)
+    if not ok:
+        raise MempoolAcceptError("non-standard", reason)
+
+    tip = chainstate.tip()
+    height = (tip.height if tip else 0) + 1
+    mtp = tip.median_time_past() if tip else 0
+    if not is_final_tx(tx, height, mtp):
+        raise MempoolAcceptError("non-final")
+
+    if pool.contains(tx.txid):
+        raise MempoolAcceptError("txn-already-in-mempool")
+    if pool.has_conflict(tx):
+        raise MempoolAcceptError("txn-mempool-conflict")
+
+    # input view: chain coins + in-pool parents (ref CCoinsViewMemPool)
+    view = CoinsViewCache(CoinsViewMemPool(chainstate.coins, pool))
+    if not view.have_inputs(tx):
+        raise MempoolAcceptError("bad-txns-inputs-missingorspent")
+
+    try:
+        fee = check_tx_inputs(tx, view, height)
+    except TxValidationError as e:
+        raise MempoolAcceptError(e.code)
+
+    sigops = get_transaction_sigop_cost(tx, view, STANDARD_SCRIPT_VERIFY_FLAGS)
+    if sigops > MAX_STANDARD_TX_SIGOPS_COST:
+        raise MempoolAcceptError("bad-txns-too-many-sigops")
+
+    size = len(tx.to_bytes())
+    if not bypass_limits and fee < MIN_RELAY_FEE.fee_for(size):
+        raise MempoolAcceptError("min relay fee not met", f"{fee} < {MIN_RELAY_FEE.fee_for(size)}")
+
+    # full script verification (ref CheckInputs with STANDARD flags)
+    for i, txin in enumerate(tx.vin):
+        coin = view.get_coin(txin.prevout)
+        assert coin is not None
+        checker = TransactionSignatureChecker(tx, i, coin.out.value)
+        ok, err = verify_script(
+            Script(txin.script_sig),
+            Script(coin.out.script_pubkey),
+            STANDARD_SCRIPT_VERIFY_FLAGS,
+            checker,
+        )
+        if not ok:
+            raise MempoolAcceptError("mandatory-script-verify-flag-failed", err)
+
+    entry = MempoolEntry(
+        tx=tx, fee=fee, time=_time.time(), height=height, sigops=sigops // 4
+    )
+    pool.add(entry)
+
+    from ..node.events import main_signals
+
+    main_signals.transaction_added_to_mempool(tx)
+    return entry
+
+
+def resubmit_disconnected(chainstate: ChainState, pool: TxMemPool) -> None:
+    """After a reorg, try to re-add disconnected txs (ref UpdateMempoolForReorg)."""
+    for tx in pool.take_disconnected():
+        try:
+            accept_to_memory_pool(chainstate, pool, tx, bypass_limits=True)
+        except TxValidationError:
+            pass

@@ -1,0 +1,85 @@
+"""Relay/standardness policy (parity: reference src/policy/policy.{h,cpp}).
+
+IsStandardTx / dust / fee floors; consensus-independent, gate only mempool
+acceptance and relay.
+"""
+
+from __future__ import annotations
+
+from ..core.amount import COIN
+from ..primitives.transaction import Transaction, TxOut
+from ..script.script import MAX_SCRIPT_SIZE, Script
+from ..script.standard import (
+    TX_MULTISIG,
+    TX_NONSTANDARD,
+    TX_NULL_DATA,
+    solver,
+)
+
+DEFAULT_MIN_RELAY_TX_FEE = 1000  # sat/kB (ref policy.h)
+MAX_STANDARD_TX_SIZE = 400_000
+MAX_STANDARD_TX_SIGOPS_COST = 16_000
+MAX_STANDARD_SCRIPTSIG_SIZE = 1650
+DUST_RELAY_TX_FEE = 3000
+
+
+class FeeRate:
+    """Fee per 1000 bytes (ref amount.h CFeeRate)."""
+
+    def __init__(self, sat_per_kb: int):
+        self.sat_per_kb = sat_per_kb
+
+    def fee_for(self, size_bytes: int) -> int:
+        fee = self.sat_per_kb * size_bytes // 1000
+        if fee == 0 and size_bytes != 0 and self.sat_per_kb > 0:
+            fee = self.sat_per_kb
+        return fee
+
+    def __repr__(self):
+        return f"FeeRate({self.sat_per_kb}/kB)"
+
+
+MIN_RELAY_FEE = FeeRate(DEFAULT_MIN_RELAY_TX_FEE)
+DUST_FEE = FeeRate(DUST_RELAY_TX_FEE)
+
+
+def is_dust(out: TxOut, dust_fee: FeeRate = DUST_FEE) -> bool:
+    """ref policy.cpp IsDust: output value below the cost of spending it."""
+    if Script(out.script_pubkey).is_unspendable():
+        return False
+    # 148 bytes to spend a typical output + the output's own size
+    spend_size = 148 + 8 + 1 + len(out.script_pubkey)
+    return out.value < 3 * dust_fee.fee_for(spend_size)
+
+
+def is_standard_tx(tx: Transaction, require_standard: bool = True) -> tuple[bool, str]:
+    """ref policy.cpp IsStandardTx."""
+    if not require_standard:
+        return True, ""
+    if tx.version < 1 or tx.version > 2:
+        return False, "version"
+    if len(tx.to_bytes()) > MAX_STANDARD_TX_SIZE:
+        return False, "tx-size"
+    for txin in tx.vin:
+        if len(txin.script_sig) > MAX_STANDARD_SCRIPTSIG_SIZE:
+            return False, "scriptsig-size"
+        if not Script(txin.script_sig).is_push_only():
+            return False, "scriptsig-not-pushonly"
+    data_outputs = 0
+    for out in tx.vout:
+        kind, sols = solver(Script(out.script_pubkey))
+        if kind == TX_NONSTANDARD:
+            return False, "scriptpubkey"
+        if kind == TX_NULL_DATA:
+            data_outputs += 1
+            continue
+        if kind == TX_MULTISIG:
+            n = sols[-1][0]
+            m = sols[0][0]
+            if n < 1 or n > 3 or m < 1 or m > n:
+                return False, "bare-multisig"
+        if is_dust(out):
+            return False, "dust"
+    if data_outputs > 1:
+        return False, "multi-op-return"
+    return True, ""

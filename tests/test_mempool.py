@@ -1,0 +1,184 @@
+"""Mempool tests: admission, chains of unconfirmed txs, mining selection,
+block removal, reorg resubmission (analogues of the reference's
+mempool_tests.cpp + mempool_* functional tests)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.mempool import MempoolEntry, TxMemPool
+from nodexa_chain_core_tpu.chain.mempool_accept import (
+    MempoolAcceptError,
+    accept_to_memory_pool,
+    resubmit_disconnected,
+)
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def chain100():
+    """Regtest chain with spendable coinbases (ref TestChain100Setup)."""
+    params = regtest_params()
+    cs = ChainState(params)
+    pool = TxMemPool()
+    cs.mempool = pool
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xFEED)))
+    t = params.genesis_time + 60
+    blocks = []
+    asm = BlockAssembler(cs)
+    for i in range(COINBASE_MATURITY + 20):
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+        blocks.append(blk)
+        t += 60
+    return params, cs, pool, ks, spk, blocks
+
+
+def spend_tx(ks, spk, prev_tx, value_out, n=0):
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(prev_tx.txid, n))],
+        vout=[TxOut(value=value_out, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, spk)
+    return tx
+
+
+def test_accept_and_mine(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[0].vtx[0]
+    tx = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+    entry = accept_to_memory_pool(cs, pool, tx)
+    assert pool.contains(tx.txid)
+    assert entry.fee == 100_000
+
+    # child spending the unconfirmed parent
+    child = spend_tx(ks, spk, tx, tx.vout[0].value - 100_000)
+    accept_to_memory_pool(cs, pool, child)
+    assert pool.get(tx.txid).count_with_descendants == 2
+    assert pool.get(child.txid).count_with_ancestors == 2
+
+    # mine both; parent must precede child
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    txids = [t.txid for t in blk.vtx]
+    assert tx.txid in txids and child.txid in txids
+    assert txids.index(tx.txid) < txids.index(child.txid)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    assert not pool.contains(tx.txid)
+    assert not pool.contains(child.txid)
+    # fees collected in coinbase
+    assert blk.vtx[0].total_output_value() >= 5000
+
+
+def test_reject_double_spend(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[1].vtx[0]
+    tx1 = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+    tx2 = spend_tx(ks, spk, cb, cb.vout[0].value - 200_000)
+    accept_to_memory_pool(cs, pool, tx1)
+    with pytest.raises(MempoolAcceptError, match="conflict"):
+        accept_to_memory_pool(cs, pool, tx2)
+
+
+def test_reject_low_fee_and_nonstandard(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[2].vtx[0]
+    free = spend_tx(ks, spk, cb, cb.vout[0].value)  # zero fee
+    with pytest.raises(MempoolAcceptError, match="fee"):
+        accept_to_memory_pool(cs, pool, free)
+
+    missing = spend_tx(ks, spk, blocks[3].vtx[0], 1000)
+    missing.vin[0].prevout = OutPoint(txid=12345, n=0)
+    with pytest.raises(MempoolAcceptError):
+        accept_to_memory_pool(cs, pool, missing)
+
+
+def test_reject_immature_coinbase_spend(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    young_cb = blocks[-1].vtx[0]
+    tx = spend_tx(ks, spk, young_cb, young_cb.vout[0].value - 100_000)
+    with pytest.raises(MempoolAcceptError, match="premature"):
+        accept_to_memory_pool(cs, pool, tx)
+
+
+def test_mining_prefers_higher_feerate(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cheap = spend_tx(ks, spk, blocks[4].vtx[0], blocks[4].vtx[0].vout[0].value - 10_000)
+    rich = spend_tx(ks, spk, blocks[5].vtx[0], blocks[5].vtx[0].vout[0].value - 1_000_000)
+    accept_to_memory_pool(cs, pool, cheap)
+    accept_to_memory_pool(cs, pool, rich)
+    order = pool.ordered_for_mining()
+    assert order[0].tx.txid == rich.txid
+
+
+def test_reorg_resubmits_transactions(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[6].vtx[0]
+    tx = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+    accept_to_memory_pool(cs, pool, tx)
+
+    # mine it into block N
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    assert not pool.contains(tx.txid)
+    tip_height = cs.tip().height
+
+    # build a competing 2-block branch from the previous tip on a fresh
+    # chainstate replaying the same blocks
+    cs2 = ChainState(params)
+    cs2.mempool = TxMemPool()
+    for b in blocks:
+        cs2.process_new_block(b)
+    t = blocks[-1].header.time + 30
+    asm2 = BlockAssembler(cs2)
+    branch = []
+    for i in range(2):
+        b2 = asm2.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(b2, params.algo_schedule)
+        cs2.process_new_block(b2)
+        branch.append(b2)
+        t += 60
+    for b2 in branch:
+        cs.process_new_block(b2)
+    assert cs.tip().height == tip_height + 1
+    assert cs.tip().block_hash == branch[-1].get_hash()
+    # the reorged-out spend gets resubmitted
+    resubmit_disconnected(cs, pool)
+    assert pool.contains(tx.txid)
+
+
+def test_trim_and_expire():
+    pool = TxMemPool()
+    txs = []
+    for i in range(5):
+        tx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(txid=1000 + i, n=0))],
+            vout=[TxOut(value=1000, script_pubkey=b"\x51")],
+        )
+        pool.add(MempoolEntry(tx=tx, fee=1000 * (i + 1), time=i, height=1))
+        txs.append(tx)
+    assert pool.size() == 5
+    total = pool.total_size_bytes()
+    removed = pool.trim_to_size(total - 1)
+    assert removed and pool.size() < 5
+    # lowest feerate went first
+    assert removed[0] == txs[0].txid
+    n = pool.expire(cutoff_time=3)
+    assert n >= 1
