@@ -1,0 +1,52 @@
+"""Node context: owns every subsystem (parity: the reference's globals —
+g_chainstate/mempool/connman/scheduler — wired by init.cpp AppInitMain)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..chain.mempool import TxMemPool
+from ..chain.validation import ChainState
+from ..node.chainparams import NetworkParams, select_params
+from ..node.scheduler import Scheduler
+
+
+class NodeContext:
+    def __init__(
+        self,
+        network: str = "main",
+        datadir: Optional[str] = None,
+        script_check_threads: int = 0,
+    ):
+        self.params: NetworkParams = select_params(network)
+        self.datadir = datadir
+        self.chainstate = ChainState(
+            self.params, datadir=datadir, script_check_threads=script_check_threads
+        )
+        self.mempool = TxMemPool()
+        self.chainstate.mempool = self.mempool
+        self.scheduler = Scheduler()
+        self.wallet = None  # attached by wallet/init when enabled
+        self.connman = None  # attached by net layer when enabled
+        self.rest_handler = None
+        self.start_time = time.time()
+        self._stop_requested = False
+
+    def uptime(self) -> int:
+        return int(time.time() - self.start_time)
+
+    def request_stop(self) -> None:
+        self._stop_requested = True
+
+    def stop_requested(self) -> bool:
+        return self._stop_requested
+
+    def shutdown(self) -> None:
+        """ref init.cpp Shutdown()."""
+        self.scheduler.stop()
+        if self.connman is not None:
+            self.connman.stop()
+        if self.wallet is not None:
+            self.wallet.flush()
+        self.chainstate.close()

@@ -1,0 +1,114 @@
+"""Daemon entry point (parity: reference src/clore_blockchaind.cpp main ->
+AppInit -> init.cpp AppInitMain's 13-step boot, SURVEY.md §3.1).
+
+Usage: ``python -m nodexa_chain_core_tpu.node.daemon -regtest
+-datadir=/tmp/n1 -port=19444 -rpcport=19443``
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+from ..rpc.register import register_all
+from ..rpc.server import HTTPRPCServer, g_rpc_table
+from ..utils.args import g_args
+from ..utils.logging import g_logger, log_printf
+from .context import NodeContext
+
+DEFAULT_RPC_PORTS = {"main": 8766, "test": 4566, "regtest": 19443}
+
+
+def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
+    # Steps 1-3: parameters + config (ref init.cpp AppInitBasicSetup/
+    # ParameterInteraction)
+    g_args.parse_parameters(argv)
+    network = g_args.network()
+    datadir = g_args.datadir()
+    os.makedirs(datadir, exist_ok=True)
+    g_args.read_config_file()
+    g_logger.open_debug_log(datadir)
+    if g_args.is_set("debug"):
+        g_logger.enable_categories(g_args.get("debug", "all"))
+    log_printf("Nodexa TPU daemon starting: network=%s datadir=%s", network, datadir)
+
+    # Steps 4-7: chainstate load (ref init.cpp:1497)
+    node = NodeContext(
+        network=network,
+        datadir=datadir,
+        script_check_threads=g_args.get_int("par", 0),
+    )
+    node.scheduler.start()
+    node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
+
+    # Step 8: wallet
+    if not g_args.get_bool("disablewallet"):
+        try:
+            from ..wallet.wallet import Wallet
+
+            node.wallet = Wallet.load_or_create(node)
+            log_printf("wallet loaded: %d keys", len(node.wallet.keystore.keys()))
+        except ImportError:
+            pass
+
+    # Step 11: network (ref CConnman::Start, net.cpp:2304)
+    if not g_args.get_bool("nolisten") and g_args.get_bool("listen", True):
+        try:
+            from ..net.connman import ConnMan
+
+            port = g_args.get_int("port", node.params.default_port)
+            node.connman = ConnMan(node, port=port)
+            node.connman.start()
+            for addr in g_args.get_all("addnode") + g_args.get_all("connect"):
+                node.connman.connect_to(addr)
+        except ImportError:
+            pass
+
+    # Steps 4a/13: RPC server + warmup end
+    register_all(g_rpc_table)
+    rpc_port = g_args.get_int("rpcport", DEFAULT_RPC_PORTS[network])
+    rpc = HTTPRPCServer(
+        node,
+        g_rpc_table,
+        host=g_args.get("rpcbind", "127.0.0.1"),
+        port=rpc_port,
+        user=g_args.get("rpcuser") or None,
+        password=g_args.get("rpcpassword") or None,
+    )
+    try:
+        from ..rpc.rest import make_rest_handler
+
+        node.rest_handler = make_rest_handler(node)
+    except ImportError:
+        pass
+    rpc.start()
+    g_rpc_table.set_warmup_finished()
+    log_printf("init complete: height=%d", node.chainstate.tip().height)
+    return node, rpc
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    node, rpc = app_init_main(argv)
+
+    def on_signal(signum, frame):
+        node.request_stop()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not node.stop_requested():
+            time.sleep(0.2)
+    finally:
+        log_printf("shutdown requested")
+        rpc.stop()
+        node.shutdown()
+        log_printf("shutdown complete")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

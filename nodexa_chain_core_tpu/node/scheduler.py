@@ -1,0 +1,71 @@
+"""Background task scheduler (parity: reference src/scheduler.{h,cpp} —
+single timer thread, scheduleEvery periodic jobs: state flush, stale-tip
+checks, fee-estimate dumps)."""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, List, Tuple
+
+
+class Scheduler:
+    def __init__(self) -> None:
+        self._heap: List[Tuple[float, int, Callable, float]] = []
+        self._counter = 0
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="scheduler", daemon=True)
+        self._thread.start()
+
+    def schedule(self, fn: Callable[[], None], delay_s: float) -> None:
+        with self._cv:
+            self._counter += 1
+            heapq.heappush(self._heap, (time.time() + delay_s, self._counter, fn, 0.0))
+            self._cv.notify()
+
+    def schedule_every(self, fn: Callable[[], None], period_s: float) -> None:
+        """ref scheduler.h:40 scheduleEvery."""
+        with self._cv:
+            self._counter += 1
+            heapq.heappush(
+                self._heap, (time.time() + period_s, self._counter, fn, period_s)
+            )
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and (
+                    not self._heap or self._heap[0][0] > time.time()
+                ):
+                    timeout = (
+                        self._heap[0][0] - time.time() if self._heap else None
+                    )
+                    self._cv.wait(timeout=timeout)
+                if self._stop:
+                    return
+                when, _, fn, period = heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # jobs must not kill the timer thread
+                pass
+            if period > 0:
+                with self._cv:
+                    if not self._stop:
+                        self._counter += 1
+                        heapq.heappush(
+                            self._heap,
+                            (time.time() + period, self._counter, fn, period),
+                        )
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread:
+            self._thread.join(timeout=2)

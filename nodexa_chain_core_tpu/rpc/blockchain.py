@@ -1,0 +1,303 @@
+"""Blockchain RPC family (parity: reference src/rpc/blockchain.cpp, command
+table at :1897)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..chain.blockindex import BlockIndex
+from ..core.amount import COIN
+from ..core.uint256 import bits_to_target, u256_from_hex, u256_hex
+from ..primitives.block import Block
+from ..script.script import Script
+from ..script.standard import extract_destination, encode_destination, solver
+from .server import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPCError,
+    RPCTable,
+)
+
+
+def _difficulty(bits: int, params) -> float:
+    target, _, _ = bits_to_target(bits)
+    if target == 0:
+        return 0.0
+    return params.consensus.pow_limit / target
+
+
+def _index_to_json(node, idx: BlockIndex, verbose_tx: bool = False) -> dict:
+    cs = node.chainstate
+    result = {
+        "hash": u256_hex(idx.block_hash),
+        "confirmations": (cs.tip().height - idx.height + 1) if idx in cs.active else -1,
+        "height": idx.height,
+        "version": idx.header.version,
+        "versionHex": f"{idx.header.version & 0xFFFFFFFF:08x}",
+        "merkleroot": u256_hex(idx.header.hash_merkle_root),
+        "time": idx.header.time,
+        "mediantime": idx.median_time_past(),
+        "nonce": idx.header.nonce,
+        "bits": f"{idx.header.bits:08x}",
+        "difficulty": _difficulty(idx.header.bits, node.params),
+        "chainwork": f"{idx.chain_work:064x}",
+        "nTx": idx.tx_count,
+    }
+    if idx.prev:
+        result["previousblockhash"] = u256_hex(idx.prev.block_hash)
+    nxt = cs.active.next(idx)
+    if nxt:
+        result["nextblockhash"] = u256_hex(nxt.block_hash)
+    return result
+
+
+def tx_to_json(node, tx, include_hex: bool = True) -> dict:
+    vin = []
+    for txin in tx.vin:
+        if txin.prevout.is_null():
+            vin.append(
+                {"coinbase": txin.script_sig.hex(), "sequence": txin.sequence}
+            )
+        else:
+            vin.append(
+                {
+                    "txid": u256_hex(txin.prevout.txid),
+                    "vout": txin.prevout.n,
+                    "scriptSig": {"hex": txin.script_sig.hex()},
+                    "sequence": txin.sequence,
+                }
+            )
+    vout = []
+    for i, out in enumerate(tx.vout):
+        spk = Script(out.script_pubkey)
+        kind, _ = solver(spk)
+        entry: dict = {
+            "value": out.value / COIN,
+            "valueSat": out.value,
+            "n": i,
+            "scriptPubKey": {"hex": out.script_pubkey.hex(), "type": kind},
+        }
+        dest = extract_destination(spk)
+        if dest is not None:
+            entry["scriptPubKey"]["addresses"] = [
+                encode_destination(dest, node.params)
+            ]
+        vout.append(entry)
+    out = {
+        "txid": tx.txid_hex,
+        "version": tx.version,
+        "size": len(tx.to_bytes()),
+        "locktime": tx.locktime,
+        "vin": vin,
+        "vout": vout,
+    }
+    if include_hex:
+        out["hex"] = tx.to_bytes().hex()
+    return out
+
+
+# --- commands ---------------------------------------------------------------
+
+
+def getblockcount(node, params: List[Any]):
+    return node.chainstate.tip().height
+
+
+def getbestblockhash(node, params: List[Any]):
+    return u256_hex(node.chainstate.tip().block_hash)
+
+
+def getblockhash(node, params: List[Any]):
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "height required")
+    idx = node.chainstate.active.at(int(params[0]))
+    if idx is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "Block height out of range")
+    return u256_hex(idx.block_hash)
+
+
+def _lookup_block(node, hash_hex: str) -> BlockIndex:
+    idx = node.chainstate.lookup(u256_from_hex(hash_hex))
+    if idx is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+    return idx
+
+
+def getblockheader(node, params: List[Any]):
+    idx = _lookup_block(node, str(params[0]))
+    verbose = bool(params[1]) if len(params) > 1 else True
+    if not verbose:
+        from ..core.serialize import ByteWriter
+
+        w = ByteWriter()
+        idx.header.serialize(w, node.params.algo_schedule)
+        return w.getvalue().hex()
+    return _index_to_json(node, idx)
+
+
+def getblock(node, params: List[Any]):
+    idx = _lookup_block(node, str(params[0]))
+    verbosity = int(params[1]) if len(params) > 1 else 1
+    block = node.chainstate.read_block(idx)
+    if verbosity == 0:
+        from ..core.serialize import ByteWriter
+
+        w = ByteWriter()
+        block.serialize(w, node.params.algo_schedule)
+        return w.getvalue().hex()
+    result = _index_to_json(node, idx)
+    result["size"] = len(block.to_bytes())
+    if verbosity == 1:
+        result["tx"] = [tx.txid_hex for tx in block.vtx]
+    else:
+        result["tx"] = [tx_to_json(node, tx) for tx in block.vtx]
+    return result
+
+
+def getblockchaininfo(node, params: List[Any]):
+    cs = node.chainstate
+    tip = cs.tip()
+    return {
+        "chain": node.params.network,
+        "blocks": tip.height,
+        "headers": max(i.height for i in cs.block_index.values()),
+        "bestblockhash": u256_hex(tip.block_hash),
+        "difficulty": _difficulty(tip.header.bits, node.params),
+        "mediantime": tip.median_time_past(),
+        "verificationprogress": 1.0,
+        "chainwork": f"{tip.chain_work:064x}",
+        "pruned": False,
+        "softforks": [],
+        "warnings": "",
+    }
+
+
+def getdifficulty(node, params: List[Any]):
+    return _difficulty(node.chainstate.tip().header.bits, node.params)
+
+
+def getchaintips(node, params: List[Any]):
+    cs = node.chainstate
+    tips = []
+    have_children = {
+        idx.prev.block_hash for idx in cs.block_index.values() if idx.prev
+    }
+    for idx in cs.block_index.values():
+        if idx.block_hash in have_children:
+            continue
+        if idx is cs.tip():
+            status = "active"
+        elif idx in cs.invalid:
+            status = "invalid"
+        else:
+            status = "valid-fork"
+        fork = cs.active.find_fork(idx)
+        tips.append(
+            {
+                "height": idx.height,
+                "hash": u256_hex(idx.block_hash),
+                "branchlen": idx.height - (fork.height if fork else 0),
+                "status": status,
+            }
+        )
+    return sorted(tips, key=lambda t: -t["height"])
+
+
+def getmempoolinfo(node, params: List[Any]):
+    pool = node.mempool
+    return {
+        "size": pool.size(),
+        "bytes": pool.total_size_bytes(),
+        "usage": pool.total_size_bytes(),
+        "total_fee": pool.total_fees() / COIN,
+        "mempoolminfee": 0.00001,
+    }
+
+
+def getrawmempool(node, params: List[Any]):
+    verbose = bool(params[0]) if params else False
+    pool = node.mempool
+    if not verbose:
+        return [u256_hex(t) for t in pool.txids()]
+    out = {}
+    for txid in pool.txids():
+        e = pool.get(txid)
+        out[u256_hex(txid)] = {
+            "size": e.size,
+            "fee": e.fee / COIN,
+            "time": int(e.time),
+            "height": e.height,
+            "descendantcount": e.count_with_descendants,
+            "ancestorcount": e.count_with_ancestors,
+        }
+    return out
+
+
+def gettxout(node, params: List[Any]):
+    from ..primitives.transaction import OutPoint
+
+    txid = u256_from_hex(str(params[0]))
+    n = int(params[1])
+    include_mempool = bool(params[2]) if len(params) > 2 else True
+    outpoint = OutPoint(txid, n)
+    coin = None
+    if include_mempool and node.mempool.spender_of(outpoint) is not None:
+        return None
+    if include_mempool:
+        tx = node.mempool.get_tx(txid)
+        if tx is not None and n < len(tx.vout):
+            from ..chain.coins import Coin
+
+            coin = Coin(tx.vout[n], 0x7FFFFFFF, False)
+    if coin is None:
+        coin = node.chainstate.coins.get_coin(outpoint)
+    if coin is None:
+        return None
+    spk = Script(coin.out.script_pubkey)
+    kind, _ = solver(spk)
+    return {
+        "bestblock": u256_hex(node.chainstate.tip().block_hash),
+        "confirmations": 0
+        if coin.height == 0x7FFFFFFF
+        else node.chainstate.tip().height - coin.height + 1,
+        "value": coin.out.value / COIN,
+        "scriptPubKey": {"hex": coin.out.script_pubkey.hex(), "type": kind},
+        "coinbase": coin.coinbase,
+    }
+
+
+def verifychain(node, params: List[Any]):
+    """ref CVerifyDB::VerifyDB (validation.cpp:12564), simplified level:
+    walk back N blocks re-running connect checks against a throwaway view."""
+    checkdepth = int(params[1]) if len(params) > 1 else 6
+    cs = node.chainstate
+    idx = cs.tip()
+    count = 0
+    while idx is not None and idx.prev is not None and count < checkdepth:
+        block = cs.read_block(idx)
+        try:
+            cs.check_block(block)
+        except Exception:
+            return False
+        idx = idx.prev
+        count += 1
+    return True
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("getblockcount", getblockcount, []),
+        ("getbestblockhash", getbestblockhash, []),
+        ("getblockhash", getblockhash, ["height"]),
+        ("getblock", getblock, ["blockhash", "verbosity"]),
+        ("getblockheader", getblockheader, ["blockhash", "verbose"]),
+        ("getblockchaininfo", getblockchaininfo, []),
+        ("getdifficulty", getdifficulty, []),
+        ("getchaintips", getchaintips, []),
+        ("getmempoolinfo", getmempoolinfo, []),
+        ("getrawmempool", getrawmempool, ["verbose"]),
+        ("gettxout", gettxout, ["txid", "n", "include_mempool"]),
+        ("verifychain", verifychain, ["checklevel", "nblocks"]),
+    ]:
+        table.register("blockchain", name, fn, args)

@@ -1,0 +1,76 @@
+"""nodexa-cli: thin JSON-RPC client (parity: reference src/clore-cli.cpp)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import sys
+import urllib.request
+
+from ..utils.args import ArgsManager
+
+DEFAULT_RPC_PORTS = {"main": 8766, "test": 4566, "regtest": 19443}
+
+
+def call(host: str, port: int, user: str, password: str, method: str, params):
+    req = urllib.request.Request(
+        f"http://{host}:{port}/",
+        data=json.dumps(
+            {"jsonrpc": "1.0", "id": "cli", "method": method, "params": params}
+        ).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "Authorization": "Basic "
+            + base64.b64encode(f"{user}:{password}".encode()).decode(),
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+    return body
+
+
+def _coerce(arg: str):
+    try:
+        return json.loads(arg)
+    except json.JSONDecodeError:
+        return arg
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    args = ArgsManager()
+    flags = [a for a in argv if a.startswith("-")]
+    rest = [a for a in argv if not a.startswith("-")]
+    args.parse_parameters(flags)
+    if not rest:
+        print("usage: nodexa-cli [-regtest] [-datadir=...] <method> [params...]")
+        return 1
+    network = args.network()
+    port = args.get_int("rpcport", DEFAULT_RPC_PORTS[network])
+    host = args.get("rpcconnect", "127.0.0.1")
+    user = args.get("rpcuser")
+    password = args.get("rpcpassword")
+    if not user:
+        cookie = os.path.join(args.datadir(), ".cookie")
+        if os.path.exists(cookie):
+            user, password = open(cookie).read().split(":", 1)
+    method, params = rest[0], [_coerce(a) for a in rest[1:]]
+    body = call(host, port, user or "", password or "", method, params)
+    if body.get("error"):
+        print(f"error: {json.dumps(body['error'])}", file=sys.stderr)
+        return 1
+    result = body.get("result")
+    if isinstance(result, (dict, list)):
+        print(json.dumps(result, indent=2))
+    else:
+        print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

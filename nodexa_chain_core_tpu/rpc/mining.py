@@ -1,0 +1,172 @@
+"""Mining RPC family (parity: reference src/rpc/mining.cpp, table :1283).
+
+``generatetoaddress`` follows the regtest CPU path (ref :175); real-difficulty
+generation runs the TPU mesh nonce search (the reference's analogue is the
+external GPU miner driven by getblocktemplate/submitblock)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List
+
+from ..core.serialize import ByteReader
+from ..core.uint256 import bits_to_target, u256_from_hex, u256_hex
+from ..mining.assembler import BlockAssembler, mine_block_cpu, mine_block_tpu
+from ..primitives.block import Block
+from ..script.standard import decode_destination, script_for_destination
+from .server import (
+    RPC_DESERIALIZATION_ERROR,
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPCError,
+    RPCTable,
+)
+
+
+def generatetoaddress(node, params: List[Any]):
+    """ref rpc/mining.cpp:175."""
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "nblocks and address required")
+    nblocks = int(params[0])
+    try:
+        dest = decode_destination(str(params[1]), node.params)
+    except ValueError as e:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+    spk = script_for_destination(dest)
+    maxtries = int(params[2]) if len(params) > 2 else 1_000_000
+
+    hashes = []
+    asm = BlockAssembler(node.chainstate)
+    for _ in range(nblocks):
+        block = asm.create_new_block(spk.raw)
+        if not mine_block_cpu(block, node.params.algo_schedule, max_tries=maxtries):
+            raise RPCError(RPC_MISC_ERROR, "couldn't find a block (maxtries)")
+        node.chainstate.process_new_block(block)
+        hashes.append(u256_hex(block.get_hash()))
+    return hashes
+
+
+def generatetoaddress_tpu(node, params: List[Any]):
+    """TPU-accelerated generation for real difficulties."""
+    nblocks = int(params[0])
+    dest = decode_destination(str(params[1]), node.params)
+    spk = script_for_destination(dest)
+    hashes = []
+    asm = BlockAssembler(node.chainstate)
+    for _ in range(nblocks):
+        block = asm.create_new_block(spk.raw)
+        if not mine_block_tpu(block, node.params.algo_schedule):
+            raise RPCError(RPC_MISC_ERROR, "nonce space exhausted")
+        node.chainstate.process_new_block(block)
+        hashes.append(u256_hex(block.get_hash()))
+    return hashes
+
+
+def getblocktemplate(node, params: List[Any]):
+    """ref rpc/mining.cpp:316 (subset: template mode for external miners)."""
+    cs = node.chainstate
+    tip = cs.tip()
+    asm = BlockAssembler(cs)
+    block = asm.create_new_block(b"\x6a", ntime=int(time.time()))  # placeholder cb
+    target, _, _ = bits_to_target(block.header.bits)
+    txs = []
+    for i, tx in enumerate(block.vtx[1:], start=1):
+        txs.append(
+            {
+                "data": tx.to_bytes().hex(),
+                "txid": tx.txid_hex,
+                "hash": tx.txid_hex,
+                "depends": [],
+                "fee": node.mempool.get(tx.txid).fee if node.mempool.get(tx.txid) else 0,
+            }
+        )
+    return {
+        "version": block.header.version,
+        "previousblockhash": u256_hex(tip.block_hash),
+        "transactions": txs,
+        "coinbasevalue": block.vtx[0].total_output_value(),
+        "target": f"{target:064x}",
+        "mintime": tip.median_time_past() + 1,
+        "curtime": block.header.time,
+        "bits": f"{block.header.bits:08x}",
+        "height": tip.height + 1,
+        "mutable": ["time", "transactions", "prevblock"],
+        "noncerange": "00000000ffffffff",
+    }
+
+
+def submitblock(node, params: List[Any]):
+    """ref rpc/mining.cpp:934."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "hexdata required")
+    try:
+        block = Block.deserialize(
+            ByteReader(bytes.fromhex(str(params[0]))), node.params.algo_schedule
+        )
+    except Exception as e:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, f"Block decode failed: {e}")
+    from ..chain.validation import BlockValidationError
+
+    try:
+        node.chainstate.process_new_block(block)
+    except BlockValidationError as e:
+        return e.code
+    if node.chainstate.tip().block_hash == block.get_hash():
+        return None  # success, like the reference
+    return "inconclusive"
+
+
+def getmininginfo(node, params: List[Any]):
+    from .blockchain import _difficulty
+
+    tip = node.chainstate.tip()
+    return {
+        "blocks": tip.height,
+        "difficulty": _difficulty(tip.header.bits, node.params),
+        "networkhashps": getnetworkhashps(node, []),
+        "hashespersec": getattr(node, "miner_hashes_per_sec", 0),
+        "pooledtx": node.mempool.size(),
+        "chain": node.params.network,
+        "warnings": "",
+    }
+
+
+def getnetworkhashps(node, params: List[Any]):
+    """ref rpc/mining.cpp GetNetworkHashPS."""
+    lookup = int(params[0]) if params else 120
+    cs = node.chainstate
+    tip = cs.tip()
+    if tip is None or tip.height == 0:
+        return 0
+    lookup = min(lookup, tip.height)
+    first = tip.get_ancestor(tip.height - lookup)
+    time_diff = max(tip.time - first.time, 1)
+    work_diff = tip.chain_work - first.chain_work
+    return work_diff / time_diff
+
+
+def prioritisetransaction(node, params: List[Any]):
+    # fee-delta bookkeeping (ref mining.cpp prioritisetransaction)
+    txid = u256_from_hex(str(params[0]))
+    delta = int(params[2] if len(params) > 2 else params[1])
+    e = node.mempool.get(txid)
+    if e is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool")
+    e.fee += delta
+    e.fees_with_ancestors += delta
+    e.fees_with_descendants += delta
+    return True
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("generatetoaddress", generatetoaddress, ["nblocks", "address", "maxtries"]),
+        ("generatetoaddresstpu", generatetoaddress_tpu, ["nblocks", "address"]),
+        ("getblocktemplate", getblocktemplate, ["template_request"]),
+        ("submitblock", submitblock, ["hexdata"]),
+        ("getmininginfo", getmininginfo, []),
+        ("getnetworkhashps", getnetworkhashps, ["nblocks", "height"]),
+        ("prioritisetransaction", prioritisetransaction, ["txid", "dummy", "fee_delta"]),
+    ]:
+        table.register("mining", name, fn, args)

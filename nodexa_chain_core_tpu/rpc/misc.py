@@ -1,0 +1,133 @@
+"""Misc/control/net RPC families (parity: reference src/rpc/misc.cpp,
+src/rpc/net.cpp)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from .. import __version__
+from ..core.amount import COIN
+from ..script.standard import decode_destination, KeyID, ScriptID
+from .server import RPC_INVALID_PARAMETER, RPCError, RPCTable
+
+
+def getinfo(node, params: List[Any]):
+    tip = node.chainstate.tip()
+    from .blockchain import _difficulty
+
+    return {
+        "version": __version__,
+        "protocolversion": 70028,
+        "blocks": tip.height,
+        "timeoffset": 0,
+        "connections": node.connman.connection_count() if node.connman else 0,
+        "difficulty": _difficulty(tip.header.bits, node.params),
+        "testnet": node.params.network == "test",
+        "chain": node.params.network,
+        "relayfee": 0.00001,
+        "warnings": "",
+    }
+
+
+def validateaddress(node, params: List[Any]):
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "address required")
+    addr = str(params[0])
+    try:
+        dest = decode_destination(addr, node.params)
+    except ValueError:
+        return {"isvalid": False}
+    return {
+        "isvalid": True,
+        "address": addr,
+        "scriptPubKey": __import__(
+            "nodexa_chain_core_tpu.script.standard", fromlist=["script_for_destination"]
+        ).script_for_destination(dest).raw.hex(),
+        "isscript": isinstance(dest, ScriptID),
+    }
+
+
+def uptime(node, params: List[Any]):
+    return node.uptime()
+
+
+def stop(node, params: List[Any]):
+    node.request_stop()
+    return "Nodexa server stopping"
+
+
+def help_cmd(node, params: List[Any]):
+    from .register import g_rpc_table
+
+    return g_rpc_table.help_text(str(params[0]) if params else None)
+
+
+def getnetworkinfo(node, params: List[Any]):
+    return {
+        "version": __version__,
+        "subversion": f"/NodexaTPU:{__version__}/",
+        "protocolversion": 70028,
+        "localservices": "0000000000000005",
+        "localrelay": True,
+        "timeoffset": 0,
+        "networkactive": node.connman is not None,
+        "connections": node.connman.connection_count() if node.connman else 0,
+        "networks": [],
+        "relayfee": 0.00001,
+        "warnings": "",
+    }
+
+
+def getpeerinfo(node, params: List[Any]):
+    if node.connman is None:
+        return []
+    return node.connman.peer_info()
+
+
+def getconnectioncount(node, params: List[Any]):
+    return node.connman.connection_count() if node.connman else 0
+
+
+def addnode(node, params: List[Any]):
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    addr = str(params[0])
+    command = str(params[1]) if len(params) > 1 else "add"
+    if command in ("add", "onetry"):
+        node.connman.connect_to(addr)
+    elif command == "remove":
+        node.connman.disconnect(addr)
+    return None
+
+
+def setban(node, params: List[Any]):
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    addr = str(params[0])
+    command = str(params[1]) if len(params) > 1 else "add"
+    if command == "add":
+        node.connman.ban(addr)
+    else:
+        node.connman.unban(addr)
+    return None
+
+
+def listbanned(node, params: List[Any]):
+    return node.connman.list_banned() if node.connman else []
+
+
+def register(table: RPCTable) -> None:
+    for cat, name, fn, args in [
+        ("control", "getinfo", getinfo, []),
+        ("control", "help", help_cmd, ["command"]),
+        ("control", "stop", stop, []),
+        ("control", "uptime", uptime, []),
+        ("util", "validateaddress", validateaddress, ["address"]),
+        ("network", "getnetworkinfo", getnetworkinfo, []),
+        ("network", "getpeerinfo", getpeerinfo, []),
+        ("network", "getconnectioncount", getconnectioncount, []),
+        ("network", "addnode", addnode, ["node", "command"]),
+        ("network", "setban", setban, ["subnet", "command"]),
+        ("network", "listbanned", listbanned, []),
+    ]:
+        table.register(cat, name, fn, args)

@@ -1,0 +1,155 @@
+"""Raw transaction RPC family (parity: reference src/rpc/rawtransaction.cpp)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+from ..core.amount import COIN
+from ..core.serialize import ByteReader
+from ..core.uint256 import u256_from_hex, u256_hex
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+from ..script.script import Script
+from ..script.sign import KeyStore, SigningError, sign_tx_input
+from ..script.standard import decode_destination, script_for_destination
+from .blockchain import tx_to_json
+from .server import (
+    RPC_DESERIALIZATION_ERROR,
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_VERIFY_REJECTED,
+    RPCError,
+    RPCTable,
+)
+
+
+def _parse_tx(hexstr: str) -> Transaction:
+    try:
+        return Transaction.from_bytes(bytes.fromhex(hexstr))
+    except Exception as e:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, f"TX decode failed: {e}")
+
+
+def createrawtransaction(node, params: List[Any]):
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "inputs and outputs required")
+    inputs, outputs = params[0], params[1]
+    locktime = int(params[2]) if len(params) > 2 else 0
+    vin = []
+    for inp in inputs:
+        txid = u256_from_hex(inp["txid"])
+        seq = inp.get("sequence", 0xFFFFFFFF if locktime == 0 else 0xFFFFFFFE)
+        vin.append(TxIn(prevout=OutPoint(txid, int(inp["vout"])), sequence=seq))
+    vout = []
+    for addr, amount in outputs.items():
+        if addr == "data":
+            from ..script.standard import nulldata_script
+
+            vout.append(TxOut(0, nulldata_script(bytes.fromhex(amount)).raw))
+            continue
+        try:
+            dest = decode_destination(addr, node.params)
+        except ValueError as e:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+        value = int(round(float(amount) * COIN))
+        vout.append(TxOut(value, script_for_destination(dest).raw))
+    tx = Transaction(version=2, vin=vin, vout=vout, locktime=locktime)
+    return tx.to_bytes().hex()
+
+
+def decoderawtransaction(node, params: List[Any]):
+    return tx_to_json(node, _parse_tx(str(params[0])))
+
+
+def sendrawtransaction(node, params: List[Any]):
+    tx = _parse_tx(str(params[0]))
+    allow_high_fees = bool(params[1]) if len(params) > 1 else False
+    try:
+        accept_to_memory_pool(node.chainstate, node.mempool, tx)
+    except MempoolAcceptError as e:
+        raise RPCError(RPC_VERIFY_REJECTED, f"{e.code} {e.reason}".strip())
+    if node.connman is not None:
+        node.connman.relay_transaction(tx)
+    return tx.txid_hex
+
+
+def getrawtransaction(node, params: List[Any]):
+    txid = u256_from_hex(str(params[0]))
+    verbose = bool(params[1]) if len(params) > 1 else False
+    tx = node.mempool.get_tx(txid)
+    height = None
+    if tx is None:
+        # scan the active chain (the reference needs -txindex for this; we
+        # walk blocks which is acceptable at this framework's scale)
+        cs = node.chainstate
+        for idx in cs.active:
+            block = cs.read_block(idx)
+            for cand in block.vtx:
+                if cand.txid == txid:
+                    tx = cand
+                    height = idx.height
+                    break
+            if tx is not None:
+                break
+    if tx is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY,
+            "No such mempool or blockchain transaction",
+        )
+    if not verbose:
+        return tx.to_bytes().hex()
+    out = tx_to_json(node, tx)
+    if height is not None:
+        out["height"] = height
+        out["confirmations"] = node.chainstate.tip().height - height + 1
+    return out
+
+
+def signrawtransaction(node, params: List[Any]):
+    """Signs with provided WIF keys (ref signrawtransaction's privkeys arg)
+    or the node wallet when attached."""
+    tx = _parse_tx(str(params[0]))
+    privkeys = params[2] if len(params) > 2 and params[2] else []
+    ks = KeyStore()
+    if node.wallet is not None:
+        for kid, priv in node.wallet.keystore.keys().items():
+            ks.add_key(priv)
+    from ..wallet.keys import wif_decode
+
+    for wif in privkeys:
+        priv, compressed = wif_decode(wif, node.params)
+        ks.add_key(priv, compressed)
+    errors = []
+    complete = True
+    for i, txin in enumerate(tx.vin):
+        coin = node.chainstate.coins.get_coin(txin.prevout)
+        if coin is None:
+            mem_tx = node.mempool.get_tx(txin.prevout.txid)
+            if mem_tx is not None and txin.prevout.n < len(mem_tx.vout):
+                from ..chain.coins import Coin
+
+                coin = Coin(mem_tx.vout[txin.prevout.n], 0, False)
+        if coin is None:
+            errors.append({"vout": i, "error": "input not found"})
+            complete = False
+            continue
+        try:
+            sign_tx_input(ks, tx, i, Script(coin.out.script_pubkey))
+        except SigningError as e:
+            errors.append({"vout": i, "error": str(e)})
+            complete = False
+    out = {"hex": tx.to_bytes().hex(), "complete": complete}
+    if errors:
+        out["errors"] = errors
+    return out
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("createrawtransaction", createrawtransaction, ["inputs", "outputs", "locktime"]),
+        ("decoderawtransaction", decoderawtransaction, ["hexstring"]),
+        ("sendrawtransaction", sendrawtransaction, ["hexstring", "allowhighfees"]),
+        ("getrawtransaction", getrawtransaction, ["txid", "verbose"]),
+        ("signrawtransaction", signrawtransaction, ["hexstring", "prevtxs", "privkeys"]),
+    ]:
+        table.register("rawtransactions", name, fn, args)

@@ -1,0 +1,30 @@
+"""RPC registration fan-out (parity: reference src/rpc/register.h:32
+RegisterAllCoreRPCCommands -> blockchain/net/misc/mining/rawtx/assets/
+messages/rewards)."""
+
+from __future__ import annotations
+
+from .server import RPCTable, g_rpc_table
+
+
+def register_all(table: RPCTable = g_rpc_table) -> RPCTable:
+    from . import blockchain, mining, misc, rawtransaction
+
+    blockchain.register(table)
+    mining.register(table)
+    misc.register(table)
+    rawtransaction.register(table)
+    # optional families attach when their subsystems exist
+    try:
+        from . import assets as assets_rpc
+
+        assets_rpc.register(table)
+    except ImportError:
+        pass
+    try:
+        from . import wallet as wallet_rpc
+
+        wallet_rpc.register(table)
+    except ImportError:
+        pass
+    return table

@@ -1,0 +1,228 @@
+"""JSON-RPC server (parity: reference src/rpc/server.{h,cpp} CRPCTable +
+src/httpserver.{h,cpp} libevent HTTP with bounded worker queue +
+src/httprpc.cpp auth/dispatch).
+
+Python build: ThreadingHTTPServer (one thread per connection, bounded by a
+semaphore to mirror the reference's WorkQueue depth), Basic auth against
+rpcuser/rpcpassword or an auto-generated ``.cookie`` (ref httprpc.cpp).
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import os
+import secrets
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import LogFlags, log_print, log_printf
+
+# JSON-RPC error codes (ref src/rpc/protocol.h)
+RPC_INVALID_REQUEST = -32600
+RPC_METHOD_NOT_FOUND = -32601
+RPC_INVALID_PARAMS = -32602
+RPC_INTERNAL_ERROR = -32603
+RPC_PARSE_ERROR = -32700
+RPC_MISC_ERROR = -1
+RPC_TYPE_ERROR = -3
+RPC_INVALID_ADDRESS_OR_KEY = -5
+RPC_OUT_OF_MEMORY = -7
+RPC_INVALID_PARAMETER = -8
+RPC_DATABASE_ERROR = -20
+RPC_DESERIALIZATION_ERROR = -22
+RPC_VERIFY_ERROR = -25
+RPC_VERIFY_REJECTED = -26
+RPC_VERIFY_ALREADY_IN_CHAIN = -27
+RPC_IN_WARMUP = -28
+RPC_METHOD_DEPRECATED = -32
+RPC_WALLET_ERROR = -4
+RPC_WALLET_INSUFFICIENT_FUNDS = -6
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class RPCCommand:
+    def __init__(self, category: str, name: str, fn: Callable, args: List[str]):
+        self.category = category
+        self.name = name
+        self.fn = fn
+        self.args = args
+
+
+class RPCTable:
+    """ref rpc/server.cpp CRPCTable; execute at :560."""
+
+    def __init__(self) -> None:
+        self._commands: Dict[str, RPCCommand] = {}
+        self.warmup: Optional[str] = "RPC in warmup"
+
+    def register(self, category: str, name: str, fn: Callable, args: List[str]) -> None:
+        self._commands[name] = RPCCommand(category, name, fn, args)
+
+    def commands(self) -> Dict[str, RPCCommand]:
+        return dict(self._commands)
+
+    def set_warmup_finished(self) -> None:
+        self.warmup = None
+
+    def execute(self, node, method: str, params: List[Any]) -> Any:
+        cmd = self._commands.get(method)
+        if cmd is None:
+            raise RPCError(RPC_METHOD_NOT_FOUND, f"Method not found: {method}")
+        if self.warmup is not None and method not in ("help", "stop", "uptime"):
+            raise RPCError(RPC_IN_WARMUP, self.warmup)
+        return cmd.fn(node, params)
+
+    def help_text(self, topic: Optional[str] = None) -> str:
+        if topic:
+            cmd = self._commands.get(topic)
+            if cmd is None:
+                raise RPCError(RPC_MISC_ERROR, f"help: unknown command: {topic}")
+            return f"{cmd.name} {' '.join(cmd.args)}"
+        by_cat: Dict[str, List[str]] = {}
+        for cmd in self._commands.values():
+            by_cat.setdefault(cmd.category, []).append(cmd.name)
+        out = []
+        for cat in sorted(by_cat):
+            out.append(f"== {cat.capitalize()} ==")
+            out.extend(sorted(by_cat[cat]))
+            out.append("")
+        return "\n".join(out)
+
+
+def generate_auth_cookie(datadir: str) -> Tuple[str, str]:
+    """ref httprpc.cpp GenerateAuthCookie."""
+    user = "__cookie__"
+    password = secrets.token_hex(32)
+    os.makedirs(datadir, exist_ok=True)
+    with open(os.path.join(datadir, ".cookie"), "w") as f:
+        f.write(f"{user}:{password}")
+    return user, password
+
+
+class HTTPRPCServer:
+    def __init__(
+        self,
+        node,
+        table: RPCTable,
+        host: str = "127.0.0.1",
+        port: int = 8766,
+        user: Optional[str] = None,
+        password: Optional[str] = None,
+        max_concurrent: int = 16,
+    ):
+        self.node = node
+        self.table = table
+        self.host = host
+        self.port = port
+        if user is None or password is None:
+            user, password = generate_auth_cookie(node.datadir or ".")
+        self._auth = base64.b64encode(f"{user}:{password}".encode()).decode()
+        self._sem = threading.BoundedSemaphore(max_concurrent)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route into our logger
+                log_print(LogFlags.HTTP, "http: " + fmt, *args)
+
+            def _reply(self, code: int, payload: dict | list | str) -> None:
+                body = (
+                    json.dumps(payload) if not isinstance(payload, str) else payload
+                ).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _check_auth(self) -> bool:
+                hdr = self.headers.get("Authorization", "")
+                if not hdr.startswith("Basic "):
+                    return False
+                return hmac.compare_digest(hdr[6:], server._auth)
+
+            def do_POST(self):
+                if not self._check_auth():
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", 'Basic realm="jsonrpc"')
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    raw = self.rfile.read(length)
+                    req = json.loads(raw)
+                except (ValueError, json.JSONDecodeError):
+                    self._reply(500, _error_envelope(None, RPC_PARSE_ERROR, "Parse error"))
+                    return
+                with server._sem:
+                    if isinstance(req, list):
+                        out = [server._handle_one(r) for r in req]
+                        self._reply(200, out)
+                    else:
+                        resp = server._handle_one(req)
+                        code = 200 if resp.get("error") is None else 500
+                        self._reply(code, resp)
+
+            def do_GET(self):
+                # REST interface plugs in here (ref src/rest.cpp)
+                handler = getattr(server.node, "rest_handler", None)
+                if handler is None:
+                    self._reply(404, {"error": "REST disabled"})
+                    return
+                code, payload = handler(self.path)
+                self._reply(code, payload)
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="httprpc", daemon=True
+        )
+        self._thread.start()
+        log_printf("HTTP RPC server listening on %s:%d", self.host, self.port)
+
+    def _handle_one(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or []
+        if not isinstance(method, str):
+            return _error_envelope(rid, RPC_INVALID_REQUEST, "Missing method")
+        try:
+            result = self.table.execute(self.node, method, params)
+            return {"result": result, "error": None, "id": rid}
+        except RPCError as e:
+            return _error_envelope(rid, e.code, e.message)
+        except Exception as e:  # noqa: BLE001 — RPC boundary
+            log_printf("rpc internal error in %s: %r", method, e)
+            return _error_envelope(rid, RPC_INTERNAL_ERROR, str(e))
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _error_envelope(rid, code: int, message: str) -> dict:
+    return {"result": None, "error": {"code": code, "message": message}, "id": rid}
+
+
+g_rpc_table = RPCTable()

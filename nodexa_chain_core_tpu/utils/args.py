@@ -1,0 +1,106 @@
+"""Config/flag management (parity: reference src/util.h:225 ArgsManager).
+
+``-key=value`` command-line flags layered over a ``nodexa.conf`` config file
+(ReadConfigFile, util.h:234), with typed getters and soft-set interaction
+defaults (SoftSetArg, :286) and per-network sections.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+
+class ArgsManager:
+    def __init__(self) -> None:
+        self._args: Dict[str, List[str]] = {}
+        self._config: Dict[str, List[str]] = {}
+
+    # -- parsing -----------------------------------------------------------
+
+    def parse_parameters(self, argv: List[str]) -> None:
+        for arg in argv:
+            if not arg.startswith("-"):
+                raise ValueError(f"invalid parameter {arg!r}")
+            body = arg.lstrip("-")
+            if "=" in body:
+                key, val = body.split("=", 1)
+            else:
+                key, val = body, "1"
+            self._args.setdefault(key, []).append(val)
+
+    def read_config_file(self, path: Optional[str] = None) -> None:
+        if path is None:
+            path = os.path.join(self.datadir(), "nodexa.conf")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                key, val = line.split("=", 1)
+                self._config.setdefault(key.strip(), []).append(val.strip())
+
+    # -- getters -----------------------------------------------------------
+
+    def _lookup(self, key: str) -> Optional[List[str]]:
+        key = key.lstrip("-")
+        return self._args.get(key) or self._config.get(key)
+
+    def is_set(self, key: str) -> bool:
+        return self._lookup(key) is not None
+
+    def get(self, key: str, default: str = "") -> str:
+        vals = self._lookup(key)
+        return vals[0] if vals else default
+
+    def get_all(self, key: str) -> List[str]:
+        return list(self._lookup(key) or [])
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        vals = self._lookup(key)
+        if not vals:
+            return default
+        try:
+            return int(vals[0], 0)
+        except ValueError:
+            return default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        vals = self._lookup(key)
+        if not vals:
+            return default
+        v = vals[0].lower()
+        return v not in ("0", "false", "no", "")
+
+    def soft_set(self, key: str, value: str) -> bool:
+        """Set only if unset (ref SoftSetArg)."""
+        key = key.lstrip("-")
+        if self.is_set(key):
+            return False
+        self._args[key] = [value]
+        return True
+
+    def force_set(self, key: str, value: str) -> None:
+        self._args[key.lstrip("-")] = [value]
+
+    # -- well-known paths --------------------------------------------------
+
+    def network(self) -> str:
+        if self.get_bool("regtest"):
+            return "regtest"
+        if self.get_bool("testnet"):
+            return "test"
+        return "main"
+
+    def datadir(self) -> str:
+        base = self.get("datadir") or os.path.expanduser("~/.nodexa")
+        net = self.network()
+        if net == "main":
+            return base
+        sub = {"test": "testnet", "regtest": "regtest"}[net]
+        return os.path.join(base, sub)
+
+
+g_args = ArgsManager()

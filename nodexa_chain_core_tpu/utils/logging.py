@@ -1,0 +1,90 @@
+"""Category logging (parity: reference src/util.h:86-105 BCLog bitflags +
+LogPrint/LogPrintf into debug.log with rotation)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import threading
+from enum import IntFlag
+from typing import Optional
+
+
+class LogFlags(IntFlag):
+    NONE = 0
+    NET = 1 << 0
+    MEMPOOL = 1 << 2
+    HTTP = 1 << 3
+    BENCH = 1 << 4
+    ZMQ = 1 << 5
+    DB = 1 << 6
+    RPC = 1 << 7
+    ADDRMAN = 1 << 9
+    SELECTCOINS = 1 << 10
+    REINDEX = 1 << 11
+    CMPCTBLOCK = 1 << 12
+    RAND = 1 << 13
+    PRUNE = 1 << 14
+    PROXY = 1 << 15
+    MEMPOOLREJ = 1 << 16
+    LIBEVENT = 1 << 17
+    COINDB = 1 << 18
+    LEVELDB = 1 << 20
+    ASSETS = 1 << 21
+    VALIDATION = 1 << 22
+    MINING = 1 << 23
+    ALL = ~0
+
+
+_CATEGORY_NAMES = {
+    "net": LogFlags.NET, "mempool": LogFlags.MEMPOOL, "http": LogFlags.HTTP,
+    "bench": LogFlags.BENCH, "zmq": LogFlags.ZMQ, "db": LogFlags.DB,
+    "rpc": LogFlags.RPC, "addrman": LogFlags.ADDRMAN, "assets": LogFlags.ASSETS,
+    "validation": LogFlags.VALIDATION, "mining": LogFlags.MINING,
+    "coindb": LogFlags.COINDB, "all": LogFlags.ALL, "1": LogFlags.ALL,
+}
+
+
+class Logger:
+    def __init__(self) -> None:
+        self.categories = LogFlags.NONE
+        self.print_to_console = True
+        self.file: Optional[object] = None
+        self._lock = threading.Lock()
+
+    def open_debug_log(self, datadir: str) -> None:
+        os.makedirs(datadir, exist_ok=True)
+        self.file = open(os.path.join(datadir, "debug.log"), "a")
+
+    def enable_categories(self, spec: str) -> None:
+        for name in spec.split(","):
+            flag = _CATEGORY_NAMES.get(name.strip().lower())
+            if flag is not None:
+                self.categories |= flag
+
+    def will_log(self, category: LogFlags) -> bool:
+        return bool(self.categories & category)
+
+    def log(self, msg: str, category: LogFlags = LogFlags.NONE) -> None:
+        if category != LogFlags.NONE and not self.will_log(category):
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        line = f"{stamp} {msg}\n"
+        with self._lock:
+            if self.print_to_console:
+                sys.stderr.write(line)
+            if self.file is not None:
+                self.file.write(line)
+                self.file.flush()
+
+
+g_logger = Logger()
+
+
+def log_printf(fmt: str, *args) -> None:
+    g_logger.log(fmt % args if args else fmt)
+
+
+def log_print(category: LogFlags, fmt: str, *args) -> None:
+    g_logger.log(fmt % args if args else fmt, category)

@@ -1,0 +1,44 @@
+"""Key encoding: WIF, pubkeys (parity: reference src/base58.cpp
+CCloreSecret + src/key.{h,cpp} / pubkey.{h,cpp})."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Tuple
+
+from ..crypto import secp256k1 as ec
+from ..crypto.hashes import hash160
+from ..utils.base58 import b58check_decode, b58check_encode
+
+
+def generate_privkey() -> int:
+    while True:
+        d = int.from_bytes(secrets.token_bytes(32), "big")
+        if ec.is_valid_privkey(d):
+            return d
+
+
+def wif_encode(priv: int, params, compressed: bool = True) -> str:
+    payload = bytes([params.prefix_secret]) + priv.to_bytes(32, "big")
+    if compressed:
+        payload += b"\x01"
+    return b58check_encode(payload)
+
+
+def wif_decode(wif: str, params) -> Tuple[int, bool]:
+    payload = b58check_decode(wif)
+    if payload[0] != params.prefix_secret:
+        raise ValueError("WIF version byte mismatch")
+    if len(payload) == 34 and payload[-1] == 1:
+        return int.from_bytes(payload[1:33], "big"), True
+    if len(payload) == 33:
+        return int.from_bytes(payload[1:], "big"), False
+    raise ValueError("bad WIF length")
+
+
+def pubkey_of(priv: int, compressed: bool = True) -> bytes:
+    return ec.pubkey_serialize(ec.pubkey_create(priv), compressed)
+
+
+def keyid_of(priv: int, compressed: bool = True) -> bytes:
+    return hash160(pubkey_of(priv, compressed))

@@ -1,0 +1,177 @@
+"""Functional test framework (parity: reference
+test/functional/test_framework/test_framework.py: CloreTestFramework +
+TestNode — N real daemon processes on regtest, driven over JSON-RPC on
+localhost)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from typing import Any, List, Optional
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class RPCProxy:
+    """ref test_framework/authproxy.py."""
+
+    def __init__(self, host: str, port: int, user: str, password: str):
+        self.url = f"http://{host}:{port}/"
+        self._auth = base64.b64encode(f"{user}:{password}".encode()).decode()
+
+    def __getattr__(self, method: str):
+        def call(*params):
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(
+                    {"jsonrpc": "1.0", "id": "t", "method": method, "params": list(params)}
+                ).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": "Basic " + self._auth,
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    body = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+            if body.get("error"):
+                raise RPCFailure(body["error"])
+            return body["result"]
+
+        return call
+
+
+class RPCFailure(Exception):
+    def __init__(self, err: dict):
+        super().__init__(f"RPC error {err.get('code')}: {err.get('message')}")
+        self.code = err.get("code")
+
+
+class TestNode:
+    """ref test_framework/test_node.py TestNode."""
+
+    def __init__(self, i: int, basedir: str, extra_args: Optional[List[str]] = None):
+        self.index = i
+        self.datadir = os.path.join(basedir, f"node{i}")
+        os.makedirs(self.datadir, exist_ok=True)
+        self.p2p_port = free_port()
+        self.rpc_port = free_port()
+        self.extra_args = extra_args or []
+        self.proc: Optional[subprocess.Popen] = None
+        self.rpc: Optional[RPCProxy] = None
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [
+            sys.executable,
+            "-m",
+            "nodexa_chain_core_tpu.node.daemon",
+            "-regtest",
+            f"-datadir={self.datadir}",
+            f"-port={self.p2p_port}",
+            f"-rpcport={self.rpc_port}",
+            "-rpcuser=test",
+            "-rpcpassword=test",
+            "-disablewallet" if "-wallet" not in self.extra_args else "-wallet",
+        ] + [a for a in self.extra_args if a != "-wallet"]
+        self.proc = subprocess.Popen(
+            cmd,
+            stdout=open(os.path.join(self.datadir, "stdout.log"), "w"),
+            stderr=open(os.path.join(self.datadir, "stderr.log"), "w"),
+            env=env,
+            cwd=REPO_ROOT,
+        )
+        self.rpc = RPCProxy("127.0.0.1", self.rpc_port, "test", "test")
+        self.wait_for_rpc()
+
+    def wait_for_rpc(self, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"node{self.index} died: "
+                    + open(os.path.join(self.datadir, "stderr.log")).read()[-2000:]
+                )
+            try:
+                self.rpc.getblockcount()
+                return
+            except (OSError, RPCFailure):
+                time.sleep(0.25)
+        raise TimeoutError(f"node{self.index} RPC not up after {timeout}s")
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.rpc.stop()
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+        self.proc = None
+
+
+class TestFramework:
+    """ref test_framework.py CloreTestFramework."""
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, num_nodes: int = 1, extra_args=None):
+        self.num_nodes = num_nodes
+        self.extra_args = extra_args or [[] for _ in range(num_nodes)]
+        self.basedir = tempfile.mkdtemp(prefix="nodexa_func_")
+        self.nodes: List[TestNode] = []
+
+    def __enter__(self) -> "TestFramework":
+        for i in range(self.num_nodes):
+            node = TestNode(i, self.basedir, self.extra_args[i])
+            node.start()
+            self.nodes.append(node)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for node in self.nodes:
+            node.stop()
+        shutil.rmtree(self.basedir, ignore_errors=True)
+
+    def connect_nodes(self, a: int, b: int) -> None:
+        self.nodes[a].rpc.addnode(f"127.0.0.1:{self.nodes[b].p2p_port}", "add")
+
+    def sync_blocks(self, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            tips = {n.rpc.getbestblockhash() for n in self.nodes}
+            if len(tips) == 1:
+                return
+            time.sleep(0.25)
+        raise TimeoutError(f"block sync timed out: heights="
+                           f"{[n.rpc.getblockcount() for n in self.nodes]}")
+
+    def sync_mempools(self, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            pools = [frozenset(n.rpc.getrawmempool()) for n in self.nodes]
+            if all(p == pools[0] for p in pools):
+                return
+            time.sleep(0.25)
+        raise TimeoutError("mempool sync timed out")

@@ -1,0 +1,81 @@
+"""Functional: single-node mining + RPC surface (parity: reference
+test/functional/mining_basic.py — the §7.2 'minimum end-to-end slice'
+acceptance test)."""
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+# a regtest P2PKH address for key 0x01 (prefix 111)
+from nodexa_chain_core_tpu.crypto.hashes import hash160
+from nodexa_chain_core_tpu.crypto.secp256k1 import pubkey_create, pubkey_serialize
+from nodexa_chain_core_tpu.utils.base58 import b58check_encode
+
+ADDR = b58check_encode(
+    b"\x6f" + hash160(pubkey_serialize(pubkey_create(1), True))
+)
+
+
+@pytest.mark.functional
+def test_mining_and_rpc_surface():
+    with TestFramework(num_nodes=1) as f:
+        rpc = f.nodes[0].rpc
+        assert rpc.getblockcount() == 0
+        info = rpc.getblockchaininfo()
+        assert info["chain"] == "regtest"
+
+        hashes = rpc.generatetoaddress(5, ADDR)
+        assert len(hashes) == 5
+        assert rpc.getblockcount() == 5
+        assert rpc.getbestblockhash() == hashes[-1]
+
+        # block introspection
+        blk = rpc.getblock(hashes[0])
+        assert blk["height"] == 1
+        assert blk["confirmations"] == 5
+        header = rpc.getblockheader(hashes[0])
+        assert header["height"] == 1
+        assert rpc.getblockhash(3) == hashes[2]
+
+        # mempool + difficulty + mining info
+        assert rpc.getmempoolinfo()["size"] == 0
+        assert rpc.getdifficulty() > 0
+        mi = rpc.getmininginfo()
+        assert mi["blocks"] == 5
+
+        # template
+        tmpl = rpc.getblocktemplate()
+        assert tmpl["height"] == 6
+        assert tmpl["previousblockhash"] == hashes[-1]
+
+        # tx lookup of a coinbase
+        txid = blk["tx"][0]
+        raw = rpc.getrawtransaction(txid, True)
+        assert raw["txid"] == txid
+        assert raw["confirmations"] == 5
+
+        # error paths
+        with pytest.raises(RPCFailure):
+            rpc.getblockhash(99)
+        with pytest.raises(RPCFailure):
+            rpc.getblock("ff" * 32)
+        with pytest.raises(RPCFailure):
+            rpc.nosuchmethod()
+
+        # utility commands
+        assert rpc.validateaddress(ADDR)["isvalid"]
+        assert not rpc.validateaddress("notanaddress")["isvalid"]
+        assert rpc.uptime() >= 0
+        assert "getblockcount" in rpc.help()
+
+
+@pytest.mark.functional
+def test_restart_persists_chain():
+    with TestFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        node.rpc.generatetoaddress(3, ADDR)
+        best = node.rpc.getbestblockhash()
+        node.stop()
+        node.start()
+        assert node.rpc.getblockcount() == 3
+        assert node.rpc.getbestblockhash() == best
