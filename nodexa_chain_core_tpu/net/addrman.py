@@ -1,0 +1,149 @@
+"""Stochastic address manager (parity: reference src/addrman.h:185 CAddrMan
++ peers.dat persistence via src/addrdb.*).
+
+Tried/new bucket structure with hash-based placement and random eviction —
+the eclipse-resistance design of the reference, sized down (64 new / 16
+tried buckets of 64 slots) for this implementation's scale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.hashes import siphash
+
+NEW_BUCKETS = 64
+TRIED_BUCKETS = 16
+BUCKET_SIZE = 64
+
+
+@dataclass
+class AddrInfo:
+    ip: str
+    port: int
+    services: int = 1
+    last_try: int = 0
+    last_success: int = 0
+    attempts: int = 0
+    in_tried: bool = False
+    source: str = ""
+
+    def key(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+class AddrMan:
+    def __init__(self, key: Optional[int] = None):
+        self._key = key if key is not None else random.getrandbits(64)
+        self._addrs: Dict[str, AddrInfo] = {}
+        self._new: List[List[Optional[str]]] = [
+            [None] * BUCKET_SIZE for _ in range(NEW_BUCKETS)
+        ]
+        self._tried: List[List[Optional[str]]] = [
+            [None] * BUCKET_SIZE for _ in range(TRIED_BUCKETS)
+        ]
+
+    def _bucket(self, key: str, tried: bool, source: str = "") -> Tuple[int, int]:
+        h = siphash(self._key, 0x1337 if tried else 0x7331, (key + source).encode())
+        nbuckets = TRIED_BUCKETS if tried else NEW_BUCKETS
+        return (h % nbuckets, (h >> 16) % BUCKET_SIZE)
+
+    # -- mutation ---------------------------------------------------------
+
+    def add(self, ip: str, port: int, services: int = 1, source: str = "") -> bool:
+        """ref CAddrMan::Add."""
+        info = AddrInfo(ip=ip, port=port, services=services, source=source)
+        key = info.key()
+        if key in self._addrs:
+            return False
+        b, slot = self._bucket(key, tried=False, source=source)
+        evicted = self._new[b][slot]
+        if evicted is not None and evicted in self._addrs:
+            if not self._addrs[evicted].in_tried:
+                del self._addrs[evicted]
+        self._new[b][slot] = key
+        self._addrs[key] = info
+        return True
+
+    def good(self, ip: str, port: int) -> None:
+        """Move to tried on successful handshake (ref CAddrMan::Good)."""
+        key = f"{ip}:{port}"
+        info = self._addrs.get(key)
+        if info is None:
+            self.add(ip, port)
+            info = self._addrs.get(key)
+            if info is None:
+                return
+        info.last_success = int(time.time())
+        info.attempts = 0
+        if info.in_tried:
+            return
+        b, slot = self._bucket(key, tried=True)
+        evicted = self._tried[b][slot]
+        if evicted is not None and evicted in self._addrs:
+            # evicted tried entry goes back to new (ref test-before-evict
+            # simplified)
+            self._addrs[evicted].in_tried = False
+            nb, ns = self._bucket(evicted, tried=False)
+            self._new[nb][ns] = evicted
+        self._tried[b][slot] = key
+        info.in_tried = True
+
+    def attempt(self, ip: str, port: int) -> None:
+        info = self._addrs.get(f"{ip}:{port}")
+        if info:
+            info.last_try = int(time.time())
+            info.attempts += 1
+
+    # -- selection --------------------------------------------------------
+
+    def select(self, new_only: bool = False) -> Optional[AddrInfo]:
+        """ref CAddrMan::Select: biased coin-flip between tried/new."""
+        candidates: List[str]
+        use_tried = not new_only and random.random() < 0.5
+        table = self._tried if use_tried else self._new
+        candidates = [k for bucket in table for k in bucket if k is not None]
+        if not candidates:
+            table = self._new if use_tried else self._tried
+            candidates = [k for bucket in table for k in bucket if k is not None]
+        if not candidates:
+            return None
+        return self._addrs.get(random.choice(candidates))
+
+    def get_addresses(self, max_count: int = 1000) -> List[AddrInfo]:
+        out = list(self._addrs.values())
+        random.shuffle(out)
+        return out[:max_count]
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    # -- persistence (ref addrdb peers.dat) --------------------------------
+
+    def save(self, path: str) -> None:
+        data = {
+            "key": self._key,
+            "addrs": [vars(a) for a in self._addrs.values()],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "AddrMan":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        am = cls(key=data.get("key"))
+        for a in data.get("addrs", []):
+            am.add(a["ip"], a["port"], a.get("services", 1), a.get("source", ""))
+            if a.get("in_tried"):
+                am.good(a["ip"], a["port"])
+        return am

@@ -1,0 +1,305 @@
+"""Connection manager (parity: reference src/net.{h,cpp} CConnman).
+
+The reference runs 5 threads (socket handler, open-connections, dns-seed,
+message handler, addr-seed; ref net.cpp:2398-2415).  Here: an accept thread,
+one reader thread per peer feeding a single inbound queue, and one message
+handler thread (ThreadMessageHandler analogue) driving
+:mod:`.net_processing` — same topology, Python-threaded.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import LogFlags, log_print, log_printf
+from . import protocol
+from .addrman import AddrMan
+
+
+class Peer:
+    """ref net.h:604 CNode."""
+
+    _next_id = 0
+
+    def __init__(self, sock: socket.socket, addr: Tuple[str, int], inbound: bool):
+        Peer._next_id += 1
+        self.id = Peer._next_id
+        self.sock = sock
+        self.ip, self.port = addr[0], addr[1]
+        self.inbound = inbound
+        self.connected_at = time.time()
+        self.version = 0
+        self.services = 0
+        self.user_agent = ""
+        self.start_height = -1
+        self.handshake_done = False
+        self.verack_received = False
+        self.disconnect = False
+        self.misbehavior = 0
+        self.last_ping_nonce = 0
+        self.ping_time_ms: Optional[float] = None
+        self.last_send = 0.0
+        self.last_recv = 0.0
+        # relay state (ref net_processing's CNodeState)
+        self.known_txs: set = set()
+        self.known_blocks: set = set()
+        self.blocks_in_flight: set = set()
+        self.sync_started = False
+        self.prefer_headers = False
+        self._send_lock = threading.Lock()
+
+    def send_msg(self, magic: bytes, command: str, payload: bytes = b"") -> bool:
+        try:
+            data = protocol.pack_message(magic, command, payload)
+            with self._send_lock:
+                self.sock.sendall(data)
+            self.last_send = time.time()
+            return True
+        except OSError:
+            self.disconnect = True
+            return False
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ConnMan:
+    """ref net.h:120 CConnman; Start at net.cpp:2304."""
+
+    MAX_OUTBOUND = 8
+    MAX_CONNECTIONS = 125
+
+    def __init__(self, node, port: int = 0, listen: bool = True):
+        self.node = node
+        self.magic = node.params.message_start
+        self.port = port
+        self.listen = listen
+        self.peers: Dict[int, Peer] = {}
+        self._peers_lock = threading.Lock()
+        self.inbound_queue: "queue.Queue" = queue.Queue()
+        self.banned: Dict[str, float] = {}
+        self.addrman = AddrMan()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._listen_sock: Optional[socket.socket] = None
+        from .net_processing import NetProcessor
+
+        self.processor = NetProcessor(node, self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.node.datadir:
+            import os
+
+            self.addrman = AddrMan.load(os.path.join(self.node.datadir, "peers.json"))
+        if self.listen:
+            self._listen_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listen_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listen_sock.bind(("0.0.0.0", self.port))
+            self.port = self._listen_sock.getsockname()[1]
+            self._listen_sock.listen(16)
+            self._listen_sock.settimeout(0.5)
+            self._spawn(self._accept_loop, "net.accept")
+        self._spawn(self._message_handler_loop, "net.msghand")
+        self._spawn(self._maintenance_loop, "net.maint")
+        log_printf("P2P listening on port %d", self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listen_sock:
+            self._listen_sock.close()
+        with self._peers_lock:
+            for p in list(self.peers.values()):
+                p.close()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self.node.datadir:
+            import os
+
+            self.addrman.save(os.path.join(self.node.datadir, "peers.json"))
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- connections -------------------------------------------------------
+
+    def connect_to(self, addr: str) -> bool:
+        """Outbound connection (ref OpenNetworkConnection)."""
+        host, _, port_s = addr.partition(":")
+        port = int(port_s or self.node.params.default_port)
+        if self.is_banned(host):
+            return False
+        try:
+            sock = socket.create_connection((host, port), timeout=5)
+        except OSError as e:
+            log_print(LogFlags.NET, "connect to %s failed: %s", addr, e)
+            self.addrman.attempt(host, port)
+            return False
+        peer = Peer(sock, (host, port), inbound=False)
+        with self._peers_lock:
+            self.peers[peer.id] = peer
+        self._spawn(lambda: self._reader_loop(peer), f"net.peer{peer.id}")
+        self.processor.init_peer(peer)
+        self.addrman.attempt(host, port)
+        return True
+
+    def disconnect(self, addr: str) -> None:
+        with self._peers_lock:
+            for p in self.peers.values():
+                if f"{p.ip}:{p.port}" == addr or p.ip == addr:
+                    p.disconnect = True
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listen_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if self.is_banned(addr[0]) or len(self.peers) >= self.MAX_CONNECTIONS:
+                sock.close()
+                continue
+            peer = Peer(sock, addr, inbound=True)
+            with self._peers_lock:
+                self.peers[peer.id] = peer
+            self._spawn(lambda p=peer: self._reader_loop(p), f"net.peer{peer.id}")
+            log_print(LogFlags.NET, "accepted connection from %s:%d", *addr)
+
+    def _reader_loop(self, peer: Peer) -> None:
+        """Per-peer socket reader -> inbound queue (the recv side of the
+        reference's ThreadSocketHandler)."""
+        sock = peer.sock
+        sock.settimeout(0.5)
+        buf = b""
+        while not self._stop.is_set() and not peer.disconnect:
+            try:
+                chunk = sock.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while len(buf) >= 24:
+                try:
+                    command, length, checksum = protocol.unpack_header(
+                        self.magic, buf[:24]
+                    )
+                except protocol.ProtocolError as e:
+                    log_print(LogFlags.NET, "peer %d bad header: %s", peer.id, e)
+                    peer.disconnect = True
+                    break
+                if len(buf) < 24 + length:
+                    break
+                payload = buf[24 : 24 + length]
+                buf = buf[24 + length :]
+                if not protocol.verify_checksum(payload, checksum):
+                    self.processor.misbehaving(peer, 10, "bad-checksum")
+                    continue
+                peer.last_recv = time.time()
+                self.inbound_queue.put((peer, command, payload))
+        self._remove_peer(peer)
+
+    def _remove_peer(self, peer: Peer) -> None:
+        peer.close()
+        with self._peers_lock:
+            self.peers.pop(peer.id, None)
+        self.processor.finalize_peer(peer)
+
+    # -- processing --------------------------------------------------------
+
+    def _message_handler_loop(self) -> None:
+        """ref net.cpp:2026 ThreadMessageHandler ->
+        PeerLogicValidation::ProcessMessages."""
+        while not self._stop.is_set():
+            try:
+                peer, command, payload = self.inbound_queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if peer.disconnect:
+                continue
+            try:
+                self.processor.process_message(peer, command, payload)
+            except Exception as e:  # noqa: BLE001 — peer input is untrusted
+                log_printf("error processing %s from peer %d: %r", command, peer.id, e)
+                self.processor.misbehaving(peer, 10, "processing-error")
+            if peer.misbehavior >= 100:
+                self.ban(peer.ip)
+                peer.disconnect = True
+            if peer.disconnect:
+                self._remove_peer(peer)
+
+    def _maintenance_loop(self) -> None:
+        while not self._stop.is_set():
+            self.processor.send_pings()
+            time.sleep(5)
+
+    # -- bans (ref banlist.dat / CBanDB) ----------------------------------
+
+    def ban(self, ip: str, duration: float = 24 * 3600) -> None:
+        self.banned[ip] = time.time() + duration
+        log_printf("banned %s", ip)
+
+    def unban(self, ip: str) -> None:
+        self.banned.pop(ip, None)
+
+    def is_banned(self, ip: str) -> bool:
+        until = self.banned.get(ip)
+        if until is None:
+            return False
+        if until < time.time():
+            del self.banned[ip]
+            return False
+        return True
+
+    def list_banned(self) -> List[dict]:
+        return [
+            {"address": ip, "banned_until": int(t)} for ip, t in self.banned.items()
+        ]
+
+    # -- introspection / relay --------------------------------------------
+
+    def connection_count(self) -> int:
+        with self._peers_lock:
+            return len(self.peers)
+
+    def all_peers(self) -> List[Peer]:
+        with self._peers_lock:
+            return list(self.peers.values())
+
+    def peer_info(self) -> List[dict]:
+        out = []
+        for p in self.all_peers():
+            out.append(
+                {
+                    "id": p.id,
+                    "addr": f"{p.ip}:{p.port}",
+                    "inbound": p.inbound,
+                    "version": p.version,
+                    "subver": p.user_agent,
+                    "startingheight": p.start_height,
+                    "banscore": p.misbehavior,
+                    "conntime": int(p.connected_at),
+                    "pingtime": p.ping_time_ms,
+                }
+            )
+        return out
+
+    def relay_transaction(self, tx) -> None:
+        self.processor.relay_transaction(tx)
+
+    def relay_block_hash(self, block_hash: int) -> None:
+        self.processor.announce_block(block_hash)

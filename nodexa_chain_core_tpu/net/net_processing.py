@@ -1,0 +1,445 @@
+"""P2P protocol state machine (parity: reference src/net_processing.{h,cpp}
+— the ProcessMessage dispatcher at :1527-2986, DoS scoring `Misbehaving`
+(:744), headers-first block download, inv/getdata relay)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional
+
+from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+from ..chain.validation import BlockValidationError
+from ..core.serialize import ByteReader, ByteWriter
+from ..core.uint256 import u256_hex
+from ..primitives.block import Block, BlockHeader
+from ..primitives.transaction import Transaction
+from ..utils.logging import LogFlags, log_print, log_printf
+from . import protocol
+from .protocol import (
+    INV_BLOCK,
+    INV_TX,
+    Inv,
+    MSG_ADDR,
+    MSG_ASSETDATA,
+    MSG_ASSETNOTFOUND,
+    MSG_BLOCK,
+    MSG_FEEFILTER,
+    MSG_GETADDR,
+    MSG_GETASSETDATA,
+    MSG_GETBLOCKS,
+    MSG_GETDATA,
+    MSG_GETHEADERS,
+    MSG_HEADERS,
+    MSG_INV,
+    MSG_MEMPOOL,
+    MSG_NOTFOUND,
+    MSG_PING,
+    MSG_PONG,
+    MSG_REJECT,
+    MSG_SENDHEADERS,
+    MSG_TX,
+    MSG_VERACK,
+    MSG_VERSION,
+    MIN_PEER_PROTO_VERSION,
+    NetAddr,
+    PROTOCOL_VERSION,
+    VersionPayload,
+    BlockLocator,
+    make_locator,
+)
+
+MAX_HEADERS_RESULTS = 2000
+MAX_BLOCKS_IN_FLIGHT_PER_PEER = 16
+MAX_INV_SIZE = 50_000
+
+
+class NetProcessor:
+    """ref PeerLogicValidation (net_processing.cpp:2986)."""
+
+    def __init__(self, node, connman):
+        self.node = node
+        self.connman = connman
+        self.magic = node.params.message_start
+        self._local_nonce = random.getrandbits(64)
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def init_peer(self, peer) -> None:
+        """Outbound: we speak first (ref PushNodeVersion)."""
+        self._send_version(peer)
+
+    def finalize_peer(self, peer) -> None:
+        pass
+
+    def misbehaving(self, peer, score: int, reason: str) -> None:
+        """ref net_processing.cpp:744 Misbehaving."""
+        peer.misbehavior += score
+        log_print(
+            LogFlags.NET,
+            "peer %d misbehaving +%d (%s) -> %d",
+            peer.id, score, reason, peer.misbehavior,
+        )
+
+    def _send_version(self, peer) -> None:
+        v = VersionPayload(
+            version=PROTOCOL_VERSION,
+            timestamp=int(time.time()),
+            addr_recv=NetAddr(ip=peer.ip, port=peer.port),
+            nonce=self._local_nonce,
+            start_height=self.node.chainstate.tip().height,
+        )
+        w = ByteWriter()
+        v.serialize(w)
+        peer.send_msg(self.magic, MSG_VERSION, w.getvalue())
+
+    # -- dispatch ----------------------------------------------------------
+
+    def process_message(self, peer, command: str, payload: bytes) -> None:
+        """ref net_processing.cpp:1527 ProcessMessage."""
+        r = ByteReader(payload)
+        if command == MSG_VERSION:
+            self._on_version(peer, r)
+            return
+        if not peer.handshake_done and command != MSG_VERACK:
+            self.misbehaving(peer, 1, "non-version before handshake")
+            return
+        handler = {
+            MSG_VERACK: self._on_verack,
+            MSG_PING: self._on_ping,
+            MSG_PONG: self._on_pong,
+            MSG_INV: self._on_inv,
+            MSG_GETDATA: self._on_getdata,
+            MSG_GETHEADERS: self._on_getheaders,
+            MSG_HEADERS: self._on_headers,
+            MSG_BLOCK: self._on_block,
+            MSG_TX: self._on_tx,
+            MSG_MEMPOOL: self._on_mempool,
+            MSG_GETADDR: self._on_getaddr,
+            MSG_ADDR: self._on_addr,
+            MSG_SENDHEADERS: self._on_sendheaders,
+            MSG_FEEFILTER: self._on_feefilter,
+            MSG_GETASSETDATA: self._on_getassetdata,
+        }.get(command)
+        if handler is None:
+            log_print(LogFlags.NET, "ignoring unknown message %r", command)
+            return
+        handler(peer, r)
+
+    # -- handshake ---------------------------------------------------------
+
+    def _on_version(self, peer, r: ByteReader) -> None:
+        v = VersionPayload.deserialize(r)
+        if v.nonce == self._local_nonce:
+            peer.disconnect = True  # connected to self
+            return
+        if v.version < MIN_PEER_PROTO_VERSION:
+            peer.send_msg(self.magic, MSG_REJECT, b"obsolete")
+            peer.disconnect = True
+            return
+        peer.version = v.version
+        peer.services = v.services
+        peer.user_agent = v.user_agent
+        peer.start_height = v.start_height
+        if peer.inbound:
+            self._send_version(peer)
+        peer.send_msg(self.magic, MSG_VERACK)
+
+    def _on_verack(self, peer, r: ByteReader) -> None:
+        peer.verack_received = True
+        peer.handshake_done = True
+        self.connman.addrman.good(peer.ip, peer.port)
+        peer.send_msg(self.magic, MSG_SENDHEADERS)
+        self._start_sync(peer)
+
+    def _start_sync(self, peer) -> None:
+        """Headers-first initial sync (ref net_processing SendMessages)."""
+        if peer.sync_started:
+            return
+        peer.sync_started = True
+        self._send_getheaders(peer)
+
+    def _send_getheaders(self, peer) -> None:
+        w = ByteWriter()
+        make_locator(self.node.chainstate.active).serialize(w)
+        w.hash256(0)
+        peer.send_msg(self.magic, MSG_GETHEADERS, w.getvalue())
+
+    # -- keepalive ---------------------------------------------------------
+
+    def send_pings(self) -> None:
+        for peer in self.connman.all_peers():
+            if not peer.handshake_done:
+                continue
+            nonce = random.getrandbits(64)
+            peer.last_ping_nonce = nonce
+            peer._ping_sent = time.time()
+            w = ByteWriter()
+            w.u64(nonce)
+            peer.send_msg(self.magic, MSG_PING, w.getvalue())
+
+    def _on_ping(self, peer, r: ByteReader) -> None:
+        nonce = r.u64() if r.remaining() else 0
+        w = ByteWriter()
+        w.u64(nonce)
+        peer.send_msg(self.magic, MSG_PONG, w.getvalue())
+
+    def _on_pong(self, peer, r: ByteReader) -> None:
+        nonce = r.u64() if r.remaining() else 0
+        if nonce and nonce == peer.last_ping_nonce:
+            peer.ping_time_ms = (time.time() - getattr(peer, "_ping_sent", time.time())) * 1000
+
+    # -- inventory / relay -------------------------------------------------
+
+    def _on_inv(self, peer, r: ByteReader) -> None:
+        invs = r.vector(Inv.deserialize)
+        if len(invs) > MAX_INV_SIZE:
+            self.misbehaving(peer, 20, "oversized-inv")
+            return
+        want: List[Inv] = []
+        for inv in invs:
+            if inv.type == INV_TX:
+                peer.known_txs.add(inv.hash)
+                if not self.node.mempool.contains(inv.hash):
+                    want.append(inv)
+            elif inv.type == INV_BLOCK:
+                peer.known_blocks.add(inv.hash)
+                if self.node.chainstate.lookup(inv.hash) is None:
+                    # headers-first: learn about the chain before the block
+                    self._send_getheaders(peer)
+        if want:
+            w = ByteWriter()
+            w.vector(want, lambda wr, i: i.serialize(wr))
+            peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
+
+    def _on_getdata(self, peer, r: ByteReader) -> None:
+        invs = r.vector(Inv.deserialize)
+        if len(invs) > MAX_INV_SIZE:
+            self.misbehaving(peer, 20, "oversized-getdata")
+            return
+        notfound: List[Inv] = []
+        for inv in invs:
+            if inv.type == INV_TX:
+                tx = self.node.mempool.get_tx(inv.hash)
+                if tx is not None:
+                    peer.send_msg(self.magic, MSG_TX, tx.to_bytes())
+                else:
+                    notfound.append(inv)
+            elif inv.type in (INV_BLOCK,):
+                idx = self.node.chainstate.lookup(inv.hash)
+                if idx is not None and idx.status & 8:  # HAVE_DATA
+                    block = self.node.chainstate.read_block(idx)
+                    w = ByteWriter()
+                    block.serialize(w, self.node.params.algo_schedule)
+                    peer.send_msg(self.magic, MSG_BLOCK, w.getvalue())
+                else:
+                    notfound.append(inv)
+        if notfound:
+            w = ByteWriter()
+            w.vector(notfound, lambda wr, i: i.serialize(wr))
+            peer.send_msg(self.magic, MSG_NOTFOUND, w.getvalue())
+
+    # -- headers sync ------------------------------------------------------
+
+    def _on_getheaders(self, peer, r: ByteReader) -> None:
+        locator = BlockLocator.deserialize(r)
+        stop_hash = r.hash256()
+        cs = self.node.chainstate
+        start = None
+        for h in locator.have:
+            idx = cs.lookup(h)
+            if idx is not None and idx in cs.active:
+                start = idx
+                break
+        headers: List[BlockHeader] = []
+        idx = cs.active.next(start) if start else cs.active.at(0)
+        while idx is not None and len(headers) < MAX_HEADERS_RESULTS:
+            headers.append(idx.header)
+            if idx.block_hash == stop_hash:
+                break
+            idx = cs.active.next(idx)
+        w = ByteWriter()
+        w.compact_size(len(headers))
+        for h in headers:
+            h.serialize(w, self.node.params.algo_schedule)
+            w.compact_size(0)  # tx-count placeholder, as the wire format has
+        peer.send_msg(self.magic, MSG_HEADERS, w.getvalue())
+
+    def _on_headers(self, peer, r: ByteReader) -> None:
+        count = r.compact_size()
+        if count > MAX_HEADERS_RESULTS:
+            self.misbehaving(peer, 20, "too-many-headers")
+            return
+        headers: List[BlockHeader] = []
+        for _ in range(count):
+            h = BlockHeader.deserialize(r, self.node.params.algo_schedule)
+            r.compact_size()
+            headers.append(h)
+        if not headers:
+            return
+        cs = self.node.chainstate
+        try:
+            indexes = cs.process_new_block_headers(headers)
+        except BlockValidationError as e:
+            self.misbehaving(peer, 20, f"bad-headers:{e.code}")
+            return
+        # track the peer's most-work announced header (ref CNodeState::
+        # pindexBestKnownBlock) and pull missing data from it
+        for idx in indexes:
+            best = getattr(peer, "best_known_header", None)
+            if best is None or idx.chain_work >= best.chain_work:
+                peer.best_known_header = idx
+        self._request_missing_blocks(peer)
+        if count == MAX_HEADERS_RESULTS:
+            self._send_getheaders(peer)
+
+    def _request_missing_blocks(self, peer) -> None:
+        """ref FindNextBlocksToDownload: walk the best-known-header chain,
+        fetch ancestors lacking data, bounded by the in-flight window."""
+        best = getattr(peer, "best_known_header", None)
+        if best is None:
+            return
+        missing: List = []
+        walk = best
+        while walk is not None and not (walk.status & 8):
+            missing.append(walk)
+            walk = walk.prev
+        missing.reverse()
+        want: List[Inv] = []
+        for idx in missing:
+            if len(peer.blocks_in_flight) >= MAX_BLOCKS_IN_FLIGHT_PER_PEER:
+                break
+            if idx.block_hash in peer.blocks_in_flight:
+                continue
+            peer.blocks_in_flight.add(idx.block_hash)
+            want.append(Inv(INV_BLOCK, idx.block_hash))
+        if want:
+            w = ByteWriter()
+            w.vector(want, lambda wr, i: i.serialize(wr))
+            peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
+
+    # -- blocks / txs ------------------------------------------------------
+
+    def _on_block(self, peer, r: ByteReader) -> None:
+        block = Block.deserialize(r, self.node.params.algo_schedule)
+        h = block.get_hash()
+        peer.blocks_in_flight.discard(h)
+        peer.known_blocks.add(h)
+        cs = self.node.chainstate
+        old_tip = cs.tip().block_hash
+        try:
+            cs.process_new_block(block)
+        except BlockValidationError as e:
+            if e.code in ("prev-blk-not-found",):
+                self._send_getheaders(peer)
+                return
+            self.misbehaving(peer, 100, f"bad-block:{e.code}")
+            return
+        if cs.tip().block_hash != old_tip:
+            self.announce_block(cs.tip().block_hash)
+        # keep the download window full toward the peer's best header
+        self._request_missing_blocks(peer)
+
+    def _on_tx(self, peer, r: ByteReader) -> None:
+        tx = Transaction.deserialize(r)
+        peer.known_txs.add(tx.txid)
+        try:
+            accept_to_memory_pool(self.node.chainstate, self.node.mempool, tx)
+        except MempoolAcceptError as e:
+            if e.code in ("bad-txns-inputs-missingorspent",):
+                return  # orphan; the reference tracks these, we re-request later
+            if e.code in ("txn-already-in-mempool", "txn-mempool-conflict"):
+                return
+            self.misbehaving(peer, 10, f"bad-tx:{e.code}")
+            return
+        self.relay_transaction(tx, exclude=peer)
+
+    def _on_mempool(self, peer, r: ByteReader) -> None:
+        invs = [Inv(INV_TX, txid) for txid in self.node.mempool.txids()]
+        w = ByteWriter()
+        w.vector(invs, lambda wr, i: i.serialize(wr))
+        peer.send_msg(self.magic, MSG_INV, w.getvalue())
+
+    # -- addr gossip -------------------------------------------------------
+
+    def _on_getaddr(self, peer, r: ByteReader) -> None:
+        addrs = self.connman.addrman.get_addresses(1000)
+        w = ByteWriter()
+        w.compact_size(len(addrs))
+        for a in addrs:
+            NetAddr(services=a.services, ip=a.ip, port=a.port).serialize(w)
+        peer.send_msg(self.magic, MSG_ADDR, w.getvalue())
+
+    def _on_addr(self, peer, r: ByteReader) -> None:
+        count = r.compact_size()
+        if count > 1000:
+            self.misbehaving(peer, 20, "oversized-addr")
+            return
+        for _ in range(count):
+            a = NetAddr.deserialize(r)
+            self.connman.addrman.add(a.ip, a.port, a.services, source=peer.ip)
+
+    def _on_sendheaders(self, peer, r: ByteReader) -> None:
+        peer.prefer_headers = True
+
+    def _on_feefilter(self, peer, r: ByteReader) -> None:
+        peer.fee_filter = r.i64() if r.remaining() else 0
+
+    # -- asset data channel (ref GETASSETDATA/ASSETDATA, protocol.h:252) ---
+
+    def _on_getassetdata(self, peer, r: ByteReader) -> None:
+        names = r.vector(lambda rr: rr.var_str())
+        assets = getattr(self.node.chainstate, "assets", None)
+        found, missing = [], []
+        for name in names:
+            data = assets.get_asset(name) if assets else None
+            if data is None:
+                missing.append(name)
+            else:
+                found.append(data)
+        if found:
+            w = ByteWriter()
+            w.compact_size(len(found))
+            for a in found:
+                a.serialize_wire(w)
+            peer.send_msg(self.magic, MSG_ASSETDATA, w.getvalue())
+        if missing:
+            w = ByteWriter()
+            w.vector(missing, lambda wr, n: wr.var_str(n))
+            peer.send_msg(self.magic, MSG_ASSETNOTFOUND, w.getvalue())
+
+    # -- outbound relay ----------------------------------------------------
+
+    def relay_transaction(self, tx, exclude=None) -> None:
+        """ref RelayTransaction -> ForEachNode INV push."""
+        inv = Inv(INV_TX, tx.txid)
+        for peer in self.connman.all_peers():
+            if peer is exclude or not peer.handshake_done:
+                continue
+            if tx.txid in peer.known_txs:
+                continue
+            peer.known_txs.add(tx.txid)
+            w = ByteWriter()
+            w.vector([inv], lambda wr, i: i.serialize(wr))
+            peer.send_msg(self.magic, MSG_INV, w.getvalue())
+
+    def announce_block(self, block_hash: int) -> None:
+        """New-tip announcement: headers to sendheaders peers, inv otherwise."""
+        cs = self.node.chainstate
+        idx = cs.lookup(block_hash)
+        for peer in self.connman.all_peers():
+            if not peer.handshake_done or block_hash in peer.known_blocks:
+                continue
+            peer.known_blocks.add(block_hash)
+            if peer.prefer_headers and idx is not None:
+                w = ByteWriter()
+                w.compact_size(1)
+                idx.header.serialize(w, self.node.params.algo_schedule)
+                w.compact_size(0)
+                peer.send_msg(self.magic, MSG_HEADERS, w.getvalue())
+            else:
+                w = ByteWriter()
+                w.vector(
+                    [Inv(INV_BLOCK, block_hash)], lambda wr, i: i.serialize(wr)
+                )
+                peer.send_msg(self.magic, MSG_INV, w.getvalue())

@@ -1,0 +1,219 @@
+"""P2P wire protocol (parity: reference src/protocol.{h,cpp}).
+
+Message framing: 4-byte network magic, 12-byte zero-padded command, 4-byte
+length, 4-byte sha256d checksum (ref CMessageHeader, protocol.h:28).
+Protocol version 70028, minimum peer 70025 (ref version.h:13-33).  Includes
+the chain's asset data messages GETASSETDATA / ASSETDATA / ASSETNOTFOUND
+(ref protocol.h:252-266).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..crypto.hashes import sha256d
+
+PROTOCOL_VERSION = 70028
+MIN_PEER_PROTO_VERSION = 70025
+INIT_PROTO_VERSION = 209
+
+NODE_NETWORK = 1 << 0
+NODE_BLOOM = 1 << 2
+
+MAX_MESSAGE_SIZE = 8 * 1024 * 1024
+
+# message commands (ref protocol.cpp NetMsgType)
+MSG_VERSION = "version"
+MSG_VERACK = "verack"
+MSG_ADDR = "addr"
+MSG_GETADDR = "getaddr"
+MSG_INV = "inv"
+MSG_GETDATA = "getdata"
+MSG_NOTFOUND = "notfound"
+MSG_GETBLOCKS = "getblocks"
+MSG_GETHEADERS = "getheaders"
+MSG_HEADERS = "headers"
+MSG_SENDHEADERS = "sendheaders"
+MSG_TX = "tx"
+MSG_BLOCK = "block"
+MSG_MEMPOOL = "mempool"
+MSG_PING = "ping"
+MSG_PONG = "pong"
+MSG_REJECT = "reject"
+MSG_FEEFILTER = "feefilter"
+MSG_FILTERLOAD = "filterload"
+MSG_FILTERADD = "filteradd"
+MSG_FILTERCLEAR = "filterclear"
+MSG_MERKLEBLOCK = "merkleblock"
+MSG_SENDCMPCT = "sendcmpct"
+MSG_CMPCTBLOCK = "cmpctblock"
+MSG_GETBLOCKTXN = "getblocktxn"
+MSG_BLOCKTXN = "blocktxn"
+# asset wire messages (ref protocol.h:252-266)
+MSG_GETASSETDATA = "getasstdata"
+MSG_ASSETDATA = "asstdata"
+MSG_ASSETNOTFOUND = "asstnotfound"
+
+# inventory types (ref protocol.h GetDataMsg)
+INV_TX = 1
+INV_BLOCK = 2
+INV_FILTERED_BLOCK = 3
+INV_CMPCT_BLOCK = 4
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def pack_message(magic: bytes, command: str, payload: bytes) -> bytes:
+    if len(payload) > MAX_MESSAGE_SIZE:
+        raise ProtocolError("oversize message")
+    cmd = command.encode().ljust(12, b"\x00")
+    checksum = sha256d(payload)[:4]
+    return magic + cmd + len(payload).to_bytes(4, "little") + checksum + payload
+
+
+def unpack_header(magic: bytes, header: bytes) -> Tuple[str, int, bytes]:
+    """24-byte header -> (command, payload_len, checksum)."""
+    if len(header) != 24:
+        raise ProtocolError("short header")
+    if header[:4] != magic:
+        raise ProtocolError("bad magic")
+    command = header[4:16].rstrip(b"\x00").decode("ascii", errors="replace")
+    length = int.from_bytes(header[16:20], "little")
+    if length > MAX_MESSAGE_SIZE:
+        raise ProtocolError("oversize payload")
+    return command, length, header[20:24]
+
+
+def verify_checksum(payload: bytes, checksum: bytes) -> bool:
+    return sha256d(payload)[:4] == checksum
+
+
+@dataclass
+class NetAddr:
+    """ref protocol.h CAddress (simplified to IPv4/IPv6-mapped)."""
+
+    services: int = NODE_NETWORK
+    ip: str = "0.0.0.0"
+    port: int = 0
+    time: int = 0
+
+    def serialize(self, w: ByteWriter, with_time: bool = True) -> None:
+        if with_time:
+            w.u32(self.time or int(time.time()))
+        w.u64(self.services)
+        w.write(_ip_to_bytes16(self.ip))
+        w.write(self.port.to_bytes(2, "big"))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader, with_time: bool = True) -> "NetAddr":
+        t = r.u32() if with_time else 0
+        services = r.u64()
+        ip = _bytes16_to_ip(r.read(16))
+        port = int.from_bytes(r.read(2), "big")
+        return cls(services=services, ip=ip, port=port, time=t)
+
+
+def _ip_to_bytes16(ip: str) -> bytes:
+    import ipaddress
+
+    addr = ipaddress.ip_address(ip)
+    if addr.version == 4:
+        return b"\x00" * 10 + b"\xff\xff" + addr.packed
+    return addr.packed
+
+
+def _bytes16_to_ip(b: bytes) -> str:
+    import ipaddress
+
+    if b[:12] == b"\x00" * 10 + b"\xff\xff":
+        return str(ipaddress.IPv4Address(b[12:]))
+    return str(ipaddress.IPv6Address(b))
+
+
+@dataclass
+class Inv:
+    """ref protocol.h CInv."""
+
+    type: int
+    hash: int
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.u32(self.type).hash256(self.hash)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "Inv":
+        return cls(type=r.u32(), hash=r.hash256())
+
+
+@dataclass
+class VersionPayload:
+    version: int = PROTOCOL_VERSION
+    services: int = NODE_NETWORK
+    timestamp: int = 0
+    addr_recv: NetAddr = field(default_factory=NetAddr)
+    addr_from: NetAddr = field(default_factory=NetAddr)
+    nonce: int = 0
+    user_agent: str = "/NodexaTPU:0.1.0/"
+    start_height: int = 0
+    relay: bool = True
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.i32(self.version).u64(self.services).i64(self.timestamp or int(time.time()))
+        self.addr_recv.serialize(w, with_time=False)
+        self.addr_from.serialize(w, with_time=False)
+        w.u64(self.nonce).var_str(self.user_agent).i32(self.start_height)
+        w.boolean(self.relay)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "VersionPayload":
+        v = cls(
+            version=r.i32(),
+            services=r.u64(),
+            timestamp=r.i64(),
+            addr_recv=NetAddr.deserialize(r, with_time=False),
+        )
+        if r.remaining():
+            v.addr_from = NetAddr.deserialize(r, with_time=False)
+            v.nonce = r.u64()
+            v.user_agent = r.var_str()
+            v.start_height = r.i32()
+        if r.remaining():
+            v.relay = r.boolean()
+        return v
+
+
+@dataclass
+class BlockLocator:
+    """ref primitives/block.h CBlockLocator: exponentially-spaced hashes."""
+
+    have: List[int] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.u32(0)  # version placeholder, as the reference serializes nVersion
+        w.vector(self.have, lambda wr, h: wr.hash256(h))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "BlockLocator":
+        r.u32()
+        return cls(have=r.vector(lambda rr: rr.hash256()))
+
+
+def make_locator(chain) -> BlockLocator:
+    """ref chain.cpp CChain::GetLocator."""
+    have: List[int] = []
+    step = 1
+    idx = chain.tip()
+    while idx is not None:
+        have.append(idx.block_hash)
+        if idx.height == 0:
+            break
+        height = max(idx.height - step, 0)
+        idx = chain.at(height)
+        if len(have) > 10:
+            step *= 2
+    return BlockLocator(have=have)

@@ -55,16 +55,24 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     # Step 11: network (ref CConnman::Start, net.cpp:2304)
     if not g_args.get_bool("nolisten") and g_args.get_bool("listen", True):
-        try:
-            from ..net.connman import ConnMan
+        from ..net.connman import ConnMan
+        from .events import ValidationInterface, main_signals
 
-            port = g_args.get_int("port", node.params.default_port)
-            node.connman = ConnMan(node, port=port)
-            node.connman.start()
-            for addr in g_args.get_all("addnode") + g_args.get_all("connect"):
-                node.connman.connect_to(addr)
-        except ImportError:
-            pass
+        port = g_args.get_int("port", node.params.default_port)
+        node.connman = ConnMan(node, port=port)
+        node.connman.start()
+
+        class _PeerNotifier(ValidationInterface):
+            """Announce locally-found tips to peers (ref the
+            PeerLogicValidation subscriber wiring)."""
+
+            def updated_block_tip(self, new_tip, fork_tip, initial_download):
+                if node.connman is not None and new_tip is not None:
+                    node.connman.relay_block_hash(new_tip.block_hash)
+
+        main_signals.register(_PeerNotifier())
+        for addr in g_args.get_all("addnode") + g_args.get_all("connect"):
+            node.connman.connect_to(addr)
 
     # Steps 4a/13: RPC server + warmup end
     register_all(g_rpc_table)

@@ -14,6 +14,9 @@ from nodexa_chain_core_tpu.utils.base58 import b58check_encode
 ADDR = b58check_encode(
     b"\x6f" + hash160(pubkey_serialize(pubkey_create(1), True))
 )
+ADDR2 = b58check_encode(
+    b"\x6f" + hash160(pubkey_serialize(pubkey_create(2), True))
+)
 
 
 @pytest.mark.functional

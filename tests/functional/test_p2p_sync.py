@@ -1,0 +1,109 @@
+"""Functional: multi-node P2P — initial sync, block relay, tx relay,
+network-split reorg (parity: reference p2p_* / feature reorg tests, run as
+N local daemons over localhost, SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from .framework import TestFramework
+from .test_mining_basic import ADDR, ADDR2
+
+
+@pytest.mark.functional
+def test_initial_block_download_and_relay():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        # mine on node0 while disconnected
+        n0.rpc.generatetoaddress(8, ADDR)
+        assert n0.rpc.getblockcount() == 8
+        assert n1.rpc.getblockcount() == 0
+        # connect: node1 should headers-sync + download all blocks
+        f.connect_nodes(1, 0)
+        f.sync_blocks(timeout=30)
+        assert n1.rpc.getblockcount() == 8
+        assert n1.rpc.getbestblockhash() == n0.rpc.getbestblockhash()
+        # now mine more while connected: relay should propagate
+        n0.rpc.generatetoaddress(2, ADDR)
+        f.sync_blocks(timeout=30)
+        assert n1.rpc.getblockcount() == 10
+        # peer introspection
+        peers = n0.rpc.getpeerinfo()
+        assert len(peers) == 1
+        assert peers[0]["version"] == 70028
+
+
+@pytest.mark.functional
+def test_tx_relay():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        # fund: coinbase to a known key, mature it
+        from nodexa_chain_core_tpu.core.amount import COIN
+        from nodexa_chain_core_tpu.crypto.hashes import hash160
+        from nodexa_chain_core_tpu.crypto.secp256k1 import (
+            pubkey_create,
+            pubkey_serialize,
+        )
+        from nodexa_chain_core_tpu.primitives.transaction import (
+            OutPoint,
+            Transaction,
+            TxIn,
+            TxOut,
+        )
+        from nodexa_chain_core_tpu.script.script import Script
+        from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+        from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+        from nodexa_chain_core_tpu.core.uint256 import u256_from_hex
+
+        ks = KeyStore()
+        kid = ks.add_key(1)  # ADDR above is key 1
+        spk = p2pkh_script(KeyID(kid))
+
+        hashes = n0.rpc.generatetoaddress(101, ADDR)
+        f.sync_blocks()
+        blk = n0.rpc.getblock(hashes[0], 2)
+        cb = blk["tx"][0]
+        value_sat = cb["vout"][0]["valueSat"]
+
+        tx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(u256_from_hex(cb["txid"]), 0))],
+            vout=[TxOut(value=value_sat - 100_000, script_pubkey=spk.raw)],
+        )
+        sign_tx_input(ks, tx, 0, spk)
+        txid = n0.rpc.sendrawtransaction(tx.to_bytes().hex())
+        assert txid in n0.rpc.getrawmempool()
+        f.sync_mempools(timeout=30)
+        assert txid in n1.rpc.getrawmempool()
+        # mine it; both nodes confirm
+        n0.rpc.generatetoaddress(1, ADDR)
+        f.sync_blocks()
+        assert n1.rpc.getrawmempool() == []
+        assert n1.rpc.getrawtransaction(txid, True)["confirmations"] == 1
+
+
+@pytest.mark.functional
+def test_network_split_reorg():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        n0.rpc.generatetoaddress(3, ADDR)
+        f.sync_blocks()
+        # split: disconnect and mine divergent branches
+        n0.rpc.addnode(f"127.0.0.1:{f.nodes[1].p2p_port}", "remove")
+        n1.rpc.addnode(f"127.0.0.1:{f.nodes[0].p2p_port}", "remove")
+        time.sleep(1)
+        n0.rpc.generatetoaddress(1, ADDR)
+        n1.rpc.generatetoaddress(3, ADDR2)  # longer, divergent branch
+        assert n0.rpc.getblockcount() == 4
+        assert n1.rpc.getblockcount() == 6
+        # heal the split: node0 must reorg onto node1's longer chain
+        f.connect_nodes(0, 1)
+        f.sync_blocks(timeout=45)
+        assert n0.rpc.getblockcount() == 6
+        assert n0.rpc.getbestblockhash() == n1.rpc.getbestblockhash()
+        tips = n0.rpc.getchaintips()
+        statuses = {t["status"] for t in tips}
+        assert "active" in statuses
+        assert any(t["status"] != "active" for t in tips)  # the stale branch

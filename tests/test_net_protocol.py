@@ -1,0 +1,72 @@
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.net import protocol
+from nodexa_chain_core_tpu.net.addrman import AddrMan
+
+
+def test_message_framing_roundtrip():
+    magic = b"ndxr"
+    msg = protocol.pack_message(magic, "ping", b"\x01\x02")
+    command, length, checksum = protocol.unpack_header(magic, msg[:24])
+    assert command == "ping"
+    assert length == 2
+    assert protocol.verify_checksum(msg[24:], checksum)
+
+
+def test_bad_magic_rejected():
+    msg = protocol.pack_message(b"ndxr", "ping", b"")
+    import pytest
+
+    with pytest.raises(protocol.ProtocolError):
+        protocol.unpack_header(b"XXXX", msg[:24])
+
+
+def test_version_payload_roundtrip():
+    v = protocol.VersionPayload(
+        timestamp=1700000000,
+        nonce=12345,
+        user_agent="/test:1/",
+        start_height=42,
+        relay=False,
+    )
+    w = ByteWriter()
+    v.serialize(w)
+    back = protocol.VersionPayload.deserialize(ByteReader(w.getvalue()))
+    assert back.nonce == 12345
+    assert back.user_agent == "/test:1/"
+    assert back.start_height == 42
+    assert back.relay is False
+
+
+def test_netaddr_ipv4_roundtrip():
+    a = protocol.NetAddr(services=5, ip="10.1.2.3", port=8788, time=1700000000)
+    w = ByteWriter()
+    a.serialize(w)
+    back = protocol.NetAddr.deserialize(ByteReader(w.getvalue()))
+    assert back.ip == "10.1.2.3"
+    assert back.port == 8788
+    assert back.services == 5
+
+
+def test_inv_roundtrip():
+    inv = protocol.Inv(protocol.INV_BLOCK, 999)
+    w = ByteWriter()
+    inv.serialize(w)
+    back = protocol.Inv.deserialize(ByteReader(w.getvalue()))
+    assert back.type == protocol.INV_BLOCK and back.hash == 999
+
+
+def test_addrman_add_select_good(tmp_path):
+    am = AddrMan(key=42)
+    for i in range(50):
+        am.add(f"10.0.0.{i}", 8788, source="seed")
+    assert am.size() > 0
+    picked = am.select()
+    assert picked is not None
+    am.good(picked.ip, picked.port)
+    assert am._addrs[picked.key()].in_tried
+    # persistence
+    path = str(tmp_path / "peers.json")
+    am.save(path)
+    am2 = AddrMan.load(path)
+    assert am2.size() == am.size()
+    assert am2._addrs[picked.key()].in_tried
