@@ -281,8 +281,19 @@ class NetProcessor:
         try:
             indexes = cs.process_new_block_headers(headers)
         except BlockValidationError as e:
+            if e.code == "prev-blk-not-found":
+                # unconnecting announcement: ask for the missing range
+                # instead of punishing (ref MAX_UNCONNECTING_HEADERS logic)
+                peer.unconnecting_headers = (
+                    getattr(peer, "unconnecting_headers", 0) + 1
+                )
+                self._send_getheaders(peer)
+                if peer.unconnecting_headers % 10 == 0:
+                    self.misbehaving(peer, 20, "too-many-unconnecting-headers")
+                return
             self.misbehaving(peer, 20, f"bad-headers:{e.code}")
             return
+        peer.unconnecting_headers = 0
         # track the peer's most-work announced header (ref CNodeState::
         # pindexBestKnownBlock) and pull missing data from it
         for idx in indexes:

@@ -1,0 +1,140 @@
+"""BIP32 hierarchical deterministic keys (parity: reference src/key.cpp
+CExtKey::Derive + src/wallet's BIP44 paths)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from ..crypto import secp256k1 as ec
+from ..crypto.hashes import hash160, hmac_sha512
+from ..utils.base58 import b58check_decode, b58check_encode
+
+HARDENED = 0x80000000
+
+
+class Bip32Error(Exception):
+    pass
+
+
+@dataclass
+class ExtKey:
+    """Extended private key."""
+
+    depth: int
+    parent_fingerprint: bytes
+    child_number: int
+    chain_code: bytes
+    key: int  # private scalar
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "ExtKey":
+        h = hmac_sha512(b"Bitcoin seed", seed)
+        key = int.from_bytes(h[:32], "big")
+        if not ec.is_valid_privkey(key):
+            raise Bip32Error("invalid master key; use another seed")
+        return cls(0, b"\x00" * 4, 0, h[32:], key)
+
+    def fingerprint(self) -> bytes:
+        return hash160(ec.pubkey_serialize(ec.pubkey_create(self.key)))[:4]
+
+    def derive(self, index: int) -> "ExtKey":
+        if index & HARDENED:
+            data = b"\x00" + self.key.to_bytes(32, "big") + index.to_bytes(4, "big")
+        else:
+            data = ec.pubkey_serialize(ec.pubkey_create(self.key)) + index.to_bytes(
+                4, "big"
+            )
+        h = hmac_sha512(self.chain_code, data)
+        tweak = int.from_bytes(h[:32], "big")
+        child_key = (tweak + self.key) % ec.N
+        if tweak >= ec.N or child_key == 0:
+            # spec: skip to next index
+            return self.derive(index + 1)
+        return ExtKey(
+            self.depth + 1, self.fingerprint(), index, h[32:], child_key
+        )
+
+    def derive_path(self, path: str) -> "ExtKey":
+        """e.g. "m/44'/1313'/0'/0/5"."""
+        node = self
+        for part in path.split("/"):
+            if part in ("m", ""):
+                continue
+            hardened = part.endswith("'") or part.endswith("h")
+            idx = int(part.rstrip("'h"))
+            node = node.derive(idx | (HARDENED if hardened else 0))
+        return node
+
+    def neuter(self) -> "ExtPubKey":
+        return ExtPubKey(
+            self.depth,
+            self.parent_fingerprint,
+            self.child_number,
+            self.chain_code,
+            ec.pubkey_create(self.key),
+        )
+
+    def serialize(self, params) -> str:
+        payload = (
+            params.ext_secret_key
+            + bytes([self.depth])
+            + self.parent_fingerprint
+            + self.child_number.to_bytes(4, "big")
+            + self.chain_code
+            + b"\x00"
+            + self.key.to_bytes(32, "big")
+        )
+        return b58check_encode(payload)
+
+    @classmethod
+    def deserialize(cls, s: str, params) -> "ExtKey":
+        raw = b58check_decode(s)
+        if len(raw) != 78 or raw[:4] != params.ext_secret_key:
+            raise Bip32Error("bad xprv")
+        return cls(
+            raw[4],
+            raw[5:9],
+            int.from_bytes(raw[9:13], "big"),
+            raw[13:45],
+            int.from_bytes(raw[46:78], "big"),
+        )
+
+
+@dataclass
+class ExtPubKey:
+    depth: int
+    parent_fingerprint: bytes
+    child_number: int
+    chain_code: bytes
+    pubkey: Tuple[int, int]
+
+    def derive(self, index: int) -> "ExtPubKey":
+        if index & HARDENED:
+            raise Bip32Error("cannot derive hardened child from xpub")
+        data = ec.pubkey_serialize(self.pubkey) + index.to_bytes(4, "big")
+        h = hmac_sha512(self.chain_code, data)
+        tweak = int.from_bytes(h[:32], "big")
+        if tweak >= ec.N:
+            return self.derive(index + 1)
+        child = ec.point_add(ec.pubkey_create(tweak), self.pubkey)
+        if child is None:
+            return self.derive(index + 1)
+        return ExtPubKey(
+            self.depth + 1,
+            hash160(ec.pubkey_serialize(self.pubkey))[:4],
+            index,
+            h[32:],
+            child,
+        )
+
+    def serialize(self, params) -> str:
+        payload = (
+            params.ext_public_key
+            + bytes([self.depth])
+            + self.parent_fingerprint
+            + self.child_number.to_bytes(4, "big")
+            + self.chain_code
+            + ec.pubkey_serialize(self.pubkey)
+        )
+        return b58check_encode(payload)
