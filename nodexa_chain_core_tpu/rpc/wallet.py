@@ -1,0 +1,222 @@
+"""Wallet RPC family (parity: reference src/wallet/rpcwallet.cpp +
+rpcdump.cpp)."""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, List
+
+from ..core.amount import COIN, parse_money
+from ..core.uint256 import u256_hex
+from ..script.script import Script
+from ..script.standard import (
+    KeyID,
+    decode_destination,
+    encode_destination,
+    extract_destination,
+    script_for_destination,
+)
+from ..wallet.keys import wif_decode, wif_encode
+from ..wallet.wallet import WalletError, verify_message
+from .server import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_WALLET_ERROR,
+    RPC_WALLET_INSUFFICIENT_FUNDS,
+    RPCError,
+    RPCTable,
+)
+
+
+def _wallet(node):
+    if node.wallet is None:
+        raise RPCError(RPC_WALLET_ERROR, "wallet is disabled")
+    return node.wallet
+
+
+def _amount_to_sat(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(round(float(v) * COIN))
+    return parse_money(str(v))
+
+
+def getnewaddress(node, params: List[Any]):
+    label = str(params[0]) if params else ""
+    return _wallet(node).get_new_address(label)
+
+
+def getbalance(node, params: List[Any]):
+    minconf = int(params[1]) if len(params) > 1 else 1
+    return _wallet(node).get_balance(min_conf=minconf) / COIN
+
+
+def getunconfirmedbalance(node, params: List[Any]):
+    return _wallet(node).get_unconfirmed_balance() / COIN
+
+
+def getwalletinfo(node, params: List[Any]):
+    w = _wallet(node)
+    return {
+        "walletname": "default",
+        "walletversion": 1,
+        "balance": w.get_balance() / COIN,
+        "unconfirmed_balance": w.get_unconfirmed_balance() / COIN,
+        "immature_balance": w.get_immature_balance() / COIN,
+        "txcount": len(w.wtx),
+        "keypoolsize": max(0, w.next_index[0]),
+        "hdseedid": "hd",
+        "paytxfee": 0.0,
+    }
+
+
+def sendtoaddress(node, params: List[Any]):
+    """ref rpcwallet.cpp:431 sendtoaddress -> SendMoney."""
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "address and amount required")
+    w = _wallet(node)
+    try:
+        dest = decode_destination(str(params[0]), node.params)
+    except ValueError as e:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+    value = _amount_to_sat(params[1])
+    try:
+        txid = w.send_to_address(script_for_destination(dest).raw, value)
+    except WalletError as e:
+        if "Insufficient" in str(e):
+            raise RPCError(RPC_WALLET_INSUFFICIENT_FUNDS, str(e))
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return u256_hex(txid)
+
+
+def sendmany(node, params: List[Any]):
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "fromaccount and amounts required")
+    w = _wallet(node)
+    recipients = []
+    for addr, amount in dict(params[1]).items():
+        dest = decode_destination(addr, node.params)
+        recipients.append((script_for_destination(dest).raw, _amount_to_sat(amount)))
+    try:
+        tx, _fee = w.create_transaction(recipients)
+        txid = w.commit_transaction(tx)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return u256_hex(txid)
+
+
+def listunspent(node, params: List[Any]):
+    w = _wallet(node)
+    minconf = int(params[0]) if params else 1
+    out = []
+    for op, txout, conf in w.unspent_coins(min_conf=minconf):
+        dest = extract_destination(Script(txout.script_pubkey))
+        out.append(
+            {
+                "txid": u256_hex(op.txid),
+                "vout": op.n,
+                "address": encode_destination(dest, node.params) if dest else None,
+                "scriptPubKey": txout.script_pubkey.hex(),
+                "amount": txout.value / COIN,
+                "confirmations": conf,
+                "spendable": True,
+                "solvable": True,
+            }
+        )
+    return out
+
+
+def listtransactions(node, params: List[Any]):
+    w = _wallet(node)
+    count = int(params[1]) if len(params) > 1 else 10
+    tip_height = node.chainstate.tip().height
+    items = []
+    for wtx in sorted(w.wtx.values(), key=lambda x: -x.time_received)[:count]:
+        conf = 0 if wtx.height < 0 else tip_height - wtx.height + 1
+        credit = sum(
+            o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
+        )
+        items.append(
+            {
+                "txid": wtx.tx.txid_hex,
+                "category": "generate" if wtx.is_coinbase() else "receive",
+                "amount": credit / COIN,
+                "confirmations": conf,
+                "time": int(wtx.time_received),
+            }
+        )
+    return items
+
+
+def keypoolrefill(node, params: List[Any]):
+    size = int(params[0]) if params else 100
+    _wallet(node).top_up_keypool(size)
+    return None
+
+
+def importprivkey(node, params: List[Any]):
+    w = _wallet(node)
+    try:
+        priv, compressed = wif_decode(str(params[0]), node.params)
+    except ValueError as e:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+    w.keystore.add_key(priv, compressed)
+    rescan = bool(params[2]) if len(params) > 2 else True
+    if rescan:
+        w.rescan()
+    return None
+
+
+def dumpprivkey(node, params: List[Any]):
+    w = _wallet(node)
+    dest = decode_destination(str(params[0]), node.params)
+    if not isinstance(dest, KeyID):
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "not a key address")
+    priv = w.keystore.get_priv(dest.h)
+    if priv is None:
+        raise RPCError(RPC_WALLET_ERROR, "key not in wallet")
+    return wif_encode(priv, node.params)
+
+
+def getmnemonic(node, params: List[Any]):
+    """ref rpcwallet getmywords/dumphdinfo-style mnemonic export."""
+    return {"mnemonic": _wallet(node).mnemonic}
+
+
+def signmessage(node, params: List[Any]):
+    w = _wallet(node)
+    dest = decode_destination(str(params[0]), node.params)
+    if not isinstance(dest, KeyID):
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "not a key address")
+    sig = w.sign_message(dest.h, str(params[1]))
+    return base64.b64encode(sig).decode()
+
+
+def verifymessage(node, params: List[Any]):
+    sig = base64.b64decode(str(params[1]))
+    return verify_message(str(params[0]), sig, str(params[2]), node.params)
+
+
+def rescanblockchain(node, params: List[Any]):
+    found = _wallet(node).rescan()
+    return {"found": found}
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("getnewaddress", getnewaddress, ["label"]),
+        ("getbalance", getbalance, ["account", "minconf"]),
+        ("getunconfirmedbalance", getunconfirmedbalance, []),
+        ("getwalletinfo", getwalletinfo, []),
+        ("sendtoaddress", sendtoaddress, ["address", "amount"]),
+        ("sendmany", sendmany, ["fromaccount", "amounts"]),
+        ("listunspent", listunspent, ["minconf"]),
+        ("listtransactions", listtransactions, ["account", "count"]),
+        ("keypoolrefill", keypoolrefill, ["newsize"]),
+        ("importprivkey", importprivkey, ["privkey", "label", "rescan"]),
+        ("dumpprivkey", dumpprivkey, ["address"]),
+        ("getmnemonic", getmnemonic, []),
+        ("signmessage", signmessage, ["address", "message"]),
+        ("verifymessage", verifymessage, ["address", "signature", "message"]),
+        ("rescanblockchain", rescanblockchain, []),
+    ]:
+        table.register("wallet", name, fn, args)

@@ -1,0 +1,95 @@
+"""Mnemonic seed phrases (parity: reference src/wallet/bip39.{h,cpp}).
+
+Implements the BIP39 algorithm (entropy -> checksummed word indices ->
+PBKDF2-SHA512 seed).  The reference embeds the standard English wordlist
+(bip39_english.h); this environment has no copy of that data, so the
+wordlist here is generated deterministically from a seed constant — same
+algorithm and 2048-word shape, but phrases are NOT interchangeable with
+BIP39-English wallets (documented divergence; drop a standard wordlist
+into WORDLIST to restore compatibility).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import List
+
+
+def _generate_wordlist() -> List[str]:
+    """2048 distinct pronounceable words, deterministic."""
+    consonants = "bcdfghjklmnprstvz"
+    vowels = "aeiou"
+    words = []
+    i = 0
+    while len(words) < 2048:
+        h = hashlib.sha256(f"nodexa-wordlist-{i}".encode()).digest()
+        w = (
+            consonants[h[0] % len(consonants)]
+            + vowels[h[1] % len(vowels)]
+            + consonants[h[2] % len(consonants)]
+            + vowels[h[3] % len(vowels)]
+            + consonants[h[4] % len(consonants)]
+        )
+        if w not in words:
+            words.append(w)
+        i += 1
+    return sorted(words)
+
+
+WORDLIST = _generate_wordlist()
+_INDEX = {w: i for i, w in enumerate(WORDLIST)}
+
+
+class MnemonicError(Exception):
+    pass
+
+
+def entropy_to_mnemonic(entropy: bytes) -> str:
+    """ref mnemonic_from_data."""
+    if len(entropy) not in (16, 20, 24, 28, 32):
+        raise MnemonicError("entropy must be 128-256 bits")
+    checksum_bits = len(entropy) * 8 // 32
+    checksum = hashlib.sha256(entropy).digest()
+    bits = int.from_bytes(entropy, "big")
+    bits = (bits << checksum_bits) | (checksum[0] >> (8 - checksum_bits))
+    total_bits = len(entropy) * 8 + checksum_bits
+    words = []
+    for i in range(total_bits // 11 - 1, -1, -1):
+        words.append(WORDLIST[(bits >> (11 * i)) & 0x7FF])
+    return " ".join(words)
+
+
+def generate_mnemonic(strength_bits: int = 128) -> str:
+    return entropy_to_mnemonic(secrets.token_bytes(strength_bits // 8))
+
+
+def check_mnemonic(mnemonic: str) -> bool:
+    """ref mnemonic_check."""
+    words = mnemonic.split()
+    if len(words) not in (12, 15, 18, 21, 24):
+        return False
+    try:
+        bits = 0
+        for w in words:
+            bits = (bits << 11) | _INDEX[w]
+    except KeyError:
+        return False
+    total_bits = len(words) * 11
+    checksum_bits = total_bits // 33
+    entropy_bits = total_bits - checksum_bits
+    entropy = (bits >> checksum_bits).to_bytes(entropy_bits // 8, "big")
+    checksum = bits & ((1 << checksum_bits) - 1)
+    expect = hashlib.sha256(entropy).digest()[0] >> (8 - checksum_bits)
+    return checksum == expect
+
+
+def mnemonic_to_seed(mnemonic: str, passphrase: str = "") -> bytes:
+    """ref mnemonic_to_seed: PBKDF2-HMAC-SHA512, 2048 rounds."""
+    return hashlib.pbkdf2_hmac(
+        "sha512",
+        mnemonic.encode("utf-8"),
+        b"mnemonic" + passphrase.encode("utf-8"),
+        2048,
+        64,
+    )

@@ -1,0 +1,438 @@
+"""HD wallet (parity: reference src/wallet/wallet.{h,cpp}).
+
+BIP44 HD chain over a BIP39-style mnemonic (ref wallet.cpp
+GenerateNewHDChain), keypool of external/internal keys, transaction
+tracking via the validation signal bus, coin selection, asset-aware
+transaction construction entry points (``create_transaction`` mirrors
+CWallet::CreateTransaction, wallet.cpp:3225-3274), and commit via the
+mempool + relay path (CommitTransaction, :3853).  Storage is the embedded
+KV store (the reference uses BerkeleyDB).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..chain.coins import Coin
+from ..chain.policy import MIN_RELAY_FEE, FeeRate
+from ..consensus.consensus import COINBASE_MATURITY
+from ..core.amount import COIN
+from ..core.uint256 import u256_hex
+from ..crypto.hashes import hash160, sha256d
+from ..node.events import ValidationInterface, main_signals
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+from ..script.script import Script
+from ..script.sign import KeyStore, SigningError, sign_tx_input
+from ..script.standard import (
+    KeyID,
+    extract_destination,
+    p2pkh_script,
+    script_for_destination,
+)
+from ..wallet.bip32 import ExtKey
+from ..wallet.bip39 import generate_mnemonic, mnemonic_to_seed
+from ..wallet.keys import pubkey_of
+
+KEYPOOL_SIZE = 100
+
+
+class WalletError(Exception):
+    pass
+
+
+@dataclass
+class WalletTx:
+    """ref wallet.h CWalletTx (subset)."""
+
+    tx: Transaction
+    height: int = -1  # -1 = unconfirmed
+    time_received: float = field(default_factory=time.time)
+
+    def is_coinbase(self) -> bool:
+        return self.tx.is_coinbase()
+
+
+class Wallet(ValidationInterface):
+    def __init__(self, node, path: Optional[str] = None):
+        self.node = node
+        self.path = path
+        self.keystore = KeyStore()
+        self.lock = threading.RLock()
+        self.mnemonic: Optional[str] = None
+        self.master: Optional[ExtKey] = None
+        self.next_index = {0: 0, 1: 0}  # external / internal chains
+        self.key_meta: Dict[bytes, Tuple[int, int]] = {}  # keyid -> (chain, idx)
+        self.wtx: Dict[int, WalletTx] = {}
+        self.address_book: Dict[str, str] = {}
+
+    # ------------------------------------------------------------ creation
+
+    @classmethod
+    def load_or_create(cls, node) -> "Wallet":
+        path = (
+            os.path.join(node.datadir, "wallet.json") if node.datadir else None
+        )
+        w = cls(node, path)
+        if path and os.path.exists(path):
+            w._load()
+        else:
+            w.generate_hd_chain()
+            w.top_up_keypool()
+            w.flush()
+        main_signals.register(w)
+        return w
+
+    def generate_hd_chain(self, mnemonic: Optional[str] = None) -> None:
+        """ref CWallet::GenerateNewHDChain + BIP44."""
+        self.mnemonic = mnemonic or generate_mnemonic()
+        seed = mnemonic_to_seed(self.mnemonic)
+        self.master = ExtKey.from_seed(seed)
+
+    def _account_key(self) -> ExtKey:
+        coin_type = self.node.params.ext_coin_type
+        return self.master.derive_path(f"m/44'/{coin_type}'/0'")
+
+    def derive_key(self, chain: int, index: int) -> int:
+        return self._account_key().derive(chain).derive(index).key
+
+    def top_up_keypool(self, size: int = KEYPOOL_SIZE) -> None:
+        """ref CWallet::TopUpKeyPool."""
+        with self.lock:
+            for chain in (0, 1):
+                while self.next_index[chain] < size:
+                    idx = self.next_index[chain]
+                    priv = self.derive_key(chain, idx)
+                    kid = self.keystore.add_key(priv)
+                    self.key_meta[kid] = (chain, idx)
+                    self.next_index[chain] = idx + 1
+
+    def get_new_address(self, label: str = "") -> str:
+        """ref GetNewAddress: hand out the next external key."""
+        from ..script.standard import encode_destination
+
+        with self.lock:
+            idx = self.next_index[0]
+            priv = self.derive_key(0, idx)
+            kid = self.keystore.add_key(priv)
+            self.key_meta[kid] = (0, idx)
+            self.next_index[0] = idx + 1
+            addr = encode_destination(KeyID(kid), self.node.params)
+            if label:
+                self.address_book[addr] = label
+            self.flush()
+            return addr
+
+    def get_change_address_script(self) -> bytes:
+        with self.lock:
+            idx = self.next_index[1]
+            priv = self.derive_key(1, idx)
+            kid = self.keystore.add_key(priv)
+            self.key_meta[kid] = (1, idx)
+            self.next_index[1] = idx + 1
+            return p2pkh_script(KeyID(kid)).raw
+
+    # ------------------------------------------------------------- tracking
+
+    def is_mine_script(self, script_pubkey: bytes) -> bool:
+        """ref ismine.h IsMine (P2PKH/P2PK/asset-envelope on our keys)."""
+        dest = extract_destination(Script(script_pubkey))
+        if isinstance(dest, KeyID):
+            return self.keystore.get_priv(dest.h) is not None
+        return False
+
+    def is_relevant(self, tx: Transaction) -> bool:
+        if any(self.is_mine_script(o.script_pubkey) for o in tx.vout):
+            return True
+        return any(i.prevout.txid in self.wtx for i in tx.vin)
+
+    def transaction_added_to_mempool(self, tx) -> None:
+        with self.lock:
+            if self.is_relevant(tx):
+                self.wtx[tx.txid] = WalletTx(tx=tx, height=-1)
+                self.flush()
+
+    def block_connected(self, block, index, txs_conflicted) -> None:
+        with self.lock:
+            changed = False
+            for tx in block.vtx:
+                if self.is_relevant(tx):
+                    self.wtx[tx.txid] = WalletTx(tx=tx, height=index.height)
+                    changed = True
+                elif tx.txid in self.wtx:
+                    self.wtx[tx.txid].height = index.height
+                    changed = True
+            if changed:
+                self.flush()
+
+    def block_disconnected(self, block) -> None:
+        with self.lock:
+            for tx in block.vtx:
+                if tx.txid in self.wtx:
+                    self.wtx[tx.txid].height = -1
+
+    def rescan(self) -> int:
+        """ref ScanForWalletTransactions."""
+        cs = self.node.chainstate
+        found = 0
+        with self.lock:
+            for idx in cs.active:
+                block = cs.read_block(idx)
+                for tx in block.vtx:
+                    if self.is_relevant(tx):
+                        self.wtx[tx.txid] = WalletTx(tx=tx, height=idx.height)
+                        found += 1
+            self.flush()
+        return found
+
+    # ------------------------------------------------------------- balance
+
+    def _spent_outpoints(self) -> set:
+        spent = set()
+        for wtx in self.wtx.values():
+            for txin in wtx.tx.vin:
+                spent.add(txin.prevout)
+        return spent
+
+    def unspent_coins(
+        self, min_conf: int = 0, include_immature: bool = False
+    ) -> List[Tuple[OutPoint, TxOut, int]]:
+        """(outpoint, txout, confirmations) for spendable wallet coins."""
+        tip_height = self.node.chainstate.tip().height
+        spent = self._spent_outpoints()
+        out = []
+        with self.lock:
+            for txid, wtx in self.wtx.items():
+                conf = 0 if wtx.height < 0 else tip_height - wtx.height + 1
+                if conf < min_conf:
+                    continue
+                if (
+                    wtx.is_coinbase()
+                    and not include_immature
+                    and conf < COINBASE_MATURITY
+                ):
+                    continue
+                for n, txout in enumerate(wtx.tx.vout):
+                    op = OutPoint(txid, n)
+                    if op in spent:
+                        continue
+                    if not self.is_mine_script(txout.script_pubkey):
+                        continue
+                    out.append((op, txout, conf))
+        return out
+
+    def get_balance(self, min_conf: int = 1) -> int:
+        return sum(o.value for _, o, c in self.unspent_coins() if c >= min_conf)
+
+    def get_unconfirmed_balance(self) -> int:
+        return sum(o.value for _, o, c in self.unspent_coins() if c == 0)
+
+    def get_immature_balance(self) -> int:
+        tip_height = self.node.chainstate.tip().height
+        spent = self._spent_outpoints()
+        total = 0
+        for txid, wtx in self.wtx.items():
+            if not wtx.is_coinbase() or wtx.height < 0:
+                continue
+            conf = tip_height - wtx.height + 1
+            if conf >= COINBASE_MATURITY:
+                continue
+            for n, txout in enumerate(wtx.tx.vout):
+                if OutPoint(txid, n) not in spent and self.is_mine_script(
+                    txout.script_pubkey
+                ):
+                    total += txout.value
+        return total
+
+    # ------------------------------------------------------ tx construction
+
+    def select_coins(self, target: int) -> Tuple[List[Tuple[OutPoint, TxOut]], int]:
+        """Largest-first selection (ref SelectCoinsMinConf, simplified)."""
+        avail = sorted(
+            [(op, o) for op, o, conf in self.unspent_coins(min_conf=1)],
+            key=lambda x: -x[1].value,
+        )
+        picked = []
+        total = 0
+        for op, o in avail:
+            picked.append((op, o))
+            total += o.value
+            if total >= target:
+                return picked, total
+        raise WalletError(
+            f"Insufficient funds: need {target}, have {total}"
+        )
+
+    def create_transaction(
+        self,
+        recipients: List[Tuple[bytes, int]],
+        feerate: Optional[FeeRate] = None,
+        subtract_fee: bool = False,
+    ) -> Tuple[Transaction, int]:
+        """ref CWallet::CreateTransaction (wallet.cpp:3250): returns
+        (signed tx, fee)."""
+        feerate = feerate or FeeRate(MIN_RELAY_FEE.sat_per_kb * 2)
+        send_total = sum(v for _, v in recipients)
+        if send_total <= 0:
+            raise WalletError("invalid amount")
+        fee = 10_000  # starting guess; iterate
+        for _ in range(10):
+            target = send_total + (0 if subtract_fee else fee)
+            picked, total_in = self.select_coins(target)
+            vout = []
+            for spk, value in recipients:
+                v = value - (fee // len(recipients) if subtract_fee else 0)
+                if v <= 0:
+                    raise WalletError("fee exceeds amount")
+                vout.append(TxOut(value=v, script_pubkey=spk))
+            change = total_in - send_total - (0 if subtract_fee else fee)
+            if subtract_fee:
+                change = total_in - send_total
+            if change > 5000:  # dust-ish floor for change
+                vout.append(TxOut(value=change, script_pubkey=self.get_change_address_script()))
+            tx = Transaction(
+                version=2,
+                vin=[
+                    TxIn(prevout=op, sequence=0xFFFFFFFE) for op, _ in picked
+                ],
+                vout=vout,
+                locktime=self.node.chainstate.tip().height,
+            )
+            # sign
+            for i, (op, prev_out) in enumerate(picked):
+                sign_tx_input(
+                    self.keystore, tx, i, Script(prev_out.script_pubkey)
+                )
+            needed = feerate.fee_for(len(tx.to_bytes()))
+            if fee >= needed:
+                return tx, fee
+            fee = needed
+        raise WalletError("fee estimation did not converge")
+
+    def commit_transaction(self, tx: Transaction) -> int:
+        """ref CWallet::CommitTransaction (wallet.cpp:3853)."""
+        from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+
+        with self.lock:
+            self.wtx[tx.txid] = WalletTx(tx=tx, height=-1)
+        try:
+            accept_to_memory_pool(self.node.chainstate, self.node.mempool, tx)
+        except MempoolAcceptError as e:
+            with self.lock:
+                del self.wtx[tx.txid]
+            raise WalletError(f"transaction rejected: {e.code}")
+        if self.node.connman is not None:
+            self.node.connman.relay_transaction(tx)
+        self.flush()
+        return tx.txid
+
+    def send_to_address(self, script_pubkey: bytes, value: int) -> int:
+        tx, _fee = self.create_transaction([(script_pubkey, value)])
+        return self.commit_transaction(tx)
+
+    # ---------------------------------------------------------- message sig
+
+    def sign_message(self, keyid: bytes, message: str) -> bytes:
+        """ref rpcmisc signmessage: compact recoverable signature."""
+        from ..crypto import secp256k1 as ec
+
+        priv = self.keystore.get_priv(keyid)
+        if priv is None:
+            raise WalletError("key not in wallet")
+        digest = _message_digest(message)
+        r, s = ec.sign(priv, digest)
+        pub = ec.pubkey_create(priv)
+        rec_id = next(
+            i
+            for i in range(4)
+            if _try_recover(digest, r, s, i) == pub
+        )
+        return bytes([27 + 4 + rec_id]) + r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    # ---------------------------------------------------------- persistence
+
+    def flush(self) -> None:
+        if self.path is None:
+            return
+        with self.lock:
+            data = {
+                "mnemonic": self.mnemonic,
+                "next_index": self.next_index,
+                "address_book": self.address_book,
+                "wtx": [
+                    {
+                        "hex": wtx.tx.to_bytes().hex(),
+                        "height": wtx.height,
+                        "time": wtx.time_received,
+                    }
+                    for wtx in self.wtx.values()
+                ],
+            }
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.path)
+
+    def _load(self) -> None:
+        with open(self.path) as f:
+            data = json.load(f)
+        self.generate_hd_chain(data["mnemonic"])
+        self.next_index = {int(k): v for k, v in data["next_index"].items()}
+        self.address_book = data.get("address_book", {})
+        # re-derive keys
+        for chain in (0, 1):
+            for idx in range(self.next_index[chain]):
+                priv = self.derive_key(chain, idx)
+                kid = self.keystore.add_key(priv)
+                self.key_meta[kid] = (chain, idx)
+        for item in data.get("wtx", []):
+            tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
+            self.wtx[tx.txid] = WalletTx(
+                tx=tx, height=item["height"], time_received=item.get("time", 0)
+            )
+
+
+def _message_digest(message: str) -> bytes:
+    from ..core.serialize import ByteWriter
+
+    w = ByteWriter()
+    w.var_str("Nodexa Signed Message:\n")
+    w.var_str(message)
+    return sha256d(w.getvalue())
+
+
+def _try_recover(digest: bytes, r: int, s: int, rec_id: int):
+    from ..crypto import secp256k1 as ec
+
+    try:
+        return ec.recover(digest, r, s, rec_id)
+    except ec.Secp256k1Error:
+        return None
+
+
+def verify_message(address: str, signature: bytes, message: str, params) -> bool:
+    """ref rpcmisc verifymessage."""
+    from ..crypto import secp256k1 as ec
+    from ..script.standard import decode_destination
+
+    if len(signature) != 65:
+        return False
+    try:
+        dest = decode_destination(address, params)
+    except ValueError:
+        return False
+    if not isinstance(dest, KeyID):
+        return False
+    rec_id = (signature[0] - 27) & 3
+    r = int.from_bytes(signature[1:33], "big")
+    s = int.from_bytes(signature[33:65], "big")
+    digest = _message_digest(message)
+    pub = _try_recover(digest, r, s, rec_id)
+    if pub is None:
+        return False
+    compressed = bool((signature[0] - 27) & 4)
+    return hash160(ec.pubkey_serialize(pub, compressed)) == dest.h
