@@ -1,0 +1,623 @@
+"""Asset state machine (parity: reference src/assets/assets.cpp
+CAssetsCache — 5.6k LoC of cache apply/undo logic — plus the per-kind
+LevelDB stores in src/assets/*db.{h,cpp}).
+
+``check_and_apply_tx`` is the ConnectBlock-side entry (ref validation.cpp
+ConnectBlock taking CAssetsCache, :10052, and CheckTxAssets); it validates
+every asset operation in a transaction against current state, mutates the
+cache, and returns an undo record that ``undo_tx`` replays backwards on
+disconnect.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.amount import MAX_MONEY
+from ..core.serialize import ByteReader, ByteWriter
+from ..crypto.hashes import hash160
+from ..primitives.transaction import Transaction
+from ..script.script import Script
+from ..script.standard import KeyID, extract_destination
+from .types import (
+    AssetTransfer,
+    AssetType,
+    BURN_AMOUNTS,
+    MAX_UNIT,
+    NewAsset,
+    NullAssetTxData,
+    OWNER_ASSET_AMOUNT,
+    OWNER_TAG,
+    OwnerPayload,
+    QUALIFIER_MAX_AMOUNT,
+    QUALIFIER_MIN_AMOUNT,
+    QualifierFlag,
+    ReissueAsset,
+    RestrictedFlag,
+    UNIQUE_ASSET_AMOUNT,
+    VerifierString,
+    asset_name_type,
+    burn_requirement,
+    is_amount_valid_with_units,
+    parent_name,
+    parse_asset_script,
+    parse_null_asset_script,
+)
+from .verifier import VerifierError, evaluate_verifier, is_verifier_valid
+
+
+class AssetError(Exception):
+    def __init__(self, code: str, reason: str = ""):
+        super().__init__(f"{code}: {reason}" if reason else code)
+        self.code = code
+        self.reason = reason
+
+
+@dataclass
+class AssetMeta:
+    """ref CDatabasedAssetData."""
+
+    asset: NewAsset
+    height: int
+    issuing_txid: int
+
+    def serialize_wire(self, w: ByteWriter) -> None:
+        self.asset.serialize(w)
+        w.u32(self.height)
+        w.hash256(self.issuing_txid)
+
+
+@dataclass
+class AssetTxUndo:
+    """Everything needed to reverse one tx's asset effects (journaled into
+    the block undo record, ref undo.h + assets/*db undo blocks)."""
+
+    balance_deltas: List[Tuple[str, bytes, int]] = field(default_factory=list)
+    created_assets: List[str] = field(default_factory=list)
+    reissues: List[Tuple[str, int, int, int, bytes]] = field(default_factory=list)
+    # (name, old_amount_added, old_units, old_reissuable, old_ipfs)
+    tag_changes: List[Tuple[str, bytes, bool]] = field(default_factory=list)
+    # (qualifier, h160, previous_state)
+    freeze_changes: List[Tuple[str, bytes, bool]] = field(default_factory=list)
+    global_changes: List[Tuple[str, bool]] = field(default_factory=list)
+    verifier_changes: List[Tuple[str, Optional[str]]] = field(default_factory=list)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.vector(
+            self.balance_deltas,
+            lambda wr, t: wr.var_str(t[0]).var_bytes(t[1]).i64(t[2]),
+        )
+        w.vector(self.created_assets, lambda wr, n: wr.var_str(n))
+        w.vector(
+            self.reissues,
+            lambda wr, t: wr.var_str(t[0]).i64(t[1]).u8(t[2]).u8(t[3]).var_bytes(t[4]),
+        )
+        w.vector(
+            self.tag_changes,
+            lambda wr, t: wr.var_str(t[0]).var_bytes(t[1]).boolean(t[2]),
+        )
+        w.vector(
+            self.freeze_changes,
+            lambda wr, t: wr.var_str(t[0]).var_bytes(t[1]).boolean(t[2]),
+        )
+        w.vector(
+            self.global_changes, lambda wr, t: wr.var_str(t[0]).boolean(t[1])
+        )
+        w.vector(
+            self.verifier_changes,
+            lambda wr, t: wr.var_str(t[0]).boolean(t[1] is not None).var_str(t[1] or ""),
+        )
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "AssetTxUndo":
+        u = cls()
+        u.balance_deltas = r.vector(
+            lambda rr: (rr.var_str(), rr.var_bytes(), rr.i64())
+        )
+        u.created_assets = r.vector(lambda rr: rr.var_str())
+        u.reissues = r.vector(
+            lambda rr: (rr.var_str(), rr.i64(), rr.u8(), rr.u8(), rr.var_bytes())
+        )
+        u.tag_changes = r.vector(
+            lambda rr: (rr.var_str(), rr.var_bytes(), rr.boolean())
+        )
+        u.freeze_changes = r.vector(
+            lambda rr: (rr.var_str(), rr.var_bytes(), rr.boolean())
+        )
+        u.global_changes = r.vector(lambda rr: (rr.var_str(), rr.boolean()))
+        u.verifier_changes = r.vector(
+            lambda rr: _read_verifier_change(rr)
+        )
+        return u
+
+
+class AssetsCache:
+    """ref assets.h:133 CAssetsCache."""
+
+    def __init__(self) -> None:
+        self.assets: Dict[str, AssetMeta] = {}
+        self.balances: Dict[Tuple[str, bytes], int] = {}
+        self.qualifier_tags: Dict[Tuple[str, bytes], bool] = {}
+        self.frozen_addresses: Dict[Tuple[str, bytes], bool] = {}
+        self.global_freezes: Dict[str, bool] = {}
+        self.verifiers: Dict[str, str] = {}
+
+    # ------------------------------------------------------------- queries
+
+    def exists(self, name: str) -> bool:
+        return name in self.assets
+
+    def get_asset(self, name: str) -> Optional[AssetMeta]:
+        return self.assets.get(name)
+
+    def balance(self, name: str, h160: bytes) -> int:
+        return self.balances.get((name, h160), 0)
+
+    def address_qualifiers(self, h160: bytes) -> Set[str]:
+        return {
+            q for (q, h), v in self.qualifier_tags.items() if h == h160 and v
+        }
+
+    def is_frozen(self, restricted: str, h160: bytes) -> bool:
+        return self.frozen_addresses.get((restricted, h160), False)
+
+    def is_globally_frozen(self, restricted: str) -> bool:
+        return self.global_freezes.get(restricted, False)
+
+    def list_assets(self, prefix: str = "") -> List[str]:
+        return sorted(n for n in self.assets if n.startswith(prefix))
+
+    def addresses_holding(self, name: str) -> Dict[bytes, int]:
+        return {
+            h: v for (n, h), v in self.balances.items() if n == name and v > 0
+        }
+
+    def assets_of_address(self, h160: bytes) -> Dict[str, int]:
+        return {
+            n: v for (n, h), v in self.balances.items() if h == h160 and v > 0
+        }
+
+    # -------------------------------------------------------------- apply
+
+    def check_and_apply_tx(
+        self, tx: Transaction, spent_coins: List[Tuple[bytes, "object"]], height: int
+    ) -> AssetTxUndo:
+        """spent_coins: [(script_pubkey_bytes, Coin)] for each input, in
+        order.  Raises AssetError; mutates state only on success."""
+        undo = AssetTxUndo()
+
+        # ---- gather inputs
+        asset_in: Dict[str, int] = {}
+        in_by_addr: Dict[Tuple[str, bytes], int] = {}
+        owner_tokens_in: Set[str] = set()
+        for spk_raw, _coin in spent_coins:
+            parsed = parse_asset_script(Script(spk_raw))
+            if parsed is None:
+                continue
+            kind, payload = parsed
+            if kind == "owner":
+                name, amount = payload.name, OWNER_ASSET_AMOUNT
+            elif kind == "transfer":
+                name, amount = payload.name, payload.amount
+            elif kind == "new":
+                name, amount = payload.name, payload.amount
+            else:  # reissue outputs spend as their asset
+                name, amount = payload.name, payload.amount
+            asset_in[name] = asset_in.get(name, 0) + amount
+            h = _script_h160(spk_raw)
+            if h is not None:
+                in_by_addr[(name, h)] = in_by_addr.get((name, h), 0) + amount
+            if name.endswith(OWNER_TAG):
+                owner_tokens_in.add(name)
+            if asset_name_type(name) in (AssetType.QUALIFIER, AssetType.SUB_QUALIFIER):
+                owner_tokens_in.add(name)
+
+        # ---- gather outputs
+        asset_out: Dict[str, int] = {}
+        out_by_addr: Dict[Tuple[str, bytes], int] = {}
+        new_assets: List[Tuple[NewAsset, bytes]] = []
+        owner_outs: List[Tuple[str, bytes]] = []
+        reissues: List[Tuple[ReissueAsset, bytes]] = []
+        transfers: List[Tuple[AssetTransfer, bytes]] = []
+        null_tags: List[Tuple[bytes, NullAssetTxData]] = []
+        global_ops: List[NullAssetTxData] = []
+        verifier_out: Optional[str] = None
+        burns: Dict[bytes, int] = {}  # script raw -> value
+
+        for out in tx.vout:
+            spk = Script(out.script_pubkey)
+            parsed = parse_asset_script(spk)
+            if parsed is not None:
+                kind, payload = parsed
+                h = _script_h160(out.script_pubkey)
+                if h is None:
+                    raise AssetError("bad-asset-destination")
+                if kind == "new":
+                    new_assets.append((payload, h))
+                    asset_out[payload.name] = (
+                        asset_out.get(payload.name, 0) + payload.amount
+                    )
+                    out_by_addr[(payload.name, h)] = (
+                        out_by_addr.get((payload.name, h), 0) + payload.amount
+                    )
+                elif kind == "owner":
+                    owner_outs.append((payload.name, h))
+                    asset_out[payload.name] = (
+                        asset_out.get(payload.name, 0) + OWNER_ASSET_AMOUNT
+                    )
+                    out_by_addr[(payload.name, h)] = (
+                        out_by_addr.get((payload.name, h), 0) + OWNER_ASSET_AMOUNT
+                    )
+                elif kind == "reissue":
+                    reissues.append((payload, h))
+                    asset_out[payload.name] = (
+                        asset_out.get(payload.name, 0) + payload.amount
+                    )
+                    out_by_addr[(payload.name, h)] = (
+                        out_by_addr.get((payload.name, h), 0) + payload.amount
+                    )
+                else:
+                    transfers.append((payload, h))
+                    asset_out[payload.name] = (
+                        asset_out.get(payload.name, 0) + payload.amount
+                    )
+                    out_by_addr[(payload.name, h)] = (
+                        out_by_addr.get((payload.name, h), 0) + payload.amount
+                    )
+                continue
+            nres = parse_null_asset_script(spk)
+            if nres is not None:
+                if nres[0] == "tag":
+                    null_tags.append((nres[1], nres[2]))
+                elif nres[0] == "global":
+                    global_ops.append(nres[1])
+                else:
+                    verifier_out = nres[1].verifier
+                continue
+            # plain output: track burn totals
+            burns[out.script_pubkey] = burns.get(out.script_pubkey, 0) + out.value
+
+        # ---- per-operation validation + state mutation
+
+        issued_names = set()
+        for asset, h in new_assets:
+            self._check_issue(asset, tx, owner_tokens_in, owner_outs, burns,
+                              verifier_out)
+            issued_names.add(asset.name)
+            self.assets[asset.name] = AssetMeta(asset, height, tx.txid)
+            undo.created_assets.append(asset.name)
+            if asset_name_type(asset.name) == AssetType.RESTRICTED:
+                undo.verifier_changes.append(
+                    (asset.name, self.verifiers.get(asset.name))
+                )
+                self.verifiers[asset.name] = verifier_out or "true"
+
+        for name, h in owner_outs:
+            base = name[:-1]
+            if base in issued_names:
+                # owner token minted alongside root issuance
+                if name in self.assets:
+                    raise AssetError("owner-already-exists", name)
+                owner_meta = NewAsset(name=name, amount=OWNER_ASSET_AMOUNT,
+                                      units=0, reissuable=0)
+                self.assets[name] = AssetMeta(owner_meta, height, tx.txid)
+                undo.created_assets.append(name)
+            else:
+                # moving an existing owner token: needs matching input
+                if asset_in.get(name, 0) < OWNER_ASSET_AMOUNT:
+                    raise AssetError("owner-token-not-in-inputs", name)
+
+        for re_asset, h in reissues:
+            self._apply_reissue(re_asset, owner_tokens_in, burns, undo)
+
+        for transfer, h in transfers:
+            self._check_transfer(
+                transfer, asset_in, issued_names, in_by_addr, height
+            )
+            if transfer.name.startswith("$"):
+                # change back to a source address of the same asset is
+                # exempt from the verifier (ref restricted transfer rules)
+                sources = {
+                    ah for (n, ah) in in_by_addr if n == transfer.name
+                }
+                if h not in sources:
+                    self.check_restricted_destination(transfer.name, h)
+
+        # conservation: for every name, inputs + minted == outputs
+        minted: Dict[str, int] = {}
+        for asset, _h in new_assets:
+            minted[asset.name] = minted.get(asset.name, 0) + asset.amount
+        for name, _h in owner_outs:
+            if name[:-1] in issued_names:
+                minted[name] = minted.get(name, 0) + OWNER_ASSET_AMOUNT
+        for re_asset, _h in reissues:
+            minted[re_asset.name] = minted.get(re_asset.name, 0) + re_asset.amount
+        for name in set(asset_out) | set(asset_in):
+            available = asset_in.get(name, 0) + minted.get(name, 0)
+            if asset_out.get(name, 0) != available:
+                raise AssetError(
+                    "asset-amount-mismatch",
+                    f"{name}: in+minted {available} != out {asset_out.get(name, 0)}",
+                )
+
+        # null-data ops
+        for addr_h, data in null_tags:
+            self._apply_tag(addr_h, data, owner_tokens_in, burns, undo)
+        for data in global_ops:
+            self._apply_global(data, owner_tokens_in, undo)
+
+        # balance bookkeeping
+        for (name, h), amount in in_by_addr.items():
+            self._adjust_balance(name, h, -amount, undo)
+        for (name, h), amount in out_by_addr.items():
+            self._adjust_balance(name, h, amount, undo)
+        return undo
+
+    # ------------------------------------------------------------ helpers
+
+    def _check_issue(self, asset: NewAsset, tx, owner_tokens_in, owner_outs,
+                     burns, verifier_out) -> None:
+        t = asset_name_type(asset.name)
+        if t in (AssetType.INVALID, AssetType.OWNER):
+            raise AssetError("bad-asset-name", asset.name)
+        if self.exists(asset.name):
+            raise AssetError("asset-already-exists", asset.name)
+        if not 0 <= asset.units <= MAX_UNIT:
+            raise AssetError("bad-asset-units")
+        if asset.amount <= 0 or asset.amount > MAX_MONEY:
+            raise AssetError("bad-asset-amount")
+        if not is_amount_valid_with_units(asset.amount, asset.units):
+            raise AssetError("amount-not-divisible-by-units")
+        if t == AssetType.UNIQUE and (
+            asset.amount != UNIQUE_ASSET_AMOUNT or asset.units != 0
+            or asset.reissuable
+        ):
+            raise AssetError("bad-unique-asset")
+        if t in (AssetType.QUALIFIER, AssetType.SUB_QUALIFIER):
+            if not QUALIFIER_MIN_AMOUNT <= asset.amount <= QUALIFIER_MAX_AMOUNT:
+                raise AssetError("bad-qualifier-amount")
+            if asset.units != 0 or asset.reissuable:
+                raise AssetError("bad-qualifier-asset")
+        if t == AssetType.RESTRICTED:
+            if verifier_out is None or not is_verifier_valid(verifier_out):
+                raise AssetError("missing-or-bad-verifier")
+        # ownership proof for non-root kinds (ref CheckIssueDataTx)
+        parent = parent_name(asset.name)
+        if t != AssetType.ROOT and t not in (AssetType.QUALIFIER,):
+            required_owner = (parent or "") + OWNER_TAG
+            if t == AssetType.SUB_QUALIFIER:
+                # sub-qualifier issuance needs the parent qualifier token
+                if parent not in owner_tokens_in:
+                    raise AssetError("missing-parent-qualifier", parent or "")
+            elif required_owner not in owner_tokens_in:
+                raise AssetError("missing-owner-token", required_owner)
+        if t == AssetType.ROOT:
+            # root issuance must mint its owner token (ref CheckIssueBurnTx)
+            if not any(name == asset.name + OWNER_TAG for name, _ in owner_outs):
+                raise AssetError("missing-owner-output", asset.name)
+        # burn requirement (ref assets.h:465 CheckIssueBurnTx)
+        required, script = burn_requirement(t)
+        if burns.get(script.raw, 0) < required:
+            raise AssetError("missing-burn", f"{asset.name} needs {required}")
+
+    def _apply_reissue(self, re_asset: ReissueAsset, owner_tokens_in, burns,
+                       undo: AssetTxUndo) -> None:
+        meta = self.assets.get(re_asset.name)
+        if meta is None:
+            raise AssetError("reissue-nonexistent", re_asset.name)
+        if not meta.asset.reissuable:
+            raise AssetError("asset-not-reissuable", re_asset.name)
+        base = re_asset.name[1:] if re_asset.name.startswith("$") else re_asset.name
+        owner = base + OWNER_TAG
+        if owner not in owner_tokens_in:
+            raise AssetError("missing-owner-token", owner)
+        if re_asset.amount < 0:
+            raise AssetError("bad-reissue-amount")
+        if meta.asset.amount + re_asset.amount > MAX_MONEY:
+            raise AssetError("reissue-exceeds-max-money")
+        new_units = re_asset.units_signed
+        if new_units != -1 and new_units < meta.asset.units:
+            raise AssetError("units-cannot-decrease")
+        required, script = burn_requirement(AssetType.REISSUE)
+        if burns.get(script.raw, 0) < required:
+            raise AssetError("missing-burn", "reissue")
+        undo.reissues.append(
+            (
+                re_asset.name,
+                re_asset.amount,
+                meta.asset.units,
+                meta.asset.reissuable,
+                meta.asset.ipfs_hash,
+            )
+        )
+        meta.asset.amount += re_asset.amount
+        if new_units != -1:
+            meta.asset.units = new_units
+        meta.asset.reissuable = re_asset.reissuable
+        if re_asset.ipfs_hash:
+            meta.asset.ipfs_hash = re_asset.ipfs_hash
+            meta.asset.has_ipfs = 1
+
+    def _check_transfer(self, transfer: AssetTransfer, asset_in, issued_names,
+                        in_by_addr, height) -> None:
+        if transfer.amount <= 0:
+            raise AssetError("bad-transfer-amount", transfer.name)
+        name = transfer.name
+        if not self.exists(name) and name not in issued_names:
+            # owner tokens exist implicitly once minted
+            raise AssetError("transfer-nonexistent-asset", name)
+        if asset_in.get(name, 0) <= 0 and name not in issued_names:
+            raise AssetError("transfer-without-input", name)
+        # restricted semantics (ref CheckRestrictedAssetTransferInputs)
+        if name.startswith("$"):
+            if self.is_globally_frozen(name):
+                raise AssetError("restricted-globally-frozen", name)
+            for (n, h), amt in in_by_addr.items():
+                if n == name and self.is_frozen(name, h):
+                    raise AssetError("restricted-source-frozen", name)
+
+    def check_restricted_destination(self, name: str, dest_h160: bytes) -> None:
+        """Verifier + freeze check for a restricted transfer destination."""
+        if not name.startswith("$"):
+            return
+        if self.is_frozen(name, dest_h160):
+            raise AssetError("restricted-dest-frozen", name)
+        verifier = self.verifiers.get(name, "true")
+        try:
+            ok = evaluate_verifier(verifier, self.address_qualifiers(dest_h160))
+        except VerifierError as e:
+            raise AssetError("bad-verifier", str(e))
+        if not ok:
+            raise AssetError("restricted-verifier-failed", name)
+
+    def _apply_tag(self, addr_h, data: NullAssetTxData, owner_tokens_in, burns,
+                   undo: AssetTxUndo) -> None:
+        t = asset_name_type(data.asset_name)
+        if t in (AssetType.QUALIFIER, AssetType.SUB_QUALIFIER):
+            if data.asset_name not in owner_tokens_in:
+                raise AssetError("missing-qualifier-token", data.asset_name)
+            if data.flag == QualifierFlag.ADD:
+                required, script = burn_requirement(AssetType.NULL_ADD_QUALIFIER)
+                if burns.get(script.raw, 0) < required:
+                    raise AssetError("missing-burn", "qualifier-tag")
+            key = (data.asset_name, addr_h)
+            undo.tag_changes.append(
+                (data.asset_name, addr_h, self.qualifier_tags.get(key, False))
+            )
+            self.qualifier_tags[key] = data.flag == QualifierFlag.ADD
+        elif t == AssetType.RESTRICTED:
+            owner = data.asset_name[1:] + OWNER_TAG
+            if owner not in owner_tokens_in:
+                raise AssetError("missing-owner-token", owner)
+            key = (data.asset_name, addr_h)
+            undo.freeze_changes.append(
+                (data.asset_name, addr_h, self.frozen_addresses.get(key, False))
+            )
+            self.frozen_addresses[key] = (
+                data.flag == RestrictedFlag.FREEZE_ADDRESS
+            )
+        else:
+            raise AssetError("bad-null-asset-data", data.asset_name)
+
+    def _apply_global(self, data: NullAssetTxData, owner_tokens_in,
+                      undo: AssetTxUndo) -> None:
+        if asset_name_type(data.asset_name) != AssetType.RESTRICTED:
+            raise AssetError("bad-global-restriction", data.asset_name)
+        owner = data.asset_name[1:] + OWNER_TAG
+        if owner not in owner_tokens_in:
+            raise AssetError("missing-owner-token", owner)
+        undo.global_changes.append(
+            (data.asset_name, self.global_freezes.get(data.asset_name, False))
+        )
+        self.global_freezes[data.asset_name] = (
+            data.flag == RestrictedFlag.GLOBAL_FREEZE
+        )
+
+    def _adjust_balance(self, name: str, h160: bytes, delta: int,
+                        undo: AssetTxUndo) -> None:
+        key = (name, h160)
+        self.balances[key] = self.balances.get(key, 0) + delta
+        if self.balances[key] == 0:
+            del self.balances[key]
+        undo.balance_deltas.append((name, h160, delta))
+
+    # --------------------------------------------------------------- undo
+
+    def undo_tx(self, undo: AssetTxUndo) -> None:
+        for name, h160, delta in reversed(undo.balance_deltas):
+            key = (name, h160)
+            self.balances[key] = self.balances.get(key, 0) - delta
+            if self.balances[key] == 0:
+                del self.balances[key]
+        for name, amount, units, reissuable, ipfs in reversed(undo.reissues):
+            meta = self.assets[name]
+            meta.asset.amount -= amount
+            meta.asset.units = units
+            meta.asset.reissuable = reissuable
+            meta.asset.ipfs_hash = ipfs
+            meta.asset.has_ipfs = 1 if ipfs else 0
+        for name in reversed(undo.created_assets):
+            self.assets.pop(name, None)
+        for q, h, prev in reversed(undo.tag_changes):
+            self.qualifier_tags[(q, h)] = prev
+        for r, h, prev in reversed(undo.freeze_changes):
+            self.frozen_addresses[(r, h)] = prev
+        for r, prev in reversed(undo.global_changes):
+            self.global_freezes[r] = prev
+        for name, prev in reversed(undo.verifier_changes):
+            if prev is None:
+                self.verifiers.pop(name, None)
+            else:
+                self.verifiers[name] = prev
+
+    # --------------------------------------------------------- persistence
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.compact_size(len(self.assets))
+        for name, meta in self.assets.items():
+            meta.asset.serialize(w)
+            w.u32(meta.height)
+            w.hash256(meta.issuing_txid)
+        w.compact_size(len(self.balances))
+        for (name, h), v in self.balances.items():
+            w.var_str(name)
+            w.var_bytes(h)
+            w.i64(v)
+        w.compact_size(len(self.qualifier_tags))
+        for (q, h), v in self.qualifier_tags.items():
+            w.var_str(q)
+            w.var_bytes(h)
+            w.boolean(v)
+        w.compact_size(len(self.frozen_addresses))
+        for (r, h), v in self.frozen_addresses.items():
+            w.var_str(r)
+            w.var_bytes(h)
+            w.boolean(v)
+        w.compact_size(len(self.global_freezes))
+        for r, v in self.global_freezes.items():
+            w.var_str(r)
+            w.boolean(v)
+        w.compact_size(len(self.verifiers))
+        for r, v in self.verifiers.items():
+            w.var_str(r)
+            w.var_str(v)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "AssetsCache":
+        c = cls()
+        for _ in range(r.compact_size()):
+            asset = NewAsset.deserialize(r)
+            height = r.u32()
+            txid = r.hash256()
+            c.assets[asset.name] = AssetMeta(asset, height, txid)
+        for _ in range(r.compact_size()):
+            name, h, v = r.var_str(), r.var_bytes(), r.i64()
+            c.balances[(name, h)] = v
+        for _ in range(r.compact_size()):
+            q, h, v = r.var_str(), r.var_bytes(), r.boolean()
+            c.qualifier_tags[(q, h)] = v
+        for _ in range(r.compact_size()):
+            rr, h, v = r.var_str(), r.var_bytes(), r.boolean()
+            c.frozen_addresses[(rr, h)] = v
+        for _ in range(r.compact_size()):
+            rr, v = r.var_str(), r.boolean()
+            c.global_freezes[rr] = v
+        for _ in range(r.compact_size()):
+            rr, v = r.var_str(), r.var_str()
+            c.verifiers[rr] = v
+        return c
+
+
+def _read_verifier_change(rr: ByteReader):
+    name = rr.var_str()
+    has = rr.boolean()
+    val = rr.var_str()
+    return (name, val if has else None)
+
+
+def _script_h160(spk_raw: bytes) -> Optional[bytes]:
+    dest = extract_destination(Script(spk_raw))
+    if isinstance(dest, KeyID):
+        return dest.h
+    return None

@@ -1,0 +1,271 @@
+"""Asset transaction construction on top of the wallet.
+
+Parity: reference src/assets/assets.cpp CreateAssetTransaction /
+CreateTransferAssetTransaction / CreateReissueAssetTransaction and the
+wallet entry points CWallet::CreateTransactionWith{Assets,TransferAsset,
+ReissueAsset} (ref wallet.cpp:3225-3274).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core.amount import COIN
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+from ..script.script import Script
+from ..script.sign import sign_tx_input
+from ..script.standard import KeyID, p2pkh_script
+from .cache import AssetError
+from .types import (
+    AssetTransfer,
+    AssetType,
+    NewAsset,
+    NullAssetTxData,
+    OWNER_ASSET_AMOUNT,
+    OWNER_TAG,
+    OwnerPayload,
+    ReissueAsset,
+    VerifierString,
+    append_asset_payload,
+    asset_name_type,
+    burn_requirement,
+    global_restriction_script,
+    null_asset_data_script,
+    parent_name,
+    parse_asset_script,
+    verifier_string_script,
+)
+
+FEE = 50_000  # flat fee for asset operations (wallet-policy, not consensus)
+
+
+class AssetBuildError(Exception):
+    pass
+
+
+def _fund_and_sign(wallet, vin_assets, vout, extra_needed: int) -> Transaction:
+    """Add plain-coin funding inputs + change, then sign everything."""
+    picked, total = wallet.select_coins(extra_needed + FEE)
+    vin = list(vin_assets) + [
+        TxIn(prevout=op, sequence=0xFFFFFFFE) for op, _ in picked
+    ]
+    change = total - extra_needed - FEE
+    if change > 5000:
+        vout = vout + [TxOut(value=change, script_pubkey=wallet.get_change_address_script())]
+    tx = Transaction(version=2, vin=vin, vout=vout, locktime=0)
+    # sign every input (asset inputs are P2PKH-prefixed, same signer)
+    all_prevs = [p for p in vin_assets] + picked
+    for i, txin in enumerate(tx.vin):
+        spk = _prev_script(wallet, txin.prevout, picked)
+        sign_tx_input(wallet.keystore, tx, i, spk)
+    return tx
+
+
+def _prev_script(wallet, outpoint: OutPoint, picked) -> Script:
+    for op, out in picked:
+        if op == outpoint:
+            return Script(out.script_pubkey)
+    wtx = wallet.wtx.get(outpoint.txid)
+    if wtx is None:
+        raise AssetBuildError(f"unknown prevout {outpoint}")
+    return Script(wtx.tx.vout[outpoint.n].script_pubkey)
+
+
+def _wallet_asset_utxos(wallet) -> List[Tuple[OutPoint, TxOut, str, int]]:
+    """(outpoint, txout, asset_name, amount) for asset-carrying coins."""
+    out = []
+    for op, txout, conf in wallet.unspent_coins(min_conf=0):
+        parsed = parse_asset_script(Script(txout.script_pubkey))
+        if parsed is None:
+            continue
+        kind, payload = parsed
+        if kind == "owner":
+            out.append((op, txout, payload.name, OWNER_ASSET_AMOUNT))
+        else:
+            out.append((op, txout, payload.name, payload.amount))
+    return out
+
+
+def wallet_asset_balances(wallet) -> dict:
+    balances: dict = {}
+    for _, _, name, amount in _wallet_asset_utxos(wallet):
+        balances[name] = balances.get(name, 0) + amount
+    return balances
+
+
+def _find_token(wallet, name: str) -> Tuple[OutPoint, TxOut]:
+    for op, txout, n, _amt in _wallet_asset_utxos(wallet):
+        if n == name:
+            return op, txout
+    raise AssetBuildError(f"wallet does not hold {name}")
+
+
+def _dest_script(wallet, dest_h160: Optional[bytes]) -> Script:
+    if dest_h160 is None:
+        from ..crypto.hashes import hash160  # noqa — used via wallet change key
+
+        raw = wallet.get_change_address_script()
+        return Script(raw)
+    return p2pkh_script(KeyID(dest_h160))
+
+
+def build_issue(
+    wallet,
+    asset: NewAsset,
+    to_h160: Optional[bytes] = None,
+    verifier: Optional[str] = None,
+) -> Transaction:
+    """ref CreateAssetTransaction (assets.cpp)."""
+    t = asset_name_type(asset.name)
+    if t in (AssetType.INVALID, AssetType.OWNER):
+        raise AssetBuildError(f"invalid asset name {asset.name!r}")
+    burn_amount, burn_spk = burn_requirement(t)
+    base = _dest_script(wallet, to_h160)
+
+    vin_assets: List[TxIn] = []
+    vout: List[TxOut] = [TxOut(value=burn_amount, script_pubkey=burn_spk.raw)]
+
+    # non-root kinds prove ownership by spending + returning the owner token
+    parent = parent_name(asset.name)
+    if t in (AssetType.SUB, AssetType.UNIQUE, AssetType.MSGCHANNEL,
+             AssetType.RESTRICTED):
+        owner_name = (parent or "") + OWNER_TAG
+        op_owner, owner_out = _find_token(wallet, owner_name)
+        vin_assets.append(TxIn(prevout=op_owner, sequence=0xFFFFFFFE))
+        vout.append(
+            TxOut(0, append_asset_payload(
+                Script(wallet.get_change_address_script()),
+                "owner", OwnerPayload(owner_name)).raw)
+        )
+    elif t == AssetType.SUB_QUALIFIER:
+        op_q, q_out = _find_token(wallet, parent or "")
+        parsed = parse_asset_script(Script(q_out.script_pubkey))
+        vin_assets.append(TxIn(prevout=op_q, sequence=0xFFFFFFFE))
+        vout.append(
+            TxOut(0, append_asset_payload(
+                Script(wallet.get_change_address_script()),
+                "transfer", AssetTransfer(parent or "", parsed[1].amount)).raw)
+        )
+
+    if t == AssetType.RESTRICTED:
+        vout.append(TxOut(0, verifier_string_script(
+            VerifierString(verifier or "true")).raw))
+
+    vout.append(TxOut(0, append_asset_payload(base, "new", asset).raw))
+    if t == AssetType.ROOT:
+        vout.append(
+            TxOut(0, append_asset_payload(base, "owner",
+                                          OwnerPayload(asset.name + OWNER_TAG)).raw)
+        )
+    return _fund_and_sign(wallet, vin_assets, vout, burn_amount)
+
+
+def build_transfer(
+    wallet, name: str, amount: int, dest_h160: bytes,
+    message: bytes = b"", expire: int = 0,
+) -> Transaction:
+    """ref CreateTransferAssetTransaction."""
+    have = 0
+    vin_assets: List[TxIn] = []
+    src_script: Optional[Script] = None
+    for op, txout, n, amt in _wallet_asset_utxos(wallet):
+        if n != name:
+            continue
+        vin_assets.append(TxIn(prevout=op, sequence=0xFFFFFFFE))
+        if src_script is None:
+            src_script = Script(txout.script_pubkey[:25])  # embedded P2PKH
+        have += amt
+        if have >= amount:
+            break
+    if have < amount:
+        raise AssetBuildError(f"insufficient {name}: have {have}, need {amount}")
+    vout = [
+        TxOut(0, append_asset_payload(
+            p2pkh_script(KeyID(dest_h160)), "transfer",
+            AssetTransfer(name, amount, message, expire)).raw)
+    ]
+    if have > amount:
+        # asset change returns to the source address: restricted assets may
+        # only change-back there without re-passing the verifier
+        change_base = src_script or Script(wallet.get_change_address_script())
+        vout.append(
+            TxOut(0, append_asset_payload(
+                change_base, "transfer",
+                AssetTransfer(name, have - amount)).raw)
+        )
+    return _fund_and_sign(wallet, vin_assets, vout, 0)
+
+
+def build_reissue(
+    wallet, reissue: ReissueAsset, to_h160: Optional[bytes] = None
+) -> Transaction:
+    """ref CreateReissueAssetTransaction."""
+    base_name = reissue.name[1:] if reissue.name.startswith("$") else reissue.name
+    owner_name = base_name + OWNER_TAG
+    op_owner, _ = _find_token(wallet, owner_name)
+    burn_amount, burn_spk = burn_requirement(AssetType.REISSUE)
+    vin_assets = [TxIn(prevout=op_owner, sequence=0xFFFFFFFE)]
+    vout = [
+        TxOut(value=burn_amount, script_pubkey=burn_spk.raw),
+        TxOut(0, append_asset_payload(
+            Script(wallet.get_change_address_script()), "owner",
+            OwnerPayload(owner_name)).raw),
+        TxOut(0, append_asset_payload(
+            _dest_script(wallet, to_h160), "reissue", reissue).raw),
+    ]
+    return _fund_and_sign(wallet, vin_assets, vout, burn_amount)
+
+
+def build_tag_address(
+    wallet, qualifier: str, target_h160: bytes, add: bool
+) -> Transaction:
+    """ref qualifier tag transactions (addtagtoaddress RPC)."""
+    op_q, q_out = _find_token(wallet, qualifier)
+    parsed = parse_asset_script(Script(q_out.script_pubkey))
+    vin_assets = [TxIn(prevout=op_q, sequence=0xFFFFFFFE)]
+    extra = 0
+    vout = []
+    if add:
+        burn_amount, burn_spk = burn_requirement(AssetType.NULL_ADD_QUALIFIER)
+        vout.append(TxOut(value=burn_amount, script_pubkey=burn_spk.raw))
+        extra = burn_amount
+    vout.append(
+        TxOut(0, append_asset_payload(
+            Script(wallet.get_change_address_script()), "transfer",
+            AssetTransfer(qualifier, parsed[1].amount)).raw)
+    )
+    vout.append(
+        TxOut(0, null_asset_data_script(
+            target_h160, NullAssetTxData(qualifier, 1 if add else 0)).raw)
+    )
+    return _fund_and_sign(wallet, vin_assets, vout, extra)
+
+
+def build_freeze_address(
+    wallet, restricted: str, target_h160: bytes, freeze: bool
+) -> Transaction:
+    owner_name = restricted[1:] + OWNER_TAG
+    op_owner, _ = _find_token(wallet, owner_name)
+    vin_assets = [TxIn(prevout=op_owner, sequence=0xFFFFFFFE)]
+    vout = [
+        TxOut(0, append_asset_payload(
+            Script(wallet.get_change_address_script()), "owner",
+            OwnerPayload(owner_name)).raw),
+        TxOut(0, null_asset_data_script(
+            target_h160, NullAssetTxData(restricted, 1 if freeze else 0)).raw),
+    ]
+    return _fund_and_sign(wallet, vin_assets, vout, 0)
+
+
+def build_global_freeze(wallet, restricted: str, freeze: bool) -> Transaction:
+    owner_name = restricted[1:] + OWNER_TAG
+    op_owner, _ = _find_token(wallet, owner_name)
+    vin_assets = [TxIn(prevout=op_owner, sequence=0xFFFFFFFE)]
+    vout = [
+        TxOut(0, append_asset_payload(
+            Script(wallet.get_change_address_script()), "owner",
+            OwnerPayload(owner_name)).raw),
+        TxOut(0, global_restriction_script(
+            NullAssetTxData(restricted, 3 if freeze else 2)).raw),
+    ]
+    return _fund_and_sign(wallet, vin_assets, vout, 0)

@@ -1,0 +1,427 @@
+"""Asset wire types + script envelopes + name validation.
+
+Parity: reference src/assets/assettypes.h — AssetType enum of 12/13 kinds
+(:21), CNewAsset (:97), CAssetTransfer (:187), CReissueAsset (:236),
+CNullAssetTxData (:276), CNullAssetTxVerifierString (:307) — and the name
+rules of src/assets/assets.cpp (IsAssetNameValid).  Script layout parity:
+P2PKH prefix + OP_ASSET + push("rvn" + kind + serialized payload) + OP_DROP
+(ref script.cpp IsAssetScript + assets.cpp ConstructTransaction).
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..core.amount import COIN
+from ..core.serialize import ByteReader, ByteWriter
+from ..crypto.hashes import hash160
+from ..script import opcodes as op
+from ..script.script import ASSET_MARKER, Script, push_data
+
+MAX_NAME_LENGTH = 31  # bytes incl. owner tag (ref assets.h MAX_ASSET_LENGTH-1)
+MIN_NAME_LENGTH = 3
+OWNER_TAG = "!"
+OWNER_ASSET_AMOUNT = 1 * COIN
+UNIQUE_ASSET_AMOUNT = 1 * COIN
+QUALIFIER_MIN_AMOUNT = 1 * COIN
+QUALIFIER_MAX_AMOUNT = 10 * COIN
+MAX_UNIT = 8
+
+
+class AssetType(enum.IntEnum):
+    """ref assettypes.h:21."""
+
+    ROOT = 0
+    SUB = 1
+    UNIQUE = 2
+    MSGCHANNEL = 3
+    QUALIFIER = 4
+    SUB_QUALIFIER = 5
+    RESTRICTED = 6
+    VOTE = 7
+    REISSUE = 8
+    OWNER = 9
+    NULL_ADD_QUALIFIER = 10
+    INVALID = 11
+
+
+class QualifierFlag(enum.IntEnum):
+    REMOVE = 0
+    ADD = 1
+
+
+class RestrictedFlag(enum.IntEnum):
+    UNFREEZE_ADDRESS = 0
+    FREEZE_ADDRESS = 1
+    GLOBAL_UNFREEZE = 2
+    GLOBAL_FREEZE = 3
+
+
+# --- name validation (ref assets.cpp IsAssetNameValid + regex set) ----------
+
+_ROOT_RE = re.compile(r"^[A-Z0-9._]{3,}$")
+_SUB_RE = re.compile(r"^[A-Z0-9._]+$")
+_UNIQUE_RE = re.compile(r"^[-A-Za-z0-9@$%&*()\[\]{}_.?:]+$")
+_CHANNEL_RE = re.compile(r"^[A-Z0-9._]+$")
+_DOUBLE_PUNCT = re.compile(r"[._]{2,}")
+_LEAD_TRAIL = re.compile(r"(^[._])|([._]$)")
+_CLORE_ROOT = re.compile(r"^CLORE$|^CLORE[._]|^CLOREC0IN", re.IGNORECASE)
+
+
+def asset_name_type(name: str) -> AssetType:
+    """Classify + validate; returns INVALID when malformed."""
+    if not name or len(name.encode()) > MAX_NAME_LENGTH:
+        return AssetType.INVALID
+    if name.endswith(OWNER_TAG):
+        base = name[:-1]
+        t = asset_name_type(base)
+        if t in (AssetType.ROOT, AssetType.SUB):
+            return AssetType.OWNER
+        return AssetType.INVALID
+    if name.startswith("$"):
+        body = name[1:]
+        if _ROOT_RE.match(body) and not _bad_punct(body) and not _CLORE_ROOT.match(body):
+            return AssetType.RESTRICTED
+        return AssetType.INVALID
+    if name.startswith("#"):
+        body = name[1:]
+        parts = body.split("/#")
+        for p in parts:
+            if not p or not _SUB_RE.match(p) or _bad_punct(p):
+                return AssetType.INVALID
+        if len(parts[0]) < MIN_NAME_LENGTH:
+            return AssetType.INVALID
+        return AssetType.SUB_QUALIFIER if len(parts) > 1 else AssetType.QUALIFIER
+    # channel: ROOT~CHANNEL
+    if "~" in name:
+        root, _, chan = name.partition("~")
+        if (
+            asset_name_type(root) in (AssetType.ROOT, AssetType.SUB)
+            and chan
+            and _CHANNEL_RE.match(chan)
+            and not _bad_punct(chan)
+            and len(chan) <= 12
+        ):
+            return AssetType.MSGCHANNEL
+        return AssetType.INVALID
+    # unique: PARENT#TAG
+    if "#" in name:
+        parent, _, tag = name.partition("#")
+        if (
+            asset_name_type(parent) in (AssetType.ROOT, AssetType.SUB)
+            and tag
+            and _UNIQUE_RE.match(tag)
+        ):
+            return AssetType.UNIQUE
+        return AssetType.INVALID
+    # sub: PARENT/SUB...
+    if "/" in name:
+        parts = name.split("/")
+        if asset_name_type(parts[0]) != AssetType.ROOT:
+            return AssetType.INVALID
+        for p in parts[1:]:
+            if not p or not _SUB_RE.match(p) or _bad_punct(p) or p[0].isdigit():
+                return AssetType.INVALID
+        return AssetType.SUB
+    # root
+    if (
+        _ROOT_RE.match(name)
+        and not _bad_punct(name)
+        and not name[0].isdigit()
+        and not _CLORE_ROOT.match(name)
+    ):
+        return AssetType.ROOT
+    return AssetType.INVALID
+
+
+def _bad_punct(s: str) -> bool:
+    return bool(_DOUBLE_PUNCT.search(s) or _LEAD_TRAIL.search(s))
+
+
+def is_asset_name_valid(name: str) -> bool:
+    return asset_name_type(name) != AssetType.INVALID
+
+
+def parent_name(name: str) -> Optional[str]:
+    """Owning root/sub for sub/unique/channel/sub-qualifier names."""
+    t = asset_name_type(name)
+    if t == AssetType.SUB:
+        return name.rsplit("/", 1)[0]
+    if t == AssetType.UNIQUE:
+        return name.rsplit("#", 1)[0]
+    if t == AssetType.MSGCHANNEL:
+        return name.rsplit("~", 1)[0]
+    if t == AssetType.SUB_QUALIFIER:
+        return name.rsplit("/#", 1)[0]
+    if t == AssetType.OWNER:
+        return name[:-1]
+    if t == AssetType.RESTRICTED:
+        return name[1:]  # $TOKEN is governed by TOKEN's owner
+    return None
+
+
+# --- units helpers ----------------------------------------------------------
+
+
+def is_amount_valid_with_units(amount: int, units: int) -> bool:
+    """Amount must be a multiple of 10^(8-units) (ref CheckAmountWithUnits)."""
+    if amount <= 0:
+        return False
+    return amount % (10 ** (MAX_UNIT - units)) == 0
+
+
+# --- payload types ----------------------------------------------------------
+
+
+@dataclass
+class NewAsset:
+    """ref assettypes.h:97 CNewAsset."""
+
+    name: str
+    amount: int
+    units: int = 0
+    reissuable: int = 1
+    has_ipfs: int = 0
+    ipfs_hash: bytes = b""
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.name)
+        w.i64(self.amount)
+        w.u8(self.units)
+        w.u8(self.reissuable)
+        w.u8(self.has_ipfs)
+        if self.has_ipfs:
+            w.write(self.ipfs_hash[:34].ljust(34, b"\x00"))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "NewAsset":
+        a = cls(name=r.var_str(), amount=r.i64(), units=r.u8(), reissuable=r.u8(),
+                has_ipfs=r.u8())
+        if a.has_ipfs:
+            a.ipfs_hash = r.read(34)
+        return a
+
+
+@dataclass
+class AssetTransfer:
+    """ref assettypes.h:187 CAssetTransfer (incl. RIP5 message fields)."""
+
+    name: str
+    amount: int
+    message: bytes = b""
+    expire_time: int = 0
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.name)
+        w.i64(self.amount)
+        if self.message:
+            w.write(self.message[:34].ljust(34, b"\x00"))
+            w.i64(self.expire_time)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "AssetTransfer":
+        t = cls(name=r.var_str(), amount=r.i64())
+        if r.remaining() >= 34:
+            t.message = r.read(34)
+            if r.remaining() >= 8:
+                t.expire_time = r.i64()
+        return t
+
+
+@dataclass
+class ReissueAsset:
+    """ref assettypes.h:236 CReissueAsset."""
+
+    name: str
+    amount: int
+    units: int = 0xFF  # -1 = unchanged
+    reissuable: int = 1
+    ipfs_hash: bytes = b""
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.name)
+        w.i64(self.amount)
+        w.u8(self.units & 0xFF)
+        w.u8(self.reissuable)
+        if self.ipfs_hash:
+            w.write(self.ipfs_hash[:34].ljust(34, b"\x00"))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "ReissueAsset":
+        a = cls(name=r.var_str(), amount=r.i64(), units=r.u8(), reissuable=r.u8())
+        if r.remaining() >= 34:
+            a.ipfs_hash = r.read(34)
+        return a
+
+    @property
+    def units_signed(self) -> int:
+        return -1 if self.units == 0xFF else self.units
+
+
+@dataclass
+class NullAssetTxData:
+    """ref assettypes.h:276 (qualifier tag / address freeze)."""
+
+    asset_name: str
+    flag: int
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.asset_name)
+        w.u8(self.flag & 0xFF)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "NullAssetTxData":
+        return cls(asset_name=r.var_str(), flag=r.u8())
+
+
+@dataclass
+class VerifierString:
+    """ref assettypes.h:307 CNullAssetTxVerifierString."""
+
+    verifier: str
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.verifier)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "VerifierString":
+        return cls(verifier=r.var_str())
+
+
+# --- script construction / parsing ------------------------------------------
+
+_KIND_BY_CHAR = {ord("q"): "new", ord("o"): "owner", ord("r"): "reissue",
+                 ord("t"): "transfer"}
+
+
+def append_asset_payload(base: Script, kind: str, payload_obj) -> Script:
+    """P2PKH + OP_ASSET + push(marker+kind+payload) + OP_DROP."""
+    char = {"new": b"q", "owner": b"o", "reissue": b"r", "transfer": b"t"}[kind]
+    w = ByteWriter()
+    payload_obj.serialize(w)
+    blob = ASSET_MARKER + char + w.getvalue()
+    return Script(base.raw + bytes([op.OP_ASSET]) + push_data(blob) + bytes([op.OP_DROP]))
+
+
+@dataclass
+class OwnerPayload:
+    name: str  # includes the trailing '!'
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.name)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "OwnerPayload":
+        return cls(name=r.var_str())
+
+
+def parse_asset_script(script: Script):
+    """Returns (kind, payload_object) or None.
+
+    kind in {"new","owner","reissue","transfer"}; payload is the matching
+    dataclass (ref assets.cpp AssetFromScript/TransferAssetFromScript/...).
+    """
+    info = script.asset_script_type()
+    if info is None:
+        return None
+    kind, start = info
+    body = script.raw[start:]
+    # strip the trailing OP_DROP if present
+    if body.endswith(bytes([op.OP_DROP])):
+        body = body[:-1]
+    r = ByteReader(body)
+    try:
+        if kind == "new":
+            return "new", NewAsset.deserialize(r)
+        if kind == "owner":
+            return "owner", OwnerPayload.deserialize(r)
+        if kind == "reissue":
+            return "reissue", ReissueAsset.deserialize(r)
+        return "transfer", AssetTransfer.deserialize(r)
+    except Exception:
+        return None
+
+
+def null_asset_data_script(address_h160: bytes, data: NullAssetTxData) -> Script:
+    """ref CNullAssetTxData::ConstructTransaction."""
+    w = ByteWriter()
+    data.serialize(w)
+    return Script(
+        bytes([op.OP_ASSET, op.OP_RESERVED])
+        + push_data(address_h160)
+        + push_data(w.getvalue())
+    )
+
+
+def global_restriction_script(data: NullAssetTxData) -> Script:
+    """ref ConstructGlobalRestrictionTransaction."""
+    w = ByteWriter()
+    data.serialize(w)
+    return Script(
+        bytes([op.OP_ASSET, op.OP_RESERVED, op.OP_RESERVED]) + push_data(w.getvalue())
+    )
+
+
+def verifier_string_script(verifier: VerifierString) -> Script:
+    w = ByteWriter()
+    verifier.serialize(w)
+    return Script(
+        bytes([op.OP_ASSET, op.OP_RESERVED, op.OP_RESERVED]) + push_data(w.getvalue())
+    )
+
+
+def parse_null_asset_script(script: Script):
+    """Returns ("tag", h160, NullAssetTxData) | ("global", NullAssetTxData)
+    | ("verifier", VerifierString) | None."""
+    raw = script.raw
+    if len(raw) < 3 or raw[0] != op.OP_ASSET or raw[1] != op.OP_RESERVED:
+        return None
+    try:
+        if raw[2] == op.OP_RESERVED:
+            parsed = list(Script(raw[3:]).ops())
+            if len(parsed) != 1 or parsed[0].data is None:
+                return None
+            r = ByteReader(parsed[0].data)
+            name = r.var_str()
+            if r.remaining() == 1:
+                return "global", NullAssetTxData(name, r.u8())
+            return "verifier", VerifierString(name)
+        parsed = list(Script(raw[2:]).ops())
+        if len(parsed) != 2 or parsed[0].data is None or parsed[1].data is None:
+            return None
+        r = ByteReader(parsed[1].data)
+        return "tag", parsed[0].data, NullAssetTxData.deserialize(r)
+    except Exception:
+        return None
+
+
+# --- burn configuration (per-network; ref chainparams.cpp:225-239) ----------
+
+BURN_AMOUNTS = {
+    AssetType.ROOT: 500 * COIN,
+    AssetType.SUB: 100 * COIN,
+    AssetType.UNIQUE: 5 * COIN,
+    AssetType.MSGCHANNEL: 100 * COIN,
+    AssetType.QUALIFIER: 1000 * COIN,
+    AssetType.SUB_QUALIFIER: 100 * COIN,
+    AssetType.RESTRICTED: 1500 * COIN,
+    AssetType.REISSUE: 100 * COIN,
+    AssetType.NULL_ADD_QUALIFIER: COIN // 10,
+}
+
+
+def burn_script(asset_type: AssetType) -> Script:
+    """Deterministic per-purpose burn destinations (the reference pins
+    vanity addresses per network, chainparams.cpp:239; ours derive the
+    hash160 from a fixed tag so they are provably key-less)."""
+    from ..script.standard import KeyID, p2pkh_script
+
+    tag = f"nodexa-burn-{int(asset_type)}".encode()
+    return p2pkh_script(KeyID(hash160(tag)))
+
+
+def burn_requirement(asset_type: AssetType) -> Tuple[int, Script]:
+    return BURN_AMOUNTS[asset_type], burn_script(asset_type)

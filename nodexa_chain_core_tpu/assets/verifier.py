@@ -1,0 +1,97 @@
+"""Restricted-asset verifier expressions.
+
+Parity: reference src/LibBoolEE.{h,cpp} — boolean expressions over
+qualifier names with ``& | ! ( )`` plus ``true``/``false`` literals,
+evaluated against the qualifier tags held by a destination address (ref
+assets.cpp ContextualCheckVerifierString).  Clean recursive-descent parser
+instead of the reference's string-splitting evaluator.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Set
+
+_TOKEN_RE = re.compile(r"\s*(\(|\)|&|\||!|[A-Z0-9._#/]+|true|false)", re.IGNORECASE)
+
+
+class VerifierError(Exception):
+    pass
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.tokens = []
+        pos = 0
+        s = text.strip()
+        while pos < len(s):
+            m = _TOKEN_RE.match(s, pos)
+            if not m:
+                raise VerifierError(f"bad verifier token at {s[pos:]!r}")
+            self.tokens.append(m.group(1))
+            pos = m.end()
+        self.i = 0
+
+    def peek(self):
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    # grammar: expr := term ('|' term)* ; term := factor ('&' factor)* ;
+    # factor := '!' factor | '(' expr ')' | NAME | true | false
+
+    def expr(self, have: Set[str]) -> bool:
+        v = self.term(have)
+        while self.peek() == "|":
+            self.next()
+            v = self.term(have) or v
+        return v
+
+    def term(self, have: Set[str]) -> bool:
+        v = self.factor(have)
+        while self.peek() == "&":
+            self.next()
+            v = self.factor(have) and v
+        return v
+
+    def factor(self, have: Set[str]) -> bool:
+        t = self.next()
+        if t is None:
+            raise VerifierError("unexpected end of verifier")
+        if t == "!":
+            return not self.factor(have)
+        if t == "(":
+            v = self.expr(have)
+            if self.next() != ")":
+                raise VerifierError("missing )")
+            return v
+        if t.lower() == "true":
+            return True
+        if t.lower() == "false":
+            return False
+        if t in ("&", "|", ")"):
+            raise VerifierError(f"unexpected {t!r}")
+        name = t if t.startswith("#") else "#" + t
+        return name in have
+
+
+def evaluate_verifier(expression: str, qualifiers: Set[str]) -> bool:
+    """True when `qualifiers` (names like "#KYC") satisfy the expression."""
+    if expression.strip() in ("", "true"):
+        return True
+    p = _Parser(expression)
+    result = p.expr(qualifiers)
+    if p.peek() is not None:
+        raise VerifierError(f"trailing tokens: {p.tokens[p.i:]}")
+    return result
+
+
+def is_verifier_valid(expression: str) -> bool:
+    try:
+        evaluate_verifier(expression, set())
+        return True
+    except VerifierError:
+        return False

@@ -34,16 +34,25 @@ class TxUndo:
 
 @dataclass
 class BlockUndo(Serializable):
-    """Undo records for all non-coinbase txs (ref undo.h CBlockUndo)."""
+    """Undo records for all non-coinbase txs (ref undo.h CBlockUndo) plus
+    the asset-state journal (the reference persists asset undo data through
+    its asset DBs; here it rides the same undo record)."""
 
     vtxundo: List[TxUndo] = field(default_factory=list)
+    asset_undos: list = field(default_factory=list)  # List[AssetTxUndo]
 
     def serialize(self, w: ByteWriter) -> None:
         w.vector(self.vtxundo, lambda wr, u: u.serialize(wr))
+        w.vector(self.asset_undos, lambda wr, u: u.serialize(wr))
 
     @classmethod
     def deserialize(cls, r: ByteReader) -> "BlockUndo":
-        return cls(vtxundo=r.vector(TxUndo.deserialize))
+        from ..assets.cache import AssetTxUndo
+
+        out = cls(vtxundo=r.vector(TxUndo.deserialize))
+        if r.remaining():
+            out.asset_undos = r.vector(AssetTxUndo.deserialize)
+        return out
 
 
 class AppendFile:

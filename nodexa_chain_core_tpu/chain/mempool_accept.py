@@ -102,6 +102,28 @@ def accept_to_memory_pool(
         if not ok:
             raise MempoolAcceptError("mandatory-script-verify-flag-failed", err)
 
+    # asset-rule validation: apply + immediate undo == pure check (ref
+    # AcceptToMemoryPoolWorker's CheckTxAssets).  Chained asset spends of
+    # in-mempool parents defer to block validation, as the pool cache
+    # doesn't model unconfirmed asset state.
+    spent_pairs = []
+    all_confirmed = True
+    for txin in tx.vin:
+        coin = view.get_coin(txin.prevout)
+        if coin is not None and coin.height == CoinsViewMemPool.MEMPOOL_HEIGHT:
+            all_confirmed = False
+        spent_pairs.append((coin.out.script_pubkey, coin))
+    if all_confirmed and height >= chainstate.params.consensus.asset_activation_height:
+        from ..assets.cache import AssetError
+
+        try:
+            asset_undo = chainstate.assets.check_and_apply_tx(
+                tx, spent_pairs, height
+            )
+            chainstate.assets.undo_tx(asset_undo)
+        except AssetError as e:
+            raise MempoolAcceptError("bad-txns-assets", str(e))
+
     entry = MempoolEntry(
         tx=tx, fee=fee, time=_time.time(), height=height, sigops=sigops // 4
     )

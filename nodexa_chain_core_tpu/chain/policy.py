@@ -44,8 +44,16 @@ DUST_FEE = FeeRate(DUST_RELAY_TX_FEE)
 
 
 def is_dust(out: TxOut, dust_fee: FeeRate = DUST_FEE) -> bool:
-    """ref policy.cpp IsDust: output value below the cost of spending it."""
-    if Script(out.script_pubkey).is_unspendable():
+    """ref policy.cpp IsDust: output value below the cost of spending it.
+    Asset-carrying and asset-null outputs are exempt (they ride 0 value)."""
+    spk = Script(out.script_pubkey)
+    if spk.is_unspendable():
+        return False
+    if (
+        spk.is_asset_script()
+        or spk.is_null_asset_tx_data_script()
+        or spk.is_null_global_restriction_script()
+    ):
         return False
     # 148 bytes to spend a typical output + the output's own size
     spend_size = 148 + 8 + 1 + len(out.script_pubkey)

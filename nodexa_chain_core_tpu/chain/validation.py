@@ -102,6 +102,17 @@ class ChainState:
         self.checkqueue = (
             CheckQueue(script_check_threads) if script_check_threads > 0 else None
         )
+        # asset state (ref CAssetsCache wired through ConnectBlock,
+        # validation.cpp:10052)
+        from ..assets.cache import AssetsCache
+
+        raw_assets = self._chainstate_db.get(b"A")
+        if raw_assets:
+            from ..core.serialize import ByteReader as _BR
+
+            self.assets = AssetsCache.deserialize(_BR(raw_assets))
+        else:
+            self.assets = AssetsCache()
         self._load_or_init()
 
     # ------------------------------------------------------------------ init
@@ -265,46 +276,75 @@ class ChainState:
         sigops_cost = 0
         script_flags = self._script_flags(idx.height)
         control = CheckQueueControl(self.checkqueue)
+        assets_active = idx.height >= self.params.consensus.asset_activation_height
+        applied_asset_undos = []
 
-        for i, tx in enumerate(block.vtx):
-            if not tx.is_coinbase():
-                try:
-                    fee = check_tx_inputs(tx, view, idx.height)
-                except TxValidationError as e:
-                    raise BlockValidationError(e.code, f"tx {i}")
-                fees += fee
-            sigops_cost += get_transaction_sigop_cost(tx, view, script_flags)
-            if sigops_cost > MAX_BLOCK_SIGOPS_COST:
-                raise BlockValidationError("bad-blk-sigops")
-            if not tx.is_coinbase():
-                # collect spent coins for the undo journal, queue script checks
-                txundo = TxUndo()
-                checks = []
-                for j, txin in enumerate(tx.vin):
-                    coin = view.get_coin(txin.prevout)
-                    assert coin is not None
-                    checks.append(
-                        _script_check(tx, j, coin, script_flags)
-                    )
-                    spent = view.spend_coin(txin.prevout)
-                    txundo.prevouts.append(spent)
-                undo.vtxundo.append(txundo)
-                control.add(checks)
-            view.add_tx_outputs(tx, idx.height)
+        try:
+            for i, tx in enumerate(block.vtx):
+                if not tx.is_coinbase():
+                    try:
+                        fee = check_tx_inputs(tx, view, idx.height)
+                    except TxValidationError as e:
+                        raise BlockValidationError(e.code, f"tx {i}")
+                    fees += fee
+                sigops_cost += get_transaction_sigop_cost(tx, view, script_flags)
+                if sigops_cost > MAX_BLOCK_SIGOPS_COST:
+                    raise BlockValidationError("bad-blk-sigops")
+                spent_pairs = []
+                if not tx.is_coinbase():
+                    # collect spent coins for undo, queue script checks
+                    txundo = TxUndo()
+                    checks = []
+                    for j, txin in enumerate(tx.vin):
+                        coin = view.get_coin(txin.prevout)
+                        assert coin is not None
+                        checks.append(_script_check(tx, j, coin, script_flags))
+                        spent_pairs.append((coin.out.script_pubkey, coin))
+                        spent = view.spend_coin(txin.prevout)
+                        txundo.prevouts.append(spent)
+                    undo.vtxundo.append(txundo)
+                    control.add(checks)
+                # asset state transition (ref CheckTxAssets + CAssetsCache
+                # apply inside ConnectBlock, validation.cpp:10052+)
+                if assets_active:
+                    from ..assets.cache import AssetError
 
-        # subsidy rule (ref ConnectBlock's GetBlockSubsidy check)
-        subsidy = powrules.get_block_subsidy(idx.height, self.params.consensus)
-        if block.vtx[0].total_output_value() > fees + subsidy:
-            raise BlockValidationError(
-                "bad-cb-amount",
-                f"{block.vtx[0].total_output_value()} > {fees + subsidy}",
-            )
+                    try:
+                        asset_undo = self.assets.check_and_apply_tx(
+                            tx, spent_pairs, idx.height
+                        )
+                    except AssetError as e:
+                        raise BlockValidationError("bad-txns-assets", str(e))
+                    applied_asset_undos.append(asset_undo)
+                    undo.asset_undos.append(asset_undo)
+                view.add_tx_outputs(tx, idx.height)
+        except BlockValidationError:
+            for au in reversed(applied_asset_undos):
+                self.assets.undo_tx(au)
+            control.wait()
+            raise
 
-        err = control.wait()
-        if err:
-            raise BlockValidationError("blk-bad-inputs", err)
+        try:
+            # subsidy rule (ref ConnectBlock's GetBlockSubsidy check)
+            subsidy = powrules.get_block_subsidy(idx.height, self.params.consensus)
+            if block.vtx[0].total_output_value() > fees + subsidy:
+                raise BlockValidationError(
+                    "bad-cb-amount",
+                    f"{block.vtx[0].total_output_value()} > {fees + subsidy}",
+                )
+            err = control.wait()
+            if err:
+                raise BlockValidationError("blk-bad-inputs", err)
+        except BlockValidationError:
+            for au in reversed(applied_asset_undos):
+                self.assets.undo_tx(au)
+            raise
 
         if just_check:
+            # leave no asset-state residue (ref TestBlockValidity's
+            # throwaway caches)
+            for au in reversed(applied_asset_undos):
+                self.assets.undo_tx(au)
             return undo
         view.set_best_block(idx.block_hash)
         return undo
@@ -319,6 +359,9 @@ class ChainState:
         undo = self.block_store.read_undo(upos)
         if len(undo.vtxundo) != len(block.vtx) - 1:
             raise BlockValidationError("bad-undo-data")
+        # roll back asset state (ref DisconnectBlock's CAssetsCache undo)
+        for au in reversed(undo.asset_undos):
+            self.assets.undo_tx(au)
         # remove outputs created by this block, restore spent coins
         for i in range(len(block.vtx) - 1, -1, -1):
             tx = block.vtx[i]
@@ -553,6 +596,11 @@ class ChainState:
         tip = self.tip()
         if tip is not None:
             self.blocktree.write_tip(tip.block_hash)
+        from ..core.serialize import ByteWriter as _BW
+
+        w = _BW()
+        self.assets.serialize(w)
+        self._chainstate_db.put(b"A", w.getvalue())
 
     def close(self) -> None:
         self.flush_state_to_disk()

@@ -1,0 +1,337 @@
+"""Asset RPC family (parity: reference src/rpc/assets.cpp, 3.1k LoC,
+command table at :3035 — issue/transfer/reissue/listassets plus the
+qualifier/restricted management commands)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..assets.cache import AssetError
+from ..assets.txbuilder import (
+    AssetBuildError,
+    build_freeze_address,
+    build_global_freeze,
+    build_issue,
+    build_reissue,
+    build_tag_address,
+    build_transfer,
+    wallet_asset_balances,
+)
+from ..assets.types import (
+    AssetType,
+    NewAsset,
+    ReissueAsset,
+    UNIQUE_ASSET_AMOUNT,
+    asset_name_type,
+    is_asset_name_valid,
+)
+from ..assets.verifier import is_verifier_valid
+from ..core.amount import COIN
+from ..core.uint256 import u256_hex
+from ..script.standard import KeyID, decode_destination, encode_destination
+from ..wallet.wallet import WalletError
+from .server import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPC_WALLET_ERROR,
+    RPCError,
+    RPCTable,
+)
+
+
+def _wallet(node):
+    if node.wallet is None:
+        raise RPCError(RPC_WALLET_ERROR, "wallet is disabled")
+    return node.wallet
+
+
+def _h160(node, addr: str) -> bytes:
+    try:
+        dest = decode_destination(addr, node.params)
+    except ValueError as e:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+    if not isinstance(dest, KeyID):
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "need a key address")
+    return dest.h
+
+
+def _commit(node, tx) -> str:
+    w = _wallet(node)
+    try:
+        txid = w.commit_transaction(tx)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return u256_hex(txid)
+
+
+def issue(node, params: List[Any]):
+    """issue "asset_name" qty "(to_address)" ... (ref rpc/assets.cpp issue)."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "asset_name required")
+    name = str(params[0])
+    qty = int(round(float(params[1]) * COIN)) if len(params) > 1 else 1 * COIN
+    to_h160 = _h160(node, str(params[2])) if len(params) > 2 and params[2] else None
+    units = int(params[4]) if len(params) > 4 else 0
+    reissuable = bool(params[5]) if len(params) > 5 else True
+    has_ipfs = bool(params[6]) if len(params) > 6 else False
+    ipfs_hash = bytes.fromhex(str(params[7])) if has_ipfs and len(params) > 7 else b""
+
+    t = asset_name_type(name)
+    if t == AssetType.INVALID:
+        raise RPCError(RPC_INVALID_PARAMETER, f"Invalid asset name: {name}")
+    if t == AssetType.UNIQUE:
+        qty, units, reissuable = UNIQUE_ASSET_AMOUNT, 0, False
+    elif t in (AssetType.QUALIFIER, AssetType.SUB_QUALIFIER):
+        units, reissuable = 0, False  # ref assets.h QUALIFIER_ASSET_UNITS
+    elif t == AssetType.MSGCHANNEL:
+        qty, units, reissuable = 1 * COIN, 0, False
+    asset = NewAsset(
+        name=name, amount=qty, units=units,
+        reissuable=1 if reissuable else 0,
+        has_ipfs=1 if ipfs_hash else 0, ipfs_hash=ipfs_hash,
+    )
+    try:
+        tx = build_issue(_wallet(node), asset, to_h160)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def issuerestrictedasset(node, params: List[Any]):
+    """ref rpc/assets.cpp issuerestrictedasset."""
+    name = str(params[0])
+    qty = int(round(float(params[1]) * COIN))
+    verifier = str(params[2])
+    to_h160 = _h160(node, str(params[3])) if len(params) > 3 and params[3] else None
+    if asset_name_type(name) != AssetType.RESTRICTED:
+        raise RPCError(RPC_INVALID_PARAMETER, f"not a restricted name: {name}")
+    if not is_verifier_valid(verifier):
+        raise RPCError(RPC_INVALID_PARAMETER, "bad verifier string")
+    asset = NewAsset(name=name, amount=qty, units=0, reissuable=1)
+    try:
+        tx = build_issue(_wallet(node), asset, to_h160, verifier=verifier)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def transfer(node, params: List[Any]):
+    """transfer "asset" qty "to" (ref rpc/assets.cpp transfer)."""
+    name = str(params[0])
+    qty = int(round(float(params[1]) * COIN))
+    to_h160 = _h160(node, str(params[2]))
+    try:
+        tx = build_transfer(_wallet(node), name, qty, to_h160)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def reissue(node, params: List[Any]):
+    name = str(params[0])
+    qty = int(round(float(params[1]) * COIN))
+    to_h160 = _h160(node, str(params[2])) if len(params) > 2 and params[2] else None
+    reissuable = bool(params[3]) if len(params) > 3 else True
+    new_units = int(params[4]) if len(params) > 4 else -1
+    re = ReissueAsset(
+        name=name, amount=qty,
+        units=0xFF if new_units < 0 else new_units,
+        reissuable=1 if reissuable else 0,
+    )
+    try:
+        tx = build_reissue(_wallet(node), re, to_h160)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def listassets(node, params: List[Any]):
+    """ref rpc/assets.cpp listassets."""
+    pattern = str(params[0]) if params else "*"
+    verbose = bool(params[1]) if len(params) > 1 else False
+    prefix = pattern.rstrip("*")
+    names = node.chainstate.assets.list_assets(prefix)
+    if not verbose:
+        return names
+    out = {}
+    for n in names:
+        meta = node.chainstate.assets.get_asset(n)
+        out[n] = _asset_json(meta)
+    return out
+
+
+def _asset_json(meta) -> dict:
+    return {
+        "name": meta.asset.name,
+        "amount": meta.asset.amount / COIN,
+        "units": meta.asset.units,
+        "reissuable": bool(meta.asset.reissuable),
+        "has_ipfs": bool(meta.asset.has_ipfs),
+        "ipfs_hash": meta.asset.ipfs_hash.hex() if meta.asset.ipfs_hash else None,
+        "block_height": meta.height,
+        "blockhash": None,
+        "txid": u256_hex(meta.issuing_txid),
+    }
+
+
+def getassetdata(node, params: List[Any]):
+    name = str(params[0])
+    meta = node.chainstate.assets.get_asset(name)
+    if meta is None:
+        raise RPCError(RPC_INVALID_PARAMETER, f"Unknown asset {name}")
+    return _asset_json(meta)
+
+
+def listmyassets(node, params: List[Any]):
+    """ref rpc/assets.cpp listmyassets (wallet holdings)."""
+    balances = wallet_asset_balances(_wallet(node))
+    pattern = str(params[0]) if params else "*"
+    prefix = pattern.rstrip("*")
+    return {
+        n: v / COIN for n, v in sorted(balances.items()) if n.startswith(prefix)
+    }
+
+
+def listaddressesbyasset(node, params: List[Any]):
+    name = str(params[0])
+    holders = node.chainstate.assets.addresses_holding(name)
+    return {
+        encode_destination(KeyID(h), node.params): v / COIN
+        for h, v in holders.items()
+    }
+
+
+def listassetbalancesbyaddress(node, params: List[Any]):
+    h = _h160(node, str(params[0]))
+    return {
+        n: v / COIN
+        for n, v in node.chainstate.assets.assets_of_address(h).items()
+    }
+
+
+def addtagtoaddress(node, params: List[Any]):
+    qualifier = str(params[0])
+    target = _h160(node, str(params[1]))
+    try:
+        tx = build_tag_address(_wallet(node), qualifier, target, add=True)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def removetagfromaddress(node, params: List[Any]):
+    qualifier = str(params[0])
+    target = _h160(node, str(params[1]))
+    try:
+        tx = build_tag_address(_wallet(node), qualifier, target, add=False)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def freezeaddress(node, params: List[Any]):
+    name = str(params[0])
+    target = _h160(node, str(params[1]))
+    try:
+        tx = build_freeze_address(_wallet(node), name, target, freeze=True)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def unfreezeaddress(node, params: List[Any]):
+    name = str(params[0])
+    target = _h160(node, str(params[1]))
+    try:
+        tx = build_freeze_address(_wallet(node), name, target, freeze=False)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def freezerestrictedasset(node, params: List[Any]):
+    name = str(params[0])
+    freeze = bool(params[1]) if len(params) > 1 else True
+    try:
+        tx = build_global_freeze(_wallet(node), name, freeze)
+    except AssetBuildError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return [_commit(node, tx)]
+
+
+def listtagsforaddress(node, params: List[Any]):
+    h = _h160(node, str(params[0]))
+    return sorted(node.chainstate.assets.address_qualifiers(h))
+
+
+def listaddressesfortag(node, params: List[Any]):
+    q = str(params[0])
+    cache = node.chainstate.assets
+    return [
+        encode_destination(KeyID(h), node.params)
+        for (name, h), v in cache.qualifier_tags.items()
+        if name == q and v
+    ]
+
+
+def checkaddresstag(node, params: List[Any]):
+    h = _h160(node, str(params[0]))
+    q = str(params[1])
+    return q in node.chainstate.assets.address_qualifiers(h)
+
+
+def checkaddressrestriction(node, params: List[Any]):
+    h = _h160(node, str(params[0]))
+    name = str(params[1])
+    return node.chainstate.assets.is_frozen(name, h)
+
+
+def checkglobalrestriction(node, params: List[Any]):
+    return node.chainstate.assets.is_globally_frozen(str(params[0]))
+
+
+def getverifierstring(node, params: List[Any]):
+    name = str(params[0])
+    v = node.chainstate.assets.verifiers.get(name)
+    if v is None:
+        raise RPCError(RPC_INVALID_PARAMETER, f"no verifier for {name}")
+    return v
+
+
+def isvalidverifierstring(node, params: List[Any]):
+    ok = is_verifier_valid(str(params[0]))
+    if not ok:
+        raise RPCError(RPC_INVALID_PARAMETER, "invalid verifier string")
+    return "Valid Verifier"
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("issue", issue, ["asset_name", "qty", "to_address", "change_address",
+                          "units", "reissuable", "has_ipfs", "ipfs_hash"]),
+        ("issuerestrictedasset", issuerestrictedasset,
+         ["asset_name", "qty", "verifier", "to_address"]),
+        ("transfer", transfer, ["asset_name", "qty", "to_address"]),
+        ("reissue", reissue, ["asset_name", "qty", "to_address", "reissuable",
+                              "new_units"]),
+        ("listassets", listassets, ["asset", "verbose"]),
+        ("getassetdata", getassetdata, ["asset_name"]),
+        ("listmyassets", listmyassets, ["asset"]),
+        ("listaddressesbyasset", listaddressesbyasset, ["asset_name"]),
+        ("listassetbalancesbyaddress", listassetbalancesbyaddress, ["address"]),
+        ("addtagtoaddress", addtagtoaddress, ["tag_name", "to_address"]),
+        ("removetagfromaddress", removetagfromaddress, ["tag_name", "to_address"]),
+        ("freezeaddress", freezeaddress, ["asset_name", "address"]),
+        ("unfreezeaddress", unfreezeaddress, ["asset_name", "address"]),
+        ("freezerestrictedasset", freezerestrictedasset, ["asset_name", "freeze"]),
+        ("listtagsforaddress", listtagsforaddress, ["address"]),
+        ("listaddressesfortag", listaddressesfortag, ["tag_name"]),
+        ("checkaddresstag", checkaddresstag, ["address", "tag_name"]),
+        ("checkaddressrestriction", checkaddressrestriction,
+         ["address", "restricted_name"]),
+        ("checkglobalrestriction", checkglobalrestriction, ["restricted_name"]),
+        ("getverifierstring", getverifierstring, ["restricted_name"]),
+        ("isvalidverifierstring", isvalidverifierstring, ["verifier_string"]),
+    ]:
+        table.register("assets", name, fn, args)

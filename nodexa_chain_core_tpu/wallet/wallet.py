@@ -251,9 +251,14 @@ class Wallet(ValidationInterface):
     # ------------------------------------------------------ tx construction
 
     def select_coins(self, target: int) -> Tuple[List[Tuple[OutPoint, TxOut]], int]:
-        """Largest-first selection (ref SelectCoinsMinConf, simplified)."""
+        """Largest-first selection (ref SelectCoinsMinConf, simplified).
+        Asset-carrying outputs are never selected for plain funding."""
         avail = sorted(
-            [(op, o) for op, o, conf in self.unspent_coins(min_conf=1)],
+            [
+                (op, o)
+                for op, o, conf in self.unspent_coins(min_conf=1)
+                if not Script(o.script_pubkey).is_asset_script()
+            ],
             key=lambda x: -x[1].value,
         )
         picked = []

@@ -1,0 +1,113 @@
+"""Functional: full asset lifecycle over RPC (parity: reference
+feature_assets.py / feature_restricted_assets.py)."""
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+
+@pytest.mark.functional
+def test_asset_issue_transfer_reissue():
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        addr0 = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(105, addr0)
+        f.sync_blocks()
+
+        # issue a root asset (burns 500, mints owner token)
+        n0.rpc.issue("FUNCOIN", 21000, addr0)
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+
+        assert "FUNCOIN" in n0.rpc.listassets()
+        data = n0.rpc.getassetdata("FUNCOIN")
+        assert data["amount"] == 21000
+        assert data["reissuable"] is True
+        mine = n0.rpc.listmyassets()
+        assert mine["FUNCOIN"] == 21000
+        assert mine["FUNCOIN!"] == 1
+        # node1 sees the same asset state via consensus
+        assert n1.rpc.getassetdata("FUNCOIN")["amount"] == 21000
+
+        # transfer 500 FUNCOIN to node1
+        addr1 = n1.rpc.getnewaddress()
+        n0.rpc.transfer("FUNCOIN", 500, addr1)
+        f.sync_mempools()
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+        assert n1.rpc.listmyassets()["FUNCOIN"] == 500
+        assert n0.rpc.listmyassets()["FUNCOIN"] == 20500
+        holders = n0.rpc.listaddressesbyasset("FUNCOIN")
+        assert holders[addr1] == 500
+
+        # reissue 1000 more (owner token required — node0 has it)
+        n0.rpc.reissue("FUNCOIN", 1000, addr0)
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+        assert n1.rpc.getassetdata("FUNCOIN")["amount"] == 22000
+
+        # node1 cannot reissue (no owner token)
+        with pytest.raises(RPCFailure):
+            n1.rpc.reissue("FUNCOIN", 5, addr1)
+
+        # sub-asset + unique
+        n0.rpc.issue("FUNCOIN/GOLD", 100, addr0)
+        n0.rpc.generatetoaddress(1, addr0)
+        n0.rpc.issue("FUNCOIN#rare-001", 1, addr0)
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+        assets = n1.rpc.listassets()
+        assert "FUNCOIN/GOLD" in assets
+        assert "FUNCOIN#rare-001" in assets
+
+
+@pytest.mark.functional
+def test_restricted_asset_flow():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(110, addr)
+
+        # qualifier + root + restricted issuance
+        n0.rpc.issue("#KYC", 5, addr)
+        n0.rpc.generatetoaddress(1, addr)
+        n0.rpc.issue("SECURETOK", 1000, addr)
+        n0.rpc.generatetoaddress(1, addr)
+        n0.rpc.issuerestrictedasset("$SECURETOK", 1000, "KYC", addr)
+        n0.rpc.generatetoaddress(1, addr)
+
+        assert n0.rpc.getverifierstring("$SECURETOK") == "KYC"
+        assert n0.rpc.isvalidverifierstring("KYC & !BAD") == "Valid Verifier"
+
+        # transfer to an untagged address is rejected at mempool admission
+        target = n0.rpc.getnewaddress()
+        with pytest.raises(RPCFailure):
+            n0.rpc.transfer("$SECURETOK", 10, target)
+
+        # tag the address, then transfer succeeds
+        n0.rpc.addtagtoaddress("#KYC", target)
+        n0.rpc.generatetoaddress(1, addr)
+        assert n0.rpc.checkaddresstag(target, "#KYC") is True
+        assert target in n0.rpc.listaddressesfortag("#KYC")
+
+        n0.rpc.transfer("$SECURETOK", 10, target)
+        n0.rpc.generatetoaddress(1, addr)
+        assert n0.rpc.listassetbalancesbyaddress(target)["$SECURETOK"] == 10
+
+        # freeze the address; further sends to it fail
+        n0.rpc.freezeaddress("$SECURETOK", target)
+        n0.rpc.generatetoaddress(1, addr)
+        assert n0.rpc.checkaddressrestriction(target, "$SECURETOK") is True
+        with pytest.raises(RPCFailure):
+            n0.rpc.transfer("$SECURETOK", 5, target)
+
+        # global freeze stops all movement
+        n0.rpc.freezerestrictedasset("$SECURETOK", True)
+        n0.rpc.generatetoaddress(1, addr)
+        assert n0.rpc.checkglobalrestriction("$SECURETOK") is True
+        other = n0.rpc.getnewaddress()
+        n0.rpc.addtagtoaddress("#KYC", other)
+        n0.rpc.generatetoaddress(1, addr)
+        with pytest.raises(RPCFailure):
+            n0.rpc.transfer("$SECURETOK", 5, other)

@@ -1,0 +1,302 @@
+"""Asset layer tests (analogues of the reference's src/test/assets/ suite:
+name validation, script envelopes, issue/transfer/reissue/unique/qualifier/
+restricted semantics, verifier strings, undo)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.assets.cache import AssetError, AssetsCache
+from nodexa_chain_core_tpu.assets.types import (
+    AssetTransfer,
+    AssetType,
+    NewAsset,
+    NullAssetTxData,
+    OWNER_ASSET_AMOUNT,
+    OwnerPayload,
+    ReissueAsset,
+    append_asset_payload,
+    asset_name_type,
+    burn_requirement,
+    is_asset_name_valid,
+    parent_name,
+    parse_asset_script,
+)
+from nodexa_chain_core_tpu.assets.verifier import (
+    VerifierError,
+    evaluate_verifier,
+    is_verifier_valid,
+)
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.script.script import Script
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+# --- names (ref assets.cpp IsAssetNameValid; asset_tests.cpp) ---------------
+
+
+def test_asset_name_classification():
+    assert asset_name_type("NODEXA") == AssetType.ROOT
+    assert asset_name_type("NODEXA/SUB") == AssetType.SUB
+    assert asset_name_type("NODEXA/SUB/DEEP") == AssetType.SUB
+    assert asset_name_type("NODEXA#uniq-1") == AssetType.UNIQUE
+    assert asset_name_type("NODEXA~CHAN") == AssetType.MSGCHANNEL
+    assert asset_name_type("#KYC") == AssetType.QUALIFIER
+    assert asset_name_type("#KYC/#US") == AssetType.SUB_QUALIFIER
+    assert asset_name_type("$TOKEN") == AssetType.RESTRICTED
+    assert asset_name_type("NODEXA!") == AssetType.OWNER
+
+
+def test_invalid_names():
+    for bad in [
+        "ab",  # too short
+        "abc",  # lowercase
+        "_ABC", "ABC_", "A__B",  # punctuation rules
+        "1ABC",  # leading digit
+        "A" * 32,  # too long
+        "CLORE",  # reserved root
+        "NODEXA//X", "NODEXA/", "#ab", "$ab", "",
+    ]:
+        assert not is_asset_name_valid(bad), bad
+
+
+def test_parent_names():
+    assert parent_name("AAA/B2") == "AAA"
+    assert parent_name("AAA#tag") == "AAA"
+    assert parent_name("AAA~CHAN") == "AAA"
+    assert parent_name("#KYC/#US") == "#KYC"
+    assert parent_name("$TOKEN") == "TOKEN"
+    assert parent_name("AAA!") == "AAA"
+
+
+# --- script envelopes -------------------------------------------------------
+
+
+def test_asset_script_roundtrip():
+    base = p2pkh_script(KeyID(b"\x11" * 20))
+    asset = NewAsset(name="TESTCOIN", amount=1000 * COIN, units=2, reissuable=1)
+    script = append_asset_payload(base, "new", asset)
+    kind, payload = parse_asset_script(script)
+    assert kind == "new"
+    assert payload.name == "TESTCOIN"
+    assert payload.amount == 1000 * COIN
+    assert payload.units == 2
+
+    tr = AssetTransfer(name="TESTCOIN", amount=5 * COIN)
+    s2 = append_asset_payload(base, "transfer", tr)
+    kind, payload = parse_asset_script(s2)
+    assert kind == "transfer" and payload.amount == 5 * COIN
+
+    ow = OwnerPayload(name="TESTCOIN!")
+    s3 = append_asset_payload(base, "owner", ow)
+    kind, payload = parse_asset_script(s3)
+    assert kind == "owner" and payload.name == "TESTCOIN!"
+
+
+# --- verifier ---------------------------------------------------------------
+
+
+def test_verifier_evaluation():
+    assert evaluate_verifier("true", set())
+    assert evaluate_verifier("KYC", {"#KYC"})
+    assert not evaluate_verifier("KYC", set())
+    assert evaluate_verifier("KYC & US", {"#KYC", "#US"})
+    assert not evaluate_verifier("KYC & US", {"#KYC"})
+    assert evaluate_verifier("KYC | US", {"#US"})
+    assert evaluate_verifier("!BANNED", set())
+    assert not evaluate_verifier("!BANNED", {"#BANNED"})
+    assert evaluate_verifier("(KYC & !BANNED) | VIP", {"#VIP", "#BANNED"})
+    assert is_verifier_valid("A & (B | !C)")
+    assert not is_verifier_valid("A & ")
+    assert not is_verifier_valid("A ( B")
+
+
+# --- cache semantics (direct, no chain) -------------------------------------
+
+
+def _issue_tx_parts(name="MYCOIN", amount=1000 * COIN, addr=b"\x22" * 20,
+                    verifier=None):
+    """Build (tx, spent_pairs) for a root issuance."""
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+    from nodexa_chain_core_tpu.assets.types import (
+        verifier_string_script,
+        VerifierString,
+    )
+
+    t = asset_name_type(name)
+    base = p2pkh_script(KeyID(addr))
+    burn_amount, burn_spk = burn_requirement(t)
+    asset = NewAsset(name=name, amount=amount, units=0, reissuable=1)
+    vout = [
+        TxOut(value=burn_amount, script_pubkey=burn_spk.raw),
+        TxOut(0, append_asset_payload(base, "new", asset).raw),
+    ]
+    if t == AssetType.ROOT:
+        vout.append(TxOut(0, append_asset_payload(base, "owner",
+                                                  OwnerPayload(name + "!")).raw))
+    if verifier is not None:
+        vout.append(TxOut(0, verifier_string_script(VerifierString(verifier)).raw))
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(txid=1, n=0))],
+        vout=vout,
+    )
+    return tx
+
+
+def test_cache_issue_transfer_undo():
+    cache = AssetsCache()
+    addr = b"\x22" * 20
+    tx = _issue_tx_parts(addr=addr)
+    undo = cache.check_and_apply_tx(tx, [(b"\x76\xa9\x14" + b"\x01" * 20 + b"\x88\xac", None)], 10)
+    assert cache.exists("MYCOIN")
+    assert cache.exists("MYCOIN!")
+    assert cache.balance("MYCOIN", addr) == 1000 * COIN
+    assert cache.balance("MYCOIN!", addr) == OWNER_ASSET_AMOUNT
+
+    # duplicate issuance rejected
+    with pytest.raises(AssetError, match="already-exists"):
+        cache.check_and_apply_tx(_issue_tx_parts(addr=addr), [], 11)
+
+    # undo removes everything
+    cache.undo_tx(undo)
+    assert not cache.exists("MYCOIN")
+    assert cache.balance("MYCOIN", addr) == 0
+
+
+def test_cache_issue_requires_burn():
+    from nodexa_chain_core_tpu.primitives.transaction import TxOut
+
+    cache = AssetsCache()
+    tx = _issue_tx_parts()
+    tx.vout[0] = TxOut(value=1, script_pubkey=tx.vout[0].script_pubkey)  # tiny burn
+    with pytest.raises(AssetError, match="missing-burn"):
+        cache.check_and_apply_tx(tx, [], 10)
+
+
+def test_cache_transfer_conservation():
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+
+    cache = AssetsCache()
+    addr = b"\x22" * 20
+    issue_tx = _issue_tx_parts(addr=addr)
+    cache.check_and_apply_tx(issue_tx, [], 10)
+
+    src_spk = issue_tx.vout[1].script_pubkey  # the asset-carrying output
+    dest = b"\x33" * 20
+    transfer_tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(issue_tx.txid, 1))],
+        vout=[
+            TxOut(0, append_asset_payload(
+                p2pkh_script(KeyID(dest)), "transfer",
+                AssetTransfer("MYCOIN", 400 * COIN)).raw),
+            TxOut(0, append_asset_payload(
+                p2pkh_script(KeyID(addr)), "transfer",
+                AssetTransfer("MYCOIN", 600 * COIN)).raw),
+        ],
+    )
+    cache.check_and_apply_tx(transfer_tx, [(src_spk, None)], 11)
+    assert cache.balance("MYCOIN", dest) == 400 * COIN
+    assert cache.balance("MYCOIN", addr) == 600 * COIN
+
+    # unbalanced transfer rejected
+    bad = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(transfer_tx.txid, 0))],
+        vout=[
+            TxOut(0, append_asset_payload(
+                p2pkh_script(KeyID(dest)), "transfer",
+                AssetTransfer("MYCOIN", 999 * COIN)).raw),
+        ],
+    )
+    with pytest.raises(AssetError, match="mismatch"):
+        cache.check_and_apply_tx(
+            bad, [(transfer_tx.vout[0].script_pubkey, None)], 12
+        )
+
+
+def test_cache_sub_issue_requires_owner():
+    cache = AssetsCache()
+    addr = b"\x22" * 20
+    root_tx = _issue_tx_parts(addr=addr)
+    cache.check_and_apply_tx(root_tx, [], 10)
+
+    sub_tx = _issue_tx_parts(name="MYCOIN/GOLD", addr=addr)
+    with pytest.raises(AssetError, match="missing-owner-token"):
+        cache.check_and_apply_tx(sub_tx, [], 11)
+
+    # include the owner token input + return output
+    from nodexa_chain_core_tpu.primitives.transaction import TxOut
+
+    owner_spk = root_tx.vout[2].script_pubkey
+    sub_tx.vout.append(TxOut(0, owner_spk))
+    undo = cache.check_and_apply_tx(sub_tx, [(owner_spk, None)], 11)
+    assert cache.exists("MYCOIN/GOLD")
+    cache.undo_tx(undo)
+    assert not cache.exists("MYCOIN/GOLD")
+
+
+def test_restricted_verifier_enforcement():
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+
+    cache = AssetsCache()
+    addr = b"\x22" * 20
+    root_tx = _issue_tx_parts(name="SECURE", addr=addr)
+    cache.check_and_apply_tx(root_tx, [], 10)
+    owner_spk = root_tx.vout[2].script_pubkey
+
+    rst_tx = _issue_tx_parts(name="$SECURE", addr=addr, verifier="KYC")
+    rst_tx.vout.append(TxOut(0, owner_spk))
+    cache.check_and_apply_tx(rst_tx, [(owner_spk, None)], 11)
+    assert cache.verifiers["$SECURE"] == "KYC"
+
+    # transfer to an untagged address fails the verifier
+    dest = b"\x44" * 20
+    src_spk = rst_tx.vout[1].script_pubkey
+    move = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(rst_tx.txid, 1))],
+        vout=[TxOut(0, append_asset_payload(
+            p2pkh_script(KeyID(dest)), "transfer",
+            AssetTransfer("$SECURE", 1000 * COIN)).raw)],
+    )
+    with pytest.raises(AssetError, match="verifier-failed"):
+        cache.check_and_apply_tx(move, [(src_spk, None)], 12)
+
+    # tag the address, then it works
+    cache.qualifier_tags[("#KYC", dest)] = True
+    undo = cache.check_and_apply_tx(move, [(src_spk, None)], 12)
+    assert cache.balance("$SECURE", dest) == 1000 * COIN
+    cache.undo_tx(undo)
+
+
+def test_cache_serialization_roundtrip():
+    cache = AssetsCache()
+    addr = b"\x22" * 20
+    cache.check_and_apply_tx(_issue_tx_parts(addr=addr), [], 10)
+    cache.qualifier_tags[("#KYC", addr)] = True
+    cache.global_freezes["$X"] = True
+    cache.verifiers["$X"] = "KYC & !BAD"
+    w = ByteWriter()
+    cache.serialize(w)
+    back = AssetsCache.deserialize(ByteReader(w.getvalue()))
+    assert back.exists("MYCOIN")
+    assert back.balance("MYCOIN", addr) == 1000 * COIN
+    assert back.qualifier_tags[("#KYC", addr)]
+    assert back.global_freezes["$X"]
+    assert back.verifiers["$X"] == "KYC & !BAD"
