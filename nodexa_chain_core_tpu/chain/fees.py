@@ -1,0 +1,86 @@
+"""Fee estimation (parity: reference src/policy/fees.{h,cpp}
+CBlockPolicyEstimator — bucketed feerate tracking of mempool txs vs their
+confirmation delay, queried by wallet/RPC estimatefee/estimatesmartfee)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+_BUCKET_SPACING = 1.1
+_MIN_BUCKET = 100.0  # sat/kB
+_MAX_BUCKET = 1e7
+_DECAY = 0.998
+_SUFFICIENT_TXS = 0.1
+_MIN_SUCCESS_PCT = 0.85
+
+
+class BlockPolicyEstimator:
+    def __init__(self) -> None:
+        self.buckets: List[float] = []
+        b = _MIN_BUCKET
+        while b <= _MAX_BUCKET:
+            self.buckets.append(b)
+            b *= _BUCKET_SPACING
+        n = len(self.buckets)
+        self.max_confirms = 25
+        # conf_avg[target][bucket]: decayed count confirmed within target
+        self.conf_avg = [[0.0] * n for _ in range(self.max_confirms)]
+        self.tx_avg = [0.0] * n
+        self._tracked: Dict[int, tuple] = {}  # txid -> (height, bucket)
+        self.best_height = 0
+
+    def _bucket_index(self, feerate: float) -> int:
+        if feerate <= _MIN_BUCKET:
+            return 0
+        idx = int(math.log(feerate / _MIN_BUCKET) / math.log(_BUCKET_SPACING))
+        return min(idx, len(self.buckets) - 1)
+
+    def process_tx(self, txid: int, height: int, fee: int, size: int) -> None:
+        feerate = fee * 1000 / max(size, 1)
+        self._tracked[txid] = (height, self._bucket_index(feerate))
+
+    def process_block(self, height: int, txids: List[int]) -> None:
+        """Record confirmation delays for tracked txs in this block."""
+        self.best_height = height
+        # decay
+        for row in self.conf_avg:
+            for i in range(len(row)):
+                row[i] *= _DECAY
+        for i in range(len(self.tx_avg)):
+            self.tx_avg[i] *= _DECAY
+        for txid in txids:
+            info = self._tracked.pop(txid, None)
+            if info is None:
+                continue
+            entry_height, bucket = info
+            blocks_to_confirm = max(height - entry_height, 1)
+            self.tx_avg[bucket] += 1
+            for target in range(blocks_to_confirm - 1, self.max_confirms):
+                self.conf_avg[target][bucket] += 1
+
+    def remove_tx(self, txid: int) -> None:
+        self._tracked.pop(txid, None)
+
+    def estimate_fee(self, target: int) -> Optional[float]:
+        """sat/kB estimate to confirm within `target` blocks, or None."""
+        target = min(max(target, 1), self.max_confirms)
+        row = self.conf_avg[target - 1]
+        # find the cheapest bucket with enough data and high success
+        for i, bucket in enumerate(self.buckets):
+            if self.tx_avg[i] < _SUFFICIENT_TXS:
+                continue
+            if row[i] / self.tx_avg[i] >= _MIN_SUCCESS_PCT:
+                return bucket
+        return None
+
+    def estimate_smart_fee(self, target: int) -> tuple:
+        """Walks up targets until an estimate exists (ref estimateSmartFee)."""
+        for t in range(target, self.max_confirms + 1):
+            est = self.estimate_fee(t)
+            if est is not None:
+                return est, t
+        return None, target
+
+
+fee_estimator = BlockPolicyEstimator()

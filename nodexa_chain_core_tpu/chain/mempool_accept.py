@@ -129,6 +129,10 @@ def accept_to_memory_pool(
     )
     pool.add(entry)
 
+    from .fees import fee_estimator
+
+    fee_estimator.process_tx(tx.txid, height, fee, size)
+
     from ..node.events import main_signals
 
     main_signals.transaction_added_to_mempool(tx)

@@ -1,0 +1,124 @@
+"""Merkle blocks / partial merkle trees (parity: reference
+src/merkleblock.{h,cpp} — CPartialMerkleTree for BIP37 filtered blocks and
+tx-inclusion proofs)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..crypto.hashes import sha256d
+from ..primitives.block import Block
+
+
+def _hash_pair(a: int, b: int) -> int:
+    return int.from_bytes(
+        sha256d(a.to_bytes(32, "little") + b.to_bytes(32, "little")), "little"
+    )
+
+
+class PartialMerkleTree:
+    """ref merkleblock.h CPartialMerkleTree."""
+
+    def __init__(self, txids: Optional[List[int]] = None,
+                 matches: Optional[List[bool]] = None):
+        self.n_transactions = 0
+        self.bits: List[bool] = []
+        self.hashes: List[int] = []
+        if txids is not None and matches is not None:
+            self.n_transactions = len(txids)
+            height = 0
+            while self._tree_width(height) > 1:
+                height += 1
+            self._traverse_build(height, 0, txids, matches)
+
+    def _tree_width(self, height: int) -> int:
+        return (self.n_transactions + (1 << height) - 1) >> height
+
+    def _calc_hash(self, height: int, pos: int, txids: List[int]) -> int:
+        if height == 0:
+            return txids[pos]
+        left = self._calc_hash(height - 1, pos * 2, txids)
+        if pos * 2 + 1 < self._tree_width(height - 1):
+            right = self._calc_hash(height - 1, pos * 2 + 1, txids)
+        else:
+            right = left
+        return _hash_pair(left, right)
+
+    def _traverse_build(self, height: int, pos: int, txids: List[int],
+                        matches: List[bool]) -> None:
+        parent_of_match = any(
+            matches[p]
+            for p in range(pos << height, min((pos + 1) << height, self.n_transactions))
+        )
+        self.bits.append(parent_of_match)
+        if height == 0 or not parent_of_match:
+            self.hashes.append(self._calc_hash(height, pos, txids))
+        else:
+            self._traverse_build(height - 1, pos * 2, txids, matches)
+            if pos * 2 + 1 < self._tree_width(height - 1):
+                self._traverse_build(height - 1, pos * 2 + 1, txids, matches)
+
+    def extract_matches(self) -> Tuple[int, List[int]]:
+        """Returns (merkle_root, matched_txids); raises on malformed proof."""
+        if self.n_transactions == 0 or not self.bits:
+            raise ValueError("empty partial merkle tree")
+        height = 0
+        while self._tree_width(height) > 1:
+            height += 1
+        used = [0, 0]  # bits, hashes
+        matched: List[int] = []
+        root = self._traverse_extract(height, 0, used, matched)
+        if used[0] > len(self.bits) or used[1] != len(self.hashes):
+            raise ValueError("unconsumed proof data")
+        return root, matched
+
+    def _traverse_extract(self, height: int, pos: int, used: List[int],
+                          matched: List[int]) -> int:
+        if used[0] >= len(self.bits):
+            raise ValueError("proof overrun")
+        parent_of_match = self.bits[used[0]]
+        used[0] += 1
+        if height == 0 or not parent_of_match:
+            if used[1] >= len(self.hashes):
+                raise ValueError("proof overrun")
+            h = self.hashes[used[1]]
+            used[1] += 1
+            if height == 0 and parent_of_match:
+                matched.append(h)
+            return h
+        left = self._traverse_extract(height - 1, pos * 2, used, matched)
+        if pos * 2 + 1 < self._tree_width(height - 1):
+            right = self._traverse_extract(height - 1, pos * 2 + 1, used, matched)
+            if left == right:
+                raise ValueError("duplicate hashes (CVE-2012-2459 guard)")
+        else:
+            right = left
+        return _hash_pair(left, right)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.u32(self.n_transactions)
+        w.vector(self.hashes, lambda wr, h: wr.hash256(h))
+        packed = bytearray((len(self.bits) + 7) // 8)
+        for i, b in enumerate(self.bits):
+            if b:
+                packed[i >> 3] |= 1 << (i & 7)
+        w.var_bytes(bytes(packed))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "PartialMerkleTree":
+        t = cls()
+        t.n_transactions = r.u32()
+        t.hashes = r.vector(lambda rr: rr.hash256())
+        packed = r.var_bytes()
+        t.bits = [bool(packed[i >> 3] & (1 << (i & 7))) for i in range(len(packed) * 8)]
+        return t
+
+
+def make_merkle_block(block: Block, match) -> Tuple[PartialMerkleTree, List[int]]:
+    """match: predicate(tx) -> bool (e.g. a bloom filter's matches_tx)."""
+    txids = [tx.txid for tx in block.vtx]
+    matches = [bool(match(tx)) for tx in block.vtx]
+    return PartialMerkleTree(txids, matches), [
+        t for t, m in zip(txids, matches) if m
+    ]

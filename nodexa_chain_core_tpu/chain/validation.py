@@ -411,6 +411,9 @@ class ChainState:
         self.active.set_tip(idx)
         if self.mempool is not None:
             self.mempool.remove_for_block(block.vtx)
+        from .fees import fee_estimator
+
+        fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
         main_signals.block_connected(block, idx, [])
 
     def _disconnect_tip(self) -> Block:

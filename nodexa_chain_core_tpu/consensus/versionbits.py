@@ -1,0 +1,116 @@
+"""BIP9 version-bits deployment state machine.
+
+Parity: reference src/versionbits.{h,cpp} — AbstractThresholdConditionChecker
+(versionbits.h:58): DEFINED -> STARTED -> LOCKED_IN -> ACTIVE / FAILED over
+retarget-window boundaries, with per-deployment threshold overrides
+(ref chainparams.cpp nOverrideRuleChangeActivationThreshold).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+from ..chain.blockindex import BlockIndex
+from .params import ALWAYS_ACTIVE, ConsensusParams, Deployment
+
+VERSIONBITS_TOP_BITS = 0x20000000
+VERSIONBITS_TOP_MASK = 0xE0000000
+
+
+class ThresholdState(enum.Enum):
+    DEFINED = 0
+    STARTED = 1
+    LOCKED_IN = 2
+    ACTIVE = 3
+    FAILED = 4
+
+
+def bit_is_set(version: int, bit: int) -> bool:
+    return (
+        (version & VERSIONBITS_TOP_MASK) == VERSIONBITS_TOP_BITS
+        and bool(version & (1 << bit))
+    )
+
+
+class VersionBitsCache:
+    """Per-deployment memoization keyed on period-start blocks
+    (ref versionbits.cpp ThresholdConditionCache)."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[str, Dict[Optional[int], ThresholdState]] = {}
+
+    def state(
+        self, prev: Optional[BlockIndex], params: ConsensusParams, name: str
+    ) -> ThresholdState:
+        dep = params.deployments[name]
+        window = dep.override_window or params.miner_confirmation_window
+        threshold = dep.override_threshold or params.rule_change_activation_threshold
+        cache = self._cache.setdefault(name, {})
+
+        if dep.start_time == ALWAYS_ACTIVE:
+            return ThresholdState.ACTIVE
+
+        # walk back to the period boundary
+        if prev is not None:
+            prev = prev.get_ancestor(prev.height - ((prev.height + 1) % window))
+
+        to_compute = []
+        while prev is not None and (prev.block_hash not in cache):
+            if prev.median_time_past() < dep.start_time:
+                cache[prev.block_hash] = ThresholdState.DEFINED
+                break
+            to_compute.append(prev)
+            prev = prev.get_ancestor(prev.height - window)
+
+        state = (
+            cache.get(prev.block_hash, ThresholdState.DEFINED)
+            if prev is not None
+            else ThresholdState.DEFINED
+        )
+        for idx in reversed(to_compute):
+            next_state = state
+            if state == ThresholdState.DEFINED:
+                if idx.median_time_past() >= dep.timeout:
+                    next_state = ThresholdState.FAILED
+                elif idx.median_time_past() >= dep.start_time:
+                    next_state = ThresholdState.STARTED
+            elif state == ThresholdState.STARTED:
+                if idx.median_time_past() >= dep.timeout:
+                    next_state = ThresholdState.FAILED
+                else:
+                    # count signalling blocks in the period ending at idx
+                    count = 0
+                    walk = idx
+                    for _ in range(window):
+                        if walk is None:
+                            break
+                        if bit_is_set(walk.header.version, dep.bit):
+                            count += 1
+                        walk = walk.prev
+                    if count >= threshold:
+                        next_state = ThresholdState.LOCKED_IN
+            elif state == ThresholdState.LOCKED_IN:
+                next_state = ThresholdState.ACTIVE
+            state = next_state
+            cache[idx.block_hash] = state
+        return state
+
+    def is_active(
+        self, prev: Optional[BlockIndex], params: ConsensusParams, name: str
+    ) -> bool:
+        return self.state(prev, params, name) == ThresholdState.ACTIVE
+
+    def compute_block_version(
+        self, prev: Optional[BlockIndex], params: ConsensusParams
+    ) -> int:
+        """ref ComputeBlockVersion: signal for STARTED/LOCKED_IN bits."""
+        version = VERSIONBITS_TOP_BITS
+        for name in params.deployments:
+            st = self.state(prev, params, name)
+            if st in (ThresholdState.STARTED, ThresholdState.LOCKED_IN):
+                version |= 1 << params.deployments[name].bit
+        return version
+
+
+versionbits_cache = VersionBitsCache()

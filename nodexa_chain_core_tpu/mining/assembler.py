@@ -59,8 +59,10 @@ class BlockAssembler:
         )
         vtx = [coinbase] + txs
         root, _ = merkle_root([t.txid for t in vtx])
+        from ..consensus.versionbits import versionbits_cache
+
         header = BlockHeader(
-            version=0x20000000,
+            version=versionbits_cache.compute_block_version(tip, params),
             hash_prev=tip.block_hash,
             hash_merkle_root=root,
             time=ntime,

@@ -62,6 +62,54 @@ def help_cmd(node, params: List[Any]):
     return g_rpc_table.help_text(str(params[0]) if params else None)
 
 
+def estimatefee(node, params: List[Any]):
+    """ref rpc/mining.cpp estimatefee."""
+    from ..chain.fees import fee_estimator
+
+    target = int(params[0]) if params else 6
+    est = fee_estimator.estimate_fee(target)
+    return -1 if est is None else est / COIN  # sat/kB -> COIN/kB
+
+
+def estimatesmartfee(node, params: List[Any]):
+    from ..chain.fees import fee_estimator
+
+    target = int(params[0]) if params else 6
+    est, found_target = fee_estimator.estimate_smart_fee(target)
+    out = {"blocks": found_target}
+    if est is None:
+        out["errors"] = ["Insufficient data or no feerate found"]
+    else:
+        out["feerate"] = est / COIN
+    return out
+
+
+def signmessagewithprivkey(node, params: List[Any]):
+    """ref misc.cpp signmessagewithprivkey."""
+    import base64
+
+    from ..wallet.keys import wif_decode
+    from ..wallet.wallet import _message_digest, _try_recover
+    from ..crypto import secp256k1 as ec
+
+    priv, compressed = wif_decode(str(params[0]), node.params)
+    digest = _message_digest(str(params[1]))
+    r, s = ec.sign(priv, digest)
+    pub = ec.pubkey_create(priv)
+    rec_id = next(i for i in range(4) if _try_recover(digest, r, s, i) == pub)
+    header = 27 + rec_id + (4 if compressed else 0)
+    return base64.b64encode(
+        bytes([header]) + r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    ).decode()
+
+
+def getmemoryinfo(node, params: List[Any]):
+    import resource
+
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    return {"locked": {"used": usage.ru_maxrss * 1024}}
+
+
 def getnetworkinfo(node, params: List[Any]):
     return {
         "version": __version__,
@@ -123,6 +171,11 @@ def register(table: RPCTable) -> None:
         ("control", "stop", stop, []),
         ("control", "uptime", uptime, []),
         ("util", "validateaddress", validateaddress, ["address"]),
+        ("util", "estimatefee", estimatefee, ["nblocks"]),
+        ("util", "estimatesmartfee", estimatesmartfee, ["conf_target"]),
+        ("util", "signmessagewithprivkey", signmessagewithprivkey,
+         ["privkey", "message"]),
+        ("control", "getmemoryinfo", getmemoryinfo, []),
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),
         ("network", "getconnectioncount", getconnectioncount, []),

@@ -62,6 +62,9 @@ def decoderawtransaction(node, params: List[Any]):
 
 
 def sendrawtransaction(node, params: List[Any]):
+    from .safemode import observe_safe_mode
+
+    observe_safe_mode()
     tx = _parse_tx(str(params[0]))
     allow_high_fees = bool(params[1]) if len(params) > 1 else False
     try:

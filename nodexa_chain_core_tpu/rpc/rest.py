@@ -1,0 +1,85 @@
+"""REST interface (parity: reference src/rest.cpp:569-578 — read-only
+endpoints /rest/tx, /rest/block, /rest/chaininfo, /rest/mempool/info,
+/rest/mempool/contents, /rest/getutxos) plus a minimal HTML status page at
+/ (the framework's stand-in for the reference's Qt status surface)."""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+from ..core.uint256 import u256_from_hex, u256_hex
+
+
+def make_rest_handler(node):
+    from .blockchain import (
+        getblockchaininfo,
+        getmempoolinfo,
+        getrawmempool,
+        getblock,
+        gettxout,
+    )
+    from .rawtransaction import getrawtransaction
+
+    def handler(path: str) -> Tuple[int, object]:
+        try:
+            parts = [p for p in path.split("?")[0].split("/") if p]
+            if not parts:
+                return 200, _status_page(node)
+            if parts[0] != "rest":
+                return 404, {"error": "not found"}
+            if parts[1] == "chaininfo.json" or parts[1] == "chaininfo":
+                return 200, getblockchaininfo(node, [])
+            if parts[1] == "mempool":
+                if len(parts) > 2 and parts[2].startswith("contents"):
+                    return 200, getrawmempool(node, [True])
+                return 200, getmempoolinfo(node, [])
+            if parts[1].startswith("block"):
+                h = parts[2].split(".")[0]
+                return 200, getblock(node, [h, 2])
+            if parts[1].startswith("tx"):
+                h = parts[2].split(".")[0]
+                return 200, getrawtransaction(node, [h, True])
+            if parts[1].startswith("getutxos"):
+                outpoints = [p for p in parts[2:] if "-" in p]
+                utxos = []
+                for opstr in outpoints:
+                    txid, n = opstr.split("-")
+                    res = gettxout(node, [txid, int(n), True])
+                    if res is not None:
+                        utxos.append(res)
+                return 200, {"utxos": utxos}
+            if parts[1].startswith("headers"):
+                count = int(parts[2])
+                start = u256_from_hex(parts[3].split(".")[0])
+                idx = node.chainstate.lookup(start)
+                out = []
+                while idx is not None and len(out) < count:
+                    from .blockchain import _index_to_json
+
+                    out.append(_index_to_json(node, idx))
+                    idx = node.chainstate.active.next(idx)
+                return 200, out
+            return 404, {"error": "unknown rest endpoint"}
+        except Exception as e:  # noqa: BLE001 — REST boundary
+            return 400, {"error": str(e)}
+
+    return handler
+
+
+def _status_page(node) -> str:
+    tip = node.chainstate.tip()
+    pool = node.mempool
+    peers = node.connman.connection_count() if node.connman else 0
+    assets = len(node.chainstate.assets.assets)
+    return f"""<!doctype html><html><head><title>nodexa-chain-core_tpu</title>
+<style>body{{font-family:monospace;margin:2em}}td{{padding:2px 12px}}</style>
+</head><body><h2>nodexa-chain-core_tpu node</h2><table>
+<tr><td>network</td><td>{node.params.network}</td></tr>
+<tr><td>height</td><td>{tip.height}</td></tr>
+<tr><td>best block</td><td>{u256_hex(tip.block_hash)}</td></tr>
+<tr><td>mempool</td><td>{pool.size()} txs</td></tr>
+<tr><td>peers</td><td>{peers}</td></tr>
+<tr><td>assets issued</td><td>{assets}</td></tr>
+<tr><td>uptime</td><td>{node.uptime()}s</td></tr>
+</table></body></html>"""

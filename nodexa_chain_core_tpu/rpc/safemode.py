@@ -1,0 +1,45 @@
+"""Safe mode (parity: reference src/rpc/safemode.cpp:7 ObserveSafeMode +
+src/warnings.cpp — lock down value-moving RPC when the chain state looks
+suspicious, e.g. a large invalid fork)."""
+
+from __future__ import annotations
+
+from .server import RPCError
+
+RPC_FORBIDDEN_BY_SAFE_MODE = -2
+
+_safe_mode_reason: str = ""
+
+
+def set_safe_mode(reason: str) -> None:
+    global _safe_mode_reason
+    _safe_mode_reason = reason
+
+
+def clear_safe_mode() -> None:
+    set_safe_mode("")
+
+
+def in_safe_mode() -> bool:
+    return bool(_safe_mode_reason)
+
+
+def observe_safe_mode() -> None:
+    """Call at the top of value-moving RPC handlers (ref ObserveSafeMode)."""
+    if _safe_mode_reason:
+        raise RPCError(
+            RPC_FORBIDDEN_BY_SAFE_MODE,
+            f"Safe mode: {_safe_mode_reason}",
+        )
+
+
+def check_fork_warning(chainstate) -> None:
+    """ref warnings/CheckForkWarningConditions: a rejected fork with more
+    than 6 blocks of work beyond our tip triggers safe mode."""
+    tip = chainstate.tip()
+    if tip is None:
+        return
+    for idx in chainstate.invalid:
+        if idx.chain_work > tip.chain_work and idx.height > tip.height + 6:
+            set_safe_mode("large invalid fork detected")
+            return

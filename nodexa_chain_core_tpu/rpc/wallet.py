@@ -70,7 +70,11 @@ def getwalletinfo(node, params: List[Any]):
 
 
 def sendtoaddress(node, params: List[Any]):
-    """ref rpcwallet.cpp:431 sendtoaddress -> SendMoney."""
+    """ref rpcwallet.cpp:431 sendtoaddress -> SendMoney (safe-mode gated,
+    ref ObserveSafeMode)."""
+    from .safemode import observe_safe_mode
+
+    observe_safe_mode()
     if len(params) < 2:
         raise RPCError(RPC_INVALID_PARAMETER, "address and amount required")
     w = _wallet(node)

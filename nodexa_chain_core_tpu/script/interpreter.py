@@ -205,7 +205,15 @@ class TransactionSignatureChecker(BaseSignatureChecker):
         # legacy quirk: the signature itself is deleted from scriptCode
         cleaned = script_code.find_and_delete(Script.build(sig))
         digest = signature_hash(cleaned, self.tx, self.in_idx, hashtype)
-        return ec.verify(pub, digest, r, s)
+        # signature cache (ref sigcache.cpp CachingTransactionSignatureChecker)
+        from .sigcache import signature_cache
+
+        cached = signature_cache.get(digest, raw_sig, pubkey)
+        if cached is not None:
+            return cached
+        ok = ec.verify(pub, digest, r, s)
+        signature_cache.set(digest, raw_sig, pubkey, ok)
+        return ok
 
     def check_locktime(self, locktime: int) -> bool:
         """BIP65 semantics (ref interpreter.cpp CheckLockTime)."""

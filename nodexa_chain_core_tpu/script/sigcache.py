@@ -1,0 +1,41 @@
+"""Signature verification cache (parity: reference src/script/sigcache.cpp,
+backed by the cuckoo cache of src/cuckoocache.h:160 — here an LRU dict with
+the same hit semantics: key = (sighash, signature, pubkey))."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from threading import Lock
+from typing import Tuple
+
+DEFAULT_MAX_ENTRIES = 1 << 16
+
+
+class SignatureCache:
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
+        self._store: "OrderedDict[Tuple[bytes, bytes, bytes], bool]" = OrderedDict()
+        self._max = max_entries
+        self._lock = Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, digest: bytes, sig: bytes, pubkey: bytes):
+        key = (digest, sig, pubkey)
+        with self._lock:
+            if key in self._store:
+                self._store.move_to_end(key)
+                self.hits += 1
+                return self._store[key]
+            self.misses += 1
+            return None
+
+    def set(self, digest: bytes, sig: bytes, pubkey: bytes, valid: bool) -> None:
+        key = (digest, sig, pubkey)
+        with self._lock:
+            self._store[key] = valid
+            self._store.move_to_end(key)
+            while len(self._store) > self._max:
+                self._store.popitem(last=False)
+
+
+signature_cache = SignatureCache()

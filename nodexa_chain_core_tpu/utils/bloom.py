@@ -1,0 +1,113 @@
+"""BIP37 bloom filters (parity: reference src/bloom.{h,cpp} — CBloomFilter
+(:47) and the rolling variant CRollingBloomFilter (:122))."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List
+
+from ..crypto.hashes import murmur3
+
+MAX_BLOOM_FILTER_SIZE = 36_000  # bytes
+MAX_HASH_FUNCS = 50
+LN2SQUARED = 0.4804530139182014
+LN2 = 0.6931471805599453
+
+BLOOM_UPDATE_NONE = 0
+BLOOM_UPDATE_ALL = 1
+BLOOM_UPDATE_P2PUBKEY_ONLY = 2
+
+
+class BloomFilter:
+    def __init__(self, n_elements: int, fp_rate: float, tweak: int = 0,
+                 flags: int = BLOOM_UPDATE_NONE):
+        size = min(
+            int(-1 / LN2SQUARED * n_elements * math.log(fp_rate)) // 8,
+            MAX_BLOOM_FILTER_SIZE,
+        )
+        self.data = bytearray(max(1, size))
+        self.n_hash_funcs = min(
+            max(1, int(len(self.data) * 8 / n_elements * LN2)), MAX_HASH_FUNCS
+        )
+        self.tweak = tweak
+        self.flags = flags
+
+    def _hash(self, n: int, item: bytes) -> int:
+        return murmur3((n * 0xFBA4C795 + self.tweak) & 0xFFFFFFFF, item) % (
+            len(self.data) * 8
+        )
+
+    def insert(self, item: bytes) -> None:
+        for i in range(self.n_hash_funcs):
+            bit = self._hash(i, item)
+            self.data[bit >> 3] |= 1 << (bit & 7)
+
+    def contains(self, item: bytes) -> bool:
+        return all(
+            self.data[(b := self._hash(i, item)) >> 3] & (1 << (b & 7))
+            for i in range(self.n_hash_funcs)
+        )
+
+    def is_within_size_constraints(self) -> bool:
+        return (
+            len(self.data) <= MAX_BLOOM_FILTER_SIZE
+            and self.n_hash_funcs <= MAX_HASH_FUNCS
+        )
+
+    def matches_tx(self, tx) -> bool:
+        """ref CBloomFilter::IsRelevantAndUpdate (match side only)."""
+        from ..script.script import Script
+
+        if self.contains(tx.txid.to_bytes(32, "little")):
+            return True
+        for out in tx.vout:
+            try:
+                for p in Script(out.script_pubkey).ops():
+                    if p.data and self.contains(p.data):
+                        return True
+            except Exception:
+                pass
+        for txin in tx.vin:
+            op_ser = txin.prevout.txid.to_bytes(32, "little") + txin.prevout.n.to_bytes(4, "little")
+            if self.contains(op_ser):
+                return True
+            try:
+                for p in Script(txin.script_sig).ops():
+                    if p.data and self.contains(p.data):
+                        return True
+            except Exception:
+                pass
+        return False
+
+
+class RollingBloomFilter:
+    """ref bloom.h:122 CRollingBloomFilter: remembers the last ~n items."""
+
+    def __init__(self, n_elements: int = 120_000, fp_rate: float = 0.000001):
+        self._n = n_elements
+        self._fp = fp_rate
+        self._gen: List[BloomFilter] = []
+        self._count = 0
+        self.reset()
+
+    def reset(self) -> None:
+        tweak = random.getrandbits(32)
+        self._gen = [
+            BloomFilter(self._n // 2, self._fp, tweak),
+            BloomFilter(self._n // 2, self._fp, tweak ^ 0xFFFFFFFF),
+        ]
+        self._count = 0
+
+    def insert(self, item: bytes) -> None:
+        if self._count >= self._n // 2:
+            self._gen.pop()
+            self._gen.insert(
+                0, BloomFilter(self._n // 2, self._fp, random.getrandbits(32))
+            )
+            self._count = 0
+        self._gen[0].insert(item)
+        self._count += 1
+
+    def contains(self, item: bytes) -> bool:
+        return any(g.contains(item) for g in self._gen)

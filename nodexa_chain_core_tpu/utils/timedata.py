@@ -1,0 +1,32 @@
+"""Network-adjusted time (parity: reference src/timedata.cpp:32-50 —
+median of peer clock offsets, capped sample count, ±70 min sanity)."""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+MAX_SAMPLES = 199
+MAX_OFFSET = 70 * 60
+
+
+class TimeData:
+    def __init__(self) -> None:
+        self._offsets: List[int] = [0]
+
+    def add_sample(self, peer_time: int) -> None:
+        if len(self._offsets) >= MAX_SAMPLES:
+            return
+        offset = peer_time - int(time.time())
+        if abs(offset) <= MAX_OFFSET:
+            self._offsets.append(offset)
+
+    def offset(self) -> int:
+        s = sorted(self._offsets)
+        return s[len(s) // 2]
+
+    def adjusted_time(self) -> int:
+        return int(time.time()) + self.offset()
+
+
+g_timedata = TimeData()

@@ -1,0 +1,153 @@
+"""Aux subsystem tests: versionbits, bloom/merkleblock, fee estimator,
+sigcache, timedata, safemode (ref versionbits_tests.cpp, bloom_tests.cpp,
+policyestimator_tests.cpp)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.blockindex import BlockIndex
+from nodexa_chain_core_tpu.chain.fees import BlockPolicyEstimator
+from nodexa_chain_core_tpu.chain.merkleblock import (
+    PartialMerkleTree,
+    make_merkle_block,
+)
+from nodexa_chain_core_tpu.consensus.params import ConsensusParams, Deployment
+from nodexa_chain_core_tpu.consensus.versionbits import (
+    ThresholdState,
+    VersionBitsCache,
+    VERSIONBITS_TOP_BITS,
+)
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.primitives.block import BlockHeader
+from nodexa_chain_core_tpu.script.sigcache import SignatureCache
+from nodexa_chain_core_tpu.utils.bloom import BloomFilter, RollingBloomFilter
+
+
+def _chain(n, version, bits=0x207FFFFF, start_time=1_000_000, spacing=60):
+    prev = None
+    for h in range(n):
+        hdr = BlockHeader(version=version, time=start_time + h * spacing, bits=bits)
+        idx = BlockIndex(header=hdr, prev=prev)
+        idx.build_from_prev()
+        prev = idx
+    return prev
+
+
+def _params(start, timeout, window=144, threshold=108):
+    return ConsensusParams(
+        miner_confirmation_window=window,
+        rule_change_activation_threshold=threshold,
+        deployments={"testdep": Deployment(bit=3, start_time=start, timeout=timeout)},
+    )
+
+
+def test_versionbits_lifecycle():
+    cache = VersionBitsCache()
+    signalling = VERSIONBITS_TOP_BITS | (1 << 3)
+    params = _params(start=1_000_000, timeout=2_000_000_000)
+    # all blocks signal from genesis: DEFINED -> STARTED -> LOCKED_IN -> ACTIVE
+    tip = _chain(144 * 4, signalling)
+    assert cache.state(tip, params, "testdep") == ThresholdState.ACTIVE
+
+    # no signalling: stuck in STARTED until timeout
+    cache2 = VersionBitsCache()
+    tip2 = _chain(144 * 4, VERSIONBITS_TOP_BITS)
+    assert cache2.state(tip2, params, "testdep") == ThresholdState.STARTED
+
+    # timeout before start: FAILED
+    cache3 = VersionBitsCache()
+    params3 = _params(start=1_000_000, timeout=1_000_300)
+    tip3 = _chain(144 * 4, VERSIONBITS_TOP_BITS)
+    assert cache3.state(tip3, params3, "testdep") == ThresholdState.FAILED
+
+
+def test_versionbits_compute_block_version():
+    cache = VersionBitsCache()
+    params = _params(start=1_000_000, timeout=2_000_000_000)
+    tip = _chain(300, VERSIONBITS_TOP_BITS)
+    v = cache.compute_block_version(tip, params)
+    assert v & VERSIONBITS_TOP_BITS
+    assert v & (1 << 3)  # still signalling while STARTED
+
+
+def test_bloom_filter_basics():
+    f = BloomFilter(10, 0.001, tweak=12345)
+    f.insert(b"hello")
+    f.insert(b"world")
+    assert f.contains(b"hello")
+    assert f.contains(b"world")
+    assert not f.contains(b"absent-element")
+    assert f.is_within_size_constraints()
+
+
+def test_rolling_bloom():
+    r = RollingBloomFilter(n_elements=100)
+    for i in range(60):
+        r.insert(i.to_bytes(4, "little"))
+    assert r.contains((59).to_bytes(4, "little"))
+    assert r.contains((0).to_bytes(4, "little"))
+    assert not r.contains((999).to_bytes(4, "little"))
+
+
+def test_partial_merkle_tree_proof():
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+
+    txids = [1000 + i for i in range(7)]
+    matches = [False, True, False, False, True, False, False]
+    tree = PartialMerkleTree(txids, matches)
+    root, matched = tree.extract_matches()
+    assert matched == [1001, 1004]
+    assert root == merkle_root(txids)[0]
+    # serialization roundtrip
+    w = ByteWriter()
+    tree.serialize(w)
+    back = PartialMerkleTree.deserialize(ByteReader(w.getvalue()))
+    root2, matched2 = back.extract_matches()
+    assert (root2, matched2) == (root, matched)
+
+
+def test_fee_estimator_learns():
+    est = BlockPolicyEstimator()
+    # 1000 txs at 5000 sat/kB confirming next block
+    for i in range(400):
+        est.process_tx(i, height=i, fee=5000, size=1000)
+        est.process_block(i + 1, [i])
+    rate = est.estimate_fee(2)
+    assert rate is not None
+    assert 3000 <= rate <= 8000
+    smart, target = est.estimate_smart_fee(1)
+    assert smart is not None
+
+
+def test_sigcache():
+    c = SignatureCache(max_entries=2)
+    c.set(b"d1", b"s1", b"p1", True)
+    assert c.get(b"d1", b"s1", b"p1") is True
+    assert c.get(b"d2", b"s1", b"p1") is None
+    c.set(b"d2", b"s2", b"p2", False)
+    c.set(b"d3", b"s3", b"p3", True)  # evicts d1
+    assert c.get(b"d1", b"s1", b"p1") is None
+    assert c.get(b"d2", b"s2", b"p2") is False
+
+
+def test_timedata_median():
+    from nodexa_chain_core_tpu.utils.timedata import TimeData
+    import time as _t
+
+    td = TimeData()
+    now = int(_t.time())
+    for off in (10, 20, 30, -5):
+        td.add_sample(now + off)
+    td.add_sample(now + 100 * 60 * 60)  # insane offset rejected
+    assert -5 <= td.offset() <= 30
+
+
+def test_safemode_gate():
+    from nodexa_chain_core_tpu.rpc import safemode
+    from nodexa_chain_core_tpu.rpc.server import RPCError
+
+    safemode.clear_safe_mode()
+    safemode.observe_safe_mode()  # no-op
+    safemode.set_safe_mode("test reason")
+    with pytest.raises(RPCError):
+        safemode.observe_safe_mode()
+    safemode.clear_safe_mode()
