@@ -185,9 +185,32 @@ class ChainState:
     # ------------------------------------------------------- header checks
 
     def check_block_header(self, header: BlockHeader, check_pow: bool = True) -> None:
-        """ref validation.cpp CheckBlockHeader."""
+        """ref validation.cpp:11638 CheckBlockHeader."""
+        sched = self.params.algo_schedule
+        if check_pow and sched.is_kawpow(header.time):
+            # Below the last checkpoint the mix_hash is trusted and only the
+            # cheap final-hash boundary is checked (ref :11640-50).
+            last_cp = max(self.params.checkpoints, default=-1)
+            if header.height > last_cp:
+                from ..crypto import kawpow
+
+                header_hash = int.from_bytes(
+                    header.kawpow_header_hash(sched), "little"
+                )
+                final, mix = kawpow.kawpow_hash(
+                    header.height, header_hash, header.nonce64
+                )
+                if not powrules.check_proof_of_work(
+                    final, header.bits, self.params.consensus
+                ):
+                    raise BlockValidationError("high-hash", "proof of work failed")
+                if mix != header.mix_hash:
+                    raise BlockValidationError(
+                        "invalid-mix-hash", "mix_hash validity failed"
+                    )
+                return
         if check_pow and not powrules.check_proof_of_work(
-            header.get_hash(self.params.algo_schedule),
+            header.get_hash(sched),
             header.bits,
             self.params.consensus,
         ):

@@ -1,0 +1,141 @@
+"""KawPow (ProgPoW 0.9.4 / ethash) — Python facade over the native engine.
+
+Byte-order contract (parity with ref src/hash.cpp:258-289): the node's
+``uint256`` values are little-endian integers over internal bytes, but the
+reference feeds progpow the *display-order* bytes — its ``KAWPOWHash`` does
+``to_hash256(uint256.GetHex())``, i.e. reverses the sha256d bytes — and
+parses results back the same way.  This module takes/returns the node's
+LE-int convention and performs the reversal at the boundary.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+from .. import native
+
+EPOCH_LENGTH = 7500
+PERIOD_LENGTH = 3
+
+
+def epoch_number(height: int) -> int:
+    return height // EPOCH_LENGTH
+
+
+def _as_progpow_bytes(u256_le_int: int) -> bytes:
+    """uint256 LE int -> reference hash256.bytes (display order)."""
+    return u256_le_int.to_bytes(32, "little")[::-1]
+
+
+def _from_progpow_bytes(b: bytes) -> int:
+    return int.from_bytes(b[::-1], "little")
+
+
+def available() -> bool:
+    return native.available()
+
+
+def kawpow_hash(height: int, header_hash: int, nonce64: int) -> Tuple[int, int]:
+    """Full DAG hash.  Returns (final_hash, mix_hash) as uint256 LE ints.
+
+    Parity: ref src/hash.cpp KAWPOWHash (:258).
+    """
+    lib = native.load()
+    final = (ctypes.c_uint8 * 32)()
+    mix = (ctypes.c_uint8 * 32)()
+    lib.nxk_kawpow_hash(
+        height, _as_progpow_bytes(header_hash), nonce64 & 0xFFFFFFFFFFFFFFFF,
+        final, mix,
+    )
+    return _from_progpow_bytes(bytes(final)), _from_progpow_bytes(bytes(mix))
+
+
+def kawpow_hash_no_verify(height: int, header_hash: int, mix_hash: int,
+                          nonce64: int) -> int:
+    """Final hash from the header's claimed mix, no DAG work.
+
+    Parity: ref src/hash.cpp KAWPOWHash_OnlyMix (:280) /
+    progpow::hash_no_verify.  This is what gives a KawPow block its identity
+    hash cheaply; full verification recomputes the mix.
+    """
+    lib = native.load()
+    final = (ctypes.c_uint8 * 32)()
+    lib.nxk_kawpow_hash_no_verify(
+        height, _as_progpow_bytes(header_hash), _as_progpow_bytes(mix_hash),
+        nonce64 & 0xFFFFFFFFFFFFFFFF, final,
+    )
+    return _from_progpow_bytes(bytes(final))
+
+
+def kawpow_verify(height: int, header_hash: int, mix_hash: int, nonce64: int,
+                  target: int) -> Tuple[bool, int]:
+    """Boundary check + mix recomputation (ref progpow::verify).
+
+    Returns (ok, final_hash).  ``target`` is the expanded compact target as a
+    uint256 LE int (the boundary).
+    """
+    lib = native.load()
+    final = (ctypes.c_uint8 * 32)()
+    ok = lib.nxk_kawpow_verify(
+        height, _as_progpow_bytes(header_hash), _as_progpow_bytes(mix_hash),
+        nonce64 & 0xFFFFFFFFFFFFFFFF, _as_progpow_bytes(target), final,
+    )
+    return bool(ok), _from_progpow_bytes(bytes(final))
+
+
+def kawpow_search(height: int, header_hash: int, target: int,
+                  start_nonce: int = 0, iterations: int = 1 << 20,
+                  ) -> Optional[Tuple[int, int, int]]:
+    """CPU nonce scan.  Returns (nonce64, final_hash, mix_hash) or None.
+
+    Parity: ref progpow::search_light; the regtest/CPU miner path.  The TPU
+    batched search lives in ops/progpow_jax.py.
+    """
+    lib = native.load()
+    nonce_out = ctypes.c_uint64()
+    final = (ctypes.c_uint8 * 32)()
+    mix = (ctypes.c_uint8 * 32)()
+    found = lib.nxk_kawpow_search(
+        height, _as_progpow_bytes(header_hash), _as_progpow_bytes(target),
+        start_nonce, iterations, ctypes.byref(nonce_out), final, mix,
+    )
+    if not found:
+        return None
+    return (
+        nonce_out.value,
+        _from_progpow_bytes(bytes(final)),
+        _from_progpow_bytes(bytes(mix)),
+    )
+
+
+def light_cache(epoch: int) -> bytes:
+    """Build/copy the epoch light cache (64-byte items) — feeds the JAX path."""
+    lib = native.load()
+    n = lib.nxk_light_cache_num_items(epoch)
+    buf = (ctypes.c_uint8 * (n * 64))()
+    lib.nxk_light_cache_copy(epoch, buf)
+    return bytes(buf)
+
+
+def l1_cache(epoch: int) -> bytes:
+    """16 KiB ProgPoW L1 cache (LE u32 words) — feeds the JAX path."""
+    lib = native.load()
+    buf = (ctypes.c_uint8 * (16 * 1024))()
+    lib.nxk_l1_cache_copy(epoch, buf)
+    return bytes(buf)
+
+
+def full_dataset_num_items(epoch: int) -> int:
+    return native.load().nxk_full_dataset_num_items(epoch)
+
+
+def light_cache_num_items(epoch: int) -> int:
+    return native.load().nxk_light_cache_num_items(epoch)
+
+
+def dataset_item_2048(epoch: int, index: int) -> bytes:
+    lib = native.load()
+    buf = (ctypes.c_uint8 * 256)()
+    lib.nxk_dataset_item_2048(epoch, index, buf)
+    return bytes(buf)

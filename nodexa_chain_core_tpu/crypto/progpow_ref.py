@@ -1,0 +1,271 @@
+"""Pure-Python ProgPoW 0.9.4 (KawPow variant) — executable specification.
+
+This is the slow, readable twin of native/src/kawpow.cpp, used to
+cross-validate the native engine and to document the algorithm.  DAG items
+and the L1 cache come from the native ethash layer (already proven against
+the reference's kawpow_l1_cache oracle); everything ProgPoW-specific is
+implemented here independently.
+
+Parity: ref src/crypto/ethash/lib/ethash/progpow.cpp and kiss99.hpp.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Sequence, Tuple
+
+from .keccak import keccak_f800
+
+M32 = 0xFFFFFFFF
+
+PERIOD_LENGTH = 3
+NUM_REGS = 32
+NUM_LANES = 16
+NUM_CACHE_ACCESSES = 11
+NUM_MATH_OPS = 18
+L1_CACHE_WORDS = (16 * 1024) // 4
+ROUNDS = 64
+
+FNV_PRIME = 0x01000193
+FNV_OFFSET_BASIS = 0x811C9DC5
+
+# "rAVENCOINKAWPOW" absorb filler (ref progpow.cpp:157-173).  The first word
+# is genuinely lowercase 'r' (0x72): the reference's "//R" comment misstates
+# its own constant, and consensus follows the value, not the comment.
+ABSORB_PAD = [ord(c) for c in "rAVENCOINKAWPOW"]
+
+
+def fnv1a(u: int, v: int) -> int:
+    return ((u ^ v) * FNV_PRIME) & M32
+
+
+def _rotl32(n: int, c: int) -> int:
+    c &= 31
+    return ((n << c) | (n >> (32 - c))) & M32 if c else n
+
+
+def _rotr32(n: int, c: int) -> int:
+    c &= 31
+    return ((n >> c) | (n << (32 - c))) & M32 if c else n
+
+
+def _clz32(x: int) -> int:
+    return 32 - x.bit_length()
+
+
+def _popcount32(x: int) -> int:
+    return bin(x).count("1")
+
+
+class Kiss99:
+    """Marsaglia KISS (1999) — ref kiss99.hpp."""
+
+    def __init__(self, z: int, w: int, jsr: int, jcong: int):
+        self.z, self.w, self.jsr, self.jcong = z, w, jsr, jcong
+
+    def next(self) -> int:
+        self.z = (36969 * (self.z & 0xFFFF) + (self.z >> 16)) & M32
+        self.w = (18000 * (self.w & 0xFFFF) + (self.w >> 16)) & M32
+        self.jcong = (69069 * self.jcong + 1234567) & M32
+        jsr = self.jsr
+        jsr ^= (jsr << 17) & M32
+        jsr ^= jsr >> 13
+        jsr ^= (jsr << 5) & M32
+        self.jsr = jsr
+        return (((((self.z << 16) & M32) + self.w) & M32 ^ self.jcong) + jsr) & M32
+
+
+def random_math(a: int, b: int, sel: int) -> int:
+    op = sel % 11
+    if op == 1:
+        return (a * b) & M32
+    if op == 2:
+        return ((a * b) >> 32) & M32
+    if op == 3:
+        return min(a, b)
+    if op == 4:
+        return _rotl32(a, b)
+    if op == 5:
+        return _rotr32(a, b)
+    if op == 6:
+        return a & b
+    if op == 7:
+        return a | b
+    if op == 8:
+        return a ^ b
+    if op == 9:
+        return _clz32(a) + _clz32(b)
+    if op == 10:
+        return _popcount32(a) + _popcount32(b)
+    return (a + b) & M32
+
+
+def random_merge(a: int, b: int, sel: int) -> int:
+    x = ((sel >> 16) % 31) + 1
+    op = sel % 4
+    if op == 0:
+        return (a * 33 + b) & M32
+    if op == 1:
+        return ((a ^ b) * 33) & M32
+    if op == 2:
+        return _rotl32(a, x) ^ b
+    return _rotr32(a, x) ^ b
+
+
+class MixSeq:
+    """Per-period register permutation + selector RNG (ref mix_rng_state)."""
+
+    def __init__(self, seed_lo: int, seed_hi: int):
+        z = fnv1a(FNV_OFFSET_BASIS, seed_lo)
+        w = fnv1a(z, seed_hi)
+        jsr = fnv1a(w, seed_lo)
+        jcong = fnv1a(jsr, seed_hi)
+        self.rng = Kiss99(z, w, jsr, jcong)
+        self.dst_seq = list(range(NUM_REGS))
+        self.src_seq = list(range(NUM_REGS))
+        for i in range(NUM_REGS, 1, -1):
+            j = self.rng.next() % i
+            self.dst_seq[i - 1], self.dst_seq[j] = self.dst_seq[j], self.dst_seq[i - 1]
+            k = self.rng.next() % i
+            self.src_seq[i - 1], self.src_seq[k] = self.src_seq[k], self.src_seq[i - 1]
+        self.dst_i = 0
+        self.src_i = 0
+
+    def clone(self) -> "MixSeq":
+        c = object.__new__(MixSeq)
+        c.rng = Kiss99(self.rng.z, self.rng.w, self.rng.jsr, self.rng.jcong)
+        c.dst_seq = list(self.dst_seq)
+        c.src_seq = list(self.src_seq)
+        c.dst_i = self.dst_i
+        c.src_i = self.src_i
+        return c
+
+    def next_dst(self) -> int:
+        v = self.dst_seq[self.dst_i % NUM_REGS]
+        self.dst_i += 1
+        return v
+
+    def next_src(self) -> int:
+        v = self.src_seq[self.src_i % NUM_REGS]
+        self.src_i += 1
+        return v
+
+
+def init_mix(seed_lo: int, seed_hi: int) -> List[List[int]]:
+    z = fnv1a(FNV_OFFSET_BASIS, seed_lo)
+    w = fnv1a(z, seed_hi)
+    mix = []
+    for lane in range(NUM_LANES):
+        jsr = fnv1a(w, lane)
+        jcong = fnv1a(jsr, lane)
+        rng = Kiss99(z, w, jsr, jcong)
+        mix.append([rng.next() for _ in range(NUM_REGS)])
+    return mix
+
+
+def progpow_round(
+    r: int,
+    mix: List[List[int]],
+    seq: MixSeq,
+    l1: Sequence[int],
+    num_items_2048: int,
+    lookup2048: Callable[[int], bytes],
+) -> None:
+    """One round; `seq` must be a fresh clone per round (pass-by-value parity)."""
+    item_index = mix[r % NUM_LANES][0] % num_items_2048
+    item = lookup2048(item_index)
+    item_words = struct.unpack("<64I", item)
+
+    for i in range(max(NUM_CACHE_ACCESSES, NUM_MATH_OPS)):
+        if i < NUM_CACHE_ACCESSES:
+            src = seq.next_src()
+            dst = seq.next_dst()
+            sel = seq.rng.next()
+            for lane in mix:
+                off = lane[src] % L1_CACHE_WORDS
+                lane[dst] = random_merge(lane[dst], l1[off], sel)
+        if i < NUM_MATH_OPS:
+            src_rnd = seq.rng.next() % (NUM_REGS * (NUM_REGS - 1))
+            src1 = src_rnd % NUM_REGS
+            src2 = src_rnd // NUM_REGS
+            if src2 >= src1:
+                src2 += 1
+            sel1 = seq.rng.next()
+            dst = seq.next_dst()
+            sel2 = seq.rng.next()
+            for lane in mix:
+                data = random_math(lane[src1], lane[src2], sel1)
+                lane[dst] = random_merge(lane[dst], data, sel2)
+
+    words_per_lane = 64 // NUM_LANES  # 4
+    dsts = []
+    sels = []
+    for i in range(words_per_lane):
+        dsts.append(0 if i == 0 else seq.next_dst())
+        sels.append(seq.rng.next())
+    for l in range(NUM_LANES):
+        off = ((l ^ r) % NUM_LANES) * words_per_lane
+        for i in range(words_per_lane):
+            mix[l][dsts[i]] = random_merge(mix[l][dsts[i]], item_words[off + i], sels[i])
+
+
+def hash_mix(
+    block_number: int,
+    seed_lo: int,
+    seed_hi: int,
+    l1: Sequence[int],
+    num_items_2048: int,
+    lookup2048: Callable[[int], bytes],
+) -> bytes:
+    mix = init_mix(seed_lo, seed_hi)
+    period = block_number // PERIOD_LENGTH
+    seq = MixSeq(period & M32, (period >> 32) & M32)
+
+    for r in range(ROUNDS):
+        progpow_round(r, mix, seq.clone(), l1, num_items_2048, lookup2048)
+
+    lane_hash = []
+    for lane in mix:
+        h = FNV_OFFSET_BASIS
+        for v in lane:
+            h = fnv1a(h, v)
+        lane_hash.append(h)
+
+    words = [FNV_OFFSET_BASIS] * 8
+    for l in range(NUM_LANES):
+        words[l % 8] = fnv1a(words[l % 8], lane_hash[l])
+    return struct.pack("<8I", *words)
+
+
+def seed_absorb(header_hash: bytes, nonce: int) -> List[int]:
+    """keccak-f800 absorb of header+nonce, RAVENCOINKAWPOW-padded.
+
+    Returns the full post-permutation 25-word state.
+    """
+    state = list(struct.unpack("<8I", header_hash[:32]))
+    state += [nonce & M32, (nonce >> 32) & M32]
+    state += ABSORB_PAD
+    keccak_f800(state)
+    return state
+
+
+def final_absorb(seed_state: Sequence[int], mix_hash: bytes) -> bytes:
+    state = list(seed_state[:8])
+    state += list(struct.unpack("<8I", mix_hash))
+    state += ABSORB_PAD[:9]
+    keccak_f800(state)
+    return struct.pack("<8I", *state[:8])
+
+
+def kawpow_hash(
+    block_number: int,
+    header_hash: bytes,
+    nonce: int,
+    l1: Sequence[int],
+    num_items_2048: int,
+    lookup2048: Callable[[int], bytes],
+) -> Tuple[bytes, bytes]:
+    """Returns (final_hash, mix_hash) as reference-order (display) bytes."""
+    state = seed_absorb(header_hash, nonce)
+    mix = hash_mix(block_number, state[0], state[1], l1, num_items_2048, lookup2048)
+    return final_absorb(state, mix), mix

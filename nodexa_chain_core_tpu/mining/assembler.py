@@ -105,12 +105,33 @@ class BlockAssembler:
 
 def mine_block_cpu(block: Block, schedule, max_tries: int = 1 << 22) -> bool:
     """Trivial-difficulty CPU nonce scan (regtest path; ref the
-    generatetoaddress regtest loop, rpc/mining.cpp:175)."""
+    generatetoaddress regtest loop, rpc/mining.cpp:175).
+
+    KawPow-era blocks search nonce64 through the native ProgPoW engine
+    (ref GenerateClores' GetHashFull loop, miner.cpp:566-726) and fill in
+    the winning mix_hash.
+    """
     from ..core.uint256 import bits_to_target
 
     target, neg, ovf = bits_to_target(block.header.bits)
     if neg or ovf or target == 0:
         return False
+    if schedule.is_kawpow(block.header.time):
+        from ..crypto import kawpow
+
+        header_hash = int.from_bytes(
+            block.header.kawpow_header_hash(schedule), "little"
+        )
+        found = kawpow.kawpow_search(
+            block.header.height, header_hash, target, 0, max_tries
+        )
+        if found is None:
+            return False
+        nonce64, _final, mix = found
+        block.header.nonce64 = nonce64
+        block.header.mix_hash = mix
+        block.header._cached_hash = None
+        return True
     for nonce in range(max_tries):
         block.header.nonce = nonce
         block.header._cached_hash = None

@@ -1,0 +1,119 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference keeps its hot consensus crypto native (C++: src/crypto/ethash
+for KawPow, src/algo for the X16R family); this package mirrors that with a
+small C++ library compiled on first use with the in-image toolchain.  No
+pybind11 in this environment, so the ABI is flat ``extern "C"`` + ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC_DIR = Path(__file__).resolve().parent / "src"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_LIB_PATH = _BUILD_DIR / "libnxkawpow.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _sources() -> list[Path]:
+    return sorted(_SRC_DIR.glob("*.cpp"))
+
+
+def _needs_build() -> bool:
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    deps = _sources() + sorted(_SRC_DIR.glob("*.hpp"))
+    return any(p.stat().st_mtime > lib_mtime for p in deps)
+
+
+def build(force: bool = False) -> Path:
+    """Compile the shared library if missing or stale."""
+    if not force and not _needs_build():
+        return _LIB_PATH
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # Compile to a per-pid temp path and rename atomically so concurrent
+    # processes (pytest workers, node + miner) never dlopen a half-written .so.
+    tmp_path = _BUILD_DIR / f".libnxkawpow.{os.getpid()}.so"
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        str(tmp_path),
+    ] + [str(p) for p in _sources()]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        tmp_path.unlink(missing_ok=True)
+        raise NativeBuildError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}"
+        )
+    os.replace(tmp_path, _LIB_PATH)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    """Build-if-needed and dlopen the native library (cached)."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.nxk_epoch_number.argtypes = [ctypes.c_int]
+        lib.nxk_epoch_number.restype = ctypes.c_int
+        lib.nxk_light_cache_num_items.argtypes = [ctypes.c_int]
+        lib.nxk_light_cache_num_items.restype = ctypes.c_int
+        lib.nxk_full_dataset_num_items.argtypes = [ctypes.c_int]
+        lib.nxk_full_dataset_num_items.restype = ctypes.c_int
+        lib.nxk_keccak256.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u8p]
+        lib.nxk_keccak512.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u8p]
+        lib.nxk_keccakf800.argtypes = [ctypes.POINTER(ctypes.c_uint32)]
+        lib.nxk_keccakf1600.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        lib.nxk_light_cache_copy.argtypes = [ctypes.c_int, u8p]
+        lib.nxk_l1_cache_copy.argtypes = [ctypes.c_int, u8p]
+        lib.nxk_dataset_item_2048.argtypes = [ctypes.c_int, ctypes.c_uint32, u8p]
+        lib.nxk_kawpow_hash.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, u8p, u8p,
+        ]
+        lib.nxk_kawpow_hash_no_verify.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, u8p,
+        ]
+        lib.nxk_kawpow_verify.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, u8p,
+        ]
+        lib.nxk_kawpow_verify.restype = ctypes.c_int
+        lib.nxk_kawpow_search.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64), u8p, u8p,
+        ]
+        lib.nxk_kawpow_search.restype = ctypes.c_int
+
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    """True if the native library can be loaded (builds on first call)."""
+    try:
+        load()
+        return True
+    except (NativeBuildError, OSError):
+        return False

@@ -1,0 +1,109 @@
+// Flat C ABI for ctypes (pybind11 is not available in this environment).
+//
+// All 32-byte hash arguments use the reference's hash256.bytes convention:
+// the KawPow header hash is passed byte-reversed relative to the node's
+// uint256 little-endian integer form (ref src/hash.cpp:258-289 round-trips
+// through GetHex()/uint256S which reverse byte order).
+
+#include "kawpow.hpp"
+#include "keccak.hpp"
+
+#include <cstring>
+
+using namespace nxk;
+
+extern "C" {
+
+int nxk_epoch_number(int height) { return height / kEpochLength; }
+
+int nxk_light_cache_num_items(int epoch) { return light_cache_num_items(epoch); }
+
+int nxk_full_dataset_num_items(int epoch) { return full_dataset_num_items(epoch); }
+
+void nxk_keccak256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  keccak256(data, len, out);
+}
+
+void nxk_keccak512(const uint8_t* data, size_t len, uint8_t out[64]) {
+  keccak512(data, len, out);
+}
+
+void nxk_keccakf800(uint32_t state[25]) { keccakf800(state); }
+
+void nxk_keccakf1600(uint64_t state[25]) { keccakf1600(state); }
+
+// Builds (and caches) the epoch context; copies out the light cache.
+// `out` must hold nxk_light_cache_num_items(epoch) * 64 bytes.
+void nxk_light_cache_copy(int epoch, uint8_t* out) {
+  auto ctx = get_epoch_context(epoch);
+  std::memcpy(out, ctx->light_cache.data(), ctx->light_cache.size() * 64);
+}
+
+// Copies the 16 KiB ProgPoW L1 cache (little-endian u32 words).
+void nxk_l1_cache_copy(int epoch, uint8_t* out) {
+  auto ctx = get_epoch_context(epoch);
+  std::memcpy(out, ctx->l1_cache.data(), kL1CacheBytes);
+}
+
+void nxk_dataset_item_2048(int epoch, uint32_t index, uint8_t out[256]) {
+  auto ctx = get_epoch_context(epoch);
+  dataset_item_2048(*ctx, index, out);
+}
+
+void nxk_kawpow_hash(int height, const uint8_t header_hash[32], uint64_t nonce,
+                     uint8_t final_out[32], uint8_t mix_out[32]) {
+  auto ctx = get_epoch_context(height / kEpochLength);
+  Hash256 hh;
+  std::memcpy(hh.bytes, header_hash, 32);
+  KawpowResult r = kawpow_hash(*ctx, height, hh, nonce);
+  std::memcpy(final_out, r.final_hash.bytes, 32);
+  std::memcpy(mix_out, r.mix_hash.bytes, 32);
+}
+
+void nxk_kawpow_hash_no_verify(int height, const uint8_t header_hash[32],
+                               const uint8_t mix_hash[32], uint64_t nonce,
+                               uint8_t final_out[32]) {
+  Hash256 hh, mix;
+  std::memcpy(hh.bytes, header_hash, 32);
+  std::memcpy(mix.bytes, mix_hash, 32);
+  Hash256 f = kawpow_hash_no_verify(height, hh, mix, nonce);
+  std::memcpy(final_out, f.bytes, 32);
+}
+
+int nxk_kawpow_verify(int height, const uint8_t header_hash[32],
+                      const uint8_t mix_hash[32], uint64_t nonce,
+                      const uint8_t boundary[32], uint8_t final_out[32]) {
+  auto ctx = get_epoch_context(height / kEpochLength);
+  Hash256 hh, mix, bound, f;
+  std::memcpy(hh.bytes, header_hash, 32);
+  std::memcpy(mix.bytes, mix_hash, 32);
+  std::memcpy(bound.bytes, boundary, 32);
+  const bool ok = kawpow_verify(*ctx, height, hh, mix, nonce, bound, &f);
+  if (final_out) std::memcpy(final_out, f.bytes, 32);
+  return ok ? 1 : 0;
+}
+
+// Simple nonce scan (CPU miner path; the TPU batched search lives in
+// ops/progpow_jax.py).  Returns 1 and fills nonce/final/mix on success.
+int nxk_kawpow_search(int height, const uint8_t header_hash[32],
+                      const uint8_t boundary[32], uint64_t start_nonce,
+                      uint64_t iterations, uint64_t* nonce_out,
+                      uint8_t final_out[32], uint8_t mix_out[32]) {
+  auto ctx = get_epoch_context(height / kEpochLength);
+  Hash256 hh, bound;
+  std::memcpy(hh.bytes, header_hash, 32);
+  std::memcpy(bound.bytes, boundary, 32);
+  for (uint64_t i = 0; i < iterations; ++i) {
+    const uint64_t nonce = start_nonce + i;
+    KawpowResult r = kawpow_hash(*ctx, height, hh, nonce);
+    if (std::memcmp(r.final_hash.bytes, bound.bytes, 32) <= 0) {
+      *nonce_out = nonce;
+      std::memcpy(final_out, r.final_hash.bytes, 32);
+      std::memcpy(mix_out, r.mix_hash.bytes, 32);
+      return 1;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

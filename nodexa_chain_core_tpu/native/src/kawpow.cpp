@@ -1,0 +1,439 @@
+// KawPow (ProgPoW 0.9.4 / ethash-DAG) verification engine.
+//
+// Clean-room from the algorithm as specified; behavioral parity targets are
+// cited per function.  Little-endian host assumed (x86-64 dev hosts and TPU
+// VMs both qualify); word views of hashes are raw LE loads, matching the
+// reference's no-op le::uint32 on such hosts.
+
+#include "kawpow.hpp"
+
+#include "keccak.hpp"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace nxk {
+
+namespace {
+
+inline uint32_t ld32(const uint8_t* p) {
+  uint32_t w;
+  std::memcpy(&w, p, 4);
+  return w;
+}
+inline void st32(uint8_t* p, uint32_t w) { std::memcpy(p, &w, 4); }
+
+constexpr uint32_t kFnvPrime = 0x01000193u;
+constexpr uint32_t kFnvOffsetBasis = 0x811c9dc5u;
+
+inline uint32_t fnv1(uint32_t u, uint32_t v) { return (u * kFnvPrime) ^ v; }
+inline uint32_t fnv1a(uint32_t u, uint32_t v) { return (u ^ v) * kFnvPrime; }
+
+inline uint32_t rotl32(uint32_t n, uint32_t c) {
+  c &= 31;
+  return c ? (n << c) | (n >> (32 - c)) : n;
+}
+inline uint32_t rotr32(uint32_t n, uint32_t c) {
+  c &= 31;
+  return c ? (n >> c) | (n << (32 - c)) : n;
+}
+inline uint32_t clz32(uint32_t x) {
+  return x ? static_cast<uint32_t>(__builtin_clz(x)) : 32u;
+}
+inline uint32_t popcount32(uint32_t x) {
+  return static_cast<uint32_t>(__builtin_popcount(x));
+}
+inline uint32_t mul_hi32(uint32_t a, uint32_t b) {
+  return static_cast<uint32_t>((static_cast<uint64_t>(a) * b) >> 32);
+}
+
+// "rAVENCOINKAWPOW" — the f800 absorb filler (ref progpow.cpp:157-173; the
+// fork renamed the array but kept the Ravencoin byte values).  NOTE: the
+// first word really is LOWERCASE 'r' (0x72) — the reference's "//R" comment
+// is wrong about its own value, and consensus follows the value.
+constexpr uint32_t kAbsorbPad[15] = {'r', 'A', 'V', 'E', 'N', 'C', 'O', 'I',
+                                     'N', 'K', 'A', 'W', 'P', 'O', 'W'};
+
+// --- KISS99 PRNG (Marsaglia 1999; ref kiss99.hpp) ---------------------------
+struct Kiss99 {
+  uint32_t z, w, jsr, jcong;
+
+  uint32_t next() {
+    z = 36969u * (z & 0xffffu) + (z >> 16);
+    w = 18000u * (w & 0xffffu) + (w >> 16);
+    jcong = 69069u * jcong + 1234567u;
+    jsr ^= jsr << 17;
+    jsr ^= jsr >> 13;
+    jsr ^= jsr << 5;
+    return (((z << 16) + w) ^ jcong) + jsr;
+  }
+};
+
+}  // namespace
+
+// --- ethash epoch machinery -------------------------------------------------
+
+int largest_prime_leq(int upper_bound) {
+  // ref primes.c ethash_find_largest_prime (trial division is fine: called
+  // once per epoch switch).
+  if (upper_bound < 2) return 0;
+  if (upper_bound == 2) return 2;
+  int n = upper_bound | 1;
+  if (n > upper_bound) n -= 2;
+  for (;; n -= 2) {
+    bool prime = true;
+    for (int64_t d = 3; d * d <= n; d += 2) {
+      if (n % d == 0) {
+        prime = false;
+        break;
+      }
+    }
+    if (prime) return n;
+  }
+}
+
+int light_cache_num_items(int epoch) {
+  return largest_prime_leq(kLightCacheInitBytes / 64 +
+                           epoch * (kLightCacheGrowthBytes / 64));
+}
+
+int full_dataset_num_items(int epoch) {
+  return largest_prime_leq(kFullDatasetInitBytes / 128 +
+                           epoch * (kFullDatasetGrowthBytes / 128));
+}
+
+Hash256 epoch_seed(int epoch) {
+  // ref ethash.cpp ethash_calculate_epoch_seed: keccak256 iterated from zero.
+  Hash256 s{};
+  for (int i = 0; i < epoch; ++i) keccak256(s.bytes, 32, s.bytes);
+  return s;
+}
+
+namespace {
+
+void build_light_cache(std::vector<Hash512>& cache, int num_items,
+                       const Hash256& seed) {
+  // ref ethash.cpp generic::build_light_cache.
+  cache.resize(num_items);
+  keccak512(seed.bytes, 32, cache[0].bytes);
+  for (int i = 1; i < num_items; ++i)
+    keccak512(cache[i - 1].bytes, 64, cache[i].bytes);
+
+  const uint32_t limit = static_cast<uint32_t>(num_items);
+  for (int round = 0; round < kLightCacheRounds; ++round) {
+    for (int i = 0; i < num_items; ++i) {
+      const uint32_t v = ld32(cache[i].bytes) % limit;
+      const uint32_t w = static_cast<uint32_t>(num_items + i - 1) % limit;
+      uint8_t x[64];
+      for (int k = 0; k < 64; ++k) x[k] = cache[v].bytes[k] ^ cache[w].bytes[k];
+      keccak512(x, 64, cache[i].bytes);
+    }
+  }
+}
+
+// ethash single 512-bit dataset item (ref ethash.cpp item_state +
+// calculate_dataset_item_512).
+void dataset_item_512(const EpochContext& ctx, int64_t index, uint8_t out[64]) {
+  const int64_t n = static_cast<int64_t>(ctx.light_cache.size());
+  const uint32_t seed = static_cast<uint32_t>(index);
+
+  uint32_t mix[16];
+  std::memcpy(mix, ctx.light_cache[index % n].bytes, 64);
+  mix[0] ^= seed;
+  {
+    uint8_t tmp[64];
+    std::memcpy(tmp, mix, 64);
+    keccak512(tmp, 64, tmp);
+    std::memcpy(mix, tmp, 64);
+  }
+
+  for (uint32_t j = 0; j < kDatasetParents; ++j) {
+    const uint32_t t = fnv1(seed ^ j, mix[j % 16]);
+    const uint8_t* parent = ctx.light_cache[t % n].bytes;
+    for (int k = 0; k < 16; ++k) mix[k] = fnv1(mix[k], ld32(parent + 4 * k));
+  }
+
+  uint8_t tmp[64];
+  std::memcpy(tmp, mix, 64);
+  keccak512(tmp, 64, out);
+}
+
+}  // namespace
+
+void dataset_item_2048(const EpochContext& ctx, uint32_t index,
+                       uint8_t out[256]) {
+  for (int64_t k = 0; k < 4; ++k)
+    dataset_item_512(ctx, static_cast<int64_t>(index) * 4 + k, out + 64 * k);
+}
+
+std::shared_ptr<const EpochContext> get_epoch_context(int epoch) {
+  static std::mutex mu;
+  static std::map<int, std::shared_ptr<const EpochContext>> cache;
+
+  std::lock_guard<std::mutex> lock(mu);
+  auto it = cache.find(epoch);
+  if (it != cache.end()) return it->second;
+
+  auto ctx = std::make_shared<EpochContext>();
+  ctx->epoch = epoch;
+  ctx->full_items = full_dataset_num_items(epoch);
+  build_light_cache(ctx->light_cache, light_cache_num_items(epoch),
+                    epoch_seed(epoch));
+
+  // ProgPoW L1 cache = first 16 KiB of the full dataset
+  // (ref ethash.cpp generic::create_epoch_context tail loop).
+  ctx->l1_cache.resize(kL1CacheWords);
+  for (uint32_t i = 0; i < kL1CacheBytes / 256; ++i) {
+    uint8_t item[256];
+    dataset_item_2048(*ctx, i, item);
+    for (int k = 0; k < 64; ++k)
+      ctx->l1_cache[i * 64 + k] = ld32(item + 4 * k);
+  }
+
+  // Keep only a few contexts resident (~17 MB each).
+  while (cache.size() >= 3) cache.erase(cache.begin());
+  cache.emplace(epoch, ctx);
+  return ctx;
+}
+
+// --- ProgPoW mix ------------------------------------------------------------
+
+namespace {
+
+// Per-period register-permutation state (ref progpow.cpp mix_rng_state).
+struct MixSeq {
+  Kiss99 rng;
+  uint32_t dst_seq[kNumRegs];
+  uint32_t src_seq[kNumRegs];
+  uint32_t dst_i = 0;
+  uint32_t src_i = 0;
+
+  explicit MixSeq(const uint32_t seed[2]) {
+    const uint32_t z = fnv1a(kFnvOffsetBasis, seed[0]);
+    const uint32_t w = fnv1a(z, seed[1]);
+    const uint32_t jsr = fnv1a(w, seed[0]);
+    const uint32_t jcong = fnv1a(jsr, seed[1]);
+    rng = Kiss99{z, w, jsr, jcong};
+    for (uint32_t i = 0; i < kNumRegs; ++i) dst_seq[i] = src_seq[i] = i;
+    // Fisher-Yates driven by the shared rng (dst drawn first each step).
+    for (uint32_t i = kNumRegs; i > 1; --i) {
+      std::swap(dst_seq[i - 1], dst_seq[rng.next() % i]);
+      std::swap(src_seq[i - 1], src_seq[rng.next() % i]);
+    }
+  }
+
+  uint32_t next_dst() { return dst_seq[(dst_i++) % kNumRegs]; }
+  uint32_t next_src() { return src_seq[(src_i++) % kNumRegs]; }
+};
+
+uint32_t random_math(uint32_t a, uint32_t b, uint32_t sel) {
+  switch (sel % 11) {
+    case 1:
+      return a * b;
+    case 2:
+      return mul_hi32(a, b);
+    case 3:
+      return std::min(a, b);
+    case 4:
+      return rotl32(a, b);
+    case 5:
+      return rotr32(a, b);
+    case 6:
+      return a & b;
+    case 7:
+      return a | b;
+    case 8:
+      return a ^ b;
+    case 9:
+      return clz32(a) + clz32(b);
+    case 10:
+      return popcount32(a) + popcount32(b);
+    default:
+      return a + b;
+  }
+}
+
+uint32_t random_merge(uint32_t a, uint32_t b, uint32_t sel) {
+  const uint32_t x = ((sel >> 16) % 31) + 1;  // non-zero rotation amount
+  switch (sel % 4) {
+    case 0:
+      return a * 33 + b;
+    case 1:
+      return (a ^ b) * 33;
+    case 2:
+      return rotl32(a, x) ^ b;
+    default:
+      return rotr32(a, x) ^ b;
+  }
+}
+
+using MixArray = uint32_t[kNumLanes][kNumRegs];
+
+// One ProgPoW round (ref progpow.cpp round()).  `seq` is taken by value on
+// purpose: the reference passes mix_rng_state by value, so every round
+// replays the identical register/selector program for its period.
+void progpow_round(const EpochContext& ctx, uint32_t r, MixArray& mix,
+                   MixSeq seq) {
+  const uint32_t num_items = static_cast<uint32_t>(ctx.full_items / 2);
+  const uint32_t item_index = mix[r % kNumLanes][0] % num_items;
+  uint8_t item[256];
+  dataset_item_2048(ctx, item_index, item);
+
+  constexpr uint32_t kWordsPerLane = 256 / (4 * kNumLanes);  // 4
+  constexpr int kMaxOps =
+      kNumCacheAccesses > kNumMathOps ? kNumCacheAccesses : kNumMathOps;
+
+  for (int i = 0; i < kMaxOps; ++i) {
+    if (i < kNumCacheAccesses) {
+      const uint32_t src = seq.next_src();
+      const uint32_t dst = seq.next_dst();
+      const uint32_t sel = seq.rng.next();
+      for (uint32_t l = 0; l < kNumLanes; ++l) {
+        const uint32_t off = mix[l][src] % kL1CacheWords;
+        mix[l][dst] = random_merge(mix[l][dst], ctx.l1_cache[off], sel);
+      }
+    }
+    if (i < kNumMathOps) {
+      const uint32_t src_rnd = seq.rng.next() % (kNumRegs * (kNumRegs - 1));
+      const uint32_t src1 = src_rnd % kNumRegs;
+      uint32_t src2 = src_rnd / kNumRegs;
+      if (src2 >= src1) ++src2;
+      const uint32_t sel1 = seq.rng.next();
+      const uint32_t dst = seq.next_dst();
+      const uint32_t sel2 = seq.rng.next();
+      for (uint32_t l = 0; l < kNumLanes; ++l) {
+        const uint32_t data = random_math(mix[l][src1], mix[l][src2], sel1);
+        mix[l][dst] = random_merge(mix[l][dst], data, sel2);
+      }
+    }
+  }
+
+  uint32_t dsts[kWordsPerLane];
+  uint32_t sels[kWordsPerLane];
+  for (uint32_t i = 0; i < kWordsPerLane; ++i) {
+    dsts[i] = i == 0 ? 0 : seq.next_dst();
+    sels[i] = seq.rng.next();
+  }
+  for (uint32_t l = 0; l < kNumLanes; ++l) {
+    const uint32_t off = ((l ^ r) % kNumLanes) * kWordsPerLane;
+    for (uint32_t i = 0; i < kWordsPerLane; ++i) {
+      const uint32_t word = ld32(item + 4 * (off + i));
+      mix[l][dsts[i]] = random_merge(mix[l][dsts[i]], word, sels[i]);
+    }
+  }
+}
+
+// Fill the lane registers from the seed (ref progpow.cpp init_mix).
+void init_mix(const uint32_t seed[2], MixArray& mix) {
+  const uint32_t z = fnv1a(kFnvOffsetBasis, seed[0]);
+  const uint32_t w = fnv1a(z, seed[1]);
+  for (uint32_t l = 0; l < kNumLanes; ++l) {
+    const uint32_t jsr = fnv1a(w, l);
+    const uint32_t jcong = fnv1a(jsr, l);
+    Kiss99 rng{z, w, jsr, jcong};
+    for (uint32_t r = 0; r < kNumRegs; ++r) mix[l][r] = rng.next();
+  }
+}
+
+// 64 rounds + lane reduction (ref progpow.cpp hash_mix).
+Hash256 hash_mix(const EpochContext& ctx, int block_number,
+                 const uint32_t seed[2]) {
+  MixArray mix;
+  init_mix(seed, mix);
+
+  const uint64_t period = static_cast<uint64_t>(block_number / kPeriodLength);
+  const uint32_t period_seed[2] = {static_cast<uint32_t>(period),
+                                   static_cast<uint32_t>(period >> 32)};
+  MixSeq seq(period_seed);
+
+  for (uint32_t r = 0; r < kProgpowRounds; ++r)
+    progpow_round(ctx, r, mix, seq);
+
+  uint32_t lane_hash[kNumLanes];
+  for (uint32_t l = 0; l < kNumLanes; ++l) {
+    lane_hash[l] = kFnvOffsetBasis;
+    for (uint32_t r = 0; r < kNumRegs; ++r)
+      lane_hash[l] = fnv1a(lane_hash[l], mix[l][r]);
+  }
+
+  uint32_t words[8];
+  for (int i = 0; i < 8; ++i) words[i] = kFnvOffsetBasis;
+  for (uint32_t l = 0; l < kNumLanes; ++l)
+    words[l % 8] = fnv1a(words[l % 8], lane_hash[l]);
+
+  Hash256 out;
+  for (int i = 0; i < 8; ++i) st32(out.bytes + 4 * i, words[i]);
+  return out;
+}
+
+// keccak-f800 absorb of header_hash+nonce padded with "RAVENCOINKAWPOW";
+// leaves the full 25-word state in `state` (ref progpow.cpp hash() phase 1).
+void seed_absorb(const Hash256& header_hash, uint64_t nonce,
+                 uint32_t state[25]) {
+  for (int i = 0; i < 8; ++i) state[i] = ld32(header_hash.bytes + 4 * i);
+  state[8] = static_cast<uint32_t>(nonce);
+  state[9] = static_cast<uint32_t>(nonce >> 32);
+  for (int i = 10; i < 25; ++i) state[i] = kAbsorbPad[i - 10];
+  keccakf800(state);
+}
+
+// Final keccak-f800 over carried seed state + mix, padded with "RAVENCOIN"
+// (ref progpow.cpp hash() phase 2).
+Hash256 final_absorb(const uint32_t seed_state[8], const Hash256& mix_hash) {
+  uint32_t state[25];
+  for (int i = 0; i < 8; ++i) state[i] = seed_state[i];
+  for (int i = 8; i < 16; ++i) state[i] = ld32(mix_hash.bytes + 4 * (i - 8));
+  for (int i = 16; i < 25; ++i) state[i] = kAbsorbPad[i - 16];
+  keccakf800(state);
+
+  Hash256 out;
+  for (int i = 0; i < 8; ++i) st32(out.bytes + 4 * i, state[i]);
+  return out;
+}
+
+// Big-endian byte comparison a <= b (ref ethash.hpp is_less_or_equal).
+bool bytes_leq(const Hash256& a, const Hash256& b) {
+  return std::memcmp(a.bytes, b.bytes, 32) <= 0;
+}
+
+}  // namespace
+
+KawpowResult kawpow_hash(const EpochContext& ctx, int block_number,
+                         const Hash256& header_hash, uint64_t nonce) {
+  uint32_t state[25];
+  seed_absorb(header_hash, nonce, state);
+  const uint32_t seed[2] = {state[0], state[1]};
+
+  KawpowResult r;
+  r.mix_hash = hash_mix(ctx, block_number, seed);
+  r.final_hash = final_absorb(state, r.mix_hash);
+  return r;
+}
+
+Hash256 kawpow_hash_no_verify(int block_number, const Hash256& header_hash,
+                              const Hash256& mix_hash, uint64_t nonce) {
+  (void)block_number;  // kept for signature parity with the reference
+  uint32_t state[25];
+  seed_absorb(header_hash, nonce, state);
+  return final_absorb(state, mix_hash);
+}
+
+bool kawpow_verify(const EpochContext& ctx, int block_number,
+                   const Hash256& header_hash, const Hash256& mix_hash,
+                   uint64_t nonce, const Hash256& boundary,
+                   Hash256* final_out) {
+  uint32_t state[25];
+  seed_absorb(header_hash, nonce, state);
+  const uint32_t seed[2] = {state[0], state[1]};
+
+  const Hash256 final_hash = final_absorb(state, mix_hash);
+  if (final_out) *final_out = final_hash;
+  if (!bytes_leq(final_hash, boundary)) return false;
+
+  const Hash256 expect_mix = hash_mix(ctx, block_number, seed);
+  return std::memcmp(expect_mix.bytes, mix_hash.bytes, 32) == 0;
+}
+
+}  // namespace nxk

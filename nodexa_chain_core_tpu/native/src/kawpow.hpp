@@ -1,0 +1,84 @@
+// KawPow = ProgPoW 0.9.4 over the ethash DAG with Ravencoin-lineage tweaks
+// (epoch length 7500, period length 3, "RAVENCOINKAWPOW" keccak-f800 absorb
+// padding).  Clean-room implementation; behavioral parity with reference
+// src/crypto/ethash/lib/ethash/{ethash.cpp,progpow.cpp} and
+// src/crypto/ethash/include/ethash/{ethash.h,progpow.hpp}.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <vector>
+
+namespace nxk {
+
+// --- ethash epoch / dataset parameters (ref ethash.h:29, ethash.cpp:21-27) --
+constexpr int kEpochLength = 7500;
+constexpr int kLightCacheInitBytes = 1 << 24;
+constexpr int kLightCacheGrowthBytes = 1 << 17;
+constexpr int kLightCacheRounds = 3;
+constexpr int kFullDatasetInitBytes = 1 << 30;
+constexpr int kFullDatasetGrowthBytes = 1 << 23;
+constexpr int kDatasetParents = 512;
+
+// --- ProgPoW 0.9.4 parameters (ref progpow.hpp:21-27) -----------------------
+constexpr int kPeriodLength = 3;
+constexpr uint32_t kNumRegs = 32;
+constexpr uint32_t kNumLanes = 16;
+constexpr int kNumCacheAccesses = 11;
+constexpr int kNumMathOps = 18;
+constexpr uint32_t kL1CacheBytes = 16 * 1024;
+constexpr uint32_t kL1CacheWords = kL1CacheBytes / 4;
+constexpr int kProgpowRounds = 64;
+
+struct Hash256 {
+  uint8_t bytes[32];
+};
+struct Hash512 {
+  // interpreted as 16 little-endian u32 words where needed
+  uint8_t bytes[64];
+};
+
+int largest_prime_leq(int upper_bound);
+int light_cache_num_items(int epoch);
+int full_dataset_num_items(int epoch);  // counts 128-byte (hash1024) items
+Hash256 epoch_seed(int epoch);
+
+// Per-epoch verification context: light cache + ProgPoW L1 cache.
+struct EpochContext {
+  int epoch = -1;
+  std::vector<Hash512> light_cache;
+  std::vector<uint32_t> l1_cache;  // kL1CacheWords little-endian words
+  int full_items = 0;              // hash1024 items
+};
+
+// Build (or fetch from a small cache) the context.  Eviction drops the
+// lowest-numbered epoch first: the chain moves forward, so old epochs are
+// the ones least likely to be needed again.
+std::shared_ptr<const EpochContext> get_epoch_context(int epoch);
+
+// 256-byte DAG item used by ProgPoW (4 interleaved 512-bit ethash items;
+// ref ethash.cpp calculate_dataset_item_2048).
+void dataset_item_2048(const EpochContext& ctx, uint32_t index,
+                       uint8_t out[256]);
+
+struct KawpowResult {
+  Hash256 final_hash;
+  Hash256 mix_hash;
+};
+
+// Full hash: header_hash is the 32-byte seed (reference feeds the
+// display-order / byte-reversed sha256d of the KawPow header here).
+KawpowResult kawpow_hash(const EpochContext& ctx, int block_number,
+                         const Hash256& header_hash, uint64_t nonce);
+
+// Final hash from a claimed mix without DAG work (ref progpow hash_no_verify).
+Hash256 kawpow_hash_no_verify(int block_number, const Hash256& header_hash,
+                              const Hash256& mix_hash, uint64_t nonce);
+
+// Full verify: boundary check on the final hash, then mix recomputation.
+bool kawpow_verify(const EpochContext& ctx, int block_number,
+                   const Hash256& header_hash, const Hash256& mix_hash,
+                   uint64_t nonce, const Hash256& boundary,
+                   Hash256* final_out);
+
+}  // namespace nxk

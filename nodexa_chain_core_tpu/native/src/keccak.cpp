@@ -1,0 +1,118 @@
+// Keccak-f[1600]/f[800] permutations + original-padding digests.
+//
+// Written from the Keccak specification (theta/rho/pi/chi/iota over a 5x5
+// lane state); not a translation of the reference's unrolled C.  The f[800]
+// variant uses 32-bit lanes, 22 rounds, and the low 32 bits of the standard
+// round constants — behavioral parity with ref
+// src/crypto/ethash/lib/keccak/keccakf800.c.
+
+#include "keccak.hpp"
+
+#include <cstring>
+
+namespace nxk {
+
+namespace {
+
+constexpr uint64_t kRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
+};
+
+// Rotation offsets indexed [x][y] (state lane (x,y) lives at index x + 5*y).
+constexpr unsigned kRot[5][5] = {
+    {0, 36, 3, 41, 18},
+    {1, 44, 10, 45, 2},
+    {62, 6, 43, 15, 61},
+    {28, 55, 25, 21, 56},
+    {27, 20, 39, 8, 14},
+};
+
+template <typename Lane, unsigned LaneBits, int Rounds>
+inline void keccak_f(Lane a[25]) {
+  auto rotl = [](Lane v, unsigned r) -> Lane {
+    r %= LaneBits;
+    if (r == 0) return v;
+    return static_cast<Lane>((v << r) | (v >> (LaneBits - r)));
+  };
+
+  Lane b[25];
+  Lane c[5];
+  Lane d[5];
+
+  for (int rnd = 0; rnd < Rounds; ++rnd) {
+    // theta
+    for (int x = 0; x < 5; ++x)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x) a[x + 5 * y] ^= d[x];
+
+    // rho + pi: lane (x,y) -> position (y, 2x+3y)
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], kRot[x][y]);
+
+    // chi
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        a[x + 5 * y] =
+            b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
+
+    // iota
+    a[0] ^= static_cast<Lane>(kRC[rnd]);
+  }
+}
+
+// Sponge with original keccak 0x01 padding; Rate in bytes, out_len in bytes.
+void sponge1600(const uint8_t* data, size_t len, size_t rate, uint8_t* out,
+                size_t out_len) {
+  uint64_t state[25] = {0};
+
+  while (len >= rate) {
+    for (size_t i = 0; i < rate / 8; ++i) {
+      uint64_t w;
+      std::memcpy(&w, data + 8 * i, 8);
+      state[i] ^= w;  // little-endian host assumed (x86/TPU-VM)
+    }
+    keccakf1600(state);
+    data += rate;
+    len -= rate;
+  }
+
+  uint8_t last[200] = {0};
+  std::memcpy(last, data, len);
+  last[len] = 0x01;
+  last[rate - 1] |= 0x80;
+  for (size_t i = 0; i < rate / 8; ++i) {
+    uint64_t w;
+    std::memcpy(&w, last + 8 * i, 8);
+    state[i] ^= w;
+  }
+  keccakf1600(state);
+
+  std::memcpy(out, state, out_len);
+}
+
+}  // namespace
+
+void keccakf1600(uint64_t state[25]) { keccak_f<uint64_t, 64, 24>(state); }
+
+void keccakf800(uint32_t state[25]) { keccak_f<uint32_t, 32, 22>(state); }
+
+void keccak256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  sponge1600(data, len, 136, out, 32);
+}
+
+void keccak512(const uint8_t* data, size_t len, uint8_t out[64]) {
+  sponge1600(data, len, 72, out, 64);
+}
+
+}  // namespace nxk

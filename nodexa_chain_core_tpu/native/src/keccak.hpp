@@ -1,0 +1,22 @@
+// Keccak permutations and legacy-pad Keccak-256/512 digests.
+//
+// Clean-room implementation for parity with the reference's ethash keccak
+// (ref src/crypto/ethash/lib/keccak/keccakf800.c, keccakf1600.c, keccak.c):
+// keccak-f[1600] with the ORIGINAL 0x01 multi-rate padding (pre-SHA3) for
+// the ethash light cache / DAG, and keccak-f[800] (22 rounds, 32-bit lanes)
+// for the ProgPoW seed/final absorb.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace nxk {
+
+void keccakf1600(uint64_t state[25]);
+void keccakf800(uint32_t state[25]);
+
+// Original-padding (0x01) keccak digests.
+void keccak256(const uint8_t* data, size_t len, uint8_t out[32]);
+void keccak512(const uint8_t* data, size_t len, uint8_t out[64]);
+
+}  // namespace nxk

@@ -293,7 +293,37 @@ def regtest_params() -> NetworkParams:
     )
 
 
-_FACTORIES = {"main": main_params, "test": test_params, "regtest": regtest_params}
+def kawpow_regtest_params() -> NetworkParams:
+    """Regtest variant with KawPow active from the first post-genesis block.
+
+    The reference regtest keeps nKAWPOWActivationTime far-future
+    (chainparams.cpp:569) and exercises KawPow only in unit tests; this
+    framework additionally offers a network where the full KawPow
+    consensus path (120-byte headers, nonce64/mix_hash, epoch DAG
+    verification) runs end to end at trivial difficulty.
+    """
+    p = regtest_params()
+    # Genesis (time == _GENESIS_TIME) stays in the legacy era; every later
+    # block timestamp falls in the KawPow era.
+    p.network = "kawpowregtest"
+    p.consensus.kawpow_activation_time = _GENESIS_TIME + 1
+    p.algo_schedule = AlgoSchedule(
+        mid_activation_time=p.consensus.x16rv2_activation_time,
+        kawpow_activation_time=p.consensus.kawpow_activation_time,
+        legacy_algo="sha256d",
+    )
+    p.message_start = b"ndxk"
+    p.default_port = 19445
+    p._genesis = None
+    return p
+
+
+_FACTORIES = {
+    "main": main_params,
+    "test": test_params,
+    "regtest": regtest_params,
+    "kawpowregtest": kawpow_regtest_params,
+}
 _active: Optional[NetworkParams] = None
 
 

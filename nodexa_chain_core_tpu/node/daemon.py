@@ -18,7 +18,9 @@ from ..utils.args import g_args
 from ..utils.logging import g_logger, log_printf
 from .context import NodeContext
 
-DEFAULT_RPC_PORTS = {"main": 8766, "test": 4566, "regtest": 19443}
+DEFAULT_RPC_PORTS = {
+    "main": 8766, "test": 4566, "regtest": 19443, "kawpowregtest": 19446,
+}
 
 
 def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:

@@ -1,0 +1,21 @@
+"""KawPow-era block identity hashing (ref src/hash.cpp:258-289).
+
+A KawPow block's identity hash is the ProgPoW *final* hash computed from the
+header's claimed ``mix_hash`` — two keccak-f800 absorbs, no DAG work
+(ref KAWPOWHash_OnlyMix / progpow::hash_no_verify).  Full PoW validation
+(boundary + mix recomputation over the epoch DAG) lives in
+chain/validation.py check_block_header, mirroring ref validation.cpp:11638-65.
+"""
+
+from __future__ import annotations
+
+from ..crypto import kawpow
+
+
+def block_hash(header, schedule) -> bytes:
+    """Identity hash for a KawPow-era header -> 32 little-endian bytes."""
+    header_hash = int.from_bytes(header.kawpow_header_hash(schedule), "little")
+    final = kawpow.kawpow_hash_no_verify(
+        header.height, header_hash, header.mix_hash, header.nonce64
+    )
+    return final.to_bytes(32, "little")

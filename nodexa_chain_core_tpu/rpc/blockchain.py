@@ -40,6 +40,14 @@ def _index_to_json(node, idx: BlockIndex, verbose_tx: bool = False) -> dict:
         "mediantime": idx.median_time_past(),
         "nonce": idx.header.nonce,
         "bits": f"{idx.header.bits:08x}",
+        **(
+            {
+                "nonce64": idx.header.nonce64,
+                "mix_hash": u256_hex(idx.header.mix_hash),
+            }
+            if node.params.algo_schedule.is_kawpow(idx.header.time)
+            else {}
+        ),
         "difficulty": _difficulty(idx.header.bits, node.params),
         "chainwork": f"{idx.chain_work:064x}",
         "nTx": idx.tx_count,

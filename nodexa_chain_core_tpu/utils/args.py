@@ -88,6 +88,8 @@ class ArgsManager:
     # -- well-known paths --------------------------------------------------
 
     def network(self) -> str:
+        if self.get_bool("kawpowregtest"):
+            return "kawpowregtest"
         if self.get_bool("regtest"):
             return "regtest"
         if self.get_bool("testnet"):
@@ -99,7 +101,8 @@ class ArgsManager:
         net = self.network()
         if net == "main":
             return base
-        sub = {"test": "testnet", "regtest": "regtest"}[net]
+        sub = {"test": "testnet", "regtest": "regtest",
+               "kawpowregtest": "kawpowregtest"}[net]
         return os.path.join(base, sub)
 
 
