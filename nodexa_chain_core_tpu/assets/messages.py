@@ -1,0 +1,281 @@
+"""On-chain asset messaging store.
+
+Parity: reference ``src/assets/messages.{h,cpp}`` (CMessage, channel
+subscriptions, spam-prevention seen-address index) and
+``src/assets/messagedb.{h,cpp}``.  A *message* is a transfer output of an
+owner token (``NAME!``) or message channel (``NAME~CHAN``) carrying the RIP5
+IPFS-hash field (ref ``assettypes.h:187`` CAssetTransfer message fields;
+creation sites in ``validation.cpp:10517-10533`` ConnectBlock, undo at
+``validation.cpp:9766`` DisconnectBlock OrphanMessage).
+
+Design differences from the reference (deliberate, idiomatic here): the
+dirty-map/DB-flush split collapses into one :class:`MessageStore` persisted
+through the node's append-log KV store; the store subscribes to the
+validation signal bus instead of being called inline from ConnectBlock.
+"""
+
+from __future__ import annotations
+
+import enum
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..node.events import ValidationInterface, main_signals
+from ..script.script import Script
+from .types import AssetType, asset_name_type, parse_asset_script
+
+
+class MessageStatus(enum.IntEnum):
+    """ref messages.h:56-64."""
+
+    READ = 0
+    UNREAD = 1
+    EXPIRED = 2
+    SPAM = 3
+    HIDDEN = 4
+    ORPHAN = 5
+    MSG_ERROR = 6
+
+
+def is_channel_name(name: str) -> bool:
+    """Owner tokens and message channels are the valid message sources
+    (ref messages.cpp AddMessage preconditions)."""
+    try:
+        t = asset_name_type(name)
+    except Exception:
+        return False
+    return t in (AssetType.OWNER, AssetType.MSGCHANNEL)
+
+
+@dataclass
+class Message:
+    """ref messages.h:70 CMessage."""
+
+    txid: int  # outpoint txid (hash256 as int, repo-wide convention)
+    n: int
+    name: str
+    ipfs_hash: bytes
+    time: int
+    expired_time: int = 0
+    block_height: int = 0
+    status: MessageStatus = MessageStatus.UNREAD
+
+    @property
+    def out(self) -> Tuple[int, int]:
+        return (self.txid, self.n)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.hash256(self.txid)
+        w.u32(self.n)
+        w.var_str(self.name)
+        w.var_bytes(self.ipfs_hash)
+        w.i64(self.time)
+        w.i64(self.expired_time)
+        w.i32(self.block_height)
+        w.u8(int(self.status))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "Message":
+        return cls(
+            txid=r.hash256(),
+            n=r.u32(),
+            name=r.var_str(),
+            ipfs_hash=r.var_bytes(),
+            time=r.i64(),
+            expired_time=r.i64(),
+            block_height=r.i32(),
+            status=MessageStatus(r.u8()),
+        )
+
+
+def messages_in_tx(tx, height: int = 0, block_time: int = 0) -> List[Message]:
+    """Extract the messages a transaction's transfer outputs carry
+    (ref validation.cpp ConnectBlock's setMessages accumulation)."""
+    found: List[Message] = []
+    txid = tx.txid
+    for n, out in enumerate(tx.vout):
+        parsed = parse_asset_script(Script(out.script_pubkey))
+        if parsed is None or parsed[0] != "transfer":
+            continue
+        transfer = parsed[1]
+        if not transfer.message or not is_channel_name(transfer.name):
+            continue
+        found.append(
+            Message(
+                txid=txid,
+                n=n,
+                name=transfer.name,
+                ipfs_hash=transfer.message,
+                time=block_time,
+                expired_time=transfer.expire_time,
+                block_height=height,
+            )
+        )
+    return found
+
+
+class MessageStore(ValidationInterface):
+    """Channel subscriptions + received-message index + seen-address spam
+    guard (ref messages.{h,cpp} globals and messagedb.{h,cpp}), fed from the
+    validation signal bus."""
+
+    DB_KEY = b"msgstore"
+
+    def __init__(self, db=None, enabled: bool = True):
+        self._db = db
+        self._dirty = False
+        self.enabled = enabled  # ref -assetmessaging flag (fMessaging)
+        self.subscribed: Set[str] = set()
+        self.messages: Dict[Tuple[int, int], Message] = {}
+        self.seen_addresses: Set[str] = set()
+        if db is not None:
+            raw = db.get(self.DB_KEY)
+            if raw:
+                self._load(ByteReader(raw))
+
+    # --- subscriptions (ref messages.cpp AddChannel/RemoveChannel) ---------
+
+    def subscribe(self, channel: str) -> None:
+        if not is_channel_name(channel):
+            raise ValueError(f"not a message channel or owner token: {channel!r}")
+        self.subscribed.add(channel)
+        self._dirty = True
+
+    def unsubscribe(self, channel: str) -> None:
+        if channel in self.subscribed:
+            self._dirty = True
+        self.subscribed.discard(channel)
+        for key in [k for k, m in self.messages.items() if m.name == channel]:
+            del self.messages[key]
+
+    def is_subscribed(self, channel: str) -> bool:
+        return channel in self.subscribed
+
+    # --- message lifecycle (ref AddMessage/RemoveMessage/OrphanMessage) ----
+
+    def add_message(self, msg: Message) -> None:
+        self.messages[msg.out] = msg
+        self._dirty = True
+
+    def get_message(self, txid: int, n: int) -> Optional[Message]:
+        return self.messages.get((txid, n))
+
+    def remove_message(self, txid: int, n: int) -> None:
+        if self.messages.pop((txid, n), None) is not None:
+            self._dirty = True
+
+    def orphan_message(self, txid: int, n: int) -> None:
+        m = self.messages.get((txid, n))
+        if m is not None:
+            m.status = MessageStatus.ORPHAN
+            self._dirty = True
+
+    def clear(self) -> int:
+        """ref rpc clearmessages."""
+        n = len(self.messages)
+        self.messages.clear()
+        self._dirty = self._dirty or n > 0
+        return n
+
+    def mark_read(self, txid: int, n: int) -> None:
+        m = self.messages.get((txid, n))
+        if m is not None and m.status == MessageStatus.UNREAD:
+            m.status = MessageStatus.READ
+            self._dirty = True
+
+    def all_messages(self) -> List[Message]:
+        now = int(_time.time())
+        out = []
+        for m in self.messages.values():
+            if (
+                m.expired_time
+                and now >= m.expired_time
+                and m.status not in (MessageStatus.ORPHAN, MessageStatus.SPAM)
+            ):
+                m.status = MessageStatus.EXPIRED
+            out.append(m)
+        return sorted(out, key=lambda m: (m.block_height, m.txid, m.n))
+
+    # --- spam-prevention seen-address index (ref messages.h:52-54) ---------
+
+    def is_address_seen(self, address: str) -> bool:
+        return address in self.seen_addresses
+
+    def add_address_seen(self, address: str) -> None:
+        self.seen_addresses.add(address)
+        self._dirty = True
+
+    # --- validation signal handlers ----------------------------------------
+
+    def block_connected(self, block, index, txs_conflicted) -> None:
+        if not self.enabled:
+            return
+        now = int(_time.time())
+        for tx in block.vtx:
+            for msg in messages_in_tx(tx, index.height, block.header.time):
+                if msg.expired_time == 0 or now < msg.expired_time:
+                    main_signals.new_asset_message(msg)
+                if self.is_subscribed(msg.name):
+                    self.add_message(msg)
+        self.flush()
+
+    def block_disconnected(self, block, index=None) -> None:
+        if not self.enabled:
+            return
+        for tx in block.vtx:
+            for msg in messages_in_tx(tx):
+                self.orphan_message(msg.txid, msg.n)
+        self.flush()
+
+    # --- rescan (ref messages.cpp ScanForMessageChannels) ------------------
+
+    def scan_chain(self, chainstate) -> int:
+        """Walk the active chain looking for messages on subscribed
+        channels; returns how many were (re)indexed."""
+        count = 0
+        idx = chainstate.tip()
+        chain = []
+        while idx is not None:
+            chain.append(idx)
+            idx = idx.prev
+        for index in reversed(chain):
+            try:
+                block = chainstate.read_block(index)
+            except Exception:
+                continue  # missing block data (pruned): skip
+            for tx in block.vtx:
+                for msg in messages_in_tx(tx, index.height, block.header.time):
+                    if self.is_subscribed(msg.name) and msg.out not in self.messages:
+                        self.add_message(msg)
+                        count += 1
+        self.flush()
+        return count
+
+    # --- persistence --------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._db is None or not self._dirty:
+            return
+        self._dirty = False
+        w = ByteWriter()
+        w.compact_size(len(self.subscribed))
+        for name in sorted(self.subscribed):
+            w.var_str(name)
+        w.compact_size(len(self.messages))
+        for m in self.all_messages():
+            m.serialize(w)
+        w.compact_size(len(self.seen_addresses))
+        for a in sorted(self.seen_addresses):
+            w.var_str(a)
+        self._db.put(self.DB_KEY, w.getvalue())
+
+    def _load(self, r: ByteReader) -> None:
+        for _ in range(r.compact_size()):
+            self.subscribed.add(r.var_str())
+        for _ in range(r.compact_size()):
+            m = Message.deserialize(r)
+            self.messages[m.out] = m
+        for _ in range(r.compact_size()):
+            self.seen_addresses.add(r.var_str())

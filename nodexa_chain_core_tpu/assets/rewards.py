@@ -1,0 +1,366 @@
+"""Reward snapshots and distribution.
+
+Parity: reference ``src/assets/rewards.{h,cpp}`` (CRewardSnapshot, payout
+calculation at rewards.cpp:140-178), ``src/assets/assetsnapshotdb.{h,cpp}``
+(CAssetSnapshotDBEntry), ``src/assets/snapshotrequestdb.{h,cpp}``
+(ScheduleSnapshot / RetrieveSnapshotRequestsForHeight).
+
+Flow: an asset owner *requests a snapshot* of holder balances at a future
+height; when the chain reaches that height the engine (listening on the
+validation signal bus, the analogue of the reference's ConnectBlock hook)
+captures ``addresses_holding(asset)`` from the assets cache; later the owner
+*distributes* a reward — CLORE or another asset — pro rata over the
+snapshotted balances, batched ``MAX_PAYMENTS_PER_TRANSACTION`` outputs per
+transaction (ref rewards.h:30).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..node.events import ValidationInterface
+from .types import AssetType, asset_name_type
+
+MAX_PAYMENTS_PER_TRANSACTION = 1000  # ref rewards.h:30
+MINIMUM_DISTRIBUTION_HEIGHT_GAP = 1  # snapshot must be strictly in the future
+
+
+class RewardStatus(enum.IntEnum):
+    """ref rewards.h CRewardSnapshot status enum."""
+
+    REWARD_ERROR = 0
+    PROCESSING = 1
+    COMPLETE = 2
+    LOW_FUNDS = 3
+    NOT_ENOUGH_FEE = 4
+    LOW_REWARDS = 5
+    STUCK_TX = 6
+    NETWORK_ERROR = 7
+    FAILED_CREATE_TRANSACTION = 8
+    FAILED_COMMIT_TRANSACTION = 9
+
+
+@dataclass
+class SnapshotRequest:
+    """ref snapshotrequestdb.h:17 CSnapshotRequestDBEntry."""
+
+    asset_name: str
+    height: int
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.asset_name)
+        w.i32(self.height)
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "SnapshotRequest":
+        return cls(asset_name=r.var_str(), height=r.i32())
+
+
+@dataclass
+class AssetSnapshot:
+    """ref assetsnapshotdb.h:13 CAssetSnapshotDBEntry — holder balances of
+    one asset captured at one height."""
+
+    asset_name: str
+    height: int
+    owners_and_amounts: Dict[str, int] = field(default_factory=dict)
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.asset_name)
+        w.i32(self.height)
+        w.compact_size(len(self.owners_and_amounts))
+        for addr in sorted(self.owners_and_amounts):
+            w.var_str(addr)
+            w.i64(self.owners_and_amounts[addr])
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "AssetSnapshot":
+        snap = cls(asset_name=r.var_str(), height=r.i32())
+        for _ in range(r.compact_size()):
+            addr = r.var_str()
+            snap.owners_and_amounts[addr] = r.i64()
+        return snap
+
+
+@dataclass
+class RewardSnapshot:
+    """ref rewards.h:82 CRewardSnapshot — one distribution job."""
+
+    ownership_asset: str
+    distribution_asset: str  # "CLORE" means the native coin
+    exception_addresses: str  # comma-delimited (ref rewards.h:28)
+    distribution_amount: int
+    height: int
+    status: RewardStatus = RewardStatus.PROCESSING
+
+    def serialize(self, w: ByteWriter) -> None:
+        w.var_str(self.ownership_asset)
+        w.var_str(self.distribution_asset)
+        w.var_str(self.exception_addresses)
+        w.i64(self.distribution_amount)
+        w.u32(self.height)
+        w.i32(int(self.status))
+
+    @classmethod
+    def deserialize(cls, r: ByteReader) -> "RewardSnapshot":
+        return cls(
+            ownership_asset=r.var_str(),
+            distribution_asset=r.var_str(),
+            exception_addresses=r.var_str(),
+            distribution_amount=r.i64(),
+            height=r.u32(),
+            status=RewardStatus(r.i32()),
+        )
+
+
+def compute_distribution(
+    snapshot: AssetSnapshot,
+    distribution_units: int,
+    distribution_amount: int,
+    exception_addresses: str = "",
+) -> List[Tuple[str, int]]:
+    """Pro-rata payment list (ref rewards.cpp:115-171).
+
+    ``reward = floor_to_units(distribution_amount * balance / total)`` where
+    ``floor_to_units`` zeroes digits finer than the distribution asset's
+    ``units`` (rewards.cpp:152-158 does the same through long-double percent
+    + pow-of-10 truncation; integer math here is exact and never *exceeds*
+    the reference's figure by more than one quantum).
+    """
+    exceptions = {a.strip() for a in exception_addresses.split(",") if a.strip()}
+    holders = [
+        (addr, amt)
+        for addr, amt in sorted(snapshot.owners_and_amounts.items())
+        if addr not in exceptions and amt > 0
+    ]
+    total = sum(amt for _, amt in holders)
+    if total <= 0:
+        return []
+    quantum = 10 ** (8 - distribution_units)
+    payments: List[Tuple[str, int]] = []
+    for addr, amt in holders:
+        raw = distribution_amount * amt // total
+        reward = (raw // quantum) * quantum
+        if reward > 0:
+            payments.append((addr, reward))
+    return payments
+
+
+def batch_payments(
+    payments: List[Tuple[str, int]], batch_size: int = MAX_PAYMENTS_PER_TRANSACTION
+) -> List[List[Tuple[str, int]]]:
+    """Split into per-transaction batches (ref rewards.cpp distribution loop
+    bounded by MAX_PAYMENTS_PER_TRANSACTION)."""
+    return [payments[i : i + batch_size] for i in range(0, len(payments), batch_size)]
+
+
+class RewardsEngine(ValidationInterface):
+    """Snapshot scheduler + store + distribution driver.
+
+    Persisted via the chainstate KV store under one key (the reference uses
+    three LevelDB wrappers: snapshotrequestdb, assetsnapshotdb,
+    distributesnapshotdb)."""
+
+    DB_KEY = b"rewards"
+
+    def __init__(self, db=None):
+        self._db = db
+        self.requests: Dict[Tuple[str, int], SnapshotRequest] = {}
+        self.snapshots: Dict[Tuple[str, int], AssetSnapshot] = {}
+        self.distributions: Dict[int, RewardSnapshot] = {}  # key: job hash
+        self.pending_txids: Dict[int, List[int]] = {}  # job hash -> txids
+        self._job_seq = 0  # uniquifies job hashes for repeat distributions
+        self._params = None
+        self._assets = None  # AssetsCache, attached by the node
+        if db is not None:
+            raw = db.get(self.DB_KEY)
+            if raw:
+                self._load(ByteReader(raw))
+
+    def attach(self, assets_cache, params) -> None:
+        self._assets = assets_cache
+        self._params = params
+
+    # --- request scheduling (ref CSnapshotRequestDB::ScheduleSnapshot) -----
+
+    def schedule_snapshot(
+        self, asset_name: str, height: int, current_height: int
+    ) -> SnapshotRequest:
+        t = asset_name_type(asset_name)
+        if t not in (
+            AssetType.ROOT,
+            AssetType.SUB,
+            AssetType.UNIQUE,
+            AssetType.RESTRICTED,
+        ):
+            raise ValueError(f"cannot snapshot asset type {t.name} ({asset_name!r})")
+        if height < current_height + MINIMUM_DISTRIBUTION_HEIGHT_GAP:
+            raise ValueError(
+                f"snapshot height {height} must be above current height {current_height}"
+            )
+        req = SnapshotRequest(asset_name, height)
+        self.requests[(asset_name, height)] = req
+        self.flush()
+        return req
+
+    def get_request(self, asset_name: str, height: int) -> Optional[SnapshotRequest]:
+        return self.requests.get((asset_name, height))
+
+    def cancel_request(self, asset_name: str, height: int) -> bool:
+        if (asset_name, height) in self.requests:
+            del self.requests[(asset_name, height)]
+            self.flush()
+            return True
+        return False
+
+    def list_requests(
+        self, asset_name: str = "", height: int = -1
+    ) -> List[SnapshotRequest]:
+        return [
+            r
+            for r in sorted(self.requests.values(), key=lambda r: (r.asset_name, r.height))
+            if (not asset_name or r.asset_name == asset_name)
+            and (height < 0 or r.height == height)
+        ]
+
+    # --- snapshot capture (ref AssetSnapshotDB + ConnectBlock trigger) -----
+
+    def get_snapshot(self, asset_name: str, height: int) -> Optional[AssetSnapshot]:
+        return self.snapshots.get((asset_name, height))
+
+    def block_connected(self, block, index, txs_conflicted) -> None:
+        due = [r for r in self.requests.values() if r.height == index.height]
+        if not due or self._assets is None:
+            return
+        from ..script.standard import KeyID, encode_destination
+
+        for req in due:
+            holders: Dict[str, int] = {}
+            for h160, amt in self._assets.addresses_holding(req.asset_name).items():
+                if amt > 0:
+                    addr = encode_destination(KeyID(h160), self._params)
+                    holders[addr] = holders.get(addr, 0) + amt
+            self.snapshots[(req.asset_name, req.height)] = AssetSnapshot(
+                asset_name=req.asset_name,
+                height=req.height,
+                owners_and_amounts=holders,
+            )
+        self.flush()
+
+    def block_disconnected(self, block, index=None) -> None:
+        # a reorg past a snapshot height invalidates that snapshot: the
+        # balances it captured belong to the abandoned branch.  Drop them;
+        # block_connected re-captures when the new branch reaches the
+        # requested height again.
+        if index is None:
+            return
+        stale = [k for k in self.snapshots if k[1] >= index.height]
+        for k in stale:
+            del self.snapshots[k]
+        if stale:
+            self.flush()
+
+    # --- distribution (ref DistributeRewardSnapshot, rewards.cpp:183+) -----
+
+    def create_distribution(
+        self,
+        ownership_asset: str,
+        snapshot_height: int,
+        distribution_asset: str,
+        amount: int,
+        exception_addresses: str = "",
+    ) -> Tuple[int, RewardSnapshot]:
+        snap = self.get_snapshot(ownership_asset, snapshot_height)
+        if snap is None:
+            raise ValueError(
+                f"no snapshot of {ownership_asset!r} at height {snapshot_height}"
+            )
+        job = RewardSnapshot(
+            ownership_asset=ownership_asset,
+            distribution_asset=distribution_asset,
+            exception_addresses=exception_addresses,
+            distribution_amount=amount,
+            height=snapshot_height,
+        )
+        w = ByteWriter()
+        job.serialize(w)
+        w.u32(self._job_seq)  # two identical reward rounds get distinct jobs
+        self._job_seq += 1
+        from ..crypto.hashes import sha256d
+
+        job_hash = int.from_bytes(sha256d(w.getvalue()), "little")
+        self.distributions[job_hash] = job
+        self.flush()
+        return job_hash, job
+
+    def distribution_units(self, distribution_asset: str) -> int:
+        if distribution_asset.upper() in ("CLORE", ""):
+            return 8  # native coin is fully divisible
+        if self._assets is None:
+            raise ValueError("assets cache not attached")
+        meta = self._assets.get_asset(distribution_asset)
+        if meta is None:
+            raise ValueError(f"unknown distribution asset {distribution_asset!r}")
+        return meta.asset.units
+
+    def payments_for(self, job: RewardSnapshot) -> List[Tuple[str, int]]:
+        snap = self.get_snapshot(job.ownership_asset, job.height)
+        if snap is None:
+            return []
+        # holders of the owner token itself don't include the '!' owner
+        # token; exclude nothing else beyond the exception list
+        return compute_distribution(
+            snap,
+            self.distribution_units(job.distribution_asset),
+            job.distribution_amount,
+            job.exception_addresses,
+        )
+
+    def record_distribution_tx(self, job_hash: int, txid: int) -> None:
+        self.pending_txids.setdefault(job_hash, []).append(txid)
+        self.flush()
+
+    def set_status(self, job_hash: int, status: RewardStatus) -> None:
+        if job_hash in self.distributions:
+            self.distributions[job_hash].status = status
+            self.flush()
+
+    # --- persistence --------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._db is None:
+            return
+        w = ByteWriter()
+        w.compact_size(len(self.requests))
+        for key in sorted(self.requests):
+            self.requests[key].serialize(w)
+        w.compact_size(len(self.snapshots))
+        for key in sorted(self.snapshots):
+            self.snapshots[key].serialize(w)
+        w.compact_size(len(self.distributions))
+        for job_hash in sorted(self.distributions):
+            w.hash256(job_hash)
+            self.distributions[job_hash].serialize(w)
+            txids = self.pending_txids.get(job_hash, [])
+            w.compact_size(len(txids))
+            for t in txids:
+                w.hash256(t)
+        self._db.put(self.DB_KEY, w.getvalue())
+
+    def _load(self, r: ByteReader) -> None:
+        for _ in range(r.compact_size()):
+            req = SnapshotRequest.deserialize(r)
+            self.requests[(req.asset_name, req.height)] = req
+        for _ in range(r.compact_size()):
+            snap = AssetSnapshot.deserialize(r)
+            self.snapshots[(snap.asset_name, snap.height)] = snap
+        for _ in range(r.compact_size()):
+            job_hash = r.hash256()
+            self._job_seq += 1
+            self.distributions[job_hash] = RewardSnapshot.deserialize(r)
+            txids = [r.hash256() for _ in range(r.compact_size())]
+            if txids:
+                self.pending_txids[job_hash] = txids

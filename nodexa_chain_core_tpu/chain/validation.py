@@ -157,6 +157,13 @@ class ChainState:
 
     # -------------------------------------------------------------- helpers
 
+    @property
+    def metadata_db(self):
+        """Shared node metadata KV store (the same store backing the coins
+        view; ref the reference's single LevelDB chainstate dir serving
+        multiple wrappers, txdb.h:73)."""
+        return self._chainstate_db
+
     def tip(self) -> Optional[BlockIndex]:
         return self.active.tip()
 
@@ -450,7 +457,7 @@ class ChainState:
         self.active.set_tip(idx.prev)
         if self.mempool is not None:
             self.mempool.add_disconnected_txs(block.vtx)
-        main_signals.block_disconnected(block)
+        main_signals.block_disconnected(block, idx)
         return block
 
     # --------------------------------------------------- best-chain logic

@@ -27,6 +27,17 @@ class NodeContext:
         self.mempool = TxMemPool()
         self.chainstate.mempool = self.mempool
         self.scheduler = Scheduler()
+        # asset messaging + rewards engines (ref init.cpp Step 7 asset DB
+        # creation and Step 12 message-channel scan)
+        from ..assets.messages import MessageStore
+        from ..assets.rewards import RewardsEngine
+        from ..node.events import main_signals
+
+        self.message_store = MessageStore(db=self.chainstate.metadata_db)
+        self.rewards = RewardsEngine(db=self.chainstate.metadata_db)
+        self.rewards.attach(self.chainstate.assets, self.params)
+        main_signals.register(self.message_store)
+        main_signals.register(self.rewards)
         self.wallet = None  # attached by wallet/init when enabled
         self.connman = None  # attached by net layer when enabled
         self.rest_handler = None
@@ -44,9 +55,18 @@ class NodeContext:
 
     def shutdown(self) -> None:
         """ref init.cpp Shutdown()."""
+        from ..node.events import main_signals
+
         self.scheduler.stop()
+        # stop the network first: blocks connected during teardown must
+        # still reach the stores (they unregister only once no more events
+        # can fire)
         if self.connman is not None:
             self.connman.stop()
+        self.message_store.flush()
+        self.rewards.flush()
+        main_signals.unregister(self.message_store)
+        main_signals.unregister(self.rewards)
         if self.wallet is not None:
             self.wallet.flush()
         self.chainstate.close()

@@ -26,7 +26,7 @@ class ValidationInterface:
     def block_connected(self, block, index, txs_conflicted) -> None:
         pass
 
-    def block_disconnected(self, block) -> None:
+    def block_disconnected(self, block, index=None) -> None:
         pass
 
     def new_pow_valid_block(self, index, block) -> None:

@@ -1,0 +1,134 @@
+"""Messages RPC family (parity: reference src/rpc/messages.cpp, command
+table at :490 — viewallmessages / viewallmessagechannels / subscribetochannel
+/ unsubscribefromchannel / sendmessage / clearmessages)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..assets.messages import MessageStatus, is_channel_name
+from ..core.uint256 import u256_hex
+from .server import (
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPC_WALLET_ERROR,
+    RPCError,
+    RPCTable,
+)
+
+
+def _store(node):
+    store = getattr(node, "message_store", None)
+    if store is None or not store.enabled:
+        raise RPCError(RPC_MISC_ERROR, "messaging is disabled")
+    return store
+
+
+def viewallmessages(node, params: List[Any]):
+    """ref rpc/messages.cpp viewallmessages."""
+    out = []
+    for m in _store(node).all_messages():
+        out.append(
+            {
+                "Asset Name": m.name,
+                "Message": m.ipfs_hash.hex(),
+                "Time": m.time,
+                "Block Height": m.block_height,
+                "Status": MessageStatus(m.status).name,
+                "Expire Time": m.expired_time or None,
+                "txid": u256_hex(m.txid),
+                "vout": m.n,
+            }
+        )
+    return out
+
+
+def viewallmessagechannels(node, params: List[Any]):
+    """ref rpc/messages.cpp viewallmessagechannels."""
+    return sorted(_store(node).subscribed)
+
+
+def subscribetochannel(node, params: List[Any]):
+    """subscribetochannel "channel_name" """
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "channel_name required")
+    name = str(params[0])
+    if not is_channel_name(name):
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            f"{name!r} is not an owner token (NAME!) or message channel (NAME~CHAN)",
+        )
+    store = _store(node)
+    store.subscribe(name)
+    # index any historical messages for the new channel
+    store.scan_chain(node.chainstate)
+    store.flush()
+    return None
+
+
+def unsubscribefromchannel(node, params: List[Any]):
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "channel_name required")
+    store = _store(node)
+    store.unsubscribe(str(params[0]))
+    store.flush()
+    return None
+
+
+def clearmessages(node, params: List[Any]):
+    return f"Erased {_store(node).clear()} Messages from the database and cache"
+
+
+def sendmessage(node, params: List[Any]):
+    """sendmessage "channel" "ipfs_hash" (expire_time) — transfers one unit
+    of the channel/owner token to yourself carrying the message
+    (ref rpc/messages.cpp sendmessage)."""
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "channel and ipfs_hash required")
+    channel, ipfs_hex = str(params[0]), str(params[1])
+    expire = int(params[2]) if len(params) > 2 else 0
+    if not is_channel_name(channel):
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            f"{channel!r} is not an owner token or message channel",
+        )
+    try:
+        message = bytes.fromhex(ipfs_hex)
+    except ValueError:
+        raise RPCError(RPC_INVALID_PARAMETER, "ipfs_hash must be hex")
+    if node.wallet is None:
+        raise RPCError(RPC_WALLET_ERROR, "wallet is disabled")
+    from ..assets.txbuilder import AssetBuildError, build_transfer
+    from ..core.amount import COIN
+    from ..wallet.wallet import WalletError
+
+    from ..script.standard import KeyID, decode_destination
+
+    try:
+        dest = decode_destination(node.wallet.get_new_address(), node.params)
+        assert isinstance(dest, KeyID)
+        dest_h160 = dest.h
+        tx = build_transfer(
+            node.wallet,
+            channel,
+            1 * COIN,
+            dest_h160,
+            message=message,
+            expire=expire,
+        )
+        txid = node.wallet.commit_transaction(tx)
+    except (AssetBuildError, WalletError) as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return [u256_hex(txid)]
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("viewallmessages", viewallmessages, []),
+        ("viewallmessagechannels", viewallmessagechannels, []),
+        ("subscribetochannel", subscribetochannel, ["channel_name"]),
+        ("unsubscribefromchannel", unsubscribefromchannel, ["channel_name"]),
+        ("sendmessage", sendmessage, ["channel", "ipfs_hash", "expire_time"]),
+        ("clearmessages", clearmessages, []),
+    ]:
+        table.register("messages", name, fn, args)

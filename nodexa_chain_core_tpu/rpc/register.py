@@ -27,4 +27,9 @@ def register_all(table: RPCTable = g_rpc_table) -> RPCTable:
         wallet_rpc.register(table)
     except ImportError:
         pass
+    from . import messages as messages_rpc
+    from . import rewards as rewards_rpc
+
+    messages_rpc.register(table)
+    rewards_rpc.register(table)
     return table

@@ -169,7 +169,7 @@ class Wallet(ValidationInterface):
             if changed:
                 self.flush()
 
-    def block_disconnected(self, block) -> None:
+    def block_disconnected(self, block, index=None) -> None:
         with self.lock:
             for tx in block.vtx:
                 if tx.txid in self.wtx:

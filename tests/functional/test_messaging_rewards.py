@@ -1,0 +1,86 @@
+"""Functional: asset messaging + reward snapshots over RPC (parity:
+reference feature_messaging.py / feature_rewards.py)."""
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+
+@pytest.mark.functional
+def test_messaging_and_rewards():
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        addr0 = n0.rpc.getnewaddress()
+        addr1 = n1.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(105, addr0)
+        f.sync_blocks()
+
+        # issue a root asset; its owner token is the broadcast channel
+        n0.rpc.issue("MSGCOIN", 1000, addr0)
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+
+        # --- messaging ------------------------------------------------------
+        n1.rpc.subscribetochannel("MSGCOIN!")
+        assert n1.rpc.viewallmessagechannels() == ["MSGCOIN!"]
+
+        ipfs = "12" + "20" + "ab" * 32  # 34-byte CIDv0-style payload
+        n0.rpc.sendmessage("MSGCOIN!", ipfs)
+        f.sync_mempools()
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+
+        msgs = n1.rpc.viewallmessages()
+        assert len(msgs) == 1
+        assert msgs[0]["Asset Name"] == "MSGCOIN!"
+        assert msgs[0]["Message"] == ipfs
+        assert msgs[0]["Status"] == "UNREAD"
+
+        # unsubscribed node sees nothing
+        assert n0.rpc.viewallmessages() == []
+
+        n1.rpc.unsubscribefromchannel("MSGCOIN!")
+        assert n1.rpc.viewallmessagechannels() == []
+
+        # --- rewards --------------------------------------------------------
+        # spread MSGCOIN across both nodes, snapshot, distribute CLORE
+        n0.rpc.transfer("MSGCOIN", 250, addr1)
+        f.sync_mempools()
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+
+        height = n0.rpc.getblockcount()
+        snap_h = height + 2
+        n0.rpc.requestsnapshot("MSGCOIN", snap_h)
+        got = n0.rpc.getsnapshotrequest("MSGCOIN", snap_h)
+        assert got == {"asset_name": "MSGCOIN", "block_height": snap_h}
+        assert len(n0.rpc.listsnapshotrequests()) == 1
+
+        n0.rpc.generatetoaddress(2, addr0)
+        f.sync_blocks()
+
+        snap = n0.rpc.getsnapshot("MSGCOIN", snap_h)
+        owners = {o["address"]: o["amount_owned"] for o in snap["owners"]}
+        assert sum(owners.values()) == 1000
+        assert owners[addr1] == 250
+
+        res = n0.rpc.distributereward("MSGCOIN", snap_h, "CLORE", 100)
+        assert res["batch_results"]
+        status = n0.rpc.getdistributestatus("MSGCOIN", snap_h, "CLORE", 100)
+        assert status and status[0]["Status"] == "COMPLETE"
+
+        # payout lands for node1 once mined: 250/1000 of 100 = 25
+        f.sync_mempools()
+        n0.rpc.generatetoaddress(1, addr0)
+        f.sync_blocks()
+        bal1 = n1.rpc.getbalance()
+        assert bal1 >= 25
+
+        # cancel path
+        n0.rpc.requestsnapshot("MSGCOIN", snap_h + 50)
+        assert n0.rpc.cancelsnapshotrequest("MSGCOIN", snap_h + 50) == {
+            "request_status": "Removed"
+        }
+        with pytest.raises(RPCFailure):
+            n0.rpc.getsnapshotrequest("MSGCOIN", snap_h + 50)

@@ -1,0 +1,237 @@
+"""Asset messaging + reward snapshot tests (analogues of the reference's
+messaging coverage in src/test/assets/ and the rewards flow driven by
+rpc/rewards.cpp; behavior per src/assets/messages.{h,cpp} and
+src/assets/rewards.{h,cpp})."""
+
+import pytest
+
+from nodexa_chain_core_tpu.assets.messages import (
+    Message,
+    MessageStatus,
+    MessageStore,
+    is_channel_name,
+    messages_in_tx,
+)
+from nodexa_chain_core_tpu.assets.rewards import (
+    AssetSnapshot,
+    RewardsEngine,
+    RewardStatus,
+    batch_payments,
+    compute_distribution,
+)
+from nodexa_chain_core_tpu.assets.types import AssetTransfer, append_asset_payload
+from nodexa_chain_core_tpu.chain.kvstore import KVStore
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+IPFS = bytes.fromhex("12") + bytes.fromhex("20") + bytes(range(32))  # 34 bytes
+
+
+def transfer_tx(name: str, message: bytes = b"", expire: int = 0) -> Transaction:
+    spk = append_asset_payload(
+        p2pkh_script(KeyID(b"\x22" * 20)),
+        "transfer",
+        AssetTransfer(name, 1 * COIN, message, expire),
+    )
+    return Transaction(
+        vin=[TxIn(prevout=OutPoint(txid=1, n=0))],
+        vout=[TxOut(0, spk.raw)],
+    )
+
+
+# --- channel-name rules -----------------------------------------------------
+
+
+def test_is_channel_name():
+    assert is_channel_name("TOKEN!")
+    assert is_channel_name("TOKEN~NEWS")
+    assert not is_channel_name("TOKEN")
+    assert not is_channel_name("#KYC")
+    assert not is_channel_name("")
+
+
+# --- message extraction -----------------------------------------------------
+
+
+def test_messages_in_tx_owner_and_channel():
+    tx = transfer_tx("TOKEN~NEWS", IPFS, expire=0)
+    msgs = messages_in_tx(tx, height=7, block_time=1234)
+    assert len(msgs) == 1
+    m = msgs[0]
+    assert m.name == "TOKEN~NEWS"
+    assert m.ipfs_hash == IPFS
+    assert m.block_height == 7 and m.time == 1234
+    # plain transfers and transfers without a message carry nothing
+    assert messages_in_tx(transfer_tx("TOKEN", IPFS)) == []
+    assert messages_in_tx(transfer_tx("TOKEN~NEWS")) == []
+
+
+def test_message_serialization_roundtrip():
+    m = Message(
+        txid=0xDEADBEEF, n=3, name="A.B!", ipfs_hash=IPFS, time=99,
+        expired_time=1000, block_height=42, status=MessageStatus.READ,
+    )
+    w = ByteWriter()
+    m.serialize(w)
+    m2 = Message.deserialize(ByteReader(w.getvalue()))
+    assert m2 == m
+
+
+# --- store lifecycle --------------------------------------------------------
+
+
+class _FakeIndex:
+    def __init__(self, height):
+        self.height = height
+
+
+class _FakeBlock:
+    def __init__(self, txs, time=1000):
+        self.vtx = txs
+
+        class H:
+            pass
+
+        self.header = H()
+        self.header.time = time
+
+
+def test_store_subscribe_receive_orphan_persist(tmp_path):
+    db = KVStore(str(tmp_path / "msgdb"))
+    store = MessageStore(db=db)
+    store.subscribe("TOKEN~NEWS")
+    with pytest.raises(ValueError):
+        store.subscribe("TOKEN")  # not a channel
+
+    tx = transfer_tx("TOKEN~NEWS", IPFS)
+    store.block_connected(_FakeBlock([tx]), _FakeIndex(5), [])
+    assert len(store.messages) == 1
+    m = store.get_message(tx.txid, 0)
+    assert m is not None and m.status == MessageStatus.UNREAD
+
+    # unsubscribed channel messages are not stored
+    tx2 = transfer_tx("OTHER~CHAN", IPFS)
+    store.block_connected(_FakeBlock([tx2]), _FakeIndex(6), [])
+    assert store.get_message(tx2.txid, 0) is None
+
+    # disconnect orphans the message
+    store.block_disconnected(_FakeBlock([tx]))
+    assert store.get_message(tx.txid, 0).status == MessageStatus.ORPHAN
+
+    # persistence across restart
+    store.flush()
+    store2 = MessageStore(db=db)
+    assert store2.is_subscribed("TOKEN~NEWS")
+    assert store2.get_message(tx.txid, 0).status == MessageStatus.ORPHAN
+    db.close()
+
+
+def test_store_expiry_and_clear():
+    store = MessageStore()
+    store.subscribe("TOKEN!")
+    tx = transfer_tx("TOKEN!", IPFS, expire=1)  # expired long ago
+    store.block_connected(_FakeBlock([tx]), _FakeIndex(1), [])
+    msgs = store.all_messages()
+    assert msgs[0].status == MessageStatus.EXPIRED
+    assert store.clear() == 1
+    assert store.all_messages() == []
+
+
+def test_seen_address_spam_guard():
+    store = MessageStore()
+    assert not store.is_address_seen("NADDR")
+    store.add_address_seen("NADDR")
+    assert store.is_address_seen("NADDR")
+
+
+# --- reward math ------------------------------------------------------------
+
+
+def test_compute_distribution_prorata_floor():
+    snap = AssetSnapshot(
+        "TOKEN", 10, {"a": 60 * COIN, "b": 30 * COIN, "c": 10 * COIN}
+    )
+    pay = dict(compute_distribution(snap, 8, 100 * COIN))
+    assert pay == {"a": 60 * COIN, "b": 30 * COIN, "c": 10 * COIN}
+    # indivisible distribution asset (units 0): sub-coin remainders floor away
+    pay0 = dict(compute_distribution(snap, 0, 100 * COIN))
+    assert pay0["a"] == 60 * COIN and pay0["b"] == 30 * COIN
+    # exceptions are excluded and the rest re-normalized
+    pay_ex = dict(compute_distribution(snap, 8, 90 * COIN, "c"))
+    assert pay_ex == {"a": 60 * COIN, "b": 30 * COIN}
+    # zero total -> nothing
+    assert compute_distribution(AssetSnapshot("T", 1, {}), 8, COIN) == []
+
+
+def test_batch_payments_split():
+    payments = [(f"addr{i}", COIN) for i in range(2500)]
+    batches = batch_payments(payments)
+    assert [len(b) for b in batches] == [1000, 1000, 500]
+
+
+# --- engine: schedule -> capture -> distribute ------------------------------
+
+
+class _FakeAssets:
+    def __init__(self, holders):
+        self._holders = holders
+
+    def addresses_holding(self, name):
+        return self._holders
+
+    def get_asset(self, name):
+        return None
+
+
+def test_engine_schedule_and_capture(tmp_path):
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+
+    db = KVStore(str(tmp_path / "rewdb"))
+    eng = RewardsEngine(db=db)
+    holders = {b"\x01" * 20: 70 * COIN, b"\x02" * 20: 30 * COIN}
+    params = regtest_params()
+    eng.attach(_FakeAssets(holders), params)
+
+    with pytest.raises(ValueError):
+        eng.schedule_snapshot("TOKEN", 5, current_height=5)  # not in future
+    with pytest.raises(ValueError):
+        eng.schedule_snapshot("TOKEN!", 9, current_height=5)  # owner token
+
+    eng.schedule_snapshot("TOKEN", 8, current_height=5)
+    assert eng.get_request("TOKEN", 8) is not None
+    assert len(eng.list_requests("TOKEN")) == 1
+
+    # block 8 connects -> snapshot captured
+    eng.block_connected(_FakeBlock([]), _FakeIndex(8), [])
+    snap = eng.get_snapshot("TOKEN", 8)
+    assert snap is not None
+    assert sorted(snap.owners_and_amounts.values()) == [30 * COIN, 70 * COIN]
+
+    # distribution job over the snapshot
+    job_hash, job = eng.create_distribution("TOKEN", 8, "CLORE", 10 * COIN)
+    payments = eng.payments_for(job)
+    assert sum(a for _, a in payments) == 10 * COIN
+    eng.record_distribution_tx(job_hash, 0x1234)
+    eng.set_status(job_hash, RewardStatus.COMPLETE)
+
+    # persistence across restart
+    eng2 = RewardsEngine(db=db)
+    assert eng2.get_snapshot("TOKEN", 8).owners_and_amounts == snap.owners_and_amounts
+    assert eng2.distributions[job_hash].status == RewardStatus.COMPLETE
+    assert eng2.pending_txids[job_hash] == [0x1234]
+    db.close()
+
+
+def test_engine_cancel():
+    eng = RewardsEngine()
+    eng.schedule_snapshot("TOKEN", 8, current_height=5)
+    assert eng.cancel_request("TOKEN", 8)
+    assert not eng.cancel_request("TOKEN", 8)
+    assert eng.list_requests() == []
