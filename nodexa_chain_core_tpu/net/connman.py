@@ -51,6 +51,11 @@ class Peer:
         self.blocks_in_flight: set = set()
         self.sync_started = False
         self.prefer_headers = False
+        # BIP152 state (ref CNodeState fProvidesHeaderAndIDs /
+        # fPreferHeaderAndIDs + PartiallyDownloadedBlock slot)
+        self.prefer_cmpct = False
+        self.cmpct_version = 0
+        self.partial_block = None
         self._send_lock = threading.Lock()
 
     def send_msg(self, magic: bytes, command: str, payload: bytes = b"") -> bool:

@@ -16,8 +16,16 @@ from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
 from ..utils.logging import LogFlags, log_print, log_printf
 from . import protocol
+from .blockencodings import (
+    BlockTransactions,
+    BlockTransactionsRequest,
+    CompactBlockError,
+    HeaderAndShortIDs,
+    PartiallyDownloadedBlock,
+)
 from .protocol import (
     INV_BLOCK,
+    INV_CMPCT_BLOCK,
     INV_TX,
     Inv,
     MSG_ADDR,
@@ -38,6 +46,10 @@ from .protocol import (
     MSG_PONG,
     MSG_REJECT,
     MSG_SENDHEADERS,
+    MSG_SENDCMPCT,
+    MSG_CMPCTBLOCK,
+    MSG_GETBLOCKTXN,
+    MSG_BLOCKTXN,
     MSG_TX,
     MSG_VERACK,
     MSG_VERSION,
@@ -118,6 +130,10 @@ class NetProcessor:
             MSG_GETADDR: self._on_getaddr,
             MSG_ADDR: self._on_addr,
             MSG_SENDHEADERS: self._on_sendheaders,
+            MSG_SENDCMPCT: self._on_sendcmpct,
+            MSG_CMPCTBLOCK: self._on_cmpctblock,
+            MSG_GETBLOCKTXN: self._on_getblocktxn,
+            MSG_BLOCKTXN: self._on_blocktxn,
             MSG_FEEFILTER: self._on_feefilter,
             MSG_GETASSETDATA: self._on_getassetdata,
         }.get(command)
@@ -150,6 +166,10 @@ class NetProcessor:
         peer.handshake_done = True
         self.connman.addrman.good(peer.ip, peer.port)
         peer.send_msg(self.magic, MSG_SENDHEADERS)
+        w = ByteWriter()
+        w.u8(1)  # announce via cmpctblock (high-bandwidth mode)
+        w.u64(1)  # compact block version 1
+        peer.send_msg(self.magic, MSG_SENDCMPCT, w.getvalue())
         self._start_sync(peer)
 
     def _start_sync(self, peer) -> None:
@@ -225,13 +245,20 @@ class NetProcessor:
                     peer.send_msg(self.magic, MSG_TX, tx.to_bytes())
                 else:
                     notfound.append(inv)
-            elif inv.type in (INV_BLOCK,):
+            elif inv.type in (INV_BLOCK, INV_CMPCT_BLOCK):
                 idx = self.node.chainstate.lookup(inv.hash)
                 if idx is not None and idx.status & 8:  # HAVE_DATA
                     block = self.node.chainstate.read_block(idx)
                     w = ByteWriter()
-                    block.serialize(w, self.node.params.algo_schedule)
-                    peer.send_msg(self.magic, MSG_BLOCK, w.getvalue())
+                    if inv.type == INV_CMPCT_BLOCK:
+                        cmpct = HeaderAndShortIDs.from_block(
+                            block, self.node.params.algo_schedule
+                        )
+                        cmpct.serialize(w, self.node.params.algo_schedule)
+                        peer.send_msg(self.magic, MSG_CMPCTBLOCK, w.getvalue())
+                    else:
+                        block.serialize(w, self.node.params.algo_schedule)
+                        peer.send_msg(self.magic, MSG_BLOCK, w.getvalue())
                 else:
                     notfound.append(inv)
         if notfound:
@@ -333,6 +360,9 @@ class NetProcessor:
 
     def _on_block(self, peer, r: ByteReader) -> None:
         block = Block.deserialize(r, self.node.params.algo_schedule)
+        self._accept_block_from_peer(peer, block, punish=True)
+
+    def _accept_block_from_peer(self, peer, block, punish: bool) -> bool:
         h = block.get_hash()
         peer.blocks_in_flight.discard(h)
         peer.known_blocks.add(h)
@@ -343,13 +373,15 @@ class NetProcessor:
         except BlockValidationError as e:
             if e.code in ("prev-blk-not-found",):
                 self._send_getheaders(peer)
-                return
-            self.misbehaving(peer, 100, f"bad-block:{e.code}")
-            return
+                return False
+            if punish:
+                self.misbehaving(peer, 100, f"bad-block:{e.code}")
+            return False
         if cs.tip().block_hash != old_tip:
             self.announce_block(cs.tip().block_hash)
         # keep the download window full toward the peer's best header
         self._request_missing_blocks(peer)
+        return True
 
     def _on_tx(self, peer, r: ByteReader) -> None:
         tx = Transaction.deserialize(r)
@@ -392,6 +424,130 @@ class NetProcessor:
 
     def _on_sendheaders(self, peer, r: ByteReader) -> None:
         peer.prefer_headers = True
+
+    # -- compact blocks (BIP152; ref net_processing.cpp CMPCTBLOCK paths) --
+
+    def _on_sendcmpct(self, peer, r: ByteReader) -> None:
+        announce = r.u8() != 0
+        version = r.u64() if r.remaining() >= 8 else 1
+        if version == 1:
+            peer.prefer_cmpct = announce
+            peer.cmpct_version = version
+
+    def _on_cmpctblock(self, peer, r: ByteReader) -> None:
+        schedule = self.node.params.algo_schedule
+        try:
+            cmpct = HeaderAndShortIDs.deserialize(r, schedule)
+        except CompactBlockError as e:
+            self.misbehaving(peer, 100, f"bad-cmpctblock:{e}")
+            return
+        cs = self.node.chainstate
+        h = cmpct.header.get_hash(schedule)
+        peer.known_blocks.add(h)
+        idx = cs.lookup(h)
+        if idx is not None and idx.status & 8:  # already have it
+            return
+        if cs.lookup(cmpct.header.hash_prev) is None:
+            # can't connect: fall back to headers sync (ref cmpctblock
+            # handling when prev is unknown)
+            self._send_getheaders(peer)
+            return
+        # validate the header (PoW, contextual) BEFORE any reconstruction
+        # work, and punish bad headers, as the reference does through
+        # ProcessNewBlockHeaders in its cmpctblock path
+        try:
+            cs.process_new_block_headers([cmpct.header])
+        except BlockValidationError as e:
+            self.misbehaving(peer, 100, f"bad-cmpctblock-header:{e.code}")
+            return
+        # a newer compact announcement supersedes any stalled one: release
+        # the stale in-flight slot so the download window can't be wedged
+        if peer.partial_block is not None:
+            peer.blocks_in_flight.discard(peer.partial_block.block_hash)
+            peer.partial_block = None
+        partial = PartiallyDownloadedBlock(schedule)
+        try:
+            missing = partial.init_data(cmpct, self.node.mempool)
+        except CompactBlockError:
+            # short-id collision: request the full block
+            self._getdata_block(peer, h)
+            return
+        if not missing:
+            block = partial.fill_block([])
+            log_print(LogFlags.NET, "cmpctblock %s reconstructed from mempool",
+                      u256_hex(h)[:16])
+            self._finish_compact(peer, block, h)
+            return
+        log_print(LogFlags.NET, "cmpctblock %s missing %d txs, getblocktxn",
+                  u256_hex(h)[:16], len(missing))
+        peer.partial_block = partial
+        req = BlockTransactionsRequest(block_hash=h, indexes=missing)
+        w = ByteWriter()
+        req.serialize(w)
+        peer.blocks_in_flight.add(h)
+        peer.send_msg(self.magic, MSG_GETBLOCKTXN, w.getvalue())
+
+    def _on_getblocktxn(self, peer, r: ByteReader) -> None:
+        try:
+            req = BlockTransactionsRequest.deserialize(r)
+        except CompactBlockError as e:
+            self.misbehaving(peer, 100, f"bad-getblocktxn:{e}")
+            return
+        cs = self.node.chainstate
+        idx = cs.lookup(req.block_hash)
+        if idx is None or not (idx.status & 8):
+            return
+        block = cs.read_block(idx)
+        try:
+            txs = [block.vtx[i] for i in req.indexes]
+        except IndexError:
+            self.misbehaving(peer, 100, "getblocktxn-index-oob")
+            return
+        resp = BlockTransactions(block_hash=req.block_hash, txs=txs)
+        w = ByteWriter()
+        resp.serialize(w)
+        peer.send_msg(self.magic, MSG_BLOCKTXN, w.getvalue())
+
+    def _on_blocktxn(self, peer, r: ByteReader) -> None:
+        resp = BlockTransactions.deserialize(r)
+        peer.blocks_in_flight.discard(resp.block_hash)
+        partial = peer.partial_block
+        if partial is None or partial.block_hash != resp.block_hash:
+            return
+        peer.partial_block = None
+        try:
+            block = partial.fill_block(resp.txs)
+        except CompactBlockError:
+            self._getdata_block(peer, resp.block_hash)
+            return
+        self._finish_compact(peer, block, resp.block_hash)
+
+    def _finish_compact(self, peer, block, block_hash: int) -> None:
+        # only a merkle mismatch (mempool reconstruction hit a short-id
+        # collision) is excusable — re-request the full block; any other
+        # invalidity is the block itself and punishes like MSG_BLOCK
+        # (ref READ_STATUS_CHECKBLOCK_FAILED vs invalid-block paths)
+        cs = self.node.chainstate
+        old_tip = cs.tip().block_hash
+        peer.blocks_in_flight.discard(block_hash)
+        peer.known_blocks.add(block_hash)
+        try:
+            cs.process_new_block(block)
+        except BlockValidationError as e:
+            if e.code in ("bad-txnmrklroot", "bad-txns-duplicate"):
+                self._getdata_block(peer, block_hash)
+            else:
+                self.misbehaving(peer, 100, f"bad-block:{e.code}")
+            return
+        if cs.tip().block_hash != old_tip:
+            self.announce_block(cs.tip().block_hash)
+        self._request_missing_blocks(peer)
+
+    def _getdata_block(self, peer, block_hash: int) -> None:
+        w = ByteWriter()
+        w.vector([Inv(INV_BLOCK, block_hash)], lambda wr, i: i.serialize(wr))
+        peer.blocks_in_flight.add(block_hash)
+        peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
 
     def _on_feefilter(self, peer, r: ByteReader) -> None:
         peer.fee_filter = r.i64() if r.remaining() else 0
@@ -438,11 +594,26 @@ class NetProcessor:
         """New-tip announcement: headers to sendheaders peers, inv otherwise."""
         cs = self.node.chainstate
         idx = cs.lookup(block_hash)
+        # one shared compact encoding serves every high-bandwidth peer
+        # (ref most_recent_compact_block caching in net_processing.cpp)
+        cmpct_payload = None
+        if idx is not None and idx.status & 8:
+            block = cs.read_block(idx)
+            cmpct = HeaderAndShortIDs.from_block(
+                block, self.node.params.algo_schedule
+            )
+            w = ByteWriter()
+            cmpct.serialize(w, self.node.params.algo_schedule)
+            cmpct_payload = w.getvalue()
         for peer in self.connman.all_peers():
             if not peer.handshake_done or block_hash in peer.known_blocks:
                 continue
             peer.known_blocks.add(block_hash)
-            if peer.prefer_headers and idx is not None:
+            if peer.prefer_cmpct and cmpct_payload is not None:
+                # high-bandwidth mode: push the compact block directly
+                # (ref net_processing.cpp SendMessages cmpctblock announce)
+                peer.send_msg(self.magic, MSG_CMPCTBLOCK, cmpct_payload)
+            elif peer.prefer_headers and idx is not None:
                 w = ByteWriter()
                 w.compact_size(1)
                 idx.header.serialize(w, self.node.params.algo_schedule)

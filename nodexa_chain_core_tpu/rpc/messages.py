@@ -106,7 +106,8 @@ def sendmessage(node, params: List[Any]):
 
     try:
         dest = decode_destination(node.wallet.get_new_address(), node.params)
-        assert isinstance(dest, KeyID)
+        if not isinstance(dest, KeyID):
+            raise RPCError(RPC_WALLET_ERROR, "wallet produced a non-P2PKH address")
         dest_h160 = dest.h
         tx = build_transfer(
             node.wallet,

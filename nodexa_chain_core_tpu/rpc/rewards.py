@@ -120,6 +120,7 @@ def distributereward(node, params: List[Any]):
     # leaves an accurate partial-payment record (ref the reference's
     # per-batch AddDistributeTransaction bookkeeping)
     txids = []
+    skipped = []
     try:
         if dist_asset.upper() in ("CLORE", ""):
             # one multi-output transaction per batch of up to
@@ -137,6 +138,9 @@ def distributereward(node, params: List[Any]):
             for addr, amt in payments:
                 dest = decode_destination(addr, node.params)
                 if not isinstance(dest, KeyID):
+                    # asset transfers need a P2PKH destination; report the
+                    # shortfall instead of silently under-paying
+                    skipped.append(addr)
                     continue
                 tx = build_transfer(node.wallet, dist_asset, amt, dest.h)
                 txid = node.wallet.commit_transaction(tx)
@@ -145,10 +149,17 @@ def distributereward(node, params: List[Any]):
     except (WalletError, AssetBuildError, ValueError) as e:
         eng.set_status(job_hash, RewardStatus.FAILED_CREATE_TRANSACTION)
         raise RPCError(RPC_WALLET_ERROR, str(e))
-    eng.set_status(job_hash, RewardStatus.COMPLETE)
+    eng.set_status(
+        job_hash,
+        RewardStatus.COMPLETE if not skipped else RewardStatus.REWARD_ERROR,
+    )
     return {
-        "error_txn_gen_failed": "",
+        "error_txn_gen_failed": (
+            "" if not skipped
+            else f"{len(skipped)} payees skipped (non-P2PKH address)"
+        ),
         "error_rewards_cancelled": "",
+        "skipped_addresses": skipped,
         "batch_results": [u256_hex(t) for t in txids],
     }
 

@@ -1,0 +1,150 @@
+"""Compact block (BIP152) tests — analogue of the reference's
+blockencodings coverage in src/test/ and p2p_compactblocks.py behavior
+(ref src/blockencodings.{h,cpp})."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.mempool import MempoolEntry, TxMemPool
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.net.blockencodings import (
+    BlockTransactions,
+    BlockTransactionsRequest,
+    CompactBlockError,
+    HeaderAndShortIDs,
+    PartiallyDownloadedBlock,
+    get_short_id,
+)
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.block import Block, BlockHeader
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+
+
+def make_tx(seed: int) -> Transaction:
+    return Transaction(
+        vin=[TxIn(prevout=OutPoint(txid=seed, n=0))],
+        vout=[TxOut(value=seed * 100, script_pubkey=bytes([0x51]))],
+    )
+
+
+@pytest.fixture()
+def setup():
+    params = regtest_params()
+    txs = [make_tx(i + 1) for i in range(5)]
+    coinbase = Transaction(
+        vin=[TxIn(prevout=OutPoint(txid=0, n=0xFFFFFFFF))],
+        vout=[TxOut(value=5000, script_pubkey=b"\x51")],
+    )
+    block = Block(
+        header=BlockHeader(version=4, hash_prev=1, time=1000, bits=0x207FFFFF),
+        vtx=[coinbase] + txs,
+    )
+    return params, block, txs
+
+
+def test_roundtrip_serialization(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=42)
+    w = ByteWriter()
+    cmpct.serialize(w, sched)
+    c2 = HeaderAndShortIDs.deserialize(ByteReader(w.getvalue()), sched)
+    assert c2.nonce == 42
+    assert c2.short_ids == cmpct.short_ids
+    assert len(c2.prefilled) == 1 and c2.prefilled[0].index == 0
+    assert c2.prefilled[0].tx.txid == block.vtx[0].txid
+    assert c2.total_tx_count() == 6
+
+
+def test_short_ids_are_48bit_and_key_dependent(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    a = HeaderAndShortIDs.from_block(block, sched, nonce=1)
+    b = HeaderAndShortIDs.from_block(block, sched, nonce=2)
+    assert all(s < (1 << 48) for s in a.short_ids)
+    assert a.short_ids != b.short_ids  # nonce changes the siphash key
+
+
+def test_reconstruct_from_full_mempool(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    pool = TxMemPool()
+    for tx in txs:
+        pool.add(MempoolEntry(tx=tx, fee=100, time=0, height=1))
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    partial = PartiallyDownloadedBlock(sched)
+    missing = partial.init_data(cmpct, pool)
+    assert missing == []
+    rebuilt = partial.fill_block([])
+    assert rebuilt.get_hash() == block.get_hash()
+    assert [t.txid for t in rebuilt.vtx] == [t.txid for t in block.vtx]
+
+
+def test_reconstruct_with_missing_txs(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    pool = TxMemPool()
+    for tx in txs[:2]:  # only the first two known
+        pool.add(MempoolEntry(tx=tx, fee=100, time=0, height=1))
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    partial = PartiallyDownloadedBlock(sched)
+    missing = partial.init_data(cmpct, pool)
+    assert missing == [3, 4, 5]  # indexes of txs[2:] (0 = prefilled coinbase)
+    # getblocktxn/blocktxn round-trip
+    req = BlockTransactionsRequest(block_hash=partial.block_hash, indexes=missing)
+    w = ByteWriter()
+    req.serialize(w)
+    req2 = BlockTransactionsRequest.deserialize(ByteReader(w.getvalue()))
+    assert req2.indexes == missing
+    resp = BlockTransactions(
+        block_hash=partial.block_hash, txs=[block.vtx[i] for i in req2.indexes]
+    )
+    w2 = ByteWriter()
+    resp.serialize(w2)
+    resp2 = BlockTransactions.deserialize(ByteReader(w2.getvalue()))
+    rebuilt = partial.fill_block(resp2.txs)
+    assert rebuilt.get_hash() == block.get_hash()
+
+
+def test_fill_block_wrong_counts(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    pool = TxMemPool()
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    partial = PartiallyDownloadedBlock(sched)
+    missing = partial.init_data(cmpct, pool)
+    assert len(missing) == 5
+    with pytest.raises(CompactBlockError):
+        partial.fill_block([txs[0]])  # too few
+    partial2 = PartiallyDownloadedBlock(sched)
+    partial2.init_data(cmpct, pool)
+    with pytest.raises(CompactBlockError):
+        partial2.fill_block(txs + [make_tx(99)])  # too many
+
+
+def test_duplicate_short_id_rejected(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    cmpct.short_ids[1] = cmpct.short_ids[0]  # forced collision
+    partial = PartiallyDownloadedBlock(sched)
+    with pytest.raises(CompactBlockError):
+        partial.init_data(cmpct, TxMemPool())
+
+
+def test_differential_index_encoding():
+    req = BlockTransactionsRequest(block_hash=5, indexes=[1, 2, 10, 100])
+    w = ByteWriter()
+    req.serialize(w)
+    req2 = BlockTransactionsRequest.deserialize(ByteReader(w.getvalue()))
+    assert req2.indexes == [1, 2, 10, 100]
+    assert req2.block_hash == 5
+
+
+def test_get_short_id_deterministic():
+    assert get_short_id(1, 2, 0xABCDEF) == get_short_id(1, 2, 0xABCDEF)
+    assert get_short_id(1, 2, 0xABCDEF) != get_short_id(1, 3, 0xABCDEF)
