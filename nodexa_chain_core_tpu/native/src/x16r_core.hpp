@@ -1,0 +1,78 @@
+// X16R hash family: shared declarations.
+//
+// Clean-room implementations of the sixteen 512-bit hash primitives the
+// X16R / X16RV2 chained PoW uses (ref /root/reference/src/hash.h:335,465 and
+// the published SHA-3-candidate specifications).  Each function hashes
+// (in, len) and writes its full digest into out64 (zero-padded to 64 bytes
+// where the natural digest is shorter, e.g. tiger's 24 bytes — matching the
+// reference's zero-initialized uint512 intermediate buffers).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace nxx {
+
+void blake512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void bmw512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void groestl512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void jh512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void keccak512x(const uint8_t* in, size_t len, uint8_t out64[64]);
+void skein512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void luffa512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void cubehash512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void shavite512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void simd512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void echo512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void hamsi512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void fugue512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void shabal512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void whirlpool512(const uint8_t* in, size_t len, uint8_t out64[64]);
+void sha512x(const uint8_t* in, size_t len, uint8_t out64[64]);
+void tiger192(const uint8_t* in, size_t len, uint8_t out64[64]);  // 24B + zeros
+
+// helpers shared across the family
+static inline uint64_t rotl64(uint64_t x, unsigned n) {
+  return n ? (x << n) | (x >> (64 - n)) : x;
+}
+static inline uint64_t rotr64(uint64_t x, unsigned n) {
+  return n ? (x >> n) | (x << (64 - n)) : x;
+}
+static inline uint32_t rotl32(uint32_t x, unsigned n) {
+  return n ? (x << n) | (x >> (32 - n)) : x;
+}
+static inline uint32_t rotr32(uint32_t x, unsigned n) {
+  return n ? (x >> n) | (x << (32 - n)) : x;
+}
+static inline uint64_t load64le(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | p[i];
+  return v;
+}
+static inline uint64_t load64be(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return v;
+}
+static inline uint32_t load32le(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+static inline uint32_t load32be(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+static inline void store64le(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; ++i) p[i] = (uint8_t)(v >> (8 * i));
+}
+static inline void store64be(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; ++i) p[i] = (uint8_t)(v >> (56 - 8 * i));
+}
+static inline void store32le(uint8_t* p, uint32_t v) {
+  for (int i = 0; i < 4; ++i) p[i] = (uint8_t)(v >> (8 * i));
+}
+static inline void store32be(uint8_t* p, uint32_t v) {
+  for (int i = 0; i < 4; ++i) p[i] = (uint8_t)(v >> (24 - 8 * i));
+}
+
+}  // namespace nxx

@@ -1,0 +1,543 @@
+// X16R family, group 1: SHA-512, BLAKE-512, BMW-512, CubeHash-512,
+// Skein-512, Shabal-512.  Clean-room from the published specifications
+// (SHA-3 candidate submissions / FIPS 180-4); behavioral parity target is
+// the reference's sph_* usage in /root/reference/src/hash.h:335.
+
+#include <cstring>
+
+#include "x16r_core.hpp"
+
+namespace nxx {
+
+// ---------------------------------------------------------------- SHA-512
+
+namespace {
+const uint64_t kSha512K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+const uint64_t kSha512IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+void sha512_compress(uint64_t h[8], const uint8_t block[128]) {
+  uint64_t w[80];
+  for (int i = 0; i < 16; ++i) w[i] = load64be(block + 8 * i);
+  for (int i = 16; i < 80; ++i) {
+    uint64_t s0 = rotr64(w[i - 15], 1) ^ rotr64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+    uint64_t s1 = rotr64(w[i - 2], 19) ^ rotr64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint64_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint64_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 80; ++i) {
+    uint64_t S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+    uint64_t ch = (e & f) ^ (~e & g);
+    uint64_t t1 = hh + S1 + ch + kSha512K[i] + w[i];
+    uint64_t S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+    uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint64_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+}  // namespace
+
+void sha512x(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  uint64_t h[8];
+  std::memcpy(h, kSha512IV, sizeof h);
+  size_t full = len / 128;
+  for (size_t i = 0; i < full; ++i) sha512_compress(h, in + 128 * i);
+  uint8_t tail[256] = {0};
+  size_t rem = len % 128;
+  std::memcpy(tail, in + 128 * full, rem);
+  tail[rem] = 0x80;
+  size_t tlen = (rem < 112) ? 128 : 256;
+  // 128-bit bit-length, big-endian (high half always 0 here)
+  store64be(tail + tlen - 8, (uint64_t)len << 3);
+  for (size_t off = 0; off < tlen; off += 128) sha512_compress(h, tail + off);
+  for (int i = 0; i < 8; ++i) store64be(out64 + 8 * i, h[i]);
+}
+
+// --------------------------------------------------------------- BLAKE-512
+
+namespace {
+const uint64_t kBlakeC[16] = {
+    0x243f6a8885a308d3ULL, 0x13198a2e03707344ULL, 0xa4093822299f31d0ULL,
+    0x082efa98ec4e6c89ULL, 0x452821e638d01377ULL, 0xbe5466cf34e90c6cULL,
+    0xc0ac29b7c97c50ddULL, 0x3f84d5b5b5470917ULL, 0x9216d5d98979fb1bULL,
+    0xd1310ba698dfb5acULL, 0x2ffd72dbd01adfb7ULL, 0xb8e1afed6a267e96ULL,
+    0xba7c9045f12c7f99ULL, 0x24a19947b3916cf7ULL, 0x0801f2e2858efc16ULL,
+    0x636920d871574e69ULL};
+
+const uint8_t kBlakeSigma[10][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0}};
+
+struct BlakeState {
+  uint64_t h[8];
+  uint64_t t;  // bit counter (messages here are far below 2^64 bits)
+};
+
+void blake512_compress(BlakeState& s, const uint8_t block[128],
+                       uint64_t counter_bits) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; ++i) m[i] = load64be(block + 8 * i);
+  for (int i = 0; i < 8; ++i) v[i] = s.h[i];
+  for (int i = 0; i < 8; ++i) v[8 + i] = kBlakeC[i];
+  v[12] ^= counter_bits;
+  v[13] ^= counter_bits;
+  // v[14]/v[15] xor the high counter half, zero for our input sizes
+  for (int r = 0; r < 16; ++r) {
+    const uint8_t* sig = kBlakeSigma[r % 10];
+    auto G = [&](int a, int b, int c, int d, int i) {
+      v[a] = v[a] + v[b] + (m[sig[2 * i]] ^ kBlakeC[sig[2 * i + 1]]);
+      v[d] = rotr64(v[d] ^ v[a], 32);
+      v[c] = v[c] + v[d];
+      v[b] = rotr64(v[b] ^ v[c], 25);
+      v[a] = v[a] + v[b] + (m[sig[2 * i + 1]] ^ kBlakeC[sig[2 * i]]);
+      v[d] = rotr64(v[d] ^ v[a], 16);
+      v[c] = v[c] + v[d];
+      v[b] = rotr64(v[b] ^ v[c], 11);
+    };
+    G(0, 4, 8, 12, 0);
+    G(1, 5, 9, 13, 1);
+    G(2, 6, 10, 14, 2);
+    G(3, 7, 11, 15, 3);
+    G(0, 5, 10, 15, 4);
+    G(1, 6, 11, 12, 5);
+    G(2, 7, 8, 13, 6);
+    G(3, 4, 9, 14, 7);
+  }
+  for (int i = 0; i < 8; ++i) s.h[i] ^= v[i] ^ v[i + 8];
+}
+}  // namespace
+
+void blake512(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  BlakeState s;
+  std::memcpy(s.h, kSha512IV, sizeof s.h);  // BLAKE-512 IV == SHA-512 IV
+  size_t full = len / 128;
+  uint64_t bits = 0;
+  // process all-but-last-full-block plainly; the final (possibly empty)
+  // block goes through padding
+  for (size_t i = 0; i < full; ++i) {
+    bits += 1024;
+    blake512_compress(s, in + 128 * i, bits);
+  }
+  size_t rem = len % 128;
+  uint8_t tail[256] = {0};
+  std::memcpy(tail, in + 128 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t total_bits = (uint64_t)len << 3;
+  if (rem <= 111) {
+    // single padding block; the 0x01 marker bit sits adjacent to the
+    // length (merging with 0x80 into 0x81 when rem == 111)
+    tail[111] |= 0x01;
+    store64be(tail + 120, total_bits);
+    blake512_compress(s, tail, rem ? total_bits : 0);
+  } else {
+    // padding spills into a second block
+    store64be(tail + 248, total_bits);
+    tail[239] |= 0x01;
+    blake512_compress(s, tail, total_bits);
+    blake512_compress(s, tail + 128, 0);
+  }
+  for (int i = 0; i < 8; ++i) store64be(out64 + 8 * i, s.h[i]);
+}
+
+// ----------------------------------------------------------------- BMW-512
+
+namespace {
+inline uint64_t bmw_s(int which, uint64_t x) {
+  switch (which) {
+    case 0: return (x >> 1) ^ (x << 3) ^ rotl64(x, 4) ^ rotl64(x, 37);
+    case 1: return (x >> 1) ^ (x << 2) ^ rotl64(x, 13) ^ rotl64(x, 43);
+    case 2: return (x >> 2) ^ (x << 1) ^ rotl64(x, 19) ^ rotl64(x, 53);
+    case 3: return (x >> 2) ^ (x << 2) ^ rotl64(x, 28) ^ rotl64(x, 59);
+    case 4: return (x >> 1) ^ x;
+    default: return (x >> 2) ^ x;
+  }
+}
+inline uint64_t bmw_r(int which, uint64_t x) {
+  static const unsigned rot[7] = {5, 11, 27, 32, 37, 43, 53};
+  return rotl64(x, rot[which - 1]);
+}
+
+// W[i] as signed 5-term combinations of (M^H); sign/index table per the
+// BMW specification (f0 function)
+const int8_t kBmwW[16][5][2] = {
+    {{5, 1}, {7, -1}, {10, 1}, {13, 1}, {14, 1}},
+    {{6, 1}, {8, -1}, {11, 1}, {14, 1}, {15, -1}},
+    {{0, 1}, {7, 1}, {9, 1}, {12, -1}, {15, 1}},
+    {{0, 1}, {1, -1}, {8, 1}, {10, -1}, {13, 1}},
+    {{1, 1}, {2, 1}, {9, 1}, {11, -1}, {14, -1}},
+    {{3, 1}, {2, -1}, {10, 1}, {12, -1}, {15, 1}},
+    {{4, 1}, {0, -1}, {3, -1}, {11, -1}, {13, 1}},
+    {{1, 1}, {4, -1}, {5, -1}, {12, -1}, {14, -1}},
+    {{2, 1}, {5, -1}, {6, -1}, {13, 1}, {15, -1}},
+    {{0, 1}, {3, -1}, {6, 1}, {7, -1}, {14, 1}},
+    {{8, 1}, {1, -1}, {4, -1}, {7, -1}, {15, 1}},
+    {{8, 1}, {0, -1}, {2, -1}, {5, -1}, {9, 1}},
+    {{1, 1}, {3, 1}, {6, -1}, {9, -1}, {10, 1}},
+    {{2, 1}, {4, 1}, {7, 1}, {10, 1}, {11, 1}},
+    {{3, 1}, {5, -1}, {8, 1}, {11, -1}, {12, -1}},
+    {{12, 1}, {4, -1}, {6, -1}, {9, -1}, {13, 1}}};
+
+// Each row value is sum(sign * (M^H)[index]) over its five pairs.
+
+uint64_t bmw_add_elt(const uint64_t m[16], const uint64_t h[16], int j) {
+  auto rol_idx = [&](int k) {
+    int idx = k & 15;
+    return rotl64(m[idx], (unsigned)(idx + 1));
+  };
+  uint64_t kj = (uint64_t)j * 0x0555555555555555ULL;
+  return (rol_idx(j) + rol_idx(j + 3) - rol_idx(j + 10) + kj) ^ h[(j + 7) & 15];
+}
+
+void bmw512_compress(uint64_t h[16], const uint64_t m[16]) {
+  uint64_t q[32];
+  // f0
+  for (int i = 0; i < 16; ++i) {
+    uint64_t w = 0;
+    for (int t = 0; t < 5; ++t) {
+      uint64_t term = m[kBmwW[i][t][0]] ^ h[kBmwW[i][t][0]];
+      w += (kBmwW[i][t][1] > 0) ? term : (uint64_t)(0 - term);
+    }
+    q[i] = bmw_s(i % 5, w) + h[(i + 1) & 15];
+  }
+  // f1: two expand1 rounds then fourteen expand2 rounds
+  for (int i = 16; i < 18; ++i) {
+    uint64_t acc = 0;
+    static const int ss[16] = {1, 2, 3, 0, 1, 2, 3, 0, 1, 2, 3, 0, 1, 2, 3, 0};
+    for (int t = 0; t < 16; ++t) acc += bmw_s(ss[t], q[i - 16 + t]);
+    q[i] = acc + bmw_add_elt(m, h, i);
+  }
+  for (int i = 18; i < 32; ++i) {
+    uint64_t acc = q[i - 16] + bmw_r(1, q[i - 15]) + q[i - 14] +
+                   bmw_r(2, q[i - 13]) + q[i - 12] + bmw_r(3, q[i - 11]) +
+                   q[i - 10] + bmw_r(4, q[i - 9]) + q[i - 8] +
+                   bmw_r(5, q[i - 7]) + q[i - 6] + bmw_r(6, q[i - 5]) +
+                   q[i - 4] + bmw_r(7, q[i - 3]) + bmw_s(4, q[i - 2]) +
+                   bmw_s(5, q[i - 1]);
+    q[i] = acc + bmw_add_elt(m, h, i);
+  }
+  uint64_t xl = q[16] ^ q[17] ^ q[18] ^ q[19] ^ q[20] ^ q[21] ^ q[22] ^ q[23];
+  uint64_t xh = xl ^ q[24] ^ q[25] ^ q[26] ^ q[27] ^ q[28] ^ q[29] ^ q[30] ^ q[31];
+  uint64_t nh[16];
+  nh[0] = ((xh << 5) ^ (q[16] >> 5) ^ m[0]) + (xl ^ q[24] ^ q[0]);
+  nh[1] = ((xh >> 7) ^ (q[17] << 8) ^ m[1]) + (xl ^ q[25] ^ q[1]);
+  nh[2] = ((xh >> 5) ^ (q[18] << 5) ^ m[2]) + (xl ^ q[26] ^ q[2]);
+  nh[3] = ((xh >> 1) ^ (q[19] << 5) ^ m[3]) + (xl ^ q[27] ^ q[3]);
+  nh[4] = ((xh >> 3) ^ q[20] ^ m[4]) + (xl ^ q[28] ^ q[4]);
+  nh[5] = ((xh << 6) ^ (q[21] >> 6) ^ m[5]) + (xl ^ q[29] ^ q[5]);
+  nh[6] = ((xh >> 4) ^ (q[22] << 6) ^ m[6]) + (xl ^ q[30] ^ q[6]);
+  nh[7] = ((xh >> 11) ^ (q[23] << 2) ^ m[7]) + (xl ^ q[31] ^ q[7]);
+  nh[8] = rotl64(nh[4], 9) + (xh ^ q[24] ^ m[8]) + ((xl << 8) ^ q[23] ^ q[8]);
+  nh[9] = rotl64(nh[5], 10) + (xh ^ q[25] ^ m[9]) + ((xl >> 6) ^ q[16] ^ q[9]);
+  nh[10] = rotl64(nh[6], 11) + (xh ^ q[26] ^ m[10]) + ((xl << 6) ^ q[17] ^ q[10]);
+  nh[11] = rotl64(nh[7], 12) + (xh ^ q[27] ^ m[11]) + ((xl << 4) ^ q[18] ^ q[11]);
+  nh[12] = rotl64(nh[0], 13) + (xh ^ q[28] ^ m[12]) + ((xl >> 3) ^ q[19] ^ q[12]);
+  nh[13] = rotl64(nh[1], 14) + (xh ^ q[29] ^ m[13]) + ((xl >> 4) ^ q[20] ^ q[13]);
+  nh[14] = rotl64(nh[2], 15) + (xh ^ q[30] ^ m[14]) + ((xl >> 7) ^ q[21] ^ q[14]);
+  nh[15] = rotl64(nh[3], 16) + (xh ^ q[31] ^ m[15]) + ((xl >> 2) ^ q[22] ^ q[15]);
+  std::memcpy(h, nh, sizeof nh);
+}
+}  // namespace
+
+void bmw512(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  uint64_t h[16];
+  for (int i = 0; i < 16; ++i)
+    h[i] = 0x8081828384858687ULL + (uint64_t)i * 0x0808080808080808ULL;
+  uint64_t m[16];
+  size_t full = len / 128;
+  for (size_t b = 0; b < full; ++b) {
+    for (int i = 0; i < 16; ++i) m[i] = load64le(in + 128 * b + 8 * i);
+    bmw512_compress(h, m);
+  }
+  size_t rem = len % 128;
+  uint8_t tail[256] = {0};
+  std::memcpy(tail, in + 128 * full, rem);
+  tail[rem] = 0x80;
+  size_t tlen = (rem < 120) ? 128 : 256;
+  store64le(tail + tlen - 8, (uint64_t)len << 3);
+  for (size_t off = 0; off < tlen; off += 128) {
+    for (int i = 0; i < 16; ++i) m[i] = load64le(tail + off + 8 * i);
+    bmw512_compress(h, m);
+  }
+  // final transform with the constant chaining value (BMW spec f3)
+  uint64_t cst[16];
+  for (int i = 0; i < 16; ++i) cst[i] = 0xaaaaaaaaaaaaaaa0ULL + (uint64_t)i;
+  uint64_t msg[16];
+  std::memcpy(msg, h, sizeof msg);
+  std::memcpy(h, cst, sizeof cst);
+  bmw512_compress(h, msg);
+  for (int i = 0; i < 8; ++i) store64le(out64 + 8 * i, h[8 + i]);
+}
+
+// ------------------------------------------------------------ CubeHash-512
+// CubeHash-16/32-512: IV derived per spec (x[0]=h/8, x[1]=b, x[2]=r, then
+// 10r blank rounds), 16 rounds per 32-byte block, 10r final rounds after
+// xor-1 into the last state word.
+
+namespace {
+void cubehash_rounds(uint32_t x[32], int n) {
+  for (int r = 0; r < n; ++r) {
+    uint32_t y[16];
+    for (int i = 0; i < 16; ++i) x[i + 16] += x[i];
+    for (int i = 0; i < 16; ++i) y[i] = x[i];
+    for (int i = 0; i < 16; ++i) x[i] = rotl32(y[i ^ 8], 7);
+    for (int i = 0; i < 16; ++i) x[i] ^= x[i + 16];
+    for (int i = 0; i < 16; ++i) y[i] = x[16 + (i ^ 2)];
+    for (int i = 0; i < 16; ++i) x[i + 16] = y[i];
+    for (int i = 0; i < 16; ++i) x[i + 16] += x[i];
+    for (int i = 0; i < 16; ++i) y[i] = x[i];
+    for (int i = 0; i < 16; ++i) x[i] = rotl32(y[i ^ 4], 11);
+    for (int i = 0; i < 16; ++i) x[i] ^= x[i + 16];
+    for (int i = 0; i < 16; ++i) y[i] = x[16 + (i ^ 1)];
+    for (int i = 0; i < 16; ++i) x[i + 16] = y[i];
+  }
+}
+}  // namespace
+
+void cubehash512(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  static uint32_t iv[32];
+  static bool iv_ready = false;
+  if (!iv_ready) {
+    uint32_t x[32] = {0};
+    x[0] = 64;  // h/8
+    x[1] = 32;  // b
+    x[2] = 16;  // r
+    cubehash_rounds(x, 160);
+    std::memcpy(iv, x, sizeof iv);
+    iv_ready = true;
+  }
+  uint32_t x[32];
+  std::memcpy(x, iv, sizeof x);
+  while (len >= 32) {
+    for (int i = 0; i < 8; ++i) x[i] ^= load32le(in + 4 * i);
+    cubehash_rounds(x, 16);
+    in += 32;
+    len -= 32;
+  }
+  uint8_t last[32] = {0};
+  std::memcpy(last, in, len);
+  last[len] = 0x80;
+  for (int i = 0; i < 8; ++i) x[i] ^= load32le(last + 4 * i);
+  cubehash_rounds(x, 16);
+  x[31] ^= 1;
+  cubehash_rounds(x, 160);
+  for (int i = 0; i < 16; ++i) store32le(out64 + 4 * i, x[i]);
+}
+
+// --------------------------------------------------------------- Skein-512
+// Threefish-512 in UBI chaining mode; rotation table and permutation per
+// the Skein 1.3 specification.
+
+namespace {
+const uint64_t kSkeinIV[8] = {
+    0x4903ADFF749C51CEULL, 0x0D95DE399746DF03ULL, 0x8FD1934127C79BCEULL,
+    0x9A255629FF352CB1ULL, 0x5DB62599DF6CA7B0ULL, 0xEABE394CA9D5C3F4ULL,
+    0x991112C71A75B523ULL, 0xAE18A40B660FCC33ULL};
+
+const unsigned kSkeinRot[8][4] = {{46, 36, 19, 37}, {33, 27, 14, 42},
+                                  {17, 49, 36, 39}, {44, 9, 54, 56},
+                                  {39, 30, 34, 24}, {13, 50, 10, 17},
+                                  {25, 29, 39, 43}, {8, 35, 56, 22}};
+const int kSkeinPerm[8] = {2, 1, 4, 7, 6, 5, 0, 3};
+
+void threefish_ubi(uint64_t h[8], const uint8_t block[64], uint64_t t0,
+                   uint64_t t1) {
+  uint64_t k[9], t[3], m[8], p[8];
+  for (int i = 0; i < 8; ++i) m[i] = load64le(block + 8 * i);
+  k[8] = 0x1BD11BDAA9FC1A22ULL;
+  for (int i = 0; i < 8; ++i) {
+    k[i] = h[i];
+    k[8] ^= h[i];
+  }
+  t[0] = t0;
+  t[1] = t1;
+  t[2] = t0 ^ t1;
+  for (int i = 0; i < 8; ++i) p[i] = m[i];
+  for (int s = 0; s < 18; ++s) {
+    // subkey injection
+    for (int i = 0; i < 8; ++i) p[i] += k[(s + i) % 9];
+    p[5] += t[s % 3];
+    p[6] += t[(s + 1) % 3];
+    p[7] += (uint64_t)s;
+    // four rounds
+    for (int r = 0; r < 4; ++r) {
+      const unsigned* rc = kSkeinRot[(s * 4 + r) % 8];
+      for (int j = 0; j < 4; ++j) {
+        uint64_t& a = p[2 * j];
+        uint64_t& b = p[2 * j + 1];
+        a += b;
+        b = rotl64(b, rc[j]) ^ a;
+      }
+      uint64_t q[8];
+      for (int j = 0; j < 8; ++j) q[j] = p[kSkeinPerm[j]];
+      std::memcpy(p, q, sizeof q);
+    }
+  }
+  for (int i = 0; i < 8; ++i) p[i] += k[(18 + i) % 9];
+  p[5] += t[18 % 3];
+  p[6] += t[(18 + 1) % 3];
+  p[7] += 18;
+  for (int i = 0; i < 8; ++i) h[i] = m[i] ^ p[i];
+}
+
+constexpr uint64_t kT1Final = 1ULL << 63;
+constexpr uint64_t kT1First = 1ULL << 62;
+constexpr uint64_t kTypeMsg = 48ULL << 56;
+constexpr uint64_t kTypeOut = 63ULL << 56;
+}  // namespace
+
+void skein512(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  uint64_t h[8];
+  std::memcpy(h, kSkeinIV, sizeof h);
+  // message UBI: final (possibly empty/partial) block is zero-padded;
+  // t0 counts real message bytes consumed through each block
+  size_t nblocks = (len + 63) / 64;
+  if (nblocks == 0) nblocks = 1;
+  for (size_t b = 0; b < nblocks; ++b) {
+    uint8_t block[64] = {0};
+    size_t off = 64 * b;
+    size_t take = (off < len) ? ((len - off < 64) ? len - off : 64) : 0;
+    std::memcpy(block, in + off, take);
+    uint64_t t0 = (uint64_t)(off + take);
+    uint64_t t1 = kTypeMsg;
+    if (b == 0) t1 |= kT1First;
+    if (b == nblocks - 1) t1 |= kT1Final;
+    threefish_ubi(h, block, t0, t1);
+  }
+  // output transform
+  uint8_t zero[64] = {0};
+  threefish_ubi(h, zero, 8, kTypeOut | kT1First | kT1Final);
+  for (int i = 0; i < 8; ++i) store64le(out64 + 8 * i, h[i]);
+}
+
+// -------------------------------------------------------------- Shabal-512
+
+namespace {
+const uint32_t kShabalA[12] = {0x20728DFD, 0x46C0BD53, 0xE782B699, 0x55304632,
+                               0x71B4EF90, 0x0EA9E82C, 0xDBB930F1, 0xFAD06B8B,
+                               0xBE0CAE40, 0x8BD14410, 0x76D2ADAC, 0x28ACAB7F};
+const uint32_t kShabalB[16] = {0xC1099CB7, 0x07B385F3, 0xE7442C26, 0xCC8AD640,
+                               0xEB6F56C7, 0x1EA81AA9, 0x73B9D314, 0x1DE85D08,
+                               0x48910A5A, 0x893B22DB, 0xC5A0DF44, 0xBBC4324E,
+                               0x72D2F240, 0x75941D99, 0x6D8BDE82, 0xA1A7502B};
+const uint32_t kShabalC[16] = {0xD9BF68D1, 0x58BAD750, 0x56028CB2, 0x8134F359,
+                               0xB5D469D8, 0x941A8CC2, 0x418B2A6E, 0x04052780,
+                               0x7F07D787, 0x5194358F, 0x3C60D665, 0xBE97D79A,
+                               0x950C3434, 0xAED9A06D, 0x2537DC8D, 0x7CDB5969};
+
+struct ShabalState {
+  uint32_t A[12], B[16], C[16];
+  uint64_t W;
+};
+
+void shabal_perm(ShabalState& s, const uint32_t m[16]) {
+  uint32_t* A = s.A;
+  uint32_t* B = s.B;
+  uint32_t* C = s.C;
+  for (int i = 0; i < 16; ++i) B[i] = rotl32(B[i], 17);
+  for (int j = 0; j < 48; ++j) {
+    int i = j % 16;
+    uint32_t& a = A[j % 12];
+    const uint32_t ap = A[(j + 11) % 12];
+    a = ((a ^ (rotl32(ap, 15) * 5u) ^ C[(8 - i) & 15]) * 3u) ^ B[(i + 13) % 16] ^
+        (B[(i + 9) % 16] & ~B[(i + 6) % 16]) ^ m[i];
+    B[i] = ~(rotl32(B[i], 1) ^ a);
+  }
+  for (int j = 0; j < 36; ++j)
+    A[11 - (j % 12)] += C[(6 - j) & 15];
+}
+
+void shabal_block(ShabalState& s, const uint8_t* block) {
+  uint32_t m[16];
+  for (int i = 0; i < 16; ++i) m[i] = load32le(block + 4 * i);
+  for (int i = 0; i < 16; ++i) s.B[i] += m[i];
+  s.A[0] ^= (uint32_t)s.W;
+  s.A[1] ^= (uint32_t)(s.W >> 32);
+  shabal_perm(s, m);
+  for (int i = 0; i < 16; ++i) s.C[i] -= m[i];
+  for (int i = 0; i < 16; ++i) {
+    uint32_t t = s.B[i];
+    s.B[i] = s.C[i];
+    s.C[i] = t;
+  }
+  s.W++;
+}
+}  // namespace
+
+void shabal512(const uint8_t* in, size_t len, uint8_t out64[64]) {
+  ShabalState s;
+  std::memcpy(s.A, kShabalA, sizeof s.A);
+  std::memcpy(s.B, kShabalB, sizeof s.B);
+  std::memcpy(s.C, kShabalC, sizeof s.C);
+  s.W = 1;
+  while (len >= 64) {
+    shabal_block(s, in);
+    in += 64;
+    len -= 64;
+  }
+  uint8_t last[64] = {0};
+  std::memcpy(last, in, len);
+  last[len] = 0x80;
+  // final block: one real pass then three extra permutations with the
+  // same counter (ref shabal spec finalization)
+  uint32_t m[16];
+  for (int i = 0; i < 16; ++i) m[i] = load32le(last + 4 * i);
+  for (int i = 0; i < 16; ++i) s.B[i] += m[i];
+  s.A[0] ^= (uint32_t)s.W;
+  s.A[1] ^= (uint32_t)(s.W >> 32);
+  shabal_perm(s, m);
+  for (int k = 0; k < 3; ++k) {
+    for (int i = 0; i < 16; ++i) {
+      uint32_t t = s.B[i];
+      s.B[i] = s.C[i];
+      s.C[i] = t;
+    }
+    s.A[0] ^= (uint32_t)s.W;
+    s.A[1] ^= (uint32_t)(s.W >> 32);
+    shabal_perm(s, m);
+  }
+  for (int i = 0; i < 16; ++i) store32le(out64 + 4 * i, s.B[i]);
+}
+
+}  // namespace nxx
