@@ -34,21 +34,29 @@ def main() -> None:
 
     print(f"backend: {jax.default_backend()}, devices: {jax.devices()}", file=sys.stderr)
 
-    batch = 1 << 20
+    on_tpu = jax.default_backend() == "tpu"
+    batch = (1 << 23) if on_tpu else (1 << 18)
     prefix = bytes(i % 251 for i in range(76))
     words = [int.from_bytes(prefix[4 * i : 4 * i + 4], "big") for i in range(19)]
     mid = s256.midstate(jnp.array(words[:16], dtype=jnp.uint32))
     tail3 = jnp.array(words[16:19], dtype=jnp.uint32)
     target_le = s256.target_to_le_words(1 << 220)
 
-    @jax.jit
-    def scan(nonce0):
-        nonces = nonce0.astype(jnp.uint32) + jnp.arange(batch, dtype=jnp.uint32)
-        block2 = s256.search_tail_block(tail3, nonces)
-        st = s256.compress(jnp.broadcast_to(mid, (batch, 8)), block2)
-        digest = s256.sha256_words(s256._digest_block(st)[..., None, :])
-        ok = s256.le256_leq(s256.digest_le_words(digest), target_le)
-        return jnp.any(ok), jnp.sum(ok)
+    if on_tpu:
+        # Pallas search kernel: rounds unrolled in VMEM, scalar writeback.
+        from nodexa_chain_core_tpu.ops import sha256_pallas as sp
+
+        def scan(nonce0):
+            return sp.pow_search_tiles(
+                mid, tail3, nonce0, target_le, batch=batch, sublanes=256
+            )
+
+    else:
+        scan = jax.jit(
+            lambda nonce0: s256.pow_search_step(
+                mid, tail3, nonce0, target_le, batch
+            )
+        )
 
     # compile + warm up
     jax.block_until_ready(scan(jnp.uint32(0)))

@@ -43,19 +43,67 @@ IV = jnp.array(
 )
 
 
+_K_INTS = [int(k) for k in _K]
+
+
+IV_INTS = [int(v) for v in IV]
+
+
 def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x >> n) | (x << (32 - n))
 
 
-def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
-    """One SHA-256 compression. state: (..., 8); block: (..., 16) BE words.
+def _want_unroll() -> bool:
+    # TPU: a fully unrolled 64-round body is ~1k wide vector ops — trivial to
+    # compile and ~10x faster than a serialized fori_loop with dynamic
+    # gathers.  Host CPU (the virtual multi-chip mesh used by tests and the
+    # driver dryrun): XLA's SPMD-partitioned CPU pipeline explodes to tens of
+    # minutes on the unrolled graph, so keep the rolled loop there.
+    # Keyed on the process default backend; when placing compress-based work
+    # on CPU devices inside a TPU-default process, set NXK_SHA256_UNROLL=0.
+    import os
 
-    Rounds run under ``lax.fori_loop`` (compiler-friendly control flow):
-    the graph stays tiny — a fully unrolled 64-round body makes XLA's
-    SPMD-partitioned CPU compile explode to tens of minutes — while the
-    leading batch dimension keeps each iteration a wide vector op, so loop
-    overhead is amortized at mining batch sizes.
+    env = os.environ.get("NXK_SHA256_UNROLL")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    return jax.default_backend() != "cpu"
+
+
+def compress_rounds(state, w16):
+    """64 statically-unrolled SHA-256 rounds with a rolling schedule window.
+
+    state: tuple of 8 values; w16: sequence of the 16 message words (arrays
+    or scalars — broadcasting handles both).  Returns the post-round state
+    tuple WITHOUT the feed-forward add; callers add the input state.  Shared
+    by the unrolled jnp path and the Pallas search kernel so there is a
+    single copy of the round function.
     """
+    w = list(w16)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        if i >= 16:
+            w15 = w[(i - 15) % 16]
+            w2 = w[(i - 2) % 16]
+            s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+            s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+            w[i % 16] = w[i % 16] + s0 + w[(i - 7) % 16] + s1
+        wi = w[i % 16]
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + jnp.uint32(_K_INTS[i]) + wi
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        a, b, c, d, e, f, g, h = t1 + S0 + maj, a, b, c, d + t1, e, f, g
+    return a, b, c, d, e, f, g, h
+
+
+def _compress_unrolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    st = tuple(state[..., i] for i in range(8))
+    out = compress_rounds(st, [block[..., i] for i in range(16)])
+    return state + jnp.stack(out, axis=-1)
+
+
+def _compress_rolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     lead = block.shape[:-1]
 
     # message schedule: w[16..63] built in place
@@ -92,6 +140,19 @@ def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     a, b, c, d, e, f, g, h = jax.lax.fori_loop(0, 64, round_fn, init)
     out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
     return state + out
+
+
+def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression. state: (..., 8); block: (..., 16) BE words.
+
+    Dispatches per backend at trace time: statically unrolled rounds on
+    accelerators (the VPU wants one flat stream of vector ops), rolled
+    ``lax.fori_loop`` on host CPU where the unrolled SPMD graph compiles
+    pathologically slowly (see _want_unroll).
+    """
+    if _want_unroll():
+        return _compress_unrolled(state, block)
+    return _compress_rolled(state, block)
 
 
 def sha256_words(blocks: jnp.ndarray) -> jnp.ndarray:
@@ -154,16 +215,24 @@ def digest_le_words(digest_be_words: jnp.ndarray) -> jnp.ndarray:
     return bswap32(digest_be_words)
 
 
-def le256_leq(hash_le: jnp.ndarray, target_le: jnp.ndarray) -> jnp.ndarray:
-    """hash <= target over (..., 8) LE limbs (limb 7 most significant)."""
-    less = jnp.zeros(hash_le.shape[:-1], dtype=bool)
-    eq = jnp.ones(hash_le.shape[:-1], dtype=bool)
+def le256_leq_limbs(hash_limbs, target_limbs) -> jnp.ndarray:
+    """hash <= target over 8 separate LE uint32 limbs (limb 7 most significant)."""
+    less = False
+    eq = True
     for j in range(7, -1, -1):
-        hw = hash_le[..., j]
-        tw = target_le[..., j]
+        hw = hash_limbs[j]
+        tw = target_limbs[j]
         less = less | (eq & (hw < tw))
         eq = eq & (hw == tw)
     return less | eq
+
+
+def le256_leq(hash_le: jnp.ndarray, target_le: jnp.ndarray) -> jnp.ndarray:
+    """hash <= target over (..., 8) LE limbs (limb 7 most significant)."""
+    return le256_leq_limbs(
+        [hash_le[..., j] for j in range(8)],
+        [target_le[..., j] for j in range(8)],
+    )
 
 
 def target_to_le_words(target: int) -> jnp.ndarray:

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel for the sha256d PoW nonce search.
+
+The XLA-fused jnp path (:mod:`.sha256_jax`) leaves the VPU underutilized:
+the 128-round dependency chain over a ~1M-lane batch gets split into many
+fusions whose intermediates round-trip HBM.  Here the search is a Pallas
+kernel: the grid walks nonce tiles, each program computes a (SUBLANES, 128)
+tile of double-SHA256 hashes entirely in VMEM/registers with the rounds
+statically unrolled and a rolling 16-word schedule window, and writes back
+only two scalars per tile (match count, first matching lane).  HBM traffic
+per tile is a few hundred bytes, so the kernel runs at VPU arithmetic speed.
+
+Reference analogue: the scalar CPU miner loop (ref src/miner.cpp:566-728);
+design per /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256_jax import IV_INTS, bswap32, compress_rounds, le256_leq_limbs
+
+# Per-program tile: SUBLANES x 128 nonce lanes.
+_LANES = 128
+
+
+def tile_search(mid8, tail3, nonce_base, target8, sublanes):
+    """Pure-jnp tile computation the Pallas kernel wraps.
+
+    mid8/tail3/target8: sequences of uint32 scalars; nonce_base: uint32
+    scalar (first nonce of the tile).  Returns (count, first) int32 scalars:
+    how many of the tile's sublanes*128 nonces meet the target and the
+    tile-local index of the first one (0x7FFFFFFF when none).  Kept separate
+    from the ref plumbing so the hash/compare/index math is unit-testable on
+    CPU, where Pallas interpret mode is orders of magnitude too slow.
+    """
+    lin = (
+        jax.lax.broadcasted_iota(jnp.uint32, (sublanes, _LANES), 0)
+        * jnp.uint32(_LANES)
+        + jax.lax.broadcasted_iota(jnp.uint32, (sublanes, _LANES), 1)
+    )
+    nonces = nonce_base + lin
+
+    zero = jnp.uint32(0)
+    # second header block: tail words 16..18, LE nonce as BE word 19, padding
+    w16 = [
+        tail3[0], tail3[1], tail3[2], bswap32(nonces),
+        jnp.uint32(0x80000000), zero, zero, zero,
+        zero, zero, zero, zero, zero, zero, zero, jnp.uint32(640),
+    ]
+    mid = tuple(mid8)
+    st = compress_rounds(mid, w16)
+    st = tuple(s + m for s, m in zip(st, mid))
+
+    # second hash: 32-byte digest padded into one block
+    w16b = list(st) + [
+        jnp.uint32(0x80000000), zero, zero, zero, zero, zero, zero,
+        jnp.uint32(256),
+    ]
+    iv = tuple(jnp.uint32(v) for v in IV_INTS)
+    dg = compress_rounds(iv, w16b)
+    digest = tuple(s + i for s, i in zip(dg, iv))
+
+    # hash-as-uint256-LE limb j = bswap(digest word j); compare to target,
+    # limb 7 most significant.
+    ok = le256_leq_limbs([bswap32(d) for d in digest], list(target8))
+
+    count = jnp.sum(ok.astype(jnp.int32))
+    big = jnp.int32(0x7FFFFFFF)
+    first = jnp.min(jnp.where(ok, lin.astype(jnp.int32), big))
+    return count, first
+
+
+def _search_kernel(mid_ref, tail_ref, nonce0_ref, target_ref,
+                   count_ref, first_ref, *, sublanes):
+    pid = pl.program_id(0)
+    tile = sublanes * _LANES
+    nonce_base = nonce0_ref[0] + pid.astype(jnp.uint32) * jnp.uint32(tile)
+    count, first = tile_search(
+        [mid_ref[i] for i in range(8)],
+        [tail_ref[i] for i in range(3)],
+        nonce_base,
+        [target_ref[j] for j in range(8)],
+        sublanes,
+    )
+    count_ref[pid] = count
+    first_ref[pid] = first
+
+
+def _search_call(*, batch, sublanes):
+    tile = sublanes * _LANES
+    if batch % tile:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    num_tiles = batch // tile
+    grid_spec = pl.GridSpec(
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # mid (8,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # tail3 (3,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # nonce0 (1,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # target (8,)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+    )
+    kernel = functools.partial(_search_kernel, sublanes=sublanes)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((num_tiles,), jnp.int32),
+            jax.ShapeDtypeStruct((num_tiles,), jnp.int32),
+        ],
+        # host CPU (tests / dryrun mesh) has no Mosaic backend
+        interpret=jax.default_backend() == "cpu",
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_search(batch, sublanes):
+    call = _search_call(batch=batch, sublanes=sublanes)
+
+    def run(mid, tail3, nonce0, target_le):
+        return call(
+            mid.astype(jnp.uint32),
+            tail3.astype(jnp.uint32),
+            jnp.reshape(nonce0, (1,)).astype(jnp.uint32),
+            target_le.astype(jnp.uint32),
+        )
+
+    if jax.default_backend() == "cpu":
+        # interpret mode runs the grid eagerly; wrapping it in jit would
+        # hand the fully unrolled round graph to XLA:CPU's SPMD pipeline,
+        # whose compile time explodes (see sha256_jax._want_unroll).
+        return run
+    return jax.jit(run)
+
+
+def pow_search_tiles(mid, tail3, nonce0, target_le, *, batch, sublanes=512):
+    """Scan `batch` nonces from nonce0; per-tile (count, first-lane) arrays.
+
+    Returns (counts, firsts), each shape (num_tiles,) int32.  The winning
+    nonce (if any) is nonce0 + tile*tile_size + firsts[tile] for the first
+    tile with counts>0.
+    """
+    return _compiled_search(batch, sublanes)(mid, tail3, nonce0, target_le)
+
+
+def pow_search_step(mid, tail3, nonce0, target_le, batch, sublanes=512):
+    """Pallas-backed equivalent of sha256_jax.pow_search_step (found, nonce).
+
+    Returns (found: bool array, nonce: uint32 array) — the first winning
+    nonce in the scanned window (undefined when not found).
+    """
+    counts, firsts = pow_search_tiles(
+        mid, tail3, nonce0, target_le, batch=batch, sublanes=sublanes
+    )
+    tile = sublanes * _LANES
+    hit = counts > 0
+    found = jnp.any(hit)
+    tidx = jnp.argmax(hit)
+    nonce = (
+        jnp.asarray(nonce0, jnp.uint32)
+        + tidx.astype(jnp.uint32) * jnp.uint32(tile)
+        + firsts[tidx].astype(jnp.uint32)
+    )
+    return found, nonce
