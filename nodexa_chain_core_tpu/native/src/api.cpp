@@ -7,12 +7,88 @@
 
 #include "kawpow.hpp"
 #include "keccak.hpp"
+#include "x16r_core.hpp"
 
 #include <cstring>
 
 using namespace nxk;
 
+namespace {
+
+// X16R algorithm table: index = prev-hash nibble selector (ref
+// src/hash.h:335 case labels).  Index 16 = tiger (X16RV2 prefix stage).
+typedef void (*HashFn)(const uint8_t*, size_t, uint8_t[64]);
+HashFn x16r_fn(int algo) {
+  switch (algo) {
+    case 0: return nxx::blake512;
+    case 1: return nxx::bmw512;
+    case 2: return nxx::groestl512;
+    case 3: return nxx::jh512;
+    case 4: return nxx::keccak512x;
+    case 5: return nxx::skein512;
+    case 6: return nxx::luffa512;
+    case 7: return nxx::cubehash512;
+    case 8: return nxx::shavite512;
+    case 9: return nxx::simd512;
+    case 10: return nxx::echo512;
+    case 11: return nxx::hamsi512;
+    case 12: return nxx::fugue512;
+    case 13: return nxx::shabal512;
+    case 14: return nxx::whirlpool512;
+    case 15: return nxx::sha512x;
+    case 16: return nxx::tiger192;
+    default: return nullptr;
+  }
+}
+
+}  // namespace
+
 extern "C" {
+
+// Single X16R-family primitive by selector index; returns 0 on bad index.
+int nxk_x16r_algo(int algo, const uint8_t* data, size_t len,
+                  uint8_t out[64]) {
+  HashFn fn = x16r_fn(algo);
+  if (!fn) return 0;
+  fn(data, len, out);
+  return 1;
+}
+
+// Chained X16R / X16RV2 header PoW hash (ref src/hash.h:335,465).
+// prevhash_le: the 32-byte little-endian uint256 of hashPrevBlock; the
+// selector for stage i reads byte (7 - i/2), high nibble first.
+// Returns the low 32 bytes (uint512.trim256()) of the final digest.
+static void x16r_chain(const uint8_t* data, size_t len,
+                       const uint8_t prevhash_le[32], int v2,
+                       uint8_t out32[32]) {
+  uint8_t cur[64];
+  size_t cur_len = len;
+  const uint8_t* src = data;
+  for (int i = 0; i < 16; ++i) {
+    uint8_t byte = prevhash_le[7 - i / 2];
+    int sel = (i % 2 == 0) ? (byte >> 4) : (byte & 0x0F);
+    if (v2 && (sel == 4 || sel == 6 || sel == 15)) {
+      uint8_t t[64];
+      nxx::tiger192(src, cur_len, t);
+      x16r_fn(sel)(t, 64, cur);
+    } else {
+      x16r_fn(sel)(src, cur_len, cur);
+    }
+    src = cur;
+    cur_len = 64;
+  }
+  std::memcpy(out32, cur, 32);
+}
+
+void nxk_x16r(const uint8_t* data, size_t len, const uint8_t prevhash_le[32],
+              uint8_t out32[32]) {
+  x16r_chain(data, len, prevhash_le, 0, out32);
+}
+
+void nxk_x16rv2(const uint8_t* data, size_t len,
+                const uint8_t prevhash_le[32], uint8_t out32[32]) {
+  x16r_chain(data, len, prevhash_le, 1, out32);
+}
 
 int nxk_epoch_number(int height) { return height / kEpochLength; }
 

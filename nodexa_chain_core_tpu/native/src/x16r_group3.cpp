@@ -1,0 +1,19 @@
+// X16R hash family, group 3: SHAvite-512, SIMD-512, ECHO-512, Hamsi-512,
+// Fugue-512 (AES-derived SHA-3 round-2 candidates).
+//
+// Clean-room implementations from the published specifications; constants
+// in x16r_constants.inc.  In progress — unimplemented entries abort.
+
+#include "x16r_core.hpp"
+
+#include <cstdlib>
+
+namespace nxx {
+
+void shavite512(const uint8_t*, size_t, uint8_t[64]) { std::abort(); }
+void simd512(const uint8_t*, size_t, uint8_t[64]) { std::abort(); }
+void echo512(const uint8_t*, size_t, uint8_t[64]) { std::abort(); }
+void hamsi512(const uint8_t*, size_t, uint8_t[64]) { std::abort(); }
+void fugue512(const uint8_t*, size_t, uint8_t[64]) { std::abort(); }
+
+}  // namespace nxx
