@@ -6,9 +6,9 @@ KawPow.  Here each algorithm registers a callable so the header-era dispatch
 in :mod:`..primitives.block` stays table-driven; native (C extension) and
 TPU-batched implementations plug into the same names.
 
-``sha256d`` is registered out of the box: it is the bootstrap algorithm used
-by this framework's regtest until the native X16R family / KawPow verifier
-are wired in (documented divergence; dispatch structure is identical).
+``sha256d`` is registered out of the box (used by tests and tooling);
+``x16r``/``x16rv2`` register from the native family on import, and the
+KawPow era dispatches through :mod:`..primitives.kawpow_glue`.
 """
 
 from __future__ import annotations
@@ -46,14 +46,21 @@ register("sha256d", sha256d)
 
 
 def _try_register_native() -> None:
-    """X16R/X16RV2 come from the native extension when built (task: native/)."""
-    try:
-        from . import x16r_native  # type: ignore
+    """Register X16R/X16RV2 when the native library is usable.
 
-        register("x16r", x16r_native.x16r)
-        register("x16rv2", x16r_native.x16rv2)
-    except ImportError:
-        pass
+    ``native.available()`` builds the shared library on first call (cached
+    on disk afterwards), so a host without a toolchain fails fast here with
+    the registry's UnknownPowAlgo instead of a NativeBuildError mid-
+    validation.
+    """
+    from .. import native
+
+    if not native.available():
+        return
+    from . import x16r_native
+
+    register("x16r", x16r_native.x16r)
+    register("x16rv2", x16r_native.x16rv2)
 
 
 _try_register_native()

@@ -1,0 +1,93 @@
+"""X16R / X16RV2 chained PoW hashes over the native primitive family.
+
+Parity: reference ``src/hash.h:335`` (HashX16R) and ``:465`` (HashX16RV2) —
+sixteen chained 512-bit hashes selected by the prev-block-hash nibbles, with
+X16RV2 inserting Tiger before keccak/luffa/sha512 stages.  The reference's
+``GetX16RHash`` (src/primitives/block.cpp:38) passes the header's own
+``hashPrevBlock`` as the selector source; since that field occupies bytes
+4..36 of the 80-byte header, the registry-facing callables here take just
+the header bytes.
+
+Implementations live in native/src/x16r_group*.cpp, validated against
+tests/data/x16r_vectors.json.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from .. import native
+
+ALGO_NAMES = [
+    "blake512", "bmw512", "groestl512", "jh512", "keccak512", "skein512",
+    "luffa512", "cubehash512", "shavite512", "simd512", "echo512",
+    "hamsi512", "fugue512", "shabal512", "whirlpool", "sha512", "tiger",
+]
+
+
+def algo(name_or_index, data: bytes) -> bytes:
+    """One primitive by selector index (0..15) or name; full 64-byte digest."""
+    idx = (
+        name_or_index
+        if isinstance(name_or_index, int)
+        else ALGO_NAMES.index(name_or_index)
+    )
+    lib = native.load()
+    out = (ctypes.c_uint8 * 64)()
+    if not lib.nxk_x16r_algo(idx, data, len(data), out):
+        raise ValueError(f"unknown x16r algo {name_or_index!r}")
+    return bytes(out)
+
+
+def x16r_with_prev(data: bytes, prevhash_le: bytes) -> bytes:
+    """Chained X16R with an explicit 32-byte LE selector hash."""
+    lib = native.load()
+    out = (ctypes.c_uint8 * 32)()
+    lib.nxk_x16r(data, len(data), prevhash_le, out)
+    return bytes(out)
+
+
+def x16rv2_with_prev(data: bytes, prevhash_le: bytes) -> bytes:
+    lib = native.load()
+    out = (ctypes.c_uint8 * 32)()
+    lib.nxk_x16rv2(data, len(data), prevhash_le, out)
+    return bytes(out)
+
+
+def search(header80: bytes, target_le_int: int, start_nonce: int = 0,
+           iterations: int = 1 << 32, v2: bool = False):
+    """Native nonce scan: returns (nonce, hash_le_int) or None.
+
+    Scans the LE u32 nonce at header offset 76 until the chained hash is
+    <= target (CPU miner / genesis mining path, ref src/miner.cpp:566).
+    """
+    lib = native.load()
+    nonce_out = ctypes.c_uint32()
+    hash_out = (ctypes.c_uint8 * 32)()
+    ok = lib.nxk_x16r_search(
+        header80,
+        1 if v2 else 0,
+        target_le_int.to_bytes(32, "little"),
+        start_nonce,
+        iterations,
+        ctypes.byref(nonce_out),
+        hash_out,
+    )
+    if not ok:
+        return None
+    return nonce_out.value, int.from_bytes(bytes(hash_out), "little")
+
+
+def _prev_from_header(header: bytes) -> bytes:
+    if len(header) != 80:
+        raise ValueError("x16r pow hash expects the 80-byte header form")
+    return header[4:36]
+
+
+def x16r(header: bytes) -> bytes:
+    """Header PoW hash (ref GetX16RHash): selector = header's hashPrevBlock."""
+    return x16r_with_prev(header, _prev_from_header(header))
+
+
+def x16rv2(header: bytes) -> bytes:
+    return x16rv2_with_prev(header, _prev_from_header(header))

@@ -106,6 +106,22 @@ def load() -> ctypes.CDLL:
         ]
         lib.nxk_kawpow_search.restype = ctypes.c_int
 
+        lib.nxk_x16r_algo.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t, u8p,
+        ]
+        lib.nxk_x16r_algo.restype = ctypes.c_int
+        lib.nxk_x16r.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, u8p,
+        ]
+        lib.nxk_x16rv2.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, u8p,
+        ]
+        lib.nxk_x16r_search.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32), u8p,
+        ]
+        lib.nxk_x16r_search.restype = ctypes.c_int
+
         _lib = lib
         return lib
 

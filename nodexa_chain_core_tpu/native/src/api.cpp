@@ -90,6 +90,42 @@ void nxk_x16rv2(const uint8_t* data, size_t len,
   x16r_chain(data, len, prevhash_le, 1, out32);
 }
 
+// Scan nonces (LE u32 at header offset 76) until the X16R-family hash meets
+// `target_le` (32-byte LE).  v2 selects X16RV2.  Returns 1 + nonce/hash on
+// success, 0 when `iterations` exhausted.  Used for genesis mining and the
+// legacy-era CPU miner (ref src/miner.cpp:566 nonce loop).
+int nxk_x16r_search(const uint8_t header80[80], int v2,
+                    const uint8_t target_le[32], uint32_t start_nonce,
+                    uint64_t iterations, uint32_t* nonce_out,
+                    uint8_t hash_out[32]) {
+  uint8_t hdr[80];
+  std::memcpy(hdr, header80, 80);
+  const uint8_t* prev = hdr + 4;
+  uint8_t h[32];
+  for (uint64_t i = 0; i < iterations; ++i) {
+    uint32_t nonce = start_nonce + (uint32_t)i;
+    hdr[76] = (uint8_t)nonce;
+    hdr[77] = (uint8_t)(nonce >> 8);
+    hdr[78] = (uint8_t)(nonce >> 16);
+    hdr[79] = (uint8_t)(nonce >> 24);
+    x16r_chain(hdr, 80, prev, v2, h);
+    // LE 256-bit compare, most significant byte last
+    bool leq = true;
+    for (int b = 31; b >= 0; --b) {
+      if (h[b] != target_le[b]) {
+        leq = h[b] < target_le[b];
+        break;
+      }
+    }
+    if (leq) {
+      *nonce_out = nonce;
+      std::memcpy(hash_out, h, 32);
+      return 1;
+    }
+  }
+  return 0;
+}
+
 int nxk_epoch_number(int height) { return height / kEpochLength; }
 
 int nxk_light_cache_num_items(int epoch) { return light_cache_num_items(epoch); }

@@ -28,13 +28,24 @@ inline uint8_t gfmul2(uint8_t a) {
   return (uint8_t)((a << 1) ^ ((a & 0x80) ? 0x1B : 0));
 }
 
-// AES T-table entry in little-endian convention: LSB-first (2S, S, S, 3S).
+// AES T-table, little-endian convention: entry = LSB-first (2S, S, S, 3S).
+// Precomputed once — SHAvite/ECHO run hundreds of AES rounds per hash.
+struct AesT0 {
+  uint32_t t[256];
+  AesT0() {
+    for (int x = 0; x < 256; ++x) {
+      uint8_t s = aes_sbox()[x];
+      uint8_t s2 = gfmul2(s);
+      uint8_t s3 = (uint8_t)(s2 ^ s);
+      t[x] = (uint32_t)s2 | ((uint32_t)s << 8) | ((uint32_t)s << 16) |
+             ((uint32_t)s3 << 24);
+    }
+  }
+};
+
 inline uint32_t aes_t0(uint8_t x) {
-  uint8_t s = aes_sbox()[x];
-  uint8_t s2 = gfmul2(s);
-  uint8_t s3 = (uint8_t)(s2 ^ s);
-  return (uint32_t)s2 | ((uint32_t)s << 8) | ((uint32_t)s << 16) |
-         ((uint32_t)s3 << 24);
+  static const AesT0 kT0;
+  return kT0.t[x];
 }
 
 // One AES round over a 4-word little-endian column state.

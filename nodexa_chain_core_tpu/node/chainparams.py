@@ -7,9 +7,10 @@ DGW from height 1 (regtest: 200), six BIP9 asset deployments, magic
 
 This is a brand-new chain (clean-room framework), so genesis blocks,
 message magic, and address prefixes are this chain's own.  The PoW era
-schedule is table-driven (:class:`..primitives.block.AlgoSchedule`); the
-bootstrap legacy algorithm is sha256d until the native X16R family lands
-(same dispatch structure as ref block.h:95-100).
+schedule is table-driven (:class:`..primitives.block.AlgoSchedule`) and
+runs the reference's real progression — X16R from genesis, X16RV2 and
+KawPow by nTime switchover (same dispatch structure as ref
+block.h:95-100) — on the native hash family in native/src/x16r_group*.
 """
 
 from __future__ import annotations
@@ -72,17 +73,29 @@ def create_genesis_block(
     return Block(header=header, vtx=[coinbase])
 
 
-def mine_genesis_nonce(time: int, bits: int) -> int:
-    """Scan nonces until the sha256d genesis meets its own target.
+def mine_genesis_nonce(time: int, bits: int, algo: str = "x16r") -> int:
+    """Scan nonces until the genesis meets its own target under `algo`.
 
-    Used once per network definition; results are pinned below.  Uses the
-    hashlib midstate trick (header prefix is constant).
+    Used once per network definition; results are pinned below.  x16r runs
+    the native search loop (the genesis selector hash — hashPrevBlock = 0 —
+    makes every stage blake512, as on the reference chain); sha256d keeps
+    the hashlib midstate trick for the bootstrap/test networks.
     """
+    blk = create_genesis_block(time, 0, bits)
+    hdr = bytearray(blk.header.pow_header_bytes(AlgoSchedule(legacy_algo=algo)))
+    target, _, _ = bits_to_target(bits)
+    if algo in ("x16r", "x16rv2"):
+        from ..crypto import x16r_native
+
+        found = x16r_native.search(bytes(hdr), target, v2=algo == "x16rv2")
+        if found is None:
+            raise RuntimeError("nonce space exhausted")
+        return found[0]
+    if algo != "sha256d":
+        raise ValueError(f"no genesis miner for algo {algo!r}")
+
     import hashlib
 
-    blk = create_genesis_block(time, 0, bits)
-    hdr = bytearray(blk.header.pow_header_bytes(AlgoSchedule(legacy_algo="sha256d")))
-    target, _, _ = bits_to_target(bits)
     mid = hashlib.sha256(bytes(hdr[:64]))
     tail = bytes(hdr[64:76])
     for nonce in range(1 << 32):
@@ -151,17 +164,24 @@ def _deployments(start: int, timeout: int) -> Dict[str, Deployment]:
 
 _GENESIS_TIME = 1753747200  # 2026-07-29 00:00:00 UTC
 
-# Pinned genesis nonces/hashes (mined once via mine_genesis_nonce; verified
-# by tests/test_chainparams.py).  None => mined lazily on first access.
-_MAIN_GENESIS_NONCE: Optional[int] = 8293673
+# Pinned genesis nonces/hashes under X16R (mined once via
+# mine_genesis_nonce; verified by tests).  None => mined lazily on first
+# access.
+_MAIN_GENESIS_NONCE: Optional[int] = 15175240
 _MAIN_GENESIS_HASH: Optional[int] = int(
-    "000000407bdbc54e47002e55cdbdf18e0db4eb7ac45423b21ba898f5725248c3", 16
+    "0000005bb04d9da6d6f804c42b5f8c4961537216fda197ddced1c80d7b4aab49", 16
 )
-_TEST_GENESIS_NONCE: Optional[int] = 7291348
+_TEST_GENESIS_NONCE: Optional[int] = 31393851
 _TEST_GENESIS_HASH: Optional[int] = int(
-    "000000323bb02d3cbfae8ff8110d4c148477edc760bf2d8759b8089fc9270a91", 16
+    "000000fed57c248c451d4c4db4e954dbf41e06ca8b7596ea373d2c70f6788130", 16
 )
 REGTEST_GENESIS_NONCE = 1  # trivially re-mined below if wrong
+
+# Era activation on main/test: X16RV2 45 days after genesis, KawPow 90 days
+# (the reference chain ran the same X16R -> X16RV2 -> KawPow progression via
+# nTime switchovers, src/primitives/block.h:95-100).
+_X16RV2_TIME = _GENESIS_TIME + 45 * 86400
+_KAWPOW_TIME = _GENESIS_TIME + 90 * 86400
 
 
 def main_params() -> NetworkParams:
@@ -169,8 +189,8 @@ def main_params() -> NetworkParams:
         deployments=_deployments(1753747200, 1785283200),
         dgw_activation_height=1,
         asset_activation_height=1,
-        x16rv2_activation_time=NEVER_ACTIVE,  # native algos not yet wired
-        kawpow_activation_time=NEVER_ACTIVE,
+        x16rv2_activation_time=_X16RV2_TIME,
+        kawpow_activation_time=_KAWPOW_TIME,
     )
     nonce = _MAIN_GENESIS_NONCE
     if nonce is None:
@@ -181,7 +201,7 @@ def main_params() -> NetworkParams:
         algo_schedule=AlgoSchedule(
             mid_activation_time=cons.x16rv2_activation_time,
             kawpow_activation_time=cons.kawpow_activation_time,
-            legacy_algo="sha256d",
+            legacy_algo="x16r",
         ),
         message_start=b"NDXA",
         default_port=8788,
@@ -206,8 +226,8 @@ def test_params() -> NetworkParams:
         deployments=_deployments(1753747200, 1785283200),
         dgw_activation_height=1,
         asset_activation_height=1,
-        x16rv2_activation_time=NEVER_ACTIVE,
-        kawpow_activation_time=NEVER_ACTIVE,
+        x16rv2_activation_time=_X16RV2_TIME,
+        kawpow_activation_time=_KAWPOW_TIME,
     )
     nonce = _TEST_GENESIS_NONCE
     if nonce is None:
@@ -218,7 +238,7 @@ def test_params() -> NetworkParams:
         algo_schedule=AlgoSchedule(
             mid_activation_time=cons.x16rv2_activation_time,
             kawpow_activation_time=cons.kawpow_activation_time,
-            legacy_algo="sha256d",
+            legacy_algo="x16r",
         ),
         message_start=b"ndxt",
         default_port=4568,
@@ -262,7 +282,7 @@ def regtest_params() -> NetworkParams:
     sched = AlgoSchedule(
         mid_activation_time=cons.x16rv2_activation_time,
         kawpow_activation_time=cons.kawpow_activation_time,
-        legacy_algo="sha256d",
+        legacy_algo="x16r",
     )
     nonce = REGTEST_GENESIS_NONCE
     # Cheap: expected 2 attempts at 0x207fffff.
@@ -310,7 +330,7 @@ def kawpow_regtest_params() -> NetworkParams:
     p.algo_schedule = AlgoSchedule(
         mid_activation_time=p.consensus.x16rv2_activation_time,
         kawpow_activation_time=p.consensus.kawpow_activation_time,
-        legacy_algo="sha256d",
+        legacy_algo="x16r",
     )
     p.message_start = b"ndxk"
     p.default_port = 19445
