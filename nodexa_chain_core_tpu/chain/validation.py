@@ -37,7 +37,7 @@ from ..consensus.tx_verify import (
     get_transaction_sigop_cost,
     is_final_tx,
 )
-from ..core.uint256 import u256_hex
+from ..core.uint256 import bits_to_target, u256_hex
 from ..node.chainparams import NetworkParams
 from ..node.events import main_signals
 from ..primitives.block import Block, BlockHeader
@@ -191,14 +191,29 @@ class ChainState:
 
     # ------------------------------------------------------- header checks
 
-    def check_block_header(self, header: BlockHeader, check_pow: bool = True) -> None:
-        """ref validation.cpp:11638 CheckBlockHeader."""
+    def check_block_header(
+        self,
+        header: BlockHeader,
+        check_pow: bool = True,
+        expected_height: Optional[int] = None,
+    ) -> None:
+        """ref validation.cpp:11638 CheckBlockHeader.
+
+        ``expected_height`` is the height implied by the already-validated
+        prev index; the checkpoint cut-off is gated on it rather than the
+        attacker-controlled header field (the reference gates on the index
+        height).  When the caller has no context it falls back to the
+        header field, which can only *widen* verification (a bogus low
+        height fails the full mix check; a bogus high height still
+        verifies fully).
+        """
         sched = self.params.algo_schedule
         if check_pow and sched.is_kawpow(header.time):
             # Below the last checkpoint the mix_hash is trusted and only the
             # cheap final-hash boundary is checked (ref :11640-50).
             last_cp = max(self.params.checkpoints, default=-1)
-            if header.height > last_cp:
+            height = expected_height if expected_height is not None else header.height
+            if height > last_cp:
                 from ..crypto import kawpow
 
                 header_hash = int.from_bytes(
@@ -549,12 +564,67 @@ class ChainState:
 
     # ------------------------------------------------------- public entry
 
+    def _batch_verify_kawpow(self, headers: List[BlockHeader]) -> set:
+        """Pre-verify KawPow PoW for a whole HEADERS message on the device.
+
+        Returns ids of headers whose PoW (mix recomputation + boundary) was
+        verified as one batched program — the TPU-native replacement for the
+        reference's per-header progpow::verify calls during headers sync
+        (ref validation.cpp:12017 -> :11638).  Headers are grouped per
+        epoch; epochs without a device-resident DAG slab fall back to the
+        scalar native path in check_block_header.  A failed batch raises
+        immediately (same bad-header outcome, one round-trip earlier).
+        """
+        factory = getattr(self, "kawpow_batch_factory", None)
+        if factory is None:
+            return set()
+        sched = self.params.algo_schedule
+        last_cp = max(self.params.checkpoints, default=-1)
+        from ..crypto import kawpow as kp
+
+        groups: dict = {}
+        for header in headers:
+            if not sched.is_kawpow(header.time):
+                continue
+            if header.height <= last_cp:
+                continue  # checkpoint fast path handles it
+            groups.setdefault(kp.epoch_number(header.height), []).append(header)
+        verified: set = set()
+        for epoch, group in groups.items():
+            verifier = factory(epoch)
+            if verifier is None:
+                continue
+            entries = []
+            for header in group:
+                target, overflow, _ = bits_to_target(header.bits)
+                if overflow:
+                    raise BlockValidationError("high-hash", "bad bits")
+                entries.append((
+                    int.from_bytes(header.kawpow_header_hash(sched), "little"),
+                    header.nonce64,
+                    header.height,
+                    header.mix_hash,
+                    target,
+                ))
+            for header, (ok, _final) in zip(group, verifier.verify_headers(entries)):
+                if not ok:
+                    raise BlockValidationError(
+                        "high-hash", "batched kawpow verification failed"
+                    )
+                verified.add(id(header))
+        return verified
+
     def process_new_block_headers(
         self, headers: List[BlockHeader], adjusted_time: Optional[int] = None
     ) -> List[BlockIndex]:
         """ref validation.cpp:12017 ProcessNewBlockHeaders."""
         if adjusted_time is None:
             adjusted_time = int(time.time())
+        new = [
+            h for h in headers
+            if self.block_index.get(h.get_hash(self.params.algo_schedule)) is None
+        ]
+        preverified = self._batch_verify_kawpow(new) if new else set()
         out = []
         for header in headers:
             h = header.get_hash(self.params.algo_schedule)
@@ -564,12 +634,16 @@ class ChainState:
                     raise BlockValidationError("duplicate-invalid")
                 out.append(existing)
                 continue
-            self.check_block_header(header)
             prev = self.block_index.get(header.hash_prev)
             if prev is None:
                 raise BlockValidationError("prev-blk-not-found")
             if prev in self.invalid:
                 raise BlockValidationError("bad-prevblk")
+            self.check_block_header(
+                header,
+                check_pow=id(header) not in preverified,
+                expected_height=prev.height + 1,
+            )
             self.contextual_check_block_header(header, prev, adjusted_time)
             out.append(self._add_to_block_index(header))
         return out

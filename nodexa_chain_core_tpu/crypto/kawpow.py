@@ -139,3 +139,24 @@ def dataset_item_2048(epoch: int, index: int) -> bytes:
     buf = (ctypes.c_uint8 * 256)()
     lib.nxk_dataset_item_2048(epoch, index, buf)
     return bytes(buf)
+
+
+def dataset_slab(epoch: int, threads: int = 0):
+    """Build the full epoch DAG as a (num_items, 64) uint32 numpy array.
+
+    ~256 MB per 1M items; feeds the device-resident slab of the TPU batch
+    verifier.  Built once per epoch (background prebuild recommended).
+    """
+    import os
+
+    import numpy as np
+
+    lib = native.load()
+    n = lib.nxk_full_dataset_num_items(epoch)
+    out = np.empty((n, 64), dtype=np.uint32)
+    if threads <= 0:
+        threads = os.cpu_count() or 4
+    lib.nxk_dataset_slab(
+        epoch, 0, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), threads
+    )
+    return out

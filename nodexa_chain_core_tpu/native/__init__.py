@@ -53,6 +53,7 @@ def build(force: bool = False) -> Path:
         "-std=c++17",
         "-shared",
         "-fPIC",
+        "-pthread",
         "-o",
         str(tmp_path),
     ] + [str(p) for p in _sources()]
@@ -89,6 +90,9 @@ def load() -> ctypes.CDLL:
         lib.nxk_light_cache_copy.argtypes = [ctypes.c_int, u8p]
         lib.nxk_l1_cache_copy.argtypes = [ctypes.c_int, u8p]
         lib.nxk_dataset_item_2048.argtypes = [ctypes.c_int, ctypes.c_uint32, u8p]
+        lib.nxk_dataset_slab.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32, u8p, ctypes.c_int,
+        ]
         lib.nxk_kawpow_hash.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64, u8p, u8p,
         ]

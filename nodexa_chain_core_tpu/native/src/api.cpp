@@ -9,7 +9,10 @@
 #include "keccak.hpp"
 #include "x16r_core.hpp"
 
+#include <atomic>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 using namespace nxk;
 
@@ -160,6 +163,31 @@ void nxk_l1_cache_copy(int epoch, uint8_t* out) {
 void nxk_dataset_item_2048(int epoch, uint32_t index, uint8_t out[256]) {
   auto ctx = get_epoch_context(epoch);
   dataset_item_2048(*ctx, index, out);
+}
+
+// Bulk DAG slab builder: items [start, start+count) at 256 bytes each,
+// fanned out over `threads` workers.  Feeds the device-resident epoch slab
+// of the TPU batch verifier (ops/progpow_jax.py); the reference's analogue
+// is ethash::calculate_full_dataset.
+void nxk_dataset_slab(int epoch, uint32_t start, uint32_t count,
+                      uint8_t* out, int threads) {
+  auto ctx = get_epoch_context(epoch);
+  if (threads < 1) threads = 1;
+  std::vector<std::thread> pool;
+  std::atomic<uint32_t> next{0};
+  const uint32_t kChunk = 1024;
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        uint32_t base = next.fetch_add(kChunk);
+        if (base >= count) return;
+        uint32_t end = base + kChunk < count ? base + kChunk : count;
+        for (uint32_t i = base; i < end; ++i)
+          dataset_item_2048(*ctx, start + i, out + (size_t)i * 256);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
 }
 
 void nxk_kawpow_hash(int height, const uint8_t header_hash[32], uint64_t nonce,

@@ -45,6 +45,26 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     node.scheduler.start()
     node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
 
+    # KawPow epoch prebuild (ref ethash managed contexts) + optional TPU
+    # batched header verification (-tpukawpow builds device DAG slabs).
+    if node.params.consensus.kawpow_activation_time < (1 << 62):
+        from .epoch_manager import EpochManager
+
+        node.epoch_manager = EpochManager(
+            tpu_verify=g_args.get_bool("tpukawpow"),
+            slab_threads=g_args.get_int("slabthreads", 0),
+        )
+        node.chainstate.kawpow_batch_factory = node.epoch_manager.verifier
+
+        def _warm_epochs():
+            tip = node.chainstate.tip()
+            sched = node.params.algo_schedule
+            if tip is not None and sched.is_kawpow(tip.header.time):
+                node.epoch_manager.ensure_for_height(tip.height)
+
+        _warm_epochs()
+        node.scheduler.schedule_every(_warm_epochs, 60.0)
+
     # Step 8: wallet
     if not g_args.get_bool("disablewallet"):
         try:

@@ -1,0 +1,83 @@
+"""Background KawPow epoch management.
+
+The reference prebuilds/caches ethash epoch contexts with managed contexts
+(ref src/crypto/ethash/lib/ethash/managed.cpp) so the first verification of
+a new epoch never stalls the message-handler thread.  This manager runs the
+same idea from the node scheduler: it warms the native light/L1 caches for
+the tip's epoch and the next one in a worker thread, and — when the TPU
+batch-verification path is enabled — builds the device-resident DAG slab
+and :class:`..ops.progpow_jax.BatchVerifier` for them.
+
+``verifier(epoch)`` is non-blocking: it returns a verifier only once the
+background build finished, so header sync transparently falls back to the
+scalar native path until the slab is ready.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..crypto import kawpow
+from ..utils.logging import g_logger
+
+
+class EpochManager:
+    def __init__(self, tpu_verify: bool = False, slab_threads: int = 0):
+        self.tpu_verify = tpu_verify
+        self.slab_threads = slab_threads
+        self._lock = threading.Lock()
+        self._warm: set = set()
+        self._building: set = set()
+        self._verifiers: Dict[int, object] = {}
+
+    # -- background warming -------------------------------------------------
+
+    def ensure_for_height(self, height: int) -> None:
+        """Warm epoch(height) and its successor; cheap if already warm."""
+        epoch = kawpow.epoch_number(height)
+        for e in (epoch, epoch + 1):
+            self._ensure(e)
+
+    def _ensure(self, epoch: int) -> None:
+        with self._lock:
+            if epoch in self._warm or epoch in self._building:
+                return
+            self._building.add(epoch)
+        t = threading.Thread(
+            target=self._build, args=(epoch,), name=f"epoch-{epoch}", daemon=True
+        )
+        t.start()
+
+    def _build(self, epoch: int) -> None:
+        try:
+            kawpow.l1_cache(epoch)  # forces native light+L1 build
+            verifier = None
+            if self.tpu_verify:
+                from ..ops.progpow_jax import BatchVerifier
+
+                g_logger.log(
+                    f"epoch {epoch}: building DAG slab for TPU verification"
+                )
+                verifier = BatchVerifier.from_epoch(
+                    epoch, threads=self.slab_threads
+                )
+            with self._lock:
+                self._warm.add(epoch)
+                if verifier is not None:
+                    self._verifiers[epoch] = verifier
+            g_logger.log(f"epoch {epoch}: context ready")
+        except Exception as e:  # pragma: no cover - defensive
+            g_logger.log(f"epoch {epoch}: prebuild failed: {e}")
+            with self._lock:
+                self._building.discard(epoch)
+            return
+        with self._lock:
+            self._building.discard(epoch)
+
+    # -- consumer API -------------------------------------------------------
+
+    def verifier(self, epoch: int) -> Optional[object]:
+        """Ready BatchVerifier for `epoch`, or None (scalar fallback)."""
+        with self._lock:
+            return self._verifiers.get(epoch)

@@ -1,0 +1,107 @@
+"""Batched KawPow header verification wiring in headers sync.
+
+process_new_block_headers must route all new KawPow-era headers of a
+HEADERS message through the injected epoch batch verifier as ONE call
+(the TPU path; ops/progpow_jax.BatchVerifier implements the same
+interface, cross-validated against the spec in test_progpow_jax), and
+skip the scalar per-header verification for pre-verified headers.
+"""
+
+import pytest
+
+from nodexa_chain_core_tpu import native
+from nodexa_chain_core_tpu.chain.validation import (
+    BlockValidationError,
+    ChainState,
+)
+from nodexa_chain_core_tpu.crypto import kawpow
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+from nodexa_chain_core_tpu.script.sign import KeyStore
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+class RecordingVerifier:
+    """BatchVerifier-interface twin backed by the native scalar engine."""
+
+    def __init__(self):
+        self.batches = []
+
+    def verify_headers(self, entries):
+        self.batches.append(len(entries))
+        out = []
+        for header_hash, nonce64, height, mix_le, target_le in entries:
+            ok, final = kawpow.kawpow_verify(
+                height, header_hash, mix_le, nonce64, target_le
+            )
+            out.append((ok, final))
+        return out
+
+
+@pytest.fixture()
+def chain():
+    from nodexa_chain_core_tpu.node import chainparams
+
+    params = chainparams.select_params("kawpowregtest")
+    cs = ChainState(params)
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xBEEF)))
+    t = params.genesis_time + 60
+    headers = []
+    for _ in range(3):
+        asm = BlockAssembler(cs)
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 16)
+        cs.process_new_block(blk)
+        headers.append(blk.header)
+        t += 60
+    yield params, headers
+    chainparams.select_params("regtest")
+
+
+def test_headers_batch_verified_in_one_call(chain):
+    params, headers = chain
+    fresh = ChainState(params)
+    verifier = RecordingVerifier()
+    calls = []
+
+    def factory(epoch):
+        calls.append(epoch)
+        return verifier
+
+    fresh.kawpow_batch_factory = factory
+    idxs = fresh.process_new_block_headers(headers)
+    assert len(idxs) == 3
+    assert verifier.batches == [3]  # one batch, all three headers
+    assert calls == [0]  # epoch 0 requested once
+
+
+def test_headers_batch_rejects_tampered_mix(chain):
+    params, headers = chain
+    fresh = ChainState(params)
+    fresh.kawpow_batch_factory = lambda epoch: RecordingVerifier()
+    import copy
+
+    bad = [copy.copy(h) for h in headers]
+    bad[1].mix_hash ^= 1 << 7
+    bad[1]._cached_hash = None
+    with pytest.raises(BlockValidationError):
+        fresh.process_new_block_headers(bad)
+
+
+def test_no_factory_falls_back_to_scalar(chain):
+    params, headers = chain
+    fresh = ChainState(params)  # no kawpow_batch_factory attribute
+    idxs = fresh.process_new_block_headers(headers)
+    assert len(idxs) == 3
+
+
+def test_factory_none_epoch_falls_back(chain):
+    params, headers = chain
+    fresh = ChainState(params)
+    fresh.kawpow_batch_factory = lambda epoch: None  # slab not built
+    idxs = fresh.process_new_block_headers(headers)
+    assert len(idxs) == 3
