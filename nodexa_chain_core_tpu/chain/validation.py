@@ -99,6 +99,16 @@ class ChainState:
 
         self.coins_db = CoinsViewDB(self._chainstate_db)
         self.coins = CoinsViewCache(self.coins_db)
+        if script_check_threads == 0:
+            # -par=0 -> auto (ref init.cpp:1125): worker threads pay off only
+            # with the GIL-free native ECDSA engine; pure Python stays inline.
+            from ..crypto.secp256k1 import _native_lib
+
+            if _native_lib() is not None:
+                auto = min(os.cpu_count() or 1, 8)
+                script_check_threads = auto if auto >= 2 else 0
+        elif script_check_threads < 0:
+            script_check_threads = 0  # -par=-1: force inline
         self.checkqueue = (
             CheckQueue(script_check_threads) if script_check_threads > 0 else None
         )

@@ -233,6 +233,27 @@ def sign(d: int, msg32: bytes) -> Tuple[int, int]:
         return r, s
 
 
+_NATIVE = None  # 0 = unavailable, CDLL = loaded
+
+
+def _native_lib():
+    """The native EC engine (GIL-free ecmult), or None.
+
+    With it, the -par checkqueue genuinely parallelizes script checks:
+    ctypes releases the GIL for the duration of the point multiplication,
+    which is ~99% of a verify (ref checkqueue.h:33 worker fan-out).
+    """
+    global _NATIVE
+    if _NATIVE is None:
+        from .. import native
+
+        try:
+            _NATIVE = native.load()
+        except Exception:
+            _NATIVE = 0
+    return _NATIVE or None
+
+
 def verify(pub: Point, msg32: bytes, r: int, s: int) -> bool:
     """Verify (r, s) over a 32-byte digest.  No low-S requirement here —
     policy-level checks live in the script interpreter, matching the split
@@ -245,6 +266,23 @@ def verify(pub: Point, msg32: bytes, r: int, s: int) -> bool:
     w = _inv(s, N)
     u1 = z * w % N
     u2 = r * w % N
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        out_x = (ctypes.c_uint8 * 32)()
+        out_y = (ctypes.c_uint8 * 32)()
+        ok = lib.nxk_ecmult(
+            u1.to_bytes(32, "big"),
+            u2.to_bytes(32, "big"),
+            pub[0].to_bytes(32, "big"),
+            pub[1].to_bytes(32, "big"),
+            out_x,
+            out_y,
+        )
+        if not ok:
+            return False
+        return int.from_bytes(bytes(out_x), "big") % N == r
     j = _jac_add(_g_mul(u1), _jac_mul(_to_jac(pub), u2))
     pt = _from_jac(j)
     if pt is None:

@@ -126,6 +126,14 @@ def load() -> ctypes.CDLL:
         ]
         lib.nxk_x16r_search.restype = ctypes.c_int
 
+        lib.nxk_ecmult.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, u8p, u8p,
+        ]
+        lib.nxk_ecmult.restype = ctypes.c_int
+        lib.nxk_ec_on_curve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.nxk_ec_on_curve.restype = ctypes.c_int
+
         _lib = lib
         return lib
 

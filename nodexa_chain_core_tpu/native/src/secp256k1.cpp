@@ -1,0 +1,390 @@
+// Clean-room secp256k1 point arithmetic for ECDSA verification.
+//
+// The reference vendors libsecp256k1 (ref src/secp256k1/) and fans
+// per-input signature checks onto the -par CCheckQueue worker threads
+// (ref src/checkqueue.h:33, validation.cpp:9257).  This engine provides
+// the hot half of a verify — R = u1*G + u2*Q and the affine x of R —
+// as a GIL-free native call; the Python layer does DER/scalar bigint work
+// and the mod-n comparison (crypto/secp256k1.py).
+//
+// Design: 4x64-bit field limbs over unsigned __int128, fully reduced
+// after every operation (p = 2^256 - 0x1000003D1); Jacobian double/add
+// (a = 0 short Weierstrass); Strauss-Shamir simultaneous 4-bit windowed
+// double-and-add with a lazily-built static window table for G.
+
+#include <cstdint>
+#include <cstring>
+
+namespace nxsecp {
+
+typedef unsigned __int128 u128;
+
+struct Fe {
+  uint64_t n[4];  // little-endian limbs, always < p
+};
+
+static const uint64_t kP[4] = {
+    0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+    0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL,
+};
+static const uint64_t kComp = 0x1000003D1ULL;  // 2^256 mod p
+
+static inline bool fe_is_zero(const Fe& a) {
+  return (a.n[0] | a.n[1] | a.n[2] | a.n[3]) == 0;
+}
+
+static inline int fe_cmp_p(const Fe& a) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.n[i] < kP[i]) return -1;
+    if (a.n[i] > kP[i]) return 1;
+  }
+  return 0;
+}
+
+static inline void fe_sub_p(Fe& a) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.n[i] - kP[i] - (uint64_t)borrow;
+    a.n[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+static inline void fe_add(Fe& r, const Fe& a, const Fe& b) {
+  u128 c = 0;
+  for (int i = 0; i < 4; ++i) {
+    c += (u128)a.n[i] + b.n[i];
+    r.n[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  if (c) {
+    // fold the 2^256 carry: += kComp
+    u128 t = (u128)r.n[0] + kComp;
+    r.n[0] = (uint64_t)t;
+    t >>= 64;
+    for (int i = 1; i < 4 && t; ++i) {
+      t += r.n[i];
+      r.n[i] = (uint64_t)t;
+      t >>= 64;
+    }
+  }
+  if (fe_cmp_p(r) >= 0) fe_sub_p(r);
+}
+
+static inline void fe_sub(Fe& r, const Fe& a, const Fe& b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.n[i] - b.n[i] - (uint64_t)borrow;
+    r.n[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (borrow) {
+    u128 c = 0;
+    for (int i = 0; i < 4; ++i) {
+      c += (u128)r.n[i] + kP[i];
+      r.n[i] = (uint64_t)c;
+      c >>= 64;
+    }
+  }
+}
+
+static void fe_mul(Fe& r, const Fe& a, const Fe& b) {
+  uint64_t lo[4] = {0, 0, 0, 0}, hi[4] = {0, 0, 0, 0};
+  // schoolbook 4x4 -> 8 limbs
+  uint64_t w[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.n[i] * b.n[j] + w[i + j] + (uint64_t)carry;
+      w[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    w[i + 4] = (uint64_t)carry;
+  }
+  std::memcpy(lo, w, sizeof lo);
+  std::memcpy(hi, w + 4, sizeof hi);
+  // fold hi * kComp into lo
+  u128 carry = 0;
+  uint64_t over = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 cur = (u128)hi[i] * kComp + lo[i] + (uint64_t)carry;
+    lo[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  over = (uint64_t)carry;  // < 2^34
+  // fold the overflow limb (over * 2^256 == over * kComp)
+  u128 cur = (u128)over * kComp + lo[0];
+  lo[0] = (uint64_t)cur;
+  cur >>= 64;
+  for (int i = 1; i < 4 && cur; ++i) {
+    cur += lo[i];
+    lo[i] = (uint64_t)cur;
+    cur >>= 64;
+  }
+  std::memcpy(r.n, lo, sizeof lo);
+  if (cur || fe_cmp_p(r) >= 0) {
+    if (cur) {
+      // one more fold (cannot recurse further)
+      Fe t = r;
+      u128 c2 = (u128)t.n[0] + kComp;
+      t.n[0] = (uint64_t)c2;
+      c2 >>= 64;
+      for (int i = 1; i < 4; ++i) {
+        c2 += t.n[i];
+        t.n[i] = (uint64_t)c2;
+        c2 >>= 64;
+      }
+      r = t;
+    }
+    if (fe_cmp_p(r) >= 0) fe_sub_p(r);
+  }
+}
+
+static inline void fe_sqr(Fe& r, const Fe& a) { fe_mul(r, a, a); }
+
+static void fe_inv(Fe& r, const Fe& a) {
+  // Fermat: a^(p-2); simple MSB-first square-and-multiply
+  static const uint64_t kPm2[4] = {
+      0xFFFFFFFEFFFFFC2DULL, 0xFFFFFFFFFFFFFFFFULL,
+      0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL,
+  };
+  Fe acc;
+  acc.n[0] = 1;
+  acc.n[1] = acc.n[2] = acc.n[3] = 0;
+  bool started = false;
+  for (int limb = 3; limb >= 0; --limb) {
+    for (int bit = 63; bit >= 0; --bit) {
+      if (started) fe_sqr(acc, acc);
+      if ((kPm2[limb] >> bit) & 1) {
+        if (started) {
+          fe_mul(acc, acc, a);
+        } else {
+          acc = a;
+          started = true;
+        }
+      }
+    }
+  }
+  r = acc;
+}
+
+// ------------------------------------------------------------- point ops
+
+struct Jac {
+  Fe x, y, z;
+  bool inf;
+};
+
+static const Fe kFeOne = {{1, 0, 0, 0}};
+
+static void jac_double(Jac& r, const Jac& p) {
+  if (p.inf || fe_is_zero(p.y)) {
+    r.inf = true;
+    return;
+  }
+  Fe a, b, c, d, e, f, t;
+  fe_sqr(a, p.x);                 // A = X^2
+  fe_sqr(b, p.y);                 // B = Y^2
+  fe_sqr(c, b);                   // C = B^2
+  fe_add(t, p.x, b);
+  fe_sqr(t, t);
+  fe_sub(t, t, a);
+  fe_sub(t, t, c);
+  fe_add(d, t, t);                // D = 2((X+B)^2 - A - C)
+  fe_add(e, a, a);
+  fe_add(e, e, a);                // E = 3A
+  fe_sqr(f, e);                   // F = E^2
+  Fe x3, y3, z3;
+  fe_sub(x3, f, d);
+  fe_sub(x3, x3, d);              // X3 = F - 2D
+  fe_sub(t, d, x3);
+  fe_mul(t, e, t);
+  Fe c8;
+  fe_add(c8, c, c);
+  fe_add(c8, c8, c8);
+  fe_add(c8, c8, c8);             // 8C
+  fe_sub(y3, t, c8);              // Y3 = E(D - X3) - 8C
+  fe_mul(z3, p.y, p.z);
+  fe_add(z3, z3, z3);             // Z3 = 2YZ
+  r.x = x3;
+  r.y = y3;
+  r.z = z3;
+  r.inf = false;
+}
+
+static void jac_add(Jac& r, const Jac& p, const Jac& q) {
+  if (p.inf) {
+    r = q;
+    return;
+  }
+  if (q.inf) {
+    r = p;
+    return;
+  }
+  Fe z1z1, z2z2, u1, u2, s1, s2, t;
+  fe_sqr(z1z1, p.z);
+  fe_sqr(z2z2, q.z);
+  fe_mul(u1, p.x, z2z2);
+  fe_mul(u2, q.x, z1z1);
+  fe_mul(t, q.z, z2z2);
+  fe_mul(s1, p.y, t);
+  fe_mul(t, p.z, z1z1);
+  fe_mul(s2, q.y, t);
+  Fe h, rr;
+  fe_sub(h, u2, u1);
+  fe_sub(rr, s2, s1);
+  if (fe_is_zero(h)) {
+    if (fe_is_zero(rr)) {
+      jac_double(r, p);
+    } else {
+      r.inf = true;
+    }
+    return;
+  }
+  Fe h2, h3, u1h2;
+  fe_sqr(h2, h);
+  fe_mul(h3, h2, h);
+  fe_mul(u1h2, u1, h2);
+  Fe x3, y3, z3;
+  fe_sqr(x3, rr);
+  fe_sub(x3, x3, h3);
+  fe_sub(x3, x3, u1h2);
+  fe_sub(x3, x3, u1h2);           // X3 = R^2 - H^3 - 2*U1*H^2
+  fe_sub(t, u1h2, x3);
+  fe_mul(t, rr, t);
+  Fe s1h3;
+  fe_mul(s1h3, s1, h3);
+  fe_sub(y3, t, s1h3);            // Y3 = R(U1H^2 - X3) - S1H^3
+  fe_mul(z3, p.z, q.z);
+  fe_mul(z3, z3, h);              // Z3 = Z1 Z2 H
+  r.x = x3;
+  r.y = y3;
+  r.z = z3;
+  r.inf = false;
+}
+
+static void fe_from_bytes(Fe& r, const uint8_t b[32]) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; ++j) v = (v << 8) | b[(3 - i) * 8 + j];
+    r.n[i] = v;
+  }
+}
+
+static void fe_to_bytes(uint8_t b[32], const Fe& a) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t v = a.n[i];
+    for (int j = 7; j >= 0; --j) {
+      b[(3 - i) * 8 + j] = (uint8_t)v;
+      v >>= 8;
+    }
+  }
+}
+
+// 4-bit window tables: T[k] = k * P for k in 1..15 (T[0] unused)
+static void build_window(Jac table[16], const Jac& p) {
+  table[1] = p;
+  jac_double(table[2], p);
+  for (int k = 3; k < 16; ++k) jac_add(table[k], table[k - 1], p);
+}
+
+struct GTable {
+  Jac t[16];
+  GTable() {
+    Jac g;
+    static const uint8_t gx[32] = {
+        0x79, 0xBE, 0x66, 0x7E, 0xF9, 0xDC, 0xBB, 0xAC, 0x55, 0xA0, 0x62,
+        0x95, 0xCE, 0x87, 0x0B, 0x07, 0x02, 0x9B, 0xFC, 0xDB, 0x2D, 0xCE,
+        0x28, 0xD9, 0x59, 0xF2, 0x81, 0x5B, 0x16, 0xF8, 0x17, 0x98,
+    };
+    static const uint8_t gy[32] = {
+        0x48, 0x3A, 0xDA, 0x77, 0x26, 0xA3, 0xC4, 0x65, 0x5D, 0xA4, 0xFB,
+        0xFC, 0x0E, 0x11, 0x08, 0xA8, 0xFD, 0x17, 0xB4, 0x48, 0xA6, 0x85,
+        0x54, 0x19, 0x9C, 0x47, 0xD0, 0x8F, 0xFB, 0x10, 0xD4, 0xB8,
+    };
+    fe_from_bytes(g.x, gx);
+    fe_from_bytes(g.y, gy);
+    g.z = kFeOne;
+    g.inf = false;
+    build_window(t, g);
+  }
+};
+
+static const GTable& g_table() {
+  static const GTable kG;
+  return kG;
+}
+
+}  // namespace nxsecp
+
+extern "C" {
+
+// R = u1*G + u2*Q.  Scalars and coordinates are 32-byte big-endian.
+// Returns 0 if R is the point at infinity, else 1 with R's affine x/y.
+int nxk_ecmult(const uint8_t u1[32], const uint8_t u2[32],
+               const uint8_t qx[32], const uint8_t qy[32],
+               uint8_t out_x[32], uint8_t out_y[32]) {
+  using namespace nxsecp;
+  Jac q;
+  fe_from_bytes(q.x, qx);
+  fe_from_bytes(q.y, qy);
+  q.z = kFeOne;
+  q.inf = false;
+  Jac qtab[16];
+  build_window(qtab, q);
+  const GTable& gt = g_table();
+
+  Jac acc;
+  acc.inf = true;
+  bool any = false;
+  for (int nib = 0; nib < 64; ++nib) {
+    if (any) {
+      Jac t;
+      jac_double(t, acc);
+      jac_double(acc, t);
+      jac_double(t, acc);
+      jac_double(acc, t);
+    }
+    int k1 = (u1[nib / 2] >> (nib % 2 ? 0 : 4)) & 0xF;
+    int k2 = (u2[nib / 2] >> (nib % 2 ? 0 : 4)) & 0xF;
+    if (k1) {
+      Jac t;
+      jac_add(t, acc, gt.t[k1]);
+      acc = t;
+      any = true;
+    }
+    if (k2) {
+      Jac t;
+      jac_add(t, acc, qtab[k2]);
+      acc = t;
+      any = true;
+    }
+  }
+  if (acc.inf || fe_is_zero(acc.z)) return 0;
+  Fe zinv, zinv2, zinv3, ax, ay;
+  fe_inv(zinv, acc.z);
+  fe_sqr(zinv2, zinv);
+  fe_mul(zinv3, zinv2, zinv);
+  fe_mul(ax, acc.x, zinv2);
+  fe_mul(ay, acc.y, zinv3);
+  fe_to_bytes(out_x, ax);
+  fe_to_bytes(out_y, ay);
+  return 1;
+}
+
+// y^2 = x^3 + 7 check for a candidate affine point (32-byte BE coords).
+int nxk_ec_on_curve(const uint8_t x[32], const uint8_t y[32]) {
+  using namespace nxsecp;
+  Fe fx, fy, lhs, rhs, t;
+  fe_from_bytes(fx, x);
+  fe_from_bytes(fy, y);
+  fe_sqr(lhs, fy);
+  fe_sqr(t, fx);
+  fe_mul(rhs, t, fx);
+  Fe seven = {{7, 0, 0, 0}};
+  fe_add(rhs, rhs, seven);
+  fe_sub(t, lhs, rhs);
+  return fe_is_zero(t) ? 1 : 0;
+}
+
+}  // extern "C"
