@@ -64,11 +64,16 @@ class MempoolEntry:
         return {i.prevout.txid for i in self.tx.vin}
 
 
+DEFAULT_MAX_MEMPOOL_BYTES = 300 * 1024 * 1024  # ref -maxmempool default
+DEFAULT_MEMPOOL_EXPIRY_HOURS = 336  # ref DEFAULT_MEMPOOL_EXPIRY (2 weeks)
+
+
 class TxMemPool:
-    def __init__(self) -> None:
+    def __init__(self, max_size_bytes: int = DEFAULT_MAX_MEMPOOL_BYTES) -> None:
         self._entries: Dict[int, MempoolEntry] = {}
         self._spenders: Dict[OutPoint, int] = {}  # mapNextTx: prevout -> txid
         self._disconnected: List[Transaction] = []
+        self.max_size_bytes = max_size_bytes
 
     # -- queries -----------------------------------------------------------
 

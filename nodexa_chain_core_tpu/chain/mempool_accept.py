@@ -129,6 +129,14 @@ def accept_to_memory_pool(
     )
     pool.add(entry)
 
+    # -maxmempool enforcement: evict lowest descendant-score packages; if
+    # the newcomer itself is evicted the submission fails (ref
+    # validation.cpp LimitMempoolSize -> "mempool full").
+    if not bypass_limits and pool.total_size_bytes() > pool.max_size_bytes:
+        pool.trim_to_size(pool.max_size_bytes)
+        if not pool.contains(tx.txid):
+            raise MempoolAcceptError("mempool-full", "mempool min fee not met")
+
     from .fees import fee_estimator
 
     fee_estimator.process_tx(tx.txid, height, fee, size)

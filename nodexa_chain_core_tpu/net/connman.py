@@ -173,9 +173,13 @@ class ConnMan:
                 continue
             except OSError:
                 return
-            if self.is_banned(addr[0]) or len(self.peers) >= self.MAX_CONNECTIONS:
+            if self.is_banned(addr[0]):
                 sock.close()
                 continue
+            if len(self.peers) >= self.MAX_CONNECTIONS:
+                if not self.attempt_evict_inbound():
+                    sock.close()
+                    continue
             peer = Peer(sock, addr, inbound=True)
             with self._peers_lock:
                 self.peers[peer.id] = peer
@@ -223,6 +227,38 @@ class ConnMan:
         with self._peers_lock:
             self.peers.pop(peer.id, None)
         self.processor.finalize_peer(peer)
+        hook = getattr(self.processor, "peer_disconnected", None)
+        if hook is not None:
+            hook(peer)
+
+    def attempt_evict_inbound(self) -> bool:
+        """Make room for a new inbound connection (ref net.cpp
+        AttemptToEvictConnection).  Protects the longest-connected peers,
+        the best-ping peers, and recent transaction/block providers; among
+        the rest, evicts the youngest connection.  Returns True if a slot
+        was freed."""
+        with self._peers_lock:
+            candidates = [p for p in self.peers.values() if p.inbound]
+        if not candidates:
+            return False
+        protected: set = set()
+        by_ping = sorted(candidates, key=lambda p: getattr(p, "ping_time_ms", 1e9))
+        protected.update(p.id for p in by_ping[:4])
+        by_conn = sorted(candidates, key=lambda p: p.connected_at)
+        protected.update(p.id for p in by_conn[:4])
+        by_tx = sorted(
+            candidates,
+            key=lambda p: -getattr(p, "last_tx_time", 0.0),
+        )
+        protected.update(p.id for p in by_tx[:4])
+        evictable = [p for p in candidates if p.id not in protected]
+        if not evictable:
+            return False
+        victim = max(evictable, key=lambda p: p.connected_at)  # youngest
+        log_printf("evicting inbound peer %d (%s)", victim.id, victim.ip)
+        victim.disconnect = True
+        self._remove_peer(victim)
+        return True
 
     # -- processing --------------------------------------------------------
 
@@ -250,6 +286,9 @@ class ConnMan:
     def _maintenance_loop(self) -> None:
         while not self._stop.is_set():
             self.processor.send_pings()
+            periodic = getattr(self.processor, "periodic", None)
+            if periodic is not None:
+                periodic()
             time.sleep(5)
 
     # -- bans (ref banlist.dat / CBanDB) ----------------------------------

@@ -74,6 +74,10 @@ class NetProcessor:
         self.connman = connman
         self.magic = node.params.message_start
         self._local_nonce = random.getrandbits(64)
+        from .orphanage import TxOrphanage, TxRequestTracker
+
+        self.orphanage = TxOrphanage()
+        self.tx_requests = TxRequestTracker()
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -136,6 +140,9 @@ class NetProcessor:
             MSG_BLOCKTXN: self._on_blocktxn,
             MSG_FEEFILTER: self._on_feefilter,
             MSG_GETASSETDATA: self._on_getassetdata,
+            protocol.MSG_FILTERLOAD: self._on_filterload,
+            protocol.MSG_FILTERADD: self._on_filteradd,
+            protocol.MSG_FILTERCLEAR: self._on_filterclear,
         }.get(command)
         if handler is None:
             log_print(LogFlags.NET, "ignoring unknown message %r", command)
@@ -220,7 +227,11 @@ class NetProcessor:
         for inv in invs:
             if inv.type == INV_TX:
                 peer.known_txs.add(inv.hash)
-                if not self.node.mempool.contains(inv.hash):
+                if (
+                    not self.node.mempool.contains(inv.hash)
+                    and inv.hash not in self.orphanage
+                    and self.tx_requests.should_request(inv.hash, peer.id)
+                ):
                     want.append(inv)
             elif inv.type == INV_BLOCK:
                 peer.known_blocks.add(inv.hash)
@@ -245,6 +256,26 @@ class NetProcessor:
                     peer.send_msg(self.magic, MSG_TX, tx.to_bytes())
                 else:
                     notfound.append(inv)
+            elif inv.type == protocol.INV_FILTERED_BLOCK:
+                # BIP37 SPV serving: merkleblock + the matched transactions
+                # (ref net_processing.cpp MSG_FILTERED_BLOCK handling)
+                filt = getattr(peer, "relay_filter", None)
+                idx = self.node.chainstate.lookup(inv.hash)
+                if filt is None or idx is None or not idx.status & 8:
+                    notfound.append(inv)
+                    continue
+                from ..chain.merkleblock import make_merkle_block
+
+                block = self.node.chainstate.read_block(idx)
+                tree, matched = make_merkle_block(block, filt.matches_tx)
+                w = ByteWriter()
+                block.header.serialize(w, self.node.params.algo_schedule)
+                tree.serialize(w)
+                peer.send_msg(self.magic, protocol.MSG_MERKLEBLOCK, w.getvalue())
+                for tx in block.vtx:
+                    if tx.txid in matched and tx.txid not in peer.known_txs:
+                        peer.known_txs.add(tx.txid)
+                        peer.send_msg(self.magic, MSG_TX, tx.to_bytes())
             elif inv.type in (INV_BLOCK, INV_CMPCT_BLOCK):
                 idx = self.node.chainstate.lookup(inv.hash)
                 if idx is not None and idx.status & 8:  # HAVE_DATA
@@ -386,16 +417,68 @@ class NetProcessor:
     def _on_tx(self, peer, r: ByteReader) -> None:
         tx = Transaction.deserialize(r)
         peer.known_txs.add(tx.txid)
+        peer.last_tx_time = time.time()  # eviction protection signal
+        self.tx_requests.received(tx.txid)
         try:
             accept_to_memory_pool(self.node.chainstate, self.node.mempool, tx)
         except MempoolAcceptError as e:
             if e.code in ("bad-txns-inputs-missingorspent",):
-                return  # orphan; the reference tracks these, we re-request later
+                # park as orphan and pull the missing parents
+                # (ref mapOrphanTransactions, net_processing.cpp:1841+)
+                if self.orphanage.add(tx, peer.id):
+                    self._request_parents(peer, tx)
+                return
             if e.code in ("txn-already-in-mempool", "txn-mempool-conflict"):
                 return
             self.misbehaving(peer, 10, f"bad-tx:{e.code}")
             return
         self.relay_transaction(tx, exclude=peer)
+        self._process_orphans_for(tx.txid)
+
+    def _request_parents(self, peer, tx: Transaction) -> None:
+        mempool = self.node.mempool
+        cs = self.node.chainstate
+
+        def have(prevout) -> bool:
+            return mempool.contains(prevout.txid) or cs.coins.have_coin(prevout)
+
+        want = [
+            Inv(INV_TX, p)
+            for p in self.orphanage.missing_parents(tx, have)
+            if self.tx_requests.should_request(p, peer.id)
+        ]
+        if want:
+            w = ByteWriter()
+            w.vector(want, lambda wr, i: i.serialize(wr))
+            peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
+
+    def _process_orphans_for(self, accepted_txid: int) -> None:
+        """Re-evaluate orphans once a parent lands (ref orphan work set)."""
+        queue = [accepted_txid]
+        while queue:
+            parent = queue.pop()
+            for otx in self.orphanage.children_of(parent):
+                try:
+                    accept_to_memory_pool(
+                        self.node.chainstate, self.node.mempool, otx
+                    )
+                except MempoolAcceptError as e:
+                    if e.code != "bad-txns-inputs-missingorspent":
+                        self.orphanage.erase(otx.txid)
+                    continue
+                self.orphanage.erase(otx.txid)
+                self.relay_transaction(otx)
+                queue.append(otx.txid)
+
+    def periodic(self) -> None:
+        """Maintenance-tick work (called from the connman maintenance
+        thread): orphan expiry + request-tracker sweeps."""
+        self.orphanage.expire()
+        self.tx_requests.expire()
+
+    def peer_disconnected(self, peer) -> None:
+        self.orphanage.erase_for_peer(peer.id)
+        self.tx_requests.forget_peer(peer.id)
 
     def _on_mempool(self, peer, r: ByteReader) -> None:
         invs = [Inv(INV_TX, txid) for txid in self.node.mempool.txids()]
@@ -424,6 +507,36 @@ class NetProcessor:
 
     def _on_sendheaders(self, peer, r: ByteReader) -> None:
         peer.prefer_headers = True
+
+    # -- BIP37 bloom filtering (ref net_processing.cpp FILTERLOAD/-ADD/
+    # -CLEAR handling; src/bloom.h:47) ------------------------------------
+
+    def _on_filterload(self, peer, r: ByteReader) -> None:
+        from ..utils.bloom import BloomFilter
+
+        data = r.var_bytes()
+        hash_funcs = r.u32()
+        tweak = r.u32()
+        flags = r.u8()
+        filt = BloomFilter.from_wire(data, hash_funcs, tweak, flags)
+        if not filt.is_within_size_constraints():
+            self.misbehaving(peer, 100, "oversized-bloom-filter")
+            return
+        peer.relay_filter = filt
+
+    def _on_filteradd(self, peer, r: ByteReader) -> None:
+        item = r.var_bytes()
+        if len(item) > 520:  # MAX_SCRIPT_ELEMENT_SIZE
+            self.misbehaving(peer, 100, "oversized-filteradd")
+            return
+        filt = getattr(peer, "relay_filter", None)
+        if filt is None:
+            self.misbehaving(peer, 100, "filteradd-without-filter")
+            return
+        filt.insert(item)
+
+    def _on_filterclear(self, peer, r: ByteReader) -> None:
+        peer.relay_filter = None
 
     # -- compact blocks (BIP152; ref net_processing.cpp CMPCTBLOCK paths) --
 
@@ -578,12 +691,15 @@ class NetProcessor:
     # -- outbound relay ----------------------------------------------------
 
     def relay_transaction(self, tx, exclude=None) -> None:
-        """ref RelayTransaction -> ForEachNode INV push."""
+        """ref RelayTransaction -> ForEachNode INV push (BIP37-aware)."""
         inv = Inv(INV_TX, tx.txid)
         for peer in self.connman.all_peers():
             if peer is exclude or not peer.handshake_done:
                 continue
             if tx.txid in peer.known_txs:
+                continue
+            filt = getattr(peer, "relay_filter", None)
+            if filt is not None and not filt.matches_tx(tx):
                 continue
             peer.known_txs.add(tx.txid)
             w = ByteWriter()

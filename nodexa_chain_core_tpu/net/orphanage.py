@@ -1,0 +1,153 @@
+"""Orphan transaction pool + transaction request tracking.
+
+Parity: reference ``src/net_processing.cpp`` ``mapOrphanTransactions`` /
+``AddOrphanTx`` / ``EraseOrphansFor`` / ``LimitOrphanTxSize`` and the
+``g_already_asked_for`` re-request throttling.  Orphans (transactions whose
+inputs aren't known yet) are parked bounded-size with expiry, re-evaluated
+when a parent arrives, and erased when their announcing peer disconnects.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..primitives.transaction import Transaction
+
+MAX_ORPHAN_TRANSACTIONS = 100
+ORPHAN_TX_EXPIRE_TIME = 20 * 60
+ORPHAN_TX_EXPIRE_INTERVAL = 5 * 60
+MAX_ORPHAN_TX_SIZE = 100_000  # bytes; oversize orphans are never kept
+
+TX_REQUEST_TIMEOUT = 60.0  # re-request window per announced tx
+
+
+@dataclass
+class _Orphan:
+    tx: Transaction
+    from_peer: int
+    expire_at: float
+
+
+class TxOrphanage:
+    """ref mapOrphanTransactions + mapOrphanTransactionsByPrev."""
+
+    def __init__(self, max_orphans: int = MAX_ORPHAN_TRANSACTIONS):
+        self.max_orphans = max_orphans
+        self._orphans: Dict[int, _Orphan] = {}
+        self._by_prev: Dict[int, Set[int]] = {}  # parent txid -> orphan txids
+        self._next_sweep = 0.0
+
+    def __contains__(self, txid: int) -> bool:
+        return txid in self._orphans
+
+    def size(self) -> int:
+        return len(self._orphans)
+
+    def add(self, tx: Transaction, from_peer: int) -> bool:
+        """Park an orphan; False if rejected (duplicate/oversize)."""
+        txid = tx.txid
+        if txid in self._orphans:
+            return False
+        if len(tx.to_bytes()) > MAX_ORPHAN_TX_SIZE:
+            return False
+        self._orphans[txid] = _Orphan(
+            tx=tx, from_peer=from_peer, expire_at=time.time() + ORPHAN_TX_EXPIRE_TIME
+        )
+        for txin in tx.vin:
+            self._by_prev.setdefault(txin.prevout.txid, set()).add(txid)
+        # bound the pool: evict random orphans (ref LimitOrphanTxSize)
+        while len(self._orphans) > self.max_orphans:
+            victim = random.choice(list(self._orphans))
+            self.erase(victim)
+        return txid in self._orphans
+
+    def erase(self, txid: int) -> None:
+        o = self._orphans.pop(txid, None)
+        if o is None:
+            return
+        for txin in o.tx.vin:
+            s = self._by_prev.get(txin.prevout.txid)
+            if s is not None:
+                s.discard(txid)
+                if not s:
+                    del self._by_prev[txin.prevout.txid]
+
+    def erase_for_peer(self, peer_id: int) -> int:
+        stale = [t for t, o in self._orphans.items() if o.from_peer == peer_id]
+        for t in stale:
+            self.erase(t)
+        return len(stale)
+
+    def children_of(self, parent_txid: int) -> List[Transaction]:
+        return [
+            self._orphans[t].tx
+            for t in sorted(self._by_prev.get(parent_txid, ()))
+            if t in self._orphans
+        ]
+
+    def get(self, txid: int) -> Optional[Transaction]:
+        o = self._orphans.get(txid)
+        return o.tx if o else None
+
+    def missing_parents(self, tx: Transaction, have) -> List[int]:
+        """Parent txids not satisfied by `have(prevout) -> bool`."""
+        out = []
+        for txin in tx.vin:
+            if not have(txin.prevout):
+                out.append(txin.prevout.txid)
+        return sorted(set(out))
+
+    def expire(self, now: Optional[float] = None) -> int:
+        """Sweep expired orphans (rate-limited, ref ORPHAN_TX_EXPIRE_*)."""
+        now = time.time() if now is None else now
+        if now < self._next_sweep:
+            return 0
+        self._next_sweep = now + ORPHAN_TX_EXPIRE_INTERVAL
+        stale = [t for t, o in self._orphans.items() if o.expire_at <= now]
+        for t in stale:
+            self.erase(t)
+        return len(stale)
+
+
+@dataclass
+class _Request:
+    peer_id: int
+    at: float
+
+
+class TxRequestTracker:
+    """One outstanding getdata per announced tx (ref g_already_asked_for).
+
+    A tx announced by several peers is requested from the first; others
+    become fallbacks only after the request times out.
+    """
+
+    def __init__(self, timeout: float = TX_REQUEST_TIMEOUT):
+        self.timeout = timeout
+        self._inflight: Dict[int, _Request] = {}
+
+    def should_request(self, txid: int, peer_id: int,
+                       now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        req = self._inflight.get(txid)
+        if req is not None and now - req.at < self.timeout:
+            return False
+        self._inflight[txid] = _Request(peer_id=peer_id, at=now)
+        return True
+
+    def received(self, txid: int) -> None:
+        self._inflight.pop(txid, None)
+
+    def forget_peer(self, peer_id: int) -> None:
+        stale = [t for t, r in self._inflight.items() if r.peer_id == peer_id]
+        for t in stale:
+            del self._inflight[t]
+
+    def expire(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        stale = [t for t, r in self._inflight.items() if now - r.at >= self.timeout * 4]
+        for t in stale:
+            del self._inflight[t]

@@ -45,6 +45,23 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     node.scheduler.start()
     node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
 
+    # mempool limits: -maxmempool (MB) + periodic expiry sweep
+    from ..chain.mempool import DEFAULT_MEMPOOL_EXPIRY_HOURS
+
+    node.mempool.max_size_bytes = (
+        g_args.get_int("maxmempool", 300) * 1024 * 1024
+    )
+    expiry_s = g_args.get_int("mempoolexpiry", DEFAULT_MEMPOOL_EXPIRY_HOURS) * 3600
+
+    def _sweep_mempool():
+        removed = node.mempool.expire(time.time() - expiry_s)
+        if node.mempool.total_size_bytes() > node.mempool.max_size_bytes:
+            removed += len(node.mempool.trim_to_size(node.mempool.max_size_bytes))
+        if removed:
+            log_printf("mempool sweep: removed %d txs", removed)
+
+    node.scheduler.schedule_every(_sweep_mempool, 600.0)
+
     # KawPow epoch prebuild (ref ethash managed contexts) + optional TPU
     # batched header verification (-tpukawpow builds device DAG slabs).
     if node.params.consensus.kawpow_activation_time < (1 << 62):

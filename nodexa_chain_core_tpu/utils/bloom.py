@@ -49,10 +49,21 @@ class BloomFilter:
             for i in range(self.n_hash_funcs)
         )
 
+    @classmethod
+    def from_wire(cls, data: bytes, n_hash_funcs: int, tweak: int,
+                  flags: int) -> "BloomFilter":
+        """Reconstruct a peer-supplied filter (ref filterload handling)."""
+        f = cls.__new__(cls)
+        f.data = bytearray(data)
+        f.n_hash_funcs = n_hash_funcs
+        f.tweak = tweak
+        f.flags = flags
+        return f
+
     def is_within_size_constraints(self) -> bool:
         return (
-            len(self.data) <= MAX_BLOOM_FILTER_SIZE
-            and self.n_hash_funcs <= MAX_HASH_FUNCS
+            0 < len(self.data) <= MAX_BLOOM_FILTER_SIZE
+            and 0 < self.n_hash_funcs <= MAX_HASH_FUNCS
         )
 
     def matches_tx(self, tx) -> bool:

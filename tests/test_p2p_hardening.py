@@ -1,0 +1,264 @@
+"""P2P hardening: orphan pool, tx request tracking, BIP37 serving,
+mempool limits, inbound eviction.
+
+Reference analogues: mapOrphanTransactions (net_processing.cpp:1841+),
+g_already_asked_for, CBloomFilter/merkleblock serving (bloom.h:47),
+LimitMempoolSize / TrimToSize (txmempool.cpp), AttemptToEvictConnection
+(net.cpp).  The message handlers are driven in-process through stub peers
+(the pattern of the reference's mininode-based p2p_* tests).
+"""
+
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+from nodexa_chain_core_tpu.chain.mempool_accept import (
+    MempoolAcceptError,
+    accept_to_memory_pool,
+)
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.net import protocol
+from nodexa_chain_core_tpu.net.net_processing import NetProcessor
+from nodexa_chain_core_tpu.net.orphanage import TxOrphanage, TxRequestTracker
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.script import Script
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+from nodexa_chain_core_tpu.utils.bloom import BLOOM_UPDATE_ALL, BloomFilter
+
+
+class StubPeer:
+    _next = 1000
+
+    def __init__(self):
+        StubPeer._next += 1
+        self.id = StubPeer._next
+        self.known_txs = set()
+        self.known_blocks = set()
+        self.handshake_done = True
+        self.inbound = True
+        self.misbehavior = 0
+        self.disconnect = False
+        self.ip = "127.0.0.1"
+        self.sent = []  # (command, payload)
+
+    def send_msg(self, magic, command, payload=b""):
+        self.sent.append((command, payload))
+
+
+class StubConnman:
+    def __init__(self, peers=()):
+        self._peers = list(peers)
+
+    def all_peers(self):
+        return self._peers
+
+
+class StubNode:
+    def __init__(self, chainstate, mempool, params):
+        self.chainstate = chainstate
+        self.mempool = mempool
+        self.params = params
+
+
+@pytest.fixture()
+def rig():
+    params = select_params("regtest")
+    cs = ChainState(params)
+    pool = TxMemPool()
+    cs.mempool = pool
+    ks = KeyStore()
+    kid = ks.add_key(0xFEED)
+    spk = p2pkh_script(KeyID(kid))
+    # mine 110 blocks so the first several coinbases are spendable
+    t = params.genesis_time + 60
+    coinbases = []
+    for _ in range(110):
+        blk = BlockAssembler(cs).create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 20)
+        cs.process_new_block(blk)
+        coinbases.append(blk.vtx[0])
+        t += 60
+    node = StubNode(cs, pool, params)
+    peer = StubPeer()
+    proc = NetProcessor(node, StubConnman([peer]))
+    return params, cs, pool, ks, kid, spk, proc, peer, coinbases
+
+
+def _spend(ks, kid, spk, prev_tx, value_out, n=0):
+    tx = Transaction(
+        version=1,
+        vin=[TxIn(prevout=OutPoint(prev_tx.txid, n))],
+        vout=[TxOut(value=value_out, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, Script(prev_tx.vout[n].script_pubkey))
+    return tx
+
+
+def _feed_tx(proc, peer, tx):
+    proc._on_tx(peer, ByteReader(tx.to_bytes()))
+
+
+def test_orphan_parked_then_resolved(rig):
+    params, cs, pool, ks, kid, spk, proc, peer, coinbases = rig
+    parent = _spend(ks, kid, spk, coinbases[0], 4999 * COIN)
+    child = _spend(ks, kid, spk, parent, 4998 * COIN)
+    # child first: parked as orphan, parents requested
+    _feed_tx(proc, peer, child)
+    assert child.txid in proc.orphanage
+    assert not pool.contains(child.txid)
+    getdatas = [c for c, _ in peer.sent if c == protocol.MSG_GETDATA]
+    assert getdatas, "missing-parent getdata not sent"
+    # parent arrives: both land in the mempool, orphan cleared
+    _feed_tx(proc, peer, parent)
+    assert pool.contains(parent.txid)
+    assert pool.contains(child.txid)
+    assert child.txid not in proc.orphanage
+
+
+def test_orphan_peer_disconnect_cleanup(rig):
+    params, cs, pool, ks, kid, spk, proc, peer, coinbases = rig
+    parent = _spend(ks, kid, spk, coinbases[1], 4999 * COIN)
+    child = _spend(ks, kid, spk, parent, 4998 * COIN)
+    _feed_tx(proc, peer, child)
+    assert proc.orphanage.size() == 1
+    proc.peer_disconnected(peer)
+    assert proc.orphanage.size() == 0
+
+
+def test_orphanage_limits_and_expiry():
+    o = TxOrphanage(max_orphans=5)
+    made = []
+    for i in range(8):
+        tx = Transaction(
+            version=1,
+            vin=[TxIn(prevout=OutPoint(i + 1, 0))],
+            vout=[TxOut(value=1, script_pubkey=b"\x51")],
+        )
+        made.append(tx)
+        o.add(tx, from_peer=7)
+    assert o.size() == 5  # bounded
+    # expiry sweep removes everything once past the deadline
+    o._next_sweep = 0
+    assert o.expire(now=time.time() + 21 * 60) == 5
+    assert o.size() == 0
+
+
+def test_tx_request_tracker_dedup():
+    tr = TxRequestTracker(timeout=30)
+    assert tr.should_request(0xAB, peer_id=1, now=100.0)
+    assert not tr.should_request(0xAB, peer_id=2, now=110.0)  # in flight
+    assert tr.should_request(0xAB, peer_id=2, now=140.0)  # timed out
+    tr.received(0xAB)
+    assert tr.should_request(0xAB, peer_id=3, now=141.0)
+
+
+def test_bip37_filterload_and_merkleblock(rig):
+    params, cs, pool, ks, kid, spk, proc, peer, coinbases = rig
+    # SPV peer loads a filter matching the wallet script
+    filt = BloomFilter(10, 0.000001, tweak=5, flags=BLOOM_UPDATE_ALL)
+    filt.insert(kid)  # the pushed keyhash element (BIP37 matches pushes)
+    w = ByteWriter()
+    w.var_bytes(bytes(filt.data))
+    w.u32(filt.n_hash_funcs)
+    w.u32(filt.tweak)
+    w.u8(filt.flags)
+    proc._on_filterload(peer, ByteReader(w.getvalue()))
+    assert getattr(peer, "relay_filter", None) is not None
+
+    # request block 1 as a filtered block
+    blk1_hash = cs.active.at(1).block_hash
+    w = ByteWriter()
+    w.vector(
+        [protocol.Inv(protocol.INV_FILTERED_BLOCK, blk1_hash)],
+        lambda wr, i: i.serialize(wr),
+    )
+    proc._on_getdata(peer, ByteReader(w.getvalue()))
+    cmds = [c for c, _ in peer.sent]
+    assert protocol.MSG_MERKLEBLOCK in cmds
+    assert protocol.MSG_TX in cmds  # the matching coinbase rides along
+
+    # the merkle proof in the reply verifies against the header
+    from nodexa_chain_core_tpu.chain.merkleblock import PartialMerkleTree
+    from nodexa_chain_core_tpu.primitives.block import BlockHeader
+
+    payload = dict(peer.sent)[protocol.MSG_MERKLEBLOCK]
+    r = ByteReader(payload)
+    hdr = BlockHeader.deserialize(r, params.algo_schedule)
+    tree = PartialMerkleTree.deserialize(r)
+    root, matches = tree.extract_matches()
+    assert root == hdr.hash_merkle_root
+    assert matches  # coinbase pays to the filtered script
+
+    # filterclear drops the filter
+    proc._on_filterclear(peer, ByteReader(b""))
+    assert peer.relay_filter is None
+
+
+def test_bip37_relay_respects_filter(rig):
+    params, cs, pool, ks, kid, spk, proc, peer, coinbases = rig
+    other = StubPeer()
+    other.relay_filter = BloomFilter(10, 0.000001, tweak=9)  # matches nothing
+    proc.connman._peers.append(other)
+    tx = _spend(ks, kid, spk, coinbases[2], 4999 * COIN)
+    _feed_tx(proc, peer, tx)
+    assert pool.contains(tx.txid)
+    assert not any(c == protocol.MSG_INV for c, _ in other.sent)
+    # a filter matching the script does get the inv
+    other2 = StubPeer()
+    f2 = BloomFilter(10, 0.000001, tweak=3)
+    f2.insert(kid)
+    other2.relay_filter = f2
+    proc.connman._peers.append(other2)
+    tx2 = _spend(ks, kid, spk, coinbases[3], 4999 * COIN)
+    _feed_tx(proc, peer, tx2)
+    assert any(c == protocol.MSG_INV for c, _ in other2.sent)
+
+
+def test_mempool_full_evicts_lowest_feerate(rig):
+    params, cs, pool, ks, kid, spk, proc, peer, coinbases = rig
+    pool.max_size_bytes = 400  # fits two small txs, not three
+    low = _spend(ks, kid, spk, coinbases[4], 5000 * COIN - 1000)  # low fee
+    accept_to_memory_pool(cs, pool, low)
+    high = _spend(ks, kid, spk, coinbases[5], 4990 * COIN)  # high fee
+    accept_to_memory_pool(cs, pool, high)
+    mid = _spend(ks, kid, spk, coinbases[6], 4999 * COIN)
+    try:
+        accept_to_memory_pool(cs, pool, mid)
+    except MempoolAcceptError as e:
+        assert e.code == "mempool-full"
+    assert pool.total_size_bytes() <= pool.max_size_bytes
+    assert pool.contains(high.txid)  # best feerate survives
+    assert not pool.contains(low.txid)  # worst feerate evicted
+
+
+def test_inbound_eviction_prefers_youngest_unprotected():
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+
+    cm = ConnMan.__new__(ConnMan)  # no sockets; just the eviction logic
+    import threading
+
+    cm._peers_lock = threading.Lock()
+    cm.processor = type("P", (), {"finalize_peer": lambda self, p: None})()
+    peers = {}
+    now = time.time()
+    for i in range(20):
+        p = StubPeer()
+        p.connected_at = now - (1000 - i * 10)  # later i = younger
+        p.ping_time_ms = i * 5.0
+        p.last_tx_time = now - i
+        p.close = lambda: None
+        peers[p.id] = p
+    cm.peers = peers
+    assert cm.attempt_evict_inbound()
+    assert len(cm.peers) == 19
