@@ -89,6 +89,29 @@ class AppendFile:
         self._f.flush()
         os.fsync(self._f.fileno())
 
+    def scan(self):
+        """Yield (pos, payload) for every intact record, in file order.
+
+        A torn tail (crash mid-append) ends the scan cleanly — the
+        -reindex path rebuilds everything recoverable and drops the rest,
+        like the reference's LoadExternalBlockFile."""
+        self._f.seek(0, os.SEEK_END)
+        end = self._f.tell()
+        pos = 0
+        while pos + 8 <= end:
+            self._f.seek(pos)
+            magic = self._f.read(4)
+            if magic != self.magic:
+                return
+            size = int.from_bytes(self._f.read(4), "little")
+            if pos + 8 + size > end:
+                return  # torn record
+            payload = self._f.read(size)
+            if len(payload) != size:
+                return
+            yield pos, payload
+            pos += 8 + size
+
     def close(self) -> None:
         self._f.close()
 

@@ -50,6 +50,7 @@ from ..script.interpreter import (
     verify_script,
 )
 from ..script.script import Script
+from ..utils.logging import LogFlags, log_print
 from .blockindex import BlockIndex, BlockStatus, Chain
 from .blockstore import BlockStore, BlockUndo, TxUndo
 from .checkqueue import CheckQueue, CheckQueueControl
@@ -164,6 +165,106 @@ class ChainState:
         idx.chain_tx_count = idx.tx_count
         self.candidates.add(idx)
         self.activate_best_chain()
+
+    # ------------------------------------------------- startup integrity
+
+    def verify_db(self, check_level: int = 3, check_blocks: int = 6) -> None:
+        """Startup sanity sweep over recent blocks (ref CVerifyDB::VerifyDB,
+        validation.cpp:12564; -checklevel/-checkblocks).
+
+        level 0: block data readable + identity hash matches the index
+        level 1: structural CheckBlock revalidation
+        level 2: undo journal readable/deserializable
+        level 3: coins-view round-trip — disconnect the window in a scratch
+                 view, then reconnect-by-undo-inverse consistency
+        Raises BlockValidationError on any failure.
+        """
+        idx = self.tip()
+        if idx is None:
+            return
+        window: List[BlockIndex] = []
+        while idx is not None and idx.height > 0 and len(window) < check_blocks:
+            window.append(idx)
+            idx = idx.prev
+        scratch = CoinsViewCache(self.coins) if check_level >= 3 else None
+        for i in window:
+            try:
+                block = self.read_block(i)
+            except Exception as e:
+                raise BlockValidationError(
+                    "verifydb-read-failed", f"{u256_hex(i.block_hash)}: {e}"
+                )
+            if block.get_hash() != i.block_hash:
+                raise BlockValidationError(
+                    "verifydb-hash-mismatch", u256_hex(i.block_hash)
+                )
+            if check_level >= 1:
+                # structural only; PoW was proven when the block connected
+                self.check_block(block, check_pow=False)
+            undo = None
+            if check_level >= 2 and i.height > 0:
+                _, upos = self.positions.get(i.block_hash, (-1, -1))
+                if upos < 0:
+                    raise BlockValidationError(
+                        "verifydb-no-undo", u256_hex(i.block_hash)
+                    )
+                try:
+                    undo = self.block_store.read_undo(upos)
+                except Exception as e:
+                    raise BlockValidationError(
+                        "verifydb-undo-read-failed",
+                        f"{u256_hex(i.block_hash)}: {e}",
+                    )
+            if check_level >= 3 and undo is not None:
+                try:
+                    self.disconnect_block(block, i, scratch, touch_assets=False)
+                except Exception as e:
+                    raise BlockValidationError(
+                        "verifydb-disconnect-failed",
+                        f"{u256_hex(i.block_hash)}: {e}",
+                    )
+        log_print(
+            LogFlags.NONE,
+            "verify_db: %d blocks checked at level %d",
+            len(window),
+            check_level,
+        )
+
+    def reindex(self) -> int:
+        """Rebuild the block index and chainstate from the block files
+        (ref -reindex, validation.cpp LoadExternalBlockFile).  The existing
+        in-memory index/coins must be empty (wiped datadir stores).
+        Returns the number of blocks reconnected."""
+        count = 0
+        sched = self.params.algo_schedule
+        from ..core.serialize import ByteReader as _BR
+
+        for pos, payload in self.block_store.blocks.scan():
+            try:
+                block = Block.deserialize(_BR(payload), sched)
+            except Exception:
+                break  # trailing garbage: stop like a torn tail
+            h = block.get_hash()
+            if h in self.block_index:
+                idx = self.block_index[h]
+            else:
+                if block.header.hash_prev and (
+                    block.header.hash_prev not in self.block_index
+                ):
+                    continue  # out-of-order record without its parent
+                idx = self._add_to_block_index(block.header)
+            self.positions[h] = (pos, self.positions.get(h, (-1, -1))[1])
+            idx.status |= BlockStatus.HAVE_DATA
+            idx.tx_count = len(block.vtx)
+            idx.chain_tx_count = (
+                (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
+            )
+            idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+            self.candidates.add(idx)
+            count += 1
+        self.activate_best_chain()
+        self.flush_state_to_disk()
+        return count
 
     # -------------------------------------------------------------- helpers
 
@@ -405,9 +506,14 @@ class ChainState:
         return undo
 
     def disconnect_block(
-        self, block: Block, idx: BlockIndex, view: CoinsViewCache
+        self, block: Block, idx: BlockIndex, view: CoinsViewCache,
+        touch_assets: bool = True,
     ) -> None:
-        """Replay the undo journal backwards (ref DisconnectBlock)."""
+        """Replay the undo journal backwards (ref DisconnectBlock).
+
+        ``touch_assets=False`` runs a coins-only dry run (verify_db's
+        scratch sweep) without mutating the live asset cache.
+        """
         _, upos = self.positions.get(idx.block_hash, (-1, -1))
         if upos < 0:
             raise BlockValidationError("no-undo-data")
@@ -415,8 +521,9 @@ class ChainState:
         if len(undo.vtxundo) != len(block.vtx) - 1:
             raise BlockValidationError("bad-undo-data")
         # roll back asset state (ref DisconnectBlock's CAssetsCache undo)
-        for au in reversed(undo.asset_undos):
-            self.assets.undo_tx(au)
+        if touch_assets:
+            for au in reversed(undo.asset_undos):
+                self.assets.undo_tx(au)
         # remove outputs created by this block, restore spent coins
         for i in range(len(block.vtx) - 1, -1, -1):
             tx = block.vtx[i]

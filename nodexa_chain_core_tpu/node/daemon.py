@@ -36,12 +36,32 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         g_logger.enable_categories(g_args.get("debug", "all"))
     log_printf("Nodexa TPU daemon starting: network=%s datadir=%s", network, datadir)
 
+    # -reindex: wipe the derived stores; the block files stay and feed the
+    # rebuild below (ref init.cpp reindex handling)
+    reindexing = g_args.get_bool("reindex")
+    if reindexing:
+        import shutil
+
+        for sub in ("chainstate", os.path.join("blocks", "index")):
+            shutil.rmtree(os.path.join(datadir, sub), ignore_errors=True)
+        log_printf("-reindex: wiped chainstate and block index")
+
     # Steps 4-7: chainstate load (ref init.cpp:1497)
     node = NodeContext(
         network=network,
         datadir=datadir,
         script_check_threads=g_args.get_int("par", 0),
     )
+    if reindexing:
+        n = node.chainstate.reindex()
+        log_printf("-reindex: reconnected %d blocks, height %d", n,
+                   node.chainstate.tip().height if node.chainstate.tip() else -1)
+
+    # Step 7b: CVerifyDB-style startup sanity sweep (ref validation.cpp:12564)
+    check_blocks = g_args.get_int("checkblocks", 6)
+    check_level = g_args.get_int("checklevel", 3)
+    if check_blocks > 0:
+        node.chainstate.verify_db(check_level=check_level, check_blocks=check_blocks)
     node.scheduler.start()
     node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
 
