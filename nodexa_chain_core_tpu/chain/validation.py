@@ -154,10 +154,22 @@ class ChainState:
                 ):
                     self.candidates.add(idx)
             return
-        # fresh datadir: install genesis
+        # fresh datadir: install genesis.  After a -reindex wipe the block
+        # file survives with genesis already at offset 0 — reuse it instead
+        # of appending a duplicate record.
         genesis = self.params.genesis
         idx = self._add_to_block_index(genesis.header)
-        pos = self.block_store.write_block(genesis, self.params.algo_schedule)
+        pos = -1
+        try:
+            existing = self.block_store.read_block(0, self.params.algo_schedule)
+            if existing.get_hash() == idx.block_hash:
+                pos = 0
+        except Exception:
+            pass
+        if pos < 0:
+            pos = self.block_store.write_block(
+                genesis, self.params.algo_schedule
+            )
         self.positions[idx.block_hash] = (pos, -1)
         idx.status |= BlockStatus.HAVE_DATA
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
@@ -217,7 +229,9 @@ class ChainState:
                     )
             if check_level >= 3 and undo is not None:
                 try:
-                    self.disconnect_block(block, i, scratch, touch_assets=False)
+                    self.disconnect_block(
+                        block, i, scratch, touch_assets=False, undo=undo
+                    )
                 except Exception as e:
                     raise BlockValidationError(
                         "verifydb-disconnect-failed",
@@ -236,23 +250,16 @@ class ChainState:
         in-memory index/coins must be empty (wiped datadir stores).
         Returns the number of blocks reconnected."""
         count = 0
+        dropped = 0
         sched = self.params.algo_schedule
         from ..core.serialize import ByteReader as _BR
 
-        for pos, payload in self.block_store.blocks.scan():
-            try:
-                block = Block.deserialize(_BR(payload), sched)
-            except Exception:
-                break  # trailing garbage: stop like a torn tail
+        def _install(block: Block, pos: int) -> None:
+            nonlocal count
             h = block.get_hash()
-            if h in self.block_index:
-                idx = self.block_index[h]
-            else:
-                if block.header.hash_prev and (
-                    block.header.hash_prev not in self.block_index
-                ):
-                    continue  # out-of-order record without its parent
-                idx = self._add_to_block_index(block.header)
+            idx = self.block_index.get(h) or self._add_to_block_index(
+                block.header
+            )
             self.positions[h] = (pos, self.positions.get(h, (-1, -1))[1])
             idx.status |= BlockStatus.HAVE_DATA
             idx.tx_count = len(block.vtx)
@@ -262,6 +269,36 @@ class ChainState:
             idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
             self.candidates.add(idx)
             count += 1
+
+        # headers-first sync can store a child before its parent, so records
+        # whose parent isn't indexed yet are parked and retried once the
+        # parent lands (ref LoadExternalBlockFile's mapBlocksUnknownParent)
+        pending: Dict[int, List[Tuple[int, Block]]] = {}
+        for pos, payload in self.block_store.blocks.scan():
+            try:
+                block = Block.deserialize(_BR(payload), sched)
+            except Exception:
+                dropped += 1  # framing intact but payload corrupt: skip it
+                continue
+            prev_h = block.header.hash_prev
+            if prev_h and prev_h not in self.block_index:
+                pending.setdefault(prev_h, []).append((pos, block))
+                continue
+            _install(block, pos)
+            ready = [block.get_hash()]
+            while ready:
+                parent = ready.pop()
+                for cpos, child in pending.pop(parent, ()):  # retry children
+                    _install(child, cpos)
+                    ready.append(child.get_hash())
+        orphaned = sum(len(v) for v in pending.values())
+        if dropped or orphaned:
+            log_print(
+                LogFlags.NONE,
+                "reindex: dropped %d corrupt and %d parentless records",
+                dropped,
+                orphaned,
+            )
         self.activate_best_chain()
         self.flush_state_to_disk()
         return count
@@ -507,17 +544,19 @@ class ChainState:
 
     def disconnect_block(
         self, block: Block, idx: BlockIndex, view: CoinsViewCache,
-        touch_assets: bool = True,
+        touch_assets: bool = True, undo: Optional[BlockUndo] = None,
     ) -> None:
         """Replay the undo journal backwards (ref DisconnectBlock).
 
         ``touch_assets=False`` runs a coins-only dry run (verify_db's
-        scratch sweep) without mutating the live asset cache.
+        scratch sweep) without mutating the live asset cache; a pre-read
+        ``undo`` skips the disk fetch.
         """
-        _, upos = self.positions.get(idx.block_hash, (-1, -1))
-        if upos < 0:
-            raise BlockValidationError("no-undo-data")
-        undo = self.block_store.read_undo(upos)
+        if undo is None:
+            _, upos = self.positions.get(idx.block_hash, (-1, -1))
+            if upos < 0:
+                raise BlockValidationError("no-undo-data")
+            undo = self.block_store.read_undo(upos)
         if len(undo.vtxundo) != len(block.vtx) - 1:
             raise BlockValidationError("bad-undo-data")
         # roll back asset state (ref DisconnectBlock's CAssetsCache undo)

@@ -67,6 +67,10 @@ class NodeContext:
         self.rewards.flush()
         main_signals.unregister(self.message_store)
         main_signals.unregister(self.rewards)
+        for attr in ("pub_server", "shell_notifier"):
+            obj = getattr(self, attr, None)
+            if obj is not None:
+                obj.close()
         if self.wallet is not None:
             self.wallet.flush()
         self.chainstate.close()

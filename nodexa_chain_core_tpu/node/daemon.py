@@ -82,6 +82,18 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     node.scheduler.schedule_every(_sweep_mempool, 600.0)
 
+    # External observability: pub socket + shell hooks (ref src/zmq/,
+    # -blocknotify)
+    pub_port = g_args.get_int("pubport", -1)
+    if pub_port >= 0:
+        from .notifications import PubServer
+
+        node.pub_server = PubServer(pub_port, schedule=node.params.algo_schedule)
+    if g_args.is_set("blocknotify"):
+        from .notifications import ShellNotifier
+
+        node.shell_notifier = ShellNotifier(g_args.get("blocknotify"))
+
     # KawPow epoch prebuild (ref ethash managed contexts) + optional TPU
     # batched header verification (-tpukawpow builds device DAG slabs).
     if node.params.consensus.kawpow_activation_time < (1 << 62):

@@ -1,0 +1,186 @@
+"""External notification publishers (ref src/zmq/zmqpublishnotifier.h:35-59)
+and -blocknotify shell hooks (ref feature_notifications.py).
+
+The reference publishes hashblock/hashtx/rawblock/rawtx/newassetmessage on
+ZeroMQ PUB sockets.  libzmq isn't part of this framework's dependency
+budget, so the same contract rides a minimal localhost TCP pub socket with
+ZMQ-compatible message CONTENT: every message is [topic, payload, 4-byte LE
+sequence], framed as length-prefixed parts.  A subscriber connects and
+streams; per-topic filtering happens client-side
+(:class:`PubSubscriber`).
+
+Wire framing per message:  u8 part-count, then per part u32 LE length +
+bytes.  Parts are exactly the reference's three ZMQ frames.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from ..core.serialize import ByteWriter
+from ..utils.logging import log_printf
+from .events import ValidationInterface, main_signals
+
+TOPICS = ("hashblock", "hashtx", "rawblock", "rawtx", "newassetmessage")
+
+
+def _hash_bytes(h: int) -> bytes:
+    """uint256 -> the reference's ZMQ byte order (display/big-endian)."""
+    return h.to_bytes(32, "big")
+
+
+class PubServer(ValidationInterface):
+    """Localhost pub socket fed by the validation signal bus."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 schedule=None):
+        self.schedule = schedule
+        self._seq: Dict[str, int] = {t: 0 for t in TOPICS}
+        self._subs: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(8)
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, name="pubsrv", daemon=True)
+        t.start()
+        main_signals.register(self)
+        log_printf("notification publisher on %s:%d", host, self.port)
+
+    # -- socket plumbing ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._listener.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._subs.append(sock)
+
+    def _publish(self, topic: str, payload: bytes) -> None:
+        seq = self._seq[topic]
+        self._seq[topic] = (seq + 1) & 0xFFFFFFFF
+        parts = [topic.encode(), payload, struct.pack("<I", seq)]
+        msg = bytes([len(parts)]) + b"".join(
+            struct.pack("<I", len(p)) + p for p in parts
+        )
+        with self._lock:
+            dead = []
+            for sock in self._subs:
+                try:
+                    sock.sendall(msg)
+                except OSError:
+                    dead.append(sock)
+            for sock in dead:
+                self._subs.remove(sock)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        main_signals.unregister(self)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._subs:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._subs.clear()
+
+    # -- validation interface ---------------------------------------------
+
+    def block_connected(self, block, index, txs_conflicted) -> None:
+        self._publish("hashblock", _hash_bytes(index.block_hash))
+        w = ByteWriter()
+        block.serialize(w, self.schedule)
+        self._publish("rawblock", w.getvalue())
+        for tx in block.vtx:
+            self._publish("hashtx", _hash_bytes(tx.txid))
+            self._publish("rawtx", tx.to_bytes())
+
+    def transaction_added_to_mempool(self, tx) -> None:
+        self._publish("hashtx", _hash_bytes(tx.txid))
+        self._publish("rawtx", tx.to_bytes())
+
+    def new_asset_message(self, message) -> None:
+        try:
+            payload = repr(message).encode()
+        except Exception:
+            payload = b""
+        self._publish("newassetmessage", payload)
+
+
+class PubSubscriber:
+    """Client-side reader for :class:`PubServer` streams (tests, tools)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1", timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise EOFError("publisher closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def recv(self):
+        """-> (topic: str, payload: bytes, sequence: int)"""
+        (nparts,) = self._read_exact(1)
+        parts = []
+        for _ in range(nparts):
+            (ln,) = struct.unpack("<I", self._read_exact(4))
+            parts.append(self._read_exact(ln))
+        topic = parts[0].decode()
+        payload = parts[1] if len(parts) > 1 else b""
+        seq = struct.unpack("<I", parts[2])[0] if len(parts) > 2 else 0
+        return topic, payload, seq
+
+    def recv_topic(self, topic: str, max_messages: int = 1000):
+        for _ in range(max_messages):
+            t, payload, seq = self.recv()
+            if t == topic:
+                return payload, seq
+        raise TimeoutError(f"no {topic} message in {max_messages} messages")
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class ShellNotifier(ValidationInterface):
+    """-blocknotify / -walletnotify shell hooks (ref init.cpp BlockNotify
+    callbacks; %s substituted with the block hash)."""
+
+    def __init__(self, blocknotify: Optional[str] = None):
+        self.blocknotify = blocknotify
+        main_signals.register(self)
+
+    def updated_block_tip(self, new_tip, fork_tip, initial_download) -> None:
+        if not self.blocknotify or initial_download:
+            return
+        cmd = self.blocknotify.replace("%s", f"{new_tip.block_hash:064x}")
+        try:
+            subprocess.Popen(cmd, shell=True)
+        except OSError as e:
+            log_printf("-blocknotify failed: %s", e)
+
+    def close(self) -> None:
+        main_signals.unregister(self)

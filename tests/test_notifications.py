@@ -1,0 +1,106 @@
+"""Notification publishers (interface_zmq-style coverage).
+
+A PubServer subscribed to the validation bus must stream
+hashblock/rawblock/hashtx/rawtx with monotonic per-topic sequence numbers
+to connected subscribers; -blocknotify must run the hook with the block
+hash substituted.
+"""
+
+import os
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.core.serialize import ByteReader
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.node.notifications import (
+    PubServer,
+    PubSubscriber,
+    ShellNotifier,
+)
+from nodexa_chain_core_tpu.primitives.block import Block
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def chain():
+    params = select_params("regtest")
+    cs = ChainState(params)
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0x9072)))
+    return params, cs, spk
+
+
+def _mine(cs, params, spk, t):
+    blk = BlockAssembler(cs).create_new_block(spk.raw, ntime=t)
+    assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 20)
+    cs.process_new_block(blk)
+    return blk
+
+
+def test_pub_server_streams_block_topics(chain):
+    params, cs, spk = chain
+    srv = PubServer(0, schedule=params.algo_schedule)
+    try:
+        sub = PubSubscriber(srv.port)
+        time.sleep(0.2)  # subscriber registered by the accept loop
+        blk = _mine(cs, params, spk, params.genesis_time + 60)
+
+        payload, seq = sub.recv_topic("hashblock")
+        assert payload == blk.get_hash().to_bytes(32, "big")
+        assert seq == 0
+
+        payload, _ = sub.recv_topic("rawblock")
+        parsed = Block.deserialize(ByteReader(payload), params.algo_schedule)
+        assert parsed.get_hash() == blk.get_hash()
+
+        payload, _ = sub.recv_topic("hashtx")
+        assert payload == blk.vtx[0].txid.to_bytes(32, "big")
+        payload, _ = sub.recv_topic("rawtx")
+        assert payload == blk.vtx[0].to_bytes()
+
+        # second block: hashblock sequence increments
+        blk2 = _mine(cs, params, spk, params.genesis_time + 120)
+        payload, seq = sub.recv_topic("hashblock")
+        assert payload == blk2.get_hash().to_bytes(32, "big")
+        assert seq == 1
+        sub.close()
+    finally:
+        srv.close()
+
+
+def test_pub_server_survives_dead_subscriber(chain):
+    params, cs, spk = chain
+    srv = PubServer(0, schedule=params.algo_schedule)
+    try:
+        sub = PubSubscriber(srv.port)
+        time.sleep(0.2)
+        sub.close()
+        _mine(cs, params, spk, params.genesis_time + 60)  # must not raise
+        sub2 = PubSubscriber(srv.port)
+        time.sleep(0.2)
+        blk = _mine(cs, params, spk, params.genesis_time + 120)
+        payload, _ = sub2.recv_topic("hashblock")
+        assert payload == blk.get_hash().to_bytes(32, "big")
+        sub2.close()
+    finally:
+        srv.close()
+
+
+def test_blocknotify_hook_runs(chain, tmp_path):
+    params, cs, spk = chain
+    out = tmp_path / "notify.txt"
+    notifier = ShellNotifier(blocknotify=f"echo %s >> {out}")
+    try:
+        blk = _mine(cs, params, spk, params.genesis_time + 60)
+        deadline = time.time() + 5
+        while time.time() < deadline and not out.exists():
+            time.sleep(0.05)
+        assert out.exists()
+        content = out.read_text().strip()
+        assert content == f"{blk.get_hash():064x}"
+    finally:
+        notifier.close()
