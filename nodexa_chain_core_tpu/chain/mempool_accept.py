@@ -67,8 +67,22 @@ def accept_to_memory_pool(
 
     if pool.contains(tx.txid):
         raise MempoolAcceptError("txn-already-in-mempool")
+    # BIP125 replace-by-fee (ref policy/rbf.cpp + AcceptToMemoryPoolWorker's
+    # conflict handling): a conflicting in-pool tx may be replaced when it
+    # signals replaceability and the newcomer pays strictly more.
+    conflicts: set = set()
     if pool.has_conflict(tx):
-        raise MempoolAcceptError("txn-mempool-conflict")
+        for txin in tx.vin:
+            spender = pool.spender_of(txin.prevout)
+            if spender is not None:
+                conflicts.add(spender)
+        for c in list(conflicts):
+            entry = pool.get(c)
+            if not any(i.sequence < 0xFFFFFFFE for i in entry.tx.vin):
+                raise MempoolAcceptError("txn-mempool-conflict")
+            conflicts |= pool.calculate_descendants(c)
+        if len(conflicts) > 100:
+            raise MempoolAcceptError("too-many-replacements")
 
     # input view: chain coins + in-pool parents (ref CCoinsViewMemPool)
     view = CoinsViewCache(CoinsViewMemPool(chainstate.coins, pool))
@@ -87,6 +101,31 @@ def accept_to_memory_pool(
     size = len(tx.to_bytes())
     if not bypass_limits and fee < MIN_RELAY_FEE.fee_for(size):
         raise MempoolAcceptError("min relay fee not met", f"{fee} < {MIN_RELAY_FEE.fee_for(size)}")
+
+    if conflicts:
+        # BIP125 rule 6: the newcomer's feerate must beat every directly
+        # conflicting tx, or a huge low-feerate tx could evict a good one
+        new_rate = fee / size
+        for c in conflicts:
+            e = pool.get(c)
+            if new_rate <= e.fee / max(e.size, 1):
+                raise MempoolAcceptError(
+                    "insufficient-fee",
+                    "replacement feerate below replaced transaction",
+                )
+        # BIP125 rules 3/4: pay more than everything replaced, plus the
+        # incremental relay fee for the newcomer's own bandwidth
+        old_fees = sum(pool.get(c).fee for c in conflicts)
+        if fee < old_fees + MIN_RELAY_FEE.fee_for(size):
+            raise MempoolAcceptError(
+                "insufficient-fee",
+                f"replacement pays {fee}, needs > {old_fees} + relay",
+            )
+        # a replacement may not depend on an unconfirmed tx it conflicts
+        # with (cheap stand-in for rule 2's new-unconfirmed-inputs check)
+        for txin in tx.vin:
+            if txin.prevout.txid in conflicts:
+                raise MempoolAcceptError("replacement-spends-conflict")
 
     # full script verification (ref CheckInputs with STANDARD flags)
     for i, txin in enumerate(tx.vin):
@@ -124,6 +163,9 @@ def accept_to_memory_pool(
         except AssetError as e:
             raise MempoolAcceptError("bad-txns-assets", str(e))
 
+    for c in conflicts:
+        pool.remove(c, "replaced")
+
     entry = MempoolEntry(
         tx=tx, fee=fee, time=_time.time(), height=height, sigops=sigops // 4
     )
@@ -145,6 +187,56 @@ def accept_to_memory_pool(
 
     main_signals.transaction_added_to_mempool(tx)
     return entry
+
+
+MEMPOOL_DAT_VERSION = 1
+
+
+def dump_mempool(pool: TxMemPool, path: str) -> int:
+    """Persist the pool to mempool.dat (ref validation.cpp DumpMempool;
+    tested by the reference's mempool_persist.py)."""
+    import json as _json
+    import os as _os
+
+    entries = []
+    for txid in pool.txids():
+        e = pool.get(txid)
+        entries.append(
+            {"hex": e.tx.to_bytes().hex(), "time": e.time, "fee": e.fee}
+        )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        _json.dump({"version": MEMPOOL_DAT_VERSION, "tx": entries}, f)
+    _os.replace(tmp, path)
+    return len(entries)
+
+
+def load_mempool(chainstate: ChainState, pool: TxMemPool, path: str) -> int:
+    """Re-accept persisted transactions on boot (ref LoadMempool): entries
+    are revalidated against the current chain, stale ones dropped."""
+    import json as _json
+    import os as _os
+
+    if not _os.path.exists(path):
+        return 0
+    try:
+        with open(path) as f:
+            data = _json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if not isinstance(data, dict):
+        return 0
+    count = 0
+    for item in data.get("tx", []):
+        try:
+            tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
+            entry = accept_to_memory_pool(chainstate, pool, tx)
+            entry.time = item.get("time", entry.time)
+            count += 1
+        except (MempoolAcceptError, TxValidationError, ValueError,
+                KeyError, TypeError, AttributeError, IndexError):
+            continue
+    return count
 
 
 def resubmit_disconnected(chainstate: ChainState, pool: TxMemPool) -> None:

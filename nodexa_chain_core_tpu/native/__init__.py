@@ -134,6 +134,15 @@ def load() -> ctypes.CDLL:
         lib.nxk_ec_on_curve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.nxk_ec_on_curve.restype = ctypes.c_int
 
+        lib.nxk_aes256cbc_encrypt.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, u8p,
+        ]
+        lib.nxk_aes256cbc_encrypt.restype = ctypes.c_int
+        lib.nxk_aes256cbc_decrypt.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, u8p,
+        ]
+        lib.nxk_aes256cbc_decrypt.restype = ctypes.c_int
+
         _lib = lib
         return lib
 

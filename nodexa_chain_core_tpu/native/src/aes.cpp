@@ -1,0 +1,185 @@
+// Clean-room AES-256-CBC for wallet key encryption.
+//
+// The reference encrypts wallet keys with AES-256-CBC through OpenSSL
+// (ref src/wallet/crypter.{h,cpp} CCrypter / src/crypto/aes.h ctaes).
+// Standard FIPS-197 implementation: 14 rounds, 8-word key schedule,
+// byte-oriented (the forward S-box is shared with the X16R AES-based
+// primitives; the inverse box is derived from it).
+
+#include "x16r_core.hpp"
+
+#include <cstring>
+
+namespace nxx {
+const uint8_t* aes_sbox();  // x16r_group2.cpp
+}
+
+namespace {
+
+using nxx::aes_sbox;
+
+struct InvSbox {
+  uint8_t inv[256];
+  InvSbox() {
+    for (int i = 0; i < 256; ++i) inv[aes_sbox()[i]] = (uint8_t)i;
+  }
+};
+
+const uint8_t* inv_sbox() {
+  static const InvSbox k;
+  return k.inv;
+}
+
+inline uint8_t xtime(uint8_t a) {
+  return (uint8_t)((a << 1) ^ ((a & 0x80) ? 0x1B : 0));
+}
+
+inline uint8_t gmul(uint8_t a, uint8_t b) {
+  uint8_t r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    a = xtime(a);
+    b >>= 1;
+  }
+  return r;
+}
+
+// 15 round keys x 16 bytes
+struct Aes256Key {
+  uint8_t rk[15][16];
+};
+
+void key_expand(Aes256Key& k, const uint8_t key[32]) {
+  uint8_t w[60][4];
+  std::memcpy(w, key, 32);
+  uint8_t rcon = 1;
+  for (int i = 8; i < 60; ++i) {
+    uint8_t t[4];
+    std::memcpy(t, w[i - 1], 4);
+    if (i % 8 == 0) {
+      uint8_t tmp = t[0];
+      t[0] = (uint8_t)(aes_sbox()[t[1]] ^ rcon);
+      t[1] = aes_sbox()[t[2]];
+      t[2] = aes_sbox()[t[3]];
+      t[3] = aes_sbox()[tmp];
+      rcon = xtime(rcon);
+    } else if (i % 8 == 4) {
+      for (int j = 0; j < 4; ++j) t[j] = aes_sbox()[t[j]];
+    }
+    for (int j = 0; j < 4; ++j) w[i][j] = (uint8_t)(w[i - 8][j] ^ t[j]);
+  }
+  std::memcpy(k.rk, w, sizeof k.rk);
+}
+
+inline void add_round_key(uint8_t s[16], const uint8_t rk[16]) {
+  for (int i = 0; i < 16; ++i) s[i] ^= rk[i];
+}
+
+void encrypt_block(const Aes256Key& k, uint8_t s[16]) {
+  add_round_key(s, k.rk[0]);
+  for (int r = 1; r <= 14; ++r) {
+    // SubBytes
+    for (int i = 0; i < 16; ++i) s[i] = aes_sbox()[s[i]];
+    // ShiftRows (state is column-major: s[4c + r])
+    uint8_t t[16];
+    for (int c = 0; c < 4; ++c)
+      for (int row = 0; row < 4; ++row)
+        t[4 * c + row] = s[4 * ((c + row) & 3) + row];
+    std::memcpy(s, t, 16);
+    if (r < 14) {
+      // MixColumns
+      for (int c = 0; c < 4; ++c) {
+        uint8_t* col = s + 4 * c;
+        uint8_t a0 = col[0], a1 = col[1], a2 = col[2], a3 = col[3];
+        col[0] = (uint8_t)(xtime(a0) ^ xtime(a1) ^ a1 ^ a2 ^ a3);
+        col[1] = (uint8_t)(a0 ^ xtime(a1) ^ xtime(a2) ^ a2 ^ a3);
+        col[2] = (uint8_t)(a0 ^ a1 ^ xtime(a2) ^ xtime(a3) ^ a3);
+        col[3] = (uint8_t)(xtime(a0) ^ a0 ^ a1 ^ a2 ^ xtime(a3));
+      }
+    }
+    add_round_key(s, k.rk[r]);
+  }
+}
+
+void decrypt_block(const Aes256Key& k, uint8_t s[16]) {
+  add_round_key(s, k.rk[14]);
+  for (int r = 13; r >= 0; --r) {
+    // InvShiftRows
+    uint8_t t[16];
+    for (int c = 0; c < 4; ++c)
+      for (int row = 0; row < 4; ++row)
+        t[4 * c + row] = s[4 * ((c - row) & 3) + row];
+    std::memcpy(s, t, 16);
+    // InvSubBytes
+    for (int i = 0; i < 16; ++i) s[i] = inv_sbox()[s[i]];
+    add_round_key(s, k.rk[r]);
+    if (r > 0) {
+      // InvMixColumns
+      for (int c = 0; c < 4; ++c) {
+        uint8_t* col = s + 4 * c;
+        uint8_t a0 = col[0], a1 = col[1], a2 = col[2], a3 = col[3];
+        col[0] = (uint8_t)(gmul(a0, 14) ^ gmul(a1, 11) ^ gmul(a2, 13) ^
+                           gmul(a3, 9));
+        col[1] = (uint8_t)(gmul(a0, 9) ^ gmul(a1, 14) ^ gmul(a2, 11) ^
+                           gmul(a3, 13));
+        col[2] = (uint8_t)(gmul(a0, 13) ^ gmul(a1, 9) ^ gmul(a2, 14) ^
+                           gmul(a3, 11));
+        col[3] = (uint8_t)(gmul(a0, 11) ^ gmul(a1, 13) ^ gmul(a2, 9) ^
+                           gmul(a3, 14));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// CBC with PKCS#7 padding.  out must hold len + 16 bytes; returns the
+// ciphertext length.
+int nxk_aes256cbc_encrypt(const uint8_t key[32], const uint8_t iv[16],
+                          const uint8_t* in, int len, uint8_t* out) {
+  Aes256Key k;
+  key_expand(k, key);
+  int pad = 16 - (len % 16);
+  int total = len + pad;
+  uint8_t prev[16];
+  std::memcpy(prev, iv, 16);
+  for (int off = 0; off < total; off += 16) {
+    uint8_t blk[16];
+    for (int i = 0; i < 16; ++i) {
+      uint8_t b = (off + i < len) ? in[off + i] : (uint8_t)pad;
+      blk[i] = (uint8_t)(b ^ prev[i]);
+    }
+    encrypt_block(k, blk);
+    std::memcpy(out + off, blk, 16);
+    std::memcpy(prev, blk, 16);
+  }
+  return total;
+}
+
+// Returns the plaintext length, or -1 on bad padding.
+int nxk_aes256cbc_decrypt(const uint8_t key[32], const uint8_t iv[16],
+                          const uint8_t* in, int len, uint8_t* out) {
+  if (len <= 0 || len % 16) return -1;
+  Aes256Key k;
+  key_expand(k, key);
+  uint8_t prev[16];
+  std::memcpy(prev, iv, 16);
+  for (int off = 0; off < len; off += 16) {
+    uint8_t blk[16];
+    std::memcpy(blk, in + off, 16);
+    uint8_t cipher[16];
+    std::memcpy(cipher, blk, 16);
+    decrypt_block(k, blk);
+    for (int i = 0; i < 16; ++i) out[off + i] = (uint8_t)(blk[i] ^ prev[i]);
+    std::memcpy(prev, cipher, 16);
+  }
+  int pad = out[len - 1];
+  if (pad < 1 || pad > 16) return -1;
+  for (int i = 0; i < pad; ++i)
+    if (out[len - 1 - i] != pad) return -1;
+  return len - pad;
+}
+
+}  // extern "C"

@@ -63,6 +63,14 @@ class NodeContext:
         # can fire)
         if self.connman is not None:
             self.connman.stop()
+        dat = getattr(self, "mempool_dat_path", None)
+        if dat is not None:
+            from ..chain.mempool_accept import dump_mempool
+
+            try:
+                dump_mempool(self.mempool, dat)
+            except OSError:
+                pass  # a failed dump must not abort the rest of shutdown
         self.message_store.flush()
         self.rewards.flush()
         main_signals.unregister(self.message_store)

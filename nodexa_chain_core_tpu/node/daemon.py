@@ -82,6 +82,15 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     node.scheduler.schedule_every(_sweep_mempool, 600.0)
 
+    # mempool.dat: reload surviving txs (ref LoadMempool, -persistmempool)
+    if g_args.get_bool("persistmempool", True):
+        from ..chain.mempool_accept import load_mempool
+
+        node.mempool_dat_path = os.path.join(datadir, "mempool.dat")
+        n = load_mempool(node.chainstate, node.mempool, node.mempool_dat_path)
+        if n:
+            log_printf("loaded %d transactions from mempool.dat", n)
+
     # External observability: pub socket + shell hooks (ref src/zmq/,
     # -blocknotify)
     pub_port = g_args.get_int("pubport", -1)

@@ -205,6 +205,57 @@ def rescanblockchain(node, params: List[Any]):
     return {"found": found}
 
 
+def encryptwallet(node, params: List[Any]):
+    """ref rpcwallet encryptwallet."""
+    try:
+        _wallet(node).encrypt_wallet(str(params[0]))
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return "wallet encrypted; the HD seed is now stored encrypted"
+
+
+def walletpassphrase(node, params: List[Any]):
+    timeout = float(params[1]) if len(params) > 1 else 60.0
+    try:
+        _wallet(node).unlock(str(params[0]), timeout=timeout)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return None
+
+
+def walletlock(node, params: List[Any]):
+    try:
+        _wallet(node).lock_wallet()
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return None
+
+
+def walletpassphrasechange(node, params: List[Any]):
+    try:
+        _wallet(node).change_passphrase(str(params[0]), str(params[1]))
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return None
+
+
+def bumpfee(node, params: List[Any]):
+    """ref rpcwallet bumpfee (feebumper.h)."""
+    from ..core.uint256 import u256_from_hex
+
+    try:
+        new_txid, old_fee, new_fee = _wallet(node).bump_fee(
+            u256_from_hex(str(params[0]))
+        )
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return {
+        "txid": u256_hex(new_txid),
+        "origfee": old_fee / COIN,
+        "fee": new_fee / COIN,
+    }
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("getnewaddress", getnewaddress, ["label"]),
@@ -222,5 +273,11 @@ def register(table: RPCTable) -> None:
         ("signmessage", signmessage, ["address", "message"]),
         ("verifymessage", verifymessage, ["address", "signature", "message"]),
         ("rescanblockchain", rescanblockchain, []),
+        ("encryptwallet", encryptwallet, ["passphrase"]),
+        ("walletpassphrase", walletpassphrase, ["passphrase", "timeout"]),
+        ("walletlock", walletlock, []),
+        ("walletpassphrasechange", walletpassphrasechange,
+         ["oldpassphrase", "newpassphrase"]),
+        ("bumpfee", bumpfee, ["txid"]),
     ]:
         table.register("wallet", name, fn, args)

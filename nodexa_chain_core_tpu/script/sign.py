@@ -47,6 +47,22 @@ class KeyStore:
         self._pubs[kid] = pub
         return kid
 
+    def add_watch_pub(self, pub: bytes) -> bytes:
+        """Public key without its secret (locked-wallet watch data)."""
+        kid = hash160(pub)
+        self._pubs[kid] = pub
+        return kid
+
+    def have_key(self, kid: bytes) -> bool:
+        """Known key id — with or without the secret (ref HaveKey)."""
+        return kid in self._pubs
+
+    def pubs(self) -> Dict[bytes, bytes]:
+        return dict(self._pubs)
+
+    def wipe_privkeys(self) -> None:
+        self._keys.clear()
+
     def add_script(self, script: Script) -> bytes:
         sid = hash160(script.raw)
         self._scripts[sid] = script

@@ -67,8 +67,107 @@ class Wallet(ValidationInterface):
         self.master: Optional[ExtKey] = None
         self.next_index = {0: 0, 1: 0}  # external / internal chains
         self.key_meta: Dict[bytes, Tuple[int, int]] = {}  # keyid -> (chain, idx)
+        self.key_pubs: Dict[bytes, bytes] = {}  # keyid -> pubkey (watch data)
         self.wtx: Dict[int, WalletTx] = {}
         self.address_book: Dict[str, str] = {}
+        # encryption state (ref CWallet::{fUseCrypto,mapMasterKeys}, crypter.h)
+        self.master_key_record = None  # crypter.MasterKey when encrypted
+        self.enc_mnemonic: Optional[bytes] = None
+        self._unlocked_until: float = 0.0
+
+    # ---------------------------------------------------------- encryption
+
+    @property
+    def is_crypted(self) -> bool:
+        return self.master_key_record is not None
+
+    def is_locked(self) -> bool:
+        if not self.is_crypted:
+            return False
+        if self.master is None:
+            return True
+        if self._unlocked_until and time.time() > self._unlocked_until:
+            self.lock_wallet()
+            return True
+        return False
+
+    def _require_unlocked(self) -> None:
+        if self.is_locked():
+            raise WalletError(
+                "wallet is locked; unlock with walletpassphrase first"
+            )
+
+    def encrypt_wallet(self, passphrase: str) -> None:
+        """ref CWallet::EncryptWallet: wrap a fresh master key under the
+        passphrase, encrypt the HD seed, and lock."""
+        from . import crypter
+
+        if not passphrase:
+            raise WalletError("empty passphrase")
+        with self.lock:
+            if self.is_crypted:
+                raise WalletError("wallet already encrypted")
+            vmk = os.urandom(crypter.WALLET_CRYPTO_KEY_SIZE)
+            self.master_key_record = crypter.MasterKey.create(passphrase, vmk)
+            self.enc_mnemonic = crypter.encrypt(
+                vmk, crypter.secret_iv(b"mnemonic"), self.mnemonic.encode()
+            )
+            # retain public watch data for every derived key
+            for kid, pub in self.keystore.pubs().items():
+                self.key_pubs[kid] = pub
+            self.flush()
+            self.lock_wallet()
+
+    def lock_wallet(self) -> None:
+        """ref CWallet::Lock: wipe secrets, keep watch data."""
+        with self.lock:
+            if not self.is_crypted:
+                raise WalletError("wallet is not encrypted")
+            self.mnemonic = None
+            self.master = None
+            self._unlocked_until = 0.0
+            # pubkeys stay in the keystore (wipe clears secrets only), so
+            # watching continues; key_pubs is the persisted twin of that set
+            self.keystore.wipe_privkeys()
+
+    def unlock(self, passphrase: str, timeout: float = 0.0) -> None:
+        """ref CWallet::Unlock + walletpassphrase timeout."""
+        from . import crypter
+
+        with self.lock:
+            if not self.is_crypted:
+                raise WalletError("wallet is not encrypted")
+            vmk = self.master_key_record.unwrap(passphrase)
+            mnemonic = (
+                crypter.decrypt(
+                    vmk, crypter.secret_iv(b"mnemonic"), self.enc_mnemonic
+                )
+                if vmk is not None
+                else None
+            )
+            if mnemonic is None:
+                raise WalletError("incorrect passphrase")
+            self.generate_hd_chain(mnemonic.decode())
+            for chain in (0, 1):
+                for idx in range(self.next_index[chain]):
+                    priv = self.derive_key(chain, idx)
+                    self._register_key(priv, chain, idx)
+            self._unlocked_until = (time.time() + timeout) if timeout else 0.0
+
+    def change_passphrase(self, old: str, new: str) -> None:
+        """ref CWallet::ChangeWalletPassphrase."""
+        from . import crypter
+
+        if not new:
+            raise WalletError("empty passphrase")
+        with self.lock:
+            if not self.is_crypted:
+                raise WalletError("wallet is not encrypted")
+            vmk = self.master_key_record.unwrap(old)
+            if vmk is None:
+                raise WalletError("incorrect passphrase")
+            self.master_key_record = crypter.MasterKey.create(new, vmk)
+            self.flush()
 
     # ------------------------------------------------------------ creation
 
@@ -100,26 +199,36 @@ class Wallet(ValidationInterface):
     def derive_key(self, chain: int, index: int) -> int:
         return self._account_key().derive(chain).derive(index).key
 
+    def _register_key(self, priv: int, chain: int, idx: int) -> bytes:
+        """Add a derived key to the keystore AND the persistent watch set
+        (key_pubs is what an encrypted wallet persists and reloads, so it
+        must track every derived key, not just those present at
+        encryption time)."""
+        kid = self.keystore.add_key(priv)
+        self.key_meta[kid] = (chain, idx)
+        self.key_pubs[kid] = self.keystore.pubs()[kid]
+        return kid
+
     def top_up_keypool(self, size: int = KEYPOOL_SIZE) -> None:
         """ref CWallet::TopUpKeyPool."""
+        self._require_unlocked()
         with self.lock:
             for chain in (0, 1):
                 while self.next_index[chain] < size:
                     idx = self.next_index[chain]
                     priv = self.derive_key(chain, idx)
-                    kid = self.keystore.add_key(priv)
-                    self.key_meta[kid] = (chain, idx)
+                    self._register_key(priv, chain, idx)
                     self.next_index[chain] = idx + 1
 
     def get_new_address(self, label: str = "") -> str:
         """ref GetNewAddress: hand out the next external key."""
+        self._require_unlocked()
         from ..script.standard import encode_destination
 
         with self.lock:
             idx = self.next_index[0]
             priv = self.derive_key(0, idx)
-            kid = self.keystore.add_key(priv)
-            self.key_meta[kid] = (0, idx)
+            kid = self._register_key(priv, 0, idx)
             self.next_index[0] = idx + 1
             addr = encode_destination(KeyID(kid), self.node.params)
             if label:
@@ -128,21 +237,26 @@ class Wallet(ValidationInterface):
             return addr
 
     def get_change_address_script(self) -> bytes:
+        self._require_unlocked()
         with self.lock:
             idx = self.next_index[1]
             priv = self.derive_key(1, idx)
-            kid = self.keystore.add_key(priv)
-            self.key_meta[kid] = (1, idx)
+            kid = self._register_key(priv, 1, idx)
             self.next_index[1] = idx + 1
             return p2pkh_script(KeyID(kid)).raw
 
     # ------------------------------------------------------------- tracking
 
     def is_mine_script(self, script_pubkey: bytes) -> bool:
-        """ref ismine.h IsMine (P2PKH/P2PK/asset-envelope on our keys)."""
+        """ref ismine.h IsMine (P2PKH/P2PK/asset-envelope on our keys).
+
+        Checks key *identity*, not secret possession, so an encrypted
+        locked wallet keeps watching its addresses (ref ISMINE_SPENDABLE
+        evaluated over the keystore's pubkey records).
+        """
         dest = extract_destination(Script(script_pubkey))
         if isinstance(dest, KeyID):
-            return self.keystore.get_priv(dest.h) is not None
+            return self.keystore.have_key(dest.h)
         return False
 
     def is_relevant(self, tx: Transaction) -> bool:
@@ -280,6 +394,7 @@ class Wallet(ValidationInterface):
     ) -> Tuple[Transaction, int]:
         """ref CWallet::CreateTransaction (wallet.cpp:3250): returns
         (signed tx, fee)."""
+        self._require_unlocked()
         feerate = feerate or FeeRate(MIN_RELAY_FEE.sat_per_kb * 2)
         send_total = sum(v for _, v in recipients)
         if send_total <= 0:
@@ -302,7 +417,7 @@ class Wallet(ValidationInterface):
             tx = Transaction(
                 version=2,
                 vin=[
-                    TxIn(prevout=op, sequence=0xFFFFFFFE) for op, _ in picked
+                    TxIn(prevout=op, sequence=0xFFFFFFFD) for op, _ in picked
                 ],
                 vout=vout,
                 locktime=self.node.chainstate.tip().height,
@@ -339,10 +454,101 @@ class Wallet(ValidationInterface):
         tx, _fee = self.create_transaction([(script_pubkey, value)])
         return self.commit_transaction(tx)
 
+    # ------------------------------------------------------ asset entry points
+
+    def create_transaction_with_asset(self, asset, to_h160=None, **kw):
+        """ref CWallet::CreateTransactionWithAssets (wallet.cpp:3225):
+        issue a new asset funded and signed by this wallet."""
+        from ..assets.txbuilder import build_issue
+
+        self._require_unlocked()
+        return build_issue(self, asset, to_h160, **kw)
+
+    def create_transaction_with_transfer_asset(self, name, qty, to_h160, **kw):
+        """ref CWallet::CreateTransactionWithTransferAsset (:3246)."""
+        from ..assets.txbuilder import build_transfer
+
+        self._require_unlocked()
+        return build_transfer(self, name, qty, to_h160, **kw)
+
+    def create_transaction_with_reissue_asset(self, reissue, to_h160=None, **kw):
+        """ref CWallet::CreateTransactionWithReissueAsset (:3236)."""
+        from ..assets.txbuilder import build_reissue
+
+        self._require_unlocked()
+        return build_reissue(self, reissue, to_h160, **kw)
+
+    def bump_fee(self, txid: int) -> Tuple[int, int, int]:
+        """ref wallet/feebumper.{h,cpp}: rebuild an unconfirmed wallet tx
+        with a doubled feerate, funded by shrinking the change output, and
+        replace it through the BIP125 mempool path.  Returns
+        (new_txid, old_fee, new_fee)."""
+        self._require_unlocked()
+        with self.lock:
+            wtx = self.wtx.get(txid)
+        if wtx is None:
+            raise WalletError("transaction not in wallet")
+        if wtx.height != -1:
+            raise WalletError("transaction already confirmed")
+        old = wtx.tx
+        if not any(i.sequence < 0xFFFFFFFE for i in old.vin):
+            raise WalletError("transaction not replaceable (BIP125)")
+        # fee of the original: inputs are wallet-known coins
+        view = self.node.chainstate.coins
+        in_total = 0
+        prevs = []
+        for i in old.vin:
+            coin = view.get_coin(i.prevout)
+            if coin is None:
+                parent = self.wtx.get(i.prevout.txid)
+                if parent is None:
+                    raise WalletError("original inputs unknown")
+                out = parent.tx.vout[i.prevout.n]
+            else:
+                out = coin.out
+            prevs.append(out)
+            in_total += out.value
+        old_fee = in_total - sum(o.value for o in old.vout)
+        # locate a change output to shrink (pays to our internal chain)
+        change_idx = None
+        for n, out in enumerate(old.vout):
+            dest = extract_destination(Script(out.script_pubkey))
+            if isinstance(dest, KeyID) and self.key_meta.get(dest.h, (0, 0))[0] == 1:
+                change_idx = n
+                break
+        if change_idx is None:
+            raise WalletError("no change output to fund the bump")
+        from ..chain.policy import MIN_RELAY_FEE as _MRF
+
+        size = len(old.to_bytes())
+        new_fee = max(old_fee * 2, old_fee + _MRF.fee_for(size) + 1)
+        delta = new_fee - old_fee
+        new_vout = [TxOut(value=o.value, script_pubkey=o.script_pubkey) for o in old.vout]
+        if new_vout[change_idx].value - delta < 5000:
+            raise WalletError("change too small to bump fee")
+        new_vout[change_idx] = TxOut(
+            value=new_vout[change_idx].value - delta,
+            script_pubkey=new_vout[change_idx].script_pubkey,
+        )
+        new_tx = Transaction(
+            version=old.version,
+            vin=[TxIn(prevout=i.prevout, sequence=i.sequence) for i in old.vin],
+            vout=new_vout,
+            locktime=old.locktime,
+        )
+        for i, out in enumerate(prevs):
+            sign_tx_input(self.keystore, new_tx, i, Script(out.script_pubkey))
+        new_txid = self.commit_transaction(new_tx)
+        with self.lock:
+            self.wtx.pop(txid, None)
+            self.flush()
+        return new_txid, old_fee, new_fee
+
     # ---------------------------------------------------------- message sig
 
     def sign_message(self, keyid: bytes, message: str) -> bytes:
         """ref rpcmisc signmessage: compact recoverable signature."""
+        self._require_unlocked()
         from ..crypto import secp256k1 as ec
 
         priv = self.keystore.get_priv(keyid)
@@ -365,7 +571,8 @@ class Wallet(ValidationInterface):
             return
         with self.lock:
             data = {
-                "mnemonic": self.mnemonic,
+                # an encrypted wallet never writes the seed in the clear
+                "mnemonic": None if self.is_crypted else self.mnemonic,
                 "next_index": self.next_index,
                 "address_book": self.address_book,
                 "wtx": [
@@ -377,6 +584,17 @@ class Wallet(ValidationInterface):
                     for wtx in self.wtx.values()
                 ],
             }
+            if self.is_crypted:
+                data["crypt"] = {
+                    "master_key": self.master_key_record.to_json(),
+                    "enc_mnemonic": self.enc_mnemonic.hex(),
+                    "key_pubs": {
+                        k.hex(): v.hex() for k, v in self.key_pubs.items()
+                    },
+                    "key_meta": {
+                        k.hex(): list(v) for k, v in self.key_meta.items()
+                    },
+                }
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(data, f)
@@ -385,15 +603,32 @@ class Wallet(ValidationInterface):
     def _load(self) -> None:
         with open(self.path) as f:
             data = json.load(f)
-        self.generate_hd_chain(data["mnemonic"])
         self.next_index = {int(k): v for k, v in data["next_index"].items()}
         self.address_book = data.get("address_book", {})
-        # re-derive keys
-        for chain in (0, 1):
-            for idx in range(self.next_index[chain]):
-                priv = self.derive_key(chain, idx)
-                kid = self.keystore.add_key(priv)
-                self.key_meta[kid] = (chain, idx)
+        crypt = data.get("crypt")
+        if crypt is not None:
+            from . import crypter
+
+            self.master_key_record = crypter.MasterKey.from_json(
+                crypt["master_key"]
+            )
+            self.enc_mnemonic = bytes.fromhex(crypt["enc_mnemonic"])
+            self.key_pubs = {
+                bytes.fromhex(k): bytes.fromhex(v)
+                for k, v in crypt["key_pubs"].items()
+            }
+            self.key_meta = {
+                bytes.fromhex(k): tuple(v)
+                for k, v in crypt.get("key_meta", {}).items()
+            }
+            for pub in self.key_pubs.values():
+                self.keystore.add_watch_pub(pub)
+        else:
+            self.generate_hd_chain(data["mnemonic"])
+            for chain in (0, 1):
+                for idx in range(self.next_index[chain]):
+                    priv = self.derive_key(chain, idx)
+                    self._register_key(priv, chain, idx)
         for item in data.get("wtx", []):
             tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
             self.wtx[tx.txid] = WalletTx(

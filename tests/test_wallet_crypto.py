@@ -1,0 +1,280 @@
+"""Wallet encryption, fee bump (BIP125 replacement), and mempool
+persistence.
+
+Reference analogues: src/wallet/crypter.{h,cpp} + wallet_encryption
+functional test, src/wallet/feebumper.h, policy/rbf.cpp, and
+DumpMempool/LoadMempool with mempool_persist.py.
+"""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.mempool_accept import (
+    MempoolAcceptError,
+    accept_to_memory_pool,
+    dump_mempool,
+    load_mempool,
+)
+from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.node.context import NodeContext
+from nodexa_chain_core_tpu.node.events import main_signals
+from nodexa_chain_core_tpu.script.standard import (
+    decode_destination,
+    script_for_destination,
+)
+from nodexa_chain_core_tpu.wallet import crypter
+from nodexa_chain_core_tpu.wallet.wallet import Wallet, WalletError
+
+
+@pytest.fixture()
+def wallet_node(tmp_path):
+    main_signals.clear()
+    node = NodeContext(network="regtest", datadir=str(tmp_path / "n"))
+    w = Wallet.load_or_create(node)
+    node.wallet = w
+    yield node, w
+    main_signals.clear()
+
+
+def _mine_to(node, spk_raw, n, t_start=None):
+    params = node.params
+    t = t_start or (params.genesis_time + 60)
+    for _ in range(n):
+        blk = BlockAssembler(node.chainstate).create_new_block(spk_raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        node.chainstate.process_new_block(blk)
+        t += 60
+    return t
+
+
+def _fund(node, w, blocks=COINBASE_MATURITY + 3):
+    addr = w.get_new_address("mine")
+    spk = script_for_destination(decode_destination(addr, node.params)).raw
+    t = _mine_to(node, spk, blocks)
+    return spk, t
+
+
+# ---------------------------------------------------------------- crypter
+
+
+def test_crypter_roundtrip_and_wrong_passphrase():
+    mk = crypter.MasterKey.create("hunter2", b"\x42" * 32, rounds=25_000)
+    assert mk.unwrap("hunter2") == b"\x42" * 32
+    assert mk.unwrap("wrong") is None
+    mk2 = crypter.MasterKey.from_json(mk.to_json())
+    assert mk2.unwrap("hunter2") == b"\x42" * 32
+
+
+# ------------------------------------------------------------- encryption
+
+
+def test_encrypt_lock_unlock_cycle(wallet_node):
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    bal = w.get_balance()
+    assert bal > 0
+    mnemonic = w.mnemonic
+
+    w.encrypt_wallet("s3cret")
+    assert w.is_crypted and w.is_locked()
+    assert w.mnemonic is None  # secret wiped from memory
+    # watching still works while locked
+    assert w.get_balance() == bal
+    with pytest.raises(WalletError):
+        w.get_new_address()
+    with pytest.raises(WalletError):
+        w.send_to_address(spk, COIN)
+    with pytest.raises(WalletError):
+        w.unlock("wrong-pass")
+
+    w.unlock("s3cret")
+    assert not w.is_locked()
+    assert w.mnemonic == mnemonic
+    # spending works again
+    txid = w.send_to_address(spk, COIN)
+    assert txid in [t for t in node.mempool.txids()]
+
+    w.lock_wallet()
+    assert w.is_locked()
+
+
+def test_encrypted_wallet_persists_no_plaintext(wallet_node, tmp_path):
+    node, w = wallet_node
+    _fund(node, w)
+    mnemonic = w.mnemonic
+    bal = w.get_balance()
+    w.encrypt_wallet("pass-x")
+    raw = open(w.path).read()
+    assert mnemonic.split()[0] not in raw  # seed not in the clear
+    # reload from disk: locked, watching, unlockable
+    main_signals.clear()
+    w2 = Wallet(node, w.path)
+    w2._load()
+    assert w2.is_crypted and w2.is_locked()
+    assert w2.get_balance() == bal
+    w2.unlock("pass-x")
+    assert w2.mnemonic == mnemonic
+
+
+def test_keys_derived_after_encryption_survive_restart(wallet_node):
+    """Regression: post-encryption addresses must stay watched after a
+    locked reload (key_pubs tracks every derived key)."""
+    node, w = wallet_node
+    _fund(node, w)
+    w.encrypt_wallet("pp")
+    w.unlock("pp")
+    addr = w.get_new_address("later")
+    dest = decode_destination(addr, node.params)
+    w.lock_wallet()
+    main_signals.clear()
+    w2 = Wallet(node, w.path)
+    w2._load()
+    assert w2.is_locked()
+    assert w2.is_mine_script(script_for_destination(dest).raw)
+
+
+def test_change_passphrase_rejects_empty(wallet_node):
+    node, w = wallet_node
+    w.encrypt_wallet("old")
+    with pytest.raises(WalletError):
+        w.change_passphrase("old", "")
+
+
+def test_rbf_rule6_low_feerate_replacement_rejected(wallet_node):
+    """A bigger tx paying more absolute fee but a lower feerate must not
+    replace (BIP125 rule 6)."""
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    tx, fee = w.create_transaction([(spk, 10 * COIN)])
+    accept_to_memory_pool(node.chainstate, node.mempool, tx)
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+    from nodexa_chain_core_tpu.script.script import Script
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    coins = [node.chainstate.coins.get_coin(i.prevout).out for i in tx.vin]
+    total_in = sum(c.value for c in coins)
+    pad = b"\x6a" + bytes([75]) + bytes(75)  # bloat via OP_RETURN outputs
+    repl = Transaction(
+        version=2,
+        vin=[TxIn(prevout=i.prevout, sequence=0xFFFFFFFD) for i in tx.vin],
+        vout=[TxOut(value=total_in - fee * 3, script_pubkey=spk)]
+        + [TxOut(value=0, script_pubkey=pad) for _ in range(40)],
+        locktime=tx.locktime,
+    )
+    for i, out in enumerate(coins):
+        sign_tx_input(w.keystore, repl, i, Script(out.script_pubkey))
+    # pays 3x the fee but is far larger -> lower feerate -> rejected
+    if len(repl.to_bytes()) * (fee / len(tx.to_bytes())) > fee * 3:
+        with pytest.raises(MempoolAcceptError):
+            accept_to_memory_pool(node.chainstate, node.mempool, repl)
+        assert node.mempool.contains(tx.txid)
+
+
+def test_change_passphrase(wallet_node):
+    node, w = wallet_node
+    w.encrypt_wallet("old-pass")
+    w.change_passphrase("old-pass", "new-pass")
+    with pytest.raises(WalletError):
+        w.unlock("old-pass")
+    w.unlock("new-pass")
+    assert not w.is_locked()
+
+
+# ------------------------------------------------------- RBF and fee bump
+
+
+def test_bip125_replacement(wallet_node):
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    tx, fee = w.create_transaction([(spk, 10 * COIN)])
+    accept_to_memory_pool(node.chainstate, node.mempool, tx)
+    # conflicting replacement spending the same inputs with more fee
+    tx2, _ = w.create_transaction([(spk, 10 * COIN)])
+    # force identical inputs, lower output value for higher fee
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+    from nodexa_chain_core_tpu.script.script import Script
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    coins = []
+    for i in tx.vin:
+        c = node.chainstate.coins.get_coin(i.prevout)
+        coins.append(c.out)
+    repl = Transaction(
+        version=2,
+        vin=[TxIn(prevout=i.prevout, sequence=0xFFFFFFFD) for i in tx.vin],
+        vout=[
+            TxOut(
+                value=sum(c.value for c in coins) - fee - 50_000,
+                script_pubkey=spk,
+            )
+        ],
+        locktime=tx.locktime,
+    )
+    for i, out in enumerate(coins):
+        sign_tx_input(w.keystore, repl, i, Script(out.script_pubkey))
+    accept_to_memory_pool(node.chainstate, node.mempool, repl)
+    assert node.mempool.contains(repl.txid)
+    assert not node.mempool.contains(tx.txid)  # replaced
+
+
+def test_non_signaling_tx_not_replaceable(wallet_node):
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    tx, fee = w.create_transaction([(spk, 5 * COIN)])
+    # rewrite as final (non-replaceable) and re-sign
+    from nodexa_chain_core_tpu.primitives.transaction import Transaction, TxIn
+    from nodexa_chain_core_tpu.script.script import Script
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    final_tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=i.prevout, sequence=0xFFFFFFFE) for i in tx.vin],
+        vout=tx.vout,
+        locktime=tx.locktime,
+    )
+    coins = [node.chainstate.coins.get_coin(i.prevout).out for i in tx.vin]
+    for i, out in enumerate(coins):
+        sign_tx_input(w.keystore, final_tx, i, Script(out.script_pubkey))
+    accept_to_memory_pool(node.chainstate, node.mempool, final_tx)
+    with pytest.raises(MempoolAcceptError) as e:
+        accept_to_memory_pool(node.chainstate, node.mempool, tx)
+    assert e.value.code == "txn-mempool-conflict"
+
+
+def test_bump_fee(wallet_node):
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    txid = w.send_to_address(spk, 7 * COIN)
+    new_txid, old_fee, new_fee = w.bump_fee(txid)
+    assert new_fee > old_fee
+    assert node.mempool.contains(new_txid)
+    assert not node.mempool.contains(txid)
+    assert new_txid in w.wtx and txid not in w.wtx
+
+
+# ------------------------------------------------------ mempool.dat
+
+
+def test_mempool_persist_roundtrip(wallet_node, tmp_path):
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    txid1 = w.send_to_address(spk, 3 * COIN)
+    txid2 = w.send_to_address(spk, 2 * COIN)
+    path = str(tmp_path / "mempool.dat")
+    assert dump_mempool(node.mempool, path) == 2
+    node.mempool.clear()
+    assert node.mempool.size() == 0
+    n = load_mempool(node.chainstate, node.mempool, path)
+    assert n == 2
+    assert node.mempool.contains(txid1)
+    assert node.mempool.contains(txid2)
