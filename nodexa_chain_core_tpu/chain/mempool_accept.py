@@ -15,6 +15,7 @@ from ..consensus.consensus import MAX_BLOCK_SIGOPS_COST
 from ..consensus.tx_verify import (
     TxValidationError,
     check_transaction,
+    check_tx_asset_values,
     check_tx_inputs,
     get_transaction_sigop_cost,
     is_final_tx,
@@ -49,6 +50,9 @@ def accept_to_memory_pool(
 
     try:
         check_transaction(tx)
+        # mempool policy enforces zero-value asset outputs unconditionally
+        # (ref tx_verify.cpp fMempoolCheck branch)
+        check_tx_asset_values(tx, enforce_reissue_zero=True)
     except TxValidationError as e:
         raise MempoolAcceptError(e.code)
 

@@ -32,11 +32,14 @@ from ..consensus.merkle import block_merkle_root
 from ..consensus.tx_verify import (
     TxValidationError,
     check_transaction,
+    check_tx_asset_values,
     check_tx_inputs,
     get_legacy_sigop_count,
     get_transaction_sigop_cost,
     is_final_tx,
 )
+from ..consensus.versionbits import versionbits_cache
+from ..consensus.params import DEPLOYMENT_ASSETS, DEPLOYMENT_ENFORCE_VALUE
 from ..core.uint256 import bits_to_target, u256_hex
 from ..node.chainparams import NetworkParams
 from ..node.events import main_signals
@@ -469,7 +472,16 @@ class ChainState:
         sigops_cost = 0
         script_flags = self._script_flags(idx.height)
         control = CheckQueueControl(self.checkqueue)
-        assets_active = idx.height >= self.params.consensus.asset_activation_height
+        # asset rules activate by height (buried) OR by BIP9 deployment
+        # (ref AreAssetsDeployed, chainparams.cpp:130-154)
+        cons = self.params.consensus
+        assets_active = (
+            idx.height >= cons.asset_activation_height
+            or versionbits_cache.is_active(idx.prev, cons, DEPLOYMENT_ASSETS)
+        )
+        enforce_value = versionbits_cache.is_active(
+            idx.prev, cons, DEPLOYMENT_ENFORCE_VALUE
+        )
         applied_asset_undos = []
 
         try:
@@ -477,6 +489,7 @@ class ChainState:
                 if not tx.is_coinbase():
                     try:
                         fee = check_tx_inputs(tx, view, idx.height)
+                        check_tx_asset_values(tx, enforce_value)
                     except TxValidationError as e:
                         raise BlockValidationError(e.code, f"tx {i}")
                     fees += fee

@@ -70,6 +70,29 @@ def check_transaction(tx: Transaction) -> None:
                 raise TxValidationError("bad-txns-prevout-null")
 
 
+def check_tx_asset_values(tx: Transaction, enforce_reissue_zero: bool) -> None:
+    """Asset outputs carry zero native value (ref tx_verify.cpp:295-330).
+
+    New/transfer asset outputs must always have nValue == 0; the reissue
+    zero-value rule is consensus-gated by the ENFORCE_VALUE BIP9 deployment
+    (ref AreEnforcedValuesDeployed) — mempool policy enforces it
+    unconditionally, block validation only once the deployment activates.
+    """
+    from ..script.script import Script
+
+    for out in tx.vout:
+        kind_info = Script(out.script_pubkey).asset_script_type()
+        if kind_info is None:
+            continue
+        kind = kind_info[0]
+        if kind in ("new", "owner", "transfer") and out.value != 0:
+            raise TxValidationError(
+                f"bad-txns-asset-{kind}-amount-isnt-zero"
+            )
+        if kind == "reissue" and enforce_reissue_zero and out.value != 0:
+            raise TxValidationError("bad-txns-asset-reissued-amount-isnt-zero")
+
+
 def check_tx_inputs(
     tx: Transaction, view: CoinsViewCache, spend_height: int
 ) -> int:

@@ -1,0 +1,50 @@
+"""Functional: pub-socket and -blocknotify observability (parity:
+reference interface_zmq.py and feature_notifications.py)."""
+
+import os
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.node.notifications import PubSubscriber
+
+from .framework import TestFramework, free_port
+from .test_mining_basic import ADDR
+
+
+@pytest.mark.functional
+def test_pub_socket_streams_from_daemon():
+    port = free_port()
+    with TestFramework(num_nodes=1, extra_args=[[f"-pubport={port}"]]) as f:
+        n0 = f.nodes[0]
+        sub = PubSubscriber(port, timeout=30)
+        time.sleep(0.3)
+        hashes = n0.rpc.generatetoaddress(2, ADDR)
+        payload, seq = sub.recv_topic("hashblock")
+        assert payload.hex() == hashes[0]
+        assert seq == 0
+        payload, seq = sub.recv_topic("hashblock")
+        assert payload.hex() == hashes[1]
+        assert seq == 1
+        sub.close()
+
+
+@pytest.mark.functional
+def test_blocknotify_shell_hook():
+    out = None
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        out = os.path.join(n0.datadir, "notify.log")
+        n0.stop()
+        n0.extra_args = [f"-blocknotify=echo %s >> {out}"]
+        n0.start()
+        hashes = n0.rpc.generatetoaddress(2, ADDR)
+        deadline = time.time() + 10
+        lines = []
+        while time.time() < deadline:
+            if os.path.exists(out):
+                lines = open(out).read().split()
+                if len(lines) >= 2:
+                    break
+            time.sleep(0.2)
+        assert lines[-2:] == hashes

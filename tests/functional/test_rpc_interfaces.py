@@ -1,0 +1,101 @@
+"""Functional: RPC surface and REST interface (parity: reference rpc_*.py
+and interface_rest.py)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from .framework import TestFramework
+
+
+@pytest.mark.functional
+def test_blockchain_rpcs():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(5, addr)
+
+        info = n0.rpc.getblockchaininfo()
+        assert info["blocks"] == 5
+        assert info["chain"] == "regtest"
+        best = n0.rpc.getbestblockhash()
+        hdr = n0.rpc.getblockheader(best)
+        assert hdr["height"] == 5
+        assert hdr["confirmations"] == 1
+        blk = n0.rpc.getblock(best)
+        assert blk["hash"] == best
+        assert len(blk["tx"]) == 1
+        # raw tx fetch for the coinbase
+        raw = n0.rpc.getrawtransaction(blk["tx"][0], True)
+        assert raw["txid"] == blk["tx"][0]
+        assert raw["vin"][0].get("coinbase")
+        # difficulty/network info shape
+        assert n0.rpc.getblockcount() == 5
+        mining = n0.rpc.getmininginfo()
+        assert mining["blocks"] == 5
+        net = n0.rpc.getnetworkinfo()
+        assert net["protocolversion"] == 70028
+
+
+@pytest.mark.functional
+def test_rest_endpoints():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(3, addr)
+        best = n0.rpc.getbestblockhash()
+
+        def rest(path):
+            url = f"http://127.0.0.1:{n0.rpc_port}{path}"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.read()
+
+        chaininfo = json.loads(rest("/rest/chaininfo.json"))
+        assert chaininfo["blocks"] == 3
+        blk = json.loads(rest(f"/rest/block/{best}.json"))
+        assert blk["hash"] == best
+        raw = rest(f"/rest/block/{best}.bin")
+        assert len(raw) > 80
+        mempool = json.loads(rest("/rest/mempool/info.json"))
+        assert mempool["size"] == 0
+
+
+@pytest.mark.functional
+def test_wallet_encryption_rpc_flow():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, addr)
+        n0.rpc.encryptwallet("correct horse")
+        # locked: spending fails
+        from .framework import RPCFailure
+
+        with pytest.raises(RPCFailure):
+            n0.rpc.sendtoaddress(addr, 1)
+        n0.rpc.walletpassphrase("correct horse", 300)
+        txid = n0.rpc.sendtoaddress(addr, 1)
+        assert txid in n0.rpc.getrawmempool()
+        n0.rpc.walletlock()
+        with pytest.raises(RPCFailure):
+            n0.rpc.sendtoaddress(addr, 1)
+        # survives restart in encrypted form
+        n0.stop()
+        n0.start()
+        with pytest.raises(RPCFailure):
+            n0.rpc.sendtoaddress(addr, 1)
+        n0.rpc.walletpassphrase("correct horse", 60)
+        n0.rpc.sendtoaddress(addr, 2)
+
+
+@pytest.mark.functional
+def test_bumpfee_rpc():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, addr)
+        txid = n0.rpc.sendtoaddress(addr, 10)
+        res = n0.rpc.bumpfee(txid)
+        assert res["fee"] > res["origfee"]
+        pool = n0.rpc.getrawmempool()
+        assert res["txid"] in pool and txid not in pool
