@@ -1,0 +1,188 @@
+"""Offline raw-transaction builder/editor (ref src/clore-tx.cpp).
+
+Command-style interface mirroring the reference's `clore-tx`:
+
+    python -m nodexa_chain_core_tpu.tools.txtool [-regtest] [-json] \
+        [-create | <hex>] command ...
+
+Commands (applied left to right, like the reference's argument walk):
+    nversion=N                       set version
+    locktime=N                       set lock time
+    replaceable[=N]                  set input N (or all) BIP125-replaceable
+    in=TXID:VOUT[:SEQUENCE]          append an input
+    outaddr=VALUE:ADDRESS            append a pay-to-address output
+    outdata=[VALUE:]HEX              append an OP_RETURN data output
+    outscript=VALUE:SCRIPT_HEX       append a raw-script output
+    delin=N / delout=N               delete input/output N
+    prevout=TXID:VOUT:SCRIPT_HEX[:AMOUNT]   register a spent output (for sign)
+    privkey=WIF                      register a signing key
+    sign=ALL                         sign every input with registered data
+
+Prints the resulting hex (or JSON decode with -json) to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Tuple
+
+from ..core.amount import COIN
+from ..core.uint256 import u256_from_hex, u256_hex
+from ..node import chainparams
+from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+from ..script.script import Script
+from ..script.sign import KeyStore, sign_tx_input
+from ..script.standard import decode_destination, script_for_destination
+from ..wallet.keys import wif_decode
+
+
+class TxToolError(Exception):
+    pass
+
+
+def _parse_value(s: str) -> int:
+    return int(round(float(s) * COIN))
+
+
+def tx_to_dict(tx: Transaction, params) -> dict:
+    return {
+        "txid": tx.txid_hex,
+        "version": tx.version,
+        "locktime": tx.locktime,
+        "vin": [
+            {
+                "txid": u256_hex(i.prevout.txid),
+                "vout": i.prevout.n,
+                "scriptSig": i.script_sig.hex(),
+                "sequence": i.sequence,
+            }
+            for i in tx.vin
+        ],
+        "vout": [
+            {
+                "value": o.value / COIN,
+                "scriptPubKey": o.script_pubkey.hex(),
+            }
+            for o in tx.vout
+        ],
+    }
+
+
+def run(args: List[str], out=sys.stdout) -> Transaction:
+    params = chainparams.select_params("main")
+    as_json = False
+    tx = None
+    commands: List[str] = []
+    for a in args:
+        if a in ("-regtest", "-testnet"):
+            params = chainparams.select_params(
+                "regtest" if a == "-regtest" else "test"
+            )
+        elif a == "-json":
+            as_json = True
+        elif a == "-create":
+            tx = Transaction(version=2, vin=[], vout=[])
+        elif tx is None and "=" not in a:
+            try:
+                tx = Transaction.from_bytes(bytes.fromhex(a))
+            except Exception as e:
+                raise TxToolError(f"bad tx hex: {e}")
+        else:
+            commands.append(a)
+    if tx is None:
+        raise TxToolError("no transaction: use -create or pass hex")
+
+    keystore = KeyStore()
+    prevouts: Dict[Tuple[int, int], TxOut] = {}
+
+    for cmd in commands:
+        name, _, arg = cmd.partition("=")
+        if name == "nversion":
+            tx.version = int(arg)
+        elif name == "locktime":
+            tx.locktime = int(arg)
+        elif name == "replaceable":
+            idxs = [int(arg)] if arg else range(len(tx.vin))
+            for i in idxs:
+                tx.vin[i].sequence = 0xFFFFFFFD
+        elif name == "in":
+            parts = arg.split(":")
+            if len(parts) < 2:
+                raise TxToolError("in=TXID:VOUT[:SEQUENCE]")
+            seq = int(parts[2]) if len(parts) > 2 else 0xFFFFFFFF
+            tx.vin.append(
+                TxIn(
+                    prevout=OutPoint(u256_from_hex(parts[0]), int(parts[1])),
+                    sequence=seq,
+                )
+            )
+        elif name == "outaddr":
+            value, _, addr = arg.partition(":")
+            dest = decode_destination(addr, params)
+            tx.vout.append(
+                TxOut(_parse_value(value), script_for_destination(dest).raw)
+            )
+        elif name == "outdata":
+            value, sep, datahex = arg.partition(":")
+            if not sep:
+                value, datahex = "0", value
+            from ..script.standard import nulldata_script
+
+            tx.vout.append(
+                TxOut(_parse_value(value), nulldata_script(bytes.fromhex(datahex)).raw)
+            )
+        elif name == "outscript":
+            value, _, scripthex = arg.partition(":")
+            tx.vout.append(TxOut(_parse_value(value), bytes.fromhex(scripthex)))
+        elif name == "delin":
+            try:
+                del tx.vin[int(arg)]
+            except IndexError:
+                raise TxToolError(f"no input {arg}")
+        elif name == "delout":
+            try:
+                del tx.vout[int(arg)]
+            except IndexError:
+                raise TxToolError(f"no output {arg}")
+        elif name == "prevout":
+            parts = arg.split(":")
+            if len(parts) < 3:
+                raise TxToolError("prevout=TXID:VOUT:SCRIPT_HEX[:AMOUNT]")
+            amount = _parse_value(parts[3]) if len(parts) > 3 else 0
+            prevouts[(u256_from_hex(parts[0]), int(parts[1]))] = TxOut(
+                amount, bytes.fromhex(parts[2])
+            )
+        elif name == "privkey":
+            priv, _compressed = wif_decode(arg, params)
+            keystore.add_key(priv)
+        elif name == "sign":
+            for i, txin in enumerate(tx.vin):
+                key = (txin.prevout.txid, txin.prevout.n)
+                prev = prevouts.get(key)
+                if prev is None:
+                    raise TxToolError(
+                        f"missing prevout for input {i}; add prevout=..."
+                    )
+                sign_tx_input(keystore, tx, i, Script(prev.script_pubkey))
+        else:
+            raise TxToolError(f"unknown command {name!r}")
+
+    if as_json:
+        print(json.dumps(tx_to_dict(tx, params), indent=1), file=out)
+    else:
+        print(tx.to_bytes().hex(), file=out)
+    return tx
+
+
+def main() -> int:
+    try:
+        run(sys.argv[1:])
+        return 0
+    except (TxToolError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Lock-order deadlock detection (ref src/sync.{h,cpp}).
+
+The reference compiles a runtime lock-order cycle detector under
+DEBUG_LOCKORDER (sync.cpp:25-183): every (lock A held while taking lock B)
+pair is recorded, and taking them in the opposite order anywhere in the
+process aborts with both stacks.  This is the Python analogue: enable it
+with ``enable_lockorder_debug()`` (tests / -debuglockorder) and wrap
+shared locks in :class:`DebugLock`.
+
+The wrapper is a context manager compatible with ``threading.Lock`` usage
+(acquire/release/with); with detection disabled it delegates with no
+bookkeeping overhead beyond one attribute check.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+_enabled = False
+_global = threading.Lock()
+# (A, B) -> formatted stacks at the time A-then-B was first observed
+_order_seen: Dict[Tuple[str, str], str] = {}
+_tls = threading.local()
+
+
+class PotentialDeadlock(Exception):
+    """ref sync.cpp:78 potential_deadlock_detected (we raise, it aborts)."""
+
+
+def enable_lockorder_debug(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+    if not on:
+        with _global:
+            _order_seen.clear()
+
+
+def _held() -> List["DebugLock"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def reset_lockorder_state() -> None:
+    """Test helper: forget observed orders (fresh process semantics)."""
+    with _global:
+        _order_seen.clear()
+
+
+class DebugLock:
+    """Named lock participating in order tracking (ref CCriticalSection)."""
+
+    def __init__(self, name: str, reentrant: bool = True):
+        self.name = name
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def _check_order(self) -> None:
+        me = self.name
+        stack = _held()
+        if any(l.name == me for l in stack):
+            return  # re-entrant acquisition: no new order pair
+        frames = "".join(traceback.format_stack(limit=8))
+        with _global:
+            for prior in stack:
+                pair = (prior.name, me)
+                inverse = (me, prior.name)
+                if inverse in _order_seen:
+                    raise PotentialDeadlock(
+                        f"lock order violation: {me} -> {prior.name} was "
+                        f"established at:\n{_order_seen[inverse]}\n"
+                        f"now acquiring {prior.name} -> {me} at:\n{frames}"
+                    )
+                _order_seen.setdefault(pair, frames)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _enabled:
+            self._check_order()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held().append(self)
+        return got
+
+    def release(self) -> None:
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def assert_lock_held(lock: DebugLock) -> None:
+    """ref AssertLockHeld (threadsafety annotations' runtime twin)."""
+    if _enabled and all(l is not lock for l in _held()):
+        raise AssertionError(f"lock {lock.name} not held where required")
