@@ -1,0 +1,208 @@
+"""Optional chain indexes (ref src/addressindex.h, spentindex.h,
+timestampindex.h; enabled by -addressindex / -spentindex / -timestampindex).
+
+The reference maintains these inside ConnectBlock against the coins view;
+here the chainstate calls :meth:`index_block` / :meth:`unindex_block` from
+its tip transitions with the block's undo data (which carries every spent
+prevout), so the index writer never needs to re-fetch coins.
+
+Key layout over the shared metadata KV store:
+  b"ai" + h160(20) + height(4 BE) + txid(32 BE) + n(2 BE) + kind(1)
+        -> signed delta (8 BE, two's complement)       [address deltas]
+  b"si" + txid(32 BE) + n(4 BE)
+        -> spending txid(32 BE) + vin(4 BE) + height(4 BE)   [spent index]
+  b"ti" + time(4 BE) + hash(32 BE) -> b""                [timestamp index]
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core.uint256 import u256_hex
+from ..script.script import Script
+from ..script.standard import KeyID, ScriptID, extract_destination
+
+KIND_RECV = 0
+KIND_SPEND = 1
+
+
+def _addr_key(script_pubkey: bytes) -> Optional[Tuple[int, bytes]]:
+    """(address_type, h160) for indexable scripts (1=pubkeyhash, 2=script).
+
+    Asset envelope scripts index under their P2PKH prefix destination,
+    matching the reference's address-index behavior for asset outputs.
+    """
+    s = Script(script_pubkey)
+    dest = extract_destination(s)
+    if dest is None and s.is_asset_script():
+        dest = extract_destination(Script(script_pubkey[:25]))
+    if isinstance(dest, KeyID):
+        return 1, dest.h
+    if isinstance(dest, ScriptID):
+        return 2, dest.h
+    return None
+
+
+def _i64(v: int) -> bytes:
+    return (v & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
+
+
+def _from_i64(b: bytes) -> int:
+    v = int.from_bytes(b, "big")
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class OptionalIndexes:
+    def __init__(self, db, address: bool = True, spent: bool = True,
+                 timestamp: bool = True):
+        self.db = db
+        self.address = address
+        self.spent = spent
+        self.timestamp = timestamp
+
+    # ------------------------------------------------------------- writes
+
+    def index_block(self, block, idx, undo) -> None:
+        h = idx.height.to_bytes(4, "big")
+        if self.timestamp:
+            self.db.put(
+                b"ti" + idx.header.time.to_bytes(4, "big")
+                + idx.block_hash.to_bytes(32, "big"),
+                b"",
+            )
+        for ti, tx in enumerate(block.vtx):
+            txid_b = tx.txid.to_bytes(32, "big")
+            if self.address:
+                for n, out in enumerate(tx.vout):
+                    ak = _addr_key(out.script_pubkey)
+                    if ak is None:
+                        continue
+                    self.db.put(
+                        b"ai" + ak[1] + h + txid_b + n.to_bytes(2, "big")
+                        + bytes([KIND_RECV]),
+                        _i64(out.value),
+                    )
+            if tx.is_coinbase():
+                continue
+            txundo = undo.vtxundo[ti - 1] if undo else None
+            for vi, txin in enumerate(tx.vin):
+                prev = txundo.prevouts[vi] if txundo else None
+                if self.spent:
+                    self.db.put(
+                        b"si" + txin.prevout.txid.to_bytes(32, "big")
+                        + txin.prevout.n.to_bytes(4, "big"),
+                        txid_b + vi.to_bytes(4, "big") + h,
+                    )
+                if self.address and prev is not None:
+                    ak = _addr_key(prev.out.script_pubkey)
+                    if ak is None:
+                        continue
+                    self.db.put(
+                        b"ai" + ak[1] + h + txid_b + vi.to_bytes(2, "big")
+                        + bytes([KIND_SPEND]),
+                        _i64(-prev.out.value),
+                    )
+
+    def unindex_block(self, block, idx, undo) -> None:
+        h = idx.height.to_bytes(4, "big")
+        if self.timestamp:
+            self.db.delete(
+                b"ti" + idx.header.time.to_bytes(4, "big")
+                + idx.block_hash.to_bytes(32, "big")
+            )
+        for ti, tx in enumerate(block.vtx):
+            txid_b = tx.txid.to_bytes(32, "big")
+            if self.address:
+                for n, out in enumerate(tx.vout):
+                    ak = _addr_key(out.script_pubkey)
+                    if ak is not None:
+                        self.db.delete(
+                            b"ai" + ak[1] + h + txid_b
+                            + n.to_bytes(2, "big") + bytes([KIND_RECV])
+                        )
+            if tx.is_coinbase():
+                continue
+            txundo = undo.vtxundo[ti - 1] if undo else None
+            for vi, txin in enumerate(tx.vin):
+                if self.spent:
+                    self.db.delete(
+                        b"si" + txin.prevout.txid.to_bytes(32, "big")
+                        + txin.prevout.n.to_bytes(4, "big")
+                    )
+                prev = txundo.prevouts[vi] if txundo else None
+                if self.address and prev is not None:
+                    ak = _addr_key(prev.out.script_pubkey)
+                    if ak is not None:
+                        self.db.delete(
+                            b"ai" + ak[1] + h + txid_b
+                            + vi.to_bytes(2, "big") + bytes([KIND_SPEND])
+                        )
+
+    # ------------------------------------------------------------- queries
+
+    def address_deltas(self, h160: bytes) -> List[dict]:
+        out = []
+        for k, v in self.db.iterate(b"ai" + h160):
+            height = int.from_bytes(k[22:26], "big")
+            txid = int.from_bytes(k[26:58], "big")
+            n = int.from_bytes(k[58:60], "big")
+            kind = k[60]
+            out.append(
+                {
+                    "height": height,
+                    "txid": u256_hex(txid),
+                    "index": n,
+                    "satoshis": _from_i64(v),
+                    "spending": kind == KIND_SPEND,
+                }
+            )
+        return out
+
+    def address_balance(self, h160: bytes) -> Tuple[int, int]:
+        """(balance, total_received) like getaddressbalance."""
+        balance = 0
+        received = 0
+        for d in self.address_deltas(h160):
+            balance += d["satoshis"]
+            if not d["spending"]:
+                received += d["satoshis"]
+        return balance, received
+
+    def address_txids(self, h160: bytes) -> List[str]:
+        seen = []
+        for d in self.address_deltas(h160):
+            if d["txid"] not in seen:
+                seen.append(d["txid"])
+        return seen
+
+    def address_utxos(self, h160: bytes) -> List[dict]:
+        utxos = []
+        for d in self.address_deltas(h160):
+            if d["spending"]:
+                continue
+            if self.spent_info(d["txid"], d["index"]) is not None:
+                continue
+            utxos.append(d)
+        return utxos
+
+    def spent_info(self, txid_hex: str, n: int) -> Optional[dict]:
+        key = (
+            b"si" + int(txid_hex, 16).to_bytes(32, "big")
+            + n.to_bytes(4, "big")
+        )
+        v = self.db.get(key)
+        if v is None:
+            return None
+        return {
+            "txid": u256_hex(int.from_bytes(v[:32], "big")),
+            "index": int.from_bytes(v[32:36], "big"),
+            "height": int.from_bytes(v[36:40], "big"),
+        }
+
+    def block_hashes_by_time(self, high: int, low: int) -> List[str]:
+        out = []
+        for k, _ in self.db.iterate(b"ti"):
+            t = int.from_bytes(k[2:6], "big")
+            if low <= t <= high:
+                out.append(u256_hex(int.from_bytes(k[6:38], "big")))
+        return out

@@ -628,6 +628,8 @@ class ChainState:
         from .fees import fee_estimator
 
         fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
+        if getattr(self, "indexes", None) is not None:
+            self.indexes.index_block(block, idx, undo)
         main_signals.block_connected(block, idx, [])
 
     def _disconnect_tip(self) -> Block:
@@ -638,6 +640,10 @@ class ChainState:
         view = CoinsViewCache(self.coins)
         self.disconnect_block(block, idx, view)
         view.flush()
+        if getattr(self, "indexes", None) is not None:
+            _, upos = self.positions.get(idx.block_hash, (-1, -1))
+            undo = self.block_store.read_undo(upos) if upos >= 0 else None
+            self.indexes.unindex_block(block, idx, undo)
         self.active.set_tip(idx.prev)
         if self.mempool is not None:
             self.mempool.add_disconnected_txs(block.vtx)

@@ -52,6 +52,19 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         datadir=datadir,
         script_check_threads=g_args.get_int("par", 0),
     )
+    # Optional indexes (-addressindex/-spentindex/-timestampindex; new
+    # blocks only — run -reindex to backfill, as the reference requires)
+    want_ai = g_args.get_bool("addressindex")
+    want_si = g_args.get_bool("spentindex")
+    want_ti = g_args.get_bool("timestampindex")
+    if want_ai or want_si or want_ti:
+        from ..chain.indexes import OptionalIndexes
+
+        node.chainstate.indexes = OptionalIndexes(
+            node.chainstate.metadata_db,
+            address=want_ai, spent=want_si, timestamp=want_ti,
+        )
+
     if reindexing:
         n = node.chainstate.reindex()
         log_printf("-reindex: reconnected %d blocks, height %d", n,

@@ -1,0 +1,104 @@
+"""Optional-index RPC family (ref src/rpc/misc.cpp getaddress*/
+getspentinfo/getblockhashes; tested by the reference's rpc_addressindex.py
+and rpc_spentindex.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..script.standard import KeyID, ScriptID, decode_destination
+from .server import RPC_INVALID_PARAMETER, RPC_MISC_ERROR, RPCError, RPCTable
+
+
+def _indexes(node):
+    ix = getattr(node.chainstate, "indexes", None)
+    if ix is None:
+        raise RPCError(
+            RPC_MISC_ERROR,
+            "address/spent/timestamp indexes not enabled "
+            "(-addressindex/-spentindex/-timestampindex)",
+        )
+    return ix
+
+
+def _h160s(node, params) -> List[bytes]:
+    spec = params[0] if params else None
+    if isinstance(spec, str):
+        addrs = [spec]
+    elif isinstance(spec, dict) and "addresses" in spec:
+        addrs = spec["addresses"]
+    else:
+        raise RPCError(RPC_INVALID_PARAMETER, "addresses required")
+    out = []
+    for a in addrs:
+        dest = decode_destination(a, node.params)
+        if not isinstance(dest, (KeyID, ScriptID)):
+            raise RPCError(RPC_INVALID_PARAMETER, f"bad address {a}")
+        out.append(dest.h)
+    return out
+
+
+def getaddressbalance(node, params: List[Any]):
+    ix = _indexes(node)
+    balance = 0
+    received = 0
+    for h in _h160s(node, params):
+        b, r = ix.address_balance(h)
+        balance += b
+        received += r
+    return {"balance": balance, "received": received}
+
+
+def getaddresstxids(node, params: List[Any]):
+    ix = _indexes(node)
+    txids: List[str] = []
+    for h in _h160s(node, params):
+        for t in ix.address_txids(h):
+            if t not in txids:
+                txids.append(t)
+    return txids
+
+
+def getaddressdeltas(node, params: List[Any]):
+    ix = _indexes(node)
+    out = []
+    for h in _h160s(node, params):
+        out.extend(ix.address_deltas(h))
+    return out
+
+
+def getaddressutxos(node, params: List[Any]):
+    ix = _indexes(node)
+    out = []
+    for h in _h160s(node, params):
+        out.extend(ix.address_utxos(h))
+    return out
+
+
+def getspentinfo(node, params: List[Any]):
+    ix = _indexes(node)
+    if not params or not isinstance(params[0], dict):
+        raise RPCError(RPC_INVALID_PARAMETER, '{"txid": ..., "index": n}')
+    info = ix.spent_info(params[0]["txid"], int(params[0]["index"]))
+    if info is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "unable to get spent info")
+    return info
+
+
+def getblockhashes(node, params: List[Any]):
+    ix = _indexes(node)
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "high and low timestamps required")
+    return ix.block_hashes_by_time(int(params[0]), int(params[1]))
+
+
+def register(table: RPCTable) -> None:
+    for name, fn, args in [
+        ("getaddressbalance", getaddressbalance, ["addresses"]),
+        ("getaddresstxids", getaddresstxids, ["addresses"]),
+        ("getaddressdeltas", getaddressdeltas, ["addresses"]),
+        ("getaddressutxos", getaddressutxos, ["addresses"]),
+        ("getspentinfo", getspentinfo, ["outpoint"]),
+        ("getblockhashes", getblockhashes, ["high", "low"]),
+    ]:
+        table.register("addressindex", name, fn, args)

@@ -32,4 +32,7 @@ def register_all(table: RPCTable = g_rpc_table) -> RPCTable:
 
     messages_rpc.register(table)
     rewards_rpc.register(table)
+    from . import indexes as indexes_rpc
+
+    indexes_rpc.register(table)
     return table

@@ -1,0 +1,60 @@
+"""Functional: address/spent/timestamp index RPCs (parity: reference
+rpc_addressindex.py / rpc_spentindex.py / rpc_timestampindex.py)."""
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+IDX_ARGS = ["-wallet", "-addressindex", "-spentindex", "-timestampindex"]
+
+
+@pytest.mark.functional
+def test_address_and_spent_indexes():
+    with TestFramework(num_nodes=1, extra_args=[IDX_ARGS]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, addr)
+
+        bal = n0.rpc.getaddressbalance({"addresses": [addr]})
+        assert bal["received"] == 103 * 5000 * 100_000_000
+        assert bal["balance"] == bal["received"]  # nothing spent yet
+        txids = n0.rpc.getaddresstxids({"addresses": [addr]})
+        assert len(txids) == 103
+
+        # spend some: deltas + spentindex reflect it
+        other = n0.rpc.getnewaddress()
+        spend_txid = n0.rpc.sendtoaddress(other, 100)
+        n0.rpc.generatetoaddress(1, addr)
+        bal2 = n0.rpc.getaddressbalance({"addresses": [addr]})
+        assert bal2["balance"] < bal2["received"]
+        deltas = n0.rpc.getaddressdeltas({"addresses": [addr]})
+        assert any(d["satoshis"] < 0 for d in deltas)
+
+        spent_tx = n0.rpc.getrawtransaction(spend_txid, True)
+        spent_in = spent_tx["vin"][0]
+        info = n0.rpc.getspentinfo(
+            {"txid": spent_in["txid"], "index": spent_in["vout"]}
+        )
+        assert info["txid"] == spend_txid
+
+        # utxos exclude spent outputs
+        utxos = n0.rpc.getaddressutxos({"addresses": [addr]})
+        assert len(utxos) < len(deltas)
+        spent_outpoints = {(info["txid"], info["index"])}
+        assert all(
+            (u["txid"], u["index"]) not in spent_outpoints for u in utxos
+        )
+
+        # timestamp index covers the mined window
+        best = n0.rpc.getbestblockhash()
+        t = n0.rpc.getblockheader(best)["time"]
+        hashes = n0.rpc.getblockhashes(t, t - 7200)
+        assert best in hashes
+
+
+@pytest.mark.functional
+def test_index_rpcs_require_flags():
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        with pytest.raises(RPCFailure):
+            n0.rpc.getaddressbalance({"addresses": []})
