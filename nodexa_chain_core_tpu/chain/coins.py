@@ -33,14 +33,34 @@ class Coin:
         return Coin(TxOut(self.out.value, self.out.script_pubkey), self.height, self.coinbase)
 
     def serialize(self, w: ByteWriter) -> None:
-        w.u32(self.height * 2 + (1 if self.coinbase else 0))
-        self.out.serialize(w)
+        """Compressed on-disk form (ref Coin::Serialize + compressor.h):
+        height/coinbase code, compressed amount, compressed script."""
+        from .compressor import (
+            compress_amount,
+            write_compressed_script,
+            write_varint,
+        )
+
+        write_varint(w, self.height * 2 + (1 if self.coinbase else 0))
+        write_varint(w, compress_amount(self.out.value))
+        write_compressed_script(w, self.out.script_pubkey)
 
     @classmethod
     def deserialize(cls, r: ByteReader) -> "Coin":
-        code = r.u32()
-        out = TxOut.deserialize(r)
-        return cls(out=out, height=code >> 1, coinbase=bool(code & 1))
+        from .compressor import (
+            decompress_amount,
+            read_compressed_script,
+            read_varint,
+        )
+
+        code = read_varint(r)
+        value = decompress_amount(read_varint(r))
+        script = read_compressed_script(r)
+        return cls(
+            out=TxOut(value=value, script_pubkey=script),
+            height=code >> 1,
+            coinbase=bool(code & 1),
+        )
 
 
 def _spent_coin() -> Coin:

@@ -1,0 +1,175 @@
+"""UTXO compression (ref src/compressor.{h,cpp}).
+
+Two pieces, used by the on-disk coins encoding:
+
+* Script compression: the common output templates shrink to 21/33 bytes —
+  0x00+keyhash (P2PKH), 0x01+scripthash (P2SH), 0x02/0x03+x (compressed
+  P2PK), 0x04/0x05+x (uncompressed P2PK, y parity folded into the tag and
+  recomputed on decompression).  Anything else is emitted verbatim with a
+  size prefix offset by the number of special cases (nSpecialScripts = 6).
+
+* Amount compression (CompressAmount/DecompressAmount): exploits round
+  values — trailing zeroes are counted into the exponent and the first
+  nonzero digit is folded in, making typical amounts 1-2 bytes as varints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.serialize import ByteReader, ByteWriter
+
+N_SPECIAL_SCRIPTS = 6
+
+
+def write_varint(w: ByteWriter, n: int) -> None:
+    """Bitcoin's serialize.h VarInt (MSB-base-128 with continuation-minus-
+    one) — used throughout the coins encoding; unbounded unlike
+    CompactSize."""
+    out = bytearray()
+    while True:
+        out.append((n & 0x7F) | (0x80 if out else 0x00))
+        if n <= 0x7F:
+            break
+        n = (n >> 7) - 1
+    w.write(bytes(reversed(out)))
+
+
+def read_varint(r: ByteReader) -> int:
+    n = 0
+    while True:
+        b = r.u8()
+        if n > (1 << 62):
+            raise ValueError("varint too large")
+        n = (n << 7) | (b & 0x7F)
+        if b & 0x80:
+            n += 1
+        else:
+            return n
+
+
+# ------------------------------------------------------------- amounts
+
+
+def compress_amount(n: int) -> int:
+    """ref compressor.cpp CompressAmount."""
+    if n == 0:
+        return 0
+    e = 0
+    while n % 10 == 0 and e < 9:
+        n //= 10
+        e += 1
+    if e < 9:
+        d = n % 10
+        n //= 10
+        return 1 + (n * 9 + d - 1) * 10 + e
+    return 1 + (n - 1) * 10 + 9
+
+
+def decompress_amount(x: int) -> int:
+    """ref compressor.cpp DecompressAmount."""
+    if x == 0:
+        return 0
+    x -= 1
+    e = x % 10
+    x //= 10
+    if e < 9:
+        d = (x % 9) + 1
+        x //= 9
+        n = x * 10 + d
+    else:
+        n = x + 1
+    while e:
+        n *= 10
+        e -= 1
+    return n
+
+
+# ------------------------------------------------------------- scripts
+
+
+def _decompress_pubkey(tag: int, x: bytes) -> Optional[bytes]:
+    """Rebuild the 65-byte uncompressed pubkey from tag 4/5 + x."""
+    from ..crypto import secp256k1 as ec
+
+    compressed = bytes([tag - 2]) + x  # 0x02/0x03 + x
+    try:
+        pt = ec.pubkey_parse(compressed)
+    except Exception:
+        return None
+    return ec.pubkey_serialize(pt, compressed=False)
+
+
+def compress_script(script: bytes) -> Optional[bytes]:
+    """Template form or None (ref CompressScript)."""
+    # P2PKH: DUP HASH160 <20> EQUALVERIFY CHECKSIG
+    if (
+        len(script) == 25
+        and script[0] == 0x76
+        and script[1] == 0xA9
+        and script[2] == 20
+        and script[23] == 0x88
+        and script[24] == 0xAC
+    ):
+        return bytes([0x00]) + script[3:23]
+    # P2SH: HASH160 <20> EQUAL
+    if len(script) == 23 and script[0] == 0xA9 and script[1] == 20 and script[22] == 0x87:
+        return bytes([0x01]) + script[2:22]
+    # compressed P2PK
+    if (
+        len(script) == 35
+        and script[0] == 33
+        and script[34] == 0xAC
+        and script[1] in (0x02, 0x03)
+    ):
+        return script[1:34]
+    # uncompressed P2PK (validity checked so decompression round-trips)
+    if (
+        len(script) == 67
+        and script[0] == 65
+        and script[66] == 0xAC
+        and script[1] == 0x04
+    ):
+        y_parity = script[34 + 31] & 1  # low bit of y's last byte
+        candidate = bytes([0x04 | y_parity]) + script[2:34]
+        rebuilt = _decompress_pubkey(0x04 | y_parity, script[2:34])
+        if rebuilt is not None and rebuilt == script[1:66]:
+            return candidate
+    return None
+
+
+def decompress_script(tag: int, payload: bytes) -> Optional[bytes]:
+    if tag == 0x00:
+        return b"\x76\xa9\x14" + payload + b"\x88\xac"
+    if tag == 0x01:
+        return b"\xa9\x14" + payload + b"\x87"
+    if tag in (0x02, 0x03):
+        return bytes([33, tag]) + payload + b"\xac"
+    if tag in (0x04, 0x05):
+        pub = _decompress_pubkey(tag, payload)
+        if pub is None:
+            return None
+        return bytes([65]) + pub + b"\xac"
+    return None
+
+
+def write_compressed_script(w: ByteWriter, script: bytes) -> None:
+    c = compress_script(script)
+    if c is not None:
+        write_varint(w, c[0])
+        w.write(c[1:])
+        return
+    write_varint(w, len(script) + N_SPECIAL_SCRIPTS)
+    w.write(script)
+
+
+def read_compressed_script(r: ByteReader) -> bytes:
+    tag = read_varint(r)
+    if tag < N_SPECIAL_SCRIPTS:
+        size = 20 if tag in (0x00, 0x01) else 32
+        payload = r.read(size)
+        out = decompress_script(tag, payload)
+        if out is None:
+            raise ValueError("bad compressed script")
+        return out
+    return r.read(tag - N_SPECIAL_SCRIPTS)

@@ -7,7 +7,7 @@ its tip transitions with the block's undo data (which carries every spent
 prevout), so the index writer never needs to re-fetch coins.
 
 Key layout over the shared metadata KV store:
-  b"ai" + h160(20) + height(4 BE) + txid(32 BE) + n(2 BE) + kind(1)
+  b"ai" + h160(20) + height(4 BE) + txid(32 BE) + n(4 BE) + kind(1)
         -> signed delta (8 BE, two's complement)       [address deltas]
   b"si" + txid(32 BE) + n(4 BE)
         -> spending txid(32 BE) + vin(4 BE) + height(4 BE)   [spent index]
@@ -78,7 +78,7 @@ class OptionalIndexes:
                     if ak is None:
                         continue
                     self.db.put(
-                        b"ai" + ak[1] + h + txid_b + n.to_bytes(2, "big")
+                        b"ai" + ak[1] + h + txid_b + n.to_bytes(4, "big")
                         + bytes([KIND_RECV]),
                         _i64(out.value),
                     )
@@ -98,7 +98,7 @@ class OptionalIndexes:
                     if ak is None:
                         continue
                     self.db.put(
-                        b"ai" + ak[1] + h + txid_b + vi.to_bytes(2, "big")
+                        b"ai" + ak[1] + h + txid_b + vi.to_bytes(4, "big")
                         + bytes([KIND_SPEND]),
                         _i64(-prev.out.value),
                     )
@@ -118,7 +118,7 @@ class OptionalIndexes:
                     if ak is not None:
                         self.db.delete(
                             b"ai" + ak[1] + h + txid_b
-                            + n.to_bytes(2, "big") + bytes([KIND_RECV])
+                            + n.to_bytes(4, "big") + bytes([KIND_RECV])
                         )
             if tx.is_coinbase():
                 continue
@@ -135,7 +135,7 @@ class OptionalIndexes:
                     if ak is not None:
                         self.db.delete(
                             b"ai" + ak[1] + h + txid_b
-                            + vi.to_bytes(2, "big") + bytes([KIND_SPEND])
+                            + vi.to_bytes(4, "big") + bytes([KIND_SPEND])
                         )
 
     # ------------------------------------------------------------- queries
@@ -145,8 +145,8 @@ class OptionalIndexes:
         for k, v in self.db.iterate(b"ai" + h160):
             height = int.from_bytes(k[22:26], "big")
             txid = int.from_bytes(k[26:58], "big")
-            n = int.from_bytes(k[58:60], "big")
-            kind = k[60]
+            n = int.from_bytes(k[58:62], "big")
+            kind = k[62]
             out.append(
                 {
                     "height": height,
@@ -169,13 +169,13 @@ class OptionalIndexes:
         return balance, received
 
     def address_txids(self, h160: bytes) -> List[str]:
-        seen = []
-        for d in self.address_deltas(h160):
-            if d["txid"] not in seen:
-                seen.append(d["txid"])
-        return seen
+        return list(dict.fromkeys(d["txid"] for d in self.address_deltas(h160)))
 
     def address_utxos(self, h160: bytes) -> List[dict]:
+        if not self.spent:
+            raise ValueError(
+                "getaddressutxos needs -spentindex to exclude spent outputs"
+            )
         utxos = []
         for d in self.address_deltas(h160):
             if d["spending"]:

@@ -471,6 +471,7 @@ class ChainState:
         fees = 0
         sigops_cost = 0
         script_flags = self._script_flags(idx.height)
+        run_scripts = self._script_checks_required(idx)
         control = CheckQueueControl(self.checkqueue)
         # asset rules activate by height (buried) OR by BIP9 deployment
         # (ref AreAssetsDeployed, chainparams.cpp:130-154)
@@ -504,7 +505,10 @@ class ChainState:
                     for j, txin in enumerate(tx.vin):
                         coin = view.get_coin(txin.prevout)
                         assert coin is not None
-                        checks.append(_script_check(tx, j, coin, script_flags))
+                        if run_scripts:
+                            checks.append(
+                                _script_check(tx, j, coin, script_flags)
+                            )
                         spent_pairs.append((coin.out.script_pubkey, coin))
                         spent = view.spend_coin(txin.prevout)
                         txundo.prevouts.append(spent)
@@ -590,6 +594,24 @@ class ChainState:
                     view.add_coin(tx.vin[j].prevout, txundo.prevouts[j], overwrite=True)
         view.set_best_block(idx.prev.block_hash if idx.prev else 0)
 
+    def _script_checks_required(self, idx: BlockIndex) -> bool:
+        """-assumevalid (ref validation.cpp fScriptChecks): blocks that are
+        ancestors of a configured known-good block skip per-input script
+        verification; everything else (PoW, merkle, amounts, asset state,
+        undo) still runs.  The assumed-valid block must be in the block
+        index and have more work than the candidate."""
+        av = getattr(self, "assume_valid_hash", 0) or (
+            self.params.consensus.default_assume_valid
+        )
+        if not av:
+            return True
+        av_idx = self.block_index.get(av)
+        if av_idx is None:
+            return True
+        if idx.height > av_idx.height:
+            return True
+        return av_idx.get_ancestor(idx.height) is not idx
+
     def _script_flags(self, height: int) -> int:
         """ref GetBlockScriptFlags: this chain runs P2SH+DERSIG+CLTV+CSV from
         genesis (all deployments buried)."""
@@ -620,6 +642,11 @@ class ChainState:
         dpos, _ = self.positions[idx.block_hash]
         self.positions[idx.block_hash] = (dpos, upos)
         idx.status |= BlockStatus.HAVE_UNDO
+        # index records go in BEFORE the coin flush: a crash in between
+        # replays this block on restart and the puts are idempotent, so
+        # the coins write remains the single commit point
+        if getattr(self, "indexes", None) is not None:
+            self.indexes.index_block(block, idx, undo)
         view.flush()
         idx.raise_validity(BlockStatus.VALID_SCRIPTS)
         self.active.set_tip(idx)
@@ -628,8 +655,6 @@ class ChainState:
         from .fees import fee_estimator
 
         fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
-        if getattr(self, "indexes", None) is not None:
-            self.indexes.index_block(block, idx, undo)
         main_signals.block_connected(block, idx, [])
 
     def _disconnect_tip(self) -> Block:

@@ -48,10 +48,37 @@ class AddrMan:
             [None] * BUCKET_SIZE for _ in range(TRIED_BUCKETS)
         ]
 
+    @staticmethod
+    def _group(ip: str) -> str:
+        """Netgroup for eclipse resistance — /16 for IPv4 (ref netaddress
+        GetGroup); non-IPv4 falls back to a short prefix."""
+        parts = ip.split(".")
+        if len(parts) == 4:
+            return f"{parts[0]}.{parts[1]}"
+        return ip[:8]
+
     def _bucket(self, key: str, tried: bool, source: str = "") -> Tuple[int, int]:
-        h = siphash(self._key, 0x1337 if tried else 0x7331, (key + source).encode())
-        nbuckets = TRIED_BUCKETS if tried else NEW_BUCKETS
-        return (h % nbuckets, (h >> 16) % BUCKET_SIZE)
+        """Bucket placement (ref addrman.h GetTriedBucket/GetNewBucket).
+
+        New: addresses from one source netgroup spread over at most 8
+        buckets, so a single /16 attacker cannot dominate the new table.
+        Tried: an address's own netgroup limits it to 8 tried buckets.
+        """
+        ip = key.rsplit(":", 1)[0]
+        if tried:
+            h1 = siphash(self._key, 0xA1, key.encode()) % 8
+            h = siphash(
+                self._key, 0xA2, f"{self._group(ip)}|{h1}".encode()
+            )
+            return (h % TRIED_BUCKETS,
+                    siphash(self._key, 0xA3, key.encode()) % BUCKET_SIZE)
+        src_group = self._group(source.rsplit(":", 1)[0]) if source else ""
+        h1 = siphash(
+            self._key, 0xB1, f"{src_group}|{self._group(ip)}".encode()
+        ) % 8
+        h = siphash(self._key, 0xB2, f"{src_group}|{h1}".encode())
+        return (h % NEW_BUCKETS,
+                siphash(self._key, 0xB3, key.encode()) % BUCKET_SIZE)
 
     # -- mutation ---------------------------------------------------------
 

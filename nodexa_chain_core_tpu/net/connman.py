@@ -116,6 +116,7 @@ class ConnMan:
             self._spawn(self._accept_loop, "net.accept")
         self._spawn(self._message_handler_loop, "net.msghand")
         self._spawn(self._maintenance_loop, "net.maint")
+        self._spawn(self._open_connections_loop, "net.opencon")
         log_printf("P2P listening on port %d", self.port)
 
     def stop(self) -> None:
@@ -141,7 +142,9 @@ class ConnMan:
 
     def connect_to(self, addr: str) -> bool:
         """Outbound connection (ref OpenNetworkConnection)."""
-        host, _, port_s = addr.partition(":")
+        host, _, port_s = addr.rpartition(":")
+        if not host:
+            host, port_s = port_s, ""
         port = int(port_s or self.node.params.default_port)
         if self.is_banned(host):
             return False
@@ -290,6 +293,70 @@ class ConnMan:
             if periodic is not None:
                 periodic()
             time.sleep(5)
+
+    FEELER_INTERVAL = 120.0
+
+    def _dns_seed(self) -> None:
+        """ref ThreadDNSAddressSeed: resolve the chain's seeds into the
+        address manager when it is empty."""
+        for seed in getattr(self.node.params, "dns_seeds", ()) or ():
+            try:
+                infos = socket.getaddrinfo(
+                    seed,
+                    self.node.params.default_port,
+                    family=socket.AF_INET,  # connect_to speaks IPv4
+                    proto=socket.IPPROTO_TCP,
+                )
+            except OSError:
+                continue
+            for _, _, _, _, sockaddr in infos:
+                self.addrman.add(sockaddr[0], sockaddr[1], source=seed)
+        if self.addrman.size():
+            log_printf("dns seeding added %d addresses", self.addrman.size())
+
+    def _open_connections_loop(self) -> None:
+        """ref ThreadOpenConnections: keep MAX_OUTBOUND slots filled from
+        addrman, plus periodic feeler connections that test NEW-table
+        entries and promote them to tried (ref net.cpp feeler logic)."""
+        last_seed_try = 0.0
+        last_feeler = time.time()
+        while not self._stop.is_set():
+            time.sleep(2)
+            if self._stop.is_set():
+                return
+            with self._peers_lock:
+                outbound = sum(1 for p in self.peers.values() if not p.inbound)
+                connected = {f"{p.ip}:{p.port}" for p in self.peers.values()}
+            # keep retrying DNS while isolated (transient resolver failure
+            # must not strand the node — ref ThreadDNSAddressSeed)
+            if (
+                self.addrman.size() == 0
+                and outbound == 0
+                and time.time() - last_seed_try >= 60.0
+            ):
+                last_seed_try = time.time()
+                self._dns_seed()
+            if outbound < self.MAX_OUTBOUND:
+                info = self.addrman.select()
+                if (
+                    info is not None
+                    and info.key() not in connected
+                    and not self.is_banned(info.ip)
+                ):
+                    self.connect_to(info.key())
+            now = time.time()
+            if now - last_feeler >= self.FEELER_INTERVAL:
+                last_feeler = now
+                info = self.addrman.select(new_only=True)
+                if info is not None and info.key() not in connected:
+                    if self.connect_to(info.key()):
+                        with self._peers_lock:
+                            for p in self.peers.values():
+                                if (
+                                    not p.inbound
+                                    and f"{p.ip}:{p.port}" == info.key()
+                                ):
+                                    p.feeler = True
 
     # -- bans (ref banlist.dat / CBanDB) ----------------------------------
 

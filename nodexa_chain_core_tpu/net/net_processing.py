@@ -171,7 +171,17 @@ class NetProcessor:
     def _on_verack(self, peer, r: ByteReader) -> None:
         peer.verack_received = True
         peer.handshake_done = True
-        self.connman.addrman.good(peer.ip, peer.port)
+        if not peer.inbound:
+            # inbound remotes connect from ephemeral ports — only outbound
+            # targets are provenly dialable addresses (ref CAddrMan usage)
+            self.connman.addrman.good(peer.ip, peer.port)
+        if getattr(peer, "feeler", False):
+            # feeler's job is done: the address is proven live and now
+            # tried (ref net.cpp feeler disconnect-after-verack)
+            peer.disconnect = True
+            return
+        if not peer.inbound:
+            peer.send_msg(self.magic, MSG_GETADDR)  # harvest addresses
         peer.send_msg(self.magic, MSG_SENDHEADERS)
         w = ByteWriter()
         w.u8(1)  # announce via cmpctblock (high-bandwidth mode)

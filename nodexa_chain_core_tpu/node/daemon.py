@@ -70,6 +70,11 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         log_printf("-reindex: reconnected %d blocks, height %d", n,
                    node.chainstate.tip().height if node.chainstate.tip() else -1)
 
+    # -assumevalid: skip script checks under a known-good block (ref
+    # init.cpp -assumevalid / Consensus::Params defaultAssumeValid)
+    if g_args.is_set("assumevalid"):
+        node.chainstate.assume_valid_hash = int(g_args.get("assumevalid"), 16)
+
     # Step 7b: CVerifyDB-style startup sanity sweep (ref validation.cpp:12564)
     check_blocks = g_args.get_int("checkblocks", 6)
     check_level = g_args.get_int("checklevel", 3)

@@ -10,13 +10,12 @@ from ..script.standard import KeyID, ScriptID, decode_destination
 from .server import RPC_INVALID_PARAMETER, RPC_MISC_ERROR, RPCError, RPCTable
 
 
-def _indexes(node):
+def _indexes(node, need: str):
     ix = getattr(node.chainstate, "indexes", None)
-    if ix is None:
+    if ix is None or not getattr(ix, need):
         raise RPCError(
             RPC_MISC_ERROR,
-            "address/spent/timestamp indexes not enabled "
-            "(-addressindex/-spentindex/-timestampindex)",
+            f"{need} index not enabled (-{need}index)",
         )
     return ix
 
@@ -39,7 +38,7 @@ def _h160s(node, params) -> List[bytes]:
 
 
 def getaddressbalance(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "address")
     balance = 0
     received = 0
     for h in _h160s(node, params):
@@ -50,7 +49,7 @@ def getaddressbalance(node, params: List[Any]):
 
 
 def getaddresstxids(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "address")
     txids: List[str] = []
     for h in _h160s(node, params):
         for t in ix.address_txids(h):
@@ -60,7 +59,7 @@ def getaddresstxids(node, params: List[Any]):
 
 
 def getaddressdeltas(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "address")
     out = []
     for h in _h160s(node, params):
         out.extend(ix.address_deltas(h))
@@ -68,7 +67,8 @@ def getaddressdeltas(node, params: List[Any]):
 
 
 def getaddressutxos(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "address")
+    _indexes(node, "spent")  # spent records are needed to exclude spends
     out = []
     for h in _h160s(node, params):
         out.extend(ix.address_utxos(h))
@@ -76,7 +76,7 @@ def getaddressutxos(node, params: List[Any]):
 
 
 def getspentinfo(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "spent")
     if not params or not isinstance(params[0], dict):
         raise RPCError(RPC_INVALID_PARAMETER, '{"txid": ..., "index": n}')
     info = ix.spent_info(params[0]["txid"], int(params[0]["index"]))
@@ -86,7 +86,7 @@ def getspentinfo(node, params: List[Any]):
 
 
 def getblockhashes(node, params: List[Any]):
-    ix = _indexes(node)
+    ix = _indexes(node, "timestamp")
     if len(params) < 2:
         raise RPCError(RPC_INVALID_PARAMETER, "high and low timestamps required")
     return ix.block_hashes_by_time(int(params[0]), int(params[1]))

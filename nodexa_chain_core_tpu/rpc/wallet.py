@@ -34,6 +34,79 @@ def _wallet(node):
     return node.wallet
 
 
+def _wallets(node):
+    if not hasattr(node, "wallets"):
+        node.wallets = {}
+        if node.wallet is not None:
+            node.wallets[getattr(node.wallet, "name", "")] = node.wallet
+    return node.wallets
+
+
+def createwallet(node, params: List[Any]):
+    """ref createwallet (multiwallet)."""
+    from ..wallet.wallet import Wallet
+
+    import os
+
+    name = str(params[0])
+    wallets = _wallets(node)
+    if not name or name in wallets:
+        raise RPCError(RPC_INVALID_PARAMETER, f"bad or duplicate name {name!r}")
+    path = os.path.join(node.datadir, "wallets", f"{name}.json")
+    if os.path.exists(path):
+        raise RPCError(
+            RPC_WALLET_ERROR, f"wallet {name!r} already exists on disk"
+        )
+    w = Wallet.load_or_create(node, name=name)
+    wallets[name] = w
+    return {"name": name, "warning": ""}
+
+
+def loadwallet(node, params: List[Any]):
+    import os
+
+    from ..wallet.wallet import Wallet
+
+    name = str(params[0])
+    wallets = _wallets(node)
+    if name in wallets:
+        raise RPCError(RPC_INVALID_PARAMETER, f"wallet {name!r} already loaded")
+    path = os.path.join(node.datadir, "wallets", f"{name}.json")
+    if not os.path.exists(path):
+        raise RPCError(RPC_WALLET_ERROR, f"wallet {name!r} not found")
+    w = Wallet.load_or_create(node, name=name)
+    wallets[name] = w
+    return {"name": name, "warning": ""}
+
+
+def unloadwallet(node, params: List[Any]):
+    name = str(params[0]) if params else getattr(node.wallet, "name", "")
+    wallets = _wallets(node)
+    w = wallets.pop(name, None)
+    if w is None:
+        raise RPCError(RPC_INVALID_PARAMETER, f"wallet {name!r} not loaded")
+    w.unload()
+    if node.wallet is w:
+        node.wallet = next(iter(wallets.values()), None)
+    return None
+
+
+def listwallets(node, params: List[Any]):
+    return sorted(_wallets(node).keys())
+
+
+def setactivewallet(node, params: List[Any]):
+    """Select which loaded wallet the wallet RPCs operate on.  (The
+    reference routes per-request via the /wallet/<name> URL; this
+    framework's single-endpoint server selects statefully instead.)"""
+    name = str(params[0])
+    wallets = _wallets(node)
+    if name not in wallets:
+        raise RPCError(RPC_INVALID_PARAMETER, f"wallet {name!r} not loaded")
+    node.wallet = wallets[name]
+    return {"active": name}
+
+
 def _amount_to_sat(v) -> int:
     if isinstance(v, (int, float)):
         return int(round(float(v) * COIN))
@@ -279,5 +352,10 @@ def register(table: RPCTable) -> None:
         ("walletpassphrasechange", walletpassphrasechange,
          ["oldpassphrase", "newpassphrase"]),
         ("bumpfee", bumpfee, ["txid"]),
+        ("createwallet", createwallet, ["wallet_name"]),
+        ("loadwallet", loadwallet, ["filename"]),
+        ("unloadwallet", unloadwallet, ["wallet_name"]),
+        ("listwallets", listwallets, []),
+        ("setactivewallet", setactivewallet, ["wallet_name"]),
     ]:
         table.register("wallet", name, fn, args)

@@ -172,11 +172,18 @@ class Wallet(ValidationInterface):
     # ------------------------------------------------------------ creation
 
     @classmethod
-    def load_or_create(cls, node) -> "Wallet":
-        path = (
-            os.path.join(node.datadir, "wallet.json") if node.datadir else None
-        )
+    def load_or_create(cls, node, name: str = "") -> "Wallet":
+        """Default wallet lives at wallet.json; named wallets (multiwallet,
+        ref -wallet=<name> / createwallet) under wallets/<name>.json."""
+        path = None
+        if node.datadir:
+            if name:
+                path = os.path.join(node.datadir, "wallets", f"{name}.json")
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+            else:
+                path = os.path.join(node.datadir, "wallet.json")
         w = cls(node, path)
+        w.name = name
         if path and os.path.exists(path):
             w._load()
         else:
@@ -185,6 +192,11 @@ class Wallet(ValidationInterface):
             w.flush()
         main_signals.register(w)
         return w
+
+    def unload(self) -> None:
+        """ref UnloadWallet: flush and stop receiving chain events."""
+        self.flush()
+        main_signals.unregister(self)
 
     def generate_hd_chain(self, mnemonic: Optional[str] = None) -> None:
         """ref CWallet::GenerateNewHDChain + BIP44."""

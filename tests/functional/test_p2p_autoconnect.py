@@ -1,0 +1,30 @@
+"""Functional: automatic outbound connections from addrman (parity:
+reference ThreadOpenConnections; addr gossip seeds the address manager and
+the open-connections thread dials without -connect)."""
+
+import time
+
+import pytest
+
+from .framework import TestFramework
+from .test_mining_basic import ADDR
+
+
+@pytest.mark.functional
+def test_outbound_from_addrman_gossip():
+    with TestFramework(num_nodes=3) as f:
+        n0, n1, n2 = f.nodes
+        # n1 learns n0 directly; n2 only ever hears about n0 via n1's gossip
+        f.connect_nodes(1, 0)
+        f.connect_nodes(2, 1)
+        time.sleep(1)
+        # push n0's address into n2's addrman via addr gossip
+        n1.rpc.generatetoaddress(1, ADDR)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            peers = {p["addr"] for p in n2.rpc.getpeerinfo()}
+            if any(str(n0.p2p_port) in a for a in peers):
+                break
+            time.sleep(1)
+        peers = {p["addr"] for p in n2.rpc.getpeerinfo()}
+        assert any(str(n0.p2p_port) in a for a in peers), peers

@@ -66,3 +66,34 @@ def test_wallet_survives_restart():
         n0.start()
         assert n0.rpc.getbalance() == bal
         assert n0.rpc.getmnemonic()["mnemonic"] == mnemonic
+
+
+@pytest.mark.functional
+def test_multiwallet():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        assert n0.rpc.listwallets() == [""]
+        n0.rpc.createwallet("miner")
+        n0.rpc.createwallet("cold")
+        assert n0.rpc.listwallets() == ["", "cold", "miner"]
+        # mine into the "miner" wallet only
+        n0.rpc.setactivewallet("miner")
+        miner_addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, miner_addr)
+        assert n0.rpc.getbalance() > 0
+        n0.rpc.setactivewallet("cold")
+        assert n0.rpc.getbalance() == 0
+        cold_addr = n0.rpc.getnewaddress()
+        # send from miner to cold
+        n0.rpc.setactivewallet("miner")
+        n0.rpc.sendtoaddress(cold_addr, 123)
+        n0.rpc.generatetoaddress(1, miner_addr)
+        n0.rpc.setactivewallet("cold")
+        assert n0.rpc.getbalance() == 123
+        # unload + reload round-trip
+        n0.rpc.setactivewallet("miner")
+        n0.rpc.unloadwallet("cold")
+        assert n0.rpc.listwallets() == ["", "miner"]
+        n0.rpc.loadwallet("cold")
+        n0.rpc.setactivewallet("cold")
+        assert n0.rpc.getbalance() == 123

@@ -1,0 +1,93 @@
+"""-assumevalid script-check elision (ref feature_assumevalid.py +
+validation.cpp fScriptChecks)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.validation import (
+    BlockValidationError,
+    ChainState,
+)
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+def _mine(cs, params, spk, t, extra_tx=None):
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=t)
+    if extra_tx is not None:
+        blk.vtx.append(extra_tx)
+        from nodexa_chain_core_tpu.consensus.merkle import block_merkle_root
+
+        blk.header.hash_merkle_root = block_merkle_root(blk)[0]
+        blk.header._cached_hash = None
+    assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 20)
+    return blk
+
+
+def test_bad_signature_accepted_only_under_assumevalid():
+    params = select_params("regtest")
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xAB)))
+
+    # build a donor chain to learn the headers/hashes (scripts all valid)
+    donor = ChainState(params)
+    t = params.genesis_time + 60
+    for _ in range(110):
+        donor.process_new_block(_mine(donor, params, spk, t))
+        t += 60
+    cb = donor.read_block(donor.active.at(1)).vtx[0]
+    # tx with a GARBAGE signature spending the height-1 coinbase
+    bad_tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0), script_sig=b"\x01\x51" * 30)],
+        vout=[TxOut(value=4000 * COIN, script_pubkey=spk.raw)],
+    )
+    bad_block = _mine(donor, params, spk, t, extra_tx=bad_tx)
+    donor_tip = donor.tip().block_hash
+    donor.process_new_block(bad_block)
+    # connect failed: block marked invalid, tip unchanged (ref ABC flow)
+    assert donor.tip().block_hash == donor_tip
+    assert donor.lookup(bad_block.get_hash()) in donor.invalid
+
+    # replay the same chain + bad block into a fresh chainstate that
+    # assumes the bad block's hash is valid: script checks are skipped
+    av = ChainState(params)
+    av.assume_valid_hash = bad_block.get_hash()
+    for h in range(1, 111):
+        av.process_new_block(donor.read_block(donor.active.at(h)))
+    av.process_new_block(bad_block)  # accepted: scripts elided
+    assert av.tip().block_hash == bad_block.get_hash()
+
+    # blocks past the assumevalid point verify scripts again
+    t2 = t + 60
+    bad_tx2 = Transaction(
+        version=2,
+        vin=[
+            TxIn(
+                prevout=OutPoint(donor.read_block(donor.active.at(2)).vtx[0].txid, 0),
+                script_sig=b"\x01\x51" * 30,
+            )
+        ],
+        vout=[TxOut(value=4000 * COIN, script_pubkey=spk.raw)],
+    )
+    asm = BlockAssembler(av)
+    blk2 = asm.create_new_block(spk.raw, ntime=t2)
+    blk2.vtx.append(bad_tx2)
+    from nodexa_chain_core_tpu.consensus.merkle import block_merkle_root
+
+    blk2.header.hash_merkle_root = block_merkle_root(blk2)[0]
+    blk2.header._cached_hash = None
+    assert mine_block_cpu(blk2, params.algo_schedule, max_tries=1 << 20)
+    av_tip = av.tip().block_hash
+    av.process_new_block(blk2)
+    assert av.tip().block_hash == av_tip  # scripts verified again: rejected
+    assert av.lookup(blk2.get_hash()) in av.invalid

@@ -1,0 +1,90 @@
+"""UTXO compression (ref src/compressor.{h,cpp} + compress_tests.cpp)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.compressor import (
+    compress_amount,
+    compress_script,
+    decompress_amount,
+    read_compressed_script,
+    write_compressed_script,
+)
+from nodexa_chain_core_tpu.chain.coins import Coin
+from nodexa_chain_core_tpu.core.amount import COIN
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.crypto import secp256k1 as ec
+from nodexa_chain_core_tpu.primitives.transaction import TxOut
+
+
+def test_varint_roundtrip():
+    from nodexa_chain_core_tpu.chain.compressor import read_varint, write_varint
+
+    for n in [0, 1, 0x7F, 0x80, 0x407F, 0x4080, 10**12, (1 << 60)]:
+        w = ByteWriter()
+        write_varint(w, n)
+        assert read_varint(ByteReader(w.getvalue())) == n
+
+
+def test_amount_compression_roundtrip():
+    # ref compress_tests.cpp sweep: powers, oddballs, max money
+    cases = [0, 1, 2, 5, 10, 100, 1000, COIN, 3 * COIN, 50 * COIN,
+             5000 * COIN, 20_999_999_999_999_999, 123_456_789]
+    for n in cases:
+        assert decompress_amount(compress_amount(n)) == n
+    # round amounts compress small
+    assert compress_amount(50 * COIN) < 100
+
+
+def test_script_compression_templates():
+    keyhash = bytes(range(20))
+    p2pkh = b"\x76\xa9\x14" + keyhash + b"\x88\xac"
+    c = compress_script(p2pkh)
+    assert c == b"\x00" + keyhash
+
+    p2sh = b"\xa9\x14" + keyhash + b"\x87"
+    assert compress_script(p2sh) == b"\x01" + keyhash
+
+    pub_c = ec.pubkey_serialize(ec.pubkey_create(7), compressed=True)
+    p2pk_c = bytes([33]) + pub_c + b"\xac"
+    assert compress_script(p2pk_c) == pub_c
+
+    pub_u = ec.pubkey_serialize(ec.pubkey_create(7), compressed=False)
+    p2pk_u = bytes([65]) + pub_u + b"\xac"
+    cu = compress_script(p2pk_u)
+    assert cu is not None and len(cu) == 33 and cu[0] in (4, 5)
+
+    assert compress_script(b"\x6a\x04test") is None  # OP_RETURN: verbatim
+
+
+@pytest.mark.parametrize(
+    "script",
+    [
+        b"\x76\xa9\x14" + bytes(range(20)) + b"\x88\xac",
+        b"\xa9\x14" + bytes(20) + b"\x87",
+        bytes([33]) + ec.pubkey_serialize(ec.pubkey_create(99)) + b"\xac",
+        bytes([65])
+        + ec.pubkey_serialize(ec.pubkey_create(99), compressed=False)
+        + b"\xac",
+        b"\x6a\x10" + bytes(16),  # nulldata
+        b"\x51\x52\x93",  # arbitrary
+        b"",
+    ],
+)
+def test_script_wire_roundtrip(script):
+    w = ByteWriter()
+    write_compressed_script(w, script)
+    assert read_compressed_script(ByteReader(w.getvalue())) == script
+
+
+def test_coin_roundtrip_is_compact():
+    keyhash = bytes(20)
+    out = TxOut(value=5000 * COIN, script_pubkey=b"\x76\xa9\x14" + keyhash + b"\x88\xac")
+    coin = Coin(out=out, height=1234, coinbase=True)
+    w = ByteWriter()
+    coin.serialize(w)
+    raw = w.getvalue()
+    assert len(raw) < 30  # vs ~38 uncompressed
+    back = Coin.deserialize(ByteReader(raw))
+    assert back.out.value == coin.out.value
+    assert back.out.script_pubkey == coin.out.script_pubkey
+    assert back.height == 1234 and back.coinbase
