@@ -132,6 +132,21 @@ def mine_block_cpu(block: Block, schedule, max_tries: int = 1 << 22) -> bool:
         block.header.mix_hash = mix
         block.header._cached_hash = None
         return True
+    algo = schedule.era_algo(block.header.time)
+    if algo in ("x16r", "x16rv2"):
+        # native scan (ref GenerateClores' nonce loop) — ~100x the Python
+        # rehash path
+        from ..crypto import x16r_native
+
+        header80 = block.header.pow_header_bytes(schedule)
+        found = x16r_native.search(
+            header80, target, iterations=max_tries, v2=algo == "x16rv2"
+        )
+        if found is None:
+            return False
+        block.header.nonce = found[0]
+        block.header._cached_hash = None
+        return True
     for nonce in range(max_tries):
         block.header.nonce = nonce
         block.header._cached_hash = None
@@ -140,13 +155,38 @@ def mine_block_cpu(block: Block, schedule, max_tries: int = 1 << 22) -> bool:
     return False
 
 
-def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10) -> bool:
-    """TPU mesh nonce search for real difficulties (the reference's
-    equivalent is the external GPU miner via getblocktemplate)."""
-    from ..parallel.pow_search import Sha256dMiner
+def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
+                   kawpow_verifier=None) -> bool:
+    """Accelerated nonce search by era (the reference's live-era analogue
+    is the external GPU miner via getblocktemplate).
+
+    KawPow era: the device-resident BatchVerifier scans nonce64 batches on
+    TPU (same kernel as verification).  X16R/X16RV2: the native scan.
+    sha256d (test schedules): the Pallas/mesh sha256d miner.
+    """
     from ..core.uint256 import bits_to_target
 
     target, _, _ = bits_to_target(block.header.bits)
+    algo = schedule.era_algo(block.header.time)
+    if algo == "kawpow":
+        if kawpow_verifier is None:
+            return mine_block_cpu(block, schedule, max_tries=max_batches * 64)
+        header_hash = block.header.kawpow_header_hash(schedule)[::-1]
+        for b in range(max_batches):
+            found = kawpow_verifier.search(
+                header_hash, block.header.height, target,
+                start_nonce=b * 2048, batch=2048,
+            )
+            if found is not None:
+                block.header.nonce64 = found[0]
+                block.header.mix_hash = found[2]
+                block.header._cached_hash = None
+                return True
+        return False
+    if algo in ("x16r", "x16rv2"):
+        return mine_block_cpu(block, schedule, max_tries=max_batches * 4096)
+    from ..parallel.pow_search import Sha256dMiner
+
     prefix = block.header.pow_header_bytes(schedule)[:76]
     miner = Sha256dMiner(prefix, target)
     res = miner.mine(max_batches=max_batches)

@@ -596,6 +596,30 @@ class BatchVerifier:
                 return b
         raise ValueError(f"batch of {n} exceeds the largest bucket")
 
+    def search(self, header_hash: bytes, height: int, target_le_int: int,
+               start_nonce: int = 0, batch: int = 2048):
+        """TPU nonce scan for KawPow mining: hash `batch` consecutive
+        nonces of one header as a single device program and return
+        (nonce64, final_le_int, mix_le_int) of the first winner, or None.
+
+        The reference's live-era mining happens on external GPU miners via
+        getblocktemplate; this is the TPU-native equivalent of that inner
+        loop (same math as verification — ProgPoW is symmetric).
+        """
+        nonces = [start_nonce + i for i in range(batch)]
+        finals, mixes = self.hash_batch(
+            [header_hash] * batch, nonces, [height] * batch
+        )
+        for i in range(batch):
+            final_le = int.from_bytes(finals[i][::-1], "little")
+            if final_le <= target_le_int:
+                return (
+                    nonces[i],
+                    final_le,
+                    int.from_bytes(mixes[i][::-1], "little"),
+                )
+        return None
+
     def hash_batch(self, header_hashes, nonces, heights):
         """header_hashes: list of 32-byte hashes; nonces/heights: ints.
 

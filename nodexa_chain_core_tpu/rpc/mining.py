@@ -54,9 +54,19 @@ def generatetoaddress_tpu(node, params: List[Any]):
     spk = script_for_destination(dest)
     hashes = []
     asm = BlockAssembler(node.chainstate)
+    mgr = getattr(node, "epoch_manager", None)
     for _ in range(nblocks):
         block = asm.create_new_block(spk.raw)
-        if not mine_block_tpu(block, node.params.algo_schedule):
+        verifier = None
+        if mgr is not None and node.params.algo_schedule.is_kawpow(
+            block.header.time
+        ):
+            from ..crypto.kawpow import epoch_number
+
+            verifier = mgr.verifier(epoch_number(block.header.height))
+        if not mine_block_tpu(
+            block, node.params.algo_schedule, kawpow_verifier=verifier
+        ):
             raise RPCError(RPC_MISC_ERROR, "nonce space exhausted")
         node.chainstate.process_new_block(block)
         hashes.append(u256_hex(block.get_hash()))

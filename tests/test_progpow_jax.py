@@ -67,6 +67,26 @@ def test_seed_absorb_matches(epoch):
     assert got == want
 
 
+def test_search_finds_verified_nonce(epoch):
+    l1, dag = epoch
+    verifier = pj.BatchVerifier(l1, dag)
+    header = bytes((i * 3 + 1) % 256 for i in range(32))
+    height = 42
+    target = 1 << 252  # ~1-in-16 per nonce
+    found = verifier.search(header, height, target, start_nonce=0, batch=64)
+    assert found is not None
+    nonce, final_le, mix_le = found
+    assert final_le <= target
+    # the winner re-verifies through the spec
+    want_final, want_mix = _ref_hash(l1, dag, height, header, nonce)
+    assert int.from_bytes(want_final[::-1], "little") == final_le
+    assert int.from_bytes(want_mix[::-1], "little") == mix_le
+    # nothing below the winning nonce qualifies (first-hit semantics)
+    for n in range(nonce):
+        f, _ = _ref_hash(l1, dag, height, header, n)
+        assert int.from_bytes(f[::-1], "little") > target
+
+
 def test_vectorized_plans_match_scalar_replay():
     periods = [0, 1, 7, 33333, 10**7]
     vec = pj.plans_for_periods(periods)
