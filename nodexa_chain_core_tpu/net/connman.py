@@ -140,8 +140,12 @@ class ConnMan:
 
     # -- connections -------------------------------------------------------
 
-    def connect_to(self, addr: str) -> bool:
-        """Outbound connection (ref OpenNetworkConnection)."""
+    def connect_to(self, addr: str, manual: bool = True) -> bool:
+        """Outbound connection (ref OpenNetworkConnection).  `manual`
+        marks -addnode/-connect/RPC peers: they never feed addrman, so a
+        test-framework disconnect is not undone by the automatic
+        open-connections loop (same behavior as the reference's manual
+        connection class)."""
         host, _, port_s = addr.rpartition(":")
         if not host:
             host, port_s = port_s, ""
@@ -155,11 +159,13 @@ class ConnMan:
             self.addrman.attempt(host, port)
             return False
         peer = Peer(sock, (host, port), inbound=False)
+        peer.manual = manual
         with self._peers_lock:
             self.peers[peer.id] = peer
         self._spawn(lambda: self._reader_loop(peer), f"net.peer{peer.id}")
         self.processor.init_peer(peer)
-        self.addrman.attempt(host, port)
+        if not manual:
+            self.addrman.attempt(host, port)
         return True
 
     def disconnect(self, addr: str) -> None:
@@ -343,13 +349,13 @@ class ConnMan:
                     and info.key() not in connected
                     and not self.is_banned(info.ip)
                 ):
-                    self.connect_to(info.key())
+                    self.connect_to(info.key(), manual=False)
             now = time.time()
             if now - last_feeler >= self.FEELER_INTERVAL:
                 last_feeler = now
                 info = self.addrman.select(new_only=True)
                 if info is not None and info.key() not in connected:
-                    if self.connect_to(info.key()):
+                    if self.connect_to(info.key(), manual=False):
                         with self._peers_lock:
                             for p in self.peers.values():
                                 if (

@@ -171,9 +171,10 @@ class NetProcessor:
     def _on_verack(self, peer, r: ByteReader) -> None:
         peer.verack_received = True
         peer.handshake_done = True
-        if not peer.inbound:
-            # inbound remotes connect from ephemeral ports — only outbound
-            # targets are provenly dialable addresses (ref CAddrMan usage)
+        if not peer.inbound and not getattr(peer, "manual", False):
+            # inbound remotes connect from ephemeral ports and manual
+            # peers are operator/test wiring — only addrman-sourced
+            # outbound targets are recorded (ref CAddrMan usage)
             self.connman.addrman.good(peer.ip, peer.port)
         if getattr(peer, "feeler", False):
             # feeler's job is done: the address is proven live and now

@@ -73,9 +73,67 @@ def generatetoaddress_tpu(node, params: List[Any]):
     return hashes
 
 
+class _TipWaiter:
+    """Long-poll support (ref getblocktemplate's WaitForNewBlock path,
+    rpc/mining.cpp:380-420): RPC worker threads block on a condition the
+    validation bus signals from updated_block_tip."""
+
+    def __init__(self):
+        import threading
+
+        self._cond = threading.Condition()
+        self._registered = False
+
+    def _ensure(self):
+        with self._cond:  # registration races resolved under the lock
+            if self._registered:
+                return
+            self._registered = True
+        from ..node.events import ValidationInterface, main_signals
+
+        waiter = self
+
+        class _Sub(ValidationInterface):
+            def updated_block_tip(self, new_tip, fork_tip, initial_download):
+                with waiter._cond:
+                    waiter._cond.notify_all()
+
+        main_signals.register(_Sub())
+
+    def wait_for_new_tip(self, node, old_tip_hash: int, timeout: float) -> None:
+        self._ensure()
+        import time as _t
+
+        deadline = _t.time() + timeout
+        with self._cond:
+            while _t.time() < deadline:
+                tip = node.chainstate.tip()
+                if tip is not None and tip.block_hash != old_tip_hash:
+                    return
+                self._cond.wait(timeout=min(1.0, deadline - _t.time()))
+
+
+_tip_waiter = _TipWaiter()
+
+
 def getblocktemplate(node, params: List[Any]):
-    """ref rpc/mining.cpp:316 (subset: template mode for external miners)."""
+    """ref rpc/mining.cpp:316 (template mode + longpoll for external
+    miners)."""
     cs = node.chainstate
+    req = params[0] if params and isinstance(params[0], dict) else {}
+    longpollid = req.get("longpollid")
+    if longpollid:
+        # longpollid = <tip hash hex>-<counter>; block until the tip moves
+        # or the window lapses (kept below common 60s client socket
+        # timeouts), then fall through to a fresh template
+        try:
+            old_tip = int(str(longpollid).split("-")[0], 16)
+        except ValueError:
+            raise RPCError(RPC_INVALID_PARAMETER, "invalid longpollid")
+        from .server import yield_rpc_slot
+
+        with yield_rpc_slot():  # don't starve submitblock while waiting
+            _tip_waiter.wait_for_new_tip(node, old_tip, timeout=50.0)
     tip = cs.tip()
     asm = BlockAssembler(cs)
     block = asm.create_new_block(b"\x6a", ntime=int(time.time()))  # placeholder cb
@@ -103,6 +161,7 @@ def getblocktemplate(node, params: List[Any]):
         "height": tip.height + 1,
         "mutable": ["time", "transactions", "prevblock"],
         "noncerange": "00000000ffffffff",
+        "longpollid": f"{tip.block_hash:064x}-{len(node.mempool.txids())}",
     }
 
 

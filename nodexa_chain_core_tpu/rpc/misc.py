@@ -136,6 +136,22 @@ def getconnectioncount(node, params: List[Any]):
     return node.connman.connection_count() if node.connman else 0
 
 
+def addpeeraddress(node, params: List[Any]):
+    """Seed the address manager directly (the upstream test-only RPC:
+    local/private addresses never enter addrman through gossip, so
+    automatic-connection tests need this injection point)."""
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "address and port required")
+    ip, port = str(params[0]), int(params[1])
+    tried = bool(params[2]) if len(params) > 2 else False
+    ok = node.connman.addrman.add(ip, port)
+    if tried:
+        node.connman.addrman.good(ip, port)
+    return {"success": ok or tried}
+
+
 def addnode(node, params: List[Any]):
     if node.connman is None:
         raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
@@ -179,6 +195,7 @@ def register(table: RPCTable) -> None:
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),
         ("network", "getconnectioncount", getconnectioncount, []),
+        ("network", "addpeeraddress", addpeeraddress, ["address", "port", "tried"]),
         ("network", "addnode", addnode, ["node", "command"]),
         ("network", "setban", setban, ["subnet", "command"]),
         ("network", "listbanned", listbanned, []),

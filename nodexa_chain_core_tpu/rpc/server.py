@@ -109,6 +109,25 @@ def generate_auth_cookie(datadir: str) -> Tuple[str, str]:
     return user, password
 
 
+_rpc_slot = threading.local()
+
+
+class yield_rpc_slot:
+    """Release the worker-pool slot across a long blocking wait (longpoll)
+    so slow pollers cannot starve submitblock and friends; reacquired on
+    exit.  No-op outside an RPC worker thread (direct-call tests)."""
+
+    def __enter__(self):
+        self._sem = getattr(_rpc_slot, "sem", None)
+        if self._sem is not None:
+            self._sem.release()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sem is not None:
+            self._sem.acquire()
+
+
 class HTTPRPCServer:
     def __init__(
         self,
@@ -171,13 +190,17 @@ class HTTPRPCServer:
                     self._reply(500, _error_envelope(None, RPC_PARSE_ERROR, "Parse error"))
                     return
                 with server._sem:
-                    if isinstance(req, list):
-                        out = [server._handle_one(r) for r in req]
-                        self._reply(200, out)
-                    else:
-                        resp = server._handle_one(req)
-                        code = 200 if resp.get("error") is None else 500
-                        self._reply(code, resp)
+                    _rpc_slot.sem = server._sem
+                    try:
+                        if isinstance(req, list):
+                            out = [server._handle_one(r) for r in req]
+                            self._reply(200, out)
+                        else:
+                            resp = server._handle_one(req)
+                            code = 200 if resp.get("error") is None else 500
+                            self._reply(code, resp)
+                    finally:
+                        _rpc_slot.sem = None
 
             def do_GET(self):
                 # REST interface plugs in here (ref src/rest.cpp)

@@ -82,3 +82,35 @@ def test_restart_persists_chain():
         node.start()
         assert node.rpc.getblockcount() == 3
         assert node.rpc.getbestblockhash() == best
+
+
+@pytest.mark.functional
+def test_getblocktemplate_longpoll():
+    """ref mining_getblocktemplate_longpoll.py: a longpoll request returns
+    once a new block arrives."""
+    import threading
+    import time as _t
+
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        n0.rpc.generatetoaddress(1, ADDR)
+        tmpl = n0.rpc.getblocktemplate()
+        assert "longpollid" in tmpl
+        result = {}
+
+        def poll():
+            t0 = _t.time()
+            result["tmpl"] = n0.rpc.getblocktemplate(
+                {"longpollid": tmpl["longpollid"]}
+            )
+            result["elapsed"] = _t.time() - t0
+
+        th = threading.Thread(target=poll)
+        th.start()
+        _t.sleep(1.5)
+        assert th.is_alive()  # still long-polling, no new block yet
+        n0.rpc.generatetoaddress(1, ADDR)
+        th.join(timeout=20)
+        assert not th.is_alive()
+        assert result["elapsed"] >= 1.0  # actually waited
+        assert result["tmpl"]["height"] == 3  # template on the new tip

@@ -1,6 +1,6 @@
 """Functional: automatic outbound connections from addrman (parity:
-reference ThreadOpenConnections; addr gossip seeds the address manager and
-the open-connections thread dials without -connect)."""
+reference ThreadOpenConnections + the addpeeraddress test RPC — local
+addresses never enter addrman via gossip, matching upstream)."""
 
 import time
 
@@ -14,12 +14,9 @@ from .test_mining_basic import ADDR
 def test_outbound_from_addrman_gossip():
     with TestFramework(num_nodes=3) as f:
         n0, n1, n2 = f.nodes
-        # n1 learns n0 directly; n2 only ever hears about n0 via n1's gossip
-        f.connect_nodes(1, 0)
-        f.connect_nodes(2, 1)
-        time.sleep(1)
-        # push n0's address into n2's addrman via addr gossip
-        n1.rpc.generatetoaddress(1, ADDR)
+        # seed n2's address manager with n0 (tried) — the open-connections
+        # loop must dial it with no -connect/-addnode wiring at all
+        n2.rpc.addpeeraddress("127.0.0.1", n0.p2p_port, True)
         deadline = time.time() + 30
         while time.time() < deadline:
             peers = {p["addr"] for p in n2.rpc.getpeerinfo()}
