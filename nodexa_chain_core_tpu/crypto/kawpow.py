@@ -152,11 +152,15 @@ def dataset_slab(epoch: int, threads: int = 0):
     import numpy as np
 
     lib = native.load()
-    n = lib.nxk_full_dataset_num_items(epoch)
-    out = np.empty((n, 64), dtype=np.uint32)
+    # full_dataset_num_items counts 128-byte hash1024 items; the ProgPoW
+    # item index space is 2048-bit items = half of that (the native
+    # verifier's modulus, kawpow.cpp progpow_hash_mix)
+    n2048 = lib.nxk_full_dataset_num_items(epoch) // 2
+    out = np.empty((n2048, 64), dtype=np.uint32)
     if threads <= 0:
         threads = os.cpu_count() or 4
     lib.nxk_dataset_slab(
-        epoch, 0, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), threads
+        epoch, 0, n2048, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        threads,
     )
     return out

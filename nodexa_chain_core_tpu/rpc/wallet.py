@@ -129,8 +129,8 @@ def getunconfirmedbalance(node, params: List[Any]):
 
 def getwalletinfo(node, params: List[Any]):
     w = _wallet(node)
-    return {
-        "walletname": "default",
+    info = {
+        "walletname": getattr(w, "name", ""),
         "walletversion": 1,
         "balance": w.get_balance() / COIN,
         "unconfirmed_balance": w.get_unconfirmed_balance() / COIN,
@@ -140,6 +140,12 @@ def getwalletinfo(node, params: List[Any]):
         "hdseedid": "hd",
         "paytxfee": 0.0,
     }
+    if w.is_crypted:
+        # ref getwalletinfo's unlocked_until field (0 = locked)
+        info["unlocked_until"] = (
+            0 if w.is_locked() else int(w._unlocked_until)
+        )
+    return info
 
 
 def sendtoaddress(node, params: List[Any]):

@@ -152,3 +152,32 @@ def test_kawpow_search_regtest_difficulty():
     assert final <= target
     ok, fin = kawpow.kawpow_verify(10, hh, mix, nonce, target)
     assert ok and fin == final
+
+
+def test_dataset_slab_units_match_native_modulus():
+    """The DAG slab must be sized in 2048-bit items = full_items/2 — the
+    native verifier's index modulus (kawpow.cpp progpow mix loop); a slab
+    sized in hash1024 units silently breaks every TPU verification."""
+    import ctypes
+
+    import numpy as np
+
+    from nodexa_chain_core_tpu import native
+    from nodexa_chain_core_tpu.crypto import kawpow
+
+    lib = native.load()
+    full = lib.nxk_full_dataset_num_items(0)
+    assert full > 0
+    # build just the head of the slab through the bulk builder and check
+    # it agrees item-for-item with the scalar path
+    head = np.empty((8, 64), dtype=np.uint32)
+    lib.nxk_dataset_slab(
+        0, 0, 8, head.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 1
+    )
+    for i in range(8):
+        assert head[i].tobytes() == kawpow.dataset_item_2048(0, i)
+    # the public builder sizes in 2048-bit units (full_items / 2)
+    import inspect
+
+    src = inspect.getsource(kawpow.dataset_slab)
+    assert "// 2" in src
