@@ -125,11 +125,26 @@ def accept_to_memory_pool(
                 "insufficient-fee",
                 f"replacement pays {fee}, needs > {old_fees} + relay",
             )
-        # a replacement may not depend on an unconfirmed tx it conflicts
-        # with (cheap stand-in for rule 2's new-unconfirmed-inputs check)
+        # BIP125 rule 2: the replacement may not add NEW unconfirmed
+        # inputs — every in-pool parent it spends must already be spent by
+        # one of the directly conflicting transactions (and it may never
+        # depend on a tx it conflicts with)
+        direct_parents: set = set()
+        for c in conflicts:
+            e = pool.get(c)
+            if e is not None:
+                direct_parents.update(i.prevout.txid for i in e.tx.vin)
         for txin in tx.vin:
             if txin.prevout.txid in conflicts:
                 raise MempoolAcceptError("replacement-spends-conflict")
+            if (
+                pool.contains(txin.prevout.txid)
+                and txin.prevout.txid not in direct_parents
+            ):
+                raise MempoolAcceptError(
+                    "replacement-adds-unconfirmed",
+                    "replacement adds a new unconfirmed input (BIP125 rule 2)",
+                )
 
     # full script verification (ref CheckInputs with STANDARD flags)
     for i, txin in enumerate(tx.vin):

@@ -176,6 +176,53 @@ def test_rbf_rule6_low_feerate_replacement_rejected(wallet_node):
         assert node.mempool.contains(tx.txid)
 
 
+def test_rbf_rule2_new_unconfirmed_input_rejected(wallet_node):
+    """A replacement spending an unconfirmed parent the original didn't
+    spend violates BIP125 rule 2."""
+    node, w = wallet_node
+    spk, _ = _fund(node, w)
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+    from nodexa_chain_core_tpu.script.script import Script
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    def _tx(prevs, out_value, seq=0xFFFFFFFD):
+        t = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(p.txid, 0), sequence=seq) for p in prevs],
+            vout=[TxOut(value=out_value, script_pubkey=spk)],
+        )
+        for i, p in enumerate(prevs):
+            sign_tx_input(w.keystore, t, i, Script(p.vout[0].script_pubkey))
+        return t
+
+    cb = [node.chainstate.read_block(node.chainstate.active.at(h)).vtx[0]
+          for h in (1, 2)]
+    original = _tx([cb[0]], 4999 * COIN)
+    accept_to_memory_pool(node.chainstate, node.mempool, original)
+    # an unrelated unconfirmed tx whose output the replacement will spend
+    parent2 = _tx([cb[1]], 4999 * COIN)
+    accept_to_memory_pool(node.chainstate, node.mempool, parent2)
+    repl = Transaction(
+        version=2,
+        vin=[
+            TxIn(prevout=OutPoint(cb[0].txid, 0), sequence=0xFFFFFFFD),
+            TxIn(prevout=OutPoint(parent2.txid, 0), sequence=0xFFFFFFFD),
+        ],
+        vout=[TxOut(value=9900 * COIN, script_pubkey=spk)],
+    )
+    sign_tx_input(w.keystore, repl, 0, Script(cb[0].vout[0].script_pubkey))
+    sign_tx_input(w.keystore, repl, 1, Script(parent2.vout[0].script_pubkey))
+    with pytest.raises(MempoolAcceptError) as e:
+        accept_to_memory_pool(node.chainstate, node.mempool, repl)
+    assert e.value.code == "replacement-adds-unconfirmed"
+    assert node.mempool.contains(original.txid)
+
+
 def test_change_passphrase(wallet_node):
     node, w = wallet_node
     w.encrypt_wallet("old-pass")
