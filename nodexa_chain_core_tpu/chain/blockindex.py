@@ -40,6 +40,10 @@ class BlockIndex:
     status: BlockStatus = BlockStatus.VALID_UNKNOWN
     tx_count: int = 0
     chain_tx_count: int = 0  # cumulative txs up to and including this block
+    # arrival-order tie break for equal-work forks; preciousblock assigns
+    # decreasing negative values so the marked tip wins the tie
+    # (ref chain.h nSequenceId + validation.cpp CBlockIndexWorkComparator)
+    sequence_id: int = 0
     _hash: Optional[int] = None
     # skip-list pointer for O(log n) ancestor walks (ref chain.h pskip)
     skip: Optional["BlockIndex"] = field(default=None, repr=False)

@@ -75,12 +75,14 @@ def accept_to_memory_pool(
     # conflict handling): a conflicting in-pool tx may be replaced when it
     # signals replaceability and the newcomer pays strictly more.
     conflicts: set = set()
+    direct_conflicts: set = set()
     if pool.has_conflict(tx):
         for txin in tx.vin:
             spender = pool.spender_of(txin.prevout)
             if spender is not None:
-                conflicts.add(spender)
-        for c in list(conflicts):
+                direct_conflicts.add(spender)
+        conflicts = set(direct_conflicts)
+        for c in list(direct_conflicts):
             entry = pool.get(c)
             if not any(i.sequence < 0xFFFFFFFE for i in entry.tx.vin):
                 raise MempoolAcceptError("txn-mempool-conflict")
@@ -107,10 +109,11 @@ def accept_to_memory_pool(
         raise MempoolAcceptError("min relay fee not met", f"{fee} < {MIN_RELAY_FEE.fee_for(size)}")
 
     if conflicts:
-        # BIP125 rule 6: the newcomer's feerate must beat every directly
+        # BIP125 rule 6: the newcomer's feerate must beat every DIRECTLY
         # conflicting tx, or a huge low-feerate tx could evict a good one
+        # (descendants count toward the rule 3/4 fee totals, not here)
         new_rate = fee / size
-        for c in conflicts:
+        for c in direct_conflicts:
             e = pool.get(c)
             if new_rate <= e.fee / max(e.size, 1):
                 raise MempoolAcceptError(
@@ -127,10 +130,12 @@ def accept_to_memory_pool(
             )
         # BIP125 rule 2: the replacement may not add NEW unconfirmed
         # inputs — every in-pool parent it spends must already be spent by
-        # one of the directly conflicting transactions (and it may never
-        # depend on a tx it conflicts with)
+        # one of the DIRECTLY conflicting transactions (descendants'
+        # parents don't qualify; ref AcceptToMemoryPoolWorker's
+        # setConflictsParents built from direct conflicts only), and it
+        # may never depend on a tx it conflicts with
         direct_parents: set = set()
-        for c in conflicts:
+        for c in direct_conflicts:
             e = pool.get(c)
             if e is not None:
                 direct_parents.update(i.prevout.txid for i in e.tx.vin)

@@ -89,6 +89,8 @@ class ChainState:
         self.candidates: Set[BlockIndex] = set()  # setBlockIndexCandidates
         self.invalid: Set[BlockIndex] = set()
         self.mempool = None  # wired by the node after construction
+        self._seq = 0  # arrival counter for fork tie-breaks
+        self._rev_seq = 0  # decreasing ids handed out by precious_block
 
         if datadir is not None:
             self._chainstate_db = KVStore(os.path.join(datadir, "chainstate"))
@@ -156,6 +158,8 @@ class ChainState:
                     idx.status & BlockStatus.HAVE_DATA
                 ):
                     self.candidates.add(idx)
+                if idx.status & BlockStatus.FAILED_MASK:
+                    self.invalid.add(idx)
             return
         # fresh datadir: install genesis.  After a -reindex wipe the block
         # file survives with genesis already at offset 0 — reuse it instead
@@ -165,7 +169,7 @@ class ChainState:
         pos = -1
         try:
             existing = self.block_store.read_block(0, self.params.algo_schedule)
-            if existing.get_hash() == idx.block_hash:
+            if existing.get_hash(self.params.algo_schedule) == idx.block_hash:
                 pos = 0
         except Exception:
             pass
@@ -209,7 +213,7 @@ class ChainState:
                 raise BlockValidationError(
                     "verifydb-read-failed", f"{u256_hex(i.block_hash)}: {e}"
                 )
-            if block.get_hash() != i.block_hash:
+            if block.get_hash(self.params.algo_schedule) != i.block_hash:
                 raise BlockValidationError(
                     "verifydb-hash-mismatch", u256_hex(i.block_hash)
                 )
@@ -259,7 +263,7 @@ class ChainState:
 
         def _install(block: Block, pos: int) -> None:
             nonlocal count
-            h = block.get_hash()
+            h = block.get_hash(sched)
             idx = self.block_index.get(h) or self._add_to_block_index(
                 block.header
             )
@@ -288,12 +292,12 @@ class ChainState:
                 pending.setdefault(prev_h, []).append((pos, block))
                 continue
             _install(block, pos)
-            ready = [block.get_hash()]
+            ready = [block.get_hash(sched)]
             while ready:
                 parent = ready.pop()
                 for cpos, child in pending.pop(parent, ()):  # retry children
                     _install(child, cpos)
-                    ready.append(child.get_hash())
+                    ready.append(child.get_hash(sched))
         orphaned = sum(len(v) for v in pending.values())
         if dropped or orphaned:
             log_print(
@@ -337,6 +341,8 @@ class ChainState:
         idx.prev = self.block_index.get(header.hash_prev)
         idx.build_from_prev()
         idx.raise_validity(BlockStatus.VALID_TREE)
+        self._seq += 1
+        idx.sequence_id = self._seq
         self.block_index[h] = idx
         return idx
 
@@ -677,12 +683,19 @@ class ChainState:
 
     # --------------------------------------------------- best-chain logic
 
+    @staticmethod
+    def _work_key(idx: BlockIndex) -> Tuple[int, int]:
+        """Fork preference: more work first, then earlier arrival; precious
+        blocks get negative sequence ids so they win equal-work ties
+        (ref validation.cpp CBlockIndexWorkComparator)."""
+        return (idx.chain_work, -idx.sequence_id)
+
     def _find_most_work_chain(self) -> Optional[BlockIndex]:
         best: Optional[BlockIndex] = None
         for cand in self.candidates:
             if cand in self.invalid:
                 continue
-            if best is None or cand.chain_work > best.chain_work:
+            if best is None or self._work_key(cand) > self._work_key(best):
                 best = cand
         return best
 
@@ -694,7 +707,7 @@ class ChainState:
             tip = self.tip()
             if best is None or best is tip:
                 break
-            if tip is not None and best.chain_work <= tip.chain_work:
+            if tip is not None and self._work_key(best) <= self._work_key(tip):
                 break
             fork = self.active.find_fork(best)
             # reorg bound (ref nMaxReorganizationDepth, chainparams.cpp:256)
@@ -721,7 +734,7 @@ class ChainState:
                 blk = (
                     new_block
                     if new_block is not None
-                    and new_block.get_hash() == idx.block_hash
+                    and new_block.get_hash(self.params.algo_schedule) == idx.block_hash
                     else None
                 )
                 try:
@@ -761,6 +774,72 @@ class ChainState:
             if cand.chain_work < tip.chain_work:
                 self.candidates.discard(cand)
         self.candidates.add(tip)
+
+    # --------------------------------------- manual chain steering (RPCs)
+
+    def invalidate_block(self, idx: BlockIndex) -> None:
+        """Permanently mark a block invalid and walk the active chain off it
+        (ref validation.cpp InvalidateBlock).  Disconnected transactions are
+        resubmitted to the mempool by _disconnect_tip; alternative forks
+        rejoin the candidate set so the best remaining chain activates."""
+        while self.tip() is not None and idx in self.active:
+            self._disconnect_tip()
+        self._invalidate(idx)
+        # candidate set was pruned to the old tip's work level; surviving
+        # forks with at least the new tip's work rejoin the competition
+        # (ref InvalidateBlock's setBlockIndexCandidates re-insertion)
+        tip = self.tip()
+        for other in self.block_index.values():
+            if (
+                other not in self.invalid
+                and other.is_valid(BlockStatus.VALID_TRANSACTIONS)
+                and other.status & BlockStatus.HAVE_DATA
+                and (tip is None or other.chain_work >= tip.chain_work)
+            ):
+                self.candidates.add(other)
+        self.activate_best_chain()
+        self._prune_candidates()
+        self.flush_state_to_disk()
+
+    def reconsider_block(self, idx: BlockIndex) -> None:
+        """Clear failure flags from idx, its ancestors, and its descendants,
+        then let the best chain re-activate (ref ResetBlockFailureFlags)."""
+
+        def _clear(entry: BlockIndex) -> None:
+            entry.status = BlockStatus(entry.status & ~BlockStatus.FAILED_MASK)
+            self.invalid.discard(entry)
+            if (
+                entry.is_valid(BlockStatus.VALID_TRANSACTIONS)
+                and entry.status & BlockStatus.HAVE_DATA
+            ):
+                self.candidates.add(entry)
+
+        for other in self.block_index.values():
+            if other.get_ancestor(idx.height) is idx:
+                _clear(other)
+        walk: Optional[BlockIndex] = idx
+        while walk is not None:
+            _clear(walk)
+            walk = walk.prev
+        self.activate_best_chain()
+        self.flush_state_to_disk()
+
+    def precious_block(self, idx: BlockIndex) -> None:
+        """Treat a block as if it were received first among equal-work tips
+        (ref validation.cpp PreciousBlock): give it a decreasing negative
+        sequence id so the work comparator prefers it, then re-activate."""
+        tip = self.tip()
+        if tip is not None and idx.chain_work < tip.chain_work:
+            return
+        self._rev_seq -= 1
+        idx.sequence_id = self._rev_seq
+        if (
+            idx not in self.invalid
+            and idx.is_valid(BlockStatus.VALID_TRANSACTIONS)
+            and idx.status & BlockStatus.HAVE_DATA
+        ):
+            self.candidates.add(idx)
+        self.activate_best_chain()
 
     # ------------------------------------------------------- public entry
 
@@ -850,7 +929,7 @@ class ChainState:
 
     def process_new_block(self, block: Block, force: bool = False) -> BlockIndex:
         """ref validation.cpp:12131 ProcessNewBlock."""
-        h = block.get_hash()
+        h = block.get_hash(self.params.algo_schedule)
         idx = self.block_index.get(h)
         if idx is not None and idx.status & BlockStatus.HAVE_DATA:
             if idx in self.invalid:
@@ -889,7 +968,7 @@ class ChainState:
         )
         self.contextual_check_block(block, prev)
         idx = BlockIndex(header=block.header, prev=prev)
-        idx._hash = block.get_hash()
+        idx._hash = block.get_hash(self.params.algo_schedule)
         idx.build_from_prev()
         view = CoinsViewCache(self.coins)
         self.connect_block(block, idx, view, just_check=True)

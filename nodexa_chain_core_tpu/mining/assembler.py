@@ -30,10 +30,18 @@ class BlockAssembler:
         self.max_size = max_size
 
     def create_new_block(
-        self, script_pubkey: bytes, ntime: Optional[int] = None
+        self,
+        script_pubkey: bytes,
+        ntime: Optional[int] = None,
+        prev_override=None,
+        extra_nonce: int = 0,
     ) -> Block:
+        """``prev_override`` builds a template on a non-tip index (fork
+        construction, the reference functional suite's blocktools path);
+        ``extra_nonce`` perturbs the coinbase so same-parent templates get
+        distinct hashes (ref miner.cpp IncrementExtraNonce)."""
         cs = self.chainstate
-        tip = cs.tip()
+        tip = prev_override if prev_override is not None else cs.tip()
         assert tip is not None
         height = tip.height + 1
         params = cs.params.consensus
@@ -42,7 +50,10 @@ class BlockAssembler:
             ntime = int(time.time())
         ntime = max(ntime, tip.median_time_past() + 1)
 
-        txs, fees = self._select_transactions(height)
+        if prev_override is None:
+            txs, fees = self._select_transactions(height)
+        else:
+            txs, fees = [], 0  # mempool txs may not be valid on that branch
 
         subsidy = powrules.get_block_subsidy(height, params)
         coinbase = Transaction(
@@ -50,7 +61,10 @@ class BlockAssembler:
             vin=[
                 TxIn(
                     prevout=OutPoint(),
-                    script_sig=Script.build(height).raw + b"\x00",  # BIP34 + extranonce room
+                    script_sig=Script.build(height).raw
+                    # BIP34 height push + 4-byte extranonce (ref miner.cpp
+                    # IncrementExtraNonce)
+                    + (extra_nonce & 0xFFFFFFFF).to_bytes(4, "little"),
                     sequence=0xFFFFFFFF,
                 )
             ],

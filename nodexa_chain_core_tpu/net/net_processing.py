@@ -405,7 +405,7 @@ class NetProcessor:
         self._accept_block_from_peer(peer, block, punish=True)
 
     def _accept_block_from_peer(self, peer, block, punish: bool) -> bool:
-        h = block.get_hash()
+        h = block.get_hash(self.node.params.algo_schedule)
         peer.blocks_in_flight.discard(h)
         peer.known_blocks.add(h)
         cs = self.node.chainstate

@@ -135,6 +135,16 @@ class NetworkParams:
     dns_seeds: tuple = ()
     _genesis: Optional[Block] = field(default=None, repr=False)
 
+    def __post_init__(self) -> None:
+        # The reference's era activation times are process-wide globals set
+        # by chainparams selection (nKAWPOWActivationTime consulted from
+        # CBlockHeader serialization); mirror that so display/convenience
+        # paths that omit the schedule follow the constructed network.
+        # Consensus paths always pass the schedule explicitly, and the
+        # header hash cache is keyed on the era algorithm, so a stale
+        # global can never corrupt validation.
+        set_active_schedule(self.algo_schedule)
+
     @property
     def genesis(self) -> Block:
         if self._genesis is None:

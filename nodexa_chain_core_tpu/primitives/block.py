@@ -75,6 +75,7 @@ class BlockHeader(Serializable):
     nonce64: int = 0
     mix_hash: int = 0
     _cached_hash: Optional[int] = field(default=None, repr=False, compare=False)
+    _cached_algo: Optional[str] = field(default=None, repr=False, compare=False)
 
     # -- serialization (era switch on nTime; ref block.h:67) --------------
 
@@ -137,11 +138,18 @@ class BlockHeader(Serializable):
         return sha256d(self.pow_header_bytes(schedule))
 
     def get_hash(self, schedule: Optional[AlgoSchedule] = None) -> int:
-        """Block identity hash == era PoW hash (ref GetHashFull/GetHash)."""
-        if self._cached_hash is not None:
-            return self._cached_hash
+        """Block identity hash == era PoW hash (ref GetHashFull/GetHash).
+
+        The cache is keyed on the era algorithm so a hash computed under
+        one schedule is never served to a caller whose schedule selects a
+        different algorithm for this header's timestamp (consensus paths
+        always pass their network's schedule; the module-global fallback
+        exists for display/convenience code only).
+        """
         s = schedule or _ACTIVE
         algo = s.era_algo(self.time)
+        if self._cached_hash is not None and self._cached_algo == algo:
+            return self._cached_hash
         if algo == "kawpow":
             from . import kawpow_glue  # lazy: needs DAG machinery
 
@@ -149,6 +157,7 @@ class BlockHeader(Serializable):
         else:
             digest = powhash.get(algo)(self.pow_header_bytes(s))
         self._cached_hash = int.from_bytes(digest, "little")
+        self._cached_algo = algo
         return self._cached_hash
 
     def rehash(self) -> int:
@@ -180,8 +189,8 @@ class Block(Serializable):
         vtx = r.vector(Transaction.deserialize)
         return cls(header=header, vtx=vtx)
 
-    def get_hash(self) -> int:
-        return self.header.get_hash()
+    def get_hash(self, schedule: Optional[AlgoSchedule] = None) -> int:
+        return self.header.get_hash(schedule)
 
     @property
     def hash_hex(self) -> str:

@@ -293,6 +293,29 @@ def verifychain(node, params: List[Any]):
     return True
 
 
+def invalidateblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp invalidateblock -> InvalidateBlock."""
+    idx = _lookup_block(node, str(params[0]))
+    if idx.prev is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "cannot invalidate genesis")
+    node.chainstate.invalidate_block(idx)
+    return None
+
+
+def reconsiderblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp reconsiderblock -> ResetBlockFailureFlags."""
+    idx = _lookup_block(node, str(params[0]))
+    node.chainstate.reconsider_block(idx)
+    return None
+
+
+def preciousblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp preciousblock -> PreciousBlock."""
+    idx = _lookup_block(node, str(params[0]))
+    node.chainstate.precious_block(idx)
+    return None
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("getblockcount", getblockcount, []),
@@ -307,5 +330,8 @@ def register(table: RPCTable) -> None:
         ("getrawmempool", getrawmempool, ["verbose"]),
         ("gettxout", gettxout, ["txid", "n", "include_mempool"]),
         ("verifychain", verifychain, ["checklevel", "nblocks"]),
+        ("invalidateblock", invalidateblock, ["blockhash"]),
+        ("reconsiderblock", reconsiderblock, ["blockhash"]),
+        ("preciousblock", preciousblock, ["blockhash"]),
     ]:
         table.register("blockchain", name, fn, args)

@@ -43,7 +43,7 @@ def generatetoaddress(node, params: List[Any]):
         if not mine_block_cpu(block, node.params.algo_schedule, max_tries=maxtries):
             raise RPCError(RPC_MISC_ERROR, "couldn't find a block (maxtries)")
         node.chainstate.process_new_block(block)
-        hashes.append(u256_hex(block.get_hash()))
+        hashes.append(u256_hex(block.get_hash(node.params.algo_schedule)))
     return hashes
 
 
@@ -69,7 +69,7 @@ def generatetoaddress_tpu(node, params: List[Any]):
         ):
             raise RPCError(RPC_MISC_ERROR, "nonce space exhausted")
         node.chainstate.process_new_block(block)
-        hashes.append(u256_hex(block.get_hash()))
+        hashes.append(u256_hex(block.get_hash(node.params.algo_schedule)))
     return hashes
 
 
@@ -181,7 +181,7 @@ def submitblock(node, params: List[Any]):
         node.chainstate.process_new_block(block)
     except BlockValidationError as e:
         return e.code
-    if node.chainstate.tip().block_hash == block.get_hash():
+    if node.chainstate.tip().block_hash == block.get_hash(node.params.algo_schedule):
         return None  # success, like the reference
     return "inconclusive"
 

@@ -1,0 +1,127 @@
+"""invalidateblock / reconsiderblock / preciousblock chain steering
+(ref validation.cpp InvalidateBlock / ResetBlockFailureFlags / PreciousBlock,
+reference functional tests rpc_invalidateblock.py, rpc_preciousblock.py)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def setup():
+    params = regtest_params()
+    cs = ChainState(params)
+    ks = KeyStore()
+    kid = ks.add_key(0xA11CE)
+    spk = p2pkh_script(KeyID(kid))
+    return params, cs, spk
+
+
+def mine_one(cs, params, spk, ntime, prev=None, extra_nonce=0):
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(
+        spk.raw, ntime=ntime, prev_override=prev, extra_nonce=extra_nonce
+    )
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    return blk
+
+
+def mine_chain(cs, params, spk, n, start_time=None):
+    t = start_time or (params.genesis_time + 60)
+    blocks = []
+    for _ in range(n):
+        blocks.append(mine_one(cs, params, spk, ntime=t))
+        t += 60
+    return blocks
+
+
+def test_invalidate_rewinds_chain(setup):
+    params, cs, spk = setup
+    blocks = mine_chain(cs, params, spk, 6)
+    assert cs.tip().height == 6
+    # invalidate block 4: tip must rewind to height 3
+    idx4 = cs.lookup(blocks[3].get_hash())
+    cs.invalidate_block(idx4)
+    assert cs.tip().height == 3
+    assert cs.tip().block_hash == blocks[2].get_hash()
+    # block 4 and all descendants are flagged
+    assert idx4 in cs.invalid
+    assert cs.lookup(blocks[5].get_hash()) in cs.invalid
+    # mining continues from the new tip
+    nxt = mine_one(cs, params, spk, ntime=params.genesis_time + 60 * 20)
+    assert cs.tip().block_hash == nxt.get_hash()
+    assert cs.tip().height == 4
+
+
+def test_reconsider_restores_longest_chain(setup):
+    params, cs, spk = setup
+    blocks = mine_chain(cs, params, spk, 6)
+    best = blocks[-1].get_hash()
+    idx4 = cs.lookup(blocks[3].get_hash())
+    cs.invalidate_block(idx4)
+    assert cs.tip().height == 3
+    cs.reconsider_block(idx4)
+    assert cs.tip().height == 6
+    assert cs.tip().block_hash == best
+    assert not cs.invalid
+
+
+def test_invalidate_activates_surviving_fork(setup):
+    params, cs, spk = setup
+    blocks = mine_chain(cs, params, spk, 3)
+    # build a side block at height 3 on top of block 2
+    prev_idx = cs.lookup(blocks[1].get_hash())
+    side = mine_one(
+        cs, params, spk,
+        ntime=params.genesis_time + 60 * 10,
+        prev=prev_idx, extra_nonce=7,
+    )
+    assert cs.tip().block_hash == blocks[2].get_hash()  # original still best
+    # invalidating the active height-3 block must switch to the side branch
+    cs.invalidate_block(cs.lookup(blocks[2].get_hash()))
+    assert cs.tip().block_hash == side.get_hash()
+    assert cs.tip().height == 3
+
+
+def test_precious_prefers_equal_work_tip(setup):
+    params, cs, spk = setup
+    blocks = mine_chain(cs, params, spk, 3)
+    prev_idx = cs.lookup(blocks[1].get_hash())
+    side = mine_one(
+        cs, params, spk,
+        ntime=params.genesis_time + 60 * 10,
+        prev=prev_idx, extra_nonce=7,
+    )
+    side_idx = cs.lookup(side.get_hash())
+    # equal work: first-seen tip stays active
+    assert cs.tip().block_hash == blocks[2].get_hash()
+    cs.precious_block(side_idx)
+    assert cs.tip().block_hash == side.get_hash()
+    # precious the original back: it must win again
+    cs.precious_block(cs.lookup(blocks[2].get_hash()))
+    assert cs.tip().block_hash == blocks[2].get_hash()
+
+
+def test_invalidate_persists_across_restart(tmp_path):
+    params = regtest_params()
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    datadir = str(tmp_path / "node")
+    cs = ChainState(params, datadir=datadir)
+    blocks = mine_chain(cs, params, spk, 4)
+    cs.invalidate_block(cs.lookup(blocks[2].get_hash()))
+    assert cs.tip().height == 2
+    cs.close()
+    cs2 = ChainState(params, datadir=datadir)
+    assert cs2.tip().height == 2
+    idx3 = cs2.lookup(blocks[2].get_hash())
+    assert idx3 in cs2.invalid
+    # reconsider after restart restores the full chain
+    cs2.reconsider_block(idx3)
+    assert cs2.tip().height == 4
+    cs2.close()

@@ -182,3 +182,67 @@ def test_trim_and_expire():
     assert removed[0] == txs[0].txid
     n = pool.expire(cutoff_time=3)
     assert n >= 1
+
+
+def rbf_tx(ks, spk, inputs, value_out):
+    """Replaceable tx (BIP125 signaling sequence) over arbitrary inputs."""
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=op, sequence=0xFFFFFFFD) for op in inputs],
+        vout=[TxOut(value=value_out, script_pubkey=spk.raw)],
+    )
+    for i in range(len(tx.vin)):
+        sign_tx_input(ks, tx, i, spk)
+    return tx
+
+
+def test_rbf_replacement_accepted(chain100):
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[7].vtx[0]
+    v = cb.vout[0].value
+    original = rbf_tx(ks, spk, [OutPoint(cb.txid, 0)], v - 100_000)
+    accept_to_memory_pool(cs, pool, original)
+    replacement = rbf_tx(ks, spk, [OutPoint(cb.txid, 0)], v - 300_000)
+    accept_to_memory_pool(cs, pool, replacement)
+    assert pool.contains(replacement.txid)
+    assert not pool.contains(original.txid)
+
+
+def test_rbf_rule2_rejects_new_unconfirmed_input_via_descendant(chain100):
+    """BIP125 rule 2: a parent spent only by a DESCENDANT of the conflicted
+    tx does not license the replacement to add that unconfirmed input
+    (ref AcceptToMemoryPoolWorker setConflictsParents from direct
+    conflicts only)."""
+    params, cs, pool, ks, spk, blocks = chain100
+    cb_a = blocks[8].vtx[0]   # coin A -> original O
+    cb_p = blocks[9].vtx[0]   # coin P -> unconfirmed parent tx P (2 outputs)
+    va, vp = cb_a.vout[0].value, cb_p.vout[0].value
+    original = rbf_tx(ks, spk, [OutPoint(cb_a.txid, 0)], va - 100_000)
+    parent_p = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb_p.txid, 0), sequence=0xFFFFFFFD)],
+        vout=[
+            TxOut(value=vp // 2, script_pubkey=spk.raw),
+            TxOut(value=vp // 2 - 100_000, script_pubkey=spk.raw),
+        ],
+    )
+    sign_tx_input(ks, parent_p, 0, spk)
+    accept_to_memory_pool(cs, pool, original)
+    accept_to_memory_pool(cs, pool, parent_p)
+    # child C spends O:0 and P:0 — a descendant of O whose inputs include P
+    child = rbf_tx(
+        ks, spk,
+        [OutPoint(original.txid, 0), OutPoint(parent_p.txid, 0)],
+        va - 100_000 + vp // 2 - 300_000,
+    )
+    accept_to_memory_pool(cs, pool, child)
+    # replacement R spends A (conflicting only with O) and the OTHER output
+    # P:1 — P is a parent of descendant C but NOT of the direct conflict O,
+    # so rule 2 must reject R
+    replacement = rbf_tx(
+        ks, spk,
+        [OutPoint(cb_a.txid, 0), OutPoint(parent_p.txid, 1)],
+        va + vp // 2 - 900_000,
+    )
+    with pytest.raises(MempoolAcceptError, match="replacement-adds-unconfirmed"):
+        accept_to_memory_pool(cs, pool, replacement)
