@@ -85,6 +85,10 @@ class AppendFile:
             raise IOError("truncated record")
         return data
 
+    def size(self) -> int:
+        self._f.seek(0, os.SEEK_END)
+        return self._f.tell()
+
     def sync(self) -> None:
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -116,12 +120,131 @@ class AppendFile:
         self._f.close()
 
 
+class PrunedError(IOError):
+    """Read of a record whose chunk file has been pruned away."""
+
+
+class ChunkedRecordFile:
+    """A sequence of numbered append-only chunk files (ref blk*.dat /
+    rev*.dat, validation.cpp FindBlockPos).  Record positions encode the
+    chunk number in the high bits so the index's flat ints keep working;
+    pruning deletes whole chunk files (ref PruneOneBlockFile /
+    UnlinkPrunedFiles)."""
+
+    CHUNK_SPAN = 1 << 40  # max bytes addressable inside one chunk
+
+    def __init__(
+        self,
+        dirpath: str,
+        base: str,
+        magic: bytes,
+        chunk_bytes: int = 16 * 1024 * 1024,
+        legacy_name: Optional[str] = None,
+    ):
+        self.dirpath = dirpath
+        self.base = base
+        self.magic = magic
+        self.chunk_bytes = chunk_bytes
+        os.makedirs(dirpath, exist_ok=True)
+        # adopt a pre-chunking single-file store as chunk 0
+        if legacy_name:
+            legacy = os.path.join(dirpath, legacy_name)
+            if os.path.exists(legacy) and not os.path.exists(self._path(0)):
+                os.rename(legacy, self._path(0))
+        self._files: dict = {}
+        nums = self.chunk_numbers()
+        self._tail = nums[-1] if nums else 0
+
+    def _path(self, n: int) -> str:
+        return os.path.join(self.dirpath, f"{self.base}{n:05d}.dat")
+
+    def chunk_numbers(self) -> List[int]:
+        out = []
+        prefix, suffix = self.base, ".dat"
+        for name in os.listdir(self.dirpath):
+            if name.startswith(prefix) and name.endswith(suffix):
+                mid = name[len(prefix):-len(suffix)]
+                if mid.isdigit():
+                    out.append(int(mid))
+        return sorted(out)
+
+    def _file(self, n: int) -> AppendFile:
+        f = self._files.get(n)
+        if f is None:
+            f = AppendFile(self._path(n), self.magic)
+            self._files[n] = f
+        return f
+
+    def append(self, payload: bytes) -> int:
+        f = self._file(self._tail)
+        if f.size() > 0 and f.size() + 8 + len(payload) > self.chunk_bytes:
+            self._tail += 1
+            f = self._file(self._tail)
+        off = f.append(payload)
+        return self._tail * self.CHUNK_SPAN + off
+
+    def read(self, pos: int) -> bytes:
+        n, off = divmod(pos, self.CHUNK_SPAN)
+        if n not in self._files and not os.path.exists(self._path(n)):
+            raise PrunedError(f"chunk {n} of {self.base} has been pruned")
+        return self._file(n).read(off)
+
+    def scan(self):
+        """(pos, payload) over all surviving chunks in order."""
+        for n in self.chunk_numbers():
+            for off, payload in self._file(n).scan():
+                yield n * self.CHUNK_SPAN + off, payload
+
+    @staticmethod
+    def chunk_of(pos: int) -> int:
+        return pos // ChunkedRecordFile.CHUNK_SPAN
+
+    def delete_chunks(self, nums) -> int:
+        """Unlink the given chunk files; the tail chunk is never deleted."""
+        freed = 0
+        for n in nums:
+            if n == self._tail:
+                continue
+            f = self._files.pop(n, None)
+            if f is not None:
+                f.close()
+            path = self._path(n)
+            if os.path.exists(path):
+                freed += os.path.getsize(path)
+                os.unlink(path)
+        return freed
+
+    def total_bytes(self) -> int:
+        return sum(
+            os.path.getsize(self._path(n)) for n in self.chunk_numbers()
+        )
+
+    def sync(self) -> None:
+        for f in self._files.values():
+            f.sync()
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
 class BlockStore:
     """Blocks + undo journal on disk."""
 
-    def __init__(self, datadir: str, magic: bytes = b"NDXB"):
-        self.blocks = AppendFile(os.path.join(datadir, "blocks", "blocks.dat"), magic)
-        self.undos = AppendFile(os.path.join(datadir, "blocks", "undo.dat"), magic)
+    def __init__(
+        self,
+        datadir: str,
+        magic: bytes = b"NDXB",
+        chunk_bytes: int = 16 * 1024 * 1024,
+    ):
+        blocks_dir = os.path.join(datadir, "blocks")
+        self.blocks = ChunkedRecordFile(
+            blocks_dir, "blk", magic, chunk_bytes, legacy_name="blocks.dat"
+        )
+        self.undos = ChunkedRecordFile(
+            blocks_dir, "rev", magic, chunk_bytes, legacy_name="undo.dat"
+        )
 
     def write_block(self, block: Block, schedule: Optional[AlgoSchedule] = None) -> int:
         w = ByteWriter()
@@ -136,6 +259,9 @@ class BlockStore:
 
     def read_undo(self, pos: int) -> BlockUndo:
         return BlockUndo.from_bytes(self.undos.read(pos))
+
+    def total_bytes(self) -> int:
+        return self.blocks.total_bytes() + self.undos.total_bytes()
 
     def sync(self) -> None:
         self.blocks.sync()

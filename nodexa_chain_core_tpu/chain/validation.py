@@ -63,6 +63,9 @@ from .txdb import BlockTreeDB
 
 MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60
 MEDIAN_TIME_SPAN = 11
+# Blocks below tip whose data may never be pruned (reorg + relay window,
+# ref validation.h MIN_BLOCKS_TO_KEEP)
+MIN_BLOCKS_TO_KEEP = 288
 
 
 class BlockValidationError(Exception):
@@ -80,6 +83,7 @@ class ChainState:
         params: NetworkParams,
         datadir: Optional[str] = None,
         script_check_threads: int = 0,
+        block_chunk_bytes: int = 16 * 1024 * 1024,
     ):
         self.params = params
         self.datadir = datadir
@@ -91,11 +95,18 @@ class ChainState:
         self.mempool = None  # wired by the node after construction
         self._seq = 0  # arrival counter for fork tie-breaks
         self._rev_seq = 0  # decreasing ids handed out by precious_block
+        # pruning state (ref fPruneMode / nPruneTarget, validation.cpp)
+        self.prune_mode = False
+        self.prune_target_bytes = 0
+        self.pruned_height = -1  # highest block whose data was pruned
+        self._last_autoprune_height = -9  # flush-time prune throttle
 
         if datadir is not None:
             self._chainstate_db = KVStore(os.path.join(datadir, "chainstate"))
             self._blocktree_db = KVStore(os.path.join(datadir, "blocks", "index"))
-            self.block_store: Optional[BlockStore] = BlockStore(datadir)
+            self.block_store: Optional[BlockStore] = BlockStore(
+                datadir, chunk_bytes=block_chunk_bytes
+            )
             self.blocktree = BlockTreeDB(self._blocktree_db, params.algo_schedule)
         else:
             self._chainstate_db = KVStore(None)
@@ -160,6 +171,9 @@ class ChainState:
                     self.candidates.add(idx)
                 if idx.status & BlockStatus.FAILED_MASK:
                     self.invalid.add(idx)
+            raw_ph = self._chainstate_db.get(b"prunedheight")
+            if raw_ph:
+                self.pruned_height = int.from_bytes(raw_ph, "little", signed=True)
             return
         # fresh datadir: install genesis.  After a -reindex wipe the block
         # file survives with genesis already at offset 0 — reuse it instead
@@ -203,6 +217,8 @@ class ChainState:
             return
         window: List[BlockIndex] = []
         while idx is not None and idx.height > 0 and len(window) < check_blocks:
+            if not idx.status & BlockStatus.HAVE_DATA:
+                break  # pruned boundary: nothing below is verifiable
             window.append(idx)
             idx = idx.prev
         scratch = CoinsViewCache(self.coins) if check_level >= 3 else None
@@ -269,6 +285,7 @@ class ChainState:
             )
             self.positions[h] = (pos, self.positions.get(h, (-1, -1))[1])
             idx.status |= BlockStatus.HAVE_DATA
+            self._received_block_data(idx)
             idx.tx_count = len(block.vtx)
             idx.chain_tx_count = (
                 (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
@@ -310,6 +327,78 @@ class ChainState:
         self.flush_state_to_disk()
         return count
 
+    # ------------------------------------------------------------ pruning
+
+    def prune_block_files(self, manual_height: Optional[int] = None) -> int:
+        """Delete block/undo chunk files wholly below the prune point
+        (ref FindFilesToPrune + PruneOneBlockFile + UnlinkPrunedFiles).
+
+        A chunk is prunable when every record it stores belongs to a block
+        at height <= the prune point; the newest MIN_BLOCKS_TO_KEEP blocks
+        are always retained.  Returns bytes freed.  Index entries for
+        pruned blocks survive with HAVE_DATA/HAVE_UNDO cleared, exactly as
+        the reference keeps pruned CBlockIndex entries.
+        """
+        from .blockstore import ChunkedRecordFile
+
+        tip = self.tip()
+        store = self.block_store
+        if tip is None or not hasattr(store, "blocks"):
+            return 0
+        if not hasattr(store.blocks, "chunk_numbers"):
+            return 0  # in-memory test fixture
+        limit = tip.height - MIN_BLOCKS_TO_KEEP
+        prune_to = limit if manual_height is None else min(manual_height, limit)
+        if prune_to <= 0:
+            return 0
+        blk_max: Dict[int, int] = {}
+        rev_max: Dict[int, int] = {}
+        for h, (dpos, upos) in self.positions.items():
+            idx = self.block_index.get(h)
+            # unindexed records can never be proven stale: pin their chunk
+            height = idx.height if idx is not None else 1 << 62
+            if dpos >= 0:
+                c = ChunkedRecordFile.chunk_of(dpos)
+                blk_max[c] = max(blk_max.get(c, -1), height)
+            if upos >= 0:
+                c = ChunkedRecordFile.chunk_of(upos)
+                rev_max[c] = max(rev_max.get(c, -1), height)
+        freed = store.blocks.delete_chunks(
+            [c for c, mh in blk_max.items() if mh <= prune_to]
+        )
+        freed += store.undos.delete_chunks(
+            [c for c, mh in rev_max.items() if mh <= prune_to]
+        )
+        if freed == 0:
+            return 0
+        live_blk = set(store.blocks.chunk_numbers())
+        live_rev = set(store.undos.chunk_numbers())
+        for h, (dpos, upos) in list(self.positions.items()):
+            nd = dpos if dpos < 0 or ChunkedRecordFile.chunk_of(dpos) in live_blk else -1
+            nu = upos if upos < 0 or ChunkedRecordFile.chunk_of(upos) in live_rev else -1
+            if (nd, nu) == (dpos, upos):
+                continue
+            self.positions[h] = (nd, nu)
+            idx = self.block_index.get(h)
+            if idx is not None:
+                if nd < 0:
+                    idx.status = BlockStatus(idx.status & ~BlockStatus.HAVE_DATA)
+                    self.candidates.discard(idx)
+                    self.pruned_height = max(self.pruned_height, idx.height)
+                if nu < 0:
+                    idx.status = BlockStatus(idx.status & ~BlockStatus.HAVE_UNDO)
+        log_print(
+            LogFlags.NONE,
+            "prune: freed %d bytes, pruned through height %d",
+            freed,
+            self.pruned_height,
+        )
+        self.blocktree.write_index(self.block_index.values(), self.positions)
+        self._chainstate_db.put(
+            b"prunedheight", self.pruned_height.to_bytes(8, "little", signed=True)
+        )
+        return freed
+
     # -------------------------------------------------------------- helpers
 
     @property
@@ -341,8 +430,6 @@ class ChainState:
         idx.prev = self.block_index.get(header.hash_prev)
         idx.build_from_prev()
         idx.raise_validity(BlockStatus.VALID_TREE)
-        self._seq += 1
-        idx.sequence_id = self._seq
         self.block_index[h] = idx
         return idx
 
@@ -683,6 +770,14 @@ class ChainState:
 
     # --------------------------------------------------- best-chain logic
 
+    def _received_block_data(self, idx: BlockIndex) -> None:
+        """First-data-arrival bookkeeping: the equal-work tie break uses
+        the order block DATA arrived, not header order (ref
+        ReceivedBlockTransactions' nSequenceId assignment)."""
+        if idx.sequence_id == 0:
+            self._seq += 1
+            idx.sequence_id = self._seq
+
     @staticmethod
     def _work_key(idx: BlockIndex) -> Tuple[int, int]:
         """Fork preference: more work first, then earlier arrival; precious
@@ -749,8 +844,19 @@ class ChainState:
             # else: loop again; _invalidate removed the bad candidate
         if progressed:
             self._prune_candidates()
+            self._resubmit_disconnected()
             main_signals.updated_block_tip(self.tip(), None, False)
             self.flush_state_to_disk()
+
+    def _resubmit_disconnected(self) -> None:
+        """Re-add reorged-out transactions to the mempool (ref
+        UpdateMempoolForReorg's disconnectpool drain)."""
+        pool = self.mempool
+        if pool is None or not getattr(pool, "_disconnected", None):
+            return
+        from .mempool_accept import resubmit_disconnected
+
+        resubmit_disconnected(self, pool)
 
     def _invalidate(self, idx: BlockIndex) -> None:
         idx.status |= BlockStatus.FAILED_VALID
@@ -780,8 +886,23 @@ class ChainState:
     def invalidate_block(self, idx: BlockIndex) -> None:
         """Permanently mark a block invalid and walk the active chain off it
         (ref validation.cpp InvalidateBlock).  Disconnected transactions are
-        resubmitted to the mempool by _disconnect_tip; alternative forks
-        rejoin the candidate set so the best remaining chain activates."""
+        queued by _disconnect_tip and resubmitted to the mempool at the end;
+        alternative forks rejoin the candidate set so the best remaining
+        chain activates."""
+        if idx in self.active:
+            # refuse before touching the tip if any block that would need
+            # disconnecting has pruned data/undo — aborting mid-rewind
+            # would strand the chain between states
+            walk = self.tip()
+            while walk is not None and walk.height >= idx.height:
+                if not (walk.status & BlockStatus.HAVE_DATA) or (
+                    walk.height > 0 and not walk.status & BlockStatus.HAVE_UNDO
+                ):
+                    raise BlockValidationError(
+                        "cannot-invalidate-pruned",
+                        f"block {walk.height} has pruned data",
+                    )
+                walk = walk.prev
         while self.tip() is not None and idx in self.active:
             self._disconnect_tip()
         self._invalidate(idx)
@@ -799,6 +920,7 @@ class ChainState:
                 self.candidates.add(other)
         self.activate_best_chain()
         self._prune_candidates()
+        self._resubmit_disconnected()
         self.flush_state_to_disk()
 
     def reconsider_block(self, idx: BlockIndex) -> None:
@@ -952,6 +1074,7 @@ class ChainState:
         pos = self.block_store.write_block(block, self.params.algo_schedule)
         self.positions[idx.block_hash] = (pos, -1)
         idx.status |= BlockStatus.HAVE_DATA
+        self._received_block_data(idx)
         idx.tx_count = len(block.vtx)
         idx.chain_tx_count = (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
@@ -977,6 +1100,19 @@ class ChainState:
 
     def flush_state_to_disk(self) -> None:
         """ref validation.cpp:10570 FlushStateToDisk."""
+        tip = self.tip()
+        if (
+            self.prune_mode
+            and self.prune_target_bytes > 0
+            and tip is not None
+            # chunk scans are O(files): only re-attempt once enough new
+            # blocks could have made another chunk prunable
+            and tip.height - self._last_autoprune_height >= 8
+            and hasattr(self.block_store, "total_bytes")
+            and self.block_store.total_bytes() > self.prune_target_bytes
+        ):
+            self._last_autoprune_height = tip.height
+            self.prune_block_files()
         self.coins.flush()
         self.blocktree.write_index(self.block_index.values(), self.positions)
         tip = self.tip()

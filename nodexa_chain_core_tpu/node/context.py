@@ -18,11 +18,15 @@ class NodeContext:
         network: str = "main",
         datadir: Optional[str] = None,
         script_check_threads: int = 0,
+        block_chunk_bytes: int = 16 * 1024 * 1024,
     ):
         self.params: NetworkParams = select_params(network)
         self.datadir = datadir
         self.chainstate = ChainState(
-            self.params, datadir=datadir, script_check_threads=script_check_threads
+            self.params,
+            datadir=datadir,
+            script_check_threads=script_check_threads,
+            block_chunk_bytes=block_chunk_bytes,
         )
         self.mempool = TxMemPool()
         self.chainstate.mempool = self.mempool

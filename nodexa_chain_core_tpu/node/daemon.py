@@ -36,9 +36,23 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         g_logger.enable_categories(g_args.get("debug", "all"))
     log_printf("Nodexa TPU daemon starting: network=%s datadir=%s", network, datadir)
 
+    reindexing = g_args.get_bool("reindex")
+    # -prune parameter interaction is validated BEFORE the -reindex wipe so
+    # a rejected configuration never destroys the derived databases
+    prune_arg = g_args.get_int("prune", 0)
+    if prune_arg:
+        if reindexing:
+            raise SystemExit("Error: -prune and -reindex are incompatible")
+        if any(
+            g_args.get_bool(a)
+            for a in ("addressindex", "spentindex", "timestampindex")
+        ):
+            raise SystemExit("Error: -prune is incompatible with optional indexes")
+        if prune_arg > 1 and prune_arg < 550:
+            raise SystemExit("Error: -prune must be 0, 1 (manual) or >=550 (MiB)")
+
     # -reindex: wipe the derived stores; the block files stay and feed the
     # rebuild below (ref init.cpp reindex handling)
-    reindexing = g_args.get_bool("reindex")
     if reindexing:
         import shutil
 
@@ -51,7 +65,22 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         network=network,
         datadir=datadir,
         script_check_threads=g_args.get_int("par", 0),
+        # debug/test knob: small chunks let functional prune tests run on
+        # short chains (ref feature_pruning.py's large-block approach)
+        block_chunk_bytes=g_args.get_int("blockchunksize", 16 * 1024 * 1024),
     )
+    # -prune=N: 0=off, 1=manual (pruneblockchain RPC), >=550 = auto-prune
+    # to N MiB (validated above, before the -reindex wipe)
+    if prune_arg:
+        cs = node.chainstate
+        cs.prune_mode = True
+        if prune_arg > 1:
+            cs.prune_target_bytes = prune_arg * 1024 * 1024
+        log_printf(
+            "prune mode: %s",
+            "manual" if prune_arg == 1 else f"target {prune_arg} MiB",
+        )
+
     # Optional indexes (-addressindex/-spentindex/-timestampindex; new
     # blocks only — run -reindex to backfill, as the reference requires)
     want_ai = g_args.get_bool("addressindex")

@@ -145,8 +145,12 @@ def getblockheader(node, params: List[Any]):
 
 
 def getblock(node, params: List[Any]):
+    from ..chain.blockindex import BlockStatus
+
     idx = _lookup_block(node, str(params[0]))
     verbosity = int(params[1]) if len(params) > 1 else 1
+    if not idx.status & BlockStatus.HAVE_DATA:
+        raise RPCError(RPC_MISC_ERROR, "Block not available (pruned data)")
     block = node.chainstate.read_block(idx)
     if verbosity == 0:
         from ..core.serialize import ByteWriter
@@ -166,7 +170,7 @@ def getblock(node, params: List[Any]):
 def getblockchaininfo(node, params: List[Any]):
     cs = node.chainstate
     tip = cs.tip()
-    return {
+    out = {
         "chain": node.params.network,
         "blocks": tip.height,
         "headers": max(i.height for i in cs.block_index.values()),
@@ -175,10 +179,15 @@ def getblockchaininfo(node, params: List[Any]):
         "mediantime": tip.median_time_past(),
         "verificationprogress": 1.0,
         "chainwork": f"{tip.chain_work:064x}",
-        "pruned": False,
+        "pruned": cs.prune_mode,
         "softforks": [],
         "warnings": "",
     }
+    if cs.prune_mode:
+        out["pruneheight"] = cs.pruned_height + 1  # first stored block
+        if cs.prune_target_bytes:
+            out["prune_target_size"] = cs.prune_target_bytes
+    return out
 
 
 def getdifficulty(node, params: List[Any]):
@@ -293,6 +302,22 @@ def verifychain(node, params: List[Any]):
     return True
 
 
+def pruneblockchain(node, params: List[Any]):
+    """ref rpc/blockchain.cpp pruneblockchain (manual prune mode)."""
+    cs = node.chainstate
+    if not cs.prune_mode:
+        raise RPCError(
+            RPC_MISC_ERROR, "Cannot prune blocks because node is not in prune mode."
+        )
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "height required")
+    height = int(params[0])
+    if height < 0:
+        raise RPCError(RPC_INVALID_PARAMETER, "Negative block height.")
+    cs.prune_block_files(manual_height=height)
+    return max(cs.pruned_height, 0)
+
+
 def invalidateblock(node, params: List[Any]):
     """ref rpc/blockchain.cpp invalidateblock -> InvalidateBlock."""
     idx = _lookup_block(node, str(params[0]))
@@ -330,6 +355,7 @@ def register(table: RPCTable) -> None:
         ("getrawmempool", getrawmempool, ["verbose"]),
         ("gettxout", gettxout, ["txid", "n", "include_mempool"]),
         ("verifychain", verifychain, ["checklevel", "nblocks"]),
+        ("pruneblockchain", pruneblockchain, ["height"]),
         ("invalidateblock", invalidateblock, ["blockhash"]),
         ("reconsiderblock", reconsiderblock, ["blockhash"]),
         ("preciousblock", preciousblock, ["blockhash"]),

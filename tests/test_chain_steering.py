@@ -125,3 +125,65 @@ def test_invalidate_persists_across_restart(tmp_path):
     cs2.reconsider_block(idx3)
     assert cs2.tip().height == 4
     cs2.close()
+
+
+def test_tie_break_uses_data_arrival_order(setup):
+    """Headers-first sync: equal-work tip ties break on which block's DATA
+    arrived first, not whose header was announced first (ref
+    ReceivedBlockTransactions nSequenceId)."""
+    params, cs, spk = setup
+    blocks = mine_chain(cs, params, spk, 2)
+    prev_idx = cs.lookup(blocks[1].get_hash())
+    # build two equal-work height-3 candidates on the same parent
+    asm_a = BlockAssembler(cs)
+    blk_a = asm_a.create_new_block(
+        spk.raw, ntime=params.genesis_time + 60 * 10, prev_override=prev_idx,
+        extra_nonce=1,
+    )
+    assert mine_block_cpu(blk_a, params.algo_schedule)
+    blk_b = asm_a.create_new_block(
+        spk.raw, ntime=params.genesis_time + 60 * 10, prev_override=prev_idx,
+        extra_nonce=2,
+    )
+    assert mine_block_cpu(blk_b, params.algo_schedule)
+    # header A announced before header B, but B's data arrives first
+    cs.process_new_block_headers([blk_a.header, blk_b.header])
+    cs.process_new_block(blk_b)
+    assert cs.tip().block_hash == blk_b.get_hash()
+    cs.process_new_block(blk_a)
+    # B won the data race: no reorg to A
+    assert cs.tip().block_hash == blk_b.get_hash()
+
+
+def test_invalidate_resubmits_transactions(setup):
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.chain.mempool_accept import accept_to_memory_pool
+    from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint, Transaction, TxIn, TxOut,
+    )
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    params = regtest_params()
+    cs = ChainState(params)
+    pool = TxMemPool()
+    cs.mempool = pool
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    blocks = mine_chain(cs, params, spk, COINBASE_MATURITY + 2)
+    cb = blocks[0].vtx[0]
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+        vout=[TxOut(value=cb.vout[0].value - 100_000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, spk)
+    accept_to_memory_pool(cs, pool, tx)
+    # mine it into a block, then invalidate that block
+    t = params.genesis_time + 60 * (COINBASE_MATURITY + 10)
+    mined = mine_one(cs, params, spk, ntime=t)
+    assert any(x.txid == tx.txid for x in mined.vtx)
+    assert not pool.contains(tx.txid)
+    cs.invalidate_block(cs.lookup(mined.get_hash()))
+    # the reorged-out spend is back in the pool
+    assert pool.contains(tx.txid)

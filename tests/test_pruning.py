@@ -1,0 +1,156 @@
+"""Block-file pruning (ref validation.cpp FindFilesToPrune / PruneOneBlockFile,
+functional model feature_pruning.py).  Uses a tiny chunk size so a short
+regtest chain spans several chunk files."""
+
+import os
+
+import pytest
+
+import nodexa_chain_core_tpu.chain.validation as validation_mod
+from nodexa_chain_core_tpu.chain.blockstore import (
+    BlockStore,
+    ChunkedRecordFile,
+    PrunedError,
+)
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def pruned_setup(tmp_path, monkeypatch):
+    # keep 10 blocks instead of 288 so tests stay fast
+    monkeypatch.setattr(validation_mod, "MIN_BLOCKS_TO_KEEP", 10)
+    params = regtest_params()
+    datadir = str(tmp_path / "node")
+    cs = ChainState(params, datadir=datadir)
+    # shrink the chunk size so every ~4 blocks start a new chunk file
+    cs.block_store.close()
+    cs.block_store = BlockStore(datadir, chunk_bytes=1024)
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    return params, cs, spk, datadir
+
+
+def mine_chain(cs, params, spk, n):
+    t = params.genesis_time + 60
+    blocks = []
+    for _ in range(n):
+        asm = BlockAssembler(cs)
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+        blocks.append(blk)
+        t += 60
+    return blocks
+
+
+def blk_files(datadir):
+    d = os.path.join(datadir, "blocks")
+    return sorted(f for f in os.listdir(d) if f.startswith("blk"))
+
+
+def test_manual_prune_deletes_chunks(pruned_setup):
+    params, cs, spk, datadir = pruned_setup
+    cs.prune_mode = True
+    blocks = mine_chain(cs, params, spk, 40)
+    before = blk_files(datadir)
+    assert len(before) > 3  # chain spans several chunk files
+    freed = cs.prune_block_files(manual_height=30)
+    assert freed > 0
+    after = blk_files(datadir)
+    assert len(after) < len(before)
+    assert cs.pruned_height >= 0
+    # pruned block: index survives, data gone
+    early = cs.lookup(blocks[0].get_hash(params.algo_schedule))
+    assert early is not None
+    from nodexa_chain_core_tpu.chain.blockindex import BlockStatus
+
+    assert not early.status & BlockStatus.HAVE_DATA
+    with pytest.raises(Exception):
+        cs.read_block(early)
+    # recent blocks are always retained (MIN_BLOCKS_TO_KEEP)
+    tip = cs.tip()
+    assert tip.status & BlockStatus.HAVE_DATA
+    assert cs.read_block(tip).get_hash(params.algo_schedule) == tip.block_hash
+
+
+def test_min_blocks_to_keep_floor(pruned_setup):
+    params, cs, spk, datadir = pruned_setup
+    cs.prune_mode = True
+    mine_chain(cs, params, spk, 12)
+    # prune point clamps to tip-10: almost nothing is eligible
+    cs.prune_block_files(manual_height=12)
+    from nodexa_chain_core_tpu.chain.blockindex import BlockStatus
+
+    tip = cs.tip()
+    walk, have = tip, 0
+    while walk is not None:
+        if walk.status & BlockStatus.HAVE_DATA:
+            have += 1
+        walk = walk.prev
+    assert have >= 10
+
+
+def test_auto_prune_on_flush(pruned_setup):
+    params, cs, spk, datadir = pruned_setup
+    cs.prune_mode = True
+    cs.prune_target_bytes = 4096  # tiny target forces pruning during flush
+    mine_chain(cs, params, spk, 40)
+    # flush (called by activate_best_chain) should have pruned automatically
+    assert cs.pruned_height >= 0
+    assert len(blk_files(datadir)) < 10
+
+
+def test_pruned_state_survives_restart(pruned_setup):
+    params, cs, spk, datadir = pruned_setup
+    cs.prune_mode = True
+    blocks = mine_chain(cs, params, spk, 40)
+    cs.prune_block_files(manual_height=30)
+    ph = cs.pruned_height
+    tip_hash = cs.tip().block_hash
+    cs.close()
+    cs2 = ChainState(params, datadir=datadir)
+    cs2.block_store.close()
+    cs2.block_store = BlockStore(datadir, chunk_bytes=1024)
+    assert cs2.tip().block_hash == tip_hash
+    assert cs2.pruned_height == ph
+    from nodexa_chain_core_tpu.chain.blockindex import BlockStatus
+
+    early = cs2.lookup(blocks[0].get_hash(params.algo_schedule))
+    assert not early.status & BlockStatus.HAVE_DATA
+    # verify_db stops cleanly at the pruned boundary
+    cs2.verify_db(check_level=3, check_blocks=1000)
+    cs2.close()
+
+
+def test_chunked_file_legacy_migration(tmp_path):
+    """A pre-chunking blocks.dat is adopted as chunk 0."""
+    d = str(tmp_path / "blocks")
+    os.makedirs(d)
+    from nodexa_chain_core_tpu.chain.blockstore import AppendFile
+
+    legacy = AppendFile(os.path.join(d, "blocks.dat"), b"NDXB")
+    p0 = legacy.append(b"hello")
+    legacy.close()
+    cf = ChunkedRecordFile(d, "blk", b"NDXB", legacy_name="blocks.dat")
+    assert cf.read(p0) == b"hello"
+    assert not os.path.exists(os.path.join(d, "blocks.dat"))
+    p1 = cf.append(b"world")
+    assert cf.read(p1) == b"world"
+    cf.close()
+
+
+def test_chunked_file_pruned_read_raises(tmp_path):
+    d = str(tmp_path / "blocks")
+    cf = ChunkedRecordFile(d, "blk", b"NDXB", chunk_bytes=32)
+    positions = [cf.append(bytes([i]) * 24) for i in range(6)]
+    chunks = {ChunkedRecordFile.chunk_of(p) for p in positions}
+    assert len(chunks) > 2
+    cf.delete_chunks([min(chunks)])
+    with pytest.raises(PrunedError):
+        cf.read(positions[0])
+    # surviving and tail records still readable
+    assert cf.read(positions[-1]) == bytes([5]) * 24

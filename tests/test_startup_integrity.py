@@ -51,7 +51,7 @@ def test_verify_db_clean_chain_passes(datadir_chain):
 def test_verify_db_detects_block_file_corruption(datadir_chain):
     params, datadir, cs, spk = datadir_chain
     cs.block_store.close()
-    path = os.path.join(datadir, "blocks", "blocks.dat")
+    path = os.path.join(datadir, "blocks", "blk00000.dat")
     data = bytearray(open(path, "rb").read())
     # flip bytes in the middle of the LAST record's payload
     data[-20] ^= 0xFF
