@@ -132,6 +132,7 @@ class ChunkedRecordFile:
     UnlinkPrunedFiles)."""
 
     CHUNK_SPAN = 1 << 40  # max bytes addressable inside one chunk
+    MAX_OPEN_FILES = 64  # fd cap: old chunks close LRU (ref flat-file sets)
 
     def __init__(
         self,
@@ -169,10 +170,16 @@ class ChunkedRecordFile:
         return sorted(out)
 
     def _file(self, n: int) -> AppendFile:
-        f = self._files.get(n)
+        f = self._files.pop(n, None)
         if f is None:
             f = AppendFile(self._path(n), self.magic)
-            self._files[n] = f
+        self._files[n] = f  # re-insert: dict order doubles as LRU order
+        while len(self._files) > self.MAX_OPEN_FILES:
+            old_n = next(iter(self._files))
+            if old_n == self._tail:  # never close the append target
+                self._files[old_n] = self._files.pop(old_n)
+                continue
+            self._files.pop(old_n).close()
         return f
 
     def append(self, payload: bytes) -> int:

@@ -95,6 +95,10 @@ class ConnMan:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listen_sock: Optional[socket.socket] = None
+        # outbound SOCKS5 proxies (ref netbase SetProxy): `proxy` routes all
+        # outbound; `onion_proxy` routes .onion destinations (-onion)
+        self.proxy: Optional[tuple] = None
+        self.onion_proxy: Optional[tuple] = None
         from .net_processing import NetProcessor
 
         self.processor = NetProcessor(node, self)
@@ -152,8 +156,20 @@ class ConnMan:
         port = int(port_s or self.node.params.default_port)
         if self.is_banned(host):
             return False
+        is_onion = host.endswith(".onion")
+        proxy = self.onion_proxy if is_onion else self.proxy
+        if is_onion and proxy is None:
+            log_print(LogFlags.NET, "no onion proxy for %s", addr)
+            # decay its selection weight or addrman reselects it forever
+            self.addrman.attempt(host, port)
+            return False
         try:
-            sock = socket.create_connection((host, port), timeout=5)
+            if proxy is not None:
+                from .torcontrol import socks5_connect
+
+                sock = socks5_connect(proxy, host, port, timeout=10)
+            else:
+                sock = socket.create_connection((host, port), timeout=5)
         except OSError as e:
             log_print(LogFlags.NET, "connect to %s failed: %s", addr, e)
             self.addrman.attempt(host, port)
@@ -304,7 +320,11 @@ class ConnMan:
 
     def _dns_seed(self) -> None:
         """ref ThreadDNSAddressSeed: resolve the chain's seeds into the
-        address manager when it is empty."""
+        address manager when it is empty.  Skipped when a proxy is set:
+        direct getaddrinfo would leak cleartext DNS around the proxy (the
+        reference likewise avoids direct seeding under -proxy)."""
+        if self.proxy is not None:
+            return
         for seed in getattr(self.node.params, "dns_seeds", ()) or ():
             try:
                 infos = socket.getaddrinfo(

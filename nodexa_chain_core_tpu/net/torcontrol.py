@@ -1,0 +1,392 @@
+"""Tor integration: SOCKS5 outbound proxying and the Tor control protocol
+(parity: reference src/torcontrol.cpp:748 TorController + src/netbase.cpp
+Socks5).
+
+Two independent pieces:
+
+- :func:`socks5_connect` — dial a destination through a SOCKS5 proxy with
+  remote (proxy-side) hostname resolution, so .onion destinations work and
+  DNS never leaks (ref netbase.cpp Socks5 / SOCKSVersion::SOCKS5).
+- :class:`TorController` — a control-port client that authenticates
+  (NULL / COOKIE / SAFECOOKIE HMAC handshake) and publishes an ephemeral
+  v3 hidden service for the P2P port via ADD_ONION, persisting the private
+  key across restarts (ref torcontrol.cpp TorController::auth_cb /
+  add_onion_cb; key file analogue of onion_v3_private_key).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import LogFlags, log_print, log_printf
+
+# -- SOCKS5 (ref netbase.cpp) -------------------------------------------------
+
+SOCKS5_VER = 0x05
+SOCKS5_CMD_CONNECT = 0x01
+SOCKS5_ATYP_DOMAIN = 0x03
+SOCKS5_AUTH_NONE = 0x00
+SOCKS5_AUTH_USERPASS = 0x02
+
+_SOCKS5_ERRORS = {
+    0x01: "general failure",
+    0x02: "connection not allowed",
+    0x03: "network unreachable",
+    0x04: "host unreachable",
+    0x05: "connection refused",
+    0x06: "TTL expired",
+    0x07: "protocol error",
+    0x08: "address type not supported",
+}
+
+
+class Socks5Error(OSError):
+    pass
+
+
+def _recvall(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise Socks5Error("proxy closed connection mid-handshake")
+        buf += chunk
+    return buf
+
+
+def socks5_connect(
+    proxy: Tuple[str, int],
+    dest_host: str,
+    dest_port: int,
+    timeout: float = 10.0,
+    auth: Optional[Tuple[str, str]] = None,
+) -> socket.socket:
+    """Open a TCP connection to ``dest_host:dest_port`` via a SOCKS5 proxy.
+
+    The destination is always sent as a domain name (ATYP 3) so the proxy
+    resolves it — required for .onion and avoids DNS leaks (ref
+    netbase.cpp's Socks5 with SOCKS5_ATYP_DOMAINNAME).
+    """
+    if len(dest_host) > 255:
+        raise Socks5Error("destination hostname too long")
+    sock = socket.create_connection(proxy, timeout=timeout)
+    try:
+        methods = [SOCKS5_AUTH_NONE]
+        if auth is not None:
+            methods.append(SOCKS5_AUTH_USERPASS)
+        sock.sendall(bytes([SOCKS5_VER, len(methods), *methods]))
+        ver, method = _recvall(sock, 2)
+        if ver != SOCKS5_VER:
+            raise Socks5Error("proxy is not SOCKS5")
+        if method == SOCKS5_AUTH_USERPASS:
+            if auth is None:
+                raise Socks5Error("proxy demands credentials")
+            user, pw = (auth[0].encode(), auth[1].encode())
+            sock.sendall(
+                bytes([0x01, len(user)]) + user + bytes([len(pw)]) + pw
+            )
+            aver, status = _recvall(sock, 2)
+            if status != 0x00:
+                raise Socks5Error("proxy authentication failed")
+        elif method != SOCKS5_AUTH_NONE:
+            raise Socks5Error("no acceptable SOCKS5 auth method")
+        host_b = dest_host.encode()
+        sock.sendall(
+            bytes([SOCKS5_VER, SOCKS5_CMD_CONNECT, 0x00, SOCKS5_ATYP_DOMAIN])
+            + bytes([len(host_b)])
+            + host_b
+            + dest_port.to_bytes(2, "big")
+        )
+        ver, rep, _rsv, atyp = _recvall(sock, 4)
+        if rep != 0x00:
+            raise Socks5Error(
+                f"SOCKS5 connect failed: {_SOCKS5_ERRORS.get(rep, hex(rep))}"
+            )
+        # drain the bound address
+        if atyp == 0x01:
+            _recvall(sock, 4 + 2)
+        elif atyp == SOCKS5_ATYP_DOMAIN:
+            (alen,) = _recvall(sock, 1)
+            _recvall(sock, alen + 2)
+        elif atyp == 0x04:
+            _recvall(sock, 16 + 2)
+        else:
+            raise Socks5Error("bad ATYP in proxy reply")
+        return sock
+    except BaseException:
+        sock.close()
+        raise
+
+
+# -- Tor control protocol (ref torcontrol.cpp) --------------------------------
+
+# HMAC keys fixed by the Tor control spec (torcontrol.cpp:61-62)
+_SAFE_SERVER_KEY = b"Tor safe cookie authentication server-to-controller hash"
+_SAFE_CLIENT_KEY = b"Tor safe cookie authentication controller-to-client hash"
+
+ONION_KEY_FILE = "onion_v3_private_key"
+
+
+class TorControlError(Exception):
+    pass
+
+
+class TorControlConnection:
+    """Line-oriented Tor control-port client (blocking, single-threaded;
+    the reference's evented TorControlConnection collapsed onto plain
+    request/reply because commands here are strictly sequential)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+
+    def _read_line(self) -> str:
+        while b"\r\n" not in self._buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise TorControlError("control connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line.decode("utf-8", "replace")
+
+    def command(self, cmd: str) -> List[str]:
+        """Send one command, collect reply lines until the final '250 ' (or
+        error) status; raises on non-25x replies."""
+        self.sock.sendall(cmd.encode() + b"\r\n")
+        lines: List[str] = []
+        while True:
+            line = self._read_line()
+            if len(line) < 4:
+                raise TorControlError(f"malformed reply line {line!r}")
+            code, sep = line[:3], line[3]
+            lines.append(line)
+            if sep == " ":  # final line of the reply
+                if not code.startswith("25"):
+                    raise TorControlError(f"command failed: {line}")
+                return lines
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _parse_kv(line: str) -> Dict[str, str]:
+    """Parse 'KEY=val KEY2="quoted val"' fragments of a reply line."""
+    out: Dict[str, str] = {}
+    i = 0
+    while i < len(line):
+        if line[i] == " ":
+            i += 1
+            continue
+        eq = line.find("=", i)
+        if eq < 0:
+            break
+        key = line[i:eq]
+        if eq + 1 < len(line) and line[eq + 1] == '"':
+            end = line.find('"', eq + 2)
+            out[key] = line[eq + 2 : end]
+            i = end + 1
+        else:
+            end = line.find(" ", eq)
+            if end < 0:
+                end = len(line)
+            out[key] = line[eq + 1 : end]
+            i = end
+    return out
+
+
+class TorController:
+    """Publish the P2P port as an ephemeral v3 onion service (ref
+    torcontrol.cpp TorController).  Runs the connect → PROTOCOLINFO →
+    AUTHENTICATE → ADD_ONION sequence on a background thread with
+    reconnect backoff; the resulting address is handed to ``on_onion``.
+    """
+
+    def __init__(
+        self,
+        control_host: str,
+        control_port: int,
+        target_port: int,
+        datadir: Optional[str] = None,
+        target_host: str = "127.0.0.1",
+        password: Optional[str] = None,
+        on_onion=None,
+    ):
+        self.control_host = control_host
+        self.control_port = control_port
+        self.target_port = target_port
+        self.target_host = target_host
+        self.password = password
+        self.datadir = datadir
+        self.on_onion = on_onion
+        self.service_id: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.conn: Optional[TorControlConnection] = None
+
+    # -- key persistence (ref onion_v3_private_key) ------------------------
+
+    def _key_path(self) -> Optional[str]:
+        if self.datadir is None:
+            return None
+        return os.path.join(self.datadir, ONION_KEY_FILE)
+
+    def _load_private_key(self) -> str:
+        path = self._key_path()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                key = f.read().strip()
+            if key:
+                return key
+        return "NEW:ED25519-V3"
+
+    def _store_private_key(self, key: str) -> None:
+        path = self._key_path()
+        if not path:
+            return
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(key + "\n")
+
+    # -- protocol steps ----------------------------------------------------
+
+    def _authenticate(self, conn: TorControlConnection) -> None:
+        """ref TorController::protocolinfo_cb: prefer NULL, then SAFECOOKIE,
+        then COOKIE, then HASHEDPASSWORD."""
+        info = conn.command("PROTOCOLINFO 1")
+        methods: List[str] = []
+        cookie_file = None
+        for line in info:
+            body = line[4:]
+            if body.startswith("AUTH "):
+                kv = _parse_kv(body[5:])
+                methods = kv.get("METHODS", "").split(",")
+                cookie_file = kv.get("COOKIEFILE")
+        if "NULL" in methods:
+            conn.command("AUTHENTICATE")
+            return
+        if "SAFECOOKIE" in methods and cookie_file:
+            with open(cookie_file, "rb") as f:
+                cookie = f.read()
+            client_nonce = os.urandom(32)
+            reply = conn.command(
+                f"AUTHCHALLENGE SAFECOOKIE {client_nonce.hex()}"
+            )
+            kv = _parse_kv(reply[-1][4:].replace("AUTHCHALLENGE ", ""))
+            server_hash = bytes.fromhex(kv["SERVERHASH"])
+            server_nonce = bytes.fromhex(kv["SERVERNONCE"])
+            msg = cookie + client_nonce + server_nonce
+            expect = hmac.new(_SAFE_SERVER_KEY, msg, hashlib.sha256).digest()
+            if not hmac.compare_digest(expect, server_hash):
+                raise TorControlError("SAFECOOKIE server hash mismatch")
+            client_hash = hmac.new(_SAFE_CLIENT_KEY, msg, hashlib.sha256)
+            conn.command(f"AUTHENTICATE {client_hash.hexdigest()}")
+            return
+        if "COOKIE" in methods and cookie_file:
+            with open(cookie_file, "rb") as f:
+                cookie = f.read()
+            conn.command(f"AUTHENTICATE {cookie.hex()}")
+            return
+        if "HASHEDPASSWORD" in methods and self.password:
+            conn.command(f'AUTHENTICATE "{self.password}"')
+            return
+        raise TorControlError(f"no usable auth method in {methods}")
+
+    def _publish(self, conn: TorControlConnection) -> None:
+        key = self._load_private_key()
+        reply = conn.command(
+            f"ADD_ONION {key} "
+            f"Port={self.target_port},{self.target_host}:{self.target_port}"
+        )
+        for line in reply:
+            body = line[4:]
+            if body.startswith("ServiceID="):
+                self.service_id = body.split("=", 1)[1].strip()
+            elif body.startswith("PrivateKey="):
+                self._store_private_key(body.split("=", 1)[1].strip())
+        if not self.service_id:
+            raise TorControlError("ADD_ONION reply missing ServiceID")
+        onion = f"{self.service_id}.onion"
+        log_printf("tor: got service ID %s, advertising %s:%d",
+                   self.service_id, onion, self.target_port)
+        if self.on_onion:
+            self.on_onion(onion, self.target_port)
+
+    def connect_once(self) -> None:
+        """One full connect/auth/publish cycle (blocking)."""
+        conn = TorControlConnection(self.control_host, self.control_port)
+        try:
+            self._authenticate(conn)
+            self._publish(conn)
+            self.conn = conn
+        except BaseException:
+            conn.close()
+            raise
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="torcontrol", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        backoff = 1.0
+        while not self._stop.is_set():
+            try:
+                self.connect_once()
+                backoff = 1.0
+                # the ephemeral onion lives only as long as this control
+                # connection: block on it and re-publish if Tor restarts
+                # (ref TorController::disconnected_cb)
+                self._watch_connection()
+                if self._stop.is_set():
+                    return
+                log_print(LogFlags.NET, "tor control connection lost; "
+                          "re-establishing onion service")
+            except (OSError, TorControlError) as e:
+                log_print(LogFlags.NET, "tor control: %s (retry in %.0fs)",
+                          e, backoff)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 600.0)
+
+    def _watch_connection(self) -> None:
+        """Block until the control connection drops (or stop())."""
+        conn = self.conn
+        if conn is None:
+            return
+        conn.sock.settimeout(1.0)
+        while not self._stop.is_set():
+            try:
+                data = conn.sock.recv(4096)
+                if not data:
+                    break  # EOF: Tor went away
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+        self.conn = None
+        conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # join the watcher first so it cannot race us for the socket
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+        conn = self.conn
+        if conn is not None:
+            try:
+                if self.service_id:
+                    conn.command(f"DEL_ONION {self.service_id}")
+            except (OSError, TorControlError):
+                pass
+            conn.close()

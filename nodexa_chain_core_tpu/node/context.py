@@ -62,6 +62,9 @@ class NodeContext:
         from ..node.events import main_signals
 
         self.scheduler.stop()
+        tor = getattr(self, "tor_controller", None)
+        if tor is not None:
+            tor.stop()
         # stop the network first: blocks connected during teardown must
         # still reach the stores (they unregister only once no more events
         # can fire)

@@ -187,7 +187,48 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
         port = g_args.get_int("port", node.params.default_port)
         node.connman = ConnMan(node, port=port)
+
+        def _parse_hostport(s: str, default_port: int = 9050) -> tuple:
+            if s.startswith("[") and "]" in s:  # [::1]:9050
+                h, rest = s[1:].split("]", 1)
+                return (h, int(rest.lstrip(":") or default_port))
+            if s.count(":") > 1:  # bare IPv6 literal, no port
+                return (s, default_port)
+            h, _, p = s.rpartition(":")
+            if not h:
+                h, p = p, ""
+            return (h, int(p or default_port))
+
+        # -proxy / -onion: SOCKS5 outbound routing (ref init.cpp SetProxy)
+        if g_args.is_set("proxy"):
+            node.connman.proxy = _parse_hostport(g_args.get("proxy"))
+            node.connman.onion_proxy = node.connman.proxy
+            log_printf("outbound via SOCKS5 proxy %s:%d", *node.connman.proxy)
+        if g_args.is_set("onion"):
+            node.connman.onion_proxy = _parse_hostport(g_args.get("onion"))
         node.connman.start()
+
+        # -listenonion: publish the P2P port as a v3 onion service through
+        # the Tor control port (ref torcontrol.cpp StartTorControl)
+        if g_args.get_bool("listenonion"):
+            from ..net.torcontrol import TorController
+
+            ctrl_host, ctrl_port = _parse_hostport(
+                g_args.get("torcontrol", "127.0.0.1:9051"), 9051
+            )
+
+            def _advertise(onion: str, p: int) -> None:
+                node.connman.addrman.add(onion, p)
+
+            node.tor_controller = TorController(
+                ctrl_host,
+                ctrl_port,
+                target_port=port,
+                datadir=datadir,
+                password=g_args.get("torpassword") or None,
+                on_onion=_advertise,
+            )
+            node.tor_controller.start()
 
         class _PeerNotifier(ValidationInterface):
             """Announce locally-found tips to peers (ref the

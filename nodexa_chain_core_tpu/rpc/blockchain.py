@@ -292,6 +292,10 @@ def verifychain(node, params: List[Any]):
     idx = cs.tip()
     count = 0
     while idx is not None and idx.prev is not None and count < checkdepth:
+        from ..chain.blockindex import BlockStatus
+
+        if not idx.status & BlockStatus.HAVE_DATA:
+            break  # pruned boundary: nothing below is verifiable
         block = cs.read_block(idx)
         try:
             cs.check_block(block)

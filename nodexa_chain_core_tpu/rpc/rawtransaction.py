@@ -84,8 +84,12 @@ def getrawtransaction(node, params: List[Any]):
     if tx is None:
         # scan the active chain (the reference needs -txindex for this; we
         # walk blocks which is acceptable at this framework's scale)
+        from ..chain.blockindex import BlockStatus
+
         cs = node.chainstate
         for idx in cs.active:
+            if not idx.status & BlockStatus.HAVE_DATA:
+                continue  # pruned: only stored blocks are searchable
             block = cs.read_block(idx)
             for cand in block.vtx:
                 if cand.txid == txid:

@@ -303,10 +303,14 @@ class Wallet(ValidationInterface):
 
     def rescan(self) -> int:
         """ref ScanForWalletTransactions."""
+        from ..chain.blockindex import BlockStatus
+
         cs = self.node.chainstate
         found = 0
         with self.lock:
             for idx in cs.active:
+                if not idx.status & BlockStatus.HAVE_DATA:
+                    continue  # pruned: scan only the stored range
                 block = cs.read_block(idx)
                 for tx in block.vtx:
                     if self.is_relevant(tx):
