@@ -10,6 +10,7 @@ index persists them).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -153,6 +154,10 @@ class ChunkedRecordFile:
             if os.path.exists(legacy) and not os.path.exists(self._path(0)):
                 os.rename(legacy, self._path(0))
         self._files: dict = {}
+        # one lock serializes handle-cache mutation AND record IO: peers,
+        # RPC threads and the wallet all read concurrently, and the LRU
+        # close below must never yank a file out from under a reader
+        self._lock = threading.RLock()
         nums = self.chunk_numbers()
         self._tail = nums[-1] if nums else 0
 
@@ -183,23 +188,27 @@ class ChunkedRecordFile:
         return f
 
     def append(self, payload: bytes) -> int:
-        f = self._file(self._tail)
-        if f.size() > 0 and f.size() + 8 + len(payload) > self.chunk_bytes:
-            self._tail += 1
+        with self._lock:
             f = self._file(self._tail)
-        off = f.append(payload)
-        return self._tail * self.CHUNK_SPAN + off
+            if f.size() > 0 and f.size() + 8 + len(payload) > self.chunk_bytes:
+                self._tail += 1
+                f = self._file(self._tail)
+            off = f.append(payload)
+            return self._tail * self.CHUNK_SPAN + off
 
     def read(self, pos: int) -> bytes:
         n, off = divmod(pos, self.CHUNK_SPAN)
-        if n not in self._files and not os.path.exists(self._path(n)):
-            raise PrunedError(f"chunk {n} of {self.base} has been pruned")
-        return self._file(n).read(off)
+        with self._lock:
+            if n not in self._files and not os.path.exists(self._path(n)):
+                raise PrunedError(f"chunk {n} of {self.base} has been pruned")
+            return self._file(n).read(off)
 
     def scan(self):
         """(pos, payload) over all surviving chunks in order."""
         for n in self.chunk_numbers():
-            for off, payload in self._file(n).scan():
+            with self._lock:
+                records = list(self._file(n).scan())
+            for off, payload in records:
                 yield n * self.CHUNK_SPAN + off, payload
 
     @staticmethod
@@ -209,16 +218,17 @@ class ChunkedRecordFile:
     def delete_chunks(self, nums) -> int:
         """Unlink the given chunk files; the tail chunk is never deleted."""
         freed = 0
-        for n in nums:
-            if n == self._tail:
-                continue
-            f = self._files.pop(n, None)
-            if f is not None:
-                f.close()
-            path = self._path(n)
-            if os.path.exists(path):
-                freed += os.path.getsize(path)
-                os.unlink(path)
+        with self._lock:
+            for n in nums:
+                if n == self._tail:
+                    continue
+                f = self._files.pop(n, None)
+                if f is not None:
+                    f.close()
+                path = self._path(n)
+                if os.path.exists(path):
+                    freed += os.path.getsize(path)
+                    os.unlink(path)
         return freed
 
     def total_bytes(self) -> int:
@@ -227,13 +237,15 @@ class ChunkedRecordFile:
         )
 
     def sync(self) -> None:
-        for f in self._files.values():
-            f.sync()
+        with self._lock:
+            for f in self._files.values():
+                f.sync()
 
     def close(self) -> None:
-        for f in self._files.values():
-            f.close()
-        self._files.clear()
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()
 
 
 class BlockStore:

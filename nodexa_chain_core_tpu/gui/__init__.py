@@ -1,0 +1,5 @@
+"""Web GUI (the TPU-native substitution for reference src/qt/ — a 36k-LoC
+Qt5 desktop wallet).  A daemon-embedded single-page app is the idiomatic
+surface for a headless TPU node: it rides the existing HTTP server, needs
+no display stack, and drives the same JSON-RPC/REST APIs a desktop wallet
+would (ref src/qt/cloregui.cpp, walletmodel.cpp, assettablemodel.cpp)."""

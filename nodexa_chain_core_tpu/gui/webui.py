@@ -1,0 +1,295 @@
+"""The single-page web wallet/explorer served at /ui (parity: reference
+src/qt/ screens — overview, send, receive, transactions, assets, peers;
+e.g. cloregui.cpp tab wiring, sendcoinsdialog.cpp, assetsdialog.cpp).
+
+Read-only data flows over the unauthenticated REST endpoints
+(ref src/rest.cpp); wallet and peer actions call JSON-RPC with the
+operator's rpcuser/rpcpassword entered in the page (held in
+sessionStorage only, like clore-qt holding RPC credentials in memory).
+"""
+
+PAGE = r"""<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>nodexa-chain-core_tpu</title>
+<style>
+:root{--bg:#101418;--panel:#1a2027;--line:#2a323c;--fg:#d7dde4;--dim:#8b97a5;
+--acc:#5aa9e6;--ok:#69c383;--bad:#e6705a;font-size:15px}
+*{box-sizing:border-box}
+body{margin:0;background:var(--bg);color:var(--fg);
+font-family:ui-monospace,SFMono-Regular,Menlo,Consolas,monospace}
+header{display:flex;gap:1.5em;align-items:baseline;padding:.8em 1.2em;
+background:var(--panel);border-bottom:1px solid var(--line);flex-wrap:wrap}
+header h1{font-size:1.05rem;margin:0;color:var(--acc)}
+header .stat b{color:var(--fg)} header .stat{color:var(--dim)}
+nav{display:flex;gap:.25em;padding:.5em 1.2em;border-bottom:1px solid var(--line)}
+nav button{background:none;border:1px solid transparent;color:var(--dim);
+padding:.35em .9em;cursor:pointer;font:inherit;border-radius:4px}
+nav button.active{color:var(--fg);border-color:var(--line);background:var(--panel)}
+main{padding:1.2em;max-width:1100px}
+table{border-collapse:collapse;width:100%;margin:.6em 0}
+th,td{text-align:left;padding:.35em .7em;border-bottom:1px solid var(--line);
+font-size:.86rem;word-break:break-all}
+th{color:var(--dim);font-weight:normal}
+.panel{background:var(--panel);border:1px solid var(--line);border-radius:6px;
+padding:1em;margin-bottom:1em}
+.mono{color:var(--dim)} .ok{color:var(--ok)} .bad{color:var(--bad)}
+input,select{background:var(--bg);border:1px solid var(--line);color:var(--fg);
+padding:.4em .6em;font:inherit;border-radius:4px}
+button.act{background:var(--acc);border:none;color:#06121e;padding:.45em 1em;
+border-radius:4px;cursor:pointer;font:inherit}
+a{color:var(--acc);cursor:pointer;text-decoration:none}
+#toast{position:fixed;bottom:1em;right:1em;background:var(--panel);
+border:1px solid var(--line);padding:.7em 1em;border-radius:6px;display:none}
+.grid{display:grid;grid-template-columns:repeat(auto-fit,minmax(220px,1fr));gap:1em}
+.kv div{margin:.2em 0}.kv span{color:var(--dim);display:inline-block;min-width:11em}
+</style>
+</head>
+<body>
+<header>
+  <h1>nodexa-chain-core_tpu</h1>
+  <span class="stat">chain <b id="h-chain">–</b></span>
+  <span class="stat">height <b id="h-height">–</b></span>
+  <span class="stat">mempool <b id="h-mempool">–</b></span>
+  <span class="stat">peers <b id="h-peers">–</b></span>
+  <span class="stat" id="h-auth" style="margin-left:auto"></span>
+</header>
+<nav id="nav"></nav>
+<main id="main"></main>
+<div id="toast"></div>
+<script>
+"use strict";
+const $ = (s) => document.querySelector(s);
+const el = (t, attrs={}, ...kids) => { const e = document.createElement(t);
+  for (const [k,v] of Object.entries(attrs)) k==="text"?e.textContent=v:e.setAttribute(k,v);
+  e.append(...kids); return e; };
+const toast = (msg, bad=false) => { const t=$("#toast");
+  t.textContent=msg; t.className=bad?"bad":"ok"; t.style.display="block";
+  setTimeout(()=>t.style.display="none", 4000); };
+
+async function rest(path){ const r = await fetch(path);
+  if (!r.ok) throw new Error("REST "+r.status); return r.json(); }
+
+function creds(){ return sessionStorage.getItem("rpcauth"); }
+async function rpc(method, params=[]){
+  const auth = creds();
+  if (!auth) throw new Error("RPC credentials required (see Wallet tab)");
+  const r = await fetch("/", {method:"POST",
+    headers:{"Authorization":"Basic "+auth,"Content-Type":"application/json"},
+    body: JSON.stringify({method, params, id:1})});
+  const j = await r.json();
+  if (j.error) throw new Error(j.error.message || JSON.stringify(j.error));
+  return j.result;
+}
+
+// -- header poll -------------------------------------------------------------
+async function pollHeader(){
+  try {
+    const ci = await rest("/rest/chaininfo");
+    $("#h-chain").textContent = ci.chain;
+    $("#h-height").textContent = ci.blocks;
+    const mi = await rest("/rest/mempool");
+    $("#h-mempool").textContent = mi.size + " tx";
+    if (creds()) {
+      try { $("#h-peers").textContent = await rpc("getconnectioncount"); }
+      catch(e) { $("#h-peers").textContent = "?"; }
+    }
+  } catch(e) { /* node restarting */ }
+}
+setInterval(pollHeader, 5000);
+
+// -- tabs --------------------------------------------------------------------
+const TABS = {Overview: viewOverview, Blocks: viewBlocks, Mempool: viewMempool,
+              Wallet: viewWallet, Assets: viewAssets, Peers: viewPeers};
+let current = "Overview";
+function nav(){
+  const n = $("#nav"); n.replaceChildren();
+  for (const name of Object.keys(TABS)) {
+    const b = el("button", {text:name});
+    if (name===current) b.classList.add("active");
+    b.onclick = () => { current=name; nav(); render(); };
+    n.append(b);
+  }
+}
+async function render(){
+  const m = $("#main"); m.replaceChildren(el("p",{text:"loading…",class:"mono"}));
+  try { m.replaceChildren(await TABS[current]()); }
+  catch(e){ m.replaceChildren(el("p",{class:"bad",text:String(e)})); }
+}
+
+// -- recent-block walk over REST (prev-hash chain; no auth needed) -----------
+async function recentBlocks(n){
+  const ci = await rest("/rest/chaininfo");
+  const out = []; let h = ci.bestblockhash;
+  while (h && out.length < n) {
+    let b;
+    try { b = await rest("/rest/block/"+h); } catch(e){ break; } // pruned
+    out.push(b); h = b.previousblockhash;
+  }
+  return out;
+}
+
+function blockTable(blocks, onclick){
+  const tb = el("tbody");
+  for (const b of blocks) {
+    const link = el("a", {text:b.hash.slice(0,24)+"…"});
+    link.onclick = () => onclick(b);
+    tb.append(el("tr",{}, el("td",{text:b.height}), el("td",{},link),
+      el("td",{text:b.nTx}), el("td",{text:new Date(b.time*1000).toISOString()})));
+  }
+  return el("table",{}, el("thead",{},el("tr",{},el("th",{text:"height"}),
+    el("th",{text:"hash"}),el("th",{text:"txs"}),el("th",{text:"time"}))), tb);
+}
+
+// -- views -------------------------------------------------------------------
+async function viewOverview(){
+  const ci = await rest("/rest/chaininfo");
+  const mi = await rest("/rest/mempool");
+  const wrap = el("div");
+  const kv = el("div",{class:"panel kv"});
+  for (const [k,v] of [["chain",ci.chain],["blocks",ci.blocks],
+      ["headers",ci.headers],["difficulty",ci.difficulty.toPrecision(6)],
+      ["best block",ci.bestblockhash],["median time",ci.mediantime],
+      ["pruned",ci.pruned],["mempool txs",mi.size]])
+    kv.append(el("div",{}, el("span",{text:k}), el("b",{text:String(v)})));
+  wrap.append(kv, el("h3",{text:"recent blocks"}));
+  wrap.append(blockTable(await recentBlocks(8), showBlock));
+  return wrap;
+}
+
+async function viewBlocks(){
+  const wrap = el("div");
+  wrap.append(blockTable(await recentBlocks(25), showBlock));
+  return wrap;
+}
+
+async function showBlock(b){
+  current = "Blocks"; nav();
+  const full = await rest("/rest/block/"+b.hash);
+  const wrap = el("div");
+  const kv = el("div",{class:"panel kv"});
+  for (const k of ["height","hash","previousblockhash","merkleroot","time",
+                   "bits","nonce","difficulty","size","nTx"])
+    if (full[k]!==undefined)
+      kv.append(el("div",{},el("span",{text:k}),el("b",{text:String(full[k])})));
+  wrap.append(kv, el("h3",{text:"transactions"}));
+  const tb = el("tbody");
+  for (const tx of full.tx) {
+    const vout = (tx.vout||[]).map(o=>o.value).reduce((a,b)=>a+b,0);
+    tb.append(el("tr",{}, el("td",{text:tx.txid||tx}),
+      el("td",{text:(tx.vin&&tx.vin[0]&&tx.vin[0].coinbase)?"coinbase":""}),
+      el("td",{text:vout?vout.toFixed(8):""})));
+  }
+  wrap.append(el("table",{},el("thead",{},el("tr",{},el("th",{text:"txid"}),
+    el("th",{text:""}),el("th",{text:"out value"}))),tb));
+  $("#main").replaceChildren(wrap);
+  return wrap;
+}
+
+async function viewMempool(){
+  const txs = await rest("/rest/mempool/contents");
+  const wrap = el("div");
+  const tb = el("tbody");
+  for (const [txid, e] of Object.entries(txs))
+    tb.append(el("tr",{}, el("td",{text:txid}), el("td",{text:e.size}),
+      el("td",{text:e.fee.toFixed(8)}),
+      el("td",{text:new Date(e.time*1000).toISOString()})));
+  wrap.append(el("table",{},el("thead",{},el("tr",{},el("th",{text:"txid"}),
+    el("th",{text:"size"}),el("th",{text:"fee"}),el("th",{text:"entered"}))),tb));
+  if (!Object.keys(txs).length) wrap.append(el("p",{class:"mono",text:"mempool is empty"}));
+  return wrap;
+}
+
+function loginPanel(after){
+  const p = el("div",{class:"panel"});
+  p.append(el("p",{text:"Enter RPC credentials (rpcuser/rpcpassword or the .cookie content user:pass)"}));
+  const u = el("input",{placeholder:"rpcuser"});
+  const w = el("input",{placeholder:"rpcpassword",type:"password"});
+  const b = el("button",{class:"act",text:"connect"});
+  b.onclick = async () => {
+    sessionStorage.setItem("rpcauth", btoa(u.value+":"+w.value));
+    try { await rpc("uptime"); $("#h-auth").textContent="rpc ✓"; toast("connected"); after(); }
+    catch(e){ sessionStorage.removeItem("rpcauth"); toast("auth failed: "+e.message, true); }
+  };
+  p.append(el("div",{},u," ",w," ",b));
+  return p;
+}
+
+async function viewWallet(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const info = await rpc("getwalletinfo");
+  const kv = el("div",{class:"panel kv"});
+  for (const [k,v] of Object.entries(info))
+    kv.append(el("div",{},el("span",{text:k}),el("b",{text:String(v)})));
+  wrap.append(kv);
+
+  const recv = el("div",{class:"panel"});
+  const addr = el("code",{class:"mono",text:" "});
+  const nb = el("button",{class:"act",text:"new address"});
+  nb.onclick = async()=>{ addr.textContent = await rpc("getnewaddress"); };
+  recv.append(el("h3",{text:"receive"}), nb, el("span",{text:"  "}), addr);
+  wrap.append(recv);
+
+  const send = el("div",{class:"panel"});
+  const to = el("input",{placeholder:"address",size:"40"});
+  const amt = el("input",{placeholder:"amount",size:"12"});
+  const sb = el("button",{class:"act",text:"send"});
+  sb.onclick = async()=>{
+    try { const txid = await rpc("sendtoaddress",[to.value,parseFloat(amt.value)]);
+      toast("sent: "+txid); render(); }
+    catch(e){ toast(String(e.message||e), true); }
+  };
+  send.append(el("h3",{text:"send"}), to, el("span",{text:" "}), amt,
+              el("span",{text:" "}), sb);
+  wrap.append(send);
+
+  const txs = await rpc("listtransactions",["*",15]);
+  const tb = el("tbody");
+  for (const t of txs)
+    tb.append(el("tr",{},el("td",{text:t.category}),el("td",{text:t.amount}),
+      el("td",{text:t.confirmations}),el("td",{text:t.txid})));
+  wrap.append(el("h3",{text:"recent transactions"}),
+    el("table",{},el("thead",{},el("tr",{},el("th",{text:"type"}),
+    el("th",{text:"amount"}),el("th",{text:"conf"}),el("th",{text:"txid"}))),tb));
+  return wrap;
+}
+
+async function viewAssets(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const assets = await rpc("listassets",["*", true]);
+  const tb = el("tbody");
+  for (const [name, a] of Object.entries(assets))
+    tb.append(el("tr",{},el("td",{text:name}),el("td",{text:a.amount}),
+      el("td",{text:a.units}),el("td",{text:a.reissuable?"yes":"no"})));
+  wrap.append(el("table",{},el("thead",{},el("tr",{},el("th",{text:"asset"}),
+    el("th",{text:"amount"}),el("th",{text:"units"}),el("th",{text:"reissuable"}))),tb));
+  if (!Object.keys(assets).length) wrap.append(el("p",{class:"mono",text:"no assets issued"}));
+  return wrap;
+}
+
+async function viewPeers(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const peers = await rpc("getpeerinfo");
+  const tb = el("tbody");
+  for (const p of peers)
+    tb.append(el("tr",{},el("td",{text:p.id}),el("td",{text:p.addr}),
+      el("td",{text:p.inbound?"in":"out"}),el("td",{text:p.subver||""}),
+      el("td",{text:p.synced_headers??""})));
+  wrap.append(el("table",{},el("thead",{},el("tr",{},el("th",{text:"id"}),
+    el("th",{text:"address"}),el("th",{text:"dir"}),el("th",{text:"agent"}),
+    el("th",{text:"headers"}))),tb));
+  if (!peers.length) wrap.append(el("p",{class:"mono",text:"no peers connected"}));
+  return wrap;
+}
+
+if (creds()) $("#h-auth").textContent = "rpc ✓";
+nav(); render(); pollHeader();
+</script>
+</body>
+</html>
+"""

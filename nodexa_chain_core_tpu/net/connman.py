@@ -99,6 +99,9 @@ class ConnMan:
         # outbound; `onion_proxy` routes .onion destinations (-onion)
         self.proxy: Optional[tuple] = None
         self.onion_proxy: Optional[tuple] = None
+        # our own reachable addresses (ref AddLocal/GetLocalAddress): they
+        # are advertised to peers, never dialed, never put in our addrman
+        self.local_addresses: List[tuple] = []
         from .net_processing import NetProcessor
 
         self.processor = NetProcessor(node, self)
@@ -156,6 +159,8 @@ class ConnMan:
         port = int(port_s or self.node.params.default_port)
         if self.is_banned(host):
             return False
+        if (host, port) in self.local_addresses:
+            return False  # never dial ourselves (ref IsLocal check)
         is_onion = host.endswith(".onion")
         proxy = self.onion_proxy if is_onion else self.proxy
         if is_onion and proxy is None:
@@ -385,6 +390,12 @@ class ConnMan:
                                     p.feeler = True
 
     # -- bans (ref banlist.dat / CBanDB) ----------------------------------
+
+    def add_local(self, host: str, port: int) -> None:
+        """Register one of our own reachable addresses (ref AddLocal)."""
+        if (host, port) not in self.local_addresses:
+            self.local_addresses.append((host, port))
+            log_printf("local address: %s:%d", host, port)
 
     def ban(self, ip: str, duration: float = 24 * 3600) -> None:
         self.banned[ip] = time.time() + duration

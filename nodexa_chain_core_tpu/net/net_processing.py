@@ -500,11 +500,25 @@ class NetProcessor:
     # -- addr gossip -------------------------------------------------------
 
     def _on_getaddr(self, peer, r: ByteReader) -> None:
+        import ipaddress as _ipa
+
         addrs = self.connman.addrman.get_addresses(1000)
+        # ref PushAddress(GetLocalAddress); only IP-form locals fit the
+        # legacy 16-byte addr encoding (v3 onions would need BIP155
+        # addrv2 — peers reach them via -addnode/-connect instead)
+        local = []
+        for host, port in getattr(self.connman, "local_addresses", []):
+            try:
+                _ipa.ip_address(host)
+                local.append((host, port))
+            except ValueError:
+                continue
         w = ByteWriter()
-        w.compact_size(len(addrs))
+        w.compact_size(len(addrs) + len(local))
         for a in addrs:
             NetAddr(services=a.services, ip=a.ip, port=a.port).serialize(w)
+        for host, port in local:
+            NetAddr(services=1, ip=host, port=port).serialize(w)
         peer.send_msg(self.magic, MSG_ADDR, w.getvalue())
 
     def _on_addr(self, peer, r: ByteReader) -> None:

@@ -191,6 +191,9 @@ def _parse_kv(line: str) -> Dict[str, str]:
         key = line[i:eq]
         if eq + 1 < len(line) and line[eq + 1] == '"':
             end = line.find('"', eq + 2)
+            if end < 0:  # unterminated quote: take the rest, stop
+                out[key] = line[eq + 2 :]
+                break
             out[key] = line[eq + 2 : end]
             i = end + 1
         else:
@@ -378,11 +381,13 @@ class TorController:
         conn.close()
 
     def stop(self) -> None:
+        # capture before joining: _watch_connection nulls self.conn when it
+        # exits, which would make the DEL_ONION below unreachable
+        conn = self.conn
         self._stop.set()
         # join the watcher first so it cannot race us for the socket
         if self._thread is not None:
             self._thread.join(timeout=3)
-        conn = self.conn
         if conn is not None:
             try:
                 if self.service_id:

@@ -218,7 +218,9 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             )
 
             def _advertise(onion: str, p: int) -> None:
-                node.connman.addrman.add(onion, p)
+                # a LOCAL address (ref AddLocal): advertised via getaddr
+                # replies, never self-dialed through addrman
+                node.connman.add_local(onion, p)
 
             node.tor_controller = TorController(
                 ctrl_host,

@@ -287,13 +287,13 @@ def gettxout(node, params: List[Any]):
 def verifychain(node, params: List[Any]):
     """ref CVerifyDB::VerifyDB (validation.cpp:12564), simplified level:
     walk back N blocks re-running connect checks against a throwaway view."""
+    from ..chain.blockindex import BlockStatus
+
     checkdepth = int(params[1]) if len(params) > 1 else 6
     cs = node.chainstate
     idx = cs.tip()
     count = 0
     while idx is not None and idx.prev is not None and count < checkdepth:
-        from ..chain.blockindex import BlockStatus
-
         if not idx.status & BlockStatus.HAVE_DATA:
             break  # pruned boundary: nothing below is verifiable
         block = cs.read_block(idx)

@@ -121,6 +121,12 @@ def getnetworkinfo(node, params: List[Any]):
         "networkactive": node.connman is not None,
         "connections": node.connman.connection_count() if node.connman else 0,
         "networks": [],
+        "localaddresses": [
+            {"address": h, "port": p, "score": 1}
+            for h, p in (
+                node.connman.local_addresses if node.connman else []
+            )
+        ],
         "relayfee": 0.00001,
         "warnings": "",
     }

@@ -26,6 +26,12 @@ def make_rest_handler(node):
             parts = [p for p in path.split("?")[0].split("/") if p]
             if not parts:
                 return 200, _status_page(node)
+            if parts[0] == "ui":
+                # the embedded web wallet/explorer (the framework's GUI
+                # surface standing in for reference src/qt/)
+                from ..gui.webui import PAGE
+
+                return 200, PAGE
             if parts[0] != "rest":
                 return 404, {"error": "not found"}
             if parts[1] == "chaininfo.json" or parts[1] == "chaininfo":

@@ -160,11 +160,15 @@ class HTTPRPCServer:
                 log_print(LogFlags.HTTP, "http: " + fmt, *args)
 
             def _reply(self, code: int, payload: dict | list | str) -> None:
-                body = (
-                    json.dumps(payload) if not isinstance(payload, str) else payload
-                ).encode()
+                if isinstance(payload, str):
+                    body = payload.encode()
+                    # string payloads are HTML pages (status page, /ui)
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

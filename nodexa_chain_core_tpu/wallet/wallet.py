@@ -307,16 +307,26 @@ class Wallet(ValidationInterface):
 
         cs = self.node.chainstate
         found = 0
+        skipped = 0
         with self.lock:
             for idx in cs.active:
                 if not idx.status & BlockStatus.HAVE_DATA:
-                    continue  # pruned: scan only the stored range
+                    skipped += 1  # pruned: scan only the stored range
+                    continue
                 block = cs.read_block(idx)
                 for tx in block.vtx:
                     if self.is_relevant(tx):
                         self.wtx[tx.txid] = WalletTx(tx=tx, height=idx.height)
                         found += 1
             self.flush()
+        if skipped:
+            from ..utils.logging import log_printf
+
+            log_printf(
+                "WARNING: rescan skipped %d pruned blocks — transactions in "
+                "them are NOT recovered (re-sync without -prune for a full "
+                "rescan)", skipped,
+            )
         return found
 
     # ------------------------------------------------------------- balance
