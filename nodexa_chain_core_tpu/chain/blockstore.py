@@ -207,6 +207,10 @@ class ChunkedRecordFile:
         """(pos, payload) over all surviving chunks in order."""
         for n in self.chunk_numbers():
             with self._lock:
+                # a concurrent prune may have unlinked this chunk; opening
+                # it blindly would resurrect it as an empty zombie file
+                if n not in self._files and not os.path.exists(self._path(n)):
+                    continue
                 records = list(self._file(n).scan())
             for off, payload in records:
                 yield n * self.CHUNK_SPAN + off, payload

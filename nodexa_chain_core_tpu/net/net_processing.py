@@ -513,6 +513,9 @@ class NetProcessor:
                 local.append((host, port))
             except ValueError:
                 continue
+        # stay within the 1000-addr message cap (receivers score
+        # oversized addr messages as misbehaving)
+        addrs = addrs[: 1000 - len(local)]
         w = ByteWriter()
         w.compact_size(len(addrs) + len(local))
         for a in addrs:

@@ -377,6 +377,10 @@ class TorController:
                 continue
             except OSError:
                 break
+        if self._stop.is_set():
+            # shutdown path: stop() still needs the connection to send
+            # DEL_ONION; it owns the close
+            return
         self.conn = None
         conn.close()
 

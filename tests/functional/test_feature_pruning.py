@@ -1,0 +1,70 @@
+"""Functional: -prune over the daemon surface (parity: reference
+feature_pruning.py, scaled down via -blockchunksize)."""
+
+import os
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+from .test_mining_basic import ADDR
+
+
+def _blk_files(node) -> list:
+    d = os.path.join(node.datadir, "regtest", "blocks")
+    return sorted(f for f in os.listdir(d) if f.startswith("blk"))
+
+
+@pytest.mark.functional
+def test_manual_prune_daemon():
+    with TestFramework(
+        num_nodes=1,
+        extra_args=[["-prune=1", "-blockchunksize=2048"]],
+    ) as f:
+        n0 = f.nodes[0]
+        n0.rpc.generatetoaddress(320, ADDR)
+        info = n0.rpc.getblockchaininfo()
+        assert info["pruned"] is True
+        files_before = _blk_files(n0)
+        assert len(files_before) > 5
+
+        pruned_through = n0.rpc.pruneblockchain(300)
+        assert pruned_through > 0
+        assert len(_blk_files(n0)) < len(files_before)
+
+        info = n0.rpc.getblockchaininfo()
+        assert info["pruneheight"] > 0
+        # early block data is gone, recent is served
+        early = n0.rpc.getblockhash(1)
+        with pytest.raises(RPCFailure, match="pruned"):
+            n0.rpc.getblock(early)
+        tip = n0.rpc.getbestblockhash()
+        assert n0.rpc.getblock(tip)["height"] == 320
+
+        # restart: prune state survives, node stays at height
+        n0.stop()
+        n0.start()
+        assert n0.rpc.getblockcount() == 320
+        assert n0.rpc.getblockchaininfo()["pruned"] is True
+        with pytest.raises(RPCFailure, match="pruned"):
+            n0.rpc.getblock(early)
+
+
+@pytest.mark.functional
+def test_pruned_node_serves_recent_blocks_to_peers():
+    """A pruned node still syncs a fresh peer for the retained window —
+    and MIN_BLOCKS_TO_KEEP (288) always covers a regtest-depth sync."""
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        n0.rpc.generatetoaddress(30, ADDR)
+        f.connect_nodes(1, 0)
+        f.sync_blocks(timeout=45)
+        assert n1.rpc.getblockcount() == 30
+
+
+@pytest.mark.functional
+def test_prune_rpc_requires_prune_mode():
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        n0.rpc.generatetoaddress(2, ADDR)
+        with pytest.raises(RPCFailure, match="prune mode"):
+            n0.rpc.pruneblockchain(1)

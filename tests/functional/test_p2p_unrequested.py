@@ -1,0 +1,113 @@
+"""Functional: protocol-level behavior against a scripted raw peer
+(parity: reference p2p_unrequested_blocks.py + p2p_leak.py, driven by a
+mininode-style mock peer)."""
+
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.mining.assembler import mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.block import Block
+
+from .framework import TestFramework
+from .mininode import MiniPeer
+from .test_mining_basic import ADDR
+
+
+def _block_from_rpc(node, block_hash: str, params) -> Block:
+    raw = bytes.fromhex(node.rpc.getblock(block_hash, 0))
+    return Block.deserialize(ByteReader(raw), params.algo_schedule)
+
+
+@pytest.mark.functional
+def test_unrequested_valid_block_is_accepted():
+    params = regtest_params()
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        n0.rpc.generatetoaddress(2, ADDR)
+        # node1 independently mines a LONGER chain; we push its tip block
+        # chain to node0 unsolicited, block-by-block (no inv/getdata)
+        n1.rpc.generatetoaddress(3, ADDR)
+        peer = MiniPeer(n0.p2p_port)
+        try:
+            peer.handshake()
+            for h in range(1, 4):
+                bh = n1.rpc.getblockhash(h)
+                blk = _block_from_rpc(n1, bh, params)
+                w = ByteWriter()
+                blk.serialize(w, params.algo_schedule)
+                peer.send("block", w.getvalue())
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if n0.rpc.getblockcount() == 3 and (
+                    n0.rpc.getbestblockhash() == n1.rpc.getbestblockhash()
+                ):
+                    break
+                time.sleep(0.2)
+            assert n0.rpc.getbestblockhash() == n1.rpc.getbestblockhash()
+        finally:
+            peer.close()
+
+
+@pytest.mark.functional
+def test_unknown_parent_block_does_not_crash_node():
+    params = regtest_params()
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        n0.rpc.generatetoaddress(1, ADDR)
+        # a block whose parent node0 has never seen (node1's private chain)
+        n1.rpc.generatetoaddress(5, ADDR)
+        orphan_hash = n1.rpc.getblockhash(5)
+        blk = _block_from_rpc(n1, orphan_hash, params)
+        peer = MiniPeer(n0.p2p_port)
+        try:
+            peer.handshake()
+            w = ByteWriter()
+            blk.serialize(w, params.algo_schedule)
+            peer.send("block", w.getvalue())
+            time.sleep(1.0)
+            # node survives and keeps its chain
+            assert n0.rpc.getblockcount() == 1
+            # and the node asks where this came from (headers sync probe)
+            assert "getheaders" in peer.commands_seen() or peer.alive
+        finally:
+            peer.close()
+
+
+@pytest.mark.functional
+def test_no_leak_before_version_handshake():
+    """ref p2p_leak.py: requests sent before the version handshake get no
+    reply (only banscore) — the node must not leak addr/pong/data."""
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        n0.rpc.generatetoaddress(1, ADDR)
+        peer = MiniPeer(n0.p2p_port)
+        try:
+            for cmd in ("getaddr", "mempool", "ping"):
+                peer.send(cmd, b"\x00" * 8 if cmd == "ping" else b"")
+            time.sleep(2.0)
+            leaked = [c for c in peer.commands_seen() if c not in ("version",)]
+            assert not leaked, f"pre-handshake leak: {leaked}"
+            # the same connection can still complete a proper handshake
+            peer.handshake()
+            peer.send("ping", b"\x11" * 8)
+            peer.wait_for("pong")
+            # and the node recorded the misbehavior
+            info = n0.rpc.getpeerinfo()
+            assert info and info[0]["banscore"] >= 3
+        finally:
+            peer.close()
+
+
+@pytest.mark.functional
+def test_bad_magic_disconnects():
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        peer = MiniPeer(n0.p2p_port, magic=b"XXXX")
+        try:
+            peer.send("version", b"\x00" * 20)
+            peer.wait_disconnected(timeout=10)
+        finally:
+            peer.close()

@@ -1,0 +1,62 @@
+"""Functional: invalidateblock / reconsiderblock / preciousblock RPCs
+across nodes (parity: reference rpc_invalidateblock.py,
+rpc_preciousblock.py)."""
+
+import time
+
+import pytest
+
+from .framework import TestFramework
+from .test_mining_basic import ADDR, ADDR2
+
+
+@pytest.mark.functional
+def test_invalidate_and_reconsider_across_nodes():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        n0.rpc.generatetoaddress(4, ADDR)
+        f.sync_blocks()
+
+        # node1 invalidates block 3 and mines its own replacement branch
+        h3 = n1.rpc.getblockhash(3)
+        n1.rpc.invalidateblock(h3)
+        assert n1.rpc.getblockcount() == 2
+        n1.rpc.generatetoaddress(3, ADDR2)  # 2 + 3 = height 5, more work
+        f.sync_blocks(timeout=45)
+        # node0 follows the new heavier branch (it never invalidated h3,
+        # but the replacement chain has more work)
+        assert n0.rpc.getblockcount() == 5
+        assert n0.rpc.getbestblockhash() == n1.rpc.getbestblockhash()
+
+        # reconsider restores the branch as a known fork, chain unchanged
+        n1.rpc.reconsiderblock(h3)
+        assert n1.rpc.getblockcount() == 5
+        statuses = {t["status"] for t in n1.rpc.getchaintips()}
+        assert "valid-fork" in statuses or len(n1.rpc.getchaintips()) > 1
+
+
+@pytest.mark.functional
+def test_preciousblock_rpc():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        # both mine one block at the same height in isolation
+        n0.rpc.generatetoaddress(1, ADDR)
+        n1.rpc.generatetoaddress(1, ADDR2)
+        t0, t1 = n0.rpc.getbestblockhash(), n1.rpc.getbestblockhash()
+        assert t0 != t1
+        # exchange blocks: each node keeps its first-seen tip
+        f.connect_nodes(0, 1)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            tips0 = n0.rpc.getchaintips()
+            if len(tips0) >= 2:
+                break
+            time.sleep(0.25)
+        assert n0.rpc.getbestblockhash() == t0
+        # precious flips node0 onto node1's equal-work tip
+        n0.rpc.preciousblock(t1)
+        assert n0.rpc.getbestblockhash() == t1
+        # and back
+        n0.rpc.preciousblock(t0)
+        assert n0.rpc.getbestblockhash() == t0
