@@ -335,6 +335,224 @@ def bumpfee(node, params: List[Any]):
     }
 
 
+def _wtx_conf(node, wtx) -> int:
+    return 0 if wtx.height < 0 else node.chainstate.tip().height - wtx.height + 1
+
+
+def gettransaction(node, params: List[Any]):
+    """ref rpcwallet.cpp gettransaction."""
+    from ..core.uint256 import u256_from_hex
+
+    w = _wallet(node)
+    txid = u256_from_hex(str(params[0]))
+    wtx = w.wtx.get(txid)
+    if wtx is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY, "Invalid or non-wallet transaction id"
+        )
+    conf = _wtx_conf(node, wtx)
+    credit = sum(
+        o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
+    )
+    spent_mine = 0
+    inputs_known = not wtx.is_coinbase()
+    inputs_total = 0
+    for txin in wtx.tx.vin:
+        prev = w.wtx.get(txin.prevout.txid)
+        if prev is not None and txin.prevout.n < len(prev.tx.vout):
+            o = prev.tx.vout[txin.prevout.n]
+            inputs_total += o.value
+            if w.is_mine_script(o.script_pubkey):
+                spent_mine += o.value
+        else:
+            inputs_known = False
+    # ref gettransaction: `amount` excludes the fee, which is reported
+    # separately (computable only when every input is wallet-known)
+    fee = None
+    if spent_mine > 0 and inputs_known:
+        fee = inputs_total - wtx.tx.total_output_value()
+    amount = credit - spent_mine + (fee or 0)
+    out = {
+        "txid": wtx.tx.txid_hex,
+        "amount": amount / COIN,
+        "confirmations": conf,
+        "time": int(wtx.time_received),
+        "timereceived": int(wtx.time_received),
+        "abandoned": wtx.abandoned,
+        "hex": wtx.tx.to_bytes().hex(),
+        "details": [],
+    }
+    if fee is not None:
+        out["fee"] = -fee / COIN
+    if wtx.height >= 0:
+        idx = node.chainstate.active.at(wtx.height)
+        if idx is not None:
+            out["blockhash"] = u256_hex(idx.block_hash)
+            out["blockheight"] = wtx.height
+    for i, o in enumerate(wtx.tx.vout):
+        dest = extract_destination(Script(o.script_pubkey))
+        if dest is not None and w.is_mine_script(o.script_pubkey):
+            out["details"].append(
+                {
+                    "address": encode_destination(dest, node.params),
+                    "category": "generate" if wtx.is_coinbase() else "receive",
+                    "amount": o.value / COIN,
+                    "vout": i,
+                }
+            )
+    return out
+
+
+def abandontransaction(node, params: List[Any]):
+    """ref rpcwallet.cpp abandontransaction -> CWallet::AbandonTransaction."""
+    from ..core.uint256 import u256_from_hex
+
+    try:
+        _wallet(node).abandon_transaction(u256_from_hex(str(params[0])))
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    return None
+
+
+def listsinceblock(node, params: List[Any]):
+    """ref rpcwallet.cpp listsinceblock: wallet txs at or above the fork
+    with the given block (everything, if omitted)."""
+    from ..core.uint256 import u256_from_hex
+
+    w = _wallet(node)
+    cs = node.chainstate
+    since_height = -1
+    if params and params[0]:
+        idx = cs.lookup(u256_from_hex(str(params[0])))
+        if idx is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+        fork = cs.active.find_fork(idx)
+        since_height = fork.height if fork is not None else -1
+    txs = []
+    for wtx in w.wtx.values():
+        if 0 <= wtx.height <= since_height:
+            continue
+        credit = sum(
+            o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
+        )
+        txs.append(
+            {
+                "txid": wtx.tx.txid_hex,
+                "category": "generate" if wtx.is_coinbase() else "receive",
+                "amount": credit / COIN,
+                "confirmations": _wtx_conf(node, wtx),
+                "abandoned": wtx.abandoned,
+            }
+        )
+    return {
+        "transactions": txs,
+        "lastblock": u256_hex(cs.tip().block_hash),
+    }
+
+
+def _received_by(node, address: str, minconf: int) -> int:
+    w = _wallet(node)
+    dest = decode_destination(address, node.params)
+    spk = script_for_destination(dest).raw
+    total = 0
+    for wtx in w.wtx.values():
+        if wtx.abandoned or _wtx_conf(node, wtx) < minconf:
+            continue
+        for o in wtx.tx.vout:
+            if o.script_pubkey == spk:
+                total += o.value
+    return total
+
+
+def getreceivedbyaddress(node, params: List[Any]):
+    minconf = int(params[1]) if len(params) > 1 else 1
+    try:
+        return _received_by(node, str(params[0]), minconf) / COIN
+    except ValueError as e:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
+
+
+def listreceivedbyaddress(node, params: List[Any]):
+    w = _wallet(node)
+    minconf = int(params[0]) if params else 1
+    by_spk: dict = {}
+    for wtx in w.wtx.values():
+        conf = _wtx_conf(node, wtx)
+        if wtx.abandoned or conf < minconf:
+            continue
+        for o in wtx.tx.vout:
+            if not w.is_mine_script(o.script_pubkey):
+                continue
+            entry = by_spk.setdefault(o.script_pubkey, [0, None, set()])
+            entry[0] += o.value
+            # ref ListReceived: report the LEAST-confirmed receiving tx
+            entry[1] = conf if entry[1] is None else min(entry[1], conf)
+            entry[2].add(wtx.tx.txid_hex)
+    out = []
+    for spk, (amount, conf, txids) in by_spk.items():
+        dest = extract_destination(Script(spk))
+        if dest is None:
+            continue
+        out.append(
+            {
+                "address": encode_destination(dest, node.params),
+                "amount": amount / COIN,
+                "confirmations": conf,
+                "txids": sorted(txids),
+            }
+        )
+    return sorted(out, key=lambda e: e["address"])
+
+
+def settxfee(node, params: List[Any]):
+    """ref rpcwallet.cpp settxfee (amount per kB; 0 restores default)."""
+    from ..chain.policy import MIN_RELAY_FEE
+
+    w = _wallet(node)
+    rate = _amount_to_sat(params[0]) if params else 0
+    if rate < 0:
+        raise RPCError(RPC_INVALID_PARAMETER, "Amount out of range")
+    if rate != 0 and rate < MIN_RELAY_FEE.sat_per_kb:
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            "txfee cannot be less than min relay tx fee",
+        )
+    w.pay_tx_feerate = rate
+    return True
+
+
+def lockunspent(node, params: List[Any]):
+    """ref rpcwallet.cpp lockunspent: unlock=true frees, false locks."""
+    from ..core.uint256 import u256_from_hex
+    from ..primitives.transaction import OutPoint
+
+    w = _wallet(node)
+    unlock = bool(params[0])
+    outputs = params[1] if len(params) > 1 else None
+    if outputs is None:
+        if not unlock:
+            raise RPCError(
+                RPC_INVALID_PARAMETER,
+                "Invalid parameter, transactions required when locking",
+            )
+        w.locked_coins.clear()
+        return True
+    for o in outputs:
+        op = OutPoint(u256_from_hex(str(o["txid"])), int(o["vout"]))
+        if unlock:
+            w.locked_coins.discard(op)
+        else:
+            w.locked_coins.add(op)
+    return True
+
+
+def listlockunspent(node, params: List[Any]):
+    return [
+        {"txid": u256_hex(op.txid), "vout": op.n}
+        for op in sorted(_wallet(node).locked_coins, key=lambda o: (o.txid, o.n))
+    ]
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("getnewaddress", getnewaddress, ["label"]),
@@ -358,6 +576,14 @@ def register(table: RPCTable) -> None:
         ("walletpassphrasechange", walletpassphrasechange,
          ["oldpassphrase", "newpassphrase"]),
         ("bumpfee", bumpfee, ["txid"]),
+        ("gettransaction", gettransaction, ["txid"]),
+        ("abandontransaction", abandontransaction, ["txid"]),
+        ("listsinceblock", listsinceblock, ["blockhash"]),
+        ("getreceivedbyaddress", getreceivedbyaddress, ["address", "minconf"]),
+        ("listreceivedbyaddress", listreceivedbyaddress, ["minconf"]),
+        ("settxfee", settxfee, ["amount"]),
+        ("lockunspent", lockunspent, ["unlock", "transactions"]),
+        ("listlockunspent", listlockunspent, []),
         ("createwallet", createwallet, ["wallet_name"]),
         ("loadwallet", loadwallet, ["filename"]),
         ("unloadwallet", unloadwallet, ["wallet_name"]),

@@ -52,6 +52,9 @@ class WalletTx:
     tx: Transaction
     height: int = -1  # -1 = unconfirmed
     time_received: float = field(default_factory=time.time)
+    # ref CWalletTx abandoned state (nIndex == -1 marker in the reference):
+    # an abandoned tx releases its inputs for respending
+    abandoned: bool = False
 
     def is_coinbase(self) -> bool:
         return self.tx.is_coinbase()
@@ -70,6 +73,11 @@ class Wallet(ValidationInterface):
         self.key_pubs: Dict[bytes, bytes] = {}  # keyid -> pubkey (watch data)
         self.wtx: Dict[int, WalletTx] = {}
         self.address_book: Dict[str, str] = {}
+        # manually locked outpoints (ref CWallet::setLockedCoins /
+        # lockunspent RPC); excluded from coin selection, not persisted
+        self.locked_coins: set = set()
+        # -paytxfee / settxfee override (sat per kB; 0 = use default)
+        self.pay_tx_feerate: int = 0
         # encryption state (ref CWallet::{fUseCrypto,mapMasterKeys}, crypter.h)
         self.master_key_record = None  # crypter.MasterKey when encrypted
         self.enc_mnemonic: Optional[bytes] = None
@@ -291,6 +299,7 @@ class Wallet(ValidationInterface):
                     changed = True
                 elif tx.txid in self.wtx:
                     self.wtx[tx.txid].height = index.height
+                    self.wtx[tx.txid].abandoned = False  # confirmed after all
                     changed = True
             if changed:
                 self.flush()
@@ -334,12 +343,17 @@ class Wallet(ValidationInterface):
     def _spent_outpoints(self) -> set:
         spent = set()
         for wtx in self.wtx.values():
+            if wtx.abandoned:
+                continue  # abandoned spends release their inputs
             for txin in wtx.tx.vin:
                 spent.add(txin.prevout)
         return spent
 
     def unspent_coins(
-        self, min_conf: int = 0, include_immature: bool = False
+        self,
+        min_conf: int = 0,
+        include_immature: bool = False,
+        include_locked: bool = False,
     ) -> List[Tuple[OutPoint, TxOut, int]]:
         """(outpoint, txout, confirmations) for spendable wallet coins."""
         tip_height = self.node.chainstate.tip().height
@@ -347,6 +361,8 @@ class Wallet(ValidationInterface):
         out = []
         with self.lock:
             for txid, wtx in self.wtx.items():
+                if wtx.abandoned:
+                    continue
                 conf = 0 if wtx.height < 0 else tip_height - wtx.height + 1
                 if conf < min_conf:
                     continue
@@ -358,6 +374,8 @@ class Wallet(ValidationInterface):
                     continue
                 for n, txout in enumerate(wtx.tx.vout):
                     op = OutPoint(txid, n)
+                    if not include_locked and op in self.locked_coins:
+                        continue
                     if op in spent:
                         continue
                     if not self.is_mine_script(txout.script_pubkey):
@@ -366,10 +384,15 @@ class Wallet(ValidationInterface):
         return out
 
     def get_balance(self, min_conf: int = 1) -> int:
-        return sum(o.value for _, o, c in self.unspent_coins() if c >= min_conf)
+        # locked coins are still owned: they count toward the balance and
+        # are only excluded from selection/listing (ref GetBalance vs
+        # AvailableCoins' setLockedCoins skip)
+        coins = self.unspent_coins(include_locked=True)
+        return sum(o.value for _, o, c in coins if c >= min_conf)
 
     def get_unconfirmed_balance(self) -> int:
-        return sum(o.value for _, o, c in self.unspent_coins() if c == 0)
+        coins = self.unspent_coins(include_locked=True)
+        return sum(o.value for _, o, c in coins if c == 0)
 
     def get_immature_balance(self) -> int:
         tip_height = self.node.chainstate.tip().height
@@ -421,7 +444,10 @@ class Wallet(ValidationInterface):
         """ref CWallet::CreateTransaction (wallet.cpp:3250): returns
         (signed tx, fee)."""
         self._require_unlocked()
-        feerate = feerate or FeeRate(MIN_RELAY_FEE.sat_per_kb * 2)
+        if feerate is None:
+            feerate = FeeRate(
+                self.pay_tx_feerate or MIN_RELAY_FEE.sat_per_kb * 2
+            )
         send_total = sum(v for _, v in recipients)
         if send_total <= 0:
             raise WalletError("invalid amount")
@@ -592,6 +618,37 @@ class Wallet(ValidationInterface):
 
     # ---------------------------------------------------------- persistence
 
+    def abandon_transaction(self, txid: int) -> None:
+        """ref CWallet::AbandonTransaction: mark an unconfirmed,
+        not-in-mempool wallet tx (and its wallet descendants) abandoned so
+        their inputs become respendable."""
+        with self.lock:
+            wtx = self.wtx.get(txid)
+            if wtx is None:
+                raise WalletError("Invalid or non-wallet transaction id")
+            if wtx.height >= 0:
+                raise WalletError(
+                    "Transaction not eligible for abandonment (confirmed)"
+                )
+            pool = self.node.mempool
+            if pool is not None and pool.contains(txid):
+                raise WalletError(
+                    "Transaction not eligible for abandonment (in mempool)"
+                )
+            todo = [txid]
+            while todo:
+                cur = todo.pop()
+                cur_wtx = self.wtx.get(cur)
+                if cur_wtx is None or cur_wtx.abandoned:
+                    continue
+                cur_wtx.abandoned = True
+                for other_id, other in self.wtx.items():
+                    if other.height < 0 and any(
+                        i.prevout.txid == cur for i in other.tx.vin
+                    ):
+                        todo.append(other_id)
+            self.flush()
+
     def flush(self) -> None:
         if self.path is None:
             return
@@ -606,6 +663,7 @@ class Wallet(ValidationInterface):
                         "hex": wtx.tx.to_bytes().hex(),
                         "height": wtx.height,
                         "time": wtx.time_received,
+                        **({"abandoned": True} if wtx.abandoned else {}),
                     }
                     for wtx in self.wtx.values()
                 ],
@@ -658,7 +716,10 @@ class Wallet(ValidationInterface):
         for item in data.get("wtx", []):
             tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
             self.wtx[tx.txid] = WalletTx(
-                tx=tx, height=item["height"], time_received=item.get("time", 0)
+                tx=tx,
+                height=item["height"],
+                time_received=item.get("time", 0),
+                abandoned=bool(item.get("abandoned", False)),
             )
 
 

@@ -65,9 +65,10 @@ class MiniPeer:
                     if not verify_checksum(payload, checksum):
                         continue
                     self._on_message(command, payload)
-        except OSError:
+        except (OSError, Exception):
             pass
-        self.alive = False
+        finally:
+            self.alive = False
 
     def _on_message(self, command: str, payload: bytes) -> None:
         with self._lock:

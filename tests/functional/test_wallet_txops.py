@@ -1,0 +1,99 @@
+"""Functional: wallet transaction operations — gettransaction,
+abandontransaction, listsinceblock, received-by, lockunspent, settxfee
+(parity: reference wallet_abandonconflict.py, wallet_listsinceblock.py,
+wallet_listreceivedby.py, rpc_fundrawtransaction settxfee paths)."""
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+
+@pytest.mark.functional
+def test_gettransaction_and_listsinceblock():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, addr)
+        mark = n0.rpc.getbestblockhash()
+        txid = n0.rpc.sendtoaddress(addr, 25)
+        n0.rpc.generatetoaddress(1, addr)
+
+        tx = n0.rpc.gettransaction(txid)
+        assert tx["txid"] == txid
+        assert tx["confirmations"] == 1
+        assert tx["blockheight"] == 104
+        assert tx["abandoned"] is False
+        assert any(d["amount"] == 25 for d in tx["details"])
+        assert tx["hex"]
+
+        since = n0.rpc.listsinceblock(mark)
+        txids = {t["txid"] for t in since["transactions"]}
+        assert txid in txids
+        assert since["lastblock"] == n0.rpc.getbestblockhash()
+        # everything-since-genesis includes far more
+        assert len(n0.rpc.listsinceblock()["transactions"]) > len(txids)
+
+        with pytest.raises(RPCFailure):
+            n0.rpc.gettransaction("00" * 32)
+
+
+@pytest.mark.functional
+def test_abandontransaction_releases_inputs():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, addr)
+        balance = n0.rpc.getbalance()
+        txid = n0.rpc.sendtoaddress(addr, 100)
+        # in-mempool txs are not abandonable
+        with pytest.raises(RPCFailure, match="mempool"):
+            n0.rpc.abandontransaction(txid)
+        # restart without mempool persistence: tx is gone from the pool
+        # but still in the wallet, unconfirmed -> abandonable
+        n0.stop()
+        n0.extra_args = ["-wallet", "-persistmempool=0"]
+        n0.start()
+        assert txid not in n0.rpc.getrawmempool()
+        assert n0.rpc.gettransaction(txid)["confirmations"] == 0
+        n0.rpc.abandontransaction(txid)
+        assert n0.rpc.gettransaction(txid)["abandoned"] is True
+        # the spent input is released: full balance is spendable again
+        assert n0.rpc.getbalance() == balance
+
+
+@pytest.mark.functional
+def test_receivedby_and_lockunspent():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        mining = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, mining)
+        recv = n0.rpc.getnewaddress("tag")
+        n0.rpc.sendtoaddress(recv, 7)
+        n0.rpc.sendtoaddress(recv, 5)
+        n0.rpc.generatetoaddress(1, mining)
+
+        assert n0.rpc.getreceivedbyaddress(recv) == 12
+        assert n0.rpc.getreceivedbyaddress(recv, 10) == 0  # minconf unmet
+        rows = n0.rpc.listreceivedbyaddress()
+        row = next(r for r in rows if r["address"] == recv)
+        assert row["amount"] == 12
+        assert len(row["txids"]) == 2
+
+        # lock a coin: it stops being selectable/listed
+        utxo = n0.rpc.listunspent()[0]
+        n0.rpc.lockunspent(False, [{"txid": utxo["txid"], "vout": utxo["vout"]}])
+        locked = n0.rpc.listlockunspent()
+        assert locked == [{"txid": utxo["txid"], "vout": utxo["vout"]}]
+        assert all(
+            (u["txid"], u["vout"]) != (utxo["txid"], utxo["vout"])
+            for u in n0.rpc.listunspent()
+        )
+        n0.rpc.lockunspent(True)
+        assert n0.rpc.listlockunspent() == []
+
+        # settxfee raises the paid fee
+        assert n0.rpc.settxfee(0.01) is True
+        t1 = n0.rpc.sendtoaddress(recv, 1)
+        fee_paid = n0.rpc.getmempoolinfo()["total_fee"]
+        assert fee_paid >= 0.001  # ~0.01/kB on a ~200B tx
+        assert t1 in n0.rpc.getrawmempool()
