@@ -1,0 +1,55 @@
+"""Microbenchmark harness (parity: reference src/bench/ — bench.cpp's
+BENCHMARK() registry and the bench_clore binary).
+
+Run: ``python -m nodexa_chain_core_tpu.bench [filter-substring]``
+Each benchmark reports iterations, total, and min/avg/max per iteration,
+in the same shape as the reference's bench output (doc/benchmarking.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, tuple] = {}
+
+
+def benchmark(name: str, iters: int = 100):
+    """ref src/bench/bench.h BENCHMARK(name) registration macro."""
+
+    def wrap(fn: Callable):
+        _REGISTRY[name] = (fn, iters)
+        return fn
+
+    return wrap
+
+
+def run(filter_substr: Optional[str] = None, out=print) -> List[dict]:
+    results = []
+    out(f"{'benchmark':34} {'iters':>6} {'total_s':>9} "
+        f"{'min_us':>10} {'avg_us':>10} {'max_us':>10}")
+    for name, (fn, iters) in sorted(_REGISTRY.items()):
+        if filter_substr and filter_substr not in name:
+            continue
+        # one warmup (JIT compilation, cache builds, lazy imports)
+        state = fn()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn(state)
+            times.append(time.perf_counter() - t0)
+        rec = {
+            "name": name,
+            "iters": iters,
+            "total": sum(times),
+            "min": min(times),
+            "avg": sum(times) / len(times),
+            "max": max(times),
+        }
+        results.append(rec)
+        out(
+            f"{name:34} {iters:>6} {rec['total']:>9.3f} "
+            f"{rec['min'] * 1e6:>10.1f} {rec['avg'] * 1e6:>10.1f} "
+            f"{rec['max'] * 1e6:>10.1f}"
+        )
+    return results

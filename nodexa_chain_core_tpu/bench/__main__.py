@@ -1,0 +1,10 @@
+"""``python -m nodexa_chain_core_tpu.bench`` — run the microbenchmarks
+(parity: reference bench_clore binary)."""
+
+import sys
+
+from . import run
+from . import benches  # noqa: F401 — registers the benchmark set
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -1,0 +1,212 @@
+"""The benchmark set (parity: reference src/bench/*.cpp — crypto_hash,
+verify_script, checkqueue, ccoins_caching, mempool_eviction, checkblock,
+merkle_root, base58).
+
+Each benchmark is a function taking an optional pre-built state: called
+once with no args for setup+warmup (returns the state), then timed calls
+receive that state.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import benchmark
+
+_DATA_32 = bytes(range(32))
+_DATA_80 = bytes(i & 0xFF for i in range(80))
+_DATA_1K = os.urandom(1024)
+
+
+# -- crypto hashes (ref bench/crypto_hash.cpp) --------------------------------
+
+
+@benchmark("crypto.sha256d_80b", iters=2000)
+def bench_sha256d(state=None):
+    from ..crypto.hashes import sha256d
+
+    return sha256d(_DATA_80)
+
+
+@benchmark("crypto.ripemd160_1k", iters=2000)
+def bench_ripemd160(state=None):
+    from ..crypto.hashes import ripemd160
+
+    return ripemd160(_DATA_1K)
+
+
+@benchmark("crypto.hash160_33b", iters=2000)
+def bench_hash160(state=None):
+    from ..crypto.hashes import hash160
+
+    return hash160(_DATA_32 + b"\x02")
+
+
+@benchmark("crypto.keccak256_1k", iters=2000)
+def bench_keccak(state=None):
+    from ..crypto.keccak import keccak256
+
+    return keccak256(_DATA_1K)
+
+
+@benchmark("crypto.x16r_80b", iters=500)
+def bench_x16r(state=None):
+    from ..crypto import x16r_native
+
+    return x16r_native.x16r(_DATA_80)
+
+
+@benchmark("crypto.x16rv2_80b", iters=500)
+def bench_x16rv2(state=None):
+    from ..crypto import x16r_native
+
+    return x16r_native.x16rv2(_DATA_80)
+
+
+@benchmark("crypto.kawpow_verify", iters=50)
+def bench_kawpow(state=None):
+    from ..crypto import kawpow
+
+    # epoch-0 verification; setup call warms the light/L1 caches
+    return kawpow.kawpow_hash(1, int.from_bytes(_DATA_32, "little"), 0x1234)
+
+
+# -- signatures (ref bench/verify_script.cpp + bench/checkqueue.cpp) ----------
+
+
+def _sig_state():
+    from ..crypto.secp256k1 import pubkey_create, sign
+
+    priv = 0x1234567890ABCDEF1234567890ABCDEF1234567890ABCDEF1234567890ABCDEF
+    pub = pubkey_create(priv)
+    r, s = sign(priv, _DATA_32)
+    return pub, r, s
+
+
+@benchmark("secp256k1.verify", iters=300)
+def bench_ecdsa_verify(state=None):
+    from ..crypto.secp256k1 import verify
+
+    if state is None:
+        return _sig_state()
+    pub, r, s = state
+    assert verify(pub, _DATA_32, r, s)
+    return state
+
+
+@benchmark("script.verify_p2pkh", iters=300)
+def bench_verify_script(state=None):
+    from ..script.interpreter import (
+        STANDARD_SCRIPT_VERIFY_FLAGS,
+        TransactionSignatureChecker,
+        verify_script,
+    )
+    from ..script.script import Script
+    from ..script.sign import KeyStore, sign_tx_input
+    from ..script.standard import KeyID, p2pkh_script
+    from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+
+    if state is None:
+        ks = KeyStore()
+        kid = ks.add_key(0xBEEF)
+        spk = p2pkh_script(KeyID(kid))
+        tx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(txid=1, n=0))],
+            vout=[TxOut(value=1000, script_pubkey=spk.raw)],
+        )
+        sign_tx_input(ks, tx, 0, spk)
+        return tx, spk
+    tx, spk = state
+    checker = TransactionSignatureChecker(tx, 0, 1000)
+    ok, err = verify_script(
+        Script(tx.vin[0].script_sig), spk, STANDARD_SCRIPT_VERIFY_FLAGS, checker
+    )
+    assert ok, err
+    return state
+
+
+# -- chain structures ---------------------------------------------------------
+
+
+@benchmark("merkle.root_1000tx", iters=100)
+def bench_merkle(state=None):
+    from ..consensus.merkle import merkle_root
+
+    if state is None:
+        return [int.from_bytes(os.urandom(32), "little") for _ in range(1000)]
+    merkle_root(state)
+    return state
+
+
+@benchmark("coins.cache_flush_1000", iters=50)
+def bench_coins(state=None):
+    from ..chain.coins import Coin, CoinsViewCache, CoinsViewDB
+    from ..chain.kvstore import KVStore
+    from ..primitives.transaction import OutPoint, TxOut
+
+    if state is None:
+        return [
+            (OutPoint(i + 1, 0), Coin(TxOut(value=1000 + i, script_pubkey=b"\x51"), 1, False))
+            for i in range(1000)
+        ]
+    db = CoinsViewDB(KVStore(None))
+    view = CoinsViewCache(db)
+    for op, coin in state:
+        view.add_coin(op, coin)
+    view.flush()
+    return state
+
+
+@benchmark("mempool.trim_500", iters=30)
+def bench_mempool_trim(state=None):
+    from ..chain.mempool import MempoolEntry, TxMemPool
+    from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+
+    if state is None:
+        txs = []
+        for i in range(500):
+            txs.append(
+                Transaction(
+                    version=2,
+                    vin=[TxIn(prevout=OutPoint(txid=10_000 + i, n=0))],
+                    vout=[TxOut(value=1000, script_pubkey=b"\x51")],
+                )
+            )
+        return txs
+    pool = TxMemPool()
+    for i, tx in enumerate(state):
+        pool.add(MempoolEntry(tx=tx, fee=1000 + i, time=i, height=1))
+    pool.trim_to_size(pool.total_size_bytes() // 2)
+    return state
+
+
+@benchmark("serialize.block_roundtrip", iters=200)
+def bench_serialize(state=None):
+    from ..core.serialize import ByteReader, ByteWriter
+    from ..primitives.block import Block, BlockHeader
+    from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+
+    if state is None:
+        vtx = [
+            Transaction(
+                version=2,
+                vin=[TxIn(prevout=OutPoint(txid=i + 1, n=0), script_sig=b"\x00" * 72)],
+                vout=[TxOut(value=5000, script_pubkey=b"\x76\xa9\x14" + bytes(20) + b"\x88\xac")],
+            )
+            for i in range(200)
+        ]
+        return Block(header=BlockHeader(version=2, time=1), vtx=vtx)
+    w = ByteWriter()
+    state.serialize(w)
+    Block.deserialize(ByteReader(w.getvalue()))
+    return state
+
+
+@benchmark("base58.encode_decode", iters=2000)
+def bench_base58(state=None):
+    from ..utils.base58 import b58check_decode, b58check_encode
+
+    s = b58check_encode(bytes([111]) + _DATA_32[:20])
+    b58check_decode(s)
+    return s

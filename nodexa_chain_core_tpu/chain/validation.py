@@ -726,11 +726,15 @@ class ChainState:
     # ------------------------------------------------- tip connect/disconnect
 
     def _connect_tip(self, idx: BlockIndex, block: Optional[Block] = None) -> None:
-        """ref ConnectTip."""
+        """ref ConnectTip (with BCLog::BENCH stage timings, ref
+        validation.cpp's nTimeConnectTotal/nTimeFlush counters)."""
+        t0 = time.perf_counter()
         if block is None:
             block = self.read_block(idx)
+        t_read = time.perf_counter()
         view = CoinsViewCache(self.coins)
         undo = self.connect_block(block, idx, view)
+        t_connect = time.perf_counter()
         upos = self.block_store.write_undo(undo)
         dpos, _ = self.positions[idx.block_hash]
         self.positions[idx.block_hash] = (dpos, upos)
@@ -741,6 +745,7 @@ class ChainState:
         if getattr(self, "indexes", None) is not None:
             self.indexes.index_block(block, idx, undo)
         view.flush()
+        t_flush = time.perf_counter()
         idx.raise_validity(BlockStatus.VALID_SCRIPTS)
         self.active.set_tip(idx)
         if self.mempool is not None:
@@ -749,6 +754,20 @@ class ChainState:
 
         fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
         main_signals.block_connected(block, idx, [])
+        t_done = time.perf_counter()
+        log_print(
+            LogFlags.BENCH,
+            "ConnectTip %s h=%d txs=%d: read %.2fms, connect %.2fms, "
+            "flush %.2fms, post %.2fms, total %.2fms",
+            u256_hex(idx.block_hash)[:16],
+            idx.height,
+            len(block.vtx),
+            (t_read - t0) * 1e3,
+            (t_connect - t_read) * 1e3,
+            (t_flush - t_connect) * 1e3,
+            (t_done - t_flush) * 1e3,
+            (t_done - t0) * 1e3,
+        )
 
     def _disconnect_tip(self) -> Block:
         """ref DisconnectTip; returns the disconnected block."""

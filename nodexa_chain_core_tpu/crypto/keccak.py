@@ -97,9 +97,42 @@ def _keccak(data: bytes, rate_bytes: int, out_bytes: int) -> bytes:
     return bytes(out[:out_bytes])
 
 
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native():
+    """Resolve the native lib once; a build failure is cached too, so a
+    broken toolchain can never trigger per-hash compile attempts."""
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from .. import native
+
+            _NATIVE = native.load()
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
+
+
 def keccak256(data: bytes) -> bytes:
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        out = (ctypes.c_uint8 * 32)()
+        lib.nxk_keccak256(data, len(data), out)
+        return bytes(out)
     return _keccak(data, 136, 32)
 
 
 def keccak512(data: bytes) -> bytes:
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        out = (ctypes.c_uint8 * 64)()
+        lib.nxk_keccak512(data, len(data), out)
+        return bytes(out)
     return _keccak(data, 72, 64)

@@ -215,9 +215,7 @@ def listtransactions(node, params: List[Any]):
     items = []
     for wtx in sorted(w.wtx.values(), key=lambda x: -x.time_received)[:count]:
         conf = 0 if wtx.height < 0 else tip_height - wtx.height + 1
-        credit = sum(
-            o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
-        )
+        credit = _wtx_credit(w, wtx)
         items.append(
             {
                 "txid": wtx.tx.txid_hex,
@@ -339,6 +337,13 @@ def _wtx_conf(node, wtx) -> int:
     return 0 if wtx.height < 0 else node.chainstate.tip().height - wtx.height + 1
 
 
+def _wtx_credit(w, wtx) -> int:
+    """Sum of this tx's outputs paying wallet keys (ref GetCredit)."""
+    return sum(
+        o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
+    )
+
+
 def gettransaction(node, params: List[Any]):
     """ref rpcwallet.cpp gettransaction."""
     from ..core.uint256 import u256_from_hex
@@ -351,9 +356,7 @@ def gettransaction(node, params: List[Any]):
             RPC_INVALID_ADDRESS_OR_KEY, "Invalid or non-wallet transaction id"
         )
     conf = _wtx_conf(node, wtx)
-    credit = sum(
-        o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
-    )
+    credit = _wtx_credit(w, wtx)
     spent_mine = 0
     inputs_known = not wtx.is_coinbase()
     inputs_total = 0
@@ -432,9 +435,7 @@ def listsinceblock(node, params: List[Any]):
     for wtx in w.wtx.values():
         if 0 <= wtx.height <= since_height:
             continue
-        credit = sum(
-            o.value for o in wtx.tx.vout if w.is_mine_script(o.script_pubkey)
-        )
+        credit = _wtx_credit(w, wtx)
         txs.append(
             {
                 "txid": wtx.tx.txid_hex,
@@ -454,6 +455,8 @@ def _received_by(node, address: str, minconf: int) -> int:
     w = _wallet(node)
     dest = decode_destination(address, node.params)
     spk = script_for_destination(dest).raw
+    if not w.is_mine_script(spk):
+        return 0  # ref getreceivedbyaddress: foreign scripts count 0
     total = 0
     for wtx in w.wtx.values():
         if wtx.abandoned or _wtx_conf(node, wtx) < minconf:
@@ -539,6 +542,15 @@ def lockunspent(node, params: List[Any]):
         return True
     for o in outputs:
         op = OutPoint(u256_from_hex(str(o["txid"])), int(o["vout"]))
+        wtx = w.wtx.get(op.txid)
+        if wtx is None:
+            raise RPCError(
+                RPC_INVALID_PARAMETER, "Invalid parameter, unknown transaction"
+            )
+        if op.n >= len(wtx.tx.vout):
+            raise RPCError(
+                RPC_INVALID_PARAMETER, "Invalid parameter, vout index out of range"
+            )
         if unlock:
             w.locked_coins.discard(op)
         else:

@@ -65,8 +65,12 @@ class MiniPeer:
                     if not verify_checksum(payload, checksum):
                         continue
                     self._on_message(command, payload)
-        except (OSError, Exception):
+        except OSError:
             pass
+        except Exception as e:  # noqa: BLE001 — surface scripting bugs
+            import sys
+
+            print(f"mininode reader died: {e!r}", file=sys.stderr)
         finally:
             self.alive = False
 

@@ -1,0 +1,53 @@
+"""Microbench harness smoke test (parity: the reference's bench_clore is
+exercised by its CI run; here the registry and a fast subset run)."""
+
+from nodexa_chain_core_tpu.bench import _REGISTRY, run
+from nodexa_chain_core_tpu import bench
+from nodexa_chain_core_tpu.bench import benches  # noqa: F401 — registers
+
+
+def test_registry_covers_reference_bench_areas():
+    names = set(_REGISTRY)
+    for area in ("crypto.", "secp256k1.", "script.", "merkle.", "coins.",
+                 "mempool.", "serialize.", "base58."):
+        assert any(n.startswith(area) for n in names), f"missing {area}*"
+
+
+def test_run_filtered_subset():
+    lines = []
+    results = run("sha256d", out=lines.append)
+    assert len(results) == 1
+    r = results[0]
+    assert r["name"] == "crypto.sha256d_80b"
+    assert r["iters"] > 0
+    assert 0 < r["min"] <= r["avg"] <= r["max"]
+    assert len(lines) == 2  # header + one row
+
+
+def test_bench_log_stage_timings(tmp_path):
+    """ConnectTip emits BCLog.BENCH stage timings when the category is on."""
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+    from nodexa_chain_core_tpu.utils.logging import g_logger
+
+    params = regtest_params()
+    cs = ChainState(params)
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    captured = []
+    orig = g_logger.log
+    g_logger.enable_categories("bench")
+    g_logger.log = lambda msg, category=None: captured.append(msg)
+    try:
+        asm = BlockAssembler(cs)
+        blk = asm.create_new_block(spk.raw, ntime=params.genesis_time + 60)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+    finally:
+        g_logger.log = orig
+    bench_lines = [m for m in captured if "ConnectTip" in m]
+    assert bench_lines, captured
+    assert "connect" in bench_lines[0] and "flush" in bench_lines[0]
