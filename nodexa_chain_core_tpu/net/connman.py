@@ -41,6 +41,8 @@ class Peer:
         self.verack_received = False
         self.disconnect = False
         self.misbehavior = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
         self.last_ping_nonce = 0
         self.ping_time_ms: Optional[float] = None
         self.last_send = 0.0
@@ -64,6 +66,7 @@ class Peer:
             with self._send_lock:
                 self.sock.sendall(data)
             self.last_send = time.time()
+            self.bytes_sent += len(data)
             return True
         except OSError:
             self.disconnect = True
@@ -99,6 +102,11 @@ class ConnMan:
         # outbound; `onion_proxy` routes .onion destinations (-onion)
         self.proxy: Optional[tuple] = None
         self.onion_proxy: Optional[tuple] = None
+        # -setnetworkactive / getnettotals state (ref CConnman::fNetworkActive
+        # and nTotalBytesSent/Recv; closed-peer byte counts accumulate here)
+        self.network_active = True
+        self._closed_bytes_sent = 0
+        self._closed_bytes_recv = 0
         # our own reachable addresses (ref AddLocal/GetLocalAddress): they
         # are advertised to peers, never dialed, never put in our addrman
         self.local_addresses: List[tuple] = []
@@ -159,6 +167,8 @@ class ConnMan:
         port = int(port_s or self.node.params.default_port)
         if self.is_banned(host):
             return False
+        if not self.network_active:
+            return False  # ref CConnman::OpenNetworkConnection gate
         if (host, port) in self.local_addresses:
             return False  # never dial ourselves (ref IsLocal check)
         is_onion = host.endswith(".onion")
@@ -189,11 +199,15 @@ class ConnMan:
             self.addrman.attempt(host, port)
         return True
 
-    def disconnect(self, addr: str) -> None:
+    def disconnect(self, addr: str) -> bool:
+        """Flag matching peers for disconnect; True if any matched."""
+        hit = False
         with self._peers_lock:
             for p in self.peers.values():
                 if f"{p.ip}:{p.port}" == addr or p.ip == addr:
                     p.disconnect = True
+                    hit = True
+        return hit
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -203,7 +217,7 @@ class ConnMan:
                 continue
             except OSError:
                 return
-            if self.is_banned(addr[0]):
+            if not self.network_active or self.is_banned(addr[0]):
                 sock.close()
                 continue
             if len(self.peers) >= self.MAX_CONNECTIONS:
@@ -231,6 +245,7 @@ class ConnMan:
                 break
             if not chunk:
                 break
+            peer.bytes_recv += len(chunk)
             buf += chunk
             while len(buf) >= 24:
                 try:
@@ -255,7 +270,13 @@ class ConnMan:
     def _remove_peer(self, peer: Peer) -> None:
         peer.close()
         with self._peers_lock:
-            self.peers.pop(peer.id, None)
+            removed = self.peers.pop(peer.id, None)
+            if removed is not None:
+                # only the call that actually removes the peer rolls its
+                # byte counters into the closed totals (reader-loop exit
+                # and handler-loop cleanup can both land here)
+                self._closed_bytes_sent += peer.bytes_sent
+                self._closed_bytes_recv += peer.bytes_recv
         self.processor.finalize_peer(peer)
         hook = getattr(self.processor, "peer_disconnected", None)
         if hook is not None:
@@ -390,6 +411,26 @@ class ConnMan:
                                     p.feeler = True
 
     # -- bans (ref banlist.dat / CBanDB) ----------------------------------
+
+    def total_bytes(self) -> tuple:
+        """(sent, recv) across live and closed peers (ref GetTotalBytes*)."""
+        with self._peers_lock:
+            sent = self._closed_bytes_sent + sum(
+                p.bytes_sent for p in self.peers.values()
+            )
+            recv = self._closed_bytes_recv + sum(
+                p.bytes_recv for p in self.peers.values()
+            )
+        return sent, recv
+
+    def set_network_active(self, active: bool) -> None:
+        """ref CConnman::SetNetworkActive: pausing drops every peer and
+        stops new connections until re-enabled."""
+        self.network_active = active
+        if not active:
+            with self._peers_lock:
+                for p in self.peers.values():
+                    p.disconnect = True
 
     def add_local(self, host: str, port: int) -> None:
         """Register one of our own reachable addresses (ref AddLocal)."""

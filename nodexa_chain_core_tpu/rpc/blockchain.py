@@ -187,6 +187,20 @@ def getblockchaininfo(node, params: List[Any]):
         out["pruneheight"] = cs.pruned_height + 1  # first stored block
         if cs.prune_target_bytes:
             out["prune_target_size"] = cs.prune_target_bytes
+    # BIP9 deployment status (ref getblockchaininfo's bip9_softforks from
+    # VersionBitsTipState)
+    from ..consensus.versionbits import versionbits_cache
+
+    bip9 = {}
+    for name, dep in node.params.consensus.deployments.items():
+        state = versionbits_cache.state(tip, node.params.consensus, name)
+        bip9[name] = {
+            "status": state.name.lower(),
+            "bit": dep.bit,
+            "startTime": dep.start_time,
+            "timeout": dep.timeout,
+        }
+    out["bip9_softforks"] = bip9
     return out
 
 
@@ -306,6 +320,208 @@ def verifychain(node, params: List[Any]):
     return True
 
 
+def getchaintxstats(node, params: List[Any]):
+    """ref rpc/blockchain.cpp getchaintxstats: tx count/rate over the last
+    N blocks (default one retarget-month analogue: 30 days of blocks)."""
+    cs = node.chainstate
+    tip = cs.tip()
+    final = tip
+    if len(params) > 1 and params[1]:
+        final = _lookup_block(node, str(params[1]))
+        if final not in cs.active:
+            raise RPCError(RPC_INVALID_PARAMETER, "Block is not in main chain")
+    nblocks = int(params[0]) if params and params[0] else min(
+        final.height, 30 * 24 * 60  # 30 days of 1-minute blocks
+    )
+    if nblocks <= 0 or nblocks > final.height:
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid block count")
+    start = final.get_ancestor(final.height - nblocks)
+    window_tx = final.chain_tx_count - start.chain_tx_count
+    window_secs = final.header.time - start.header.time
+    out = {
+        "time": final.header.time,
+        "txcount": final.chain_tx_count,
+        "window_final_block_hash": u256_hex(final.block_hash),
+        "window_block_count": nblocks,
+        "window_tx_count": window_tx,
+        "window_interval": window_secs,
+    }
+    if window_secs > 0:
+        out["txrate"] = window_tx / window_secs
+    return out
+
+
+def getblockstats(node, params: List[Any]):
+    """ref rpc/blockchain.cpp getblockstats: per-block aggregates; fees
+    computed from the undo journal's spent coins."""
+    from ..chain.blockindex import BlockStatus
+
+    cs = node.chainstate
+    arg = params[0]
+    if isinstance(arg, int) or (isinstance(arg, str) and len(arg) < 16):
+        try:
+            height = int(arg)
+        except ValueError:
+            raise RPCError(
+                RPC_INVALID_PARAMETER, f"{arg!r} is not a valid hash or height"
+            )
+        idx = cs.active.at(height)
+        if idx is None:
+            raise RPCError(RPC_INVALID_PARAMETER, "Block height out of range")
+    else:
+        idx = _lookup_block(node, str(arg))
+    if not idx.status & BlockStatus.HAVE_DATA:
+        raise RPCError(RPC_MISC_ERROR, "Block not available (pruned data)")
+    block = cs.read_block(idx)
+    _, upos = cs.positions.get(idx.block_hash, (-1, -1))
+    undo = cs.block_store.read_undo(upos) if upos >= 0 else None
+
+    fees = []
+    total_out = 0
+    ins = outs = 0
+    sizes = []
+    for i, tx in enumerate(block.vtx):
+        outs += len(tx.vout)
+        total_out += tx.total_output_value()
+        sizes.append(len(tx.to_bytes()))
+        if tx.is_coinbase():
+            continue
+        ins += len(tx.vin)
+        if undo is not None and i - 1 < len(undo.vtxundo):
+            spent = sum(c.out.value for c in undo.vtxundo[i - 1].prevouts)
+            fees.append(spent - tx.total_output_value())
+    from ..consensus import pow as powrules
+
+    subsidy = powrules.get_block_subsidy(idx.height, node.params.consensus)
+    return {
+        "blockhash": u256_hex(idx.block_hash),
+        "height": idx.height,
+        "time": idx.header.time,
+        "mediantime": idx.median_time_past(),
+        "txs": len(block.vtx),
+        "ins": ins,
+        "outs": outs,
+        "total_out": total_out,
+        "total_size": len(block.to_bytes()),
+        "subsidy": subsidy,
+        "totalfee": sum(fees),
+        "avgfee": sum(fees) // len(fees) if fees else 0,
+        "minfee": min(fees) if fees else 0,
+        "maxfee": max(fees) if fees else 0,
+        "avgtxsize": sum(sizes) // len(sizes) if sizes else 0,
+        "mintxsize": min(sizes) if sizes else 0,
+        "maxtxsize": max(sizes) if sizes else 0,
+    }
+
+
+def _mempool_entry_json(node, e) -> dict:
+    return {
+        "size": e.size,
+        "fee": e.fee / COIN,
+        "modifiedfee": e.fee / COIN,
+        "time": int(e.time),
+        "height": e.height,
+        "descendantcount": e.count_with_descendants,
+        "descendantsize": e.size_with_descendants,
+        "ancestorcount": e.count_with_ancestors,
+        "ancestorsize": e.size_with_ancestors,
+        "depends": [
+            u256_hex(p) for p in e.parents() if node.mempool.contains(p)
+        ],
+    }
+
+
+def getmempoolentry(node, params: List[Any]):
+    txid = u256_from_hex(str(params[0]))
+    e = node.mempool.get(txid)
+    if e is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool"
+        )
+    return _mempool_entry_json(node, e)
+
+
+def getmempoolancestors(node, params: List[Any]):
+    pool = node.mempool
+    txid = u256_from_hex(str(params[0]))
+    e = pool.get(txid)
+    if e is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool"
+        )
+    verbose = bool(params[1]) if len(params) > 1 else False
+    anc = pool.calculate_ancestors(e.parents()) - {txid}
+    if not verbose:
+        return [u256_hex(t) for t in anc]
+    entries = {t: pool.get(t) for t in anc}
+    return {
+        u256_hex(t): _mempool_entry_json(node, e)
+        for t, e in entries.items()
+        if e is not None  # tx may leave the pool mid-request
+    }
+
+
+def getmempooldescendants(node, params: List[Any]):
+    pool = node.mempool
+    txid = u256_from_hex(str(params[0]))
+    if pool.get(txid) is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool"
+        )
+    verbose = bool(params[1]) if len(params) > 1 else False
+    desc = pool.calculate_descendants(txid) - {txid}
+    if not verbose:
+        return [u256_hex(t) for t in desc]
+    entries = {t: pool.get(t) for t in desc}
+    return {
+        u256_hex(t): _mempool_entry_json(node, e)
+        for t, e in entries.items()
+        if e is not None  # tx may leave the pool mid-request
+    }
+
+
+def savemempool(node, params: List[Any]):
+    """ref rpc/blockchain.cpp savemempool -> DumpMempool."""
+    from ..chain.mempool_accept import dump_mempool
+
+    path = getattr(node, "mempool_dat_path", None)
+    if path is None:
+        import os
+
+        if not node.datadir:
+            raise RPCError(RPC_MISC_ERROR, "no datadir to save into")
+        path = os.path.join(node.datadir, "mempool.dat")
+    dump_mempool(node.mempool, path)
+    return None
+
+
+def waitfornewblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp waitfornewblock (functional-test support)."""
+    from .mining import _tip_waiter
+
+    timeout_ms = int(params[0]) if params else 0
+    start = node.chainstate.tip().block_hash
+    _tip_waiter.wait(
+        lambda: node.chainstate.tip().block_hash != start,
+        timeout=(timeout_ms / 1000.0) if timeout_ms else None,
+    )
+    tip = node.chainstate.tip()
+    return {"hash": u256_hex(tip.block_hash), "height": tip.height}
+
+
+def waitforblockheight(node, params: List[Any]):
+    from .mining import _tip_waiter
+
+    height = int(params[0])
+    timeout_ms = int(params[1]) if len(params) > 1 else 0
+    _tip_waiter.wait(
+        lambda: node.chainstate.tip().height >= height,
+        timeout=(timeout_ms / 1000.0) if timeout_ms else None,
+    )
+    tip = node.chainstate.tip()
+    return {"hash": u256_hex(tip.block_hash), "height": tip.height}
+
+
 def pruneblockchain(node, params: List[Any]):
     """ref rpc/blockchain.cpp pruneblockchain (manual prune mode)."""
     cs = node.chainstate
@@ -359,6 +575,14 @@ def register(table: RPCTable) -> None:
         ("getrawmempool", getrawmempool, ["verbose"]),
         ("gettxout", gettxout, ["txid", "n", "include_mempool"]),
         ("verifychain", verifychain, ["checklevel", "nblocks"]),
+        ("getchaintxstats", getchaintxstats, ["nblocks", "blockhash"]),
+        ("getblockstats", getblockstats, ["hash_or_height", "stats"]),
+        ("getmempoolentry", getmempoolentry, ["txid"]),
+        ("getmempoolancestors", getmempoolancestors, ["txid", "verbose"]),
+        ("getmempooldescendants", getmempooldescendants, ["txid", "verbose"]),
+        ("savemempool", savemempool, []),
+        ("waitfornewblock", waitfornewblock, ["timeout"]),
+        ("waitforblockheight", waitforblockheight, ["height", "timeout"]),
         ("pruneblockchain", pruneblockchain, ["height"]),
         ("invalidateblock", invalidateblock, ["blockhash"]),
         ("reconsiderblock", reconsiderblock, ["blockhash"]),

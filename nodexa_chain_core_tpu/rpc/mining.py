@@ -100,6 +100,24 @@ class _TipWaiter:
 
         main_signals.register(_Sub())
 
+    def wait(self, predicate, timeout=None) -> bool:
+        """Block until predicate() or timeout (None = forever); re-checks
+        on every tip update (ref waitfornewblock/waitforblockheight)."""
+        self._ensure()
+        import time as _t
+
+        deadline = (_t.time() + timeout) if timeout else None
+        with self._cond:
+            while True:
+                if predicate():
+                    return True
+                if deadline is not None and _t.time() >= deadline:
+                    return False
+                remaining = (
+                    min(1.0, deadline - _t.time()) if deadline else 1.0
+                )
+                self._cond.wait(timeout=remaining)
+
     def wait_for_new_tip(self, node, old_tip_hash: int, timeout: float) -> None:
         self._ensure()
         import time as _t

@@ -118,7 +118,9 @@ def getnetworkinfo(node, params: List[Any]):
         "localservices": "0000000000000005",
         "localrelay": True,
         "timeoffset": 0,
-        "networkactive": node.connman is not None,
+        "networkactive": (
+            node.connman.network_active if node.connman else False
+        ),
         "connections": node.connman.connection_count() if node.connman else 0,
         "networks": [],
         "localaddresses": [
@@ -186,6 +188,45 @@ def listbanned(node, params: List[Any]):
     return node.connman.list_banned() if node.connman else []
 
 
+def clearbanned(node, params: List[Any]):
+    """ref rpc/net.cpp clearbanned."""
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    node.connman.banned.clear()
+    return None
+
+
+def disconnectnode(node, params: List[Any]):
+    """ref rpc/net.cpp disconnectnode."""
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    if not node.connman.disconnect(str(params[0])):
+        raise RPCError(
+            RPC_INVALID_PARAMETER, "Node not found in connected nodes"
+        )
+    return None
+
+
+def getnettotals(node, params: List[Any]):
+    """ref rpc/net.cpp getnettotals."""
+    import time as _t
+
+    sent, recv = node.connman.total_bytes() if node.connman else (0, 0)
+    return {
+        "totalbytesrecv": recv,
+        "totalbytessent": sent,
+        "timemillis": int(_t.time() * 1000),
+    }
+
+
+def setnetworkactive(node, params: List[Any]):
+    """ref rpc/net.cpp setnetworkactive."""
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P disabled")
+    node.connman.set_network_active(bool(params[0]))
+    return node.connman.network_active
+
+
 def register(table: RPCTable) -> None:
     for cat, name, fn, args in [
         ("control", "getinfo", getinfo, []),
@@ -205,5 +246,9 @@ def register(table: RPCTable) -> None:
         ("network", "addnode", addnode, ["node", "command"]),
         ("network", "setban", setban, ["subnet", "command"]),
         ("network", "listbanned", listbanned, []),
+        ("network", "clearbanned", clearbanned, []),
+        ("network", "disconnectnode", disconnectnode, ["address"]),
+        ("network", "getnettotals", getnettotals, []),
+        ("network", "setnetworkactive", setnetworkactive, ["state"]),
     ]:
         table.register(cat, name, fn, args)

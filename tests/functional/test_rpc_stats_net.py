@@ -1,0 +1,124 @@
+"""Functional: blockchain stats / mempool introspection / net control RPCs
+(parity: reference rpc_getblockstats.py, rpc_getchaintxstats coverage in
+rpc_blockchain.py, mempool_packages.py, rpc_net.py)."""
+
+import threading
+import time
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+from .test_mining_basic import ADDR
+
+
+@pytest.mark.functional
+def test_chain_and_block_stats():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        mine = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, mine)
+        addr = n0.rpc.getnewaddress()
+        txid = n0.rpc.sendtoaddress(addr, 10)
+        n0.rpc.generatetoaddress(1, mine)
+
+        stats = n0.rpc.getchaintxstats(50)
+        assert stats["window_block_count"] == 50
+        assert stats["txcount"] == 106  # genesis + 104 coinbases + 1 spend
+        assert stats["window_tx_count"] >= 51
+        assert stats["txrate"] > 0
+
+        bs = n0.rpc.getblockstats(104)
+        assert bs["height"] == 104
+        assert bs["txs"] == 2
+        assert bs["ins"] == 1
+        assert bs["totalfee"] > 0
+        assert bs["minfee"] == bs["maxfee"] == bs["totalfee"]
+        assert bs["subsidy"] > 0
+        # by hash too
+        bs2 = n0.rpc.getblockstats(n0.rpc.getblockhash(104))
+        assert bs2 == bs
+
+
+@pytest.mark.functional
+def test_mempool_introspection_and_save():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        mine = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(103, mine)
+        addr = n0.rpc.getnewaddress()
+        parent = n0.rpc.sendtoaddress(addr, 50)
+        child = n0.rpc.sendtoaddress(addr, 49)  # spends the parent's change
+
+        e = n0.rpc.getmempoolentry(parent)
+        assert e["descendantcount"] >= 1 and e["fee"] > 0
+        anc = n0.rpc.getmempoolancestors(child)
+        desc = n0.rpc.getmempooldescendants(parent)
+        # parent/child linkage in at least one direction (child may spend
+        # either the wallet change of `parent` or another coin)
+        assert (parent in anc) == (child in desc)
+        verbose = n0.rpc.getmempoolancestors(child, True)
+        assert all("fee" in v for v in verbose.values())
+        with pytest.raises(RPCFailure, match="not in mempool"):
+            n0.rpc.getmempoolentry("00" * 32)
+
+        n0.rpc.savemempool()
+        import os
+
+        assert os.path.exists(
+            os.path.join(n0.datadir, "regtest", "mempool.dat")
+        )
+
+
+@pytest.mark.functional
+def test_waitforblockheight_and_nettotals():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        n0.rpc.generatetoaddress(1, ADDR)
+        f.sync_blocks()
+        # bytes flowed in both directions over the wire
+        totals = n0.rpc.getnettotals()
+        assert totals["totalbytessent"] > 0
+        assert totals["totalbytesrecv"] > 0
+
+        # waitforblockheight returns immediately when already reached
+        r = n0.rpc.waitforblockheight(1, 100)
+        assert r["height"] >= 1
+        # and blocks until a background mine reaches the target
+        done = {}
+
+        def _miner():
+            time.sleep(0.5)
+            n1.rpc.generatetoaddress(2, ADDR)
+
+        t = threading.Thread(target=_miner)
+        t.start()
+        r = n0.rpc.waitforblockheight(3, 30000)
+        t.join()
+        assert r["height"] >= 3
+
+
+@pytest.mark.functional
+def test_setnetworkactive_and_bans():
+    with TestFramework(num_nodes=2) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        deadline = time.time() + 10
+        while time.time() < deadline and n0.rpc.getconnectioncount() == 0:
+            time.sleep(0.2)
+        assert n0.rpc.getconnectioncount() >= 1
+
+        assert n0.rpc.setnetworkactive(False) is False
+        deadline = time.time() + 10
+        while time.time() < deadline and n0.rpc.getconnectioncount() > 0:
+            time.sleep(0.2)
+        assert n0.rpc.getconnectioncount() == 0
+        assert n0.rpc.getnetworkinfo()["networkactive"] is False
+        assert n0.rpc.setnetworkactive(True) is True
+
+        n0.rpc.setban("203.0.113.7", "add")
+        assert any(
+            "203.0.113.7" in b.get("address", "") for b in n0.rpc.listbanned()
+        )
+        n0.rpc.clearbanned()
+        assert n0.rpc.listbanned() == []
