@@ -100,6 +100,38 @@ def accept_to_memory_pool(
     except TxValidationError as e:
         raise MempoolAcceptError(e.code)
 
+    # BIP68 relative lock-times against the NEXT block (ref
+    # AcceptToMemoryPoolWorker's CheckSequenceLocks with
+    # STANDARD_LOCKTIME_VERIFY_FLAGS); unconfirmed parents count as being
+    # included in that same block
+    from ..consensus.consensus import LOCKTIME_VERIFY_SEQUENCE
+    from ..consensus.tx_verify import (
+        calculate_sequence_locks,
+        evaluate_sequence_locks,
+    )
+
+    tip = chainstate.tip()
+    prev_heights = []
+    for txin in tx.vin:
+        c = view.get_coin(txin.prevout)
+        ch = c.height if c is not None else height
+        prev_heights.append(height if ch >= 0x7FFFFFFF else ch)
+    locks = calculate_sequence_locks(
+        tx,
+        LOCKTIME_VERIFY_SEQUENCE,
+        prev_heights,
+        height,
+        lambda h: (
+            tip.get_ancestor(h).median_time_past()
+            if tip is not None and tip.get_ancestor(h) is not None
+            else 0
+        ),
+    )
+    if not evaluate_sequence_locks(
+        height, tip.median_time_past() if tip is not None else 0, locks
+    ):
+        raise MempoolAcceptError("non-BIP68-final")
+
     sigops = get_transaction_sigop_cost(tx, view, STANDARD_SCRIPT_VERIFY_FLAGS)
     if sigops > MAX_STANDARD_TX_SIGOPS_COST:
         raise MempoolAcceptError("bad-txns-too-many-sigops")

@@ -27,13 +27,16 @@ from ..consensus.consensus import (
     MAX_BLOCK_SERIALIZED_SIZE,
     MAX_BLOCK_SIGOPS_COST,
     LOCKTIME_MEDIAN_TIME_PAST,
+    LOCKTIME_VERIFY_SEQUENCE,
 )
 from ..consensus.merkle import block_merkle_root
 from ..consensus.tx_verify import (
     TxValidationError,
+    calculate_sequence_locks,
     check_transaction,
     check_tx_asset_values,
     check_tx_inputs,
+    evaluate_sequence_locks,
     get_legacy_sigop_count,
     get_transaction_sigop_cost,
     is_final_tx,
@@ -99,6 +102,10 @@ class ChainState:
         self.prune_mode = False
         self.prune_target_bytes = 0
         self.pruned_height = -1  # highest block whose data was pruned
+        # data-present blocks whose ancestor chain is still incomplete,
+        # keyed by prev hash (ref mapBlocksUnlinked): drained by the
+        # nChainTx cascade in O(children) instead of O(index)
+        self._blocks_unlinked: Dict[int, List[BlockIndex]] = {}
         self._last_autoprune_height = -9  # flush-time prune throttle
 
         if datadir is not None:
@@ -158,15 +165,32 @@ class ChainState:
                     idx.prev = self.block_index.get(prev_hash)
             for idx in sorted(self.block_index.values(), key=lambda i: i.height):
                 idx.build_from_prev()
-                idx.chain_tx_count = (
-                    (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
+                # nChainTx gate survives restarts: only data-complete
+                # ancestor chains get a nonzero cumulative count.  Pruned
+                # blocks lost their data AFTER connecting (VALID_SCRIPTS),
+                # so they still count as complete (ref nChainTx retention
+                # under pruning).
+                has_or_had_data = bool(idx.status & BlockStatus.HAVE_DATA) or (
+                    (idx.status & BlockStatus.VALID_MASK)
+                    >= BlockStatus.VALID_SCRIPTS
                 )
+                if has_or_had_data and (
+                    idx.prev is None or idx.prev.chain_tx_count > 0
+                ):
+                    idx.chain_tx_count = (
+                        (idx.prev.chain_tx_count if idx.prev else 0)
+                        + idx.tx_count
+                    )
+                else:
+                    idx.chain_tx_count = 0
             tip_hash = self.blocktree.read_tip()
             if tip_hash is not None and tip_hash in self.block_index:
                 self.active.set_tip(self.block_index[tip_hash])
             for idx in self.block_index.values():
-                if idx.is_valid(BlockStatus.VALID_TRANSACTIONS) and (
-                    idx.status & BlockStatus.HAVE_DATA
+                if (
+                    idx.is_valid(BlockStatus.VALID_TRANSACTIONS)
+                    and idx.status & BlockStatus.HAVE_DATA
+                    and idx.chain_tx_count > 0
                 ):
                     self.candidates.add(idx)
                 if idx.status & BlockStatus.FAILED_MASK:
@@ -587,6 +611,32 @@ class ChainState:
                     except TxValidationError as e:
                         raise BlockValidationError(e.code, f"tx {i}")
                     fees += fee
+                    # BIP68 relative lock-times (ref ConnectBlock's
+                    # SequenceLocks check; CSV active from genesis here)
+                    prev_heights = []
+                    for txin in tx.vin:
+                        c = view.get_coin(txin.prevout)
+                        prev_heights.append(
+                            c.height if c is not None else idx.height
+                        )
+                    locks = calculate_sequence_locks(
+                        tx,
+                        LOCKTIME_VERIFY_SEQUENCE,
+                        prev_heights,
+                        idx.height,
+                        lambda h: idx.get_ancestor(h).median_time_past()
+                        if idx.get_ancestor(h) is not None
+                        else 0,
+                    )
+                    prev_mtp = (
+                        idx.prev.median_time_past() if idx.prev else 0
+                    )
+                    if not evaluate_sequence_locks(
+                        idx.height, prev_mtp, locks
+                    ):
+                        raise BlockValidationError(
+                            "bad-txns-nonfinal", f"tx {i} sequence locks"
+                        )
                 sigops_cost += get_transaction_sigop_cost(tx, view, script_flags)
                 if sigops_cost > MAX_BLOCK_SIGOPS_COST:
                     raise BlockValidationError("bad-blk-sigops")
@@ -854,8 +904,43 @@ class ChainState:
                 try:
                     self._connect_tip(idx, blk)
                     progressed = True
-                except BlockValidationError:
-                    self._invalidate(idx)
+                except BlockValidationError as e:
+                    # ref InvalidChainFound/InvalidBlockFound logging
+                    log_print(
+                        LogFlags.NONE,
+                        "ERROR: ConnectTip %s h=%d failed: %s",
+                        u256_hex(idx.block_hash)[:16],
+                        idx.height,
+                        e,
+                    )
+                    if e.code in ("no-data", "no-undo-data"):
+                        # missing data is NOT invalidity (defense in depth
+                        # behind the nChainTx candidacy gate): drop the
+                        # candidate and its candidate descendants, clear
+                        # their completeness marks, and park the direct
+                        # children so a re-submitted block reinstates them
+                        self.candidates.discard(idx)
+                        idx.status = BlockStatus(
+                            idx.status & ~BlockStatus.HAVE_DATA
+                        )
+                        self.positions.pop(idx.block_hash, None)
+                        idx.chain_tx_count = 0
+                        for cand in list(self.candidates):
+                            if cand.get_ancestor(idx.height) is idx:
+                                self.candidates.discard(cand)
+                        for other in self.block_index.values():
+                            if other.get_ancestor(idx.height) is idx:
+                                other.chain_tx_count = 0
+                                if other is not idx and other.prev is idx and (
+                                    other.status & BlockStatus.HAVE_DATA
+                                ):
+                                    parked = self._blocks_unlinked.setdefault(
+                                        idx.block_hash, []
+                                    )
+                                    if other not in parked:
+                                        parked.append(other)
+                    else:
+                        self._invalidate(idx)
                     failed = True
                     break
             if not failed:
@@ -1095,9 +1180,26 @@ class ChainState:
         idx.status |= BlockStatus.HAVE_DATA
         self._received_block_data(idx)
         idx.tx_count = len(block.vtx)
-        idx.chain_tx_count = (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
-        self.candidates.add(idx)
+        # nChainTx gate (ref ReceivedBlockTransactions): a block becomes a
+        # chain candidate only once data for its WHOLE ancestor chain has
+        # arrived — block data can land out of order when compact-block
+        # announcements race the initial headers sync.  chain_tx_count > 0
+        # marks "all ancestors connectable"; arrival cascades to waiting
+        # descendants (ref mapBlocksUnlinked).
+        if idx.prev is None or idx.prev.chain_tx_count > 0:
+            todo = [idx]
+            while todo:
+                cur = todo.pop()
+                cur.chain_tx_count = (
+                    (cur.prev.chain_tx_count if cur.prev else 0) + cur.tx_count
+                )
+                self.candidates.add(cur)
+                todo.extend(self._blocks_unlinked.pop(cur.block_hash, ()))
+        else:
+            self._blocks_unlinked.setdefault(
+                idx.header.hash_prev, []
+            ).append(idx)
         main_signals.new_pow_valid_block(idx, block)
         self.activate_best_chain(block)
         return idx

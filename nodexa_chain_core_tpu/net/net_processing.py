@@ -164,6 +164,12 @@ class NetProcessor:
         peer.services = v.services
         peer.user_agent = v.user_agent
         peer.start_height = v.start_height
+        if not peer.inbound:
+            # outbound-only, deduped per address: inbound floods must not
+            # steer the adjusted clock (ref AddTimeData + setKnown)
+            from ..utils.timedata import g_timedata
+
+            g_timedata.add_sample(v.timestamp, source=peer.ip)
         if peer.inbound:
             self._send_version(peer)
         peer.send_msg(self.magic, MSG_VERACK)
@@ -348,7 +354,11 @@ class NetProcessor:
             return
         cs = self.node.chainstate
         try:
-            indexes = cs.process_new_block_headers(headers)
+            from ..utils.timedata import g_timedata
+
+            indexes = cs.process_new_block_headers(
+                headers, adjusted_time=g_timedata.adjusted_time()
+            )
         except BlockValidationError as e:
             if e.code == "prev-blk-not-found":
                 # unconnecting announcement: ask for the missing range

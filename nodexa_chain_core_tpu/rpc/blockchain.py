@@ -330,11 +330,21 @@ def getchaintxstats(node, params: List[Any]):
         final = _lookup_block(node, str(params[1]))
         if final not in cs.active:
             raise RPCError(RPC_INVALID_PARAMETER, "Block is not in main chain")
-    nblocks = int(params[0]) if params and params[0] else min(
-        final.height, 30 * 24 * 60  # 30 days of 1-minute blocks
-    )
-    if nblocks <= 0 or nblocks > final.height:
+    if params and params[0] is not None:
+        nblocks = int(params[0])
+    else:
+        nblocks = min(final.height, 30 * 24 * 60)  # 30 days of 1-min blocks
+    if nblocks < 0 or nblocks > final.height:
         raise RPCError(RPC_INVALID_PARAMETER, "Invalid block count")
+    if nblocks == 0:
+        return {
+            "time": final.header.time,
+            "txcount": final.chain_tx_count,
+            "window_final_block_hash": u256_hex(final.block_hash),
+            "window_block_count": 0,
+            "window_tx_count": 0,
+            "window_interval": 0,
+        }
     start = final.get_ancestor(final.height - nblocks)
     window_tx = final.chain_tx_count - start.chain_tx_count
     window_secs = final.header.time - start.header.time
@@ -374,6 +384,10 @@ def getblockstats(node, params: List[Any]):
         raise RPCError(RPC_MISC_ERROR, "Block not available (pruned data)")
     block = cs.read_block(idx)
     _, upos = cs.positions.get(idx.block_hash, (-1, -1))
+    if upos < 0 and len(block.vtx) > 1:
+        raise RPCError(
+            RPC_MISC_ERROR, "Undo data expected but can't be read"
+        )
     undo = cs.block_store.read_undo(upos) if upos >= 0 else None
 
     fees = []

@@ -11,6 +11,12 @@ from ..script.standard import decode_destination, KeyID, ScriptID
 from .server import RPC_INVALID_PARAMETER, RPCError, RPCTable
 
 
+def _time_offset() -> int:
+    from ..utils.timedata import g_timedata
+
+    return g_timedata.offset()
+
+
 def getinfo(node, params: List[Any]):
     tip = node.chainstate.tip()
     from .blockchain import _difficulty
@@ -19,7 +25,7 @@ def getinfo(node, params: List[Any]):
         "version": __version__,
         "protocolversion": 70028,
         "blocks": tip.height,
-        "timeoffset": 0,
+        "timeoffset": _time_offset(),
         "connections": node.connman.connection_count() if node.connman else 0,
         "difficulty": _difficulty(tip.header.bits, node.params),
         "testnet": node.params.network == "test",
@@ -117,7 +123,7 @@ def getnetworkinfo(node, params: List[Any]):
         "protocolversion": 70028,
         "localservices": "0000000000000005",
         "localrelay": True,
-        "timeoffset": 0,
+        "timeoffset": _time_offset(),
         "networkactive": (
             node.connman.network_active if node.connman else False
         ),

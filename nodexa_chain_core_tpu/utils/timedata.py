@@ -13,10 +13,18 @@ MAX_OFFSET = 70 * 60
 class TimeData:
     def __init__(self) -> None:
         self._offsets: List[int] = [0]
+        self._seen: set = set()
 
-    def add_sample(self, peer_time: int) -> None:
+    def add_sample(self, peer_time: int, source: str = "") -> None:
+        """One sample per source address (ref timedata.cpp's setKnown):
+        reconnecting or multi-connecting from one host can't stack the
+        median."""
         if len(self._offsets) >= MAX_SAMPLES:
             return
+        if source:
+            if source in self._seen:
+                return
+            self._seen.add(source)
         offset = peer_time - int(time.time())
         if abs(offset) <= MAX_OFFSET:
             self._offsets.append(offset)

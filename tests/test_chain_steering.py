@@ -187,3 +187,34 @@ def test_invalidate_resubmits_transactions(setup):
     cs.invalidate_block(cs.lookup(mined.get_hash()))
     # the reorged-out spend is back in the pool
     assert pool.contains(tx.txid)
+
+
+def test_out_of_order_block_data_does_not_invalidate(setup):
+    """Block DATA arriving child-before-parent (compact announcements
+    racing headers sync) must never brand the parent invalid — candidacy
+    waits for a data-complete ancestor chain (ref ReceivedBlockTransactions
+    nChainTx gate + mapBlocksUnlinked cascade)."""
+    params, cs, spk = setup
+    # build 3 blocks on a scratch chainstate
+    scratch = ChainState(params)
+    t = params.genesis_time + 60
+    blocks = []
+    for _ in range(3):
+        asm = BlockAssembler(scratch)
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        scratch.process_new_block(blk)
+        blocks.append(blk)
+        t += 60
+    # feed cs the HEADERS first (headers-first sync), then data in REVERSE
+    cs.process_new_block_headers([b.header for b in blocks])
+    cs.process_new_block(blocks[2])  # child data first
+    assert cs.tip().height == 0      # not connectable yet
+    assert not cs.invalid            # and nothing branded invalid
+    cs.process_new_block(blocks[1])
+    assert cs.tip().height == 0
+    assert not cs.invalid
+    cs.process_new_block(blocks[0])  # gap fills: cascade connects all 3
+    assert cs.tip().height == 3
+    assert cs.tip().block_hash == blocks[2].get_hash()
+    assert not cs.invalid

@@ -246,3 +246,52 @@ def test_rbf_rule2_rejects_new_unconfirmed_input_via_descendant(chain100):
     )
     with pytest.raises(MempoolAcceptError, match="replacement-adds-unconfirmed"):
         accept_to_memory_pool(cs, pool, replacement)
+
+
+def test_bip68_sequence_locks(chain100):
+    """BIP68: a v2 tx with a height-relative nSequence is rejected until
+    the input has aged enough blocks (ref CheckSequenceLocks /
+    functional mempool_sequence coverage)."""
+    params, cs, pool, ks, spk, blocks = chain100
+    tip_before = cs.tip().height
+    cb = blocks[10].vtx[0]
+    age = tip_before - 10  # current confirmations of that coinbase
+    need = age + 5  # require 5 more blocks than it has
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0), sequence=need)],
+        vout=[TxOut(value=cb.vout[0].value - 100_000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, spk)
+    with pytest.raises(MempoolAcceptError, match="non-BIP68-final"):
+        accept_to_memory_pool(cs, pool, tx)
+    # mine past the requirement; the same tx becomes acceptable
+    asm = BlockAssembler(cs)
+    t = params.genesis_time + 60 * 1000
+    for _ in range(6):
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+        t += 60
+    accept_to_memory_pool(cs, pool, tx)
+    assert pool.contains(tx.txid)
+    # and a block including it connects (consensus-path check)
+    blk = asm.create_new_block(spk.raw, ntime=t)
+    assert any(x.txid == tx.txid for x in blk.vtx)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    assert cs.tip().height == tip_before + 7
+
+
+def test_bip68_disable_flag_ignored(chain100):
+    """A sequence with the disable bit set carries no BIP68 constraint."""
+    params, cs, pool, ks, spk, blocks = chain100
+    cb = blocks[11].vtx[0]
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0), sequence=(1 << 31) | 5000)],
+        vout=[TxOut(value=cb.vout[0].value - 100_000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, spk)
+    accept_to_memory_pool(cs, pool, tx)
+    assert pool.contains(tx.txid)

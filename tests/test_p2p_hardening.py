@@ -50,6 +50,8 @@ class StubPeer:
         self.misbehavior = 0
         self.disconnect = False
         self.ip = "127.0.0.1"
+        self.bytes_sent = 0
+        self.bytes_recv = 0
         self.sent = []  # (command, payload)
 
     def send_msg(self, magic, command, payload=b""):
@@ -249,6 +251,7 @@ def test_inbound_eviction_prefers_youngest_unprotected():
     import threading
 
     cm._peers_lock = threading.Lock()
+    cm._closed_bytes_sent = cm._closed_bytes_recv = 0
     cm.processor = type("P", (), {"finalize_peer": lambda self, p: None})()
     peers = {}
     now = time.time()
