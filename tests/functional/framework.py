@@ -66,12 +66,23 @@ class RPCFailure(Exception):
 class TestNode:
     """ref test_framework/test_node.py TestNode."""
 
-    def __init__(self, i: int, basedir: str, extra_args: Optional[List[str]] = None):
+    def __init__(
+        self,
+        i: int,
+        basedir: str,
+        extra_args: Optional[List[str]] = None,
+        network: str = "regtest",
+    ):
         self.index = i
         self.datadir = os.path.join(basedir, f"node{i}")
         os.makedirs(self.datadir, exist_ok=True)
         self.p2p_port = free_port()
         self.rpc_port = free_port()
+        if network not in ("regtest", "kawpowregtest", "testnet"):
+            # unknown flags are silently ignored by the daemon and would
+            # boot MAINNET consensus; fail here instead
+            raise ValueError(f"unsupported test network {network!r}")
+        self.network = network
         self.extra_args = extra_args or []
         self.proc: Optional[subprocess.Popen] = None
         self.rpc: Optional[RPCProxy] = None
@@ -84,7 +95,7 @@ class TestNode:
             sys.executable,
             "-m",
             "nodexa_chain_core_tpu.node.daemon",
-            "-regtest",
+            f"-{self.network}",
             f"-datadir={self.datadir}",
             f"-port={self.p2p_port}",
             f"-rpcport={self.rpc_port}",
@@ -136,15 +147,19 @@ class TestFramework:
 
     __test__ = False  # not a pytest collection target
 
-    def __init__(self, num_nodes: int = 1, extra_args=None):
+    def __init__(self, num_nodes: int = 1, extra_args=None,
+                 network: str = "regtest"):
         self.num_nodes = num_nodes
         self.extra_args = extra_args or [[] for _ in range(num_nodes)]
         self.basedir = tempfile.mkdtemp(prefix="nodexa_func_")
+        self.network = network
         self.nodes: List[TestNode] = []
 
     def __enter__(self) -> "TestFramework":
         for i in range(self.num_nodes):
-            node = TestNode(i, self.basedir, self.extra_args[i])
+            node = TestNode(
+                i, self.basedir, self.extra_args[i], network=self.network
+            )
             node.start()
             self.nodes.append(node)
         return self

@@ -1,0 +1,89 @@
+"""Functional: asset state across reorgs (parity: reference
+feature_assets_reorg.py — an asset issued on a losing branch must vanish
+from consensus state when the chain reorganizes past it, and the name
+becomes issuable again on the winning branch)."""
+
+import time
+
+import pytest
+
+from .framework import RPCFailure, TestFramework
+
+
+@pytest.mark.functional
+def test_asset_issue_rolls_back_on_reorg():
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        a0 = n0.rpc.getnewaddress()
+        a1 = n1.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(105, a0)
+        f.sync_blocks()
+
+        # split the network
+        n0.rpc.addnode(f"127.0.0.1:{n1.p2p_port}", "remove")
+        n1.rpc.addnode(f"127.0.0.1:{n0.p2p_port}", "remove")
+        time.sleep(1)
+
+        # node0 issues REORGCOIN on its (soon losing) branch
+        n0.rpc.issue("REORGCOIN", 1000, a0)
+        n0.rpc.generatetoaddress(1, a0)
+        assert "REORGCOIN" in n0.rpc.listassets()
+
+        # node1 secretly mines a longer branch with no such asset
+        n1.rpc.generatetoaddress(3, a1)
+
+        # heal: node0 must reorg onto node1's branch
+        f.connect_nodes(0, 1)
+        f.sync_blocks(timeout=60)
+        assert n0.rpc.getbestblockhash() == n1.rpc.getbestblockhash()
+        # the asset is GONE from consensus state on both nodes
+        assert "REORGCOIN" not in n0.rpc.listassets()
+        assert "REORGCOIN" not in n1.rpc.listassets()
+        with pytest.raises(RPCFailure):
+            n0.rpc.getassetdata("REORGCOIN")
+
+        # the reorged-out issuance returned to node0's mempool, so mining a
+        # block on the NEW branch re-includes it and the name exists again
+        n0.rpc.generatetoaddress(1, a0)
+        f.sync_blocks(timeout=60)
+        if "REORGCOIN" not in n0.rpc.listassets():
+            # resubmission raced the mine: issue fresh — name must be free
+            n0.rpc.issue("REORGCOIN", 1000, a0)
+            n0.rpc.generatetoaddress(1, a0)
+            f.sync_blocks(timeout=60)
+        assert n1.rpc.getassetdata("REORGCOIN")["amount"] == 1000
+
+
+@pytest.mark.functional
+def test_asset_transfer_rolls_back_on_reorg():
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        a0 = n0.rpc.getnewaddress()
+        a1 = n1.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(105, a0)
+        n0.rpc.issue("XFERCOIN", 500, a0)
+        n0.rpc.generatetoaddress(1, a0)
+        f.sync_blocks()
+        assert n1.rpc.getassetdata("XFERCOIN")["amount"] == 500
+
+        # split; node0 confirms a transfer to node1 on the losing branch
+        n0.rpc.addnode(f"127.0.0.1:{n1.p2p_port}", "remove")
+        n1.rpc.addnode(f"127.0.0.1:{n0.p2p_port}", "remove")
+        time.sleep(1)
+        n0.rpc.transfer("XFERCOIN", 123, a1)
+        n0.rpc.generatetoaddress(1, a0)
+        holders = n0.rpc.listaddressesbyasset("XFERCOIN")
+        assert holders.get(a1) == 123
+
+        n1.rpc.generatetoaddress(3, a1)
+        f.connect_nodes(0, 1)
+        f.sync_blocks(timeout=60)
+        # transfer unwound with the reorg: a1 no longer holds on-chain
+        # (no block has been mined on the healed chain, so the resubmitted
+        # transfer can only sit unconfirmed in the mempool)
+        holders = n0.rpc.listaddressesbyasset("XFERCOIN")
+        assert not holders.get(a1)
+        # asset supply itself is branch-independent
+        assert n0.rpc.getassetdata("XFERCOIN")["amount"] == 500
