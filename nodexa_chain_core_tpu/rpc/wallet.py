@@ -565,6 +565,70 @@ def listlockunspent(node, params: List[Any]):
     ]
 
 
+def _multisig_script(node, nrequired: int, keys: List[Any], wallet=None):
+    from ..script.standard import multisig_script
+
+    from ..crypto.secp256k1 import pubkey_parse
+
+    pubkeys = []
+    for k in keys:
+        k = str(k)
+        if len(k) in (66, 130):  # hex pubkey
+            try:
+                raw = bytes.fromhex(k)
+                pubkey_parse(raw)  # must be a valid curve point
+            except Exception as e:  # hex or Secp256k1Error
+                raise RPCError(
+                    RPC_INVALID_ADDRESS_OR_KEY, f"{k}: invalid public key ({e})"
+                )
+            pubkeys.append(raw)
+            continue
+        # wallet address -> pubkey lookup
+        try:
+            dest = decode_destination(k, node.params)
+        except ValueError as e:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, f"{k}: {e}")
+        if not isinstance(dest, KeyID):
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, f"{k}: not a key address")
+        pub = (wallet or _wallet(node)).keystore.get_pub(dest.h)
+        if pub is None:
+            raise RPCError(
+                RPC_INVALID_ADDRESS_OR_KEY, f"{k}: no full public key in wallet"
+            )
+        pubkeys.append(pub)
+    if not 1 <= nrequired <= len(pubkeys) <= 16:
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            "nrequired must be 1..n and n at most 16",
+        )
+    return multisig_script(nrequired, pubkeys)
+
+
+def createmultisig(node, params: List[Any]):
+    """ref rpc/misc.cpp createmultisig (stateless)."""
+    from ..crypto.hashes import hash160
+    from ..script.standard import ScriptID
+
+    redeem = _multisig_script(node, int(params[0]), list(params[1]))
+    sid = ScriptID(hash160(redeem.raw))
+    return {
+        "address": encode_destination(sid, node.params),
+        "redeemScript": redeem.raw.hex(),
+    }
+
+
+def addmultisigaddress(node, params: List[Any]):
+    """ref rpcwallet.cpp addmultisigaddress: store the redeem script so
+    the P2SH address becomes watch/spendable by this wallet."""
+    from ..script.standard import ScriptID
+
+    w = _wallet(node)
+    redeem = _multisig_script(node, int(params[0]), list(params[1]), wallet=w)
+    sid = ScriptID(w.keystore.add_script(redeem))
+    w.flush()
+    return encode_destination(sid, node.params)
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("getnewaddress", getnewaddress, ["label"]),
@@ -596,6 +660,8 @@ def register(table: RPCTable) -> None:
         ("settxfee", settxfee, ["amount"]),
         ("lockunspent", lockunspent, ["unlock", "transactions"]),
         ("listlockunspent", listlockunspent, []),
+        ("addmultisigaddress", addmultisigaddress, ["nrequired", "keys"]),
+        ("createmultisig", createmultisig, ["nrequired", "keys"]),
         ("createwallet", createwallet, ["wallet_name"]),
         ("loadwallet", loadwallet, ["filename"]),
         ("unloadwallet", unloadwallet, ["wallet_name"]),

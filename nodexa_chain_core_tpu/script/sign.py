@@ -80,6 +80,9 @@ class KeyStore:
     def get_script(self, scriptid: bytes) -> Optional[Script]:
         return self._scripts.get(scriptid)
 
+    def scripts(self) -> Dict[bytes, Script]:
+        return dict(self._scripts)
+
     def keys(self):
         return dict(self._keys)
 

@@ -274,9 +274,36 @@ class Wallet(ValidationInterface):
         locked wallet keeps watching its addresses (ref ISMINE_SPENDABLE
         evaluated over the keystore's pubkey records).
         """
+        from ..script.standard import (
+            TX_MULTISIG,
+            TX_PUBKEY,
+            TX_PUBKEYHASH,
+            ScriptID,
+            solver,
+        )
+
         dest = extract_destination(Script(script_pubkey))
         if isinstance(dest, KeyID):
             return self.keystore.have_key(dest.h)
+        if isinstance(dest, ScriptID):
+            # P2SH is spendable-mine only when we hold the redeem script
+            # AND every key it demands (ref IsMine's TX_SCRIPTHASH branch
+            # recursing, with multisig requiring HaveKeys == all)
+            redeem = self.keystore.get_script(dest.h)
+            if redeem is None:
+                return False
+            kind, sols = solver(redeem)
+            from ..crypto.hashes import hash160 as _h160
+
+            if kind == TX_MULTISIG:
+                return all(
+                    self.keystore.have_key(_h160(pub)) for pub in sols[1:-1]
+                )
+            if kind == TX_PUBKEYHASH:
+                return self.keystore.have_key(sols[0])
+            if kind == TX_PUBKEY:
+                return self.keystore.have_key(_h160(sols[0]))
+            return False
         return False
 
     def is_relevant(self, tx: Transaction) -> bool:
@@ -658,6 +685,9 @@ class Wallet(ValidationInterface):
                 "mnemonic": None if self.is_crypted else self.mnemonic,
                 "next_index": self.next_index,
                 "address_book": self.address_book,
+                "scripts": [
+                    s.raw.hex() for s in self.keystore.scripts().values()
+                ],
                 "wtx": [
                     {
                         "hex": wtx.tx.to_bytes().hex(),
@@ -713,6 +743,8 @@ class Wallet(ValidationInterface):
                 for idx in range(self.next_index[chain]):
                     priv = self.derive_key(chain, idx)
                     self._register_key(priv, chain, idx)
+        for raw in data.get("scripts", []):
+            self.keystore.add_script(Script(bytes.fromhex(raw)))
         for item in data.get("wtx", []):
             tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
             self.wtx[tx.txid] = WalletTx(
