@@ -44,7 +44,24 @@ def accept_to_memory_pool(
     bypass_limits: bool = False,
     require_standard: Optional[bool] = None,
 ) -> MempoolEntry:
-    """Validate and insert; raises MempoolAcceptError on rejection."""
+    """Validate and insert; raises MempoolAcceptError on rejection.
+
+    Runs under cs_main (ref AcceptToMemoryPool's LOCK(cs_main)): admission
+    reads the coins view and tip state that block connection mutates.
+    """
+    with chainstate.cs_main:
+        return _accept_to_memory_pool_locked(
+            chainstate, pool, tx, bypass_limits, require_standard
+        )
+
+
+def _accept_to_memory_pool_locked(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool = False,
+    require_standard: Optional[bool] = None,
+) -> MempoolEntry:
     if require_standard is None:
         require_standard = chainstate.params.require_standard
 

@@ -17,7 +17,9 @@ the reference's CScriptCheck batches do (ref validation.cpp:9217,9301).
 
 from __future__ import annotations
 
+import functools
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -78,6 +80,20 @@ class BlockValidationError(Exception):
         self.reason = reason
 
 
+def _with_cs_main(method):
+    """Serialize a ChainState entry point under cs_main (ref the
+    reference's LOCK(cs_main) at every ProcessNewBlock/ActivateBestChain
+    call site): RPC worker threads, the P2P message handler, and built-in
+    miner threads all submit blocks concurrently."""
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self.cs_main:
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
+
 class ChainState:
     """ref validation.cpp's g_chainstate + mapBlockIndex + pcoinsTip."""
 
@@ -90,6 +106,8 @@ class ChainState:
     ):
         self.params = params
         self.datadir = datadir
+        # ref sync.h cs_main: one recursive lock over chainstate mutation
+        self.cs_main = threading.RLock()
         self.block_index: Dict[int, BlockIndex] = {}
         self.positions: Dict[int, Tuple[int, int]] = {}  # hash -> (data, undo)
         self.active = Chain()
@@ -225,6 +243,7 @@ class ChainState:
 
     # ------------------------------------------------- startup integrity
 
+    @_with_cs_main
     def verify_db(self, check_level: int = 3, check_blocks: int = 6) -> None:
         """Startup sanity sweep over recent blocks (ref CVerifyDB::VerifyDB,
         validation.cpp:12564; -checklevel/-checkblocks).
@@ -291,6 +310,7 @@ class ChainState:
             check_level,
         )
 
+    @_with_cs_main
     def reindex(self) -> int:
         """Rebuild the block index and chainstate from the block files
         (ref -reindex, validation.cpp LoadExternalBlockFile).  The existing
@@ -353,6 +373,7 @@ class ChainState:
 
     # ------------------------------------------------------------ pruning
 
+    @_with_cs_main
     def prune_block_files(self, manual_height: Optional[int] = None) -> int:
         """Delete block/undo chunk files wholly below the prune point
         (ref FindFilesToPrune + PruneOneBlockFile + UnlinkPrunedFiles).
@@ -863,6 +884,7 @@ class ChainState:
                 best = cand
         return best
 
+    @_with_cs_main
     def activate_best_chain(self, new_block: Optional[Block] = None) -> None:
         """ref validation.cpp:11272 ActivateBestChain + Step (:11164)."""
         progressed = False
@@ -987,6 +1009,7 @@ class ChainState:
 
     # --------------------------------------- manual chain steering (RPCs)
 
+    @_with_cs_main
     def invalidate_block(self, idx: BlockIndex) -> None:
         """Permanently mark a block invalid and walk the active chain off it
         (ref validation.cpp InvalidateBlock).  Disconnected transactions are
@@ -1027,6 +1050,7 @@ class ChainState:
         self._resubmit_disconnected()
         self.flush_state_to_disk()
 
+    @_with_cs_main
     def reconsider_block(self, idx: BlockIndex) -> None:
         """Clear failure flags from idx, its ancestors, and its descendants,
         then let the best chain re-activate (ref ResetBlockFailureFlags)."""
@@ -1050,6 +1074,7 @@ class ChainState:
         self.activate_best_chain()
         self.flush_state_to_disk()
 
+    @_with_cs_main
     def precious_block(self, idx: BlockIndex) -> None:
         """Treat a block as if it were received first among equal-work tips
         (ref validation.cpp PreciousBlock): give it a decreasing negative
@@ -1119,6 +1144,7 @@ class ChainState:
                 verified.add(id(header))
         return verified
 
+    @_with_cs_main
     def process_new_block_headers(
         self, headers: List[BlockHeader], adjusted_time: Optional[int] = None
     ) -> List[BlockIndex]:
@@ -1153,6 +1179,7 @@ class ChainState:
             out.append(self._add_to_block_index(header))
         return out
 
+    @_with_cs_main
     def process_new_block(self, block: Block, force: bool = False) -> BlockIndex:
         """ref validation.cpp:12131 ProcessNewBlock."""
         h = block.get_hash(self.params.algo_schedule)
@@ -1204,6 +1231,7 @@ class ChainState:
         self.activate_best_chain(block)
         return idx
 
+    @_with_cs_main
     def test_block_validity(self, block: Block, prev: BlockIndex) -> None:
         """ref validation.cpp:12164 TestBlockValidity (miner pre-check)."""
         self.check_block(block, check_pow=False)
@@ -1219,6 +1247,7 @@ class ChainState:
 
     # ------------------------------------------------------------- flush
 
+    @_with_cs_main
     def flush_state_to_disk(self) -> None:
         """ref validation.cpp:10570 FlushStateToDisk."""
         tip = self.tip()

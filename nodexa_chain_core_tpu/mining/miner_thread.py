@@ -1,0 +1,127 @@
+"""Built-in background miner (parity: reference src/miner.cpp:566-728 —
+CloreMiner / GenerateClores, the Ravencoin-era re-addition of in-process
+mining threads that upstream Bitcoin removed; controlled by
+getgenerate/setgenerate and -gen/-genproclimit).
+
+Each worker loops: assemble a template on the current tip, search a nonce
+slice (era-aware: native X16R/KawPow scan or the sha256d path), submit on
+success, refresh the template when the tip moves.  A rolling hash counter
+feeds getmininginfo's hashespersec (ref nHashesPerSec, miner.cpp:684-685).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..utils.logging import log_printf
+from .assembler import BlockAssembler, mine_block_cpu
+
+SLICE_TRIES = 50_000  # nonces per template round before staleness re-check
+
+
+class BackgroundMiner:
+    def __init__(self, node, threads: int = 1):
+        self.node = node
+        self.threads = max(1, threads)
+        self._stop = threading.Event()
+        self._workers: list = []
+        self._hashes = 0
+        self._window_start = time.time()
+        self._lock = threading.Lock()
+
+    # -- control (ref GenerateClores's thread-group management) -------------
+
+    @property
+    def running(self) -> bool:
+        return bool(self._workers) and not self._stop.is_set()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        self._window_start = time.time()
+        self._hashes = 0
+        for i in range(self.threads):
+            t = threading.Thread(
+                target=self._mine_loop, args=(i,), name=f"miner-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+        log_printf("built-in miner started: %d thread(s)", self.threads)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=15)  # a native search slice can run for seconds
+        self._workers.clear()
+        self.node.miner_hashes_per_sec = 0
+        log_printf("built-in miner stopped")
+
+    # -- worker -------------------------------------------------------------
+
+    def _coinbase_script(self) -> Optional[bytes]:
+        wallet = getattr(self.node, "wallet", None)
+        if wallet is None:
+            return None
+        from ..script.standard import KeyID, p2pkh_script
+
+        kid = wallet.get_keyid_for_mining()
+        return p2pkh_script(KeyID(kid)).raw if kid else None
+
+    def _count(self, n: int) -> None:
+        if self._stop.is_set():
+            return  # never overwrite the rate stop() just zeroed
+        with self._lock:
+            self._hashes += n
+            dt = time.time() - self._window_start
+            if dt >= 1.0:
+                self.node.miner_hashes_per_sec = int(self._hashes / dt)
+                self._hashes = 0
+                self._window_start = time.time()
+
+    def _mine_loop(self, worker_id: int) -> None:
+        node = self.node
+        params = node.params
+        # monotonically increasing per-worker extranonce (ref
+        # IncrementExtraNonce): every round searches a FRESH template even
+        # within one wall-clock second
+        extra = worker_id << 24
+        while not self._stop.is_set():
+            try:
+                if params.mining_requires_peers and (
+                    node.connman is None
+                    or node.connman.connection_count() == 0
+                ):
+                    time.sleep(1.0)
+                    continue
+                spk = self._coinbase_script()
+                if spk is None:
+                    time.sleep(1.0)
+                    continue
+                tip_hash = node.chainstate.tip().block_hash
+                extra += 1
+                asm = BlockAssembler(node.chainstate)
+                block = asm.create_new_block(spk, extra_nonce=extra)
+                found = mine_block_cpu(
+                    block, params.algo_schedule, max_tries=SLICE_TRIES
+                )
+                self._count(SLICE_TRIES if not found else SLICE_TRIES // 2)
+                if self._stop.is_set():
+                    return
+                if not found:
+                    continue  # fresh extranonce next round
+                # cs_main serializes against concurrent submitters; the
+                # staleness probe just avoids a pointless duplicate height
+                if node.chainstate.tip().block_hash != tip_hash:
+                    continue
+                node.chainstate.process_new_block(block)
+                log_printf(
+                    "miner: found block %s at height %d",
+                    block.hash_hex[:16],
+                    node.chainstate.tip().height,
+                )
+            except Exception as e:  # keep the worker alive; log visibly
+                log_printf("miner[%d]: error: %r", worker_id, e)
+                time.sleep(0.5)

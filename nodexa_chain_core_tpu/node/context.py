@@ -62,6 +62,9 @@ class NodeContext:
         from ..node.events import main_signals
 
         self.scheduler.stop()
+        miner = getattr(self, "background_miner", None)
+        if miner is not None:
+            miner.stop()
         tor = getattr(self, "tor_controller", None)
         if tor is not None:
             tor.stop()

@@ -244,6 +244,15 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         for addr in g_args.get_all("addnode") + g_args.get_all("connect"):
             node.connman.connect_to(addr)
 
+    # -gen/-genproclimit: built-in miner (ref GenerateClores at init)
+    if g_args.get_bool("gen") and getattr(node, "wallet", None) is not None:
+        from ..mining.miner_thread import BackgroundMiner
+
+        node.background_miner = BackgroundMiner(
+            node, threads=g_args.get_int("genproclimit", 1)
+        )
+        node.background_miner.start()
+
     # Steps 4a/13: RPC server + warmup end
     register_all(g_rpc_table)
     rpc_port = g_args.get_int("rpcport", DEFAULT_RPC_PORTS[network])

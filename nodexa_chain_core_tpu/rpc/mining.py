@@ -208,15 +208,51 @@ def getmininginfo(node, params: List[Any]):
     from .blockchain import _difficulty
 
     tip = node.chainstate.tip()
+    miner = getattr(node, "background_miner", None)
     return {
         "blocks": tip.height,
         "difficulty": _difficulty(tip.header.bits, node.params),
         "networkhashps": getnetworkhashps(node, []),
         "hashespersec": getattr(node, "miner_hashes_per_sec", 0),
+        "generate": bool(miner is not None and miner.running),
+        "genproclimit": miner.threads if miner is not None else -1,
         "pooledtx": node.mempool.size(),
         "chain": node.params.network,
         "warnings": "",
     }
+
+
+def getgenerate(node, params: List[Any]):
+    """ref rpc/mining.cpp getgenerate."""
+    miner = getattr(node, "background_miner", None)
+    return bool(miner is not None and miner.running)
+
+
+def setgenerate(node, params: List[Any]):
+    """ref rpc/mining.cpp setgenerate -> GenerateClores(miner.cpp:728):
+    start/stop the built-in miner threads."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "generate flag required")
+    import os as _os
+
+    generate = bool(params[0])
+    threads = int(params[1]) if len(params) > 1 else 1
+    if threads <= 0:
+        threads = _os.cpu_count() or 1  # ref -genproclimit=-1: all cores
+    if generate and getattr(node, "wallet", None) is None:
+        raise RPCError(
+            RPC_MISC_ERROR, "built-in mining needs the wallet for coinbase keys"
+        )
+    miner = getattr(node, "background_miner", None)
+    if miner is not None:
+        miner.stop()
+        node.background_miner = None
+    if generate:
+        from ..mining.miner_thread import BackgroundMiner
+
+        node.background_miner = BackgroundMiner(node, threads=threads)
+        node.background_miner.start()
+    return None
 
 
 def getnetworkhashps(node, params: List[Any]):
@@ -253,6 +289,8 @@ def register(table: RPCTable) -> None:
         ("getblocktemplate", getblocktemplate, ["template_request"]),
         ("submitblock", submitblock, ["hexdata"]),
         ("getmininginfo", getmininginfo, []),
+        ("getgenerate", getgenerate, []),
+        ("setgenerate", setgenerate, ["generate", "genproclimit"]),
         ("getnetworkhashps", getnetworkhashps, ["nblocks", "height"]),
         ("prioritisetransaction", prioritisetransaction, ["txid", "dummy", "fee_delta"]),
     ]:

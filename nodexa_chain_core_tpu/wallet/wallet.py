@@ -256,6 +256,24 @@ class Wallet(ValidationInterface):
             self.flush()
             return addr
 
+    def get_keyid_for_mining(self):
+        """A stable coinbase key for the built-in miner (ref the reserve
+        key GenerateClores draws; reuses the first external key so mining
+        doesn't burn through the keypool)."""
+        with self.lock:
+            if self.is_locked():
+                return None
+            pubs = self.keystore.pubs()
+            for kid, (chain, idx) in sorted(
+                self.key_meta.items(), key=lambda kv: kv[1]
+            ):
+                if chain == 0 and kid in pubs:
+                    return kid
+        from ..script.standard import decode_destination
+
+        addr = self.get_new_address("mining")
+        return decode_destination(addr, self.node.params).h
+
     def get_change_address_script(self) -> bytes:
         self._require_unlocked()
         with self.lock:

@@ -114,3 +114,34 @@ def test_getblocktemplate_longpoll():
         assert not th.is_alive()
         assert result["elapsed"] >= 1.0  # actually waited
         assert result["tmpl"]["height"] == 3  # template on the new tip
+
+
+@pytest.mark.functional
+def test_builtin_miner_setgenerate():
+    """ref the built-in CPU miner (GenerateClores, miner.cpp:728) driven by
+    getgenerate/setgenerate."""
+    import time
+
+    from .framework import RPCFailure, TestFramework as TF
+
+    with TF(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        assert n0.rpc.getgenerate() is False
+        assert n0.rpc.getmininginfo()["generate"] is False
+
+        n0.rpc.setgenerate(True, 2)
+        assert n0.rpc.getgenerate() is True
+        info = n0.rpc.getmininginfo()
+        assert info["generate"] is True and info["genproclimit"] == 2
+        deadline = time.time() + 30
+        while time.time() < deadline and n0.rpc.getblockcount() < 2:
+            time.sleep(0.25)
+        assert n0.rpc.getblockcount() >= 2
+        # coinbase pays the wallet
+        assert n0.rpc.getwalletinfo()["immature_balance"] > 0
+
+        n0.rpc.setgenerate(False)
+        assert n0.rpc.getgenerate() is False
+        h = n0.rpc.getblockcount()
+        time.sleep(2)
+        assert n0.rpc.getblockcount() <= h + 1  # an in-flight slice may land
