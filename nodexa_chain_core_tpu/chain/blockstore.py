@@ -121,6 +121,28 @@ class AppendFile:
         self._f.close()
 
 
+def scan_block_file(path: str, magic: bytes):
+    """Read-only (pos, payload) scan over a framed block file — for
+    caller-supplied bootstrap files (-loadblock) that must never be
+    created, appended to, or require write permission."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        pos = 0
+        while pos + 8 <= end:
+            f.seek(pos)
+            if f.read(4) != magic:
+                return
+            size = int.from_bytes(f.read(4), "little")
+            if pos + 8 + size > end:
+                return  # torn record
+            payload = f.read(size)
+            if len(payload) != size:
+                return
+            yield pos, payload
+            pos += 8 + size
+
+
 class PrunedError(IOError):
     """Read of a record whose chunk file has been pruned away."""
 

@@ -444,6 +444,74 @@ class ChainState:
         )
         return freed
 
+    @_with_cs_main
+    def load_external_block_file(self, path: str) -> int:
+        """Import fully-validated blocks from a framed block file
+        (ref -loadblock / LoadExternalBlockFile, init.cpp Step 10).
+
+        The file uses the same magic+length framing as this framework's
+        blk chunk files, so another node's blocks/blk*.dat doubles as a
+        bootstrap file.  Out-of-order records are parked and retried once
+        their parent connects (ref mapBlocksUnknownParent).
+        """
+        from ..core.serialize import ByteReader as _BR
+        from .blockstore import scan_block_file
+
+        if not os.path.exists(path):
+            raise BlockValidationError("loadblock-missing", path)
+        sched = self.params.algo_schedule
+        imported = 0
+        failed = 0
+        pending: Dict[int, List[Block]] = {}
+
+        def _try(block: Block) -> bool:
+            nonlocal imported, failed
+            try:
+                self.process_new_block(block)
+                imported += 1
+                return True
+            except BlockValidationError as e:
+                if e.code == "prev-blk-not-found":
+                    pending.setdefault(block.header.hash_prev, []).append(
+                        block
+                    )
+                    return False
+                failed += 1
+                log_print(
+                    LogFlags.REINDEX,
+                    "loadblock: rejected %s: %s",
+                    block.hash_hex[:16],
+                    e,
+                )
+                return False
+
+        magic = getattr(
+            getattr(self.block_store, "blocks", None), "magic", b"NDXB"
+        )
+        for _pos, payload in scan_block_file(path, magic):
+            try:
+                block = Block.deserialize(_BR(payload), sched)
+            except Exception:
+                failed += 1
+                continue
+            if _try(block):
+                ready = [block.get_hash(sched)]
+                while ready:
+                    parent = ready.pop()
+                    for child in pending.pop(parent, ()):
+                        if _try(child):
+                            ready.append(child.get_hash(sched))
+        orphaned = sum(len(v) for v in pending.values())
+        log_print(
+            LogFlags.NONE,
+            "loadblock %s: imported %d, rejected %d, parentless %d",
+            path,
+            imported,
+            failed,
+            orphaned,
+        )
+        return imported
+
     # -------------------------------------------------------------- helpers
 
     @property

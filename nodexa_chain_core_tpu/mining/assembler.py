@@ -41,6 +41,21 @@ class BlockAssembler:
         ``extra_nonce`` perturbs the coinbase so same-parent templates get
         distinct hashes (ref miner.cpp IncrementExtraNonce)."""
         cs = self.chainstate
+        # ref CreateNewBlock's LOCK2(cs_main, mempool.cs): assembly must
+        # not interleave with block connection mutating the mempool/tip
+        with cs.cs_main:
+            return self._create_new_block_locked(
+                script_pubkey, ntime, prev_override, extra_nonce
+            )
+
+    def _create_new_block_locked(
+        self,
+        script_pubkey: bytes,
+        ntime: Optional[int],
+        prev_override,
+        extra_nonce: int,
+    ) -> Block:
+        cs = self.chainstate
         tip = prev_override if prev_override is not None else cs.tip()
         assert tip is not None
         height = tip.height + 1

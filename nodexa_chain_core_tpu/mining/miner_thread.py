@@ -88,6 +88,7 @@ class BackgroundMiner:
         # IncrementExtraNonce): every round searches a FRESH template even
         # within one wall-clock second
         extra = worker_id << 24
+        spk = None  # resolved once; the mining key is stable
         while not self._stop.is_set():
             try:
                 if params.mining_requires_peers and (
@@ -96,10 +97,11 @@ class BackgroundMiner:
                 ):
                     time.sleep(1.0)
                     continue
-                spk = self._coinbase_script()
                 if spk is None:
-                    time.sleep(1.0)
-                    continue
+                    spk = self._coinbase_script()
+                    if spk is None:  # wallet locked/absent: retry later
+                        time.sleep(1.0)
+                        continue
                 tip_hash = node.chainstate.tip().block_hash
                 extra += 1
                 asm = BlockAssembler(node.chainstate)

@@ -99,6 +99,13 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         log_printf("-reindex: reconnected %d blocks, height %d", n,
                    node.chainstate.tip().height if node.chainstate.tip() else -1)
 
+    # Step 10: -loadblock=<file> bootstrap import (ref init.cpp's
+    # ThreadImport over LoadExternalBlockFile)
+    for path in g_args.get_all("loadblock"):
+        n = node.chainstate.load_external_block_file(path)
+        log_printf("-loadblock %s: imported %d blocks, height %d", path, n,
+                   node.chainstate.tip().height)
+
     # -assumevalid: skip script checks under a known-good block (ref
     # init.cpp -assumevalid / Consensus::Params defaultAssumeValid)
     if g_args.is_set("assumevalid"):
@@ -248,9 +255,10 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     if g_args.get_bool("gen") and getattr(node, "wallet", None) is not None:
         from ..mining.miner_thread import BackgroundMiner
 
-        node.background_miner = BackgroundMiner(
-            node, threads=g_args.get_int("genproclimit", 1)
-        )
+        limit = g_args.get_int("genproclimit", 1)
+        if limit <= 0:
+            limit = os.cpu_count() or 1  # ref -genproclimit=-1: all cores
+        node.background_miner = BackgroundMiner(node, threads=limit)
         node.background_miner.start()
 
     # Steps 4a/13: RPC server + warmup end

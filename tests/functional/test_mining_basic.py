@@ -145,3 +145,28 @@ def test_builtin_miner_setgenerate():
         h = n0.rpc.getblockcount()
         time.sleep(2)
         assert n0.rpc.getblockcount() <= h + 1  # an in-flight slice may land
+
+
+@pytest.mark.functional
+def test_loadblock_bootstrap_import():
+    """ref -loadblock / LoadExternalBlockFile (init.cpp Step 10): a fresh
+    node imports and fully validates another node's block file."""
+    import os
+    import shutil
+    import tempfile
+
+    from .framework import TestFramework as TF
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bootstrap = os.path.join(tmp, "bootstrap.dat")
+        with TF(num_nodes=1) as f:
+            n0 = f.nodes[0]
+            n0.rpc.generatetoaddress(12, ADDR)
+            tip = n0.rpc.getbestblockhash()
+            n0.stop()
+            src = os.path.join(n0.datadir, "regtest", "blocks", "blk00000.dat")
+            shutil.copy(src, bootstrap)
+        with TF(num_nodes=1, extra_args=[[f"-loadblock={bootstrap}"]]) as f:
+            n1 = f.nodes[0]
+            assert n1.rpc.getblockcount() == 12
+            assert n1.rpc.getbestblockhash() == tip
