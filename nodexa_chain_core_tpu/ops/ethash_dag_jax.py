@@ -1,0 +1,210 @@
+"""Ethash/KawPow DAG generation on TPU — the epoch slab built on device.
+
+The reference builds its full dataset with CPU worker threads
+(ref src/crypto/ethash/lib/ethash/managed.cpp; item math in ethash.cpp
+calculate_dataset_item_512) — minutes of host time per epoch.  GPU KawPow
+miners generate the DAG on the accelerator for the same reason we do here:
+item generation is embarrassingly parallel and bounded by random 64-byte
+reads of the 16 MB light cache, which is exactly what an accelerator's
+memory system eats for breakfast.
+
+TPU mapping: the light cache lives on device as a ``(n_light, 16)`` uint32
+slab; a batch of dataset-item indices becomes one device program —
+keccak-f1600 (64-bit lanes emulated as uint32 lo/hi pairs, batched on the
+lane axis), then ``lax.scan`` over the 256 parent rounds, each a row gather
++ elementwise FNV fold.  The host loop stitches launches into the
+``(n2048, 64)`` slab consumed by the ProgPoW verify/search kernels.
+
+Parity anchor: native/src/kawpow.cpp dataset_item_512 (itself cited to the
+reference's ethash.cpp), cross-checked bit-for-bit in
+tests/test_ethash_dag_jax.py against the native engine on real epoch 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import progpow_jax as pj
+
+_U32 = jnp.uint32
+
+FNV_PRIME = 0x01000193
+DATASET_PARENTS = 512  # ProgPoW doubles ethash's 256 (native kawpow.hpp:21)
+
+# keccak-f1600: same pi permutation / rotation table as f800 (progpow_jax),
+# rotations taken mod 64 instead of mod 32; 24 rounds with 64-bit iota RCs.
+_RC64 = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+
+def _rotl64(lo, hi, n: int):
+    n &= 63
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        return (
+            (lo << n) | (hi >> (32 - n)),
+            (hi << n) | (lo >> (32 - n)),
+        )
+    n -= 32
+    return (
+        (hi << n) | (lo >> (32 - n)),
+        (lo << n) | (hi >> (32 - n)),
+    )
+
+
+def keccak_f1600(lo, hi):
+    """24-round permutation over 25 (B,) uint32 lo/hi lane pairs."""
+    lo = list(lo)
+    hi = list(hi)
+    for rc in _RC64:
+        # theta
+        clo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20]
+               for x in range(5)]
+        chi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20]
+               for x in range(5)]
+        for x in range(5):
+            rlo, rhi = _rotl64(clo[(x + 1) % 5], chi[(x + 1) % 5], 1)
+            dlo = clo[(x + 4) % 5] ^ rlo
+            dhi = chi[(x + 4) % 5] ^ rhi
+            for y in range(0, 25, 5):
+                lo[x + y] = lo[x + y] ^ dlo
+                hi[x + y] = hi[x + y] ^ dhi
+        # rho + pi
+        tlo, thi = lo[1], hi[1]
+        for i in range(24):
+            j = pj._KECCAK_PILN[i]
+            nlo, nhi = _rotl64(tlo, thi, pj._KECCAK_ROTC[i])
+            tlo, thi = lo[j], hi[j]
+            lo[j], hi[j] = nlo, nhi
+        # chi
+        for y in range(0, 25, 5):
+            rlo = lo[y : y + 5]
+            rhi = hi[y : y + 5]
+            for x in range(5):
+                lo[y + x] = rlo[x] ^ (~rlo[(x + 1) % 5] & rlo[(x + 2) % 5])
+                hi[y + x] = rhi[x] ^ (~rhi[(x + 1) % 5] & rhi[(x + 2) % 5])
+        # iota
+        lo[0] = lo[0] ^ _U32(rc & 0xFFFFFFFF)
+        hi[0] = hi[0] ^ _U32(rc >> 32)
+    return lo, hi
+
+
+def keccak512_64(words):
+    """Batched keccak-512 of a 64-byte message: (B, 16) u32 -> (B, 16) u32.
+
+    Original-Keccak padding (0x01 / 0x80), rate 72 bytes: the pad block is
+    one constant 64-bit lane at position 8 (bytes 64..71), the rest zero.
+    """
+    b = words.shape[0]
+    zero = jnp.zeros((b,), _U32)
+    lo = [words[:, 2 * k] for k in range(8)]
+    hi = [words[:, 2 * k + 1] for k in range(8)]
+    lo.append(jnp.full((b,), 0x00000001, _U32))
+    hi.append(jnp.full((b,), 0x80000000, _U32))
+    for _ in range(16):
+        lo.append(zero)
+        hi.append(zero)
+    lo, hi = keccak_f1600(lo, hi)
+    out = []
+    for k in range(8):
+        out.append(lo[k])
+        out.append(hi[k])
+    return jnp.stack(out, axis=-1)
+
+
+def _fnv1(u, v):
+    return (u * _U32(FNV_PRIME)) ^ v
+
+
+def dataset_items_512(light, idx):
+    """Batched ethash hash512 items: light (n,16) u32, idx (B,) u32 -> (B,16).
+
+    Mirrors native/src/kawpow.cpp dataset_item_512: seed the mix from
+    light[i % n], keccak512, 256 FNV parent folds, keccak512.
+    """
+    n = light.shape[0]
+    mix = jnp.take(light, (idx % _U32(n)).astype(jnp.int32), axis=0)
+    mix = mix.at[:, 0].set(mix[:, 0] ^ idx)
+    mix = keccak512_64(mix)
+
+    def body(mix, j):
+        word = jnp.take_along_axis(
+            mix, jnp.broadcast_to(jnp.mod(j, 16), (mix.shape[0], 1)), axis=1
+        )[:, 0]
+        t = _fnv1(idx ^ j.astype(_U32), word)
+        parent = jnp.take(light, (t % _U32(n)).astype(jnp.int32), axis=0)
+        return _fnv1(mix, parent), None
+
+    mix, _ = jax.lax.scan(
+        body, mix, jnp.arange(DATASET_PARENTS, dtype=jnp.int32)
+    )
+    return keccak512_64(mix)
+
+
+class DagBuilder:
+    """Builds the (n2048, 64) ProgPoW item slab on device, in launches.
+
+    One 2048-bit ProgPoW item = four consecutive hash512 items (native
+    kawpow.cpp dataset_item_2048), so a launch over ``4 * rows`` hash512
+    indices yields ``rows`` slab rows.
+    """
+
+    def __init__(self, light: np.ndarray):
+        assert light.ndim == 2 and light.shape[1] == 16
+        self.light = jnp.asarray(light, _U32)
+        if jax.default_backend() == "cpu":
+            self._fn = dataset_items_512  # eager: XLA:CPU compile pathology
+        else:
+            self._fn = jax.jit(dataset_items_512)
+
+    @classmethod
+    def from_epoch(cls, epoch: int) -> "DagBuilder":
+        from ..crypto import kawpow
+
+        light = np.frombuffer(
+            kawpow.light_cache(epoch), dtype="<u4"
+        ).reshape(-1, 16).copy()
+        return cls(light)
+
+    def build_rows(self, start_row: int, rows: int) -> np.ndarray:
+        """Slab rows [start_row, start_row+rows) as (rows, 64) u32."""
+        idx = (np.arange(rows * 4, dtype=np.uint32)
+               + np.uint32(start_row * 4))
+        out = self._fn(self.light, jnp.asarray(idx))
+        return np.asarray(out).reshape(rows, 64)
+
+    def build_slab(self, n2048: int, rows_per_launch: int = 16384,
+                   progress=None) -> np.ndarray:
+        slab = np.empty((n2048, 64), np.uint32)
+        done = 0
+        while done < n2048:
+            rows = min(rows_per_launch, n2048 - done)
+            slab[done : done + rows] = self.build_rows(done, rows)
+            done += rows
+            if progress is not None:
+                progress(done, n2048)
+        return slab
+
+
+def build_epoch_slab(epoch: int, rows_per_launch: int = 16384,
+                     progress=None) -> np.ndarray:
+    """Device-built real slab for an epoch (the bench/mining entry point)."""
+    from ..crypto import kawpow
+
+    n2048 = kawpow.full_dataset_num_items(epoch) // 2
+    return DagBuilder.from_epoch(epoch).build_slab(
+        n2048, rows_per_launch, progress
+    )

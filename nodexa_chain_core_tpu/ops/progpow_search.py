@@ -1,0 +1,314 @@
+"""Period-specialized KawPow nonce search on TPU — the mining hot loop.
+
+The reference mines its live era on external GPU miners whose kernels are
+*generated per ProgPoW period*: the host emits CUDA/OpenCL source with that
+period's random-program selectors burned in, compiles it, and launches nonce
+sweeps (ref src/crypto/ethash/lib/ethash/progpow.cpp:15 documents the
+period-seeded program; progpow_kernel generation lives in the miner, not the
+node).  This module is the TPU-native equivalent: the selector plan for ONE
+period (block_number // 3) is replayed host-side into concrete numpy values
+and traced into the XLA graph as **static constants**.
+
+Why that matters vs :class:`..ops.progpow_jax.BatchVerifier` (which keeps the
+plan as traced device arrays so one compile serves every period):
+
+- register moves become static SSA renames — no one-hot scatters,
+- each random_math/random_merge traces only the ONE selected variant —
+  no branch-free ``jnp.where`` chains over 11 ops,
+- merge rotations are literal constants.
+
+The only dynamic memory ops left are the two consensus-mandated gathers
+(16 KiB L1 cache, 256-byte DAG items), which is exactly the memory-hardness
+ProgPoW was designed around.  One compile per (period, batch) — the same
+cost profile as the GPU miners' per-period kernel build — amortized over a
+period's entire nonce space (a period is 3 blocks).
+
+Data layout is ``(LANES, B)``: the 16 ProgPoW lanes ride the sublane axis,
+the nonce batch rides the 128-wide lane axis, so every elementwise op
+vectorizes cleanly and the DAG row gather stays a contiguous 256-byte read
+per nonce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import progpow_ref as ref
+from . import progpow_jax as pj
+
+LANES = ref.NUM_LANES
+REGS = ref.NUM_REGS
+ROUNDS = ref.ROUNDS
+CACHE_ACCESSES = ref.NUM_CACHE_ACCESSES
+MATH_OPS = ref.NUM_MATH_OPS
+L1_WORDS = ref.L1_CACHE_WORDS
+
+_U32 = jnp.uint32
+
+
+def _rotl_c(x, n: int):
+    n &= 31
+    if n == 0:
+        return x
+    return (x << n) | (x >> (32 - n))
+
+
+def _rotr_c(x, n: int):
+    return _rotl_c(x, 32 - (n & 31))
+
+
+def _merge_static(a, b, op: int, rot: int):
+    """random_merge with concrete selector (ref progpow spec merge())."""
+    if op == 0:
+        return a * _U32(33) + b
+    if op == 1:
+        return (a ^ b) * _U32(33)
+    if op == 2:
+        return _rotl_c(a, rot) ^ b
+    return _rotr_c(a, rot) ^ b
+
+
+def _math_static(a, b, op: int):
+    """random_math with concrete selector — only the chosen op is traced."""
+    i32 = jnp.int32
+    if op == 0:
+        return a + b
+    if op == 1:
+        return a * b
+    if op == 2:
+        return pj._mulhi(a, b)
+    if op == 3:
+        return jnp.minimum(a, b)
+    if op == 4:
+        return pj._rotl(a, b)
+    if op == 5:
+        return pj._rotr(a, b)
+    if op == 6:
+        return a & b
+    if op == 7:
+        return a | b
+    if op == 8:
+        return a ^ b
+    if op == 9:
+        return (jax.lax.clz(a.astype(i32)).astype(_U32)
+                + jax.lax.clz(b.astype(i32)).astype(_U32))
+    return (jax.lax.population_count(a.astype(i32)).astype(_U32)
+            + jax.lax.population_count(b.astype(i32)).astype(_U32))
+
+
+def _init_regs(seed_lo, seed_hi):
+    """(B,) seeds -> list of 32 (LANES, B) register planes."""
+    z0 = pj._fnv1a(_U32(pj.FNV_OFFSET), seed_lo)
+    w0 = pj._fnv1a(z0, seed_hi)
+    lanes = jnp.arange(LANES, dtype=_U32)[:, None]  # (16, 1)
+    z = jnp.broadcast_to(z0[None, :], (LANES,) + z0.shape)
+    w = jnp.broadcast_to(w0[None, :], (LANES,) + w0.shape)
+    jsr = pj._fnv1a(w, lanes)
+    jcong = pj._fnv1a(jsr, lanes)
+    st = (z, w, jsr, jcong)
+    regs = []
+    for _ in range(REGS):
+        v, st = pj._kiss99_next(*st)
+        regs.append(v)
+    return regs
+
+
+def _unrolled_mix(regs, plan: pj.PeriodPlan, l1, dag):
+    """The 64 ProgPoW rounds with every selector a Python int.
+
+    regs: list of 32 (LANES, B) u32 planes; returns the (B, 8) digest words.
+    """
+    num_items = dag.shape[0]
+    b = regs[0].shape[1]
+    for r in range(ROUNDS):
+        item_index = jnp.mod(regs[0][r % LANES], _U32(num_items))  # (B,)
+        item = jnp.take(dag, item_index.astype(jnp.int32), axis=0)  # (B, 64)
+        # pre-permute columns so lane l's 4 epilogue words sit at [l, :, 0:4]
+        perm = [((l ^ r) % LANES) * 4 + i for l in range(LANES)
+                for i in range(4)]
+        epi = jnp.moveaxis(
+            item[:, jnp.array(perm, jnp.int32)].reshape(b, LANES, 4), 0, 1
+        )  # (16, B, 4)
+        for i in range(max(CACHE_ACCESSES, MATH_OPS)):
+            if i < CACHE_ACCESSES:
+                src = int(plan.cache_src[r, i])
+                dst = int(plan.cache_dst[r, i])
+                off = jnp.mod(regs[src], _U32(L1_WORDS))
+                data = jnp.take(l1, off.astype(jnp.int32), axis=0)
+                regs[dst] = _merge_static(
+                    regs[dst], data,
+                    int(plan.cache_merge_op[r, i]),
+                    int(plan.cache_merge_rot[r, i]),
+                )
+            if i < MATH_OPS:
+                data = _math_static(
+                    regs[int(plan.math_src1[r, i])],
+                    regs[int(plan.math_src2[r, i])],
+                    int(plan.math_op[r, i]),
+                )
+                dst = int(plan.math_dst[r, i])
+                regs[dst] = _merge_static(
+                    regs[dst], data,
+                    int(plan.math_merge_op[r, i]),
+                    int(plan.math_merge_rot[r, i]),
+                )
+        for i in range(4):
+            dst = int(plan.epi_dst[r, i])
+            regs[dst] = _merge_static(
+                regs[dst], epi[:, :, i],
+                int(plan.epi_merge_op[r, i]),
+                int(plan.epi_merge_rot[r, i]),
+            )
+    # per-lane FNV reduction, cross-lane fold into 8 words (ref spec final)
+    lane_hash = jnp.full((LANES, b), pj.FNV_OFFSET, _U32)
+    for i in range(REGS):
+        lane_hash = pj._fnv1a(lane_hash, regs[i])
+    words = [jnp.full((b,), pj.FNV_OFFSET, _U32) for _ in range(8)]
+    for l in range(LANES):
+        words[l % 8] = pj._fnv1a(words[l % 8], lane_hash[l])
+    return jnp.stack(words, axis=-1)  # (B, 8)
+
+
+def _bswap32(x):
+    return ((x >> 24) | ((x >> 8) & _U32(0xFF00))
+            | ((x << 8) & _U32(0xFF0000)) | (x << 24))
+
+
+def _digest_lte(f, t):
+    """Node-convention boundary check: digest (B, 8) LE-u32 words <= target.
+
+    The node's uint256 value of a progpow digest reads the display-order
+    bytes big-endian (crypto/kawpow.py _from_progpow_bytes), so digest word
+    0 holds the MOST significant bytes, byte-reversed within the word.  `t`
+    is the target pre-swapped host-side (big-endian u32 reads of the
+    display bytes); words compare lexicographically from word 0 down.
+    """
+    lt = jnp.zeros(f.shape[:1], bool)
+    gt = jnp.zeros(f.shape[:1], bool)
+    for w in range(8):
+        fw = _bswap32(f[:, w])
+        lt = lt | (~gt & (fw < t[w]))
+        gt = gt | (~lt & (fw > t[w]))
+    return ~gt
+
+
+def _search_kernel(period: int, batch: int):
+    """Build the jittable sweep fn for one period at one batch size."""
+    plan = pj.build_period_plan(period)
+
+    def sweep(header_words, base_lo, base_hi, target_words, l1, dag):
+        i = jnp.arange(batch, dtype=_U32)
+        nlo = base_lo + i
+        nhi = base_hi + (nlo < base_lo).astype(_U32)
+        state = [jnp.broadcast_to(header_words[k], (batch,))
+                 for k in range(8)]
+        state += [nlo, nhi]
+        state += [jnp.full((batch,), w, _U32) for w in pj._ABSORB_PAD]
+        seed = pj.keccak_f800(state)
+        regs = _init_regs(seed[0], seed[1])
+        mix_words = _unrolled_mix(regs, plan, l1, dag)
+        final = pj._final_absorb(seed, mix_words)
+        ok = _digest_lte(final, target_words)
+        found = jnp.any(ok)
+        win = jnp.argmax(ok)  # first True when found
+        return found, win, final[win], mix_words[win]
+
+    return sweep
+
+
+class SearchKernel:
+    """TPU nonce sweeps for one epoch's device-resident L1 + DAG slab.
+
+    Jitted sweep functions are cached per (period, batch); winner extraction
+    happens on device so each launch ships back one bool + three tiny
+    vectors, never the batch of digests.
+    """
+
+    def __init__(self, l1: np.ndarray, dag: np.ndarray):
+        assert l1.shape == (L1_WORDS,)
+        assert dag.ndim == 2 and dag.shape[1] == 64
+        self.l1 = jnp.asarray(l1, dtype=_U32)
+        self.dag = jnp.asarray(dag, dtype=_U32)
+        self._jit_cache: dict = {}
+
+    @classmethod
+    def from_epoch(cls, epoch: int, threads: int = 0) -> "SearchKernel":
+        from ..crypto import kawpow
+
+        l1 = np.frombuffer(kawpow.l1_cache(epoch), dtype="<u4").copy()
+        dag = kawpow.dataset_slab(epoch, threads=threads)
+        return cls(l1, dag)
+
+    @classmethod
+    def from_verifier(cls, verifier: pj.BatchVerifier) -> "SearchKernel":
+        """Share the verifier's HBM slab — no second DAG copy."""
+        obj = cls.__new__(cls)
+        obj.l1 = verifier.l1
+        obj.dag = verifier.dag
+        obj._jit_cache = {}
+        return obj
+
+    def _fn(self, period: int, batch: int):
+        key = (period, batch)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = _search_kernel(period, batch)
+            # XLA:CPU chokes on the ~17k-op unrolled graph (same pathology
+            # as BatchVerifier / ops/sha256_jax._want_unroll); eager CPU
+            # still runs the identical trace, op by op, which is what the
+            # correctness tests need.  Real backends get the jit.
+            if jax.default_backend() != "cpu":
+                fn = jax.jit(fn)
+            if len(self._jit_cache) > 4:  # periods are transient; cap VMEM
+                self._jit_cache.clear()
+            self._jit_cache[key] = fn
+        return fn
+
+    def sweep(self, header_hash: bytes, height: int, target_le_int: int,
+              start_nonce: int, batch: int):
+        """One device launch over [start_nonce, start_nonce+batch).
+
+        header_hash is display-order bytes (the native engine's convention).
+        Returns (nonce64, final_le_int, mix_le_int) or None.
+        """
+        fn = self._fn(height // ref.PERIOD_LENGTH, batch)
+        hw = jnp.asarray(np.frombuffer(header_hash[:32], dtype="<u4").copy())
+        # target: node LE int -> display bytes -> big-endian u32 words, the
+        # pre-swapped form _digest_lte compares against
+        tw = jnp.asarray(
+            np.frombuffer(
+                target_le_int.to_bytes(32, "little")[::-1], dtype=">u4"
+            ).astype(np.uint32)
+        )
+        found, win, final, mix = fn(
+            hw, _U32(start_nonce & 0xFFFFFFFF),
+            _U32((start_nonce >> 32) & 0xFFFFFFFF), tw, self.l1, self.dag,
+        )
+        if not bool(found):
+            return None
+        nonce = (start_nonce + int(win)) & 0xFFFFFFFFFFFFFFFF
+        # digest LE-word bytes -> node uint256 LE int (display-order read)
+        final_le = int.from_bytes(
+            np.asarray(final).astype("<u4").tobytes()[::-1], "little"
+        )
+        mix_le = int.from_bytes(
+            np.asarray(mix).astype("<u4").tobytes()[::-1], "little"
+        )
+        return nonce, final_le, mix_le
+
+    def search(self, header_hash: bytes, height: int, target_le_int: int,
+               start_nonce: int = 0, batch: int = 16384,
+               max_launches: int = 1) -> Optional[Tuple[int, int, int]]:
+        """Scan `max_launches` consecutive batches; first winner or None."""
+        for k in range(max_launches):
+            hit = self.sweep(
+                header_hash, height, target_le_int,
+                start_nonce + k * batch, batch,
+            )
+            if hit is not None:
+                return hit
+        return None

@@ -1,0 +1,97 @@
+"""Period-specialized KawPow search kernel vs the executable spec.
+
+Same chain of trust as test_progpow_jax: crypto/progpow_ref is validated
+against the native engine + reference ProgPoW vectors; here the unrolled
+search kernel's winners must re-verify bit-for-bit through the spec on a
+synthetic epoch, including the first-winner ordering and the nonce-carry
+across the 32-bit boundary.
+"""
+
+import numpy as np
+import pytest
+
+from nodexa_chain_core_tpu.crypto import progpow_ref as ref
+from nodexa_chain_core_tpu.ops import progpow_search as ps
+
+RNG = np.random.default_rng(0x5EA)
+N_ITEMS = 512
+
+
+@pytest.fixture(scope="module")
+def epoch():
+    l1 = RNG.integers(0, 1 << 32, size=ps.L1_WORDS, dtype=np.uint32)
+    dag = RNG.integers(0, 1 << 32, size=(N_ITEMS, 64), dtype=np.uint32)
+    return l1, dag
+
+
+def _spec_hash(l1, dag, height, header_hash, nonce):
+    def lookup(idx):
+        return dag[idx].astype("<u4").tobytes()
+
+    return ref.kawpow_hash(
+        height, header_hash, nonce, [int(x) for x in l1], N_ITEMS, lookup
+    )
+
+
+def test_first_winner_matches_spec(epoch):
+    l1, dag = epoch
+    kern = ps.SearchKernel(l1, dag)
+    header = bytes((i * 7 + 3) % 256 for i in range(32))
+    height = 99  # period 33
+    target = 1 << 252  # ~1-in-16 per nonce
+    hit = kern.search(header, height, target, start_nonce=0, batch=128)
+    assert hit is not None
+    nonce, final_le, mix_le = hit
+    assert final_le <= target
+    # bit-exact against the spec, and no earlier nonce wins (spec digests
+    # are LE-word bytes; the node value reads display order -> [::-1])
+    for n in range(nonce + 1):
+        want_final, want_mix = _spec_hash(l1, dag, height, header, n)
+        wf = int.from_bytes(want_final[::-1], "little")
+        if n < nonce:
+            assert wf > target, f"kernel skipped winning nonce {n}"
+        else:
+            assert wf == final_le
+            assert int.from_bytes(want_mix[::-1], "little") == mix_le
+
+
+def test_nonce_carry_across_u32_boundary(epoch):
+    l1, dag = epoch
+    kern = ps.SearchKernel(l1, dag)
+    header = bytes((i * 11 + 5) % 256 for i in range(32))
+    height = 4  # period 1
+    start = (1 << 32) - 8
+    hit = kern.search(header, height, 1 << 253, start_nonce=start, batch=64)
+    assert hit is not None
+    nonce, final_le, mix_le = hit
+    assert start <= nonce < start + 64
+    want_final, want_mix = _spec_hash(l1, dag, height, header, nonce)
+    assert int.from_bytes(want_final[::-1], "little") == final_le
+    assert int.from_bytes(want_mix[::-1], "little") == mix_le
+
+
+def test_winner_reverifies_through_batch_verifier(epoch):
+    """Pins the node-convention bridge between the two kernels: a search
+    winner must pass BatchVerifier.verify_headers with the returned
+    mix/final, and fail with a tampered mix."""
+    from nodexa_chain_core_tpu.ops.progpow_jax import BatchVerifier
+
+    l1, dag = epoch
+    kern = ps.SearchKernel(l1, dag)
+    header = bytes((i * 7 + 3) % 256 for i in range(32))
+    height = 99
+    target = 1 << 252
+    nonce, final_le, mix_le = kern.search(header, height, target, batch=128)
+    ver = BatchVerifier(l1, dag)
+    hh = int.from_bytes(header[::-1], "little")  # display bytes -> LE int
+    ok, final2 = ver.verify_headers([(hh, nonce, height, mix_le, target)])[0]
+    assert ok and final2 == final_le
+    bad, _ = ver.verify_headers([(hh, nonce, height, mix_le ^ 1, target)])[0]
+    assert not bad
+
+
+def test_no_winner_returns_none(epoch):
+    l1, dag = epoch
+    kern = ps.SearchKernel(l1, dag)
+    header = bytes(32)
+    assert kern.search(header, 7, 0, start_nonce=0, batch=64) is None
