@@ -184,8 +184,26 @@ def mine_block_cpu(block: Block, schedule, max_tries: int = 1 << 22) -> bool:
     return False
 
 
+def kawpow_verifier_for(node, block: Block):
+    """Ready TPU BatchVerifier for a block's epoch, or None.
+
+    The one era-gate + epoch-lookup policy shared by every device-mining
+    dispatch site (the background miner and generatetoaddress_tpu): a
+    verifier exists only when -tpukawpow prebuilt the epoch's device slab
+    (node/epoch_manager.py) and the block is in the KawPow era.
+    """
+    mgr = getattr(node, "epoch_manager", None)
+    if mgr is None or not node.params.algo_schedule.is_kawpow(
+        block.header.time
+    ):
+        return None
+    from ..crypto.kawpow import epoch_number
+
+    return mgr.verifier(epoch_number(block.header.height))
+
+
 def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
-                   kawpow_verifier=None) -> bool:
+                   kawpow_verifier=None, batch: int = 2048) -> bool:
     """Accelerated nonce search by era (the reference's live-era analogue
     is the external GPU miner via getblocktemplate).
 
@@ -204,7 +222,7 @@ def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
         for b in range(max_batches):
             found = kawpow_verifier.search(
                 header_hash, block.header.height, target,
-                start_nonce=b * 2048, batch=2048,
+                start_nonce=b * batch, batch=batch,
             )
             if found is not None:
                 block.header.nonce64 = found[0]

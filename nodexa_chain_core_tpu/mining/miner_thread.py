@@ -70,6 +70,23 @@ class BackgroundMiner:
         kid = wallet.get_keyid_for_mining()
         return p2pkh_script(KeyID(kid)).raw if kid else None
 
+    def _search_slice(self, block) -> bool:
+        """One nonce slice, era-aware: the TPU batched KawPow search when a
+        device slab is ready (ref the external GPU miners driving the live
+        era), else the native CPU scans (ref GenerateClores' inner loop)."""
+        from .assembler import kawpow_verifier_for, mine_block_tpu
+
+        verifier = kawpow_verifier_for(self.node, block)
+        if verifier is not None:
+            return mine_block_tpu(
+                block, self.node.params.algo_schedule,
+                max_batches=max(1, SLICE_TRIES // 2048),
+                kawpow_verifier=verifier,
+            )
+        return mine_block_cpu(
+            block, self.node.params.algo_schedule, max_tries=SLICE_TRIES
+        )
+
     def _count(self, n: int) -> None:
         if self._stop.is_set():
             return  # never overwrite the rate stop() just zeroed
@@ -106,9 +123,7 @@ class BackgroundMiner:
                 extra += 1
                 asm = BlockAssembler(node.chainstate)
                 block = asm.create_new_block(spk, extra_nonce=extra)
-                found = mine_block_cpu(
-                    block, params.algo_schedule, max_tries=SLICE_TRIES
-                )
+                found = self._search_slice(block)
                 self._count(SLICE_TRIES if not found else SLICE_TRIES // 2)
                 if self._stop.is_set():
                     return

@@ -332,30 +332,56 @@ _KECCAK_RC = [
 ]
 
 
+# rho+pi as one static permutation + per-lane rotation: the serial walk
+# (t = s[1]; s[PILN[i]] = rotl(t_prev, ROTC[i])) assigns lane PILN[i] from
+# the OLD lane PILN[i-1] (with PILN[-1] := 1); lane 0 is untouched.
+def _rho_pi_tables():
+    src = [0] * 25
+    rot = [0] * 25
+    prev = 1
+    for i in range(24):
+        dst = _KECCAK_PILN[i]
+        src[dst] = prev
+        rot[dst] = _KECCAK_ROTC[i]
+        prev = dst
+    return src, rot
+
+
+_RHO_PI_SRC, _RHO_PI_ROT = _rho_pi_tables()
+
+
 def keccak_f800(state):
-    """state: list of 25 (B,) uint32 arrays -> new list (in place semantics)."""
-    s = list(state)
-    for rc in _KECCAK_RC:
+    """state: list of 25 (B,) uint32 arrays -> new list (in place semantics).
+
+    Tensor form: the 25 lanes stack to one (25, B) array and the 22 rounds
+    run as ``lax.scan`` with the iota constants as xs — one theta/rho+pi/
+    chi/iota round is ~25 tensor ops instead of ~150 per-lane ones, which
+    keeps both XLA:CPU compiles (whose scheduler degenerates on the long
+    unrolled scalar chains, see BatchVerifier.__init__) and eager dispatch
+    counts small.  Permutation/rotation amounts are static vectors.
+    """
+    s = jnp.stack(state)  # (25, B)
+    src = jnp.asarray(_RHO_PI_SRC, jnp.int32)
+    rot = jnp.asarray(_RHO_PI_ROT, jnp.uint32).reshape(25, *([1] * (s.ndim - 1)))
+
+    def round_(s, rc):
         # theta
-        c = [s[x] ^ s[x + 5] ^ s[x + 10] ^ s[x + 15] ^ s[x + 20]
-             for x in range(5)]
-        for x in range(5):
-            d = c[(x + 4) % 5] ^ _rotl(c[(x + 1) % 5], 1)
-            for y in range(0, 25, 5):
-                s[x + y] = s[x + y] ^ d
-        # rho + pi
-        t = s[1]
-        for i in range(24):
-            j = _KECCAK_PILN[i]
-            t, s[j] = s[j], _rotl(t, _KECCAK_ROTC[i])
-        # chi
-        for y in range(0, 25, 5):
-            row = s[y : y + 5]
-            for x in range(5):
-                s[y + x] = row[x] ^ (~row[(x + 1) % 5] & row[(x + 2) % 5])
+        rows5 = s.reshape(5, 5, *s.shape[1:])
+        c = rows5[0] ^ rows5[1] ^ rows5[2] ^ rows5[3] ^ rows5[4]
+        d = jnp.roll(c, 1, axis=0) ^ _rotl(jnp.roll(c, -1, axis=0), 1)
+        s = s ^ jnp.tile(d, (5,) + (1,) * (d.ndim - 1))
+        # rho + pi (static gather + vector rotation)
+        s = _rotl(jnp.take(s, src, axis=0), rot)
+        # chi (within each row of 5)
+        rows = s.reshape(5, 5, *s.shape[1:])
+        s = (rows ^ (~jnp.roll(rows, -1, axis=1) & jnp.roll(rows, -2, axis=1))
+             ).reshape(s.shape)
         # iota
-        s[0] = s[0] ^ _U32(rc)
-    return s
+        s = s.at[0].set(s[0] ^ rc)
+        return s, None
+
+    s, _ = jax.lax.scan(round_, s, jnp.asarray(_KECCAK_RC, jnp.uint32))
+    return [s[i] for i in range(25)]
 
 
 _ABSORB_PAD = [int(c) for c in ref.ABSORB_PAD]
@@ -523,6 +549,66 @@ def kawpow_hash_batch(header_words, nonce_lo, nonce_hi, plans, pidx, l1, dag):
     return final, mix_words
 
 
+def _bswap32(x):
+    return ((x >> 24) | ((x >> 8) & _U32(0xFF00))
+            | ((x << 8) & _U32(0xFF0000)) | (x << 24))
+
+
+def digest_lte(final, target_words):
+    """Node-convention boundary check: digest (B, 8) LE-u32 words <= target.
+
+    The node's uint256 value of a progpow digest reads the display-order
+    bytes big-endian (crypto/kawpow.py _from_progpow_bytes), so digest
+    word 0 holds the MOST significant bytes, byte-reversed within the
+    word.  ``target_words`` must come from :func:`target_swapped_words`;
+    words compare lexicographically from word 0 down.  Shared by both
+    search kernels (this module and ops/progpow_search) — the boundary
+    rule is consensus-critical and must exist exactly once.
+    """
+    lt = jnp.zeros(final.shape[:1], bool)
+    gt = jnp.zeros(final.shape[:1], bool)
+    for w in range(8):
+        fw = _bswap32(final[:, w])
+        lt = lt | (~gt & (fw < target_words[w]))
+        gt = gt | (~lt & (fw > target_words[w]))
+    return ~gt
+
+
+def target_swapped_words(target_le_int: int) -> np.ndarray:
+    """Host prep for digest_lte: node LE target int -> display bytes ->
+    big-endian u32 reads (the pre-swapped compare form)."""
+    return np.frombuffer(
+        target_le_int.to_bytes(32, "little")[::-1], dtype=">u4"
+    ).astype(np.uint32)
+
+
+def digest_words_to_le_int(words) -> int:
+    """Device digest (8,) LE-u32 words -> node uint256 LE int."""
+    return int.from_bytes(
+        np.asarray(words).astype("<u4").tobytes()[::-1], "little"
+    )
+
+
+def kawpow_search_batch(header_words, nonce_lo, nonce_hi, plans, pidx,
+                        target_words, l1, dag):
+    """hash_batch + on-device boundary check and winner reduction.
+
+    The miner's inner loop: unlike the per-period unrolled kernel in
+    ops/progpow_search.py (max throughput, but an XLA compile per period),
+    this traces the plan as data, so ONE compile serves every period — the
+    right trade for live mining where a period lasts only 3 blocks.
+    Returns (found, win_index, final_words, mix_words) — scalars + two
+    8-vectors; the digest batch never leaves the device.
+    """
+    final, mix_words = kawpow_hash_batch(
+        header_words, nonce_lo, nonce_hi, plans, pidx, l1, dag
+    )
+    ok = digest_lte(final, target_words)
+    found = jnp.any(ok)
+    win = jnp.argmax(ok)
+    return found, win, final[win], mix_words[win]
+
+
 # ------------------------------------------------------------- public API
 
 
@@ -534,32 +620,69 @@ class BatchVerifier:
     synthetic slabs (cross-validated against crypto.progpow_ref).
     """
 
-    def __init__(self, l1: np.ndarray, dag: np.ndarray):
+    def __init__(self, l1: np.ndarray, dag: np.ndarray, mesh=None):
         assert l1.shape == (L1_WORDS,)
         assert dag.ndim == 2 and dag.shape[1] == 64
         self.l1 = jnp.asarray(l1, dtype=_U32)
         self.dag = jnp.asarray(dag, dtype=_U32)
+        self.mesh = mesh
         self._plan_cache: dict = {}
-        # XLA:CPU's compile time explodes on the whole-graph jit (same
-        # pathology as ops/sha256_jax._want_unroll); eager still compiles
-        # the scan body once, which is where nearly all the work is.
-        if jax.default_backend() == "cpu":
-            self._jit = kawpow_hash_batch
-        else:
-            self._jit = jax.jit(kawpow_hash_batch)
+        # jit everywhere, XLA:CPU included: with keccak_f800 in tensor/scan
+        # form the whole-graph CPU compile is ~1 min per shape bucket and
+        # steady-state batches run ~400x faster than the eager dispatch
+        # loop (the r1/r2 eager-on-cpu fallback predated that fix; the old
+        # unrolled per-lane keccak was what made XLA:CPU choke).
+        hash_fn = kawpow_hash_batch
+        if mesh is not None:
+            hash_fn = self._shard_over_mesh(mesh)
+        self._jit = jax.jit(hash_fn)
+        self._jit_search = jax.jit(kawpow_search_batch)
+
+    @staticmethod
+    def _shard_over_mesh(mesh):
+        """Mesh-parallel verification: headers ride the flattened device
+        axes, the epoch data (L1 + DAG slab) is replicated per chip.
+
+        Replication is the bandwidth-right layout: every header touches 64
+        pseudo-random slab rows, so a sharded slab would turn each access
+        into a remote lookup over ICI; one HBM-resident copy per chip (1-2
+        GB of 16) keeps every gather local, and the only cross-chip work is
+        the batch scatter/digest gather at the jit boundary.  Header
+        batches are pure maps, so shard_map needs no collectives.
+        """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+        b1 = P(axes)  # 1D: batch over every mesh axis
+        b2 = P(axes, None)
+        plan_spec = PeriodPlan(*([P()] * len(PeriodPlan._fields)))
+        return shard_map(
+            kawpow_hash_batch,
+            mesh=mesh,
+            in_specs=(b2, b1, b1, plan_spec, b1, P(), P()),
+            out_specs=(b2, b2),
+        )
 
     @classmethod
     def from_epoch(cls, epoch: int, threads: int = 0) -> "BatchVerifier":
         """Device-resident verifier for a real epoch (builds the DAG slab).
 
-        Slab build is CPU-threaded native work (~minutes per epoch, done
-        once); the result lives in HBM so every subsequent HEADERS batch
-        verifies as one device program.
+        On a real accelerator the slab itself is generated on device
+        (ops/ethash_dag_jax, ~3.5 min for epoch 0 on v5e vs ~16 min for
+        one host core); the XLA:CPU backend falls back to the native
+        CPU-threaded build.  Either way the result lives in HBM so every
+        subsequent HEADERS batch verifies as one device program.
         """
         from ..crypto import kawpow
 
         l1 = np.frombuffer(kawpow.l1_cache(epoch), dtype="<u4").copy()
-        dag = kawpow.dataset_slab(epoch, threads=threads)
+        if jax.default_backend() != "cpu":
+            from .ethash_dag_jax import build_epoch_slab
+
+            dag = build_epoch_slab(epoch)
+        else:
+            dag = kawpow.dataset_slab(epoch, threads=threads)
         return cls(l1, dag)
 
     def verify_headers(self, entries):
@@ -584,9 +707,9 @@ class BatchVerifier:
 
     # Shape buckets: every distinct (batch, periods) shape pair costs a
     # fresh XLA compile (~minutes on TPU), so batches and period tables are
-    # padded to one of two fixed sizes — small (mining/tests) and the
-    # 2000-header HEADERS-message sync shape.
-    _BATCH_BUCKETS = (64, 2048)
+    # padded to fixed sizes — small (mining/tests), the 2000-header
+    # HEADERS-message sync shape, and a deep mining sweep.
+    _BATCH_BUCKETS = (64, 2048, 32768)
     _PERIOD_BUCKETS = (32, 688)
 
     @staticmethod
@@ -596,29 +719,69 @@ class BatchVerifier:
                 return b
         raise ValueError(f"batch of {n} exceeds the largest bucket")
 
+    def _plans_padded(self, periods, bb):
+        """Device plan table (padded to a period bucket) + per-entry index.
+
+        `periods` may be shorter than `bb`; padding entries index plan row
+        0, which is harmless (their results are ignored or re-scanned).
+        """
+        uniq = tuple(sorted(set(periods)))
+        pb = self._bucket(len(uniq), self._PERIOD_BUCKETS)
+        key = (uniq, pb)
+        plans = self._plan_cache.get(key)
+        if plans is None:
+            padded = uniq + (uniq[-1],) * (pb - len(uniq))
+            plans = PeriodPlan(
+                *[jnp.asarray(f) for f in plans_for_periods(padded)]
+            )
+            if len(self._plan_cache) > 8:
+                self._plan_cache.clear()
+            self._plan_cache[key] = plans
+        lut = {p: i for i, p in enumerate(uniq)}
+        pidx = np.zeros(bb, np.int32)
+        for i, p in enumerate(periods):
+            pidx[i] = lut[p]
+        return plans, pidx
+
     def search(self, header_hash: bytes, height: int, target_le_int: int,
                start_nonce: int = 0, batch: int = 2048):
         """TPU nonce scan for KawPow mining: hash `batch` consecutive
-        nonces of one header as a single device program and return
-        (nonce64, final_le_int, mix_le_int) of the first winner, or None.
+        nonces of one header as a single device program with the boundary
+        check and winner reduction on device (kawpow_search_batch), and
+        return (nonce64, final_le_int, mix_le_int) of a winner, or None.
 
         The reference's live-era mining happens on external GPU miners via
         getblocktemplate; this is the TPU-native equivalent of that inner
-        loop (same math as verification — ProgPoW is symmetric).
+        loop (same math as verification — ProgPoW is symmetric).  For
+        sustained single-period sweeps, ops/progpow_search.SearchKernel
+        trades a per-period compile for higher steady throughput.
         """
-        nonces = [start_nonce + i for i in range(batch)]
-        finals, mixes = self.hash_batch(
-            [header_hash] * batch, nonces, [height] * batch
+        bb = self._bucket(batch, self._BATCH_BUCKETS)
+        hw8 = np.frombuffer(header_hash[:32], dtype="<u4")
+        hw = np.broadcast_to(hw8, (bb, 8))
+        # bucket padding repeats the LAST requested nonce so coverage stays
+        # exactly [start_nonce, start_nonce + batch) — a pad winner is a
+        # duplicate of a real candidate, never a nonce past the range the
+        # caller will advance over
+        nonces = (np.uint64(start_nonce)
+                  + np.minimum(np.arange(bb, dtype=np.uint64), batch - 1))
+        nlo = (nonces & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        nhi = (nonces >> np.uint64(32)).astype(np.uint32)
+        plans, pidx = self._plans_padded(
+            [height // ref.PERIOD_LENGTH] * batch, bb
         )
-        for i in range(batch):
-            final_le = int.from_bytes(finals[i][::-1], "little")
-            if final_le <= target_le_int:
-                return (
-                    nonces[i],
-                    final_le,
-                    int.from_bytes(mixes[i][::-1], "little"),
-                )
-        return None
+        tw = target_swapped_words(target_le_int)
+        found, win, final, mix = self._jit_search(
+            jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
+            jnp.asarray(pidx), jnp.asarray(tw), self.l1, self.dag,
+        )
+        if not bool(found):
+            return None
+        return (
+            int(nonces[int(win)]),
+            digest_words_to_le_int(final),
+            digest_words_to_le_int(mix),
+        )
 
     def hash_batch(self, header_hashes, nonces, heights):
         """header_hashes: list of 32-byte hashes; nonces/heights: ints.
@@ -637,22 +800,7 @@ class BatchVerifier:
             nlo[i] = n & 0xFFFFFFFF
             nhi[i] = (n >> 32) & 0xFFFFFFFF
         periods = [h // ref.PERIOD_LENGTH for h in heights]
-        uniq = tuple(sorted(set(periods)))
-        pb = self._bucket(len(uniq), self._PERIOD_BUCKETS)
-        key = (uniq, pb)
-        plans = self._plan_cache.get(key)
-        if plans is None:
-            padded = uniq + (uniq[-1],) * (pb - len(uniq))
-            plans = PeriodPlan(
-                *[jnp.asarray(f) for f in plans_for_periods(padded)]
-            )
-            if len(self._plan_cache) > 8:
-                self._plan_cache.clear()
-            self._plan_cache[key] = plans
-        lut = {p: i for i, p in enumerate(uniq)}
-        pidx = np.zeros(bb, np.int32)
-        for i, p in enumerate(periods):
-            pidx[i] = lut[p]
+        plans, pidx = self._plans_padded(periods, bb)
         final, mix = self._jit(
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), self.l1, self.dag,

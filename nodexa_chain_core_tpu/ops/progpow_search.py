@@ -173,29 +173,6 @@ def _unrolled_mix(regs, plan: pj.PeriodPlan, l1, dag):
     return jnp.stack(words, axis=-1)  # (B, 8)
 
 
-def _bswap32(x):
-    return ((x >> 24) | ((x >> 8) & _U32(0xFF00))
-            | ((x << 8) & _U32(0xFF0000)) | (x << 24))
-
-
-def _digest_lte(f, t):
-    """Node-convention boundary check: digest (B, 8) LE-u32 words <= target.
-
-    The node's uint256 value of a progpow digest reads the display-order
-    bytes big-endian (crypto/kawpow.py _from_progpow_bytes), so digest word
-    0 holds the MOST significant bytes, byte-reversed within the word.  `t`
-    is the target pre-swapped host-side (big-endian u32 reads of the
-    display bytes); words compare lexicographically from word 0 down.
-    """
-    lt = jnp.zeros(f.shape[:1], bool)
-    gt = jnp.zeros(f.shape[:1], bool)
-    for w in range(8):
-        fw = _bswap32(f[:, w])
-        lt = lt | (~gt & (fw < t[w]))
-        gt = gt | (~lt & (fw > t[w]))
-    return ~gt
-
-
 def _search_kernel(period: int, batch: int):
     """Build the jittable sweep fn for one period at one batch size."""
     plan = pj.build_period_plan(period)
@@ -212,7 +189,7 @@ def _search_kernel(period: int, batch: int):
         regs = _init_regs(seed[0], seed[1])
         mix_words = _unrolled_mix(regs, plan, l1, dag)
         final = pj._final_absorb(seed, mix_words)
-        ok = _digest_lte(final, target_words)
+        ok = pj.digest_lte(final, target_words)
         found = jnp.any(ok)
         win = jnp.argmax(ok)  # first True when found
         return found, win, final[win], mix_words[win]
@@ -237,11 +214,10 @@ class SearchKernel:
 
     @classmethod
     def from_epoch(cls, epoch: int, threads: int = 0) -> "SearchKernel":
-        from ..crypto import kawpow
-
-        l1 = np.frombuffer(kawpow.l1_cache(epoch), dtype="<u4").copy()
-        dag = kawpow.dataset_slab(epoch, threads=threads)
-        return cls(l1, dag)
+        """Delegates the slab build to BatchVerifier.from_epoch (device
+        DAG builder on real backends, native threads on cpu) and shares
+        its HBM arrays."""
+        return cls.from_verifier(pj.BatchVerifier.from_epoch(epoch, threads))
 
     @classmethod
     def from_verifier(cls, verifier: pj.BatchVerifier) -> "SearchKernel":
@@ -257,10 +233,12 @@ class SearchKernel:
         fn = self._jit_cache.get(key)
         if fn is None:
             fn = _search_kernel(period, batch)
-            # XLA:CPU chokes on the ~17k-op unrolled graph (same pathology
-            # as BatchVerifier / ops/sha256_jax._want_unroll); eager CPU
-            # still runs the identical trace, op by op, which is what the
-            # correctness tests need.  Real backends get the jit.
+            # XLA:CPU cannot digest the ~17k-op unrolled mix (its scheduler
+            # degenerates on long static chains — the scan-based kernels in
+            # progpow_jax jit fine there after the keccak tensor rewrite,
+            # but this kernel's whole point is the unroll).  Eager CPU runs
+            # the identical trace op-by-op, which is what the correctness
+            # tests need; real backends get the jit.
             if jax.default_backend() != "cpu":
                 fn = jax.jit(fn)
             if len(self._jit_cache) > 4:  # periods are transient; cap VMEM
@@ -277,13 +255,7 @@ class SearchKernel:
         """
         fn = self._fn(height // ref.PERIOD_LENGTH, batch)
         hw = jnp.asarray(np.frombuffer(header_hash[:32], dtype="<u4").copy())
-        # target: node LE int -> display bytes -> big-endian u32 words, the
-        # pre-swapped form _digest_lte compares against
-        tw = jnp.asarray(
-            np.frombuffer(
-                target_le_int.to_bytes(32, "little")[::-1], dtype=">u4"
-            ).astype(np.uint32)
-        )
+        tw = jnp.asarray(pj.target_swapped_words(target_le_int))
         found, win, final, mix = fn(
             hw, _U32(start_nonce & 0xFFFFFFFF),
             _U32((start_nonce >> 32) & 0xFFFFFFFF), tw, self.l1, self.dag,
@@ -291,14 +263,11 @@ class SearchKernel:
         if not bool(found):
             return None
         nonce = (start_nonce + int(win)) & 0xFFFFFFFFFFFFFFFF
-        # digest LE-word bytes -> node uint256 LE int (display-order read)
-        final_le = int.from_bytes(
-            np.asarray(final).astype("<u4").tobytes()[::-1], "little"
+        return (
+            nonce,
+            pj.digest_words_to_le_int(final),
+            pj.digest_words_to_le_int(mix),
         )
-        mix_le = int.from_bytes(
-            np.asarray(mix).astype("<u4").tobytes()[::-1], "little"
-        )
-        return nonce, final_le, mix_le
 
     def search(self, header_hash: bytes, height: int, target_le_int: int,
                start_nonce: int = 0, batch: int = 16384,

@@ -54,16 +54,11 @@ def generatetoaddress_tpu(node, params: List[Any]):
     spk = script_for_destination(dest)
     hashes = []
     asm = BlockAssembler(node.chainstate)
-    mgr = getattr(node, "epoch_manager", None)
+    from ..mining.assembler import kawpow_verifier_for
+
     for _ in range(nblocks):
         block = asm.create_new_block(spk.raw)
-        verifier = None
-        if mgr is not None and node.params.algo_schedule.is_kawpow(
-            block.header.time
-        ):
-            from ..crypto.kawpow import epoch_number
-
-            verifier = mgr.verifier(epoch_number(block.header.height))
+        verifier = kawpow_verifier_for(node, block)
         if not mine_block_tpu(
             block, node.params.algo_schedule, kawpow_verifier=verifier
         ):

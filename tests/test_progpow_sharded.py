@@ -1,0 +1,90 @@
+"""Mesh-sharded KawPow batch verification on the virtual 8-device mesh.
+
+The sharded verifier must (a) produce bit-identical results to the
+single-device kernel, (b) actually partition the header batch across every
+device of a 2x4 mesh with the epoch slab replicated — the layout argued in
+BatchVerifier._shard_over_mesh (each header touches 64 pseudo-random slab
+rows; a sharded slab would make every gather a remote ICI lookup).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nodexa_chain_core_tpu.ops import progpow_jax as pj
+
+RNG = np.random.default_rng(0x5AD)
+N_ITEMS = 512
+
+
+@pytest.fixture(scope="module")
+def epoch():
+    l1 = RNG.integers(0, 1 << 32, size=pj.L1_WORDS, dtype=np.uint32)
+    dag = RNG.integers(0, 1 << 32, size=(N_ITEMS, 64), dtype=np.uint32)
+    return l1, dag
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest should provide 8 virtual devices"
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("header", "lane"))
+
+
+def test_sharded_matches_single_device(epoch, mesh):
+    l1, dag = epoch
+    plain = pj.BatchVerifier(l1, dag)
+    sharded = pj.BatchVerifier(l1, dag, mesh=mesh)
+    headers = [bytes((i + j) % 256 for j in range(32)) for i in range(10)]
+    nonces = [i * 7919 for i in range(10)]
+    heights = [100 + i for i in range(10)]  # several periods in one batch
+    f0, m0 = plain.hash_batch(headers, nonces, heights)
+    f1, m1 = sharded.hash_batch(headers, nonces, heights)
+    assert f0 == f1
+    assert m0 == m1
+
+
+def test_batch_actually_spans_all_devices(epoch, mesh):
+    """Pin the sharding itself, not just the math: inputs laid out with the
+    verifier's specs must place a distinct batch shard on each of the 8
+    devices, with the DAG slab replicated everywhere."""
+    l1, dag = epoch
+    b1 = P(("header", "lane"))
+    hw = jax.device_put(
+        np.zeros((64, 8), np.uint32), NamedSharding(mesh, P(("header", "lane"), None))
+    )
+    assert len(hw.sharding.device_set) == 8
+    shard_rows = {s.index[0] for s in hw.addressable_shards}
+    assert len(shard_rows) == 8, "batch axis is not split 8 ways"
+
+    slab = jax.device_put(dag, NamedSharding(mesh, P()))
+    assert len(slab.sharding.device_set) == 8
+    assert all(
+        s.data.shape == dag.shape for s in slab.addressable_shards
+    ), "DAG slab must be fully replicated per device"
+
+
+def test_sharded_verify_headers_entry_point(epoch, mesh):
+    """verify_headers through the sharded path accepts/rejects correctly."""
+    from nodexa_chain_core_tpu.crypto import progpow_ref as ref
+
+    l1, dag = epoch
+    sharded = pj.BatchVerifier(l1, dag, mesh=mesh)
+    header = bytes((i * 3 + 1) % 256 for i in range(32))
+    height, nonce = 77, 0xBEEF
+
+    def lookup(idx):
+        return dag[idx].astype("<u4").tobytes()
+
+    want_final, want_mix = ref.kawpow_hash(
+        height, header, nonce, [int(x) for x in l1], N_ITEMS, lookup
+    )
+    hh = int.from_bytes(header[::-1], "little")
+    mix_le = int.from_bytes(want_mix[::-1], "little")
+    final_le = int.from_bytes(want_final[::-1], "little")
+    ok, final = sharded.verify_headers([(hh, nonce, height, mix_le, 1 << 256)])[0]
+    assert ok and final == final_le
+    bad, _ = sharded.verify_headers([(hh, nonce, height, mix_le ^ 2, 1 << 256)])[0]
+    assert not bad

