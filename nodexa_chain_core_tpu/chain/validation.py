@@ -201,6 +201,16 @@ class ChainState:
                     )
                 else:
                     idx.chain_tx_count = 0
+                    # data-present blocks parked behind a data-less ancestor
+                    # must re-enter the unlinked map, or the cascade in
+                    # process_new_block never finds them when the ancestor's
+                    # data finally arrives and the branch stalls until
+                    # -reindex (ref LoadBlockIndex rebuilding
+                    # mapBlocksUnlinked, validation.cpp:12439)
+                    if has_or_had_data and idx.prev is not None:
+                        self._blocks_unlinked.setdefault(
+                            idx.header.hash_prev, []
+                        ).append(idx)
             tip_hash = self.blocktree.read_tip()
             if tip_hash is not None and tip_hash in self.block_index:
                 self.active.set_tip(self.block_index[tip_hash])
@@ -1129,6 +1139,11 @@ class ChainState:
             if (
                 entry.is_valid(BlockStatus.VALID_TRANSACTIONS)
                 and entry.status & BlockStatus.HAVE_DATA
+                # same nChainTx candidacy gate as process_new_block and
+                # _load_or_init: a data-incomplete ancestor chain must not
+                # re-enter the candidate set, or activate_best_chain spins
+                # on the no-data fallback and strips this entry's HAVE_DATA
+                and entry.chain_tx_count > 0
             ):
                 self.candidates.add(entry)
 

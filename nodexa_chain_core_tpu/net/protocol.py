@@ -52,9 +52,10 @@ MSG_SENDCMPCT = "sendcmpct"
 MSG_CMPCTBLOCK = "cmpctblock"
 MSG_GETBLOCKTXN = "getblocktxn"
 MSG_BLOCKTXN = "blocktxn"
-# asset wire messages (ref protocol.h:252-266)
-MSG_GETASSETDATA = "getasstdata"
-MSG_ASSETDATA = "asstdata"
+# asset wire messages (ref protocol.cpp:45-47: "getassetdata"/"assetdata"
+# but — reference quirk — the not-found reply really is "asstnotfound")
+MSG_GETASSETDATA = "getassetdata"
+MSG_ASSETDATA = "assetdata"
 MSG_ASSETNOTFOUND = "asstnotfound"
 
 # inventory types (ref protocol.h GetDataMsg)

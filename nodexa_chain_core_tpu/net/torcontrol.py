@@ -298,7 +298,11 @@ class TorController:
             conn.command(f"AUTHENTICATE {cookie.hex()}")
             return
         if "HASHEDPASSWORD" in methods and self.password:
-            conn.command(f'AUTHENTICATE "{self.password}"')
+            # quoted-string escaping per the control-port spec (ref
+            # torcontrol.cpp): backslashes and quotes in -torpassword
+            # would otherwise truncate or malform the command
+            quoted = self.password.replace("\\", "\\\\").replace('"', '\\"')
+            conn.command(f'AUTHENTICATE "{quoted}"')
             return
         raise TorControlError(f"no usable auth method in {methods}")
 

@@ -650,7 +650,10 @@ class BatchVerifier:
         the batch scatter/digest gather at the jit boundary.  Header
         batches are pure maps, so shard_map needs no collectives.
         """
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         axes = tuple(mesh.axis_names)

@@ -18,6 +18,7 @@ from .server import (
     RPC_DESERIALIZATION_ERROR,
     RPC_INVALID_ADDRESS_OR_KEY,
     RPC_INVALID_PARAMETER,
+    RPC_INVALID_PARAMS,
     RPC_MISC_ERROR,
     RPCError,
     RPCTable,
@@ -149,7 +150,15 @@ def getblocktemplate(node, params: List[Any]):
             _tip_waiter.wait_for_new_tip(node, old_tip, timeout=50.0)
     tip = cs.tip()
     asm = BlockAssembler(cs)
-    block = asm.create_new_block(b"\x6a", ntime=int(time.time()))  # placeholder cb
+    # -miningaddress (ref gArgs "-miningaddress", mining.cpp:724): with it
+    # the template's coinbase is final and the KawPow pprpc handshake can
+    # hand external miners a ready-to-mine header hash; without it the
+    # coinbase is a placeholder the pool replaces
+    mining_spk = _mining_address_script(node)
+    block = asm.create_new_block(
+        mining_spk if mining_spk is not None else b"\x6a",
+        ntime=int(time.time()),
+    )
     target, _, _ = bits_to_target(block.header.bits)
     txs = []
     for i, tx in enumerate(block.vtx[1:], start=1):
@@ -162,7 +171,7 @@ def getblocktemplate(node, params: List[Any]):
                 "fee": node.mempool.get(tx.txid).fee if node.mempool.get(tx.txid) else 0,
             }
         )
-    return {
+    result = {
         "version": block.header.version,
         "previousblockhash": u256_hex(tip.block_hash),
         "transactions": txs,
@@ -176,6 +185,137 @@ def getblocktemplate(node, params: List[Any]):
         "noncerange": "00000000ffffffff",
         "longpollid": f"{tip.block_hash:064x}-{len(node.mempool.txids())}",
     }
+    # KawPow pool-mining handshake (ref mining.cpp:723-740): stash the
+    # full template keyed by its progpow header hash and surface
+    # pprpcheader/pprpcepoch so external miners can mine via pprpcsb.
+    # A template younger than 30 s is re-served (ref lastheader reuse).
+    sched = node.params.algo_schedule
+    if mining_spk is not None and sched.is_kawpow(block.header.time):
+        from ..crypto.kawpow import epoch_number
+
+        templates = node.__dict__.setdefault("kawpow_templates", {})
+        last_hex = getattr(node, "kawpow_last_pprpc_header", "")
+        last_blk = templates.get(last_hex)
+        # reuse only while it still builds on the CURRENT tip — an age-only
+        # check would hand miners a superseded template for 30 s after
+        # every block (the reference regenerates per CreateNewBlock cache,
+        # which is tip-keyed)
+        if (
+            last_blk is not None
+            and last_blk.header.hash_prev == tip.block_hash
+            and block.header.time - 30 < last_blk.header.time
+        ):
+            result["pprpcheader"] = last_hex
+            result["pprpcepoch"] = epoch_number(tip.height + 1)
+            return result
+        hh_hex = block.header.kawpow_header_hash(sched)[::-1].hex()
+        result["pprpcheader"] = hh_hex
+        result["pprpcepoch"] = epoch_number(tip.height + 1)
+        if len(templates) > 64:  # bounded (ref clears on tip change)
+            templates.clear()
+        templates[hh_hex] = block
+        node.kawpow_last_pprpc_header = hh_hex
+    return result
+
+
+def _mining_address_script(node):
+    """scriptPubKey for -miningaddress, or None (ref mining.cpp:724-726)."""
+    from ..utils.args import g_args
+
+    addr = g_args.get("miningaddress", "")
+    if not addr:
+        return None
+    try:
+        return script_for_destination(
+            decode_destination(str(addr), node.params)
+        ).raw
+    except Exception:
+        return None
+
+
+def getkawpowhash(node, params: List[Any]):
+    """KawPow hash check for pool/miner RPC clients (ref mining.cpp:763).
+
+    params: header_hash hex, mix_hash hex, nonce hex, height, [target hex].
+    Returns result/digest/mix_hash (+meets_target when a target is given).
+    """
+    if len(params) < 4:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "header_hash, mix_hash, nonce, height required")
+    from ..crypto import kawpow
+
+    try:
+        nonce = int(str(params[2]).removeprefix("0x"), 16)
+    except ValueError:
+        raise RPCError(RPC_INVALID_PARAMS, "Invalid nonce hex string")
+    height = int(params[3])
+    tip = node.chainstate.tip()
+    if height > tip.height + 10:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, "Block height is to large")
+    header_hash = u256_from_hex(str(params[0]))
+    claimed_mix = u256_from_hex(str(params[1]))
+    final, mix = kawpow.kawpow_hash(height, header_hash, nonce)
+    ret = {
+        "result": "true" if mix == claimed_mix else "false",
+        "digest": u256_hex(final),
+        "mix_hash": u256_hex(mix),
+        "info": "",
+    }
+    if len(params) >= 5 and params[4] is not None:
+        target = u256_from_hex(str(params[4]))
+        ret["meets_target"] = "true" if final <= target else "false"
+    return ret
+
+
+def pprpcsb(node, params: List[Any]):
+    """ProgPoW RPC submit block (ref mining.cpp:841): how external KawPow
+    miners land blocks — header-hash looks up the stashed getblocktemplate
+    block, nonce64/mix_hash complete it, then normal block processing."""
+    if len(params) != 3:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "header_hash, mix_hash, nonce required")
+    import copy
+
+    try:
+        nonce = int(str(params[2]).removeprefix("0x"), 16)
+    except ValueError:
+        raise RPCError(RPC_INVALID_PARAMS, "Invalid hex nonce")
+    templates = getattr(node, "kawpow_templates", {})
+    tmpl = templates.get(str(params[0]))
+    if tmpl is None:
+        raise RPCError(RPC_INVALID_PARAMS,
+                       "Block header hash not found in block data")
+    block = copy.deepcopy(tmpl)
+    block.header.nonce64 = nonce & 0xFFFFFFFFFFFFFFFF
+    block.header.mix_hash = u256_from_hex(str(params[1]))
+    block.header._cached_hash = None
+    if not block.vtx or not block.vtx[0].is_coinbase():
+        raise RPCError(RPC_DESERIALIZATION_ERROR,
+                       "Block does not start with a coinbase")
+    # boundary pre-check with the full recomputed hash (ref GetHashFull +
+    # CheckProofOfWork before ProcessNewBlock)
+    from ..consensus import pow as powrules
+    from ..crypto import kawpow
+
+    sched = node.params.algo_schedule
+    header_hash = int.from_bytes(
+        block.header.kawpow_header_hash(sched), "little"
+    )
+    final, _mix = kawpow.kawpow_hash(block.header.height, header_hash, nonce)
+    if not powrules.check_proof_of_work(
+        final, block.header.bits, node.params.consensus
+    ):
+        raise RPCError(RPC_DESERIALIZATION_ERROR,
+                       "Block does not solve the boundary")
+    from ..chain.validation import BlockValidationError
+
+    try:
+        node.chainstate.process_new_block(block)
+    except BlockValidationError as e:
+        return e.code
+    if node.chainstate.tip().block_hash == block.get_hash(sched):
+        return None
+    return "inconclusive"
 
 
 def submitblock(node, params: List[Any]):
@@ -283,6 +423,9 @@ def register(table: RPCTable) -> None:
         ("generatetoaddresstpu", generatetoaddress_tpu, ["nblocks", "address"]),
         ("getblocktemplate", getblocktemplate, ["template_request"]),
         ("submitblock", submitblock, ["hexdata"]),
+        ("getkawpowhash", getkawpowhash,
+         ["header_hash", "mix_hash", "nonce", "height", "target"]),
+        ("pprpcsb", pprpcsb, ["header_hash", "mix_hash", "nonce"]),
         ("getmininginfo", getmininginfo, []),
         ("getgenerate", getgenerate, []),
         ("setgenerate", setgenerate, ["generate", "genproclimit"]),

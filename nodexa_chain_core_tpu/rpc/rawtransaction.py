@@ -6,7 +6,7 @@ from typing import Any, List
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
 from ..core.amount import COIN
-from ..core.serialize import ByteReader
+from ..core.serialize import ByteReader, ByteWriter
 from ..core.uint256 import u256_from_hex, u256_hex
 from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
 from ..script.script import Script
@@ -151,6 +151,88 @@ def signrawtransaction(node, params: List[Any]):
     return out
 
 
+def gettxoutproof(node, params: List[Any]):
+    """Merkle proof that txids were included in a block (ref
+    rpc/rawtransaction.cpp:225): header + CPartialMerkleTree hex.
+
+    Without an explicit blockhash the reference resolves the block via the
+    UTXO (or -txindex); this framework walks the active chain like
+    getrawtransaction does — same results at this scale.
+    """
+    if not params or not isinstance(params[0], list) or not params[0]:
+        raise RPCError(RPC_INVALID_PARAMETER, "txids array required")
+    txids = []
+    for s in params[0]:
+        h = u256_from_hex(str(s))
+        if h in txids:
+            raise RPCError(
+                RPC_INVALID_PARAMETER, f"Invalid parameter, duplicated txid: {s}"
+            )
+        txids.append(h)
+    cs = node.chainstate
+    sched = node.params.algo_schedule
+    from ..chain.blockindex import BlockStatus
+
+    idx = None
+    if len(params) > 1 and params[1]:
+        idx = cs.lookup(u256_from_hex(str(params[1])))
+        if idx is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+    else:
+        for cand in cs.active:
+            if not cand.status & BlockStatus.HAVE_DATA:
+                continue
+            blk = cs.read_block(cand)
+            if any(tx.txid == txids[0] for tx in blk.vtx):
+                idx = cand
+                break
+        if idx is None:
+            raise RPCError(
+                RPC_INVALID_ADDRESS_OR_KEY, "Transaction not yet in block"
+            )
+    block = cs.read_block(idx)
+    present = {tx.txid for tx in block.vtx}
+    if not all(t in present for t in txids):
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY,
+            "Not all transactions found in specified or retrieved block",
+        )
+    from ..chain.merkleblock import make_merkle_block
+
+    wanted = set(txids)
+    tree, _ = make_merkle_block(block, lambda tx: tx.txid in wanted)
+    w = ByteWriter()
+    block.header.serialize(w, sched)
+    tree.serialize(w)
+    return w.getvalue().hex()
+
+
+def verifytxoutproof(node, params: List[Any]):
+    """ref rpc/rawtransaction.cpp:314: returns the committed txids, erroring
+    if the proof's block is not in the best chain."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "proof required")
+    from ..chain.merkleblock import PartialMerkleTree
+    from ..primitives.block import BlockHeader
+
+    sched = node.params.algo_schedule
+    try:
+        r = ByteReader(bytes.fromhex(str(params[0])))
+        header = BlockHeader.deserialize(r, sched)
+        tree = PartialMerkleTree.deserialize(r)
+    except Exception as e:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, f"proof decode failed: {e}")
+    root, matches = tree.extract_matches()
+    if root != header.hash_merkle_root or not matches:
+        return []
+    idx = node.chainstate.lookup(header.get_hash(sched))
+    if idx is None or idx not in node.chainstate.active:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY, "Block not found in chain"
+        )
+    return [u256_hex(t) for t in matches]
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("createrawtransaction", createrawtransaction, ["inputs", "outputs", "locktime"]),
@@ -158,5 +240,7 @@ def register(table: RPCTable) -> None:
         ("sendrawtransaction", sendrawtransaction, ["hexstring", "allowhighfees"]),
         ("getrawtransaction", getrawtransaction, ["txid", "verbose"]),
         ("signrawtransaction", signrawtransaction, ["hexstring", "prevtxs", "privkeys"]),
+        ("gettxoutproof", gettxoutproof, ["txids", "blockhash"]),
+        ("verifytxoutproof", verifytxoutproof, ["proof"]),
     ]:
         table.register("rawtransactions", name, fn, args)

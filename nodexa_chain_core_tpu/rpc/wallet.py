@@ -191,8 +191,11 @@ def listunspent(node, params: List[Any]):
     w = _wallet(node)
     minconf = int(params[0]) if params else 1
     out = []
-    for op, txout, conf in w.unspent_coins(min_conf=minconf):
+    for op, txout, conf in w.unspent_coins(
+        min_conf=minconf, include_watchonly=True
+    ):
         dest = extract_destination(Script(txout.script_pubkey))
+        spendable = w.is_mine_script(txout.script_pubkey)
         out.append(
             {
                 "txid": u256_hex(op.txid),
@@ -201,8 +204,8 @@ def listunspent(node, params: List[Any]):
                 "scriptPubKey": txout.script_pubkey.hex(),
                 "amount": txout.value / COIN,
                 "confirmations": conf,
-                "spendable": True,
-                "solvable": True,
+                "spendable": spendable,
+                "solvable": spendable,
             }
         )
     return out
@@ -235,16 +238,224 @@ def keypoolrefill(node, params: List[Any]):
 
 
 def importprivkey(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp:75 — the key persists across restarts."""
     w = _wallet(node)
     try:
         priv, compressed = wif_decode(str(params[0]), node.params)
     except ValueError as e:
         raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, str(e))
-    w.keystore.add_key(priv, compressed)
+    from ..wallet.wallet import WalletError
+
+    try:
+        kid = w.import_private_key(priv, compressed)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e))
+    label = str(params[1]) if len(params) > 1 and params[1] else ""
+    if label:
+        w.address_book[encode_destination(KeyID(kid), node.params)] = label
     rescan = bool(params[2]) if len(params) > 2 else True
     if rescan:
         w.rescan()
     return None
+
+
+def _script_for_import(node, text: str, p2sh: bool):
+    """address-or-hex-script resolution shared by importaddress (ref
+    rpcdump.cpp:220 choosing ImportAddress vs ImportScript)."""
+    from ..script.script import Script as _S
+
+    try:
+        dest = decode_destination(text, node.params)
+        return [script_for_destination(dest).raw], None
+    except Exception:
+        pass
+    try:
+        raw = bytes.fromhex(text)
+    except ValueError:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY,
+            "Invalid Nodexa address or script",
+        )
+    scripts = [raw]
+    redeem = None
+    if p2sh:
+        # watch the P2SH wrapper and remember the redeem script
+        from ..crypto.hashes import hash160
+        from ..script.standard import ScriptID
+
+        redeem = _S(raw)
+        scripts.append(script_for_destination(ScriptID(hash160(raw))).raw)
+    return scripts, redeem
+
+
+def importaddress(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp:220 — watch-only address/script import."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "address required")
+    w = _wallet(node)
+    label = str(params[1]) if len(params) > 1 and params[1] else ""
+    rescan = bool(params[2]) if len(params) > 2 else True
+    p2sh = bool(params[3]) if len(params) > 3 else False
+    scripts, redeem = _script_for_import(node, str(params[0]), p2sh)
+    if redeem is not None:
+        w.keystore.add_script(redeem)
+    for spk in scripts:
+        w.import_watch_script(spk, label)
+    if rescan:
+        w.rescan()
+    return None
+
+
+def importpubkey(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp:390 — watch the P2PKH/P2PK forms of a key."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "pubkey required")
+    w = _wallet(node)
+    try:
+        pub = bytes.fromhex(str(params[0]))
+        assert len(pub) in (33, 65)
+    except Exception:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Pubkey must be a hex string of 33 or 65 bytes")
+    label = str(params[1]) if len(params) > 1 and params[1] else ""
+    rescan = bool(params[2]) if len(params) > 2 else True
+    from ..crypto.hashes import hash160
+
+    w.import_watch_script(
+        script_for_destination(KeyID(hash160(pub))).raw, label
+    )
+    if rescan:
+        w.rescan()
+    return None
+
+
+def dumpwallet(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp dumpwallet: human-readable key export."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "filename required")
+    import os
+    import time as _t
+
+    w = _wallet(node)
+    if w.is_crypted and w.is_locked():
+        raise RPCError(RPC_WALLET_ERROR, "wallet is locked")
+    path = os.path.abspath(str(params[0]))
+    tip = node.chainstate.tip()
+    lines = [
+        "# Wallet dump created by nodexa_chain_core_tpu",
+        f"# * Created on {_t.strftime('%Y-%m-%dT%H:%M:%SZ', _t.gmtime())}",
+        f"# * Best block at time of backup was {tip.height} "
+        f"({u256_hex(tip.block_hash)})",
+    ]
+    if w.mnemonic:
+        lines.append(f"# mnemonic: {w.mnemonic}")
+    lines.append("")
+    pubs = w.keystore.pubs()
+    for kid, priv in w.keystore.keys().items():
+        meta = w.key_meta.get(kid)
+        tag = (
+            f"hdkeypath=m/44'/0'/0'/{meta[0]}/{meta[1]}"
+            if meta else "imported=1"
+        )
+        addr = encode_destination(KeyID(kid), node.params)
+        label = w.address_book.get(addr, "")
+        # the compressed flag decides the keyid — an uncompressed key
+        # exported as a compressed WIF would re-import to a different
+        # address and orphan its funds
+        compressed = len(pubs.get(kid, b"\x00" * 33)) == 33
+        lines.append(
+            f"{wif_encode(priv, node.params, compressed)} {tag} # addr={addr}"
+            + (f" label={label}" if label else "")
+        )
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return {"filename": path}
+
+
+def importwallet(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp:450 — re-import a dumpwallet file."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "filename required")
+    w = _wallet(node)
+    from ..wallet.wallet import WalletError
+
+    imported = 0
+    try:
+        with open(str(params[0])) as f:
+            body = f.read()
+    except OSError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"Cannot open wallet dump file: {e}")
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        wif = line.split()[0]
+        try:
+            priv, compressed = wif_decode(wif, node.params)
+        except ValueError:
+            continue  # ref skips unparseable lines
+        try:
+            w.import_private_key(priv, compressed)
+        except WalletError as e:
+            raise RPCError(RPC_WALLET_ERROR, str(e))
+        imported += 1
+    if imported == 0:
+        raise RPCError(RPC_WALLET_ERROR,
+                       "No keys found in the wallet dump")
+    w.rescan()
+    return None
+
+
+def importmulti(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp importmulti: batched import of addresses,
+    scripts, pubkeys and keys, one result object per request."""
+    if not params or not isinstance(params[0], list):
+        raise RPCError(RPC_INVALID_PARAMETER, "requests array required")
+    options = params[1] if len(params) > 1 and isinstance(params[1], dict) else {}
+    w = _wallet(node)
+    from ..crypto.hashes import hash160
+    from ..script.script import Script as _S
+    from ..wallet.wallet import WalletError
+
+    results = []
+    any_ok = False
+    for req in params[0]:
+        try:
+            if not isinstance(req, dict):
+                raise ValueError("request must be an object")
+            label = str(req.get("label", "") or "")
+            spk = req.get("scriptPubKey")
+            if isinstance(spk, dict) and "address" in spk:
+                dest = decode_destination(str(spk["address"]), node.params)
+                raw_spk = script_for_destination(dest).raw
+            elif isinstance(spk, str):
+                raw_spk = bytes.fromhex(spk)
+            else:
+                raise ValueError("scriptPubKey required")
+            if req.get("redeemscript"):
+                w.keystore.add_script(
+                    _S(bytes.fromhex(str(req["redeemscript"])))
+                )
+            for wif in req.get("keys", []) or []:
+                priv, compressed = wif_decode(str(wif), node.params)
+                w.import_private_key(priv, compressed)
+            for pub_hex in req.get("pubkeys", []) or []:
+                pub = bytes.fromhex(str(pub_hex))
+                w.import_watch_script(
+                    script_for_destination(KeyID(hash160(pub))).raw, label
+                )
+            if not req.get("keys"):
+                w.import_watch_script(raw_spk, label)
+            results.append({"success": True})
+            any_ok = True
+        except (ValueError, KeyError, WalletError) as e:
+            results.append(
+                {"success": False,
+                 "error": {"code": RPC_INVALID_PARAMETER, "message": str(e)}}
+            )
+    if any_ok and options.get("rescan", True):
+        w.rescan()
+    return results
 
 
 def dumpprivkey(node, params: List[Any]):
@@ -642,6 +853,12 @@ def register(table: RPCTable) -> None:
         ("keypoolrefill", keypoolrefill, ["newsize"]),
         ("importprivkey", importprivkey, ["privkey", "label", "rescan"]),
         ("dumpprivkey", dumpprivkey, ["address"]),
+        ("importaddress", importaddress,
+         ["address", "label", "rescan", "p2sh"]),
+        ("importpubkey", importpubkey, ["pubkey", "label", "rescan"]),
+        ("importwallet", importwallet, ["filename"]),
+        ("dumpwallet", dumpwallet, ["filename"]),
+        ("importmulti", importmulti, ["requests", "options"]),
         ("getmnemonic", getmnemonic, []),
         ("signmessage", signmessage, ["address", "message"]),
         ("verifymessage", verifymessage, ["address", "signature", "message"]),

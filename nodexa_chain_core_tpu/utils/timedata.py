@@ -30,7 +30,16 @@ class TimeData:
             self._offsets.append(offset)
 
     def offset(self) -> int:
+        # the reference only applies an offset once at least 5 samples
+        # arrived, and only recomputes on odd counts (timedata.cpp
+        # AddTimeData) — otherwise the first outbound peer's VERSION
+        # timestamp could swing adjusted_time by up to ±70 minutes and
+        # with it the header future-time bound
+        if len(self._offsets) < 5:
+            return 0
         s = sorted(self._offsets)
+        if len(s) % 2 == 0:
+            s = s[:-1]
         return s[len(s) // 2]
 
     def adjusted_time(self) -> int:

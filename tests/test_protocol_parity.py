@@ -1,0 +1,76 @@
+"""Wire-protocol parity pin: every message command string this node speaks
+must match the reference's documented surface (ref src/protocol.cpp:19-47
+NetMsgType definitions), so future edits cannot silently drift the wire
+format (VERDICT r2 weak #4 — "getasstdata"/"asstdata" had diverged from
+the reference's "getassetdata"/"assetdata").
+
+The expected strings below are transcribed from the reference, including
+its own quirk: the asset not-found reply really is "asstnotfound"
+(protocol.cpp:47) even though the request/reply pair is spelled out.
+"""
+
+from nodexa_chain_core_tpu.net import protocol as p
+
+# ref protocol.cpp:19-47, in definition order
+REFERENCE_COMMANDS = {
+    "MSG_VERSION": "version",
+    "MSG_VERACK": "verack",
+    "MSG_ADDR": "addr",
+    "MSG_INV": "inv",
+    "MSG_GETDATA": "getdata",
+    "MSG_MERKLEBLOCK": "merkleblock",
+    "MSG_GETBLOCKS": "getblocks",
+    "MSG_GETHEADERS": "getheaders",
+    "MSG_TX": "tx",
+    "MSG_HEADERS": "headers",
+    "MSG_BLOCK": "block",
+    "MSG_GETADDR": "getaddr",
+    "MSG_MEMPOOL": "mempool",
+    "MSG_PING": "ping",
+    "MSG_PONG": "pong",
+    "MSG_NOTFOUND": "notfound",
+    "MSG_FILTERLOAD": "filterload",
+    "MSG_FILTERADD": "filteradd",
+    "MSG_FILTERCLEAR": "filterclear",
+    "MSG_REJECT": "reject",
+    "MSG_SENDHEADERS": "sendheaders",
+    "MSG_FEEFILTER": "feefilter",
+    "MSG_SENDCMPCT": "sendcmpct",
+    "MSG_CMPCTBLOCK": "cmpctblock",
+    "MSG_GETBLOCKTXN": "getblocktxn",
+    "MSG_BLOCKTXN": "blocktxn",
+    "MSG_GETASSETDATA": "getassetdata",
+    "MSG_ASSETDATA": "assetdata",
+    "MSG_ASSETNOTFOUND": "asstnotfound",
+}
+
+
+def test_every_command_string_matches_reference():
+    for const, wire in REFERENCE_COMMANDS.items():
+        assert getattr(p, const) == wire, (
+            f"{const} drifted from the reference wire command {wire!r}"
+        )
+
+
+def test_no_unpinned_commands():
+    """Any new MSG_* constant must be added to the reference table above
+    (with a reference citation) before it ships."""
+    ours = {n for n in dir(p) if n.startswith("MSG_")}
+    assert ours == set(REFERENCE_COMMANDS), (
+        f"unpinned commands: {ours.symmetric_difference(REFERENCE_COMMANDS)}"
+    )
+
+
+def test_message_header_layout():
+    """24-byte header: magic(4) command(12, NUL-padded) length(4)
+    checksum(4) = sha256d prefix (ref protocol.h CMessageHeader)."""
+    from nodexa_chain_core_tpu.crypto.hashes import sha256d
+
+    payload = b"\x01\x02\x03"
+    magic = bytes.fromhex("deadbeef")
+    raw = p.pack_message(magic, p.MSG_PING, payload)
+    assert raw[:4] == magic
+    assert raw[4:16] == b"ping" + b"\x00" * 8
+    assert raw[16:20] == len(payload).to_bytes(4, "little")
+    assert raw[20:24] == sha256d(payload)[:4]
+    assert raw[24:] == payload

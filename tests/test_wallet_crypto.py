@@ -325,3 +325,36 @@ def test_mempool_persist_roundtrip(wallet_node, tmp_path):
     assert n == 2
     assert node.mempool.contains(txid1)
     assert node.mempool.contains(txid2)
+
+
+def test_imported_key_encrypted_persistence(wallet_node):
+    """importprivkey into an encrypted wallet (ref rpcdump.cpp:75 requiring
+    an unlocked wallet): the key rides wallet.json under the master key,
+    watches while locked, and signs again after unlock on a fresh load."""
+    import hashlib
+
+    from nodexa_chain_core_tpu.wallet.keys import keyid_of
+
+    node, w = wallet_node
+    _fund(node, w)
+    w.encrypt_wallet("pw-imp")
+    priv = int.from_bytes(hashlib.sha256(b"imported-k").digest(), "big")
+    kid = keyid_of(priv)
+
+    with pytest.raises(WalletError):
+        w.import_private_key(priv)  # locked: refused
+    w.unlock("pw-imp")
+    assert w.import_private_key(priv) == kid
+    raw = open(w.path).read()
+    assert f"{priv:064x}" not in raw  # never in the clear
+
+    main_signals.clear()
+    w2 = Wallet(node, w.path)
+    w2._load()
+    assert w2.is_locked()
+    from nodexa_chain_core_tpu.script.standard import KeyID
+
+    spk = script_for_destination(KeyID(kid)).raw
+    assert w2.is_mine_script(spk)  # watched while locked
+    w2.unlock("pw-imp")
+    assert w2.keystore.get_priv(kid) is not None  # spendable again
