@@ -82,5 +82,64 @@ class BlockPolicyEstimator:
                 return est, t
         return None, target
 
+    # ----------------------------------------------------- persistence
+    # ref CBlockPolicyEstimator::Write/Read -> fee_estimates.dat
+    # (policy/fees.cpp:916, flushed from Shutdown(), loaded in init Step
+    # 7): learned confirmation statistics survive restarts.  In-flight
+    # _tracked txs are NOT persisted — the mempool reload re-announces
+    # them — matching the reference, which only serializes the stats.
+
+    _FILE_VERSION = 1
+
+    def write_file(self, path: str) -> None:
+        import json
+        import os
+
+        data = {
+            "version": self._FILE_VERSION,
+            "n_buckets": len(self.buckets),
+            "max_confirms": self.max_confirms,
+            "best_height": self.best_height,
+            "tx_avg": self.tx_avg,
+            "conf_avg": self.conf_avg,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def read_file(self, path: str) -> bool:
+        """Load stats; False (and untouched state) on any mismatch — a
+        stale file from different bucket parameters must not poison
+        estimates (the reference guards with its serialization version)."""
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if (
+                data.get("version") != self._FILE_VERSION
+                or data.get("n_buckets") != len(self.buckets)
+                or data.get("max_confirms") != self.max_confirms
+            ):
+                return False
+            tx_avg = [float(x) for x in data["tx_avg"]]
+            conf_avg = [[float(x) for x in row] for row in data["conf_avg"]]
+            if len(tx_avg) != len(self.buckets) or len(conf_avg) != (
+                self.max_confirms
+            ):
+                return False
+            if any(len(row) != len(self.buckets) for row in conf_avg):
+                return False  # a short row would IndexError in process_block
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        self.tx_avg = tx_avg
+        self.conf_avg = conf_avg
+        self.best_height = int(data.get("best_height", 0))
+        return True
+
 
 fee_estimator = BlockPolicyEstimator()

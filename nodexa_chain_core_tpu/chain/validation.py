@@ -1120,6 +1120,10 @@ class ChainState:
                 other not in self.invalid
                 and other.is_valid(BlockStatus.VALID_TRANSACTIONS)
                 and other.status & BlockStatus.HAVE_DATA
+                # nChainTx candidacy gate, same as process_new_block /
+                # _load_or_init / reconsider_block: data-incomplete
+                # ancestor chains must not rejoin the candidate set
+                and other.chain_tx_count > 0
                 and (tip is None or other.chain_work >= tip.chain_work)
             ):
                 self.candidates.add(other)

@@ -1,0 +1,1235 @@
+// Embeddable consensus script verification — the libcloreconsensus analog
+// (ref src/script/cloreconsensus.{h,cpp}): a stable C ABI other processes
+// and languages can call to verify a scriptPubKey against a serialized
+// transaction input, with no Python anywhere in the path.
+//
+// Clean-room port of this framework's own Python VM
+// (nodexa_chain_core_tpu/script/interpreter.py — itself written against the
+// reference's interpreter.cpp semantics); differential tests drive both VMs
+// over the same corpus (tests/test_consensus_abi.py), which is the guard
+// against the two implementations drifting.
+//
+// ECDSA verification comes from secp256k1.cpp's nxk_ecdsa_verify_rs;
+// SHA-256 / SHA-1 / RIPEMD-160 are implemented here from their public
+// specifications (FIPS 180-4, FIPS 180-1, the RIPEMD-160 paper).
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" int nxk_ecdsa_verify_rs(const uint8_t digest[32],
+                                   const uint8_t r32[32],
+                                   const uint8_t s32[32],
+                                   const uint8_t* pubkey,
+                                   unsigned pubkey_len);
+
+namespace nxcons {
+
+using Bytes = std::vector<uint8_t>;
+
+// ------------------------------------------------------------------ hashes
+
+static inline uint32_t rotl32(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+static inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+// FIPS 180-4 SHA-256
+static void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  static const uint32_t K[64] = {
+      0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+      0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+      0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+      0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+      0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+      0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+      0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+      0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+      0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+      0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+      0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+  };
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t total = (uint64_t)len * 8;
+  std::vector<uint8_t> msg(data, data + len);
+  msg.push_back(0x80);
+  while (msg.size() % 64 != 56) msg.push_back(0);
+  for (int i = 7; i >= 0; --i) msg.push_back((uint8_t)(total >> (8 * i)));
+  for (size_t blk = 0; blk < msg.size(); blk += 64) {
+    uint32_t w[64];
+    for (int t = 0; t < 16; ++t)
+      w[t] = (msg[blk + 4 * t] << 24) | (msg[blk + 4 * t + 1] << 16) |
+             (msg[blk + 4 * t + 2] << 8) | msg[blk + 4 * t + 3];
+    for (int t = 16; t < 64; ++t) {
+      uint32_t s0 =
+          rotr32(w[t - 15], 7) ^ rotr32(w[t - 15], 18) ^ (w[t - 15] >> 3);
+      uint32_t s1 =
+          rotr32(w[t - 2], 17) ^ rotr32(w[t - 2], 19) ^ (w[t - 2] >> 10);
+      w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int t = 0; t < 64; ++t) {
+      uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[t] + w[t];
+      uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+}
+
+static void sha256d(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint8_t tmp[32];
+  sha256(data, len, tmp);
+  sha256(tmp, 32, out);
+}
+
+// FIPS 180-1 SHA-1
+static void sha1(const uint8_t* data, size_t len, uint8_t out[20]) {
+  uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476,
+                   0xC3D2E1F0};
+  uint64_t total = (uint64_t)len * 8;
+  std::vector<uint8_t> msg(data, data + len);
+  msg.push_back(0x80);
+  while (msg.size() % 64 != 56) msg.push_back(0);
+  for (int i = 7; i >= 0; --i) msg.push_back((uint8_t)(total >> (8 * i)));
+  for (size_t blk = 0; blk < msg.size(); blk += 64) {
+    uint32_t w[80];
+    for (int t = 0; t < 16; ++t)
+      w[t] = (msg[blk + 4 * t] << 24) | (msg[blk + 4 * t + 1] << 16) |
+             (msg[blk + 4 * t + 2] << 8) | msg[blk + 4 * t + 3];
+    for (int t = 16; t < 80; ++t)
+      w[t] = rotl32(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1);
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int t = 0; t < 80; ++t) {
+      uint32_t f, k;
+      if (t < 20) { f = (b & c) | (~b & d); k = 0x5A827999; }
+      else if (t < 40) { f = b ^ c ^ d; k = 0x6ED9EBA1; }
+      else if (t < 60) { f = (b & c) | (b & d) | (c & d); k = 0x8F1BBCDC; }
+      else { f = b ^ c ^ d; k = 0xCA62C1D6; }
+      uint32_t tmp = rotl32(a, 5) + f + e + k + w[t];
+      e = d; d = c; c = rotl32(b, 30); b = a; a = tmp;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d; h[4] += e;
+  }
+  for (int i = 0; i < 5; ++i) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+}
+
+// RIPEMD-160 (Dobbertin/Bosselaers/Preneel)
+static void ripemd160(const uint8_t* data, size_t len, uint8_t out[20]) {
+  static const int R1[80] = {
+      0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+      7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+      3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+      1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+      4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13};
+  static const int R2[80] = {
+      5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+      6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+      15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+      8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+      12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11};
+  static const int S1[80] = {
+      11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+      7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+      11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+      11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+      9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6};
+  static const int S2[80] = {
+      8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+      9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+      9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+      15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+      8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11};
+  auto f = [](int j, uint32_t x, uint32_t y, uint32_t z) -> uint32_t {
+    if (j < 16) return x ^ y ^ z;
+    if (j < 32) return (x & y) | (~x & z);
+    if (j < 48) return (x | ~y) ^ z;
+    if (j < 64) return (x & z) | (y & ~z);
+    return x ^ (y | ~z);
+  };
+  static const uint32_t K1[5] = {0x00000000, 0x5A827999, 0x6ED9EBA1,
+                                 0x8F1BBCDC, 0xA953FD4E};
+  static const uint32_t K2[5] = {0x50A28BE6, 0x5C4DD124, 0x6D703EF3,
+                                 0x7A6D76E9, 0x00000000};
+  uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476,
+                   0xC3D2E1F0};
+  uint64_t total = (uint64_t)len * 8;
+  std::vector<uint8_t> msg(data, data + len);
+  msg.push_back(0x80);
+  while (msg.size() % 64 != 56) msg.push_back(0);
+  for (int i = 0; i < 8; ++i) msg.push_back((uint8_t)(total >> (8 * i)));
+  for (size_t blk = 0; blk < msg.size(); blk += 64) {
+    uint32_t x[16];
+    for (int t = 0; t < 16; ++t)
+      x[t] = msg[blk + 4 * t] | (msg[blk + 4 * t + 1] << 8) |
+             (msg[blk + 4 * t + 2] << 16) | ((uint32_t)msg[blk + 4 * t + 3] << 24);
+    uint32_t a1 = h[0], b1 = h[1], c1 = h[2], d1 = h[3], e1 = h[4];
+    uint32_t a2 = h[0], b2 = h[1], c2 = h[2], d2 = h[3], e2 = h[4];
+    for (int j = 0; j < 80; ++j) {
+      uint32_t t = rotl32(a1 + f(j, b1, c1, d1) + x[R1[j]] + K1[j / 16],
+                          S1[j]) + e1;
+      a1 = e1; e1 = d1; d1 = rotl32(c1, 10); c1 = b1; b1 = t;
+      t = rotl32(a2 + f(79 - j, b2, c2, d2) + x[R2[j]] + K2[j / 16],
+                 S2[j]) + e2;
+      a2 = e2; e2 = d2; d2 = rotl32(c2, 10); c2 = b2; b2 = t;
+    }
+    uint32_t t = h[1] + c1 + d2;
+    h[1] = h[2] + d1 + e2;
+    h[2] = h[3] + e1 + a2;
+    h[3] = h[4] + a1 + b2;
+    h[4] = h[0] + b1 + c2;
+    h[0] = t;
+  }
+  for (int i = 0; i < 5; ++i) {
+    out[4 * i] = (uint8_t)h[i];
+    out[4 * i + 1] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 3] = (uint8_t)(h[i] >> 24);
+  }
+}
+
+static void hash160(const uint8_t* data, size_t len, uint8_t out[20]) {
+  uint8_t tmp[32];
+  sha256(data, len, tmp);
+  ripemd160(tmp, 32, out);
+}
+
+// ---------------------------------------------------------- script model
+
+// opcodes (ref script/opcodes.py; values are the shared wire constants)
+enum {
+  OP_0 = 0x00, OP_PUSHDATA1 = 0x4c, OP_PUSHDATA2 = 0x4d, OP_PUSHDATA4 = 0x4e,
+  OP_1NEGATE = 0x4f, OP_RESERVED = 0x50, OP_1 = 0x51, OP_16 = 0x60,
+  OP_NOP = 0x61, OP_VER = 0x62, OP_IF = 0x63, OP_NOTIF = 0x64,
+  OP_VERIF = 0x65, OP_VERNOTIF = 0x66, OP_ELSE = 0x67, OP_ENDIF = 0x68,
+  OP_VERIFY = 0x69, OP_RETURN = 0x6a, OP_TOALTSTACK = 0x6b,
+  OP_FROMALTSTACK = 0x6c, OP_2DROP = 0x6d, OP_2DUP = 0x6e, OP_3DUP = 0x6f,
+  OP_2OVER = 0x70, OP_2ROT = 0x71, OP_2SWAP = 0x72, OP_IFDUP = 0x73,
+  OP_DEPTH = 0x74, OP_DROP = 0x75, OP_DUP = 0x76, OP_NIP = 0x77,
+  OP_OVER = 0x78, OP_PICK = 0x79, OP_ROLL = 0x7a, OP_ROT = 0x7b,
+  OP_SWAP = 0x7c, OP_TUCK = 0x7d, OP_CAT = 0x7e, OP_SUBSTR = 0x7f,
+  OP_LEFT = 0x80, OP_RIGHT = 0x81, OP_SIZE = 0x82, OP_INVERT = 0x83,
+  OP_AND = 0x84, OP_OR = 0x85, OP_XOR = 0x86, OP_EQUAL = 0x87,
+  OP_EQUALVERIFY = 0x88, OP_RESERVED1 = 0x89, OP_RESERVED2 = 0x8a,
+  OP_1ADD = 0x8b, OP_1SUB = 0x8c, OP_2MUL = 0x8d, OP_2DIV = 0x8e,
+  OP_NEGATE = 0x8f, OP_ABS = 0x90, OP_NOT = 0x91, OP_0NOTEQUAL = 0x92,
+  OP_ADD = 0x93, OP_SUB = 0x94, OP_MUL = 0x95, OP_DIV = 0x96,
+  OP_MOD = 0x97, OP_LSHIFT = 0x98, OP_RSHIFT = 0x99, OP_BOOLAND = 0x9a,
+  OP_BOOLOR = 0x9b, OP_NUMEQUAL = 0x9c, OP_NUMEQUALVERIFY = 0x9d,
+  OP_NUMNOTEQUAL = 0x9e, OP_LESSTHAN = 0x9f, OP_GREATERTHAN = 0xa0,
+  OP_LESSTHANOREQUAL = 0xa1, OP_GREATERTHANOREQUAL = 0xa2, OP_MIN = 0xa3,
+  OP_MAX = 0xa4, OP_WITHIN = 0xa5, OP_RIPEMD160 = 0xa6, OP_SHA1 = 0xa7,
+  OP_SHA256 = 0xa8, OP_HASH160 = 0xa9, OP_HASH256 = 0xaa,
+  OP_CODESEPARATOR = 0xab, OP_CHECKSIG = 0xac, OP_CHECKSIGVERIFY = 0xad,
+  OP_CHECKMULTISIG = 0xae, OP_CHECKMULTISIGVERIFY = 0xaf, OP_NOP1 = 0xb0,
+  OP_CHECKLOCKTIMEVERIFY = 0xb1, OP_CHECKSEQUENCEVERIFY = 0xb2,
+  OP_NOP4 = 0xb3, OP_NOP10 = 0xb9, OP_ASSET = 0xc0,
+};
+
+enum {
+  VERIFY_P2SH = 1 << 0, VERIFY_STRICTENC = 1 << 1, VERIFY_DERSIG = 1 << 2,
+  VERIFY_LOW_S = 1 << 3, VERIFY_NULLDUMMY = 1 << 4,
+  VERIFY_SIGPUSHONLY = 1 << 5, VERIFY_MINIMALDATA = 1 << 6,
+  VERIFY_DISCOURAGE_UPGRADABLE_NOPS = 1 << 7, VERIFY_CLEANSTACK = 1 << 8,
+  VERIFY_CHECKLOCKTIMEVERIFY = 1 << 9, VERIFY_CHECKSEQUENCEVERIFY = 1 << 10,
+  VERIFY_MINIMALIF = 1 << 13, VERIFY_NULLFAIL = 1 << 14,
+};
+
+static const size_t kMaxScriptSize = 10000;
+static const size_t kMaxElementSize = 520;
+static const int kMaxOps = 201;
+static const int kMaxPubkeys = 20;
+static const uint32_t kLocktimeThreshold = 500000000;
+static const uint32_t kSequenceFinal = 0xFFFFFFFF;
+static const uint32_t kSeqDisable = 1u << 31;
+static const uint32_t kSeqTypeFlag = 1u << 22;
+static const uint32_t kSeqMask = 0x0000FFFF;
+enum { SIGHASH_ALL = 1, SIGHASH_NONE = 2, SIGHASH_SINGLE = 3,
+       SIGHASH_ANYONECANPAY = 0x80 };
+
+struct ScriptErr {
+  const char* code;
+  explicit ScriptErr(const char* c) : code(c) {}
+};
+
+// one parsed op; data_valid distinguishes "no data" from empty push
+struct Op {
+  int opcode;
+  bool has_data;
+  Bytes data;
+  size_t offset;
+};
+
+// parse all ops; throws ScriptErr("bad_script") on truncation
+static std::vector<Op> parse_ops(const Bytes& raw) {
+  std::vector<Op> out;
+  size_t i = 0, n = raw.size();
+  while (i < n) {
+    Op o;
+    o.offset = i;
+    o.opcode = raw[i++];
+    o.has_data = false;
+    if (o.opcode <= OP_PUSHDATA4) {
+      size_t size;
+      if (o.opcode < OP_PUSHDATA1) {
+        size = (size_t)o.opcode;
+      } else if (o.opcode == OP_PUSHDATA1) {
+        if (i + 1 > n) throw ScriptErr("bad_script");
+        size = raw[i]; i += 1;
+      } else if (o.opcode == OP_PUSHDATA2) {
+        if (i + 2 > n) throw ScriptErr("bad_script");
+        size = raw[i] | (raw[i + 1] << 8); i += 2;
+      } else {
+        if (i + 4 > n) throw ScriptErr("bad_script");
+        size = raw[i] | (raw[i + 1] << 8) | ((size_t)raw[i + 2] << 16) |
+               ((size_t)raw[i + 3] << 24);
+        i += 4;
+      }
+      if (i + size > n) throw ScriptErr("bad_script");
+      o.has_data = true;
+      o.data.assign(raw.begin() + i, raw.begin() + i + size);
+      i += size;
+    } else if (o.opcode == OP_ASSET) {
+      o.has_data = true;
+      o.data.assign(raw.begin() + i, raw.end());
+      i = n;
+    }
+    out.push_back(std::move(o));
+  }
+  return out;
+}
+
+static bool is_push_only(const Bytes& raw) {
+  try {
+    for (const Op& o : parse_ops(raw))
+      if (o.opcode > OP_16) return false;
+  } catch (const ScriptErr&) {
+    return false;
+  }
+  return true;
+}
+
+static bool is_p2sh(const Bytes& r) {
+  return r.size() == 23 && r[0] == OP_HASH160 && r[1] == 20 &&
+         r[22] == OP_EQUAL;
+}
+
+// CScriptNum
+static Bytes num_encode(int64_t n) {
+  Bytes out;
+  if (n == 0) return out;
+  bool neg = n < 0;
+  uint64_t a = neg ? (uint64_t)(-n) : (uint64_t)n;
+  while (a) {
+    out.push_back((uint8_t)(a & 0xFF));
+    a >>= 8;
+  }
+  if (out.back() & 0x80) out.push_back(neg ? 0x80 : 0x00);
+  else if (neg) out.back() |= 0x80;
+  return out;
+}
+
+static int64_t num_decode(const Bytes& d, size_t max_size,
+                          bool require_minimal) {
+  if (d.size() > max_size) throw ScriptErr("scriptnum");
+  if (require_minimal && !d.empty()) {
+    if ((d.back() & 0x7F) == 0) {
+      if (d.size() <= 1 || !(d[d.size() - 2] & 0x80))
+        throw ScriptErr("scriptnum");
+    }
+  }
+  if (d.empty()) return 0;
+  uint64_t v = 0;
+  for (size_t i = 0; i < d.size(); ++i) v |= (uint64_t)d[i] << (8 * i);
+  if (d.back() & 0x80) {
+    v &= (1ULL << (d.size() * 8 - 1)) - 1;
+    return -(int64_t)v;
+  }
+  return (int64_t)v;
+}
+
+static bool cast_to_bool(const Bytes& v) {
+  for (size_t i = 0; i < v.size(); ++i) {
+    if (v[i] != 0) {
+      if (i == v.size() - 1 && v[i] == 0x80) return false;  // negative zero
+      return true;
+    }
+  }
+  return false;
+}
+
+// the minimal encoding of `data` as a single push op
+static Bytes build_push(const Bytes& data) {
+  Bytes out;
+  size_t n = data.size();
+  if (n < OP_PUSHDATA1) {
+    out.push_back((uint8_t)n);
+  } else if (n <= 0xFF) {
+    out.push_back(OP_PUSHDATA1);
+    out.push_back((uint8_t)n);
+  } else if (n <= 0xFFFF) {
+    out.push_back(OP_PUSHDATA2);
+    out.push_back((uint8_t)n);
+    out.push_back((uint8_t)(n >> 8));
+  } else {
+    out.push_back(OP_PUSHDATA4);
+    out.push_back((uint8_t)n);
+    out.push_back((uint8_t)(n >> 8));
+    out.push_back((uint8_t)(n >> 16));
+    out.push_back((uint8_t)(n >> 24));
+  }
+  out.insert(out.end(), data.begin(), data.end());
+  return out;
+}
+
+// FindAndDelete at op boundaries (the legacy sighash quirk)
+static Bytes find_and_delete(const Bytes& raw, const Bytes& needle) {
+  if (needle.empty()) return raw;
+  Bytes out;
+  size_t pc = 0, seg = 0, n = raw.size();
+  auto matches = [&](size_t at) {
+    return at + needle.size() <= n &&
+           std::memcmp(raw.data() + at, needle.data(), needle.size()) == 0;
+  };
+  while (true) {
+    if (matches(pc)) {
+      out.insert(out.end(), raw.begin() + seg, raw.begin() + pc);
+      while (matches(pc)) pc += needle.size();
+      seg = pc;
+    }
+    if (pc >= n) break;
+    int opcode = raw[pc++];
+    if (opcode <= OP_PUSHDATA4) {
+      size_t size;
+      if (opcode < OP_PUSHDATA1) size = (size_t)opcode;
+      else if (opcode == OP_PUSHDATA1) {
+        if (pc + 1 > n) break;
+        size = raw[pc]; pc += 1;
+      } else if (opcode == OP_PUSHDATA2) {
+        if (pc + 2 > n) break;
+        size = raw[pc] | (raw[pc + 1] << 8); pc += 2;
+      } else {
+        if (pc + 4 > n) break;
+        size = raw[pc] | (raw[pc + 1] << 8) | ((size_t)raw[pc + 2] << 16) |
+               ((size_t)raw[pc + 3] << 24);
+        pc += 4;
+      }
+      if (pc + size > n) break;
+      pc += size;
+    } else if (opcode == OP_ASSET) {
+      pc = n;
+    }
+  }
+  out.insert(out.end(), raw.begin() + seg, raw.end());
+  return out;
+}
+
+// -------------------------------------------------------------- tx model
+
+struct TxIn {
+  uint8_t prev_hash[32];
+  uint32_t prev_n;
+  Bytes script_sig;
+  uint32_t sequence;
+};
+
+struct TxOut {
+  int64_t value;
+  Bytes script_pubkey;
+};
+
+struct Tx {
+  int32_t version;
+  std::vector<TxIn> vin;
+  std::vector<TxOut> vout;
+  uint32_t locktime;
+};
+
+struct Reader {
+  const uint8_t* p;
+  size_t n, i = 0;
+  Reader(const uint8_t* d, size_t len) : p(d), n(len) {}
+  void need(size_t k) {
+    if (i + k > n) throw ScriptErr("tx_deserialize");
+  }
+  uint8_t u8() { need(1); return p[i++]; }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = p[i] | (p[i + 1] << 8) | ((uint32_t)p[i + 2] << 16) |
+                 ((uint32_t)p[i + 3] << 24);
+    i += 4;
+    return v;
+  }
+  uint64_t u64() {
+    uint64_t lo = u32();
+    uint64_t hi = u32();
+    return lo | (hi << 32);
+  }
+  uint64_t compact() {
+    uint8_t c = u8();
+    if (c < 253) return c;
+    if (c == 253) { need(2); uint64_t v = p[i] | (p[i+1] << 8); i += 2; return v; }
+    if (c == 254) return u32();
+    return u64();
+  }
+  Bytes bytes(size_t k) {
+    need(k);
+    Bytes v(p + i, p + i + k);
+    i += k;
+    return v;
+  }
+};
+
+static Tx parse_tx(const uint8_t* data, size_t len) {
+  Reader r(data, len);
+  Tx tx;
+  tx.version = (int32_t)r.u32();
+  uint64_t nin = r.compact();
+  if (nin > 1000000) throw ScriptErr("tx_deserialize");
+  for (uint64_t k = 0; k < nin; ++k) {
+    TxIn in;
+    Bytes h = r.bytes(32);
+    std::memcpy(in.prev_hash, h.data(), 32);
+    in.prev_n = r.u32();
+    in.script_sig = r.bytes(r.compact());
+    in.sequence = r.u32();
+    tx.vin.push_back(std::move(in));
+  }
+  uint64_t nout = r.compact();
+  if (nout > 1000000) throw ScriptErr("tx_deserialize");
+  for (uint64_t k = 0; k < nout; ++k) {
+    TxOut o;
+    o.value = (int64_t)r.u64();
+    o.script_pubkey = r.bytes(r.compact());
+    tx.vout.push_back(std::move(o));
+  }
+  tx.locktime = r.u32();
+  if (r.i != r.n) throw ScriptErr("tx_deserialize");
+  return tx;
+}
+
+// -------------------------------------------------------------- sighash
+
+struct Writer {
+  Bytes b;
+  void u8(uint8_t v) { b.push_back(v); }
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) b.push_back((uint8_t)(v >> (8 * i)));
+  }
+  void i64(int64_t v) {
+    uint64_t u = (uint64_t)v;
+    for (int i = 0; i < 8; ++i) b.push_back((uint8_t)(u >> (8 * i)));
+  }
+  void compact(uint64_t v) {
+    if (v < 253) { u8((uint8_t)v); }
+    else if (v <= 0xFFFF) { u8(253); u8((uint8_t)v); u8((uint8_t)(v >> 8)); }
+    else if (v <= 0xFFFFFFFFULL) { u8(254); u32((uint32_t)v); }
+    else { u8(255); u32((uint32_t)v); u32((uint32_t)(v >> 32)); }
+  }
+  void raw(const uint8_t* d, size_t n) { b.insert(b.end(), d, d + n); }
+  void var_bytes(const Bytes& d) { compact(d.size()); raw(d.data(), d.size()); }
+};
+
+static void ser_input(Writer& w, const Tx& tx, size_t i, size_t sign_idx,
+                      const Bytes& script_code, int base) {
+  const TxIn& in = tx.vin[i];
+  w.raw(in.prev_hash, 32);
+  w.u32(in.prev_n);
+  if (i == sign_idx) {
+    w.var_bytes(script_code);
+    w.u32(in.sequence);
+  } else {
+    w.compact(0);
+    if (base == SIGHASH_NONE || base == SIGHASH_SINGLE) w.u32(0);
+    else w.u32(in.sequence);
+  }
+}
+
+static void signature_hash(uint8_t out[32], const Bytes& script_code,
+                           const Tx& tx, size_t in_idx, uint32_t hashtype) {
+  if (in_idx >= tx.vin.size()) {
+    std::memset(out, 0, 32);
+    out[0] = 1;  // "hash of one", little-endian
+    return;
+  }
+  int base = hashtype & 0x1F;
+  if (base == SIGHASH_SINGLE && in_idx >= tx.vout.size()) {
+    std::memset(out, 0, 32);
+    out[0] = 1;
+    return;
+  }
+  bool anyone = (hashtype & SIGHASH_ANYONECANPAY) != 0;
+  Writer w;
+  w.u32((uint32_t)tx.version);
+  if (anyone) {
+    w.compact(1);
+    ser_input(w, tx, in_idx, in_idx, script_code, base);
+  } else {
+    w.compact(tx.vin.size());
+    for (size_t i = 0; i < tx.vin.size(); ++i)
+      ser_input(w, tx, i, in_idx, script_code, base);
+  }
+  if (base == SIGHASH_NONE) {
+    w.compact(0);
+  } else if (base == SIGHASH_SINGLE) {
+    w.compact(in_idx + 1);
+    for (size_t i = 0; i <= in_idx; ++i) {
+      if (i == in_idx) {
+        w.i64(tx.vout[i].value);
+        w.var_bytes(tx.vout[i].script_pubkey);
+      } else {
+        w.i64(-1);
+        w.compact(0);
+      }
+    }
+  } else {
+    w.compact(tx.vout.size());
+    for (const TxOut& o : tx.vout) {
+      w.i64(o.value);
+      w.var_bytes(o.script_pubkey);
+    }
+  }
+  w.u32(tx.locktime);
+  w.u32(hashtype);
+  sha256d(w.b.data(), w.b.size(), out);
+}
+
+// ------------------------------------------------- signature plumbing
+
+// BIP66 strict shape check (ref IsValidSignatureEncoding)
+static bool valid_sig_encoding(const Bytes& sig) {
+  if (sig.size() < 9 || sig.size() > 73) return false;
+  if (sig[0] != 0x30 || sig[1] != sig.size() - 3) return false;
+  size_t len_r = sig[3];
+  if (5 + len_r >= sig.size()) return false;
+  size_t len_s = sig[5 + len_r];
+  if (len_r + len_s + 7 != sig.size()) return false;
+  if (sig[2] != 0x02 || len_r == 0 || (sig[4] & 0x80)) return false;
+  if (len_r > 1 && sig[4] == 0 && !(sig[5] & 0x80)) return false;
+  if (sig[4 + len_r] != 0x02 || len_s == 0 || (sig[6 + len_r] & 0x80))
+    return false;
+  if (len_s > 1 && sig[6 + len_r] == 0 && !(sig[7 + len_r] & 0x80))
+    return false;
+  return true;
+}
+
+// lax DER parse -> fixed 32-byte big-endian r/s; false when unparseable
+// or a value needs more than 32 significant bytes
+static bool der_parse_lax(const Bytes& der, uint8_t r32[32], uint8_t s32[32]) {
+  if (der.size() < 8 || der[0] != 0x30) return false;
+  if (der[1] != der.size() - 2) return false;
+  size_t i = 2;
+  auto read_int = [&](uint8_t out[32]) -> bool {
+    if (i + 2 > der.size() || der[i] != 0x02) return false;
+    size_t ln = der[i + 1];
+    i += 2;
+    if (i + ln > der.size() || ln == 0) return false;
+    size_t start = i;
+    i += ln;
+    // strip leading zeros
+    while (ln > 0 && der[start] == 0) { ++start; --ln; }
+    if (ln > 32) return false;
+    std::memset(out, 0, 32);
+    std::memcpy(out + 32 - ln, der.data() + start, ln);
+    return true;
+  };
+  if (!read_int(r32)) return false;
+  if (!read_int(s32)) return false;
+  return i == der.size();
+}
+
+// s <= n/2 for LOW_S (half-order big-endian)
+static bool is_low_s(const uint8_t s32[32]) {
+  static const uint8_t kHalfN[32] = {
+      0x7F, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x5D, 0x57, 0x6E, 0x73, 0x57, 0xA4,
+      0x50, 0x1D, 0xDF, 0xE9, 0x2F, 0x46, 0x68, 0x1B, 0x20, 0xA0,
+  };
+  bool nonzero = false;
+  for (int i = 0; i < 32; ++i) {
+    if (s32[i] != kHalfN[i]) {
+      if (s32[i] > kHalfN[i]) return false;
+      break;
+    }
+  }
+  for (int i = 0; i < 32; ++i) nonzero |= s32[i] != 0;
+  return nonzero;
+}
+
+struct Checker {
+  const Tx& tx;
+  size_t in_idx;
+  Checker(const Tx& t, size_t i) : tx(t), in_idx(i) {}
+
+  bool check_sig(const Bytes& sig, const Bytes& pubkey,
+                 const Bytes& script_code) const {
+    if (sig.empty()) return false;
+    uint32_t hashtype = sig.back();
+    Bytes raw_sig(sig.begin(), sig.end() - 1);
+    uint8_t r32[32], s32[32];
+    if (!der_parse_lax(raw_sig, r32, s32)) return false;
+    Bytes cleaned = find_and_delete(script_code, build_push(sig));
+    uint8_t digest[32];
+    signature_hash(digest, cleaned, tx, in_idx, hashtype);
+    return nxk_ecdsa_verify_rs(digest, r32, s32, pubkey.data(),
+                               (unsigned)pubkey.size()) == 1;
+  }
+
+  bool check_locktime(int64_t locktime) const {
+    uint32_t tx_lock = tx.locktime;
+    bool both_height = tx_lock < kLocktimeThreshold &&
+                       locktime < (int64_t)kLocktimeThreshold;
+    bool both_time = tx_lock >= kLocktimeThreshold &&
+                     locktime >= (int64_t)kLocktimeThreshold;
+    if (!both_height && !both_time) return false;
+    if (locktime > (int64_t)tx_lock) return false;
+    if (tx.vin[in_idx].sequence == kSequenceFinal) return false;
+    return true;
+  }
+
+  bool check_sequence(int64_t sequence) const {
+    uint32_t tx_seq = tx.vin[in_idx].sequence;
+    if (tx.version < 2) return false;
+    if (tx_seq & kSeqDisable) return false;
+    uint32_t mask = kSeqTypeFlag | kSeqMask;
+    uint32_t masked_tx = tx_seq & mask;
+    uint32_t masked_op = (uint32_t)sequence & mask;
+    bool both_blocks =
+        masked_tx < kSeqTypeFlag && masked_op < kSeqTypeFlag;
+    bool both_time =
+        masked_tx >= kSeqTypeFlag && masked_op >= kSeqTypeFlag;
+    if (!both_blocks && !both_time) return false;
+    return masked_op <= masked_tx;
+  }
+};
+
+static void check_sig_encoding(const Bytes& sig, unsigned flags) {
+  if (sig.empty()) return;
+  if (flags & (VERIFY_DERSIG | VERIFY_LOW_S | VERIFY_STRICTENC)) {
+    if (!valid_sig_encoding(sig)) throw ScriptErr("sig_der");
+  }
+  if (flags & VERIFY_LOW_S) {
+    uint8_t r32[32], s32[32];
+    Bytes raw_sig(sig.begin(), sig.end() - 1);
+    if (!der_parse_lax(raw_sig, r32, s32)) throw ScriptErr("sig_der");
+    if (!is_low_s(s32)) throw ScriptErr("sig_high_s");
+  }
+  if (flags & VERIFY_STRICTENC) {
+    uint32_t ht = sig.back() & ~(uint32_t)SIGHASH_ANYONECANPAY;
+    if (ht != SIGHASH_ALL && ht != SIGHASH_NONE && ht != SIGHASH_SINGLE)
+      throw ScriptErr("sig_hashtype");
+  }
+}
+
+static void check_pubkey_encoding(const Bytes& pub, unsigned flags) {
+  if (flags & VERIFY_STRICTENC) {
+    bool ok = (pub.size() == 33 && (pub[0] == 2 || pub[0] == 3)) ||
+              (pub.size() == 65 && pub[0] == 4);
+    if (!ok) throw ScriptErr("pubkey_type");
+  }
+}
+
+static bool minimal_push(const Bytes& data, int opcode) {
+  if (data.empty()) return opcode == OP_0;
+  if (data.size() == 1 && data[0] >= 1 && data[0] <= 16)
+    return opcode == OP_1 + data[0] - 1;
+  if (data.size() == 1 && data[0] == 0x81) return opcode == OP_1NEGATE;
+  if (data.size() <= 75) return opcode == (int)data.size();
+  if (data.size() <= 255) return opcode == OP_PUSHDATA1;
+  if (data.size() <= 65535) return opcode == OP_PUSHDATA2;
+  return true;
+}
+
+static bool is_disabled(int opcode) {
+  switch (opcode) {
+    case OP_CAT: case OP_SUBSTR: case OP_LEFT: case OP_RIGHT:
+    case OP_INVERT: case OP_AND: case OP_OR: case OP_XOR:
+    case OP_2MUL: case OP_2DIV: case OP_MUL: case OP_DIV:
+    case OP_MOD: case OP_LSHIFT: case OP_RSHIFT:
+      return true;
+  }
+  return false;
+}
+
+// ------------------------------------------------------------ eval loop
+
+static void eval(std::vector<Bytes>& stack, const Bytes& raw, unsigned flags,
+                 const Checker& checker) {
+  if (raw.size() > kMaxScriptSize) throw ScriptErr("script_size");
+  std::vector<Bytes> altstack;
+  std::vector<bool> vf_exec;
+  int op_count = 0;
+  bool minimal = (flags & VERIFY_MINIMALDATA) != 0;
+  size_t begincode = 0;
+  const Bytes kTrue = {1};
+  const Bytes kFalse = {};
+
+  auto popstack = [&]() -> Bytes {
+    if (stack.empty()) throw ScriptErr("invalid_stack_operation");
+    Bytes v = std::move(stack.back());
+    stack.pop_back();
+    return v;
+  };
+  auto popnum = [&](size_t max_size) -> int64_t {
+    return num_decode(popstack(), max_size, minimal);
+  };
+  auto need = [&](size_t k) {
+    if (stack.size() < k) throw ScriptErr("invalid_stack_operation");
+  };
+
+  for (const Op& o : parse_ops(raw)) {
+    int opcode = o.opcode;
+    bool f_exec = true;
+    for (bool b : vf_exec) f_exec &= b;
+
+    if (o.has_data && o.data.size() > kMaxElementSize)
+      throw ScriptErr("push_size");
+    if (opcode > OP_16 && opcode != OP_ASSET) {
+      if (++op_count > kMaxOps) throw ScriptErr("op_count");
+    }
+    if (is_disabled(opcode)) throw ScriptErr("disabled_opcode");
+
+    if (f_exec && opcode >= 0 && opcode <= OP_PUSHDATA4) {
+      if (minimal && !minimal_push(o.data, opcode))
+        throw ScriptErr("minimaldata");
+      stack.push_back(o.data);
+      continue;
+    }
+    if (!(f_exec || (OP_IF <= opcode && opcode <= OP_ENDIF))) continue;
+
+    switch (opcode) {
+      case OP_IF:
+      case OP_NOTIF: {
+        bool value = false;
+        if (f_exec) {
+          Bytes top = popstack();
+          if ((flags & VERIFY_MINIMALIF) &&
+              !(top.empty() || (top.size() == 1 && top[0] == 1)))
+            throw ScriptErr("minimalif");
+          value = cast_to_bool(top);
+          if (opcode == OP_NOTIF) value = !value;
+        }
+        vf_exec.push_back(value);
+        break;
+      }
+      case OP_ELSE:
+        if (vf_exec.empty()) throw ScriptErr("unbalanced_conditional");
+        vf_exec.back() = !vf_exec.back();
+        break;
+      case OP_ENDIF:
+        if (vf_exec.empty()) throw ScriptErr("unbalanced_conditional");
+        vf_exec.pop_back();
+        break;
+      case OP_VERIF:
+      case OP_VERNOTIF:
+        throw ScriptErr("bad_opcode");
+
+      case OP_1NEGATE:
+        stack.push_back(num_encode(-1));
+        break;
+
+      case OP_NOP:
+        break;
+      case OP_CHECKLOCKTIMEVERIFY: {
+        if (!(flags & VERIFY_CHECKLOCKTIMEVERIFY)) {
+          if (flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS)
+            throw ScriptErr("discourage_upgradable_nops");
+          break;
+        }
+        need(1);
+        int64_t lock = num_decode(stack.back(), 5, minimal);
+        if (lock < 0) throw ScriptErr("negative_locktime");
+        if (!checker.check_locktime(lock))
+          throw ScriptErr("unsatisfied_locktime");
+        break;
+      }
+      case OP_CHECKSEQUENCEVERIFY: {
+        if (!(flags & VERIFY_CHECKSEQUENCEVERIFY)) {
+          if (flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS)
+            throw ScriptErr("discourage_upgradable_nops");
+          break;
+        }
+        need(1);
+        int64_t seq = num_decode(stack.back(), 5, minimal);
+        if (seq < 0) throw ScriptErr("negative_locktime");
+        if (!((uint64_t)seq & kSeqDisable)) {
+          if (!checker.check_sequence(seq))
+            throw ScriptErr("unsatisfied_locktime");
+        }
+        break;
+      }
+
+      case OP_VERIFY:
+        if (!cast_to_bool(popstack())) throw ScriptErr("verify");
+        break;
+      case OP_RETURN:
+        throw ScriptErr("op_return");
+
+      case OP_TOALTSTACK:
+        altstack.push_back(popstack());
+        break;
+      case OP_FROMALTSTACK:
+        if (altstack.empty()) throw ScriptErr("invalid_altstack_operation");
+        stack.push_back(std::move(altstack.back()));
+        altstack.pop_back();
+        break;
+      case OP_2DROP:
+        popstack();
+        popstack();
+        break;
+      case OP_2DUP: {
+        need(2);
+        Bytes a = stack[stack.size() - 2], b = stack[stack.size() - 1];
+        stack.push_back(a);
+        stack.push_back(b);
+        break;
+      }
+      case OP_3DUP: {
+        need(3);
+        Bytes a = stack[stack.size() - 3], b = stack[stack.size() - 2],
+              c = stack[stack.size() - 1];
+        stack.push_back(a);
+        stack.push_back(b);
+        stack.push_back(c);
+        break;
+      }
+      case OP_2OVER: {
+        need(4);
+        Bytes a = stack[stack.size() - 4], b = stack[stack.size() - 3];
+        stack.push_back(a);
+        stack.push_back(b);
+        break;
+      }
+      case OP_2ROT: {
+        need(6);
+        Bytes a = stack[stack.size() - 6], b = stack[stack.size() - 5];
+        stack.erase(stack.end() - 6, stack.end() - 4);
+        stack.push_back(a);
+        stack.push_back(b);
+        break;
+      }
+      case OP_2SWAP: {
+        need(4);
+        std::swap(stack[stack.size() - 4], stack[stack.size() - 2]);
+        std::swap(stack[stack.size() - 3], stack[stack.size() - 1]);
+        break;
+      }
+      case OP_IFDUP: {
+        need(1);
+        if (cast_to_bool(stack.back())) stack.push_back(stack.back());
+        break;
+      }
+      case OP_DEPTH:
+        stack.push_back(num_encode((int64_t)stack.size()));
+        break;
+      case OP_DROP:
+        popstack();
+        break;
+      case OP_DUP:
+        need(1);
+        stack.push_back(stack.back());
+        break;
+      case OP_NIP:
+        need(2);
+        stack.erase(stack.end() - 2);
+        break;
+      case OP_OVER:
+        need(2);
+        stack.push_back(stack[stack.size() - 2]);
+        break;
+      case OP_PICK:
+      case OP_ROLL: {
+        int64_t n = popnum(4);
+        if (n < 0 || (uint64_t)n >= stack.size())
+          throw ScriptErr("invalid_stack_operation");
+        Bytes v = stack[stack.size() - 1 - (size_t)n];
+        if (opcode == OP_ROLL)
+          stack.erase(stack.end() - 1 - (size_t)n);
+        stack.push_back(std::move(v));
+        break;
+      }
+      case OP_ROT: {
+        need(3);
+        Bytes a = stack[stack.size() - 3];
+        stack.erase(stack.end() - 3);
+        stack.push_back(std::move(a));
+        break;
+      }
+      case OP_SWAP:
+        need(2);
+        std::swap(stack[stack.size() - 2], stack[stack.size() - 1]);
+        break;
+      case OP_TUCK: {
+        need(2);
+        Bytes top = stack.back();
+        stack.insert(stack.end() - 2, std::move(top));
+        break;
+      }
+      case OP_SIZE:
+        need(1);
+        stack.push_back(num_encode((int64_t)stack.back().size()));
+        break;
+
+      case OP_EQUAL:
+      case OP_EQUALVERIFY: {
+        Bytes b2 = popstack();
+        Bytes b1 = popstack();
+        bool eq = b1 == b2;
+        if (opcode == OP_EQUALVERIFY) {
+          if (!eq) throw ScriptErr("equalverify");
+        } else {
+          stack.push_back(eq ? kTrue : kFalse);
+        }
+        break;
+      }
+      case OP_RESERVED:
+      case OP_RESERVED1:
+      case OP_RESERVED2:
+      case OP_VER:
+        throw ScriptErr("bad_opcode");
+
+      case OP_1ADD: case OP_1SUB: case OP_NEGATE: case OP_ABS:
+      case OP_NOT: case OP_0NOTEQUAL: {
+        int64_t n = popnum(4);
+        switch (opcode) {
+          case OP_1ADD: n += 1; break;
+          case OP_1SUB: n -= 1; break;
+          case OP_NEGATE: n = -n; break;
+          case OP_ABS: n = n < 0 ? -n : n; break;
+          case OP_NOT: n = (n == 0); break;
+          default: n = (n != 0); break;
+        }
+        stack.push_back(num_encode(n));
+        break;
+      }
+      case OP_ADD: case OP_SUB: case OP_BOOLAND: case OP_BOOLOR:
+      case OP_NUMEQUAL: case OP_NUMEQUALVERIFY: case OP_NUMNOTEQUAL:
+      case OP_LESSTHAN: case OP_GREATERTHAN: case OP_LESSTHANOREQUAL:
+      case OP_GREATERTHANOREQUAL: case OP_MIN: case OP_MAX: {
+        int64_t n2 = popnum(4);
+        int64_t n1 = popnum(4);
+        int64_t r;
+        switch (opcode) {
+          case OP_ADD: r = n1 + n2; break;
+          case OP_SUB: r = n1 - n2; break;
+          case OP_BOOLAND: r = (n1 != 0 && n2 != 0); break;
+          case OP_BOOLOR: r = (n1 != 0 || n2 != 0); break;
+          case OP_NUMEQUAL: case OP_NUMEQUALVERIFY: r = (n1 == n2); break;
+          case OP_NUMNOTEQUAL: r = (n1 != n2); break;
+          case OP_LESSTHAN: r = (n1 < n2); break;
+          case OP_GREATERTHAN: r = (n1 > n2); break;
+          case OP_LESSTHANOREQUAL: r = (n1 <= n2); break;
+          case OP_GREATERTHANOREQUAL: r = (n1 >= n2); break;
+          case OP_MIN: r = n1 < n2 ? n1 : n2; break;
+          default: r = n1 > n2 ? n1 : n2; break;
+        }
+        if (opcode == OP_NUMEQUALVERIFY) {
+          if (!r) throw ScriptErr("numequalverify");
+        } else {
+          stack.push_back(num_encode(r));
+        }
+        break;
+      }
+      case OP_WITHIN: {
+        int64_t n3 = popnum(4);
+        int64_t n2 = popnum(4);
+        int64_t n1 = popnum(4);
+        stack.push_back((n2 <= n1 && n1 < n3) ? kTrue : kFalse);
+        break;
+      }
+
+      case OP_RIPEMD160: case OP_SHA1: case OP_SHA256:
+      case OP_HASH160: case OP_HASH256: {
+        Bytes v = popstack();
+        Bytes h;
+        if (opcode == OP_RIPEMD160) {
+          h.resize(20); ripemd160(v.data(), v.size(), h.data());
+        } else if (opcode == OP_SHA1) {
+          h.resize(20); sha1(v.data(), v.size(), h.data());
+        } else if (opcode == OP_SHA256) {
+          h.resize(32); sha256(v.data(), v.size(), h.data());
+        } else if (opcode == OP_HASH160) {
+          h.resize(20); hash160(v.data(), v.size(), h.data());
+        } else {
+          h.resize(32); sha256d(v.data(), v.size(), h.data());
+        }
+        stack.push_back(std::move(h));
+        break;
+      }
+      case OP_CODESEPARATOR:
+        begincode = o.offset + 1;
+        break;
+      case OP_CHECKSIG:
+      case OP_CHECKSIGVERIFY: {
+        Bytes pubkey = popstack();
+        Bytes sig = popstack();
+        Bytes subscript(raw.begin() + begincode, raw.end());
+        subscript = find_and_delete(subscript, build_push(sig));
+        check_sig_encoding(sig, flags);
+        check_pubkey_encoding(pubkey, flags);
+        bool ok = checker.check_sig(sig, pubkey, subscript);
+        if (!ok && (flags & VERIFY_NULLFAIL) && !sig.empty())
+          throw ScriptErr("nullfail");
+        if (opcode == OP_CHECKSIGVERIFY) {
+          if (!ok) throw ScriptErr("checksigverify");
+        } else {
+          stack.push_back(ok ? kTrue : kFalse);
+        }
+        break;
+      }
+      case OP_CHECKMULTISIG:
+      case OP_CHECKMULTISIGVERIFY: {
+        int64_t n_keys = popnum(4);
+        if (n_keys < 0 || n_keys > kMaxPubkeys)
+          throw ScriptErr("pubkey_count");
+        op_count += (int)n_keys;
+        if (op_count > kMaxOps) throw ScriptErr("op_count");
+        std::vector<Bytes> keys;
+        for (int64_t k = 0; k < n_keys; ++k) keys.push_back(popstack());
+        int64_t n_sigs = popnum(4);
+        if (n_sigs < 0 || n_sigs > n_keys) throw ScriptErr("sig_count");
+        std::vector<Bytes> sigs;
+        for (int64_t k = 0; k < n_sigs; ++k) sigs.push_back(popstack());
+        Bytes subscript(raw.begin() + begincode, raw.end());
+        for (const Bytes& sig : sigs)
+          subscript = find_and_delete(subscript, build_push(sig));
+        bool ok = true;
+        size_t ikey = 0, isig = 0;
+        while (isig < sigs.size() && ok) {
+          if (ikey >= keys.size()) {
+            ok = false;
+            break;
+          }
+          const Bytes& sig = sigs[isig];
+          const Bytes& key = keys[ikey];
+          check_sig_encoding(sig, flags);
+          check_pubkey_encoding(key, flags);
+          if (checker.check_sig(sig, key, subscript)) ++isig;
+          ++ikey;
+          if (sigs.size() - isig > keys.size() - ikey) ok = false;
+        }
+        if (!ok && (flags & VERIFY_NULLFAIL)) {
+          for (const Bytes& s : sigs)
+            if (!s.empty()) throw ScriptErr("nullfail");
+        }
+        Bytes dummy = popstack();
+        if ((flags & VERIFY_NULLDUMMY) && !dummy.empty())
+          throw ScriptErr("sig_nulldummy");
+        if (opcode == OP_CHECKMULTISIGVERIFY) {
+          if (!ok) throw ScriptErr("checkmultisigverify");
+        } else {
+          stack.push_back(ok ? kTrue : kFalse);
+        }
+        break;
+      }
+
+      case OP_ASSET:
+        break;  // envelope: trailing payload consumed as data by the parser
+
+      default:
+        if (opcode >= OP_1 && opcode <= OP_16) {
+          stack.push_back(num_encode(opcode - (OP_1 - 1)));
+        } else if (opcode == OP_NOP1 ||
+                   (opcode >= OP_NOP4 && opcode <= OP_NOP10)) {
+          if (flags & VERIFY_DISCOURAGE_UPGRADABLE_NOPS)
+            throw ScriptErr("discourage_upgradable_nops");
+        } else {
+          throw ScriptErr("bad_opcode");
+        }
+    }
+
+    if (stack.size() + altstack.size() > 1000) throw ScriptErr("stack_size");
+  }
+  if (!vf_exec.empty()) throw ScriptErr("unbalanced_conditional");
+}
+
+static bool verify_script(const Bytes& script_sig, const Bytes& script_pubkey,
+                          unsigned flags, const Checker& checker) {
+  if ((flags & VERIFY_SIGPUSHONLY) && !is_push_only(script_sig)) return false;
+  std::vector<Bytes> stack;
+  try {
+    eval(stack, script_sig, flags, checker);
+    std::vector<Bytes> stack_copy;
+    if (flags & VERIFY_P2SH) stack_copy = stack;
+    eval(stack, script_pubkey, flags, checker);
+    if (stack.empty() || !cast_to_bool(stack.back())) return false;
+    if ((flags & VERIFY_P2SH) && is_p2sh(script_pubkey)) {
+      if (!is_push_only(script_sig)) return false;
+      stack = std::move(stack_copy);
+      if (stack.empty()) return false;
+      Bytes redeem = std::move(stack.back());
+      stack.pop_back();
+      eval(stack, redeem, flags, checker);
+      if (stack.empty() || !cast_to_bool(stack.back())) return false;
+    }
+    if (flags & VERIFY_CLEANSTACK) {
+      if (stack.size() != 1) return false;
+    }
+  } catch (const ScriptErr&) {
+    return false;
+  }
+  return true;
+}
+
+}  // namespace nxcons
+
+extern "C" {
+
+// Error codes mirror cloreconsensus_error (ref script/cloreconsensus.h)
+enum {
+  NXK_CONSENSUS_ERR_OK = 0,
+  NXK_CONSENSUS_ERR_TX_INDEX = 1,
+  NXK_CONSENSUS_ERR_TX_SIZE_MISMATCH = 2,
+  NXK_CONSENSUS_ERR_TX_DESERIALIZE = 3,
+};
+
+// Verify that the nIn-th input of txTo (serialized) correctly spends
+// scriptPubKey under the given flags.  Returns 1 if the script verifies.
+// (ref cloreconsensus_verify_script, script/cloreconsensus.cpp:71)
+int nxk_verify_script(const uint8_t* script_pubkey, unsigned spk_len,
+                      const uint8_t* tx_to, unsigned tx_len, unsigned n_in,
+                      unsigned flags, int* err) {
+  using namespace nxcons;
+  if (err) *err = NXK_CONSENSUS_ERR_OK;
+  Tx tx;
+  try {
+    tx = parse_tx(tx_to, tx_len);
+  } catch (const ScriptErr&) {
+    if (err) *err = NXK_CONSENSUS_ERR_TX_DESERIALIZE;
+    return 0;
+  }
+  if (n_in >= tx.vin.size()) {
+    if (err) *err = NXK_CONSENSUS_ERR_TX_INDEX;
+    return 0;
+  }
+  Bytes spk(script_pubkey, script_pubkey + spk_len);
+  Checker checker(tx, n_in);
+  return verify_script(tx.vin[n_in].script_sig, spk, flags, checker) ? 1 : 0;
+}
+
+unsigned nxk_consensus_version(void) { return 1; }
+
+}  // extern "C"

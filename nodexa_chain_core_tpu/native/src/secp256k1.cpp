@@ -372,6 +372,263 @@ int nxk_ecmult(const uint8_t u1[32], const uint8_t u2[32],
   return 1;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Self-contained ECDSA verification for the embeddable consensus library
+// (native/src/consensus.cpp).  The Python node keeps using nxk_ecmult with
+// its own scalar bigints; this path adds the missing mod-n scalar
+// arithmetic and pubkey decompression so script verification can run with
+// no Python at all (ref src/pubkey.cpp CPubKey::Verify).
+
+namespace nxsecp {
+
+// 256-bit big-endian-limb-free helpers over uint64_t[4] (little-endian
+// limb order), used only for arithmetic mod the group order n.
+static const uint64_t kN[4] = {
+    0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL,
+    0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL,
+};
+
+struct U256 {
+  uint64_t v[4];
+};
+
+static int u_cmp(const U256& a, const U256& b) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] != b.v[i]) return a.v[i] < b.v[i] ? -1 : 1;
+  }
+  return 0;
+}
+
+static bool u_is_zero(const U256& a) {
+  return !(a.v[0] | a.v[1] | a.v[2] | a.v[3]);
+}
+
+static uint64_t u_add(U256& r, const U256& a, const U256& b) {
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 4; ++i) {
+    c += (unsigned __int128)a.v[i] + b.v[i];
+    r.v[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  return (uint64_t)c;
+}
+
+static uint64_t u_sub(U256& r, const U256& a, const U256& b) {
+  unsigned __int128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 d =
+        (unsigned __int128)a.v[i] - b.v[i] - (uint64_t)borrow;
+    r.v[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  return (uint64_t)borrow;
+}
+
+static void u_shr1(U256& a) {
+  for (int i = 0; i < 4; ++i) {
+    a.v[i] >>= 1;
+    if (i < 3) a.v[i] |= a.v[i + 1] << 63;
+  }
+}
+
+static void u_from_bytes(U256& r, const uint8_t b[32]) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; ++j) v = (v << 8) | b[(3 - i) * 8 + j];
+    r.v[i] = v;
+  }
+}
+
+static void u_to_bytes(uint8_t b[32], const U256& a) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t v = a.v[i];
+    for (int j = 7; j >= 0; --j) {
+      b[(3 - i) * 8 + j] = (uint8_t)v;
+      v >>= 8;
+    }
+  }
+}
+
+static const U256 kNU = {{kN[0], kN[1], kN[2], kN[3]}};
+
+// (a * b) mod n via 512-bit product + shift-subtract reduction: ~512
+// iterations of add/sub — microseconds, and this path runs twice per
+// signature, far from any hot loop.
+static void n_mulmod(U256& r, const U256& a, const U256& b) {
+  uint64_t prod[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      carry += (unsigned __int128)a.v[i] * b.v[j] + prod[i + j];
+      prod[i + j] = (uint64_t)carry;
+      carry >>= 64;
+    }
+    prod[i + 4] = (uint64_t)carry;
+  }
+  // rem = prod mod n, processing bits from the top
+  U256 rem = {{0, 0, 0, 0}};
+  for (int bit = 511; bit >= 0; --bit) {
+    uint64_t top = rem.v[3] >> 63;
+    for (int i = 3; i > 0; --i) rem.v[i] = (rem.v[i] << 1) | (rem.v[i - 1] >> 63);
+    rem.v[0] = (rem.v[0] << 1) | ((prod[bit / 64] >> (bit % 64)) & 1);
+    if (top || u_cmp(rem, kNU) >= 0) u_sub(rem, rem, kNU);
+  }
+  r = rem;
+}
+
+// modular inverse mod n (binary extended gcd; n is prime and odd)
+static bool n_inv(U256& r, const U256& a0) {
+  if (u_is_zero(a0)) return false;
+  U256 u = a0, v = kNU;
+  U256 x1 = {{1, 0, 0, 0}}, x2 = {{0, 0, 0, 0}};
+  while (!u_is_zero(u) && !(u.v[0] == 1 && !(u.v[1] | u.v[2] | u.v[3]))) {
+    if (u_is_zero(v) || (v.v[0] == 1 && !(v.v[1] | v.v[2] | v.v[3]))) break;
+    while (!(u.v[0] & 1)) {
+      u_shr1(u);
+      if (x1.v[0] & 1) {
+        uint64_t c = u_add(x1, x1, kNU);
+        u_shr1(x1);
+        if (c) x1.v[3] |= 1ULL << 63;
+      } else {
+        u_shr1(x1);
+      }
+    }
+    while (!(v.v[0] & 1)) {
+      u_shr1(v);
+      if (x2.v[0] & 1) {
+        uint64_t c = u_add(x2, x2, kNU);
+        u_shr1(x2);
+        if (c) x2.v[3] |= 1ULL << 63;
+      } else {
+        u_shr1(x2);
+      }
+    }
+    if (u_cmp(u, v) >= 0) {
+      u_sub(u, u, v);
+      if (u_sub(x1, x1, x2)) u_add(x1, x1, kNU);
+    } else {
+      u_sub(v, v, u);
+      if (u_sub(x2, x2, x1)) u_add(x2, x2, kNU);
+    }
+  }
+  if (u.v[0] == 1 && !(u.v[1] | u.v[2] | u.v[3])) {
+    r = x1;
+    return true;
+  }
+  if (v.v[0] == 1 && !(v.v[1] | v.v[2] | v.v[3])) {
+    r = x2;
+    return true;
+  }
+  return false;
+}
+
+// sqrt mod p via a^((p+1)/4) (p = 3 mod 4); returns false if no root
+static bool fe_sqrt(Fe& r, const Fe& a) {
+  // (p+1)/4 big-endian bytes
+  static const uint8_t kExp[32] = {
+      0x3F, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xBF, 0xFF, 0xFF, 0x0C,
+  };
+  Fe acc = kFeOne;
+  bool started = false;
+  for (int byte = 0; byte < 32; ++byte) {
+    for (int bit = 7; bit >= 0; --bit) {
+      if (started) {
+        Fe t;
+        fe_sqr(t, acc);
+        acc = t;
+      }
+      if ((kExp[byte] >> bit) & 1) {
+        if (started) {
+          Fe t;
+          fe_mul(t, acc, a);
+          acc = t;
+        } else {
+          acc = a;
+          started = true;
+        }
+      }
+    }
+  }
+  Fe chk;
+  fe_sqr(chk, acc);
+  Fe diff;
+  fe_sub(diff, chk, a);
+  if (!fe_is_zero(diff)) return false;
+  r = acc;
+  return true;
+}
+
+static bool pubkey_load(Fe& x, Fe& y, const uint8_t* pub, unsigned len) {
+  if (len == 65 && pub[0] == 0x04) {
+    fe_from_bytes(x, pub + 1);
+    fe_from_bytes(y, pub + 33);
+    return true;
+  }
+  if (len == 33 && (pub[0] == 0x02 || pub[0] == 0x03)) {
+    fe_from_bytes(x, pub + 1);
+    Fe x2, x3, rhs;
+    fe_sqr(x2, x);
+    fe_mul(x3, x2, x);
+    Fe seven = {{7, 0, 0, 0}};
+    fe_add(rhs, x3, seven);
+    if (!fe_sqrt(y, rhs)) return false;
+    uint8_t yb[32];
+    fe_to_bytes(yb, y);
+    if ((yb[31] & 1) != (pub[0] & 1)) {
+      Fe zero = {{0, 0, 0, 0}};
+      fe_sub(y, zero, y);
+    }
+    return true;
+  }
+  return false;
+}
+
+}  // namespace nxsecp
+
+extern "C" {
+
+int nxk_ec_on_curve(const uint8_t x[32], const uint8_t y[32]);
+
+// ECDSA verify with raw (r, s) scalars against a 32-byte message digest.
+// pubkey is SEC1 compressed or uncompressed.  Returns 1 on a valid
+// signature.  (ref pubkey.cpp CPubKey::Verify -> secp256k1_ecdsa_verify)
+int nxk_ecdsa_verify_rs(const uint8_t digest[32], const uint8_t r32[32],
+                        const uint8_t s32[32], const uint8_t* pubkey,
+                        unsigned pubkey_len) {
+  using namespace nxsecp;
+  U256 r, s, z;
+  u_from_bytes(r, r32);
+  u_from_bytes(s, s32);
+  u_from_bytes(z, digest);
+  if (u_is_zero(r) || u_is_zero(s)) return 0;
+  if (u_cmp(r, kNU) >= 0 || u_cmp(s, kNU) >= 0) return 0;
+  if (u_cmp(z, kNU) >= 0) u_sub(z, z, kNU);
+  Fe qx, qy;
+  if (!pubkey_load(qx, qy, pubkey, pubkey_len)) return 0;
+  uint8_t qxb[32], qyb[32];
+  fe_to_bytes(qxb, qx);
+  fe_to_bytes(qyb, qy);
+  if (!nxk_ec_on_curve(qxb, qyb)) return 0;
+  U256 w;
+  if (!n_inv(w, s)) return 0;
+  U256 u1, u2;
+  n_mulmod(u1, z, w);
+  n_mulmod(u2, r, w);
+  uint8_t u1b[32], u2b[32], outx[32], outy[32];
+  u_to_bytes(u1b, u1);
+  u_to_bytes(u2b, u2);
+  if (!nxk_ecmult(u1b, u2b, qxb, qyb, outx, outy)) return 0;
+  U256 rx;
+  u_from_bytes(rx, outx);
+  // x(R) may exceed n; compare mod n (ref the standard verify final step)
+  if (u_cmp(rx, kNU) >= 0) u_sub(rx, rx, kNU);
+  return u_cmp(rx, r) == 0 ? 1 : 0;
+}
+
 // y^2 = x^3 + 7 check for a candidate affine point (32-byte BE coords).
 int nxk_ec_on_curve(const uint8_t x[32], const uint8_t y[32]) {
   using namespace nxsecp;

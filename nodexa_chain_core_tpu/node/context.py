@@ -81,6 +81,14 @@ class NodeContext:
                 dump_mempool(self.mempool, dat)
             except OSError:
                 pass  # a failed dump must not abort the rest of shutdown
+        fee_path = getattr(self, "fee_estimates_path", None)
+        if fee_path is not None:
+            from ..chain.fees import fee_estimator
+
+            try:  # ref Shutdown() flushing fee_estimates.dat
+                fee_estimator.write_file(fee_path)
+            except OSError:
+                pass
         self.message_store.flush()
         self.rewards.flush()
         main_signals.unregister(self.message_store)

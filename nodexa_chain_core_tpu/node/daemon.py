@@ -145,6 +145,15 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         if n:
             log_printf("loaded %d transactions from mempool.dat", n)
 
+    # fee_estimates.dat: learned confirmation stats survive restarts
+    # (ref CBlockPolicyEstimator::Read, init.cpp Step 7 / fees.cpp:916)
+    from ..chain.fees import fee_estimator
+
+    node.fee_estimates_path = os.path.join(datadir, "fee_estimates.dat")
+    if fee_estimator.read_file(node.fee_estimates_path):
+        log_printf("loaded fee estimates (best height %d)",
+                   fee_estimator.best_height)
+
     # External observability: pub socket + shell hooks (ref src/zmq/,
     # -blocknotify)
     pub_port = g_args.get_int("pubport", -1)

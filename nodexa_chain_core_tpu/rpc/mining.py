@@ -211,8 +211,9 @@ def getblocktemplate(node, params: List[Any]):
         hh_hex = block.header.kawpow_header_hash(sched)[::-1].hex()
         result["pprpcheader"] = hh_hex
         result["pprpcepoch"] = epoch_number(tip.height + 1)
-        if len(templates) > 64:  # bounded (ref clears on tip change)
-            templates.clear()
+        while len(templates) > 64:  # bounded: evict oldest, never a
+            # recently served header a miner may still be sweeping
+            templates.pop(next(iter(templates)))
         templates[hh_hex] = block
         node.kawpow_last_pprpc_header = hh_hex
     return result
@@ -229,7 +230,13 @@ def _mining_address_script(node):
         return script_for_destination(
             decode_destination(str(addr), node.params)
         ).raw
-    except Exception:
+    except Exception as e:
+        # a typo'd -miningaddress silently killing the pprpc handshake is
+        # undebuggable; say so (the reference errors out at init)
+        from ..utils.logging import log_printf
+
+        log_printf("WARNING: invalid -miningaddress %r (%s): kawpow pool "
+                   "mining handshake disabled", addr, e)
         return None
 
 

@@ -178,6 +178,10 @@ def gettxoutproof(node, params: List[Any]):
         idx = cs.lookup(u256_from_hex(str(params[1])))
         if idx is None:
             raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+        if not idx.status & BlockStatus.HAVE_DATA:
+            raise RPCError(
+                RPC_INVALID_ADDRESS_OR_KEY, "Block not available"
+            )
     else:
         for cand in cs.active:
             if not cand.status & BlockStatus.HAVE_DATA:

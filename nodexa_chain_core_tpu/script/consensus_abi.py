@@ -1,0 +1,51 @@
+"""Embeddable consensus ABI — Python face of the native verify_script.
+
+The reference installs libcloreconsensus (script/cloreconsensus.cpp +
+libcloreconsensus.pc.in) so external software can verify spends without
+running a node; this framework exports the same capability from its native
+library as ``nxk_verify_script`` (native/src/consensus.cpp, a clean-room
+C++ port of script/interpreter.py's VM).  This module is both the in-tree
+consumer and the usage documentation for C embedders:
+
+.. code-block:: c
+
+    int err = 0;
+    int ok = nxk_verify_script(spk, spk_len, tx_bytes, tx_len,
+                               input_index, flags, &err);
+
+Flags are the VERIFY_* bits from script/interpreter.py (P2SH = 1,
+DERSIG = 4, CHECKLOCKTIMEVERIFY = 512, ... — the same wire values the
+reference's API uses for its shared subset).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+from .. import native
+
+ERR_OK = 0
+ERR_TX_INDEX = 1
+ERR_TX_SIZE_MISMATCH = 2
+ERR_TX_DESERIALIZE = 3
+
+
+def available() -> bool:
+    return native.available()
+
+
+def verify_script(script_pubkey: bytes, tx_bytes: bytes, n_in: int,
+                  flags: int) -> Tuple[bool, int]:
+    """Native script verification for input `n_in` of a serialized tx.
+
+    Returns (ok, err) where err is an ERR_* input-validation code (script
+    FAILURES are just ok=False with ERR_OK, like the reference ABI).
+    """
+    lib = native.load()
+    err = ctypes.c_int(0)
+    ok = lib.nxk_verify_script(
+        bytes(script_pubkey), len(script_pubkey), bytes(tx_bytes),
+        len(tx_bytes), n_in, flags, ctypes.byref(err),
+    )
+    return bool(ok), err.value

@@ -1,0 +1,233 @@
+"""Differential testing: the native consensus ABI vs the Python script VM.
+
+The embeddable library (native/src/consensus.cpp, ref libcloreconsensus)
+is a second implementation of consensus-critical code, so every case here
+runs through BOTH VMs and their verdicts must agree — real signed spends
+(P2PKH/P2SH/multisig, every sighash type), CLTV/CSV, and a corpus of
+hand-built edge-case scripts exercising numerics, stack ops, conditionals,
+hashing and failure modes.
+"""
+
+import hashlib
+
+import pytest
+
+from nodexa_chain_core_tpu import native
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script import consensus_abi
+from nodexa_chain_core_tpu.script import interpreter as interp
+from nodexa_chain_core_tpu.script.interpreter import (
+    STANDARD_SCRIPT_VERIFY_FLAGS,
+    VERIFY_P2SH,
+    TransactionSignatureChecker,
+    verify_script,
+)
+from nodexa_chain_core_tpu.script.script import Script
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import (
+    KeyID,
+    ScriptID,
+    multisig_script,
+    p2pkh_script,
+    p2sh_script,
+)
+from nodexa_chain_core_tpu.script import opcodes as op
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def both(script_sig: Script, script_pubkey: Script, tx: Transaction,
+         n_in: int, flags: int) -> bool:
+    """Run both VMs; assert agreement; return the shared verdict."""
+    tx.vin[n_in].script_sig = script_sig.raw
+    py_ok, py_err = verify_script(
+        script_sig, script_pubkey, flags,
+        TransactionSignatureChecker(tx, n_in),
+    )
+    native_ok, err = consensus_abi.verify_script(
+        script_pubkey.raw, tx.to_bytes(), n_in, flags
+    )
+    assert err == consensus_abi.ERR_OK
+    assert native_ok == py_ok, (
+        f"VM divergence: python={py_ok} ({py_err}) native={native_ok} "
+        f"sig={script_sig.raw.hex()} spk={script_pubkey.raw.hex()}"
+    )
+    return py_ok
+
+
+def spend_tx(script_pubkey: bytes, nout: int = 1) -> Transaction:
+    prev = Transaction(
+        version=2, vin=[TxIn(OutPoint(0, 0xFFFFFFFF), b"\x51")],
+        vout=[TxOut(50_000, script_pubkey) for _ in range(nout)],
+    )
+    return Transaction(
+        version=2,
+        vin=[TxIn(OutPoint(prev.txid, 0), b"")],
+        vout=[TxOut(49_000, b"\x6a")],
+    )
+
+
+@pytest.fixture(scope="module")
+def keys():
+    ks = KeyStore()
+    kids = [ks.add_key(0x1000 + i) for i in range(3)]
+    return ks, kids
+
+
+def test_p2pkh_all_sighash_types(keys):
+    ks, kids = keys
+    spk = p2pkh_script(KeyID(kids[0]))
+    for hashtype in (0x01, 0x02, 0x03, 0x81, 0x82, 0x83):
+        tx = spend_tx(spk.raw)
+        sign_tx_input(ks, tx, 0, spk, hashtype=hashtype)
+        assert both(Script(tx.vin[0].script_sig), spk, tx, 0,
+                    STANDARD_SCRIPT_VERIFY_FLAGS)
+    # corrupt signature fails identically
+    tx = spend_tx(spk.raw)
+    sign_tx_input(ks, tx, 0, spk)
+    sig = bytearray(tx.vin[0].script_sig)
+    sig[10] ^= 1
+    assert not both(Script(bytes(sig)), spk, tx, 0, VERIFY_P2SH)
+
+
+def test_p2sh_multisig(keys):
+    ks, kids = keys
+    pubs = [ks.pubs()[k] for k in kids]
+    redeem = multisig_script(2, pubs)
+    sid = ks.add_script(redeem)
+    spk = p2sh_script(ScriptID(sid))
+    tx = spend_tx(spk.raw)
+    sign_tx_input(ks, tx, 0, spk)
+    assert both(Script(tx.vin[0].script_sig), spk, tx, 0,
+                STANDARD_SCRIPT_VERIFY_FLAGS)
+    # drop one signature: 2-of-3 unmet, same verdict both sides
+    partial = Script(tx.vin[0].script_sig)
+    ops = list(partial.ops())
+    stripped = Script(
+        b"".join(Script.build(o.data).raw if o.data is not None else
+                 bytes([o.opcode]) for o in ops[:-2] + ops[-1:])
+    )
+    assert not both(stripped, spk, tx, 0, VERIFY_P2SH)
+
+
+def test_cltv_csv(keys):
+    ks, kids = keys
+    from nodexa_chain_core_tpu.script.script import script_num_encode
+
+    flags = (VERIFY_P2SH | interp.VERIFY_CHECKLOCKTIMEVERIFY
+             | interp.VERIFY_CHECKSEQUENCEVERIFY)
+    # CLTV: tx locktime 100, script demands 90 (ok) and 200 (fail)
+    for demand, want in ((90, True), (200, False)):
+        spk = Script(
+            Script.build(script_num_encode(demand)).raw
+            + bytes([op.OP_CHECKLOCKTIMEVERIFY, op.OP_DROP, op.OP_1])
+        )
+        tx = spend_tx(spk.raw)
+        tx.locktime = 100
+        tx.vin[0].sequence = 0xFFFFFFFE
+        assert both(Script(b""), spk, tx, 0, flags) is want
+    # CSV: input sequence 50, script demands 40 (ok) and 60 (fail)
+    for demand, want in ((40, True), (60, False)):
+        spk = Script(
+            Script.build(script_num_encode(demand)).raw
+            + bytes([op.OP_CHECKSEQUENCEVERIFY, op.OP_DROP, op.OP_1])
+        )
+        tx = spend_tx(spk.raw)
+        tx.vin[0].sequence = 50
+        assert both(Script(b""), spk, tx, 0, flags) is want
+
+
+CORPUS = [
+    # (script_sig hex-ish ops, script_pubkey ops, expected)
+    (b"\x51\x52", b"\x93\x53\x87", True),            # 1 2 ADD 3 EQUAL
+    (b"\x51\x52", b"\x93\x54\x87", False),
+    (b"\x00", b"\x63\x51\x67\x52\x68", True),        # IF 1 ELSE 2 ENDIF -> 2
+    (b"\x51", b"\x63\x51\x67\x00\x68", True),
+    (b"\x4f", b"\x90\x51\x87", True),                # -1 ABS 1 EQUAL
+    (b"\x51\x51\x51", b"\x7b\x7c\x7d\x75\x75\x75\x51", True),  # rot/swap/tuck churn
+    (b"\x05hello", b"\xa8" + b"\x20" + hashlib.sha256(b"hello").digest() + b"\x87", True),
+    (b"\x05hello", b"\xaa" + b"\x20" + hashlib.sha256(hashlib.sha256(b"hello").digest()).digest() + b"\x87", True),
+    (b"\x05hello", b"\xa7" + b"\x14" + hashlib.sha1(b"hello").digest() + b"\x87", True),
+    (b"", b"\x6a", False),                            # OP_RETURN
+    (b"\x51", b"\x61\x61\x51\x87", True),             # NOPs
+    (b"\x51", b"\x95", False),                        # disabled OP_MUL
+    (b"\x51\x52\x53", b"\x74\x53\x87\x69\x75\x75\x75\x51", True),  # DEPTH
+    (b"\x02\xe8\x03", b"\x02\xe8\x03\x9c", True),     # 1000 NUMEQUAL
+    (b"\x51", b"\x63\x68", False),                    # IF ENDIF -> empty stack... pops
+    (b"\x51", b"\x67", False),                        # bare ELSE
+    (b"\x51\x00", b"\x9a", False),                    # BOOLAND false -> eval_false
+    (b"\x51\x52\x53", b"\xa5\x91", True),             # WITHIN false, NOT -> 1
+    (b"\x01\x80", b"\x69", False),                    # negative zero is false -> VERIFY fails
+]
+
+
+def test_corpus_agreement():
+    for sig_raw, spk_raw, want in CORPUS:
+        spk = Script(spk_raw)
+        tx = spend_tx(spk_raw)
+        got = both(Script(sig_raw), spk, tx, 0, 0)
+        assert got is want, f"case {sig_raw.hex()}/{spk_raw.hex()}"
+
+
+def test_asset_envelope_agreement(keys):
+    """P2PKH + OP_ASSET envelope: the payload after OP_ASSET is one data
+    blob on both sides (ref script.h:582)."""
+    from nodexa_chain_core_tpu.crypto import secp256k1 as ec
+
+    ks, kids = keys
+    base = p2pkh_script(KeyID(kids[0]))
+    spk = Script(base.raw + bytes([op.OP_ASSET]) + b"nxa-payload-bytes")
+    tx = spend_tx(spk.raw)
+    # sign manually: the template solver refuses a malformed envelope, but
+    # the VM semantics (everything after OP_ASSET is one data blob) are
+    # what this test pins
+    digest = interp.signature_hash(spk, tx, 0, 0x01)
+    r, s = ec.sign(ks.get_priv(kids[0]), digest)
+    sig = ec.sig_to_der(r, s) + b"\x01"
+    pub = ks.get_pub(kids[0])
+    script_sig = Script(Script.build(sig).raw + Script.build(pub).raw)
+    assert both(script_sig, spk, tx, 0, VERIFY_P2SH)
+
+
+def test_input_validation_errors():
+    ok, err = consensus_abi.verify_script(b"\x51", b"garbage-not-a-tx", 0, 0)
+    assert not ok and err == consensus_abi.ERR_TX_DESERIALIZE
+    tx = spend_tx(b"\x51")
+    ok, err = consensus_abi.verify_script(b"\x51", tx.to_bytes(), 5, 0)
+    assert not ok and err == consensus_abi.ERR_TX_INDEX
+
+
+def test_random_script_fuzz_agreement():
+    """Structured random scripts: both VMs must agree on every one."""
+    import random
+
+    rng = random.Random(0xC0DE)
+    interesting = [0x00, 0x4f, 0x51, 0x52, 0x60, 0x63, 0x64, 0x67, 0x68,
+                   0x69, 0x6b, 0x6c, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78,
+                   0x79, 0x7a, 0x7b, 0x7c, 0x7d, 0x82, 0x87, 0x88, 0x8b,
+                   0x8c, 0x8f, 0x90, 0x91, 0x92, 0x93, 0x94, 0x9a, 0x9b,
+                   0x9c, 0x9e, 0x9f, 0xa0, 0xa1, 0xa2, 0xa3, 0xa4, 0xa5,
+                   0xa6, 0xa7, 0xa8, 0xa9, 0xaa, 0x61]
+    agree = 0
+    for _ in range(300):
+        n = rng.randint(1, 12)
+        body = bytearray()
+        for _ in range(n):
+            if rng.random() < 0.35:
+                blob = bytes(rng.randrange(256) for _ in range(rng.randint(0, 5)))
+                body += Script.build(blob).raw
+            else:
+                body.append(rng.choice(interesting))
+        spk = Script(bytes(body))
+        sig = Script(Script.build(b"\x01").raw * rng.randint(0, 3))
+        tx = spend_tx(spk.raw)
+        both(sig, spk, tx, 0, 0)
+        agree += 1
+    assert agree == 300
