@@ -260,13 +260,71 @@ async function viewWallet(){
 async function viewAssets(){
   const wrap = el("div");
   if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
-  const assets = await rpc("listassets",["*", true]);
+
+  // issue flow (ref src/qt/createassetdialog.cpp)
+  const issue = el("div",{class:"panel"});
+  const iname = el("input",{placeholder:"ASSET_NAME"});
+  const iqty = el("input",{placeholder:"qty",value:"1"});
+  const iunits = el("input",{placeholder:"units 0-8",value:"0"});
+  const ireis = el("select",{},el("option",{text:"reissuable",value:"1"}),
+    el("option",{text:"not reissuable",value:"0"}));
+  const ib = el("button",{class:"act",text:"issue"});
+  ib.onclick = async()=>{
+    if (!isFinite(parseFloat(iqty.value))) return toast("qty required", true);
+    try { const txid = await rpc("issue",[iname.value.trim(),
+        parseFloat(iqty.value), "", "", parseInt(iunits.value),
+        ireis.value==="1"]);
+      toast("issued: "+txid); render(); }
+    catch(e){ toast("issue failed: "+e.message); } };
+  issue.append(el("h3",{text:"issue asset"}), iname, el("span",{text:" "}),
+    iqty, el("span",{text:" "}), iunits, el("span",{text:" "}), ireis,
+    el("span",{text:" "}), ib,
+    el("p",{class:"mono",text:"burns the issuance fee; name rules per the asset layer"}));
+  wrap.append(issue);
+
+  // transfer flow (ref src/qt/sendassetsdialog / assetcontroldialog)
+  const xfer = el("div",{class:"panel"});
+  const tname = el("input",{placeholder:"ASSET_NAME"});
+  const tqty = el("input",{placeholder:"qty"});
+  const taddr = el("input",{placeholder:"to address",size:40});
+  const tbtn = el("button",{class:"act",text:"transfer"});
+  tbtn.onclick = async()=>{
+    if (!isFinite(parseFloat(tqty.value))) return toast("qty required", true);
+    try { const txid = await rpc("transfer",[tname.value.trim(),
+        parseFloat(tqty.value), taddr.value]);
+      toast("transferred: "+txid); render(); }
+    catch(e){ toast("transfer failed: "+e.message); } };
+  xfer.append(el("h3",{text:"transfer asset"}), tname, el("span",{text:" "}),
+    tqty, el("span",{text:" "}), taddr, el("span",{text:" "}), tbtn);
+  wrap.append(xfer);
+
+  // reissue flow (ref src/qt/reissueassetdialog.cpp)
+  const reis = el("div",{class:"panel"});
+  const rname = el("input",{placeholder:"ASSET_NAME"});
+  const rqty = el("input",{placeholder:"additional qty"});
+  const rbtn = el("button",{class:"act",text:"reissue"});
+  rbtn.onclick = async()=>{
+    if (!isFinite(parseFloat(rqty.value))) return toast("qty required", true);
+    try { const txid = await rpc("reissue",[rname.value.trim(),
+        parseFloat(rqty.value), ""]);
+      toast("reissued: "+txid); render(); }
+    catch(e){ toast("reissue failed: "+e.message); } };
+  reis.append(el("h3",{text:"reissue"}), rname, el("span",{text:" "}),
+    rqty, el("span",{text:" "}), rbtn);
+  wrap.append(reis);
+
+  const [assets, mine] = await Promise.all([
+    rpc("listassets",["*", true]),
+    rpc("listmyassets",["*"]).catch(()=>({})),
+  ]);
   const tb = el("tbody");
   for (const [name, a] of Object.entries(assets))
     tb.append(el("tr",{},el("td",{text:name}),el("td",{text:a.amount}),
-      el("td",{text:a.units}),el("td",{text:a.reissuable?"yes":"no"})));
+      el("td",{text:a.units}),el("td",{text:a.reissuable?"yes":"no"}),
+      el("td",{text:mine[name]??""})));
   wrap.append(el("table",{},el("thead",{},el("tr",{},el("th",{text:"asset"}),
-    el("th",{text:"amount"}),el("th",{text:"units"}),el("th",{text:"reissuable"}))),tb));
+    el("th",{text:"amount"}),el("th",{text:"units"}),
+    el("th",{text:"reissuable"}),el("th",{text:"balance"}))),tb));
   if (!Object.keys(assets).length) wrap.append(el("p",{class:"mono",text:"no assets issued"}));
   return wrap;
 }

@@ -3,7 +3,9 @@
 Vectors in tests/data/x16r_vectors.json: 11 per primitive (boundary
 lengths, 64-byte chaining inputs, 80-byte headers) and 10 chained header
 vectors per algorithm, generated from the reference implementations
-(ref src/hash.h:335,465, src/algo/*).
+(ref src/hash.h:335,465, src/algo/*) by the in-tree
+tools/generate_x16r_vectors.py — run it with --check to confirm the file
+reproduces bit-for-bit from the reference sources.
 """
 
 import json
