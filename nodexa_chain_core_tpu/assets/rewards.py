@@ -231,6 +231,13 @@ class RewardsEngine(ValidationInterface):
     def get_snapshot(self, asset_name: str, height: int) -> Optional[AssetSnapshot]:
         return self.snapshots.get((asset_name, height))
 
+    def purge_snapshot(self, asset_name: str, height: int) -> bool:
+        """ref rpc/rewards.cpp purgesnapshot -> pAssetSnapshotDb->Purge."""
+        gone = self.snapshots.pop((asset_name, height), None) is not None
+        if gone:
+            self.flush()
+        return gone
+
     def block_connected(self, block, index, txs_conflicted) -> None:
         due = [r for r in self.requests.values() if r.height == index.height]
         if not due or self._assets is None:

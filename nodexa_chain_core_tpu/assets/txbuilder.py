@@ -162,14 +162,18 @@ def build_issue(
 
 def build_transfer(
     wallet, name: str, amount: int, dest_h160: bytes,
-    message: bytes = b"", expire: int = 0,
+    message: bytes = b"", expire: int = 0, utxo_filter=None,
 ) -> Transaction:
-    """ref CreateTransferAssetTransaction."""
+    """ref CreateTransferAssetTransaction.  `utxo_filter(script_pubkey)`
+    restricts the spendable asset coins (ref transferfromaddress(es)'
+    pinned coin control)."""
     have = 0
     vin_assets: List[TxIn] = []
     src_script: Optional[Script] = None
     for op, txout, n, amt in _wallet_asset_utxos(wallet):
         if n != name:
+            continue
+        if utxo_filter is not None and not utxo_filter(txout.script_pubkey):
             continue
         vin_assets.append(TxIn(prevout=op, sequence=0xFFFFFFFE))
         if src_script is None:

@@ -1,0 +1,172 @@
+"""UPnP IGD port mapping (parity: reference src/net.cpp:1465 ThreadMapPort
+/ MapPort — miniupnpc-driven -upnp).
+
+Pure-stdlib implementation of the slice of UPnP the node needs: SSDP
+M-SEARCH discovery of an Internet Gateway Device, device-description
+fetch to find the WAN(IP|PPP)Connection control URL, then SOAP
+AddPortMapping (re-asserted every 20 minutes like the reference's
+PORT_MAPPING_REINTERVAL), GetExternalIPAddress to feed the local-address
+advertiser, and DeletePortMapping on shutdown.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import threading
+import urllib.request
+from typing import Optional, Tuple
+from urllib.parse import urljoin
+
+from ..utils.logging import log_printf
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+SSDP_ST = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+REMAP_INTERVAL = 20 * 60  # ref PORT_MAPPING_REINTERVAL
+
+
+def discover_igd(timeout: float = 2.0) -> Optional[str]:
+    """SSDP M-SEARCH; returns the IGD's description URL or None."""
+    msg = (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {SSDP_ADDR[0]}:{SSDP_ADDR[1]}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        "MX: 2\r\n"
+        f"ST: {SSDP_ST}\r\n\r\n"
+    ).encode()
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.sendto(msg, SSDP_ADDR)
+        try:
+            data, _ = s.recvfrom(4096)
+        except socket.timeout:
+            return None
+    m = re.search(rb"(?im)^location:\s*(\S+)", data)
+    return m.group(1).decode() if m else None
+
+
+def fetch_control_url(desc_url: str) -> Optional[Tuple[str, str]]:
+    """Parse the device description; returns (control_url, service_type)."""
+    with urllib.request.urlopen(desc_url, timeout=5) as r:
+        xml = r.read().decode(errors="replace")
+    for stype in SERVICE_TYPES:
+        # serviceType ... controlURL within the same <service> block
+        pat = (
+            r"<service>\s*<serviceType>"
+            + re.escape(stype)
+            + r"</serviceType>.*?<controlURL>([^<]+)</controlURL>"
+        )
+        m = re.search(pat, xml, re.S)
+        if m:
+            return urljoin(desc_url, m.group(1).strip()), stype
+    return None
+
+
+def _soap(control_url: str, stype: str, action: str, args: dict) -> str:
+    body = "".join(f"<{k}>{v}</{k}>" for k, v in args.items())
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{stype}">{body}</u:{action}>'
+        "</s:Body></s:Envelope>"
+    ).encode()
+    req = urllib.request.Request(
+        control_url, data=envelope,
+        headers={
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{stype}#{action}"',
+        },
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.read().decode(errors="replace")
+
+
+def _lan_address(desc_url: str) -> str:
+    """The local address routable toward the IGD (ref lanaddr)."""
+    host = re.match(r"https?://([^/:]+)", desc_url).group(1)
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.connect((host, 1900))
+        return s.getsockname()[0]
+
+
+class UPnPMapper:
+    """Background port-mapping thread (ref ThreadMapPort)."""
+
+    def __init__(self, port: int, on_external_ip=None):
+        self.port = port
+        self.on_external_ip = on_external_ip
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._control: Optional[Tuple[str, str]] = None
+        self._lan = ""
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="upnp", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=8)
+        if self._control is not None:
+            try:  # ref UPNP_DeletePortMapping on thread interrupt
+                _soap(*self._control, "DeletePortMapping", {
+                    "NewRemoteHost": "",
+                    "NewExternalPort": self.port,
+                    "NewProtocol": "TCP",
+                })
+                log_printf("UPnP: removed mapping for port %d", self.port)
+            except Exception:
+                pass
+
+    def _run(self) -> None:
+        try:
+            desc = discover_igd()
+            if desc is None:
+                log_printf("UPnP: no IGD found")
+                return
+            found = fetch_control_url(desc)
+            if found is None:
+                log_printf("UPnP: no WANIPConnection service at %s", desc)
+                return
+            self._control = found
+            self._lan = _lan_address(desc)
+        except Exception as e:
+            log_printf("UPnP: discovery failed: %r", e)
+            return
+        # external IP feeds the address advertiser (ref fDiscover branch)
+        try:
+            reply = _soap(*self._control, "GetExternalIPAddress", {})
+            m = re.search(
+                r"<NewExternalIPAddress>([^<]+)</NewExternalIPAddress>", reply
+            )
+            if m and self.on_external_ip:
+                self.on_external_ip(m.group(1).strip())
+            if m:
+                log_printf("UPnP: external IP %s", m.group(1).strip())
+        except Exception as e:
+            log_printf("UPnP: GetExternalIPAddress failed: %r", e)
+        while not self._stop.is_set():
+            try:
+                _soap(*self._control, "AddPortMapping", {
+                    "NewRemoteHost": "",
+                    "NewExternalPort": self.port,
+                    "NewProtocol": "TCP",
+                    "NewInternalPort": self.port,
+                    "NewInternalClient": self._lan,
+                    "NewEnabled": 1,
+                    "NewPortMappingDescription": "nodexa-chain-core_tpu",
+                    "NewLeaseDuration": 0,
+                })
+                log_printf("UPnP: mapped port %d -> %s:%d", self.port,
+                           self._lan, self.port)
+            except Exception as e:
+                log_printf("UPnP: AddPortMapping failed: %r", e)
+            self._stop.wait(REMAP_INTERVAL)

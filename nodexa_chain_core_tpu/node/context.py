@@ -68,6 +68,9 @@ class NodeContext:
         tor = getattr(self, "tor_controller", None)
         if tor is not None:
             tor.stop()
+        upnp = getattr(self, "upnp_mapper", None)
+        if upnp is not None:
+            upnp.stop()
         # stop the network first: blocks connected during teardown must
         # still reach the stores (they unregister only once no more events
         # can fire)

@@ -248,6 +248,17 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             )
             node.tor_controller.start()
 
+        # -upnp: IGD port mapping + external-IP discovery feeding the
+        # local-address advertiser (ref net.cpp:1465 ThreadMapPort)
+        if g_args.get_bool("upnp"):
+            from ..net.upnp import UPnPMapper
+
+            node.upnp_mapper = UPnPMapper(
+                port,
+                on_external_ip=lambda ip: node.connman.add_local(ip, port),
+            )
+            node.upnp_mapper.start()
+
         class _PeerNotifier(ValidationInterface):
             """Announce locally-found tips to peers (ref the
             PeerLogicValidation subscriber wiring)."""

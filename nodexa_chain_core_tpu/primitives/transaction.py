@@ -111,11 +111,13 @@ class Transaction(Serializable):
         w.u32(self.locktime)
 
     @classmethod
-    def deserialize(cls, r: ByteReader) -> "Transaction":
+    def deserialize(cls, r: ByteReader, allow_witness: bool = True
+                    ) -> "Transaction":
         version = r.i32()
         vin = r.vector(TxIn.deserialize)
         has_wit = False
-        if not vin and r.remaining() and r.peek(1) == b"\x01":
+        if (allow_witness and not vin and r.remaining()
+                and r.peek(1) == b"\x01"):
             # empty-vin + flag byte => segwit framing
             r.u8()
             has_wit = True
@@ -125,6 +127,27 @@ class Transaction(Serializable):
             for i in vin:
                 i.witness = r.vector(lambda rr: rr.var_bytes())
         return cls(version=version, vin=vin, vout=vout, locktime=r.u32())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Transaction":
+        """Tolerant decode: a genuinely empty-vin tx (e.g. the unfunded
+        input to fundrawtransaction) is framing-ambiguous with the segwit
+        marker; like the reference's DecodeHexTx, try extended framing
+        first and retry legacy on failure."""
+        from ..core.serialize import SerializationError
+
+        try:
+            r = ByteReader(data)
+            tx = cls.deserialize(r)
+            if r.remaining():
+                raise SerializationError("trailing tx bytes")
+            return tx
+        except SerializationError:
+            r = ByteReader(data)
+            tx = cls.deserialize(r, allow_witness=False)
+            if r.remaining():
+                raise SerializationError("trailing tx bytes")
+            return tx
 
     def to_bytes(self, with_witness: bool = True) -> bytes:
         w = ByteWriter()

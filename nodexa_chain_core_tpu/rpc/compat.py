@@ -1,0 +1,1019 @@
+"""RPC surface completion: the reference commands outside the core flows —
+the deprecated account API (label-backed, ref wallet/rpcwallet.cpp),
+introspection/diagnostic helpers (ref rpc/misc.cpp, rpc/net.cpp,
+rpc/blockchain.cpp), test hooks (setmocktime/echo), and asset extras.
+
+Grouped here rather than spread over the family files because these are
+surface-parity commands: thin, honest adapters over subsystems that
+already exist.  Reference citations sit on each handler.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List
+
+from ..core.amount import COIN
+from ..core.uint256 import u256_from_hex, u256_hex
+from ..script.script import Script
+from ..script.standard import (
+    KeyID,
+    decode_destination,
+    encode_destination,
+    extract_destination,
+    script_for_destination,
+)
+from .server import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPC_WALLET_ERROR,
+    RPCError,
+    RPCTable,
+)
+
+
+def _wallet(node):
+    if node.wallet is None:
+        raise RPCError(RPC_WALLET_ERROR, "wallet disabled")
+    return node.wallet
+
+
+# ------------------------------------------------------------- test hooks
+
+
+def echo(node, params: List[Any]):
+    """ref rpc/misc.cpp echo: returns its arguments (testing aid)."""
+    return params
+
+
+def echojson(node, params: List[Any]):
+    return params
+
+
+def setmocktime(node, params: List[Any]):
+    """ref rpc/misc.cpp setmocktime — pins adjusted time for tests."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "timestamp required")
+    from ..utils import timedata
+
+    t = int(params[0])
+    timedata.g_timedata.mocktime = t if t > 0 else None
+    return None
+
+
+# ----------------------------------------------------------------- network
+
+
+def ping(node, params: List[Any]):
+    """ref rpc/net.cpp ping: queue a ping round to every peer."""
+    if node.connman is None:
+        raise RPCError(RPC_MISC_ERROR, "p2p disabled")
+    node.connman.processor.send_pings()
+    return None
+
+
+def getaddednodeinfo(node, params: List[Any]):
+    """ref rpc/net.cpp getaddednodeinfo: manual (-addnode/RPC-added)
+    peers and their connection state."""
+    if node.connman is None:
+        raise RPCError(RPC_MISC_ERROR, "p2p disabled")
+    from ..utils.args import g_args
+
+    wanted = str(params[0]) if params else None
+    manual_peers = {
+        f"{p.ip}:{p.port}": p
+        for p in node.connman.all_peers()
+        if getattr(p, "manual", False)
+    }
+    known = set(manual_peers) | set(g_args.get_all("addnode"))
+    out = []
+    for addr in sorted(known):
+        if wanted and addr != wanted:
+            continue
+        peer = manual_peers.get(addr)
+        out.append({
+            "addednode": addr,
+            "connected": peer is not None,
+            "addresses": (
+                [{"address": addr,
+                  "connected": "inbound" if peer.inbound else "outbound"}]
+                if peer else []
+            ),
+        })
+    if wanted and not out:
+        raise RPCError(RPC_INVALID_PARAMETER, "Node has not been added")
+    return out
+
+
+# -------------------------------------------------------------- blockchain
+
+
+def waitforblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp waitforblock(hash, timeout_ms)."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "blockhash required")
+    want = u256_from_hex(str(params[0]))
+    timeout = (int(params[1]) / 1000.0) if len(params) > 1 and params[1] else 0
+    deadline = time.time() + timeout if timeout else None
+    from .server import yield_rpc_slot
+
+    with yield_rpc_slot():
+        while True:
+            tip = node.chainstate.tip()
+            if tip is not None and tip.block_hash == want:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(0.2)
+    tip = node.chainstate.tip()
+    return {"hash": u256_hex(tip.block_hash), "height": tip.height}
+
+
+def gettxoutsetinfo(node, params: List[Any]):
+    """ref rpc/blockchain.cpp gettxoutsetinfo: UTXO statistics by walking
+    the chainstate store (coin cache flushed first for a exact view)."""
+    cs = node.chainstate
+    with cs.cs_main:
+        cs.flush_state_to_disk()
+        from ..chain.coins import _KEY_PREFIX, Coin
+        from ..core.serialize import ByteReader
+
+        n = 0
+        total = 0
+        txids = set()
+        for key, raw in cs._chainstate_db.iterate(_KEY_PREFIX):
+            coin = Coin.deserialize(ByteReader(raw))
+            if coin.is_spent():
+                continue
+            n += 1
+            total += coin.out.value
+            txids.add(key[len(_KEY_PREFIX):len(_KEY_PREFIX) + 32])
+        tip = cs.tip()
+        return {
+            "height": tip.height,
+            "bestblock": u256_hex(tip.block_hash),
+            "transactions": len(txids),
+            "txouts": n,
+            "total_amount": total / COIN,
+        }
+
+
+def decodescript(node, params: List[Any]):
+    """ref rpc/rawtransaction.cpp decodescript."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "hexstring required")
+    from ..crypto.hashes import hash160
+    from ..script import opcodes as opmod
+    from ..script.standard import ScriptID, solver
+
+    names = {
+        v: n for n, v in vars(opmod).items()
+        if n.startswith("OP_") and isinstance(v, int)
+    }
+    raw = bytes.fromhex(str(params[0]))
+    script = Script(raw)
+    kind, sols = solver(script)
+    asm_parts = []
+    try:
+        for o in script.ops():
+            if o.data is not None:
+                asm_parts.append(o.data.hex() if o.data else "0")
+            else:
+                asm_parts.append(names.get(o.opcode, f"OP_{o.opcode}"))
+    except Exception:
+        asm_parts.append("[error]")
+    out = {"asm": " ".join(asm_parts), "type": str(kind)}
+    dest = extract_destination(script)
+    if dest is not None:
+        out["address"] = encode_destination(dest, node.params)
+    # the P2SH wrapper address for embedding this script (ref behavior)
+    out["p2sh"] = encode_destination(
+        ScriptID(hash160(raw)), node.params
+    )
+    return out
+
+
+def decodeblock(node, params: List[Any]):
+    """ref rpc/blockchain.cpp decodeblock over raw block hex."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "hexstring required")
+    from ..core.serialize import ByteReader
+    from ..primitives.block import Block
+
+    try:
+        block = Block.deserialize(
+            ByteReader(bytes.fromhex(str(params[0]))),
+            node.params.algo_schedule,
+        )
+    except Exception as e:
+        raise RPCError(RPC_INVALID_PARAMETER, f"Block decode failed: {e}")
+    h = block.header
+    return {
+        "hash": u256_hex(block.get_hash(node.params.algo_schedule)),
+        "version": h.version,
+        "previousblockhash": u256_hex(h.hash_prev),
+        "merkleroot": u256_hex(h.hash_merkle_root),
+        "time": h.time,
+        "bits": f"{h.bits:08x}",
+        "tx": [tx.txid_hex for tx in block.vtx],
+        "size": len(bytes.fromhex(str(params[0]))),
+    }
+
+
+def clearmempool(node, params: List[Any]):
+    """ref rpc/blockchain.cpp clearmempool."""
+    with node.chainstate.cs_main:
+        n = len(node.mempool.txids())
+        node.mempool.clear()
+    return n
+
+
+def estimaterawfee(node, params: List[Any]):
+    """ref rpc/mining.cpp estimaterawfee: raw bucket stats for a target."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "conf_target required")
+    from ..chain.fees import fee_estimator as est
+
+    target = max(1, min(int(params[0]), est.max_confirms))
+    row = est.conf_avg[target - 1]
+    buckets = []
+    for i, b in enumerate(est.buckets):
+        if est.tx_avg[i] <= 0:
+            continue
+        buckets.append({
+            "startrange": round(b, 1),
+            "txcount": round(est.tx_avg[i], 4),
+            "withintarget": round(row[i], 4),
+        })
+    fee = est.estimate_fee(target)
+    return {
+        "short": {
+            "feerate": (fee / COIN) if fee is not None else -1,
+            "decay": 0.998,
+            "pass": {"buckets": buckets},
+        }
+    }
+
+
+# ------------------------------------------------------------ node control
+
+
+def logging_cmd(node, params: List[Any]):
+    """ref rpc/misc.cpp logging: view/toggle debug categories."""
+    from ..utils.logging import LogFlags, g_logger
+
+    def apply(names, on):
+        for name in names:
+            flag = getattr(LogFlags, str(name).upper(), None)
+            if flag is None:
+                raise RPCError(RPC_INVALID_PARAMETER,
+                               f"unknown logging category {name}")
+            if on:
+                g_logger.categories |= flag
+            else:
+                g_logger.categories &= ~flag
+
+    if params:
+        apply(params[0] or [], True)
+    if len(params) > 1:
+        apply(params[1] or [], False)
+    return {
+        f.name.lower(): bool(g_logger.categories & f)
+        for f in LogFlags if f.name not in ("NONE", "ALL")
+    }
+
+
+def getrpcinfo(node, params: List[Any]):
+    """ref rpc/server.cpp getrpcinfo."""
+    from .server import g_rpc_table
+
+    return {
+        "active_commands": [{"method": "getrpcinfo", "duration": 0}],
+        "commands": len(g_rpc_table.commands()),
+    }
+
+
+def getcacheinfo(node, params: List[Any]):
+    """ref rpc/misc.cpp getcacheinfo: asset/coin cache occupancy."""
+    cs = node.chainstate
+    out = {
+        "uxto-cache-entries": len(getattr(cs.coins, "_cache", {})),
+        "block-index": len(cs.block_index),
+        "mempool-txs": len(node.mempool.txids()),
+    }
+    assets = getattr(cs, "assets", None)
+    if assets is not None:
+        out["asset-cache-entries"] = len(getattr(assets, "assets", {}))
+    return out
+
+
+# ----------------------------------------------------------------- wallet
+
+
+def getmywords(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp getmywords — the BIP39 seed words."""
+    w = _wallet(node)
+    if w.is_crypted and w.is_locked():
+        raise RPCError(RPC_WALLET_ERROR, "wallet is locked")
+    if not w.mnemonic:
+        raise RPCError(RPC_WALLET_ERROR, "no mnemonic available")
+    return {"word_list": w.mnemonic}
+
+
+def getmasterkeyinfo(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp getmasterkeyinfo."""
+    w = _wallet(node)
+    if w.is_crypted and w.is_locked():
+        raise RPCError(RPC_WALLET_ERROR, "wallet is locked")
+    if w.master is None:
+        raise RPCError(RPC_WALLET_ERROR, "no HD master key")
+    return {
+        "bip32_root_private": "xprv-withheld (use getmnemonic)",
+        "account_derivation_path": "m/44'/0'/0'",
+        "next_external_index": w.next_index.get(0, 0),
+        "next_internal_index": w.next_index.get(1, 0),
+    }
+
+
+def getrawchangeaddress(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp getrawchangeaddress."""
+    w = _wallet(node)
+    spk = w.get_change_address_script()
+    dest = extract_destination(Script(spk))
+    return encode_destination(dest, node.params)
+
+
+def backupwallet(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp backupwallet: copy wallet.json."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "destination required")
+    import os
+    import shutil
+
+    w = _wallet(node)
+    w.flush()
+    dest = str(params[0])
+    if os.path.isdir(dest):
+        dest = os.path.join(dest, os.path.basename(w.path))
+    try:
+        shutil.copyfile(w.path, dest)
+    except OSError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"backup failed: {e}")
+    return None
+
+
+def abortrescan(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp abortrescan.  Rescans here run
+    synchronously inside their RPC, so there is never one to abort."""
+    return False
+
+
+def resendwallettransactions(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp resendwallettransactions."""
+    w = _wallet(node)
+    out = []
+    for txid, wtx in w.wtx.items():
+        if wtx.height >= 0 or wtx.abandoned:
+            continue
+        if node.connman is not None:
+            node.connman.relay_transaction(wtx.tx)
+        out.append(u256_hex(txid))
+    return out
+
+
+def listaddressgroupings(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp listaddressgroupings: addresses linked by
+    co-spent inputs, with current balances."""
+    w = _wallet(node)
+    # union-find over input ownership
+    parent: dict = {}
+
+    def find(a):
+        parent.setdefault(a, a)
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    def union(a, b):
+        parent[find(a)] = find(b)
+
+    def addr_of(spk):
+        dest = extract_destination(Script(spk))
+        return encode_destination(dest, node.params) if dest else None
+
+    for wtx in w.wtx.values():
+        ins = []
+        for txin in wtx.tx.vin:
+            src = w.wtx.get(txin.prevout.txid)
+            if src and txin.prevout.n < len(src.tx.vout):
+                spk = src.tx.vout[txin.prevout.n].script_pubkey
+                if w.is_mine_script(spk):
+                    a = addr_of(spk)
+                    if a:
+                        ins.append(a)
+        for a in ins[1:]:
+            union(ins[0], a)
+        for a in ins:
+            find(a)
+    balances: dict = {}
+    for op, txout, conf in w.unspent_coins(min_conf=0):
+        a = addr_of(txout.script_pubkey)
+        if a:
+            balances[a] = balances.get(a, 0) + txout.value
+            find(a)
+    groups: dict = {}
+    for a in parent:
+        groups.setdefault(find(a), []).append(a)
+    return [
+        [[a, balances.get(a, 0) / COIN] for a in sorted(members)]
+        for members in groups.values()
+    ]
+
+
+# ---------------------------------------------- deprecated account API
+# (ref wallet/rpcwallet.cpp account commands — label-backed here, with
+# "" as the default account, matching the reference's deprecation shim)
+
+
+def _label_addresses(w, node, label):
+    return [a for a, l in w.address_book.items() if l == label]
+
+
+def getaccount(node, params: List[Any]):
+    w = _wallet(node)
+    return w.address_book.get(str(params[0]), "")
+
+
+def setaccount(node, params: List[Any]):
+    w = _wallet(node)
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER, "address and account required")
+    decode_destination(str(params[0]), node.params)  # validates
+    w.address_book[str(params[0])] = str(params[1])
+    w.flush()
+    return None
+
+
+def getaccountaddress(node, params: List[Any]):
+    w = _wallet(node)
+    label = str(params[0]) if params else ""
+    existing = _label_addresses(w, node, label)
+    if existing:
+        return existing[0]
+    addr = w.get_new_address(label)
+    return addr
+
+
+def getaddressesbyaccount(node, params: List[Any]):
+    w = _wallet(node)
+    return sorted(_label_addresses(w, node, str(params[0]) if params else ""))
+
+
+def listaccounts(node, params: List[Any]):
+    w = _wallet(node)
+    out = {"": 0.0}
+    by_addr = {}
+    for op, txout, conf in w.unspent_coins(min_conf=1):
+        dest = extract_destination(Script(txout.script_pubkey))
+        a = encode_destination(dest, node.params) if dest else None
+        if a:
+            by_addr[a] = by_addr.get(a, 0) + txout.value
+    for a, v in by_addr.items():
+        out[w.address_book.get(a, "")] = (
+            out.get(w.address_book.get(a, ""), 0.0) + v / COIN
+        )
+    return out
+
+
+def getreceivedbyaccount(node, params: List[Any]):
+    w = _wallet(node)
+    label = str(params[0]) if params else ""
+    addrs = set(_label_addresses(w, node, label))
+    from .wallet import getreceivedbyaddress
+
+    total = 0.0
+    for a in addrs:
+        total += getreceivedbyaddress(node, [a] + list(params[1:2]))
+    return total
+
+
+def listreceivedbyaccount(node, params: List[Any]):
+    w = _wallet(node)
+    from .wallet import listreceivedbyaddress
+
+    rows = listreceivedbyaddress(node, params)
+    by_label: dict = {}
+    for row in rows:
+        label = w.address_book.get(row["address"], "")
+        by_label[label] = by_label.get(label, 0.0) + row["amount"]
+    return [
+        {"account": label, "amount": amount, "confirmations": 1}
+        for label, amount in sorted(by_label.items())
+    ]
+
+
+def move(node, params: List[Any]):
+    """Book-entry move between accounts — always true, like the
+    reference's deprecated implementation's net effect here (labels do
+    not hold separate balances)."""
+    _wallet(node)
+    return True
+
+
+def sendfrom(node, params: List[Any]):
+    """ref sendfrom account command: account is advisory; pays from the
+    wallet at large (the deprecation semantics)."""
+    if len(params) < 3:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "fromaccount, toaddress, amount required")
+    from .wallet import sendtoaddress
+
+    return sendtoaddress(node, [params[1], params[2]])
+
+
+# ------------------------------------------------------- asset/misc extras
+
+
+def generate(node, params: List[Any]):
+    """ref deprecated generate: mine to a fresh wallet address."""
+    from .mining import generatetoaddress
+
+    w = _wallet(node)
+    addr = w.get_new_address("")
+    return generatetoaddress(node, [params[0] if params else 1, addr]
+                             + list(params[1:2]))
+
+
+def addwitnessaddress(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp addwitnessaddress — segwit is not part of
+    this chain's consensus (the reference hides the command behind the
+    same runtime refusal)."""
+    raise RPCError(RPC_MISC_ERROR,
+                   "Segregated witness is not enabled on this chain")
+
+
+def issueunique(node, params: List[Any]):
+    """ref rpc/assets.cpp issueunique: batch of PARENT#tag units."""
+    if len(params) < 2 or not isinstance(params[1], list) or not params[1]:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "root_name and asset_tags required")
+    from .assets import issue
+
+    root = str(params[0])
+    ipfs = params[2] if len(params) > 2 and params[2] else []
+    to_addr = params[3] if len(params) > 3 else None
+    txids = []
+    for i, tag in enumerate(params[1]):
+        args = [f"{root}#{tag}", 1, to_addr, None, 0, False]
+        if i < len(ipfs) and ipfs[i]:
+            args += [True, ipfs[i]]
+        txids.extend(issue(node, args))
+    return txids
+
+
+def testgetassetdata(node, params: List[Any]):
+    """ref rpc/assets.cpp testgetassetdata (diagnostic alias)."""
+    from .assets import getassetdata
+
+    return getassetdata(node, params)
+
+
+def getaddressmempool(node, params: List[Any]):
+    """ref rpc/misc.cpp getaddressmempool (addressindex family): mempool
+    deltas for a set of addresses, via a mempool scan."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "addresses required")
+    spec = params[0]
+    addrs = spec.get("addresses") if isinstance(spec, dict) else [spec]
+    want = set()
+    for a in addrs:
+        want.add(script_for_destination(
+            decode_destination(str(a), node.params)
+        ).raw)
+    out = []
+    for txid in node.mempool.txids():
+        entry = node.mempool.get(txid)
+        if entry is None:
+            continue
+        for n, txout in enumerate(entry.tx.vout):
+            if txout.script_pubkey in want:
+                dest = extract_destination(Script(txout.script_pubkey))
+                out.append({
+                    "address": encode_destination(dest, node.params),
+                    "txid": u256_hex(txid),
+                    "index": n,
+                    "satoshis": txout.value,
+                    "timestamp": int(entry.time) if hasattr(entry, "time")
+                    else 0,
+                })
+    return out
+
+
+def viewmytaggedaddresses(node, params: List[Any]):
+    """ref rpc/assets.cpp viewmytaggedaddresses: wallet addresses carrying
+    qualifier tags."""
+    w = _wallet(node)
+    cache = node.chainstate.assets
+    from ..crypto.hashes import hash160
+
+    mine = {}
+    for kid, pub in w.keystore.pubs().items():
+        mine[kid] = encode_destination(KeyID(kid), node.params)
+    out = []
+    for (qualifier, h), tagged in cache.qualifier_tags.items():
+        if tagged and h in mine:
+            out.append({"Address": mine[h], "Tag Name": qualifier})
+    return out
+
+
+def viewmyrestrictedaddresses(node, params: List[Any]):
+    """ref rpc/assets.cpp viewmyrestrictedaddresses: wallet addresses
+    frozen by restricted assets."""
+    w = _wallet(node)
+    cache = node.chainstate.assets
+    mine = {kid: encode_destination(KeyID(kid), node.params)
+            for kid in w.keystore.pubs()}
+    out = []
+    for (restricted, h), frozen in cache.frozen_addresses.items():
+        if frozen and h in mine:
+            out.append({"Address": mine[h], "Asset Name": restricted,
+                        "Restricted": True})
+    return out
+
+
+def purgesnapshot(node, params: List[Any]):
+    """ref rpc/rewards.cpp purgesnapshot: drop a stored ownership
+    snapshot."""
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "asset_name and block_height required")
+    from .rewards import _engine
+
+    name, height = str(params[0]), int(params[1])
+    ok = _engine(node).purge_snapshot(name, height)
+    return {"name": name, "height": height, "purged": bool(ok)}
+
+
+def _filtered_spend(node, from_addrs, to_addr, amount_sat,
+                    asset_name=None):
+    """Spend restricted to coins held by `from_addrs` with change back to
+    the first of them (ref sendfromaddress/transferfromaddress semantics —
+    rpc/assets.cpp:  coin control pinned to the given addresses)."""
+    from ..primitives.transaction import Transaction, TxIn, TxOut
+    from ..script.sign import sign_tx_input
+
+    w = _wallet(node)
+    want_spks = {
+        script_for_destination(decode_destination(a, node.params)).raw
+        for a in from_addrs
+    }
+    spendable = [
+        (op, txout) for op, txout, conf in w.unspent_coins(min_conf=1)
+        if txout.script_pubkey in want_spks
+    ]
+    fee = 20_000
+    picked, total = [], 0
+    for op, txout in spendable:
+        picked.append((op, txout))
+        total += txout.value
+        if total >= amount_sat + fee:
+            break
+    if total < amount_sat + fee:
+        raise RPCError(RPC_WALLET_ERROR,
+                       "Insufficient funds on the given address(es)")
+    dest_spk = script_for_destination(
+        decode_destination(to_addr, node.params)
+    ).raw
+    vout = [TxOut(amount_sat, dest_spk)]
+    change = total - amount_sat - fee
+    if change > 5000:
+        vout.append(TxOut(change, picked[0][1].script_pubkey))
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=op, sequence=0xFFFFFFFD) for op, _ in picked],
+        vout=vout,
+    )
+    for i, (op, txout) in enumerate(picked):
+        sign_tx_input(w.keystore, tx, i, Script(txout.script_pubkey))
+    return u256_hex(w.commit_transaction(tx))
+
+
+def sendfromaddress(node, params: List[Any]):
+    """ref rpc/wallet sendfromaddress: pay from ONE specific address."""
+    if len(params) < 3:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "from_address, to_address, amount required")
+    return _filtered_spend(
+        node, [str(params[0])], str(params[1]),
+        int(round(float(params[2]) * COIN)),
+    )
+
+
+def transferfromaddress(node, params: List[Any]):
+    """ref rpc/assets.cpp transferfromaddress: asset transfer restricted
+    to one source address."""
+    if len(params) < 4:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "asset_name, from_address, qty, to_address required")
+    return transferfromaddresses(
+        node, [params[0], [params[1]], params[2], params[3]]
+    )
+
+
+def transferfromaddresses(node, params: List[Any]):
+    """ref rpc/assets.cpp transferfromaddresses."""
+    if len(params) < 4 or not isinstance(params[1], list):
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "asset_name, from_addresses, qty, to_address required")
+    from ..assets.txbuilder import build_transfer
+    from ..crypto.hashes import hash160
+
+    w = _wallet(node)
+    name = str(params[0])
+    qty = int(round(float(params[2]) * COIN))
+    dest = decode_destination(str(params[3]), node.params)
+    if not isinstance(dest, KeyID):
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "transfer destination must be a key address")
+    want_spks = {
+        script_for_destination(decode_destination(str(a), node.params)).raw
+        for a in params[1]
+    }
+    tx = build_transfer(
+        w, name, qty, dest.h,
+        utxo_filter=lambda spk: spk[:25] in want_spks or spk in want_spks,
+    )
+    return [u256_hex(w.commit_transaction(tx))]
+
+
+def combinerawtransaction(node, params: List[Any]):
+    """ref rpc/rawtransaction.cpp combinerawtransaction: merge the
+    signatures of partially signed copies of one transaction.  Per input
+    the first scriptSig that verifies against the spent coin wins (the
+    reference's CombineSignatures outcome for the supported templates)."""
+    if not params or not isinstance(params[0], list) or len(params[0]) < 1:
+        raise RPCError(RPC_INVALID_PARAMETER, "txs array required")
+    from ..primitives.transaction import Transaction
+    from ..script.interpreter import (
+        TransactionSignatureChecker,
+        verify_script,
+    )
+
+    txs = [Transaction.from_bytes(bytes.fromhex(str(h))) for h in params[0]]
+    base = txs[0]
+    for other in txs[1:]:
+        if len(other.vin) != len(base.vin) or any(
+            a.prevout != b.prevout for a, b in zip(other.vin, base.vin)
+        ):
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           "txs must spend the same inputs")
+    cs = node.chainstate
+    for i, txin in enumerate(base.vin):
+        coin = cs.coins.get_coin(txin.prevout)
+        if coin is None:
+            continue
+        spk = Script(coin.out.script_pubkey)
+        for cand in txs:
+            base.vin[i].script_sig = cand.vin[i].script_sig
+            ok, _err = verify_script(
+                Script(cand.vin[i].script_sig), spk, 1,
+                TransactionSignatureChecker(base, i),
+            )
+            if ok:
+                break
+    return base.to_bytes().hex()
+
+
+def fundrawtransaction(node, params: List[Any]):
+    """ref wallet/rpcwallet.cpp fundrawtransaction: add wallet inputs and
+    a change output to an unfunded transaction."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "hexstring required")
+    from ..primitives.transaction import Transaction, TxIn, TxOut
+
+    w = _wallet(node)
+    tx = Transaction.from_bytes(bytes.fromhex(str(params[0])))
+    out_total = sum(o.value for o in tx.vout)
+    in_total = 0
+    have = {i.prevout for i in tx.vin}
+    for txin in tx.vin:
+        coin = node.chainstate.coins.get_coin(txin.prevout)
+        if coin is not None:
+            in_total += coin.out.value
+    fee = max(10_000, 1000 * (1 + len(tx.to_bytes()) // 1000))
+    changepos = -1
+    if in_total < out_total + fee:
+        for op, txout, conf in w.unspent_coins(min_conf=1):
+            if op in have:
+                continue
+            tx.vin.append(TxIn(prevout=op, sequence=0xFFFFFFFD))
+            in_total += txout.value
+            if in_total >= out_total + fee:
+                break
+        if in_total < out_total + fee:
+            raise RPCError(RPC_WALLET_ERROR, "Insufficient funds")
+    change = in_total - out_total - fee
+    if change > 5000:
+        tx.vout.append(TxOut(change, w.get_change_address_script()))
+        changepos = len(tx.vout) - 1
+    return {"hex": tx.to_bytes().hex(), "fee": fee / COIN,
+            "changepos": changepos}
+
+
+def importprunedfunds(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp importprunedfunds: adopt a transaction with
+    a txoutproof instead of a rescan (the pruned-wallet workflow)."""
+    if len(params) < 2:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "rawtransaction and txoutproof required")
+    from ..chain.merkleblock import PartialMerkleTree
+    from ..core.serialize import ByteReader
+    from ..primitives.block import BlockHeader
+    from ..primitives.transaction import Transaction
+    from ..wallet.wallet import WalletTx
+
+    w = _wallet(node)
+    tx = Transaction.from_bytes(bytes.fromhex(str(params[0])))
+    sched = node.params.algo_schedule
+    r = ByteReader(bytes.fromhex(str(params[1])))
+    header = BlockHeader.deserialize(r, sched)
+    tree = PartialMerkleTree.deserialize(r)
+    root, matches = tree.extract_matches()
+    if root != header.hash_merkle_root or tx.txid not in matches:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Something wrong with merkleblock")
+    idx = node.chainstate.lookup(header.get_hash(sched))
+    if idx is None or idx not in node.chainstate.active:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Block not found in chain")
+    if not w.is_relevant(tx):
+        raise RPCError(RPC_WALLET_ERROR,
+                       "No addresses in wallet correspond to included "
+                       "transaction")
+    with w.lock:
+        w.wtx[tx.txid] = WalletTx(tx=tx, height=idx.height)
+        w.flush()
+    return None
+
+
+def removeprunedfunds(node, params: List[Any]):
+    """ref wallet/rpcdump.cpp removeprunedfunds."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "txid required")
+    w = _wallet(node)
+    txid = u256_from_hex(str(params[0]))
+    with w.lock:
+        if txid not in w.wtx:
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           "Transaction does not exist in wallet.")
+        del w.wtx[txid]
+        w.flush()
+    return None
+
+
+def getblockdeltas(node, params: List[Any]):
+    """ref rpc/misc.cpp getblockdeltas (addressindex family): per-tx input
+    and output address deltas for a block, input values via undo data."""
+    if not params:
+        raise RPCError(RPC_INVALID_PARAMETER, "blockhash required")
+    cs = node.chainstate
+    idx = cs.lookup(u256_from_hex(str(params[0])))
+    if idx is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+    from ..chain.blockindex import BlockStatus
+
+    if not idx.status & BlockStatus.HAVE_DATA:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not available")
+    block = cs.read_block(idx)
+    _dpos, upos = cs.positions.get(idx.block_hash, (-1, -1))
+    undo = cs.block_store.read_undo(upos) if upos >= 0 else None
+
+    def addr_of(spk):
+        dest = extract_destination(Script(spk))
+        return encode_destination(dest, node.params) if dest else None
+
+    deltas = []
+    for ti, tx in enumerate(block.vtx):
+        inputs = []
+        if ti > 0 and undo is not None and ti - 1 < len(undo.vtxundo):
+            for vi, coin in enumerate(undo.vtxundo[ti - 1].prevouts):
+                inputs.append({
+                    "address": addr_of(coin.out.script_pubkey),
+                    "satoshis": -coin.out.value,
+                    "index": vi,
+                    "prevtxid": u256_hex(tx.vin[vi].prevout.txid),
+                    "prevout": tx.vin[vi].prevout.n,
+                })
+        outputs = [
+            {"address": addr_of(o.script_pubkey), "satoshis": o.value,
+             "index": n}
+            for n, o in enumerate(tx.vout)
+        ]
+        deltas.append({"txid": tx.txid_hex, "index": ti,
+                       "inputs": inputs, "outputs": outputs})
+    return {
+        "hash": u256_hex(idx.block_hash),
+        "height": idx.height,
+        "time": block.header.time,
+        "deltas": deltas,
+    }
+
+
+def testmempoolaccept(node, params: List[Any]):
+    """ref rpc/rawtransaction.cpp testmempoolaccept: dry-run acceptance —
+    runs the full policy/consensus checks, then removes the tx again so
+    the mempool is untouched."""
+    if not params or not isinstance(params[0], list):
+        raise RPCError(RPC_INVALID_PARAMETER, "rawtxs array required")
+    from ..chain.mempool_accept import (
+        MempoolAcceptError,
+        accept_to_memory_pool,
+    )
+    from ..primitives.transaction import Transaction
+
+    out = []
+    with node.chainstate.cs_main:
+        for hexstr in params[0]:
+            try:
+                tx = Transaction.from_bytes(bytes.fromhex(str(hexstr)))
+            except Exception:
+                out.append({"txid": None, "allowed": False,
+                            "reject-reason": "decode-failed"})
+                continue
+            res = {"txid": tx.txid_hex}
+            already = node.mempool.contains(tx.txid)
+            try:
+                accept_to_memory_pool(node.chainstate, node.mempool, tx)
+                res["allowed"] = True
+                if not already:
+                    node.mempool.remove(tx.txid)
+            except MempoolAcceptError as e:
+                res["allowed"] = False
+                res["reject-reason"] = f"{e.code} {e.reason}".strip()
+            out.append(res)
+    return out
+
+
+def register(table: RPCTable) -> None:
+    for family, name, fn, args in [
+        ("control", "echo", echo, ["arg0"]),
+        ("control", "echojson", echojson, ["arg0"]),
+        ("control", "setmocktime", setmocktime, ["timestamp"]),
+        ("control", "logging", logging_cmd, ["include", "exclude"]),
+        ("control", "getrpcinfo", getrpcinfo, []),
+        ("control", "getcacheinfo", getcacheinfo, []),
+        ("network", "ping", ping, []),
+        ("network", "getaddednodeinfo", getaddednodeinfo, ["node"]),
+        ("blockchain", "waitforblock", waitforblock, ["blockhash", "timeout"]),
+        ("blockchain", "gettxoutsetinfo", gettxoutsetinfo, []),
+        ("blockchain", "decodeblock", decodeblock, ["hexstring"]),
+        ("blockchain", "clearmempool", clearmempool, []),
+        ("rawtransactions", "decodescript", decodescript, ["hexstring"]),
+        ("util", "estimaterawfee", estimaterawfee, ["conf_target"]),
+        ("wallet", "getmywords", getmywords, []),
+        ("wallet", "getmasterkeyinfo", getmasterkeyinfo, []),
+        ("wallet", "getrawchangeaddress", getrawchangeaddress, []),
+        ("wallet", "backupwallet", backupwallet, ["destination"]),
+        ("wallet", "abortrescan", abortrescan, []),
+        ("wallet", "resendwallettransactions", resendwallettransactions, []),
+        ("wallet", "listaddressgroupings", listaddressgroupings, []),
+        ("wallet", "getaccount", getaccount, ["address"]),
+        ("wallet", "setaccount", setaccount, ["address", "account"]),
+        ("wallet", "getaccountaddress", getaccountaddress, ["account"]),
+        ("wallet", "getaddressesbyaccount", getaddressesbyaccount, ["account"]),
+        ("wallet", "listaccounts", listaccounts, []),
+        ("wallet", "getreceivedbyaccount", getreceivedbyaccount,
+         ["account", "minconf"]),
+        ("wallet", "listreceivedbyaccount", listreceivedbyaccount, ["minconf"]),
+        ("wallet", "move", move, ["fromaccount", "toaccount", "amount"]),
+        ("wallet", "sendfrom", sendfrom, ["fromaccount", "toaddress", "amount"]),
+        ("mining", "generate", generate, ["nblocks", "maxtries"]),
+        ("wallet", "addwitnessaddress", addwitnessaddress, ["address"]),
+        ("assets", "issueunique", issueunique,
+         ["root_name", "asset_tags", "ipfs_hashes", "to_address"]),
+        ("assets", "testgetassetdata", testgetassetdata, ["asset_name"]),
+        ("assets", "viewmytaggedaddresses", viewmytaggedaddresses, []),
+        ("assets", "viewmyrestrictedaddresses", viewmyrestrictedaddresses, []),
+        ("addressindex", "getaddressmempool", getaddressmempool, ["addresses"]),
+        ("rewards", "purgesnapshot", purgesnapshot,
+         ["asset_name", "block_height"]),
+        ("rawtransactions", "testmempoolaccept", testmempoolaccept,
+         ["rawtxs"]),
+        ("rawtransactions", "combinerawtransaction", combinerawtransaction,
+         ["txs"]),
+        ("rawtransactions", "fundrawtransaction", fundrawtransaction,
+         ["hexstring"]),
+        ("wallet", "sendfromaddress", sendfromaddress,
+         ["from_address", "to_address", "amount"]),
+        ("assets", "transferfromaddress", transferfromaddress,
+         ["asset_name", "from_address", "qty", "to_address"]),
+        ("assets", "transferfromaddresses", transferfromaddresses,
+         ["asset_name", "from_addresses", "qty", "to_address"]),
+        ("wallet", "importprunedfunds", importprunedfunds,
+         ["rawtransaction", "txoutproof"]),
+        ("wallet", "removeprunedfunds", removeprunedfunds, ["txid"]),
+        ("addressindex", "getblockdeltas", getblockdeltas, ["blockhash"]),
+    ]:
+        table.register(family, name, fn, args)

@@ -35,4 +35,7 @@ def register_all(table: RPCTable = g_rpc_table) -> RPCTable:
     from . import indexes as indexes_rpc
 
     indexes_rpc.register(table)
+    from . import compat as compat_rpc
+
+    compat_rpc.register(table)
     return table

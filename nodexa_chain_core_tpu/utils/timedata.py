@@ -14,6 +14,8 @@ class TimeData:
     def __init__(self) -> None:
         self._offsets: List[int] = [0]
         self._seen: set = set()
+        # test hook (ref utiltime.cpp SetMockTime via the setmocktime RPC)
+        self.mocktime: int | None = None
 
     def add_sample(self, peer_time: int, source: str = "") -> None:
         """One sample per source address (ref timedata.cpp's setKnown):
@@ -43,6 +45,8 @@ class TimeData:
         return s[len(s) // 2]
 
     def adjusted_time(self) -> int:
+        if self.mocktime is not None:
+            return self.mocktime
         return int(time.time()) + self.offset()
 
 

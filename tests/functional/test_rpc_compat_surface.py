@@ -1,0 +1,186 @@
+"""Functional: the surface-parity RPC family (rpc/compat.py — deprecated
+account API, diagnostics, test hooks, asset extras) against a live daemon."""
+
+import pytest
+
+from .framework import TestFramework
+
+
+@pytest.mark.functional
+def test_compat_surface():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        r = n0.rpc
+
+        # test hooks
+        assert r.echo("a", 2) == ["a", 2]
+        r.setmocktime(1_900_000_000)
+        r.setmocktime(0)
+
+        # mining via the deprecated generate (fresh wallet address)
+        hashes = r.generate(101)
+        assert len(hashes) == 101 and r.getblockcount() == 101
+
+        # account API (label-backed)
+        acct_addr = r.getaccountaddress("team")
+        assert r.getaccount(acct_addr) == "team"
+        assert acct_addr in r.getaddressesbyaccount("team")
+        r.setaccount(acct_addr, "crew")
+        assert r.getaccount(acct_addr) == "crew"
+        assert "" in r.listaccounts()
+        assert r.move("", "crew", 1) is True
+        txid = r.sendfrom("", r.getnewaddress(), 2)
+        assert len(txid) == 64
+        r.generate(1)
+        assert isinstance(r.listreceivedbyaccount(1), list)
+        assert r.getreceivedbyaccount("crew") >= 0
+
+        # wallet utils
+        change = r.getrawchangeaddress()
+        assert change.startswith(("m", "n", "2"))  # regtest base58
+        groups = r.listaddressgroupings()
+        assert any(groups)
+        words = r.getmywords()["word_list"]
+        assert len(words.split()) >= 12
+        info = r.getmasterkeyinfo()
+        assert info["next_external_index"] > 0
+        import os
+        dump = f.basedir + "/wallet-backup.json"
+        r.backupwallet(dump)
+        assert os.path.exists(dump)
+        assert r.abortrescan() is False
+        assert isinstance(r.resendwallettransactions(), list)
+
+        # diagnostics
+        assert r.getrpcinfo()["commands"] > 150
+        caches = r.getcacheinfo()
+        assert caches["block-index"] >= 102
+        logcfg = r.logging(["net"], [])
+        assert logcfg["net"] is True
+        r.logging([], ["net"])
+
+        # blockchain extras
+        utxo = r.gettxoutsetinfo()
+        assert utxo["height"] == r.getblockcount()
+        assert utxo["txouts"] > 0 and utxo["total_amount"] > 0
+        best = r.getbestblockhash()
+        assert r.waitforblock(best, 500)["hash"] == best
+        raw_blk = r.getblock(best, 0)
+        decoded = r.decodeblock(raw_blk)
+        assert decoded["hash"] == best
+
+        # decodescript on a 2-of-2 multisig
+        pub = r.validateaddress(r.getnewaddress()).get("pubkey")
+        if pub:
+            ms = r.createmultisig(1, [pub])
+            d = r.decodescript(ms["redeemScript"])
+            assert "OP_CHECKMULTISIG" in d["asm"]
+            assert d["p2sh"] == ms["address"]
+
+        # mempool dry-run: a valid spend is allowed and NOT left behind
+        raw = r.createrawtransaction(
+            [], {r.getnewaddress(): 1}
+        )
+        res = r.testmempoolaccept([raw])
+        assert res[0]["allowed"] is False  # no inputs -> rejected cleanly
+        assert r.getmempoolinfo()["size"] == 0
+
+        # asset extras
+        r.issue("COMPATROOT", 100)
+        r.generate(1)
+        u = r.issueunique("COMPATROOT", ["alpha", "beta"])
+        assert len(u) == 2
+        r.generate(1)
+        data = r.testgetassetdata("COMPATROOT#alpha")
+        assert data["amount"] == 1
+        assert r.viewmytaggedaddresses() == []
+        assert r.viewmyrestrictedaddresses() == []
+
+        # network extras (no peers; shape-level checks)
+        r.ping()
+        assert r.getaddednodeinfo() == []
+        assert r.getaddressmempool({"addresses": [acct_addr]}) == []
+
+        # segwit stays off
+        try:
+            r.addwitnessaddress(acct_addr)
+            raised = False
+        except Exception:
+            raised = True
+        assert raised
+
+
+@pytest.mark.functional
+def test_compat_funding_and_proof_flows():
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        r = n0.rpc
+        addr = r.getnewaddress()
+        r.generatetoaddress(110, addr)
+
+        # fundrawtransaction completes an unfunded payment
+        dest = r.getnewaddress()
+        raw = r.createrawtransaction([], {dest: 3})
+        funded = r.fundrawtransaction(raw)
+        assert funded["fee"] > 0
+        signed = r.signrawtransaction(funded["hex"])
+        assert signed["complete"]
+
+        # combinerawtransaction: unsigned + signed copies -> verifying sigs
+        # win (inputs must still be unspent for the combiner to check them)
+        combined = r.combinerawtransaction([funded["hex"], signed["hex"]])
+        assert combined == signed["hex"]
+
+        txid = r.sendrawtransaction(signed["hex"])
+        r.generatetoaddress(1, addr)
+
+        # sendfromaddress spends only that address's coins
+        tx = r.getrawtransaction(txid, True)
+        funded_addr = next(
+            o["scriptPubKey"]["addresses"][0] if isinstance(
+                o["scriptPubKey"], dict) and o["scriptPubKey"].get("addresses")
+            else None
+            for o in tx["vout"] if abs(o["value"] - 3) < 1e-8
+        )
+        if funded_addr:
+            spend = r.sendfromaddress(funded_addr, r.getnewaddress(), 1)
+            assert len(spend) == 64
+            r.generatetoaddress(1, addr)
+
+        # asset transferfromaddress(es): issue straight to a known holder
+        holder = r.getnewaddress()
+        r.issue("FROMADDR", 50, holder)
+        r.generatetoaddress(1, addr)
+        assert r.listmyassets("FROMADDR")["FROMADDR"] == 50.0
+        tgt = r.getnewaddress()
+        res = r.transferfromaddresses("FROMADDR", [holder], 5, tgt)
+        assert isinstance(res, list) and len(res) == 1
+        r.generatetoaddress(1, addr)
+        res2 = r.transferfromaddress("FROMADDR", tgt, 2, holder)
+        assert isinstance(res2, list) and len(res2) == 1
+        r.generatetoaddress(1, addr)
+        # a non-holding address cleanly reports insufficient assets
+        try:
+            r.transferfromaddress("FROMADDR", r.getnewaddress(), 1, tgt)
+            raised = False
+        except Exception:
+            raised = True
+        assert raised
+
+        # importprunedfunds adopts a tx via proof; removeprunedfunds drops it
+        ptxid = r.sendtoaddress(r.getnewaddress(), 2)
+        r.generatetoaddress(1, addr)
+        proof = r.gettxoutproof([ptxid])
+        rawtx = r.getrawtransaction(ptxid)
+        before = r.gettransaction(ptxid)
+        assert before  # wallet already knows it (not pruned) — remove first
+        r.removeprunedfunds(ptxid)
+        r.importprunedfunds(rawtx, proof)
+        after = r.gettransaction(ptxid)
+        assert after["txid"] == ptxid
+
+        # getblockdeltas exposes input/output address deltas
+        best = r.getbestblockhash()
+        deltas = r.getblockdeltas(best)
+        assert deltas["hash"] == best
+        assert deltas["deltas"][0]["outputs"]

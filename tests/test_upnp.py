@@ -1,0 +1,113 @@
+"""UPnP port mapping against an in-process fake IGD (ref net.cpp:1465
+ThreadMapPort): SSDP discovery, description parse, AddPortMapping /
+GetExternalIPAddress SOAP round-trips, DeletePortMapping on stop."""
+
+import re
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from nodexa_chain_core_tpu.net import upnp
+
+
+class FakeIGD:
+    """Minimal IGD: SSDP responder + description + SOAP control."""
+
+    def __init__(self):
+        self.actions = []
+        self.httpd = HTTPServer(("127.0.0.1", 0), self._handler())
+        self.http_port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def _handler(igd_self=None):
+        igd = None
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                desc = f"""<?xml version="1.0"?>
+<root><device><serviceList><service>
+<serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+<controlURL>/ctl</controlURL>
+</service></serviceList></device></root>"""
+                body = desc.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                action = re.search(r"<u:(\w+)", body).group(1)
+                self.server.igd.actions.append((action, body))
+                if action == "GetExternalIPAddress":
+                    reply = ("<NewExternalIPAddress>203.0.113.7"
+                             "</NewExternalIPAddress>")
+                else:
+                    reply = ""
+                out = (f"<s:Envelope><s:Body><u:{action}Response>{reply}"
+                       f"</u:{action}Response></s:Body></s:Envelope>").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        return H
+
+    @property
+    def desc_url(self):
+        return f"http://127.0.0.1:{self.http_port}/desc.xml"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_description_parse_and_mapping_lifecycle(monkeypatch):
+    igd = FakeIGD()
+    igd.httpd.igd = igd
+    try:
+        # discovery is network-multicast; pin it to the fake
+        monkeypatch.setattr(upnp, "discover_igd", lambda timeout=2.0: igd.desc_url)
+        got_ip = []
+        mapper = upnp.UPnPMapper(18444, on_external_ip=got_ip.append)
+        mapper.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(igd.actions) < 2:
+            time.sleep(0.05)
+        names = [a for a, _ in igd.actions]
+        assert "GetExternalIPAddress" in names
+        assert "AddPortMapping" in names
+        assert got_ip == ["203.0.113.7"]
+        add_body = next(b for a, b in igd.actions if a == "AddPortMapping")
+        assert "<NewExternalPort>18444</NewExternalPort>" in add_body
+        assert "<NewProtocol>TCP</NewProtocol>" in add_body
+        mapper.stop()
+        assert any(a == "DeletePortMapping" for a, _ in igd.actions), (
+            "shutdown must remove the mapping"
+        )
+    finally:
+        igd.close()
+
+
+def test_control_url_resolution():
+    igd = FakeIGD()
+    igd.httpd.igd = igd
+    try:
+        ctl, stype = upnp.fetch_control_url(igd.desc_url)
+        assert ctl == f"http://127.0.0.1:{igd.http_port}/ctl"
+        assert stype.endswith("WANIPConnection:1")
+    finally:
+        igd.close()
+
+
+def test_no_igd_is_quiet(monkeypatch):
+    monkeypatch.setattr(upnp, "discover_igd", lambda timeout=2.0: None)
+    mapper = upnp.UPnPMapper(18444)
+    mapper.start()
+    mapper._thread.join(timeout=5)
+    assert not mapper._thread.is_alive()
+    mapper.stop()  # no mapping was made; must not raise
