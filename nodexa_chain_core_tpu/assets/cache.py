@@ -16,27 +16,23 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.amount import MAX_MONEY
 from ..core.serialize import ByteReader, ByteWriter
-from ..crypto.hashes import hash160
 from ..primitives.transaction import Transaction
 from ..script.script import Script
 from ..script.standard import KeyID, extract_destination
 from .types import (
     AssetTransfer,
     AssetType,
-    BURN_AMOUNTS,
     MAX_UNIT,
     NewAsset,
     NullAssetTxData,
     OWNER_ASSET_AMOUNT,
     OWNER_TAG,
-    OwnerPayload,
     QUALIFIER_MAX_AMOUNT,
     QUALIFIER_MIN_AMOUNT,
     QualifierFlag,
     ReissueAsset,
     RestrictedFlag,
     UNIQUE_ASSET_AMOUNT,
-    VerifierString,
     asset_name_type,
     burn_requirement,
     is_amount_valid_with_units,

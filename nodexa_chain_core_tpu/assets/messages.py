@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import enum
 import time as _time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.serialize import ByteReader, ByteWriter

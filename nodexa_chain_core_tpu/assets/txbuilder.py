@@ -10,12 +10,10 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ..core.amount import COIN
 from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
 from ..script.script import Script
 from ..script.sign import sign_tx_input
 from ..script.standard import KeyID, p2pkh_script
-from .cache import AssetError
 from .types import (
     AssetTransfer,
     AssetType,
@@ -102,8 +100,6 @@ def _find_token(wallet, name: str) -> Tuple[OutPoint, TxOut]:
 
 def _dest_script(wallet, dest_h160: Optional[bytes]) -> Script:
     if dest_h160 is None:
-        from ..crypto.hashes import hash160  # noqa — used via wallet change key
-
         raw = wallet.get_change_address_script()
         return Script(raw)
     return p2pkh_script(KeyID(dest_h160))

@@ -10,7 +10,7 @@ instead of the reference's string-splitting evaluator.
 from __future__ import annotations
 
 import re
-from typing import Callable, Set
+from typing import Set
 
 _TOKEN_RE = re.compile(r"\s*(\(|\)|&|\||!|[A-Z0-9._#/]+|true|false)", re.IGNORECASE)
 

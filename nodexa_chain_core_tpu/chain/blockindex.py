@@ -11,7 +11,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..core.uint256 import target_to_work, bits_to_target
-from ..primitives.block import AlgoSchedule, BlockHeader
+from ..primitives.block import BlockHeader
 
 
 class BlockStatus(enum.IntFlag):

@@ -1,107 +1,536 @@
 """Fee estimation (parity: reference src/policy/fees.{h,cpp}
-CBlockPolicyEstimator — bucketed feerate tracking of mempool txs vs their
-confirmation delay, queried by wallet/RPC estimatefee/estimatesmartfee)."""
+CBlockPolicyEstimator + TxConfirmStats).
+
+Design (ref policy/fees.h:28-72 block comment): txs entering the mempool
+are bucketed by feerate (exponential bucket bounds, fees.h:190 FEE_SPACING
+1.05 over [1000, 1e7] sat/kB).  Three TxConfirmStats track, per bucket,
+exponentially decaying moving averages of confirm counts at three time
+horizons (fees.h:143-162):
+
+  short : 12 periods x scale 1  (12 blocks),  decay 0.962
+  medium: 24 periods x scale 2  (48 blocks),  decay 0.9952
+  long  : 42 periods x scale 24 (1008 blocks), decay 0.99931
+
+Each stats object also tracks still-unconfirmed txs in a per-block
+circular buffer (unconf_txs) plus an overflow counter (old_unconf_txs),
+and failed-to-confirm removals (fail_avg) — both lower the success rate a
+bucket can show (fees.cpp:282-305 EstimateMedianVal denominator).
+
+estimate_smart_fee returns the max of the 60%-at-target/2,
+85%-at-target and 95%-at-2*target calculations, each from the shortest
+horizon tracking that target, with conservative mode also requiring the
+95% threshold on longer horizons (fees.cpp:832-905).
+"""
 
 from __future__ import annotations
 
-import math
-from typing import Dict, List, Optional
+import json
+import os
+from typing import Dict, List, Optional, Tuple
 
-_BUCKET_SPACING = 1.1
-_MIN_BUCKET = 100.0  # sat/kB
-_MAX_BUCKET = 1e7
-_DECAY = 0.998
-_SUFFICIENT_TXS = 0.1
-_MIN_SUCCESS_PCT = 0.85
+INF_FEERATE = 1e99
+
+# fees.h:176-190
+MIN_BUCKET_FEERATE = 1000.0
+MAX_BUCKET_FEERATE = 1e7
+FEE_SPACING = 1.05
+
+# fees.h:143-162
+SHORT_BLOCK_PERIODS = 12
+SHORT_SCALE = 1
+MED_BLOCK_PERIODS = 24
+MED_SCALE = 2
+LONG_BLOCK_PERIODS = 42
+LONG_SCALE = 24
+OLDEST_ESTIMATE_HISTORY = 6 * 1008
+
+SHORT_DECAY = 0.962
+MED_DECAY = 0.9952
+LONG_DECAY = 0.99931
+
+# fees.h:163-173
+HALF_SUCCESS_PCT = 0.6
+SUCCESS_PCT = 0.85
+DOUBLE_SUCCESS_PCT = 0.95
+SUFFICIENT_FEETXS = 0.1
+SUFFICIENT_TXS_SHORT = 0.5
+
+HORIZON_SHORT = "short"
+HORIZON_MED = "medium"
+HORIZON_LONG = "long"
+
+
+def _bucket_bounds() -> List[float]:
+    buckets = []
+    b = MIN_BUCKET_FEERATE
+    while b <= MAX_BUCKET_FEERATE:
+        buckets.append(b)
+        b *= FEE_SPACING
+    buckets.append(INF_FEERATE)
+    return buckets
+
+
+class TxConfirmStats:
+    """One horizon's decayed confirmation statistics
+    (ref policy/fees.cpp:70-118 class TxConfirmStats)."""
+
+    def __init__(self, buckets: List[float], max_periods: int, decay: float,
+                 scale: int) -> None:
+        assert scale > 0
+        self.buckets = buckets
+        self.decay = decay
+        self.scale = scale
+        n = len(buckets)
+        # conf_avg[period][bucket]: decayed count confirmed within
+        # (period+1)*scale blocks; fail_avg: removed unconfirmed after
+        # that long (ref fees.cpp:88-97)
+        self.conf_avg = [[0.0] * n for _ in range(max_periods)]
+        self.fail_avg = [[0.0] * n for _ in range(max_periods)]
+        self.tx_ct_avg = [0.0] * n
+        self.avg = [0.0] * n  # decayed feerate sum per bucket
+        # circular per-block counts of still-unconfirmed txs
+        # (ref fees.cpp:107-112 unconfTxs/oldUnconfTxs)
+        self.unconf_txs = [[0] * n for _ in range(self.max_confirms())]
+        self.old_unconf_txs = [0] * n
+
+    def max_confirms(self) -> int:
+        return self.scale * len(self.conf_avg)
+
+    def clear_current(self, height: int) -> None:
+        """Roll the circular buffer (ref fees.cpp:215-221 ClearCurrent)."""
+        row = self.unconf_txs[height % len(self.unconf_txs)]
+        for j in range(len(self.buckets)):
+            self.old_unconf_txs[j] += row[j]
+            row[j] = 0
+
+    def record(self, blocks_to_confirm: int, bucket: int, feerate: float
+               ) -> None:
+        """ref fees.cpp:225-237 Record (blocks_to_confirm is 1-based)."""
+        if blocks_to_confirm < 1:
+            return
+        periods = (blocks_to_confirm + self.scale - 1) // self.scale
+        for i in range(periods - 1, len(self.conf_avg)):
+            self.conf_avg[i][bucket] += 1
+        self.tx_ct_avg[bucket] += 1
+        self.avg[bucket] += feerate
+
+    def new_tx(self, height: int, bucket: int) -> None:
+        self.unconf_txs[height % len(self.unconf_txs)][bucket] += 1
+
+    def remove_tx(self, entry_height: int, best_height: int, bucket: int,
+                  in_block: bool) -> None:
+        """ref fees.cpp:484-519 removeTx."""
+        blocks_ago = best_height - entry_height
+        if best_height == 0:
+            blocks_ago = 0
+        if blocks_ago < 0:
+            return
+        bins = len(self.unconf_txs)
+        if blocks_ago >= bins:
+            if self.old_unconf_txs[bucket] > 0:
+                self.old_unconf_txs[bucket] -= 1
+        else:
+            row = self.unconf_txs[entry_height % bins]
+            if row[bucket] > 0:
+                row[bucket] -= 1
+        if not in_block and blocks_ago >= self.scale:
+            periods_ago = blocks_ago // self.scale
+            for i in range(min(periods_ago, len(self.fail_avg))):
+                self.fail_avg[i][bucket] += 1
+
+    def update_moving_averages(self) -> None:
+        d = self.decay
+        for j in range(len(self.buckets)):
+            for row in self.conf_avg:
+                row[j] *= d
+            for row in self.fail_avg:
+                row[j] *= d
+            self.avg[j] *= d
+            self.tx_ct_avg[j] *= d
+
+    def estimate_median_val(self, conf_target: int, sufficient_tx_val: float,
+                            success_break: float, best_height: int,
+                            ) -> Tuple[float, dict]:
+        """Lowest-feerate passing bucket range's median feerate, or -1
+        (ref fees.cpp:248-418 EstimateMedianVal, requireGreater=true —
+        the only polarity the reference ever calls with)."""
+        n_conf = 0.0
+        total_num = 0.0
+        extra_num = 0
+        fail_num = 0.0
+        period_target = (conf_target + self.scale - 1) // self.scale
+        max_bucket = len(self.buckets) - 1
+        start = max_bucket
+        cur_near = best_near = cur_far = best_far = start
+        found = False
+        bins = len(self.unconf_txs)
+        new_range = True
+        passing = True
+        pass_bucket: dict = {}
+        fail_bucket: dict = {}
+
+        def _bucket_info(near, far, nc, tn, en, fn):
+            lo, hi = min(near, far), max(near, far)
+            return {
+                "startrange": self.buckets[lo - 1] if lo else 0.0,
+                "endrange": self.buckets[hi],
+                "withintarget": nc,
+                "totalconfirmed": tn,
+                "inmempool": en,
+                "leftmempool": fn,
+            }
+
+        for bucket in range(start, -1, -1):
+            if new_range:
+                cur_near = bucket
+                new_range = False
+            cur_far = bucket
+            n_conf += self.conf_avg[period_target - 1][bucket]
+            total_num += self.tx_ct_avg[bucket]
+            fail_num += self.fail_avg[period_target - 1][bucket]
+            for confct in range(conf_target, self.max_confirms()):
+                # uint32 wrap kept bit-for-bit with the reference's
+                # unsigned arithmetic (fees.cpp:297)
+                extra_num += self.unconf_txs[
+                    ((best_height - confct) & 0xFFFFFFFF) % bins][bucket]
+            extra_num += self.old_unconf_txs[bucket]
+            if total_num >= sufficient_tx_val / (1 - self.decay):
+                cur_pct = n_conf / (total_num + fail_num + extra_num)
+                if cur_pct < success_break:
+                    if passing:
+                        fail_bucket = _bucket_info(
+                            cur_near, cur_far, n_conf, total_num, extra_num,
+                            fail_num)
+                        passing = False
+                    continue
+                fail_bucket = {}
+                found = True
+                passing = True
+                pass_bucket = {
+                    "withintarget": n_conf,
+                    "totalconfirmed": total_num,
+                    "inmempool": extra_num,
+                    "leftmempool": fail_num,
+                }
+                n_conf = 0.0
+                total_num = 0.0
+                extra_num = 0
+                fail_num = 0.0
+                best_near, best_far = cur_near, cur_far
+                new_range = True
+
+        median = -1.0
+        lo, hi = min(best_near, best_far), max(best_near, best_far)
+        tx_sum = sum(self.tx_ct_avg[j] for j in range(lo, hi + 1))
+        if found and tx_sum != 0:
+            tx_sum /= 2
+            for j in range(lo, hi + 1):
+                if self.tx_ct_avg[j] < tx_sum:
+                    tx_sum -= self.tx_ct_avg[j]
+                else:  # median tx's bucket: report its average feerate
+                    median = self.avg[j] / self.tx_ct_avg[j]
+                    break
+            pass_bucket["startrange"] = self.buckets[lo - 1] if lo else 0.0
+            pass_bucket["endrange"] = self.buckets[hi]
+        if passing and not new_range:
+            fail_bucket = _bucket_info(
+                cur_near, cur_far, n_conf, total_num, extra_num, fail_num)
+        result = {
+            "pass": pass_bucket,
+            "fail": fail_bucket,
+            "decay": self.decay,
+            "scale": self.scale,
+        }
+        return median, result
+
+    # persistence (ref fees.cpp:421-436 Write / :438-475 Read)
+    def to_json(self) -> dict:
+        return {
+            "decay": self.decay,
+            "scale": self.scale,
+            "avg": self.avg,
+            "tx_ct_avg": self.tx_ct_avg,
+            "conf_avg": self.conf_avg,
+            "fail_avg": self.fail_avg,
+        }
+
+    def load_json(self, data: dict) -> None:
+        n = len(self.buckets)
+        conf = [[float(x) for x in row] for row in data["conf_avg"]]
+        fail = [[float(x) for x in row] for row in data["fail_avg"]]
+        avg = [float(x) for x in data["avg"]]
+        txct = [float(x) for x in data["tx_ct_avg"]]
+        if (
+            len(conf) != len(self.conf_avg)
+            or len(fail) != len(self.fail_avg)
+            or any(len(r) != n for r in conf)
+            or any(len(r) != n for r in fail)
+            or len(avg) != n
+            or len(txct) != n
+            or not (0 < float(data["decay"]) < 1)
+        ):
+            raise ValueError("corrupt estimates data")
+        scale = int(data["scale"])
+        if scale < 1:
+            raise ValueError("corrupt estimates data: scale must be >= 1")
+        self.decay = float(data["decay"])
+        self.scale = scale
+        self.conf_avg = conf
+        self.fail_avg = fail
+        self.avg = avg
+        self.tx_ct_avg = txct
 
 
 class BlockPolicyEstimator:
+    """ref policy/fees.h:139 CBlockPolicyEstimator."""
+
     def __init__(self) -> None:
-        self.buckets: List[float] = []
-        b = _MIN_BUCKET
-        while b <= _MAX_BUCKET:
-            self.buckets.append(b)
-            b *= _BUCKET_SPACING
-        n = len(self.buckets)
-        self.max_confirms = 25
-        # conf_avg[target][bucket]: decayed count confirmed within target
-        self.conf_avg = [[0.0] * n for _ in range(self.max_confirms)]
-        self.tx_avg = [0.0] * n
-        self._tracked: Dict[int, tuple] = {}  # txid -> (height, bucket)
+        self.buckets = _bucket_bounds()
+        self.feeStats = TxConfirmStats(
+            self.buckets, MED_BLOCK_PERIODS, MED_DECAY, MED_SCALE)
+        self.shortStats = TxConfirmStats(
+            self.buckets, SHORT_BLOCK_PERIODS, SHORT_DECAY, SHORT_SCALE)
+        self.longStats = TxConfirmStats(
+            self.buckets, LONG_BLOCK_PERIODS, LONG_DECAY, LONG_SCALE)
         self.best_height = 0
+        self.first_recorded_height = 0
+        self.historical_first = 0
+        self.historical_best = 0
+        self.tracked_txs = 0
+        self.untracked_txs = 0
+        # txid -> (entry_height, bucket_index, feerate sat/kB)
+        self._tracked: Dict[int, Tuple[int, int, float]] = {}
+
+    # ----------------------------------------------------------- intake
 
     def _bucket_index(self, feerate: float) -> int:
-        if feerate <= _MIN_BUCKET:
-            return 0
-        idx = int(math.log(feerate / _MIN_BUCKET) / math.log(_BUCKET_SPACING))
-        return min(idx, len(self.buckets) - 1)
+        """lower_bound over inclusive upper bounds (ref bucketMap use)."""
+        import bisect
 
-    def process_tx(self, txid: int, height: int, fee: int, size: int) -> None:
-        feerate = fee * 1000 / max(size, 1)
-        self._tracked[txid] = (height, self._bucket_index(feerate))
+        return bisect.bisect_left(self.buckets, feerate)
+
+    def process_tx(self, txid: int, height: int, fee: int, size: int,
+                   valid_fee_estimate: bool = True) -> None:
+        """ref fees.cpp:567-603 processTransaction."""
+        if txid in self._tracked:
+            return
+        if height != self.best_height:
+            # ignore side chains / not-synced entries (fees.cpp:578-585)
+            return
+        if not valid_fee_estimate:
+            self.untracked_txs += 1
+            return
+        self.tracked_txs += 1
+        feerate = fee * 1000.0 / max(size, 1)
+        bucket = self._bucket_index(feerate)
+        self._tracked[txid] = (height, bucket, feerate)
+        self.feeStats.new_tx(height, bucket)
+        self.shortStats.new_tx(height, bucket)
+        self.longStats.new_tx(height, bucket)
+
+    def remove_tx(self, txid: int, in_block: bool = False) -> bool:
+        """ref fees.cpp:526-541 removeTx."""
+        info = self._tracked.pop(txid, None)
+        if info is None:
+            return False
+        entry_height, bucket, _ = info
+        for stats in (self.feeStats, self.shortStats, self.longStats):
+            stats.remove_tx(entry_height, self.best_height, bucket, in_block)
+        return True
+
+    def _process_block_tx(self, height: int, txid: int) -> bool:
+        """ref fees.cpp:605-630 processBlockTx."""
+        info = self._tracked.get(txid)
+        if not self.remove_tx(txid, in_block=True):
+            return False
+        entry_height, bucket, feerate = info
+        blocks_to_confirm = height - entry_height
+        if blocks_to_confirm <= 0:
+            return False
+        for stats in (self.feeStats, self.shortStats, self.longStats):
+            stats.record(blocks_to_confirm, bucket, feerate)
+        return True
 
     def process_block(self, height: int, txids: List[int]) -> None:
-        """Record confirmation delays for tracked txs in this block."""
+        """ref fees.cpp:632-678 processBlock."""
+        if height <= self.best_height:
+            return  # side chains / reorgs don't update estimates
         self.best_height = height
-        # decay
-        for row in self.conf_avg:
-            for i in range(len(row)):
-                row[i] *= _DECAY
-        for i in range(len(self.tx_avg)):
-            self.tx_avg[i] *= _DECAY
+        for stats in (self.feeStats, self.shortStats, self.longStats):
+            stats.clear_current(height)
+            stats.update_moving_averages()
+        counted = 0
         for txid in txids:
-            info = self._tracked.pop(txid, None)
-            if info is None:
-                continue
-            entry_height, bucket = info
-            blocks_to_confirm = max(height - entry_height, 1)
-            self.tx_avg[bucket] += 1
-            for target in range(blocks_to_confirm - 1, self.max_confirms):
-                self.conf_avg[target][bucket] += 1
+            if self._process_block_tx(height, txid):
+                counted += 1
+        if self.first_recorded_height == 0 and counted > 0:
+            self.first_recorded_height = height
+        self.tracked_txs = 0
+        self.untracked_txs = 0
 
-    def remove_tx(self, txid: int) -> None:
-        self._tracked.pop(txid, None)
+    def flush_unconfirmed(self, txids: List[int]) -> None:
+        """Shutdown: record still-unconfirmed txs as failures
+        (ref fees.cpp:1036-1047 FlushUnconfirmed)."""
+        for txid in txids:
+            self.remove_tx(txid, in_block=False)
 
-    def estimate_fee(self, target: int) -> Optional[float]:
-        """sat/kB estimate to confirm within `target` blocks, or None."""
-        target = min(max(target, 1), self.max_confirms)
-        row = self.conf_avg[target - 1]
-        # find the cheapest bucket with enough data and high success
-        for i, bucket in enumerate(self.buckets):
-            if self.tx_avg[i] < _SUFFICIENT_TXS:
-                continue
-            if row[i] / self.tx_avg[i] >= _MIN_SUCCESS_PCT:
-                return bucket
-        return None
+    # -------------------------------------------------------- estimates
 
-    def estimate_smart_fee(self, target: int) -> tuple:
-        """Walks up targets until an estimate exists (ref estimateSmartFee)."""
-        for t in range(target, self.max_confirms + 1):
-            est = self.estimate_fee(t)
-            if est is not None:
-                return est, t
-        return None, target
+    def _stats_for(self, horizon: str) -> TxConfirmStats:
+        return {
+            HORIZON_SHORT: self.shortStats,
+            HORIZON_MED: self.feeStats,
+            HORIZON_LONG: self.longStats,
+        }[horizon]
+
+    def highest_target_tracked(self, horizon: str) -> int:
+        return self._stats_for(horizon).max_confirms()
+
+    def _block_span(self) -> int:
+        if self.first_recorded_height == 0:
+            return 0
+        return self.best_height - self.first_recorded_height
+
+    def _historical_block_span(self) -> int:
+        if self.historical_first == 0:
+            return 0
+        if self.best_height - self.historical_best > OLDEST_ESTIMATE_HISTORY:
+            return 0
+        return self.historical_best - self.historical_first
+
+    def _max_usable_estimate(self) -> int:
+        """ref fees.cpp:761-765 MaxUsableEstimate."""
+        return min(
+            self.longStats.max_confirms(),
+            max(self._block_span(), self._historical_block_span()) // 2,
+        )
+
+    def estimate_raw_fee(self, conf_target: int, success_threshold: float,
+                         horizon: str) -> Tuple[Optional[float], dict]:
+        """sat/kB estimate at one horizon/threshold, plus bucket detail
+        (ref fees.cpp:690-725 estimateRawFee)."""
+        stats = self._stats_for(horizon)
+        sufficient = (
+            SUFFICIENT_TXS_SHORT if horizon == HORIZON_SHORT
+            else SUFFICIENT_FEETXS
+        )
+        if conf_target <= 0 or conf_target > stats.max_confirms():
+            return None, {}
+        if success_threshold > 1:
+            return None, {}
+        median, result = stats.estimate_median_val(
+            conf_target, sufficient, success_threshold, self.best_height)
+        if median < 0:
+            return None, result
+        return median, result
+
+    def estimate_fee(self, conf_target: int) -> Optional[float]:
+        """DEPRECATED single-horizon estimate (ref fees.cpp:681-688)."""
+        if conf_target <= 1:
+            return None
+        est, _ = self.estimate_raw_fee(
+            conf_target, DOUBLE_SUCCESS_PCT, HORIZON_MED)
+        return est
+
+    def _estimate_combined_fee(self, conf_target: int, threshold: float,
+                               check_shorter: bool) -> float:
+        """ref fees.cpp:771-808 estimateCombinedFee."""
+        estimate = -1.0
+        if conf_target < 1 or conf_target > self.longStats.max_confirms():
+            return estimate
+        if conf_target <= self.shortStats.max_confirms():
+            estimate, _ = self.shortStats.estimate_median_val(
+                conf_target, SUFFICIENT_TXS_SHORT, threshold,
+                self.best_height)
+        elif conf_target <= self.feeStats.max_confirms():
+            estimate, _ = self.feeStats.estimate_median_val(
+                conf_target, SUFFICIENT_FEETXS, threshold, self.best_height)
+        else:
+            estimate, _ = self.longStats.estimate_median_val(
+                conf_target, SUFFICIENT_FEETXS, threshold, self.best_height)
+        if check_shorter:
+            if conf_target > self.feeStats.max_confirms():
+                med_max, _ = self.feeStats.estimate_median_val(
+                    self.feeStats.max_confirms(), SUFFICIENT_FEETXS,
+                    threshold, self.best_height)
+                if med_max > 0 and (estimate == -1 or med_max < estimate):
+                    estimate = med_max
+            if conf_target > self.shortStats.max_confirms():
+                short_max, _ = self.shortStats.estimate_median_val(
+                    self.shortStats.max_confirms(), SUFFICIENT_TXS_SHORT,
+                    threshold, self.best_height)
+                if short_max > 0 and (estimate == -1 or short_max < estimate):
+                    estimate = short_max
+        return estimate
+
+    def _estimate_conservative_fee(self, double_target: int) -> float:
+        """ref fees.cpp:813-829 estimateConservativeFee."""
+        estimate = -1.0
+        if double_target <= self.shortStats.max_confirms():
+            estimate, _ = self.feeStats.estimate_median_val(
+                double_target, SUFFICIENT_FEETXS, DOUBLE_SUCCESS_PCT,
+                self.best_height)
+        if double_target <= self.feeStats.max_confirms():
+            long_est, _ = self.longStats.estimate_median_val(
+                double_target, SUFFICIENT_FEETXS, DOUBLE_SUCCESS_PCT,
+                self.best_height)
+            if long_est > estimate:
+                estimate = long_est
+        return estimate
+
+    def estimate_smart_fee(self, conf_target: int, conservative: bool = True
+                           ) -> Tuple[Optional[float], int]:
+        """(sat/kB estimate or None, target answered at)
+        (ref fees.cpp:838-905 estimateSmartFee)."""
+        if conf_target <= 0 or conf_target > self.longStats.max_confirms():
+            return None, conf_target
+        if conf_target == 1:
+            conf_target = 2  # no reasonable next-block estimates
+        max_usable = self._max_usable_estimate()
+        if conf_target > max_usable:
+            conf_target = max_usable
+        if conf_target <= 1:
+            return None, conf_target
+        median = self._estimate_combined_fee(
+            conf_target // 2, HALF_SUCCESS_PCT, True)
+        actual = self._estimate_combined_fee(conf_target, SUCCESS_PCT, True)
+        if actual > median:
+            median = actual
+        double_est = self._estimate_combined_fee(
+            2 * conf_target, DOUBLE_SUCCESS_PCT, not conservative)
+        if double_est > median:
+            median = double_est
+        if conservative or median == -1:
+            cons = self._estimate_conservative_fee(2 * conf_target)
+            if cons > median:
+                median = cons
+        if median < 0:
+            return None, conf_target
+        return median, conf_target
 
     # ----------------------------------------------------- persistence
     # ref CBlockPolicyEstimator::Write/Read -> fee_estimates.dat
-    # (policy/fees.cpp:916, flushed from Shutdown(), loaded in init Step
-    # 7): learned confirmation statistics survive restarts.  In-flight
+    # (fees.cpp:916-1034, flushed from Shutdown(), loaded in init Step 7):
+    # learned confirmation statistics survive restarts.  In-flight
     # _tracked txs are NOT persisted — the mempool reload re-announces
     # them — matching the reference, which only serializes the stats.
 
-    _FILE_VERSION = 1
+    _FILE_VERSION = 2
 
     def write_file(self, path: str) -> None:
-        import json
-        import os
-
+        if self._block_span() > self._historical_block_span() // 2:
+            hist = (self.first_recorded_height, self.best_height)
+        else:
+            hist = (self.historical_first, self.historical_best)
         data = {
             "version": self._FILE_VERSION,
             "n_buckets": len(self.buckets),
-            "max_confirms": self.max_confirms,
             "best_height": self.best_height,
-            "tx_avg": self.tx_avg,
-            "conf_avg": self.conf_avg,
+            "historical_first": hist[0],
+            "historical_best": hist[1],
+            "fee_stats": self.feeStats.to_json(),
+            "short_stats": self.shortStats.to_json(),
+            "long_stats": self.longStats.to_json(),
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -111,10 +540,7 @@ class BlockPolicyEstimator:
     def read_file(self, path: str) -> bool:
         """Load stats; False (and untouched state) on any mismatch — a
         stale file from different bucket parameters must not poison
-        estimates (the reference guards with its serialization version)."""
-        import json
-        import os
-
+        estimates (ref Read's version/shape guards, fees.cpp:973-1014)."""
         if not os.path.exists(path):
             return False
         try:
@@ -123,23 +549,61 @@ class BlockPolicyEstimator:
             if (
                 data.get("version") != self._FILE_VERSION
                 or data.get("n_buckets") != len(self.buckets)
-                or data.get("max_confirms") != self.max_confirms
             ):
                 return False
-            tx_avg = [float(x) for x in data["tx_avg"]]
-            conf_avg = [[float(x) for x in row] for row in data["conf_avg"]]
-            if len(tx_avg) != len(self.buckets) or len(conf_avg) != (
-                self.max_confirms
-            ):
+            hist_first = int(data.get("historical_first", 0))
+            hist_best = int(data.get("historical_best", 0))
+            best = int(data.get("best_height", 0))
+            if hist_first > hist_best or hist_best > best:
                 return False
-            if any(len(row) != len(self.buckets) for row in conf_avg):
-                return False  # a short row would IndexError in process_block
+            fresh = (
+                TxConfirmStats(self.buckets, MED_BLOCK_PERIODS, MED_DECAY,
+                               MED_SCALE),
+                TxConfirmStats(self.buckets, SHORT_BLOCK_PERIODS, SHORT_DECAY,
+                               SHORT_SCALE),
+                TxConfirmStats(self.buckets, LONG_BLOCK_PERIODS, LONG_DECAY,
+                               LONG_SCALE),
+            )
+            fresh[0].load_json(data["fee_stats"])
+            fresh[1].load_json(data["short_stats"])
+            fresh[2].load_json(data["long_stats"])
         except (OSError, ValueError, KeyError, TypeError):
             return False
-        self.tx_avg = tx_avg
-        self.conf_avg = conf_avg
-        self.best_height = int(data.get("best_height", 0))
+        self.feeStats, self.shortStats, self.longStats = fresh
+        self.best_height = best
+        self.historical_first = hist_first
+        self.historical_best = hist_best
         return True
+
+
+class FeeFilterRounder:
+    """Quantize BIP133 feefilter values for privacy
+    (ref policy/fees.h:279-300 + fees.cpp:1049-1055)."""
+
+    MAX_FILTER_FEERATE = 1e7
+    FEE_FILTER_SPACING = 1.1
+
+    def __init__(self, min_incremental_fee: float) -> None:
+        from ..crypto.chacha20 import FastRandomContext
+
+        min_filter = max(1.0, min_incremental_fee / 2)
+        self.feeset: List[float] = [0.0]
+        b = min_filter
+        while b <= self.MAX_FILTER_FEERATE:
+            self.feeset.append(b)
+            b *= self.FEE_FILTER_SPACING
+        self._rand = FastRandomContext()
+
+    def round(self, current_min_fee: float) -> int:
+        """lower_bound pick, decremented with 2/3 probability (and always
+        when past the end) — unpredictable to peers (ref fees.cpp:1051)."""
+        import bisect
+
+        it = bisect.bisect_left(self.feeset, current_min_fee)
+        at_end = it == len(self.feeset)
+        if (it != 0 and self._rand.rand32() % 3 != 0) or at_end:
+            it -= 1
+        return int(round(self.feeset[it]))
 
 
 fee_estimator = BlockPolicyEstimator()

@@ -2,10 +2,25 @@
 
 Role parity with the reference's LevelDB wrapper (ref src/dbwrapper.{h,cpp}
 CDBWrapper over vendored src/leveldb/): atomic batched writes, prefix
-iteration, crash consistency.  Design here is a write-ahead log with CRC'd
-records over an in-memory table, compacted to a snapshot when the log grows
-— the durability contract the chainstate needs (batch atomicity) without
-vendoring a full LSM tree.
+iteration, crash consistency, and a disk-resident working set.
+
+Design: a single-level LSM —
+
+- **WAL**: every batch appends CRC'd records + a commit marker; torn or
+  corrupt tails are discarded on recovery (ref leveldb log_format).
+- **Memtable**: the WAL's contents live in a dict (value or tombstone)
+  until compaction.
+- **Snapshot**: a sorted, block-structured table on disk.  Blocks are
+  ~64 KiB, CRC'd; RAM holds only a sparse index (first key + offset per
+  block) and a small LRU block cache, so the full key space does NOT
+  live in process memory (the r3 design's all-RAM table was its scale
+  ceiling).
+- **Compaction**: streaming merge of the snapshot with the sorted
+  memtable into a new snapshot — peak memory is one block + the
+  memtable, never the whole table.
+
+Capacity envelope is measured by tools/kvstore_soak.py and documented in
+README (10 M coins: RSS and compaction time).
 """
 
 from __future__ import annotations
@@ -13,12 +28,21 @@ from __future__ import annotations
 import os
 import struct
 import zlib
-from typing import Dict, Iterator, Optional, Tuple
+from bisect import bisect_right
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
 
-_MAGIC = b"NXKV"
+_MAGIC_V1 = b"NXKV"  # r3 full-table snapshot (read-supported for upgrade)
+_MAGIC_V2 = b"NXK2"  # block-structured snapshot
+_FOOTER = b"NXKF"
 _REC_PUT = 1
 _REC_DEL = 2
 _REC_COMMIT = 3
+
+_BLOCK_TARGET = 64 * 1024
+_BLOCK_CACHE_BLOCKS = 256  # ~16 MiB hot-block cache
+
+_TOMBSTONE = None
 
 
 class KVError(Exception):
@@ -40,11 +64,185 @@ class WriteBatch:
         return self
 
 
+def _pack_block(items: List[Tuple[bytes, bytes]]) -> bytes:
+    parts = [struct.pack("<I", len(items))]
+    for k, v in items:
+        parts.append(struct.pack("<II", len(k), len(v)))
+        parts.append(k)
+        parts.append(v)
+    body = b"".join(parts)
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+def _unpack_block(data: bytes) -> List[Tuple[bytes, bytes]]:
+    if len(data) < 8:
+        raise KVError("short block")
+    body, (crc,) = data[:-4], struct.unpack_from("<I", data, len(data) - 4)
+    if zlib.crc32(body) != crc:
+        raise KVError("block crc mismatch")
+    (count,) = struct.unpack_from("<I", body, 0)
+    i = 4
+    out = []
+    for _ in range(count):
+        klen, vlen = struct.unpack_from("<II", body, i)
+        i += 8
+        out.append((body[i : i + klen], body[i + klen : i + klen + vlen]))
+        i += klen + vlen
+    return out
+
+
+class _Snapshot:
+    """Read side of one block-structured snapshot file."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.first_keys: List[bytes] = []
+        self.offsets: List[Tuple[int, int]] = []  # (offset, length)
+        self.count = 0
+        self._file = None
+        # block index -> (sorted record list, lazily-built lookup dict)
+        self._cache: OrderedDict[int, list] = OrderedDict()
+        if os.path.exists(path):
+            self._open()
+
+    def _open(self) -> None:
+        f = open(self.path, "rb")
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            f.close()
+            return
+        f.seek(0)
+        magic = f.read(4)
+        if magic == _MAGIC_V1:
+            f.close()
+            raise _LegacySnapshot(self.path)
+        if magic != _MAGIC_V2:
+            raise KVError("bad snapshot magic")
+        f.seek(size - 20)
+        footer = f.read(20)
+        idx_off, count, idx_crc = struct.unpack("<QQI", footer[:20])
+        f.seek(idx_off)
+        idx_data = f.read(size - 20 - idx_off)
+        if zlib.crc32(idx_data) != idx_crc:
+            raise KVError("snapshot index crc mismatch")
+        i = 0
+        while i < len(idx_data):
+            klen, off, length = struct.unpack_from("<IQI", idx_data, i)
+            i += 16
+            self.first_keys.append(idx_data[i : i + klen])
+            self.offsets.append((off, length))
+            i += klen
+        self.count = count
+        self._file = f
+
+    def _entry(self, bi: int) -> list:
+        ent = self._cache.get(bi)
+        if ent is not None:
+            self._cache.move_to_end(bi)
+            return ent
+        off, length = self.offsets[bi]
+        self._file.seek(off)
+        ent = [_unpack_block(self._file.read(length)), None]
+        self._cache[bi] = ent
+        while len(self._cache) > _BLOCK_CACHE_BLOCKS:
+            self._cache.popitem(last=False)
+        return ent
+
+    def block(self, bi: int) -> List[Tuple[bytes, bytes]]:
+        return self._entry(bi)[0]
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if not self.first_keys:
+            return None
+        bi = bisect_right(self.first_keys, key) - 1
+        if bi < 0:
+            return None
+        ent = self._entry(bi)
+        if ent[1] is None:
+            ent[1] = dict(ent[0])
+        return ent[1].get(key)
+
+    def iterate_from(self, start_key: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        if not self.first_keys:
+            return
+        bi = max(bisect_right(self.first_keys, start_key) - 1, 0)
+        for b in range(bi, len(self.offsets)):
+            for k, v in self.block(b):
+                if k >= start_key:
+                    yield k, v
+
+    def iterate(self) -> Iterator[Tuple[bytes, bytes]]:
+        for b in range(len(self.offsets)):
+            yield from self.block(b)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        self._cache.clear()
+
+
+class _LegacySnapshot(Exception):
+    """r3 full-table snapshot encountered; caller loads it as memtable."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+
+def _write_snapshot(path: str, items: Iterator[Tuple[bytes, bytes]]) -> int:
+    """Stream sorted items into a block-structured snapshot; returns count."""
+    tmp = path + ".tmp"
+    count = 0
+    index: List[Tuple[bytes, int, int]] = []
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC_V2)
+        cur: List[Tuple[bytes, bytes]] = []
+        cur_size = 0
+
+        def flush_block():
+            nonlocal cur, cur_size
+            if not cur:
+                return
+            data = _pack_block(cur)
+            index.append((cur[0][0], f.tell(), len(data)))
+            f.write(data)
+            cur = []
+            cur_size = 0
+
+        for k, v in items:
+            cur.append((k, v))
+            cur_size += len(k) + len(v) + 8
+            count += 1
+            if cur_size >= _BLOCK_TARGET:
+                flush_block()
+        flush_block()
+        idx_off = f.tell()
+        idx_parts = []
+        for k, off, length in index:
+            idx_parts.append(struct.pack("<IQI", len(k), off, length))
+            idx_parts.append(k)
+        idx_data = b"".join(idx_parts)
+        f.write(idx_data)
+        f.write(struct.pack("<QQI", idx_off, count, zlib.crc32(idx_data)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return count
+
+
 class KVStore:
     """get/put/delete/batch/prefix-scan store. path=None => memory only."""
 
-    def __init__(self, path: Optional[str] = None, compact_threshold: int = 1 << 24):
-        self._table: Dict[bytes, bytes] = {}
+    def __init__(self, path: Optional[str] = None,
+                 compact_threshold: int = 1 << 24):
+        # (snapshot, memtable) swapped as ONE tuple: readers (get /
+        # in-flight iterate generators on RPC threads) load it once and
+        # keep a consistent pair even if a compaction swaps mid-scan.
+        # The superseded _Snapshot is not closed eagerly — its file
+        # handle lives until the last reader drops it (refcount).
+        self._state: Tuple[Optional[_Snapshot], Dict[bytes, Optional[bytes]]]
+        self._state = (None, {})
         self._path = path
         self._log = None
         self._log_size = 0
@@ -59,23 +257,31 @@ class KVStore:
 
     # -- recovery ---------------------------------------------------------
 
+    @property
+    def _snap(self) -> Optional[_Snapshot]:
+        return self._state[0]
+
+    @property
+    def _mem(self) -> Dict[bytes, Optional[bytes]]:
+        return self._state[1]
+
     def _load(self) -> None:
-        if os.path.exists(self._snapshot_path):
+        snap, mem = None, {}
+        try:
+            snap = _Snapshot(self._snapshot_path)
+        except _LegacySnapshot:
+            # r3 full-table format: pull into the memtable; the next
+            # compaction rewrites it block-structured
             with open(self._snapshot_path, "rb") as f:
                 data = f.read()
-            if data[:4] != _MAGIC:
-                raise KVError("bad snapshot magic")
             i = 4
             (count,) = struct.unpack_from("<Q", data, i)
             i += 8
             for _ in range(count):
                 klen, vlen = struct.unpack_from("<II", data, i)
                 i += 8
-                k = data[i : i + klen]
-                i += klen
-                v = data[i : i + vlen]
-                i += vlen
-                self._table[k] = v
+                mem[data[i : i + klen]] = data[i + klen : i + klen + vlen]
+                i += klen + vlen
         # replay WAL; torn trailing records are discarded
         if os.path.exists(self._log_path):
             with open(self._log_path, "rb") as f:
@@ -87,10 +293,7 @@ class KVStore:
                 j = i + 9
                 if rec_type == _REC_COMMIT:
                     for t, k, v in pending:
-                        if t == _REC_PUT:
-                            self._table[k] = v
-                        else:
-                            self._table.pop(k, None)
+                        mem[k] = v if t == _REC_PUT else _TOMBSTONE
                     pending = []
                     i = j
                     continue
@@ -103,6 +306,7 @@ class KVStore:
                     break  # corruption: stop replay here
                 pending.append((rec_type, k, v))
                 i = j + klen + vlen + 4
+        self._state = (snap, mem)
 
     # -- writes -----------------------------------------------------------
 
@@ -123,10 +327,7 @@ class KVStore:
             if sync:
                 os.fsync(self._log.fileno())
         for t, k, v in batch.ops:
-            if t == _REC_PUT:
-                self._table[k] = v
-            else:
-                self._table.pop(k, None)
+            self._mem[k] = v if t == _REC_PUT else _TOMBSTONE
         if self._log is not None and self._log_size > self._compact_threshold:
             self.compact()
 
@@ -139,43 +340,82 @@ class KVStore:
     # -- reads ------------------------------------------------------------
 
     def get(self, key: bytes) -> Optional[bytes]:
-        return self._table.get(bytes(key))
+        key = bytes(key)
+        snap, mem = self._state
+        if key in mem:
+            return mem[key]
+        if snap is not None:
+            return snap.get(key)
+        return None
 
     def exists(self, key: bytes) -> bool:
-        return bytes(key) in self._table
+        return self.get(key) is not None
 
     def iterate(self, prefix: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
-        """Sorted prefix scan (ref CDBIterator Seek/Next)."""
-        for k in sorted(self._table):
-            if k.startswith(prefix):
-                yield k, self._table[k]
+        """Sorted prefix scan (ref CDBIterator Seek/Next): streaming merge
+        of the snapshot blocks with the sorted memtable."""
+        yield from self._merged(start_key=prefix, prefix=prefix)
+
+    def _merged(self, start_key: bytes = b"", prefix: Optional[bytes] = None
+                ) -> Iterator[Tuple[bytes, bytes]]:
+        snap, mem = self._state  # one consistent pair for the whole scan
+        mem_keys = sorted(k for k in mem if k >= start_key)
+        mi = 0
+        snap_it = (
+            snap.iterate_from(start_key)
+            if snap is not None and start_key
+            else snap.iterate()
+            if snap is not None
+            else iter(())
+        )
+        snap_item = next(snap_it, None)
+        while mi < len(mem_keys) or snap_item is not None:
+            if snap_item is not None and (
+                mi >= len(mem_keys) or snap_item[0] < mem_keys[mi]
+            ):
+                k, v = snap_item
+                snap_item = next(snap_it, None)
+            else:
+                k = mem_keys[mi]
+                v = mem[k]
+                mi += 1
+                if snap_item is not None and snap_item[0] == k:
+                    snap_item = next(snap_it, None)  # memtable shadows
+                if v is _TOMBSTONE:
+                    continue
+            if prefix and not k.startswith(prefix):
+                if k > prefix:
+                    return  # sorted: past the prefix range, nothing more
+                continue
+            yield k, v
 
     def __len__(self) -> int:
-        return len(self._table)
+        n = sum(1 for _ in self._merged())
+        return n
 
     # -- maintenance -------------------------------------------------------
 
     def compact(self) -> None:
-        """Write snapshot, truncate WAL."""
+        """Streaming merge memtable + snapshot -> new snapshot; reset WAL.
+
+        The old (snapshot, memtable) pair is swapped out, not mutated:
+        in-flight readers finish their scan against the superseded pair
+        (its deleted-inode file handle stays valid until dropped)."""
         if self._path is None:
             return
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_MAGIC)
-            f.write(struct.pack("<Q", len(self._table)))
-            for k, v in self._table.items():
-                f.write(struct.pack("<II", len(k), len(v)))
-                f.write(k)
-                f.write(v)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snapshot_path)
+        count = _write_snapshot(self._snapshot_path, self._merged())
+        new_snap = _Snapshot(self._snapshot_path)
+        assert new_snap.count == count
+        self._state = (new_snap, {})
         self._log.close()
         self._log = open(self._log_path, "wb")
         self._log_size = 0
 
     def close(self) -> None:
         if self._log is not None:
-            self.compact()
+            if self._mem:
+                self.compact()
             self._log.close()
             self._log = None
+        if self._snap is not None:
+            self._snap.close()

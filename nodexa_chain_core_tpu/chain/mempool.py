@@ -11,11 +11,12 @@ index maintenance).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set
 
 from ..consensus.tx_verify import get_legacy_sigop_count
 from ..primitives.transaction import OutPoint, Transaction
+from .policy import DEFAULT_MIN_RELAY_TX_FEE as _INCREMENTAL_RELAY_FEERATE
 from .coins import Coin, CoinsView, CoinsViewBacked, CoinsViewCache
 
 DEFAULT_ANCESTOR_LIMIT = 25
@@ -74,6 +75,8 @@ class TxMemPool:
         self._spenders: Dict[OutPoint, int] = {}  # mapNextTx: prevout -> txid
         self._disconnected: List[Transaction] = []
         self.max_size_bytes = max_size_bytes
+        self._rolling_min_fee = 0.0
+        self._rolling_time = 0.0
 
     # -- queries -----------------------------------------------------------
 
@@ -171,10 +174,15 @@ class TxMemPool:
             self._remove_single(d)
         self._remove_single(txid)
 
-    def _remove_single(self, txid: int) -> None:
+    def _remove_single(self, txid: int, in_block: bool = False) -> None:
         e = self._entries.pop(txid, None)
         if e is None:
             return
+        # ref CTxMemPool::removeUnchecked -> estimator removeTx: evictions
+        # and expiries count as confirmation failures (failAvg)
+        from .fees import fee_estimator
+
+        fee_estimator.remove_tx(txid, in_block=in_block)
         for txin in e.tx.vin:
             if self._spenders.get(txin.prevout) == txid:
                 del self._spenders[txin.prevout]
@@ -189,7 +197,7 @@ class TxMemPool:
     def remove_for_block(self, vtx: List[Transaction]) -> None:
         """ref removeForBlock: drop included + conflicted txs."""
         for tx in vtx:
-            self._remove_single(tx.txid)
+            self._remove_single(tx.txid, in_block=True)
             for txin in tx.vin:
                 conflict = self._spenders.get(txin.prevout)
                 if conflict is not None and conflict != tx.txid:
@@ -229,14 +237,37 @@ class TxMemPool:
         )
 
     def trim_to_size(self, max_bytes: int) -> List[int]:
-        """Evict lowest descendant-score packages (ref TrimToSize)."""
+        """Evict lowest descendant-score packages (ref TrimToSize); each
+        eviction raises the rolling minimum feerate new entries must
+        beat (ref trackPackageRemoved)."""
         removed = []
         while self.total_size_bytes() > max_bytes and self._entries:
             worst = self.ordered_by_descendant_score()[0]
+            feerate = (
+                worst.fees_with_descendants
+                * 1000
+                / max(worst.size_with_descendants, 1)
+            )
+            if feerate + _INCREMENTAL_RELAY_FEERATE > self._rolling_min_fee:
+                self._rolling_min_fee = feerate + _INCREMENTAL_RELAY_FEERATE
+                self._rolling_time = time.time()
             txid = worst.tx.txid
             removed.append(txid)
             self.remove(txid, "size")
         return removed
+
+    def get_min_fee(self) -> float:
+        """sat/kB floor for new entries (ref CTxMemPool::GetMinFee):
+        raised by evictions, halves every 12 h, snaps to 0 below half
+        the incremental relay feerate."""
+        if self._rolling_min_fee <= 0:
+            return 0.0
+        now = time.time()
+        self._rolling_min_fee /= 2 ** ((now - self._rolling_time) / 43200.0)
+        self._rolling_time = now
+        if self._rolling_min_fee < _INCREMENTAL_RELAY_FEERATE / 2:
+            self._rolling_min_fee = 0.0
+        return self._rolling_min_fee
 
     # -- consistency -------------------------------------------------------
 

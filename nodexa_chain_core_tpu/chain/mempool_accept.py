@@ -11,7 +11,6 @@ from __future__ import annotations
 import time as _time
 from typing import Optional
 
-from ..consensus.consensus import MAX_BLOCK_SIGOPS_COST
 from ..consensus.tx_verify import (
     TxValidationError,
     check_transaction,
@@ -157,6 +156,14 @@ def _accept_to_memory_pool_locked(
     if not bypass_limits and fee < MIN_RELAY_FEE.fee_for(size):
         raise MempoolAcceptError("min relay fee not met", f"{fee} < {MIN_RELAY_FEE.fee_for(size)}")
 
+    # rolling mempool minimum after evictions (ref AcceptToMemoryPoolWorker
+    # mempoolRejectFee check backed by CTxMemPool::GetMinFee)
+    reject_fee = pool.get_min_fee() * size / 1000.0
+    if not bypass_limits and reject_fee > 0 and fee < reject_fee:
+        raise MempoolAcceptError(
+            "mempool min fee not met", f"{fee} < {reject_fee:.0f}"
+        )
+
     if conflicts:
         # BIP125 rule 6: the newcomer's feerate must beat every DIRECTLY
         # conflicting tx, or a huge low-feerate tx could evict a good one
@@ -244,6 +251,23 @@ def _accept_to_memory_pool_locked(
     )
     pool.add(entry)
 
+    # ref AcceptToMemoryPoolWorker validForFeeEstimation =
+    # !fReplacementTransaction && !bypass && pool.HasNoInputsOf(tx):
+    # RBF replacements and in-pool-parented txs don't feed the estimator
+    from .fees import fee_estimator
+
+    has_no_pool_inputs = not any(
+        pool.contains(txin.prevout.txid) for txin in tx.vin
+    )
+    # entry height for the estimator is the TIP (ref entry.GetHeight() ==
+    # chainActive.Height()), not this tx's validation height (tip+1)
+    fee_estimator.process_tx(
+        tx.txid, height - 1, fee, size,
+        valid_fee_estimate=(
+            not bypass_limits and not conflicts and has_no_pool_inputs
+        ),
+    )
+
     # -maxmempool enforcement: evict lowest descendant-score packages; if
     # the newcomer itself is evicted the submission fails (ref
     # validation.cpp LimitMempoolSize -> "mempool full").
@@ -251,10 +275,6 @@ def _accept_to_memory_pool_locked(
         pool.trim_to_size(pool.max_size_bytes)
         if not pool.contains(tx.txid):
             raise MempoolAcceptError("mempool-full", "mempool min fee not met")
-
-    from .fees import fee_estimator
-
-    fee_estimator.process_tx(tx.txid, height, fee, size)
 
     from ..node.events import main_signals
 

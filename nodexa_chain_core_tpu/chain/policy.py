@@ -6,9 +6,8 @@ acceptance and relay.
 
 from __future__ import annotations
 
-from ..core.amount import COIN
 from ..primitives.transaction import Transaction, TxOut
-from ..script.script import MAX_SCRIPT_SIZE, Script
+from ..script.script import Script
 from ..script.standard import (
     TX_MULTISIG,
     TX_NONSTANDARD,

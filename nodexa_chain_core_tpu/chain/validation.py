@@ -25,10 +25,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..consensus import pow as powrules
 from ..consensus.consensus import (
-    COINBASE_MATURITY,
     MAX_BLOCK_SERIALIZED_SIZE,
     MAX_BLOCK_SIGOPS_COST,
-    LOCKTIME_MEDIAN_TIME_PAST,
     LOCKTIME_VERIFY_SEQUENCE,
 )
 from ..consensus.merkle import block_merkle_root
@@ -51,8 +49,6 @@ from ..node.events import main_signals
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import OutPoint, Transaction
 from ..script.interpreter import (
-    MANDATORY_SCRIPT_VERIFY_FLAGS,
-    STANDARD_SCRIPT_VERIFY_FLAGS,
     TransactionSignatureChecker,
     VERIFY_P2SH,
     verify_script,
@@ -897,11 +893,14 @@ class ChainState:
         t_flush = time.perf_counter()
         idx.raise_validity(BlockStatus.VALID_SCRIPTS)
         self.active.set_tip(idx)
-        if self.mempool is not None:
-            self.mempool.remove_for_block(block.vtx)
+        # estimator first (Record needs its tracked entries), then the
+        # pool removal notifies remove_tx for already-erased txids — a
+        # no-op — matching ref removeForBlock's processBlock-then-remove
         from .fees import fee_estimator
 
         fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
+        if self.mempool is not None:
+            self.mempool.remove_for_block(block.vtx)
         main_signals.block_connected(block, idx, [])
         t_done = time.perf_counter()
         log_print(

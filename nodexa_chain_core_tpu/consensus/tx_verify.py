@@ -11,13 +11,11 @@ from typing import List, Optional, Tuple
 
 from ..chain.coins import CoinsViewCache
 from ..core.amount import MAX_MONEY, money_range
-from ..primitives.transaction import OutPoint, Transaction
+from ..primitives.transaction import Transaction
 from ..script.script import Script
 from .consensus import (
     COINBASE_MATURITY,
-    LOCKTIME_MEDIAN_TIME_PAST,
     LOCKTIME_VERIFY_SEQUENCE,
-    MAX_BLOCK_SERIALIZED_SIZE,
     WITNESS_SCALE_FACTOR,
 )
 

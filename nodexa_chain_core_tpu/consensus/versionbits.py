@@ -12,7 +12,7 @@ import enum
 from typing import Dict, Optional
 
 from ..chain.blockindex import BlockIndex
-from .params import ALWAYS_ACTIVE, ConsensusParams, Deployment
+from .params import ALWAYS_ACTIVE, ConsensusParams
 
 VERSIONBITS_TOP_BITS = 0x20000000
 VERSIONBITS_TOP_MASK = 0xE0000000

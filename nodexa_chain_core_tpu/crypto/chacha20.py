@@ -1,0 +1,192 @@
+"""ChaCha20 keystream generator + FastRandomContext.
+
+Parity: reference src/crypto/chacha20.{h,cpp} (djb variant — 64-bit
+IV/nonce in words 14-15, 64-bit block counter in words 12-13, "expand
+32-byte k" constants) and src/random.h:47 FastRandomContext, the
+non-cryptographic-cost fast RNG the reference uses for addrman bucket
+selection, peer eviction choices, feefilter quantization jitter and
+message-nonce generation.  Vector-pinned in tests/test_chacha20.py
+against the RFC 7539 / draft-agl-tls-chacha20poly1305 vectors the
+reference pins in src/test/crypto_tests.cpp:538.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Sequence
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _rotl32(v: int, c: int) -> int:
+    return ((v << c) | (v >> (32 - c))) & _MASK32
+
+
+_SIGMA = struct.unpack("<4I", b"expand 32-byte k")
+_TAU = struct.unpack("<4I", b"expand 16-byte k")
+
+
+class ChaCha20:
+    """Keystream-only ChaCha20 (ref chacha20.h: SetKey/SetIV/Seek/Output)."""
+
+    def __init__(self, key: Optional[bytes] = None) -> None:
+        self.input: List[int] = [0] * 16
+        if key is not None:
+            self.set_key(key)
+
+    def set_key(self, key: bytes) -> None:
+        if len(key) not in (16, 32):
+            raise ValueError("ChaCha20 key must be 16 or 32 bytes")
+        self.input[4:8] = struct.unpack("<4I", key[:16])
+        if len(key) == 32:
+            self.input[8:12] = struct.unpack("<4I", key[16:])
+            self.input[0:4] = _SIGMA
+        else:
+            self.input[8:12] = struct.unpack("<4I", key[:16])
+            self.input[0:4] = _TAU
+        self.input[12:16] = [0, 0, 0, 0]
+
+    def set_iv(self, iv: int) -> None:
+        """64-bit nonce -> words 14/15 (ref chacha20.cpp SetIV)."""
+        self.input[14] = iv & _MASK32
+        self.input[15] = (iv >> 32) & _MASK32
+
+    def seek(self, pos: int) -> None:
+        """64-bit block counter -> words 12/13 (ref chacha20.cpp Seek)."""
+        self.input[12] = pos & _MASK32
+        self.input[13] = (pos >> 32) & _MASK32
+
+    def _block(self) -> bytes:
+        x = list(self.input)
+
+        def qr(a: int, b: int, c: int, d: int) -> None:
+            x[a] = (x[a] + x[b]) & _MASK32
+            x[d] = _rotl32(x[d] ^ x[a], 16)
+            x[c] = (x[c] + x[d]) & _MASK32
+            x[b] = _rotl32(x[b] ^ x[c], 12)
+            x[a] = (x[a] + x[b]) & _MASK32
+            x[d] = _rotl32(x[d] ^ x[a], 8)
+            x[c] = (x[c] + x[d]) & _MASK32
+            x[b] = _rotl32(x[b] ^ x[c], 7)
+
+        for _ in range(10):  # 20 rounds: 10 column + diagonal pairs
+            qr(0, 4, 8, 12)
+            qr(1, 5, 9, 13)
+            qr(2, 6, 10, 14)
+            qr(3, 7, 11, 15)
+            qr(0, 5, 10, 15)
+            qr(1, 6, 11, 12)
+            qr(2, 7, 8, 13)
+            qr(3, 4, 9, 14)
+        out = struct.pack(
+            "<16I", *((x[i] + self.input[i]) & _MASK32 for i in range(16))
+        )
+        # 64-bit counter increment across words 12/13
+        self.input[12] = (self.input[12] + 1) & _MASK32
+        if self.input[12] == 0:
+            self.input[13] = (self.input[13] + 1) & _MASK32
+        return out
+
+    def keystream(self, nbytes: int) -> bytes:
+        """ref chacha20.cpp Output: raw keystream bytes."""
+        out = bytearray()
+        while len(out) < nbytes:
+            out += self._block()
+        return bytes(out[:nbytes])
+
+    def crypt(self, data: bytes) -> bytes:
+        """XOR data with the keystream (encrypt == decrypt)."""
+        ks = self.keystream(len(data))
+        return bytes(a ^ b for a, b in zip(data, ks))
+
+
+class FastRandomContext:
+    """Fast ChaCha20-backed RNG (ref random.h:47).
+
+    Not for key material — for protocol randomness that must be cheap
+    and unpredictable to peers: addrman bucket positions, eviction
+    choices, ping/msg nonces, feefilter jitter.
+    """
+
+    def __init__(self, deterministic: bool = False,
+                 seed: Optional[bytes] = None) -> None:
+        self.rng = ChaCha20()
+        self.bytebuf = b""
+        self.bitbuf = 0
+        self.bitbuf_size = 0
+        if seed is not None:
+            self.rng.set_key(seed[:32].ljust(32, b"\x00"))
+            self.requires_seed = False
+        elif deterministic:
+            self.rng.set_key(bytes(32))
+            self.requires_seed = False
+        else:
+            self.requires_seed = True
+
+    def _seed(self) -> None:
+        self.rng.set_key(os.urandom(32))
+        self.requires_seed = False
+
+    def _fill_byte_buffer(self) -> None:
+        if self.requires_seed:
+            self._seed()
+        self.bytebuf = self.rng.keystream(256)
+
+    def rand64(self) -> int:
+        if len(self.bytebuf) < 8:
+            self._fill_byte_buffer()
+        ret = struct.unpack("<Q", self.bytebuf[:8])[0]
+        self.bytebuf = self.bytebuf[8:]
+        return ret
+
+    def randbits(self, bits: int) -> int:
+        if bits == 0:
+            return 0
+        if bits > 32:
+            return self.rand64() >> (64 - bits)
+        if self.bitbuf_size < bits:
+            self.bitbuf = self.rand64()
+            self.bitbuf_size = 64
+        ret = self.bitbuf & ((1 << bits) - 1)
+        self.bitbuf >>= bits
+        self.bitbuf_size -= bits
+        return ret
+
+    def randrange(self, rng: int) -> int:
+        """Uniform in [0, rng) by rejection (ref random.h:106)."""
+        if rng <= 0:
+            raise ValueError("randrange requires a positive range")
+        limit = rng - 1
+        bits = limit.bit_length()
+        while True:
+            ret = self.randbits(bits)
+            if ret <= limit:
+                return ret
+
+    def randbytes(self, n: int) -> bytes:
+        if self.requires_seed:
+            self._seed()
+        return self.rng.keystream(n)
+
+    def rand32(self) -> int:
+        return self.randbits(32)
+
+    def rand256(self) -> int:
+        return int.from_bytes(self.randbytes(32), "little")
+
+    def randbool(self) -> bool:
+        return bool(self.randbits(1))
+
+    # conveniences mirroring the random-module call sites they replace
+    def choice(self, seq: Sequence):
+        return seq[self.randrange(len(seq))]
+
+    def shuffle(self, seq: list) -> None:
+        """Fisher-Yates with randrange."""
+        for i in range(len(seq) - 1, 0, -1):
+            j = self.randrange(i + 1)
+            seq[i], seq[j] = seq[j], seq[i]
+
+    def random(self) -> float:
+        return self.rand64() / (1 << 64)

@@ -16,7 +16,6 @@ from ..chain.validation import ChainState
 from ..consensus import pow as powrules
 from ..consensus.consensus import MAX_BLOCK_SIGOPS_COST
 from ..consensus.merkle import merkle_root
-from ..consensus.tx_verify import get_legacy_sigop_count
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
 from ..script.script import Script

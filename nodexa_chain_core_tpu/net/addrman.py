@@ -10,11 +10,11 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..crypto.chacha20 import FastRandomContext
 from ..crypto.hashes import siphash
 
 NEW_BUCKETS = 64
@@ -39,7 +39,11 @@ class AddrInfo:
 
 class AddrMan:
     def __init__(self, key: Optional[int] = None):
-        self._key = key if key is not None else random.getrandbits(64)
+        # ref CAddrMan: nKey + insecure_rand are FastRandomContext-backed
+        # (src/addrman.h:223) so bucket placement and selection jitter are
+        # not observable-PRNG (eclipse hardening)
+        self._rand = FastRandomContext()
+        self._key = key if key is not None else self._rand.rand64()
         self._addrs: Dict[str, AddrInfo] = {}
         self._new: List[List[Optional[str]]] = [
             [None] * BUCKET_SIZE for _ in range(NEW_BUCKETS)
@@ -132,7 +136,7 @@ class AddrMan:
     def select(self, new_only: bool = False) -> Optional[AddrInfo]:
         """ref CAddrMan::Select: biased coin-flip between tried/new."""
         candidates: List[str]
-        use_tried = not new_only and random.random() < 0.5
+        use_tried = not new_only and self._rand.randbool()
         table = self._tried if use_tried else self._new
         candidates = [k for bucket in table for k in bucket if k is not None]
         if not candidates:
@@ -140,11 +144,11 @@ class AddrMan:
             candidates = [k for bucket in table for k in bucket if k is not None]
         if not candidates:
             return None
-        return self._addrs.get(random.choice(candidates))
+        return self._addrs.get(self._rand.choice(candidates))
 
     def get_addresses(self, max_count: int = 1000) -> List[AddrInfo]:
         out = list(self._addrs.values())
-        random.shuffle(out)
+        self._rand.shuffle(out)
         return out[:max_count]
 
     def size(self) -> int:

@@ -14,7 +14,6 @@ GetShortID).
 
 from __future__ import annotations
 
-import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -22,6 +21,9 @@ from ..core.serialize import ByteReader, ByteWriter
 from ..crypto.hashes import sha256, siphash
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
+from ..crypto.chacha20 import FastRandomContext
+
+_rand = FastRandomContext()
 
 SHORTTXIDS_LENGTH = 6  # 48-bit short ids
 
@@ -70,7 +72,7 @@ class HeaderAndShortIDs:
         """Prefills only the coinbase, as the reference does when not given
         extra prefill hints (blockencodings.cpp constructor)."""
         if nonce is None:
-            nonce = random.getrandbits(64)
+            nonce = _rand.rand64()
         obj = cls(header=block.header, nonce=nonce)
         k0, k1 = _shortid_keys(block.header, nonce, schedule)
         obj.prefilled = [PrefilledTransaction(0, block.vtx[0])]

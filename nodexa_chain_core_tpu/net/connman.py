@@ -10,7 +10,6 @@ handler thread (ThreadMessageHandler analogue) driving
 from __future__ import annotations
 
 import queue
-import random
 import socket
 import threading
 import time

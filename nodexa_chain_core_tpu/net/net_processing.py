@@ -4,9 +4,8 @@
 
 from __future__ import annotations
 
-import random
 import time
-from typing import List, Optional
+from typing import List
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
 from ..chain.validation import BlockValidationError
@@ -14,8 +13,9 @@ from ..core.serialize import ByteReader, ByteWriter
 from ..core.uint256 import u256_hex
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
-from ..utils.logging import LogFlags, log_print, log_printf
+from ..utils.logging import LogFlags, log_print
 from . import protocol
+from ..crypto.chacha20 import FastRandomContext
 from .blockencodings import (
     BlockTransactions,
     BlockTransactionsRequest,
@@ -35,7 +35,6 @@ from .protocol import (
     MSG_FEEFILTER,
     MSG_GETADDR,
     MSG_GETASSETDATA,
-    MSG_GETBLOCKS,
     MSG_GETDATA,
     MSG_GETHEADERS,
     MSG_HEADERS,
@@ -61,6 +60,8 @@ from .protocol import (
     make_locator,
 )
 
+_rand = FastRandomContext()
+
 MAX_HEADERS_RESULTS = 2000
 MAX_BLOCKS_IN_FLIGHT_PER_PEER = 16
 MAX_INV_SIZE = 50_000
@@ -73,11 +74,12 @@ class NetProcessor:
         self.node = node
         self.connman = connman
         self.magic = node.params.message_start
-        self._local_nonce = random.getrandbits(64)
+        self._local_nonce = _rand.rand64()
         from .orphanage import TxOrphanage, TxRequestTracker
 
         self.orphanage = TxOrphanage()
         self.tx_requests = TxRequestTracker()
+        self._fee_rounder = None
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -215,7 +217,7 @@ class NetProcessor:
         for peer in self.connman.all_peers():
             if not peer.handshake_done:
                 continue
-            nonce = random.getrandbits(64)
+            nonce = _rand.rand64()
             peer.last_ping_nonce = nonce
             peer._ping_sent = time.time()
             w = ByteWriter()
@@ -493,9 +495,44 @@ class NetProcessor:
 
     def periodic(self) -> None:
         """Maintenance-tick work (called from the connman maintenance
-        thread): orphan expiry + request-tracker sweeps."""
+        thread): orphan expiry + request-tracker sweeps + feefilter."""
         self.orphanage.expire()
         self.tx_requests.expire()
+        self._send_feefilters()
+
+    _FEEFILTER_INTERVAL = 10 * 60  # ref AVG_FEEFILTER_BROADCAST_INTERVAL
+
+    def _send_feefilters(self) -> None:
+        """BIP133 outbound: advertise our (privacy-rounded) mempool min
+        feerate so peers skip relaying below it (ref net_processing.cpp
+        :3779-3804 'Message: feefilter')."""
+        if self._fee_rounder is None:
+            from ..chain.fees import FeeFilterRounder
+            from ..chain.policy import DEFAULT_MIN_RELAY_TX_FEE
+
+            self._fee_rounder = FeeFilterRounder(
+                float(DEFAULT_MIN_RELAY_TX_FEE))
+        now = time.time()
+        pool = self.node.mempool
+        current = float(pool.get_min_fee()) if pool is not None else 0.0
+        for peer in self.connman.all_peers():
+            if not peer.verack_received:
+                continue
+            if now < getattr(peer, "next_feefilter_send", 0.0):
+                continue
+            from ..chain.policy import DEFAULT_MIN_RELAY_TX_FEE
+
+            send = max(self._fee_rounder.round(current),
+                       DEFAULT_MIN_RELAY_TX_FEE)
+            if send != getattr(peer, "last_sent_feefilter", None):
+                w = ByteWriter()
+                w.i64(send)
+                peer.send_msg(self.magic, MSG_FEEFILTER, w.getvalue())
+                peer.last_sent_feefilter = send
+            # Poisson-ish spacing around the broadcast interval
+            peer.next_feefilter_send = now + self._FEEFILTER_INTERVAL * (
+                0.5 + _rand.random()
+            )
 
     def peer_disconnected(self, peer) -> None:
         self.orphanage.erase_for_peer(peer.id)

@@ -9,12 +9,14 @@ when a parent arrives, and erased when their announcing peer disconnects.
 
 from __future__ import annotations
 
-import random
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
 from ..primitives.transaction import Transaction
+from ..crypto.chacha20 import FastRandomContext
+
+_rand = FastRandomContext()
 
 MAX_ORPHAN_TRANSACTIONS = 100
 ORPHAN_TX_EXPIRE_TIME = 20 * 60
@@ -60,7 +62,7 @@ class TxOrphanage:
             self._by_prev.setdefault(txin.prevout.txid, set()).add(txid)
         # bound the pool: evict random orphans (ref LimitOrphanTxSize)
         while len(self._orphans) > self.max_orphans:
-            victim = random.choice(list(self._orphans))
+            victim = _rand.choice(list(self._orphans))
             self.erase(victim)
         return txid in self._orphans
 

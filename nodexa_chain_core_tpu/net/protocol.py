@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from ..core.serialize import ByteReader, ByteWriter
 from ..crypto.hashes import sha256d

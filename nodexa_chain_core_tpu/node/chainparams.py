@@ -19,7 +19,6 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..consensus.params import (
-    ALWAYS_ACTIVE,
     NEVER_ACTIVE,
     ConsensusParams,
     Deployment,

@@ -88,7 +88,9 @@ class NodeContext:
         if fee_path is not None:
             from ..chain.fees import fee_estimator
 
-            try:  # ref Shutdown() flushing fee_estimates.dat
+            try:  # ref Shutdown(): FlushUnconfirmed then fee_estimates.dat
+                if self.mempool is not None:
+                    fee_estimator.flush_unconfirmed(self.mempool.txids())
                 fee_estimator.write_file(fee_path)
             except OSError:
                 pass

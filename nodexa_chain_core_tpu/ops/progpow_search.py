@@ -49,6 +49,190 @@ L1_WORDS = ref.L1_CACHE_WORDS
 
 _U32 = jnp.uint32
 
+# ----------------------------------------------------- TPU round kernel
+#
+# The sweep cost is ~100% the 704 random 4-B gathers from the 16-KiB L1
+# cache (64 rounds x 11 accesses, each (LANES, B) offsets): XLA lowers
+# small-table gathers to a ~0.14 G elem/s element loop
+# (tools/search_profile.py bisect: removing only the cache accesses takes
+# a 2.7 s sweep to ~0).  TPU v5e has a hardware per-lane gather
+# (tpu.dynamic_gather) but only within a single vreg along the gathered
+# axis, so a 4096-word table can't be gathered directly.  Decomposition
+# that fits the hardware: off = hi*128 + lo with the table laid out
+# (32, 128); pass c lane-gathers chunk c by `lo` (a 128-entry-per-row
+# dynamic_gather) and selects it where hi == c — 32 passes x ~4 vreg-ops,
+# measured 4.1 G elem/s, ~30x the XLA gather
+# (tools/l1_gather32_bench.py).  Only Mosaic exposes that lowering
+# (jnp.take_along_axis axis=1, mode=promise_in_bounds), so the gathers
+# must live in Pallas.
+#
+# Packaging: one pallas_call per ProgPoW ROUND (not per access — 704
+# kernel instances blew up the XLA/Mosaic compile).  The kernel is
+# plan-DRIVEN: the round's selectors arrive as a scalar-prefetch operand
+# and every round shares ONE Mosaic kernel; register state is a single
+# (REGS*LANES, B) u32 array aliased input->output, mutated in place with
+# dynamic-start row slices (reg k lives at rows [k*16, k*16+16)).  The
+# interleaved cache/math/epilogue merge order of the reference spec
+# (ref progpow.cpp:15 progPowLoop) is preserved inside the kernel.
+#
+# Mosaic quirk (verified in isolation): right-shift of u32 by a TRACED
+# SCALAR lowers as an arithmetic shift — all dynamic shift amounts are
+# broadcast to vectors first, which uses the correct logical path.
+
+_PLAN_CACHE_BASE = 0          # 11 x [src, dst, merge_op, rot]
+_PLAN_MATH_BASE = 44          # 18 x [src1, src2, op, dst, merge_op, rot]
+_PLAN_EPI_BASE = 152          # 4 x [dst, merge_op, rot]
+_PLAN_LEN = 164
+
+
+def _plan_rows(plan: "pj.PeriodPlan") -> np.ndarray:
+    """(ROUNDS, _PLAN_LEN) i32 selector matrix for the round kernel."""
+    rows = np.zeros((ROUNDS, _PLAN_LEN), np.int32)
+    for r in range(ROUNDS):
+        for i in range(CACHE_ACCESSES):
+            rows[r, _PLAN_CACHE_BASE + 4 * i : _PLAN_CACHE_BASE + 4 * i + 4] = (
+                plan.cache_src[r, i], plan.cache_dst[r, i],
+                plan.cache_merge_op[r, i], plan.cache_merge_rot[r, i],
+            )
+        for i in range(MATH_OPS):
+            rows[r, _PLAN_MATH_BASE + 6 * i : _PLAN_MATH_BASE + 6 * i + 6] = (
+                plan.math_src1[r, i], plan.math_src2[r, i],
+                plan.math_op[r, i], plan.math_dst[r, i],
+                plan.math_merge_op[r, i], plan.math_merge_rot[r, i],
+            )
+        for i in range(4):
+            rows[r, _PLAN_EPI_BASE + 3 * i : _PLAN_EPI_BASE + 3 * i + 3] = (
+                plan.epi_dst[r, i], plan.epi_merge_op[r, i],
+                plan.epi_merge_rot[r, i],
+            )
+    return rows
+
+
+def _rotl_v(x, r_vec):
+    """rotl by a broadcast vector amount; r in [0,32) (0 -> identity)."""
+    return (x << r_vec) | (x >> ((_U32(32) - r_vec) & _U32(31)))
+
+
+def _merge_dyn(a, b, mop, rot, shape):
+    r = jnp.broadcast_to(rot.astype(_U32), shape) & _U32(31)
+    m0 = a * _U32(33) + b
+    m1 = (a ^ b) * _U32(33)
+    m2 = _rotl_v(a, r) ^ b
+    m3 = _rotl_v(a, (_U32(32) - r) & _U32(31)) ^ b
+    return jnp.where(mop == 0, m0,
+                     jnp.where(mop == 1, m1, jnp.where(mop == 2, m2, m3)))
+
+
+def _math_dyn(a, b, op):
+    i32 = jnp.int32
+    shift = b & _U32(31)
+    variants = [
+        a + b,
+        a * b,
+        pj._mulhi(a, b),
+        jnp.where(a < b, a, b),  # minimum: arith.minui has no lowering
+        _rotl_v(a, shift),
+        _rotl_v(a, (_U32(32) - shift) & _U32(31)),
+        a & b,
+        a | b,
+        a ^ b,
+        (jax.lax.clz(a.astype(i32)).astype(_U32)
+         + jax.lax.clz(b.astype(i32)).astype(_U32)),
+        (jax.lax.population_count(a.astype(i32)).astype(_U32)
+         + jax.lax.population_count(b.astype(i32)).astype(_U32)),
+    ]
+    out = variants[-1]
+    for k in range(len(variants) - 2, -1, -1):
+        out = jnp.where(op == k, variants[k], out)
+    return out
+
+
+def _l1_gather32(tbl32, off):
+    """(S, 128) gather of off in [0, 4096) from tbl32 (32, 128) via 32
+    lane-gather+select passes (the hardware-shaped decomposition)."""
+    hi = (off >> 7).astype(jnp.int32)
+    lo = (off & _U32(127)).astype(jnp.int32)
+    out = jnp.zeros(off.shape, _U32)
+    for c in range(32):
+        row = jnp.broadcast_to(tbl32[c][None, :], off.shape)
+        cand = jnp.take_along_axis(row, lo, axis=1,
+                                   mode="promise_in_bounds")
+        out = jnp.where(hi == c, cand, out)
+    return out
+
+
+def _round_kernel(p_ref, regs_in_ref, l1_ref, epi_ref, out_ref):
+    """One ProgPoW round's cache/math/epilogue merges on a 128-nonce tile.
+
+    regs/out: (REGS*LANES, 128) aliased; epi: (4*LANES, 128) word-major
+    DAG epilogue values (word i of lane l at row i*LANES+l)."""
+    from jax.experimental import pallas as pl
+
+    out_ref[...] = regs_in_ref[...]
+    tbl = l1_ref[...]
+    shape = (LANES, 128)
+
+    def reg_read(idx):
+        return out_ref[pl.ds(idx * LANES, LANES), :]
+
+    def reg_merge(dst, data, mop, rot):
+        cur = out_ref[pl.ds(dst * LANES, LANES), :]
+        out_ref[pl.ds(dst * LANES, LANES), :] = _merge_dyn(
+            cur, data, mop, rot, shape)
+
+    for i in range(max(CACHE_ACCESSES, MATH_OPS)):
+        if i < CACHE_ACCESSES:
+            base = _PLAN_CACHE_BASE + 4 * i
+            off = reg_read(p_ref[base]) & _U32(L1_WORDS - 1)
+            data = _l1_gather32(tbl, off)
+            reg_merge(p_ref[base + 1], data, p_ref[base + 2],
+                      p_ref[base + 3])
+        if i < MATH_OPS:
+            base = _PLAN_MATH_BASE + 6 * i
+            a = reg_read(p_ref[base])
+            b = reg_read(p_ref[base + 1])
+            data = _math_dyn(a, b, p_ref[base + 2])
+            reg_merge(p_ref[base + 3], data, p_ref[base + 4],
+                      p_ref[base + 5])
+    for i in range(4):
+        base = _PLAN_EPI_BASE + 3 * i
+        data = epi_ref[pl.ds(i * LANES, LANES), :]
+        reg_merge(p_ref[base], data, p_ref[base + 1], p_ref[base + 2])
+
+
+_round_call_cache: dict = {}
+
+
+def _mix_round_call(batch: int):
+    fn = _round_call_cache.get(batch)
+    if fn is None:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        fn = pl.pallas_call(
+            _round_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(batch // 128,),
+                in_specs=[
+                    pl.BlockSpec((REGS * LANES, 128), lambda i, s: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((32, 128), lambda i, s: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((4 * LANES, 128), lambda i, s: (0, i),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((REGS * LANES, 128),
+                                       lambda i, s: (0, i),
+                                       memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((REGS * LANES, batch), _U32),
+            input_output_aliases={1: 0},
+        )
+        _round_call_cache[batch] = fn
+    return fn
+
+
 
 def _rotl_c(x, n: int):
     n &= 31
@@ -173,11 +357,48 @@ def _unrolled_mix(regs, plan: pj.PeriodPlan, l1, dag):
     return jnp.stack(words, axis=-1)  # (B, 8)
 
 
-def _search_kernel(period: int, batch: int):
-    """Build the jittable sweep fn for one period at one batch size."""
-    plan = pj.build_period_plan(period)
+def _pallas_mix(regs, plan: pj.PeriodPlan, l1, dag):
+    """TPU mix path: XLA does the DAG row gather + epilogue word layout;
+    the shared plan-driven Pallas round kernel does the cache gathers and
+    all merges (see the module-top design note)."""
+    num_items = dag.shape[0]
+    b = regs[0].shape[1]
+    plan_rows = _plan_rows(plan)
+    tbl32 = l1.reshape(32, 128)
+    call = _mix_round_call(b)
+    stacked = jnp.concatenate(regs, axis=0)  # (REGS*LANES, B)
+    for r in range(ROUNDS):
+        item_index = jnp.mod(stacked[r % LANES], _U32(num_items))  # (B,)
+        item = jnp.take(dag, item_index.astype(jnp.int32), axis=0)  # (B, 64)
+        # word-major epilogue rows: word i of lane l at row i*LANES+l
+        perm = [((l ^ r) % LANES) * 4 + i for i in range(4)
+                for l in range(LANES)]
+        epi = jnp.take(item.T, jnp.array(perm, jnp.int32), axis=0)
+        stacked = call(jnp.asarray(plan_rows[r]), stacked, tbl32, epi)
+    lane_hash = jnp.full((LANES, b), pj.FNV_OFFSET, _U32)
+    for i in range(REGS):
+        lane_hash = pj._fnv1a(
+            lane_hash, stacked[i * LANES : (i + 1) * LANES])
+    words = [jnp.full((b,), pj.FNV_OFFSET, _U32) for _ in range(8)]
+    for l in range(LANES):
+        words[l % 8] = pj._fnv1a(words[l % 8], lane_hash[l])
+    return jnp.stack(words, axis=-1)  # (B, 8)
 
-    def sweep(header_words, base_lo, base_hi, target_words, l1, dag):
+
+def _search_kernel(period: int, batch: int):
+    """Build the jittable finals fn for one period at one batch size.
+
+    Returns the full (B, 8) final + mix digest-word arrays.  The
+    boundary check / winner extraction lives in a SEPARATE tiny jit
+    (:func:`_extract_fn`): fusing it into this graph produced winner
+    digests inconsistent with the graph's own finals at batch 32768 on
+    the axon backend (an aliasing/scheduling miscompile — the split
+    graphs are each verified bit-exact against the independent
+    BatchVerifier, tools/tpu_search_check.py)."""
+    plan = pj.build_period_plan(period)
+    use_pallas = jax.default_backend() != "cpu" and batch % 128 == 0
+
+    def finals(header_words, base_lo, base_hi, l1, dag):
         i = jnp.arange(batch, dtype=_U32)
         nlo = base_lo + i
         nhi = base_hi + (nlo < base_lo).astype(_U32)
@@ -187,21 +408,35 @@ def _search_kernel(period: int, batch: int):
         state += [jnp.full((batch,), w, _U32) for w in pj._ABSORB_PAD]
         seed = pj.keccak_f800(state)
         regs = _init_regs(seed[0], seed[1])
-        mix_words = _unrolled_mix(regs, plan, l1, dag)
+        if use_pallas:
+            mix_words = _pallas_mix(regs, plan, l1, dag)
+        else:
+            mix_words = _unrolled_mix(regs, plan, l1, dag)
         final = pj._final_absorb(seed, mix_words)
-        ok = pj.digest_lte(final, target_words)
-        found = jnp.any(ok)
-        win = jnp.argmax(ok)  # first True when found
-        return found, win, final[win], mix_words[win]
+        return final, mix_words
 
-    return sweep
+    return finals
+
+
+def _extract(final, mix_words, target_words):
+    """(found, win, final_win, mix_win) from full digest arrays."""
+    ok = pj.digest_lte(final, target_words)
+    found = jnp.any(ok)
+    win = jnp.argmax(ok)  # first True when found
+    sel_col = (
+        jnp.arange(final.shape[0], dtype=_U32) == win.astype(_U32)
+    ).astype(_U32)[:, None]
+    final_win = (final * sel_col).sum(axis=0, dtype=_U32)
+    mix_win = (mix_words * sel_col).sum(axis=0, dtype=_U32)
+    return found, win, final_win, mix_win
 
 
 class SearchKernel:
     """TPU nonce sweeps for one epoch's device-resident L1 + DAG slab.
 
-    Jitted sweep functions are cached per (period, batch); winner extraction
-    happens on device so each launch ships back one bool + three tiny
+    Jitted finals fns are cached per (period, batch); the boundary check
+    and winner extraction run in a second tiny jit over the on-device
+    digest arrays, so each launch ships back one bool + three tiny
     vectors, never the batch of digests.
     """
 
@@ -211,6 +446,9 @@ class SearchKernel:
         self.l1 = jnp.asarray(l1, dtype=_U32)
         self.dag = jnp.asarray(dag, dtype=_U32)
         self._jit_cache: dict = {}
+        self._extract = (
+            jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
+        )
 
     @classmethod
     def from_epoch(cls, epoch: int, threads: int = 0) -> "SearchKernel":
@@ -226,6 +464,9 @@ class SearchKernel:
         obj.l1 = verifier.l1
         obj.dag = verifier.dag
         obj._jit_cache = {}
+        obj._extract = (
+            jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
+        )
         return obj
 
     def _fn(self, period: int, batch: int):
@@ -256,10 +497,11 @@ class SearchKernel:
         fn = self._fn(height // ref.PERIOD_LENGTH, batch)
         hw = jnp.asarray(np.frombuffer(header_hash[:32], dtype="<u4").copy())
         tw = jnp.asarray(pj.target_swapped_words(target_le_int))
-        found, win, final, mix = fn(
+        final_all, mix_all = fn(
             hw, _U32(start_nonce & 0xFFFFFFFF),
-            _U32((start_nonce >> 32) & 0xFFFFFFFF), tw, self.l1, self.dag,
+            _U32((start_nonce >> 32) & 0xFFFFFFFF), self.l1, self.dag,
         )
+        found, win, final, mix = self._extract(final_all, mix_all, tw)
         if not bool(found):
             return None
         nonce = (start_nonce + int(win)) & 0xFFFFFFFFFFFFFFFF

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 from typing import Any, List
 
-from ..assets.cache import AssetError
 from ..assets.txbuilder import (
     AssetBuildError,
     build_freeze_address,
@@ -23,7 +22,6 @@ from ..assets.types import (
     ReissueAsset,
     UNIQUE_ASSET_AMOUNT,
     asset_name_type,
-    is_asset_name_valid,
 )
 from ..assets.verifier import is_verifier_valid
 from ..core.amount import COIN

@@ -230,30 +230,57 @@ def clearmempool(node, params: List[Any]):
 
 
 def estimaterawfee(node, params: List[Any]):
-    """ref rpc/mining.cpp estimaterawfee: raw bucket stats for a target."""
+    """ref rpc/mining.cpp:1111 estimaterawfee conf_target (threshold):
+    per-horizon estimate + pass/fail bucket detail."""
     if not params:
         raise RPCError(RPC_INVALID_PARAMETER, "conf_target required")
+    from ..chain import fees
     from ..chain.fees import fee_estimator as est
 
-    target = max(1, min(int(params[0]), est.max_confirms))
-    row = est.conf_avg[target - 1]
-    buckets = []
-    for i, b in enumerate(est.buckets):
-        if est.tx_avg[i] <= 0:
-            continue
-        buckets.append({
-            "startrange": round(b, 1),
-            "txcount": round(est.tx_avg[i], 4),
-            "withintarget": round(row[i], 4),
-        })
-    fee = est.estimate_fee(target)
-    return {
-        "short": {
-            "feerate": (fee / COIN) if fee is not None else -1,
-            "decay": 0.998,
-            "pass": {"buckets": buckets},
+    try:
+        target = int(params[0])
+    except (TypeError, ValueError):
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid conf_target")
+    max_target = est.highest_target_tracked(fees.HORIZON_LONG)
+    if target < 1 or target > max_target:
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            f"Invalid conf_target, must be between 1 - {max_target}",
+        )
+    try:
+        threshold = float(params[1]) if len(params) > 1 else 0.95
+    except (TypeError, ValueError):
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid threshold")
+    if threshold < 0 or threshold > 1:
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid threshold")
+
+    def _bucket(d: dict) -> dict:
+        return {
+            "startrange": round(d.get("startrange", -1)),
+            "endrange": round(min(d.get("endrange", -1), 1e18)),
+            "withintarget": round(d.get("withintarget", 0.0), 2),
+            "totalconfirmed": round(d.get("totalconfirmed", 0.0), 2),
+            "inmempool": round(d.get("inmempool", 0.0), 2),
+            "leftmempool": round(d.get("leftmempool", 0.0), 2),
         }
-    }
+
+    out = {}
+    for horizon in (fees.HORIZON_SHORT, fees.HORIZON_MED, fees.HORIZON_LONG):
+        if target > est.highest_target_tracked(horizon):
+            continue  # only horizons which track the target
+        fee, result = est.estimate_raw_fee(target, threshold, horizon)
+        hr = {}
+        if fee is not None:
+            hr["feerate"] = fee / COIN
+            hr["decay"] = result["decay"]
+            hr["scale"] = result["scale"]
+            hr["pass"] = _bucket(result["pass"])
+            if result["fail"]:
+                hr["fail"] = _bucket(result["fail"])
+        else:
+            hr["errors"] = ["Insufficient data or no feerate found"]
+        out[horizon] = hr
+    return out
 
 
 # ------------------------------------------------------------ node control

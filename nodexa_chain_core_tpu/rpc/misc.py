@@ -7,7 +7,7 @@ from typing import Any, List
 
 from .. import __version__
 from ..core.amount import COIN
-from ..script.standard import decode_destination, KeyID, ScriptID
+from ..script.standard import decode_destination, ScriptID
 from .server import RPC_INVALID_PARAMETER, RPCError, RPCTable
 
 
@@ -78,10 +78,26 @@ def estimatefee(node, params: List[Any]):
 
 
 def estimatesmartfee(node, params: List[Any]):
-    from ..chain.fees import fee_estimator
+    """ref rpc/mining.cpp estimatesmartfee: conf_target + estimate_mode
+    (CONSERVATIVE default / ECONOMICAL)."""
+    from ..chain.fees import HORIZON_LONG, fee_estimator
 
-    target = int(params[0]) if params else 6
-    est, found_target = fee_estimator.estimate_smart_fee(target)
+    try:
+        target = int(params[0]) if params else 6
+    except (TypeError, ValueError):
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid conf_target")
+    max_target = fee_estimator.highest_target_tracked(HORIZON_LONG)
+    if target < 1 or target > max_target:
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            f"Invalid conf_target, must be between 1 - {max_target}",
+        )
+    mode = str(params[1]).upper() if len(params) > 1 else "CONSERVATIVE"
+    if mode not in ("UNSET", "ECONOMICAL", "CONSERVATIVE"):
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid estimate_mode")
+    conservative = mode != "ECONOMICAL"
+    est, found_target = fee_estimator.estimate_smart_fee(
+        target, conservative=conservative)
     out = {"blocks": found_target}
     if est is None:
         out["errors"] = ["Insufficient data or no feerate found"]

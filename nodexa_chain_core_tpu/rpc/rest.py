@@ -5,7 +5,6 @@ endpoints /rest/tx, /rest/block, /rest/chaininfo, /rest/mempool/info,
 
 from __future__ import annotations
 
-import json
 from typing import Tuple
 
 from ..core.uint256 import u256_from_hex, u256_hex

@@ -14,7 +14,6 @@ import hmac
 import json
 import os
 import secrets
-import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple

@@ -21,7 +21,7 @@ reference's API uses for its shared subset).
 from __future__ import annotations
 
 import ctypes
-from typing import Optional, Tuple
+from typing import Tuple
 
 from .. import native
 

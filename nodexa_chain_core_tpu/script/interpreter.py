@@ -11,7 +11,7 @@ with its SIGHASH_SINGLE "hash of one" quirk.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import List
 
 from ..core.serialize import ByteWriter
 from ..crypto import secp256k1 as ec
@@ -25,7 +25,6 @@ from .script import (
     MAX_SCRIPT_SIZE,
     Script,
     ScriptError,
-    decode_op_n,
     script_num_decode,
     script_num_encode,
 )

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 from collections import OrderedDict
 from threading import Lock
-from typing import Tuple
 
 DEFAULT_MAX_ENTRIES = 1 << 16
 

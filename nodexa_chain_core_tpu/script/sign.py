@@ -8,12 +8,11 @@ full (asset-carrying) scriptPubKey.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..crypto import secp256k1 as ec
 from ..crypto.hashes import hash160
 from ..primitives.transaction import Transaction
-from . import opcodes as op
 from .interpreter import SIGHASH_ALL, signature_hash
 from .script import Script
 from .standard import (

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import traceback
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 _enabled = False
 _global = threading.Lock()

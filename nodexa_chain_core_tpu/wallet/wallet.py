@@ -18,25 +18,20 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..chain.coins import Coin
 from ..chain.policy import MIN_RELAY_FEE, FeeRate
 from ..consensus.consensus import COINBASE_MATURITY
-from ..core.amount import COIN
-from ..core.uint256 import u256_hex
 from ..crypto.hashes import hash160, sha256d
 from ..node.events import ValidationInterface, main_signals
 from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
 from ..script.script import Script
-from ..script.sign import KeyStore, SigningError, sign_tx_input
+from ..script.sign import KeyStore, sign_tx_input
 from ..script.standard import (
     KeyID,
     extract_destination,
     p2pkh_script,
-    script_for_destination,
 )
 from ..wallet.bip32 import ExtKey
 from ..wallet.bip39 import generate_mnemonic, mnemonic_to_seed
-from ..wallet.keys import pubkey_of
 
 KEYPOOL_SIZE = 100
 

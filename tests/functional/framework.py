@@ -15,7 +15,7 @@ import sys
 import tempfile
 import time
 import urllib.request
-from typing import Any, List, Optional
+from typing import List, Optional
 
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 

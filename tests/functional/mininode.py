@@ -13,9 +13,9 @@ import random
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import List, Tuple
 
-from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+from nodexa_chain_core_tpu.core.serialize import ByteWriter
 from nodexa_chain_core_tpu.net.protocol import (
     MSG_PING,
     MSG_PONG,

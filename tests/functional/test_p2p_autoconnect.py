@@ -7,7 +7,6 @@ import time
 import pytest
 
 from .framework import TestFramework
-from .test_mining_basic import ADDR
 
 
 @pytest.mark.functional

@@ -39,19 +39,12 @@ def test_tx_relay():
         n0, n1 = f.nodes
         f.connect_nodes(0, 1)
         # fund: coinbase to a known key, mature it
-        from nodexa_chain_core_tpu.core.amount import COIN
-        from nodexa_chain_core_tpu.crypto.hashes import hash160
-        from nodexa_chain_core_tpu.crypto.secp256k1 import (
-            pubkey_create,
-            pubkey_serialize,
-        )
         from nodexa_chain_core_tpu.primitives.transaction import (
             OutPoint,
             Transaction,
             TxIn,
             TxOut,
         )
-        from nodexa_chain_core_tpu.script.script import Script
         from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
         from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
         from nodexa_chain_core_tpu.core.uint256 import u256_from_hex

@@ -7,7 +7,6 @@ import time
 import pytest
 
 from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
-from nodexa_chain_core_tpu.mining.assembler import mine_block_cpu
 from nodexa_chain_core_tpu.node.chainparams import regtest_params
 from nodexa_chain_core_tpu.primitives.block import Block
 

@@ -9,10 +9,8 @@ from nodexa_chain_core_tpu.assets.types import (
     AssetTransfer,
     AssetType,
     NewAsset,
-    NullAssetTxData,
     OWNER_ASSET_AMOUNT,
     OwnerPayload,
-    ReissueAsset,
     append_asset_payload,
     asset_name_type,
     burn_requirement,
@@ -21,13 +19,11 @@ from nodexa_chain_core_tpu.assets.types import (
     parse_asset_script,
 )
 from nodexa_chain_core_tpu.assets.verifier import (
-    VerifierError,
     evaluate_verifier,
     is_verifier_valid,
 )
 from nodexa_chain_core_tpu.core.amount import COIN
 from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
-from nodexa_chain_core_tpu.script.script import Script
 from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
 
 

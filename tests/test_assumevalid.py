@@ -1,10 +1,8 @@
 """-assumevalid script-check elision (ref feature_assumevalid.py +
 validation.cpp fScriptChecks)."""
 
-import pytest
 
 from nodexa_chain_core_tpu.chain.validation import (
-    BlockValidationError,
     ChainState,
 )
 from nodexa_chain_core_tpu.core.amount import COIN

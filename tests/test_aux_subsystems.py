@@ -8,7 +8,6 @@ from nodexa_chain_core_tpu.chain.blockindex import BlockIndex
 from nodexa_chain_core_tpu.chain.fees import BlockPolicyEstimator
 from nodexa_chain_core_tpu.chain.merkleblock import (
     PartialMerkleTree,
-    make_merkle_block,
 )
 from nodexa_chain_core_tpu.consensus.params import ConsensusParams, Deployment
 from nodexa_chain_core_tpu.consensus.versionbits import (

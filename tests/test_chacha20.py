@@ -1,0 +1,128 @@
+"""ChaCha20 vectors (RFC 7539 + draft-agl-tls-chacha20poly1305-04 §7 —
+the same public vectors the reference pins in
+src/test/crypto_tests.cpp:538) and FastRandomContext behavior
+(ref src/random.h:47, src/test/random_tests.cpp)."""
+
+import pytest
+
+from nodexa_chain_core_tpu.crypto.chacha20 import ChaCha20, FastRandomContext
+
+# (hex key, iv, seek, hex keystream)
+VECTORS = [
+    # RFC 7539 §2.4.2-shaped vector (key schedule + counter seek)
+    ("000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
+     0x4A000000, 1,
+     "224f51f3401bd9e12fde276fb8631ded8c131f823d2c06e27e4fcaec9ef3cf78"
+     "8a3b0aa372600a92b57974cded2b9334794cba40c63e34cdea212c4cf07d41b7"
+     "69a6749f3f630f4122cafe28ec4dc47e26d4346d70b98c73f3e9c53ac40c5945"
+     "398b6eda1a832c89c167eacd901d7e2bf363"),
+    ("0000000000000000000000000000000000000000000000000000000000000000",
+     0, 0,
+     "76b8e0ada0f13d90405d6ae55386bd28bdd219b8a08ded1aa836efcc8b770dc7"
+     "da41597c5157488d7724e03fb8d84a376a43b8f41518a11cc387b669b2ee6586"),
+    ("0000000000000000000000000000000000000000000000000000000000000001",
+     0, 0,
+     "4540f05a9f1fb296d7736e7b208e3c96eb4fe1834688d2604f450952ed432d41"
+     "bbe2a0b6ea7566d2a5d1e7e20d42af2c53d792b1c43fea817e9ad275ae546963"),
+    ("0000000000000000000000000000000000000000000000000000000000000000",
+     0x0100000000000000, 0,
+     "de9cba7bf3d69ef5e786dc63973f653a0b49e015adbff7134fcb7df137821031"
+     "e85a050278a7084527214f73efc7fa5b5277062eb7a0433e445f41e3"),
+    ("0000000000000000000000000000000000000000000000000000000000000000",
+     1, 0,
+     "ef3fdfd6c61578fbf5cf35bd3dd33b8009631634d21e42ac33960bd138e50d32"
+     "111e4caf237ee53ca8ad6426194a88545ddc497a0b466e7d6bbdb0041b2f586b"),
+    ("000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
+     0x0706050403020100, 0,
+     "f798a189f195e66982105ffb640bb7757f579da31602fc93ec01ac56f85ac3c1"
+     "34a4547b733b46413042c9440049176905d3be59ea1c53f15916155c2be8241a"
+     "38008b9a26bc35941e2444177c8ade6689de95264986d95889fb60e84629c9bd"
+     "9a5acb1cc118be563eb9b3a4a472f82e09a7e778492b562ef7130e88dfe031c7"
+     "9db9d4f7c7a899151b9a475032b63fc385245fe054e3dd5a97a5f576fe064025"
+     "d3ce042c566ab2c507b138db853e3d6959660996546cc9c4a6eafdc777c040d7"
+     "0eaf46f76dad3979e5c5360c3317166a1c894c94a371876a94df7628fe4eaaf2"
+     "ccb27d5aaae0ad7ad0f9d4b6ad3b54098746d4524d38407a6deb3ab78fab78c9"),
+]
+
+
+@pytest.mark.parametrize("hexkey,iv,seek,hexout", VECTORS)
+def test_keystream_vectors(hexkey, iv, seek, hexout):
+    rng = ChaCha20(bytes.fromhex(hexkey))
+    rng.set_iv(iv)
+    rng.seek(seek)
+    want = bytes.fromhex(hexout)
+    assert rng.keystream(len(want)) == want
+
+
+def test_keystream_block_granularity():
+    """Partial-block output discards the rest of that block — the
+    counter advances whole blocks per call (reference Output
+    semantics; FastRandomContext only ever pulls 64-byte multiples)."""
+    key = bytes.fromhex(VECTORS[1][0])
+    rng = ChaCha20(key)
+    rng.set_iv(0)
+    rng.seek(0)
+    whole = rng.keystream(128)
+    rng2 = ChaCha20(key)
+    rng2.set_iv(0)
+    rng2.seek(0)
+    first7 = rng2.keystream(7)
+    assert first7 == whole[:7]
+    # next call starts at block 1, not offset 7
+    assert rng2.keystream(64) == whole[64:128]
+
+
+def test_crypt_round_trip():
+    key = bytes(range(32))
+    msg = b"the quick brown fox jumps over the lazy dog" * 3
+    enc = ChaCha20(key)
+    enc.set_iv(42)
+    ct = enc.crypt(msg)
+    dec = ChaCha20(key)
+    dec.set_iv(42)
+    assert ct != msg and dec.crypt(ct) == msg
+
+
+def test_fastrandom_deterministic_stream():
+    a = FastRandomContext(deterministic=True)
+    b = FastRandomContext(deterministic=True)
+    assert [a.rand64() for _ in range(16)] == [b.rand64() for _ in range(16)]
+    assert a.randbytes(33) == b.randbytes(33)
+
+
+def test_fastrandom_randbits_in_range():
+    r = FastRandomContext(deterministic=True)
+    for bits in range(0, 65):
+        for _ in range(20):
+            v = r.randbits(bits)
+            assert 0 <= v < (1 << bits) or (bits == 0 and v == 0)
+
+
+def test_fastrandom_randrange_bounds_and_coverage():
+    r = FastRandomContext(deterministic=True)
+    seen = set()
+    for _ in range(400):
+        v = r.randrange(7)
+        assert 0 <= v < 7
+        seen.add(v)
+    assert seen == set(range(7))
+    with pytest.raises(ValueError):
+        r.randrange(0)
+
+
+def test_fastrandom_seeded_reproducible():
+    s1 = FastRandomContext(seed=b"\x01" * 32)
+    s2 = FastRandomContext(seed=b"\x01" * 32)
+    s3 = FastRandomContext(seed=b"\x02" * 32)
+    a, b, c = s1.rand256(), s2.rand256(), s3.rand256()
+    assert a == b != c
+
+
+def test_fastrandom_shuffle_choice():
+    r = FastRandomContext(deterministic=True)
+    xs = list(range(50))
+    ys = list(xs)
+    r.shuffle(ys)
+    assert sorted(ys) == xs and ys != xs
+    for _ in range(10):
+        assert r.choice(xs) in xs

@@ -4,7 +4,6 @@ from nodexa_chain_core_tpu.consensus.pow import (
     check_proof_of_work,
     dark_gravity_wave,
     get_block_subsidy,
-    get_next_work_required,
 )
 from nodexa_chain_core_tpu.core.amount import COIN
 from nodexa_chain_core_tpu.core.uint256 import bits_to_target, target_to_bits

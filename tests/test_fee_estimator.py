@@ -1,69 +1,139 @@
 """Fee estimator: pinned-stream behavior + fee_estimates.dat persistence
-(ref policy/fees.cpp CBlockPolicyEstimator; Write/Read at :916).
+(ref policy/fees.cpp CBlockPolicyEstimator + TxConfirmStats).
 
-The stream is deterministic, so the estimates it should produce are known:
-high-feerate txs confirming next block must drive estimate_fee(1) to their
-bucket; low-feerate txs confirming in ~10 blocks must surface only at
-looser targets; and a reloaded estimator must answer exactly like the one
-that learned the stream.
+The stream is deterministic, so the estimates it must produce are known
+exactly: every fast tx pays 50,000 sat/kB and confirms next block, every
+slow tx pays 1,000 sat/kB and confirms in 10 blocks — so the bucket
+medians are exactly those feerates, tight targets must answer 50,000,
+loose targets 1,000, the long (scale-24) horizon answers 1,000 even at
+tight targets (one 24-block period covers the slow confirms), and a
+reloaded estimator must answer exactly like the one that learned the
+stream.
 """
 
 import pytest
 
-from nodexa_chain_core_tpu.chain.fees import BlockPolicyEstimator
+from nodexa_chain_core_tpu.chain.fees import (
+    DOUBLE_SUCCESS_PCT,
+    HORIZON_LONG,
+    HORIZON_MED,
+    HORIZON_SHORT,
+    SUCCESS_PCT,
+    BlockPolicyEstimator,
+)
+
+FAST_RATE = 50_000.0  # sat/kB
+SLOW_RATE = 1_000.0
+SLOW_DELAY = 10  # blocks to confirm
 
 
-def _feed(est, blocks=120):
+def _feed(est, blocks=200, fast=5, slow=3):
+    """Entry height == best height (the reference only tracks synced
+    entries, fees.cpp:578); block h confirms h-1's fast txs and
+    h-SLOW_DELAY's slow txs."""
     txid = 0
-    for h in range(1, blocks):
-        confirmed = []
-        # 5 high-fee txs per block, confirmed immediately (next block)
-        for _ in range(5):
+    pending = {}
+    for _ in range(blocks):
+        tip = est.best_height
+        confirm = []
+        for _ in range(fast):
             txid += 1
-            est.process_tx(txid, h, fee=50_000, size=1000)  # 50k sat/kB
-            confirmed.append(txid)
-        # 3 low-fee txs, confirmed 10 blocks later
-        slow = []
-        for _ in range(3):
+            est.process_tx(txid, tip, fee=int(FAST_RATE), size=1000)
+            confirm.append(txid)
+        slow_ids = []
+        for _ in range(slow):
             txid += 1
-            est.process_tx(txid, h, fee=1_000, size=1000)  # 1k sat/kB
-            slow.append(txid)
-        est.process_block(h, confirmed + [t for t in _due(h)])
-        _schedule(h + 10, slow)
+            est.process_tx(txid, tip, fee=int(SLOW_RATE), size=1000)
+            slow_ids.append(txid)
+        pending[tip + SLOW_DELAY] = slow_ids
+        est.process_block(tip + 1, confirm + pending.pop(tip + 1, []))
     return est
-
-
-_pending = {}
-
-
-def _schedule(height, txids):
-    _pending.setdefault(height, []).extend(txids)
-
-
-def _due(height):
-    return _pending.pop(height, [])
-
-
-@pytest.fixture(autouse=True)
-def _clear_pending():
-    _pending.clear()
-    yield
-    _pending.clear()
 
 
 def test_pinned_stream_estimates():
     est = _feed(BlockPolicyEstimator())
-    fast = est.estimate_fee(1)
-    assert fast is not None, "no next-block estimate after 120 blocks"
-    # 50k sat/kB lands in the bucket covering it; the estimate must be in
-    # the right order of magnitude and above the slow stream's feerate
-    assert 10_000 <= fast <= 60_000
-    slow, found_at = est.estimate_smart_fee(2)
-    assert slow is not None
-    # at a loose target the low-fee bucket qualifies
-    loose = est.estimate_fee(15)
-    assert loose is not None and loose < fast
-    assert loose <= 1_100
+
+    # deprecated single-horizon estimate: 95% at MED horizon
+    assert est.estimate_fee(1) is None  # no next-block estimates (parity)
+    assert est.estimate_fee(2) == pytest.approx(FAST_RATE, rel=1e-9)
+
+    # tight target: only the fast bucket confirms within 2 blocks
+    tight, at = est.estimate_smart_fee(2)
+    assert at == 2
+    assert tight == pytest.approx(FAST_RATE, rel=1e-9)
+
+    # loose target: the slow bucket (10-block confirms) qualifies and is
+    # cheaper, so it must win
+    loose, at = est.estimate_smart_fee(20)
+    assert at == 20
+    assert loose == pytest.approx(SLOW_RATE, rel=1e-9)
+
+    # economical mode can only be <= conservative
+    eco, _ = est.estimate_smart_fee(20, conservative=False)
+    assert eco == pytest.approx(SLOW_RATE, rel=1e-9)
+
+
+def test_horizon_consistency():
+    """estimate_raw_fee per horizon on the pinned stream: short/medium
+    see the slow bucket fail a 2-block target; long's 24-block period
+    granularity covers the 10-block confirms, so it answers the slow
+    bucket's rate."""
+    est = _feed(BlockPolicyEstimator())
+    s, _ = est.estimate_raw_fee(2, DOUBLE_SUCCESS_PCT, HORIZON_SHORT)
+    m, _ = est.estimate_raw_fee(2, DOUBLE_SUCCESS_PCT, HORIZON_MED)
+    # long horizon at 85%: its scale-24 period granularity covers the
+    # 10-block confirms (95% would sit exactly at the in-mempool margin)
+    lg, _ = est.estimate_raw_fee(2, SUCCESS_PCT, HORIZON_LONG)
+    assert s == pytest.approx(FAST_RATE, rel=1e-9)
+    assert m == pytest.approx(FAST_RATE, rel=1e-9)
+    assert lg == pytest.approx(SLOW_RATE, rel=1e-9)
+
+    # raw-fee detail: pass bucket must bracket the answering feerate
+    fee, result = est.estimate_raw_fee(2, DOUBLE_SUCCESS_PCT, HORIZON_MED)
+    assert result["scale"] == 2
+    assert result["pass"]["startrange"] <= fee <= result["pass"]["endrange"]
+    # the failing range below it is the slow bucket's
+    assert result["fail"]["endrange"] < result["pass"]["startrange"] * 1.01
+
+
+def test_failed_txs_lower_success():
+    """Evicted-not-confirmed txs count against their bucket
+    (ref fees.cpp:512-519 failAvg): a mid-feerate bucket whose txs all
+    leave the pool unconfirmed must never produce an estimate."""
+    est = BlockPolicyEstimator()
+    txid = 0
+    evict_due = {}
+    for _ in range(200):
+        tip = est.best_height
+        confirm = []
+        for _ in range(5):
+            txid += 1
+            est.process_tx(txid, tip, fee=50_000, size=1000)
+            confirm.append(txid)
+        txid += 1
+        est.process_tx(txid, tip, fee=5_000, size=1000)
+        evict_due[tip + 8] = [txid]  # evicted 8 blocks later, unconfirmed
+        est.process_block(tip + 1, confirm)
+        for ev in evict_due.pop(est.best_height, []):
+            assert est.remove_tx(ev, in_block=False)
+    # 5k bucket has plenty of (failed) data points; estimates at any
+    # target must skip it and answer the 50k bucket
+    for target in (2, 5, 12, 20):
+        fee, _ = est.estimate_smart_fee(target)
+        assert fee == pytest.approx(50_000.0, rel=1e-9), target
+
+
+def test_unsynced_and_duplicate_entries_ignored():
+    est = BlockPolicyEstimator()
+    est.process_block(5, [])
+    est.process_tx(1, 3, fee=1000, size=1000)  # stale entry height
+    assert not est._tracked
+    est.process_tx(2, 5, fee=1000, size=1000)
+    est.process_tx(2, 5, fee=9000, size=1000)  # duplicate: first wins
+    assert est._tracked[2][2] == 1000.0
+    # side-chain / reorg block heights don't rewind stats
+    est.process_block(5, [2])
+    assert 2 in est._tracked
 
 
 def test_persistence_round_trip(tmp_path):
@@ -72,13 +142,16 @@ def test_persistence_round_trip(tmp_path):
     est.write_file(path)
 
     est2 = BlockPolicyEstimator()
-    assert est2.estimate_fee(1) is None  # fresh: knows nothing
+    assert est2.estimate_fee(2) is None  # fresh: knows nothing
     assert est2.read_file(path)
     assert est2.best_height == est.best_height
-    for target in (1, 2, 5, 15, 25):
+    for target in (2, 5, 15, 25, 40):
         assert est2.estimate_fee(target) == est.estimate_fee(target), (
             f"estimate drift after reload at target {target}"
         )
+        assert est2.estimate_smart_fee(target) == est.estimate_smart_fee(
+            target
+        ), f"smart-fee drift after reload at target {target}"
 
 
 def test_mismatched_or_corrupt_file_is_ignored(tmp_path):
@@ -97,7 +170,13 @@ def test_mismatched_or_corrupt_file_is_ignored(tmp_path):
     data["n_buckets"] = 3
     json.dump(data, open(path, "w"))
     assert not est.read_file(path)
-    assert est.estimate_fee(1) is None  # state untouched
+    # truncated stats rows
+    good.write_file(path)
+    data = json.load(open(path))
+    data["fee_stats"]["conf_avg"] = data["fee_stats"]["conf_avg"][:3]
+    json.dump(data, open(path, "w"))
+    assert not est.read_file(path)
+    assert est.estimate_fee(2) is None  # state untouched
     # missing file
     assert not est.read_file(str(tmp_path / "nope.dat"))
 

@@ -8,11 +8,9 @@ productive half, as in the reference's fuzz seeds).
 
 import random
 
-import pytest
 
 from nodexa_chain_core_tpu.assets.types import (
     AssetTransfer,
-    NewAsset,
     parse_asset_script,
 )
 from nodexa_chain_core_tpu.chain.merkleblock import PartialMerkleTree

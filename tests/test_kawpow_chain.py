@@ -15,7 +15,6 @@ from nodexa_chain_core_tpu.chain.validation import (
 )
 from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
 from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
-from nodexa_chain_core_tpu.node.chainparams import kawpow_regtest_params
 from nodexa_chain_core_tpu.primitives.block import BlockHeader
 from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
 from nodexa_chain_core_tpu.script.sign import KeyStore

@@ -12,7 +12,6 @@ from nodexa_chain_core_tpu.chain.mempool_accept import (
 )
 from nodexa_chain_core_tpu.chain.validation import ChainState
 from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
-from nodexa_chain_core_tpu.consensus.merkle import merkle_root
 from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
 from nodexa_chain_core_tpu.node.chainparams import regtest_params
 from nodexa_chain_core_tpu.primitives.transaction import (

@@ -6,7 +6,6 @@ to connected subscribers; -blocknotify must run the hook with the block
 hash substituted.
 """
 
-import os
 import time
 
 import pytest

@@ -1,12 +1,10 @@
 """JAX SHA-256d ops vs hashlib ground truth, plus mesh-sharded variants."""
 
 import hashlib
-import os
 import random
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from nodexa_chain_core_tpu.ops import sha256_jax as s256
 from nodexa_chain_core_tpu.parallel import mesh as meshlib

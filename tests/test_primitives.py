@@ -5,7 +5,6 @@ exercises 80-byte header serialization, coinbase tx serialization, txid
 hashing, and merkle-root computation against universally published hashes.
 """
 
-import pytest
 
 from nodexa_chain_core_tpu.consensus.merkle import block_merkle_root, merkle_root
 from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter

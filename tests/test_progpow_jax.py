@@ -7,7 +7,6 @@ epoch (small DAG slab + random L1), across different periods, nonces and
 header hashes in ONE batch.
 """
 
-import struct
 
 import numpy as np
 import pytest

@@ -1,0 +1,25 @@
+"""RPC dispatch-table parity (analog of the reference's
+contrib/devtools/check-rpc-mappings.py): every command name in the
+reference's CRPCCommand tables (committed snapshot,
+tests/data/reference_rpc_commands.json, regenerable via
+tools/check_rpc_mappings.py --regen) must resolve in our table."""
+
+import json
+import os
+
+DATA = os.path.join(os.path.dirname(__file__), "data",
+                    "reference_rpc_commands.json")
+
+
+def test_all_reference_rpc_commands_implemented():
+    with open(DATA) as f:
+        ref = json.load(f)
+    assert ref["count"] == len(ref["commands"]) == 168
+
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    table = register_all(RPCTable())
+    ours = set(table.commands())
+    missing = [c for c in ref["commands"] if c not in ours]
+    assert not missing, f"reference RPCs without handlers: {missing}"

@@ -2,8 +2,6 @@
 
 import pytest
 
-from nodexa_chain_core_tpu.crypto import secp256k1 as ec
-from nodexa_chain_core_tpu.crypto.hashes import hash160
 from nodexa_chain_core_tpu.primitives.transaction import (
     OutPoint,
     Transaction,
@@ -19,7 +17,6 @@ from nodexa_chain_core_tpu.script.interpreter import (
     STANDARD_SCRIPT_VERIFY_FLAGS,
     TransactionSignatureChecker,
     VERIFY_CLEANSTACK,
-    VERIFY_MINIMALDATA,
     VERIFY_P2SH,
     eval_script,
     signature_hash,
@@ -35,7 +32,6 @@ from nodexa_chain_core_tpu.script.standard import (
     KeyID,
     ScriptID,
     TX_MULTISIG,
-    TX_NEW_ASSET,
     TX_NULL_DATA,
     TX_PUBKEY,
     TX_PUBKEYHASH,

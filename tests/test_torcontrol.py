@@ -7,7 +7,6 @@ import hashlib
 import hmac
 import os
 import socket
-import socketserver
 import threading
 
 import pytest

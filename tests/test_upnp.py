@@ -3,7 +3,6 @@ ThreadMapPort): SSDP discovery, description parse, AddPortMapping /
 GetExternalIPAddress SOAP round-trips, DeletePortMapping on stop."""
 
 import re
-import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer

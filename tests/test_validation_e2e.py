@@ -9,7 +9,6 @@ from nodexa_chain_core_tpu.chain.validation import (
     ChainState,
 )
 from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
-from nodexa_chain_core_tpu.core.amount import COIN
 from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
 from nodexa_chain_core_tpu.node.chainparams import regtest_params
 from nodexa_chain_core_tpu.primitives.transaction import (
@@ -19,7 +18,6 @@ from nodexa_chain_core_tpu.primitives.transaction import (
     TxOut,
 )
 from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
-from nodexa_chain_core_tpu.script.script import Script
 from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
 
 

@@ -3,11 +3,9 @@ reference's wallet_tests.cpp with its own fixture)."""
 
 import pytest
 
-from nodexa_chain_core_tpu.chain.mempool import TxMemPool
 from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
 from nodexa_chain_core_tpu.core.amount import COIN
 from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
-from nodexa_chain_core_tpu.node.chainparams import regtest_params, select_params
 from nodexa_chain_core_tpu.node.context import NodeContext
 from nodexa_chain_core_tpu.node.events import main_signals
 from nodexa_chain_core_tpu.script.standard import decode_destination, script_for_destination

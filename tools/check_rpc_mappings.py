@@ -1,0 +1,84 @@
+"""RPC dispatch-table parity pin (analog of the reference's
+contrib/devtools/check-rpc-mappings.py).
+
+Modes:
+  --regen  : re-extract the reference's CRPCCommand tables (requires
+             /root/reference) into tests/data/reference_rpc_commands.json
+  (default): assert every committed reference command name resolves in
+             this package's dispatch table; exit 1 listing any gaps.
+
+The committed JSON keeps the gate hermetic — a fresh clone without the
+reference mounted still enforces that the 168/168 coverage never
+regresses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DATA = os.path.join(REPO, "tests", "data", "reference_rpc_commands.json")
+REF = os.environ.get("NODEXA_REFERENCE", "/root/reference")
+
+_ROW = re.compile(r'\{ *"[a-z]+", +"([a-z0-9]+)", +&[a-zA-Z_]+')
+
+
+def extract_reference() -> list:
+    names = set()
+    rpc_dir = os.path.join(REF, "src", "rpc")
+    wallet_dir = os.path.join(REF, "src", "wallet")
+    files = []
+    for d in (rpc_dir, wallet_dir):
+        if os.path.isdir(d):
+            files += [
+                os.path.join(d, f) for f in os.listdir(d)
+                if f.endswith(".cpp")
+            ]
+    for path in files:
+        with open(path, errors="replace") as f:
+            for m in _ROW.finditer(f.read()):
+                names.add(m.group(1))
+    return sorted(names)
+
+
+def implemented() -> set:
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    table = register_all(RPCTable())
+    return set(table.commands())
+
+
+def main() -> int:
+    if "--regen" in sys.argv:
+        names = extract_reference()
+        if not names:
+            print(f"no commands extracted from {REF}", file=sys.stderr)
+            return 1
+        with open(DATA, "w") as f:
+            json.dump({"source": "reference CRPCCommand tables",
+                       "count": len(names), "commands": names}, f, indent=1)
+        print(f"wrote {len(names)} commands to {DATA}")
+        return 0
+
+    with open(DATA) as f:
+        ref = json.load(f)
+    ours = implemented()
+    missing = [c for c in ref["commands"] if c not in ours]
+    extras = sorted(ours - set(ref["commands"]))
+    print(f"reference commands: {len(ref['commands'])}; "
+          f"implemented: {len(ours)} ({len(extras)} extras)")
+    if missing:
+        print("MISSING:", ", ".join(missing), file=sys.stderr)
+        return 1
+    print("rpc mapping parity OK (all reference commands implemented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Benchmark the 32-pass lane-gather L1 scheme (Pallas vs XLA).
+
+off in [0,4096) decomposes as hi*128+lo; pass c lane-gathers chunk c
+(128 words) by lo and selects where hi==c.  In Pallas each pass is one
+tpu.dynamic_gather along lanes (single vreg along the gather dim — the
+supported form) + compare + select.
+
+Run: python tools/l1_gather32_bench.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+L1_WORDS = 4096
+R = 4096           # (R, 128) element tile == one (16, 32768) cache access
+K = 64             # chained accesses per dispatch
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def gather32(tbl32, off):
+    """(R,128) gather from tbl32 (32,128) via 32 lane-gather passes."""
+    hi = (off >> 7).astype(jnp.int32)
+    lo = (off & jnp.uint32(127)).astype(jnp.int32)
+    out = jnp.zeros(off.shape, jnp.uint32)
+    for c in range(32):
+        row = jnp.broadcast_to(tbl32[c][None, :], off.shape)
+        cand = jnp.take_along_axis(row, lo, axis=1,
+                                   mode="promise_in_bounds")
+        out = jnp.where(hi == c, cand, out)
+    return out
+
+
+def make_pallas(tbl32):
+    def kern(tbl_ref, idx_ref, out_ref):
+        tbl = tbl_ref[...]
+
+        def body(i, ix):
+            g = gather32(tbl, ix & jnp.uint32(L1_WORDS - 1))
+            return g + i
+
+        out_ref[...] = jax.lax.fori_loop(0, K, body, idx_ref[...])
+
+    call = pl.pallas_call(
+        kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), jnp.uint32),
+    )
+
+    @jax.jit
+    def f(idx, salt):
+        return call(tbl32, idx + salt)[0, 0]
+
+    return f
+
+
+def make_xla(tbl32):
+    @jax.jit
+    def f(idx, salt):
+        idx = idx + salt
+
+        def body(i, ix):
+            g = gather32(tbl32, ix & jnp.uint32(L1_WORDS - 1))
+            return g + i
+
+        return jax.lax.fori_loop(0, K, body, idx)[0, 0]
+
+    return f
+
+
+def slope_time(fn, idx):
+    out = fn(idx, jnp.uint32(0))
+    np.asarray(out)
+    def run(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = fn(idx, jnp.uint32(salt + i))
+        np.asarray(o)
+        return time.perf_counter() - t
+    t1 = run(1, 10)
+    t5 = run(5, 100)
+    return (t5 - t1) / 4
+
+
+def main():
+    rng = np.random.default_rng(3)
+    tbl = rng.integers(0, 1 << 32, size=(L1_WORDS,), dtype=np.uint32)
+    tbl32 = jnp.asarray(tbl.reshape(32, 128))
+    off = rng.integers(0, 1 << 32, size=(R, 128), dtype=np.uint32)
+    idx = jnp.asarray(off)
+
+    # correctness of one pass of the scheme
+    got = np.asarray(gather32(tbl32, idx & jnp.uint32(L1_WORDS - 1)))
+    want = tbl[off & (L1_WORDS - 1)]
+    assert (got == want).all(), "gather32 scheme mismatch"
+    log("gather32 correct")
+
+    elems = R * 128 * K
+    for name, maker in [("pallas32", make_pallas), ("xla32", make_xla)]:
+        try:
+            f = maker(tbl32)
+            dt = slope_time(f, idx)
+            log(f"{name:>9}: {dt*1e3:9.2f} ms/dispatch -> "
+                f"{elems/dt/1e9:8.2f} G elem/s "
+                f"({dt/K*1e6:7.1f} us/access)")
+        except Exception as e:
+            log(f"{name:>9} FAILED: {e!r:.300}")
+
+
+if __name__ == "__main__":
+    main()

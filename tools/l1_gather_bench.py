@@ -1,0 +1,150 @@
+"""Find the fastest consensus-compatible L1-cache word-gather on TPU.
+
+The KawPow search kernel's cost is ~100% the 704x (16, B) random 4-B
+gathers from the 16-KiB L1 cache (tools/search_profile.py bisect).  This
+tool measures candidate formulations, each as a K-iteration in-jit chain
+(output feeds next indices, so nothing elides) with slope timing over
+pipelined dispatches (the axon tunnel adds ~90ms latency per fetch and
+its block_until_ready does not block).
+
+Candidates:
+  xla_take      : jnp.take from (4096,) — what the kernel does today
+  xla_tala      : jnp.take_along_axis on a lane-replicated (4096, 128)
+                  table — per-lane sublane gather form
+  pallas_tala   : same, inside a Pallas kernel (Mosaic 2D dynamic gather)
+
+Run: python tools/l1_gather_bench.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+L1_WORDS = 4096
+B = 32768          # nonce batch of the production kernel
+LANES = 16
+ROWS = LANES * B // 128  # (ROWS, 128) index tile
+K = 64             # chained gathers per dispatch (~1 round-trip of work)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def slope_time(fn, *args):
+    out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0])  # compile+sync
+    def run(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = fn(*args[:-1], args[-1] + jnp.uint32(salt + i))
+        np.asarray(jax.tree_util.tree_leaves(o)[0])
+        return time.perf_counter() - t
+    t1 = run(1, 10)
+    t5 = run(5, 100)
+    return (t5 - t1) / 4
+
+
+def make_xla_take(tbl1d):
+    @jax.jit
+    def f(idx, salt):
+        idx = idx + salt
+
+        def body(i, ix):
+            g = jnp.take(tbl1d, (ix & (L1_WORDS - 1)).astype(jnp.int32),
+                         axis=0)
+            return g + i
+
+        out = jax.lax.fori_loop(0, K, body, idx)
+        return out[0, 0]
+
+    return f
+
+
+def make_xla_tala(tbl2d):
+    @jax.jit
+    def f(idx, salt):
+        idx = idx + salt
+
+        def body(i, ix):
+            g = jnp.take_along_axis(
+                tbl2d, (ix & (L1_WORDS - 1)).astype(jnp.int32), axis=0)
+            return g + i
+
+        out = jax.lax.fori_loop(0, K, body, idx)
+        return out[0, 0]
+
+    return f
+
+
+def make_pallas_tala(tbl2d, rows):
+    def kern(tbl_ref, idx_ref, out_ref):
+        tbl = tbl_ref[...]
+
+        def body(i, ix):
+            g = jnp.take_along_axis(
+                tbl, (ix & (L1_WORDS - 1)).astype(jnp.int32), axis=0)
+            return g + i
+
+        out_ref[...] = jax.lax.fori_loop(
+            0, K, body, idx_ref[...], unroll=True)
+
+    call = pl.pallas_call(
+        kern,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
+    )
+
+    @jax.jit
+    def f(idx, salt):
+        return call(tbl2d, idx + salt)[0, 0]
+
+    return f
+
+
+def main():
+    rng = np.random.default_rng(3)
+    tbl = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(L1_WORDS,), dtype=np.uint32))
+    tbl2d = jnp.broadcast_to(tbl[:, None], (L1_WORDS, 128))
+    idx = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(ROWS, 128), dtype=np.uint32))
+
+    # correctness of the take_along_axis formulation vs plain take
+    want = np.asarray(tbl)[np.asarray(idx) & (L1_WORDS - 1)]
+    got = np.asarray(jnp.take_along_axis(
+        tbl2d, (idx & (L1_WORDS - 1)).astype(jnp.int32), axis=0))
+    assert (got == want).all(), "take_along_axis formulation mismatch"
+
+    elems = ROWS * 128 * K
+    for name, maker, args in [
+        ("xla_take", make_xla_take, (tbl,)),
+        ("xla_tala", make_xla_tala, (tbl2d,)),
+        ("pallas_tala", make_pallas_tala, (tbl2d, ROWS)),
+    ]:
+        try:
+            f = maker(*args)
+            dt = slope_time(f, idx, jnp.uint32(0))
+            log(f"{name:>12}: {dt*1e3:9.1f} ms/dispatch -> "
+                f"{elems/dt/1e9:8.2f} G elem/s")
+        except Exception as e:
+            log(f"{name:>12} FAILED: {e!r:.300}")
+
+
+if __name__ == "__main__":
+    main()

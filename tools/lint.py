@@ -1,0 +1,121 @@
+"""In-repo linter (analog of the reference's contrib/devtools/lint-*.sh;
+this image has no ruff/flake8/mypy, so the gate carries its own checks).
+
+Checks, per Python file:
+  - parses (syntax)
+  - no unused imports (names imported but never referenced)
+  - no tabs in indentation, no trailing whitespace
+  - no `except:` bare handlers
+  - no mutable default arguments (def f(x=[]) / {} / set())
+
+Run: python tools/lint.py [paths...]   (default: package + tests + tools)
+Exit 1 with findings listed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DEFAULT_PATHS = ["nodexa_chain_core_tpu", "tests", "tools", "bench.py",
+                 "__graft_entry__.py"]
+
+
+class ImportChecker(ast.NodeVisitor):
+    def __init__(self):
+        self.imports = {}  # name -> lineno
+        self.used = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> list:
+    problems = []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    for i, line in enumerate(src.split("\n"), 1):
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if "\t" in line[: len(line) - len(line.lstrip())]:
+            problems.append(f"{path}:{i}: tab indentation")
+
+    chk = ImportChecker()
+    chk.visit(tree)
+    # attribute roots count as uses; also names in docstrings' doctest etc.
+    # conservative: scan raw source for the identifier
+    src_lines = src.split("\n")
+    for name, lineno in sorted(chk.imports.items()):
+        if name.startswith("_"):
+            continue
+        if "noqa" in src_lines[lineno - 1]:
+            continue
+        uses = sum(
+            1 for n in ast.walk(tree)
+            if isinstance(n, ast.Name) and n.id == name
+        )
+        attr_uses = src.count(f"{name}.")
+        string_uses = src.count(f'"{name}"') + src.count(f"'{name}'")
+        if uses == 0 and attr_uses == 0 and string_uses == 0:
+            problems.append(f"{path}:{lineno}: unused import '{name}'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: bare 'except:'")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.args.defaults + node.args.kw_defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{d.lineno}: mutable default argument"
+                    )
+    return problems
+
+
+def main() -> int:
+    paths = sys.argv[1:] or DEFAULT_PATHS
+    files = []
+    for p in paths:
+        full = os.path.join(REPO, p) if not os.path.isabs(p) else p
+        if os.path.isfile(full):
+            files.append(full)
+        else:
+            for root, _dirs, names in os.walk(full):
+                files += [
+                    os.path.join(root, n) for n in names
+                    if n.endswith(".py")
+                ]
+    problems = []
+    for f in sorted(files):
+        problems += lint_file(f)
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

