@@ -6,30 +6,36 @@ Prints ONE JSON line:
 
 Phases (stderr narrates):
   1. REAL epoch-0 light + L1 caches via the native engine (consensus data).
-  2. DAG slab: by default the bench measures the on-device slab build rate
-     on a sample launch and fills the full-size slab synthetically — slab
-     CONTENTS do not affect search/verify throughput (same gathers, same
-     math; bit-exactness of device-built items vs the native engine is
-     pinned by tests/test_ethash_dag_jax.py).  NODEXA_BENCH_FULL_DAG=1
-     builds the full real slab on device instead (~6 min on v5e, cached to
-     .bench_cache/ for later runs).
-  3. kawpow_search_throughput: the period-specialized SearchKernel
-     (ops/progpow_search.py) sweeps nonce batches with the boundary check
-     and winner reduction on device.
+  2. DAG slab: REAL by default — built once on device (bit-exactness of
+     the device builder vs the native engine is pinned by
+     tests/test_ethash_dag_jax.py) and cached to .bench_cache/dag_e0.npy;
+     later runs load the cache.  NODEXA_BENCH_SYNTHETIC_DAG=1 falls back
+     to a synthetic-contents slab (same size/layout) for quick runs.
+  3. kawpow_search_throughput: the Pallas round-kernel search
+     (ops/progpow_search.py) sweeping nonce batches.  Timing is the
+     SLOPE over pipelined sweeps (total(N)-total(1))/(N-1): the axon
+     tunnel adds ~100 ms of per-dispatch round-trip latency that real
+     deployments don't pay; the fetch-every-sweep figure is also
+     reported.  A known-answer assertion cross-checks one sweep against
+     the independent BatchVerifier before timing.
   4. kawpow_verify_headers_per_s: BatchVerifier over a 2048-header sync
      batch spanning consecutive heights (the HEADERS-message shape).
-  5. Baseline: the native engine's single-core search loop (the reference
-     node's own in-process capability, ref progpow::search_light) measured
-     in-run; vs_baseline = TPU H/s / native H/s.
-  6. sha256d extras: the round-1/2 Pallas search kernel numbers, kept for
-     cross-round continuity.
+  5. Measured gather rooflines: random 256-B DAG-row gather GB/s and
+     random L1 word-gather G elem/s, each timed as in-jit chained loops
+     (nothing elides, no dispatch latency) — the honest ceilings the
+     kernel's achieved traffic is judged against in extra.utilization.
+  6. Baseline: the native engine's single-core search loop (the
+     reference node's own in-process capability, ref progpow::
+     search_light) measured in-run; vs_baseline = TPU H/s / native H/s.
+  7. sha256d extras: the round-1/2 Pallas search kernel numbers, kept
+     for cross-round continuity.
 
-Utilization accounting (`extra.utilization`): KawPow is designed to be
-memory-hard — per hash it reads 64 random 256 B DAG rows (16 KiB) plus
-11264 random L1 words (44 KiB), so the meaningful ceiling is random-access
-HBM traffic, not ALU throughput.  Both achieved ALU rate (analytic ops/hash
-x H/s vs ~4e12 u32 op/s VPU peak) and achieved random-read bandwidth are
-reported.  sha256d by contrast is pure ALU and lands near VPU peak.
+Utilization accounting (`extra.utilization`): KawPow is memory-hard by
+design — per hash it reads 64 random 256-B DAG rows (16 KiB) + 11,264
+random L1 words (44 KiB).  The kernel's DAG traffic is compared against
+the measured in-jit random-row-gather ceiling; the L1 side runs on the
+hardware lane-gather decomposition whose measured standalone rate is
+also reported (see ops/progpow_search.py module notes).
 """
 
 from __future__ import annotations
@@ -49,15 +55,113 @@ def log(msg: str) -> None:
 # + 4 epilogue merges ~5 ops) + 2 keccak-f800 (~22*120) ~= 2.1e5 u32 ops.
 KAWPOW_OPS_PER_HASH = 210_000
 KAWPOW_DAG_BYTES_PER_HASH = 64 * 256
-KAWPOW_L1_BYTES_PER_HASH = 64 * 11 * 16 * 4
+KAWPOW_L1_WORDS_PER_HASH = 64 * 11 * 16
 # sha256d on an 80-byte header with the first-block midstate precomputed:
 # 2 compressions, each ~64 rounds x ~20 ops + schedule ~48 x 12 ~= 1.9e3.
 SHA256D_OPS_PER_HASH = 3_800
 V5E_U32_OPS_PEAK = 4.0e12  # approx: 8 sublanes x 128 lanes x ~4 ALUs x 940MHz
 
 
+def _measure_gather_ceilings(dag_jnp, l1_np) -> dict:
+    """In-jit chained-loop rooflines for the two consensus access shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = {}
+    # random 256-B row gather: 32 chained rounds of (32768,) row fetches,
+    # indices fed from gathered data so nothing hoists or elides
+    K, B = 32, 32768
+    nrows = dag_jnp.shape[0]
+
+    @jax.jit
+    def row_chain(d, seed):
+        def body(i, ix):
+            rows = jnp.take(d, (ix % nrows).astype(jnp.int32), axis=0)
+            return rows[:, 0] + rows[:, 63] + i
+
+        return jax.lax.fori_loop(
+            0, K, body, seed + jnp.arange(B, dtype=jnp.uint32)
+        )[0]
+
+    t = time.perf_counter()
+    float(np.asarray(row_chain(dag_jnp, jnp.uint32(1))))
+    compile_s = time.perf_counter() - t
+
+    def run(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = row_chain(dag_jnp, jnp.uint32(salt + i))
+        np.asarray(o)
+        return time.perf_counter() - t
+
+    dt = (run(5, 50) - run(1, 10)) / 4
+    out["dag_row_gather_GBps"] = round(K * B * 256 / dt / 1e9, 2)
+    log(f"[roofline] random 256-B row gather: "
+        f"{out['dag_row_gather_GBps']} GB/s (compile {compile_s:.0f}s)")
+
+    # L1 word gather: the Pallas 32-pass lane-gather decomposition the
+    # kernel uses, measured standalone (tools/l1_gather32_bench.py form)
+    from nodexa_chain_core_tpu.ops import progpow_search as ps
+
+    R = 4096
+    tbl32 = jnp.asarray(l1_np.reshape(32, 128))
+    idx = jnp.asarray(
+        np.random.default_rng(3).integers(
+            0, 1 << 32, size=(R, 128), dtype=np.uint32)
+    )
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BLK = 512
+
+    def kern(tbl_ref, idx_ref, out_ref):
+        out_ref[...] = ps._l1_gather32(
+            tbl_ref[...], idx_ref[...] & jnp.uint32(4095))
+
+    call = pl.pallas_call(
+        kern,
+        grid=(R // BLK,),
+        in_specs=[
+            pl.BlockSpec((32, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), jnp.uint32),
+    )
+
+    @jax.jit
+    def l1_chain(ix, salt):
+        def body(i, v):
+            return call(tbl32, v) + i
+
+        return jax.lax.fori_loop(0, 64, body, ix + salt)[0, 0]
+
+    float(np.asarray(l1_chain(idx, jnp.uint32(0))))
+
+    def run2(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = l1_chain(idx, jnp.uint32(salt + i))
+        np.asarray(o)
+        return time.perf_counter() - t
+
+    dt = (run2(5, 50) - run2(1, 10)) / 4
+    out["l1_word_gather_Geps"] = round(R * 128 * 64 / dt / 1e9, 2)
+    log(f"[roofline] L1 lane-gather (Pallas 32-pass): "
+        f"{out['l1_word_gather_Geps']} G elem/s")
+    return out
+
+
 def bench_kawpow(on_tpu: bool) -> dict:
     import numpy as np
+    import jax
+    import jax.numpy as jnp
 
     from nodexa_chain_core_tpu.crypto import kawpow
     from nodexa_chain_core_tpu.ops.ethash_dag_jax import DagBuilder
@@ -73,45 +177,43 @@ def bench_kawpow(on_tpu: bool) -> dict:
         f"{time.perf_counter()-t0:.1f}s; slab = {n2048:,} x 256 B")
 
     builder = DagBuilder(light.copy())
-    slab_src = "synthetic-contents (real size; device-build parity pinned by tests)"
     cache_path = os.path.join(".bench_cache", "dag_e0.npy")
     slab = None
-    if on_tpu and os.path.exists(cache_path):
-        # cpu dev runs must keep their tiny synthetic epoch even when a TPU
-        # run cached the real 1 GiB slab earlier
-        slab = np.load(cache_path, mmap_mode=None)
-        slab_src = "real (disk cache)"
-        log(f"[kawpow] loaded cached real slab from {cache_path}")
+    slab_src = None
+    if on_tpu and not os.environ.get("NODEXA_BENCH_SYNTHETIC_DAG"):
+        if os.path.exists(cache_path):
+            slab = np.load(cache_path, mmap_mode=None)
+            slab_src = "real (disk cache)"
+            log(f"[kawpow] loaded cached real slab from {cache_path}")
+        else:
+            t = time.perf_counter()
+            slab = builder.build_slab(n2048)
+            build_s = time.perf_counter() - t
+            out["dag_device_build_rows_per_s"] = round(n2048 / build_s)
+            slab_src = "real (device-built)"
+            log(f"[kawpow] full real slab built on device in {build_s:.0f}s "
+                f"({n2048/build_s:,.0f} rows/s incl. compile)")
+            os.makedirs(".bench_cache", exist_ok=True)
+            t = time.perf_counter()
+            np.save(cache_path, slab)
+            log(f"[kawpow] slab cached to disk in "
+                f"{time.perf_counter()-t:.0f}s")
     if slab is None and on_tpu:
-        # sample the device build rate (one compile, one timed launch)
         rows = 262144
-        t = time.perf_counter()
         sample = builder.build_rows(0, rows)
-        compile_s = time.perf_counter() - t
         t = time.perf_counter()
         sample2 = builder.build_rows(rows, rows)
         rate = rows / (time.perf_counter() - t)
         out["dag_device_build_rows_per_s"] = round(rate)
-        out["dag_device_full_build_est_s"] = round(n2048 / rate)
-        log(f"[kawpow] device DAG build: {rate:,.0f} rows/s "
-            f"(full real slab ~{n2048/rate:,.0f}s; first compile "
-            f"{compile_s:.0f}s)")
-        if os.environ.get("NODEXA_BENCH_FULL_DAG"):
-            t = time.perf_counter()
-            slab = builder.build_slab(n2048)
-            log(f"[kawpow] full real slab built on device in "
-                f"{time.perf_counter()-t:.0f}s")
-            slab_src = "real (device-built)"
-            os.makedirs(".bench_cache", exist_ok=True)
-            np.save(cache_path, slab)
-        else:
-            slab = np.empty((n2048, 64), np.uint32)
-            slab[:rows] = sample
-            slab[rows : 2 * rows] = sample2
-            rng = np.random.default_rng(0xDA6)
-            slab[2 * rows :] = rng.integers(
-                0, 1 << 32, size=(n2048 - 2 * rows, 64), dtype=np.uint32
-            )
+        slab = np.empty((n2048, 64), np.uint32)
+        slab[:rows] = sample
+        slab[rows : 2 * rows] = sample2
+        rng = np.random.default_rng(0xDA6)
+        slab[2 * rows :] = rng.integers(
+            0, 1 << 32, size=(n2048 - 2 * rows, 64), dtype=np.uint32
+        )
+        slab_src = "synthetic-contents (real size; device-build parity " \
+                   "pinned by tests)"
     elif slab is None:
         # CPU backend dev run: tiny synthetic epoch, eager kernels
         n2048 = 4096
@@ -125,17 +227,53 @@ def bench_kawpow(on_tpu: bool) -> dict:
     height = 1_000_000  # deep kawpow era
     header = bytes(range(32))
     batch = 32768 if on_tpu else 64
+
+    # known-answer gate: the sweep must re-verify on the independent
+    # plan-array kernel before any number is reported
+    probe_nonce = 0xC0FFEE
+    fs, ms = verifier.hash_batch([header], [probe_nonce], [height])
+    probe_final = int.from_bytes(fs[0][::-1], "little")
     t = time.perf_counter()
-    kern.sweep(header, height, 1, 0, batch)  # impossible target: full sweep
-    log(f"[kawpow] search kernel compile+first sweep "
+    hit = kern.sweep(header, height, probe_final, probe_nonce, batch)
+    log(f"[kawpow] search compile+first sweep "
         f"{time.perf_counter()-t:.1f}s (batch {batch})")
-    steps = 3 if on_tpu else 2
-    t = time.perf_counter()
-    for k in range(steps):
-        kern.sweep(header, height, 1, (k + 1) * batch, batch)
-    search_hs = steps * batch / (time.perf_counter() - t)
+    assert hit is not None and hit[0] == probe_nonce, "known-answer miss"
+    assert hit[1] == probe_final, "known-answer final mismatch"
+    assert hit[2] == int.from_bytes(ms[0][::-1], "little"), "mix mismatch"
+    log("[kawpow] known-answer cross-check vs BatchVerifier OK")
+
+    if on_tpu:
+        from nodexa_chain_core_tpu.crypto import progpow_ref as ppref
+        from nodexa_chain_core_tpu.ops import progpow_jax as pj
+
+        fn = kern._fn(height // ppref.PERIOD_LENGTH, batch)
+        hw = jnp.asarray(np.frombuffer(header, dtype="<u4").copy())
+        tw = jnp.asarray(pj.target_swapped_words(1))
+
+        def run(n, salt):
+            t = time.perf_counter()
+            o = None
+            for k in range(n):
+                fa, ma = fn(hw, jnp.uint32(salt + k * batch), jnp.uint32(0),
+                            kern.l1, kern.dag)
+                o = kern._extract(fa, ma, tw)
+            bool(o[0])
+            return time.perf_counter() - t
+
+        t1 = run(1, 10 * batch)
+        tn = run(6, 100 * batch)
+        slope = (tn - t1) / 5
+        search_hs = batch / slope
+        out["kawpow_search_fetch_each_hs"] = round(batch / t1)
+        log(f"[kawpow] search: {search_hs:,.0f} H/s slope "
+            f"({batch/t1:,.0f} H/s with per-sweep host fetch)")
+    else:
+        steps = 2
+        t = time.perf_counter()
+        for k in range(steps):
+            kern.sweep(header, height, 1, (k + 1) * batch, batch)
+        search_hs = steps * batch / (time.perf_counter() - t)
     out["kawpow_search_tpu_hs"] = round(search_hs)
-    log(f"[kawpow] search: {search_hs:,.0f} H/s")
 
     nverify = 2048 if on_tpu else 64
     entries = []
@@ -145,6 +283,7 @@ def bench_kawpow(on_tpu: bool) -> dict:
     t = time.perf_counter()
     verifier.verify_headers(entries)
     log(f"[kawpow] verify compile+first batch {time.perf_counter()-t:.1f}s")
+    steps = 3 if on_tpu else 2
     t = time.perf_counter()
     for _ in range(steps):
         verifier.verify_headers(entries)
@@ -152,6 +291,10 @@ def bench_kawpow(on_tpu: bool) -> dict:
     out["kawpow_verify_headers_per_s"] = round(verify_hs)
     log(f"[kawpow] verify: {verify_hs:,.0f} headers/s "
         f"({nverify}-header sync batches)")
+
+    ceilings = (
+        _measure_gather_ceilings(kern.dag, l1) if on_tpu else {}
+    )
 
     # native single-core baseline: the reference-analogue in-node search
     iters = 60 if on_tpu else 20
@@ -161,20 +304,25 @@ def bench_kawpow(on_tpu: bool) -> dict:
     out["kawpow_native_cpu_hs"] = round(native_hs, 1)
     log(f"[kawpow] native 1-core search: {native_hs:,.1f} H/s")
 
-    out["utilization"] = {
+    dag_gbps = search_hs * KAWPOW_DAG_BYTES_PER_HASH / 1e9
+    l1_geps = search_hs * KAWPOW_L1_WORDS_PER_HASH / 1e9
+    util = {
+        "kawpow_dag_read_GBps": round(dag_gbps, 2),
+        "kawpow_l1_gather_Geps": round(l1_geps, 2),
+        "ops_per_hash_model": KAWPOW_OPS_PER_HASH,
         "kawpow_alu_frac_of_vpu_peak": round(
             search_hs * KAWPOW_OPS_PER_HASH / V5E_U32_OPS_PEAK, 5
         ),
-        "kawpow_random_read_GBps": round(
-            search_hs
-            * (KAWPOW_DAG_BYTES_PER_HASH + KAWPOW_L1_BYTES_PER_HASH)
-            / 1e9,
-            3,
-        ),
-        "ops_per_hash_model": KAWPOW_OPS_PER_HASH,
-        "note": "memory-hard by design: bound by random 256B DAG row + 4B "
-                "L1 word reads, not ALU; see bench.py docstring",
+        "note": "memory-hard by design: per hash 64 random 256-B DAG rows"
+                " + 11264 random L1 words; ceilings measured in-run",
     }
+    util.update(ceilings)
+    if ceilings:
+        util["dag_frac_of_measured_row_gather_ceiling"] = round(
+            dag_gbps / ceilings["dag_row_gather_GBps"], 3)
+        util["l1_frac_of_measured_lane_gather_ceiling"] = round(
+            l1_geps / ceilings["l1_word_gather_Geps"], 3)
+    out["utilization"] = util
     return out
 
 
@@ -207,13 +355,23 @@ def bench_sha256d(on_tpu: bool) -> dict:
             )
         )
 
-    jax.block_until_ready(scan(jnp.uint32(0)))
-    steps = 6 if on_tpu else 8
-    start = time.perf_counter()
-    for i in range(steps):
-        out = scan(jnp.uint32(i * batch))
-    jax.block_until_ready(out)
-    tpu_hs = steps * batch / (time.perf_counter() - start)
+    import numpy as _np
+
+    _np.asarray(scan(jnp.uint32(0))[0])  # compile + real sync (the axon
+    # tunnel's block_until_ready returns before execution finishes)
+
+    def run(n, salt):
+        start = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = scan(jnp.uint32(salt + i * batch))
+        _np.asarray(o[0])
+        return time.perf_counter() - start
+
+    if on_tpu:
+        tpu_hs = 5 * batch / (run(6, 100) - run(1, 10))  # slope
+    else:
+        tpu_hs = 8 * batch / run(8, 10)
 
     n = 30_000
     start = time.perf_counter()

@@ -635,8 +635,10 @@ class BatchVerifier:
         hash_fn = kawpow_hash_batch
         if mesh is not None:
             hash_fn = self._shard_over_mesh(mesh)
+            self._jit_search = jax.jit(self._shard_search_over_mesh(mesh))
+        else:
+            self._jit_search = jax.jit(kawpow_search_batch)
         self._jit = jax.jit(hash_fn)
-        self._jit_search = jax.jit(kawpow_search_batch)
 
     @staticmethod
     def _shard_over_mesh(mesh):
@@ -665,6 +667,49 @@ class BatchVerifier:
             mesh=mesh,
             in_specs=(b2, b1, b1, plan_spec, b1, P(), P()),
             out_specs=(b2, b2),
+        )
+
+    @staticmethod
+    def _shard_search_over_mesh(mesh):
+        """Mesh-parallel nonce SEARCH: the mining hot loop's layout —
+        nonce lanes sharded over every mesh axis, the epoch data (L1 +
+        DAG slab) replicated per chip, exactly like the verify path
+        (see _shard_over_mesh's bandwidth rationale).  Each shard runs
+        the full boundary check + winner reduction locally and emits one
+        (found, local-win, final, mix) row; no collectives are needed —
+        the first-found-shard pick is a host-side scan of D scalars."""
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+        b1 = P(axes)
+        b2 = P(axes, None)
+        plan_spec = PeriodPlan(*([P()] * len(PeriodPlan._fields)))
+
+        def local_search(hw, nlo, nhi, plans, pidx, tw, l1, dag):
+            final, mix_words = kawpow_hash_batch(
+                hw, nlo, nhi, plans, pidx, l1, dag
+            )
+            ok = digest_lte(final, tw)
+            win = jnp.argmax(ok)
+            sel = (
+                jnp.arange(final.shape[0], dtype=_U32) == win.astype(_U32)
+            ).astype(_U32)[:, None]
+            return (
+                jnp.any(ok)[None],
+                win.astype(_U32)[None],
+                (final * sel).sum(axis=0, dtype=_U32)[None],
+                (mix_words * sel).sum(axis=0, dtype=_U32)[None],
+            )
+
+        return shard_map(
+            local_search,
+            mesh=mesh,
+            in_specs=(b2, b1, b1, plan_spec, b1, P(), P(), P()),
+            out_specs=(b1, b1, b2, b2),
         )
 
     @classmethod
@@ -778,6 +823,20 @@ class BatchVerifier:
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), jnp.asarray(tw), self.l1, self.dag,
         )
+        if self.mesh is not None:
+            # one (found, local-win, final, mix) row per shard; take the
+            # first shard that found a winner (lowest nonce range)
+            found = np.asarray(found)
+            hits = np.nonzero(found)[0]
+            if len(hits) == 0:
+                return None
+            d = int(hits[0])
+            shard = bb // found.shape[0]
+            return (
+                int(nonces[d * shard + int(np.asarray(win)[d])]),
+                digest_words_to_le_int(np.asarray(final)[d]),
+                digest_words_to_le_int(np.asarray(mix)[d]),
+            )
         if not bool(found):
             return None
         return (
