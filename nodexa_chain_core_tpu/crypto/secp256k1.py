@@ -155,6 +155,17 @@ def is_valid_privkey(d: int) -> bool:
 def pubkey_create(d: int) -> Point:
     if not is_valid_privkey(d):
         raise Secp256k1Error("invalid private key")
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        out_x = (ctypes.c_uint8 * 32)()
+        out_y = (ctypes.c_uint8 * 32)()
+        if lib.nxk_ec_pubkey_create(d.to_bytes(32, "big"), out_x, out_y):
+            return (
+                int.from_bytes(bytes(out_x), "big"),
+                int.from_bytes(bytes(out_y), "big"),
+            )
     return _from_jac(_g_mul(d))
 
 
@@ -212,11 +223,27 @@ def _rfc6979_k(d: int, msg32: bytes, extra: bytes = b"") -> int:
 
 
 def sign(d: int, msg32: bytes) -> Tuple[int, int]:
-    """Sign a 32-byte digest -> (r, s) with low-S."""
+    """Sign a 32-byte digest -> (r, s), RFC 6979 nonce, low-S.
+
+    Native path: nxk_ecdsa_sign (constant-time fixed-window scalar mult
+    + Fermat mod-n inverse, native/src/secp256k1.cpp) — bit-compatible
+    with the pure-Python fallback below, which stays as the differential
+    test peer (tests/test_secp_native.py)."""
     if len(msg32) != 32:
         raise Secp256k1Error("digest must be 32 bytes")
     if not is_valid_privkey(d):
         raise Secp256k1Error("invalid private key")
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        out_r = (ctypes.c_uint8 * 32)()
+        out_s = (ctypes.c_uint8 * 32)()
+        if lib.nxk_ecdsa_sign(msg32, d.to_bytes(32, "big"), out_r, out_s):
+            return (
+                int.from_bytes(bytes(out_r), "big"),
+                int.from_bytes(bytes(out_s), "big"),
+            )
     z = int.from_bytes(msg32, "big")
     while True:
         k = _rfc6979_k(d, msg32)

@@ -1,6 +1,15 @@
 """The single-page web wallet/explorer served at /ui (parity: reference
-src/qt/ screens — overview, send, receive, transactions, assets, peers;
-e.g. cloregui.cpp tab wiring, sendcoinsdialog.cpp, assetsdialog.cpp).
+src/qt/ screens — overview, send, receive, transactions, assets,
+restricted assets, messaging, rewards, peers; e.g. cloregui.cpp tab
+wiring, sendcoinsdialog.cpp, assetsdialog.cpp,
+restrictedassetsdialog.cpp, askpassphrasedialog.cpp).
+
+Payment URIs: BIP21-style `nodexa:ADDRESS?amount=&label=` links are
+parsed into the send form (and generated on the receive panel), the
+paymentserver.cpp analog for click-to-pay.  BIP70 (the X.509
+payment-protocol messages paymentrequestplus.cpp speaks) is explicitly
+descoped: it is deprecated ecosystem-wide and its trust anchor (CA-signed
+payment requests) has no place in a headless node; see README.
 
 Read-only data flows over the unauthenticated REST endpoints
 (ref src/rest.cpp); wallet and peer actions call JSON-RPC with the
@@ -102,8 +111,10 @@ setInterval(pollHeader, 5000);
 
 // -- tabs --------------------------------------------------------------------
 const TABS = {Overview: viewOverview, Blocks: viewBlocks, Mempool: viewMempool,
-              Wallet: viewWallet, Assets: viewAssets, Peers: viewPeers};
+              Wallet: viewWallet, Assets: viewAssets, Restricted: viewRestricted,
+              Messages: viewMessages, Rewards: viewRewards, Peers: viewPeers};
 let current = "Overview";
+let pendingPay = null;  // parsed #pay= URI awaiting the wallet send form
 function nav(){
   const n = $("#nav"); n.replaceChildren();
   for (const name of Object.keys(TABS)) {
@@ -217,6 +228,66 @@ function loginPanel(after){
   return p;
 }
 
+// BIP21 payment URIs (ref src/qt/paymentserver.cpp parseBitcoinURI;
+// BIP70 descoped — see module docstring)
+function parsePaymentURI(uri){
+  const m = /^nodexa:([A-Za-z0-9]+)(\?(.*))?$/.exec(uri.trim());
+  if (!m) return null;
+  const out = {address:m[1]};
+  const q = new URLSearchParams(m[3]||"");
+  if (q.get("amount") !== null) out.amount = parseFloat(q.get("amount"));
+  if (q.get("label") !== null) out.label = q.get("label");
+  if (q.get("message") !== null) out.message = q.get("message");
+  return out;
+}
+function makePaymentURI(addr, amount, label){
+  let u = "nodexa:"+addr; const q=[];
+  if (amount) q.push("amount="+amount);
+  if (label) q.push("label="+encodeURIComponent(label));
+  return q.length ? u+"?"+q.join("&") : u;
+}
+
+// wallet encryption / unlock (ref src/qt/askpassphrasedialog.cpp)
+function securityPanel(info){
+  const p = el("div",{class:"panel"});
+  p.append(el("h3",{text:"wallet security"}));
+  const enc = info.unlocked_until !== undefined;
+  const locked = enc && !info.unlocked_until;
+  p.append(el("p",{class:"mono",text: enc
+    ? (locked ? "encrypted — LOCKED" : "encrypted — unlocked until "
+       + new Date(info.unlocked_until*1000).toISOString())
+    : "wallet is NOT encrypted"}));
+  const pw = el("input",{placeholder:"passphrase",type:"password",id:"wl-pass"});
+  if (!enc) {
+    const b = el("button",{class:"act",text:"encrypt wallet",id:"wl-encrypt"});
+    b.onclick = async()=>{ try {
+        await rpc("encryptwallet",[pw.value]);
+        toast("wallet encrypted"); render(); }
+      catch(e){ toast(String(e.message||e), true); } };
+    p.append(pw, el("span",{text:" "}), b);
+  } else {
+    const secs = el("input",{placeholder:"unlock seconds",value:"60",size:"8"});
+    const ub = el("button",{class:"act",text:"unlock",id:"wl-unlock"});
+    ub.onclick = async()=>{ try {
+        await rpc("walletpassphrase",[pw.value, parseInt(secs.value)]);
+        toast("unlocked"); render(); }
+      catch(e){ toast(String(e.message||e), true); } };
+    const lb = el("button",{class:"act",text:"lock now",id:"wl-lock"});
+    lb.onclick = async()=>{ try { await rpc("walletlock"); toast("locked"); render(); }
+      catch(e){ toast(String(e.message||e), true); } };
+    const np = el("input",{placeholder:"new passphrase",type:"password"});
+    const cb = el("button",{class:"act",text:"change passphrase"});
+    cb.onclick = async()=>{ try {
+        await rpc("walletpassphrasechange",[pw.value, np.value]);
+        toast("passphrase changed"); render(); }
+      catch(e){ toast(String(e.message||e), true); } };
+    p.append(pw, el("span",{text:" "}), secs, el("span",{text:" "}), ub,
+      el("span",{text:" "}), lb, el("div",{style:"margin-top:.5em"}, np,
+      el("span",{text:" "}), cb));
+  }
+  return p;
+}
+
 async function viewWallet(){
   const wrap = el("div");
   if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
@@ -225,25 +296,41 @@ async function viewWallet(){
   for (const [k,v] of Object.entries(info))
     kv.append(el("div",{},el("span",{text:k}),el("b",{text:String(v)})));
   wrap.append(kv);
+  wrap.append(securityPanel(info));
 
   const recv = el("div",{class:"panel"});
   const addr = el("code",{class:"mono",text:" "});
+  const uri = el("code",{class:"mono",text:""});
   const nb = el("button",{class:"act",text:"new address"});
-  nb.onclick = async()=>{ addr.textContent = await rpc("getnewaddress"); };
-  recv.append(el("h3",{text:"receive"}), nb, el("span",{text:"  "}), addr);
+  const ramt = el("input",{placeholder:"request amount",size:"12"});
+  nb.onclick = async()=>{ const a = await rpc("getnewaddress");
+    addr.textContent = a;
+    uri.textContent = makePaymentURI(a, parseFloat(ramt.value)||0, ""); };
+  recv.append(el("h3",{text:"receive"}), nb, el("span",{text:"  "}), ramt,
+    el("span",{text:"  "}), addr, el("div",{}, uri));
   wrap.append(recv);
 
   const send = el("div",{class:"panel"});
-  const to = el("input",{placeholder:"address",size:"40"});
-  const amt = el("input",{placeholder:"amount",size:"12"});
+  const to = el("input",{placeholder:"address",size:"40",id:"send-to"});
+  const amt = el("input",{placeholder:"amount",size:"12",id:"send-amt"});
+  if (pendingPay) { to.value = pendingPay.address;
+    if (pendingPay.amount) amt.value = pendingPay.amount;
+    toast("payment URI loaded"+(pendingPay.label?" — "+pendingPay.label:""));
+    pendingPay = null; }
+  const puri = el("input",{placeholder:"nodexa: payment URI (BIP21)",size:"50",id:"send-uri"});
+  puri.onchange = ()=>{ const p = parsePaymentURI(puri.value);
+    if (!p) return toast("not a nodexa: URI", true);
+    to.value = p.address; if (p.amount) amt.value = p.amount;
+    toast("URI parsed"+(p.label?" — "+p.label:"")); };
   const sb = el("button",{class:"act",text:"send"});
   sb.onclick = async()=>{
     try { const txid = await rpc("sendtoaddress",[to.value,parseFloat(amt.value)]);
       toast("sent: "+txid); render(); }
     catch(e){ toast(String(e.message||e), true); }
   };
-  send.append(el("h3",{text:"send"}), to, el("span",{text:" "}), amt,
-              el("span",{text:" "}), sb);
+  send.append(el("h3",{text:"send"}), el("div",{}, puri),
+              el("div",{style:"margin-top:.4em"}, to, el("span",{text:" "}),
+              amt, el("span",{text:" "}), sb));
   wrap.append(send);
 
   const txs = await rpc("listtransactions",["*",15]);
@@ -329,6 +416,175 @@ async function viewAssets(){
   return wrap;
 }
 
+// restricted assets (ref src/qt/restrictedassetsdialog.cpp,
+// createassetdialog.cpp restricted mode)
+async function viewRestricted(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+
+  const iss = el("div",{class:"panel"});
+  const rn = el("input",{placeholder:"$RESTRICTED_NAME",id:"ra-name"});
+  const rq = el("input",{placeholder:"qty",value:"1000",size:"10"});
+  const rv = el("input",{placeholder:"verifier e.g. #KYC",size:"22",id:"ra-verifier"});
+  const rto = el("input",{placeholder:"to address",size:"40"});
+  const vchk = el("button",{class:"act",text:"check verifier"});
+  vchk.onclick = async()=>{
+    try { await rpc("isvalidverifierstring",[rv.value]);
+      toast("verifier OK"); }
+    catch(e){ toast("invalid verifier: "+e.message, true); } };
+  const ib = el("button",{class:"act",text:"issue restricted",id:"ra-issue"});
+  ib.onclick = async()=>{
+    try { const txid = await rpc("issuerestrictedasset",
+        [rn.value.trim(), parseFloat(rq.value), rv.value.trim(), rto.value]);
+      toast("issued: "+txid); render(); }
+    catch(e){ toast("issue failed: "+e.message, true); } };
+  iss.append(el("h3",{text:"issue restricted asset"}), rn,
+    el("span",{text:" "}), rq, el("span",{text:" "}), rv,
+    el("span",{text:" "}), vchk, el("div",{style:"margin-top:.4em"}, rto,
+    el("span",{text:" "}), ib),
+    el("p",{class:"mono",text:"holders must satisfy the verifier's qualifier tags"}));
+  wrap.append(iss);
+
+  const tag = el("div",{class:"panel"});
+  const qn = el("input",{placeholder:"#QUALIFIER",id:"tag-name"});
+  const qa = el("input",{placeholder:"address",size:"40",id:"tag-addr"});
+  const ta = el("button",{class:"act",text:"tag",id:"tag-add"});
+  const tr = el("button",{class:"act",text:"untag"});
+  ta.onclick = async()=>{ try {
+      await rpc("addtagtoaddress",[qn.value.trim(), qa.value]);
+      toast("tagged"); render(); }
+    catch(e){ toast("tag failed: "+e.message, true); } };
+  tr.onclick = async()=>{ try {
+      await rpc("removetagfromaddress",[qn.value.trim(), qa.value]);
+      toast("untagged"); render(); }
+    catch(e){ toast("untag failed: "+e.message, true); } };
+  tag.append(el("h3",{text:"qualifier tags"}), qn, el("span",{text:" "}),
+    qa, el("span",{text:" "}), ta, el("span",{text:" "}), tr);
+  wrap.append(tag);
+
+  const frz = el("div",{class:"panel"});
+  const fn = el("input",{placeholder:"$RESTRICTED_NAME",id:"frz-name"});
+  const fa = el("input",{placeholder:"address (blank = global)",size:"40",id:"frz-addr"});
+  const fb = el("button",{class:"act",text:"freeze",id:"frz-freeze"});
+  const ub = el("button",{class:"act",text:"unfreeze"});
+  fb.onclick = async()=>{ try {
+      if (fa.value) await rpc("freezeaddress",[fn.value.trim(), fa.value]);
+      else await rpc("freezerestrictedasset",[fn.value.trim(), true]);
+      toast("frozen"); render(); }
+    catch(e){ toast("freeze failed: "+e.message, true); } };
+  ub.onclick = async()=>{ try {
+      if (fa.value) await rpc("unfreezeaddress",[fn.value.trim(), fa.value]);
+      else await rpc("freezerestrictedasset",[fn.value.trim(), false]);
+      toast("unfrozen"); render(); }
+    catch(e){ toast("unfreeze failed: "+e.message, true); } };
+  frz.append(el("h3",{text:"freezes"}), fn, el("span",{text:" "}), fa,
+    el("span",{text:" "}), fb, el("span",{text:" "}), ub);
+  wrap.append(frz);
+
+  // lookups: verifier string + tag membership
+  const look = el("div",{class:"panel"});
+  const la = el("input",{placeholder:"$NAME or address",size:"40"});
+  const lb = el("button",{class:"act",text:"verifier string"});
+  const lt = el("button",{class:"act",text:"tags for address"});
+  const out = el("pre",{class:"mono",text:""});
+  lb.onclick = async()=>{ try {
+      out.textContent = JSON.stringify(
+        await rpc("getverifierstring",[la.value.trim()]), null, 1); }
+    catch(e){ out.textContent = String(e.message||e); } };
+  lt.onclick = async()=>{ try {
+      out.textContent = JSON.stringify(
+        await rpc("listtagsforaddress",[la.value.trim()]), null, 1); }
+    catch(e){ out.textContent = String(e.message||e); } };
+  look.append(el("h3",{text:"lookups"}), la, el("span",{text:" "}), lb,
+    el("span",{text:" "}), lt, out);
+  wrap.append(look);
+  return wrap;
+}
+
+// on-chain messaging (ref src/qt messaging views + rpc/messages.cpp)
+async function viewMessages(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const snd = el("div",{class:"panel"});
+  const ch = el("input",{placeholder:"CHANNEL_NAME!",id:"msg-channel"});
+  const ipfs = el("input",{placeholder:"message hash (ipfs/txid hex)",size:"48"});
+  const exp = el("input",{placeholder:"expiry block (opt)",size:"12"});
+  const sb = el("button",{class:"act",text:"send message",id:"msg-send"});
+  sb.onclick = async()=>{ try {
+      const args = [ch.value.trim(), ipfs.value.trim()];
+      if (exp.value) args.push(parseInt(exp.value));
+      const txid = await rpc("sendmessage", args);
+      toast("message sent: "+txid); render(); }
+    catch(e){ toast("send failed: "+e.message, true); } };
+  snd.append(el("h3",{text:"send channel message"}), ch,
+    el("span",{text:" "}), ipfs, el("span",{text:" "}), exp,
+    el("span",{text:" "}), sb);
+  wrap.append(snd);
+
+  const [msgs, chans] = await Promise.all([
+    rpc("viewallmessages").catch(()=>[]),
+    rpc("viewallmessagechannels").catch(()=>[]),
+  ]);
+  wrap.append(el("h3",{text:"channels"}),
+    el("p",{class:"mono",text:(chans||[]).join("  ") || "none"}));
+  const tb = el("tbody");
+  for (const m of msgs)
+    tb.append(el("tr",{}, el("td",{text:m.channel||m.asset_name||""}),
+      el("td",{text:m.message||m.ipfs_hash||""}),
+      el("td",{text:m.height??m.block_height??""}),
+      el("td",{text:m.expires??""})));
+  wrap.append(el("h3",{text:"messages"}),
+    el("table",{},el("thead",{},el("tr",{},el("th",{text:"channel"}),
+    el("th",{text:"hash"}),el("th",{text:"height"}),
+    el("th",{text:"expires"}))),tb));
+  if (!msgs.length) wrap.append(el("p",{class:"mono",text:"no messages"}));
+  return wrap;
+}
+
+// reward snapshots (ref src/qt rewards views + rpc/rewards.cpp)
+async function viewRewards(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const req = el("div",{class:"panel"});
+  const an = el("input",{placeholder:"ASSET_NAME",id:"rw-asset"});
+  const hh = el("input",{placeholder:"snapshot height",size:"12",id:"rw-height"});
+  const rb = el("button",{class:"act",text:"request snapshot",id:"rw-request"});
+  rb.onclick = async()=>{ try {
+      await rpc("requestsnapshot",[an.value.trim(), parseInt(hh.value)]);
+      toast("snapshot requested"); render(); }
+    catch(e){ toast("request failed: "+e.message, true); } };
+  req.append(el("h3",{text:"request holder snapshot"}), an,
+    el("span",{text:" "}), hh, el("span",{text:" "}), rb);
+  wrap.append(req);
+
+  const dist = el("div",{class:"panel"});
+  const dn = el("input",{placeholder:"ASSET_NAME"});
+  const dh = el("input",{placeholder:"snapshot height",size:"12"});
+  const dd = el("input",{placeholder:"distribution asset (NODEXA for coin)",size:"20"});
+  const dq = el("input",{placeholder:"total qty",size:"12"});
+  const db = el("button",{class:"act",text:"distribute",id:"rw-distribute"});
+  db.onclick = async()=>{ try {
+      const r = await rpc("distributereward",[dn.value.trim(),
+        parseInt(dh.value), dd.value.trim()||"NODEXA", parseFloat(dq.value)]);
+      toast("distributed: "+JSON.stringify(r).slice(0,60)); render(); }
+    catch(e){ toast("distribute failed: "+e.message, true); } };
+  dist.append(el("h3",{text:"distribute reward"}), dn, el("span",{text:" "}),
+    dh, el("span",{text:" "}), dd, el("span",{text:" "}), dq,
+    el("span",{text:" "}), db);
+  wrap.append(dist);
+
+  const reqs = await rpc("listsnapshotrequests").catch(()=>[]);
+  const tb = el("tbody");
+  for (const r of reqs)
+    tb.append(el("tr",{}, el("td",{text:r.asset_name||r.assetName||""}),
+      el("td",{text:r.block_height??r.height??""})));
+  wrap.append(el("h3",{text:"snapshot requests"}),
+    el("table",{},el("thead",{},el("tr",{},el("th",{text:"asset"}),
+    el("th",{text:"height"}))),tb));
+  if (!reqs.length) wrap.append(el("p",{class:"mono",text:"no snapshot requests"}));
+  return wrap;
+}
+
 async function viewPeers(){
   const wrap = el("div");
   if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
@@ -346,6 +602,14 @@ async function viewPeers(){
 }
 
 if (creds()) $("#h-auth").textContent = "rpc ✓";
+// click-to-pay: /ui#pay=nodexa:ADDR?amount=.. opens the send form filled.
+// The parsed URI is stashed and consumed by viewWallet when it builds the
+// form (it survives the login panel and any number of re-renders).
+if (location.hash.startsWith("#pay=")) {
+  const p = parsePaymentURI(decodeURIComponent(location.hash.slice(5)));
+  if (p) { pendingPay = p; current = "Wallet"; }
+  else toast("unparseable payment URI in #pay=", true);
+}
 nav(); render(); pollHeader();
 </script>
 </body>

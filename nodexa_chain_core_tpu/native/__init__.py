@@ -133,6 +133,12 @@ def load() -> ctypes.CDLL:
         lib.nxk_ecmult.restype = ctypes.c_int
         lib.nxk_ec_on_curve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.nxk_ec_on_curve.restype = ctypes.c_int
+        lib.nxk_ecdsa_sign.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, u8p, u8p,
+        ]
+        lib.nxk_ecdsa_sign.restype = ctypes.c_int
+        lib.nxk_ec_pubkey_create.argtypes = [ctypes.c_char_p, u8p, u8p]
+        lib.nxk_ec_pubkey_create.restype = ctypes.c_int
 
         lib.nxk_aes256cbc_encrypt.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, u8p,

@@ -645,3 +645,417 @@ int nxk_ec_on_curve(const uint8_t x[32], const uint8_t y[32]) {
 }
 
 }  // extern "C"
+
+// ===================================================================
+// Deterministic ECDSA signing (RFC 6979) with constant-time scalar
+// handling — the wallet's signing path (ref secp256k1_ecdsa_sign with
+// nonce_function_rfc6979; key derivation uses the same ct scalar-mult).
+//
+// Constant-time discipline (the threat is a co-resident timing
+// observer, not a power/EM lab):
+//  - the nonce scalar is consumed by a FIXED 4-bit window: 64 windows,
+//    4 doublings + 1 addition each, no early exit;
+//  - window-table lookups scan ALL 16 entries with arithmetic masks —
+//    no secret-indexed loads;
+//  - accumulator-infinity (leading zero windows) is tracked as a mask
+//    and blended, never branched on;
+//  - scalar inversion is Fermat exponentiation by the PUBLIC n-2 (the
+//    branch pattern depends only on the public exponent), not the
+//    variable-time binary gcd the verify path uses;
+//  - mod-n arithmetic on secrets (the Fermat ladder, r*d, k^-1*(z+rd))
+//    goes through masked-subtract mulmod/addmod, never the verify
+//    path's branching reduction;
+//  - residual caveat: the FIELD ops under the point ladder keep their
+//    conditional final reductions (fe_add/fe_sub/fe_cmp_p), whose
+//    pattern depends on intermediate coordinates — orders of magnitude
+//    below the scalar-structure leaks this discipline closes, but not
+//    hardware-grade constant time.
+// The Jacobian add/double formulas are the standard incomplete ones:
+// their exceptional case (acc == +-T[d]) requires k*G colliding with a
+// 4-bit multiple mid-ladder — probability ~2^-250 per signature with
+// honest RFC 6979 nonces (the classic pre-complete-formula caveat).
+
+namespace nxsecp {
+
+// ---- SHA-256 (FIPS 180-4 spec constants) for the RFC 6979 HMAC DRBG
+
+static const uint32_t kShaK[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+struct Sha256Ctx {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t total;
+  size_t used;
+};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+static void sha_init(Sha256Ctx& c) {
+  static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                   0x1f83d9ab, 0x5be0cd19};
+  for (int i = 0; i < 8; ++i) c.h[i] = init[i];
+  c.total = 0;
+  c.used = 0;
+}
+
+static void sha_block(Sha256Ctx& c, const uint8_t* p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i)
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = c.h[0], b = c.h[1], d0 = c.h[2], d = c.h[3], e = c.h[4],
+           f = c.h[5], g = c.h[6], h = c.h[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + kShaK[i] + w[i];
+    uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+    uint32_t mj = (a & b) ^ (a & d0) ^ (b & d0);
+    uint32_t t2 = S0 + mj;
+    h = g; g = f; f = e; e = d + t1;
+    d = d0; d0 = b; b = a; a = t1 + t2;
+  }
+  c.h[0] += a; c.h[1] += b; c.h[2] += d0; c.h[3] += d;
+  c.h[4] += e; c.h[5] += f; c.h[6] += g; c.h[7] += h;
+}
+
+static void sha_update(Sha256Ctx& c, const uint8_t* p, size_t n) {
+  c.total += n;
+  while (n) {
+    size_t take = 64 - c.used;
+    if (take > n) take = n;
+    memcpy(c.buf + c.used, p, take);
+    c.used += take;
+    p += take;
+    n -= take;
+    if (c.used == 64) {
+      sha_block(c, c.buf);
+      c.used = 0;
+    }
+  }
+}
+
+static void sha_final(Sha256Ctx& c, uint8_t out[32]) {
+  uint64_t bits = c.total * 8;
+  uint8_t pad = 0x80;
+  sha_update(c, &pad, 1);
+  uint8_t zero = 0;
+  while (c.used != 56) sha_update(c, &zero, 1);
+  uint8_t len[8];
+  for (int i = 0; i < 8; ++i) len[i] = uint8_t(bits >> (56 - 8 * i));
+  sha_update(c, len, 8);
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = uint8_t(c.h[i] >> 24);
+    out[4 * i + 1] = uint8_t(c.h[i] >> 16);
+    out[4 * i + 2] = uint8_t(c.h[i] >> 8);
+    out[4 * i + 3] = uint8_t(c.h[i]);
+  }
+}
+
+// HMAC-SHA256 over up to 4 concatenated parts (key is always 32 bytes
+// here, well under the block size)
+static void hmac_sha256(const uint8_t key[32], const uint8_t* p1, size_t n1,
+                        const uint8_t* p2, size_t n2, const uint8_t* p3,
+                        size_t n3, const uint8_t* p4, size_t n4,
+                        uint8_t out[32]) {
+  uint8_t k_ipad[64], k_opad[64];
+  for (int i = 0; i < 64; ++i) {
+    uint8_t kb = i < 32 ? key[i] : 0;
+    k_ipad[i] = kb ^ 0x36;
+    k_opad[i] = kb ^ 0x5c;
+  }
+  Sha256Ctx c;
+  uint8_t inner[32];
+  sha_init(c);
+  sha_update(c, k_ipad, 64);
+  if (n1) sha_update(c, p1, n1);
+  if (n2) sha_update(c, p2, n2);
+  if (n3) sha_update(c, p3, n3);
+  if (n4) sha_update(c, p4, n4);
+  sha_final(c, inner);
+  sha_init(c);
+  sha_update(c, k_opad, 64);
+  sha_update(c, inner, 32);
+  sha_final(c, out);
+}
+
+// ---- constant-time primitives
+
+static inline uint64_t ct_mask_eq(uint64_t a, uint64_t b) {
+  uint64_t d = a ^ b;  // 0 iff equal
+  // all-ones when d == 0
+  return uint64_t(0) - uint64_t(1 ^ ((d | (uint64_t(0) - d)) >> 63));
+}
+
+static inline void fe_cmov(Fe& r, const Fe& a, uint64_t mask) {
+  for (int i = 0; i < 4; ++i) r.n[i] = (r.n[i] & ~mask) | (a.n[i] & mask);
+}
+
+static inline void jac_cmov(Jac& r, const Jac& a, uint64_t mask) {
+  fe_cmov(r.x, a.x, mask);
+  fe_cmov(r.y, a.y, mask);
+  fe_cmov(r.z, a.z, mask);
+}
+
+// add/double without the inf/exceptional-case branches (see the header
+// comment for why the generic formulas suffice here)
+static void jac_double_nb(Jac& r, const Jac& p) {
+  Jac in = p;
+  in.inf = false;
+  Jac tmp;
+  jac_double(tmp, in);
+  r.x = tmp.x; r.y = tmp.y; r.z = tmp.z; r.inf = false;
+}
+
+static void jac_add_nb(Jac& r, const Jac& p, const Jac& q) {
+  Fe z1z1, z2z2, u1, u2, s1, s2, t;
+  fe_sqr(z1z1, p.z);
+  fe_sqr(z2z2, q.z);
+  fe_mul(u1, p.x, z2z2);
+  fe_mul(u2, q.x, z1z1);
+  fe_mul(t, q.z, z2z2);
+  fe_mul(s1, p.y, t);
+  fe_mul(t, p.z, z1z1);
+  fe_mul(s2, q.y, t);
+  Fe h, rr;
+  fe_sub(h, u2, u1);
+  fe_sub(rr, s2, s1);
+  Fe h2, h3, u1h2;
+  fe_sqr(h2, h);
+  fe_mul(h3, h2, h);
+  fe_mul(u1h2, u1, h2);
+  Fe x3, y3, z3;
+  fe_sqr(x3, rr);
+  fe_sub(x3, x3, h3);
+  fe_sub(x3, x3, u1h2);
+  fe_sub(x3, x3, u1h2);
+  fe_sub(t, u1h2, x3);
+  fe_mul(t, rr, t);
+  Fe s1h3;
+  fe_mul(s1h3, s1, h3);
+  fe_sub(y3, t, s1h3);
+  fe_mul(z3, p.z, q.z);
+  fe_mul(z3, z3, h);
+  r.x = x3; r.y = y3; r.z = z3; r.inf = false;
+}
+
+// R = k*G, fixed 4-bit window, constant-time in k
+static void ct_mul_g(Jac& out, const uint8_t k_be[32]) {
+  const GTable& G = g_table();
+  Jac acc = G.t[1];          // value irrelevant while inf_mask is set
+  uint64_t inf_mask = ~uint64_t(0);
+  for (int w = 0; w < 64; ++w) {
+    if (w) {
+      jac_double_nb(acc, acc);
+      jac_double_nb(acc, acc);
+      jac_double_nb(acc, acc);
+      jac_double_nb(acc, acc);
+    }
+    int byte = w / 2;
+    uint64_t digit = (w & 1) ? (k_be[byte] & 0x0F) : (k_be[byte] >> 4);
+    // masked scan of the whole table — no secret-indexed load
+    Jac sel = G.t[1];
+    for (uint64_t j = 2; j < 16; ++j)
+      jac_cmov(sel, G.t[j], ct_mask_eq(digit, j));
+    Jac added;
+    jac_add_nb(added, acc, sel);
+    uint64_t d_zero = ct_mask_eq(digit, 0);
+    // digit==0            -> keep acc (and keep inf state)
+    // digit!=0, acc=inf   -> sel
+    // digit!=0, acc!=inf  -> acc + sel
+    Jac next = added;
+    jac_cmov(next, sel, inf_mask);
+    jac_cmov(next, acc, d_zero);
+    acc = next;
+    inf_mask &= d_zero;
+  }
+  out = acc;
+  out.inf = inf_mask != 0;
+}
+
+// ---- mod-n helpers for the signing equation
+
+// fixed-sequence product mod n: same schoolbook product as n_mulmod,
+// but the per-bit reduction uses a masked subtract instead of the
+// verify path's data-dependent branch (the signing equation multiplies
+// the secret nonce and private key through here)
+static void n_mulmod_ct(U256& r, const U256& a, const U256& b) {
+  uint64_t prod[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    unsigned __int128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      carry += (unsigned __int128)a.v[i] * b.v[j] + prod[i + j];
+      prod[i + j] = (uint64_t)carry;
+      carry >>= 64;
+    }
+    prod[i + 4] = (uint64_t)carry;
+  }
+  U256 rem = {{0, 0, 0, 0}};
+  for (int bit = 511; bit >= 0; --bit) {
+    uint64_t top = rem.v[3] >> 63;
+    for (int i = 3; i > 0; --i)
+      rem.v[i] = (rem.v[i] << 1) | (rem.v[i - 1] >> 63);
+    rem.v[0] = (rem.v[0] << 1) | ((prod[bit / 64] >> (bit % 64)) & 1);
+    U256 t;
+    uint64_t borrow = u_sub(t, rem, kNU);
+    // subtract when the shifted-out bit is set OR rem >= n — as an
+    // arithmetic mask, never a branch
+    uint64_t mask = uint64_t(0) - (top | (borrow ^ 1));
+    for (int i = 0; i < 4; ++i)
+      rem.v[i] = (rem.v[i] & ~mask) | (t.v[i] & mask);
+  }
+  r = rem;
+}
+
+static void n_addmod_ct(U256& r, const U256& a, const U256& b) {
+  uint64_t carry = u_add(r, a, b);
+  U256 t;
+  uint64_t borrow = u_sub(t, r, kNU);
+  uint64_t mask = uint64_t(0) - (carry | (borrow ^ 1));
+  for (int i = 0; i < 4; ++i)
+    r.v[i] = (r.v[i] & ~mask) | (t.v[i] & mask);
+}
+
+static void n_reduce_once(U256& a) {
+  U256 t;
+  uint64_t borrow = u_sub(t, a, kNU);
+  if (!borrow) a = t;  // value-dependent, but only leaks z/r*d >= n
+}
+
+// w = a^(n-2) mod n — exponent is PUBLIC, so its branch pattern leaks
+// nothing about a (unlike the binary-gcd n_inv used by verify)
+static void n_inv_ct(U256& r, const U256& a) {
+  static const uint64_t kNm2[4] = {
+      0xBFD25E8CD036413FULL, 0xBAAEDCE6AF48A03BULL,
+      0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL,
+  };
+  U256 acc{{1, 0, 0, 0}};
+  for (int limb = 3; limb >= 0; --limb) {
+    for (int bit = 63; bit >= 0; --bit) {
+      n_mulmod_ct(acc, acc, acc);
+      if ((kNm2[limb] >> bit) & 1) n_mulmod_ct(acc, acc, a);
+    }
+  }
+  r = acc;
+}
+
+// ---- RFC 6979 nonce (HMAC-SHA256 DRBG, no extra data)
+
+static void rfc6979_k(const uint8_t x32[32], const uint8_t h32[32],
+                      U256& k_out) {
+  uint8_t K[32], V[32];
+  memset(K, 0x00, 32);
+  memset(V, 0x01, 32);
+  uint8_t sep0 = 0x00, sep1 = 0x01;
+  hmac_sha256(K, V, 32, &sep0, 1, x32, 32, h32, 32, K);
+  hmac_sha256(K, V, 32, nullptr, 0, nullptr, 0, nullptr, 0, V);
+  hmac_sha256(K, V, 32, &sep1, 1, x32, 32, h32, 32, K);
+  hmac_sha256(K, V, 32, nullptr, 0, nullptr, 0, nullptr, 0, V);
+  for (;;) {
+    hmac_sha256(K, V, 32, nullptr, 0, nullptr, 0, nullptr, 0, V);
+    U256 cand;
+    u_from_bytes(cand, V);
+    if (!u_is_zero(cand) && u_cmp(cand, kNU) < 0) {
+      k_out = cand;
+      return;
+    }
+    hmac_sha256(K, V, 32, &sep0, 1, nullptr, 0, nullptr, 0, K);
+    hmac_sha256(K, V, 32, nullptr, 0, nullptr, 0, nullptr, 0, V);
+  }
+}
+
+}  // namespace nxsecp
+
+extern "C" {
+
+// Public key from a private scalar via the constant-time G ladder
+// (ref secp256k1_ec_pubkey_create; BIP32 derivation's hot op).
+// Returns 1 on success (priv in [1, n-1]), 0 otherwise.
+int nxk_ec_pubkey_create(const uint8_t priv32[32], uint8_t out_x[32],
+                         uint8_t out_y[32]) {
+  using namespace nxsecp;
+  U256 d;
+  u_from_bytes(d, priv32);
+  if (u_is_zero(d) || u_cmp(d, kNU) >= 0) return 0;
+  Jac p;
+  ct_mul_g(p, priv32);
+  if (p.inf) return 0;
+  Fe zi, zi2, zi3, ax, ay;
+  fe_inv(zi, p.z);
+  fe_sqr(zi2, zi);
+  fe_mul(zi3, zi2, zi);
+  fe_mul(ax, p.x, zi2);
+  fe_mul(ay, p.y, zi3);
+  fe_to_bytes(out_x, ax);
+  fe_to_bytes(out_y, ay);
+  return 1;
+}
+
+// RFC 6979 deterministic ECDSA over a 32-byte digest, low-S normalized
+// (BIP 62).  Bit-compatible with the Python fallback signer — the two
+// are differential-tested against each other.  Returns 1 on success.
+int nxk_ecdsa_sign(const uint8_t digest32[32], const uint8_t priv32[32],
+                   uint8_t out_r[32], uint8_t out_s[32]) {
+  using namespace nxsecp;
+  U256 d, z;
+  u_from_bytes(d, priv32);
+  if (u_is_zero(d) || u_cmp(d, kNU) >= 0) return 0;
+  u_from_bytes(z, digest32);
+  n_reduce_once(z);
+  U256 k;
+  rfc6979_k(priv32, digest32, k);
+  uint8_t kb[32];
+  u_to_bytes(kb, k);
+  Jac R;
+  ct_mul_g(R, kb);
+  if (R.inf) return 0;  // unreachable for k in [1, n-1]
+  Fe zi, zi2, rx;
+  fe_inv(zi, R.z);
+  fe_sqr(zi2, zi);
+  fe_mul(rx, R.x, zi2);
+  uint8_t rxb[32];
+  fe_to_bytes(rxb, rx);
+  U256 r;
+  u_from_bytes(r, rxb);
+  n_reduce_once(r);
+  if (u_is_zero(r)) return 0;  // ~2^-256; caller may retry with new msg
+  U256 kinv, rd, zrd, s;
+  n_inv_ct(kinv, k);
+  n_mulmod_ct(rd, r, d);
+  n_addmod_ct(zrd, z, rd);
+  n_mulmod_ct(s, kinv, zrd);
+  if (u_is_zero(s)) return 0;
+  // low-S: s = min(s, n - s)
+  U256 ns;
+  u_sub(ns, kNU, s);
+  static const uint64_t kHalfN[4] = {
+      0xDFE92F46681B20A0ULL, 0x5D576E7357A4501DULL,
+      0xFFFFFFFFFFFFFFFFULL, 0x7FFFFFFFFFFFFFFFULL,
+  };
+  U256 half{{kHalfN[0], kHalfN[1], kHalfN[2], kHalfN[3]}};
+  if (u_cmp(s, half) > 0) s = ns;
+  u_to_bytes(out_r, r);
+  u_to_bytes(out_s, s);
+  return 1;
+}
+
+}  // extern "C"

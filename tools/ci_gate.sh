@@ -1,0 +1,78 @@
+#!/bin/sh
+# CI gate — one command, green from a fresh clone (analog of the
+# reference's contrib/devtools + doc/travis-ci.md lint/check/test lanes).
+#
+#   sh tools/ci_gate.sh            # lint + parity pins + unit tests + wheel
+#   sh tools/ci_gate.sh --full     # also the functional (daemon) suite
+#
+# Stages:
+#   1. lint            tools/lint.py (no ruff/flake8 in-image; the gate
+#                      carries its own checks: syntax, unused imports,
+#                      tabs/trailing-ws, bare except, mutable defaults)
+#   2. import graph    every package module imports cleanly on CPU
+#   3. rpc parity      tools/check_rpc_mappings.py — all 168 reference
+#                      CRPCCommand names have handlers (committed pin)
+#   4. vectors         generate_x16r_vectors.py --check — the committed
+#                      crypto vectors regenerate bit-for-bit (only when
+#                      the reference tree is mounted)
+#   5. native build    compiles the C++ engine (also feeds the wheel)
+#   6. pytest          unit suite (functional suite with --full)
+#   7. wheel           self-contained wheel including the native .so
+set -e
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== [1/7] lint"
+python tools/lint.py
+
+echo "== [2/7] import graph"
+python - <<'EOF'
+import importlib, os, pkgutil
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import nodexa_chain_core_tpu as pkg
+
+bad = []
+for m in pkgutil.walk_packages(pkg.__path__, pkg.__name__ + "."):
+    try:
+        importlib.import_module(m.name)
+    except Exception as e:  # noqa — gate report, not control flow
+        bad.append((m.name, repr(e)))
+for name, err in bad:
+    print(f"IMPORT FAIL {name}: {err}")
+raise SystemExit(1 if bad else 0)
+EOF
+echo "   all modules import"
+
+echo "== [3/7] rpc mapping parity"
+python tools/check_rpc_mappings.py
+
+echo "== [4/7] crypto vector regeneration"
+if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
+    python tools/generate_x16r_vectors.py --check
+else
+    echo "   reference tree not mounted; committed vectors still exercised by pytest"
+fi
+
+echo "== [5/7] native engine build"
+python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
+
+echo "== [6/7] pytest"
+if [ "$1" = "--full" ]; then
+    python -m pytest tests/ -q
+else
+    python -m pytest tests/ -q -m "not functional"
+fi
+
+echo "== [7/7] wheel"
+rm -rf build/ dist/ ./*.egg-info
+python -m pip wheel --no-build-isolation --no-deps -w dist . -q
+python - <<'EOF'
+import glob, zipfile
+whl = glob.glob("dist/*.whl")[0]
+names = zipfile.ZipFile(whl).namelist()
+so = [n for n in names if n.endswith(".so")]
+assert so, f"wheel {whl} does not ship the native engine"
+print(f"   {whl}: {len(names)} files incl. {so[0].split('/')[-1]}")
+EOF
+
+echo "CI GATE GREEN"
