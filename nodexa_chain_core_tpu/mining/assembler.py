@@ -201,8 +201,23 @@ def kawpow_verifier_for(node, block: Block):
     return mgr.verifier(epoch_number(block.header.height))
 
 
+def _hybrid_searcher(verifier, fallback_batch: int):
+    """Per-verifier HybridSearch (fast per-period kernel + scan-kernel
+    fallback, ops/progpow_search.HybridSearch), created once and cached
+    on the verifier so the background-compiled kernels survive across
+    mining slices."""
+    searcher = getattr(verifier, "_hybrid_search", None)
+    if searcher is None or searcher.fallback_batch != fallback_batch:
+        from ..ops.progpow_search import HybridSearch
+
+        searcher = HybridSearch(verifier, fallback_batch=fallback_batch)
+        verifier._hybrid_search = searcher
+    return searcher
+
+
 def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
-                   kawpow_verifier=None, batch: int = 2048) -> bool:
+                   kawpow_verifier=None, batch: int = 2048,
+                   on_progress=None) -> bool:
     """Accelerated nonce search by era (the reference's live-era analogue
     is the external GPU miner via getblocktemplate).
 
@@ -218,16 +233,20 @@ def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
         if kawpow_verifier is None:
             return mine_block_cpu(block, schedule, max_tries=max_batches * 64)
         header_hash = block.header.kawpow_header_hash(schedule)[::-1]
-        for b in range(max_batches):
-            found = kawpow_verifier.search(
-                header_hash, block.header.height, target,
-                start_nonce=b * batch, batch=batch,
+        searcher = _hybrid_searcher(kawpow_verifier, batch)
+        start = 0
+        for _ in range(max_batches):
+            found, width = searcher.search_window(
+                header_hash, block.header.height, target, start
             )
+            if on_progress is not None:
+                on_progress(width)
             if found is not None:
                 block.header.nonce64 = found[0]
                 block.header.mix_hash = found[2]
                 block.header._cached_hash = None
                 return True
+            start += width
         return False
     if algo in ("x16r", "x16rv2"):
         return mine_block_cpu(block, schedule, max_tries=max_batches * 4096)

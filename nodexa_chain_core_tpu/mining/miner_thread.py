@@ -73,16 +73,34 @@ class BackgroundMiner:
     def _search_slice(self, block) -> bool:
         """One nonce slice, era-aware: the TPU batched KawPow search when a
         device slab is ready (ref the external GPU miners driving the live
-        era), else the native CPU scans (ref GenerateClores' inner loop)."""
+        era), else the native CPU scans (ref GenerateClores' inner loop).
+
+        Device windows vary in width (the hybrid searcher jumps from 2k
+        to 32k nonces once a period's fast kernel lands), so the device
+        path reports its actual coverage through on_progress and the
+        slice stops once ~SLICE_TRIES nonces are covered — keeping both
+        the hashrate accounting and the template-staleness recheck
+        cadence honest."""
         from .assembler import kawpow_verifier_for, mine_block_tpu
 
         verifier = kawpow_verifier_for(self.node, block)
         if verifier is not None:
-            return mine_block_tpu(
-                block, self.node.params.algo_schedule,
-                max_batches=max(1, SLICE_TRIES // 2048),
-                kawpow_verifier=verifier,
-            )
+            covered = [0]
+
+            def on_progress(n):
+                covered[0] += n
+
+            found = False
+            while covered[0] < SLICE_TRIES and not self._stop.is_set():
+                found = mine_block_tpu(
+                    block, self.node.params.algo_schedule, max_batches=1,
+                    kawpow_verifier=verifier, on_progress=on_progress,
+                )
+                if found:
+                    break
+            self._slice_covered = covered[0]
+            return found
+        self._slice_covered = SLICE_TRIES
         return mine_block_cpu(
             block, self.node.params.algo_schedule, max_tries=SLICE_TRIES
         )
@@ -124,7 +142,8 @@ class BackgroundMiner:
                 asm = BlockAssembler(node.chainstate)
                 block = asm.create_new_block(spk, extra_nonce=extra)
                 found = self._search_slice(block)
-                self._count(SLICE_TRIES if not found else SLICE_TRIES // 2)
+                covered = getattr(self, "_slice_covered", SLICE_TRIES)
+                self._count(covered if not found else max(covered // 2, 1))
                 if self._stop.is_set():
                     return
                 if not found:

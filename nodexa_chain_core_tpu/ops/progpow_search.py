@@ -31,6 +31,7 @@ per nonce.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -446,6 +447,7 @@ class SearchKernel:
         self.l1 = jnp.asarray(l1, dtype=_U32)
         self.dag = jnp.asarray(dag, dtype=_U32)
         self._jit_cache: dict = {}
+        self._cache_lock = threading.Lock()
         self._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
         )
@@ -464,27 +466,35 @@ class SearchKernel:
         obj.l1 = verifier.l1
         obj.dag = verifier.dag
         obj._jit_cache = {}
+        obj._cache_lock = threading.Lock()
         obj._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
         )
         return obj
 
     def _fn(self, period: int, batch: int):
+        # the lock serializes concurrent compiles (HybridSearch warms
+        # kernels on background threads) and makes the LRU sane; holding
+        # it across the build is intentional — two threads racing the
+        # same period would otherwise compile twice
         key = (period, batch)
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            fn = _search_kernel(period, batch)
-            # XLA:CPU cannot digest the ~17k-op unrolled mix (its scheduler
-            # degenerates on long static chains — the scan-based kernels in
-            # progpow_jax jit fine there after the keccak tensor rewrite,
-            # but this kernel's whole point is the unroll).  Eager CPU runs
-            # the identical trace op-by-op, which is what the correctness
-            # tests need; real backends get the jit.
-            if jax.default_backend() != "cpu":
-                fn = jax.jit(fn)
-            if len(self._jit_cache) > 4:  # periods are transient; cap VMEM
-                self._jit_cache.clear()
-            self._jit_cache[key] = fn
+        with self._cache_lock:
+            fn = self._jit_cache.pop(key, None)
+            if fn is None:
+                fn = _search_kernel(period, batch)
+                # XLA:CPU cannot digest the ~17k-op unrolled mix (its
+                # scheduler degenerates on long static chains — the
+                # scan-based kernels in progpow_jax jit fine there after
+                # the keccak tensor rewrite, but this kernel's whole
+                # point is the unroll).  Eager CPU runs the identical
+                # trace op-by-op, which is what the correctness tests
+                # need; real backends get the jit.
+                if jax.default_backend() != "cpu":
+                    fn = jax.jit(fn)
+                while len(self._jit_cache) >= 4:  # cap VMEM: evict LRU,
+                    # never the active (most recently used) periods
+                    self._jit_cache.pop(next(iter(self._jit_cache)))
+            self._jit_cache[key] = fn  # re-insert = move to MRU
         return fn
 
     def sweep(self, header_hash: bytes, height: int, target_le_int: int,
@@ -523,3 +533,119 @@ class SearchKernel:
             if hit is not None:
                 return hit
         return None
+
+
+class HybridSearch:
+    """The live-mining dispatch: per-period Pallas kernel when compiled,
+    the compile-once plan-array scan kernel meanwhile.
+
+    The reference's live era mines on external GPU miners that pay a
+    per-period kernel generation+compile and sweep fast in between (ref
+    progpow.cpp:15 period-seeded programs).  This is the same economics
+    on TPU: the round-kernel sweep is ~100x the scan kernel's rate but
+    costs a per-(period, batch) XLA compile (~20-30 s); a period lasts
+    3 blocks (~3 min).  The compile runs on a background thread the
+    first time a period is seen, and until it lands every search is
+    served by the verifier's always-ready scan kernel — mining never
+    stalls, and never waits on a compile.
+    """
+
+    def __init__(self, verifier: pj.BatchVerifier, fast_batch: int = 32768,
+                 fallback_batch: int = 2048, force_fast: bool = False):
+        self.verifier = verifier
+        self.kern = SearchKernel.from_verifier(verifier)
+        self.fast_batch = fast_batch
+        self.fallback_batch = fallback_batch
+        self._force_fast = force_fast  # tests: skip the backend gate
+        self._ready: set = set()
+        self._compiling: set = set()
+        self._lock = threading.Lock()
+
+    def _fast_enabled(self) -> bool:
+        return self._force_fast or jax.default_backend() != "cpu"
+
+    def _warm(self, period: int, height: int) -> None:
+        try:
+            # compile + first sweep against an impossible target
+            self.kern.sweep(b"\x00" * 32, height, 1, 0, self.fast_batch)
+            with self._lock:
+                self._ready.add(period)
+        except Exception:  # pragma: no cover — compile-service hiccup:
+            pass  # stay on the scan kernel; retried on the next period
+        finally:
+            with self._lock:
+                self._compiling.discard(period)
+
+    def _period_ready(self, period: int) -> bool:
+        # the SearchKernel caps its jit cache; readiness must track it
+        return (
+            period in self._ready
+            and (period, self.fast_batch) in self.kern._jit_cache
+        )
+
+    def effective_batch(self, height: int) -> int:
+        """Advisory: the window width search_window would pick now."""
+        if not self._fast_enabled():
+            return self.fallback_batch
+        period = height // ref.PERIOD_LENGTH
+        with self._lock:
+            return (
+                self.fast_batch if self._period_ready(period)
+                else self.fallback_batch
+            )
+
+    def search_window(self, header_hash: bytes, height: int,
+                      target_le_int: int, start_nonce: int = 0,
+                      ) -> Tuple[Optional[Tuple[int, int, int]], int]:
+        """One window at the best available tier.
+
+        Returns (hit-or-None, width actually covered).  Tier choice and
+        width are decided together under the lock, so a background warm
+        landing mid-call can never send a foreign batch size to the fast
+        kernel (which would trigger a synchronous compile)."""
+        if not self._fast_enabled():
+            return (
+                self.verifier.search(
+                    header_hash, height, target_le_int,
+                    start_nonce=start_nonce, batch=self.fallback_batch,
+                ),
+                self.fallback_batch,
+            )
+        period = height // ref.PERIOD_LENGTH
+        with self._lock:
+            ready = self._period_ready(period)
+            if not ready and period not in self._compiling:
+                self._compiling.add(period)
+                threading.Thread(
+                    target=self._warm, args=(period, height),
+                    name=f"kawpow-kernel-{period}", daemon=True,
+                ).start()
+        if ready:
+            return (
+                self.kern.search(
+                    header_hash, height, target_le_int, start_nonce,
+                    batch=self.fast_batch,
+                ),
+                self.fast_batch,
+            )
+        return (
+            self.verifier.search(
+                header_hash, height, target_le_int,
+                start_nonce=start_nonce, batch=self.fallback_batch,
+            ),
+            self.fallback_batch,
+        )
+
+    def search(self, header_hash: bytes, height: int, target_le_int: int,
+               start_nonce: int = 0,
+               batch: Optional[int] = None) -> Optional[Tuple[int, int, int]]:
+        """Compatibility wrapper over search_window (the `batch`
+        override only applies on the fallback tier)."""
+        if batch is not None and not self._fast_enabled():
+            return self.verifier.search(
+                header_hash, height, target_le_int,
+                start_nonce=start_nonce, batch=batch,
+            )
+        return self.search_window(
+            header_hash, height, target_le_int, start_nonce
+        )[0]

@@ -1,0 +1,140 @@
+"""HybridSearch: the live-mining dispatch between the always-ready scan
+kernel and the per-period Pallas round kernel
+(ops/progpow_search.HybridSearch; ref: GPU miners' per-period kernel
+generation economics, progpow.cpp:15).
+
+On CPU the fast tier is gated off (the round kernel runs eagerly there)
+— force_fast with tiny batches exercises the dispatch machinery and the
+result parity of both tiers."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nodexa_chain_core_tpu.crypto import progpow_ref as ref
+from nodexa_chain_core_tpu.ops import progpow_jax as pj
+from nodexa_chain_core_tpu.ops.progpow_search import HybridSearch
+
+RNG = np.random.default_rng(0x4B1D)
+N_ITEMS = 512
+
+
+@pytest.fixture(scope="module")
+def epoch():
+    l1 = RNG.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    dag = RNG.integers(0, 1 << 32, size=(N_ITEMS, 64), dtype=np.uint32)
+    return l1, dag
+
+
+def _wait_ready(h, period, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with h._lock:
+            if h._period_ready(period):
+                return True
+        time.sleep(0.1)
+    return False
+
+
+def test_cpu_gate_serves_scan_kernel(epoch):
+    l1, dag = epoch
+    verifier = pj.BatchVerifier(l1, dag)
+    h = HybridSearch(verifier, fast_batch=64, fallback_batch=64)
+    height = 99
+    assert h.effective_batch(height) == 64  # cpu backend: fallback tier
+    header = bytes(range(32))
+
+    def lookup(idx):
+        return dag[idx].astype("<u4").tobytes()
+
+    want_final, want_mix = ref.kawpow_hash(
+        height, header, 7, [int(x) for x in l1], N_ITEMS, lookup
+    )
+    hit = h.search(header, height, int.from_bytes(want_final[::-1], "little"),
+                   start_nonce=7, batch=64)
+    assert hit is not None and hit[0] == 7
+    assert hit[1] == int.from_bytes(want_final[::-1], "little")
+    # no background compiles were started on the gated path
+    assert not h._compiling and not h._ready
+
+
+def test_fast_tier_compiles_in_background_and_agrees(epoch):
+    l1, dag = epoch
+    verifier = pj.BatchVerifier(l1, dag)
+    h = HybridSearch(verifier, fast_batch=64, fallback_batch=64,
+                     force_fast=True)
+    height = 300
+    period = height // ref.PERIOD_LENGTH
+    header = bytes((i * 5 + 1) % 256 for i in range(32))
+
+    def lookup(idx):
+        return dag[idx].astype("<u4").tobytes()
+
+    want_final, want_mix = ref.kawpow_hash(
+        height, header, 3, [int(x) for x in l1], N_ITEMS, lookup
+    )
+    target = int.from_bytes(want_final[::-1], "little")
+
+    # first call: fast tier not ready -> served by the scan kernel,
+    # compile kicked off in the background
+    hit1 = h.search(header, height, target, start_nonce=3)
+    assert hit1 is not None and hit1[0] == 3
+    assert _wait_ready(h, period), "background warm never completed"
+    assert h.effective_batch(height) == 64
+
+    # second call: fast tier serves, bit-identical results
+    hit2 = h.search(header, height, target, start_nonce=3)
+    assert hit2 == hit1
+    assert hit2[2] == int.from_bytes(want_mix[::-1], "little")
+
+    # a different period falls back again until its own warm lands
+    other_height = height + ref.PERIOD_LENGTH
+    assert h.effective_batch(other_height) == 64  # fallback tier width
+    hit3 = h.search(header, other_height, 1, start_nonce=0)
+    assert hit3 is None  # impossible target, scan tier
+    assert _wait_ready(h, other_height // ref.PERIOD_LENGTH)
+
+
+def test_miner_routes_through_hybrid(epoch, monkeypatch):
+    """mine_block_tpu attaches a HybridSearch to the verifier and
+    advances the nonce window by the tier's effective width."""
+    from nodexa_chain_core_tpu.mining import assembler
+
+    l1, dag = epoch
+    verifier = pj.BatchVerifier(l1, dag)
+    calls = []
+
+    class SpyHybrid:
+        fallback_batch = 64
+
+        def search_window(self, header_hash, height, target, start_nonce=0):
+            calls.append((start_nonce, 64))
+            return None, 64
+
+    monkeypatch.setattr(
+        assembler, "_hybrid_searcher", lambda v, fb: SpyHybrid()
+    )
+
+    class Hdr:
+        height = 50
+        time = 10**9
+        bits = 0x207FFFFF
+        nonce64 = 0
+        mix_hash = 0
+        _cached_hash = None
+
+        def kawpow_header_hash(self, schedule):
+            return bytes(32)
+
+    class Blk:
+        header = Hdr()
+
+    class Sched:
+        def era_algo(self, t):
+            return "kawpow"
+
+    assert not assembler.mine_block_tpu(
+        Blk(), Sched(), max_batches=3, kawpow_verifier=verifier, batch=64
+    )
+    assert calls == [(0, 64), (64, 64), (128, 64)]
