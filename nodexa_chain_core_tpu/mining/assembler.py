@@ -201,23 +201,29 @@ def kawpow_verifier_for(node, block: Block):
     return mgr.verifier(epoch_number(block.header.height))
 
 
+_hybrid_lock = __import__("threading").Lock()
+
+
 def _hybrid_searcher(verifier, fallback_batch: int):
     """Per-verifier HybridSearch (fast per-period kernel + scan-kernel
     fallback, ops/progpow_search.HybridSearch), created once and cached
     on the verifier so the background-compiled kernels survive across
-    mining slices."""
-    searcher = getattr(verifier, "_hybrid_search", None)
-    if searcher is None or searcher.fallback_batch != fallback_batch:
-        from ..ops.progpow_search import HybridSearch
+    mining slices.  The check-then-set runs under a lock: concurrent
+    miner workers and generatetoaddress_tpu share one verifier, and a
+    duplicated HybridSearch would duplicate its per-period compiles."""
+    with _hybrid_lock:
+        searcher = getattr(verifier, "_hybrid_search", None)
+        if searcher is None or searcher.fallback_batch != fallback_batch:
+            from ..ops.progpow_search import HybridSearch
 
-        searcher = HybridSearch(verifier, fallback_batch=fallback_batch)
-        verifier._hybrid_search = searcher
-    return searcher
+            searcher = HybridSearch(verifier, fallback_batch=fallback_batch)
+            verifier._hybrid_search = searcher
+        return searcher
 
 
 def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
                    kawpow_verifier=None, batch: int = 2048,
-                   on_progress=None) -> bool:
+                   on_progress=None, start_nonce: int = 0) -> bool:
     """Accelerated nonce search by era (the reference's live-era analogue
     is the external GPU miner via getblocktemplate).
 
@@ -234,7 +240,7 @@ def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
             return mine_block_cpu(block, schedule, max_tries=max_batches * 64)
         header_hash = block.header.kawpow_header_hash(schedule)[::-1]
         searcher = _hybrid_searcher(kawpow_verifier, batch)
-        start = 0
+        start = start_nonce
         for _ in range(max_batches):
             found, width = searcher.search_window(
                 header_hash, block.header.height, target, start

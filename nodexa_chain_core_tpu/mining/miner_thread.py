@@ -70,17 +70,19 @@ class BackgroundMiner:
         kid = wallet.get_keyid_for_mining()
         return p2pkh_script(KeyID(kid)).raw if kid else None
 
-    def _search_slice(self, block) -> bool:
+    def _search_slice(self, block):
         """One nonce slice, era-aware: the TPU batched KawPow search when a
         device slab is ready (ref the external GPU miners driving the live
         era), else the native CPU scans (ref GenerateClores' inner loop).
 
         Device windows vary in width (the hybrid searcher jumps from 2k
         to 32k nonces once a period's fast kernel lands), so the device
-        path reports its actual coverage through on_progress and the
-        slice stops once ~SLICE_TRIES nonces are covered — keeping both
-        the hashrate accounting and the template-staleness recheck
-        cadence honest."""
+        path resumes each window at the covered-so-far nonce, reports
+        its actual coverage, and the slice stops once ~SLICE_TRIES
+        nonces are covered — keeping the nonce walk, the hashrate
+        accounting, and the template-staleness recheck cadence honest.
+        Returns (found, nonces_covered) — per call, never on self (the
+        worker threads share this object)."""
         from .assembler import kawpow_verifier_for, mine_block_tpu
 
         verifier = kawpow_verifier_for(self.node, block)
@@ -95,14 +97,17 @@ class BackgroundMiner:
                 found = mine_block_tpu(
                     block, self.node.params.algo_schedule, max_batches=1,
                     kawpow_verifier=verifier, on_progress=on_progress,
+                    start_nonce=covered[0],
                 )
                 if found:
                     break
-            self._slice_covered = covered[0]
-            return found
-        self._slice_covered = SLICE_TRIES
-        return mine_block_cpu(
-            block, self.node.params.algo_schedule, max_tries=SLICE_TRIES
+            return found, covered[0]
+        return (
+            mine_block_cpu(
+                block, self.node.params.algo_schedule,
+                max_tries=SLICE_TRIES,
+            ),
+            SLICE_TRIES,
         )
 
     def _count(self, n: int) -> None:
@@ -141,8 +146,7 @@ class BackgroundMiner:
                 extra += 1
                 asm = BlockAssembler(node.chainstate)
                 block = asm.create_new_block(spk, extra_nonce=extra)
-                found = self._search_slice(block)
-                covered = getattr(self, "_slice_covered", SLICE_TRIES)
+                found, covered = self._search_slice(block)
                 self._count(covered if not found else max(covered // 2, 1))
                 if self._stop.is_set():
                     return

@@ -11,7 +11,7 @@ from nodexa_chain_core_tpu.node.chainparams import (
     main_params,
     regtest_params,
     select_params,
-    test_params,
+    test_params as _testnet_params,  # aliased: pytest must not collect the factory
 )
 from nodexa_chain_core_tpu.primitives.block import BlockHeader
 
@@ -24,7 +24,7 @@ def test_genesis_pinned_hashes():
     assert check_proof_of_work(
         g.header.get_hash(mp.algo_schedule), mp.genesis_bits, mp.consensus
     )
-    tp = test_params()
+    tp = _testnet_params()
     assert tp.genesis.header.get_hash(tp.algo_schedule) != g.header.get_hash(
         mp.algo_schedule
     )

@@ -186,7 +186,7 @@ def test_mining_continues_across_epoch_switch(setup, monkeypatch):
         blk = asm.create_new_block(
             spk.raw, ntime=params.genesis_time + 60 * height
         )
-        assert miner._search_slice(blk), f"no winner at height {height}"
+        assert miner._search_slice(blk)[0], f"no winner at height {height}"
         cs.process_new_block(blk)
         assert cs.tip().height == height
 

@@ -138,3 +138,74 @@ def test_miner_routes_through_hybrid(epoch, monkeypatch):
         Blk(), Sched(), max_batches=3, kawpow_verifier=verifier, batch=64
     )
     assert calls == [(0, 64), (64, 64), (128, 64)]
+
+    # start_nonce resumes a walk (the miner-thread slice loop calls with
+    # max_batches=1 and the covered-so-far count — each call must pick
+    # up where the last stopped, not re-search [0, width))
+    calls.clear()
+    assert not assembler.mine_block_tpu(
+        Blk(), Sched(), max_batches=2, kawpow_verifier=verifier, batch=64,
+        start_nonce=640,
+    )
+    assert calls == [(640, 64), (704, 64)]
+
+
+def test_miner_slice_advances_nonce_walk(epoch, monkeypatch):
+    """The BackgroundMiner slice loop must cover DISTINCT windows of one
+    template (regression: a max_batches=1 loop that restarted at nonce 0
+    re-searched the same window ~24x per slice)."""
+    from types import SimpleNamespace
+
+    from nodexa_chain_core_tpu.mining import assembler, miner_thread
+    from nodexa_chain_core_tpu.mining.miner_thread import BackgroundMiner
+
+    l1, dag = epoch
+    verifier = pj.BatchVerifier(l1, dag)
+    starts = []
+
+    class SpyHybrid:
+        fallback_batch = 2048
+
+        def search_window(self, header_hash, height, target, start_nonce=0):
+            starts.append(start_nonce)
+            return None, 2048
+
+    monkeypatch.setattr(
+        assembler, "_hybrid_searcher", lambda v, fb: SpyHybrid()
+    )
+    monkeypatch.setattr(miner_thread, "SLICE_TRIES", 8192)
+
+    class Mgr:
+        def verifier(self, epoch):
+            return verifier
+
+    class Hdr:
+        height = 50
+        time = 10**9
+        bits = 0x207FFFFF
+        nonce64 = 0
+        mix_hash = 0
+        _cached_hash = None
+
+        def kawpow_header_hash(self, schedule):
+            return bytes(32)
+
+    class Blk:
+        header = Hdr()
+
+    class Sched:
+        def era_algo(self, t):
+            return "kawpow"
+
+        def is_kawpow(self, t):
+            return True
+
+    node = SimpleNamespace(
+        params=SimpleNamespace(algo_schedule=Sched()),
+        epoch_manager=Mgr(),
+        chainstate=None,
+    )
+    miner = BackgroundMiner(node)
+    found, covered = miner._search_slice(Blk())
+    assert not found and covered == 8192
+    assert starts == [0, 2048, 4096, 6144]  # distinct advancing windows

@@ -122,7 +122,7 @@ def test_background_miner_dispatches_tpu(setup, monkeypatch):
     miner = BackgroundMiner(node)
     asm = BlockAssembler(cs)
     blk = asm.create_new_block(spk.raw, ntime=params.genesis_time + 60)
-    assert miner._search_slice(blk)
+    assert miner._search_slice(blk)[0]
     assert mgr.asked == [0], "device search was not consulted"
     cs.process_new_block(blk)
     assert cs.tip().height == 1
