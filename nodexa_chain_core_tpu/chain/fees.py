@@ -273,8 +273,16 @@ class TxConfirmStats:
         scale = int(data["scale"])
         if scale < 1:
             raise ValueError("corrupt estimates data: scale must be >= 1")
-        self.decay = float(data["decay"])
-        self.scale = scale
+        # the unconfirmed-tx ring and period math are sized by the
+        # constructor's constants; adopting a foreign scale/decay would
+        # desynchronize them (the reference's Read rejects mismatches,
+        # ref policy/fees.cpp TxConfirmStats::Read)
+        if scale != self.scale or float(data["decay"]) != self.decay:
+            raise ValueError(
+                "estimates data scale/decay mismatch: "
+                f"file ({scale}, {data['decay']}) != "
+                f"expected ({self.scale}, {self.decay})"
+            )
         self.conf_avg = conf
         self.fail_avg = fail
         self.avg = avg

@@ -4,45 +4,72 @@ Role parity with the reference's LevelDB wrapper (ref src/dbwrapper.{h,cpp}
 CDBWrapper over vendored src/leveldb/): atomic batched writes, prefix
 iteration, crash consistency, and a disk-resident working set.
 
-Design: a single-level LSM —
+Design: a tiered LSM (two levels, the same role leveled compaction plays
+in the reference's LevelDB — ref src/leveldb/db/version_set.cc compaction
+picking — sized down to this node's working set):
 
 - **WAL**: every batch appends CRC'd records + a commit marker; torn or
   corrupt tails are discarded on recovery (ref leveldb log_format).
 - **Memtable**: the WAL's contents live in a dict (value or tombstone)
-  until compaction.
-- **Snapshot**: a sorted, block-structured table on disk.  Blocks are
-  ~64 KiB, CRC'd; RAM holds only a sparse index (first key + offset per
-  block) and a small LRU block cache, so the full key space does NOT
-  live in process memory (the r3 design's all-RAM table was its scale
-  ceiling).
-- **Compaction**: streaming merge of the snapshot with the sorted
-  memtable into a new snapshot — peak memory is one block + the
-  memtable, never the whole table.
+  until flushed.
+- **L0 segments**: when the WAL crosses the threshold the memtable is
+  flushed to a NEW sorted segment file — an O(memtable) write, never a
+  rewrite of the whole store.  Segments keep tombstones so they shadow
+  older levels.  Reads consult memtable, then segments newest-first,
+  then the base.
+- **L1 base**: one big sorted table.  A *major* compaction (streaming
+  k-way merge of base + all segments, tombstones dropped) runs only when
+  the L0 tier has grown to a fixed fraction of the base — so its O(total)
+  cost is amortized: per-batch write cost stays flat as the store grows.
+- All tables are block-structured: ~64 KiB CRC'd blocks, RAM holds only
+  a sparse index (first key + offset per block) and a small LRU block
+  cache, so the full key space does NOT live in process memory.
+
+Concurrency: writers (write_batch/compact) are serialized by an internal
+lock; readers are lock-free against the writer — they load the
+(tables, memtable) state tuple once per operation and block fetches use
+os.pread (atomic at the syscall level, no shared seek pointer).  The
+block cache has its own small mutex.
 
 Capacity envelope is measured by tools/kvstore_soak.py and documented in
-README (10 M coins: RSS and compaction time).
+README (10 M / 30 M coins: RSS, flush and major-compaction cost).
 """
 
 from __future__ import annotations
 
 import os
+import re
 import struct
+import threading
 import zlib
 from bisect import bisect_right
-from collections import OrderedDict
+from heapq import merge as _heap_merge
 from typing import Dict, Iterator, List, Optional, Tuple
 
 _MAGIC_V1 = b"NXKV"  # r3 full-table snapshot (read-supported for upgrade)
-_MAGIC_V2 = b"NXK2"  # block-structured snapshot
-_FOOTER = b"NXKF"
+_MAGIC_V2 = b"NXK2"  # r4 block-structured snapshot (read-supported)
+_MAGIC_V3 = b"NXK3"  # block-structured table with per-record tombstones
 _REC_PUT = 1
 _REC_DEL = 2
 _REC_COMMIT = 3
 
 _BLOCK_TARGET = 64 * 1024
-_BLOCK_CACHE_BLOCKS = 256  # ~16 MiB hot-block cache
+# hot-block cache budget: base table ~16 MiB, each L0 segment ~2 MiB
+# (worst case with _MAX_SEGMENTS live: ~36 MiB of decoded blocks)
+_BLOCK_CACHE_BLOCKS = 256
+_SEG_CACHE_BLOCKS = 32
 
-_TOMBSTONE = None
+# L0 -> L1 major-compaction policy: merge when the segment tier exceeds
+# this fraction of the base, or segment count risks read fan-out.
+_MAJOR_RATIO = 0.25
+_MAJOR_MIN_BYTES = 4 << 20
+_MAX_SEGMENTS = 10
+
+_TOMBSTONE = None  # memtable deletion marker
+_TOMB = object()   # table-record deletion marker (distinct from "absent")
+_MISS = object()
+
+_SEG_RE = re.compile(r"^seg_(\d{8})\.dat$")
 
 
 class KVError(Exception):
@@ -64,17 +91,22 @@ class WriteBatch:
         return self
 
 
-def _pack_block(items: List[Tuple[bytes, bytes]]) -> bytes:
+def _pack_block(items: List[Tuple[bytes, object]]) -> bytes:
+    """V3 block: records carry a tombstone flag."""
     parts = [struct.pack("<I", len(items))]
     for k, v in items:
-        parts.append(struct.pack("<II", len(k), len(v)))
-        parts.append(k)
-        parts.append(v)
+        if v is _TOMB:
+            parts.append(struct.pack("<BII", 1, len(k), 0))
+            parts.append(k)
+        else:
+            parts.append(struct.pack("<BII", 0, len(k), len(v)))
+            parts.append(k)
+            parts.append(v)
     body = b"".join(parts)
     return body + struct.pack("<I", zlib.crc32(body))
 
 
-def _unpack_block(data: bytes) -> List[Tuple[bytes, bytes]]:
+def _unpack_block(data: bytes, v3: bool) -> List[Tuple[bytes, object]]:
     if len(data) < 8:
         raise KVError("short block")
     body, (crc,) = data[:-4], struct.unpack_from("<I", data, len(data) - 4)
@@ -82,26 +114,40 @@ def _unpack_block(data: bytes) -> List[Tuple[bytes, bytes]]:
         raise KVError("block crc mismatch")
     (count,) = struct.unpack_from("<I", body, 0)
     i = 4
-    out = []
+    out: List[Tuple[bytes, object]] = []
     for _ in range(count):
-        klen, vlen = struct.unpack_from("<II", body, i)
-        i += 8
-        out.append((body[i : i + klen], body[i + klen : i + klen + vlen]))
+        if v3:
+            flag, klen, vlen = struct.unpack_from("<BII", body, i)
+            i += 9
+        else:
+            flag = 0
+            klen, vlen = struct.unpack_from("<II", body, i)
+            i += 8
+        k = body[i : i + klen]
+        v = _TOMB if flag else body[i + klen : i + klen + vlen]
+        out.append((k, v))
         i += klen + vlen
     return out
 
 
-class _Snapshot:
-    """Read side of one block-structured snapshot file."""
+class _Table:
+    """Read side of one block-structured table file (segment or base)."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, cache_blocks: int = _BLOCK_CACHE_BLOCKS
+                 ) -> None:
         self.path = path
         self.first_keys: List[bytes] = []
         self.offsets: List[Tuple[int, int]] = []  # (offset, length)
         self.count = 0
+        self.size_bytes = 0
         self._file = None
+        self._fd = -1
+        self._v3 = True
         # block index -> (sorted record list, lazily-built lookup dict)
-        self._cache: OrderedDict[int, list] = OrderedDict()
+        self._cache: "dict[int, list]" = {}
+        self._cache_order: List[int] = []
+        self._cache_blocks = cache_blocks
+        self._cache_lock = threading.Lock()
         if os.path.exists(path):
             self._open()
 
@@ -117,7 +163,9 @@ class _Snapshot:
         if magic == _MAGIC_V1:
             f.close()
             raise _LegacySnapshot(self.path)
-        if magic != _MAGIC_V2:
+        if magic == _MAGIC_V2:
+            self._v3 = False
+        elif magic != _MAGIC_V3:
             raise KVError("bad snapshot magic")
         f.seek(size - 20)
         footer = f.read(20)
@@ -134,25 +182,37 @@ class _Snapshot:
             self.offsets.append((off, length))
             i += klen
         self.count = count
+        self.size_bytes = size
         self._file = f
+        self._fd = f.fileno()
 
     def _entry(self, bi: int) -> list:
-        ent = self._cache.get(bi)
-        if ent is not None:
-            self._cache.move_to_end(bi)
-            return ent
+        with self._cache_lock:
+            ent = self._cache.get(bi)
+            if ent is not None:
+                # LRU touch
+                self._cache_order.remove(bi)
+                self._cache_order.append(bi)
+                return ent
         off, length = self.offsets[bi]
-        self._file.seek(off)
-        ent = [_unpack_block(self._file.read(length)), None]
-        self._cache[bi] = ent
-        while len(self._cache) > _BLOCK_CACHE_BLOCKS:
-            self._cache.popitem(last=False)
+        # pread: atomic offset read, safe across concurrent readers
+        data = os.pread(self._fd, length, off)
+        ent = [_unpack_block(data, self._v3), None]
+        with self._cache_lock:
+            cached = self._cache.get(bi)
+            if cached is not None:
+                return cached
+            self._cache[bi] = ent
+            self._cache_order.append(bi)
+            while len(self._cache_order) > self._cache_blocks:
+                self._cache.pop(self._cache_order.pop(0), None)
         return ent
 
-    def block(self, bi: int) -> List[Tuple[bytes, bytes]]:
+    def block(self, bi: int) -> List[Tuple[bytes, object]]:
         return self._entry(bi)[0]
 
-    def get(self, key: bytes) -> Optional[bytes]:
+    def get(self, key: bytes) -> object:
+        """value bytes, _TOMB, or None (absent)."""
         if not self.first_keys:
             return None
         bi = bisect_right(self.first_keys, key) - 1
@@ -163,7 +223,7 @@ class _Snapshot:
             ent[1] = dict(ent[0])
         return ent[1].get(key)
 
-    def iterate_from(self, start_key: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    def iterate_from(self, start_key: bytes) -> Iterator[Tuple[bytes, object]]:
         if not self.first_keys:
             return
         bi = max(bisect_right(self.first_keys, start_key) - 1, 0)
@@ -172,7 +232,7 @@ class _Snapshot:
                 if k >= start_key:
                     yield k, v
 
-    def iterate(self) -> Iterator[Tuple[bytes, bytes]]:
+    def iterate(self) -> Iterator[Tuple[bytes, object]]:
         for b in range(len(self.offsets)):
             yield from self.block(b)
 
@@ -180,7 +240,10 @@ class _Snapshot:
         if self._file is not None:
             self._file.close()
             self._file = None
-        self._cache.clear()
+            self._fd = -1
+        with self._cache_lock:
+            self._cache.clear()
+            self._cache_order.clear()
 
 
 class _LegacySnapshot(Exception):
@@ -190,14 +253,15 @@ class _LegacySnapshot(Exception):
         self.path = path
 
 
-def _write_snapshot(path: str, items: Iterator[Tuple[bytes, bytes]]) -> int:
-    """Stream sorted items into a block-structured snapshot; returns count."""
+def _write_table(path: str, items: Iterator[Tuple[bytes, object]]) -> int:
+    """Stream sorted (key, value-or-_TOMB) items into a table; returns
+    the record count."""
     tmp = path + ".tmp"
     count = 0
     index: List[Tuple[bytes, int, int]] = []
     with open(tmp, "wb") as f:
-        f.write(_MAGIC_V2)
-        cur: List[Tuple[bytes, bytes]] = []
+        f.write(_MAGIC_V3)
+        cur: List[Tuple[bytes, object]] = []
         cur_size = 0
 
         def flush_block():
@@ -212,7 +276,7 @@ def _write_snapshot(path: str, items: Iterator[Tuple[bytes, bytes]]) -> int:
 
         for k, v in items:
             cur.append((k, v))
-            cur_size += len(k) + len(v) + 8
+            cur_size += len(k) + (0 if v is _TOMB else len(v)) + 9
             count += 1
             if cur_size >= _BLOCK_TARGET:
                 flush_block()
@@ -231,48 +295,94 @@ def _write_snapshot(path: str, items: Iterator[Tuple[bytes, bytes]]) -> int:
     return count
 
 
+def _merge_tables(
+    sources: List[Iterator[Tuple[bytes, object]]],
+    drop_tombstones: bool,
+) -> Iterator[Tuple[bytes, object]]:
+    """K-way merge, sources ordered newest-first; newest wins per key."""
+    def _tag(src, pri):
+        return ((k, pri, v) for k, v in src)
+
+    tagged = [_tag(src, pri) for pri, src in enumerate(sources)]
+    last_key: Optional[bytes] = None
+    for k, _pri, v in _heap_merge(*tagged):
+        if k == last_key:
+            continue  # an older source's value for a key already emitted
+        last_key = k
+        if v is _TOMB and drop_tombstones:
+            continue
+        yield k, v
+
+
 class KVStore:
     """get/put/delete/batch/prefix-scan store. path=None => memory only."""
 
     def __init__(self, path: Optional[str] = None,
                  compact_threshold: int = 1 << 24):
-        # (snapshot, memtable) swapped as ONE tuple: readers (get /
+        # (tables, memtable) swapped as ONE tuple: readers (get /
         # in-flight iterate generators on RPC threads) load it once and
-        # keep a consistent pair even if a compaction swaps mid-scan.
-        # The superseded _Snapshot is not closed eagerly — its file
-        # handle lives until the last reader drops it (refcount).
-        self._state: Tuple[Optional[_Snapshot], Dict[bytes, Optional[bytes]]]
-        self._state = (None, {})
+        # keep a consistent view even if a flush/compaction swaps it
+        # mid-scan.  tables = (seg_newest, ..., seg_oldest, base).
+        # Superseded _Table objects are not closed eagerly — their file
+        # handles live until the last reader drops them (refcount).
+        self._state: Tuple[Tuple[_Table, ...], Dict[bytes, Optional[bytes]]]
+        self._state = ((), {})
         self._path = path
         self._log = None
         self._log_size = 0
         self._compact_threshold = compact_threshold
+        self._write_lock = threading.RLock()
+        self._seg_counter = 0
         if path is not None:
             os.makedirs(path, exist_ok=True)
-            self._snapshot_path = os.path.join(path, "snapshot.dat")
+            self._base_path = os.path.join(path, "snapshot.dat")
             self._log_path = os.path.join(path, "wal.dat")
             self._load()
             self._log = open(self._log_path, "ab")
             self._log_size = self._log.tell()
 
-    # -- recovery ---------------------------------------------------------
+    # -- introspection (tests / tools) ------------------------------------
 
     @property
-    def _snap(self) -> Optional[_Snapshot]:
-        return self._state[0]
+    def _snap(self) -> Optional[_Table]:
+        """The L1 base table (None before the first flush)."""
+        tables = self._state[0]
+        return tables[-1] if tables else None
+
+    @property
+    def _segments(self) -> Tuple[_Table, ...]:
+        """L0 segments, newest first."""
+        tables = self._state[0]
+        return tables[:-1] if tables else ()
 
     @property
     def _mem(self) -> Dict[bytes, Optional[bytes]]:
         return self._state[1]
 
+    # -- recovery ---------------------------------------------------------
+
+    def _seg_path(self, n: int) -> str:
+        return os.path.join(self._path, "seg_%08d.dat" % n)
+
     def _load(self) -> None:
-        snap, mem = None, {}
+        tables: List[_Table] = []
+        mem: Dict[bytes, Optional[bytes]] = {}
+        seg_nums = []
+        for name in os.listdir(self._path):
+            m = _SEG_RE.match(name)
+            if m:
+                seg_nums.append(int(m.group(1)))
+        for n in sorted(seg_nums, reverse=True):  # newest first
+            tables.append(_Table(self._seg_path(n), _SEG_CACHE_BLOCKS))
+        self._seg_counter = max(seg_nums, default=0)
         try:
-            snap = _Snapshot(self._snapshot_path)
+            base = _Table(self._base_path)
+            if base.size_bytes or not tables:
+                tables.append(base)
         except _LegacySnapshot:
             # r3 full-table format: pull into the memtable; the next
             # compaction rewrites it block-structured
-            with open(self._snapshot_path, "rb") as f:
+            with open(self._base_path, "rb") as f:
                 data = f.read()
             i = 4
             (count,) = struct.unpack_from("<Q", data, i)
@@ -282,6 +392,7 @@ class KVStore:
                 i += 8
                 mem[data[i : i + klen]] = data[i + klen : i + klen + vlen]
                 i += klen + vlen
+            tables.append(_Table(self._base_path + ".absent"))
         # replay WAL; torn trailing records are discarded
         if os.path.exists(self._log_path):
             with open(self._log_path, "rb") as f:
@@ -306,7 +417,7 @@ class KVStore:
                     break  # corruption: stop replay here
                 pending.append((rec_type, k, v))
                 i = j + klen + vlen + 4
-        self._state = (snap, mem)
+        self._state = (tuple(tables), mem)
 
     # -- writes -----------------------------------------------------------
 
@@ -318,18 +429,22 @@ class KVStore:
         self._log_size += len(body) + 4
 
     def write_batch(self, batch: WriteBatch, sync: bool = False) -> None:
-        if self._log is not None:
+        with self._write_lock:
+            if self._log is not None:
+                for t, k, v in batch.ops:
+                    self._append_record(t, k, v)
+                self._log.write(struct.pack("<BII", _REC_COMMIT, 0, 0))
+                self._log_size += 9
+                self._log.flush()
+                if sync:
+                    os.fsync(self._log.fileno())
+            mem = self._mem
             for t, k, v in batch.ops:
-                self._append_record(t, k, v)
-            self._log.write(struct.pack("<BII", _REC_COMMIT, 0, 0))
-            self._log_size += 9
-            self._log.flush()
-            if sync:
-                os.fsync(self._log.fileno())
-        for t, k, v in batch.ops:
-            self._mem[k] = v if t == _REC_PUT else _TOMBSTONE
-        if self._log is not None and self._log_size > self._compact_threshold:
-            self.compact()
+                mem[k] = v if t == _REC_PUT else _TOMBSTONE
+            if (self._log is not None
+                    and self._log_size > self._compact_threshold):
+                self.flush()
+                self._maybe_major()
 
     def put(self, key: bytes, value: bytes) -> None:
         self.write_batch(WriteBatch().put(key, value))
@@ -341,11 +456,16 @@ class KVStore:
 
     def get(self, key: bytes) -> Optional[bytes]:
         key = bytes(key)
-        snap, mem = self._state
-        if key in mem:
-            return mem[key]
-        if snap is not None:
-            return snap.get(key)
+        tables, mem = self._state
+        v = mem.get(key, _MISS)
+        if v is not _MISS:
+            return v  # value or tombstone(None)
+        for t in tables:
+            v = t.get(key)
+            if v is _TOMB:
+                return None
+            if v is not None:
+                return v
         return None
 
     def exists(self, key: bytes) -> bool:
@@ -353,36 +473,26 @@ class KVStore:
 
     def iterate(self, prefix: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
         """Sorted prefix scan (ref CDBIterator Seek/Next): streaming merge
-        of the snapshot blocks with the sorted memtable."""
+        of the table levels with the sorted memtable."""
         yield from self._merged(start_key=prefix, prefix=prefix)
 
     def _merged(self, start_key: bytes = b"", prefix: Optional[bytes] = None
                 ) -> Iterator[Tuple[bytes, bytes]]:
-        snap, mem = self._state  # one consistent pair for the whole scan
-        mem_keys = sorted(k for k in mem if k >= start_key)
-        mi = 0
-        snap_it = (
-            snap.iterate_from(start_key)
-            if snap is not None and start_key
-            else snap.iterate()
-            if snap is not None
-            else iter(())
-        )
-        snap_item = next(snap_it, None)
-        while mi < len(mem_keys) or snap_item is not None:
-            if snap_item is not None and (
-                mi >= len(mem_keys) or snap_item[0] < mem_keys[mi]
-            ):
-                k, v = snap_item
-                snap_item = next(snap_it, None)
-            else:
-                k = mem_keys[mi]
-                v = mem[k]
-                mi += 1
-                if snap_item is not None and snap_item[0] == k:
-                    snap_item = next(snap_it, None)  # memtable shadows
-                if v is _TOMBSTONE:
-                    continue
+        tables, mem = self._state  # one consistent view for the whole scan
+        # dict(mem) is a single C-level op (atomic under the GIL), so the
+        # copy cannot observe a concurrent writer mid-insert; the sort
+        # then runs over a private snapshot.
+        mem_copy = dict(mem)
+        mem_items: Iterator[Tuple[bytes, object]] = iter(sorted(
+            (k, _TOMB if v is _TOMBSTONE else v)
+            for k, v in mem_copy.items() if k >= start_key
+        ))
+        sources = [mem_items]
+        for t in tables:
+            sources.append(
+                t.iterate_from(start_key) if start_key else t.iterate()
+            )
+        for k, v in _merge_tables(sources, drop_tombstones=True):
             if prefix and not k.startswith(prefix):
                 if k > prefix:
                     return  # sorted: past the prefix range, nothing more
@@ -390,32 +500,90 @@ class KVStore:
             yield k, v
 
     def __len__(self) -> int:
-        n = sum(1 for _ in self._merged())
-        return n
+        return sum(1 for _ in self._merged())
 
     # -- maintenance -------------------------------------------------------
 
-    def compact(self) -> None:
-        """Streaming merge memtable + snapshot -> new snapshot; reset WAL.
+    def flush(self) -> None:
+        """Minor compaction: memtable -> new L0 segment; reset WAL.
 
-        The old (snapshot, memtable) pair is swapped out, not mutated:
-        in-flight readers finish their scan against the superseded pair
-        (its deleted-inode file handle stays valid until dropped)."""
-        if self._path is None:
-            return
-        count = _write_snapshot(self._snapshot_path, self._merged())
-        new_snap = _Snapshot(self._snapshot_path)
-        assert new_snap.count == count
-        self._state = (new_snap, {})
+        O(memtable) — the base is never rewritten here.  The first flush
+        of an empty store becomes the base directly."""
+        with self._write_lock:
+            if self._path is None or not self._mem:
+                return
+            tables, mem = self._state
+            items = sorted(
+                (k, _TOMB if v is _TOMBSTONE else v) for k, v in mem.items()
+            )
+            base = tables[-1] if tables else None
+            if base is None or base.count == 0 and len(tables) == 1:
+                # empty base: promote this flush to the base, dropping
+                # tombstones (there is nothing older to shadow)
+                _write_table(
+                    self._base_path,
+                    iter((k, v) for k, v in items if v is not _TOMB),
+                )
+                new = _Table(self._base_path)
+                self._state = ((new,), {})
+            else:
+                self._seg_counter += 1
+                path = self._seg_path(self._seg_counter)
+                _write_table(path, iter(items))
+                self._state = (
+                    (_Table(path, _SEG_CACHE_BLOCKS),) + tables, {})
+            self._reset_wal()
+
+    def _reset_wal(self) -> None:
         self._log.close()
         self._log = open(self._log_path, "wb")
         self._log_size = 0
 
+    def _maybe_major(self) -> None:
+        """Run a major compaction when L0 outgrows the policy bounds."""
+        tables = self._state[0]
+        segs = tables[:-1]
+        if not segs:
+            return
+        base = tables[-1]
+        seg_bytes = sum(t.size_bytes for t in segs)
+        if (len(segs) >= _MAX_SEGMENTS
+                or seg_bytes >= max(_MAJOR_MIN_BYTES,
+                                    base.size_bytes * _MAJOR_RATIO)):
+            self.compact()
+
+    def compact(self) -> None:
+        """Major compaction: streaming merge of memtable + all levels into
+        a fresh base; segments deleted; WAL reset.
+
+        The old (tables, memtable) state is swapped out, not mutated:
+        in-flight readers finish their scan against the superseded tables
+        (deleted-inode file handles stay valid until dropped)."""
+        with self._write_lock:
+            if self._path is None:
+                return
+            old_tables, _ = self._state
+            count = _write_table(
+                self._base_path,
+                ((k, v) for k, v in self._merged()),
+            )
+            new_base = _Table(self._base_path)
+            assert new_base.count == count
+            self._state = ((new_base,), {})
+            # unlink oldest-first: a crash mid-loop must leave only the
+            # NEWEST segments, whose data the merged base already holds
+            # and which shadow it consistently; newest-first deletion
+            # would let an older segment serve stale/resurrected keys
+            for t in reversed(old_tables):
+                if t.path != self._base_path and os.path.exists(t.path):
+                    os.unlink(t.path)
+            self._reset_wal()
+
     def close(self) -> None:
         if self._log is not None:
             if self._mem:
-                self.compact()
+                self.flush()
             self._log.close()
             self._log = None
-        if self._snap is not None:
-            self._snap.close()
+        for t in self._state[0]:
+            t.close()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import List, Optional, Sequence
 
 _MASK32 = 0xFFFFFFFF
@@ -115,6 +116,10 @@ class FastRandomContext:
         self.bytebuf = b""
         self.bitbuf = 0
         self.bitbuf_size = 0
+        # draws mutate buffer state; instances are shared across threads
+        # (net_processing message handlers + connman maintenance), so
+        # each draw is atomic under this lock
+        self._lock = threading.Lock()
         if seed is not None:
             self.rng.set_key(seed[:32].ljust(32, b"\x00"))
             self.requires_seed = False
@@ -133,25 +138,30 @@ class FastRandomContext:
             self._seed()
         self.bytebuf = self.rng.keystream(256)
 
-    def rand64(self) -> int:
+    def _rand64(self) -> int:
         if len(self.bytebuf) < 8:
             self._fill_byte_buffer()
         ret = struct.unpack("<Q", self.bytebuf[:8])[0]
         self.bytebuf = self.bytebuf[8:]
         return ret
 
+    def rand64(self) -> int:
+        with self._lock:
+            return self._rand64()
+
     def randbits(self, bits: int) -> int:
         if bits == 0:
             return 0
-        if bits > 32:
-            return self.rand64() >> (64 - bits)
-        if self.bitbuf_size < bits:
-            self.bitbuf = self.rand64()
-            self.bitbuf_size = 64
-        ret = self.bitbuf & ((1 << bits) - 1)
-        self.bitbuf >>= bits
-        self.bitbuf_size -= bits
-        return ret
+        with self._lock:
+            if bits > 32:
+                return self._rand64() >> (64 - bits)
+            if self.bitbuf_size < bits:
+                self.bitbuf = self._rand64()
+                self.bitbuf_size = 64
+            ret = self.bitbuf & ((1 << bits) - 1)
+            self.bitbuf >>= bits
+            self.bitbuf_size -= bits
+            return ret
 
     def randrange(self, rng: int) -> int:
         """Uniform in [0, rng) by rejection (ref random.h:106)."""
@@ -165,9 +175,10 @@ class FastRandomContext:
                 return ret
 
     def randbytes(self, n: int) -> bytes:
-        if self.requires_seed:
-            self._seed()
-        return self.rng.keystream(n)
+        with self._lock:
+            if self.requires_seed:
+                self._seed()
+            return self.rng.keystream(n)
 
     def rand32(self) -> int:
         return self.randbits(32)

@@ -447,6 +447,7 @@ class SearchKernel:
         self.l1 = jnp.asarray(l1, dtype=_U32)
         self.dag = jnp.asarray(dag, dtype=_U32)
         self._jit_cache: dict = {}
+        self._pinned: set = set()
         self._cache_lock = threading.Lock()
         self._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
@@ -466,11 +467,19 @@ class SearchKernel:
         obj.l1 = verifier.l1
         obj.dag = verifier.dag
         obj._jit_cache = {}
+        obj._pinned = set()
         obj._cache_lock = threading.Lock()
         obj._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
         )
         return obj
+
+    def pin(self, period: int, batch: int) -> None:
+        """Mark (period, batch) as the live-mining entry: eviction skips
+        it, so a readiness check on it stays true until the next pin
+        (the check-then-sweep race ADVICE r4 flagged)."""
+        with self._cache_lock:
+            self._pinned = {(period, batch)}
 
     def _fn(self, period: int, batch: int):
         # the lock serializes concurrent compiles (HybridSearch warms
@@ -491,9 +500,12 @@ class SearchKernel:
                 # need; real backends get the jit.
                 if jax.default_backend() != "cpu":
                     fn = jax.jit(fn)
-                while len(self._jit_cache) >= 4:  # cap VMEM: evict LRU,
-                    # never the active (most recently used) periods
-                    self._jit_cache.pop(next(iter(self._jit_cache)))
+                evictable = [
+                    k for k in self._jit_cache if k not in self._pinned
+                ]
+                while len(self._jit_cache) >= 4 and evictable:
+                    # cap VMEM: evict LRU, never the pinned live entry
+                    self._jit_cache.pop(evictable.pop(0))
             self._jit_cache[key] = fn  # re-insert = move to MRU
         return fn
 
@@ -612,6 +624,10 @@ class HybridSearch:
                 self.fallback_batch,
             )
         period = height // ref.PERIOD_LENGTH
+        # pin before the readiness check: once observed ready, the entry
+        # cannot be LRU-evicted by a background warm of a later period,
+        # so the sweep below never degrades into a synchronous compile
+        self.kern.pin(period, self.fast_batch)
         with self._lock:
             ready = self._period_ready(period)
             if not ready and period not in self._compiling:

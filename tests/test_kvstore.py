@@ -101,7 +101,7 @@ def test_legacy_v1_snapshot_upgrade(tmp_path):
     assert kv.get(b"a") == b"1" and kv.get(b"b") == b"2"
     kv.compact()
     with open(os.path.join(d, "snapshot.dat"), "rb") as f:
-        assert f.read(4) == b"NXK2"
+        assert f.read(4) == b"NXK3"
     assert kv.get(b"a") == b"1"
     kv.close()
 
@@ -126,4 +126,100 @@ def test_tombstone_shadows_snapshot(tmp_path):
     kv.compact()  # merge drops the pair entirely
     assert kv.get(b"x") is None
     assert kv._snap.count == 0
+    kv.close()
+
+
+def test_flush_creates_segments_not_base_rewrite(tmp_path):
+    kv = KVStore(str(tmp_path / "db"))
+    for i in range(100):
+        kv.put(b"a%03d" % i, b"x")
+    kv.flush()  # first flush promotes to base
+    assert kv._snap is not None and kv._snap.count == 100
+    assert kv._segments == ()
+    kv.put(b"b", b"y")
+    kv.delete(b"a000")
+    kv.flush()  # second flush -> L0 segment, base untouched
+    assert len(kv._segments) == 1
+    assert kv._snap.count == 100  # base not rewritten
+    assert kv.get(b"b") == b"y"
+    assert kv.get(b"a000") is None  # segment tombstone shadows base
+    assert kv.get(b"a001") == b"x"
+    kv.close()
+
+
+def test_reopen_with_segments(tmp_path):
+    kv = KVStore(str(tmp_path / "db"))
+    kv.put(b"k1", b"v1")
+    kv.flush()
+    kv.put(b"k2", b"v2")
+    kv.delete(b"k1")
+    kv.flush()
+    kv._log.close()
+    kv._log = None  # crash: skip close-flush
+    kv2 = KVStore(str(tmp_path / "db"))
+    assert len(kv2._segments) == 1
+    assert kv2.get(b"k1") is None
+    assert kv2.get(b"k2") == b"v2"
+    assert dict(kv2.iterate()) == {b"k2": b"v2"}
+    kv2.close()
+
+
+def test_major_compaction_collapses_segments(tmp_path):
+    kv = KVStore(str(tmp_path / "db"))
+    kv.put(b"base", b"1")
+    kv.flush()
+    for i in range(3):
+        kv.put(b"s%d" % i, b"v%d" % i)
+        kv.delete(b"base") if i == 2 else None
+        kv.flush()
+    assert len(kv._segments) == 3
+    kv.compact()
+    assert kv._segments == ()
+    assert kv.get(b"base") is None
+    assert kv.get(b"s1") == b"v1"
+    # segment files actually deleted
+    import os as _os
+    segs = [f for f in _os.listdir(str(tmp_path / "db"))
+            if f.startswith("seg_")]
+    assert segs == []
+    kv.close()
+
+
+def test_segment_count_triggers_major(tmp_path):
+    from nodexa_chain_core_tpu.chain import kvstore as kvmod
+    kv = KVStore(str(tmp_path / "db"), compact_threshold=64)
+    # tiny threshold: every put flushes; enough puts must eventually
+    # collapse the tier via the _MAX_SEGMENTS bound
+    for i in range(kvmod._MAX_SEGMENTS * 3):
+        kv.put(b"k%03d" % i, b"v" * 64)
+    assert len(kv._segments) < kvmod._MAX_SEGMENTS
+    assert len(kv) == kvmod._MAX_SEGMENTS * 3
+    kv.close()
+
+
+def test_concurrent_readers_during_writes(tmp_path):
+    import threading as _t
+    kv = KVStore(str(tmp_path / "db"), compact_threshold=1 << 12)
+    for i in range(2000):
+        kv.put(b"w%05d" % i, b"v%d" % i)
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(30):
+                assert kv.get(b"w00000") == b"v0"
+                n = sum(1 for _ in kv.iterate(b"w000"))
+                assert n >= 100
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [_t.Thread(target=reader) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for i in range(2000, 4000):
+        kv.put(b"w%05d" % i, b"v%d" % i)
+    for th in threads:
+        th.join()
+    assert errors == []
+    assert len(kv) == 4000
     kv.close()

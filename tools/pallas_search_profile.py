@@ -1,0 +1,203 @@
+"""Bisect where sweep time goes in the PALLAS per-period search path.
+
+Builds variants of ops/progpow_search._pallas_mix with pieces disabled
+(DAG row take, in-kernel L1 gathers, in-kernel math) and times each on
+the real device with a synthetic full-size slab, using the pipelined
+slope method (removes tunnel round-trip latency).
+
+Run: python tools/pallas_search_profile.py [--batch 32768]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nodexa_chain_core_tpu.ops import progpow_jax as pj
+from nodexa_chain_core_tpu.ops import progpow_search as ps
+
+LANES = ps.LANES
+REGS = ps.REGS
+ROUNDS = ps.ROUNDS
+_U32 = jnp.uint32
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _round_kernel_variant(l1_on: bool, math_on: bool,
+                          p_ref, regs_in_ref, l1_ref, epi_ref, out_ref):
+    """ps._round_kernel with the L1 gathers / math ops toggleable."""
+    from jax.experimental import pallas as pl
+
+    out_ref[...] = regs_in_ref[...]
+    tbl = l1_ref[...]
+    shape = (LANES, 128)
+
+    def reg_read(idx):
+        return out_ref[pl.ds(idx * LANES, LANES), :]
+
+    def reg_merge(dst, data, mop, rot):
+        cur = out_ref[pl.ds(dst * LANES, LANES), :]
+        out_ref[pl.ds(dst * LANES, LANES), :] = ps._merge_dyn(
+            cur, data, mop, rot, shape)
+
+    for i in range(max(ps.CACHE_ACCESSES, ps.MATH_OPS)):
+        if i < ps.CACHE_ACCESSES:
+            base = ps._PLAN_CACHE_BASE + 4 * i
+            off = reg_read(p_ref[base]) & _U32(ps.L1_WORDS - 1)
+            if l1_on:
+                data = ps._l1_gather32(tbl, off)
+            else:
+                data = off ^ _U32(0x9E3779B9)
+            reg_merge(p_ref[base + 1], data, p_ref[base + 2],
+                      p_ref[base + 3])
+        if i < ps.MATH_OPS:
+            base = ps._PLAN_MATH_BASE + 6 * i
+            a = reg_read(p_ref[base])
+            b = reg_read(p_ref[base + 1])
+            if math_on:
+                data = ps._math_dyn(a, b, p_ref[base + 2])
+            else:
+                data = a ^ b
+            reg_merge(p_ref[base + 3], data, p_ref[base + 4],
+                      p_ref[base + 5])
+    for i in range(4):
+        base = ps._PLAN_EPI_BASE + 3 * i
+        data = epi_ref[pl.ds(i * LANES, LANES), :]
+        reg_merge(p_ref[base], data, p_ref[base + 1], p_ref[base + 2])
+
+
+def make_sweep(period: int, batch: int, *, dag_on=True, l1_on=True,
+               math_on=True, kernel_on=True):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    plan = pj.build_period_plan(period)
+    plan_rows = ps._plan_rows(plan)
+    call = pl.pallas_call(
+        functools.partial(_round_kernel_variant, l1_on, math_on),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch // 128,),
+            in_specs=[
+                pl.BlockSpec((REGS * LANES, 128), lambda i, s: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((32, 128), lambda i, s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((4 * LANES, 128), lambda i, s: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((REGS * LANES, 128),
+                                   lambda i, s: (0, i),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((REGS * LANES, batch), _U32),
+        input_output_aliases={1: 0},
+    )
+
+    def sweep(header_words, base_lo, base_hi, target_words, l1, dag):
+        num_items = dag.shape[0]
+        i = jnp.arange(batch, dtype=_U32)
+        nlo = base_lo + i
+        nhi = base_hi + (nlo < base_lo).astype(_U32)
+        state = [jnp.broadcast_to(header_words[k], (batch,))
+                 for k in range(8)]
+        state += [nlo, nhi]
+        state += [jnp.full((batch,), w, _U32) for w in pj._ABSORB_PAD]
+        seed = pj.keccak_f800(state)
+        regs = ps._init_regs(seed[0], seed[1])
+        tbl32 = l1.reshape(32, 128)
+        stacked = jnp.concatenate(regs, axis=0)
+        for r in range(ROUNDS):
+            if dag_on:
+                item_index = jnp.mod(stacked[r % LANES], _U32(num_items))
+                item = jnp.take(dag, item_index.astype(jnp.int32), axis=0)
+            else:
+                item = jnp.broadcast_to(
+                    dag[0], (batch, 64)) ^ stacked[r % LANES][:, None]
+            perm = [((l ^ r) % LANES) * 4 + i for i in range(4)
+                    for l in range(LANES)]
+            epi = jnp.take(item.T, jnp.array(perm, jnp.int32), axis=0)
+            if kernel_on:
+                stacked = call(jnp.asarray(plan_rows[r]), stacked, tbl32, epi)
+            else:
+                stacked = stacked + epi.sum(axis=0, keepdims=True)
+        lane_hash = jnp.full((LANES, batch), pj.FNV_OFFSET, _U32)
+        for i in range(REGS):
+            lane_hash = pj._fnv1a(
+                lane_hash, stacked[i * LANES : (i + 1) * LANES])
+        words = [jnp.full((batch,), pj.FNV_OFFSET, _U32) for _ in range(8)]
+        for l in range(LANES):
+            words[l % 8] = pj._fnv1a(words[l % 8], lane_hash[l])
+        mix_words = jnp.stack(words, axis=-1)
+        final = pj._final_absorb(seed, mix_words)
+        ok = pj.digest_lte(final, target_words)
+        return jnp.any(ok), jnp.argmax(ok), final[0], mix_words[0]
+
+    return jax.jit(sweep)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32768)
+    ap.add_argument("--reps", type=int, default=5,
+                    help="pipelined sweeps per timing (min 2)")
+    args = ap.parse_args()
+    if args.reps < 2:
+        ap.error("--reps must be >= 2 (slope needs two timings)")
+    batch = args.batch
+    nrows = 1 << 22
+    rng = np.random.default_rng(7)
+    dag = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(nrows, 64), dtype=np.uint32))
+    l1 = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(4096,), dtype=np.uint32))
+    hw = jnp.asarray(rng.integers(0, 1 << 32, size=(8,), dtype=np.uint32))
+    tw = jnp.asarray(np.full(8, 0, np.uint32))
+
+    variants = [
+        ("full", dict()),
+        ("no_dag_take", dict(dag_on=False)),
+        ("no_l1_gather", dict(l1_on=False)),
+        ("no_math", dict(math_on=False)),
+        ("no_kernel", dict(kernel_on=False)),
+        ("dag_take_only", dict(kernel_on=False)),  # same as no_kernel
+    ]
+
+    def run_n(fn, n, salt):
+        t = time.perf_counter()
+        out = None
+        for k in range(n):
+            out = fn(hw, _U32(salt + k + 1), _U32(0), tw, l1, dag)
+        bool(out[0])
+        return time.perf_counter() - t
+
+    for name, kw in variants:
+        try:
+            fn = make_sweep(1234, batch, **kw)
+            t = time.perf_counter()
+            out = fn(hw, _U32(0), _U32(0), tw, l1, dag)
+            bool(out[0])
+            compile_s = time.perf_counter() - t
+            t1 = run_n(fn, 1, 100)
+            tn = run_n(fn, args.reps, 200)
+            dt = (tn - t1) / (args.reps - 1)
+            log(f"{name:>14}: {dt*1e3:9.1f} ms/sweep slope "
+                f"({batch/max(dt,1e-9):,.0f} H/s)  compile {compile_s:.0f}s")
+        except Exception as e:
+            log(f"{name:>14}: FAIL {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()
