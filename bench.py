@@ -242,8 +242,10 @@ def bench_kawpow(on_tpu: bool) -> dict:
     probe_final = int.from_bytes(fs[0][::-1], "little")
     t = time.perf_counter()
     hit = kern.sweep(header, height, probe_final, probe_nonce, batch)
+    compile_s = time.perf_counter() - t
+    out["kawpow_kernel_compile_s"] = round(compile_s, 1)
     log(f"[kawpow] search compile+first sweep "
-        f"{time.perf_counter()-t:.1f}s (batch {batch})")
+        f"{compile_s:.1f}s (batch {batch})")
     assert hit is not None and hit[0] == probe_nonce, "known-answer miss"
     assert hit[1] == probe_final, "known-answer final mismatch"
     assert hit[2] == int.from_bytes(ms[0][::-1], "little"), "mix mismatch"
@@ -300,6 +302,68 @@ def bench_kawpow(on_tpu: bool) -> dict:
     out["kawpow_verify_headers_per_s"] = round(verify_hs)
     log(f"[kawpow] verify: {verify_hs:,.0f} headers/s "
         f"({nverify}-header sync batches)")
+
+    if on_tpu and not os.environ.get("NODEXA_BENCH_SKIP_WARM"):
+        # persistent-cache warm restart (VERDICT r4 next #4): a restarted
+        # miner re-creating the SAME (period, batch, slab-shape) kernel
+        # must hit the on-disk executable cache instead of re-paying the
+        # ~20-30 s per-period compile.  The cache key is the HLO
+        # fingerprint, which is stable across runs of the same code path
+        # (a restart) but NOT across differently-shaped call sites — so
+        # the measurement runs the identical child twice: the first
+        # populates (or hits a prior round's entry), the second IS the
+        # restart.  Synthetic slab: the fingerprint covers shapes + the
+        # period-specialized constants, not slab contents.
+        import subprocess
+        child = (
+            "import sys, time, os; sys.path.insert(0, %r);\n"
+            "from nodexa_chain_core_tpu.utils.jitcache import "
+            "enable_persistent_cache\n"
+            "enable_persistent_cache(%r)\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "from nodexa_chain_core_tpu.ops.progpow_search import "
+            "SearchKernel\n"
+            "l1 = np.zeros(4096, np.uint32)\n"
+            "dag = np.zeros((%d, 64), np.uint32)\n"
+            "kern = SearchKernel(l1, dag)\n"
+            "jax.block_until_ready(kern.dag)\n"
+            "t = time.perf_counter()\n"
+            "kern.sweep(bytes(range(32)), %d, 1, 0, %d)\n"
+            "print('WARM_SWEEP_S', round(time.perf_counter() - t, 1))\n"
+        ) % (os.getcwd(), _JIT_CACHE_DIR, int(slab.shape[0]), height, batch)
+
+        def run_child():
+            try:
+                return subprocess.run(
+                    [sys.executable, "-c", child], capture_output=True,
+                    text=True, timeout=600)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                return subprocess.CompletedProcess(
+                    [], 1, "", "warm-restart child timed out after 600s")
+
+        def child_sweep_s(proc):
+            for line in proc.stdout.splitlines():
+                if line.startswith("WARM_SWEEP_S"):
+                    return float(line.split()[1])
+            return None
+
+        t = time.perf_counter()
+        first = child_sweep_s(run_child())   # populates (cold unless a
+        # prior round already cached this round's HLO)
+        proc = run_child()
+        warm = child_sweep_s(proc)           # the restart being measured
+        if warm is not None:
+            out["kawpow_kernel_restart_first_s"] = first
+            out["kawpow_kernel_warm_restart_s"] = warm
+            log(f"[kawpow] restart sweeps (fresh processes): first "
+                f"{first if first is not None else float('nan'):.1f}s, "
+                f"warm (disk-cached executables) {warm:.1f}s "
+                f"(in-process cold compile was {compile_s:.1f}s; both "
+                f"children total {time.perf_counter()-t:.0f}s)")
+        else:  # pragma: no cover - cache service hiccup: report, don't fail
+            log(f"[kawpow] warm-restart child failed: "
+                f"{proc.stderr[-400:]}")
 
     ceilings = (
         _measure_gather_ceilings(kern.dag, l1) if on_tpu else {}
@@ -399,7 +463,14 @@ def bench_sha256d(on_tpu: bool) -> dict:
     }
 
 
+_JIT_CACHE_DIR = os.path.abspath(os.path.join(".bench_cache", "jit"))
+
+
 def main() -> None:
+    from nodexa_chain_core_tpu.utils.jitcache import enable_persistent_cache
+
+    enable_persistent_cache(_JIT_CACHE_DIR)
+
     import jax
 
     on_tpu = jax.default_backend() != "cpu"

@@ -43,6 +43,7 @@ import struct
 import threading
 import zlib
 from bisect import bisect_right
+from collections import OrderedDict
 from heapq import merge as _heap_merge
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -143,9 +144,9 @@ class _Table:
         self._file = None
         self._fd = -1
         self._v3 = True
-        # block index -> (sorted record list, lazily-built lookup dict)
-        self._cache: "dict[int, list]" = {}
-        self._cache_order: List[int] = []
+        # block index -> (sorted record list, lazily-built lookup dict);
+        # OrderedDict for O(1) LRU touch under the lock
+        self._cache: "OrderedDict[int, list]" = OrderedDict()
         self._cache_blocks = cache_blocks
         self._cache_lock = threading.Lock()
         if os.path.exists(path):
@@ -190,9 +191,7 @@ class _Table:
         with self._cache_lock:
             ent = self._cache.get(bi)
             if ent is not None:
-                # LRU touch
-                self._cache_order.remove(bi)
-                self._cache_order.append(bi)
+                self._cache.move_to_end(bi)  # LRU touch, O(1)
                 return ent
         off, length = self.offsets[bi]
         # pread: atomic offset read, safe across concurrent readers
@@ -203,9 +202,8 @@ class _Table:
             if cached is not None:
                 return cached
             self._cache[bi] = ent
-            self._cache_order.append(bi)
-            while len(self._cache_order) > self._cache_blocks:
-                self._cache.pop(self._cache_order.pop(0), None)
+            while len(self._cache) > self._cache_blocks:
+                self._cache.popitem(last=False)
         return ent
 
     def block(self, bi: int) -> List[Tuple[bytes, object]]:
@@ -243,7 +241,6 @@ class _Table:
             self._fd = -1
         with self._cache_lock:
             self._cache.clear()
-            self._cache_order.clear()
 
 
 class _LegacySnapshot(Exception):

@@ -215,7 +215,10 @@ def _hybrid_searcher(verifier, fallback_batch: int):
         searcher = getattr(verifier, "_hybrid_search", None)
         if searcher is None or searcher.fallback_batch != fallback_batch:
             from ..ops.progpow_search import HybridSearch
+            from ..utils.jitcache import enable_persistent_cache
 
+            # per-period kernel compiles persist across miner restarts
+            enable_persistent_cache()
             searcher = HybridSearch(verifier, fallback_batch=fallback_batch)
             verifier._hybrid_search = searcher
         return searcher

@@ -1,0 +1,25 @@
+"""Persistent XLA compilation cache wiring (utils/jitcache.py)."""
+
+import os
+
+from nodexa_chain_core_tpu.utils import jitcache
+
+
+def test_enable_persistent_cache_idempotent(tmp_path, monkeypatch):
+    monkeypatch.setattr(jitcache, "_enabled", None)
+    d = str(tmp_path / "jit")
+    got = jitcache.enable_persistent_cache(d)
+    assert got == d and os.path.isdir(d)
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == d
+    # second call with no arg keeps the existing dir (idempotent)
+    assert jitcache.enable_persistent_cache() == d
+
+
+def test_env_var_default(tmp_path, monkeypatch):
+    monkeypatch.setattr(jitcache, "_enabled", None)
+    d = str(tmp_path / "envjit")
+    monkeypatch.setenv("NXK_JIT_CACHE", d)
+    assert jitcache.enable_persistent_cache() == d
+    assert os.path.isdir(d)

@@ -172,7 +172,6 @@ def main():
         ("no_l1_gather", dict(l1_on=False)),
         ("no_math", dict(math_on=False)),
         ("no_kernel", dict(kernel_on=False)),
-        ("dag_take_only", dict(kernel_on=False)),  # same as no_kernel
     ]
 
     def run_n(fn, n, salt):
