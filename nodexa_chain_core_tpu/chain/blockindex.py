@@ -166,19 +166,23 @@ class Chain:
         return iter(self._v)
 
     def set_tip(self, index: Optional[BlockIndex]) -> None:
-        """Rewrite the array to end at `index` (ref CChain::SetTip)."""
+        """Re-point the array to end at `index` (ref CChain::SetTip).
+
+        In place: truncate/extend, then back-fill only until the walk
+        meets the existing chain — amortized O(1) for the tip-extend
+        case (a slice-copy here is O(height) per connected block, which
+        the r5 IBD soak measured as quadratic sync time)."""
         if index is None:
             self._v = []
             return
-        self._v = self._v[: index.height + 1] + [None] * max(
-            0, index.height + 1 - len(self._v)
-        )
+        h = index.height
+        if h + 1 < len(self._v):
+            del self._v[h + 1:]
+        elif h + 1 > len(self._v):
+            self._v.extend([None] * (h + 1 - len(self._v)))
         walk: Optional[BlockIndex] = index
-        while walk is not None and (
-            walk.height >= len(self._v) or self._v[walk.height] is not walk
-        ):
-            if walk.height < len(self._v):
-                self._v[walk.height] = walk
+        while walk is not None and self._v[walk.height] is not walk:
+            self._v[walk.height] = walk
             walk = walk.prev
 
     def find_fork(self, index: Optional[BlockIndex]) -> Optional[BlockIndex]:

@@ -106,6 +106,13 @@ class ChainState:
         self.cs_main = threading.RLock()
         self.block_index: Dict[int, BlockIndex] = {}
         self.positions: Dict[int, Tuple[int, int]] = {}  # hash -> (data, undo)
+        # block-index entries mutated since the last flush: the per-block
+        # flush writes ONLY these (a full-index write per block is
+        # O(height) -> quadratic sync, found by the r5 IBD soak); the
+        # rare administrative paths (prune/invalidate/reconsider/
+        # reindex) request a full write instead
+        self._dirty_index: Set[BlockIndex] = set()
+        self._full_index_flush = False
         self.active = Chain()
         self.candidates: Set[BlockIndex] = set()  # setBlockIndexCandidates
         self.invalid: Set[BlockIndex] = set()
@@ -244,6 +251,7 @@ class ChainState:
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
         idx.tx_count = len(genesis.vtx)
         idx.chain_tx_count = idx.tx_count
+        self._dirty_index.add(idx)
         self.candidates.add(idx)
         self.activate_best_chain()
 
@@ -341,6 +349,7 @@ class ChainState:
                 (idx.prev.chain_tx_count if idx.prev else 0) + idx.tx_count
             )
             idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+            self._dirty_index.add(idx)
             self.candidates.add(idx)
             count += 1
 
@@ -445,6 +454,7 @@ class ChainState:
             self.pruned_height,
         )
         self.blocktree.write_index(self.block_index.values(), self.positions)
+        self._dirty_index.clear()
         self._chainstate_db.put(
             b"prunedheight", self.pruned_height.to_bytes(8, "little", signed=True)
         )
@@ -549,6 +559,7 @@ class ChainState:
         idx.prev = self.block_index.get(header.hash_prev)
         idx.build_from_prev()
         idx.raise_validity(BlockStatus.VALID_TREE)
+        self._dirty_index.add(idx)
         self.block_index[h] = idx
         return idx
 
@@ -884,6 +895,7 @@ class ChainState:
         dpos, _ = self.positions[idx.block_hash]
         self.positions[idx.block_hash] = (dpos, upos)
         idx.status |= BlockStatus.HAVE_UNDO
+        self._dirty_index.add(idx)
         # index records go in BEFORE the coin flush: a crash in between
         # replays this block on restart and the puts are idempotent, so
         # the coins write remains the single commit point
@@ -1023,6 +1035,7 @@ class ChainState:
                             idx.status & ~BlockStatus.HAVE_DATA
                         )
                         self.positions.pop(idx.block_hash, None)
+                        self._dirty_index.add(idx)  # persist the clear
                         idx.chain_tx_count = 0
                         for cand in list(self.candidates):
                             if cand.get_ancestor(idx.height) is idx:
@@ -1062,6 +1075,7 @@ class ChainState:
         resubmit_disconnected(self, pool)
 
     def _invalidate(self, idx: BlockIndex) -> None:
+        self._full_index_flush = True
         idx.status |= BlockStatus.FAILED_VALID
         self.invalid.add(idx)
         self.candidates.discard(idx)
@@ -1137,6 +1151,7 @@ class ChainState:
         then let the best chain re-activate (ref ResetBlockFailureFlags)."""
 
         def _clear(entry: BlockIndex) -> None:
+            self._full_index_flush = True
             entry.status = BlockStatus(entry.status & ~BlockStatus.FAILED_MASK)
             self.invalid.discard(entry)
             if (
@@ -1294,6 +1309,7 @@ class ChainState:
         self._received_block_data(idx)
         idx.tx_count = len(block.vtx)
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+        self._dirty_index.add(idx)
         # nChainTx gate (ref ReceivedBlockTransactions): a block becomes a
         # chain candidate only once data for its WHOLE ancestor chain has
         # arrived — block data can land out of order when compact-block
@@ -1350,7 +1366,15 @@ class ChainState:
             self._last_autoprune_height = tip.height
             self.prune_block_files()
         self.coins.flush()
-        self.blocktree.write_index(self.block_index.values(), self.positions)
+        if self._full_index_flush:
+            self.blocktree.write_index(
+                self.block_index.values(), self.positions)
+            self._full_index_flush = False
+            self._dirty_index.clear()
+        elif self._dirty_index:
+            self.blocktree.write_index(
+                tuple(self._dirty_index), self.positions)
+            self._dirty_index.clear()
         tip = self.tip()
         if tip is not None:
             self.blocktree.write_tip(tip.block_hash)

@@ -111,8 +111,10 @@ setInterval(pollHeader, 5000);
 
 // -- tabs --------------------------------------------------------------------
 const TABS = {Overview: viewOverview, Blocks: viewBlocks, Mempool: viewMempool,
-              Wallet: viewWallet, Assets: viewAssets, Restricted: viewRestricted,
-              Messages: viewMessages, Rewards: viewRewards, Peers: viewPeers};
+              Wallet: viewWallet, Coins: viewCoins, Addresses: viewAddresses,
+              Assets: viewAssets, Restricted: viewRestricted,
+              Messages: viewMessages, Rewards: viewRewards, Peers: viewPeers,
+              Console: viewConsole};
 let current = "Overview";
 let pendingPay = null;  // parsed #pay= URI awaiting the wallet send form
 function nav(){
@@ -598,6 +600,199 @@ async function viewPeers(){
     el("th",{text:"address"}),el("th",{text:"dir"}),el("th",{text:"agent"}),
     el("th",{text:"headers"}))),tb));
   if (!peers.length) wrap.append(el("p",{class:"mono",text:"no peers connected"}));
+  return wrap;
+}
+
+// -- RPC console (ref src/qt/rpcconsole.cpp) ---------------------------------
+// Command line: `method arg1 arg2 ...`; args parse as JSON when they look
+// like it (numbers, true/false, [..], {..}, "quoted"), else as strings —
+// the same convention clore-qt's console and clore-cli share.
+function parseConsoleArg(tok){
+  if (/^(-?\d+(\.\d+)?|true|false|null)$/.test(tok)) return JSON.parse(tok);
+  if (/^[\[{"]/.test(tok)) { try { return JSON.parse(tok); } catch(e){} }
+  return tok;
+}
+function splitConsoleLine(line){
+  const toks = []; let cur = "", depth = 0, q = false;
+  for (const ch of line.trim()) {
+    if (ch === '"') q = !q;
+    if (!q && depth === 0 && /\s/.test(ch)) {
+      if (cur) { toks.push(cur); cur = ""; } continue; }
+    if ("[{".includes(ch)) depth++;
+    if ("]}".includes(ch)) depth--;
+    cur += ch;
+  }
+  if (cur) toks.push(cur);
+  return toks;
+}
+const consoleHistory = [];
+async function viewConsole(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const log = el("pre",{id:"console-log",class:"panel",
+    style:"max-height:24em;overflow:auto;white-space:pre-wrap;"+
+          "font-size:.82rem;margin-top:0"});
+  for (const line of consoleHistory) log.append(line+"\n");
+  const input = el("input",{id:"console-input",size:"70",
+    placeholder:"getblockchaininfo | getblockhash 0 | help getblock"});
+  const cmdsOf = ()=>consoleHistory.filter(l=>l.startsWith("> "));
+  let histIdx = cmdsOf().length;  // indexes the COMMANDS, not the log
+  const run = async()=>{
+    const line = input.value.trim(); if (!line) return;
+    consoleHistory.push("> "+line); log.append("> "+line+"\n");
+    input.value = ""; histIdx = cmdsOf().length;
+    const toks = splitConsoleLine(line);
+    try {
+      const out = await rpc(toks[0], toks.slice(1).map(parseConsoleArg));
+      const s = typeof out === "string" ? out : JSON.stringify(out, null, 1);
+      consoleHistory.push(s); log.append(s+"\n");
+    } catch(e){
+      consoleHistory.push("error: "+(e.message||e));
+      log.append("error: "+(e.message||e)+"\n");
+    }
+    log.scrollTop = log.scrollHeight;
+  };
+  input.onkeydown = (ev)=>{
+    if (ev.key === "Enter") run();
+    else if (ev.key === "ArrowUp") {
+      const cmds = cmdsOf();
+      if (!cmds.length) return;
+      histIdx = Math.max(0, histIdx - 1);
+      input.value = cmds[histIdx].slice(2);
+    } else if (ev.key === "ArrowDown") {
+      const cmds = cmdsOf();
+      histIdx = Math.min(cmds.length, histIdx + 1);
+      input.value = histIdx < cmds.length ? cmds[histIdx].slice(2) : "";
+    }
+  };
+  const b = el("button",{class:"act",text:"run",id:"console-run"});
+  b.onclick = run;
+  wrap.append(el("h3",{text:"RPC console"}), log,
+    el("div",{}, input, el("span",{text:" "}), b),
+    el("p",{class:"mono",text:"history persists for this page session; "+
+      "`help` lists commands"}));
+  return wrap;
+}
+
+// -- address book (ref src/qt/addressbookpage.cpp; account-API labels) -------
+async function viewAddresses(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const accounts = await rpc("listaccounts");
+  const tb = el("tbody");
+  for (const label of Object.keys(accounts)) {
+    const addrs = await rpc("getaddressesbyaccount",[label]);
+    for (const a of addrs) {
+      const uriLink = el("a",{text:"pay URI"});
+      uriLink.onclick = ()=>{ navigator.clipboard?.writeText(
+          makePaymentURI(a,0,label)); toast("URI copied"); };
+      tb.append(el("tr",{}, el("td",{text:label||"(default)"}),
+        el("td",{text:a}), el("td",{},uriLink)));
+    }
+  }
+  wrap.append(el("h3",{text:"address book"}),
+    el("table",{},el("thead",{},el("tr",{},el("th",{text:"label"}),
+      el("th",{text:"address"}),el("th",{text:""}))),tb));
+  const p = el("div",{class:"panel"});
+  const lbl = el("input",{placeholder:"label",id:"ab-label"});
+  const nb = el("button",{class:"act",text:"new labeled address",id:"ab-new"});
+  const outc = el("code",{class:"mono",text:""});
+  nb.onclick = async()=>{ try {
+      const a = await rpc("getnewaddress",[lbl.value.trim()]);
+      outc.textContent = a; toast("address created"); render(); }
+    catch(e){ toast(String(e.message||e), true); } };
+  const ra = el("input",{placeholder:"address",size:"40",id:"ab-addr"});
+  const rl = el("input",{placeholder:"new label",id:"ab-relabel"});
+  const rb = el("button",{class:"act",text:"relabel",id:"ab-set"});
+  rb.onclick = async()=>{ try {
+      await rpc("setaccount",[ra.value.trim(), rl.value.trim()]);
+      toast("label set"); render(); }
+    catch(e){ toast(String(e.message||e), true); } };
+  p.append(el("h3",{text:"manage"}), lbl, el("span",{text:" "}), nb,
+    el("div",{}, outc),
+    el("div",{style:"margin-top:.5em"}, ra, el("span",{text:" "}), rl,
+      el("span",{text:" "}), rb));
+  wrap.append(p);
+  return wrap;
+}
+
+// -- coin control (ref src/qt/coincontroldialog.cpp) -------------------------
+// Pick exact inputs, lock/unlock them, and send with manual change: the
+// raw-tx path (createrawtransaction -> signrawtransaction ->
+// sendrawtransaction) with change to getrawchangeaddress.
+const ccSelected = new Set();
+async function viewCoins(){
+  const wrap = el("div");
+  if (!creds()) { wrap.append(loginPanel(render)); return wrap; }
+  const utxos = await rpc("listunspent",[0]);
+  const locked = await rpc("listlockunspent").catch(()=>[]);
+  const lockedKey = new Set(locked.map(o=>o.txid+":"+o.vout));
+  const tb = el("tbody");
+  let total = 0;
+  const totalEl = el("b",{id:"cc-total",text:"0"});
+  const refreshTotal = ()=>{
+    total = 0;
+    for (const u of utxos)
+      if (ccSelected.has(u.txid+":"+u.vout)) total += u.amount;
+    totalEl.textContent = total.toFixed(8);
+  };
+  for (const u of utxos) {
+    const key = u.txid+":"+u.vout;
+    const cb = el("input",{type:"checkbox","data-key":key});
+    if (ccSelected.has(key)) cb.checked = true;
+    cb.onchange = ()=>{ cb.checked ? ccSelected.add(key)
+                                   : ccSelected.delete(key);
+      refreshTotal(); };
+    const lk = el("a",{text:lockedKey.has(key)?"unlock":"lock"});
+    lk.onclick = async()=>{ try {
+        await rpc("lockunspent",[lockedKey.has(key),
+          [{txid:u.txid, vout:u.vout}]]);
+        render(); }
+      catch(e){ toast(String(e.message||e), true); } };
+    tb.append(el("tr",{}, el("td",{},cb), el("td",{text:u.txid.slice(0,20)+"…:"+u.vout}),
+      el("td",{text:u.amount}), el("td",{text:u.confirmations}),
+      el("td",{text:u.address||""}),
+      el("td",{text:lockedKey.has(key)?"locked":""}), el("td",{},lk)));
+  }
+  refreshTotal();
+  wrap.append(el("h3",{text:"coin control"}),
+    el("table",{},el("thead",{},el("tr",{},el("th",{text:"pick"}),
+      el("th",{text:"outpoint"}),el("th",{text:"amount"}),
+      el("th",{text:"conf"}),el("th",{text:"address"}),
+      el("th",{text:""}),el("th",{text:""}))),tb));
+  if (!utxos.length) wrap.append(el("p",{class:"mono",text:"no UTXOs"}));
+
+  const p = el("div",{class:"panel"});
+  const to = el("input",{placeholder:"pay to address",size:"40",id:"cc-to"});
+  const amt = el("input",{placeholder:"amount",size:"12",id:"cc-amt"});
+  const fee = el("input",{placeholder:"fee",value:"0.001",size:"8",id:"cc-fee"});
+  const sb = el("button",{class:"act",text:"send selected",id:"cc-send"});
+  sb.onclick = async()=>{ try {
+      const ins = utxos.filter(u=>ccSelected.has(u.txid+":"+u.vout))
+        .map(u=>({txid:u.txid, vout:u.vout}));
+      if (!ins.length) throw new Error("no inputs selected");
+      const pay = parseFloat(amt.value), f = parseFloat(fee.value)||0;
+      const inTotal = utxos.filter(u=>ccSelected.has(u.txid+":"+u.vout))
+        .reduce((s,u)=>s+u.amount, 0);
+      const change = inTotal - pay - f;
+      if (!(pay > 0) || change < 0)
+        throw new Error("selected "+inTotal.toFixed(8)+
+                        " < amount+fee");
+      const outs = {}; outs[to.value.trim()] = Number(pay.toFixed(8));
+      if (change > 1e-8)
+        outs[await rpc("getrawchangeaddress")] = Number(change.toFixed(8));
+      const raw = await rpc("createrawtransaction",[ins, outs]);
+      const signed = await rpc("signrawtransaction",[raw]);
+      if (!signed.complete) throw new Error("signing incomplete");
+      const txid = await rpc("sendrawtransaction",[signed.hex]);
+      ccSelected.clear();
+      toast("sent: "+txid); render(); }
+    catch(e){ toast(String(e.message||e), true); } };
+  p.append(el("h3",{text:"spend selected inputs"}),
+    el("div",{class:"mono"}, el("span",{text:"selected total "}), totalEl),
+    el("div",{style:"margin-top:.4em"}, to, el("span",{text:" "}), amt,
+      el("span",{text:" fee "}), fee, el("span",{text:" "}), sb));
+  wrap.append(p);
   return wrap;
 }
 

@@ -204,17 +204,27 @@ class BlockLocator:
         return cls(have=r.vector(lambda rr: rr.hash256()))
 
 
-def make_locator(chain) -> BlockLocator:
-    """ref chain.cpp CChain::GetLocator."""
+def make_locator(chain, tip=None) -> BlockLocator:
+    """ref chain.cpp CChain::GetLocator(pindex).
+
+    With `tip` given, the locator starts at that (header-chain) index —
+    the IBD continuation case, where getheaders must resume from the
+    last RECEIVED header, not the lagging active tip (resuming from the
+    active chain re-serves ~every known header per batch, which the r5
+    IBD soak measured as quadratic header re-hashing)."""
     have: List[int] = []
     step = 1
-    idx = chain.tip()
+    idx = tip if tip is not None else chain.tip()
     while idx is not None:
         have.append(idx.block_hash)
         if idx.height == 0:
             break
         height = max(idx.height - step, 0)
-        idx = chain.at(height)
+        # prefer the active chain's O(1) lookup once inside it
+        if chain is not None and chain.at(idx.height) is idx:
+            idx = chain.at(height)
+        else:
+            idx = idx.get_ancestor(height)
         if len(have) > 10:
             step *= 2
     return BlockLocator(have=have)

@@ -193,6 +193,10 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
             node.wallet = Wallet.load_or_create(node)
             log_printf("wallet loaded: %d keys", len(node.wallet.keystore.keys()))
+            # periodic writer for chain-driven wallet state (ref
+            # init.cpp wallet-flush scheduleEvery; per-block flushes
+            # were O(wallet) each — see Wallet.block_connected)
+            node.scheduler.schedule_every(node.wallet.flush_if_dirty, 5.0)
         except ImportError:
             pass
 
@@ -304,9 +308,52 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     return node, rpc
 
 
+def _start_sampling_profiler(path: str):
+    """Env-gated wall-clock stack sampler (NODEXA_SAMPLE_PROF=file):
+    every 5 ms record the top frames of every thread; the histogram is
+    dumped at exit.  Diagnoses where daemon threads actually spend wall
+    time without instrumenting the hot paths."""
+    import atexit
+    import collections
+    import threading
+
+    hist: "collections.Counter" = collections.Counter()
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            for frame in list(sys._current_frames().values()):
+                parts = []
+                f = frame
+                for _ in range(6):
+                    if f is None:
+                        break
+                    parts.append(
+                        f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{f.f_code.co_name}:{f.f_lineno}")
+                    f = f.f_back
+                hist[" <- ".join(parts)] += 1
+            stop.wait(0.005)
+
+    t = threading.Thread(target=sample, name="sampleprof", daemon=True)
+    t.start()
+
+    def dump():
+        stop.set()
+        with open(path, "w") as fh:
+            for k, v in hist.most_common(80):
+                fh.write(f"{v:8d}  {k}\n")
+
+    atexit.register(dump)
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if os.environ.get("NODEXA_SAMPLE_PROF"):
+        # per-process file: a test spawns several daemons from one env
+        _start_sampling_profiler(
+            f"{os.environ['NODEXA_SAMPLE_PROF']}.{os.getpid()}")
     node, rpc = app_init_main(argv)
 
     def on_signal(signum, frame):

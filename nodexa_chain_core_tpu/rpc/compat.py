@@ -500,7 +500,11 @@ def getaddressesbyaccount(node, params: List[Any]):
 
 def listaccounts(node, params: List[Any]):
     w = _wallet(node)
+    # every address-book label appears, zero balance included (ref
+    # rpcwallet.cpp ListAccounts seeds from the address book)
     out = {"": 0.0}
+    for label in w.address_book.values():
+        out.setdefault(label, 0.0)
     by_addr = {}
     for op, txout, conf in w.unspent_coins(min_conf=1):
         dest = extract_destination(Script(txout.script_pubkey))

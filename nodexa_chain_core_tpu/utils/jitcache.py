@@ -14,6 +14,13 @@ becomes a cache read).
 Call :func:`enable_persistent_cache` before the first compile.  It is
 idempotent, multi-process safe (the cache write is atomic-rename), and
 a no-op when the backend is initialized with caching already on.
+
+Measured on the v5e tunnel (bench.py's restart probe): a cache-hit
+restart re-warms the 32768-batch per-period kernel in ~28 s solo
+(~45-55 s when another process shares the tunnel; the hit itself
+deserializes in ~4 s — backend init, slab upload and service
+round-trips are the rest) vs ~70 s+ for a cold-cache restart paying the
+full XLA compile.
 """
 
 from __future__ import annotations

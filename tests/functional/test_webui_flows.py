@@ -130,3 +130,91 @@ def test_rewards_snapshot_via_web_endpoints():
         n0.rpc.generatetoaddress(3, addr)
         snap = n0.rpc.getsnapshot("RWDTOK", h)
         assert snap.get("owners") or snap.get("height") == h
+
+
+def test_console_addressbook_coincontrol_screens_served():
+    """The r5 screens (rpcconsole.cpp, addressbookpage.cpp,
+    coincontroldialog.cpp analogs) are in the served page with their
+    control ids and the RPC methods their handlers emit."""
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        page = _fetch_ui(f.nodes[0])
+        for marker in (
+            "viewConsole", "viewAddresses", "viewCoins",
+            # console
+            "console-input", "console-run", "splitConsoleLine",
+            "parseConsoleArg",
+            # address book
+            "ab-new", "ab-set", "listaccounts", "getaddressesbyaccount",
+            "setaccount",
+            # coin control
+            "cc-send", "cc-to", "listunspent", "lockunspent",
+            "createrawtransaction", "signrawtransaction",
+            "sendrawtransaction", "getrawchangeaddress",
+        ):
+            assert marker in page, f"/ui is missing {marker!r}"
+
+
+def test_coin_control_flow_via_web_endpoints():
+    """The exact RPC sequence the Coins screen's send button emits:
+    pick inputs -> lock/unlock -> createraw -> signraw -> sendraw with
+    manual change, over the browser's HTTP endpoint."""
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        addr = n0.rpc.getnewaddress()
+        n0.rpc.generatetoaddress(110, addr)
+
+        utxos = n0.rpc.listunspent(0)
+        assert utxos, "mining should have produced spendable coins"
+        pick = utxos[0]
+
+        # lock/unlock round-trip (the lock link)
+        assert n0.rpc.lockunspent(
+            False, [{"txid": pick["txid"], "vout": pick["vout"]}]) is True
+        assert n0.rpc.listlockunspent() == [
+            {"txid": pick["txid"], "vout": pick["vout"]}]
+        assert n0.rpc.lockunspent(
+            True, [{"txid": pick["txid"], "vout": pick["vout"]}]) is True
+        assert n0.rpc.listlockunspent() == []
+
+        # manual-change spend of exactly that input
+        dest = n0.rpc.getnewaddress()
+        fee = 0.001
+        pay = 1.0
+        change = round(float(pick["amount"]) - pay - fee, 8)
+        assert change > 0
+        outs = {dest: pay, n0.rpc.getrawchangeaddress(): change}
+        raw = n0.rpc.createrawtransaction(
+            [{"txid": pick["txid"], "vout": pick["vout"]}], outs)
+        signed = n0.rpc.signrawtransaction(raw)
+        assert signed["complete"] is True
+        txid = n0.rpc.sendrawtransaction(signed["hex"])
+        assert txid in n0.rpc.getrawmempool()
+        n0.rpc.generatetoaddress(1, addr)
+        got = n0.rpc.gettransaction(txid)
+        assert got["confirmations"] == 1
+
+
+def test_addressbook_flow_via_web_endpoints():
+    """The Addresses screen's handlers: labeled address creation,
+    relabel, and enumeration via the account API."""
+    with TestFramework(num_nodes=1, extra_args=[["-wallet"]]) as f:
+        n0 = f.nodes[0]
+        a1 = n0.rpc.getnewaddress("savings")
+        accounts = n0.rpc.listaccounts()
+        assert "savings" in accounts
+        assert a1 in n0.rpc.getaddressesbyaccount("savings")
+        n0.rpc.setaccount(a1, "cold")
+        assert a1 in n0.rpc.getaddressesbyaccount("cold")
+        assert a1 not in n0.rpc.getaddressesbyaccount("savings")
+
+
+def test_console_rpc_sequence():
+    """What the Console screen does for `getblockhash 0` and a JSON
+    arg: positional params over the same HTTP endpoint."""
+    with TestFramework(num_nodes=1) as f:
+        n0 = f.nodes[0]
+        h0 = n0.rpc.getblockhash(0)
+        blk = n0.rpc.getblock(h0, 1)
+        assert blk["height"] == 0
+        helptext = n0.rpc.help("getblock")
+        assert "getblock" in helptext

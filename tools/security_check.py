@@ -1,0 +1,98 @@
+"""Hardened-binary checks for the native engine (analog of the
+reference's contrib/devtools/security-check.py, which asserts PIE /
+NX / RELRO / canary properties of release ELF artifacts).
+
+For a shared library the applicable properties are:
+
+- **NX**: no PT_GNU_STACK segment with the X flag (stack not executable)
+- **RELRO**: a PT_GNU_RELRO segment present; BIND_NOW for full RELRO
+- **no TEXTREL**: relocations must not patch the code segment
+- **canary**: __stack_chk_fail imported (stack-smashing protection;
+  present when compiled with -fstack-protector and a protectable frame
+  exists)
+
+Run: python tools/security_check.py [path.so ...]
+Defaults to the built native engine; exit 1 on a failed REQUIRED check.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def readelf(flag: str, path: str) -> str:
+    return subprocess.run(
+        ["readelf", flag, path], capture_output=True, text=True, check=True
+    ).stdout
+
+
+def check_so(path: str) -> list:
+    problems = []
+    progs = readelf("-lW", path)
+
+    # NX: GNU_STACK must exist and not be executable.  readelf -lW rows
+    # end "... FileSiz MemSiz Flg Align": the flags are the SECOND-TO-
+    # LAST token (e.g. "RW" / "RWE"), the last is the alignment
+    nx_ok = False
+    for line in progs.splitlines():
+        if "GNU_STACK" in line:
+            parts = line.split()
+            nx_ok = len(parts) >= 2 and "E" not in parts[-2]
+    if not nx_ok:
+        problems.append("NX: GNU_STACK missing or executable")
+
+    # RELRO segment
+    if "GNU_RELRO" not in progs:
+        problems.append("RELRO: no PT_GNU_RELRO segment")
+    dyn = readelf("-dW", path)
+    if "BIND_NOW" not in dyn and "NOW" not in dyn:
+        # partial RELRO: report but do not fail (matches the reference
+        # checker's posture for non-PIE-critical artifacts)
+        print(f"   note: {os.path.basename(path)} has partial RELRO "
+              "(no BIND_NOW)")
+
+    # TEXTREL: code-segment relocations defeat page sharing and W^X
+    if "TEXTREL" in dyn:
+        problems.append("TEXTREL present (writable code relocations)")
+
+    # stack canary: look for the glibc hook among dynamic symbols
+    syms = readelf("--dyn-syms", path)
+    if "__stack_chk_fail" not in syms:
+        print(f"   note: {os.path.basename(path)} imports no "
+              "__stack_chk_fail (no protectable frames or no "
+              "-fstack-protector)")
+    return problems
+
+
+def main() -> int:
+    targets = sys.argv[1:]
+    if not targets:
+        here = os.path.dirname(os.path.abspath(__file__))
+        build = os.path.join(here, "..", "nodexa_chain_core_tpu",
+                             "native", "_build")
+        targets = [
+            os.path.join(build, f)
+            for f in (sorted(os.listdir(build))
+                      if os.path.isdir(build) else [])
+            if f.endswith(".so")
+        ]
+    if not targets:
+        print("security_check: no .so targets (build the native engine "
+              "first)")
+        return 1
+    rc = 0
+    for t in targets:
+        problems = check_so(t)
+        for p in problems:
+            print(f"FAIL {os.path.basename(t)}: {p}")
+            rc = 1
+        if not problems:
+            print(f"   {os.path.basename(t)}: NX ok, RELRO ok, "
+                  "no TEXTREL")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

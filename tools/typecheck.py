@@ -1,0 +1,173 @@
+"""Static checks for the consensus-critical packages (VERDICT r4 missing
+#3: a typecheck lane).  No mypy/pyflakes in this image, so this carries
+its own three checks built on stdlib ast/symtable/inspect:
+
+1. **undefined names** (NameError class): every name LOADed in a scope
+   must resolve through the symtable scope chain, module globals, or
+   builtins.
+2. **module-attribute existence** (AttributeError class): `mod.attr`
+   where `mod` is an imported module must exist on the real imported
+   module (modules are imported on the CPU backend, so this is exact,
+   not heuristic).
+3. **call arity** (TypeError class): calls to functions *defined in the
+   same module* must pass an acceptable number of positional args.
+
+Scope: the packages whose bugs are consensus/funds-affecting —
+core, consensus, chain, script, primitives, crypto, assets.
+
+Run: python tools/typecheck.py   (exit 1 on findings)
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import importlib
+import inspect
+import os
+import sys
+import symtable
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PKG = "nodexa_chain_core_tpu"
+SUBPKGS = ("core", "consensus", "chain", "script", "primitives", "crypto",
+           "assets")
+
+_BUILTINS = set(dir(builtins)) | {"__file__", "__name__", "__doc__",
+                                  "__package__", "__spec__", "__loader__",
+                                  "__builtins__", "__debug__", "__path__",
+                                  "__class__"}  # zero-arg super() cell
+
+
+def _scope_names(tab: symtable.SymbolTable) -> set:
+    return {s.get_name() for s in tab.get_symbols()
+            if s.is_assigned() or s.is_imported() or s.is_parameter()
+            or s.is_global() or s.is_declared_global()}
+
+
+def check_undefined(path: str, src: str, errors: list) -> None:
+    """Walk the symtable scope chain: a LOAD that no enclosing scope
+    defines is a NameError waiting for its branch to run."""
+    try:
+        top = symtable.symtable(src, path, "exec")
+    except SyntaxError as e:
+        errors.append(f"{path}: syntax error: {e}")
+        return
+
+    def walk(tab, inherited):
+        local = _scope_names(tab)
+        # class bodies do not contribute to nested function scopes
+        passed = inherited if tab.get_type() == "class" else inherited | local
+        for sym in tab.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced() or name in _BUILTINS:
+                continue
+            if sym.is_assigned() or sym.is_imported() or sym.is_parameter():
+                continue
+            if sym.is_free() or sym.is_global():
+                if name in inherited | local:
+                    continue
+                # module-global resolution happens at runtime; the module
+                # imported fine (gate stage 2), so only flag names absent
+                # from the MODULE top scope too
+                if name in _scope_names(top):
+                    continue
+                errors.append(
+                    f"{path}: undefined name {name!r} in scope "
+                    f"{tab.get_name()!r} (line ~{tab.get_lineno()})")
+        for child in tab.get_children():
+            walk(child, passed)
+
+    walk(top, set())
+
+
+def check_module_attrs(path: str, tree: ast.Module, mod, errors: list) -> None:
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in imported
+                and isinstance(node.ctx, ast.Load)):
+            target = sys.modules.get(imported[node.value.id])
+            if target is not None and inspect.ismodule(target):
+                if not hasattr(target, node.attr):
+                    errors.append(
+                        f"{path}:{node.lineno}: module "
+                        f"{imported[node.value.id]!r} has no attribute "
+                        f"{node.attr!r}")
+
+
+def check_call_arity(path: str, tree: ast.Module, mod, errors: list) -> None:
+    local_fns = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = getattr(mod, node.name, None)
+            if inspect.isfunction(fn):
+                local_fns[node.name] = fn
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in local_fns):
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                kw.arg is None for kw in node.keywords):
+            continue  # *args / **kwargs at call site: not checkable
+        fn = local_fns[node.func.id]
+        try:
+            sig = inspect.signature(fn)
+            sig.bind(*[None] * len(node.args),
+                     **{kw.arg: None for kw in node.keywords})
+        except TypeError as e:
+            errors.append(
+                f"{path}:{node.lineno}: call to {node.func.id}() "
+                f"does not match its signature: {e}")
+        except ValueError:
+            pass
+
+
+def main() -> int:
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", PKG)
+    errors: list = []
+    nfiles = 0
+    for sub in SUBPKGS:
+        subdir = os.path.normpath(os.path.join(root, sub))
+        for dirpath, _dirs, files in os.walk(subdir):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, os.path.join(root, ".."))
+                modname = rel[:-3].replace(os.sep, ".")
+                if fname == "__init__.py":
+                    modname = modname[: -len(".__init__")]
+                with open(path) as f:
+                    src = f.read()
+                try:
+                    mod = importlib.import_module(modname)
+                except Exception as e:
+                    errors.append(f"{rel}: import failed: {e!r}")
+                    continue
+                tree = ast.parse(src, rel)
+                check_undefined(rel, src, errors)
+                check_module_attrs(rel, tree, mod, errors)
+                check_call_arity(rel, tree, mod, errors)
+                nfiles += 1
+    for e in errors:
+        print(e)
+    print(f"typecheck: {nfiles} files in {'/'.join(SUBPKGS)}, "
+          f"{len(errors)} problems")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
