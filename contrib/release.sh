@@ -1,0 +1,65 @@
+#!/bin/sh
+# Release builder (analog of the reference's release-linux.sh +
+# gitian-descriptors posture, sized to a Python+native wheel artifact).
+#
+#   sh contrib/release.sh [VERSION]
+#
+# Produces release/<version>/ containing:
+#   - the platform wheel (hardened native engine inside),
+#   - the sdist,
+#   - SHA256SUMS over both,
+#   - BUILDINFO (toolchain + dependency pins for reproduction).
+#
+# Reproducibility posture: SOURCE_DATE_EPOCH is pinned to the release
+# commit's timestamp so the wheel/sdist zip metadata is deterministic;
+# BUILDINFO records the exact interpreter, compiler and dependency
+# versions so a builder on the same base image reproduces bit-identical
+# artifacts (the role the reference's gitian descriptors + depends/
+# tree play, without requiring its VM orchestration).
+set -e
+cd "$(dirname "$0")/.."
+
+VERSION="${1:-$(python -c 'import tomllib;print(tomllib.load(open("pyproject.toml","rb"))["project"]["version"])')}"
+OUT="release/$VERSION"
+
+echo "== gate first: a release is a green gate's artifacts"
+sh tools/ci_gate.sh
+
+echo "== building release $VERSION"
+rm -rf "$OUT" build ./*.egg-info
+mkdir -p "$OUT"
+
+SOURCE_DATE_EPOCH="$(git log -1 --format=%ct 2>/dev/null || date +%s)"
+export SOURCE_DATE_EPOCH
+
+python -m pip wheel --no-build-isolation --no-deps -w "$OUT" . -q
+python - <<'EOF'
+import glob, subprocess, sys
+# sdist via setuptools directly (build isolation off: image deps only)
+subprocess.run([sys.executable, "setup.py", "-q", "sdist", "-d"]
+               + glob.glob("release/*")[:1], check=True)
+EOF
+
+( cd "$OUT" && sha256sum ./* > SHA256SUMS )
+
+{
+    echo "version: $VERSION"
+    echo "source_date_epoch: $SOURCE_DATE_EPOCH"
+    echo "commit: $(git rev-parse HEAD 2>/dev/null || echo unknown)"
+    echo "python: $(python -V 2>&1)"
+    echo "compiler: $(g++ --version | head -1)"
+    echo "glibc: $(ldd --version | head -1)"
+    echo "deps:"
+    python - <<'EOF'
+import importlib.metadata as md
+for d in ("jax", "jaxlib", "numpy", "setuptools", "wheel", "pip"):
+    try:
+        print(f"  {d}=={md.version(d)}")
+    except md.PackageNotFoundError:
+        pass
+EOF
+} > "$OUT/BUILDINFO"
+
+echo "== release artifacts"
+ls -l "$OUT"
+echo "RELEASE OK: $OUT"

@@ -434,11 +434,23 @@ def _gather_regs(mix, idx):
     A 32-step select chain: XLA lowers per-element dynamic gathers over
     the 32-reg axis to an element loop, while 32 vectorized where-passes
     stay on the VPU (same reasoning as the L1 gather decomposition)."""
-    idx = idx.astype(jnp.int32)[None, :]
-    out = mix[0]
+    return _gather_regs_multi(mix, (idx,))[0]
+
+
+def _gather_regs_multi(mix, idxs):
+    """Gather several (B,) register selections in ONE chain pass.
+
+    Each mix[k] plane is read once and reused for every selector, so a
+    cache access's (src, dst) or a math op's (src1, src2, dst) triple
+    costs one traversal of the register file instead of two or three."""
+    idxs = [i.astype(jnp.int32)[None, :] for i in idxs]
+    outs = [mix[0]] * len(idxs)
     for k in range(1, REGS):
-        out = jnp.where(idx == k, mix[k], out)
-    return out
+        plane = mix[k]
+        outs = [
+            jnp.where(idx == k, plane, out) for idx, out in zip(idxs, outs)
+        ]
+    return outs
 
 
 # --------------------------------------------- Pallas L1 gather (verify)
@@ -566,9 +578,9 @@ def hash_mix_batch(mix, plan_rows, l1, dag):
             if i < CACHE_ACCESSES:
                 src = x["cache_src"][:, i]
                 dst = x["cache_dst"][:, i]
-                off = jnp.mod(_gather_regs(mix, src), _U32(L1_WORDS))
+                src_val, old = _gather_regs_multi(mix, (src, dst))
+                off = jnp.mod(src_val, _U32(L1_WORDS))
                 data = _l1_gather(l1, off, use_pallas)  # (16,B)
-                old = _gather_regs(mix, dst)
                 merged = _merge(
                     old, data,
                     x["cache_mop"][None, :, i], x["cache_mrot"][None, :, i]
@@ -576,11 +588,12 @@ def hash_mix_batch(mix, plan_rows, l1, dag):
                 )
                 mix = _scatter_regs(mix, dst, merged)
             if i < MATH_OPS:
-                a = _gather_regs(mix, x["math_src1"][:, i])
-                b = _gather_regs(mix, x["math_src2"][:, i])
-                data = _math(a, b, x["math_op"][None, :, i])
                 dst = x["math_dst"][:, i]
-                old = _gather_regs(mix, dst)
+                a, b, old = _gather_regs_multi(
+                    mix,
+                    (x["math_src1"][:, i], x["math_src2"][:, i], dst),
+                )
+                data = _math(a, b, x["math_op"][None, :, i])
                 merged = _merge(
                     old, data,
                     x["math_mop"][None, :, i],
