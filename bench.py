@@ -20,14 +20,21 @@ Phases (stderr narrates):
      the independent BatchVerifier before timing.
   4. kawpow_verify_headers_per_s: BatchVerifier over a 2048-header sync
      batch spanning consecutive heights (the HEADERS-message shape).
-  5. Measured gather rooflines: random 256-B DAG-row gather GB/s and
-     random L1 word-gather G elem/s, each timed as in-jit chained loops
-     (nothing elides, no dispatch latency) — the honest ceilings the
-     kernel's achieved traffic is judged against in extra.utilization.
-  6. Baseline: the native engine's single-core search loop (the
+  5. Persistent-cache restart probe: two identical fresh processes
+     re-create the same kernel; the second (the "restart") loads the
+     executable from the on-disk compilation cache.
+  6. Measured gather rooflines: random 256-B DAG-row gather GB/s,
+     random L1 word-gather G elem/s (in-jit chained loops — nothing
+     elides, no dispatch latency), and the Pallas async-DMA pair-row
+     probe (the r3/r4 "DMA should beat XLA take" hypothesis: measured
+     issue-rate-bound ~10x BELOW the gather engine, so XLA's take is
+     the honest ceiling).  extra.utilization reports each component's
+     achieved fraction AND the composite serialized ceiling — the
+     number the ">= 70% of measured ceiling" criterion applies to.
+  7. Baseline: the native engine's single-core search loop (the
      reference node's own in-process capability, ref progpow::
      search_light) measured in-run; vs_baseline = TPU H/s / native H/s.
-  7. sha256d extras: the round-1/2 Pallas search kernel numbers, kept
+  8. sha256d extras: the round-1/2 Pallas search kernel numbers, kept
      for cross-round continuity.
 
 Utilization accounting (`extra.utilization`): KawPow is memory-hard by
@@ -97,11 +104,16 @@ def _measure_gather_ceilings(dag_jnp, l1_np) -> dict:
         return time.perf_counter() - t
 
     # a ceiling is a max-capability figure and tunnel hiccups are
-    # one-sided: take min PER POINT, then difference (a min over paired
-    # differences would select hiccup-corrupted baselines)
-    t1 = min(run(1, 10 + a) for a in range(3))
-    t5 = min(run(5, 50 + 10 * a) for a in range(3))
-    dt = (t5 - t1) / 4
+    # one-sided: take min PER POINT within an estimate, then the MAX
+    # over independent slope estimates (one corrupted estimate would
+    # otherwise under-report the ceiling below the kernel's own
+    # achieved rate, which r5 observed)
+    def slope_estimate(salt):
+        t1 = min(run(1, 10 + salt + a) for a in range(2))
+        t5 = min(run(5, 50 + 10 * (salt + a)) for a in range(2))
+        return (t5 - t1) / 4
+
+    dt = min(slope_estimate(100 * e) for e in range(3))
     out["dag_row_gather_GBps"] = round(K * B * 256 / dt / 1e9, 2)
     log(f"[roofline] random 256-B row gather: "
         f"{out['dag_row_gather_GBps']} GB/s (compile {compile_s:.0f}s)")
@@ -156,9 +168,12 @@ def _measure_gather_ceilings(dag_jnp, l1_np) -> dict:
         np.asarray(o)
         return time.perf_counter() - t
 
-    t1 = min(run2(1, 10 + a) for a in range(3))
-    t5 = min(run2(5, 50 + 10 * a) for a in range(3))
-    dt = (t5 - t1) / 4
+    def slope_estimate2(salt):
+        t1 = min(run2(1, 10 + salt + a) for a in range(2))
+        t5 = min(run2(5, 50 + 10 * (salt + a)) for a in range(2))
+        return (t5 - t1) / 4
+
+    dt = min(slope_estimate2(100 * e) for e in range(3))
     out["l1_word_gather_Geps"] = round(R * 128 * 64 / dt / 1e9, 2)
     log(f"[roofline] L1 lane-gather (Pallas 32-pass): "
         f"{out['l1_word_gather_Geps']} G elem/s")
@@ -409,6 +424,16 @@ def bench_kawpow(on_tpu: bool) -> dict:
     }
     util.update(ceilings)
     if ceilings:
+        # a measured "ceiling" below the kernel's own achieved rate is a
+        # corrupted sample (tunnel hiccup), not physics: clamp up and say
+        # so, keeping the utilization fractions <= 1 by construction
+        if ceilings["dag_row_gather_GBps"] < dag_gbps:
+            ceilings["dag_row_gather_GBps"] = round(dag_gbps, 2)
+            util["dag_ceiling_clamped_to_achieved"] = True
+        if ceilings["l1_word_gather_Geps"] < l1_geps:
+            ceilings["l1_word_gather_Geps"] = round(l1_geps, 2)
+            util["l1_ceiling_clamped_to_achieved"] = True
+        util.update(ceilings)
         util["dag_frac_of_measured_row_gather_ceiling"] = round(
             dag_gbps / ceilings["dag_row_gather_GBps"], 3)
         util["l1_frac_of_measured_lane_gather_ceiling"] = round(
