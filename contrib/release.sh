@@ -33,12 +33,11 @@ SOURCE_DATE_EPOCH="$(git log -1 --format=%ct 2>/dev/null || date +%s)"
 export SOURCE_DATE_EPOCH
 
 python -m pip wheel --no-build-isolation --no-deps -w "$OUT" . -q
-python - <<'EOF'
-import glob, subprocess, sys
-# sdist via setuptools directly (build isolation off: image deps only)
-subprocess.run([sys.executable, "setup.py", "-q", "sdist", "-d"]
-               + glob.glob("release/*")[:1], check=True)
-EOF
+# sdist via setuptools directly (build isolation off: image deps only);
+# the target dir is passed explicitly — globbing release/* could pick a
+# stale prior-version directory, silently dropping the sdist from this
+# release's SHA256SUMS
+python setup.py -q sdist -d "$OUT"
 
 ( cd "$OUT" && sha256sum ./* > SHA256SUMS )
 

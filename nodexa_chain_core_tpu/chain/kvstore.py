@@ -72,6 +72,14 @@ _MISS = object()
 
 _SEG_RE = re.compile(r"^seg_(\d{8})\.dat$")
 
+# block-cache stats, summed over every table in the process.  Stats-only
+# counters: increments happen under each table's own cache lock, so a
+# concurrent increment from another table can (rarely) be lost — an
+# acceptable error for a hit-ratio gauge, chosen over adding a global
+# lock acquisition to the hottest read path in the store.
+_cache_hits = 0
+_cache_misses = 0
+
 
 class KVError(Exception):
     pass
@@ -188,11 +196,14 @@ class _Table:
         self._fd = f.fileno()
 
     def _entry(self, bi: int) -> list:
+        global _cache_hits, _cache_misses
         with self._cache_lock:
             ent = self._cache.get(bi)
             if ent is not None:
                 self._cache.move_to_end(bi)  # LRU touch, O(1)
+                _cache_hits += 1
                 return ent
+            _cache_misses += 1
         off, length = self.offsets[bi]
         # pread: atomic offset read, safe across concurrent readers
         data = os.pread(self._fd, length, off)
@@ -584,3 +595,13 @@ class KVStore:
             self._log = None
         for t in self._state[0]:
             t.close()
+
+
+from ..telemetry import g_metrics as _g_metrics  # noqa: E402
+
+_g_metrics.counter_fn(
+    "nodexa_kvstore_block_cache_hits_total",
+    "KVStore table block-cache hits (all stores)", lambda: _cache_hits)
+_g_metrics.counter_fn(
+    "nodexa_kvstore_block_cache_misses_total",
+    "KVStore table block-cache misses (all stores)", lambda: _cache_misses)

@@ -26,6 +26,7 @@ from ..script.interpreter import (
     verify_script,
 )
 from ..script.script import Script
+from ..telemetry import g_metrics
 from .coins import CoinsViewCache
 from .mempool import CoinsViewMemPool, MempoolEntry, TxMemPool
 from .policy import MAX_STANDARD_TX_SIGOPS_COST, MIN_RELAY_FEE, is_standard_tx
@@ -34,6 +35,17 @@ from .validation import ChainState
 
 class MempoolAcceptError(TxValidationError):
     pass
+
+
+_M_ACCEPT_SECONDS = g_metrics.histogram(
+    "nodexa_mempool_accept_seconds",
+    "AcceptToMemoryPool latency (admitted and rejected submissions)",
+)
+_M_ACCEPTED = g_metrics.counter(
+    "nodexa_mempool_accepted_total", "Transactions admitted to the mempool")
+_M_REJECTED = g_metrics.counter(
+    "nodexa_mempool_rejected_total",
+    "Mempool rejections, labeled by reason code")
 
 
 def accept_to_memory_pool(
@@ -48,10 +60,19 @@ def accept_to_memory_pool(
     Runs under cs_main (ref AcceptToMemoryPool's LOCK(cs_main)): admission
     reads the coins view and tip state that block connection mutates.
     """
-    with chainstate.cs_main:
-        return _accept_to_memory_pool_locked(
-            chainstate, pool, tx, bypass_limits, require_standard
-        )
+    t0 = _time.perf_counter()
+    try:
+        with chainstate.cs_main:
+            entry = _accept_to_memory_pool_locked(
+                chainstate, pool, tx, bypass_limits, require_standard
+            )
+    except MempoolAcceptError as e:
+        _M_REJECTED.inc(reason=e.code)
+        raise
+    finally:
+        _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t0)
+    _M_ACCEPTED.inc()
+    return entry
 
 
 def _accept_to_memory_pool_locked(

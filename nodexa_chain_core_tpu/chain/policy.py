@@ -44,7 +44,11 @@ DUST_FEE = FeeRate(DUST_RELAY_TX_FEE)
 
 def is_dust(out: TxOut, dust_fee: FeeRate = DUST_FEE) -> bool:
     """ref policy.cpp IsDust: output value below the cost of spending it.
-    Asset-carrying and asset-null outputs are exempt (they ride 0 value)."""
+    Asset-carrying and asset-null outputs are exempt (they ride 0 value).
+
+    The p2pkh result of this formula is served to UI clients as
+    getnetworkinfo.dustthreshold (rpc/misc.py); the web UI's coin-control
+    change gate consumes it from there."""
     spk = Script(out.script_pubkey)
     if spk.is_unspendable():
         return False

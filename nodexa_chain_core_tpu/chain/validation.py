@@ -54,6 +54,7 @@ from ..script.interpreter import (
     verify_script,
 )
 from ..script.script import Script
+from ..telemetry import g_metrics, span
 from ..utils.logging import LogFlags, log_print
 from .blockindex import BlockIndex, BlockStatus, Chain
 from .blockstore import BlockStore, BlockUndo, TxUndo
@@ -64,6 +65,23 @@ from .txdb import BlockTreeDB
 
 MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60
 MEDIAN_TIME_SPAN = 11
+
+# ConnectTip stage timings, the queryable form of the BCLog::BENCH line
+# below (ref validation.cpp nTimeReadFromDisk/nTimeConnectTotal/nTimeFlush/
+# nTimePostConnect counters)
+_M_CONNECT_STAGE = g_metrics.histogram(
+    "nodexa_connectblock_stage_seconds",
+    "Per-stage ConnectTip latency (stage=read|connect|flush|post|total)",
+)
+_M_BLOCKS_CONNECTED = g_metrics.counter(
+    "nodexa_blocks_connected_total", "Blocks connected to the active chain")
+_M_BLOCKS_DISCONNECTED = g_metrics.counter(
+    "nodexa_blocks_disconnected_total", "Blocks disconnected (reorgs)")
+_M_TXS_CONNECTED = g_metrics.counter(
+    "nodexa_block_txs_connected_total",
+    "Transactions connected inside blocks")
+_M_HEADERS = g_metrics.counter(
+    "nodexa_headers_processed_total", "Headers accepted into the index")
 # Blocks below tip whose data may never be pruned (reorg + relay window,
 # ref validation.h MIN_BLOCKS_TO_KEEP)
 MIN_BLOCKS_TO_KEEP = 288
@@ -791,7 +809,8 @@ class ChainState:
                     "bad-cb-amount",
                     f"{block.vtx[0].total_output_value()} > {fees + subsidy}",
                 )
-            err = control.wait()
+            with span("connectblock.scripts"):
+                err = control.wait()
             if err:
                 raise BlockValidationError("blk-bad-inputs", err)
         except BlockValidationError:
@@ -915,6 +934,13 @@ class ChainState:
             self.mempool.remove_for_block(block.vtx)
         main_signals.block_connected(block, idx, [])
         t_done = time.perf_counter()
+        _M_CONNECT_STAGE.observe(t_read - t0, stage="read")
+        _M_CONNECT_STAGE.observe(t_connect - t_read, stage="connect")
+        _M_CONNECT_STAGE.observe(t_flush - t_connect, stage="flush")
+        _M_CONNECT_STAGE.observe(t_done - t_flush, stage="post")
+        _M_CONNECT_STAGE.observe(t_done - t0, stage="total")
+        _M_BLOCKS_CONNECTED.inc()
+        _M_TXS_CONNECTED.inc(len(block.vtx))
         log_print(
             LogFlags.BENCH,
             "ConnectTip %s h=%d txs=%d: read %.2fms, connect %.2fms, "
@@ -937,6 +963,7 @@ class ChainState:
         view = CoinsViewCache(self.coins)
         self.disconnect_block(block, idx, view)
         view.flush()
+        _M_BLOCKS_DISCONNECTED.inc()
         if getattr(self, "indexes", None) is not None:
             _, upos = self.positions.get(idx.block_hash, (-1, -1))
             undo = self.block_store.read_undo(upos) if upos >= 0 else None
@@ -1256,28 +1283,38 @@ class ChainState:
             h for h in headers
             if self.block_index.get(h.get_hash(self.params.algo_schedule)) is None
         ]
-        preverified = self._batch_verify_kawpow(new) if new else set()
+        with span("headers.batch_verify"):
+            preverified = self._batch_verify_kawpow(new) if new else set()
         out = []
-        for header in headers:
-            h = header.get_hash(self.params.algo_schedule)
-            existing = self.block_index.get(h)
-            if existing is not None:
-                if existing in self.invalid:
-                    raise BlockValidationError("duplicate-invalid")
-                out.append(existing)
-                continue
-            prev = self.block_index.get(header.hash_prev)
-            if prev is None:
-                raise BlockValidationError("prev-blk-not-found")
-            if prev in self.invalid:
-                raise BlockValidationError("bad-prevblk")
-            self.check_block_header(
-                header,
-                check_pow=id(header) not in preverified,
-                expected_height=prev.height + 1,
-            )
-            self.contextual_check_block_header(header, prev, adjusted_time)
-            out.append(self._add_to_block_index(header))
+        accepted = 0
+        try:
+            for header in headers:
+                h = header.get_hash(self.params.algo_schedule)
+                existing = self.block_index.get(h)
+                if existing is not None:
+                    if existing in self.invalid:
+                        raise BlockValidationError("duplicate-invalid")
+                    out.append(existing)
+                    continue
+                prev = self.block_index.get(header.hash_prev)
+                if prev is None:
+                    raise BlockValidationError("prev-blk-not-found")
+                if prev in self.invalid:
+                    raise BlockValidationError("bad-prevblk")
+                self.check_block_header(
+                    header,
+                    check_pow=id(header) not in preverified,
+                    expected_height=prev.height + 1,
+                )
+                self.contextual_check_block_header(
+                    header, prev, adjusted_time)
+                out.append(self._add_to_block_index(header))
+                accepted += 1
+        finally:
+            # finally: headers indexed BEFORE a mid-batch rejection must
+            # still count (header-spam is when this series matters most)
+            if accepted:
+                _M_HEADERS.inc(accepted)
         return out
 
     @_with_cs_main
@@ -1291,7 +1328,8 @@ class ChainState:
             self.activate_best_chain(block)
             return idx
 
-        self.check_block(block)
+        with span("connectblock.checkblock"):
+            self.check_block(block)
         if block.header.hash_prev:
             prev = self.block_index.get(block.header.hash_prev)
             if prev is None:

@@ -613,13 +613,19 @@ function parseConsoleArg(tok){
   return tok;
 }
 function splitConsoleLine(line){
-  const toks = []; let cur = "", depth = 0, q = false;
+  const toks = []; let cur = "", depth = 0, q = false, esc = false;
   for (const ch of line.trim()) {
+    // inside quotes, a backslash escapes the next char: `"say \"hi\""`
+    // must not toggle the quote tracker
+    if (esc) { cur += ch; esc = false; continue; }
+    if (q && ch === "\\") { cur += ch; esc = true; continue; }
     if (ch === '"') q = !q;
     if (!q && depth === 0 && /\s/.test(ch)) {
       if (cur) { toks.push(cur); cur = ""; } continue; }
-    if ("[{".includes(ch)) depth++;
-    if ("]}".includes(ch)) depth--;
+    // brackets inside a quoted string are literal text, not nesting:
+    // `signmessage addr "a [b"` must not leave depth dangling
+    if (!q && "[{".includes(ch)) depth++;
+    if (!q && "]}".includes(ch)) depth--;
     cur += ch;
   }
   if (cur) toks.push(cur);
@@ -771,16 +777,28 @@ async function viewCoins(){
       const ins = utxos.filter(u=>ccSelected.has(u.txid+":"+u.vout))
         .map(u=>({txid:u.txid, vout:u.vout}));
       if (!ins.length) throw new Error("no inputs selected");
-      const pay = parseFloat(amt.value), f = parseFloat(fee.value)||0;
-      const inTotal = utxos.filter(u=>ccSelected.has(u.txid+":"+u.vout))
-        .reduce((s,u)=>s+u.amount, 0);
-      const change = inTotal - pay - f;
-      if (!(pay > 0) || change < 0)
-        throw new Error("selected "+inTotal.toFixed(8)+
+      // all arithmetic in integer satoshis: binary-float sums leave
+      // ~1e-16 residue that spuriously rejects exact-sweep spends
+      const toSat = x => Math.round(x*1e8);
+      const paySat = toSat(parseFloat(amt.value)||0);
+      const feeSat = toSat(parseFloat(fee.value)||0);
+      const inSat = utxos.filter(u=>ccSelected.has(u.txid+":"+u.vout))
+        .reduce((s,u)=>s+toSat(u.amount), 0);
+      const changeSat = inSat - paySat - feeSat;
+      if (!(paySat > 0) || changeSat < 0)
+        throw new Error("selected "+(inSat/1e8).toFixed(8)+
                         " < amount+fee");
-      const outs = {}; outs[to.value.trim()] = Number(pay.toFixed(8));
-      if (change > 1e-8)
-        outs[await rpc("getrawchangeaddress")] = Number(change.toFixed(8));
+      const outs = {}; outs[to.value.trim()] = Number((paySat/1e8).toFixed(8));
+      // change below the node's dust floor would be rejected as
+      // non-standard: fold it into the fee instead.  The threshold comes
+      // from the node (getnetworkinfo.dustthreshold, derived from
+      // chain/policy.py is_dust) so UI and policy can't desync; the
+      // fallback matches the default policy's p2pkh result.
+      const dustSat = toSat(
+        (await rpc("getnetworkinfo")).dustthreshold || 1638e-8);
+      if (changeSat >= dustSat)
+        outs[await rpc("getrawchangeaddress")] =
+          Number((changeSat/1e8).toFixed(8));
       const raw = await rpc("createrawtransaction",[ins, outs]);
       const signed = await rpc("signrawtransaction",[raw]);
       if (!signed.complete) throw new Error("signing incomplete");

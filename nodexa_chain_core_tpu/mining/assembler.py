@@ -241,13 +241,17 @@ def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
     if algo == "kawpow":
         if kawpow_verifier is None:
             return mine_block_cpu(block, schedule, max_tries=max_batches * 64)
+        from ..parallel.pow_search import record_search_batch
+
         header_hash = block.header.kawpow_header_hash(schedule)[::-1]
         searcher = _hybrid_searcher(kawpow_verifier, batch)
         start = start_nonce
         for _ in range(max_batches):
+            t0 = time.perf_counter()
             found, width = searcher.search_window(
                 header_hash, block.header.height, target, start
             )
+            record_search_batch(time.perf_counter() - t0)
             if on_progress is not None:
                 on_progress(width)
             if found is not None:

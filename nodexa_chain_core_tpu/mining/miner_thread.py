@@ -15,10 +15,17 @@ import threading
 import time
 from typing import Optional
 
+from ..telemetry import g_metrics
 from ..utils.logging import log_printf
 from .assembler import BlockAssembler, mine_block_cpu
 
 SLICE_TRIES = 50_000  # nonces per template round before staleness re-check
+
+_M_HASHRATE = g_metrics.gauge(
+    "nodexa_miner_hashes_per_second",
+    "Built-in miner rolling hashrate (getmininginfo hashespersec)")
+_M_BLOCKS_FOUND = g_metrics.counter(
+    "nodexa_miner_blocks_found_total", "Blocks found by the built-in miner")
 
 
 class BackgroundMiner:
@@ -57,6 +64,7 @@ class BackgroundMiner:
             t.join(timeout=15)  # a native search slice can run for seconds
         self._workers.clear()
         self.node.miner_hashes_per_sec = 0
+        _M_HASHRATE.set(0)
         log_printf("built-in miner stopped")
 
     # -- worker -------------------------------------------------------------
@@ -118,6 +126,7 @@ class BackgroundMiner:
             dt = time.time() - self._window_start
             if dt >= 1.0:
                 self.node.miner_hashes_per_sec = int(self._hashes / dt)
+                _M_HASHRATE.set(self.node.miner_hashes_per_sec)
                 self._hashes = 0
                 self._window_start = time.time()
 
@@ -157,6 +166,7 @@ class BackgroundMiner:
                 if node.chainstate.tip().block_hash != tip_hash:
                     continue
                 node.chainstate.process_new_block(block)
+                _M_BLOCKS_FOUND.inc()
                 log_printf(
                     "miner: found block %s at height %d",
                     block.hash_hex[:16],

@@ -15,9 +15,43 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from . import protocol
 from .addrman import AddrMan
+
+_M_MSGS = g_metrics.counter(
+    "nodexa_p2p_messages_total",
+    "P2P messages, labeled by command and direction")
+_M_BYTES = g_metrics.counter(
+    "nodexa_p2p_bytes_total",
+    "P2P wire bytes (header + payload), labeled by command and direction")
+# the command label is attacker-controlled wire input: unknown commands
+# collapse into one bucket, or a peer spraying random 12-byte commands
+# would grow the label set (and node memory) without bound
+_KNOWN_COMMANDS = frozenset(
+    v for k, v in vars(protocol).items()
+    if k.startswith("MSG_") and isinstance(v, str)
+)
+
+# (command, direction) -> (bound msg counter, bound byte counter): the
+# per-message path pays one dict hit + two locked adds, no kwargs
+# canonicalization (the bound-child fast path registry.py provides for
+# exactly this dispatcher).  Bounded: known commands + "other", 2 dirs.
+_bound_cache: Dict[Tuple[str, str], tuple] = {}
+
+
+def _wire_counters(command: str, direction: str) -> tuple:
+    if command not in _KNOWN_COMMANDS:
+        command = "other"
+    key = (command, direction)
+    bound = _bound_cache.get(key)
+    if bound is None:
+        bound = _bound_cache[key] = (
+            _M_MSGS.labels(command=command, direction=direction),
+            _M_BYTES.labels(command=command, direction=direction),
+        )
+    return bound
 
 
 class Peer:
@@ -66,6 +100,9 @@ class Peer:
                 self.sock.sendall(data)
             self.last_send = time.time()
             self.bytes_sent += len(data)
+            msgs, nbytes = _wire_counters(command, "sent")
+            msgs.inc()
+            nbytes.inc(len(data))
             return True
         except OSError:
             self.disconnect = True
@@ -112,6 +149,26 @@ class ConnMan:
         from .net_processing import NetProcessor
 
         self.processor = NetProcessor(node, self)
+        # scrape-time peer gauges (no hot-path cost; last node wins when a
+        # test harness runs several in-process nodes).  weakref: the
+        # registry outlives every node, and a strong capture would pin the
+        # whole NodeContext graph after shutdown.
+        import weakref
+
+        wself = weakref.ref(self)
+
+        def _peer_count(inbound: bool) -> int:
+            s = wself()
+            if s is None:
+                return 0
+            return sum(1 for p in s.all_peers() if p.inbound == inbound)
+
+        g_metrics.gauge_fn(
+            "nodexa_peers", "Connected peer count by direction",
+            lambda: _peer_count(True), direction="inbound")
+        g_metrics.gauge_fn(
+            "nodexa_peers", "Connected peer count by direction",
+            lambda: _peer_count(False), direction="outbound")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -263,6 +320,9 @@ class ConnMan:
                     self.processor.misbehaving(peer, 10, "bad-checksum")
                     continue
                 peer.last_recv = time.time()
+                msgs, nbytes = _wire_counters(command, "recv")
+                msgs.inc()
+                nbytes.inc(24 + length)
                 self.inbound_queue.put((peer, command, payload))
         self._remove_peer(peer)
 

@@ -13,6 +13,7 @@ from ..core.serialize import ByteReader, ByteWriter
 from ..core.uint256 import u256_hex
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
+from ..telemetry import g_metrics
 from ..utils.logging import LogFlags, log_print
 from . import protocol
 from ..crypto.chacha20 import FastRandomContext
@@ -66,6 +67,10 @@ MAX_HEADERS_RESULTS = 2000
 MAX_BLOCKS_IN_FLIGHT_PER_PEER = 16
 MAX_INV_SIZE = 50_000
 
+_M_MISBEHAVING = g_metrics.counter(
+    "nodexa_p2p_misbehavior_total",
+    "Misbehavior score assignments, labeled by reason")
+
 
 class NetProcessor:
     """ref PeerLogicValidation (net_processing.cpp:2986)."""
@@ -93,6 +98,7 @@ class NetProcessor:
     def misbehaving(self, peer, score: int, reason: str) -> None:
         """ref net_processing.cpp:744 Misbehaving."""
         peer.misbehavior += score
+        _M_MISBEHAVING.inc(reason=reason.split(":")[0])
         log_print(
             LogFlags.NET,
             "peer %d misbehaving +%d (%s) -> %d",

@@ -36,6 +36,13 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         g_logger.enable_categories(g_args.get("debug", "all"))
     log_printf("Nodexa TPU daemon starting: network=%s datadir=%s", network, datadir)
 
+    # span kill switch BEFORE any chainstate work: -reindex/-loadblock/
+    # verify_db below are exactly the high-volume connect windows the
+    # flag exists to keep uninstrumented (-telemetryspans=0)
+    from ..telemetry import set_spans_enabled, summary_lines
+
+    set_spans_enabled(g_args.get_bool("telemetryspans", True))
+
     reindexing = g_args.get_bool("reindex")
     # -prune parameter interaction is validated BEFORE the -reindex wipe so
     # a rejected configuration never destroys the derived databases
@@ -118,6 +125,20 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         node.chainstate.verify_db(check_level=check_level, check_blocks=check_blocks)
     node.scheduler.start()
     node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
+
+    # -debug=telemetry: periodic per-subsystem summary lines from the
+    # metrics registry (spans themselves were gated before chainstate
+    # load, top of this function)
+    from ..utils.logging import LogFlags, log_print
+
+    def _log_telemetry_summary():
+        if not g_logger.will_log(LogFlags.TELEMETRY):
+            return  # skip the registry walk when nobody listens
+        for line in summary_lines():
+            log_print(LogFlags.TELEMETRY, "%s", line)
+
+    node.scheduler.schedule_every(
+        _log_telemetry_summary, g_args.get_int("telemetryinterval", 60))
 
     # mempool limits: -maxmempool (MB) + periodic expiry sweep
     from ..chain.mempool import DEFAULT_MEMPOOL_EXPIRY_HOURS

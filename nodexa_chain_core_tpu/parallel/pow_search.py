@@ -10,6 +10,7 @@ collectives under ``jit`` — no host round-trips inside the scan loop.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional, Tuple
 
@@ -18,7 +19,30 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops import sha256_jax as s256
+from ..telemetry import g_metrics
 from . import mesh as meshlib
+
+_M_BATCH_SECONDS = g_metrics.histogram(
+    "nodexa_pow_batch_seconds",
+    "Device round-trip latency of one sharded nonce-scan batch")
+_M_BATCHES = g_metrics.counter(
+    "nodexa_pow_batches_total", "Sharded search batches dispatched")
+# busy-seconds per wall-second: an EWMA of device duty cycle.  1.0 means
+# the search loop keeps the device saturated; the gap to 1.0 is host-side
+# stall (template assembly, staleness checks, GIL).
+_M_DEVICE_UTIL = g_metrics.ewma(
+    "nodexa_pow_device_utilization",
+    "EWMA fraction of wall time spent inside device search batches",
+    tau=30.0)
+
+
+def record_search_batch(dt: float) -> None:
+    """Fold one device search round-trip into the shared pow metrics
+    (also called by the KawPow hybrid search in mining/assembler.py, so
+    every device-mining era reports through the same series)."""
+    _M_BATCH_SECONDS.observe(dt)
+    _M_BATCHES.inc()
+    _M_DEVICE_UTIL.update(dt)
 
 
 @partial(jax.jit, static_argnames=("batch", "mesh"))
@@ -56,6 +80,7 @@ class Sha256dMiner:
 
     def scan(self, nonce0: int) -> Tuple[bool, int, int]:
         """Scan [nonce0, nonce0+batch). Returns (found, nonce, hash_int)."""
+        t0 = time.perf_counter()
         found, nonce, hash_le = _search_jit(
             self._mid,
             self._tail3,
@@ -64,7 +89,9 @@ class Sha256dMiner:
             self.batch,
             self._mesh,
         )
-        if not bool(found):
+        found_host = bool(found)  # device sync point: batch is complete
+        record_search_batch(time.perf_counter() - t0)
+        if not found_host:
             return False, 0, 0
         limbs = [int(x) for x in jax.device_get(hash_le)]
         h = sum(l << (32 * j) for j, l in enumerate(limbs))

@@ -132,8 +132,27 @@ def getmemoryinfo(node, params: List[Any]):
     return {"locked": {"used": usage.ru_maxrss * 1024}}
 
 
+def getmetrics(node, params: List[Any]):
+    """Node-wide telemetry registry as JSON (the RPC twin of the REST
+    ``/metrics`` Prometheus endpoint).  Optional first param filters
+    metric names by substring."""
+    from ..telemetry import registry_snapshot
+
+    snap = registry_snapshot()
+    if params and params[0]:
+        needle = str(params[0])
+        snap = {k: v for k, v in snap.items() if needle in k}
+    return {"metrics": snap}
+
+
 def getnetworkinfo(node, params: List[Any]):
+    # p2pkh dust threshold in COIN units, derived from the live policy
+    # (chain/policy.py is_dust) so UI clients never hardcode it
+    from ..chain.policy import DUST_FEE
+
+    dust = 3 * DUST_FEE.fee_for(148 + 8 + 1 + 25)
     return {
+        "dustthreshold": dust / COIN,
         "version": __version__,
         "subversion": f"/NodexaTPU:{__version__}/",
         "protocolversion": 70028,
@@ -261,6 +280,7 @@ def register(table: RPCTable) -> None:
         ("util", "signmessagewithprivkey", signmessagewithprivkey,
          ["privkey", "message"]),
         ("control", "getmemoryinfo", getmemoryinfo, []),
+        ("control", "getmetrics", getmetrics, ["filter"]),
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),
         ("network", "getconnectioncount", getconnectioncount, []),

@@ -1,13 +1,15 @@
 """REST interface (parity: reference src/rest.cpp:569-578 — read-only
 endpoints /rest/tx, /rest/block, /rest/chaininfo, /rest/mempool/info,
-/rest/mempool/contents, /rest/getutxos) plus a minimal HTML status page at
-/ (the framework's stand-in for the reference's Qt status surface)."""
+/rest/mempool/contents, /rest/getutxos), a Prometheus scrape endpoint at
+/metrics, and a minimal HTML status page at / (the framework's stand-in
+for the reference's Qt status surface)."""
 
 from __future__ import annotations
 
 from typing import Tuple
 
 from ..core.uint256 import u256_from_hex, u256_hex
+from ..telemetry.exposition import PROMETHEUS_CONTENT_TYPE, prometheus_text
 
 
 def make_rest_handler(node):
@@ -25,6 +27,9 @@ def make_rest_handler(node):
             parts = [p for p in path.split("?")[0].split("/") if p]
             if not parts:
                 return 200, _status_page(node)
+            if parts[0] == "metrics":
+                # Prometheus text exposition of the node-wide registry
+                return 200, prometheus_text(), PROMETHEUS_CONTENT_TYPE
             if parts[0] == "ui":
                 # the embedded web wallet/explorer (the framework's GUI
                 # surface standing in for reference src/qt/)

@@ -158,14 +158,16 @@ class HTTPRPCServer:
             def log_message(self, fmt, *args):  # route into our logger
                 log_print(LogFlags.HTTP, "http: " + fmt, *args)
 
-            def _reply(self, code: int, payload: dict | list | str) -> None:
+            def _reply(self, code: int, payload: dict | list | str,
+                       ctype: Optional[str] = None) -> None:
                 if isinstance(payload, str):
                     body = payload.encode()
-                    # string payloads are HTML pages (status page, /ui)
-                    ctype = "text/html; charset=utf-8"
+                    # string payloads default to HTML (status page, /ui);
+                    # REST endpoints may override (e.g. /metrics text)
+                    ctype = ctype or "text/html; charset=utf-8"
                 else:
                     body = json.dumps(payload).encode()
-                    ctype = "application/json"
+                    ctype = ctype or "application/json"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -211,8 +213,9 @@ class HTTPRPCServer:
                 if handler is None:
                     self._reply(404, {"error": "REST disabled"})
                     return
-                code, payload = handler(self.path)
-                self._reply(code, payload)
+                # handlers return (code, payload) or (code, payload, ctype)
+                res = handler(self.path)
+                self._reply(*res)
 
         class _Server(ThreadingHTTPServer):
             daemon_threads = True

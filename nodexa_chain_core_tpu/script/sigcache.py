@@ -38,3 +38,17 @@ class SignatureCache:
 
 
 signature_cache = SignatureCache()
+
+# scrape-time telemetry: the cache already counts, so the hot verify path
+# pays nothing extra (ref getmemoryinfo-style pull model)
+from ..telemetry import g_metrics as _g_metrics  # noqa: E402
+
+_g_metrics.counter_fn(
+    "nodexa_sigcache_hits_total", "Signature cache hits",
+    lambda: signature_cache.hits)
+_g_metrics.counter_fn(
+    "nodexa_sigcache_misses_total", "Signature cache misses",
+    lambda: signature_cache.misses)
+_g_metrics.gauge_fn(
+    "nodexa_sigcache_entries", "Signature cache live entries",
+    lambda: len(signature_cache._store))

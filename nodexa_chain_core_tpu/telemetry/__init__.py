@@ -1,0 +1,41 @@
+"""Node-wide telemetry: metrics registry, trace spans, exposition.
+
+The in-process analogue of the reference's scattered instrumentation —
+``-debug=bench`` ConnectBlock timings (ref validation.cpp nTimeConnectTotal
+counters), ``getnettotals``/``getrpcinfo`` counters, and the miners'
+hashrate trackers — unified behind one thread-safe registry that every
+subsystem writes into and three surfaces read out of:
+
+- ``GET /metrics`` on the REST server (Prometheus text exposition),
+- the ``getmetrics`` RPC (JSON snapshot of the same registry),
+- periodic ``-debug=telemetry`` summary lines through the Logger.
+
+Import rules: this package depends on the standard library only, so any
+layer (chain, net, mining, script, utils) may import it without cycles.
+"""
+
+from .registry import (
+    Counter,
+    EWMARate,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    g_metrics,
+)
+from .spans import span, set_spans_enabled, spans_enabled
+from .exposition import prometheus_text, registry_snapshot, summary_lines
+
+__all__ = [
+    "Counter",
+    "EWMARate",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "g_metrics",
+    "span",
+    "set_spans_enabled",
+    "spans_enabled",
+    "prometheus_text",
+    "registry_snapshot",
+    "summary_lines",
+]

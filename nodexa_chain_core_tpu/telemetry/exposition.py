@@ -1,0 +1,202 @@
+"""Registry exposition: Prometheus text format, JSON snapshot, log lines.
+
+- :func:`prometheus_text` renders the classic text exposition format
+  (``text/plain; version=0.0.4``) served at ``GET /metrics``.
+- :func:`registry_snapshot` renders the same samples as a JSON-able dict
+  for the ``getmetrics`` RPC and ``tools/metrics_snapshot.py``.
+- :func:`summary_lines` compresses the registry into a handful of
+  per-subsystem lines for the periodic ``-debug=telemetry`` log.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .registry import (
+    CallbackMetric,
+    Counter,
+    EWMARate,
+    Gauge,
+    Histogram,
+    LabelKey,
+    Metric,
+    MetricsRegistry,
+    g_metrics,
+)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def ensure_default_instrumentation() -> None:
+    """Import the lazily-loaded subsystems whose scrape-time callbacks
+    register at module import (sigcache, jitcache, kvstore), so /metrics
+    and getmetrics expose the full series set even before any activity
+    has touched those paths.  Idempotent: after the first call these are
+    sys.modules hits."""
+    import importlib
+
+    for mod in (
+        "script.sigcache",
+        "utils.jitcache",
+        "chain.kvstore",
+        "chain.mempool_accept",
+        "mining.miner_thread",
+        "parallel.pow_search",
+        "net.connman",
+        "net.net_processing",
+    ):
+        try:
+            importlib.import_module(f"nodexa_chain_core_tpu.{mod}")
+        except Exception:  # noqa: BLE001 — exposition must not die on a
+            pass  # broken optional subsystem
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(key: LabelKey, extra: Optional[List[tuple]] = None) -> str:
+    pairs = list(key) + (extra or [])
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(registry: MetricsRegistry = g_metrics) -> str:
+    """Full registry in the Prometheus text exposition format."""
+    if registry is g_metrics:
+        ensure_default_instrumentation()
+    out: List[str] = []
+    for m in registry.metrics():
+        samples = m.collect()
+        if m.help:
+            out.append(f"# HELP {m.name} {m.help}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        if not samples:
+            # quiet families still advertise themselves with one zero
+            # sample, so scrapers see the full catalogue from boot
+            if isinstance(m, Histogram):
+                for boundary in m.buckets:
+                    out.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels((), [('le', repr(boundary))])} 0")
+                out.append(
+                    f"{m.name}_bucket{_fmt_labels((), [('le', '+Inf')])} 0")
+                out.append(f"{m.name}_sum 0")
+                out.append(f"{m.name}_count 0")
+            else:
+                out.append(f"{m.name} 0")
+            continue
+        if isinstance(m, Histogram):
+            for key, (counts, total, count) in samples:
+                cum = 0
+                for boundary, c in zip(m.buckets, counts):
+                    cum += c
+                    out.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(key, [('le', repr(boundary))])}"
+                        f" {cum}"
+                    )
+                out.append(
+                    f"{m.name}_bucket{_fmt_labels(key, [('le', '+Inf')])}"
+                    f" {count}"
+                )
+                out.append(f"{m.name}_sum{_fmt_labels(key)} {repr(total)}")
+                out.append(f"{m.name}_count{_fmt_labels(key)} {count}")
+        else:
+            for key, value in samples:
+                out.append(f"{m.name}{_fmt_labels(key)} {_fmt_value(value)}")
+    return "\n".join(out) + "\n"
+
+
+def _snapshot_one(m: Metric) -> dict:
+    entry: dict = {"type": m.kind, "help": m.help, "values": []}
+    if isinstance(m, Histogram):
+        for key, (counts, total, count) in m.collect():
+            cum, buckets = 0, {}
+            for boundary, c in zip(m.buckets, counts):
+                cum += c
+                buckets[repr(boundary)] = cum
+            entry["values"].append({
+                "labels": dict(key),
+                "buckets": buckets,
+                "sum": total,
+                "count": count,
+            })
+    else:
+        for key, value in m.collect():
+            entry["values"].append({"labels": dict(key), "value": value})
+    return entry
+
+
+def registry_snapshot(registry: MetricsRegistry = g_metrics) -> dict:
+    """JSON-able snapshot: {metric_name: {type, help, values}}."""
+    if registry is g_metrics:
+        ensure_default_instrumentation()
+    out: Dict[str, dict] = {}
+    for m in registry.metrics():
+        entry = _snapshot_one(m)
+        if entry["values"]:
+            out[m.name] = entry
+    return out
+
+
+# metric-name prefix -> summary category for the periodic log lines
+_SUMMARY_GROUPS = (
+    ("nodexa_connectblock", "chain"),
+    ("nodexa_blocks", "chain"),
+    ("nodexa_block_txs", "chain"),
+    ("nodexa_headers", "chain"),
+    ("nodexa_mempool", "mempool"),
+    ("nodexa_p2p", "net"),
+    ("nodexa_peers", "net"),
+    ("nodexa_miner", "mining"),
+    ("nodexa_pow", "mining"),
+    ("nodexa_sigcache", "cache"),
+    ("nodexa_jitcache", "cache"),
+    ("nodexa_kvstore", "cache"),
+    ("nodexa_span", "spans"),
+)
+
+
+def _group_of(name: str) -> str:
+    for prefix, group in _SUMMARY_GROUPS:
+        if name.startswith(prefix):
+            return group
+    return "other"
+
+
+def summary_lines(registry: MetricsRegistry = g_metrics) -> List[str]:
+    """One compact ``telemetry: <group> k=v ...`` line per subsystem."""
+    groups: Dict[str, List[str]] = {}
+    for m in registry.metrics():
+        samples = m.collect()
+        if not samples:
+            continue
+        short = m.name.removeprefix("nodexa_")
+        parts = groups.setdefault(_group_of(m.name), [])
+        if isinstance(m, Histogram):
+            count = sum(c for _, (_, _, c) in samples)
+            total = sum(s for _, (_, s, _) in samples)
+            mean_ms = (total / count * 1e3) if count else 0.0
+            parts.append(f"{short}.count={count}")
+            parts.append(f"{short}.mean_ms={mean_ms:.2f}")
+        elif isinstance(m, (Counter, CallbackMetric, Gauge, EWMARate)):
+            if len(samples) == 1 and samples[0][0] == ():
+                parts.append(f"{short}={_fmt_value(samples[0][1])}")
+            else:
+                total = sum(v for _, v in samples)
+                parts.append(f"{short}.sum={_fmt_value(total)}")
+    return [
+        f"telemetry: {group} " + " ".join(parts)
+        for group, parts in sorted(groups.items())
+    ]

@@ -1,0 +1,393 @@
+"""Thread-safe metrics registry: counters, gauges, histograms, EWMA rates.
+
+Shaped after the Prometheus client data model (metric families with label
+sets) but dependency-free and sized for a node's hot paths:
+
+- one ``threading.Lock`` per metric family, held only for a dict update;
+- label sets are keyword arguments, canonicalized to a sorted tuple key;
+- ``labels(...)`` returns a bound child with the key pre-resolved, so a
+  per-command counter in the P2P dispatcher costs one lock + one add;
+- callback counters/gauges sample an existing counter variable at scrape
+  time (zero hot-path overhead for subsystems that already count, e.g.
+  the sigcache's hits/misses).
+
+Time is injected (``time_fn``) so EWMA decay is unit-testable.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+# Latency buckets (seconds): 100us .. 10s, roughly log-spaced.  Chosen so
+# both a mempool script check (~ms) and a full ConnectTip flush (~100ms+)
+# land mid-range.
+DEFAULT_TIME_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Metric:
+    """Base: name/help/type plus the family-wide lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+    def collect(self) -> List[Tuple[LabelKey, object]]:
+        """(label_key, value) samples; value shape depends on kind."""
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def labels(self, **labels) -> "_BoundCounter":
+        return _BoundCounter(self, _label_key(labels))
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def collect(self):
+        with self._lock:
+            return sorted(self._values.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+class _BoundCounter:
+    """Pre-resolved label child: hot paths skip kwargs canonicalization."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: Counter, key: LabelKey):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        m = self._metric
+        with m._lock:
+            m._values[self._key] = m._values.get(self._key, 0.0) + amount
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self):
+        with self._lock:
+            return sorted(self._values.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+class CallbackMetric(Metric):
+    """Samples a callable at scrape time (counter or gauge semantics).
+
+    Registration is last-writer-wins per (name, labels): in-process test
+    harnesses construct several nodes against the one global registry, and
+    the newest subsystem instance is the one worth scraping.
+    """
+
+    def __init__(self, name: str, help_text: str, kind: str):
+        super().__init__(name, help_text)
+        self.kind = kind
+        self._fns: Dict[LabelKey, Callable[[], float]] = {}
+
+    def set_fn(self, fn: Callable[[], float], **labels) -> None:
+        with self._lock:
+            self._fns[_label_key(labels)] = fn
+
+    def collect(self):
+        with self._lock:
+            fns = sorted(self._fns.items())
+        out = []
+        for key, fn in fns:
+            try:
+                out.append((key, float(fn())))
+            except Exception:  # noqa: BLE001 — a dead callback must not
+                continue  # poison the whole scrape
+        return out
+
+    def clear(self) -> None:
+        # registry.reset() keeps callbacks: they sample live subsystem
+        # state, and dropping them would silently unhook sigcache & co.
+        pass
+
+
+class _HistData:
+    __slots__ = ("bucket_counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (ref the Prometheus classic histogram).
+
+    ``observe`` is O(log buckets) via bisect + one lock; boundaries are
+    immutable after construction so collection never re-buckets.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Tuple[float, ...] = DEFAULT_TIME_BUCKETS):
+        super().__init__(name, help_text)
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError("bucket boundaries must be sorted and non-empty")
+        self.buckets = tuple(float(b) for b in buckets)
+        self._data: Dict[LabelKey, _HistData] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        # bisect_left: le boundaries are INCLUSIVE (Prometheus semantics)
+        bi = bisect_left(self.buckets, value)
+        with self._lock:
+            d = self._data.get(key)
+            if d is None:
+                d = self._data[key] = _HistData(len(self.buckets) + 1)
+            d.bucket_counts[bi] += 1
+            d.sum += value
+            d.count += 1
+
+    def labels(self, **labels) -> "_BoundHistogram":
+        return _BoundHistogram(self, _label_key(labels))
+
+    def snapshot(self, **labels) -> Optional[dict]:
+        """{"buckets": {le: cumulative}, "sum": s, "count": n} or None."""
+        with self._lock:
+            d = self._data.get(_label_key(labels))
+            if d is None:
+                return None
+            counts = list(d.bucket_counts)
+            s, n = d.sum, d.count
+        cum, out = 0, {}
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out[b] = cum
+        return {"buckets": out, "sum": s, "count": n}
+
+    def collect(self):
+        with self._lock:
+            return sorted(
+                (key, (list(d.bucket_counts), d.sum, d.count))
+                for key, d in self._data.items()
+            )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+class _BoundHistogram:
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: Histogram, key: LabelKey):
+        self._metric = metric
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        m = self._metric
+        bi = bisect_left(m.buckets, value)
+        with m._lock:
+            d = m._data.get(self._key)
+            if d is None:
+                d = m._data[self._key] = _HistData(len(m.buckets) + 1)
+            d.bucket_counts[bi] += 1
+            d.sum += value
+            d.count += 1
+
+
+class EWMARate(Metric):
+    """Exponentially-weighted events-per-second rate (ref the reference
+    miners' rolling nHashesPerSec window, generalized).
+
+    ``update(n)`` folds n events in; ``value()`` reads the decayed rate.
+    With ``tau`` seconds of time constant, a burst decays to 1/e of its
+    contribution after tau idle seconds.  Exposed as a gauge.
+    """
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str = "", tau: float = 60.0,
+                 time_fn: Callable[[], float] = time.monotonic):
+        super().__init__(name, help_text)
+        self.tau = float(tau)
+        self._time = time_fn
+        self._state: Dict[LabelKey, Tuple[float, float]] = {}  # (rate, t)
+
+    def _fold(self, key: LabelKey, n: float, now: float) -> float:
+        rate, t_last = self._state.get(key, (0.0, now))
+        dt = max(now - t_last, 1e-9)
+        alpha = 1.0 - math.exp(-dt / self.tau)
+        # treat the n events as spread over dt, then blend toward it
+        inst = n / dt
+        rate += alpha * (inst - rate)
+        self._state[key] = (rate, now)
+        return rate
+
+    def update(self, n: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        now = self._time()
+        with self._lock:
+            self._fold(key, n, now)
+
+    def value(self, **labels) -> float:
+        key = _label_key(labels)
+        now = self._time()
+        with self._lock:
+            # decay-only read: fold zero events up to now
+            if key not in self._state:
+                return 0.0
+            return self._fold(key, 0.0, now)
+
+    def collect(self):
+        with self._lock:
+            keys = sorted(self._state)
+        return [(key, self.value(**dict(key))) for key in keys]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._state.clear()
+
+
+class MetricsRegistry:
+    """Name -> metric family map; get-or-create constructors are idempotent
+    so module-level handles survive re-imports and multiple nodes."""
+
+    def __init__(self) -> None:
+        self._metrics: "Dict[str, Metric]" = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, factory) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        m = self._get_or_create(name, lambda: Counter(name, help_text))
+        if not isinstance(m, Counter):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        return m
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        m = self._get_or_create(name, lambda: Gauge(name, help_text))
+        if not isinstance(m, Gauge):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        return m
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_TIME_BUCKETS
+                  ) -> Histogram:
+        m = self._get_or_create(
+            name, lambda: Histogram(name, help_text, buckets))
+        if not isinstance(m, Histogram):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        return m
+
+    def ewma(self, name: str, help_text: str = "", tau: float = 60.0,
+             time_fn: Callable[[], float] = time.monotonic) -> EWMARate:
+        m = self._get_or_create(
+            name, lambda: EWMARate(name, help_text, tau, time_fn))
+        if not isinstance(m, EWMARate):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        return m
+
+    def counter_fn(self, name: str, help_text: str,
+                   fn: Callable[[], float], **labels) -> CallbackMetric:
+        m = self._get_or_create(
+            name, lambda: CallbackMetric(name, help_text, "counter"))
+        if not isinstance(m, CallbackMetric):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        m.set_fn(fn, **labels)
+        return m
+
+    def gauge_fn(self, name: str, help_text: str,
+                 fn: Callable[[], float], **labels) -> CallbackMetric:
+        m = self._get_or_create(
+            name, lambda: CallbackMetric(name, help_text, "gauge"))
+        if not isinstance(m, CallbackMetric):
+            raise TypeError(f"{name} already registered as {m.kind}")
+        m.set_fn(fn, **labels)
+        return m
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[Metric]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def reset(self) -> None:
+        """Clear every family's samples (families stay registered) —
+        test/bench isolation for the process-global registry."""
+        for m in self.metrics():
+            m.clear()
+
+
+# The process-global registry every subsystem instruments into (the
+# analogue of the reference's scattered per-subsystem statics, unified).
+g_metrics = MetricsRegistry()

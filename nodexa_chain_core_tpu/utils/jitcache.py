@@ -28,7 +28,52 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from ..telemetry import g_metrics
+
 _enabled: Optional[str] = None
+
+# persistent-compile-cache hit/miss, fed by jax.monitoring events (the
+# supported observability hook: jax records cache_hits/cache_misses per
+# compile request).  Counter reads are scrape-time callbacks.
+hits = 0
+misses = 0
+
+g_metrics.counter_fn(
+    "nodexa_jitcache_hits_total",
+    "Persistent XLA compile-cache hits", lambda: hits)
+g_metrics.counter_fn(
+    "nodexa_jitcache_misses_total",
+    "Persistent XLA compile-cache misses (full compiles)", lambda: misses)
+g_metrics.gauge_fn(
+    "nodexa_jitcache_enabled",
+    "1 when the persistent XLA compile cache is active",
+    lambda: 0 if _enabled is None else 1)
+
+_listener_installed = False
+
+
+def _install_cache_listener() -> None:
+    """Count compile-cache hits/misses via jax.monitoring (idempotent).
+
+    Event names are stable-in-practice but not a contract; a jax that
+    stops emitting them just leaves the counters at zero."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax import monitoring
+    except ImportError:
+        return
+
+    def _on_event(event: str, **kw) -> None:
+        global hits, misses
+        if event == "/jax/compilation_cache/cache_hits":
+            hits += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            misses += 1
+
+    monitoring.register_event_listener(_on_event)
+    _listener_installed = True
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
@@ -46,6 +91,7 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
                          "nodexa_tpu_jit"),
         )
     os.makedirs(cache_dir, exist_ok=True)
+    _install_cache_listener()
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)

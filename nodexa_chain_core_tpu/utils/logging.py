@@ -34,6 +34,7 @@ class LogFlags(IntFlag):
     ASSETS = 1 << 21
     VALIDATION = 1 << 22
     MINING = 1 << 23
+    TELEMETRY = 1 << 24
     ALL = ~0
 
 
@@ -42,6 +43,7 @@ _CATEGORY_NAMES = {
     "bench": LogFlags.BENCH, "zmq": LogFlags.ZMQ, "db": LogFlags.DB,
     "rpc": LogFlags.RPC, "addrman": LogFlags.ADDRMAN, "assets": LogFlags.ASSETS,
     "validation": LogFlags.VALIDATION, "mining": LogFlags.MINING,
+    "telemetry": LogFlags.TELEMETRY,
     "coindb": LogFlags.COINDB, "all": LogFlags.ALL, "1": LogFlags.ALL,
 }
 

@@ -56,7 +56,11 @@ def test_batch_actually_spans_all_devices(epoch, mesh):
         np.zeros((64, 8), np.uint32), NamedSharding(mesh, P(("header", "lane"), None))
     )
     assert len(hw.sharding.device_set) == 8
-    shard_rows = {s.index[0] for s in hw.addressable_shards}
+    # slice objects are unhashable before Python 3.12: set-key on the
+    # (start, stop) pair instead of the raw slice
+    shard_rows = {
+        (s.index[0].start, s.index[0].stop) for s in hw.addressable_shards
+    }
     assert len(shard_rows) == 8, "batch axis is not split 8 ways"
 
     slab = jax.device_put(dag, NamedSharding(mesh, P()))

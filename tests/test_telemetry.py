@@ -1,0 +1,441 @@
+"""Telemetry subsystem: registry semantics, span tracing, Prometheus/JSON
+exposition, the getmetrics RPC and REST /metrics surfaces, and the
+end-to-end assertion that chain activity moves the expected series."""
+
+import json
+import re
+import threading
+
+import pytest
+
+from nodexa_chain_core_tpu.telemetry import (
+    g_metrics,
+    prometheus_text,
+    registry_snapshot,
+    set_spans_enabled,
+    span,
+    spans_enabled,
+    summary_lines,
+)
+from nodexa_chain_core_tpu.telemetry.registry import MetricsRegistry
+from nodexa_chain_core_tpu.telemetry.spans import span_hist
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_basic_and_labels():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "help")
+    c.inc()
+    c.inc(2.5)
+    c.inc(3, command="tx")
+    assert c.value() == 3.5
+    assert c.value(command="tx") == 3
+    assert c.total() == 6.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_counter_label_order_canonical():
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+    c.inc(1, a="x", b="y")
+    c.inc(1, b="y", a="x")
+    assert c.value(a="x", b="y") == 2
+
+
+def test_bound_counter_child():
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+    child = c.labels(command="inv")
+    child.inc()
+    child.inc(4)
+    assert c.value(command="inv") == 5
+
+
+def test_registry_get_or_create_idempotent_and_kind_checked():
+    r = MetricsRegistry()
+    a = r.counter("t_total")
+    assert r.counter("t_total") is a
+    with pytest.raises(TypeError):
+        r.gauge("t_total")
+
+
+def test_gauge_set_inc_dec():
+    r = MetricsRegistry()
+    g = r.gauge("t_gauge")
+    g.set(10)
+    g.inc(5)
+    g.dec(3)
+    assert g.value() == 12
+    g.set(2, direction="inbound")
+    assert g.value(direction="inbound") == 2
+
+
+def test_histogram_bucket_placement_and_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("t_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.555)
+    # cumulative counts at each boundary
+    assert snap["buckets"][0.01] == 1
+    assert snap["buckets"][0.1] == 2
+    assert snap["buckets"][1.0] == 3  # 5.0 only lands in +Inf
+
+
+def test_histogram_boundary_value_goes_into_le_bucket():
+    r = MetricsRegistry()
+    h = r.histogram("t_seconds", buckets=(0.1, 1.0))
+    h.observe(0.1)  # le="0.1" is inclusive (Prometheus semantics)
+    assert h.snapshot()["buckets"][0.1] == 1
+
+
+def test_histogram_rejects_unsorted_buckets():
+    r = MetricsRegistry()
+    with pytest.raises(ValueError):
+        r.histogram("t_seconds", buckets=(1.0, 0.1))
+
+
+def test_ewma_rate_converges_and_decays():
+    t = [0.0]
+    r = MetricsRegistry()
+    e = r.ewma("t_rate", tau=10.0, time_fn=lambda: t[0])
+    for _ in range(100):
+        t[0] += 1.0
+        e.update(5)  # 5 events/sec steady state
+    assert e.value() == pytest.approx(5.0, rel=0.05)
+    t[0] += 100.0  # long idle: decayed well below steady state
+    assert e.value() < 0.1
+
+
+def test_thread_safety_exact_totals():
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+    h = r.histogram("t_seconds", buckets=(0.5,))
+    n_threads, per_thread = 8, 2000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc(1, worker="w")
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert c.value(worker="w") == n_threads * per_thread
+    assert h.snapshot()["count"] == n_threads * per_thread
+
+
+def test_registry_reset_clears_values_keeps_families():
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+    c.inc(5)
+    r.reset()
+    assert c.value() == 0
+    assert r.get("t_total") is c
+
+
+def test_callback_metrics_sample_live_state():
+    r = MetricsRegistry()
+    box = {"n": 1}
+    r.counter_fn("t_cb_total", "h", lambda: box["n"])
+    assert r.get("t_cb_total").collect() == [((), 1.0)]
+    box["n"] = 7
+    assert r.get("t_cb_total").collect() == [((), 7.0)]
+    # a raising callback is skipped, not fatal
+    r.gauge_fn("t_bad", "h", lambda: 1 / 0)
+    assert r.get("t_bad").collect() == []
+
+
+# ---------------------------------------------------------------- spans
+
+
+def test_span_records_into_histogram():
+    before = span_hist.snapshot(span="test.span")
+    before_n = before["count"] if before else 0
+    with span("test.span"):
+        pass
+    after = span_hist.snapshot(span="test.span")
+    assert after["count"] == before_n + 1
+
+
+def test_span_disabled_records_nothing():
+    with span("test.off"):
+        pass
+    n1 = span_hist.snapshot(span="test.off")["count"]
+    set_spans_enabled(False)
+    try:
+        assert not spans_enabled()
+        with span("test.off"):
+            pass
+        assert span_hist.snapshot(span="test.off")["count"] == n1
+    finally:
+        set_spans_enabled(True)
+
+
+def test_span_records_even_on_exception():
+    before = span_hist.snapshot(span="test.exc")
+    before_n = before["count"] if before else 0
+    with pytest.raises(RuntimeError):
+        with span("test.exc"):
+            raise RuntimeError("boom")
+    assert span_hist.snapshot(span="test.exc")["count"] == before_n + 1
+
+
+# ----------------------------------------------------------- exposition
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$"
+)
+
+
+def test_prometheus_text_format_valid():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "a counter")
+    c.inc(3, command="tx")
+    h = r.histogram("t_seconds", "a hist", buckets=(0.1, 1.0))
+    h.observe(0.05, stage="read")
+    r.gauge("t_gauge", "a gauge").set(2.5)
+    text = prometheus_text(r)
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ", line)
+        else:
+            assert _SAMPLE_LINE.match(line), line
+    assert "# TYPE t_total counter" in text
+    assert 't_total{command="tx"} 3' in text
+    assert "# TYPE t_seconds histogram" in text
+    assert 't_seconds_bucket{stage="read",le="+Inf"} 1' in text
+    assert 't_seconds_count{stage="read"} 1' in text
+    assert "t_gauge 2.5" in text
+
+
+def test_prometheus_histogram_bucket_monotone_and_inf_equals_count():
+    r = MetricsRegistry()
+    h = r.histogram("t_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.0001):
+        h.observe(v)
+    text = prometheus_text(r)
+    counts = [
+        int(m.group(1))
+        for m in re.finditer(r't_seconds_bucket\{le="[^"]+"\} (\d+)', text)
+    ]
+    assert counts == sorted(counts)
+    assert counts[-1] == 5  # +Inf
+    assert "t_seconds_count 5" in text
+
+
+def test_prometheus_label_escaping():
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+    c.inc(1, reason='has "quotes" and \\slash\\')
+    text = prometheus_text(r)
+    assert r't_total{reason="has \"quotes\" and \\slash\\"} 1' in text
+
+
+def test_snapshot_is_json_serializable_and_mirrors_registry():
+    r = MetricsRegistry()
+    r.counter("t_total").inc(2, k="v")
+    r.histogram("t_seconds", buckets=(1.0,)).observe(0.5)
+    snap = registry_snapshot(r)
+    json.dumps(snap)  # must not raise
+    assert snap["t_total"]["type"] == "counter"
+    assert snap["t_total"]["values"][0] == {"labels": {"k": "v"}, "value": 2}
+    hv = snap["t_seconds"]["values"][0]
+    assert hv["count"] == 1 and hv["sum"] == 0.5
+
+
+def test_summary_lines_group_by_subsystem():
+    lines = summary_lines()
+    assert any(l.startswith("telemetry: ") for l in lines)
+
+
+# ----------------------------------------------- node surfaces (RPC/REST)
+
+
+@pytest.fixture()
+def node():
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    return NodeContext(network="regtest")
+
+
+def test_getmetrics_rpc_shape(node):
+    from nodexa_chain_core_tpu.rpc.misc import getmetrics
+
+    out = getmetrics(node, [])
+    assert set(out) == {"metrics"}
+    metrics = out["metrics"]
+    # always-present callback families (wired at exposition time)
+    assert "nodexa_sigcache_hits_total" in metrics
+    assert "nodexa_kvstore_block_cache_hits_total" in metrics
+    for entry in metrics.values():
+        assert entry["type"] in ("counter", "gauge", "histogram")
+        assert isinstance(entry["values"], list)
+    json.dumps(out)  # RPC result must be JSON-clean
+    filtered = getmetrics(node, ["sigcache"])["metrics"]
+    assert filtered and all("sigcache" in k for k in filtered)
+
+
+def test_getmetrics_registered_in_rpc_table():
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    table = register_all(RPCTable())
+    assert "getmetrics" in table.commands()
+
+
+def test_rest_metrics_endpoint(node):
+    from nodexa_chain_core_tpu.rpc.rest import make_rest_handler
+    from nodexa_chain_core_tpu.telemetry.exposition import (
+        PROMETHEUS_CONTENT_TYPE,
+    )
+
+    handler = make_rest_handler(node)
+    res = handler("/metrics")
+    assert len(res) == 3
+    code, body, ctype = res
+    assert code == 200
+    assert ctype == PROMETHEUS_CONTENT_TYPE
+    for series in (
+        "nodexa_connectblock_stage_seconds",  # per-stage ConnectBlock
+        "nodexa_mempool_accept_seconds",      # mempool accept latency
+        "nodexa_p2p_messages_total",          # per-command P2P counters
+        "nodexa_sigcache_hits_total",         # sigcache hit ratio
+        "nodexa_jitcache_hits_total",         # jitcache hit ratio
+        "nodexa_miner_hashes_per_second",     # miner hashrate
+    ):
+        assert f"# TYPE {series}" in body, series
+    # other endpoints keep the legacy 2-tuple shape
+    assert len(handler("/rest/chaininfo.json")) == 2
+
+
+# --------------------------------------------------------------- e2e
+
+
+def _mine_one(cs, params, spk):
+    from nodexa_chain_core_tpu.mining.assembler import (
+        BlockAssembler,
+        mine_block_cpu,
+    )
+
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    return blk
+
+
+def test_e2e_block_connect_and_mempool_accept_move_series():
+    from nodexa_chain_core_tpu.chain.mempool_accept import (
+        MempoolAcceptError,
+        accept_to_memory_pool,
+    )
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+    from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+    params = regtest_params()
+    cs = ChainState(params)
+    pool = TxMemPool()
+    cs.mempool = pool
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+
+    blocks_c = g_metrics.get("nodexa_blocks_connected_total")
+    stage_h = g_metrics.get("nodexa_connectblock_stage_seconds")
+    accept_h = g_metrics.get("nodexa_mempool_accept_seconds")
+    accepted_c = g_metrics.get("nodexa_mempool_accepted_total")
+    rejected_c = g_metrics.get("nodexa_mempool_rejected_total")
+
+    b0 = blocks_c.total()
+    s0 = {  # per-stage counts before
+        st: (stage_h.snapshot(stage=st) or {"count": 0})["count"]
+        for st in ("read", "connect", "flush", "post", "total")
+    }
+    n = COINBASE_MATURITY + 1
+    first = _mine_one(cs, params, spk)
+    for _ in range(n - 1):
+        _mine_one(cs, params, spk)
+    assert blocks_c.total() == b0 + n
+    for st, before in s0.items():
+        assert stage_h.snapshot(stage=st)["count"] == before + n, st
+
+    # mempool accept: spend the (now mature) first coinbase
+    cb = first.vtx[0]
+    spend = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+        vout=[TxOut(value=cb.vout[0].value - 10000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, spend, 0, spk)
+    a0, h0 = accepted_c.total(), accept_h.snapshot()
+    h0n = h0["count"] if h0 else 0
+    accept_to_memory_pool(cs, pool, spend)
+    assert accepted_c.total() == a0 + 1
+    assert accept_h.snapshot()["count"] == h0n + 1
+
+    # rejection path: resubmitting is txn-already-in-mempool
+    r0 = rejected_c.value(reason="txn-already-in-mempool")
+    with pytest.raises(MempoolAcceptError):
+        accept_to_memory_pool(cs, pool, spend)
+    assert rejected_c.value(reason="txn-already-in-mempool") == r0 + 1
+    # the rejected attempt is timed too
+    assert accept_h.snapshot()["count"] == h0n + 2
+
+
+def test_p2p_message_counters_on_wire_traffic():
+    """A real loopback handshake increments per-command send/recv
+    counters in both nodes' shared registry."""
+    import time as _t
+
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    msgs = g_metrics.get("nodexa_p2p_messages_total")
+    sent0 = msgs.value(command="version", direction="sent")
+    recv0 = msgs.value(command="version", direction="recv")
+
+    n1 = NodeContext(network="regtest")
+    n2 = NodeContext(network="regtest")
+    c1 = ConnMan(n1, port=0)
+    c2 = ConnMan(n2, port=0)
+    try:
+        c1.start()
+        c2.start()
+        assert c2.connect_to(f"127.0.0.1:{c1.port}")
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            if any(p.handshake_done for p in c2.all_peers()):
+                break
+            _t.sleep(0.05)
+        else:
+            pytest.fail("handshake did not complete")
+        # both sides sent and received at least one VERSION
+        assert msgs.value(command="version", direction="sent") >= sent0 + 2
+        assert msgs.value(command="version", direction="recv") >= recv0 + 2
+        bytes_c = g_metrics.get("nodexa_p2p_bytes_total")
+        assert bytes_c.value(command="version", direction="sent") > 0
+        # peer gauges answer through the callback; registration is
+        # last-writer-wins, so the registry reflects c2 (1 outbound)
+        peers = g_metrics.get("nodexa_peers")
+        vals = {dict(k)["direction"]: v for k, v in peers.collect()}
+        assert vals["outbound"] >= 1
+    finally:
+        c1.stop()
+        c2.stop()

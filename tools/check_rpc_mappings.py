@@ -27,6 +27,24 @@ REF = os.environ.get("NODEXA_REFERENCE", "/root/reference")
 
 _ROW = re.compile(r'\{ *"[a-z]+", +"([a-z0-9]+)", +&[a-zA-Z_]+')
 
+# Commands this node ships BEYOND the reference's tables, pinned exactly:
+# an unlisted extra means an RPC landed without updating this gate; a
+# listed-but-missing extra means a shipped RPC silently disappeared.
+EXPECTED_EXTRAS = {
+    # reference-era asset/restricted extensions + multiwallet management
+    "addpeeraddress", "addtagtoaddress", "checkaddressrestriction",
+    "checkaddresstag", "checkglobalrestriction", "createwallet",
+    "freezeaddress", "freezerestrictedasset", "getblockstats",
+    "getmnemonic", "getverifierstring", "issuerestrictedasset",
+    "isvalidverifierstring", "listaddressesfortag", "listtagsforaddress",
+    "loadwallet", "removetagfromaddress", "setactivewallet",
+    "unfreezeaddress", "unloadwallet",
+    # TPU-native mining path
+    "generatetoaddresstpu",
+    # node-wide telemetry registry (REST /metrics twin)
+    "getmetrics",
+}
+
 
 def extract_reference() -> list:
     names = set()
@@ -76,7 +94,18 @@ def main() -> int:
     if missing:
         print("MISSING:", ", ".join(missing), file=sys.stderr)
         return 1
-    print("rpc mapping parity OK (all reference commands implemented)")
+    unknown = sorted(set(extras) - EXPECTED_EXTRAS)
+    dropped = sorted(EXPECTED_EXTRAS - set(extras))
+    if unknown:
+        print("UNPINNED EXTRAS (add to EXPECTED_EXTRAS):",
+              ", ".join(unknown), file=sys.stderr)
+    if dropped:
+        print("DROPPED EXTRAS (shipped RPCs gone):",
+              ", ".join(dropped), file=sys.stderr)
+    if unknown or dropped:
+        return 1
+    print("rpc mapping parity OK (all reference commands implemented; "
+          f"{len(extras)} extras pinned)")
     return 0
 
 

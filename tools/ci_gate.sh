@@ -11,26 +11,28 @@
 #                      tabs/trailing-ws, bare except, mutable defaults)
 #   2. import graph    every package module imports cleanly on CPU
 #   3. rpc parity      tools/check_rpc_mappings.py — all 168 reference
-#                      CRPCCommand names have handlers (committed pin)
-#   4. vectors         generate_x16r_vectors.py --check — the committed
+#                      CRPCCommand names have handlers + extras pinned
+#   4. telemetry       tests/test_telemetry.py — registry semantics,
+#                      Prometheus exposition, getmetrics/REST surfaces
+#   5. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#   5. native build    compiles the C++ engine (also feeds the wheel)
-#   6. static checks   tools/typecheck.py over the consensus-critical
+#   6. native build    compiles the C++ engine (also feeds the wheel)
+#   7. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#   7. hardening       tools/security_check.py asserts NX/RELRO/no-
+#   8. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#   8. pytest          unit suite (functional suite with --full)
-#   9. wheel           platform-tagged wheel incl. the native .so,
+#   9. pytest          unit suite (functional suite with --full)
+#  10. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/9] lint"
+echo "== [1/10] lint"
 python tools/lint.py
 
-echo "== [2/9] import graph"
+echo "== [2/10] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -48,33 +50,38 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/9] rpc mapping parity"
+echo "== [3/10] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/9] crypto vector regeneration"
+echo "== [4/10] telemetry exposition"
+python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
+
+echo "== [5/10] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [5/9] native engine build"
+echo "== [6/10] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [6/9] static checks (consensus-critical packages)"
+echo "== [7/10] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [7/9] native hardening (security-check analog)"
+echo "== [8/10] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [8/9] pytest"
+echo "== [9/10] pytest"
+# telemetry suite already ran as stage 4: don't pay for it twice
 if [ "$1" = "--full" ]; then
-    python -m pytest tests/ -q
+    python -m pytest tests/ -q --ignore=tests/test_telemetry.py
 else
-    python -m pytest tests/ -q -m "not functional"
+    python -m pytest tests/ -q -m "not functional" \
+        --ignore=tests/test_telemetry.py
 fi
 
-echo "== [9/9] wheel"
+echo "== [10/10] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

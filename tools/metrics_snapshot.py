@@ -1,0 +1,149 @@
+"""Dump a labeled telemetry-registry snapshot as JSON (bench companion).
+
+Two sources:
+
+  --rpc          pull ``getmetrics`` from a running daemon (cookie or
+                 rpcuser/rpcpassword auth), the way bench.py probes a
+                 live node;
+  (default)      snapshot this process's in-process registry — useful at
+                 the end of an in-process bench/script that imported the
+                 package and did work.
+
+Diffing two snapshots isolates what one bench run did:
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > before.json
+  ... drive load ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff before.json > delta.json
+
+The diff subtracts counter values and histogram bucket counts/sums;
+gauges pass through as (before, after) pairs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+def fetch_rpc(host: str, port: int, auth: str) -> dict:
+    req = urllib.request.Request(
+        f"http://{host}:{port}/",
+        data=json.dumps(
+            {"id": 0, "method": "getmetrics", "params": []}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    import base64
+
+    req.add_header(
+        "Authorization",
+        "Basic " + base64.b64encode(auth.encode()).decode(),
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.load(resp)
+    if body.get("error"):
+        raise SystemExit(f"rpc error: {body['error']}")
+    return body["result"]["metrics"]
+
+
+def local_snapshot() -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from nodexa_chain_core_tpu.telemetry import registry_snapshot
+
+    return registry_snapshot()
+
+
+def _values_by_labels(entry: dict) -> dict:
+    return {
+        json.dumps(v.get("labels", {}), sort_keys=True): v
+        for v in entry.get("values", [])
+    }
+
+
+def diff_snapshots(before: dict, after: dict) -> dict:
+    """after - before per series; new series pass through unchanged."""
+    out: dict = {}
+    for name, entry in after.items():
+        old = before.get(name)
+        if old is None:
+            out[name] = entry
+            continue
+        old_vals = _values_by_labels(old)
+        new_entry = {"type": entry["type"], "help": entry["help"],
+                     "values": []}
+        for key, v in _values_by_labels(entry).items():
+            ov = old_vals.get(key)
+            if ov is None:
+                new_entry["values"].append(v)
+            elif "buckets" in v:
+                new_entry["values"].append({
+                    "labels": v["labels"],
+                    "buckets": {
+                        le: c - ov["buckets"].get(le, 0)
+                        for le, c in v["buckets"].items()
+                    },
+                    "sum": v["sum"] - ov.get("sum", 0),
+                    "count": v["count"] - ov.get("count", 0),
+                })
+            elif entry["type"] == "counter":
+                new_entry["values"].append({
+                    "labels": v["labels"],
+                    "value": v["value"] - ov.get("value", 0),
+                })
+            else:  # gauge: a delta is meaningless, show the endpoints
+                new_entry["values"].append({
+                    "labels": v["labels"],
+                    "before": ov.get("value"),
+                    "after": v["value"],
+                })
+        if any(
+            v.get("value") or v.get("count") or "after" in v
+            for v in new_entry["values"]
+        ):
+            out[name] = new_entry
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rpc", action="store_true",
+                    help="pull getmetrics from a running daemon")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=19443,
+                    help="rpc port (default: regtest 19443)")
+    ap.add_argument("--datadir", default=None,
+                    help="read .cookie auth from this datadir")
+    ap.add_argument("--auth", default=None,
+                    help="user:password (overrides --datadir cookie)")
+    ap.add_argument("--diff", default=None, metavar="BEFORE_JSON",
+                    help="emit the delta against an earlier snapshot file")
+    args = ap.parse_args()
+
+    if args.rpc:
+        auth = args.auth
+        if auth is None and args.datadir:
+            with open(os.path.join(args.datadir, ".cookie")) as f:
+                auth = f.read().strip()
+        if auth is None:
+            ap.error("--rpc needs --auth or --datadir for credentials")
+        snap = fetch_rpc(args.host, args.port, auth)
+    else:
+        snap = local_snapshot()
+
+    if args.diff:
+        with open(args.diff) as f:
+            snap = diff_snapshots(json.load(f), snap)
+
+    json.dump(snap, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
