@@ -1,0 +1,231 @@
+"""Synthetic IBD benchmark: the PR-2 fast-path proof harness.
+
+Builds a regtest chain once (coinbase blocks, then blocks that also spend
+matured coinbases), then connects it into a fresh datadir-backed
+ChainState the way a syncing node receives it — headers first, block data
+out of order — so the final block triggers ONE multi-block
+``activate_best_chain`` run exercising block read-ahead, the persistent
+coins cache, and the deferred flush policy.
+
+Two modes are timed against the same chain:
+
+- ``perblock``: ``dbcache_bytes=0`` — every activation full-flushes the
+  coins to the kvstore, reproducing the pre-dbcache per-block behavior;
+- ``dbcache``: the default budget/interval — coins hit disk only at the
+  shutdown sync.
+
+Reported (also used by tools/ci_gate.sh stage 5 and bench.py):
+
+- ``ibd_blocks_per_s``       wall-clock connect rate in dbcache mode
+- ``flush_disk_s_per_block`` per-mode coins-disk-write time per block
+  (``nodexa_coins_flush_seconds`` sum / blocks, shutdown flush included)
+- ``flush_speedup``          perblock / dbcache of the above — the
+  ISSUE-2 acceptance asks for >= 5x
+- ``prefetch_*``             read-ahead stage observations + warmed coins
+
+Run: ``python -m nodexa_chain_core_tpu.bench.ibd [--blocks N] [--json]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+from ..telemetry import g_metrics
+
+
+def build_chain(n_blocks: int = 24, spends_per_block: int = 2):
+    """(params, blocks): COINBASE_MATURITY warmup blocks + n_blocks that
+    each also spend ``spends_per_block`` matured coinbases."""
+    from ..chain.validation import ChainState
+    from ..consensus.consensus import COINBASE_MATURITY
+    from ..consensus.merkle import merkle_root
+    from ..mining.assembler import BlockAssembler, mine_block_cpu
+    from ..node.chainparams import regtest_params
+    from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+    from ..script.sign import KeyStore, sign_tx_input
+    from ..script.standard import KeyID, p2pkh_script
+
+    params = regtest_params()
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    cs = ChainState(params)
+    blocks = []
+    t = params.genesis_time + 60
+    matured = []  # (txid, value) coinbases old enough to spend
+
+    def mine(extra_txs=()):
+        nonlocal t
+        asm = BlockAssembler(cs)
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        if extra_txs:
+            blk.vtx.extend(extra_txs)
+            blk.header.hash_merkle_root = merkle_root(
+                [tx.txid for tx in blk.vtx]
+            )[0]
+        if not mine_block_cpu(blk, params.algo_schedule):
+            raise RuntimeError("regtest mining failed")
+        cs.process_new_block(blk)
+        blocks.append(blk)
+        matured.append(blk.vtx[0])
+        t += 60
+
+    for _ in range(COINBASE_MATURITY + 1):
+        mine()
+    for _ in range(n_blocks):
+        spends = []
+        for _ in range(spends_per_block):
+            if len(matured) <= COINBASE_MATURITY + 1:
+                break
+            cb = matured.pop(0)
+            tx = Transaction(
+                version=2,
+                vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+                vout=[
+                    TxOut(
+                        value=cb.vout[0].value - 10000,
+                        script_pubkey=spk.raw,
+                    )
+                ],
+            )
+            sign_tx_input(ks, tx, 0, spk)
+            spends.append(tx)
+        mine(spends)
+    return params, blocks
+
+
+def _hist_sum(name: str, **labels) -> tuple:
+    h = g_metrics.get(name)
+    snap = h.snapshot(**labels) if h is not None else None
+    if snap is None:
+        return 0.0, 0
+    return snap["sum"], snap["count"]
+
+
+def _connect_run(params, blocks, datadir: str, **cs_kwargs) -> dict:
+    """Feed the chain headers-first + data out of order; time the connect."""
+    from ..chain.validation import ChainState
+
+    g_metrics.reset()
+    cs = ChainState(params, datadir=datadir, **cs_kwargs)
+    headers = [b.header for b in blocks]
+    t0 = time.perf_counter()
+    cs.process_new_block_headers(headers)
+    # data arrives newest-first: everything parks behind the nChainTx
+    # gate until block 1 lands, which cascades into ONE multi-block
+    # activate_best_chain run (the read-ahead window)
+    for blk in reversed(blocks):
+        cs.process_new_block(blk)
+    connect_s = time.perf_counter() - t0
+    n = cs.tip().height
+    if n != len(blocks):
+        raise RuntimeError(f"IBD stalled: tip {n} != {len(blocks)}")
+    cs.close()  # shutdown sync: deferred modes pay their disk bill here
+    total_s = time.perf_counter() - t0
+    flush_sum = sum(
+        _hist_sum("nodexa_coins_flush_seconds", mode=m)[0]
+        for m in ("sync", "full")
+    )
+    stage_flush_sum, _ = _hist_sum(
+        "nodexa_connectblock_stage_seconds", stage="flush")
+    pf_sum, pf_count = _hist_sum(
+        "nodexa_connectblock_stage_seconds", stage="prefetch")
+    warm = g_metrics.get("nodexa_prefetch_warmed_coins_total")
+    delivered = g_metrics.get("nodexa_prefetch_blocks_total")
+    return {
+        "blocks": n,
+        "connect_s": round(connect_s, 3),
+        "total_s": round(total_s, 3),
+        "blocks_per_s": round(n / connect_s, 1),
+        "flush_disk_s_per_block": round(flush_sum / n, 6),
+        "stage_flush_s_per_block": round(stage_flush_sum / n, 6),
+        "prefetch_observations": pf_count,
+        "prefetch_wait_s": round(pf_sum, 3),
+        "prefetch_warmed_coins": int(warm.total()) if warm else 0,
+        # blocks the worker actually handed over pre-deserialized — the
+        # non-vacuous read-ahead signal (the stage histogram above is
+        # observed for every block, delivered or not)
+        "prefetch_blocks_delivered": (
+            int(delivered.total()) if delivered else 0),
+    }
+
+
+def synthetic_ibd(
+    n_blocks: int = 24, spends_per_block: int = 2, repeats: int = 3
+) -> dict:
+    """Build once, connect each mode ``repeats`` times, report the delta.
+
+    Per mode the repeat with the LOWEST flush-disk time is kept (min-of-N
+    timing: fsync hiccups are one-sided noise and would otherwise flake
+    the >= 5x CI floor in either direction)."""
+    params, blocks = build_chain(n_blocks, spends_per_block)
+    out = {}
+    for mode, kwargs in (
+        ("perblock", {"dbcache_bytes": 0, "coins_flush_interval_s": 0.0}),
+        ("dbcache", {}),
+    ):
+        best = None
+        for _ in range(max(1, repeats)):
+            datadir = tempfile.mkdtemp(prefix=f"ibd_{mode}_")
+            try:
+                r = _connect_run(params, blocks, datadir, **kwargs)
+            finally:
+                shutil.rmtree(datadir, ignore_errors=True)
+            if (
+                best is None
+                or r["flush_disk_s_per_block"] < best["flush_disk_s_per_block"]
+            ):
+                best = r
+        out[mode] = best
+    per, db = out["perblock"], out["dbcache"]
+    out["ibd_blocks_per_s"] = db["blocks_per_s"]
+    denom = max(db["flush_disk_s_per_block"], 1e-9)
+    out["flush_speedup"] = round(per["flush_disk_s_per_block"] / denom, 1)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--blocks", type=int, default=24)
+    p.add_argument("--spends", type=int, default=2)
+    p.add_argument(
+        "--assert-fast-path",
+        action="store_true",
+        help="CI gate: require prefetch-stage observations and a "
+        "positive blocks/s figure",
+    )
+    args = p.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    res = synthetic_ibd(args.blocks, args.spends)
+    print(json.dumps(res, indent=1))
+    if args.assert_fast_path:
+        # explicit raises, not assert: the gate must also gate under -O
+        db = res["dbcache"]
+        gates = (
+            (db["blocks_per_s"] > 0, "no blocks/s emitted"),
+            (db["prefetch_observations"] > 0,
+             "connect_stage histogram has no prefetch stage samples"),
+            (db["prefetch_blocks_delivered"] > 0,
+             "read-ahead worker delivered no blocks"),
+            (res["flush_speedup"] >= 5.0,
+             f"flush speedup {res['flush_speedup']}x < 5x acceptance floor"),
+        )
+        for ok, msg in gates:
+            if not ok:
+                raise SystemExit(f"IBD fast path FAILED: {msg}")
+        print(
+            f"IBD fast path OK: {db['blocks_per_s']} blk/s, "
+            f"flush {res['flush_speedup']}x vs per-block, "
+            f"{db['prefetch_blocks_delivered']} blocks delivered by "
+            "read-ahead"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,9 +10,11 @@ index persists them).
 from __future__ import annotations
 
 import os
+import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..core.serialize import ByteReader, ByteWriter, Serializable
 from ..primitives.block import AlgoSchedule, Block
@@ -272,6 +274,93 @@ class ChunkedRecordFile:
             for f in self._files.values():
                 f.close()
             self._files.clear()
+
+
+class BlockReadAhead:
+    """Background block prefetch for multi-block connect runs (the IBD /
+    reorg fast path): while block N validates, one worker thread reads
+    and deserializes block N+1 off the connect thread and pre-touches
+    its spent outpoints in the bottom coins DB so the kvstore block
+    cache is hot when ConnectBlock fetches inputs.
+
+    The worker NEVER mutates a coins cache — it only reads (block file
+    IO is serialized by ChunkedRecordFile's lock; KVStore reads are
+    lock-free against its writer), so a stale read can at worst waste a
+    warm.  Consistency stays owned by the connect thread under cs_main.
+    The consumer contract is strictly in-order: ``get`` for the items in
+    the order passed to ``start``; a miss (timeout, worker death, read
+    error) returns ``(None, 0)`` and the caller falls back to its own
+    synchronous read."""
+
+    def __init__(
+        self,
+        read_fn: Callable[[object], object],
+        warm_fn: Optional[Callable[[object], int]] = None,
+        depth: int = 2,
+    ):
+        self._read = read_fn
+        self._warm = warm_fn
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, items) -> None:
+        items = list(items)
+
+        def run() -> None:
+            for it in items:
+                if self._stop.is_set():
+                    return
+                blk = None
+                warmed = 0
+                try:
+                    blk = self._read(it)
+                    if self._warm is not None and blk is not None:
+                        warmed = self._warm(blk)
+                except Exception:
+                    blk = None  # consumer re-reads and raises the real error
+                while not self._stop.is_set():
+                    try:
+                        self._q.put((it, blk, warmed), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(
+            target=run, name="blk-readahead", daemon=True
+        )
+        self._thread.start()
+
+    def get(self, item, timeout: float = 30.0):
+        """(block, warmed_coins) for ``item``, or (None, 0) on fallback."""
+        if self._thread is None:
+            return None, 0
+        deadline = time.monotonic() + timeout
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return None, 0
+            try:
+                it, blk, warmed = self._q.get(timeout=min(remain, 0.5))
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    return None, 0
+                continue
+            if it is item:
+                return blk, warmed
+            # stale entry for an item the consumer skipped: drop and keep
+            # draining until the requested one surfaces
+
+    def close(self) -> None:
+        self._stop.set()
+        try:  # drain so a put blocked on a full queue wakes and exits
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
 
 
 class BlockStore:

@@ -15,7 +15,23 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Callable, List, Optional
+
+from ..telemetry import g_metrics
+
+# -par observability: worker count is a config gauge, queue depth samples
+# the in-flight check backlog at scrape time (zero hot-path cost), and the
+# counter splits executed checks by queued-vs-inline so the effective
+# parallelism of a sync is queryable
+_M_WORKERS = g_metrics.gauge(
+    "nodexa_scriptcheck_workers",
+    "Configured script-verification worker threads (-par; 0 = inline)")
+_M_CHECKS = g_metrics.counter(
+    "nodexa_scriptcheck_checks_total",
+    "Script checks executed, labeled by mode (queued|inline)")
+_CHECKS_QUEUED = _M_CHECKS.labels(mode="queued")
+_CHECKS_INLINE = _M_CHECKS.labels(mode="inline")
 
 
 class CheckQueue:
@@ -27,6 +43,14 @@ class CheckQueue:
         self._failed: Optional[str] = None
         self._pending = 0
         self._done = threading.Condition(self._lock)
+        _M_WORKERS.set(n_threads)
+        # weakref: the registry keeps the last-registered callback for the
+        # process life — don't let it pin a stopped queue
+        self_ref = weakref.ref(self)
+        g_metrics.gauge_fn(
+            "nodexa_scriptcheck_queue_depth",
+            "Script checks queued or running in the -par worker pool",
+            lambda: float(q._pending) if (q := self_ref()) else 0.0)
         if n_threads > 0:
             for i in range(n_threads):
                 t = threading.Thread(
@@ -56,6 +80,10 @@ class CheckQueue:
                 self._done.notify_all()
 
     def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
+        if checks:
+            # counted at enqueue, one locked add per BATCH — the per-check
+            # fast path (workers and _run_one) stays uninstrumented
+            _CHECKS_QUEUED.inc(len(checks))
         with self._done:
             self._pending += len(checks)
         if self.n_threads > 0:
@@ -96,6 +124,8 @@ class CheckQueueControl:
                 err = c()
                 if err and self._inline_err is None:
                     self._inline_err = err
+            if checks:
+                _CHECKS_INLINE.inc(len(checks))
 
     def wait(self) -> Optional[str]:
         if self.q is not None:

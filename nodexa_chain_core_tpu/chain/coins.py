@@ -71,6 +71,12 @@ def _spent_coin() -> Coin:
 _FLAG_DIRTY = 1
 _FLAG_FRESH = 2
 
+# Approximate heap cost of one cache entry beyond its script bytes (dict
+# slot + OutPoint + _CacheEntry + Coin + TxOut objects).  Used for
+# -dbcache sizing (ref CCoinsViewCache::DynamicMemoryUsage); precision
+# doesn't matter, monotonicity with entry count/script size does.
+_ENTRY_OVERHEAD_BYTES = 176
+
 
 @dataclass
 class _CacheEntry:
@@ -120,6 +126,11 @@ class CoinsViewCache(CoinsViewBacked):
         super().__init__(base)
         self._cache: Dict[OutPoint, _CacheEntry] = {}
         self._best_block: int = 0
+        self._mem_bytes: int = 0
+
+    @staticmethod
+    def _entry_bytes(e: _CacheEntry) -> int:
+        return _ENTRY_OVERHEAD_BYTES + len(e.coin.out.script_pubkey)
 
     # -- reads ------------------------------------------------------------
 
@@ -132,6 +143,7 @@ class CoinsViewCache(CoinsViewBacked):
             return None
         e = _CacheEntry(coin.clone(), 0)
         self._cache[outpoint] = e
+        self._mem_bytes += self._entry_bytes(e)
         return e
 
     def get_coin(self, outpoint: OutPoint) -> Optional[Coin]:
@@ -165,10 +177,14 @@ class CoinsViewCache(CoinsViewBacked):
         if e is None:
             e = _CacheEntry(_spent_coin(), 0)
             self._cache[outpoint] = e
+            self._mem_bytes += self._entry_bytes(e)
         if not overwrite and not e.coin.is_spent():
             raise ValueError("adding coin over unspent coin")
         if not (e.flags & _FLAG_DIRTY):
             fresh = e.coin.is_spent()
+        self._mem_bytes += len(coin.out.script_pubkey) - len(
+            e.coin.out.script_pubkey
+        )
         e.coin = coin
         e.flags |= _FLAG_DIRTY | (_FLAG_FRESH if fresh else 0)
 
@@ -180,18 +196,43 @@ class CoinsViewCache(CoinsViewBacked):
         moved = e.coin
         if e.flags & _FLAG_FRESH:
             del self._cache[outpoint]
+            self._mem_bytes -= self._entry_bytes(e)
         else:
             e.flags |= _FLAG_DIRTY
             e.coin = _spent_coin()
+            self._mem_bytes -= len(moved.out.script_pubkey)
         return moved
 
     def flush(self) -> None:
-        """Push net changes to the parent (ref CCoinsViewCache::Flush)."""
+        """Push net changes to the parent and DROP the cache
+        (ref CCoinsViewCache::Flush).  Frees all memory; the next reads
+        go back to the parent.  Use :meth:`sync` to keep a warm cache."""
         dirty = {
             k: e for k, e in self._cache.items() if e.flags & _FLAG_DIRTY
         }
         self.base.batch_write(dirty, self.get_best_block())
         self._cache.clear()
+        self._mem_bytes = 0
+
+    def sync(self) -> None:
+        """Push net changes to the parent but KEEP unspent entries as a
+        clean read cache (ref CCoinsViewCache::Sync): dirty entries are
+        written, spent entries dropped (the parent deleted them), and
+        survivors stay resident with their flags cleared — the warm
+        working set a long-lived dbcache retains across flushes.  If the
+        parent write raises, the cache is untouched (nothing is lost)."""
+        dirty = {
+            k: e for k, e in self._cache.items() if e.flags & _FLAG_DIRTY
+        }
+        self.base.batch_write(dirty, self.get_best_block())
+        spent = [k for k, e in self._cache.items() if e.coin.is_spent()]
+        for k in spent:
+            del self._cache[k]
+        mem = 0
+        for e in self._cache.values():
+            e.flags = 0
+            mem += self._entry_bytes(e)
+        self._mem_bytes = mem
 
     def batch_write(self, entries: Dict[OutPoint, _CacheEntry], best_block: int) -> None:
         """Absorb a child cache's changes (ref CCoinsViewCache::BatchWrite)."""
@@ -201,9 +242,11 @@ class CoinsViewCache(CoinsViewBacked):
             mine = self._cache.get(outpoint)
             if mine is None:
                 if not (child.flags & _FLAG_FRESH and child.coin.is_spent()):
-                    self._cache[outpoint] = _CacheEntry(
+                    e = _CacheEntry(
                         child.coin.clone(), child.flags & (_FLAG_DIRTY | _FLAG_FRESH)
                     )
+                    self._cache[outpoint] = e
+                    self._mem_bytes += self._entry_bytes(e)
             else:
                 if (
                     child.flags & _FLAG_FRESH
@@ -212,14 +255,25 @@ class CoinsViewCache(CoinsViewBacked):
                 ):
                     raise ValueError("FRESH child overwrites unspent parent coin")
                 if mine.flags & _FLAG_FRESH and child.coin.is_spent():
+                    # the coin was created in this cache and died in the
+                    # child before ever reaching the parent: annihilate
+                    # the pair instead of leaking a dirty tombstone
                     del self._cache[outpoint]
+                    self._mem_bytes -= self._entry_bytes(mine)
                 else:
+                    self._mem_bytes += len(child.coin.out.script_pubkey) - len(
+                        mine.coin.out.script_pubkey
+                    )
                     mine.coin = child.coin.clone()
                     mine.flags |= _FLAG_DIRTY
         self._best_block = best_block
 
     def cache_size(self) -> int:
         return len(self._cache)
+
+    def cache_bytes(self) -> int:
+        """Approximate heap footprint — the -dbcache accounting unit."""
+        return self._mem_bytes
 
     # -- tx helpers --------------------------------------------------------
 
@@ -257,6 +311,10 @@ class CoinsViewDB(CoinsView):
 
     def __init__(self, db: KVStore):
         self.db = db
+        # sidecar puts that must commit ATOMICALLY with the next coins
+        # batch (the asset-state snapshot rides here): a crash can then
+        # never split the coins from the state snapshotted with them
+        self.pending_extra: Dict[bytes, bytes] = {}
 
     @staticmethod
     def _key(outpoint: OutPoint) -> bytes:
@@ -288,6 +346,9 @@ class CoinsViewDB(CoinsView):
                 w = ByteWriter()
                 e.coin.serialize(w)
                 batch.put(self._key(outpoint), w.getvalue())
+        for k, v in self.pending_extra.items():
+            batch.put(k, v)
+        self.pending_extra.clear()
         batch.put(_BEST_BLOCK_KEY, best_block.to_bytes(32, "little"))
         self.db.write_batch(batch)
 

@@ -41,6 +41,7 @@ import os
 import re
 import struct
 import threading
+import time as _time
 import zlib
 from bisect import bisect_right
 from collections import OrderedDict
@@ -437,6 +438,8 @@ class KVStore:
         self._log_size += len(body) + 4
 
     def write_batch(self, batch: WriteBatch, sync: bool = False) -> None:
+        t0 = _time.perf_counter()
+        nbytes = sum(len(k) + len(v) for _, k, v in batch.ops)
         with self._write_lock:
             if self._log is not None:
                 for t, k, v in batch.ops:
@@ -453,6 +456,10 @@ class KVStore:
                     and self._log_size > self._compact_threshold):
                 self.flush()
                 self._maybe_major()
+        _M_BATCH_WRITES.inc()
+        _M_BATCH_OPS.inc(len(batch.ops))
+        _M_BATCH_BYTES.inc(nbytes)
+        _M_BATCH_SECONDS.observe(_time.perf_counter() - t0)
 
     def put(self, key: bytes, value: bytes) -> None:
         self.write_batch(WriteBatch().put(key, value))
@@ -605,3 +612,14 @@ _g_metrics.counter_fn(
 _g_metrics.counter_fn(
     "nodexa_kvstore_block_cache_misses_total",
     "KVStore table block-cache misses (all stores)", lambda: _cache_misses)
+# batch-write telemetry (all stores): the dbcache fast path turns many
+# small per-block coin batches into few large deferred ones — these series
+# are how that shift (and its latency) shows up in a scrape
+_M_BATCH_WRITES = _g_metrics.counter(
+    "nodexa_kvstore_batch_writes_total", "Atomic write batches committed")
+_M_BATCH_OPS = _g_metrics.counter(
+    "nodexa_kvstore_batch_ops_total", "Put/delete operations batched")
+_M_BATCH_BYTES = _g_metrics.counter(
+    "nodexa_kvstore_batch_bytes_total", "Key+value bytes written in batches")
+_M_BATCH_SECONDS = _g_metrics.histogram(
+    "nodexa_kvstore_batch_write_seconds", "Batch commit latency (WAL append)")

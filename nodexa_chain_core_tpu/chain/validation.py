@@ -21,6 +21,7 @@ import functools
 import os
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..consensus import pow as powrules
@@ -43,7 +44,7 @@ from ..consensus.tx_verify import (
 )
 from ..consensus.versionbits import versionbits_cache
 from ..consensus.params import DEPLOYMENT_ASSETS, DEPLOYMENT_ENFORCE_VALUE
-from ..core.uint256 import bits_to_target, u256_hex
+from ..core.uint256 import u256_hex
 from ..node.chainparams import NetworkParams
 from ..node.events import main_signals
 from ..primitives.block import Block, BlockHeader
@@ -57,7 +58,7 @@ from ..script.script import Script
 from ..telemetry import g_metrics, span
 from ..utils.logging import LogFlags, log_print
 from .blockindex import BlockIndex, BlockStatus, Chain
-from .blockstore import BlockStore, BlockUndo, TxUndo
+from .blockstore import BlockReadAhead, BlockStore, BlockUndo, TxUndo
 from .checkqueue import CheckQueue, CheckQueueControl
 from .coins import Coin, CoinsViewCache, CoinsViewDB
 from .kvstore import KVStore
@@ -71,8 +72,25 @@ MEDIAN_TIME_SPAN = 11
 # nTimePostConnect counters)
 _M_CONNECT_STAGE = g_metrics.histogram(
     "nodexa_connectblock_stage_seconds",
-    "Per-stage ConnectTip latency (stage=read|connect|flush|post|total)",
+    "Per-stage ConnectTip latency "
+    "(stage=prefetch|read|connect|flush|post|total)",
 )
+# dbcache-style persistent coins cache: disk-write latency per flush (the
+# deferred analogue of the old per-block CoinsViewDB batch_write), split
+# by mode — "sync" keeps the warm cache, "full" drops it (size pressure)
+_M_COINS_FLUSH = g_metrics.histogram(
+    "nodexa_coins_flush_seconds",
+    "Coins-cache disk flush latency (mode=sync|full)",
+)
+_M_PREFETCH_COINS = g_metrics.counter(
+    "nodexa_prefetch_warmed_coins_total",
+    "Spent outpoints pre-touched in the coins DB by block read-ahead")
+_M_PREFETCH_BLOCKS = g_metrics.counter(
+    "nodexa_prefetch_blocks_total",
+    "Blocks actually delivered pre-deserialized by the read-ahead worker")
+_M_HEADERS_POW = g_metrics.counter(
+    "nodexa_headers_pow_verified_total",
+    "Header PoW verifications, labeled by path (batch|scalar)")
 _M_BLOCKS_CONNECTED = g_metrics.counter(
     "nodexa_blocks_connected_total", "Blocks connected to the active chain")
 _M_BLOCKS_DISCONNECTED = g_metrics.counter(
@@ -117,9 +135,19 @@ class ChainState:
         datadir: Optional[str] = None,
         script_check_threads: int = 0,
         block_chunk_bytes: int = 16 * 1024 * 1024,
+        dbcache_bytes: int = 64 * 1024 * 1024,
+        coins_flush_interval_s: float = 300.0,
     ):
         self.params = params
         self.datadir = datadir
+        # -dbcache: the persistent coins cache is written to disk only on
+        # size pressure (full flush, cache dropped), interval expiry
+        # (sync, warm cache kept), prune/admin boundaries, and shutdown —
+        # NOT per connected block (ref nCoinCacheUsage / FlushStateToDisk
+        # periodic modes)
+        self.dbcache_bytes = dbcache_bytes
+        self.coins_flush_interval_s = coins_flush_interval_s
+        self._last_coins_write = time.monotonic()
         # ref sync.h cs_main: one recursive lock over chainstate mutation
         self.cs_main = threading.RLock()
         self.block_index: Dict[int, BlockIndex] = {}
@@ -162,6 +190,19 @@ class ChainState:
 
         self.coins_db = CoinsViewDB(self._chainstate_db)
         self.coins = CoinsViewCache(self.coins_db)
+        # weakref: the registry callback is last-writer-wins and outlives
+        # this ChainState — a closure over self.coins would pin a closed
+        # chainstate's whole cache (up to -dbcache) for the process life
+        coins_ref = weakref.ref(self.coins)
+        g_metrics.gauge_fn(
+            "nodexa_coins_cache_entries",
+            "Entries resident in the persistent coins cache",
+            lambda: float(c.cache_size()) if (c := coins_ref()) else 0.0)
+        g_metrics.gauge_fn(
+            "nodexa_coins_cache_bytes",
+            "Approximate heap bytes of the persistent coins cache "
+            "(-dbcache accounting)",
+            lambda: float(c.cache_bytes()) if (c := coins_ref()) else 0.0)
         if script_check_threads == 0:
             # -par=0 -> auto (ref init.cpp:1125): worker threads pay off only
             # with the GIL-free native ECDSA engine; pure Python stays inline.
@@ -247,6 +288,11 @@ class ChainState:
             raw_ph = self._chainstate_db.get(b"prunedheight")
             if raw_ph:
                 self.pruned_height = int.from_bytes(raw_ph, "little", signed=True)
+            # deferred coin flushing means a crash can leave the coins DB
+            # behind (or on a stale branch vs) the block index — heal it
+            # before serving anything (ref ReplayBlocks, validation.cpp)
+            if self._replay_blocks():
+                self.flush_state_to_disk()
             return
         # fresh datadir: install genesis.  After a -reindex wipe the block
         # file survives with genesis already at offset 0 — reuse it instead
@@ -272,6 +318,93 @@ class ChainState:
         self._dirty_index.add(idx)
         self.candidates.add(idx)
         self.activate_best_chain()
+
+    # ----------------------------------------------- crash-replay on load
+
+    def _roll_forward_block(
+        self, block: Block, idx: BlockIndex, view: CoinsViewCache
+    ) -> None:
+        """Re-apply an already-validated block's coin + asset transitions
+        (ref ReplayBlocks' RollforwardBlock): no PoW/script/amount checks
+        re-run — the block was fully validated before the crash; only the
+        state transition is replayed."""
+        cons = self.params.consensus
+        assets_active = (
+            idx.height >= cons.asset_activation_height
+            or versionbits_cache.is_active(idx.prev, cons, DEPLOYMENT_ASSETS)
+        )
+        for tx in block.vtx:
+            spent_pairs = []
+            if not tx.is_coinbase():
+                for txin in tx.vin:
+                    coin = view.get_coin(txin.prevout)
+                    if coin is None:
+                        raise BlockValidationError(
+                            "replay-missing-input",
+                            f"h={idx.height} {txin.prevout}",
+                        )
+                    spent_pairs.append((coin.out.script_pubkey, coin))
+                    view.spend_coin(txin.prevout)
+            if assets_active:
+                self.assets.check_and_apply_tx(tx, spent_pairs, idx.height)
+            view.add_tx_outputs(tx, idx.height)
+        view.set_best_block(idx.block_hash)
+
+    def _replay_blocks(self) -> int:
+        """Roll the persisted coins view forward (and, after a crash
+        mid-reorg, first backward via the undo journal) to the block-index
+        tip (ref validation.cpp ReplayBlocks).
+
+        The write ordering guarantees index >= coins on disk: undo records
+        and dirty index entries go down per block, the coins/assets pair
+        goes down only on flush boundaries.  Returns blocks replayed."""
+        tip = self.tip()
+        if tip is None:
+            return 0
+        coins_best = self.coins.get_best_block()
+        if coins_best == tip.block_hash:
+            return 0
+        view = CoinsViewCache(self.coins)
+        n = 0
+        start_height = 0
+        if coins_best:
+            start = self.block_index.get(coins_best)
+            if start is None:
+                raise BlockValidationError(
+                    "replay-unknown-coins-tip", u256_hex(coins_best)
+                )
+            fork = (
+                start if start in self.active
+                else self.active.find_fork(start)
+            )
+            walk: Optional[BlockIndex] = start
+            while walk is not None and walk is not fork:
+                block = self.read_block(walk)
+                _, upos = self.positions.get(walk.block_hash, (-1, -1))
+                if upos < 0:
+                    raise BlockValidationError(
+                        "replay-no-undo", u256_hex(walk.block_hash)
+                    )
+                self.disconnect_block(
+                    block, walk, view, undo=self.block_store.read_undo(upos)
+                )
+                n += 1
+                walk = walk.prev
+            start_height = fork.height + 1 if fork is not None else 0
+        for h in range(start_height, tip.height + 1):
+            idx = self.active.at(h)
+            assert idx is not None
+            self._roll_forward_block(self.read_block(idx), idx, view)
+            n += 1
+        view.flush()
+        log_print(
+            LogFlags.NONE,
+            "replay: healed coins view over %d blocks to %s h=%d",
+            n,
+            u256_hex(tip.block_hash)[:16],
+            tip.height,
+        )
+        return n
 
     # ------------------------------------------------- startup integrity
 
@@ -441,12 +574,19 @@ class ChainState:
             if upos >= 0:
                 c = ChunkedRecordFile.chunk_of(upos)
                 rev_max[c] = max(rev_max.get(c, -1), height)
-        freed = store.blocks.delete_chunks(
-            [c for c, mh in blk_max.items() if mh <= prune_to]
-        )
-        freed += store.undos.delete_chunks(
-            [c for c, mh in rev_max.items() if mh <= prune_to]
-        )
+        blk_del = [c for c, mh in blk_max.items() if mh <= prune_to]
+        rev_del = [c for c, mh in rev_max.items() if mh <= prune_to]
+        if not blk_del and not rev_del:
+            return 0
+        # coins must be durable BEFORE any chunk file is unlinked: with
+        # deferred flushing the coins DB can lag the tip by more than
+        # MIN_BLOCKS_TO_KEEP, and crash replay can only roll forward
+        # over block data that still exists.  Placed after the
+        # early-outs so a no-op prune attempt (autoprune fires every ~8
+        # blocks under size pressure) doesn't defeat -dbcache deferral.
+        self._write_coins()
+        freed = store.blocks.delete_chunks(blk_del)
+        freed += store.undos.delete_chunks(rev_del)
         if freed == 0:
             return 0
         live_blk = set(store.blocks.chunk_numbers())
@@ -900,9 +1040,49 @@ class ChainState:
 
     # ------------------------------------------------- tip connect/disconnect
 
-    def _connect_tip(self, idx: BlockIndex, block: Optional[Block] = None) -> None:
+    def _warm_coins_for_block(self, block: Block) -> int:
+        """Pre-touch a block's spent outpoints in the bottom coins DB —
+        called from the read-ahead thread.  The reads pull the kvstore
+        blocks holding those coins into its LRU cache, so the connect
+        thread's subsequent ``_fetch`` hits memory.
+
+        Outpoints already resident in the persistent cache are skipped:
+        inside the -dbcache deferral window the funding coins of recent
+        spends live there, and a DB probe for them is pure waste — the
+        warm pays off for coins that are on DISK (sync after a restart,
+        post-flush cold sets).  The residency peek is a bare dict
+        membership read (GIL-atomic, possibly stale, never mutating):
+        a stale answer costs at most one wasted or missed DB read.  The
+        DB reads themselves are thread-safe by the kvstore's lock-free
+        reader contract, and no cache mutation means no consistency
+        hazard."""
+        db = self.coins_db
+        resident = self.coins._cache
+        n = 0
+        for tx in block.vtx[1:]:
+            for txin in tx.vin:
+                if txin.prevout in resident:
+                    continue
+                # have_coin: the raw kvstore read does the warming; skip
+                # the per-coin deserialization a get_coin would pay
+                if db.have_coin(txin.prevout):
+                    n += 1
+        return n
+
+    def _connect_tip(
+        self,
+        idx: BlockIndex,
+        block: Optional[Block] = None,
+        prefetch_wait: float = 0.0,
+        prefetched_coins: int = 0,
+    ) -> None:
         """ref ConnectTip (with BCLog::BENCH stage timings, ref
-        validation.cpp's nTimeConnectTotal/nTimeFlush counters)."""
+        validation.cpp's nTimeConnectTotal/nTimeFlush counters).
+
+        ``prefetch_wait`` is the time the caller spent waiting on the
+        read-ahead worker for ``block`` (0 when the block arrived with the
+        request or read synchronously below); ``prefetched_coins`` counts
+        the spent outpoints the worker pre-touched in the coins DB."""
         t0 = time.perf_counter()
         if block is None:
             block = self.read_block(idx)
@@ -934,6 +1114,9 @@ class ChainState:
             self.mempool.remove_for_block(block.vtx)
         main_signals.block_connected(block, idx, [])
         t_done = time.perf_counter()
+        _M_CONNECT_STAGE.observe(prefetch_wait, stage="prefetch")
+        if prefetched_coins:
+            _M_PREFETCH_COINS.inc(prefetched_coins)
         _M_CONNECT_STAGE.observe(t_read - t0, stage="read")
         _M_CONNECT_STAGE.observe(t_connect - t_read, stage="connect")
         _M_CONNECT_STAGE.observe(t_flush - t_connect, stage="flush")
@@ -1032,56 +1215,90 @@ class ChainState:
                 path.append(walk)
                 walk = walk.prev
             failed = False
-            for idx in reversed(path):
-                blk = (
-                    new_block
-                    if new_block is not None
-                    and new_block.get_hash(self.params.algo_schedule) == idx.block_hash
-                    else None
+            to_connect = list(reversed(path))
+            # multi-block run: a worker thread stays ahead of the connect
+            # loop, deserializing the next block and warming the coins DB
+            # with its spent outpoints (the IBD/reorg read-ahead path)
+            readahead: Optional[BlockReadAhead] = None
+            if len(to_connect) > 1:
+                readahead = BlockReadAhead(
+                    self.read_block, self._warm_coins_for_block
                 )
-                try:
-                    self._connect_tip(idx, blk)
-                    progressed = True
-                except BlockValidationError as e:
-                    # ref InvalidChainFound/InvalidBlockFound logging
-                    log_print(
-                        LogFlags.NONE,
-                        "ERROR: ConnectTip %s h=%d failed: %s",
-                        u256_hex(idx.block_hash)[:16],
-                        idx.height,
-                        e,
+                readahead.start(to_connect[1:])
+            try:
+                for i, idx in enumerate(to_connect):
+                    blk = (
+                        new_block
+                        if new_block is not None
+                        and new_block.get_hash(self.params.algo_schedule)
+                        == idx.block_hash
+                        else None
                     )
-                    if e.code in ("no-data", "no-undo-data"):
-                        # missing data is NOT invalidity (defense in depth
-                        # behind the nChainTx candidacy gate): drop the
-                        # candidate and its candidate descendants, clear
-                        # their completeness marks, and park the direct
-                        # children so a re-submitted block reinstates them
-                        self.candidates.discard(idx)
-                        idx.status = BlockStatus(
-                            idx.status & ~BlockStatus.HAVE_DATA
+                    pf_wait = 0.0
+                    warmed = 0
+                    if blk is None and readahead is not None and i > 0:
+                        t_pf = time.perf_counter()
+                        blk, warmed = readahead.get(idx)
+                        pf_wait = time.perf_counter() - t_pf
+                        if blk is not None:
+                            _M_PREFETCH_BLOCKS.inc()
+                    try:
+                        self._connect_tip(
+                            idx,
+                            blk,
+                            prefetch_wait=pf_wait,
+                            prefetched_coins=warmed,
                         )
-                        self.positions.pop(idx.block_hash, None)
-                        self._dirty_index.add(idx)  # persist the clear
-                        idx.chain_tx_count = 0
-                        for cand in list(self.candidates):
-                            if cand.get_ancestor(idx.height) is idx:
-                                self.candidates.discard(cand)
-                        for other in self.block_index.values():
-                            if other.get_ancestor(idx.height) is idx:
-                                other.chain_tx_count = 0
-                                if other is not idx and other.prev is idx and (
-                                    other.status & BlockStatus.HAVE_DATA
-                                ):
-                                    parked = self._blocks_unlinked.setdefault(
-                                        idx.block_hash, []
-                                    )
-                                    if other not in parked:
-                                        parked.append(other)
-                    else:
-                        self._invalidate(idx)
-                    failed = True
-                    break
+                        progressed = True
+                    except BlockValidationError as e:
+                        # ref InvalidChainFound/InvalidBlockFound logging
+                        log_print(
+                            LogFlags.NONE,
+                            "ERROR: ConnectTip %s h=%d failed: %s",
+                            u256_hex(idx.block_hash)[:16],
+                            idx.height,
+                            e,
+                        )
+                        if e.code in ("no-data", "no-undo-data"):
+                            # missing data is NOT invalidity (defense in
+                            # depth behind the nChainTx candidacy gate):
+                            # drop the candidate and its candidate
+                            # descendants, clear their completeness marks,
+                            # and park the direct children so a
+                            # re-submitted block reinstates them
+                            self.candidates.discard(idx)
+                            idx.status = BlockStatus(
+                                idx.status & ~BlockStatus.HAVE_DATA
+                            )
+                            self.positions.pop(idx.block_hash, None)
+                            self._dirty_index.add(idx)  # persist the clear
+                            idx.chain_tx_count = 0
+                            for cand in list(self.candidates):
+                                if cand.get_ancestor(idx.height) is idx:
+                                    self.candidates.discard(cand)
+                            for other in self.block_index.values():
+                                if other.get_ancestor(idx.height) is idx:
+                                    other.chain_tx_count = 0
+                                    if other is not idx and other.prev is idx and (
+                                        other.status & BlockStatus.HAVE_DATA
+                                    ):
+                                        parked = self._blocks_unlinked.setdefault(
+                                            idx.block_hash, []
+                                        )
+                                        if other not in parked:
+                                            parked.append(other)
+                        else:
+                            self._invalidate(idx)
+                        failed = True
+                        break
+                    # bound the cache during long connect runs (reindex,
+                    # deep reorg): size pressure flushes mid-run instead of
+                    # waiting for the end of activation
+                    if self.coins.cache_bytes() > self.dbcache_bytes:
+                        self.flush_state_to_disk("if_needed")
+            finally:
+                if readahead is not None:
+                    readahead.close()
             if not failed:
                 break  # reached `best`
             # else: loop again; _invalidate removed the bad candidate
@@ -1089,7 +1306,7 @@ class ChainState:
             self._prune_candidates()
             self._resubmit_disconnected()
             main_signals.updated_block_tip(self.tip(), None, False)
-            self.flush_state_to_disk()
+            self.flush_state_to_disk("if_needed")
 
     def _resubmit_disconnected(self) -> None:
         """Re-add reorged-out transactions to the mempool (ref
@@ -1254,8 +1471,14 @@ class ChainState:
                 continue
             entries = []
             for header in group:
-                target, overflow, _ = bits_to_target(header.bits)
-                if overflow:
+                try:
+                    # full nBits validation (range + pow_limit), matching
+                    # what the scalar check_proof_of_work enforces — the
+                    # device compares against the decoded target only
+                    target = powrules.compact_target(
+                        header.bits, self.params.consensus
+                    )
+                except ValueError:
                     raise BlockValidationError("high-hash", "bad bits")
                 entries.append((
                     int.from_bytes(header.kawpow_header_hash(sched), "little"),
@@ -1270,6 +1493,8 @@ class ChainState:
                         "high-hash", "batched kawpow verification failed"
                     )
                 verified.add(id(header))
+        if verified:
+            _M_HEADERS_POW.inc(len(verified), path="batch")
         return verified
 
     @_with_cs_main
@@ -1301,9 +1526,12 @@ class ChainState:
                     raise BlockValidationError("prev-blk-not-found")
                 if prev in self.invalid:
                     raise BlockValidationError("bad-prevblk")
+                scalar_pow = id(header) not in preverified
+                if scalar_pow:
+                    _M_HEADERS_POW.inc(path="scalar")
                 self.check_block_header(
                     header,
-                    check_pow=id(header) not in preverified,
+                    check_pow=scalar_pow,
                     expected_height=prev.height + 1,
                 )
                 self.contextual_check_block_header(
@@ -1388,10 +1616,22 @@ class ChainState:
     # ------------------------------------------------------------- flush
 
     @_with_cs_main
-    def flush_state_to_disk(self) -> None:
-        """ref validation.cpp:10570 FlushStateToDisk."""
+    def flush_state_to_disk(self, mode: str = "always") -> None:
+        """ref validation.cpp:10570 FlushStateToDisk.
+
+        mode "always" (shutdown, admin paths, external callers): write all
+        dirty state now; the coins cache survives as a warm read layer.
+        mode "if_needed" (per-activation during sync): undo records are
+        already down (written at connect time), dirty index entries + tip
+        are written every call — cheap, and the crash-replay on load needs
+        the index at-or-ahead of the coins DB — but the coins/assets pair
+        goes down only when the cache crosses -dbcache (full flush,
+        dropping the cache) or the periodic write interval elapsed (sync,
+        keeping the warm cache).  A crash in the deferral window is healed
+        by ``_replay_blocks``.
+        """
         tip = self.tip()
-        if (
+        want_autoprune = (
             self.prune_mode
             and self.prune_target_bytes > 0
             and tip is not None
@@ -1400,10 +1640,21 @@ class ChainState:
             and tip.height - self._last_autoprune_height >= 8
             and hasattr(self.block_store, "total_bytes")
             and self.block_store.total_bytes() > self.prune_target_bytes
-        ):
-            self._last_autoprune_height = tip.height
-            self.prune_block_files()
-        self.coins.flush()
+        )
+        write_coins = mode != "if_needed"
+        drop_cache = False
+        if not write_coins:
+            if self.coins.cache_bytes() > self.dbcache_bytes:
+                write_coins, drop_cache = True, True
+            elif (
+                time.monotonic() - self._last_coins_write
+                >= self.coins_flush_interval_s
+            ):
+                write_coins = True
+        # index + tip BEFORE coins: a crash in between leaves the index
+        # ahead, which replay rolls forward from idempotent block data;
+        # the reverse order could leave coins claiming a block the index
+        # never recorded
         if self._full_index_flush:
             self.blocktree.write_index(
                 self.block_index.values(), self.positions)
@@ -1416,11 +1667,33 @@ class ChainState:
         tip = self.tip()
         if tip is not None:
             self.blocktree.write_tip(tip.block_hash)
+        if write_coins:
+            self._write_coins(drop_cache)
+        if want_autoprune:
+            self._last_autoprune_height = tip.height
+            self.prune_block_files()
+
+    def _write_coins(self, drop_cache: bool = False) -> None:
+        """Commit the coins cache (+ the asset snapshot, riding IN the
+        same kvstore batch so both always reflect the same best block —
+        replay then re-applies or undoes them together from that point).
+        ``drop_cache`` empties the cache (size pressure); the default
+        sync keeps the warm working set."""
+        t0 = time.perf_counter()
         from ..core.serialize import ByteWriter as _BW
 
         w = _BW()
         self.assets.serialize(w)
-        self._chainstate_db.put(b"A", w.getvalue())
+        self.coins_db.pending_extra[b"A"] = w.getvalue()
+        if drop_cache:
+            self.coins.flush()
+        else:
+            self.coins.sync()
+        self._last_coins_write = time.monotonic()
+        _M_COINS_FLUSH.observe(
+            time.perf_counter() - t0,
+            mode="full" if drop_cache else "sync",
+        )
 
     def close(self) -> None:
         self.flush_state_to_disk()

@@ -19,10 +19,23 @@ from .params import ConsensusParams
 DGW_PAST_BLOCKS = 180  # ref pow.cpp:24 (~3h at 60s spacing)
 
 
-def check_proof_of_work(hash_int: int, nbits: int, params: ConsensusParams) -> bool:
-    """ref pow.cpp:182-199."""
+def compact_target(nbits: int, params: ConsensusParams) -> int:
+    """Decode nBits with full range validation (ref pow.cpp:182-190),
+    raising ValueError on invalid encodings — the single definition of
+    "valid nBits" shared by the scalar check below and the batched
+    header-PoW path (which needs the target itself before the device
+    compares hashes against it)."""
     target, negative, overflow = bits_to_target(nbits)
     if negative or target == 0 or overflow or target > params.pow_limit:
+        raise ValueError(f"invalid nBits {nbits:#x}")
+    return target
+
+
+def check_proof_of_work(hash_int: int, nbits: int, params: ConsensusParams) -> bool:
+    """ref pow.cpp:182-199."""
+    try:
+        target = compact_target(nbits, params)
+    except ValueError:
         return False
     return hash_int <= target
 

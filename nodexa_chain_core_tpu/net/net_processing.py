@@ -70,6 +70,14 @@ MAX_INV_SIZE = 50_000
 _M_MISBEHAVING = g_metrics.counter(
     "nodexa_p2p_misbehavior_total",
     "Misbehavior score assignments, labeled by reason")
+# headers-sync batching: during IBD every full HEADERS message should land
+# in the top bucket (MAX_HEADERS_RESULTS) and verify as ONE device call —
+# a distribution skewed low means the batched-PoW fast path is being fed
+# crumbs (count buckets, not seconds)
+_M_HEADERS_BATCH = g_metrics.histogram(
+    "nodexa_headers_batch_size",
+    "Headers per HEADERS message handed to process_new_block_headers",
+    buckets=(1, 10, 50, 100, 500, 1000, 2000, 4000))
 
 
 class NetProcessor:
@@ -365,6 +373,7 @@ class NetProcessor:
             headers.append(h)
         if not headers:
             return
+        _M_HEADERS_BATCH.observe(len(headers))
         cs = self.node.chainstate
         try:
             from ..utils.timedata import g_timedata

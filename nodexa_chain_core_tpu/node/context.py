@@ -19,6 +19,8 @@ class NodeContext:
         datadir: Optional[str] = None,
         script_check_threads: int = 0,
         block_chunk_bytes: int = 16 * 1024 * 1024,
+        dbcache_bytes: int = 64 * 1024 * 1024,
+        coins_flush_interval_s: float = 300.0,
     ):
         self.params: NetworkParams = select_params(network)
         self.datadir = datadir
@@ -27,6 +29,8 @@ class NodeContext:
             datadir=datadir,
             script_check_threads=script_check_threads,
             block_chunk_bytes=block_chunk_bytes,
+            dbcache_bytes=dbcache_bytes,
+            coins_flush_interval_s=coins_flush_interval_s,
         )
         self.mempool = TxMemPool()
         self.chainstate.mempool = self.mempool

@@ -75,6 +75,19 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         # debug/test knob: small chunks let functional prune tests run on
         # short chains (ref feature_pruning.py's large-block approach)
         block_chunk_bytes=g_args.get_int("blockchunksize", 16 * 1024 * 1024),
+        # -dbcache=<MiB>: persistent coins-cache budget; coins hit disk
+        # only on size pressure, the periodic interval, or shutdown (ref
+        # init.cpp -dbcache / nCoinCacheUsage)
+        dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
+        coins_flush_interval_s=float(
+            g_args.get_int("dbcacheinterval", 300)),
+    )
+    cq = node.chainstate.checkqueue
+    log_printf(
+        "script verification: %s; coins cache: %d MiB budget",
+        f"{cq.n_threads} -par worker threads" if cq is not None
+        else "inline (single-threaded)",
+        node.chainstate.dbcache_bytes // (1024 * 1024),
     )
     # -prune=N: 0=off, 1=manual (pruneblockchain RPC), >=550 = auto-prune
     # to N MiB (validated above, before the -reindex wipe)
@@ -124,7 +137,10 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     if check_blocks > 0:
         node.chainstate.verify_db(check_level=check_level, check_blocks=check_blocks)
     node.scheduler.start()
-    node.scheduler.schedule_every(node.chainstate.flush_state_to_disk, 60.0)
+    # periodic flusher defers to the -dbcache policy: index/tip every
+    # pass, coins only on size pressure or -dbcacheinterval expiry
+    node.scheduler.schedule_every(
+        lambda: node.chainstate.flush_state_to_disk("if_needed"), 60.0)
 
     # -debug=telemetry: periodic per-subsystem summary lines from the
     # metrics registry (spans themselves were gated before chainstate

@@ -29,6 +29,7 @@ class EpochManager:
         self._lock = threading.Lock()
         self._warm: set = set()
         self._building: set = set()
+        self._failed: set = set()
         self._verifiers: Dict[int, object] = {}
 
     # -- background warming -------------------------------------------------
@@ -41,7 +42,11 @@ class EpochManager:
 
     def _ensure(self, epoch: int) -> None:
         with self._lock:
-            if epoch in self._warm or epoch in self._building:
+            if (
+                epoch in self._warm
+                or epoch in self._building
+                or epoch in self._failed
+            ):
                 return
             self._building.add(epoch)
         t = threading.Thread(
@@ -59,6 +64,9 @@ class EpochManager:
                 g_logger.log(
                     f"epoch {epoch}: building DAG slab for TPU verification"
                 )
+                # from_epoch self-gates on a known-answer cross-check vs
+                # the native engine; a mismatch raises into the except
+                # below and the node stays on the scalar fallback
                 verifier = BatchVerifier.from_epoch(
                     epoch, threads=self.slab_threads
                 )
@@ -68,9 +76,17 @@ class EpochManager:
                     self._verifiers[epoch] = verifier
             g_logger.log(f"epoch {epoch}: context ready")
         except Exception as e:  # pragma: no cover - defensive
-            g_logger.log(f"epoch {epoch}: prebuild failed: {e}")
+            # the scheduler re-calls ensure_for_height every tick, so a
+            # deterministic failure (e.g. the known-answer gate rejecting
+            # a miscompiled kernel) must be memoized or the node rebuilds
+            # the multi-GB slab forever; scalar verification keeps working
+            g_logger.log(
+                f"epoch {epoch}: prebuild failed, staying on the scalar "
+                f"path (restart to retry): {e}"
+            )
             with self._lock:
                 self._building.discard(epoch)
+                self._failed.add(epoch)
             return
         with self._lock:
             self._building.discard(epoch)

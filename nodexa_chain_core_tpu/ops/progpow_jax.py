@@ -834,7 +834,18 @@ class BatchVerifier:
             dag = build_epoch_slab(epoch)
         else:
             dag = kawpow.dataset_slab(epoch, threads=threads)
-        return cls(l1, dag)
+        verifier = cls(l1, dag)
+        # known-answer gate before the verifier may serve consensus
+        # headers: one probe hash must match the native scalar engine
+        # bit-for-bit, or the build fails CLOSED (callers fall back to
+        # the scalar path).  Costs one small-bucket compile — noise next
+        # to the slab build above.
+        if not verifier.self_check(epoch * kawpow.EPOCH_LENGTH):
+            raise RuntimeError(
+                f"epoch {epoch} device verifier failed the known-answer "
+                "cross-check against the native engine"
+            )
+        return verifier
 
     def verify_headers(self, entries):
         """Node-convention batched verification.
@@ -855,6 +866,27 @@ class BatchVerifier:
             mix_ok = int.from_bytes(mixes[i][::-1], "little") == mix_le
             out.append((mix_ok and final_le <= target_le, final_le))
         return out
+
+    def self_check(self, height: int) -> bool:
+        """Known-answer cross-check against the native scalar engine for
+        one probe header at ``height`` — the gate a verifier must pass
+        before it serves consensus headers (a wrong DAG slab, a stale L1,
+        or a miscompiled kernel must fail CLOSED to the scalar path).
+        Only meaningful when the slab holds REAL epoch data."""
+        from ..crypto import kawpow
+
+        if not kawpow.available():
+            return True  # nothing to cross-check against
+        header_disp = bytes(range(32))
+        nonce = 0x5EEDC0FFEE
+        finals, mixes = self.hash_batch([header_disp], [nonce], [height])
+        final_ref, mix_ref = kawpow.kawpow_hash(
+            height, int.from_bytes(header_disp[::-1], "little"), nonce
+        )
+        return (
+            int.from_bytes(finals[0][::-1], "little") == final_ref
+            and int.from_bytes(mixes[0][::-1], "little") == mix_ref
+        )
 
     # Shape buckets: every distinct (batch, periods) shape pair costs a
     # fresh XLA compile (~minutes on TPU), so batches and period tables are

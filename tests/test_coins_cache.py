@@ -1,0 +1,330 @@
+"""Persistent dbcache-style coins-cache semantics (PR 2 tentpole).
+
+Covers the CCoinsViewCache parity corners the IBD fast path leans on:
+flush() (drop) vs sync() (warm cache) split, FRESH/DIRTY annihilation
+through nested views, add-over-unspent rejection, -dbcache size-pressure
+and interval-based flush triggering inside ChainState, and crash-replay
+idempotence of the undo/index-before-coins write ordering.
+"""
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.coins import (
+    _FLAG_DIRTY,
+    _FLAG_FRESH,
+    Coin,
+    CoinsView,
+    CoinsViewCache,
+    CoinsViewDB,
+)
+from nodexa_chain_core_tpu.chain.kvstore import KVStore
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+def _coin(v=50, script=b"\x51", height=1):
+    return Coin(TxOut(value=v, script_pubkey=script), height, False)
+
+
+def _op(n):
+    return OutPoint(0xABCD00 + n, 0)
+
+
+class CountingView(CoinsView):
+    """Base view that counts get_coin calls and records batch_writes."""
+
+    def __init__(self):
+        self.coins = {}
+        self.reads = 0
+        self.batches = []
+
+    def get_coin(self, outpoint):
+        self.reads += 1
+        c = self.coins.get(outpoint)
+        return c.clone() if c is not None else None
+
+    def batch_write(self, entries, best_block):
+        self.batches.append(dict(entries))
+        for op, e in entries.items():
+            if e.coin.is_spent():
+                self.coins.pop(op, None)
+            else:
+                self.coins[op] = e.coin.clone()
+
+
+# ---------------------------------------------------------- flush vs sync
+
+
+def test_flush_drops_sync_keeps_warm_cache():
+    base = CountingView()
+    base.coins[_op(1)] = _coin()
+    cache = CoinsViewCache(base)
+    assert cache.get_coin(_op(1)) is not None
+    assert base.reads == 1
+
+    cache.sync()  # nothing dirty: entry survives as a clean read layer
+    assert cache.get_coin(_op(1)) is not None
+    assert base.reads == 1  # served from the warm cache
+
+    cache.add_coin(_op(2), _coin(75))
+    cache.sync()
+    assert base.coins[_op(2)].out.value == 75
+    assert cache.cache_size() == 2  # both entries retained, flags cleared
+    assert not any(
+        e.flags for e in cache._cache.values()
+    ), "sync must clear FRESH/DIRTY flags"
+
+    cache.flush()  # full flush drops everything
+    assert cache.cache_size() == 0
+    assert cache.cache_bytes() == 0
+    cache.get_coin(_op(1))
+    assert base.reads == 2  # back to the base after the drop
+
+
+def test_sync_drops_spent_entries_and_writes_deletes():
+    base = CountingView()
+    base.coins[_op(1)] = _coin()
+    cache = CoinsViewCache(base)
+    cache.spend_coin(_op(1))
+    cache.sync()
+    assert _op(1) not in base.coins  # delete propagated
+    assert cache.cache_size() == 0  # spent entry not retained
+    assert cache.get_coin(_op(1)) is None
+
+
+# --------------------------------------------- FRESH/DIRTY annihilation
+
+
+def test_fresh_spend_annihilates_in_one_cache():
+    base = CountingView()
+    cache = CoinsViewCache(base)
+    cache.add_coin(_op(1), _coin())
+    assert cache._cache[_op(1)].flags == _FLAG_DIRTY | _FLAG_FRESH
+    cache.spend_coin(_op(1))
+    assert cache.cache_size() == 0  # FRESH+spend = never existed
+    cache.flush()
+    assert base.batches == [{}]  # nothing reaches the base
+
+
+def test_child_spend_of_parent_fresh_coin_annihilates_through_batch_write():
+    base = CountingView()
+    parent = CoinsViewCache(base)
+    parent.add_coin(_op(1), _coin())  # FRESH in the parent
+    child = CoinsViewCache(parent)
+    assert child.spend_coin(_op(1)) is not None  # fetched: DIRTY, not FRESH
+    child.flush()
+    # the pair annihilated in the parent: no leaked tombstone, and the
+    # base never hears about the coin
+    assert parent.cache_size() == 0
+    parent.flush()
+    assert _op(1) not in base.batches[-1]
+
+
+def test_nested_three_deep_annihilation():
+    base = CountingView()
+    l1 = CoinsViewCache(base)
+    l2 = CoinsViewCache(l1)
+    l3 = CoinsViewCache(l2)
+    l2.add_coin(_op(7), _coin())
+    l3.spend_coin(_op(7))
+    l3.flush()
+    assert l2.cache_size() == 0
+    l2.flush()
+    assert l1.cache_size() == 0
+    l1.flush()
+    assert _op(7) not in base.coins
+
+
+def test_fresh_child_over_unspent_clean_parent_raises():
+    base = CountingView()
+    parent = CoinsViewCache(base)
+    base.coins[_op(1)] = _coin()
+    assert parent.get_coin(_op(1)) is not None  # clean, unspent in parent
+    from nodexa_chain_core_tpu.chain.coins import _CacheEntry
+
+    bogus = {_op(1): _CacheEntry(_coin(99), _FLAG_DIRTY | _FLAG_FRESH)}
+    with pytest.raises(ValueError):
+        parent.batch_write(bogus, 0)
+
+
+def test_add_over_unspent_rejected_and_overwrite_allowed():
+    base = CountingView()
+    cache = CoinsViewCache(base)
+    cache.add_coin(_op(1), _coin())
+    with pytest.raises(ValueError):
+        cache.add_coin(_op(1), _coin(60))
+    cache.add_coin(_op(1), _coin(60), overwrite=True)  # BIP30-style path
+    assert cache.get_coin(_op(1)).out.value == 60
+
+
+# ------------------------------------------------------ memory accounting
+
+
+def test_cache_bytes_tracks_mutations():
+    base = CountingView()
+    cache = CoinsViewCache(base)
+    assert cache.cache_bytes() == 0
+    cache.add_coin(_op(1), _coin(script=b"\x51" * 30))
+    b1 = cache.cache_bytes()
+    assert b1 > 30
+    cache.add_coin(_op(2), _coin(script=b"\x51" * 10))
+    assert cache.cache_bytes() > b1
+    cache.spend_coin(_op(2))  # FRESH: annihilates, memory returns
+    assert cache.cache_bytes() == b1
+    cache.flush()
+    assert cache.cache_bytes() == 0
+
+
+# --------------------------------------- ChainState flush-policy triggers
+
+
+def _mine(cs, params, spk, n, t0=None):
+    t = t0 or (params.genesis_time + 60)
+    out = []
+    for _ in range(n):
+        asm = BlockAssembler(cs)
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+        out.append(blk)
+        t += 60
+    return out
+
+
+@pytest.fixture()
+def keys():
+    ks = KeyStore()
+    return ks, p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+
+
+def test_deferred_flush_keeps_coins_db_behind(keys, tmp_path):
+    ks, spk = keys
+    params = regtest_params()
+    cs = ChainState(
+        params, datadir=str(tmp_path / "n"), coins_flush_interval_s=1e9
+    )
+    _mine(cs, params, spk, 3)
+    # index/tip advanced on disk, coins deferred in the cache
+    assert cs.blocktree.read_tip() == cs.tip().block_hash
+    assert cs.coins_db.get_best_block() != cs.tip().block_hash
+    assert cs.coins.cache_size() > 0
+    tip_hash = cs.tip().block_hash
+    cs.close()  # shutdown flush writes everything
+    db = KVStore(str(tmp_path / "n" / "chainstate"))
+    assert CoinsViewDB(db).get_best_block() == tip_hash
+    db.close()
+
+
+def test_interval_expiry_triggers_sync(keys, tmp_path):
+    ks, spk = keys
+    params = regtest_params()
+    cs = ChainState(
+        params, datadir=str(tmp_path / "n"), coins_flush_interval_s=0.0
+    )
+    blocks = _mine(cs, params, spk, 2)
+    # zero interval: every activation syncs the coins through to disk,
+    # and the warm cache survives the write
+    assert cs.coins_db.get_best_block() == cs.tip().block_hash
+    assert cs.coins_db.get_coin(OutPoint(blocks[0].vtx[0].txid, 0)) is not None
+    assert cs.coins.cache_size() > 0
+    cs.close()
+
+
+def test_size_pressure_triggers_full_flush(keys, tmp_path):
+    ks, spk = keys
+    params = regtest_params()
+    cs = ChainState(
+        params,
+        datadir=str(tmp_path / "n"),
+        dbcache_bytes=0,  # everything is size pressure
+        coins_flush_interval_s=1e9,
+    )
+    _mine(cs, params, spk, 2)
+    # full flush: written through AND dropped
+    assert cs.coins_db.get_best_block() == cs.tip().block_hash
+    assert cs.coins.cache_size() == 0
+    cs.close()
+
+
+# ----------------------------------------------------- crash replay
+
+
+def test_crash_replay_rolls_coins_forward(keys, tmp_path):
+    ks, spk = keys
+    params = regtest_params()
+    datadir = str(tmp_path / "n")
+    cs = ChainState(params, datadir=datadir, coins_flush_interval_s=1e9)
+    n = COINBASE_MATURITY + 2
+    blocks = _mine(cs, params, spk, n)
+    # spend a matured coinbase so the replay exercises spends too
+    cb = blocks[0].vtx[0]
+    spend = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+        vout=[TxOut(value=cb.vout[0].value - 10000, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, spend, 0, spk)
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(
+        spk.raw, ntime=params.genesis_time + 60 * (n + 10)
+    )
+    blk.vtx.append(spend)
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+
+    blk.header.hash_merkle_root = merkle_root([t.txid for t in blk.vtx])[0]
+    assert mine_block_cpu(blk, params.algo_schedule)
+    cs.process_new_block(blk)
+    tip_hash = cs.tip().block_hash
+    assert cs.coins_db.get_best_block() != tip_hash  # still deferred
+    # CRASH: no close(), the cache (and its dirty coins) evaporate
+
+    cs2 = ChainState(params, datadir=datadir)
+    assert cs2.tip().block_hash == tip_hash
+    assert cs2.coins_db.get_best_block() == tip_hash  # replay persisted
+    assert cs2.coins.get_coin(OutPoint(cb.txid, 0)) is None  # spend replayed
+    assert cs2.coins.get_coin(OutPoint(spend.txid, 0)) is not None
+    # replay is idempotent: a third cold start is a no-op
+    cs3 = ChainState(params, datadir=datadir)
+    assert cs3.coins_db.get_best_block() == tip_hash
+    assert cs3.coins.get_coin(OutPoint(spend.txid, 0)) is not None
+    cs3.close()
+
+
+def test_crash_replay_across_reorg_unwinds_stale_branch(keys, tmp_path):
+    ks, spk = keys
+    params = regtest_params()
+    datadir = str(tmp_path / "n")
+    cs = ChainState(params, datadir=datadir, coins_flush_interval_s=1e9)
+    a = _mine(cs, params, spk, 3)
+    cs.flush_state_to_disk()  # coins DB now sits on the A branch tip
+    assert cs.coins_db.get_best_block() == cs.tip().block_hash
+
+    # build a longer B branch on a scratch chainstate and reorg onto it,
+    # with the post-reorg coin state left unflushed
+    cs_b = ChainState(params)
+    ks2 = KeyStore()
+    spk2 = p2pkh_script(KeyID(ks2.add_key(0xB0B)))
+    b = _mine(cs_b, params, spk2, 5, t0=params.genesis_time + 30)
+    for blk in b:
+        cs.process_new_block(blk)
+    assert cs.tip().block_hash == b[-1].get_hash()
+    assert cs.coins_db.get_best_block() == a[-1].get_hash()  # stale branch
+    # CRASH mid-deferral: replay must DISCONNECT the A coins by undo
+    # journal, then roll forward along B
+
+    cs2 = ChainState(params, datadir=datadir)
+    assert cs2.tip().block_hash == b[-1].get_hash()
+    assert cs2.coins_db.get_best_block() == b[-1].get_hash()
+    assert cs2.coins.get_coin(OutPoint(a[0].vtx[0].txid, 0)) is None
+    assert cs2.coins.get_coin(OutPoint(b[0].vtx[0].txid, 0)) is not None
+    cs2.close()

@@ -14,25 +14,30 @@
 #                      CRPCCommand names have handlers + extras pinned
 #   4. telemetry       tests/test_telemetry.py — registry semantics,
 #                      Prometheus exposition, getmetrics/REST surfaces
-#   5. vectors         generate_x16r_vectors.py --check — the committed
+#   5. ibd fast path   bench/ibd.py --assert-fast-path — short synthetic
+#                      IBD (headers-first, out-of-order data) asserting
+#                      blocks/s is emitted, the connect_stage histogram
+#                      carries the new `prefetch` stage, and the deferred
+#                      coins flush beats per-block flushing >= 5x
+#   6. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#   6. native build    compiles the C++ engine (also feeds the wheel)
-#   7. static checks   tools/typecheck.py over the consensus-critical
+#   7. native build    compiles the C++ engine (also feeds the wheel)
+#   8. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#   8. hardening       tools/security_check.py asserts NX/RELRO/no-
+#   9. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#   9. pytest          unit suite (functional suite with --full)
-#  10. wheel           platform-tagged wheel incl. the native .so,
+#  10. pytest          unit suite (functional suite with --full)
+#  11. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/10] lint"
+echo "== [1/11] lint"
 python tools/lint.py
 
-echo "== [2/10] import graph"
+echo "== [2/11] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -50,29 +55,41 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/10] rpc mapping parity"
+echo "== [3/11] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/10] telemetry exposition"
+echo "== [4/11] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/10] crypto vector regeneration"
+echo "== [5/11] IBD fast path (synthetic)"
+# no pipe: a pipeline would launder the gate's exit status through tail
+# and set -e could never fire on an --assert-fast-path failure; the
+# temp file keeps the per-mode JSON diagnostics visible when it DOES fail
+IBD_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
+        > "$IBD_LOG" 2>&1; then
+    cat "$IBD_LOG"; rm -f "$IBD_LOG"
+    exit 1
+fi
+tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
+
+echo "== [6/11] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [6/10] native engine build"
+echo "== [7/11] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [7/10] static checks (consensus-critical packages)"
+echo "== [8/11] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [8/10] native hardening (security-check analog)"
+echo "== [9/11] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [9/10] pytest"
+echo "== [10/11] pytest"
 # telemetry suite already ran as stage 4: don't pay for it twice
 if [ "$1" = "--full" ]; then
     python -m pytest tests/ -q --ignore=tests/test_telemetry.py
@@ -81,7 +98,7 @@ else
         --ignore=tests/test_telemetry.py
 fi
 
-echo "== [10/10] wheel"
+echo "== [11/11] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -18,6 +18,27 @@ Diffing two snapshots isolates what one bench run did:
 
 The diff subtracts counter values and histogram bucket counts/sums;
 gauges pass through as (before, after) pairs.
+
+Diffing an IBD run (the PR-2 fast-path proof): snapshot before the sync
+starts and after it finishes, then read the delta's
+
+  nodexa_connectblock_stage_seconds{stage=prefetch|read|connect|flush}
+      — per-stage connect time; `prefetch` is the read-ahead wait, and
+      during a healthy run flush stays near zero (deferred to -dbcache)
+  nodexa_coins_flush_seconds{mode=sync|full}
+      — the few actual coins disk writes the whole sync paid
+  nodexa_coins_cache_entries / nodexa_coins_cache_bytes
+      — (gauge pair) how the persistent cache grew across the run
+  nodexa_headers_batch_size / nodexa_headers_pow_verified_total{path=...}
+      — whether headers arrived in full 2000-header batches and how many
+      verified on the device vs the scalar fallback
+  nodexa_prefetch_warmed_coins_total
+      — spent outpoints the read-ahead thread pre-touched in the DB
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_ibd.json
+  ... let the node sync ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_ibd.json | python -m json.tool | grep -A8 connectblock
 """
 
 from __future__ import annotations
