@@ -538,6 +538,23 @@ def bench_sha256d(on_tpu: bool) -> dict:
     }
 
 
+def bench_pool() -> dict:
+    """Stratum share-validation throughput (pool/ subsystem): micro-
+    batched BatchVerifier vs the scalar path over one synthetic epoch.
+    Runs LAST: the rig patches the kawpow facade onto its spec twin and
+    selects kawpowregtest params (restored on exit isn't needed — the
+    process ends).  Details in nodexa_chain_core_tpu/bench/pool.py."""
+    from nodexa_chain_core_tpu.bench.pool import measure_throughput
+
+    t = time.perf_counter()
+    res = measure_throughput()
+    log(f"[pool] batched {res['pool_shares_per_s_batched']:,} shares/s vs "
+        f"scalar {res['pool_shares_per_s_scalar']:,} -> "
+        f"{res['pool_batched_vs_scalar']}x "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return res
+
+
 def bench_ibd() -> dict:
     """Synthetic IBD (node fast path, CPU-side): headers-first + out-of-
     order data into a datadir-backed ChainState, dbcache vs per-block
@@ -579,6 +596,8 @@ def main() -> None:
         extra.update(bench_sha256d(on_tpu))
     if not os.environ.get("NODEXA_BENCH_SKIP_IBD"):
         extra.update(bench_ibd())
+    if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):
+        extra.update(bench_pool())
 
     value = extra.pop("kawpow_search_tpu_hs")
     baseline = extra["kawpow_native_cpu_hs"]

@@ -37,6 +37,14 @@ class BackgroundMiner:
         self._hashes = 0
         self._window_start = time.time()
         self._lock = threading.Lock()
+        # bumped by the validation bus when the tip moves (a pool- or
+        # p2p-found block): workers abandon the current template slice
+        # instead of finishing up to SLICE_TRIES nonces of stale work.
+        # A generation COUNTER, not an event: each worker compares
+        # against the value it sampled at template build, so one worker
+        # consuming the signal can't hide it from the others
+        self._tip_gen = 0
+        self._tip_sub = None
 
     # -- control (ref GenerateClores's thread-group management) -------------
 
@@ -48,8 +56,21 @@ class BackgroundMiner:
         if self.running:
             return
         self._stop.clear()
-        self._window_start = time.time()
-        self._hashes = 0
+        with self._lock:
+            self._window_start = time.time()
+            self._hashes = 0
+        if self._tip_sub is None:
+            from ..node.events import ValidationInterface, main_signals
+
+            miner = self
+
+            class _TipSub(ValidationInterface):
+                def updated_block_tip(self, new_tip, fork_tip,
+                                      initial_download):
+                    miner._tip_gen += 1  # GIL-atomic enough for a flag
+
+            self._tip_sub = _TipSub()
+            main_signals.register(self._tip_sub)
         for i in range(self.threads):
             t = threading.Thread(
                 target=self._mine_loop, args=(i,), name=f"miner-{i}", daemon=True
@@ -63,6 +84,17 @@ class BackgroundMiner:
         for t in self._workers:
             t.join(timeout=15)  # a native search slice can run for seconds
         self._workers.clear()
+        if self._tip_sub is not None:
+            from ..node.events import main_signals
+
+            main_signals.unregister(self._tip_sub)
+            self._tip_sub = None
+        # reset the rolling window too: a later start() (setgenerate off/
+        # on reuses the object in tests) must not divide the dead-time
+        # gap into stale _hashes and report a spiked/garbage rate
+        with self._lock:
+            self._hashes = 0
+            self._window_start = time.time()
         self.node.miner_hashes_per_sec = 0
         _M_HASHRATE.set(0)
         log_printf("built-in miner stopped")
@@ -78,7 +110,7 @@ class BackgroundMiner:
         kid = wallet.get_keyid_for_mining()
         return p2pkh_script(KeyID(kid)).raw if kid else None
 
-    def _search_slice(self, block):
+    def _search_slice(self, block, tip_gen: int = -1):
         """One nonce slice, era-aware: the TPU batched KawPow search when a
         device slab is ready (ref the external GPU miners driving the live
         era), else the native CPU scans (ref GenerateClores' inner loop).
@@ -101,7 +133,8 @@ class BackgroundMiner:
                 covered[0] += n
 
             found = False
-            while covered[0] < SLICE_TRIES and not self._stop.is_set():
+            while (covered[0] < SLICE_TRIES and not self._stop.is_set()
+                   and (tip_gen < 0 or self._tip_gen == tip_gen)):
                 found = mine_block_tpu(
                     block, self.node.params.algo_schedule, max_batches=1,
                     kawpow_verifier=verifier, on_progress=on_progress,
@@ -123,7 +156,16 @@ class BackgroundMiner:
             return  # never overwrite the rate stop() just zeroed
         with self._lock:
             self._hashes += n
+            # clock steps can make dt zero or negative (time.time() is not
+            # monotonic): guard the division and resync the window
             dt = time.time() - self._window_start
+            if dt <= 0.0:
+                # restart the window CLEANLY: keeping the accumulated
+                # count would divide pre-step hashes by a short fresh
+                # window and publish exactly the spike being guarded
+                self._hashes = 0
+                self._window_start = time.time()
+                return
             if dt >= 1.0:
                 self.node.miner_hashes_per_sec = int(self._hashes / dt)
                 _M_HASHRATE.set(self.node.miner_hashes_per_sec)
@@ -152,10 +194,15 @@ class BackgroundMiner:
                         time.sleep(1.0)
                         continue
                 tip_hash = node.chainstate.tip().block_hash
+                # sample the tip generation WITH the tip: a bump past
+                # this value means someone else (pool, p2p, RPC) advanced
+                # the chain and the device slice aborts instead of
+                # sweeping stale work
+                tip_gen = self._tip_gen
                 extra += 1
                 asm = BlockAssembler(node.chainstate)
                 block = asm.create_new_block(spk, extra_nonce=extra)
-                found, covered = self._search_slice(block)
+                found, covered = self._search_slice(block, tip_gen)
                 self._count(covered if not found else max(covered // 2, 1))
                 if self._stop.is_set():
                     return

@@ -69,6 +69,11 @@ class NodeContext:
         miner = getattr(self, "background_miner", None)
         if miner is not None:
             miner.stop()
+        # pool before connman: the stratum server submits blocks, and
+        # those must still propagate while the network is alive
+        pool = getattr(self, "pool_server", None)
+        if pool is not None:
+            pool.stop()
         tor = getattr(self, "tor_controller", None)
         if tor is not None:
             tor.stop()

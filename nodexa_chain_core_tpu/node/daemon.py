@@ -312,6 +312,20 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         for addr in g_args.get_all("addnode") + g_args.get_all("connect"):
             node.connman.connect_to(addr)
 
+    # -pool: Stratum work server for external KawPow miners (pool/):
+    # push-based jobs off the validation bus, TPU micro-batched share
+    # validation, winning shares into the normal ConnectTip path
+    if g_args.get_bool("pool"):
+        from ..pool import start_pool
+
+        node.pool_server = start_pool(
+            node,
+            host=g_args.get("poolbind", "127.0.0.1"),
+            port=g_args.get_int("poolport", 3333),
+            start_difficulty=g_args.get_int("pooldiff", 1),
+            max_connections=g_args.get_int("poolmaxconn", 256),
+        )
+
     # -gen/-genproclimit: built-in miner (ref GenerateClores at init)
     if g_args.get_bool("gen") and getattr(node, "wallet", None) is not None:
         from ..mining.miner_thread import BackgroundMiner

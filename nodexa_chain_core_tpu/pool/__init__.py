@@ -1,0 +1,65 @@
+"""Stratum work-server subsystem: serve KawPow jobs to external miners.
+
+The reference node's only mining surface is polling RPC
+(getblocktemplate / pprpcsb / submitblock, ref src/rpc/mining.cpp) — one
+template per request, one share per HTTP round-trip, scalar validation.
+That caps it at a handful of local miners.  This package turns the node
+itself into the work server for fleets of external miners: a push-based
+Stratum-style protocol over a line-JSON socket, with share validation
+running as micro-batched device calls through the same
+:class:`..ops.progpow_jax.BatchVerifier` the headers-sync path uses
+(scalar native fallback when no device slab is ready, exactly like
+headers).
+
+Three layers:
+
+- :mod:`.jobs` — ``JobManager``: assembles block templates off the
+  existing :class:`..mining.assembler.BlockAssembler`, pushes
+  ``mining.notify`` jobs on tip/mempool events via the validation signal
+  bus, and tracks job -> template lineage for stale detection.
+- :mod:`.shares` — ``SharePipeline``: accumulates submitted shares into
+  micro-batches and validates each batch with ONE batched KawPow device
+  call; winning shares route into the normal
+  ``ChainState.process_new_block`` / ConnectTip path.
+- :mod:`.server` — ``StratumServer``: non-blocking line-JSON socket
+  server with per-connection sessions (subscribe / authorize / submit),
+  unique extranonce1 allocation, per-session vardiff, and
+  misbehavior-style banning of abusive connections.
+
+Wire dialect (KawPow-stratum shaped; one JSON object per ``\\n``-framed
+line, ids echoed like JSON-RPC):
+
+  -> {"id":1,"method":"mining.subscribe","params":["agent"]}
+  <- {"id":1,"result":[["mining.notify","<session>"],"<extranonce1>"],
+      "error":null}
+  -> {"id":2,"method":"mining.authorize","params":["worker","pass"]}
+  <- {"id":2,"result":true,"error":null}
+  <- {"id":null,"method":"mining.set_target","params":["<target 64hex>"]}
+  <- {"id":null,"method":"mining.notify","params":
+        ["<job_id>","<header_hash 64hex>",<epoch>,"<share_target 64hex>",
+         <clean>,<height>,"<bits 8hex>"]}
+  -> {"id":3,"method":"mining.submit","params":
+        ["worker","<job_id>","<nonce 16hex>","<mix_hash 64hex>"]}
+  <- {"id":3,"result":true,"error":null}          # accepted
+  <- {"id":3,"result":false,"error":[22,"duplicate",null]}
+
+The 64-bit nonce is partitioned: its top 16 bits MUST equal the
+session's extranonce1 (the miner owns the low 48 bits), which makes the
+nonce walk collision-free across sessions and bad-prefix submissions
+cheaply rejectable.  Hex strings are display order (big-endian), the
+order RPC shows hashes.
+"""
+
+from __future__ import annotations
+
+from .jobs import Job, JobManager
+from .server import StratumServer, start_pool
+from .shares import SharePipeline
+
+__all__ = [
+    "Job",
+    "JobManager",
+    "SharePipeline",
+    "StratumServer",
+    "start_pool",
+]

@@ -1,0 +1,244 @@
+"""Stratum job management: templates -> notify jobs, with stale lineage.
+
+``JobManager`` rides the validation signal bus the same way the wallet
+and the pub socket do: ``updated_block_tip`` cuts a clean job (workers
+must abandon the old template — its coinbase pays a superseded height),
+``transaction_added_to_mempool`` refreshes the job at most once per
+``refresh_interval_s`` with ``clean=False`` (workers may finish their
+current nonce range).  Templates come from the one
+:class:`..mining.assembler.BlockAssembler` every other mining surface
+uses, so pool work, ``getblocktemplate`` work and the built-in miner all
+select transactions identically.
+
+Lineage: each job remembers the tip it was built on.  A submitted share
+referencing a job whose parent is no longer the active tip is *stale*
+(distinct from *unknown* — a job that never existed or was evicted), the
+distinction miners rely on to tune their work-restart latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..core.uint256 import bits_to_target
+from ..crypto.kawpow import epoch_number
+from ..node.events import ValidationInterface, main_signals
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+
+_M_JOBS = g_metrics.counter(
+    "nodexa_pool_jobs_total",
+    "Stratum jobs cut, labeled clean=true/false (clean = tip moved)")
+
+MAX_JOBS = 32  # retained for late submits; older jobs become "unknown"
+
+
+class Job:
+    """One notify-able unit of work (an assembled template + lineage)."""
+
+    __slots__ = (
+        "job_id", "block", "height", "bits", "target", "epoch",
+        "header_hash_disp", "header_hash_le", "prev_hash", "created",
+        "clean", "seen_nonces",
+    )
+
+    def __init__(self, job_id: str, block, schedule, clean: bool):
+        self.job_id = job_id
+        self.block = block
+        self.height = block.header.height
+        self.bits = block.header.bits
+        target, _, _ = bits_to_target(block.header.bits)
+        self.target = target  # network boundary (block-winning)
+        self.epoch = epoch_number(self.height)
+        hh = block.header.kawpow_header_hash(schedule)
+        self.header_hash_disp = hh[::-1]  # display order (stratum wire)
+        self.header_hash_le = int.from_bytes(hh, "little")
+        self.prev_hash = block.header.hash_prev
+        self.created = time.time()
+        self.clean = clean
+        # nonces claimed by any session on this job (duplicate rejection
+        # is job-wide: two workers handing in the same nonce is the same
+        # work twice no matter who did it)
+        self.seen_nonces: set = set()
+
+
+MAX_TIP_AGE_S = 24 * 3600  # ref IsInitialBlockDownload's nMaxTipAge
+
+
+class JobManager(ValidationInterface):
+    """Signal handlers only flag work; a dedicated ``pool-jobs`` thread
+    does the template assembly + notify fanout.  The bus fires
+    ``updated_block_tip`` from inside activate_best_chain's cs_main
+    critical section and ``transaction_added_to_mempool`` on the
+    tx-accept thread — neither may pay for mempool selection or a fleet
+    broadcast inline (ref the reference posting validation callbacks to
+    a background scheduler)."""
+
+    def __init__(self, node, payout_script: bytes,
+                 refresh_interval_s: float = 10.0):
+        self.node = node
+        self.payout_script = payout_script
+        self.refresh_interval_s = refresh_interval_s
+        self._jobs: "OrderedDict[str, Job]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._counter = 0
+        self._last_refresh = 0.0
+        self._warned_era = False
+        # server installs its broadcast here; None until it does
+        self.on_new_job: Optional[Callable[[Job], None]] = None
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._pending_clean = False
+        self._pending_refresh = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        main_signals.register(self)
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pool-jobs", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        main_signals.unregister(self)
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+        self._thread = None
+
+    def _syncing(self) -> bool:
+        """Far-behind tip = still syncing: don't hand miners work that
+        goes stale within seconds (ref IsInitialBlockDownload's tip-age
+        latch; regtest networks are exempt via mining_requires_peers,
+        the same proxy the built-in miner uses)."""
+        if not self.node.params.mining_requires_peers:
+            return False
+        tip = self.node.chainstate.tip()
+        return tip is None or tip.time < time.time() - MAX_TIP_AGE_S
+
+    # -- validation interface (the push triggers; flag-and-wake only) ------
+
+    def updated_block_tip(self, new_tip, fork_tip, initial_download) -> None:
+        if initial_download or self._syncing():
+            return  # don't spray jobs while syncing; tip isn't ours yet
+        with self._lock:  # vs _run's consume: a tip flag set in the
+            self._pending_clean = True  # read-clear window must survive
+        self._wake.set()
+
+    def transaction_added_to_mempool(self, tx) -> None:
+        with self._lock:
+            if not self._jobs:
+                return  # nothing to refresh before the first job exists
+            # LATCH the request even inside the throttle window: the
+            # cutter thread applies the interval, so a tx arriving right
+            # after a cut still lands in a refreshed job one interval
+            # later instead of waiting for the next unrelated trigger
+            self._pending_refresh = True
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            now = time.time()
+            with self._lock:
+                clean = self._pending_clean
+                refresh_due = self._pending_refresh and (
+                    now - self._last_refresh >= self.refresh_interval_s)
+                if not clean and not refresh_due:
+                    continue  # _pending_refresh stays latched for later
+                self._pending_clean = False
+                self._pending_refresh = False
+            try:
+                self.new_job(clean=clean)
+            except Exception as e:  # noqa: BLE001 — keep the cutter alive
+                log_printf("pool: job cut failed: %r", e)
+
+    # -- job lifecycle -----------------------------------------------------
+
+    def new_job(self, clean: bool = True) -> Optional[Job]:
+        """Assemble a template on the current tip and register it.
+
+        Returns None outside the KawPow era (the pool serves KawPow work
+        only; pre-fork eras have no external-miner protocol to speak).
+        """
+        from ..mining.assembler import BlockAssembler
+
+        node = self.node
+        sched = node.params.algo_schedule
+        with self._lock:
+            self._counter += 1
+            extra = self._counter
+        block = BlockAssembler(node.chainstate).create_new_block(
+            self.payout_script, extra_nonce=extra
+        )
+        if not sched.is_kawpow(block.header.time):
+            if not self._warned_era:
+                self._warned_era = True
+                log_printf(
+                    "pool: tip is outside the KawPow era; no stratum jobs "
+                    "until activation"
+                )
+            return None
+        with self._lock:
+            # id from the CAPTURED counter: two concurrent new_job calls
+            # (tip signal racing a mempool refresh) re-reading the live
+            # counter would mint two different jobs under one id
+            job = Job(f"{extra:04x}", block, sched, clean)
+            self._jobs[job.job_id] = job
+            while len(self._jobs) > MAX_JOBS:
+                self._jobs.popitem(last=False)
+            self._last_refresh = job.created
+            cb = self.on_new_job
+        _M_JOBS.inc(clean=str(clean).lower())
+        if cb is not None:
+            cb(job)
+        return job
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def current(self) -> Optional[Job]:
+        """Freshest job, cutting one if none exists or the tip moved
+        (the cold-subscribe path; steady-state the signal thread keeps a
+        fresh job registered and this never assembles)."""
+        tip = self.node.chainstate.tip()
+        with self._lock:
+            if self._jobs:
+                job = next(reversed(self._jobs.values()))
+                if tip is None or job.prev_hash == tip.block_hash:
+                    return job
+        if self._syncing():
+            return None  # no work to hand out mid-sync
+        return self.new_job(clean=True)
+
+    def is_stale(self, job: Job) -> bool:
+        tip = self.node.chainstate.tip()
+        return tip is None or job.prev_hash != tip.block_hash
+
+    def claim_nonce(self, job: Job, nonce: int) -> bool:
+        """Atomically claim a nonce on a job; False means duplicate.
+
+        Claimed at submit time (not after validation) so duplicates are
+        deterministic even when both copies sit in the same micro-batch.
+        """
+        with self._lock:
+            if nonce in job.seen_nonces:
+                return False
+            job.seen_nonces.add(nonce)
+            return True
+
+    def release_nonce(self, job: Job, nonce: int) -> None:
+        """Un-claim a nonce whose share was load-shed before validation
+        (the miner may legitimately resubmit it)."""
+        with self._lock:
+            job.seen_nonces.discard(nonce)

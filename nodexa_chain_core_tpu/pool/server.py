@@ -1,0 +1,681 @@
+"""Non-blocking line-JSON Stratum server with per-connection sessions.
+
+One IO thread runs a ``selectors`` loop over the listener and every
+client socket: reads are dispatched as they arrive, `\\n`-framed JSON
+lines are parsed and routed (subscribe / authorize / submit), and
+oversized or garbage input scores misbehavior exactly like the P2P
+layer's ``Misbehaving`` (ref net_processing.cpp) — enough score and the
+connection is dropped and its address banned.
+
+Writes (submit replies from the share pipeline, notify fanout from the
+job manager) happen from their originating threads under a per-session
+lock; a failed or timed-out write marks the session dead and the IO
+thread reaps it.  Only the IO thread closes sockets, so the selector
+never races a foreign close.
+
+Session state: unique extranonce1 (the top 16 bits of every nonce the
+session may submit), per-session vardiff difficulty with
+``mining.set_target`` pushes, authorized worker names, share counters,
+and a misbehavior score.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import selectors
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+from ..core.uint256 import u256_hex
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+from . import shares as sh
+from .jobs import Job, JobManager
+from .shares import Share, SharePipeline
+
+MAX_LINE = 8192          # one stratum message never legitimately nears this
+MAX_BUFFER = 65536       # unframed garbage cap before the connection drops
+MAX_SEND_BUFFER = 262144  # slow-consumer cap: miss this and you're dropped
+BAN_THRESHOLD = 100      # misbehavior score that converts into a ban
+MAX_INFLIGHT_SHARES = 32  # per-session shares awaiting validation
+
+_M_CONNECTIONS = g_metrics.counter(
+    "nodexa_pool_connections_total",
+    "Stratum connections, labeled event=accepted/refused_banned/full")
+_M_MISBEHAVIOR = g_metrics.counter(
+    "nodexa_pool_misbehavior_total",
+    "Stratum misbehavior score, labeled by reason")
+_M_NOTIFY_SECONDS = g_metrics.histogram(
+    "nodexa_pool_notify_seconds",
+    "Job-notify fanout latency (one observation per broadcast)")
+_M_VARDIFF = g_metrics.counter(
+    "nodexa_pool_vardiff_retargets_total",
+    "Vardiff retargets, labeled direction=up/down")
+_M_HASHRATE = g_metrics.ewma(
+    "nodexa_pool_worker_hashrate_hs",
+    "Estimated per-worker hashrate from accepted share difficulty",
+    tau=300.0)
+_MAX_WORKER_LABELS = 64  # worker names are remote input: bound the label set
+
+
+class Vardiff:
+    """Per-session difficulty retargeting (power-of-two steps).
+
+    Aims for one share every ``target_share_s``.  A window closes after
+    ``window_shares`` shares or ``window_s`` seconds (whichever first,
+    evaluated on each share); a window whose rate is >2x the goal doubles
+    the difficulty, <0.5x halves it.  Powers of two keep the share
+    target arithmetic exact.
+    """
+
+    def __init__(self, target_share_s: float = 10.0, window_shares: int = 8,
+                 window_s: float = 60.0, min_diff: int = 1,
+                 max_diff: int = 1 << 32, time_fn=time.monotonic):
+        self.target_share_s = target_share_s
+        self.window_shares = window_shares
+        self.window_s = window_s
+        self.min_diff = min_diff
+        self.max_diff = max_diff
+        self._time = time_fn
+        self.difficulty = min_diff
+        self._window_start = time_fn()
+        self._shares = 0
+
+    def record_share(self) -> Optional[str]:
+        """Fold one accepted share in; returns "up"/"down" on retarget."""
+        now = self._time()
+        self._shares += 1
+        elapsed = max(now - self._window_start, 1e-9)
+        if self._shares < self.window_shares and elapsed < self.window_s:
+            return None
+        rate = self._shares / elapsed
+        ideal = 1.0 / self.target_share_s
+        direction = None
+        if rate > 2.0 * ideal and self.difficulty < self.max_diff:
+            self.difficulty *= 2
+            direction = "up"
+        elif rate < 0.5 * ideal and self.difficulty > self.min_diff:
+            self.difficulty //= 2
+            direction = "down"
+        self._window_start = now
+        self._shares = 0
+        return direction
+
+
+class StratumSession:
+    _next_key = 0
+
+    def __init__(self, sock: socket.socket, addr, extranonce1: int,
+                 vardiff: Vardiff):
+        StratumSession._next_key += 1
+        self.key = StratumSession._next_key
+        self.sock = sock
+        self.ip = addr[0]
+        self.buffer = b""
+        self.extranonce1 = extranonce1
+        self.subscribed = False
+        self.workers: set = set()
+        self.vardiff = vardiff
+        self.misbehavior = 0
+        self.dead = False
+        self.last_job_id: Optional[str] = None
+        self.accepted = 0
+        self.rejected = 0
+        self.inflight = 0  # shares queued for validation, not yet judged
+        self.connected_at = time.time()
+        self._wlock = threading.Lock()
+        self._out = bytearray()
+        # last TWO pushed share targets: in-flight shares mined against
+        # the pre-retarget target stay acceptable (stratum convention)
+        self.pushed_targets: list = []
+
+    @property
+    def extranonce1_hex(self) -> str:
+        return f"{self.extranonce1:04x}"
+
+    def send_json(self, obj: dict) -> bool:
+        """Queue + opportunistic non-blocking flush.
+
+        NEVER blocks: notify fanout runs on the validation-bus thread
+        (under cs_main) and replies on the share pipeline — a stalled
+        miner socket must cost neither.  Unsent bytes accumulate up to
+        MAX_SEND_BUFFER (then the slow consumer is dropped) and the IO
+        loop re-flushes as the socket drains.
+        """
+        data = (json.dumps(obj) + "\n").encode()
+        with self._wlock:
+            if len(self._out) + len(data) > MAX_SEND_BUFFER:
+                self.dead = True
+                return False
+            self._out += data
+            return self._flush_locked()
+
+    def flush(self) -> None:
+        with self._wlock:
+            if self._out:
+                self._flush_locked()
+
+    def _flush_locked(self) -> bool:
+        try:
+            while self._out:
+                n = self.sock.send(self._out)
+                if n <= 0:
+                    break
+                del self._out[:n]
+        except (BlockingIOError, InterruptedError):
+            pass  # kernel buffer full; the IO loop retries
+        except OSError:
+            self.dead = True
+            return False
+        return True
+
+    def reply(self, req_id, result, error=None) -> bool:
+        return self.send_json({"id": req_id, "result": result, "error": error})
+
+    def reply_error(self, req_id, code: int, reason: str) -> bool:
+        return self.reply(req_id, False, [code, reason, None])
+
+
+class StratumServer:
+    """The pool front door; one instance per node (``-pool``)."""
+
+    def __init__(self, node, jobs: JobManager, pipeline: SharePipeline,
+                 host: str = "127.0.0.1", port: int = 3333,
+                 start_difficulty: int = 1, max_connections: int = 256,
+                 ban_time_s: float = 600.0,
+                 vardiff_target_share_s: float = 10.0,
+                 vardiff_window_shares: int = 8,
+                 vardiff_window_s: float = 60.0):
+        self.node = node
+        self.jobs = jobs
+        self.pipeline = pipeline
+        self.host = host
+        self.max_connections = max_connections
+        self.ban_time_s = ban_time_s
+        self.start_difficulty = max(1, start_difficulty)
+        self.vardiff_target_share_s = vardiff_target_share_s
+        self.vardiff_window_shares = vardiff_window_shares
+        self.vardiff_window_s = vardiff_window_s
+        # difficulty-1 share target: the chain's KawPow limit, so diff N
+        # means "N times the work of the easiest valid KawPow share"
+        self.diff1_target = node.params.consensus.kawpow_limit
+        # expected hashes behind one diff-1 share (for hashrate gauges)
+        self._hashes_per_diff1 = (1 << 256) / float(self.diff1_target + 1)
+
+        self.sessions: Dict[int, StratumSession] = {}
+        self._sessions_lock = threading.Lock()
+        # written from the IO thread (_accept/prune), the share pipeline
+        # and the bus (_misbehave via send failures), read by info():
+        # every touch goes through _banned_lock
+        self.banned: Dict[str, float] = {}
+        self._banned_lock = threading.Lock()
+        self._extranonce_ctr = secrets.randbelow(1 << 16)
+        self._worker_labels: set = set()
+        self.started_at = time.time()
+
+        self._stop = threading.Event()
+        self._sel = selectors.DefaultSelector()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self._sel.register(self._listener, selectors.EVENT_READ, None)
+        self._thread: Optional[threading.Thread] = None
+
+        jobs.on_new_job = self.broadcast_job
+        g_metrics.gauge_fn(
+            "nodexa_pool_sessions", "Connected stratum sessions",
+            lambda: len(self.sessions))
+        g_metrics.gauge_fn(
+            "nodexa_pool_workers", "Distinct authorized stratum workers",
+            self._worker_count)
+
+    def _worker_count(self) -> int:
+        with self._sessions_lock:
+            return len({
+                w for s in self.sessions.values() for w in s.workers
+            })
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.pipeline.start()
+        self.jobs.start()
+        self._thread = threading.Thread(
+            target=self._io_loop, name="pool-io", daemon=True)
+        self._thread.start()
+        log_printf("stratum pool server listening on %s:%d (diff %d)",
+                   self.host, self.port, self.start_difficulty)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.jobs.stop()
+        self.pipeline.stop()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+        self._thread = None
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._sessions_lock:
+            sessions = list(self.sessions.values())
+            self.sessions.clear()
+        for s in sessions:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+    # -- IO loop (the only thread that closes/unregisters sockets) --------
+
+    def _io_loop(self) -> None:
+        self._last_prune = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self._io_pass()
+            except Exception as e:  # noqa: BLE001 — the ONE io thread
+                # must survive anything a hostile peer provokes
+                log_printf("pool: io loop error: %r", e)
+                time.sleep(0.05)
+
+    def _io_pass(self) -> None:
+        events = self._sel.select(timeout=0.2)
+        for key, _ in events:
+            if key.data is None:
+                self._accept()
+            else:
+                self._read(key.data)
+        with self._sessions_lock:
+            sessions = list(self.sessions.values())
+        for s in sessions:
+            if not s.dead:
+                s.flush()  # drain bytes queued by writer threads
+        for s in sessions:
+            if s.dead:
+                self._drop(s)
+        now = time.monotonic()
+        if now - self._last_prune > 60.0:
+            self._last_prune = now
+            wall = time.time()
+            with self._banned_lock:
+                for ip in [
+                    ip for ip, t in self.banned.items() if t <= wall
+                ]:
+                    del self.banned[ip]
+
+    def _accept(self) -> None:
+        try:
+            sock, addr = self._listener.accept()
+        except OSError:
+            return
+        now = time.time()
+        with self._banned_lock:
+            until = self.banned.get(addr[0], 0)
+            if until and until <= now:
+                del self.banned[addr[0]]  # expired: stop carrying it
+        if until > now:
+            _M_CONNECTIONS.inc(event="refused_banned")
+            sock.close()
+            return
+        if len(self.sessions) >= self.max_connections:
+            _M_CONNECTIONS.inc(event="full")
+            sock.close()
+            return
+        sock.setblocking(False)
+        sess = StratumSession(
+            sock, addr, self._alloc_extranonce(),
+            Vardiff(self.vardiff_target_share_s, self.vardiff_window_shares,
+                    self.vardiff_window_s, min_diff=self.start_difficulty),
+        )
+        with self._sessions_lock:
+            self.sessions[sess.key] = sess
+        self._sel.register(sock, selectors.EVENT_READ, sess)
+        _M_CONNECTIONS.inc(event="accepted")
+
+    def _alloc_extranonce(self) -> int:
+        """Unique-per-live-session 16-bit nonce prefix."""
+        with self._sessions_lock:
+            in_use = {s.extranonce1 for s in self.sessions.values()}
+            for _ in range(1 << 16):
+                self._extranonce_ctr = (self._extranonce_ctr + 1) & 0xFFFF
+                if self._extranonce_ctr not in in_use:
+                    return self._extranonce_ctr
+        raise RuntimeError("extranonce space exhausted")
+
+    def _drop(self, sess: StratumSession) -> None:
+        with self._sessions_lock:
+            self.sessions.pop(sess.key, None)
+        try:
+            self._sel.unregister(sess.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            sess.sock.close()
+        except OSError:
+            pass
+
+    def _read(self, sess: StratumSession) -> None:
+        try:
+            chunk = sess.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return  # spurious readiness on the non-blocking socket
+        except OSError:
+            chunk = b""
+        if not chunk:
+            self._drop(sess)
+            return
+        sess.buffer += chunk
+        if b"\n" not in sess.buffer and len(sess.buffer) > MAX_BUFFER:
+            self._misbehave(sess, BAN_THRESHOLD, "unframed-flood")
+            return
+        while b"\n" in sess.buffer and not sess.dead:
+            line, sess.buffer = sess.buffer.split(b"\n", 1)
+            if not line.strip():
+                continue
+            if len(line) > MAX_LINE:
+                self._misbehave(sess, 20, "oversized-line")
+                continue
+            self._handle_line(sess, line)
+        if sess.dead:
+            self._drop(sess)
+
+    # -- protocol ----------------------------------------------------------
+
+    def _handle_line(self, sess: StratumSession, line: bytes) -> None:
+        try:
+            msg = json.loads(line)
+            method = msg["method"]
+            params = msg.get("params") or []
+            req_id = msg.get("id")
+            if not isinstance(method, str) or not isinstance(params, list):
+                raise ValueError("bad shape")
+        except (ValueError, KeyError, TypeError):
+            self._misbehave(sess, 10, "garbage-line")
+            sess.reply_error(None, sh.E_OTHER, "parse error")
+            return
+        if method == "mining.subscribe":
+            self._on_subscribe(sess, req_id)
+        elif method == "mining.authorize":
+            self._on_authorize(sess, req_id, params)
+        elif method == "mining.extranonce.subscribe":
+            sess.reply(req_id, True)
+        elif method == "mining.submit":
+            self._on_submit(sess, req_id, params)
+        else:
+            self._misbehave(sess, 1, "unknown-method")
+            sess.reply_error(req_id, sh.E_OTHER, f"unknown method {method}")
+
+    def _on_subscribe(self, sess: StratumSession, req_id) -> None:
+        sess.subscribed = True
+        sess.reply(req_id, [
+            ["mining.notify", f"{sess.key:08x}"], sess.extranonce1_hex,
+        ])
+        self._push_target(sess)
+        # current() may CUT the first job, which already notified this
+        # (subscribed) session via broadcast_job — _send_job dedups
+        job = self.jobs.current()
+        if job is not None:
+            self._send_job(sess, job, clean=True)
+
+    def _on_authorize(self, sess: StratumSession, req_id, params) -> None:
+        if not params or not str(params[0]).strip():
+            sess.reply_error(req_id, sh.E_OTHER, "worker name required")
+            return
+        worker = str(params[0])[:64]
+        sess.workers.add(worker)
+        sess.reply(req_id, True)
+
+    def share_target(self, sess: StratumSession) -> int:
+        return self.diff1_target // sess.vardiff.difficulty
+
+    def _push_target(self, sess: StratumSession) -> None:
+        target = self.share_target(sess)
+        # remember the previous push too: shares mined before the miner
+        # applies a retarget are judged against the easier of the two
+        sess.pushed_targets = (sess.pushed_targets + [target])[-2:]
+        sess.send_json({
+            "id": None, "method": "mining.set_target",
+            "params": [u256_hex(target)],
+        })
+
+    def _notify_msg(self, sess: StratumSession, job: Job,
+                    clean: bool) -> dict:
+        return {
+            "id": None, "method": "mining.notify",
+            "params": [
+                job.job_id,
+                job.header_hash_disp.hex(),
+                job.epoch,
+                u256_hex(self.share_target(sess)),
+                clean,
+                job.height,
+                f"{job.bits:08x}",
+            ],
+        }
+
+    def _send_job(self, sess: StratumSession, job: Job,
+                  clean: bool) -> None:
+        if sess.last_job_id == job.job_id:
+            return  # already notified (subscribe racing broadcast)
+        sess.last_job_id = job.job_id
+        sess.send_json(self._notify_msg(sess, job, clean=clean))
+
+    def broadcast_job(self, job: Job) -> None:
+        """Fan a fresh job out to every subscribed session (JobManager's
+        on_new_job hook — fires on tip updates and mempool refreshes)."""
+        t0 = time.perf_counter()
+        with self._sessions_lock:
+            sessions = [s for s in self.sessions.values() if s.subscribed]
+        for sess in sessions:
+            self._send_job(sess, job, clean=job.clean)
+        _M_NOTIFY_SECONDS.observe(time.perf_counter() - t0)
+
+    # -- submit path -------------------------------------------------------
+
+    def _on_submit(self, sess: StratumSession, req_id, params) -> None:
+        if not sess.subscribed:
+            sess.reply_error(req_id, sh.E_NOT_SUBSCRIBED, "not subscribed")
+            return
+        # [worker, job_id, nonce, mix] or the wider GPU-miner shape
+        # [worker, job_id, nonce, header_hash, mix]
+        if len(params) not in (4, 5):
+            self._misbehave(sess, 5, "bad-submit-arity")
+            sess.reply_error(req_id, sh.E_OTHER, "bad submit params")
+            return
+        worker = str(params[0])
+        job_id = str(params[1])
+        nonce_hex = str(params[2])
+        mix_hex = str(params[-1])
+        if worker not in sess.workers:
+            sess.reply_error(req_id, sh.E_UNAUTHORIZED, "unauthorized worker")
+            return
+        try:
+            nonce = int(nonce_hex.removeprefix("0x"), 16)
+            mix = int(mix_hex.removeprefix("0x"), 16)
+            if nonce >= (1 << 64) or mix >= (1 << 256):
+                raise ValueError
+        except ValueError:
+            self._misbehave(sess, 10, "unparseable-share")
+            self._reject(sess, req_id, sh.E_OTHER, sh.R_BAD_NONCE)
+            return
+        job = self.jobs.get(job_id)
+        if job is None:
+            self._reject(sess, req_id, sh.E_STALE, sh.R_UNKNOWN_JOB)
+            self._misbehave(sess, 1, sh.R_UNKNOWN_JOB)
+            return
+        if self.jobs.is_stale(job):
+            self._reject(sess, req_id, sh.E_STALE, sh.R_STALE)
+            return
+        if (nonce >> 48) != sess.extranonce1:
+            # a miner ignoring its nonce partition is either broken or
+            # replaying another session's shares: score it harder
+            self._misbehave(sess, 10, sh.R_BAD_NONCE)
+            self._reject(sess, req_id, sh.E_OTHER, sh.R_BAD_NONCE)
+            return
+        # backpressure BEFORE the nonce claim: a shed share must stay
+        # resubmittable, not burn its nonce into a later duplicate.
+        # A session streaming raw hashes as shares (each costing a full
+        # KawPow validation) is load-shed and scored — honest miners at
+        # a sane vardiff never hold 32 shares in flight
+        with sess._wlock:
+            over = sess.inflight >= MAX_INFLIGHT_SHARES
+            if not over:
+                sess.inflight += 1
+        if over:
+            self._misbehave(sess, 1, "share-flood")
+            sess.reply_error(req_id, sh.E_OTHER, "busy")
+            return
+        if not self.jobs.claim_nonce(job, nonce):
+            with sess._wlock:
+                sess.inflight -= 1
+            self._misbehave(sess, 5, sh.R_DUPLICATE)
+            self._reject(sess, req_id, sh.E_DUPLICATE, sh.R_DUPLICATE)
+            return
+        accepted = self.pipeline.submit(Share(
+            sess, req_id, worker, job, nonce, mix,
+            max(sess.pushed_targets or [self.share_target(sess)]),
+            self._on_share_result,
+        ))
+        if not accepted:  # pipeline queue saturated (global backpressure)
+            with sess._wlock:
+                sess.inflight -= 1
+            self.jobs.release_nonce(job, nonce)  # resubmittable later
+            sess.reply_error(req_id, sh.E_OTHER, "busy")
+
+    def _reject(self, sess: StratumSession, req_id, code: int,
+                reason: str) -> None:
+        sess.rejected += 1
+        self.pipeline.count(reason)
+        sess.reply_error(req_id, code, reason)
+
+    def _on_share_result(self, share: Share, ok: bool, reason: str) -> None:
+        """Pipeline verdict callback (runs on the pool-shares thread)."""
+        sess: StratumSession = share.session
+        with sess._wlock:
+            sess.inflight = max(0, sess.inflight - 1)
+        if not ok:
+            sess.rejected += 1
+            # only a FABRICATED share (wrong mix) is hostile; low-diff
+            # happens to honest miners around retargets and an internal
+            # validation error is the server's own fault
+            if reason == sh.R_BAD_MIX:
+                self._misbehave(sess, 5, reason)
+            code = sh.E_LOW_DIFF if reason == sh.R_LOW_DIFF else sh.E_OTHER
+            sess.reply_error(share.req_id, code, reason)
+            return
+        sess.accepted += 1
+        self._record_hashrate(share.worker, sess.vardiff.difficulty)
+        direction = sess.vardiff.record_share()
+        sess.reply(share.req_id, True)
+        if direction is not None:
+            _M_VARDIFF.inc(direction=direction)
+            self._push_target(sess)
+
+    def _record_hashrate(self, worker: str, difficulty: int) -> None:
+        if worker not in self._worker_labels:
+            if len(self._worker_labels) >= _MAX_WORKER_LABELS:
+                worker = "other"
+            else:
+                self._worker_labels.add(worker)
+        _M_HASHRATE.update(
+            difficulty * self._hashes_per_diff1, worker=worker)
+
+    # -- abuse handling ----------------------------------------------------
+
+    def _misbehave(self, sess: StratumSession, score: int,
+                   reason: str) -> None:
+        sess.misbehavior += score
+        _M_MISBEHAVIOR.inc(score, reason=reason)
+        if sess.misbehavior >= BAN_THRESHOLD:
+            with self._banned_lock:
+                self.banned[sess.ip] = time.time() + self.ban_time_s
+            log_printf("pool: banning %s for %ds (%s, score %d)",
+                       sess.ip, int(self.ban_time_s), reason,
+                       sess.misbehavior)
+            sess.dead = True
+
+    # -- introspection (getpoolinfo) --------------------------------------
+
+    def info(self) -> dict:
+        now = time.time()
+        with self._sessions_lock:
+            sessions = list(self.sessions.values())
+        workers = sorted({w for s in sessions for w in s.workers})
+        per_worker = {
+            w: round(_M_HASHRATE.value(
+                worker=w if w in self._worker_labels else "other"), 2)
+            for w in workers
+        }
+        return {
+            "enabled": True,
+            "bind": f"{self.host}:{self.port}",
+            "uptime": int(now - self.started_at),
+            "connections": len(sessions),
+            "workers": workers,
+            "worker_hashrate_hs": per_worker,
+            "difficulty1_target": u256_hex(self.diff1_target),
+            "start_difficulty": self.start_difficulty,
+            "vardiff": {
+                "target_share_seconds": self.vardiff_target_share_s,
+                "window_shares": self.vardiff_window_shares,
+                "window_seconds": self.vardiff_window_s,
+            },
+            "shares": self.pipeline.snapshot_counts(),
+            "pending_shares": self.pipeline.pending(),
+            "banned": sum(
+                1 for t in self._banned_snapshot() if t > now),
+        }
+
+    def _banned_snapshot(self):
+        with self._banned_lock:
+            return list(self.banned.values())
+
+
+def _payout_script(node) -> bytes:
+    """Pool coinbase scriptPubKey: -pooladdress, else -miningaddress,
+    else the wallet's mining key (the built-in miner's policy)."""
+    from ..script.standard import decode_destination, script_for_destination
+    from ..utils.args import g_args
+
+    for argname in ("pooladdress", "miningaddress"):
+        addr = g_args.get(argname, "")
+        if addr:
+            return script_for_destination(
+                decode_destination(str(addr), node.params)
+            ).raw
+    wallet = getattr(node, "wallet", None)
+    if wallet is not None:
+        from ..script.standard import KeyID, p2pkh_script
+
+        kid = wallet.get_keyid_for_mining()
+        if kid:
+            return p2pkh_script(KeyID(kid)).raw
+    raise SystemExit(
+        "Error: -pool needs a coinbase destination: set -pooladdress (or "
+        "-miningaddress), or run with the wallet enabled")
+
+
+def start_pool(node, host: str = "127.0.0.1", port: int = 3333,
+               payout_script: Optional[bytes] = None,
+               start_difficulty: int = 1,
+               **server_kwargs) -> StratumServer:
+    """Build and start the full pool stack (daemon -pool entry point)."""
+    if payout_script is None:
+        payout_script = _payout_script(node)
+    jobs = JobManager(node, payout_script)
+    pipeline = SharePipeline(node)
+    server = StratumServer(
+        node, jobs, pipeline, host=host, port=port,
+        start_difficulty=start_difficulty, **server_kwargs)
+    server.start()
+    return server

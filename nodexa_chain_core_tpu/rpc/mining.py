@@ -81,10 +81,6 @@ class _TipWaiter:
         self._registered = False
 
     def _ensure(self):
-        with self._cond:  # registration races resolved under the lock
-            if self._registered:
-                return
-            self._registered = True
         from ..node.events import ValidationInterface, main_signals
 
         waiter = self
@@ -94,7 +90,20 @@ class _TipWaiter:
                 with waiter._cond:
                     waiter._cond.notify_all()
 
-        main_signals.register(_Sub())
+        # Register while HOLDING the condition and only then mark
+        # registered: the old mark-then-register window let a second
+        # waiter thread see _registered and start cond.wait before the
+        # subscriber existed, so a tip update in that window (e.g. a
+        # pool- or submitblock-landed block, which signals from the
+        # submitting thread immediately) was missed until the 1 s
+        # re-poll.  updated_block_tip fires for LOCAL blocks too
+        # (activate_best_chain -> main_signals), so pool-found blocks
+        # wake long-pollers through the same path as p2p tip updates.
+        with self._cond:
+            if self._registered:
+                return
+            main_signals.register(_Sub())
+            self._registered = True
 
     def wait(self, predicate, timeout=None) -> bool:
         """Block until predicate() or timeout (None = forever); re-checks
@@ -397,6 +406,16 @@ def setgenerate(node, params: List[Any]):
     return None
 
 
+def getpoolinfo(node, params: List[Any]):
+    """Stratum work-server introspection (pool/ subsystem): bind address,
+    connected sessions/workers, per-worker hashrate estimates, share
+    counters by reject reason, vardiff policy, and ban count."""
+    pool = getattr(node, "pool_server", None)
+    if pool is None:
+        return {"enabled": False}
+    return pool.info()
+
+
 def getnetworkhashps(node, params: List[Any]):
     """ref rpc/mining.cpp GetNetworkHashPS."""
     lookup = int(params[0]) if params else 120
@@ -434,6 +453,7 @@ def register(table: RPCTable) -> None:
          ["header_hash", "mix_hash", "nonce", "height", "target"]),
         ("pprpcsb", pprpcsb, ["header_hash", "mix_hash", "nonce"]),
         ("getmininginfo", getmininginfo, []),
+        ("getpoolinfo", getpoolinfo, []),
         ("getgenerate", getgenerate, []),
         ("setgenerate", setgenerate, ["generate", "genproclimit"]),
         ("getnetworkhashps", getnetworkhashps, ["nblocks", "height"]),

@@ -43,6 +43,8 @@ EXPECTED_EXTRAS = {
     "generatetoaddresstpu",
     # node-wide telemetry registry (REST /metrics twin)
     "getmetrics",
+    # stratum work-server subsystem (pool/)
+    "getpoolinfo",
 }
 
 

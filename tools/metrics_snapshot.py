@@ -39,6 +39,28 @@ starts and after it finishes, then read the delta's
   ... let the node sync ...
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_ibd.json | python -m json.tool | grep -A8 connectblock
+
+Diffing a pool session (-pool stratum work server): snapshot before the
+miners connect and after a share interval, then read the delta's
+
+  nodexa_pool_shares_total{result=accepted|duplicate|stale-job|...}
+      — the share ledger by verdict; low-diff climbing means vardiff
+      lags the fleet, stale-job climbing means notify fanout is slow
+  nodexa_pool_share_batch_seconds{path=batched|scalar}
+      — validation latency per micro-batch; `scalar` samples mean the
+      epoch's device slab wasn't ready (check -tpukawpow / epoch logs)
+  nodexa_pool_share_batch_size
+      — how full micro-batches run (1-share batches = light load)
+  nodexa_pool_notify_seconds / nodexa_pool_vardiff_retargets_total
+      — job fanout latency and retarget churn
+  nodexa_pool_sessions / nodexa_pool_workers (gauge pair) and
+  nodexa_pool_worker_hashrate_hs{worker=...}
+      — fleet size and per-worker rate estimated from share difficulty
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_pool.json
+  ... miners hammer the stratum port ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_pool.json | python -m json.tool | grep -A4 nodexa_pool
 """
 
 from __future__ import annotations
