@@ -555,6 +555,34 @@ def bench_pool() -> dict:
     return res
 
 
+def bench_txflood() -> dict:
+    """Transaction-admission throughput (node fast path, CPU-side): a
+    pre-signed P2PKH flood submitted from concurrent threads through the
+    staged (off-cs_main parallel scripts + sighash midstate) vs inline
+    (legacy all-under-the-lock) admission paths.  Details in
+    nodexa_chain_core_tpu/bench/txflood.py."""
+    from nodexa_chain_core_tpu.bench.txflood import flood
+
+    t = time.perf_counter()
+    threads = min(4, max(2, os.cpu_count() or 2))
+    res = flood(threads=threads, repeats=3)
+    log(f"[txflood] {res['staged']['txs']} txs x {threads} threads: "
+        f"{res['mempool_accepts_per_s']:,.0f} accepts/s staged vs "
+        f"{res['mempool_accepts_per_s_inline']:,.0f} inline -> "
+        f"{res['mempool_staged_vs_inline']}x; cs_main hold p99 "
+        f"{res['csmain_hold_p99_s']*1e3:.1f}ms vs scripts mean "
+        f"{res['scripts_stage_mean_s']*1e3:.1f}ms "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return {
+        "mempool_accepts_per_s": res["mempool_accepts_per_s"],
+        "mempool_accepts_per_s_inline": res["mempool_accepts_per_s_inline"],
+        "mempool_staged_vs_inline": res["mempool_staged_vs_inline"],
+        "mempool_csmain_hold_p99_s": res["csmain_hold_p99_s"],
+        "mempool_scripts_stage_mean_s": res["scripts_stage_mean_s"],
+        "mempool_taxonomy_match": res["taxonomy"]["match"],
+    }
+
+
 def bench_ibd() -> dict:
     """Synthetic IBD (node fast path, CPU-side): headers-first + out-of-
     order data into a datadir-backed ChainState, dbcache vs per-block
@@ -596,6 +624,8 @@ def main() -> None:
         extra.update(bench_sha256d(on_tpu))
     if not os.environ.get("NODEXA_BENCH_SKIP_IBD"):
         extra.update(bench_ibd())
+    if not os.environ.get("NODEXA_BENCH_SKIP_TXFLOOD"):
+        extra.update(bench_txflood())
     if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):
         extra.update(bench_pool())
 

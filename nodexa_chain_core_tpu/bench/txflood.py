@@ -1,0 +1,356 @@
+"""Synthetic signed-tx flood: the ISSUE-4 admission fast-path proof.
+
+Builds a regtest chain once (matured coinbases fanned out into many
+small confirmed outputs), pre-signs a flood of standard P2PKH spends —
+independent multi-input transactions plus chained segments spending
+in-mempool parents — then submits the identical flood through both
+admission paths from ``--threads`` concurrent submitters:
+
+- ``inline``: the legacy pipeline, everything (ECDSA included) under one
+  ``cs_main`` hold per transaction — concurrency collapses to the lock;
+- ``staged``: the PreChecks / snapshot+reserve / off-lock parallel
+  scripts / commit pipeline, sighash midstate + native ``verify_raw``.
+
+Per mode the flood runs ``--repeats`` times against a fresh mempool with
+the signature cache cleared (max-of-N: scheduler hiccups are one-sided
+noise and would otherwise flake the CI floor).  Reported (also used by
+tools/ci_gate.sh and bench.py):
+
+- ``mempool_accepts_per_s``          staged accepts/s
+- ``mempool_accepts_per_s_inline``   inline accepts/s
+- ``mempool_staged_vs_inline``       the ratio — CI floor >= 2x
+- ``csmain_hold_p99_s``              p99 of the staged path's cs_main
+  holds (snapshot+commit) — must sit BELOW the mean scripts-stage wall
+  time, the "ECDSA runs outside the lock" observability proof
+- ``scripts_stage_mean_s``           mean off-lock script-verify time
+- ``taxonomy``                       reject codes for a canned scenario
+  set on both paths — must match exactly
+
+Run: ``python -m nodexa_chain_core_tpu.bench.txflood [--txs N] [--assert-fast-path]``
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..telemetry import g_metrics
+
+
+def build_flood(n_txs: int = 240, threads: int = 4, inputs_per_tx: int = 2,
+                chain_frac: float = 0.33):
+    """(params, chainstate, per-thread tx lists, taxonomy fixtures).
+
+    The chain mines COINBASE_MATURITY + F coinbases, fans F of them out
+    into enough confirmed P2PKH outputs for the whole flood, and mines
+    the fanouts into one block.  Flood txs are pre-signed so submission
+    time is pure admission cost.  ``chain_frac`` of each thread's quota
+    is chained segments (child spends the in-mempool parent admitted
+    just before it — exercises the CoinsViewMemPool overlay and commit
+    ordering); the rest are independent ``inputs_per_tx``-input spends.
+    """
+    from ..chain.mempool import TxMemPool
+    from ..chain.validation import ChainState
+    from ..consensus.consensus import COINBASE_MATURITY
+    from ..consensus.merkle import merkle_root
+    from ..mining.assembler import BlockAssembler, mine_block_cpu
+    from ..node.chainparams import regtest_params
+    from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+    from ..script.interpreter import PrecomputedSighash
+    from ..script.sign import KeyStore, sign_tx_input
+    from ..script.standard import KeyID, p2pkh_script
+
+    params = regtest_params()
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xF100D)))
+    cs = ChainState(params)
+    cs.mempool = TxMemPool()
+    asm = BlockAssembler(cs)
+    t = params.genesis_time + 60
+
+    def mine(extra_txs=()):
+        nonlocal t
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        if extra_txs:
+            blk.vtx.extend(extra_txs)
+            blk.header.hash_merkle_root = merkle_root(
+                [tx.txid for tx in blk.vtx]
+            )[0]
+        if not mine_block_cpu(blk, params.algo_schedule):
+            raise RuntimeError("regtest mining failed")
+        cs.process_new_block(blk)
+        t += 60
+        return blk
+
+    fee = 100_000
+    outs_per_fanout = 32
+    n_chained = int(n_txs * chain_frac)
+    n_outputs_needed = (
+        (n_txs - n_chained) * inputs_per_tx  # independent spends
+        + n_chained  # chain roots (the rest of a chain feeds itself)
+        + 16  # taxonomy fixtures + slack
+    )
+    n_fanouts = (n_outputs_needed + outs_per_fanout - 1) // outs_per_fanout
+
+    cb_blocks = [mine() for _ in range(COINBASE_MATURITY + n_fanouts)]
+    fanouts = []
+    for i in range(n_fanouts):
+        cb = cb_blocks[i].vtx[0]
+        share = (cb.vout[0].value - fee) // outs_per_fanout
+        ftx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(cb.txid, 0))],
+            vout=[TxOut(value=share, script_pubkey=spk.raw)
+                  for _ in range(outs_per_fanout)],
+        )
+        sign_tx_input(ks, ftx, 0, spk)
+        fanouts.append(ftx)
+    mine(fanouts)
+
+    outputs = [(OutPoint(ftx.txid, n), ftx.vout[n].value)
+               for ftx in fanouts for n in range(outs_per_fanout)]
+
+    def make_tx(ins):
+        tx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=op) for op, _ in ins],
+            vout=[TxOut(value=sum(v for _, v in ins) - fee,
+                        script_pubkey=spk.raw)],
+        )
+        precomp = PrecomputedSighash(tx)
+        for i in range(len(ins)):
+            sign_tx_input(ks, tx, i, spk, precomputed=precomp)
+        return tx
+
+    lists = [[] for _ in range(threads)]
+    per_thread = n_txs // threads
+    chain_per_thread = int(per_thread * chain_frac)
+    for tl in lists:
+        # one chained segment: root from a confirmed output, then
+        # children riding the in-mempool parent
+        if chain_per_thread:
+            prev = make_tx([outputs.pop()])
+            tl.append(prev)
+            for _ in range(chain_per_thread - 1):
+                prev = make_tx([(OutPoint(prev.txid, 0), prev.vout[0].value)])
+                tl.append(prev)
+        while len(tl) < per_thread:
+            ins = [outputs.pop() for _ in range(inputs_per_tx)]
+            tl.append(make_tx(ins))
+
+    # taxonomy fixtures: canned reject scenarios replayed on both paths
+    fixtures = {"outputs": [outputs.pop() for _ in range(8)],
+                "ks": ks, "spk": spk, "make_tx": make_tx}
+    return params, cs, lists, fixtures
+
+
+def _run_flood(cs, lists, staged: bool, threads: int) -> dict:
+    from ..chain.mempool import TxMemPool
+    from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+    from ..script.sigcache import signature_cache
+
+    signature_cache.clear()
+    pool = TxMemPool()
+    n_total = sum(len(tl) for tl in lists)
+    errors = []
+    start = threading.Barrier(threads + 1)
+
+    def submit(txs):
+        start.wait()
+        for tx in txs:
+            try:
+                accept_to_memory_pool(cs, pool, tx, staged=staged)
+            except MempoolAcceptError as e:  # flood txs are all valid
+                errors.append((tx.txid, e.code))
+
+    workers = [threading.Thread(target=submit, args=(tl,), daemon=True)
+               for tl in lists]
+    for w in workers:
+        w.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for w in workers:
+        w.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"flood rejects on {'staged' if staged else 'inline'}"
+                           f" path: {errors[:4]} (+{max(0, len(errors)-4)})")
+    if pool.size() != n_total:
+        raise RuntimeError(f"pool holds {pool.size()} != {n_total} accepted")
+    if pool.reserved_count() != 0:
+        raise RuntimeError("outpoint reservations leaked")
+    return {
+        "txs": n_total,
+        "wall_s": round(wall, 4),
+        "accepts_per_s": round(n_total / wall, 1),
+    }
+
+
+def _hist_mean(name: str, **labels):
+    h = g_metrics.get(name)
+    snap = h.snapshot(**labels) if h is not None else None
+    if not snap or not snap["count"]:
+        return 0.0, 0
+    return snap["sum"] / snap["count"], snap["count"]
+
+
+def _hold_p99(stages=("snapshot", "commit")) -> float:
+    """p99 across the staged path's cs_main hold histograms (bucket
+    upper bound containing the 99th percentile observation)."""
+    h = g_metrics.get("nodexa_mempool_csmain_hold_seconds")
+    if h is None:
+        return float("inf")
+    merged: dict = {}
+    total = 0
+    for stage in stages:
+        snap = h.snapshot(stage=stage)
+        if not snap:
+            continue
+        total += snap["count"]
+        for boundary, cum in snap["buckets"].items():
+            merged[boundary] = merged.get(boundary, 0) + cum
+    if not total:
+        return float("inf")
+    threshold = 0.99 * total
+    for boundary in sorted(merged):
+        if merged[boundary] >= threshold:
+            return boundary
+    return float("inf")
+
+
+def _taxonomy(cs, fixtures) -> dict:
+    """Reject-code parity: the same canned scenarios through both paths
+    against fresh pools must produce identical codes."""
+    from ..chain.mempool import TxMemPool
+    from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+    from ..primitives.transaction import OutPoint
+
+    make_tx = fixtures["make_tx"]
+    outs = fixtures["outputs"]
+
+    def run_path(staged):
+        pool = TxMemPool()
+        codes = {}
+
+        def code(name, tx):
+            try:
+                accept_to_memory_pool(cs, pool, tx, staged=staged)
+                codes[name] = None
+            except MempoolAcceptError as e:
+                codes[name] = e.code
+
+        keep = make_tx([outs[0]])
+        code("accept", keep)
+        code("duplicate", keep)
+        code("double-spend", make_tx([outs[0]]))
+        badsig = make_tx([outs[1]])
+        sig = bytearray(badsig.vin[0].script_sig)
+        sig[10] ^= 0x01
+        badsig.vin[0].script_sig = bytes(sig)
+        code("bad-sig", badsig)
+        missing = make_tx([outs[2]])
+        missing.vin[0].prevout = OutPoint(txid=0xDEAD, n=0)
+        code("missing-input", missing)
+        zero = make_tx([outs[3]])
+        zero.vout[0].value += 100_000  # claws the fee back
+        code("zero-fee", zero)
+        return codes
+
+    staged_codes = run_path(True)
+    inline_codes = run_path(False)
+    return {
+        "staged": staged_codes,
+        "inline": inline_codes,
+        "match": staged_codes == inline_codes,
+    }
+
+
+def flood(n_txs: int = 240, threads: int = 4, inputs_per_tx: int = 2,
+          repeats: int = 2) -> dict:
+    """Build once, flood each path ``repeats`` times, keep the best."""
+    params, cs, lists, fixtures = build_flood(n_txs, threads, inputs_per_tx)
+    out = {}
+    # repeats are INTERLEAVED (inline, staged, inline, staged, ...): this
+    # box's clock speed drifts run to run, and back-to-back pairs sample
+    # both paths under the same conditions before max-of-N picks winners
+    for _ in range(max(1, repeats)):
+        for mode, staged in (("inline", False), ("staged", True)):
+            if staged:
+                # the assert reads the STAGED runs' histograms: isolate
+                # them from the inline runs and the chain build
+                g_metrics.reset()
+            r = _run_flood(cs, lists, staged, threads)
+            best = out.get(mode)
+            if best is None or r["accepts_per_s"] > best["accepts_per_s"]:
+                out[mode] = r
+    scripts_mean, scripts_n = _hist_mean(
+        "nodexa_mempool_accept_seconds", stage="scripts")
+    out["mempool_accepts_per_s"] = out["staged"]["accepts_per_s"]
+    out["mempool_accepts_per_s_inline"] = out["inline"]["accepts_per_s"]
+    out["mempool_staged_vs_inline"] = round(
+        out["staged"]["accepts_per_s"]
+        / max(out["inline"]["accepts_per_s"], 1e-9), 2)
+    out["csmain_hold_p99_s"] = _hold_p99()
+    out["scripts_stage_mean_s"] = round(scripts_mean, 6)
+    out["scripts_stage_observations"] = scripts_n
+    out["taxonomy"] = _taxonomy(cs, fixtures)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--txs", type=int, default=240)
+    p.add_argument(
+        "--threads", type=int, default=0,
+        help="submitter threads; 0 = one per core, capped at 4 "
+        "(oversubscribing physical cores only adds GIL ping-pong)")
+    p.add_argument("--inputs", type=int, default=2)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument(
+        "--assert-fast-path",
+        action="store_true",
+        help="CI gate: staged >= 2x inline accepts/s, cs_main hold p99 "
+        "below the mean scripts-stage wall time, and identical reject "
+        "taxonomy on both paths",
+    )
+    args = p.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    threads = args.threads or min(4, max(2, os.cpu_count() or 2))
+    res = flood(args.txs, threads, args.inputs, args.repeats)
+    print(json.dumps(res, indent=1))
+    if args.assert_fast_path:
+        # explicit raises, not assert: the gate must also gate under -O
+        gates = (
+            (res["mempool_staged_vs_inline"] >= 2.0,
+             f"staged {res['mempool_accepts_per_s']}/s is only "
+             f"{res['mempool_staged_vs_inline']}x inline "
+             f"{res['mempool_accepts_per_s_inline']}/s (< 2x floor)"),
+            (res["scripts_stage_observations"] > 0,
+             "no scripts-stage observations: the staged path never ran "
+             "script verification off the lock"),
+            (res["csmain_hold_p99_s"] < res["scripts_stage_mean_s"],
+             f"cs_main hold p99 {res['csmain_hold_p99_s']}s is not below "
+             f"the scripts-stage mean {res['scripts_stage_mean_s']}s — "
+             "ECDSA is not demonstrably outside the lock"),
+            (res["taxonomy"]["match"],
+             f"reject taxonomy diverged: {res['taxonomy']}"),
+        )
+        for ok, msg in gates:
+            if not ok:
+                raise SystemExit(f"tx admission fast path FAILED: {msg}")
+        print(
+            f"tx admission fast path OK: staged "
+            f"{res['mempool_accepts_per_s']:,} accepts/s = "
+            f"{res['mempool_staged_vs_inline']}x inline, cs_main hold p99 "
+            f"{res['csmain_hold_p99_s']*1e3:.1f}ms < scripts mean "
+            f"{res['scripts_stage_mean_s']*1e3:.1f}ms, taxonomy identical"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

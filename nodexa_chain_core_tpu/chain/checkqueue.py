@@ -4,11 +4,20 @@ Parity: reference src/checkqueue.h CCheckQueue/CCheckQueueControl — the
 ``-par`` script-verification worker pool that ConnectBlock fans per-input
 script checks onto (ref validation.cpp:9257,9301).
 
+Unlike the reference (whose CCheckQueueControl takes a queue-wide mutex,
+serializing whole batches), completion state lives in per-control
+*sessions*: every ``CheckQueueControl`` owns its own pending counter and
+first-failure slot, and workers complete checks against the session they
+were enqueued under.  That lets ConnectBlock (under cs_main) and any
+number of staged mempool admissions (outside cs_main) share the same
+worker pool concurrently — the tx-admission fast path's whole point is
+running ECDSA while cs_main is free for block connection.
+
 Python build note: with the pure-Python ECDSA backend the GIL serializes
 CPU-bound checks, so the default is inline execution; a thread pool engages
 when the configured check function releases the GIL (native backend).  The
 control-object protocol (add / wait-all / collect failure) is identical
-either way, so swapping the backend doesn't touch ConnectBlock.
+either way, so swapping the backend doesn't touch call sites.
 """
 
 from __future__ import annotations
@@ -34,23 +43,93 @@ _CHECKS_QUEUED = _M_CHECKS.labels(mode="queued")
 _CHECKS_INLINE = _M_CHECKS.labels(mode="inline")
 
 
+class CheckSession:
+    """One batch owner's completion state.
+
+    ``add`` enqueues onto the owning queue's shared workers;
+    ``wait`` blocks until every check added *to this session* completed
+    and returns the first failure (or None).  Several sessions may be
+    in flight on one queue at once.
+    """
+
+    __slots__ = ("_q", "_cond", "_pending", "_failed")
+
+    def __init__(self, q: "CheckQueue"):
+        self._q = q
+        self._cond = threading.Condition()
+        self._pending = 0
+        self._failed: Optional[str] = None
+
+    def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
+        if not checks:
+            return
+        # counted at enqueue, one locked add per BATCH — the per-check
+        # fast path (workers and _run_one) stays uninstrumented
+        _CHECKS_QUEUED.inc(len(checks))
+        with self._cond:
+            self._pending += len(checks)
+        q = self._q
+        if q.n_threads > 0:
+            for c in checks:
+                q._tasks.put((self, c))
+        else:
+            for c in checks:
+                q._run_one(self, c)
+
+    def _complete(self, err: Optional[str]) -> None:
+        with self._cond:
+            if err and self._failed is None:
+                self._failed = err
+            self._pending -= 1
+            if self._pending <= 0:
+                self._cond.notify_all()
+
+    def wait(self) -> Optional[str]:
+        """Drain until all of this session's checks are done; returns the
+        first failure or None (and resets for reuse).
+
+        The waiting thread is a WORKER while it waits (ref checkqueue.h
+        Loop(fMaster=true)): instead of sleeping on the condition it pops
+        queued checks — its own session's or anyone's — so an admission's
+        submitter thread contributes a core to script verification
+        rather than idling behind two context switches per check."""
+        q = self._q
+        while True:
+            with self._cond:
+                if not self._pending:
+                    failed, self._failed = self._failed, None
+                    return failed
+            try:
+                item = q._tasks.get_nowait()
+            except queue.Empty:
+                with self._cond:
+                    if self._pending:
+                        self._cond.wait()
+                continue
+            if item is None:  # a worker's stop sentinel: not ours to eat
+                q._tasks.put(None)
+                with self._cond:
+                    if self._pending:
+                        self._cond.wait(0.01)
+                continue
+            q._run_one(item[0], item[1])
+
+
 class CheckQueue:
     def __init__(self, n_threads: int = 0):
         self.n_threads = n_threads
         self._tasks: "queue.Queue" = queue.Queue()
         self._threads: List[threading.Thread] = []
-        self._lock = threading.Lock()
-        self._failed: Optional[str] = None
-        self._pending = 0
-        self._done = threading.Condition(self._lock)
+        self._default: Optional[CheckSession] = None
         _M_WORKERS.set(n_threads)
         # weakref: the registry keeps the last-registered callback for the
-        # process life — don't let it pin a stopped queue
+        # process life — don't let it pin a stopped queue.  qsize() is the
+        # queued-not-yet-claimed backlog (running checks excluded).
         self_ref = weakref.ref(self)
         g_metrics.gauge_fn(
             "nodexa_scriptcheck_queue_depth",
-            "Script checks queued or running in the -par worker pool",
-            lambda: float(q._pending) if (q := self_ref()) else 0.0)
+            "Script checks queued for the -par worker pool",
+            lambda: float(q._tasks.qsize()) if (q := self_ref()) else 0.0)
         if n_threads > 0:
             for i in range(n_threads):
                 t = threading.Thread(
@@ -59,47 +138,38 @@ class CheckQueue:
                 t.start()
                 self._threads.append(t)
 
+    def session(self) -> CheckSession:
+        return CheckSession(self)
+
+    # -- legacy single-session facade (direct add/wait callers) ----------
+
+    def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
+        if self._default is None:
+            self._default = self.session()
+        self._default.add(checks)
+
+    def wait(self) -> Optional[str]:
+        if self._default is None:
+            return None
+        return self._default.wait()
+
     def _worker(self) -> None:
         while True:
-            check = self._tasks.get()
-            if check is None:
+            item = self._tasks.get()
+            if item is None:
                 return
-            self._run_one(check)
+            session, check = item
+            self._run_one(session, check)
 
-    def _run_one(self, check: Callable[[], Optional[str]]) -> None:
+    def _run_one(
+        self, session: CheckSession, check: Callable[[], Optional[str]]
+    ) -> None:
         err = None
         try:
             err = check()
         except Exception as e:  # checks must not throw; belt-and-braces
             err = f"exception: {e}"
-        with self._done:
-            if err and self._failed is None:
-                self._failed = err
-            self._pending -= 1
-            if self._pending == 0:
-                self._done.notify_all()
-
-    def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
-        if checks:
-            # counted at enqueue, one locked add per BATCH — the per-check
-            # fast path (workers and _run_one) stays uninstrumented
-            _CHECKS_QUEUED.inc(len(checks))
-        with self._done:
-            self._pending += len(checks)
-        if self.n_threads > 0:
-            for c in checks:
-                self._tasks.put(c)
-        else:
-            for c in checks:
-                self._run_one(c)
-
-    def wait(self) -> Optional[str]:
-        """Block until all queued checks are done; returns failure or None."""
-        with self._done:
-            while self._pending:
-                self._done.wait()
-            failed, self._failed = self._failed, None
-            return failed
+        session._complete(err)
 
     def stop(self) -> None:
         for _ in self._threads:
@@ -110,15 +180,18 @@ class CheckQueue:
 
 
 class CheckQueueControl:
-    """RAII-style scope (ref checkqueue.h:177 CCheckQueueControl)."""
+    """RAII-style scope (ref checkqueue.h:177 CCheckQueueControl), backed
+    by its own session so concurrent controls never interleave failure
+    state or wait on each other's checks."""
 
     def __init__(self, q: Optional[CheckQueue]):
         self.q = q
+        self._session = q.session() if q is not None else None
         self._inline_err: Optional[str] = None
 
     def add(self, checks) -> None:
-        if self.q is not None:
-            self.q.add(checks)
+        if self._session is not None:
+            self._session.add(checks)
         else:
             for c in checks:
                 err = c()
@@ -128,6 +201,6 @@ class CheckQueueControl:
                 _CHECKS_INLINE.inc(len(checks))
 
     def wait(self) -> Optional[str]:
-        if self.q is not None:
-            return self.q.wait()
+        if self._session is not None:
+            return self._session.wait()
         return self._inline_err

@@ -10,6 +10,7 @@ index maintenance).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set
@@ -74,9 +75,30 @@ class TxMemPool:
         self._entries: Dict[int, MempoolEntry] = {}
         self._spenders: Dict[OutPoint, int] = {}  # mapNextTx: prevout -> txid
         self._disconnected: List[Transaction] = []
+        # running totals (ref cachedInnerUsage/totalTxSize): admission
+        # consults the byte total on EVERY commit, so it must be O(1),
+        # not a sum over the pool
+        self._total_size = 0
+        self._total_fee = 0
         self.max_size_bytes = max_size_bytes
         self._rolling_min_fee = 0.0
         self._rolling_time = 0.0
+        # in-flight admission reservations (staged mempool_accept): an
+        # outpoint claimed by a transaction mid-validation — its script
+        # checks run OUTSIDE cs_main, so without the claim two mutually
+        # conflicting txs could both pass their snapshot stage and both
+        # commit.  Own lock: claims are taken under cs_main but released
+        # from reject paths that don't hold it.  Claims are REFCOUNTED
+        # per owner txid: concurrent submissions of the same tx each hold
+        # one reference, so one twin's reject can't strip the claim out
+        # from under the other mid-scripts.
+        self._reserved: Dict[OutPoint, List] = {}  # outpoint -> [txid, refs]
+        self._reserved_lock = threading.Lock()
+        # bumped on every entry removal (replacement, eviction, expiry,
+        # block): the staged admission commit re-runs its context checks
+        # when this moved, because a removal can take an in-pool parent
+        # out from under a snapshot without the TIP generation moving
+        self.removal_generation = 0
 
     # -- queries -----------------------------------------------------------
 
@@ -94,10 +116,10 @@ class TxMemPool:
         return len(self._entries)
 
     def total_size_bytes(self) -> int:
-        return sum(e.size for e in self._entries.values())
+        return self._total_size
 
     def total_fees(self) -> int:
-        return sum(e.fee for e in self._entries.values())
+        return self._total_fee
 
     def txids(self) -> List[int]:
         return list(self._entries)
@@ -107,6 +129,48 @@ class TxMemPool:
 
     def has_conflict(self, tx: Transaction) -> bool:
         return any(i.prevout in self._spenders for i in tx.vin)
+
+    # -- in-flight outpoint reservations (staged admission) ----------------
+
+    def reserve_outpoints(self, tx: Transaction) -> bool:
+        """Claim tx's inputs against concurrent in-flight admissions.
+
+        All-or-nothing: returns False (claiming nothing) if any input is
+        already reserved by a DIFFERENT transaction.  Same-txid claims
+        stack — each successful reserve must be paired with exactly one
+        release, so a rejected duplicate submission releasing its claim
+        cannot free the outpoints an identical in-flight twin is still
+        verifying against."""
+        txid = tx.txid
+        with self._reserved_lock:
+            for txin in tx.vin:
+                claim = self._reserved.get(txin.prevout)
+                if claim is not None and claim[0] != txid:
+                    return False
+            for txin in tx.vin:
+                claim = self._reserved.get(txin.prevout)
+                if claim is None:
+                    self._reserved[txin.prevout] = [txid, 1]
+                else:
+                    claim[1] += 1
+        return True
+
+    def release_outpoints(self, tx: Transaction) -> None:
+        """Drop one reference on tx's claims (reject cleanup or post-
+        commit: an inserted entry's outpoints are owned by the _spenders
+        index instead); the outpoint frees when the last twin releases."""
+        txid = tx.txid
+        with self._reserved_lock:
+            for txin in tx.vin:
+                claim = self._reserved.get(txin.prevout)
+                if claim is not None and claim[0] == txid:
+                    claim[1] -= 1
+                    if claim[1] <= 0:
+                        del self._reserved[txin.prevout]
+
+    def reserved_count(self) -> int:
+        with self._reserved_lock:
+            return len(self._reserved)
 
     # -- ancestry ----------------------------------------------------------
 
@@ -155,6 +219,8 @@ class TxMemPool:
             self._entries[a].fee for a in ancestors
         )
         self._entries[txid] = entry
+        self._total_size += entry.size
+        self._total_fee += entry.fee
         for txin in entry.tx.vin:
             self._spenders[txin.prevout] = txid
         for a in ancestors:
@@ -178,6 +244,9 @@ class TxMemPool:
         e = self._entries.pop(txid, None)
         if e is None:
             return
+        self.removal_generation += 1
+        self._total_size -= e.size
+        self._total_fee -= e.fee
         # ref CTxMemPool::removeUnchecked -> estimator removeTx: evictions
         # and expiries count as confirmation failures (failAvg)
         from .fees import fee_estimator
@@ -220,6 +289,9 @@ class TxMemPool:
     def clear(self) -> None:
         self._entries.clear()
         self._spenders.clear()
+        self._total_size = 0
+        self._total_fee = 0
+        self.removal_generation += 1
 
     # -- ordering ----------------------------------------------------------
 

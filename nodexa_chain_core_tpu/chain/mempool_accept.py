@@ -1,15 +1,36 @@
 """Mempool admission (parity: reference src/validation.cpp
-AcceptToMemoryPool (:1114) -> AcceptToMemoryPoolWorker (:525)).
+AcceptToMemoryPool (:1114) -> AcceptToMemoryPoolWorker (:525), staged like
+the reference's later MemPoolAccept PreChecks / PolicyScriptChecks split).
 
-Pipeline: stateless checks -> standardness -> finality -> conflict scan ->
-input lookup through the mempool coins overlay -> fee floor -> sigops cap ->
-full script verification with STANDARD flags -> pool insert.
+Two paths share every check, in the same order, with the same reject
+taxonomy:
+
+- **staged** (default, ref MemPoolAccept): (1) lock-free pre-checks
+  (deserialization sanity, standardness, policy math that needs no chain
+  state); (2) a short ``cs_main`` hold that snapshots the spent coins,
+  tip height/MTP/sequence-lock context and fee context, then *reserves*
+  the tx's outpoints against concurrent admissions; (3) full script
+  verification OUTSIDE ``cs_main`` against the snapshot, fanned per-input
+  onto the shared ``-par`` CheckQueue with a per-tx sighash midstate; (4)
+  a commit hold that re-runs the cheap context checks iff the tip
+  generation moved while scripts ran, then inserts.  ECDSA — the dominant
+  admission cost — runs while block connection, pool job assembly and
+  other admissions hold or take ``cs_main`` freely.
+- **inline** (legacy, ``-stagedmempool=0`` / ``staged=False``): the whole
+  pipeline under one ``cs_main`` hold with serial, naive-sighash script
+  verification — the pre-PR behavior, kept as the bench/parity baseline.
+
+Checks: stateless -> standardness -> finality -> conflict scan -> input
+lookup through the mempool coins overlay -> fee floor -> sigops cap ->
+full script verification with STANDARD flags -> asset rules -> pool insert.
 """
 
 from __future__ import annotations
 
+import threading
 import time as _time
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Set
 
 from ..consensus.tx_verify import (
     TxValidationError,
@@ -19,15 +40,19 @@ from ..consensus.tx_verify import (
     get_transaction_sigop_cost,
     is_final_tx,
 )
-from ..primitives.transaction import Transaction
+from ..primitives.transaction import OutPoint, Transaction
 from ..script.interpreter import (
+    PrecomputedSighash,
     STANDARD_SCRIPT_VERIFY_FLAGS,
     TransactionSignatureChecker,
+    p2pkh_batch_prep,
     verify_script,
+    verify_script_fast,
 )
 from ..script.script import Script
 from ..telemetry import g_metrics
-from .coins import CoinsViewCache
+from .checkqueue import CheckQueueControl
+from .coins import Coin, CoinsViewCache
 from .mempool import CoinsViewMemPool, MempoolEntry, TxMemPool
 from .policy import MAX_STANDARD_TX_SIGOPS_COST, MIN_RELAY_FEE, is_standard_tx
 from .validation import ChainState
@@ -39,13 +64,51 @@ class MempoolAcceptError(TxValidationError):
 
 _M_ACCEPT_SECONDS = g_metrics.histogram(
     "nodexa_mempool_accept_seconds",
-    "AcceptToMemoryPool latency (admitted and rejected submissions)",
+    "AcceptToMemoryPool latency: unlabeled = whole submissions (admitted "
+    "and rejected); {stage=prechecks|snapshot|scripts|commit} = staged-"
+    "pipeline stage timings",
 )
 _M_ACCEPTED = g_metrics.counter(
     "nodexa_mempool_accepted_total", "Transactions admitted to the mempool")
 _M_REJECTED = g_metrics.counter(
     "nodexa_mempool_rejected_total",
     "Mempool rejections, labeled by reason code")
+_M_ACCEPTS = g_metrics.counter(
+    "nodexa_mempool_accepts_total",
+    "Admission outcomes, labeled by result (accepted|rejected) and path "
+    "(staged|inline)")
+_M_CSMAIN_HOLD = g_metrics.histogram(
+    "nodexa_mempool_csmain_hold_seconds",
+    "cs_main hold time per admission critical section "
+    "(stage=snapshot|commit for the staged path, stage=inline for the "
+    "legacy whole-pipeline hold)",
+)
+
+# test-only: called between script verification and the commit hold of the
+# staged path, with the tx under admission — lets tests deterministically
+# interleave a ConnectTip (tip-generation race coverage)
+_test_hook_after_scripts: Optional[Callable[[Transaction], None]] = None
+
+
+@dataclass
+class _AdmissionContext:
+    """Chain/pool context captured under the snapshot hold.
+
+    ``coins`` are clones — immutable-for-our-purposes copies the off-lock
+    script stage reads while block connection freely mutates the live
+    caches.  An outpoint's scriptPubKey/amount are determined by its txid,
+    so a snapshot coin can never be *wrong*, only *gone* (spent by a
+    block) — which the commit-stage generation re-check catches."""
+
+    height: int
+    fee: int
+    size: int
+    sigops: int
+    coins: Dict[OutPoint, Coin]
+    conflicts: Set[int] = field(default_factory=set)
+    direct_conflicts: Set[int] = field(default_factory=set)
+    generation: int = -1
+    pool_generation: int = -1
 
 
 def accept_to_memory_pool(
@@ -54,34 +117,56 @@ def accept_to_memory_pool(
     tx: Transaction,
     bypass_limits: bool = False,
     require_standard: Optional[bool] = None,
+    staged: Optional[bool] = None,
 ) -> MempoolEntry:
     """Validate and insert; raises MempoolAcceptError on rejection.
 
-    Runs under cs_main (ref AcceptToMemoryPool's LOCK(cs_main)): admission
-    reads the coins view and tip state that block connection mutates.
+    ``staged=None`` follows ``chainstate.staged_mempool`` (default True).
+    The inline path runs entirely under cs_main (ref AcceptToMemoryPool's
+    LOCK(cs_main)); the staged path holds cs_main only for the snapshot
+    and commit sections.
     """
+    if staged is None:
+        staged = getattr(chainstate, "staged_mempool", True)
+    path = "staged" if staged else "inline"
     t0 = _time.perf_counter()
     try:
-        with chainstate.cs_main:
-            entry = _accept_to_memory_pool_locked(
+        if staged:
+            entry = _accept_staged(
                 chainstate, pool, tx, bypass_limits, require_standard
             )
+        else:
+            with chainstate.cs_main:
+                # hold time, not wait time: the clock starts once the
+                # lock is OURS (the histogram answers "how long do we
+                # keep everyone else out", not "how contended is it")
+                t_lock = _time.perf_counter()
+                entry = _accept_inline_locked(
+                    chainstate, pool, tx, bypass_limits, require_standard
+                )
+                hold = _time.perf_counter() - t_lock
+            _M_CSMAIN_HOLD.observe(hold, stage="inline")
     except MempoolAcceptError as e:
         _M_REJECTED.inc(reason=e.code)
+        _M_ACCEPTS.inc(result="rejected", path=path)
         raise
     finally:
         _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t0)
     _M_ACCEPTED.inc()
+    _M_ACCEPTS.inc(result="accepted", path=path)
     return entry
 
 
-def _accept_to_memory_pool_locked(
-    chainstate: ChainState,
-    pool: TxMemPool,
-    tx: Transaction,
-    bypass_limits: bool = False,
-    require_standard: Optional[bool] = None,
-) -> MempoolEntry:
+# --------------------------------------------------------------- the stages
+
+
+def _stateless_checks(
+    chainstate: ChainState, tx: Transaction, require_standard: Optional[bool]
+) -> int:
+    """Stage 1 (ref MemPoolAccept::PreChecks' chain-state-free prefix):
+    everything decidable from the transaction bytes alone.  Returns the
+    serialized size — computed once here, threaded through the later
+    stages (fee floor, entry) instead of re-serializing per stage."""
     if require_standard is None:
         require_standard = chainstate.params.require_standard
 
@@ -96,10 +181,23 @@ def _accept_to_memory_pool_locked(
     if tx.is_coinbase():
         raise MempoolAcceptError("coinbase")
 
-    ok, reason = is_standard_tx(tx, require_standard)
+    size = len(tx.to_bytes())
+    ok, reason = is_standard_tx(tx, require_standard, size=size)
     if not ok:
         raise MempoolAcceptError("non-standard", reason)
+    return size
 
+
+def _context_checks(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool,
+    size: int = 0,
+) -> _AdmissionContext:
+    """Stage 2 (under cs_main): every check that reads tip or pool state,
+    ending in a coins snapshot the off-lock script stage verifies against.
+    Also the commit-stage re-check when the tip moved mid-flight."""
     tip = chainstate.tip()
     height = (tip.height if tip else 0) + 1
     mtp = tip.median_time_past() if tip else 0
@@ -111,8 +209,8 @@ def _accept_to_memory_pool_locked(
     # BIP125 replace-by-fee (ref policy/rbf.cpp + AcceptToMemoryPoolWorker's
     # conflict handling): a conflicting in-pool tx may be replaced when it
     # signals replaceability and the newcomer pays strictly more.
-    conflicts: set = set()
-    direct_conflicts: set = set()
+    conflicts: Set[int] = set()
+    direct_conflicts: Set[int] = set()
     if pool.has_conflict(tx):
         for txin in tx.vin:
             spender = pool.spender_of(txin.prevout)
@@ -147,7 +245,6 @@ def _accept_to_memory_pool_locked(
         evaluate_sequence_locks,
     )
 
-    tip = chainstate.tip()
     prev_heights = []
     for txin in tx.vin:
         c = view.get_coin(txin.prevout)
@@ -173,7 +270,8 @@ def _accept_to_memory_pool_locked(
     if sigops > MAX_STANDARD_TX_SIGOPS_COST:
         raise MempoolAcceptError("bad-txns-too-many-sigops")
 
-    size = len(tx.to_bytes())
+    if not size:
+        size = len(tx.to_bytes())
     if not bypass_limits and fee < MIN_RELAY_FEE.fee_for(size):
         raise MempoolAcceptError("min relay fee not met", f"{fee} < {MIN_RELAY_FEE.fee_for(size)}")
 
@@ -211,7 +309,7 @@ def _accept_to_memory_pool_locked(
         # parents don't qualify; ref AcceptToMemoryPoolWorker's
         # setConflictsParents built from direct conflicts only), and it
         # may never depend on a tx it conflicts with
-        direct_parents: set = set()
+        direct_parents: Set[int] = set()
         for c in direct_conflicts:
             e = pool.get(c)
             if e is not None:
@@ -228,10 +326,27 @@ def _accept_to_memory_pool_locked(
                     "replacement adds a new unconfirmed input (BIP125 rule 2)",
                 )
 
-    # full script verification (ref CheckInputs with STANDARD flags)
+    coins = {
+        txin.prevout: view.get_coin(txin.prevout).clone() for txin in tx.vin
+    }
+    return _AdmissionContext(
+        height=height,
+        fee=fee,
+        size=size,
+        sigops=sigops,
+        coins=coins,
+        conflicts=conflicts,
+        direct_conflicts=direct_conflicts,
+        generation=getattr(chainstate, "tip_generation", 0),
+        pool_generation=pool.removal_generation,
+    )
+
+
+def _script_checks_inline(tx: Transaction, ctx: _AdmissionContext) -> None:
+    """Legacy stage 3: serial verification, naive per-signature sighash
+    (ref CheckInputs with STANDARD flags)."""
     for i, txin in enumerate(tx.vin):
-        coin = view.get_coin(txin.prevout)
-        assert coin is not None
+        coin = ctx.coins[txin.prevout]
         checker = TransactionSignatureChecker(tx, i, coin.out.value)
         ok, err = verify_script(
             Script(txin.script_sig),
@@ -242,6 +357,108 @@ def _accept_to_memory_pool_locked(
         if not ok:
             raise MempoolAcceptError("mandatory-script-verify-flag-failed", err)
 
+
+# concurrent stage-3 admissions currently verifying scripts: steers the
+# fan-out decision below (own lock — read/written outside cs_main)
+_script_stage_lock = threading.Lock()
+_script_stages_active = 0
+
+
+def _script_checks_parallel(
+    chainstate: ChainState, tx: Transaction, ctx: _AdmissionContext
+) -> None:
+    """Staged stage 3, OUTSIDE cs_main: full script verification against
+    the snapshot, one sighash midstate per tx.
+
+    Canonical P2PKH inputs — the overwhelming relay majority — are
+    prepped in Python (template parse, EQUALVERIFY, encoding checks,
+    sigcache probe; ``p2pkh_batch_prep`` mirrors the VM step for step)
+    and their curve work pooled into ONE batched native ECDSA call:
+    one GIL-free window per TRANSACTION instead of per signature, so a
+    concurrent submitter gets a long uninterrupted slot for its own
+    Python stages — that cross-tx overlap is the flood throughput path.
+
+    Everything else falls back to the generic VM; those checks fan
+    onto the shared -par CheckQueue when the pool can give this
+    admission real parallelism (per-control sessions let admissions
+    and ConnectBlock share the worker pool), else run submitter-side."""
+    global _script_stages_active
+    precomp = PrecomputedSighash(tx)
+    flags = STANDARD_SCRIPT_VERIFY_FLAGS
+    checks = []
+    batch_items = []
+    batch_idx = []
+    first_err: Optional[str] = None
+    for i, txin in enumerate(tx.vin):
+        coin = ctx.coins[txin.prevout]
+        prep = p2pkh_batch_prep(
+            txin.script_sig, coin.out.script_pubkey, flags, precomp, i)
+        if prep is not None:
+            code, item = prep
+            if code:
+                first_err = f"input {i}: {code}"
+                break  # in-order short-circuit, like the inline path
+            if item is not None:
+                batch_items.append(item)
+                batch_idx.append(i)
+            continue  # cache said valid: nothing left to do
+
+        def check(i=i, script_sig=txin.script_sig, coin=coin):
+            checker = TransactionSignatureChecker(
+                tx, i, coin.out.value, precomputed=precomp)
+            ok, err = verify_script_fast(
+                Script(script_sig),
+                Script(coin.out.script_pubkey),
+                flags,
+                checker,
+            )
+            return None if ok else f"input {i}: {err}"
+
+        checks.append(check)
+    if first_err:  # cheap reject: skip the curve work entirely
+        raise MempoolAcceptError(
+            "mandatory-script-verify-flag-failed", first_err)
+    q = getattr(chainstate, "checkqueue", None)
+    with _script_stage_lock:
+        _script_stages_active += 1
+        active = _script_stages_active
+    try:
+        # a single check gains nothing from a queue handoff (two lock
+        # round-trips + a worker wake for zero added parallelism)
+        use_queue = (q is not None and len(checks) >= 2
+                     and q.n_threads + 1 >= 2 * active)
+        control = CheckQueueControl(q if use_queue else None)
+        control.add(checks)
+        err = None
+        if batch_items:
+            from ..crypto.secp256k1 import verify_raw_batch
+            from ..script.sigcache import signature_cache
+
+            verdicts = verify_raw_batch(
+                [it[:4] for it in batch_items])
+            for i, (digest, r, s, pubkey, raw_sig), ok in zip(
+                    batch_idx, batch_items, verdicts):
+                signature_cache.set(digest, raw_sig, pubkey, ok)
+                if not ok and err is None:
+                    err = f"input {i}: nullfail"
+        qerr = control.wait()
+        err = err or qerr
+    finally:
+        with _script_stage_lock:
+            _script_stages_active -= 1
+    if err:
+        raise MempoolAcceptError("mandatory-script-verify-flag-failed", err)
+
+
+def _commit_locked(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    ctx: _AdmissionContext,
+    bypass_limits: bool,
+) -> MempoolEntry:
+    """Stage 4 (under cs_main): asset-rule validation, conflict eviction,
+    pool insert, fee-estimator feed, -maxmempool enforcement, signals."""
     # asset-rule validation: apply + immediate undo == pure check (ref
     # AcceptToMemoryPoolWorker's CheckTxAssets).  Chained asset spends of
     # in-mempool parents defer to block validation, as the pool cache
@@ -249,26 +466,27 @@ def _accept_to_memory_pool_locked(
     spent_pairs = []
     all_confirmed = True
     for txin in tx.vin:
-        coin = view.get_coin(txin.prevout)
-        if coin is not None and coin.height == CoinsViewMemPool.MEMPOOL_HEIGHT:
+        coin = ctx.coins[txin.prevout]
+        if coin.height == CoinsViewMemPool.MEMPOOL_HEIGHT:
             all_confirmed = False
         spent_pairs.append((coin.out.script_pubkey, coin))
-    if all_confirmed and height >= chainstate.params.consensus.asset_activation_height:
+    if all_confirmed and ctx.height >= chainstate.params.consensus.asset_activation_height:
         from ..assets.cache import AssetError
 
         try:
             asset_undo = chainstate.assets.check_and_apply_tx(
-                tx, spent_pairs, height
+                tx, spent_pairs, ctx.height
             )
             chainstate.assets.undo_tx(asset_undo)
         except AssetError as e:
             raise MempoolAcceptError("bad-txns-assets", str(e))
 
-    for c in conflicts:
+    for c in ctx.conflicts:
         pool.remove(c, "replaced")
 
     entry = MempoolEntry(
-        tx=tx, fee=fee, time=_time.time(), height=height, sigops=sigops // 4
+        tx=tx, fee=ctx.fee, time=_time.time(), height=ctx.height,
+        size=ctx.size, sigops=ctx.sigops // 4,
     )
     pool.add(entry)
 
@@ -283,9 +501,9 @@ def _accept_to_memory_pool_locked(
     # entry height for the estimator is the TIP (ref entry.GetHeight() ==
     # chainActive.Height()), not this tx's validation height (tip+1)
     fee_estimator.process_tx(
-        tx.txid, height - 1, fee, size,
+        tx.txid, ctx.height - 1, ctx.fee, ctx.size,
         valid_fee_estimate=(
-            not bypass_limits and not conflicts and has_no_pool_inputs
+            not bypass_limits and not ctx.conflicts and has_no_pool_inputs
         ),
     )
 
@@ -301,6 +519,86 @@ def _accept_to_memory_pool_locked(
 
     main_signals.transaction_added_to_mempool(tx)
     return entry
+
+
+# ---------------------------------------------------------------- the paths
+
+
+def _accept_inline_locked(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool = False,
+    require_standard: Optional[bool] = None,
+) -> MempoolEntry:
+    """Single cs_main hold over the whole pipeline (pre-PR behavior)."""
+    size = _stateless_checks(chainstate, tx, require_standard)
+    ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
+    _script_checks_inline(tx, ctx)
+    return _commit_locked(chainstate, pool, tx, ctx, bypass_limits)
+
+
+def _accept_staged(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool = False,
+    require_standard: Optional[bool] = None,
+) -> MempoolEntry:
+    t = _time.perf_counter()
+    size = _stateless_checks(chainstate, tx, require_standard)
+    _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="prechecks")
+
+    t = _time.perf_counter()
+    with chainstate.cs_main:
+        t_hold = _time.perf_counter()  # hold time: clock starts owned
+        ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
+        # claim the outpoints before dropping the lock: two mutually
+        # conflicting txs must not both reach commit with valid scripts
+        if not pool.reserve_outpoints(tx):
+            raise MempoolAcceptError(
+                "txn-mempool-conflict",
+                "input reserved by a concurrent admission",
+            )
+        hold = _time.perf_counter() - t_hold
+    _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="snapshot")
+    _M_CSMAIN_HOLD.observe(hold, stage="snapshot")
+
+    try:
+        t = _time.perf_counter()
+        _script_checks_parallel(chainstate, tx, ctx)
+        _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="scripts")
+
+        if _test_hook_after_scripts is not None:
+            _test_hook_after_scripts(tx)
+
+        t = _time.perf_counter()
+        with chainstate.cs_main:
+            t_hold = _time.perf_counter()
+            if (getattr(chainstate, "tip_generation", 0) != ctx.generation
+                    or pool.removal_generation != ctx.pool_generation):
+                # the tip moved while scripts ran (an input may now be
+                # spent by a block; finality/maturity/fee context may
+                # have shifted) OR the pool dropped entries (replacement,
+                # eviction, expiry — an in-pool parent our snapshot
+                # relied on may be gone without the tip moving): re-run
+                # the cheap context checks against the current state.
+                # Scripts are NOT re-run: an outpoint's scriptPubKey and
+                # amount are fixed by its txid, so the already-verified
+                # signatures stay valid.
+                ctx = _context_checks(
+                    chainstate, pool, tx, bypass_limits, size)
+            elif pool.contains(tx.txid):
+                # same-txid race: a concurrent duplicate submission
+                # (reservation admits same-owner claims) committed first
+                raise MempoolAcceptError("txn-already-in-mempool")
+            entry = _commit_locked(chainstate, pool, tx, ctx, bypass_limits)
+            hold = _time.perf_counter() - t_hold
+        _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="commit")
+        _M_CSMAIN_HOLD.observe(hold, stage="commit")
+        return entry
+    finally:
+        pool.release_outpoints(tx)
 
 
 MEMPOOL_DAT_VERSION = 1

@@ -63,13 +63,16 @@ def is_dust(out: TxOut, dust_fee: FeeRate = DUST_FEE) -> bool:
     return out.value < 3 * dust_fee.fee_for(spend_size)
 
 
-def is_standard_tx(tx: Transaction, require_standard: bool = True) -> tuple[bool, str]:
-    """ref policy.cpp IsStandardTx."""
+def is_standard_tx(tx: Transaction, require_standard: bool = True,
+                   size: int = 0) -> tuple[bool, str]:
+    """ref policy.cpp IsStandardTx.  ``size`` — the caller's already-
+    serialized byte length, if it has one (admission serializes once and
+    threads the figure through every stage)."""
     if not require_standard:
         return True, ""
     if tx.version < 1 or tx.version > 2:
         return False, "version"
-    if len(tx.to_bytes()) > MAX_STANDARD_TX_SIZE:
+    if (size or len(tx.to_bytes())) > MAX_STANDARD_TX_SIZE:
         return False, "tx-size"
     for txin in tx.vin:
         if len(txin.script_sig) > MAX_STANDARD_SCRIPTSIG_SIZE:

@@ -50,9 +50,10 @@ from ..node.events import main_signals
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import OutPoint, Transaction
 from ..script.interpreter import (
+    PrecomputedSighash,
     TransactionSignatureChecker,
     VERIFY_P2SH,
-    verify_script,
+    verify_script_fast,
 )
 from ..script.script import Script
 from ..telemetry import g_metrics, span
@@ -150,6 +151,16 @@ class ChainState:
         self._last_coins_write = time.monotonic()
         # ref sync.h cs_main: one recursive lock over chainstate mutation
         self.cs_main = threading.RLock()
+        # bumped on every tip move (connect AND disconnect) under cs_main:
+        # the staged mempool admission snapshots it, verifies scripts off
+        # the lock, and re-runs its cheap context checks at commit iff the
+        # generation moved (same stale-work signal the miner's template
+        # loop keys off via the validation bus)
+        self.tip_generation = 0
+        # -stagedmempool: accept_to_memory_pool uses the staged pipeline
+        # (short cs_main holds, parallel off-lock script checks) unless
+        # the operator forces the legacy inline path
+        self.staged_mempool = True
         self.block_index: Dict[int, BlockIndex] = {}
         self.positions: Dict[int, Tuple[int, int]] = {}  # hash -> (data, undo)
         # block-index entries mutated since the last flush: the per-block
@@ -906,15 +917,19 @@ class ChainState:
                     raise BlockValidationError("bad-blk-sigops")
                 spent_pairs = []
                 if not tx.is_coinbase():
-                    # collect spent coins for undo, queue script checks
+                    # collect spent coins for undo, queue script checks;
+                    # one sighash midstate serves all of the tx's inputs
+                    # across the -par workers
                     txundo = TxUndo()
                     checks = []
+                    precomp = PrecomputedSighash(tx) if run_scripts else None
                     for j, txin in enumerate(tx.vin):
                         coin = view.get_coin(txin.prevout)
                         assert coin is not None
                         if run_scripts:
                             checks.append(
-                                _script_check(tx, j, coin, script_flags)
+                                _script_check(tx, j, coin, script_flags,
+                                              precomp)
                             )
                         spent_pairs.append((coin.out.script_pubkey, coin))
                         spent = view.spend_coin(txin.prevout)
@@ -1104,6 +1119,7 @@ class ChainState:
         t_flush = time.perf_counter()
         idx.raise_validity(BlockStatus.VALID_SCRIPTS)
         self.active.set_tip(idx)
+        self.tip_generation += 1
         # estimator first (Record needs its tracked entries), then the
         # pool removal notifies remove_tx for already-erased txids — a
         # no-op — matching ref removeForBlock's processBlock-then-remove
@@ -1152,6 +1168,7 @@ class ChainState:
             undo = self.block_store.read_undo(upos) if upos >= 0 else None
             self.indexes.unindex_block(block, idx, undo)
         self.active.set_tip(idx.prev)
+        self.tip_generation += 1
         if self.mempool is not None:
             self.mempool.add_disconnected_txs(block.vtx)
         main_signals.block_disconnected(block, idx)
@@ -1704,14 +1721,16 @@ class ChainState:
         self.block_store.close()
 
 
-def _script_check(tx: Transaction, in_idx: int, coin: Coin, flags: int):
+def _script_check(tx: Transaction, in_idx: int, coin: Coin, flags: int,
+                  precomp: Optional[PrecomputedSighash] = None):
     """One deferred script check (ref validation.cpp CScriptCheck)."""
     spk = Script(coin.out.script_pubkey)
     script_sig = Script(tx.vin[in_idx].script_sig)
-    checker = TransactionSignatureChecker(tx, in_idx, coin.out.value)
+    checker = TransactionSignatureChecker(
+        tx, in_idx, coin.out.value, precomputed=precomp)
 
     def run() -> Optional[str]:
-        ok, err = verify_script(script_sig, spk, flags, checker)
+        ok, err = verify_script_fast(script_sig, spk, flags, checker)
         if not ok:
             return f"input {in_idx}: {err}"
         return None

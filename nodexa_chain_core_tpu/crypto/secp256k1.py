@@ -317,6 +317,84 @@ def verify(pub: Point, msg32: bytes, r: int, s: int) -> bool:
     return pt[0] % N == r
 
 
+def _native_canonical_pubkey(pubkey: bytes) -> bool:
+    """True iff the native loader can take this SEC1 encoding: 02/03
+    compressed or 04 uncompressed with in-range coordinates.  Hybrid
+    06/07 keys and out-of-range encodings must take the pure-Python
+    path so consensus results stay bit-identical on both routes — the
+    scalar and batch verifiers share this predicate so they can never
+    diverge on which signatures go native."""
+    return (
+        (len(pubkey) == 33 and pubkey[0] in (2, 3)
+         and int.from_bytes(pubkey[1:], "big") < P)
+        or (len(pubkey) == 65 and pubkey[0] == 4
+            and int.from_bytes(pubkey[1:33], "big") < P
+            and int.from_bytes(pubkey[33:], "big") < P)
+    )
+
+
+def verify_raw(msg32: bytes, r: int, s: int, pubkey: bytes) -> bool:
+    """Whole-verify from wire bytes: scalar inversion, pubkey
+    decompression and ecmult in ONE GIL-free native call
+    (nxk_ecdsa_verify_rs) — the script checkers' hot path, where the
+    Python-side ``pubkey_parse`` (a modular sqrt) + ``_inv`` would
+    otherwise hold the GIL for a third of each verification.
+
+    The native loader only speaks canonical SEC1 (02/03 compressed,
+    04 uncompressed with in-range coordinates); hybrid 06/07 keys and
+    out-of-range encodings take the pure-Python path so consensus
+    results are bit-identical either way."""
+    lib = _native_lib()
+    if lib is not None and _native_canonical_pubkey(pubkey):
+        if not (1 <= r < N and 1 <= s < N):
+            return False
+        return bool(lib.nxk_ecdsa_verify_rs(
+            msg32, r.to_bytes(32, "big"), s.to_bytes(32, "big"),
+            pubkey, len(pubkey)))
+    try:
+        pub = pubkey_parse(pubkey)
+    except Secp256k1Error:
+        return False
+    return verify(pub, msg32, r, s)
+
+
+def verify_raw_batch(items) -> list:
+    """Verify ``[(msg32, r, s, pubkey), ...]`` with ONE native call.
+
+    The staged admission path collects a transaction's per-input
+    sighashes and crosses the ctypes boundary once: the GIL stays
+    released for the whole batch, giving concurrent submitter threads a
+    long uninterrupted Python window.  Entries the native loader can't
+    take (non-canonical pubkey encodings, out-of-range scalars) fall
+    back to :func:`verify_raw` individually — results are bit-identical
+    to calling it per item."""
+    n = len(items)
+    if n == 0:
+        return []
+    lib = _native_lib()
+    results = [False] * n
+    native_idx = []
+    if lib is not None:
+        for i, (msg32, r, s, pubkey) in enumerate(items):
+            if (_native_canonical_pubkey(pubkey)
+                    and 1 <= r < N and 1 <= s < N):
+                native_idx.append(i)
+    if len(native_idx) == n:
+        import ctypes
+
+        digests = b"".join(it[0] for it in items)
+        rs = b"".join(it[1].to_bytes(32, "big") for it in items)
+        ss = b"".join(it[2].to_bytes(32, "big") for it in items)
+        pubs = b"".join(it[3].ljust(65, b"\x00") for it in items)
+        lens = bytes(len(it[3]) for it in items)
+        out = (ctypes.c_uint8 * n)()
+        lib.nxk_ecdsa_verify_batch(n, digests, rs, ss, pubs, lens, out)
+        return [bool(v) for v in out]
+    for i, (msg32, r, s, pubkey) in enumerate(items):
+        results[i] = verify_raw(msg32, r, s, pubkey)
+    return results
+
+
 def recover(msg32: bytes, r: int, s: int, rec_id: int) -> Point:
     """Recover the public key from a signature (ref secp256k1_recover)."""
     if not (1 <= r < N and 1 <= s < N) or not 0 <= rec_id < 4:

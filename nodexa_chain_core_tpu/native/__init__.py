@@ -140,6 +140,21 @@ def load() -> ctypes.CDLL:
         lib.nxk_ecmult.restype = ctypes.c_int
         lib.nxk_ec_on_curve.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.nxk_ec_on_curve.restype = ctypes.c_int
+        # whole-verify entry: scalar inversion, pubkey decompression and
+        # ecmult all inside one GIL-free call — the tx-admission fast
+        # path's per-signature workhorse
+        lib.nxk_ecdsa_verify_rs.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint,
+        ]
+        lib.nxk_ecdsa_verify_rs.restype = ctypes.c_int
+        # batched whole-verify: one ctypes crossing (and one GIL-free
+        # window) for a whole transaction's signatures
+        lib.nxk_ecdsa_verify_batch.argtypes = [
+            ctypes.c_uint, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u8p,
+        ]
+        lib.nxk_ecdsa_verify_batch.restype = ctypes.c_int
         lib.nxk_ecdsa_sign.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, u8p, u8p,
         ]

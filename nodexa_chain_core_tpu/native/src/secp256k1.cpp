@@ -629,6 +629,28 @@ int nxk_ecdsa_verify_rs(const uint8_t digest[32], const uint8_t r32[32],
   return u_cmp(rx, r) == 0 ? 1 : 0;
 }
 
+// Batched ECDSA verify: n independent signatures in ONE library call.
+// The tx-admission fast path collects a whole transaction's sighashes
+// and crosses the Python/ctypes boundary once, so the GIL is released
+// for the full n-verification window instead of per signature —
+// concurrent submitter threads get one long window to run their Python
+// stages under.  Layout: digests/rs/ss are n*32 bytes; pubs is n*65
+// (unused tail bytes ignored); publens[i] in {33, 65}.  out[i] gets
+// 0/1 per signature; returns 1 iff every signature verified.
+int nxk_ecdsa_verify_batch(unsigned n, const uint8_t* digests,
+                           const uint8_t* rs, const uint8_t* ss,
+                           const uint8_t* pubs, const uint8_t* publens,
+                           uint8_t* out) {
+  int all = 1;
+  for (unsigned i = 0; i < n; ++i) {
+    int ok = nxk_ecdsa_verify_rs(digests + 32u * i, rs + 32u * i,
+                                 ss + 32u * i, pubs + 65u * i, publens[i]);
+    out[i] = static_cast<uint8_t>(ok);
+    if (!ok) all = 0;
+  }
+  return all;
+}
+
 // y^2 = x^3 + 7 check for a candidate affine point (32-byte BE coords).
 int nxk_ec_on_curve(const uint8_t x[32], const uint8_t y[32]) {
   using namespace nxsecp;

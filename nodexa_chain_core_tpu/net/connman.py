@@ -372,26 +372,44 @@ class ConnMan:
 
     # -- processing --------------------------------------------------------
 
+    MAX_MSG_DRAIN = 64  # messages coalesced per handler pass
+
     def _message_handler_loop(self) -> None:
         """ref net.cpp:2026 ThreadMessageHandler ->
-        PeerLogicValidation::ProcessMessages."""
+        PeerLogicValidation::ProcessMessages.
+
+        Drains up to MAX_MSG_DRAIN queued messages per pass and hands
+        them to the processor's batched entry point, which coalesces
+        consecutive TX messages into one topologically-ordered admission
+        batch (the tx-ingestion fast path); per-peer ordering of all
+        other traffic is preserved."""
         while not self._stop.is_set():
             try:
-                peer, command, payload = self.inbound_queue.get(timeout=0.25)
+                batch = [self.inbound_queue.get(timeout=0.25)]
             except queue.Empty:
                 continue
-            if peer.disconnect:
-                continue
+            while len(batch) < self.MAX_MSG_DRAIN:
+                try:
+                    batch.append(self.inbound_queue.get_nowait())
+                except queue.Empty:
+                    break
             try:
-                self.processor.process_message(peer, command, payload)
+                touched = self.processor.process_messages(batch)
             except Exception as e:  # noqa: BLE001 — peer input is untrusted
-                log_printf("error processing %s from peer %d: %r", command, peer.id, e)
-                self.processor.misbehaving(peer, 10, "processing-error")
-            if peer.misbehavior >= 100:
-                self.ban(peer.ip)
-                peer.disconnect = True
-            if peer.disconnect:
-                self._remove_peer(peer)
+                # per-message errors are scored inside process_messages;
+                # this is the batch machinery itself failing
+                log_printf("error processing message batch: %r", e)
+                touched = [item[0] for item in batch]
+            seen = set()
+            for peer in touched:
+                if id(peer) in seen:
+                    continue
+                seen.add(id(peer))
+                if peer.misbehavior >= 100:
+                    self.ban(peer.ip)
+                    peer.disconnect = True
+                if peer.disconnect:
+                    self._remove_peer(peer)
 
     def _maintenance_loop(self) -> None:
         while not self._stop.is_set():

@@ -89,6 +89,21 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         else "inline (single-threaded)",
         node.chainstate.dbcache_bytes // (1024 * 1024),
     )
+    # -stagedmempool=0 forces the legacy whole-pipeline-under-cs_main
+    # admission; default is the staged fast path (short snapshot/commit
+    # holds, script verification off the lock on the -par pool)
+    node.chainstate.staged_mempool = g_args.get_bool("stagedmempool", True)
+    # -maxsigcachesize=<MiB>: byte budget for cached signature verdicts
+    # (ref init.cpp -maxsigcachesize -> InitSignatureCache)
+    from ..script.sigcache import signature_cache
+
+    signature_cache.set_max_bytes(
+        g_args.get_int("maxsigcachesize", 32) * 1024 * 1024)
+    log_printf(
+        "tx admission: %s pipeline; signature cache budget %d MiB",
+        "staged" if node.chainstate.staged_mempool else "inline (legacy)",
+        g_args.get_int("maxsigcachesize", 32),
+    )
     # -prune=N: 0=off, 1=manual (pruneblockchain RPC), >=550 = auto-prune
     # to N MiB (validated above, before the -reindex wipe)
     if prune_arg:

@@ -11,7 +11,7 @@ with its SIGHASH_SINGLE "hash of one" quirk.
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from ..core.serialize import ByteWriter
 from ..crypto import secp256k1 as ec
@@ -169,6 +169,113 @@ def _ser_input(
             w.u32(txin.sequence)
 
 
+class PrecomputedSighash:
+    """Per-transaction sighash midstate (ref validation.h
+    PrecomputedTransactionData, adapted to the legacy algorithm).
+
+    ``signature_hash`` re-serializes the whole transaction for every
+    signature — O(inputs) work per input, O(inputs^2) per transaction.
+    The legacy preimage differs between inputs only in one splice point
+    (the signed input's scriptCode + sequence slot) and, for
+    SIGHASH_SINGLE, the truncated output list; everything else is fixed
+    per (tx, hashtype-class).  This cache serializes a per-input
+    (prefix, suffix) byte pair once per class, so each signature pays
+    only ``prefix + var_bytes(scriptCode) + suffix + hashtype`` — the
+    scriptCode varies per signature anyway (find_and_delete).
+
+    Thread-safety: class builds are idempotent and the dict store is
+    GIL-atomic, so concurrent -par workers sharing one instance at worst
+    duplicate a build (benign race, same bytes).  The transaction's
+    prevouts/sequences/outputs/locktime must not mutate while an
+    instance is live; scriptSig edits (signing) are fine — other inputs'
+    scriptSigs are serialized empty in the legacy preimage.
+    """
+
+    __slots__ = ("tx", "_classes")
+
+    def __init__(self, tx: Transaction):
+        self.tx = tx
+        self._classes = {}
+
+    def _build(self, base: int, anyonecanpay: bool):
+        tx = self.tx
+        n_in = len(tx.vin)
+        # "other input" segments: null scriptSig, base-dependent sequence
+        others = []
+        for txin in tx.vin:
+            w = ByteWriter()
+            txin.prevout.serialize(w)
+            w.var_bytes(b"")
+            if base in (SIGHASH_NONE, SIGHASH_SINGLE):
+                w.u32(0)
+            else:
+                w.u32(txin.sequence)
+            others.append(w.getvalue())
+        outs_common = None
+        if base == SIGHASH_NONE:
+            outs_common = ByteWriter().compact_size(0).getvalue()
+        elif base != SIGHASH_SINGLE:
+            w = ByteWriter()
+            w.compact_size(len(tx.vout))
+            for o in tx.vout:
+                o.serialize(w)
+            outs_common = w.getvalue()
+        prefixes, suffixes = [], []
+        for i in range(n_in):
+            w = ByteWriter()
+            w.i32(tx.version)
+            if anyonecanpay:
+                w.compact_size(1)
+            else:
+                w.compact_size(n_in)
+                for j in range(i):
+                    w.write(others[j])
+            tx.vin[i].prevout.serialize(w)
+            prefixes.append(w.getvalue())
+            w = ByteWriter()
+            w.u32(tx.vin[i].sequence)
+            if not anyonecanpay:
+                for j in range(i + 1, n_in):
+                    w.write(others[j])
+            if base == SIGHASH_SINGLE:
+                if i < len(tx.vout):
+                    w.compact_size(i + 1)
+                    for k in range(i):
+                        w.i64(-1).var_bytes(b"")  # null txout
+                    tx.vout[i].serialize(w)
+                # out-of-range SINGLE short-circuits in digest()
+            else:
+                w.write(outs_common)
+            w.u32(tx.locktime)
+            suffixes.append(w.getvalue())
+        built = (prefixes, suffixes)
+        self._classes[(base, anyonecanpay)] = built
+        return built
+
+    def digest(self, script_code: Script, in_idx: int, hashtype: int) -> bytes:
+        """Drop-in for ``signature_hash(script_code, tx, in_idx,
+        hashtype)`` including the "hash of one" quirks."""
+        tx = self.tx
+        one = (1).to_bytes(32, "little")
+        if in_idx >= len(tx.vin):
+            return one
+        base = hashtype & 0x1F
+        if base == SIGHASH_SINGLE and in_idx >= len(tx.vout):
+            return one
+        if base not in (SIGHASH_NONE, SIGHASH_SINGLE):
+            base = SIGHASH_ALL  # every other value serializes ALL-like
+        key = (base, bool(hashtype & SIGHASH_ANYONECANPAY))
+        cls = self._classes.get(key)
+        if cls is None:
+            cls = self._build(*key)
+        w = ByteWriter()
+        w.write(cls[0][in_idx])
+        w.var_bytes(script_code.raw)
+        w.write(cls[1][in_idx])
+        w.u32(hashtype & 0xFFFFFFFF)
+        return sha256d(w.getvalue())
+
+
 # --- signature checker ------------------------------------------------------
 
 
@@ -184,12 +291,19 @@ class BaseSignatureChecker:
 
 
 class TransactionSignatureChecker(BaseSignatureChecker):
-    """ref interpreter.h TransactionSignatureChecker."""
+    """ref interpreter.h TransactionSignatureChecker.
 
-    def __init__(self, tx: Transaction, in_idx: int, amount: int = 0):
+    ``precomputed`` (a :class:`PrecomputedSighash` over the same tx)
+    switches sighash computation to the midstate path — one instance is
+    shared across all of a transaction's per-input checkers, including
+    -par worker threads."""
+
+    def __init__(self, tx: Transaction, in_idx: int, amount: int = 0,
+                 precomputed: Optional[PrecomputedSighash] = None):
         self.tx = tx
         self.in_idx = in_idx
         self.amount = amount
+        self.precomputed = precomputed
 
     def check_sig(self, sig: bytes, pubkey: bytes, script_code: Script) -> bool:
         if not sig:
@@ -198,11 +312,29 @@ class TransactionSignatureChecker(BaseSignatureChecker):
         raw_sig = sig[:-1]
         try:
             r, s = ec.sig_from_der(raw_sig, strict=False)
-            pub = ec.pubkey_parse(pubkey)
         except ec.Secp256k1Error:
             return False
         # legacy quirk: the signature itself is deleted from scriptCode
         cleaned = script_code.find_and_delete(Script.build(sig))
+        if self.precomputed is not None:
+            # fast path (block connect + staged admission): midstate
+            # sighash, and pubkey parsing INSIDE the one GIL-free native
+            # verify call.  The plain-checker branch below stays the
+            # slow differential twin (naive serialization, Python parse)
+            # — tests pin the two bit-equal.
+            digest = self.precomputed.digest(cleaned, self.in_idx, hashtype)
+            from .sigcache import signature_cache
+
+            cached = signature_cache.get(digest, raw_sig, pubkey)
+            if cached is not None:
+                return cached
+            ok = ec.verify_raw(digest, r, s, pubkey)
+            signature_cache.set(digest, raw_sig, pubkey, ok)
+            return ok
+        try:
+            pub = ec.pubkey_parse(pubkey)
+        except ec.Secp256k1Error:
+            return False
         digest = signature_hash(cleaned, self.tx, self.in_idx, hashtype)
         # signature cache (ref sigcache.cpp CachingTransactionSignatureChecker)
         from .sigcache import signature_cache
@@ -702,6 +834,125 @@ def _eval(
 
     if vf_exec:
         raise ScriptVerifyError("unbalanced_conditional")
+
+
+def verify_script_fast(
+    script_sig: Script,
+    script_pubkey: Script,
+    flags: int,
+    checker: BaseSignatureChecker,
+) -> tuple[bool, str]:
+    """``verify_script`` with a template shortcut for the canonical
+    P2PKH spend — ``push(sig) push(pub)`` against
+    ``DUP HASH160 <20> EQUALVERIFY CHECKSIG`` — the overwhelming
+    majority of relayed inputs.
+
+    The shortcut replays the generic VM's exact step sequence for that
+    one shape (minimal-push admissibility, the encoding checks, EQUAL-
+    VERIFY, find-and-delete reachability, NULLFAIL, cleanstack) without
+    paying the per-opcode dispatch machinery; ANY deviation — extra
+    ops, non-direct pushes, a sig short enough that minimal-push or
+    find-and-delete semantics could bite, P2SH, empty pushes — falls
+    through to :func:`verify_script` untouched.  Callers on the
+    admission/block-connect hot path use this entry; error codes are
+    bit-identical to the generic VM (pinned by the differential tests).
+    """
+    parts = _p2pkh_parts(script_sig.raw, script_pubkey.raw)
+    if parts is not None:
+        sig, pubkey = parts
+        try:
+            # VM order: EQUALVERIFY fires before CHECKSIG's checks
+            if hash160(pubkey) != script_pubkey.raw[3:23]:
+                return False, "equalverify"
+            _check_signature_encoding(sig, flags)
+            _check_pubkey_encoding(pubkey, flags)
+            # begincode == 0 (no codeseparator): subscript is the
+            # whole spk; find_and_delete can't match (guarded in the
+            # template parse)
+            if not checker.check_sig(sig, pubkey, script_pubkey):
+                # sig is non-empty here, so NULLFAIL always fires
+                # (under standard flags) exactly as in the VM
+                if flags & VERIFY_NULLFAIL:
+                    return False, "nullfail"
+                return False, "eval_false"
+            return True, ""  # stack == [TRUE]: cleanstack holds
+        except ScriptVerifyError as e:
+            return False, e.code
+    return verify_script(script_sig, script_pubkey, flags, checker)
+
+
+def _p2pkh_parts(sig_raw: bytes, spk: bytes):
+    """``(sig, pubkey)`` when the spend is the canonical P2PKH template
+    the fast path may shortcut; ``None`` sends the caller to the
+    generic VM.  The guards make direct pushes provably minimal and
+    find-and-delete provably a no-op, so the shortcut's semantics can't
+    drift from the interpreter's."""
+    if not (
+        len(spk) == 25
+        and spk[0] == 0x76        # OP_DUP
+        and spk[1] == 0xA9        # OP_HASH160
+        and spk[2] == 0x14        # direct 20-byte push (minimal)
+        and spk[23] == 0x88       # OP_EQUALVERIFY
+        and spk[24] == 0xAC       # OP_CHECKSIG
+        and len(sig_raw) >= 4
+        and 2 <= sig_raw[0] <= 75                  # direct push == minimal
+        and len(sig_raw) >= 2 + sig_raw[0]
+    ):
+        return None
+    n_sig = sig_raw[0]
+    n_pub = sig_raw[1 + n_sig]
+    if not (
+        2 <= n_pub <= 75                        # direct push == minimal
+        and len(sig_raw) == 2 + n_sig + n_pub  # exactly two pushes
+        # a 20-byte "sig" could collide with the spk's own hash push
+        # under find-and-delete; leave that to the generic VM
+        and n_sig != 20
+    ):
+        return None
+    return sig_raw[1:1 + n_sig], sig_raw[2 + n_sig:]
+
+
+def p2pkh_batch_prep(sig_raw: bytes, spk: bytes, flags: int,
+                     precomp: PrecomputedSighash, in_idx: int):
+    """Everything :func:`verify_script_fast` does for a template P2PKH
+    input EXCEPT the ECDSA call, so a caller can pool many inputs'
+    curve work into one batched native crossing.
+
+    Returns ``None`` when the input is not template-shaped (run the
+    generic VM), else ``(err_code, batch_item)``:
+
+    - ``err_code`` set — the input already failed (same code the VM
+      would produce), or already passed when it's ``""``;
+    - ``batch_item = (digest, r, s, pubkey, raw_sig)`` — feed to
+      :func:`..crypto.secp256k1.verify_raw_batch`; a False verdict
+      maps to ``nullfail`` exactly like the VM's CHECKSIG, and the
+      (digest, raw_sig, pubkey, verdict) goes back into the signature
+      cache."""
+    parts = _p2pkh_parts(sig_raw, spk)
+    if parts is None:
+        return None
+    sig, pubkey = parts
+    if hash160(pubkey) != spk[3:23]:
+        return "equalverify", None
+    try:
+        _check_signature_encoding(sig, flags)
+        _check_pubkey_encoding(pubkey, flags)
+    except ScriptVerifyError as e:
+        return e.code, None
+    nullfail = "nullfail" if flags & VERIFY_NULLFAIL else "eval_false"
+    hashtype = sig[-1]
+    raw_sig = sig[:-1]
+    try:
+        r, s = ec.sig_from_der(raw_sig, strict=False)
+    except ec.Secp256k1Error:
+        return nullfail, None
+    digest = precomp.digest(Script(spk), in_idx, hashtype)
+    from .sigcache import signature_cache
+
+    cached = signature_cache.get(digest, raw_sig, pubkey)
+    if cached is not None:
+        return ("" if cached else nullfail), None
+    return "", (digest, r, s, pubkey, raw_sig)
 
 
 def verify_script(

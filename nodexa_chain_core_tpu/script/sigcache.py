@@ -1,22 +1,40 @@
 """Signature verification cache (parity: reference src/script/sigcache.cpp,
 backed by the cuckoo cache of src/cuckoocache.h:160 — here an LRU dict with
-the same hit semantics: key = (sighash, signature, pubkey))."""
+the same hit semantics: key = (sighash, signature, pubkey)).
+
+Sizing is BYTE-accounted like the reference's -maxsigcachesize (MiB):
+every entry charges its key material (32-byte digest + DER sig + pubkey)
+plus a fixed per-entry overhead approximating the CPython dict slot +
+tuple + bytes headers, and eviction drops oldest-inserted entries until
+the budget holds.  The old entry-count bound evicted a 72-byte-sig entry
+and a 520-byte one with equal weight, so a burst of large-script traffic
+could blow the intended memory envelope several-fold.
+"""
 
 from __future__ import annotations
 
 from collections import OrderedDict
 from threading import Lock
+from typing import Tuple
 
-DEFAULT_MAX_ENTRIES = 1 << 16
+DEFAULT_MAX_BYTES = 32 * 1024 * 1024  # ref DEFAULT_MAX_SIG_CACHE_SIZE MiB
+# CPython cost of one cached entry beyond the key bytes themselves:
+# 3 bytes-object headers (~33 B each) + 3-tuple + dict slot + bool ref
+_ENTRY_OVERHEAD = 160
 
 
 class SignatureCache:
-    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
         self._store: "OrderedDict[Tuple[bytes, bytes, bytes], bool]" = OrderedDict()
-        self._max = max_entries
+        self._max_bytes = max_bytes
+        self._bytes = 0
         self._lock = Lock()
         self.hits = 0
         self.misses = 0
+
+    @staticmethod
+    def _entry_bytes(key: Tuple[bytes, bytes, bytes]) -> int:
+        return _ENTRY_OVERHEAD + len(key[0]) + len(key[1]) + len(key[2])
 
     def get(self, digest: bytes, sig: bytes, pubkey: bytes):
         key = (digest, sig, pubkey)
@@ -31,10 +49,32 @@ class SignatureCache:
     def set(self, digest: bytes, sig: bytes, pubkey: bytes, valid: bool) -> None:
         key = (digest, sig, pubkey)
         with self._lock:
+            if key not in self._store:
+                self._bytes += self._entry_bytes(key)
             self._store[key] = valid
             self._store.move_to_end(key)
-            while len(self._store) > self._max:
-                self._store.popitem(last=False)
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        while self._bytes > self._max_bytes and self._store:
+            old_key, _ = self._store.popitem(last=False)
+            self._bytes -= self._entry_bytes(old_key)
+
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """-maxsigcachesize plumbing; shrinking evicts immediately."""
+        with self._lock:
+            self._max_bytes = max(0, int(max_bytes))
+            self._evict_locked()
+
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def clear(self) -> None:
+        """Drop all entries (bench/test isolation)."""
+        with self._lock:
+            self._store.clear()
+            self._bytes = 0
 
 
 signature_cache = SignatureCache()
@@ -52,3 +92,8 @@ _g_metrics.counter_fn(
 _g_metrics.gauge_fn(
     "nodexa_sigcache_entries", "Signature cache live entries",
     lambda: len(signature_cache._store))
+_g_metrics.gauge_fn(
+    "nodexa_sigcache_bytes",
+    "Approximate heap bytes of cached signature verdicts "
+    "(-maxsigcachesize accounting)",
+    lambda: signature_cache._bytes)

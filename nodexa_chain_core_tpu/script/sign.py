@@ -13,7 +13,7 @@ from typing import Dict, List, Optional
 from ..crypto import secp256k1 as ec
 from ..crypto.hashes import hash160
 from ..primitives.transaction import Transaction
-from .interpreter import SIGHASH_ALL, signature_hash
+from .interpreter import PrecomputedSighash, SIGHASH_ALL, signature_hash
 from .script import Script
 from .standard import (
     TX_MULTISIG,
@@ -87,9 +87,13 @@ class KeyStore:
 
 
 def _make_sig(
-    priv: int, script_code: Script, tx: Transaction, in_idx: int, hashtype: int
+    priv: int, script_code: Script, tx: Transaction, in_idx: int,
+    hashtype: int, precomp: Optional[PrecomputedSighash] = None,
 ) -> bytes:
-    digest = signature_hash(script_code, tx, in_idx, hashtype)
+    if precomp is not None:
+        digest = precomp.digest(script_code, in_idx, hashtype)
+    else:
+        digest = signature_hash(script_code, tx, in_idx, hashtype)
     r, s = ec.sign(priv, digest)
     return ec.sig_to_der(r, s) + bytes([hashtype])
 
@@ -100,6 +104,7 @@ def _sign_step(
     tx: Transaction,
     in_idx: int,
     hashtype: int,
+    precomp: Optional[PrecomputedSighash] = None,
 ) -> List[bytes]:
     """Solve one level; returns the scriptSig stack (ref sign.cpp SignStep)."""
     kind, sols = solver(script_pubkey)
@@ -107,14 +112,15 @@ def _sign_step(
         priv = keystore.priv_for_pub(sols[0])
         if priv is None:
             raise SigningError("missing key for pay-to-pubkey")
-        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype)]
+        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype, precomp)]
     if kind in (TX_PUBKEYHASH, TX_NEW_ASSET, TX_TRANSFER_ASSET, TX_REISSUE_ASSET):
         kid = sols[0]
         priv = keystore.get_priv(kid)
         pub = keystore.get_pub(kid)
         if priv is None or pub is None:
             raise SigningError("missing key for pubkeyhash")
-        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype), pub]
+        return [_make_sig(priv, script_pubkey, tx, in_idx, hashtype, precomp),
+                pub]
     if kind == TX_MULTISIG:
         m = sols[0][0]
         pubkeys = sols[1:-1]
@@ -126,7 +132,8 @@ def _sign_step(
             priv = keystore.priv_for_pub(pub)
             if priv is None:
                 continue
-            sigs.append(_make_sig(priv, script_pubkey, tx, in_idx, hashtype))
+            sigs.append(
+                _make_sig(priv, script_pubkey, tx, in_idx, hashtype, precomp))
             count += 1
         if count < m:
             raise SigningError(f"have {count} of {m} multisig keys")
@@ -135,7 +142,7 @@ def _sign_step(
         redeem = keystore.get_script(sols[0])
         if redeem is None:
             raise SigningError("missing redeem script")
-        inner = _sign_step(keystore, redeem, tx, in_idx, hashtype)
+        inner = _sign_step(keystore, redeem, tx, in_idx, hashtype, precomp)
         return inner + [redeem.raw]
     raise SigningError(f"cannot sign {kind} output")
 
@@ -146,8 +153,17 @@ def sign_tx_input(
     in_idx: int,
     script_pubkey: Script,
     hashtype: int = SIGHASH_ALL,
+    precomputed: Optional[PrecomputedSighash] = None,
 ) -> None:
-    """Sign input in place (ref sign.cpp SignSignature)."""
-    stack = _sign_step(keystore, script_pubkey, tx, in_idx, hashtype)
+    """Sign input in place (ref sign.cpp SignSignature).
+
+    ``precomputed`` — a :class:`PrecomputedSighash` over this tx — makes
+    signing a many-input transaction O(inputs) instead of O(inputs^2):
+    scriptSig edits between inputs don't invalidate it (other inputs'
+    scriptSigs serialize empty in the legacy preimage), so one instance
+    serves a whole signing loop."""
+    stack = _sign_step(
+        keystore, script_pubkey, tx, in_idx, hashtype, precomputed
+    )
     tx.vin[in_idx].script_sig = Script.build(*stack).raw
     tx.rehash()

@@ -612,10 +612,14 @@ class Wallet(ValidationInterface):
                 vout=vout,
                 locktime=self.node.chainstate.tip().height,
             )
-            # sign
+            # sign: one sighash midstate serves the whole input loop
+            from ..script.interpreter import PrecomputedSighash
+
+            precomp = PrecomputedSighash(tx)
             for i, (op, prev_out) in enumerate(picked):
                 sign_tx_input(
-                    self.keystore, tx, i, Script(prev_out.script_pubkey)
+                    self.keystore, tx, i, Script(prev_out.script_pubkey),
+                    precomputed=precomp,
                 )
             needed = feerate.fee_for(len(tx.to_bytes()))
             if fee >= needed:
@@ -726,8 +730,12 @@ class Wallet(ValidationInterface):
             vout=new_vout,
             locktime=old.locktime,
         )
+        from ..script.interpreter import PrecomputedSighash
+
+        precomp = PrecomputedSighash(new_tx)
         for i, out in enumerate(prevs):
-            sign_tx_input(self.keystore, new_tx, i, Script(out.script_pubkey))
+            sign_tx_input(self.keystore, new_tx, i, Script(out.script_pubkey),
+                          precomputed=precomp)
         new_txid = self.commit_transaction(new_tx)
         with self.lock:
             self.wtx.pop(txid, None)

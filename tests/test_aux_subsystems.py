@@ -118,14 +118,27 @@ def test_fee_estimator_learns():
 
 
 def test_sigcache():
-    c = SignatureCache(max_entries=2)
+    from nodexa_chain_core_tpu.script.sigcache import _ENTRY_OVERHEAD
+
+    per = _ENTRY_OVERHEAD + 6  # three 2-byte key components each
+    c = SignatureCache(max_bytes=2 * per)
     c.set(b"d1", b"s1", b"p1", True)
     assert c.get(b"d1", b"s1", b"p1") is True
     assert c.get(b"d2", b"s1", b"p1") is None
     c.set(b"d2", b"s2", b"p2", False)
-    c.set(b"d3", b"s3", b"p3", True)  # evicts d1
+    assert c.bytes_used() == 2 * per
+    c.set(b"d3", b"s3", b"p3", True)  # over budget: evicts d1
     assert c.get(b"d1", b"s1", b"p1") is None
     assert c.get(b"d2", b"s2", b"p2") is False
+    # a large entry charges its real size: inserting it evicts BOTH
+    # small survivors, not just one slot
+    c.set(b"d4" * 16, b"s4" * 36, b"p4" * 33, True)
+    assert c.get(b"d2", b"s2", b"p2") is None
+    assert c.get(b"d3", b"s3", b"p3") is None
+    # -maxsigcachesize shrink evicts immediately
+    c.set_max_bytes(0)
+    assert c.bytes_used() == 0
+    assert c.get(b"d4" * 16, b"s4" * 36, b"p4" * 33) is None
 
 
 def test_timedata_median():

@@ -1,0 +1,516 @@
+"""Staged tx-admission fast path (ISSUE 4): reject-taxonomy parity with
+the legacy inline path, the tip-moves-between-snapshot-and-commit race,
+outpoint reservation semantics, per-control CheckQueue sessions, and
+sighash-midstate equivalence against the naive ``signature_hash``."""
+
+import threading
+
+import pytest
+
+from nodexa_chain_core_tpu.chain import mempool_accept
+from nodexa_chain_core_tpu.chain.checkqueue import CheckQueue
+from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+from nodexa_chain_core_tpu.chain.mempool_accept import (
+    MempoolAcceptError,
+    accept_to_memory_pool,
+)
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.script.interpreter import (
+    SIGHASH_ALL,
+    SIGHASH_ANYONECANPAY,
+    SIGHASH_NONE,
+    SIGHASH_SINGLE,
+    STANDARD_SCRIPT_VERIFY_FLAGS,
+    PrecomputedSighash,
+    TransactionSignatureChecker,
+    signature_hash,
+    verify_script,
+    verify_script_fast,
+)
+from nodexa_chain_core_tpu.script.script import Script
+from nodexa_chain_core_tpu.script.sign import KeyStore, sign_tx_input
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+
+@pytest.fixture()
+def chain(tmp_path):
+    """Regtest chain with spendable coinbases (ref TestChain100Setup)."""
+    params = regtest_params()
+    cs = ChainState(params)
+    cs.mempool = TxMemPool()
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xFA57)))
+    t = params.genesis_time + 60
+    blocks = []
+    asm = BlockAssembler(cs)
+    for _ in range(COINBASE_MATURITY + 16):
+        blk = asm.create_new_block(spk.raw, ntime=t)
+        assert mine_block_cpu(blk, params.algo_schedule)
+        cs.process_new_block(blk)
+        blocks.append(blk)
+        t += 60
+    return params, cs, ks, spk, blocks
+
+
+def spend_tx(ks, spk, prev_tx, value_out, n=0):
+    tx = Transaction(
+        version=2,
+        vin=[TxIn(prevout=OutPoint(prev_tx.txid, n))],
+        vout=[TxOut(value=value_out, script_pubkey=spk.raw)],
+    )
+    sign_tx_input(ks, tx, 0, spk)
+    return tx
+
+
+def mine_with(cs, params, spk, extra_txs=()):
+    """Mine a block on the current tip, optionally carrying extra txs
+    injected past the assembler (the ibd-bench pattern)."""
+    asm = BlockAssembler(cs)
+    blk = asm.create_new_block(spk.raw, ntime=cs.tip().time + 60)
+    if extra_txs:
+        blk.vtx.extend(extra_txs)
+        blk.header.hash_merkle_root = merkle_root([x.txid for x in blk.vtx])[0]
+    assert mine_block_cpu(blk, params.algo_schedule)
+    assert cs.process_new_block(blk)
+    return blk
+
+
+# --------------------------------------------------- taxonomy parity
+
+
+def _reject_code(cs, pool, tx, staged, **kw):
+    try:
+        accept_to_memory_pool(cs, pool, tx, staged=staged, **kw)
+    except MempoolAcceptError as e:
+        return e.code
+    return None
+
+
+def test_reject_taxonomy_parity(chain):
+    """Every reject (and the accepts) must carry the same code on both
+    paths — the staged pipeline re-orders work, not semantics."""
+    params, cs, ks, spk, blocks = chain
+
+    def scenarios(pool, staged):
+        """Ordered (name, code) observations against a fresh pool."""
+        out = []
+        cb = [blocks[i].vtx[0] for i in range(8)]
+        v = cb[0].vout[0].value
+
+        good = spend_tx(ks, spk, cb[0], v - 100_000)
+        out.append(("accept", _reject_code(cs, pool, good, staged)))
+        out.append(("duplicate", _reject_code(cs, pool, good, staged)))
+
+        dspend = spend_tx(ks, spk, cb[0], v - 200_000)
+        out.append(("double-spend", _reject_code(cs, pool, dspend, staged)))
+
+        free = spend_tx(ks, spk, cb[1], cb[1].vout[0].value)
+        out.append(("zero-fee", _reject_code(cs, pool, free, staged)))
+
+        young = blocks[-1].vtx[0]
+        imm = spend_tx(ks, spk, young, young.vout[0].value - 100_000)
+        out.append(("immature", _reject_code(cs, pool, imm, staged)))
+
+        missing = spend_tx(ks, spk, cb[2], v - 100_000)
+        missing.vin[0].prevout = OutPoint(txid=0xDEAD, n=0)
+        out.append(("missing-input", _reject_code(cs, pool, missing, staged)))
+
+        badsig = spend_tx(ks, spk, cb[3], v - 100_000)
+        sig = bytearray(badsig.vin[0].script_sig)
+        sig[10] ^= 0x01  # corrupt a signature byte, keep DER shape
+        badsig.vin[0].script_sig = bytes(sig)
+        out.append(("bad-sig", _reject_code(cs, pool, badsig, staged)))
+
+        # regtest runs require_standard=False; force the policy on to
+        # exercise the non-standard reject (version 3 signed as such)
+        weird = Transaction(
+            version=3,
+            vin=[TxIn(prevout=OutPoint(cb[4].txid, 0))],
+            vout=[TxOut(value=v - 100_000, script_pubkey=spk.raw)],
+        )
+        sign_tx_input(ks, weird, 0, spk)
+        out.append(("nonstandard", _reject_code(
+            cs, pool, weird, staged, require_standard=True)))
+
+        out.append(("coinbase", _reject_code(cs, pool, cb[5], staged)))
+
+        nonfinal = Transaction(
+            version=2,
+            vin=[TxIn(prevout=OutPoint(cb[6].txid, 0), sequence=0)],
+            vout=[TxOut(value=v - 100_000, script_pubkey=spk.raw)],
+            locktime=cs.tip().height + 50,
+        )
+        sign_tx_input(ks, nonfinal, 0, spk)
+        out.append(("non-final", _reject_code(cs, pool, nonfinal, staged)))
+        return out
+
+    staged_codes = scenarios(TxMemPool(), staged=True)
+    inline_codes = scenarios(TxMemPool(), staged=False)
+    assert staged_codes == inline_codes
+    codes = dict(staged_codes)
+    assert codes["accept"] is None
+    assert codes["duplicate"] == "txn-already-in-mempool"
+    assert codes["double-spend"] == "txn-mempool-conflict"
+    assert codes["bad-sig"] == "mandatory-script-verify-flag-failed"
+    assert codes["missing-input"] == "bad-txns-inputs-missingorspent"
+    assert codes["nonstandard"] == "non-standard"
+    assert codes["coinbase"] == "coinbase"
+
+
+def test_entry_equivalence(chain):
+    """Both paths produce the same MempoolEntry economics."""
+    params, cs, ks, spk, blocks = chain
+    cb = blocks[0].vtx[0]
+    tx = spend_tx(ks, spk, cb, cb.vout[0].value - 123_456)
+    e_staged = accept_to_memory_pool(cs, TxMemPool(), tx, staged=True)
+    e_inline = accept_to_memory_pool(cs, TxMemPool(), tx, staged=False)
+    assert (e_staged.fee, e_staged.height, e_staged.sigops) == (
+        e_inline.fee, e_inline.height, e_inline.sigops)
+    assert e_staged.fee == 123_456
+
+
+# --------------------------------------------------- snapshot/commit race
+
+
+def _with_hook(hook, fn):
+    mempool_accept._test_hook_after_scripts = hook
+    try:
+        return fn()
+    finally:
+        mempool_accept._test_hook_after_scripts = None
+
+
+def test_race_block_spends_input(chain):
+    """Tip moves between scripts and commit AND spends our input: the
+    commit-stage generation re-check must reject — no double spend."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    cb = blocks[0].vtx[0]
+    v = cb.vout[0].value
+    ours = spend_tx(ks, spk, cb, v - 100_000)
+    theirs = spend_tx(ks, spk, cb, v - 150_000)  # same coin, mined instead
+    gen_before = cs.tip_generation
+
+    def hook(tx):
+        mine_with(cs, params, spk, extra_txs=[theirs])
+
+    with pytest.raises(MempoolAcceptError, match="missingorspent"):
+        _with_hook(hook, lambda: accept_to_memory_pool(
+            cs, pool, ours, staged=True))
+    assert cs.tip_generation == gen_before + 1
+    assert not pool.contains(ours.txid)
+    assert pool.reserved_count() == 0  # reject released the claims
+
+
+def test_race_benign_tip_move(chain):
+    """Tip moves but our input survives: the re-run context checks accept
+    against the new tip (fresh height), not the snapshot's."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    cb = blocks[1].vtx[0]
+    ours = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+
+    def hook(tx):
+        mine_with(cs, params, spk)  # unrelated empty block
+
+    entry = _with_hook(hook, lambda: accept_to_memory_pool(
+        cs, pool, ours, staged=True))
+    assert pool.contains(ours.txid)
+    # admission height tracked the MOVED tip (validation height = tip+1)
+    assert entry.height == cs.tip().height + 1
+    assert pool.reserved_count() == 0
+
+
+def test_concurrent_conflicting_admission(chain):
+    """A conflicting tx arriving while the first is verifying scripts hits
+    the outpoint reservation and rejects — it must NOT pass its own
+    snapshot and commit a double spend."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    cb = blocks[2].vtx[0]
+    v = cb.vout[0].value
+    first = spend_tx(ks, spk, cb, v - 100_000)
+    rival = spend_tx(ks, spk, cb, v - 150_000)
+    rival_code = []
+
+    def hook(tx):
+        if tx.txid != first.txid:
+            return  # the rival's own scripts-stage firing: ignore
+        try:
+            accept_to_memory_pool(cs, pool, rival, staged=True)
+            rival_code.append(None)
+        except MempoolAcceptError as e:
+            rival_code.append(e.code)
+
+    _with_hook(hook, lambda: accept_to_memory_pool(
+        cs, pool, first, staged=True))
+    assert rival_code == ["txn-mempool-conflict"]
+    assert pool.contains(first.txid)
+    assert not pool.contains(rival.txid)
+    assert pool.reserved_count() == 0
+
+
+def test_reservation_released_on_script_reject(chain):
+    """A script-stage reject must release the claims so the outpoint is
+    immediately admittable by a valid spend."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    cb = blocks[3].vtx[0]
+    v = cb.vout[0].value
+    bad = spend_tx(ks, spk, cb, v - 100_000)
+    sig = bytearray(bad.vin[0].script_sig)
+    sig[10] ^= 0x01
+    bad.vin[0].script_sig = bytes(sig)
+    with pytest.raises(MempoolAcceptError, match="script-verify"):
+        accept_to_memory_pool(cs, pool, bad, staged=True)
+    assert pool.reserved_count() == 0
+    good = spend_tx(ks, spk, cb, v - 120_000)
+    accept_to_memory_pool(cs, pool, good, staged=True)
+    assert pool.contains(good.txid)
+
+
+def test_race_pool_removal_without_tip_move(chain):
+    """An in-pool parent evicted (replacement/size/expiry) while the child
+    verifies scripts: the TIP generation never moves, but the pool's
+    removal generation does — commit must re-run context checks and
+    reject the now-parentless child instead of inserting it."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    cb = blocks[0].vtx[0]
+    parent = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+    accept_to_memory_pool(cs, pool, parent, staged=True)
+    child = spend_tx(ks, spk, parent, parent.vout[0].value - 100_000)
+    gen_before = cs.tip_generation
+
+    def hook(tx):
+        pool.remove(parent.txid, "size")  # trim_to_size-style eviction
+
+    with pytest.raises(MempoolAcceptError, match="missingorspent"):
+        _with_hook(hook, lambda: accept_to_memory_pool(
+            cs, pool, child, staged=True))
+    assert cs.tip_generation == gen_before  # the tip never moved
+    assert not pool.contains(child.txid)
+    assert pool.reserved_count() == 0
+
+
+def test_reservation_refcount_same_txid_twins():
+    """Concurrent submissions of the SAME tx each hold one claim: one
+    twin's release must not free the outpoints the other is still
+    verifying against (a rival conflict must stay locked out)."""
+    pool = TxMemPool()
+    tx = _arbitrary_tx(2, 1)
+    rival = _arbitrary_tx(2, 1)  # same prevouts, different txid
+    rival.vout[0].value += 1
+    assert tx.txid != rival.txid
+    assert pool.reserve_outpoints(tx)
+    assert pool.reserve_outpoints(tx)  # the in-flight twin
+    pool.release_outpoints(tx)  # first twin rejected at its commit
+    assert not pool.reserve_outpoints(rival)  # live twin still holds
+    pool.release_outpoints(tx)
+    assert pool.reserved_count() == 0
+    assert pool.reserve_outpoints(rival)  # now genuinely free
+    pool.release_outpoints(rival)
+    assert pool.reserved_count() == 0
+
+
+def test_parallel_flood_no_double_spend(chain):
+    """Many threads race pairs of mutually conflicting spends: exactly one
+    of each pair lands, reservations all drain."""
+    params, cs, ks, spk, blocks = chain
+    pool = TxMemPool()
+    pairs = []
+    for i in range(6):
+        cb = blocks[4 + i].vtx[0]
+        v = cb.vout[0].value
+        pairs.append((spend_tx(ks, spk, cb, v - 100_000),
+                      spend_tx(ks, spk, cb, v - 150_000)))
+    results = []
+    lock = threading.Lock()
+
+    def submit(tx):
+        try:
+            accept_to_memory_pool(cs, pool, tx, staged=True)
+            ok = True
+        except MempoolAcceptError:
+            ok = False
+        with lock:
+            results.append(ok)
+
+    threads = [threading.Thread(target=submit, args=(tx,))
+               for pair in pairs for tx in pair]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for a, b in pairs:
+        assert pool.contains(a.txid) ^ pool.contains(b.txid)
+    assert pool.reserved_count() == 0
+    assert sum(results) == len(pairs)
+
+
+# --------------------------------------------------- P2PKH fast path
+
+
+def test_verify_script_fast_differential(chain):
+    """The P2PKH template shortcut must agree with the generic VM —
+    (ok, error-code) bit-identical — across valid spends and every
+    tampering class, and must FALL BACK (not reject) on shapes outside
+    the template."""
+    params, cs, ks, spk, blocks = chain
+    cb = blocks[5].vtx[0]
+    tx = spend_tx(ks, spk, cb, cb.vout[0].value - 100_000)
+    good_sig = tx.vin[0].script_sig
+
+    def both(script_sig_raw, spk_raw=spk.raw):
+        cases = []
+        for fn in (verify_script, verify_script_fast):
+            c = TransactionSignatureChecker(
+                tx, 0, cb.vout[0].value,
+                precomputed=PrecomputedSighash(tx))
+            cases.append(fn(Script(script_sig_raw), Script(spk_raw),
+                            STANDARD_SCRIPT_VERIFY_FLAGS, c))
+        return cases
+
+    # valid spend
+    a, b = both(good_sig)
+    assert a == b == (True, "")
+    # corrupt signature byte (valid DER shape, wrong sig)
+    bad = bytearray(good_sig)
+    bad[10] ^= 0x01
+    assert both(bytes(bad))[0] == both(bytes(bad))[1]
+    assert both(bytes(bad))[0][1] == "nullfail"
+    # wrong pubkey for the hash: swap in another key's pubkey push
+    other_pub = ks.get_pub(ks.add_key(0xBEEF))
+    n_sig = good_sig[0]
+    swapped = (good_sig[:1 + n_sig]
+               + bytes([len(other_pub)]) + other_pub)
+    assert both(swapped)[0] == both(swapped)[1]
+    assert both(swapped)[0][1] == "equalverify"
+    # truncated DER (encoding reject)
+    trunc = bytes([n_sig - 6]) + good_sig[1:n_sig - 5] + good_sig[1 + n_sig:]
+    assert both(trunc)[0] == both(trunc)[1]
+    # hybrid (0x06) pubkey encoding under STRICTENC
+    hybrid = bytes([0x06]) + other_pub[1:] + b"\x00" * 32
+    hyb_sig = (good_sig[:1 + n_sig] + bytes([len(hybrid)]) + hybrid)
+    assert both(hyb_sig)[0] == both(hyb_sig)[1]
+    # non-minimal push (PUSHDATA1 where direct push required): the fast
+    # path must fall back and the verdicts still agree
+    pd1 = bytes([0x4C, n_sig]) + good_sig[1:]
+    assert both(pd1)[0] == both(pd1)[1]
+    # non-P2PKH spk: fall-through parity (P2SH-looking spk)
+    p2sh = bytes([0xA9, 0x14]) + b"\x11" * 20 + bytes([0x87])
+    assert both(good_sig, spk_raw=p2sh)[0] == both(good_sig, spk_raw=p2sh)[1]
+    # empty scriptSig
+    assert both(b"")[0] == both(b"")[1]
+
+
+# --------------------------------------------------- checkqueue sessions
+
+
+def test_checkqueue_sessions_isolate_failures():
+    """Two interleaved sessions on one queue: each wait() sees only its
+    own batch's verdict."""
+    q = CheckQueue(2)
+    try:
+        s1, s2 = q.session(), q.session()
+        s1.add([lambda: None] * 8)
+        s2.add([lambda: "boom"] + [lambda: None] * 7)
+        s1.add([lambda: None] * 8)
+        assert s2.wait() == "boom"
+        assert s1.wait() is None
+        # sessions reset after wait: reusable
+        s2.add([lambda: None])
+        assert s2.wait() is None
+    finally:
+        q.stop()
+
+
+# --------------------------------------------------- sighash midstate
+
+HASHTYPES = (
+    SIGHASH_ALL,
+    SIGHASH_NONE,
+    SIGHASH_SINGLE,
+    SIGHASH_ALL | SIGHASH_ANYONECANPAY,
+    SIGHASH_NONE | SIGHASH_ANYONECANPAY,
+    SIGHASH_SINGLE | SIGHASH_ANYONECANPAY,
+    0,          # defaults to ALL-like serialization
+    0x1F,       # masked base out of the named range
+    0x41,       # named base with junk high bits (no ANYONECANPAY)
+    0x7F,
+    0xFF,       # SINGLE|ANYONECANPAY with junk bits
+    0x84,
+)
+
+
+def _arbitrary_tx(n_in, n_out):
+    return Transaction(
+        version=2,
+        vin=[
+            TxIn(prevout=OutPoint(txid=0x1111 * (i + 1), n=i),
+                 script_sig=bytes([0x51 + i]),
+                 sequence=0xFFFFFFF0 + i)
+            for i in range(n_in)
+        ],
+        vout=[
+            TxOut(value=5_000 * (j + 1), script_pubkey=bytes([0x52, 0x87 + j]))
+            for j in range(n_out)
+        ],
+        locktime=77,
+    )
+
+
+def test_sighash_midstate_matches_naive():
+    """PrecomputedSighash.digest == signature_hash for every SIGHASH
+    class, every input, including ANYONECANPAY and junk-bit types."""
+    script = Script(bytes.fromhex("76a914") + b"\xAB" * 20
+                    + bytes.fromhex("88ac"))
+    for n_in, n_out in ((1, 1), (3, 2), (2, 4)):
+        tx = _arbitrary_tx(n_in, n_out)
+        pre = PrecomputedSighash(tx)
+        for ht in HASHTYPES:
+            for i in range(n_in):
+                assert pre.digest(script, i, ht) == signature_hash(
+                    script, tx, i, ht), (n_in, n_out, ht, i)
+
+
+def test_sighash_midstate_single_out_of_range():
+    """SIGHASH_SINGLE with in_idx >= len(vout) and in_idx >= len(vin)
+    both reproduce the 'hash of one' quirk."""
+    one = (1).to_bytes(32, "little")
+    script = Script(b"\x51")
+    tx = _arbitrary_tx(3, 1)
+    pre = PrecomputedSighash(tx)
+    for ht in (SIGHASH_SINGLE, SIGHASH_SINGLE | SIGHASH_ANYONECANPAY):
+        for i in (1, 2):  # no matching output
+            assert signature_hash(script, tx, i, ht) == one
+            assert pre.digest(script, i, ht) == one
+        assert pre.digest(script, 0, ht) == signature_hash(script, tx, 0, ht)
+    # out-of-range input index
+    assert pre.digest(script, 7, SIGHASH_ALL) == one
+    assert signature_hash(script, tx, 7, SIGHASH_ALL) == one
+
+
+def test_sighash_midstate_scriptsig_edit_safe():
+    """Signing-loop contract: mutating one input's scriptSig does not
+    change any other input's digest (others serialize empty)."""
+    script = Script(b"\x51\x87")
+    tx = _arbitrary_tx(3, 3)
+    naive_before = [signature_hash(script, tx, i, SIGHASH_ALL)
+                    for i in range(3)]
+    pre = PrecomputedSighash(tx)
+    assert pre.digest(script, 0, SIGHASH_ALL) == naive_before[0]
+    tx.vin[0].script_sig = b"\x00" * 40  # "signed"
+    for i in (1, 2):
+        assert pre.digest(script, i, SIGHASH_ALL) == naive_before[i]
+        assert signature_hash(script, tx, i, SIGHASH_ALL) == naive_before[i]

@@ -24,25 +24,31 @@
 #                      accepted shares on the batched device path AND the
 #                      scalar fallback, plus a winning share landing a
 #                      block through ConnectTip, all asserted
-#   7. vectors         generate_x16r_vectors.py --check — the committed
+#   7. tx admission    bench/txflood.py --assert-fast-path — a concurrent
+#                      pre-signed tx flood through both admission paths,
+#                      asserting staged >= 2x inline accepts/s, cs_main
+#                      hold p99 below the off-lock scripts-stage mean
+#                      (ECDSA demonstrably outside the lock), and an
+#                      identical reject taxonomy on both paths
+#   8. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#   8. native build    compiles the C++ engine (also feeds the wheel)
-#   9. static checks   tools/typecheck.py over the consensus-critical
+#   9. native build    compiles the C++ engine (also feeds the wheel)
+#  10. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  10. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  11. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  11. pytest          unit suite (functional suite with --full)
-#  12. wheel           platform-tagged wheel incl. the native .so,
+#  12. pytest          unit suite (functional suite with --full)
+#  13. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/12] lint"
+echo "== [1/13] lint"
 python tools/lint.py
 
-echo "== [2/12] import graph"
+echo "== [2/13] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -60,13 +66,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/12] rpc mapping parity"
+echo "== [3/13] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/12] telemetry exposition"
+echo "== [4/13] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/12] IBD fast path (synthetic)"
+echo "== [5/13] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -78,7 +84,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/12] pool stratum e2e (loopback)"
+echo "== [6/13] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -89,23 +95,34 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/12] crypto vector regeneration"
+echo "== [7/13] tx admission fast path (flood)"
+# no-pipe discipline again: the gate's exit status must reach set -e and
+# the per-path JSON diagnostics must surface when the floor fails
+TXF_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
+        --assert-fast-path > "$TXF_LOG" 2>&1; then
+    cat "$TXF_LOG"; rm -f "$TXF_LOG"
+    exit 1
+fi
+tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
+
+echo "== [8/13] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [8/12] native engine build"
+echo "== [9/13] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [9/12] static checks (consensus-critical packages)"
+echo "== [10/13] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [10/12] native hardening (security-check analog)"
+echo "== [11/13] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [11/12] pytest"
+echo "== [12/13] pytest"
 # telemetry suite already ran as stage 4: don't pay for it twice
 if [ "$1" = "--full" ]; then
     python -m pytest tests/ -q --ignore=tests/test_telemetry.py
@@ -114,7 +131,7 @@ else
         --ignore=tests/test_telemetry.py
 fi
 
-echo "== [12/12] wheel"
+echo "== [13/13] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -61,6 +61,32 @@ miners connect and after a share interval, then read the delta's
   ... miners hammer the stratum port ...
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_pool.json | python -m json.tool | grep -A4 nodexa_pool
+
+Diffing a tx flood (the PR-4 staged-admission proof): snapshot before
+relaying a burst of transactions at the node and after the mempool
+settles, then read the delta's
+
+  nodexa_mempool_accept_seconds{stage=prechecks|snapshot|scripts|commit}
+      — per-stage admission time; `scripts` (the ECDSA) should dominate
+  nodexa_mempool_csmain_hold_seconds{stage=snapshot|commit}
+      — the actual lock holds; their p99 sitting far below the scripts
+      mean IS the fast path working (stage=inline samples mean
+      -stagedmempool=0 is forcing the legacy path)
+  nodexa_mempool_accepts_total{result=...,path=staged|inline} and
+  nodexa_mempool_rejected_total{reason=...}
+      — outcomes by path and the reject taxonomy
+  nodexa_p2p_tx_batch_size / nodexa_orphans_promoted_total
+      — how many TX messages coalesced per admission pass and orphans
+      promoted in one-pass work-set walks
+  nodexa_scriptcheck_checks_total{mode=queued|inline} and
+  nodexa_sigcache_hits_total / nodexa_sigcache_bytes
+      — whether per-input checks actually fanned onto the -par workers
+      and what the verdict cache holds under -maxsigcachesize
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_flood.json
+  ... relay the tx burst (e.g. wallet sends / sendrawtransaction loop) ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_flood.json | python -m json.tool | grep -A8 mempool
 """
 
 from __future__ import annotations
