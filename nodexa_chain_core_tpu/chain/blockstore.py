@@ -17,8 +17,19 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from ..core.serialize import ByteReader, ByteWriter, Serializable
+from ..node.faults import g_faults
 from ..primitives.block import AlgoSchedule, Block
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
 from .coins import Coin
+
+# read-ahead misses force the connect loop back onto a synchronous read:
+# the reason label separates real worker errors from consumer-side
+# timeouts and a dead worker thread
+_M_PREFETCH_FALLBACK = g_metrics.counter(
+    "nodexa_prefetch_fallback_total",
+    "Block read-ahead misses that fell back to a synchronous read, "
+    "labeled by reason (error|timeout|dead)")
 
 
 @dataclass
@@ -59,11 +70,16 @@ class BlockUndo(Serializable):
 
 
 class AppendFile:
-    """Magic+length framed append-only record file."""
+    """Magic+length framed append-only record file.
 
-    def __init__(self, path: str, magic: bytes):
+    ``site`` is an optional fault-injection prefix (``blockstore.blk`` /
+    ``blockstore.rev``): when set, append/read/sync consult the fault
+    registry under ``<site>.append`` / ``.read`` / ``.sync``."""
+
+    def __init__(self, path: str, magic: bytes, site: Optional[str] = None):
         self.path = path
         self.magic = magic
+        self.site = site
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._f = open(path, "ab+")
 
@@ -71,9 +87,13 @@ class AppendFile:
         """Returns the byte offset of the record."""
         self._f.seek(0, os.SEEK_END)
         pos = self._f.tell()
-        self._f.write(self.magic)
-        self._f.write(len(payload).to_bytes(4, "little"))
-        self._f.write(payload)
+        rec = self.magic + len(payload).to_bytes(4, "little") + payload
+        if g_faults.enabled and self.site:
+            # kill@<n> first writes n framed bytes: the torn tail a
+            # mid-append power cut leaves, which scan() must stop at
+            g_faults.check(self.site + ".append",
+                           torn_file=self._f, torn_data=rec)
+        self._f.write(rec)
         self._f.flush()
         return pos
 
@@ -84,6 +104,8 @@ class AppendFile:
             raise IOError(f"bad record magic at {pos} in {self.path}")
         size = int.from_bytes(self._f.read(4), "little")
         data = self._f.read(size)
+        if g_faults.enabled and self.site:
+            data = g_faults.filter_read(self.site + ".read", data)
         if len(data) != size:
             raise IOError("truncated record")
         return data
@@ -93,6 +115,8 @@ class AppendFile:
         return self._f.tell()
 
     def sync(self) -> None:
+        if g_faults.enabled and self.site:
+            g_faults.check(self.site + ".sync")
         self._f.flush()
         os.fsync(self._f.fileno())
 
@@ -166,10 +190,12 @@ class ChunkedRecordFile:
         magic: bytes,
         chunk_bytes: int = 16 * 1024 * 1024,
         legacy_name: Optional[str] = None,
+        site: Optional[str] = None,
     ):
         self.dirpath = dirpath
         self.base = base
         self.magic = magic
+        self.site = site
         self.chunk_bytes = chunk_bytes
         os.makedirs(dirpath, exist_ok=True)
         # adopt a pre-chunking single-file store as chunk 0
@@ -201,7 +227,7 @@ class ChunkedRecordFile:
     def _file(self, n: int) -> AppendFile:
         f = self._files.pop(n, None)
         if f is None:
-            f = AppendFile(self._path(n), self.magic)
+            f = AppendFile(self._path(n), self.magic, site=self.site)
         self._files[n] = f  # re-insert: dict order doubles as LRU order
         while len(self._files) > self.MAX_OPEN_FILES:
             old_n = next(iter(self._files))
@@ -290,7 +316,12 @@ class BlockReadAhead:
     The consumer contract is strictly in-order: ``get`` for the items in
     the order passed to ``start``; a miss (timeout, worker death, read
     error) returns ``(None, 0)`` and the caller falls back to its own
-    synchronous read."""
+    synchronous read.  Worker failures are TYPED, never swallowed: the
+    captured exception travels through the queue, ``get`` logs it and
+    counts the fallback in ``nodexa_prefetch_fallback_total`` — the
+    consumer's synchronous re-read then surfaces the real error if the
+    fault is persistent (an injected/transient one simply costs the
+    prefetch win)."""
 
     def __init__(
         self,
@@ -313,15 +344,19 @@ class BlockReadAhead:
                     return
                 blk = None
                 warmed = 0
+                err: Optional[BaseException] = None
                 try:
                     blk = self._read(it)
                     if self._warm is not None and blk is not None:
                         warmed = self._warm(blk)
-                except Exception:
-                    blk = None  # consumer re-reads and raises the real error
+                except Exception as e:  # noqa: BLE001 — typed + re-surfaced
+                    # the failure rides the queue: the consumer counts it,
+                    # logs it, and re-reads synchronously (raising the
+                    # real error if it reproduces)
+                    blk, err = None, e
                 while not self._stop.is_set():
                     try:
-                        self._q.put((it, blk, warmed), timeout=0.1)
+                        self._q.put((it, blk, warmed, err), timeout=0.1)
                         break
                     except queue.Full:
                         continue
@@ -339,14 +374,22 @@ class BlockReadAhead:
         while True:
             remain = deadline - time.monotonic()
             if remain <= 0:
+                _M_PREFETCH_FALLBACK.inc(reason="timeout")
                 return None, 0
             try:
-                it, blk, warmed = self._q.get(timeout=min(remain, 0.5))
+                it, blk, warmed, err = self._q.get(timeout=min(remain, 0.5))
             except queue.Empty:
                 if not self._thread.is_alive() and self._q.empty():
+                    _M_PREFETCH_FALLBACK.inc(reason="dead")
                     return None, 0
                 continue
             if it is item:
+                if err is not None:
+                    _M_PREFETCH_FALLBACK.inc(reason="error")
+                    log_printf(
+                        "readahead: %s reading %r; falling back to a "
+                        "synchronous read", repr(err), item)
+                    return None, 0
                 return blk, warmed
             # stale entry for an item the consumer skipped: drop and keep
             # draining until the requested one surfaces
@@ -374,10 +417,12 @@ class BlockStore:
     ):
         blocks_dir = os.path.join(datadir, "blocks")
         self.blocks = ChunkedRecordFile(
-            blocks_dir, "blk", magic, chunk_bytes, legacy_name="blocks.dat"
+            blocks_dir, "blk", magic, chunk_bytes, legacy_name="blocks.dat",
+            site="blockstore.blk",
         )
         self.undos = ChunkedRecordFile(
-            blocks_dir, "rev", magic, chunk_bytes, legacy_name="undo.dat"
+            blocks_dir, "rev", magic, chunk_bytes, legacy_name="undo.dat",
+            site="blockstore.rev",
         )
 
     def write_block(self, block: Block, schedule: Optional[AlgoSchedule] = None) -> int:

@@ -407,6 +407,7 @@ class KVStore:
             with open(self._log_path, "rb") as f:
                 log = f.read()
             i = 0
+            committed_end = 0  # offset just past the last commit marker
             pending: list[Tuple[int, bytes, bytes]] = []
             while i + 9 <= len(log):
                 rec_type, klen, vlen = struct.unpack_from("<BII", log, i)
@@ -416,6 +417,7 @@ class KVStore:
                         mem[k] = v if t == _REC_PUT else _TOMBSTONE
                     pending = []
                     i = j
+                    committed_end = j
                     continue
                 if j + klen + vlen + 4 > len(log):
                     break  # torn record
@@ -426,36 +428,78 @@ class KVStore:
                     break  # corruption: stop replay here
                 pending.append((rec_type, k, v))
                 i = j + klen + vlen + 4
+            if committed_end < len(log):
+                # torn/corrupt/uncommitted tail: truncate at the last
+                # COMMIT boundary — not the last valid record — so (a)
+                # re-opening in append mode cannot bury new commits
+                # behind unreadable garbage, and (b) an aborted batch's
+                # CRC-valid prefix records can never be adopted by a
+                # LATER batch's commit marker on the next recovery
+                _M_TORN_TAIL.inc()
+                log_printf(
+                    "kvstore %s: discarding %d-byte uncommitted WAL tail "
+                    "at offset %d (last commit boundary)",
+                    self._path, len(log) - committed_end, committed_end)
+                with open(self._log_path, "r+b") as f:
+                    f.truncate(committed_end)
         self._state = (tuple(tables), mem)
 
     # -- writes -----------------------------------------------------------
 
     def _append_record(self, rec_type: int, key: bytes, value: bytes) -> None:
+        """One CRC'd WAL record WITHOUT a commit marker (crash-simulation
+        hook for tests; write_batch appends the whole batch in one write)."""
         hdr = struct.pack("<BII", rec_type, len(key), len(value))
         body = hdr + key + value
-        crc = zlib.crc32(body)
-        self._log.write(body + struct.pack("<I", crc))
+        self._log.write(body + struct.pack("<I", zlib.crc32(body)))
         self._log_size += len(body) + 4
+
+    @staticmethod
+    def _encode_batch(ops) -> bytes:
+        """The batch's WAL byte image: CRC'd records + a commit marker."""
+        parts = []
+        for t, k, v in ops:
+            body = struct.pack("<BII", t, len(k), len(v)) + k + v
+            parts.append(body + struct.pack("<I", zlib.crc32(body)))
+        parts.append(struct.pack("<BII", _REC_COMMIT, 0, 0))
+        return b"".join(parts)
 
     def write_batch(self, batch: WriteBatch, sync: bool = False) -> None:
         t0 = _time.perf_counter()
         nbytes = sum(len(k) + len(v) for _, k, v in batch.ops)
-        with self._write_lock:
-            if self._log is not None:
+        try:
+            with self._write_lock:
+                if self._log is not None:
+                    records = self._encode_batch(batch.ops)
+                    if _g_faults.enabled:
+                        # kill@<n> writes n record bytes first: exactly the
+                        # torn tail a mid-append power cut leaves behind
+                        _g_faults.check("kvstore.wal_append",
+                                        torn_file=self._log, torn_data=records)
+                    self._log.write(records)
+                    self._log_size += len(records)
+                    self._log.flush()
+                    if sync:
+                        if _g_faults.enabled:
+                            _g_faults.check("kvstore.wal_fsync")
+                        os.fsync(self._log.fileno())
+                mem = self._mem
                 for t, k, v in batch.ops:
-                    self._append_record(t, k, v)
-                self._log.write(struct.pack("<BII", _REC_COMMIT, 0, 0))
-                self._log_size += 9
-                self._log.flush()
-                if sync:
-                    os.fsync(self._log.fileno())
-            mem = self._mem
-            for t, k, v in batch.ops:
-                mem[k] = v if t == _REC_PUT else _TOMBSTONE
-            if (self._log is not None
-                    and self._log_size > self._compact_threshold):
-                self.flush()
-                self._maybe_major()
+                    mem[k] = v if t == _REC_PUT else _TOMBSTONE
+                if (self._log is not None
+                        and self._log_size > self._compact_threshold):
+                    self.flush()
+                    self._maybe_major()
+        except (OSError, KVError) as e:
+            # the commit marker never hit the disk (or the memtable is now
+            # ahead of a WAL that did not confirm): this store can no
+            # longer promise durability — escalate unless the error is
+            # transient, in which case the caller's retry layer owns it
+            from ..node.health import g_health, is_transient
+
+            if not is_transient(e):
+                g_health.critical_error("kvstore.write_batch", e)
+            raise
         _M_BATCH_WRITES.inc()
         _M_BATCH_OPS.inc(len(batch.ops))
         _M_BATCH_BYTES.inc(nbytes)
@@ -527,6 +571,8 @@ class KVStore:
         with self._write_lock:
             if self._path is None or not self._mem:
                 return
+            if _g_faults.enabled:
+                _g_faults.check("kvstore.segment_write")
             tables, mem = self._state
             items = sorted(
                 (k, _TOMB if v is _TOMBSTONE else v) for k, v in mem.items()
@@ -577,6 +623,8 @@ class KVStore:
         with self._write_lock:
             if self._path is None:
                 return
+            if _g_faults.enabled:
+                _g_faults.check("kvstore.compact")
             old_tables, _ = self._state
             count = _write_table(
                 self._base_path,
@@ -596,15 +644,26 @@ class KVStore:
 
     def close(self) -> None:
         if self._log is not None:
-            if self._mem:
-                self.flush()
-            self._log.close()
-            self._log = None
+            try:
+                if self._mem:
+                    self.flush()
+            finally:
+                # a failed final flush must still release the handle —
+                # the WAL already holds everything the flush would have
+                # written, so the next open recovers it
+                self._log.close()
+                self._log = None
         for t in self._state[0]:
             t.close()
 
 
+from ..node.faults import g_faults as _g_faults  # noqa: E402
 from ..telemetry import g_metrics as _g_metrics  # noqa: E402
+from ..utils.logging import log_printf  # noqa: E402
+
+_M_TORN_TAIL = _g_metrics.counter(
+    "nodexa_kvstore_torn_tail_total",
+    "WAL recoveries that truncated a torn/corrupt tail record")
 
 _g_metrics.counter_fn(
     "nodexa_kvstore_block_cache_hits_total",

@@ -126,6 +126,15 @@ def accept_to_memory_pool(
     LOCK(cs_main)); the staged path holds cs_main only for the snapshot
     and commit sections.
     """
+    from ..node.health import g_health
+
+    if not g_health.allow_mutations():
+        # safe mode / shutdown: the node must stop PRODUCING state it can
+        # no longer durably store — admission refuses up front, before
+        # any validation work or outpoint reservation
+        raise MempoolAcceptError(
+            "safe-mode", "transaction admission halted: node is in "
+            + g_health.mode_name() + " mode")
     if staged is None:
         staged = getattr(chainstate, "staged_mempool", True)
     path = "staged" if staged else "inline"

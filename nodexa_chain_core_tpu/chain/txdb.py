@@ -7,6 +7,7 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 from ..core.serialize import ByteReader, ByteWriter
+from ..node.health import guarded_io
 from ..primitives.block import AlgoSchedule, BlockHeader
 from .blockindex import BlockIndex, BlockStatus
 from .kvstore import KVStore, WriteBatch
@@ -55,7 +56,12 @@ class BlockTreeDB:
         return _IDX_PREFIX + block_hash.to_bytes(32, "little")
 
     def write_index(self, entries, positions: Dict[int, Tuple[int, int]]) -> None:
-        """entries: iterable of BlockIndex; positions: hash -> (data, undo)."""
+        """entries: iterable of BlockIndex; positions: hash -> (data, undo).
+
+        Losing index entries strands every block connected since the last
+        flush, so the batch commit runs through the health layer: bounded
+        retry on transient errors, safe-mode escalation otherwise (the
+        AbortNode analogue for the block-tree DB)."""
         batch = WriteBatch()
         for idx in entries:
             data_pos, undo_pos = positions.get(idx.block_hash, (-1, -1))
@@ -65,10 +71,12 @@ class BlockTreeDB:
             w = ByteWriter()
             d.serialize(w, self.schedule)
             batch.put(self._key(idx.block_hash), w.getvalue())
-        self.db.write_batch(batch)
+        guarded_io("txdb.write_index", lambda: self.db.write_batch(batch))
 
     def write_tip(self, block_hash: int) -> None:
-        self.db.put(_TIP_KEY, block_hash.to_bytes(32, "little"))
+        guarded_io(
+            "txdb.write_tip",
+            lambda: self.db.put(_TIP_KEY, block_hash.to_bytes(32, "little")))
 
     def read_tip(self) -> Optional[int]:
         raw = self.db.get(_TIP_KEY)

@@ -47,6 +47,7 @@ from ..consensus.params import DEPLOYMENT_ASSETS, DEPLOYMENT_ENFORCE_VALUE
 from ..core.uint256 import u256_hex
 from ..node.chainparams import NetworkParams
 from ..node.events import main_signals
+from ..node.health import NodeCriticalError, guarded_io
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import OutPoint, Transaction
 from ..script.interpreter import (
@@ -59,10 +60,16 @@ from ..script.script import Script
 from ..telemetry import g_metrics, span
 from ..utils.logging import LogFlags, log_print
 from .blockindex import BlockIndex, BlockStatus, Chain
-from .blockstore import BlockReadAhead, BlockStore, BlockUndo, TxUndo
+from .blockstore import (
+    BlockReadAhead,
+    BlockStore,
+    BlockUndo,
+    PrunedError,
+    TxUndo,
+)
 from .checkqueue import CheckQueue, CheckQueueControl
 from .coins import Coin, CoinsViewCache, CoinsViewDB
-from .kvstore import KVStore
+from .kvstore import KVError, KVStore
 from .txdb import BlockTreeDB
 
 MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60
@@ -425,15 +432,32 @@ class ChainState:
         validation.cpp:12564; -checklevel/-checkblocks).
 
         level 0: block data readable + identity hash matches the index
-        level 1: structural CheckBlock revalidation
-        level 2: undo journal readable/deserializable
-        level 3: coins-view round-trip — disconnect the window in a scratch
-                 view, then reconnect-by-undo-inverse consistency
-        Raises BlockValidationError on any failure.
+        level 1: structural CheckBlock revalidation + the coins DB sits
+                 exactly at the index tip (the ``_replay_blocks`` recovery
+                 point — a mismatch here means crash replay failed to
+                 converge and every further connect would corrupt)
+        level 2: undo journal readable + byte-exact re-serialization
+                 round-trip
+        level 3: coins-view round-trip — disconnect the whole window in a
+                 scratch view, then reconnect it forward again and require
+                 the reconnected view to land back on the tip (ref
+                 VerifyDB's check-level-4 reconnect pass, coins-only)
+        Raises BlockValidationError on any failure; the daemon turns that
+        into a refusal to start with a -reindex hint.
         """
-        idx = self.tip()
-        if idx is None:
+        tip = self.tip()
+        if tip is None:
             return
+        if check_level >= 1:
+            coins_best = self.coins.get_best_block()
+            if coins_best and coins_best != tip.block_hash:
+                raise BlockValidationError(
+                    "verifydb-coins-desync",
+                    f"coins view at {u256_hex(coins_best)[:16]} but the "
+                    f"block index tip is {u256_hex(tip.block_hash)[:16]} "
+                    f"h={tip.height} — crash replay did not converge",
+                )
+        idx: Optional[BlockIndex] = tip
         window: List[BlockIndex] = []
         while idx is not None and idx.height > 0 and len(window) < check_blocks:
             if not idx.status & BlockStatus.HAVE_DATA:
@@ -441,6 +465,7 @@ class ChainState:
             window.append(idx)
             idx = idx.prev
         scratch = CoinsViewCache(self.coins) if check_level >= 3 else None
+        swept: List[Tuple[BlockIndex, Block]] = []
         for i in window:
             try:
                 block = self.read_block(i)
@@ -463,7 +488,22 @@ class ChainState:
                         "verifydb-no-undo", u256_hex(i.block_hash)
                     )
                 try:
-                    undo = self.block_store.read_undo(upos)
+                    store = self.block_store
+                    if hasattr(store, "undos"):
+                        # raw record: the round-trip check below needs the
+                        # exact on-disk bytes, not just a parseable object
+                        raw = store.undos.read(upos)
+                        undo = BlockUndo.from_bytes(raw)
+                        if undo.to_bytes() != raw:
+                            raise BlockValidationError(
+                                "verifydb-undo-roundtrip",
+                                f"{u256_hex(i.block_hash)}: undo record "
+                                "does not re-serialize byte-exact",
+                            )
+                    else:
+                        undo = store.read_undo(upos)
+                except BlockValidationError:
+                    raise
                 except Exception as e:
                     raise BlockValidationError(
                         "verifydb-undo-read-failed",
@@ -479,6 +519,30 @@ class ChainState:
                         "verifydb-disconnect-failed",
                         f"{u256_hex(i.block_hash)}: {e}",
                     )
+                swept.append((i, block))
+        # level 3 second half: roll the disconnected window forward again
+        # (coins-only, like _roll_forward_block) — every input the chain
+        # claims to have spent must be present in the unwound view, and
+        # the reconnected view must land exactly back on the tip
+        if check_level >= 3 and swept:
+            for i, block in reversed(swept):  # ascending height
+                for tx in block.vtx:
+                    if not tx.is_coinbase():
+                        for txin in tx.vin:
+                            if scratch.get_coin(txin.prevout) is None:
+                                raise BlockValidationError(
+                                    "verifydb-reconnect-failed",
+                                    f"h={i.height}: missing input "
+                                    f"{txin.prevout} on reconnect",
+                                )
+                            scratch.spend_coin(txin.prevout)
+                    scratch.add_tx_outputs(tx, i.height)
+                scratch.set_best_block(i.block_hash)
+            if scratch.get_best_block() != tip.block_hash:
+                raise BlockValidationError(
+                    "verifydb-reconnect-failed",
+                    "reconnected view did not return to the tip",
+                )
         log_print(
             LogFlags.NONE,
             "verify_db: %d blocks checked at level %d",
@@ -1100,12 +1164,21 @@ class ChainState:
         the spent outpoints the worker pre-touched in the coins DB."""
         t0 = time.perf_counter()
         if block is None:
-            block = self.read_block(idx)
+            # a read failure here is the node's storage failing, never the
+            # block's fault: escalate instead of invalidating the block
+            # ("no-data"/PrunedError keep their candidate-drop semantics)
+            block = guarded_io(
+                "blockstore.read_block", lambda: self.read_block(idx),
+                chainstate=self,
+                passthrough=(BlockValidationError, PrunedError),
+            )
         t_read = time.perf_counter()
         view = CoinsViewCache(self.coins)
         undo = self.connect_block(block, idx, view)
         t_connect = time.perf_counter()
-        upos = self.block_store.write_undo(undo)
+        upos = guarded_io(
+            "blockstore.write_undo",
+            lambda: self.block_store.write_undo(undo), chainstate=self)
         dpos, _ = self.positions[idx.block_hash]
         self.positions[idx.block_hash] = (dpos, upos)
         idx.status |= BlockStatus.HAVE_UNDO
@@ -1586,7 +1659,11 @@ class ChainState:
             )
             self.contextual_check_block(block, prev)
         idx = self._add_to_block_index(block.header)
-        pos = self.block_store.write_block(block, self.params.algo_schedule)
+        pos = guarded_io(
+            "blockstore.write_block",
+            lambda: self.block_store.write_block(
+                block, self.params.algo_schedule),
+            chainstate=self)
         self.positions[idx.block_hash] = (pos, -1)
         idx.status |= BlockStatus.HAVE_DATA
         self._received_block_data(idx)
@@ -1695,17 +1772,29 @@ class ChainState:
         same kvstore batch so both always reflect the same best block —
         replay then re-applies or undoes them together from that point).
         ``drop_cache`` empties the cache (size pressure); the default
-        sync keeps the warm working set."""
+        sync keeps the warm working set.
+
+        The commit runs through the health layer: transient errors are
+        retried with backoff, anything persistent escalates to safe mode
+        and raises :class:`NodeCriticalError` — a failed coins flush must
+        never be mistaken for chain invalidity or silently dropped (the
+        deferral window it guards can hold hours of IBD)."""
         t0 = time.perf_counter()
         from ..core.serialize import ByteWriter as _BW
+        from ..node.faults import g_faults
 
-        w = _BW()
-        self.assets.serialize(w)
-        self.coins_db.pending_extra[b"A"] = w.getvalue()
-        if drop_cache:
-            self.coins.flush()
-        else:
-            self.coins.sync()
+        def _commit() -> None:
+            if g_faults.enabled:
+                g_faults.check("chainstate.coins_flush")
+            w = _BW()
+            self.assets.serialize(w)
+            self.coins_db.pending_extra[b"A"] = w.getvalue()
+            if drop_cache:
+                self.coins.flush()
+            else:
+                self.coins.sync()
+
+        guarded_io("chainstate.coins_flush", _commit, chainstate=self)
         self._last_coins_write = time.monotonic()
         _M_COINS_FLUSH.observe(
             time.perf_counter() - t0,
@@ -1713,12 +1802,26 @@ class ChainState:
         )
 
     def close(self) -> None:
-        self.flush_state_to_disk()
+        """Shutdown flush + store teardown.  Stays clean when the disk is
+        the thing that failed: a persisting critical error must not turn
+        an orderly shutdown into a crash — whatever could not be flushed
+        is healed by ``_replay_blocks`` on the next start."""
+        try:
+            self.flush_state_to_disk()
+        except (NodeCriticalError, OSError, KVError) as e:
+            log_print(
+                LogFlags.NONE,
+                "close: final flush failed (%r); shutting down anyway — "
+                "restart will replay from the last good state", e,
+            )
         if self.checkqueue:
             self.checkqueue.stop()
-        self._chainstate_db.close()
-        self._blocktree_db.close()
-        self.block_store.close()
+        for closer in (self._chainstate_db.close, self._blocktree_db.close,
+                       self.block_store.close):
+            try:
+                closer()
+            except (NodeCriticalError, OSError, KVError) as e:
+                log_print(LogFlags.NONE, "close: store close failed: %r", e)
 
 
 def _script_check(tx: Transaction, in_idx: int, coin: Coin, flags: int,

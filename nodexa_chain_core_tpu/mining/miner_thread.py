@@ -182,6 +182,14 @@ class BackgroundMiner:
         spk = None  # resolved once; the mining key is stable
         while not self._stop.is_set():
             try:
+                # safe mode: stop producing blocks immediately, even
+                # before the health layer's async stop() lands (that join
+                # can lag behind a cs_main holder)
+                from ..node.health import g_health
+
+                if not g_health.allow_mutations():
+                    time.sleep(0.5)
+                    continue
                 if params.mining_requires_peers and (
                     node.connman is None
                     or node.connman.connection_count() == 0

@@ -9,6 +9,7 @@ from typing import List
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
 from ..chain.validation import BlockValidationError
+from ..node.health import NodeCriticalError
 from ..core.serialize import ByteReader, ByteWriter
 from ..core.uint256 import u256_hex
 from ..primitives.block import Block, BlockHeader
@@ -182,6 +183,11 @@ class NetProcessor:
                 touched.append(peer)
             try:
                 self.process_message(peer, command, payload)
+            except NodeCriticalError as e:
+                # OUR storage failed, not the peer: never score it
+                log_print(LogFlags.NET,
+                          "node critical error processing %s from peer %d "
+                          "(not misbehavior): %r", command, peer.id, e)
             except Exception as e:  # noqa: BLE001 — peer input is untrusted
                 log_print(LogFlags.NET, "error processing %s from peer %d: %r",
                           command, peer.id, e)
@@ -535,6 +541,14 @@ class NetProcessor:
         old_tip = cs.tip().block_hash
         try:
             cs.process_new_block(block)
+        except NodeCriticalError as e:
+            # the node's own disk failed mid-accept (safe-mode escalation
+            # already ran inside the chainstate): the peer did nothing
+            # wrong, and the block can be re-fetched after recovery
+            log_print(LogFlags.NET,
+                      "dropping block %s from peer %d: %r",
+                      u256_hex(h)[:16], peer.id, e)
+            return False
         except BlockValidationError as e:
             if e.code in ("prev-blk-not-found",):
                 self._send_getheaders(peer)
@@ -612,9 +626,18 @@ class NetProcessor:
                     if self.orphanage.add(tx, peer.id):
                         self._request_parents(peer, tx)
                     continue
-                if e.code in ("txn-already-in-mempool", "txn-mempool-conflict"):
+                if e.code in ("txn-already-in-mempool", "txn-mempool-conflict",
+                              "safe-mode"):
+                    # safe-mode: admission is halted node-side; relayed
+                    # txs are NOT peer misbehavior (scoring them would
+                    # ban the whole peer set while degraded)
                     continue
                 self.misbehaving(peer, 10, f"bad-tx:{e.code}")
+                continue
+            except NodeCriticalError as e:
+                log_print(LogFlags.NET,
+                          "dropping tx %064x from peer %d on node critical "
+                          "error (not misbehavior): %r", tx.txid, peer.id, e)
                 continue
             except Exception as e:  # noqa: BLE001 — peer input is untrusted
                 # one tx blowing up must not discard the rest of the

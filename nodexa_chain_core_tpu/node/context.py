@@ -62,9 +62,18 @@ class NodeContext:
         return self._stop_requested
 
     def shutdown(self) -> None:
-        """ref init.cpp Shutdown()."""
+        """ref init.cpp Shutdown().  Must complete cleanly even when the
+        node is shutting down BECAUSE its disk failed: every flush below
+        is tolerant of the persisting fault (losing the un-flushable tail
+        is exactly what crash replay heals on the next start)."""
         from ..node.events import main_signals
+        from ..node.health import g_health
 
+        g_health.note_shutdown()
+        # an in-flight safe-mode escalation may still be stopping the
+        # miner/pool on its own thread; let it finish so the stop()s
+        # below don't race it
+        g_health.join_halt()
         self.scheduler.stop()
         miner = getattr(self, "background_miner", None)
         if miner is not None:
@@ -103,8 +112,14 @@ class NodeContext:
                 fee_estimator.write_file(fee_path)
             except OSError:
                 pass
-        self.message_store.flush()
-        self.rewards.flush()
+        from ..chain.kvstore import KVError
+        from ..node.health import NodeCriticalError
+
+        for flusher in (self.message_store.flush, self.rewards.flush):
+            try:
+                flusher()
+            except (NodeCriticalError, KVError, OSError):
+                pass  # the failing disk must not abort the rest
         main_signals.unregister(self.message_store)
         main_signals.unregister(self.rewards)
         for attr in ("pub_server", "shell_notifier"):
@@ -112,5 +127,8 @@ class NodeContext:
             if obj is not None:
                 obj.close()
         if self.wallet is not None:
-            self.wallet.flush()
+            try:
+                self.wallet.flush()
+            except (NodeCriticalError, KVError, OSError):
+                pass
         self.chainstate.close()

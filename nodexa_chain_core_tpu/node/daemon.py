@@ -43,6 +43,19 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     set_spans_enabled(g_args.get_bool("telemetryspans", True))
 
+    # -faultinject=<site>:<spec> (repeatable): arm deterministic faults
+    # BEFORE any store opens so chainstate-load choke points are covered
+    # too.  Unknown sites are a hard startup error — a typo must not
+    # silently arm nothing (tests also arm via NODEXA_FAULTINJECT env).
+    from .faults import g_faults
+    from .health import g_health
+
+    for spec in g_args.get_all("faultinject"):
+        try:
+            g_faults.arm_from_string(spec)
+        except ValueError as e:
+            raise SystemExit(f"Error: -faultinject: {e}")
+
     reindexing = g_args.get_bool("reindex")
     # -prune parameter interaction is validated BEFORE the -reindex wipe so
     # a rejected configuration never destroys the derived databases
@@ -67,21 +80,44 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             shutil.rmtree(os.path.join(datadir, sub), ignore_errors=True)
         log_printf("-reindex: wiped chainstate and block index")
 
-    # Steps 4-7: chainstate load (ref init.cpp:1497)
-    node = NodeContext(
-        network=network,
-        datadir=datadir,
-        script_check_threads=g_args.get_int("par", 0),
-        # debug/test knob: small chunks let functional prune tests run on
-        # short chains (ref feature_pruning.py's large-block approach)
-        block_chunk_bytes=g_args.get_int("blockchunksize", 16 * 1024 * 1024),
-        # -dbcache=<MiB>: persistent coins-cache budget; coins hit disk
-        # only on size pressure, the periodic interval, or shutdown (ref
-        # init.cpp -dbcache / nCoinCacheUsage)
-        dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
-        coins_flush_interval_s=float(
-            g_args.get_int("dbcacheinterval", 300)),
-    )
+    # Steps 4-7: chainstate load (ref init.cpp:1497).  A crash-replay
+    # failure here means the stores disagree in a way _replay_blocks
+    # cannot heal — refuse to run on it rather than corrupt further.
+    from ..chain.validation import BlockValidationError
+    from .health import NodeCriticalError
+
+    try:
+        node = NodeContext(
+            network=network,
+            datadir=datadir,
+            script_check_threads=g_args.get_int("par", 0),
+            # debug/test knob: small chunks let functional prune tests run
+            # on short chains (ref feature_pruning.py's large-block
+            # approach)
+            block_chunk_bytes=g_args.get_int(
+                "blockchunksize", 16 * 1024 * 1024),
+            # -dbcache=<MiB>: persistent coins-cache budget; coins hit disk
+            # only on size pressure, the periodic interval, or shutdown
+            # (ref init.cpp -dbcache / nCoinCacheUsage)
+            dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
+            coins_flush_interval_s=float(
+                g_args.get_int("dbcacheinterval", 300)),
+        )
+    except BlockValidationError as e:
+        raise SystemExit(
+            f"Error: chainstate load failed: {e}. The databases are "
+            "inconsistent beyond crash replay; restart with -reindex to "
+            "rebuild the chain state from the block files."
+        )
+    except NodeCriticalError as e:
+        # disk/DB failure before there is a node to degrade: there is no
+        # safe mode to fall into at init — refuse to run, cleanly
+        raise SystemExit(
+            f"Error: disk or database failure during chainstate load: {e}. "
+            "Fix the underlying storage problem and restart."
+        )
+    # give safe-mode escalation a node whose miner/pool it can halt
+    g_health.attach_node(node)
     cq = node.chainstate.checkqueue
     log_printf(
         "script verification: %s; coins cache: %d MiB budget",
@@ -146,11 +182,26 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     if g_args.is_set("assumevalid"):
         node.chainstate.assume_valid_hash = int(g_args.get("assumevalid"), 16)
 
-    # Step 7b: CVerifyDB-style startup sanity sweep (ref validation.cpp:12564)
+    # Step 7b: CVerifyDB-style startup sanity sweep (ref validation.cpp:
+    # 12564).  A failure is a refusal to start: serving (or extending) a
+    # chain whose recent blocks don't round-trip corrupts further — the
+    # operator gets the verdict on getnodehealth after a -checkblocks=0
+    # boot, and the fix is a -reindex rebuild.
     check_blocks = g_args.get_int("checkblocks", 6)
     check_level = g_args.get_int("checklevel", 3)
     if check_blocks > 0:
-        node.chainstate.verify_db(check_level=check_level, check_blocks=check_blocks)
+        try:
+            node.chainstate.verify_db(
+                check_level=check_level, check_blocks=check_blocks)
+        except BlockValidationError as e:
+            g_health.record_selfcheck(
+                check_level, check_blocks, ok=False, error=str(e))
+            raise SystemExit(
+                f"Error: startup self-check failed: {e}. The chainstate "
+                "appears corrupted; restart with -reindex to rebuild it "
+                "from the block files."
+            )
+        g_health.record_selfcheck(check_level, check_blocks, ok=True)
     node.scheduler.start()
     # periodic flusher defers to the -dbcache policy: index/tip every
     # pass, coins only on size pressure or -dbcacheinterval expiry
@@ -435,7 +486,7 @@ def main(argv=None) -> int:
         rpc.stop()
         node.shutdown()
         log_printf("shutdown complete")
-    return 0
+    return 0  # clean exit even out of safe mode (the disk already failed)
 
 
 if __name__ == "__main__":

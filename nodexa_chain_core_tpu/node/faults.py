@@ -1,0 +1,249 @@
+"""Deterministic fault injection for the node's I/O choke points.
+
+The reference hardens its disk paths with AbortNode + -checkblocks but
+has no first-class way to *provoke* those paths; its crash tests
+(feature_dbcrash.py) rely on timing-dependent external kills.  This
+registry makes every interesting failure reproducible: a **site** is a
+named point in real I/O code (WAL append, undo write, coins flush, pool
+socket send, ...) that consults the registry; an armed **spec** tells
+the site to raise ``OSError``/``KVError``, return torn/short data, or
+hard-kill the process — deterministically, on the N-th hit.
+
+Arming:
+
+- ``-faultinject=<site>:<spec>`` daemon flag (repeatable), or
+- ``NODEXA_FAULTINJECT="<site>:<spec>[;<site>:<spec>...]"`` env var
+  (picked up by any process that constructs a chainstate — the crash
+  matrix test's subprocess drivers), or
+- ``g_faults.arm_from_string(...)`` directly from in-process tests.
+
+Spec grammar — comma-separated fields after the ``site:`` prefix:
+
+- ``raise``            raise OSError(EIO)  (the default mode)
+- ``errno=ENOSPC``     raise OSError with that errno (name or number)
+- ``kverror``          raise chain.kvstore.KVError
+- ``torn=<n>``         read sites: truncate the returned data to n bytes
+- ``kill`` / ``kill@<n>``  os._exit(137); with ``@n`` and a write site
+                       that supports it, first write n payload bytes
+                       (a torn record, exactly what a mid-write power
+                       cut leaves)
+- ``after=<n>``        skip the first n hits of the site (default 0)
+- ``count=<n>``        trigger at most n times; -1 = every hit
+                       (default 1)
+- ``transient``        mark the raised error transient — the health
+                       layer's bounded retry path will retry it
+
+Every trigger increments ``nodexa_fault_injections_total{site=...}`` in
+the node-wide telemetry registry, so tests and operators can see what
+actually fired.
+
+Hot-path cost when nothing is armed: one attribute read + one branch
+(``g_faults.enabled`` stays False until the first ``arm``).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+
+# Every site threaded through the tree, with a flag marking the ones a
+# block-import (IBD) run exercises — the crash-recovery matrix test
+# iterates exactly those.  Arming an unknown site is a hard error so a
+# typo in a test or -faultinject flag can't silently arm nothing.
+KNOWN_SITES: Dict[str, dict] = {
+    "kvstore.wal_append":   {"ibd": True,  "help": "KVStore WAL batch append"},
+    "kvstore.wal_fsync":    {"ibd": False, "help": "KVStore WAL fsync (sync batches)"},
+    "kvstore.segment_write": {"ibd": True, "help": "KVStore memtable -> L0 segment flush"},
+    "kvstore.compact":      {"ibd": False, "help": "KVStore major compaction"},
+    "blockstore.blk.append": {"ibd": True, "help": "block data record append"},
+    "blockstore.blk.read":  {"ibd": True,  "help": "block data record read"},
+    "blockstore.blk.sync":  {"ibd": False, "help": "block data fsync"},
+    "blockstore.rev.append": {"ibd": True, "help": "undo record append"},
+    "blockstore.rev.read":  {"ibd": False, "help": "undo record read"},
+    "blockstore.rev.sync":  {"ibd": False, "help": "undo fsync"},
+    "chainstate.coins_flush": {"ibd": True, "help": "coins+assets cache disk flush"},
+    "pool.socket_send":     {"ibd": False, "help": "stratum session socket send"},
+}
+
+KILL_EXIT_CODE = 137  # what a SIGKILLed process reports; greppable in CI
+
+_M_INJECT = g_metrics.counter(
+    "nodexa_fault_injections_total",
+    "Deterministic fault-injection triggers, labeled by site")
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    mode: str = "raise"          # raise | kverror | torn | kill
+    err: int = _errno.EIO
+    after: int = 0
+    count: int = 1               # -1 = unlimited
+    offset: Optional[int] = None  # kill@<n> partial-write / torn=<n> length
+    transient: bool = False
+    hits: int = field(default=0, compare=False)
+    triggers: int = field(default=0, compare=False)
+
+    def should_fire(self) -> bool:
+        """Count one hit; True iff this hit is inside the armed window."""
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.count >= 0 and self.triggers >= self.count:
+            return False
+        self.triggers += 1
+        return True
+
+
+def parse_spec(text: str) -> FaultSpec:
+    """``site:field[,field...]`` -> FaultSpec (see module docstring)."""
+    if ":" not in text:
+        raise ValueError(f"fault spec {text!r}: expected <site>:<spec>")
+    site, body = text.split(":", 1)
+    site = site.strip()
+    if site not in KNOWN_SITES:
+        raise ValueError(
+            f"unknown fault site {site!r} (known: {', '.join(sorted(KNOWN_SITES))})")
+    spec = FaultSpec(site=site)
+    for raw in body.split(","):
+        f = raw.strip()
+        if not f:
+            continue
+        if f == "raise":
+            spec.mode = "raise"
+        elif f == "kverror":
+            spec.mode = "kverror"
+        elif f == "transient":
+            spec.transient = True
+        elif f.startswith("errno="):
+            spec.mode = "raise"
+            v = f[6:]
+            spec.err = getattr(_errno, v) if v.isalpha() else int(v)
+        elif f.startswith("torn="):
+            spec.mode = "torn"
+            spec.offset = int(f[5:])
+        elif f == "kill" or f.startswith("kill@"):
+            spec.mode = "kill"
+            if f.startswith("kill@"):
+                spec.offset = int(f[5:])
+        elif f.startswith("after="):
+            spec.after = int(f[6:])
+        elif f.startswith("count="):
+            spec.count = int(f[6:])
+        else:
+            raise ValueError(f"fault spec {text!r}: unknown field {f!r}")
+    return spec
+
+
+class FaultRegistry:
+    """site -> armed FaultSpec; shared by every store in the process."""
+
+    def __init__(self) -> None:
+        self.enabled = False  # fast-path gate, read without the lock
+        self._specs: Dict[str, FaultSpec] = {}
+        self._lock = threading.Lock()
+
+    # -- arming -----------------------------------------------------------
+
+    def arm(self, spec: FaultSpec) -> None:
+        with self._lock:
+            self._specs[spec.site] = spec
+            self.enabled = True
+        log_printf("faultinject: armed %s mode=%s after=%d count=%d",
+                   spec.site, spec.mode, spec.after, spec.count)
+
+    def arm_from_string(self, text: str) -> FaultSpec:
+        spec = parse_spec(text)
+        self.arm(spec)
+        return spec
+
+    def arm_from_env(self, var: str = "NODEXA_FAULTINJECT") -> int:
+        """Arm every ``;``-separated spec in the env var; returns count."""
+        raw = os.environ.get(var, "")
+        n = 0
+        for part in raw.split(";"):
+            if part.strip():
+                self.arm_from_string(part)
+                n += 1
+        return n
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            self._specs.clear()
+            self.enabled = False
+
+    def injection_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {s.site: s.triggers for s in self._specs.values()}
+
+    # -- the site-facing surface ------------------------------------------
+
+    def _fire(self, site: str) -> Optional[FaultSpec]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            spec = self._specs.get(site)
+            if spec is None or not spec.should_fire():
+                return None
+        _M_INJECT.inc(site=site)
+        log_printf("faultinject: firing %s (%s, trigger %d)",
+                   site, spec.mode, spec.triggers)
+        return spec
+
+    def _raise(self, spec: FaultSpec) -> None:
+        if spec.mode == "kverror":
+            from ..chain.kvstore import KVError
+
+            e: Exception = KVError(f"injected fault at {spec.site}")
+        else:
+            e = OSError(spec.err, os.strerror(spec.err)
+                        + f" [injected at {spec.site}]")
+        e.fault_injected = True  # type: ignore[attr-defined]
+        e.transient = spec.transient  # type: ignore[attr-defined]
+        raise e
+
+    def check(self, site: str, torn_file=None, torn_data: bytes = b"") -> None:
+        """Write-site hook.  Raises for raise/kverror specs; ``kill``
+        exits the process — with ``kill@<n>`` and a (file, record) pair,
+        the first ``n`` record bytes are written and flushed first, so
+        the on-disk state is exactly a mid-write power cut's."""
+        spec = self._fire(site)
+        if spec is None or spec.mode == "torn":
+            return
+        if spec.mode == "kill":
+            if spec.offset is not None and torn_file is not None and torn_data:
+                try:
+                    torn_file.write(torn_data[: spec.offset])
+                    torn_file.flush()
+                    os.fsync(torn_file.fileno())
+                except OSError:
+                    pass  # dying anyway; best-effort torn tail
+            os._exit(KILL_EXIT_CODE)
+        self._raise(spec)
+
+    def filter_read(self, site: str, data: bytes) -> bytes:
+        """Read-site hook: raise/kill like :meth:`check`, or return a
+        torn (truncated) copy of ``data`` for ``torn=<n>`` specs."""
+        spec = self._fire(site)
+        if spec is None:
+            return data
+        if spec.mode == "torn":
+            return data[: (spec.offset or 0)]
+        if spec.mode == "kill":
+            os._exit(KILL_EXIT_CODE)
+        self._raise(spec)
+        return data  # unreachable; keeps type checkers honest
+
+
+g_faults = FaultRegistry()
+
+# Subprocess test drivers arm through the environment before any store
+# opens; a plain process with nothing set pays one getenv at import.
+if os.environ.get("NODEXA_FAULTINJECT"):
+    g_faults.arm_from_env()

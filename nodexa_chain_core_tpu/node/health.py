@@ -1,0 +1,273 @@
+"""Node health: the AbortNode analogue, graded down to safe mode.
+
+The reference answers an unrecoverable disk/DB error with ``AbortNode``
+— log, flag, shut everything down.  This node degrades instead of
+dying: a critical error at any disk touchpoint (kvstore WAL, block or
+undo append, coins/assets flush, block-tree index write) flips the node
+into **safe mode**:
+
+- block/share/transaction *producers* stop — the built-in miner, the
+  stratum pool, and mempool admission all refuse new work;
+- mutating RPCs refuse with the structured safe-mode error
+  (``rpc.safemode``); read-only RPC and ``GET /metrics`` stay up so an
+  operator can see what happened;
+- a best-effort flush-to-safe-point writes whatever still can be
+  written (dirty block index + tip; never the path that just failed);
+- shutdown stays clean — ``ChainState.close`` tolerates the persisting
+  fault instead of crashing out of the flush.
+
+Transient errors (EINTR/EAGAIN, or injected faults marked
+``transient``) get a bounded retry-with-backoff via
+:func:`NodeHealth.run_with_retries` before any of that escalation.
+
+``g_health`` is process-global like ``g_metrics``: storage layers report
+into it without needing a node handle; the daemon attaches its
+``NodeContext`` so escalation can actually stop the miner/pool.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+
+MODE_NORMAL = 0
+MODE_SAFE = 1
+MODE_SHUTDOWN = 2
+
+_MODE_NAMES = {MODE_NORMAL: "normal", MODE_SAFE: "safe",
+               MODE_SHUTDOWN: "shutting-down"}
+
+_TRANSIENT_ERRNOS = (_errno.EINTR, _errno.EAGAIN, _errno.EBUSY)
+
+_M_CRITICAL = g_metrics.counter(
+    "nodexa_critical_errors_total",
+    "Critical I/O errors reported to the health layer, by source")
+_M_RETRIES = g_metrics.counter(
+    "nodexa_io_retries_total",
+    "Transient I/O errors retried before succeeding or escalating")
+
+
+class NodeCriticalError(RuntimeError):
+    """Raised (after safe-mode escalation) out of a disk touchpoint so
+    callers distinguish "the node's storage failed" from "this block/tx
+    is invalid" — it must NEVER be treated as block invalidity."""
+
+    def __init__(self, source: str, cause: BaseException):
+        super().__init__(f"critical error at {source}: {cause!r}")
+        self.source = source
+        self.cause = cause
+
+
+def is_transient(exc: BaseException) -> bool:
+    if getattr(exc, "transient", False):
+        return True
+    return isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
+
+
+def guarded_io(source: str, fn: Callable, chainstate=None, attempts: int = 3,
+               passthrough: tuple = ()):
+    """Run one disk touchpoint through the health layer: transient errors
+    get the bounded retry, anything else escalates to safe mode and
+    surfaces as :class:`NodeCriticalError` (never as block/tx invalidity).
+    ``passthrough`` exceptions (e.g. BlockValidationError from a wrapped
+    read helper) propagate untouched."""
+    try:
+        return g_health.run_with_retries(fn, source, attempts=attempts)
+    except NodeCriticalError:
+        raise
+    except passthrough:
+        raise
+    except Exception as e:  # noqa: BLE001 — the escalation boundary
+        g_health.critical_error(source, e, chainstate=chainstate)
+        raise NodeCriticalError(source, e) from e
+
+
+class NodeHealth:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.mode = MODE_NORMAL
+        self.last_error: Optional[dict] = None
+        self.retry_counts: Dict[str, int] = {}
+        self.error_counts: Dict[str, int] = {}
+        self.selfcheck: dict = {"result": "not-run"}
+        self._node = None
+        self._halt_thread: Optional[threading.Thread] = None
+
+    # -- wiring -----------------------------------------------------------
+
+    def attach_node(self, node) -> None:
+        """Give escalation a NodeContext whose miner/pool it can stop."""
+        with self._lock:
+            self._node = node
+
+    def reset_for_tests(self) -> None:
+        from ..rpc.safemode import clear_safe_mode
+
+        self.join_halt()
+        with self._lock:
+            self.mode = MODE_NORMAL
+            self.last_error = None
+            self.retry_counts.clear()
+            self.error_counts.clear()
+            self.selfcheck = {"result": "not-run"}
+            self._node = None
+        clear_safe_mode()
+
+    # -- queries ----------------------------------------------------------
+
+    def mode_name(self) -> str:
+        return _MODE_NAMES[self.mode]
+
+    def allow_mutations(self) -> bool:
+        """False once the node left normal operation: mining, pool share
+        acceptance, and mempool admission key off this."""
+        return self.mode == MODE_NORMAL
+
+    def snapshot(self) -> dict:
+        from .faults import g_faults
+
+        with self._lock:
+            return {
+                "mode": self.mode_name(),
+                "last_critical_error": dict(self.last_error)
+                if self.last_error else None,
+                "critical_errors": dict(self.error_counts),
+                "io_retries": dict(self.retry_counts),
+                "selfcheck": dict(self.selfcheck),
+                "fault_injections": g_faults.injection_counts(),
+            }
+
+    # -- startup self-check record ----------------------------------------
+
+    def record_selfcheck(self, level: int, blocks: int,
+                         ok: bool, error: str = "") -> None:
+        with self._lock:
+            self.selfcheck = {
+                "result": "passed" if ok else "failed",
+                "level": level,
+                "blocks": blocks,
+            }
+            if error:
+                self.selfcheck["error"] = error
+
+    # -- shutdown ----------------------------------------------------------
+
+    def note_shutdown(self) -> None:
+        with self._lock:
+            if self.mode != MODE_SHUTDOWN:
+                self.mode = MODE_SHUTDOWN
+
+    # -- bounded retry ----------------------------------------------------
+
+    def run_with_retries(self, fn: Callable[[], None], source: str,
+                         attempts: int = 3, base_delay: float = 0.05):
+        """Run ``fn``; transient failures retry with doubling backoff up
+        to ``attempts`` total tries, then the last error propagates for
+        the caller to escalate.  Non-transient errors propagate at once."""
+        delay = base_delay
+        for i in range(attempts):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — transiency-filtered below
+                if not is_transient(e) or i == attempts - 1:
+                    raise
+                with self._lock:
+                    self.retry_counts[source] = (
+                        self.retry_counts.get(source, 0) + 1)
+                _M_RETRIES.inc(source=source)
+                log_printf("health: transient error at %s (%r), retry %d/%d "
+                           "in %.0fms", source, e, i + 1, attempts - 1,
+                           delay * 1e3)
+                time.sleep(delay)
+                delay *= 2
+
+    # -- escalation -------------------------------------------------------
+
+    def critical_error(self, source: str, exc: BaseException,
+                       chainstate=None) -> None:
+        """The AbortNode analogue.  Records the error; on the FIRST call
+        flips safe mode, halts producers (asynchronously — stop() joins
+        worker threads that may be blocked on cs_main, which this thread
+        can hold), and runs a best-effort flush-to-safe-point.  Never
+        raises: the caller decides what to propagate."""
+        first = False
+        with self._lock:
+            self.error_counts[source] = self.error_counts.get(source, 0) + 1
+            self.last_error = {
+                "source": source,
+                "error": repr(exc),
+                "time": int(time.time()),
+            }
+            if self.mode == MODE_NORMAL:
+                self.mode = MODE_SAFE
+                first = True
+            node = self._node
+        _M_CRITICAL.inc(source=source)
+        log_printf("CRITICAL: %s failed: %r%s", source, exc,
+                   " — entering safe mode" if first else "")
+        if not first:
+            return
+        from ..rpc.safemode import set_safe_mode
+
+        set_safe_mode(f"critical error at {source}: {exc}")
+        self._flush_safe_point(chainstate)
+        t = threading.Thread(
+            target=self._halt_producers, args=(node,),
+            name="health-halt", daemon=True)
+        self._halt_thread = t
+        t.start()
+
+    def _flush_safe_point(self, chainstate) -> None:
+        """Write what still can be written — dirty index entries + tip —
+        so restart replay starts from the freshest recoverable point.
+        Every step is best-effort: the disk just failed."""
+        if chainstate is None:
+            node = self._node
+            chainstate = getattr(node, "chainstate", None) if node else None
+        if chainstate is None:
+            return
+        try:
+            if chainstate._dirty_index:
+                chainstate.blocktree.write_index(
+                    tuple(chainstate._dirty_index), chainstate.positions)
+                chainstate._dirty_index.clear()
+            tip = chainstate.tip()
+            if tip is not None:
+                chainstate.blocktree.write_tip(tip.block_hash)
+            chainstate.block_store.sync()
+            log_printf("health: flush-to-safe-point complete (tip h=%d)",
+                       tip.height if tip else -1)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            log_printf("health: flush-to-safe-point incomplete: %r", e)
+
+    def _halt_producers(self, node) -> None:
+        if node is None:
+            return
+        for attr in ("background_miner", "pool_server"):
+            obj = getattr(node, attr, None)
+            if obj is None:
+                continue
+            try:
+                obj.stop()
+                log_printf("health: stopped %s (safe mode)", attr)
+            except Exception as e:  # noqa: BLE001 — halt the rest anyway
+                log_printf("health: stopping %s failed: %r", attr, e)
+
+    def join_halt(self, timeout: float = 10.0) -> None:
+        t = self._halt_thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._halt_thread = None
+
+
+g_health = NodeHealth()
+
+g_metrics.gauge_fn(
+    "nodexa_node_health",
+    "Node health mode (0=normal, 1=safe mode, 2=shutting down)",
+    lambda: float(g_health.mode))

@@ -30,6 +30,8 @@ import time
 from typing import Dict, Optional
 
 from ..core.uint256 import u256_hex
+from ..node.faults import g_faults
+from ..node.health import g_health
 from ..telemetry import g_metrics
 from ..utils.logging import log_printf
 from . import shares as sh
@@ -160,6 +162,8 @@ class StratumSession:
 
     def _flush_locked(self) -> bool:
         try:
+            if g_faults.enabled:
+                g_faults.check("pool.socket_send")
             while self._out:
                 n = self.sock.send(self._out)
                 if n <= 0:
@@ -485,6 +489,12 @@ class StratumServer:
     def _on_submit(self, sess: StratumSession, req_id, params) -> None:
         if not sess.subscribed:
             sess.reply_error(req_id, sh.E_NOT_SUBSCRIBED, "not subscribed")
+            return
+        if not g_health.allow_mutations():
+            # safe mode: share production stops (the health layer is also
+            # stopping this server asynchronously) — no misbehavior score,
+            # the miner did nothing wrong
+            sess.reply_error(req_id, sh.E_OTHER, "node in safe mode")
             return
         # [worker, job_id, nonce, mix] or the wider GPU-miner shape
         # [worker, job_id, nonce, header_hash, mix]

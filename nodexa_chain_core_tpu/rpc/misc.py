@@ -145,6 +145,18 @@ def getmetrics(node, params: List[Any]):
     return {"metrics": snap}
 
 
+def getnodehealth(node, params: List[Any]):
+    """Node fault-tolerance surface: operating mode (normal / safe /
+    shutting-down), the last critical error, per-source critical-error
+    and transient-retry counters, the startup self-check verdict, and any
+    armed fault-injection trigger counts.  Deliberately NOT a mutating
+    command — it must answer while the node sits in safe mode (the same
+    state rides the ``nodexa_node_health`` gauge for scrapes)."""
+    from ..node.health import g_health
+
+    return g_health.snapshot()
+
+
 def getnetworkinfo(node, params: List[Any]):
     # p2pkh dust threshold in COIN units, derived from the live policy
     # (chain/policy.py is_dust) so UI clients never hardcode it
@@ -281,6 +293,7 @@ def register(table: RPCTable) -> None:
          ["privkey", "message"]),
         ("control", "getmemoryinfo", getmemoryinfo, []),
         ("control", "getmetrics", getmetrics, ["filter"]),
+        ("control", "getnodehealth", getnodehealth, []),
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),
         ("network", "getconnectioncount", getconnectioncount, []),

@@ -33,6 +33,64 @@ def observe_safe_mode() -> None:
         )
 
 
+# Every RPC that MUTATES node, chain, or wallet state.  When the health
+# layer flips safe mode (a critical disk/DB error), these refuse with the
+# structured safe-mode error at the dispatch table — read-only RPC and
+# GET /metrics stay up so an operator can diagnose.  Broader than the
+# per-handler observe_safe_mode calls (which guard value-moving wallet
+# paths even for the legacy fork-warning safe mode): a node that can no
+# longer persist state must not grow any.
+MUTATING_COMMANDS = frozenset({
+    # chain steering + block production
+    "generate", "generatetoaddress", "generatetoaddresstpu", "setgenerate",
+    "submitblock", "pprpcsb", "invalidateblock", "reconsiderblock",
+    "preciousblock", "pruneblockchain",
+    # mempool mutation
+    "sendrawtransaction", "clearmempool", "savemempool",
+    "prioritisetransaction",
+    # wallet value movement + key management
+    "sendtoaddress", "sendmany", "sendfrom", "sendfromaddress", "move",
+    "bumpfee", "abandontransaction", "fundrawtransaction",
+    "importprivkey", "importaddress", "importpubkey", "importwallet",
+    "importmulti", "importprunedfunds", "removeprunedfunds",
+    "encryptwallet", "keypoolrefill", "settxfee",
+    "resendwallettransactions",
+    # asset issuance / transfer / restriction management
+    "issue", "issueunique", "issuerestrictedasset", "issuequalifierasset",
+    "reissue", "reissuerestrictedasset", "transfer", "transferfromaddress",
+    "transferfromaddresses", "addtagtoaddress", "removetagfromaddress",
+    "freezeaddress", "unfreezeaddress", "freezerestrictedasset",
+    "unfreezerestrictedasset", "distributereward",
+    # messaging + snapshots
+    "sendmessage", "subscribetochannel", "unsubscribefromchannel",
+    "clearmessages", "requestsnapshot", "cancelsnapshotrequest",
+    "purgesnapshot",
+})
+
+
+def reject_if_locked_down(method: str) -> None:
+    """Dispatch-table gate: refuse mutating RPCs while the HEALTH layer's
+    safe mode holds (a critical disk/DB error).  Read-only methods (and
+    help/stop/uptime/getnodehealth) pass through untouched so diagnosis
+    and clean shutdown remain possible.
+
+    Deliberately keyed off the health mode, NOT the shared
+    ``_safe_mode_reason`` string: the legacy fork-warning safe mode (any
+    peer can provoke it with a heavier invalid header chain) must keep
+    its narrower wallet-only ``observe_safe_mode`` guard — locking down
+    ``invalidateblock``/``reconsiderblock``/``submitblock`` there would
+    refuse the very RPCs an operator needs to resolve the fork."""
+    if method not in MUTATING_COMMANDS:
+        return
+    from ..node.health import g_health
+
+    if not g_health.allow_mutations():
+        raise RPCError(
+            RPC_FORBIDDEN_BY_SAFE_MODE,
+            f"Safe mode: {_safe_mode_reason or g_health.mode_name()}",
+        )
+
+
 def check_fork_warning(chainstate) -> None:
     """ref warnings/CheckForkWarningConditions: a rejected fork with more
     than 6 blocks of work beyond our tip triggers safe mode."""

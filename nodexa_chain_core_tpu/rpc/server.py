@@ -79,6 +79,11 @@ class RPCTable:
             raise RPCError(RPC_METHOD_NOT_FOUND, f"Method not found: {method}")
         if self.warmup is not None and method not in ("help", "stop", "uptime"):
             raise RPCError(RPC_IN_WARMUP, self.warmup)
+        # safe-mode lockdown (health layer / fork warning): mutating
+        # commands refuse with a structured error, read-only RPC stays up
+        from .safemode import reject_if_locked_down
+
+        reject_if_locked_down(method)
         return cmd.fn(node, params)
 
     def help_text(self, topic: Optional[str] = None) -> str:

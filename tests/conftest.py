@@ -23,3 +23,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fault_and_health_isolation():
+    """The fault registry and health state are process-global (like
+    g_metrics): a test that arms an injection or trips safe mode must not
+    leak either into the next test."""
+    yield
+    from nodexa_chain_core_tpu.node.faults import g_faults
+    from nodexa_chain_core_tpu.node.health import g_health
+
+    if g_faults.enabled:
+        g_faults.disarm_all()
+    # unconditional: retry/error counters and the self-check verdict leak
+    # even when the mode never left normal
+    g_health.reset_for_tests()

@@ -1,0 +1,620 @@
+"""Fault-tolerant node core: deterministic injection, the kill-at-site
+crash-recovery matrix, safe-mode degradation, and the startup self-check.
+
+Reference analogues: AbortNode + -checkblocks/-checklevel (CVerifyDB)
+and test/functional/feature_dbcrash.py — except the kills here are
+DETERMINISTIC (a named fault site fires on its N-th hit) instead of
+timing-dependent external signals.
+"""
+
+import errno
+import os
+import subprocess
+import sys
+
+import pytest
+
+from nodexa_chain_core_tpu.chain.blockstore import BlockReadAhead
+from nodexa_chain_core_tpu.chain.kvstore import KVStore
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.node.faults import (
+    KILL_EXIT_CODE,
+    KNOWN_SITES,
+    g_faults,
+    parse_spec,
+)
+from nodexa_chain_core_tpu.node.health import (
+    MODE_NORMAL,
+    NodeCriticalError,
+    g_health,
+)
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+from nodexa_chain_core_tpu.telemetry import g_metrics
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+TARGET_HEIGHT = 6
+
+# The crash-matrix driver: a deterministic regtest IBD — fixed key, fixed
+# per-height ntime, nonce scan from zero — so an interrupted run, healed
+# and resumed, MUST converge to the uninterrupted run's tip hash.
+# dbcache_bytes=1 keeps the coins_flush site firing per activation; the
+# read-back and periodic kvstore flush exercise the read/segment sites.
+_DRIVER = """\
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.core.uint256 import u256_hex
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import select_params
+from nodexa_chain_core_tpu.script.sign import KeyStore
+from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+datadir, target = sys.argv[1], int(sys.argv[2])
+params = select_params("regtest")
+cs = ChainState(params, datadir=datadir, dbcache_bytes=1)
+spk = p2pkh_script(KeyID(KeyStore().add_key(0xD00D)))
+while cs.tip().height < target:
+    h = cs.tip().height
+    blk = BlockAssembler(cs).create_new_block(
+        spk.raw, ntime=params.genesis_time + 60 * (h + 1))
+    assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 22)
+    cs.process_new_block(blk)
+    cs.read_block(cs.tip())          # blockstore.blk.read coverage
+    if cs.tip().height % 2 == 0:
+        cs._chainstate_db.flush()    # kvstore.segment_write coverage
+cs.flush_state_to_disk()
+print("TIP %064x %d" % (cs.tip().block_hash, cs.tip().height))
+cs.close()
+"""
+
+
+def _run_driver(datadir, faultinject=None, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("NODEXA_FAULTINJECT", None)
+    if faultinject:
+        env["NODEXA_FAULTINJECT"] = faultinject
+    return subprocess.run(
+        [sys.executable, "-c", _DRIVER, datadir, str(TARGET_HEIGHT)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=timeout,
+    )
+
+
+def _tip_of(proc):
+    for line in proc.stdout.splitlines():
+        if line.startswith("TIP "):
+            _, tip, height = line.split()
+            return tip, int(height)
+    raise AssertionError(
+        f"driver printed no TIP\nstdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+
+
+@pytest.fixture(scope="module")
+def baseline_tip(tmp_path_factory):
+    """Tip hash of one uninterrupted run — the convergence target."""
+    proc = _run_driver(str(tmp_path_factory.mktemp("baseline")))
+    assert proc.returncode == 0, proc.stderr
+    tip, height = _tip_of(proc)
+    assert height == TARGET_HEIGHT
+    return tip
+
+
+def _crash_and_heal(tmp_path, baseline_tip, site, spec):
+    datadir = str(tmp_path / "node")
+    killed = _run_driver(datadir, faultinject=f"{site}:{spec}")
+    assert killed.returncode == KILL_EXIT_CODE, (
+        f"{site} injection never fired (exit {killed.returncode})\n"
+        f"stderr: {killed.stderr}"
+    )
+    healed = _run_driver(datadir)  # no injection: replay + resume
+    assert healed.returncode == 0, healed.stderr
+    tip, height = _tip_of(healed)
+    assert height == TARGET_HEIGHT
+    assert tip == baseline_tip, (
+        f"healed run after {site} kill diverged from the uninterrupted tip"
+    )
+
+
+# `after` counts are tuned so every kill lands mid-IBD (the site has
+# already fired at least once and the chain is part-built).
+_MATRIX = {
+    "kvstore.wal_append": "kill,after=6",
+    "kvstore.segment_write": "kill,after=1",
+    "blockstore.blk.append": "kill@20,after=3",  # leaves a torn record
+    "blockstore.blk.read": "kill,after=4",
+    "blockstore.rev.append": "kill,after=3",
+    "chainstate.coins_flush": "kill,after=3",
+}
+def test_matrix_covers_every_ibd_site():
+    ibd_sites = {s for s, meta in KNOWN_SITES.items() if meta["ibd"]}
+    assert ibd_sites == set(_MATRIX), (
+        "crash matrix out of sync with KNOWN_SITES ibd flags"
+    )
+
+
+@pytest.mark.parametrize("site", sorted(_MATRIX))
+def test_crash_recovery_matrix(tmp_path, baseline_tip, site):
+    _crash_and_heal(tmp_path, baseline_tip, site, _MATRIX[site])
+
+
+# ---------------------------------------------------------------- spec DSL
+
+
+def test_parse_spec_fields():
+    s = parse_spec("kvstore.wal_append:errno=ENOSPC,after=2,count=3")
+    assert (s.mode, s.err, s.after, s.count) == ("raise", errno.ENOSPC, 2, 3)
+    s = parse_spec("blockstore.blk.append:kill@16")
+    assert (s.mode, s.offset) == ("kill", 16)
+    s = parse_spec("blockstore.rev.read:torn=5,count=-1")
+    assert (s.mode, s.offset, s.count) == ("torn", 5, -1)
+    s = parse_spec("kvstore.wal_fsync:errno=5,transient")
+    assert (s.err, s.transient) == (5, True)
+
+
+def test_parse_spec_rejects_unknown_site_and_field():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        parse_spec("kvstore.wal_apend:raise")
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_spec("kvstore.wal_append:explode")
+    with pytest.raises(ValueError, match="expected <site>"):
+        parse_spec("no-colon")
+
+
+def test_fire_window_after_and_count():
+    s = parse_spec("kvstore.wal_append:after=2,count=2")
+    fired = [s.should_fire() for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_injection_raises_and_counts(tmp_path):
+    kv = KVStore(str(tmp_path / "kv"))
+    m = g_metrics.counter("nodexa_fault_injections_total")
+    before = m.value(site="kvstore.wal_append")
+    g_faults.arm_from_string("kvstore.wal_append:errno=ENOSPC")
+    with pytest.raises(OSError) as ei:
+        kv.put(b"k", b"v")
+    assert ei.value.errno == errno.ENOSPC
+    assert m.value(site="kvstore.wal_append") == before + 1
+    assert g_faults.injection_counts()["kvstore.wal_append"] == 1
+    g_faults.disarm_all()
+    kv.put(b"k", b"v")  # disarmed: store still writable
+    assert kv.get(b"k") == b"v"
+    kv.close()
+
+
+def test_torn_read_injection(tmp_path):
+    params = select_params("regtest")
+    cs = ChainState(params, datadir=str(tmp_path / "n"))
+    _mine(cs, params, 1)
+    g_faults.arm_from_string("blockstore.blk.read:torn=5")
+    with pytest.raises(IOError, match="truncated record"):
+        cs.read_block(cs.tip())
+    cs.read_block(cs.tip())  # count=1 default: next read is clean
+    cs.close()
+
+
+# ---------------------------------------------------- transient vs critical
+
+
+def _mine(cs, params, n):
+    spk = p2pkh_script(KeyID(KeyStore().add_key(0xD00D)))
+    for _ in range(n):
+        h = cs.tip().height
+        blk = BlockAssembler(cs).create_new_block(
+            spk.raw, ntime=params.genesis_time + 60 * (h + 1))
+        assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 22)
+        cs.process_new_block(blk)
+
+
+def test_transient_fault_retried_not_escalated(tmp_path):
+    params = select_params("regtest")
+    cs = ChainState(params, datadir=str(tmp_path / "n"))
+    _mine(cs, params, 1)
+    # EAGAIN twice, then clean: the bounded retry absorbs it
+    g_faults.arm_from_string("chainstate.coins_flush:errno=EAGAIN,count=2")
+    cs.flush_state_to_disk()
+    assert g_health.mode == MODE_NORMAL
+    assert g_health.retry_counts.get("chainstate.coins_flush") == 2
+    cs.close()
+
+
+class _Stoppable:
+    def __init__(self):
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_safe_mode_e2e_flush_failure(tmp_path):
+    """The acceptance safe-mode path, in-process: persistent ENOSPC on the
+    coins flush -> safe mode, producers halted, mutating RPC refused,
+    read-only RPC + health/metrics live, clean shutdown."""
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.chain.mempool_accept import (
+        MempoolAcceptError,
+        accept_to_memory_pool,
+    )
+    from nodexa_chain_core_tpu.primitives.transaction import Transaction
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.safemode import RPC_FORBIDDEN_BY_SAFE_MODE
+    from nodexa_chain_core_tpu.rpc.server import RPCError, RPCTable
+
+    params = select_params("regtest")
+    cs = ChainState(params, datadir=str(tmp_path / "n"))
+    _mine(cs, params, 2)
+    cs.flush_state_to_disk()
+
+    class _Node:
+        chainstate = cs
+        mempool = TxMemPool()
+        connman = None
+        params = cs.params
+
+        def uptime(self):
+            return 1
+
+    node = _Node()
+    node.background_miner = _Stoppable()
+    node.pool_server = _Stoppable()
+    g_health.attach_node(node)
+
+    _mine(cs, params, 1)  # dirty state for the failing flush to carry
+    g_faults.arm_from_string("chainstate.coins_flush:errno=ENOSPC,count=-1")
+    with pytest.raises(NodeCriticalError):
+        cs.flush_state_to_disk()
+
+    # 1. mode + producers
+    assert g_health.mode_name() == "safe"
+    assert not g_health.allow_mutations()
+    g_health.join_halt()
+    assert node.background_miner.stopped
+    assert node.pool_server.stopped
+
+    # 2. tx admission refuses up front
+    with pytest.raises(MempoolAcceptError) as ei:
+        accept_to_memory_pool(cs, node.mempool, Transaction())
+    assert ei.value.code == "safe-mode"
+
+    # 3. RPC surface: mutating refused with the structured error,
+    #    read-only + health still answer
+    table = register_all(RPCTable())
+    table.set_warmup_finished()
+    with pytest.raises(RPCError) as ri:
+        table.execute(node, "sendrawtransaction", ["00"])
+    assert ri.value.code == RPC_FORBIDDEN_BY_SAFE_MODE
+    with pytest.raises(RPCError) as ri:
+        table.execute(node, "generate", [1])
+    assert ri.value.code == RPC_FORBIDDEN_BY_SAFE_MODE
+    assert table.execute(node, "uptime", []) == 1
+    health = table.execute(node, "getnodehealth", [])
+    assert health["mode"] == "safe"
+    assert health["last_critical_error"]["source"] == "chainstate.coins_flush"
+    assert health["critical_errors"]["chainstate.coins_flush"] >= 1
+
+    # 4. the health gauge rides the metrics registry (the /metrics twin)
+    gauge = g_metrics.get("nodexa_node_health")
+    assert [v for _, v in gauge.collect()] == [1.0]
+
+    # 5. clean shutdown with the fault still armed: close() tolerates the
+    #    persisting flush failure instead of crashing out
+    cs.close()
+
+
+def test_readahead_failure_is_typed_and_counted():
+    m = g_metrics.counter("nodexa_prefetch_fallback_total")
+    before = m.value(reason="error")
+
+    def boom(_item):
+        raise IOError("injected read failure")
+
+    ra = BlockReadAhead(boom)
+    ra.start([object()])
+    item_missing = object()
+    blk, warmed = ra.get(item_missing, timeout=0.1)  # also covers timeout
+    assert blk is None and warmed == 0
+    ra.close()
+
+    ra = BlockReadAhead(boom)
+    sentinel = object()
+    ra.start([sentinel])
+    blk, warmed = ra.get(sentinel, timeout=10)
+    assert (blk, warmed) == (None, 0)
+    assert m.value(reason="error") == before + 1
+    ra.close()
+
+
+def test_wal_aborted_batch_prefix_never_adopted_by_later_commit(tmp_path):
+    """An aborted batch's CRC-valid record prefix (written, no commit
+    marker — a mid-batch crash) must be truncated at the last COMMIT
+    boundary on recovery: truncating at the last valid *record* boundary
+    would leave the prefix in the WAL, and the NEXT batch's commit marker
+    would atomically apply half of the aborted batch on the recovery
+    after that."""
+    path = str(tmp_path / "kv")
+    kv = KVStore(path)
+    kv.put(b"committed", b"1")
+    # aborted batch: records hit the WAL, the commit marker never did
+    kv._append_record(1, b"half", b"x")
+    kv._append_record(1, b"batch", b"y")
+    kv._log.flush()
+    kv._log.close()
+    kv._log = None  # kill -9: no close-time flush/compaction
+    kv2 = KVStore(path)  # first recovery: must drop the uncommitted tail
+    assert kv2.get(b"half") is None
+    kv2.put(b"later", b"2")  # a later batch WITH a commit marker
+    kv2._log.close()
+    kv2._log = None
+    kv3 = KVStore(path)  # second recovery: the aborted prefix must not
+    assert kv3.get(b"half") is None  # ride in on "later"'s commit
+    assert kv3.get(b"batch") is None
+    assert kv3.get(b"committed") == b"1"
+    assert kv3.get(b"later") == b"2"
+    kv3.close()
+
+
+def test_safe_mode_tx_relay_is_not_peer_misbehavior():
+    """Once safe mode halts admission, relayed txs refuse with the
+    'safe-mode' code — scoring that as misbehavior would ban the whole
+    peer set while the node is degraded."""
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.net.net_processing import NetProcessor
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint,
+        Transaction,
+        TxIn,
+        TxOut,
+    )
+
+    params = select_params("regtest")
+    cs = ChainState(params)
+
+    class _Peer:
+        id = 1
+        known_txs = set()
+        disconnect = False
+        misbehavior = 0
+        last_tx_time = 0.0
+
+        def send_msg(self, *a, **k):
+            pass
+
+    class _Node:
+        chainstate = cs
+        mempool = TxMemPool()
+        params = cs.params
+
+    class _Connman:
+        def all_peers(self):
+            return []
+
+    proc = NetProcessor(_Node(), _Connman())
+    peer = _Peer()
+    g_health.critical_error("chainstate.coins_flush", OSError(28, "boom"))
+    tx = Transaction(version=1,
+                     vin=[TxIn(prevout=OutPoint(1, 0))],
+                     vout=[TxOut(value=1, script_pubkey=b"")])
+    proc._on_tx_batch([(peer, tx.to_bytes())])
+    assert peer.misbehavior == 0
+    cs.close()
+
+
+def test_fork_warning_safe_mode_does_not_lock_down_chain_steering():
+    """The legacy fork-warning safe mode (peer-provokable) keeps its
+    narrow wallet-only guard: the dispatch-table lockdown is the HEALTH
+    layer's alone, so invalidateblock/reconsiderblock/submitblock stay
+    available to resolve the fork."""
+    from nodexa_chain_core_tpu.rpc.safemode import (
+        observe_safe_mode,
+        reject_if_locked_down,
+        set_safe_mode,
+    )
+    from nodexa_chain_core_tpu.rpc.server import RPCError
+
+    set_safe_mode("large invalid fork detected")
+    try:
+        # health layer still normal: chain-steering RPCs pass the gate
+        reject_if_locked_down("reconsiderblock")
+        reject_if_locked_down("submitblock")
+        # ...while the wallet's value-moving guard still refuses
+        with pytest.raises(RPCError):
+            observe_safe_mode()
+        # the health layer's own escalation DOES lock the table down
+        g_health.critical_error("kvstore.write_batch", OSError(5, "io"))
+        with pytest.raises(RPCError):
+            reject_if_locked_down("reconsiderblock")
+        reject_if_locked_down("getblockcount")  # read-only: never gated
+    finally:
+        g_health.join_halt()
+
+
+def test_kvstore_torn_tail_truncated_counted_and_appendable(tmp_path):
+    m = g_metrics.counter("nodexa_kvstore_torn_tail_total")
+    before = m.total()
+    path = str(tmp_path / "kv")
+    kv = KVStore(path)
+    kv.put(b"a", b"1")
+    kv._log.close()
+    kv._log = None  # kill -9: skip close-time compaction
+    wal = os.path.join(path, "wal.dat")
+    with open(wal, "ab") as f:
+        f.write(b"\x01\x40\x00\x00\x00garbage")  # torn record, huge klen
+    kv2 = KVStore(path)
+    assert m.total() == before + 1
+    assert kv2.get(b"a") == b"1"
+    # the tail was TRUNCATED, not just skipped: a commit appended after
+    # recovery must survive the next recovery (pre-fix it was buried
+    # behind the garbage and silently lost)
+    kv2.put(b"after", b"ok")
+    kv2._log.close()
+    kv2._log = None
+    kv3 = KVStore(path)
+    assert kv3.get(b"after") == b"ok"
+    kv3.close()
+
+
+# ------------------------------------------------------- startup self-check
+
+
+def _build_datadir(tmp_path, blocks=8):
+    """Chain data under <node>/regtest — the subdir the daemon derives
+    from -datadir=<node>, so both in-process and daemon tests see it."""
+    params = select_params("regtest")
+    datadir = str(tmp_path / "node" / "regtest")
+    cs = ChainState(params, datadir=datadir)
+    _mine(cs, params, blocks)
+    cs.flush_state_to_disk()
+    cs.close()
+    return params, datadir
+
+
+def _corrupt_last_undo(datadir):
+    """Flip the tail bytes of the newest rev chunk: the LAST record's
+    payload (the tip block's undo), inside the -checkblocks window."""
+    rev = sorted(
+        f for f in os.listdir(os.path.join(datadir, "blocks"))
+        if f.startswith("rev")
+    )[-1]
+    path = os.path.join(datadir, "blocks", rev)
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF
+    data[-2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+def test_verify_db_detects_corrupted_undo(tmp_path):
+    from nodexa_chain_core_tpu.chain.validation import BlockValidationError
+
+    params, datadir = _build_datadir(tmp_path)
+    _corrupt_last_undo(datadir)
+    cs = ChainState(params, datadir=datadir)
+    with pytest.raises(BlockValidationError, match="verifydb-"):
+        cs.verify_db(check_level=3, check_blocks=6)
+    cs.close()
+
+
+def test_daemon_refuses_start_on_corrupted_undo_with_reindex_hint(tmp_path):
+    _, datadir = _build_datadir(tmp_path)
+    _corrupt_last_undo(datadir)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "nodexa_chain_core_tpu.node.daemon",
+         "-regtest", f"-datadir={os.path.dirname(datadir)}", "-nolisten",
+         "-disablewallet", "-checklevel=3", "-checkblocks=6"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=180,
+    )
+    assert proc.returncode != 0
+    assert "self-check failed" in proc.stderr
+    assert "-reindex" in proc.stderr
+
+
+def test_daemon_rejects_bogus_faultinject_site(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "nodexa_chain_core_tpu.node.daemon",
+         "-regtest", f"-datadir={tmp_path / 'd'}", "-nolisten",
+         "-disablewallet", "-faultinject=nonsense.site:raise"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=180,
+    )
+    assert proc.returncode != 0
+    assert "unknown fault site" in proc.stderr
+
+
+def test_verify_db_detects_coins_desync(tmp_path):
+    """The _replay_blocks recovery-point cross-check: a coins view parked
+    on a different block than the index tip must fail the self-check."""
+    from nodexa_chain_core_tpu.chain.validation import BlockValidationError
+
+    params, datadir = _build_datadir(tmp_path, blocks=4)
+    cs = ChainState(params, datadir=datadir)
+    cs.verify_db(check_level=3, check_blocks=4)  # sane after a clean boot
+    # simulate a replay that failed to converge: coins best-block pinned
+    # two blocks behind the index tip
+    stale = cs.active.at(cs.tip().height - 2).block_hash
+    cs.coins.set_best_block(stale)
+    with pytest.raises(BlockValidationError, match="coins-desync"):
+        cs.verify_db(check_level=1, check_blocks=4)
+    cs.close()
+
+
+@pytest.mark.slow
+def test_safe_mode_daemon_e2e(tmp_path):
+    """Full-daemon acceptance run: armed ENOSPC on the coins flush with a
+    zero-byte dbcache (flush per activation), mine over RPC until the
+    fault fires, then assert the complete safe-mode surface and a clean
+    exit code."""
+    import time as _t
+
+    from nodexa_chain_core_tpu.script.standard import encode_destination
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from functional.framework import RPCFailure, TestNode
+
+    params = select_params("regtest")
+    addr = encode_destination(KeyID(KeyStore().add_key(0xD00D)), params)
+    node = TestNode(
+        0, str(tmp_path),
+        extra_args=[
+            "-dbcache=0",  # size pressure: coins flush on every activation
+            "-faultinject=chainstate.coins_flush:errno=ENOSPC,after=2,count=-1",
+        ],
+    )
+    node.start()
+    try:
+        fired = False
+        for _ in range(6):
+            try:
+                node.rpc.generatetoaddress(1, addr)
+            except RPCFailure:
+                fired = True
+                break
+        assert fired, "injected coins-flush failure never surfaced"
+        health = node.rpc.getnodehealth()
+        assert health["mode"] == "safe"
+        assert health["last_critical_error"]["source"] == (
+            "chainstate.coins_flush")
+        # mutating RPC refused with the structured safe-mode error
+        try:
+            node.rpc.sendrawtransaction("00")
+            raise AssertionError("sendrawtransaction accepted in safe mode")
+        except RPCFailure as e:
+            assert e.code == -2
+        # read-only RPC still answers
+        assert node.rpc.getblockcount() >= 0
+        assert "metrics" in node.rpc.getmetrics("nodexa_node_health")
+    finally:
+        proc = node.proc
+        node.stop()
+    assert proc is not None and proc.returncode == 0, (
+        "safe-mode shutdown was not clean")
+
+
+@pytest.mark.slow
+def test_daemon_starts_clean_after_reindex_of_corrupted_undo(tmp_path):
+    """The runbook end-to-end: corruption detected -> -reindex rebuilds ->
+    the self-check passes again."""
+    params, datadir = _build_datadir(tmp_path)
+    _corrupt_last_undo(datadir)
+    cs = ChainState(params, datadir=datadir)
+    with pytest.raises(Exception):
+        cs.verify_db(check_level=3, check_blocks=6)
+    cs.close()
+    # -reindex analogue: wipe derived stores and rebuild from block files
+    import shutil
+
+    shutil.rmtree(os.path.join(datadir, "chainstate"))
+    shutil.rmtree(os.path.join(datadir, "blocks", "index"))
+    fresh = ChainState(params, datadir=datadir)
+    fresh.reindex()
+    fresh.verify_db(check_level=3, check_blocks=6)
+    assert fresh.tip().height == 8
+    fresh.close()

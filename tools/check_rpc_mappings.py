@@ -43,6 +43,8 @@ EXPECTED_EXTRAS = {
     "generatetoaddresstpu",
     # node-wide telemetry registry (REST /metrics twin)
     "getmetrics",
+    # fault-tolerance surface: health mode, critical errors, self-check
+    "getnodehealth",
     # stratum work-server subsystem (pool/)
     "getpoolinfo",
 }

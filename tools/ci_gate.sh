@@ -30,16 +30,23 @@
 #                      hold p99 below the off-lock scripts-stage mean
 #                      (ECDSA demonstrably outside the lock), and an
 #                      identical reject taxonomy on both paths
-#   8. vectors         generate_x16r_vectors.py --check — the committed
+#   8. fault tolerance tests/test_fault_tolerance.py (fast subset) —
+#                      deterministic fault-injection specs, a kill-at-
+#                      site crash-recovery pair per tier-1 site asserting
+#                      restart converges to the uninterrupted tip, the
+#                      safe-mode degradation surface, and the startup
+#                      self-check refusing a corrupted undo journal
+#                      (full matrix + daemon e2e run under -m slow)
+#   9. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#   9. native build    compiles the C++ engine (also feeds the wheel)
-#  10. static checks   tools/typecheck.py over the consensus-critical
+#  10. native build    compiles the C++ engine (also feeds the wheel)
+#  11. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  11. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  12. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  12. pytest          unit suite (functional suite with --full)
-#  13. wheel           platform-tagged wheel incl. the native .so,
+#  13. pytest          unit suite (functional suite with --full)
+#  14. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
@@ -106,32 +113,46 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [8/13] crypto vector regeneration"
+echo "== [8/14] fault tolerance (crash-recovery matrix + safe mode)"
+# kill-at-site crash pairs, safe-mode degradation, and the startup
+# self-check refusing corrupted undo data; the full site matrix and the
+# daemon-level safe-mode e2e run under the slow marker (--full lane)
+if [ "$1" = "--full" ]; then
+    python -m pytest tests/test_fault_tolerance.py -q -p no:cacheprovider
+else
+    python -m pytest tests/test_fault_tolerance.py -q -m "not slow" \
+        -p no:cacheprovider
+fi
+
+echo "== [9/14] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [9/13] native engine build"
+echo "== [10/14] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [10/13] static checks (consensus-critical packages)"
+echo "== [11/14] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [11/13] native hardening (security-check analog)"
+echo "== [12/14] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [12/13] pytest"
-# telemetry suite already ran as stage 4: don't pay for it twice
+echo "== [13/14] pytest"
+# telemetry + fault-tolerance suites already ran as stages 4/8: don't
+# pay for them twice
 if [ "$1" = "--full" ]; then
-    python -m pytest tests/ -q --ignore=tests/test_telemetry.py
+    python -m pytest tests/ -q --ignore=tests/test_telemetry.py \
+        --ignore=tests/test_fault_tolerance.py
 else
     python -m pytest tests/ -q -m "not functional" \
-        --ignore=tests/test_telemetry.py
+        --ignore=tests/test_telemetry.py \
+        --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [13/13] wheel"
+echo "== [14/14] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'
