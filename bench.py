@@ -538,6 +538,28 @@ def bench_sha256d(on_tpu: bool) -> dict:
     }
 
 
+def bench_mesh() -> dict:
+    """Mesh serving backend (parallel/backend.py): headers-verify,
+    pool-share, and search throughput at n_devices=8 vs 1, measured in
+    fresh child processes with the XLA host device count forced (the
+    backend path every consumer now routes through).  *_mesh8 keys +
+    mesh_scaling_efficiency.  Details in bench/mesh.py."""
+    from nodexa_chain_core_tpu.bench.mesh import measure
+
+    t = time.perf_counter()
+    res = measure(devices=8, rounds=3, batch=64)
+    suffix = f"mesh{res['mesh_devices']}"
+    log(f"[mesh] {res['mesh_devices']}-device backend (path="
+        f"{res['mesh_backend_path']}, shape {res['mesh_shape']}): "
+        f"verify {res[f'headers_verify_per_s_{suffix}']:,} headers/s, "
+        f"shares {res[f'pool_shares_per_s_{suffix}']:,}/s, search "
+        f"{res[f'kawpow_search_hs_{suffix}']:,} H/s; scaling "
+        f"{res['mesh_scaling']} (efficiency "
+        f"{res['mesh_scaling_efficiency']}) "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return res
+
+
 def bench_pool() -> dict:
     """Stratum share-validation throughput (pool/ subsystem): micro-
     batched BatchVerifier vs the scalar path over one synthetic epoch.
@@ -628,6 +650,8 @@ def main() -> None:
         extra.update(bench_txflood())
     if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):
         extra.update(bench_pool())
+    if not os.environ.get("NODEXA_BENCH_SKIP_MESH"):
+        extra.update(bench_mesh())
 
     value = extra.pop("kawpow_search_tpu_hs")
     baseline = extra["kawpow_native_cpu_hs"]

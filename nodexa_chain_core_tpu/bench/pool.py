@@ -282,7 +282,12 @@ def run_e2e(shares_target: int, assert_accepted: int | None) -> int:
 
     blocks = node.chainstate.tip().height - start_height
     hist = g_metrics.get("nodexa_pool_share_batch_seconds")
-    batched_n = (hist.snapshot(path="batched") or {}).get("count", 0)
+    # device batches report under the serving-backend path label
+    # (mesh when a MeshBackend serves the node, single for a bare
+    # verifier like this rig's)
+    batched_n = sum(
+        (hist.snapshot(path=p) or {}).get("count", 0)
+        for p in ("mesh", "single"))
     scalar_n = (hist.snapshot(path="scalar") or {}).get("count", 0)
     text = prometheus_text()
     metrics_ok = all(

@@ -98,7 +98,8 @@ _M_PREFETCH_BLOCKS = g_metrics.counter(
     "Blocks actually delivered pre-deserialized by the read-ahead worker")
 _M_HEADERS_POW = g_metrics.counter(
     "nodexa_headers_pow_verified_total",
-    "Header PoW verifications, labeled by path (batch|scalar)")
+    "Header PoW verifications, labeled by serving path "
+    "(mesh|single|scalar)")
 _M_BLOCKS_CONNECTED = g_metrics.counter(
     "nodexa_blocks_connected_total", "Blocks connected to the active chain")
 _M_BLOCKS_DISCONNECTED = g_metrics.counter(
@@ -1539,9 +1540,17 @@ class ChainState:
         epoch; epochs without a device-resident DAG slab fall back to the
         scalar native path in check_block_header.  A failed batch raises
         immediately (same bad-header outcome, one round-trip earlier).
+
+        With a mesh serving backend attached (``self.mesh_backend``, set
+        by the daemon under -tpukawpow) the batch routes through
+        ``MeshBackend.verify_headers`` — sharded over the mesh's headers
+        axis with the path label and shard-size telemetry owned by the
+        backend; ``kawpow_batch_factory`` alone is the single-device
+        legacy/test route.
         """
+        backend = getattr(self, "mesh_backend", None)
         factory = getattr(self, "kawpow_batch_factory", None)
-        if factory is None:
+        if factory is None and backend is None:
             return set()
         sched = self.params.algo_schedule
         last_cp = max(self.params.checkpoints, default=-1)
@@ -1555,10 +1564,16 @@ class ChainState:
                 continue  # checkpoint fast path handles it
             groups.setdefault(kp.epoch_number(header.height), []).append(header)
         verified: set = set()
+        pow_paths: dict = {}
         for epoch, group in groups.items():
-            verifier = factory(epoch)
-            if verifier is None:
-                continue
+            if backend is not None:
+                verifier = None
+                if backend.verifier(epoch) is None:
+                    continue  # slab not resident: scalar fallback
+            else:
+                verifier = factory(epoch)
+                if verifier is None:
+                    continue
             entries = []
             for header in group:
                 try:
@@ -1577,14 +1592,25 @@ class ChainState:
                     header.mix_hash,
                     target,
                 ))
-            for header, (ok, _final) in zip(group, verifier.verify_headers(entries)):
+            if backend is not None:
+                res = backend.verify_headers(epoch, entries)
+                if res is None:
+                    continue  # slab evicted between check and call
+                results, path = res
+            else:
+                results = verifier.verify_headers(entries)
+                # a bare verifier (tests inject scalar twins) counts as
+                # the single-device path
+                path = getattr(verifier, "backend_path", "single")
+            for header, (ok, _final) in zip(group, results):
                 if not ok:
                     raise BlockValidationError(
                         "high-hash", "batched kawpow verification failed"
                     )
                 verified.add(id(header))
-        if verified:
-            _M_HEADERS_POW.inc(len(verified), path="batch")
+                pow_paths[path] = pow_paths.get(path, 0) + 1
+        for path, n in pow_paths.items():
+            _M_HEADERS_POW.inc(n, path=path)
         return verified
 
     @_with_cs_main

@@ -189,7 +189,10 @@ def kawpow_verifier_for(node, block: Block):
     The one era-gate + epoch-lookup policy shared by every device-mining
     dispatch site (the background miner and generatetoaddress_tpu): a
     verifier exists only when -tpukawpow prebuilt the epoch's device slab
-    (node/epoch_manager.py) and the block is in the KawPow era.
+    and the block is in the KawPow era.  With a mesh serving backend
+    attached (parallel/backend.py), the epoch manager hands back the
+    backend's resident verifier — mesh-sharded when the mesh path passed
+    its self-check, single-device after a demotion.
     """
     mgr = getattr(node, "epoch_manager", None)
     if mgr is None or not node.params.algo_schedule.is_kawpow(
@@ -199,6 +202,17 @@ def kawpow_verifier_for(node, block: Block):
     from ..crypto.kawpow import epoch_number
 
     return mgr.verifier(epoch_number(block.header.height))
+
+
+def mesh_backend_for(node, block: Block):
+    """The node's MeshBackend when it can serve this block's era sweep
+    (same era gate as kawpow_verifier_for), else None."""
+    backend = getattr(node, "mesh_backend", None)
+    if backend is None or not node.params.algo_schedule.is_kawpow(
+        block.header.time
+    ):
+        return None
+    return backend
 
 
 _hybrid_lock = __import__("threading").Lock()
@@ -226,32 +240,67 @@ def _hybrid_searcher(verifier, fallback_batch: int):
 
 def mine_block_tpu(block: Block, schedule, max_batches: int = 1 << 10,
                    kawpow_verifier=None, batch: int = 2048,
-                   on_progress=None, start_nonce: int = 0) -> bool:
+                   on_progress=None, start_nonce: int = 0,
+                   backend=None) -> bool:
     """Accelerated nonce search by era (the reference's live-era analogue
     is the external GPU miner via getblocktemplate).
 
-    KawPow era: the device-resident BatchVerifier scans nonce64 batches on
-    TPU (same kernel as verification).  X16R/X16RV2: the native scan.
-    sha256d (test schedules): the Pallas/mesh sha256d miner.
+    KawPow era: when a mesh serving ``backend`` is attached the sweep
+    routes through ``MeshBackend.search_sweep`` (nonce lanes sharded
+    across the mesh, path-labeled telemetry); otherwise the
+    device-resident BatchVerifier scans nonce64 batches directly (same
+    kernel as verification).  X16R/X16RV2: the native scan.  sha256d
+    (test schedules): the Pallas/mesh sha256d miner.
     """
     from ..core.uint256 import bits_to_target
 
     target, _, _ = bits_to_target(block.header.bits)
     algo = schedule.era_algo(block.header.time)
     if algo == "kawpow":
-        if kawpow_verifier is None:
+        if kawpow_verifier is None and backend is None:
             return mine_block_cpu(block, schedule, max_tries=max_batches * 64)
         from ..parallel.pow_search import record_search_batch
 
         header_hash = block.header.kawpow_header_hash(schedule)[::-1]
-        searcher = _hybrid_searcher(kawpow_verifier, batch)
+        height = block.header.height
+        if backend is None:
+            searcher = _hybrid_searcher(kawpow_verifier, batch)
+            path = getattr(kawpow_verifier, "backend_path", "single")
         start = start_nonce
         for _ in range(max_batches):
-            t0 = time.perf_counter()
-            found, width = searcher.search_window(
-                header_hash, block.header.height, target, start
-            )
-            record_search_batch(time.perf_counter() - t0)
+            if backend is not None:
+                res = backend.search_sweep(
+                    header_hash, height, target, start, batch=batch)
+                if res is None:
+                    # slab evicted mid-slice (rollover): cover THIS
+                    # window on the native scan — honoring start and
+                    # reporting coverage, so the caller's slice
+                    # accounting (miner_thread's covered[0] loop) keeps
+                    # walking the nonce space instead of re-scanning
+                    # the same window forever
+                    from ..crypto import kawpow as kp
+
+                    hit = kp.kawpow_search(
+                        height,
+                        int.from_bytes(header_hash[::-1], "little"),
+                        target, start, batch,
+                    )
+                    if on_progress is not None:
+                        on_progress(batch)
+                    if hit is not None:
+                        block.header.nonce64 = hit[0]
+                        block.header.mix_hash = hit[2]
+                        block.header._cached_hash = None
+                        return True
+                    start += batch
+                    continue
+                (found, width), _path = res
+            else:
+                t0 = time.perf_counter()
+                found, width = searcher.search_window(
+                    header_hash, height, target, start
+                )
+                record_search_batch(time.perf_counter() - t0, path=path)
             if on_progress is not None:
                 on_progress(width)
             if found is not None:

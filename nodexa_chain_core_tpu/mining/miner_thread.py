@@ -123,9 +123,14 @@ class BackgroundMiner:
         accounting, and the template-staleness recheck cadence honest.
         Returns (found, nonces_covered) — per call, never on self (the
         worker threads share this object)."""
-        from .assembler import kawpow_verifier_for, mine_block_tpu
+        from .assembler import (
+            kawpow_verifier_for,
+            mesh_backend_for,
+            mine_block_tpu,
+        )
 
         verifier = kawpow_verifier_for(self.node, block)
+        backend = mesh_backend_for(self.node, block)
         if verifier is not None:
             covered = [0]
 
@@ -138,7 +143,7 @@ class BackgroundMiner:
                 found = mine_block_tpu(
                     block, self.node.params.algo_schedule, max_batches=1,
                     kawpow_verifier=verifier, on_progress=on_progress,
-                    start_nonce=covered[0],
+                    start_nonce=covered[0], backend=backend,
                 )
                 if found:
                     break

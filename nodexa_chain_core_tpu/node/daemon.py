@@ -271,14 +271,36 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     # KawPow epoch prebuild (ref ethash managed contexts) + optional TPU
     # batched header verification (-tpukawpow builds device DAG slabs).
+    # With more than one local device the mesh serving backend
+    # (parallel/backend.py) shards header verify, the miner's nonce
+    # sweeps, and pool share validation across all of them; -meshshape
+    # pins the (headers x lanes) grid, -tpudevices caps the device count.
     if node.params.consensus.kawpow_activation_time < (1 << 62):
         from .epoch_manager import EpochManager
 
+        tpu_verify = g_args.get_bool("tpukawpow")
+        if tpu_verify:
+            from ..parallel.backend import MeshBackend
+
+            try:
+                node.mesh_backend = MeshBackend.from_args(
+                    mesh_shape=g_args.get("meshshape", ""),
+                    max_devices=g_args.get_int("tpudevices", 0),
+                    slab_threads=g_args.get_int("slabthreads", 0),
+                )
+            except ValueError as e:  # bad -meshshape must not boot blind
+                raise SystemExit(f"Error: {e}")
         node.epoch_manager = EpochManager(
-            tpu_verify=g_args.get_bool("tpukawpow"),
+            tpu_verify=tpu_verify,
             slab_threads=g_args.get_int("slabthreads", 0),
+            backend=getattr(node, "mesh_backend", None),
         )
         node.chainstate.kawpow_batch_factory = node.epoch_manager.verifier
+        # header sync routes its batches through the backend directly
+        # (sharded over the headers axis, path label + shard telemetry
+        # owned by the backend); the factory stays as the availability
+        # contract for tests and the no-backend configuration
+        node.chainstate.mesh_backend = getattr(node, "mesh_backend", None)
 
         def _warm_epochs():
             tip = node.chainstate.tip()

@@ -6,33 +6,73 @@ a new epoch never stalls the message-handler thread.  This manager runs the
 same idea from the node scheduler: it warms the native light/L1 caches for
 the tip's epoch and the next one in a worker thread, and — when the TPU
 batch-verification path is enabled — builds the device-resident DAG slab
-and :class:`..ops.progpow_jax.BatchVerifier` for them.
+and verifier for them.
+
+With a :class:`..parallel.backend.MeshBackend` attached, slab residency,
+mesh-vs-single path selection, and self-check demotion all live in the
+backend (the mesh serving subsystem); this manager keeps the scheduling
+contract (pre-warm epoch and epoch+1 off the critical path) and the
+native-cache warming.  Without a backend (tests, legacy), it builds
+single-device ``BatchVerifier``s directly, as before.
 
 ``verifier(epoch)`` is non-blocking: it returns a verifier only once the
 background build finished, so header sync transparently falls back to the
 scalar native path until the slab is ready.
+
+Failure memoization is keyed on **(epoch, path)** — a deterministic
+mesh-path self-check failure must not loop multi-GB slab rebuilds, but it
+must not poison the healthy single-device path for that epoch either
+(and vice versa); scalar verification keeps working throughout.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..crypto import kawpow
 from ..utils.logging import g_logger
 
+# the legacy (no-backend) build route has exactly one device path
+_SINGLE = "single"
+
 
 class EpochManager:
-    def __init__(self, tpu_verify: bool = False, slab_threads: int = 0):
+    def __init__(self, tpu_verify: bool = False, slab_threads: int = 0,
+                 backend=None):
         self.tpu_verify = tpu_verify
         self.slab_threads = slab_threads
+        self.backend = backend
         self._lock = threading.Lock()
         self._warm: set = set()
         self._building: set = set()
-        self._failed: set = set()
+        self._failed: set = set()  # {(epoch, path)} — never epoch alone
         self._verifiers: Dict[int, object] = {}
+        if backend is not None:
+            # residency eviction (epoch rollover) must clear the warm
+            # memo, or a later ensure_for_height would never rebuild the
+            # re-needed epoch
+            backend.on_evict = self._forget
 
     # -- background warming -------------------------------------------------
+
+    def _device_paths(self) -> Tuple[str, ...]:
+        if not self.tpu_verify:
+            return ()
+        if self.backend is not None:
+            return self.backend.device_paths()
+        return (_SINGLE,)
+
+    def _all_paths_failed(self, epoch: int) -> bool:
+        # cheap short-circuit first: consulting the backend's path list
+        # may resolve the device mesh (a jax init), which must stay off
+        # the scheduler tick until a failure actually needs judging
+        if not any(e == epoch for (e, _p) in self._failed):
+            return False
+        # _SINGLE fallback covers the tpu_verify=False native-cache
+        # failure memo (no device paths, but the build can still fail)
+        paths = self._device_paths() or (_SINGLE,)
+        return all((epoch, p) in self._failed for p in paths)
 
     def ensure_for_height(self, height: int) -> None:
         """Warm epoch(height) and its successor; cheap if already warm."""
@@ -45,7 +85,7 @@ class EpochManager:
             if (
                 epoch in self._warm
                 or epoch in self._building
-                or epoch in self._failed
+                or self._all_paths_failed(epoch)
             ):
                 return
             self._building.add(epoch)
@@ -54,28 +94,26 @@ class EpochManager:
         )
         t.start()
 
-    def _build(self, epoch: int) -> None:
-        try:
-            kawpow.l1_cache(epoch)  # forces native light+L1 build
-            verifier = None
-            if self.tpu_verify:
-                from ..ops.progpow_jax import BatchVerifier
-
-                g_logger.log(
-                    f"epoch {epoch}: building DAG slab for TPU verification"
-                )
-                # from_epoch self-gates on a known-answer cross-check vs
-                # the native engine; a mismatch raises into the except
-                # below and the node stays on the scalar fallback
-                verifier = BatchVerifier.from_epoch(
-                    epoch, threads=self.slab_threads
-                )
+    def _build_verifier(self, epoch: int):
+        """One device-verifier build attempt; returns the verifier or
+        None (every available path failed and is memoized)."""
+        if self.backend is not None:
+            verifier = self.backend.build_epoch(epoch)
+            # mirror the backend's per-path memoization so _ensure stops
+            # scheduling rebuilds once every path is exhausted
             with self._lock:
-                self._warm.add(epoch)
-                if verifier is not None:
-                    self._verifiers[epoch] = verifier
-            g_logger.log(f"epoch {epoch}: context ready")
-        except Exception as e:  # pragma: no cover - defensive
+                for p in self.backend.failed_paths(epoch):
+                    self._failed.add((epoch, p))
+            return verifier
+        from ..ops.progpow_jax import BatchVerifier
+
+        g_logger.log(f"epoch {epoch}: building DAG slab for TPU verification")
+        # from_epoch self-gates on a known-answer cross-check vs the
+        # native engine; a mismatch raises to the caller and the node
+        # stays on the scalar fallback
+        try:
+            return BatchVerifier.from_epoch(epoch, threads=self.slab_threads)
+        except Exception as e:
             # the scheduler re-calls ensure_for_height every tick, so a
             # deterministic failure (e.g. the known-answer gate rejecting
             # a miscompiled kernel) must be memoized or the node rebuilds
@@ -85,15 +123,48 @@ class EpochManager:
                 f"path (restart to retry): {e}"
             )
             with self._lock:
+                self._failed.add((epoch, _SINGLE))
+            return None
+
+    def _build(self, epoch: int) -> None:
+        try:
+            kawpow.l1_cache(epoch)  # forces native light+L1 build
+            verifier = None
+            if self.tpu_verify:
+                verifier = self._build_verifier(epoch)
+            with self._lock:
+                self._warm.add(epoch)
+                if verifier is not None and self.backend is None:
+                    self._verifiers[epoch] = verifier
+            g_logger.log(f"epoch {epoch}: context ready")
+        except Exception as e:  # pragma: no cover - defensive
+            # native cache build failure: nothing device-specific to key
+            # on — memoize every path so the tick loop stops retrying
+            g_logger.log(
+                f"epoch {epoch}: prebuild failed, staying on the scalar "
+                f"path (restart to retry): {e}"
+            )
+            with self._lock:
                 self._building.discard(epoch)
-                self._failed.add(epoch)
+                for p in self._device_paths() or (_SINGLE,):
+                    self._failed.add((epoch, p))
             return
         with self._lock:
             self._building.discard(epoch)
 
+    def _forget(self, epoch: int) -> None:
+        """Backend eviction callback: drop the warm memo so a future
+        ensure_for_height rebuilds the epoch (failed memos stay — an
+        eviction is not an absolution)."""
+        with self._lock:
+            self._warm.discard(epoch)
+            self._verifiers.pop(epoch, None)
+
     # -- consumer API -------------------------------------------------------
 
     def verifier(self, epoch: int) -> Optional[object]:
-        """Ready BatchVerifier for `epoch`, or None (scalar fallback)."""
+        """Ready verifier for `epoch`, or None (scalar fallback)."""
+        if self.backend is not None:
+            return self.backend.verifier(epoch)
         with self._lock:
             return self._verifiers.get(epoch)

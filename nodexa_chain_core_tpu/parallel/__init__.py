@@ -1,0 +1,8 @@
+"""Multi-device serving: mesh helpers, sharded PoW kernels, and the
+MeshBackend every device-compute consumer (header sync, the miner, the
+pool share pipeline) routes through.
+
+Import rule: ``backend`` is imported lazily by consumers (it pulls in
+jax at mesh-construction time); this package root stays import-light so
+``from ..parallel import mesh`` keeps working everywhere.
+"""

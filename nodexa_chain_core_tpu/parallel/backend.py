@@ -1,0 +1,654 @@
+"""Mesh serving backend: every device-compute consumer routes here.
+
+The dryrun attestations (MULTICHIP_r05) proved the sharded kernels —
+KawPow verify with the epoch slab replicated and headers sharded, nonce
+search with lanes sharded — run bit-exact on an 8-device mesh, but
+``BatchVerifier``, the miner, and the pool ``SharePipeline`` all built
+their own single-device calls.  This module is the production owner of
+multi-device serving:
+
+- **Mesh construction & shape selection.**  ``-meshshape=HxL`` pins the
+  (headers, lanes) grid; otherwise every local device lands on the lane
+  axis.  ``-tpudevices=N`` caps the device count.  One device (or a mesh
+  init failure) degrades cleanly to the single-device path — the mesh is
+  an accelerant, never a requirement.
+
+- **Per-epoch DAG slab residency.**  The epoch slab + L1 cache are
+  loaded once and placed REPLICATED across the mesh (``NamedSharding``
+  with an empty ``PartitionSpec`` — every header/nonce touches 64
+  pseudo-random slab rows, so replication is the bandwidth-right layout;
+  see ``BatchVerifier._shard_over_mesh``).  Two epochs stay resident so
+  an epoch rollover never stalls on a slab build (the ``EpochManager``
+  pre-warm contract); older epochs are evicted and failed builds are
+  memoized per **(epoch, path)** so a mesh self-check failure cannot
+  poison the healthy single-device path.
+
+- **Sharded entry points.**  ``verify_headers`` (headers axis),
+  ``search_sweep`` (nonce-lane axis; resumes at the caller's nonce and
+  reports covered width, so the miner's tip-generation abort cadence and
+  the pool's extranonce nonce-partitioning contract are preserved), and
+  ``validate_shares`` (headers axis) — all labeled ``path=mesh|single``
+  on the shared pow/share telemetry, ``scalar`` being the callers' own
+  no-device fallback.
+
+- **Fail-closed self-checks.**  Each (epoch, path) verifier must
+  reproduce the native engine's known-answer hash bit-for-bit before it
+  serves consensus data (``BatchVerifier.self_check`` semantics); a mesh
+  mismatch demotes that epoch to the single-device path, a single-device
+  mismatch demotes to the scalar native engine.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+
+PATH_MESH = "mesh"
+PATH_SINGLE = "single"
+PATH_SCALAR = "scalar"
+
+_M_DEVICES = g_metrics.gauge(
+    "nodexa_mesh_devices",
+    "Devices in the serving mesh (1 = single-device path)")
+_M_SHAPE = g_metrics.gauge(
+    "nodexa_mesh_shape",
+    "Mesh extent per axis (labels: axis=headers|lanes)")
+_M_SHARD_SIZE = g_metrics.histogram(
+    "nodexa_mesh_shard_size",
+    "Per-device shard size of one sharded call (labels: axis)",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536))
+_M_RESIDENCY = g_metrics.gauge(
+    "nodexa_dag_residency",
+    "1 when the epoch's DAG slab is device-resident (labels: epoch)")
+_M_DEMOTIONS = g_metrics.counter(
+    "nodexa_mesh_demotions_total",
+    "Self-check failures demoting an (epoch, path) build")
+_M_BUILDS = g_metrics.counter(
+    "nodexa_mesh_epoch_builds_total",
+    "Epoch slab builds completed, labeled by serving path")
+
+
+def parse_mesh_shape(spec: str) -> Optional[Tuple[int, int]]:
+    """``-meshshape`` grammar: "HxL" (headers x lanes) or a bare device
+    count "N" (all lanes).  Empty/None -> auto.  Raises ValueError on
+    garbage — a typo must not silently serve single-device."""
+    if not spec:
+        return None
+    s = spec.lower().replace("*", "x")
+    try:
+        if "x" in s:
+            h, l = s.split("x", 1)
+            shape = (int(h), int(l))
+        else:
+            shape = (1, int(s))
+    except ValueError:
+        raise ValueError(f"bad -meshshape {spec!r} (want HxL or N)")
+    if shape[0] <= 0 or shape[1] <= 0:
+        raise ValueError(f"bad -meshshape {spec!r} (axes must be >= 1)")
+    return shape
+
+
+def build_mesh(shape: Optional[Tuple[int, int]] = None,
+               max_devices: Optional[int] = None,
+               devices: Optional[Sequence] = None):
+    """Mesh over the local devices, or None for the single-device path.
+
+    None comes back when there is one device, when the requested shape
+    cannot tile the device count, or when mesh init fails — every case
+    logs, none raises: serving must start either way."""
+    import jax
+
+    from . import mesh as meshlib
+
+    try:
+        devs = list(devices) if devices is not None else jax.local_devices()
+    except Exception as e:  # pragma: no cover - backend init failure
+        log_printf("mesh: device enumeration failed (%r); single-device", e)
+        return None
+    if max_devices is not None and max_devices > 0:
+        devs = devs[:max_devices]
+    n = len(devs)
+    if n <= 1:
+        return None
+    if shape is None:
+        shape = (1, n)
+    if shape[0] * shape[1] != n:
+        log_printf(
+            "mesh: shape %dx%d != %d local devices; single-device path",
+            shape[0], shape[1], n)
+        return None
+    try:
+        return meshlib.make_mesh(devs, shape)
+    except Exception as e:  # pragma: no cover - defensive
+        log_printf("mesh: init failed (%r); single-device path", e)
+        return None
+
+
+def _default_slab_loader(epoch: int, threads: int = 0):
+    """(l1, dag) for a real epoch — the BatchVerifier.from_epoch recipe:
+    native L1 always; the DAG slab built on device on real accelerators,
+    by the native CPU threads otherwise."""
+    import jax
+
+    from ..crypto import kawpow
+
+    l1 = np.frombuffer(kawpow.l1_cache(epoch), dtype="<u4").copy()
+    if jax.default_backend() != "cpu":
+        from ..ops.ethash_dag_jax import build_epoch_slab
+
+        dag = build_epoch_slab(epoch)
+    else:
+        dag = kawpow.dataset_slab(epoch, threads=threads)
+    return l1, dag
+
+
+class MeshBackend:
+    """Owns the device mesh and every epoch's device-resident serving state.
+
+    Consumers never construct their own device calls: header sync pulls
+    ``verifier(epoch)`` (the ``kawpow_batch_factory`` contract), the
+    miner sweeps through :meth:`search_sweep`, the pool validates through
+    :meth:`validate_shares`.  All three serve from the same resident
+    slab, so the mesh pays for one replication per epoch, not three.
+    """
+
+    def __init__(self, mesh=None, slab_threads: int = 0,
+                 resident_epochs: int = 2,
+                 slab_loader: Optional[Callable] = None,
+                 verifier_factory: Optional[Callable] = None,
+                 mesh_factory: Optional[Callable] = None):
+        self.slab_threads = slab_threads
+        self.resident_epochs = max(1, resident_epochs)
+        self._slab_loader = slab_loader or _default_slab_loader
+        # (l1, dag, mesh) -> verifier; injectable so residency/demotion
+        # tests run without paying a BatchVerifier XLA compile
+        self._verifier_factory = verifier_factory
+        self._lock = threading.Lock()
+        # mesh construction may be DEFERRED (mesh_factory): touching the
+        # device runtime (jax init, seconds to tens of seconds on real
+        # hardware) must stay off the daemon's blocking startup path —
+        # the first consumer to need the mesh (a background epoch build,
+        # an RPC describe) resolves it once
+        self._mesh = mesh
+        self._mesh_factory = mesh_factory
+        self._mesh_lock = threading.Lock()
+        # epoch -> ready verifier (BatchVerifier tagged .backend_path);
+        # ordered by last ensure so eviction drops the stalest epoch
+        self._resident: "OrderedDict[int, object]" = OrderedDict()
+        self._failed: set = set()  # {(epoch, path)} — NEVER epoch alone
+        # notified when residency eviction drops an epoch, so the
+        # EpochManager can forget its warm memo and rebuild on demand
+        self.on_evict: Optional[Callable[[int], None]] = None
+        if mesh_factory is None:
+            self._publish_shape()
+
+    @property
+    def mesh(self):
+        factory = self._mesh_factory
+        if factory is not None:
+            with self._mesh_lock:
+                if self._mesh_factory is not None:
+                    self._mesh = self._mesh_factory()
+                    self._mesh_factory = None
+                    self._publish_shape()
+        return self._mesh
+
+    def _publish_shape(self) -> None:
+        _M_DEVICES.set(self.n_devices)
+        h, l = self.shape
+        _M_SHAPE.set(h, axis="headers")
+        _M_SHAPE.set(l, axis="lanes")
+
+    # -- shape & introspection ---------------------------------------------
+
+    @classmethod
+    def from_args(cls, mesh_shape: str = "", max_devices: int = 0,
+                  slab_threads: int = 0) -> "MeshBackend":
+        """Daemon entry: ``-meshshape``/``-tpudevices``.  The shape is
+        validated NOW (a typo must refuse startup) but the mesh itself
+        resolves lazily on first use — device-runtime init never sits on
+        the blocking boot path."""
+        shape = parse_mesh_shape(mesh_shape)
+        backend = cls(
+            slab_threads=slab_threads,
+            mesh_factory=lambda: build_mesh(shape, max_devices or None),
+        )
+        log_printf(
+            "mesh backend: shape %s, device cap %s (mesh resolves on "
+            "first use)",
+            "auto" if shape is None else f"{shape[0]}x{shape[1]}",
+            max_devices or "all",
+        )
+        return backend
+
+    @property
+    def n_devices(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        if self.mesh is None:
+            return (1, 1)
+        from . import mesh as meshlib
+
+        return (self.mesh.shape[meshlib.HEADER_AXIS],
+                self.mesh.shape[meshlib.LANE_AXIS])
+
+    def default_path(self) -> str:
+        return PATH_MESH if self.mesh is not None else PATH_SINGLE
+
+    def describe(self) -> dict:
+        """RPC-facing summary (getmininginfo/getpoolinfo "mesh" field)."""
+        with self._lock:
+            resident = {
+                str(e): getattr(v, "backend_path", PATH_SINGLE)
+                for e, v in self._resident.items()
+            }
+        h, l = self.shape
+        return {
+            "devices": self.n_devices,
+            "shape": f"{h}x{l}",
+            "path": self.default_path(),
+            "resident_epochs": resident,
+        }
+
+    def describe_str(self) -> str:
+        h, l = self.shape
+        return (f"{self.n_devices} device(s), shape {h}x{l} "
+                f"(headers x lanes), default path {self.default_path()}")
+
+    # -- residency ---------------------------------------------------------
+
+    def device_paths(self) -> Tuple[str, ...]:
+        """Serving paths this backend can try, strongest first."""
+        return (PATH_MESH, PATH_SINGLE) if self.mesh is not None \
+            else (PATH_SINGLE,)
+
+    def failed_paths(self, epoch: int) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(p for (e, p) in self._failed if e == epoch)
+
+    def verifier(self, epoch: int):
+        """Resident verifier for ``epoch`` or None — non-blocking, the
+        ``kawpow_batch_factory`` / pool ``epoch_manager`` contract."""
+        with self._lock:
+            v = self._resident.get(epoch)
+            if v is not None:
+                self._resident.move_to_end(epoch)
+            return v
+
+    def path_for(self, epoch: int) -> str:
+        v = self.verifier(epoch)
+        if v is None:
+            return PATH_SCALAR
+        return getattr(v, "backend_path", PATH_SINGLE)
+
+    def _self_check(self, verifier, epoch: int) -> bool:
+        """Known-answer gate per (epoch, path) — override point for
+        tests; production defers to BatchVerifier.self_check (one probe
+        header vs the native scalar engine, bit-for-bit)."""
+        from ..crypto import kawpow
+
+        return verifier.self_check(epoch * kawpow.EPOCH_LENGTH)
+
+    def build_epoch(self, epoch: int):
+        """BLOCKING build of epoch's device serving state (the
+        EpochManager calls this from its background worker thread).
+
+        Loads the slab once, then walks the path ladder mesh -> single:
+        each candidate verifier must pass the known-answer self-check or
+        its (epoch, path) is memoized failed and the next path is tried.
+        Returns the installed verifier, or None when every device path
+        failed (callers stay on the scalar native engine).
+        """
+        with self._lock:
+            v = self._resident.get(epoch)
+            paths = [p for p in self.device_paths()
+                     if (epoch, p) not in self._failed]
+        if v is not None:
+            return v
+        if not paths:
+            return None  # all device paths memoized failed
+        l1, dag = self._slab_loader(epoch, self.slab_threads)
+        factory = self._verifier_factory
+        if factory is None:
+            from ..ops.progpow_jax import BatchVerifier
+
+            factory = BatchVerifier
+
+        for path in paths:
+            mesh = self.mesh if path == PATH_MESH else None
+            try:
+                verifier = factory(l1, dag, mesh=mesh)
+                if not self._self_check(verifier, epoch):
+                    raise RuntimeError(
+                        f"epoch {epoch} {path}-path verifier failed the "
+                        "known-answer cross-check against the native engine"
+                    )
+            except Exception as e:
+                # fail CLOSED and memoize per (epoch, path): a broken
+                # mesh lowering must not cost a slab rebuild every
+                # scheduler tick — and must not block the next path
+                log_printf(
+                    "mesh: epoch %d %s path failed self-check, demoting "
+                    "(restart to retry): %r", epoch, path, e)
+                _M_DEMOTIONS.inc(path=path)
+                with self._lock:
+                    self._failed.add((epoch, path))
+                continue
+            verifier.backend_path = path
+            self._install(epoch, verifier, path)
+            return verifier
+        return None
+
+    def _install(self, epoch: int, verifier, path: str) -> None:
+        evicted: List[int] = []
+        with self._lock:
+            self._resident[epoch] = verifier
+            self._resident.move_to_end(epoch)
+            while len(self._resident) > self.resident_epochs:
+                old, _ = self._resident.popitem(last=False)
+                evicted.append(old)
+        _M_BUILDS.inc(path=path)
+        _M_RESIDENCY.set(1, epoch=str(epoch))
+        for old in evicted:
+            _M_RESIDENCY.set(0, epoch=str(old))
+            log_printf("mesh: evicted epoch %d slab (rollover)", old)
+            cb = self.on_evict
+            if cb is not None:
+                cb(old)
+        log_printf(
+            "mesh: epoch %d resident on the %s path (%d device(s))",
+            epoch, path, self.n_devices if path == PATH_MESH else 1)
+
+    def evict_epoch(self, epoch: int) -> None:
+        with self._lock:
+            gone = self._resident.pop(epoch, None) is not None
+        if gone:
+            _M_RESIDENCY.set(0, epoch=str(epoch))
+            cb = self.on_evict
+            if cb is not None:
+                cb(epoch)
+
+    def resident(self) -> Dict[int, str]:
+        with self._lock:
+            return {
+                e: getattr(v, "backend_path", PATH_SINGLE)
+                for e, v in self._resident.items()
+            }
+
+    # -- sharded entry points ----------------------------------------------
+
+    def _observe_shard(self, axis: str, batch: int) -> None:
+        h, l = self.shape
+        per = max(1, batch // (h * l))
+        _M_SHARD_SIZE.observe(per, axis=axis)
+
+    def verify_headers(self, epoch: int, entries):
+        """Batched header verification for one epoch's HEADERS group.
+
+        entries: (header_hash_le, nonce64, height, mix_le, target_le)
+        tuples (the BatchVerifier.verify_headers contract).  Returns
+        (results, path) or None when no slab is resident (the caller
+        falls back to the scalar native check)."""
+        v = self.verifier(epoch)
+        if v is None:
+            return None
+        self._observe_shard("headers", len(entries))
+        path = getattr(v, "backend_path", PATH_SINGLE)
+        return v.verify_headers(entries), path
+
+    def validate_shares(self, epoch: int, header_hashes: List[bytes],
+                        nonces: List[int], heights: List[int]):
+        """Pool micro-batch: one device call for a batch of shares.
+
+        Returns ([(final_le_int, mix_le_int)], path) or None when no
+        slab is resident (the pipeline runs its scalar fallback)."""
+        v = self.verifier(epoch)
+        if v is None:
+            return None
+        self._observe_shard("headers", len(header_hashes))
+        finals, mixes = v.hash_batch(header_hashes, nonces, heights)
+        path = getattr(v, "backend_path", PATH_SINGLE)
+        return [
+            (int.from_bytes(f[::-1], "little"),
+             int.from_bytes(m[::-1], "little"))
+            for f, m in zip(finals, mixes)
+        ], path
+
+    def search_sweep(self, header_hash_disp: bytes, height: int,
+                     target_le_int: int, start_nonce: int,
+                     batch: int = 2048):
+        """One mining sweep window, nonce lanes sharded over the mesh.
+
+        Resumes exactly at ``start_nonce`` and returns
+        ((hit-or-None, covered_width), path): callers advance by the
+        reported width, which preserves both the miner's per-slice
+        tip-staleness cadence and the pool's extranonce partitioning
+        (sessions own disjoint top nonce bits; a sweep never strays
+        outside [start_nonce, start_nonce + width)).  None when the
+        epoch has no resident slab."""
+        import time as _time
+
+        from ..crypto.kawpow import epoch_number
+
+        v = self.verifier(epoch_number(height))
+        if v is None:
+            return None
+        from ..mining.assembler import _hybrid_searcher
+        from .pow_search import record_search_batch
+
+        path = getattr(v, "backend_path", PATH_SINGLE)
+        searcher = _hybrid_searcher(v, batch)
+        t0 = _time.perf_counter()
+        hit, width = searcher.search_window(
+            header_hash_disp, height, target_le_int, start_nonce)
+        record_search_batch(_time.perf_counter() - t0, path=path)
+        self._observe_shard("lanes", width)
+        return (hit, width), path
+
+
+# --------------------------------------------------------------- dryrun
+
+
+def synthetic_spec_backend(n_devices: int, devices=None, seed: int = 0xD24,
+                           n_items: int = 512):
+    """(backend, l1, dag, spec) over ONE synthetic epoch — the shared
+    rig for the dryrun attestation and bench/mesh.py, so the slab shape,
+    the (2, N/2)-vs-(1, N) mesh pick, and the self-check policy cannot
+    silently diverge between them.
+
+    The backend's native known-answer gate is overridden to pass: a
+    synthetic slab has nothing native to cross-check, so the caller pins
+    results against ``spec`` (the executable-spec twin over the same
+    slab) instead.  ``spec(height, header_disp, nonce) -> (final_le,
+    mix_le)`` ints in the node convention."""
+    import jax
+
+    from ..crypto import progpow_ref as ppref
+
+    rng = np.random.default_rng(seed)
+    l1 = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    dag = rng.integers(0, 1 << 32, size=(n_items, 64), dtype=np.uint32)
+
+    class _SpecBackend(MeshBackend):
+        def _self_check(self, verifier, epoch):
+            return True
+
+    if devices is None:
+        devices = jax.devices("cpu")[:n_devices]
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(devices)} "
+            "(run with xla_force_host_platform_device_count)"
+        )
+    shape = (2, n_devices // 2) if n_devices % 2 == 0 and n_devices > 1 \
+        else (1, n_devices)
+    mesh = build_mesh(shape, devices=devices) if n_devices > 1 else None
+    backend = _SpecBackend(mesh=mesh, slab_loader=lambda e, t: (l1, dag))
+
+    def spec(height: int, header_disp: bytes, nonce64: int):
+        final, mix = ppref.kawpow_hash(
+            height, header_disp, nonce64, [int(x) for x in l1], n_items,
+            lambda i: dag[i].astype("<u4").tobytes(),
+        )
+        return (int.from_bytes(final[::-1], "little"),
+                int.from_bytes(mix[::-1], "little"))
+
+    return backend, l1, dag, spec
+
+
+def dryrun(n_devices: int) -> None:
+    """The multichip attestation, now a thin driver over the PRODUCTION
+    subsystem: a MeshBackend on an n-device mesh serves a synthetic
+    epoch through the same verify_headers / search_sweep /
+    validate_shares entry points the node uses, and every result is
+    pinned bit-exact against the executable spec.  Demotion is exercised
+    by failing the mesh self-check on a second backend.  Called (in a
+    re-exec'd CPU child) by ``__graft_entry__.dryrun_multichip``."""
+    from ..ops import sha256_jax as s256
+
+    # --- synthetic epoch served by the real backend (shared rig with
+    # bench/mesh.py: slab shape, mesh pick, and self-check policy live
+    # in ONE place)
+    backend, l1, dag, spec_at = synthetic_spec_backend(n_devices)
+    mesh = backend.mesh
+    assert mesh is not None, "mesh construction failed on the CPU devices"
+    shape = tuple(backend.shape)
+    epoch = 0
+    assert backend.build_epoch(epoch) is not None
+    assert backend.path_for(epoch) == PATH_MESH, backend.path_for(epoch)
+
+    header = bytes((i * 9 + 2) % 256 for i in range(32))
+    # height inside epoch 0: search_sweep derives the epoch from the
+    # height (the production contract), so it must hit the resident slab
+    height, nonce = 4_242, 0xC0FFEE
+    from ..crypto import kawpow as _kp
+
+    assert _kp.epoch_number(height) == epoch
+
+    def spec(nonce64):
+        return spec_at(height, header, nonce64)
+
+    # 1) production verify_headers: spec-valid accepted, tampered mix
+    # rejected, final bit-exact — through the headers-sharded mesh path
+    final_le_want, mix_le = spec(nonce)
+    hh = int.from_bytes(header[::-1], "little")
+    res, path = backend.verify_headers(
+        epoch, [(hh, nonce, height, mix_le, 1 << 256),
+                (hh, nonce, height, mix_le ^ 1, 1 << 256)])
+    assert path == PATH_MESH
+    (ok, final_le), (bad, _) = res
+    assert ok and final_le == final_le_want, "mesh verify diverged from spec"
+    assert not bad, "mesh verify accepted a tampered mix"
+
+    # 2) production validate_shares: the pool batch contract, bit-exact
+    nonces = [nonce, nonce + 1, nonce + 2]
+    fm, path = backend.validate_shares(
+        epoch, [header] * 3, nonces, [height] * 3)
+    assert path == PATH_MESH
+    for n64, (f_le, m_le) in zip(nonces, fm):
+        assert (f_le, m_le) == spec(n64), "share final/mix diverged"
+
+    # 3) production search_sweep: plant the window-min winner on a
+    # NON-zero shard (a shard-0-only implementation cannot pass), then
+    # require the backend to find it bit-exact and report a clean miss
+    sbatch = 64
+    per_shard = sbatch // n_devices
+    verifier = backend.verifier(epoch)
+    start = 90_000
+    for _ in range(8):
+        window = [start + i for i in range(sbatch)]
+        wf, _wm = verifier.hash_batch(
+            [header] * sbatch, window, [height] * sbatch)
+        vals = [int.from_bytes(f[::-1], "little") for f in wf]
+        i_min = min(range(sbatch), key=vals.__getitem__)
+        if i_min // per_shard > 0:
+            break
+        start += sbatch
+    else:
+        raise RuntimeError(
+            "could not place a window-min winner off shard 0 in 8 windows")
+    # route through the HybridSearch fast tier exactly as the miner does
+    from ..ops.progpow_search import HybridSearch
+
+    verifier._hybrid_search = HybridSearch(
+        verifier, fast_batch=sbatch, fallback_batch=sbatch, force_fast=True)
+    assert verifier._hybrid_search.kern.mesh is mesh, \
+        "fast tier did not inherit the backend mesh"
+    (hit, width), path = backend.search_sweep(
+        header, height, vals[i_min], start, batch=sbatch)
+    assert path == PATH_MESH
+    assert hit is not None and hit[0] == start + i_min, "sharded search miss"
+    assert (hit[1], hit[2]) == spec(hit[0]), "winner diverged from spec"
+    win_shard = (hit[0] - start) // per_shard
+    assert win_shard > 0, "winner unexpectedly on shard 0"
+    (miss, _w2), _ = backend.search_sweep(
+        header, height, 1, start, batch=sbatch)
+    assert miss is None, "backend must report a miss on impossible target"
+
+    # 4) fail-closed demotion: a backend whose mesh self-check rejects
+    # must memoize (epoch, mesh) failed and serve the SAME epoch on the
+    # single-device path — bit-exact with the mesh result above
+    class _DemotingBackend(MeshBackend):
+        def _self_check(self, verifier, epoch):
+            return verifier.mesh is None  # mesh path fails, single passes
+
+    demoted = _DemotingBackend(
+        mesh=mesh, slab_loader=lambda e, t: (l1, dag))
+    assert demoted.build_epoch(epoch) is not None
+    assert demoted.path_for(epoch) == PATH_SINGLE
+    assert (epoch, PATH_MESH) in demoted._failed
+    res2, path2 = demoted.verify_headers(
+        epoch, [(hh, nonce, height, mix_le, 1 << 256)])
+    assert path2 == PATH_SINGLE and res2[0][0]
+    assert res2[0][1] == final_le_want, "single-path demotion diverged"
+
+    # 5) legacy continuity: the sha256d mesh step (headers x lanes grid
+    # with cross-chip reductions) still compiles and runs on this mesh
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import mesh as meshlib
+
+    n_headers = shape[0] * 2
+    lane_batch = shape[1] * 64
+    headers80 = [bytes((i + j) % 256 for j in range(80))
+                 for i in range(n_headers)]
+    header_words = jnp.stack(
+        [s256.header_bytes_to_words(h) for h in headers80])
+    target_le = s256.target_to_le_words(1 << 252)
+
+    def step(hw):
+        hw = jax.lax.with_sharding_constraint(
+            hw, NamedSharding(mesh, P(meshlib.HEADER_AXIS)))
+        digests = s256.sha256d_headers(hw)
+        ok_verify = s256.le256_leq(s256.digest_le_words(digests), target_le)
+        return ok_verify, jnp.sum(ok_verify)
+
+    ok_verify, total = jax.jit(step)(header_words)
+    jax.block_until_ready((ok_verify, total))
+    assert ok_verify.shape == (n_headers,)
+
+    print(
+        f"dryrun_multichip ok: MeshBackend on mesh {shape} "
+        f"({n_devices} devices) served a synthetic epoch through the "
+        f"PRODUCTION entry points — verify_headers (headers sharded, "
+        f"slab replicated) accepted/rejected bit-exact vs the spec, "
+        f"validate_shares returned spec-exact finals/mixes for "
+        f"{len(nonces)} shares, search_sweep (lanes sharded, HybridSearch "
+        f"fast tier) found its planted winner on shard {win_shard} of "
+        f"{n_devices} (nonce {hit[0]:#x}) bit-exact and reported a clean "
+        f"miss; a failing mesh self-check demoted (epoch 0, mesh) to the "
+        f"single-device path with identical results; sha256d grid step "
+        f"ran with cross-chip reductions"
+    )

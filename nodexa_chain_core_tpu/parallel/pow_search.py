@@ -26,7 +26,9 @@ _M_BATCH_SECONDS = g_metrics.histogram(
     "nodexa_pow_batch_seconds",
     "Device round-trip latency of one sharded nonce-scan batch")
 _M_BATCHES = g_metrics.counter(
-    "nodexa_pow_batches_total", "Sharded search batches dispatched")
+    "nodexa_pow_batches_total",
+    "Search batches dispatched, labeled by backend path "
+    "(mesh|single|scalar)")
 # busy-seconds per wall-second: an EWMA of device duty cycle.  1.0 means
 # the search loop keeps the device saturated; the gap to 1.0 is host-side
 # stall (template assembly, staleness checks, GIL).
@@ -36,12 +38,13 @@ _M_DEVICE_UTIL = g_metrics.ewma(
     tau=30.0)
 
 
-def record_search_batch(dt: float) -> None:
+def record_search_batch(dt: float, path: str = "single") -> None:
     """Fold one device search round-trip into the shared pow metrics
-    (also called by the KawPow hybrid search in mining/assembler.py, so
-    every device-mining era reports through the same series)."""
+    (also called by the KawPow hybrid search in mining/assembler.py and
+    the MeshBackend, so every device-mining era reports through the same
+    series).  ``path`` labels the serving backend (mesh|single)."""
     _M_BATCH_SECONDS.observe(dt)
-    _M_BATCHES.inc()
+    _M_BATCHES.inc(path=path)
     _M_DEVICE_UTIL.update(dt)
 
 
@@ -90,7 +93,9 @@ class Sha256dMiner:
             self._mesh,
         )
         found_host = bool(found)  # device sync point: batch is complete
-        record_search_batch(time.perf_counter() - t0)
+        record_search_batch(
+            time.perf_counter() - t0,
+            path="mesh" if self._mesh is not None else "single")
         if not found_host:
             return False, 0, 0
         limbs = [int(x) for x in jax.device_get(hash_le)]

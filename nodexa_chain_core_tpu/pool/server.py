@@ -626,7 +626,12 @@ class StratumServer:
                 worker=w if w in self._worker_labels else "other"), 2)
             for w in workers
         }
+        backend = getattr(self.node, "mesh_backend", None)
+        mesh = backend.describe() if backend is not None else None
         return {
+            # mesh serving backend the share pipeline validates on
+            # (None = no backend; shares run single-device or scalar)
+            "mesh": mesh,
             "enabled": True,
             "bind": f"{self.host}:{self.port}",
             "uptime": int(now - self.started_at),

@@ -58,7 +58,8 @@ _M_SHARES = g_metrics.counter(
     "Stratum shares by result (accepted/duplicate/stale-job/low-diff/...)")
 _M_BATCH_SECONDS = g_metrics.histogram(
     "nodexa_pool_share_batch_seconds",
-    "Share-validation batch latency, labeled path=batched/scalar")
+    "Share-validation batch latency, labeled by serving path "
+    "(mesh|single|scalar)")
 _M_BATCH_SIZE = g_metrics.histogram(
     "nodexa_pool_share_batch_size",
     "Shares per validation micro-batch",
@@ -202,8 +203,11 @@ class SharePipeline:
         """Validate a micro-batch and dispatch each share's verdict.
 
         One device call per epoch present in the batch (in practice one:
-        epochs are 7500 blocks); shares whose epoch has no ready device
-        slab take the scalar native path — mirroring the headers-sync
+        epochs are 7500 blocks).  With a mesh serving backend on the node
+        the call routes through ``MeshBackend.validate_shares`` — one
+        sharded program across every local device, path-labeled
+        ``mesh``/``single``; shares whose epoch has no ready device slab
+        take the scalar native path — mirroring the headers-sync
         fallback policy bit for bit.
         """
         _M_BATCH_SIZE.observe(len(batch))
@@ -211,29 +215,41 @@ class SharePipeline:
         for s in batch:
             by_epoch.setdefault(s.job.epoch, []).append(s)
         for epoch, shares in by_epoch.items():
-            verifier = self._verifier_for_epoch(epoch)
-            if verifier is not None:
-                finals_mixes = self._device_hashes(verifier, shares)
-                path = "batched"
-            else:
+            finals_mixes, path = self._device_hashes(epoch, shares)
+            if finals_mixes is None:
                 finals_mixes = self._scalar_hashes(shares)
                 path = "scalar"
             for s, (final, mix) in zip(shares, finals_mixes):
                 self._judge(s, final, mix, path)
 
-    def _device_hashes(self, verifier, shares: List[Share]):
+    def _device_hashes(self, epoch: int, shares: List[Share]):
+        """((final, mix) ints, path) via the mesh backend when attached,
+        else the epoch manager's verifier; (None, None) = no device slab
+        resident for this epoch (caller runs the scalar path)."""
+        header_hashes = [s.job.header_hash_disp for s in shares]
+        nonces = [s.nonce for s in shares]
+        heights = [s.job.height for s in shares]
+        backend = getattr(self.node, "mesh_backend", None)
         t0 = time.perf_counter()
-        finals, mixes = verifier.hash_batch(
-            [s.job.header_hash_disp for s in shares],
-            [s.nonce for s in shares],
-            [s.job.height for s in shares],
-        )
-        _M_BATCH_SECONDS.observe(time.perf_counter() - t0, path="batched")
+        if backend is not None:
+            res = backend.validate_shares(epoch, header_hashes, nonces,
+                                          heights)
+            if res is None:
+                return None, None
+            finals_mixes, path = res
+            _M_BATCH_SECONDS.observe(time.perf_counter() - t0, path=path)
+            return finals_mixes, path
+        verifier = self._verifier_for_epoch(epoch)
+        if verifier is None:
+            return None, None
+        finals, mixes = verifier.hash_batch(header_hashes, nonces, heights)
+        path = getattr(verifier, "backend_path", "single")
+        _M_BATCH_SECONDS.observe(time.perf_counter() - t0, path=path)
         return [
             (int.from_bytes(f[::-1], "little"),
              int.from_bytes(m[::-1], "little"))
             for f, m in zip(finals, mixes)
-        ]
+        ], path
 
     def _scalar_hashes(self, shares: List[Share]):
         from ..crypto import kawpow

@@ -55,13 +55,14 @@ def generatetoaddress_tpu(node, params: List[Any]):
     spk = script_for_destination(dest)
     hashes = []
     asm = BlockAssembler(node.chainstate)
-    from ..mining.assembler import kawpow_verifier_for
+    from ..mining.assembler import kawpow_verifier_for, mesh_backend_for
 
     for _ in range(nblocks):
         block = asm.create_new_block(spk.raw)
         verifier = kawpow_verifier_for(node, block)
         if not mine_block_tpu(
-            block, node.params.algo_schedule, kawpow_verifier=verifier
+            block, node.params.algo_schedule, kawpow_verifier=verifier,
+            backend=mesh_backend_for(node, block),
         ):
             raise RPCError(RPC_MISC_ERROR, "nonce space exhausted")
         node.chainstate.process_new_block(block)
@@ -360,7 +361,7 @@ def getmininginfo(node, params: List[Any]):
 
     tip = node.chainstate.tip()
     miner = getattr(node, "background_miner", None)
-    return {
+    out = {
         "blocks": tip.height,
         "difficulty": _difficulty(tip.header.bits, node.params),
         "networkhashps": getnetworkhashps(node, []),
@@ -371,6 +372,12 @@ def getmininginfo(node, params: List[Any]):
         "chain": node.params.network,
         "warnings": "",
     }
+    backend = getattr(node, "mesh_backend", None)
+    if backend is not None:
+        # mesh serving backend: device count, (headers x lanes) shape,
+        # default path, and which epochs' DAG slabs are resident
+        out["mesh"] = backend.describe()
+    return out
 
 
 def getgenerate(node, params: List[Any]):

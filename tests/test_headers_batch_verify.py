@@ -105,3 +105,47 @@ def test_factory_none_epoch_falls_back(chain):
     fresh.kawpow_batch_factory = lambda epoch: None  # slab not built
     idxs = fresh.process_new_block_headers(headers)
     assert len(idxs) == 3
+
+
+def test_mesh_backend_routes_header_batches(chain):
+    """With a mesh backend on the chainstate, the HEADERS batch goes
+    through MeshBackend.verify_headers (ONE call, backend-owned path
+    label), not the factory verifier."""
+    params, headers = chain
+    fresh = ChainState(params)
+    inner = RecordingVerifier()
+
+    class _Backend:
+        def __init__(self):
+            self.calls = []
+
+        def verifier(self, epoch):
+            return inner  # resident
+
+        def verify_headers(self, epoch, entries):
+            self.calls.append((epoch, len(entries)))
+            return inner.verify_headers(entries), "mesh"
+
+    backend = _Backend()
+    fresh.mesh_backend = backend
+    # factory absent: the backend alone must carry the batch route
+    idxs = fresh.process_new_block_headers(headers)
+    assert len(idxs) == 3
+    assert backend.calls == [(0, 3)]
+    assert inner.batches == [3]
+
+
+def test_mesh_backend_nonresident_epoch_falls_back(chain):
+    params, headers = chain
+    fresh = ChainState(params)
+
+    class _Backend:
+        def verifier(self, epoch):
+            return None  # slab not resident
+
+        def verify_headers(self, epoch, entries):  # pragma: no cover
+            raise AssertionError("must not be called without residency")
+
+    fresh.mesh_backend = _Backend()
+    idxs = fresh.process_new_block_headers(headers)  # scalar fallback
+    assert len(idxs) == 3

@@ -1,0 +1,452 @@
+"""Mesh serving backend (parallel/backend.py): shape selection, slab
+residency + rollover eviction, (epoch, path) failure memoization with
+fail-closed demotion, and bit-exact parity of the production entry
+points (verify_headers / search_sweep / validate_shares) across
+mesh vs single-device vs the scalar executable spec on the virtual
+8-device CPU mesh the conftest provides.
+
+Budget split: residency/demotion/wiring tests run on injected fake
+verifiers (no XLA compile) and stay in the tier-1 lane; the bit-exact
+parity suite pays BatchVerifier compiles and is marked ``slow`` (the CI
+gate's pytest stage and the dedicated mesh stage cover it).
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nodexa_chain_core_tpu.parallel import backend as mb
+from nodexa_chain_core_tpu.parallel.backend import (
+    MeshBackend,
+    PATH_MESH,
+    PATH_SCALAR,
+    PATH_SINGLE,
+    build_mesh,
+    parse_mesh_shape,
+)
+
+N_ITEMS = 512
+
+
+def _synthetic_epoch(seed=0x3E5B):
+    rng = np.random.default_rng(seed)
+    l1 = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    dag = rng.integers(0, 1 << 32, size=(N_ITEMS, 64), dtype=np.uint32)
+    return l1, dag
+
+
+# ------------------------------------------------------- shape selection
+
+
+def test_parse_mesh_shape():
+    assert parse_mesh_shape("") is None
+    assert parse_mesh_shape("2x4") == (2, 4)
+    assert parse_mesh_shape("1X8") == (1, 8)
+    assert parse_mesh_shape("8") == (1, 8)
+    for bad in ("0x4", "2x-1", "axb", "2x", "x"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+
+
+def test_build_mesh_auto_and_fallbacks():
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest should provide 8 virtual devices"
+    # auto: every device on the lane axis
+    mesh = build_mesh(devices=devs[:8])
+    assert mesh is not None and mesh.devices.shape == (1, 8)
+    # pinned 2x4
+    mesh = build_mesh((2, 4), devices=devs[:8])
+    assert mesh.devices.shape == (2, 4)
+    # -tpudevices cap composes with auto shape
+    mesh = build_mesh(None, max_devices=4, devices=devs[:8])
+    assert mesh.devices.shape == (1, 4)
+    # one device: clean single-device fallback, not a 1x1 mesh
+    assert build_mesh(devices=devs[:1]) is None
+    # a shape that cannot tile the device count degrades, never raises
+    assert build_mesh((3, 3), devices=devs[:8]) is None
+
+
+# --------------------------------------------- residency (fake verifiers)
+
+
+class FakeVerifier:
+    """BatchVerifier stand-in: records its mesh, self-check scripted."""
+
+    def __init__(self, l1, dag, mesh=None):
+        self.mesh = mesh
+        self.calls = 0
+
+    def self_check(self, height):
+        return True
+
+    def hash_batch(self, hh, nonces, heights):
+        finals = [bytes(32) for _ in hh]
+        return finals, finals
+
+    def verify_headers(self, entries):
+        return [(True, 0)] * len(entries)
+
+
+def _fake_backend(mesh="mesh", fail_paths=(), resident_epochs=2,
+                  factory_log=None):
+    """Backend over fake verifiers; ``mesh`` may be any truthy sentinel —
+    residency logic never touches jax unless shard metrics need shapes,
+    so a real Mesh is only needed for shape introspection."""
+    import jax
+
+    real_mesh = build_mesh((2, 4), devices=jax.devices("cpu")[:8]) \
+        if mesh else None
+
+    def factory(l1, dag, mesh=None):
+        v = FakeVerifier(l1, dag, mesh=mesh)
+        if factory_log is not None:
+            factory_log.append((mesh is not None))
+        return v
+
+    class _Backend(MeshBackend):
+        def _self_check(self, verifier, epoch):
+            path = PATH_MESH if verifier.mesh is not None else PATH_SINGLE
+            return path not in fail_paths
+
+    return _Backend(
+        mesh=real_mesh,
+        slab_loader=lambda e, t: (None, None),
+        verifier_factory=factory,
+        resident_epochs=resident_epochs,
+    )
+
+
+def test_build_serves_mesh_path_and_memoizes():
+    log = []
+    backend = _fake_backend(factory_log=log)
+    v = backend.build_epoch(0)
+    assert v is not None and v.backend_path == PATH_MESH
+    assert backend.path_for(0) == PATH_MESH
+    assert backend.verifier(0) is v
+    # a second build is a residency hit, not a rebuild
+    assert backend.build_epoch(0) is v
+    assert log == [True]
+
+
+def test_mesh_selfcheck_failure_demotes_to_single():
+    """The satellite bugfix: a mesh self-check failure memoizes
+    (epoch, mesh) — it must NOT poison the healthy single-device path."""
+    log = []
+    backend = _fake_backend(fail_paths=(PATH_MESH,), factory_log=log)
+    v = backend.build_epoch(0)
+    assert v is not None and v.backend_path == PATH_SINGLE
+    assert backend.path_for(0) == PATH_SINGLE
+    assert set(backend.failed_paths(0)) == {PATH_MESH}
+    # both paths were attempted exactly once (mesh first, then single)
+    assert log == [True, False]
+    # a different epoch still tries the mesh path fresh
+    v1 = backend.build_epoch(1)
+    assert v1.backend_path == PATH_SINGLE  # fail_paths applies to all
+    assert set(backend.failed_paths(1)) == {PATH_MESH}
+
+
+def test_all_paths_failed_is_memoized_scalar():
+    log = []
+    backend = _fake_backend(fail_paths=(PATH_MESH, PATH_SINGLE),
+                            factory_log=log)
+    assert backend.build_epoch(0) is None
+    assert set(backend.failed_paths(0)) == {PATH_MESH, PATH_SINGLE}
+    assert backend.path_for(0) == PATH_SCALAR
+    n = len(log)
+    # memoized: another build attempt constructs NO new verifier
+    assert backend.build_epoch(0) is None
+    assert len(log) == n
+
+
+def test_residency_keeps_two_epochs_and_evicts_with_callback():
+    backend = _fake_backend(resident_epochs=2)
+    evicted = []
+    backend.on_evict = evicted.append
+    for e in (0, 1):
+        assert backend.build_epoch(e) is not None
+    assert set(backend.resident()) == {0, 1}
+    assert backend.build_epoch(2) is not None  # rollover
+    assert set(backend.resident()) == {1, 2}
+    assert evicted == [0]
+    assert backend.verifier(0) is None
+    assert backend.path_for(0) == PATH_SCALAR
+    # residency gauge followed the eviction
+    g = mb._M_RESIDENCY
+    assert g.value(epoch="0") == 0
+    assert g.value(epoch="1") == 1 and g.value(epoch="2") == 1
+    # an evicted epoch REBUILDS on demand (memoized-failure is per
+    # (epoch, path); eviction is not a failure)
+    assert backend.build_epoch(0) is not None
+    assert backend.verifier(0) is not None
+
+
+def _wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_epoch_manager_delegates_and_forgets_on_eviction(monkeypatch):
+    """EpochManager + backend: pre-warm installs into backend residency,
+    rollover eviction clears the warm memo so ensure rebuilds, and a
+    mesh-path failure is keyed (epoch, mesh) in the manager too."""
+    from nodexa_chain_core_tpu.crypto import kawpow
+    from nodexa_chain_core_tpu.node.epoch_manager import EpochManager
+
+    monkeypatch.setattr(kawpow, "EPOCH_LENGTH", 3)
+    monkeypatch.setattr(kawpow, "epoch_number", lambda h: h // 3)
+    monkeypatch.setattr(kawpow, "l1_cache", lambda e: b"\x00" * 16384)
+
+    backend = _fake_backend(fail_paths=(PATH_MESH,))
+    mgr = EpochManager(tpu_verify=True, backend=backend)
+    mgr.ensure_for_height(0)  # warms epochs 0 and 1
+    assert _wait_for(lambda: mgr.verifier(0) is not None
+                     and mgr.verifier(1) is not None)
+    assert mgr.verifier(0).backend_path == PATH_SINGLE
+    assert (0, PATH_MESH) in mgr._failed
+    assert (0, PATH_SINGLE) not in mgr._failed
+    # rollover: warming epoch 2/3 evicts 0 and 1; the manager must
+    # forget them so a later ensure rebuilds
+    mgr.ensure_for_height(6)
+    assert _wait_for(lambda: mgr.verifier(2) is not None
+                     and mgr.verifier(3) is not None)
+    assert _wait_for(lambda: mgr.verifier(0) is None)
+    assert 0 not in mgr._warm
+    mgr.ensure_for_height(0)
+    assert _wait_for(lambda: mgr.verifier(0) is not None)
+
+
+def test_epoch_manager_all_paths_failed_stops_rescheduling(monkeypatch):
+    from nodexa_chain_core_tpu.crypto import kawpow
+    from nodexa_chain_core_tpu.node.epoch_manager import EpochManager
+
+    monkeypatch.setattr(kawpow, "epoch_number", lambda h: h // 3)
+    monkeypatch.setattr(kawpow, "l1_cache", lambda e: b"\x00" * 16384)
+    log = []
+    backend = _fake_backend(fail_paths=(PATH_MESH, PATH_SINGLE),
+                            factory_log=log)
+    mgr = EpochManager(tpu_verify=True, backend=backend)
+    mgr.ensure_for_height(0)
+    assert _wait_for(
+        lambda: (0, PATH_SINGLE) in mgr._failed
+        and (1, PATH_SINGLE) in mgr._failed)
+    n = len(log)
+    mgr.ensure_for_height(0)  # the scheduler tick must be a no-op now
+    time.sleep(0.1)
+    assert len(log) == n
+    assert mgr.verifier(0) is None  # scalar fallback forever
+
+
+def test_native_cache_failure_memoized_without_device_paths(monkeypatch):
+    """tpu_verify=False regression: a deterministic native-cache build
+    failure must be memoized (the single-path key) so the scheduler tick
+    doesn't re-run the expensive build forever."""
+    from nodexa_chain_core_tpu.crypto import kawpow
+    from nodexa_chain_core_tpu.node.epoch_manager import EpochManager
+
+    calls = []
+
+    def boom(epoch):
+        calls.append(epoch)
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(kawpow, "epoch_number", lambda h: h // 3)
+    monkeypatch.setattr(kawpow, "l1_cache", boom)
+    mgr = EpochManager(tpu_verify=False)
+    mgr.ensure_for_height(0)
+    assert _wait_for(
+        lambda: (0, "single") in mgr._failed and (1, "single") in mgr._failed)
+    n = len(calls)
+    mgr.ensure_for_height(0)  # the next scheduler tick: a no-op
+    time.sleep(0.1)
+    assert len(calls) == n
+    assert mgr.verifier(0) is None
+
+
+def test_describe_surfaces_shape_and_residency():
+    backend = _fake_backend()
+    backend.build_epoch(7)
+    d = backend.describe()
+    assert d["devices"] == 8 and d["shape"] == "2x4"
+    assert d["path"] == PATH_MESH
+    assert d["resident_epochs"] == {"7": PATH_MESH}
+    single = MeshBackend(mesh=None, slab_loader=lambda e, t: (None, None),
+                         verifier_factory=FakeVerifier)
+    assert single.describe()["devices"] == 1
+    assert single.describe()["path"] == PATH_SINGLE
+    assert single.device_paths() == (PATH_SINGLE,)
+
+
+# ----------------------------------------- bit-exact parity (slow, XLA)
+
+
+@pytest.fixture(scope="module")
+def parity_rig():
+    """Mesh + single backends over ONE synthetic epoch, with the scalar
+    engine routed through the executable-spec twin — every path hashes
+    the same epoch data, so verdicts must agree bit-for-bit.  Module
+    scoped: the two BatchVerifier compiles dominate the suite's cost."""
+    from nodexa_chain_core_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    import jax
+
+    from nodexa_chain_core_tpu.crypto import kawpow, progpow_ref
+
+    l1, dag = _synthetic_epoch()
+    l1_list = [int(x) for x in l1]
+
+    def spec_hash(height, header_hash_le, nonce64):
+        final, mix = progpow_ref.kawpow_hash(
+            height, header_hash_le.to_bytes(32, "little")[::-1], nonce64,
+            l1_list, N_ITEMS, lambda i: dag[i].astype("<u4").tobytes(),
+        )
+        return (int.from_bytes(final[::-1], "little"),
+                int.from_bytes(mix[::-1], "little"))
+
+    mp = pytest.MonkeyPatch()
+    mp.setattr(kawpow, "kawpow_hash", spec_hash)
+    loader = lambda e, t: (l1, dag)  # noqa: E731
+    mesh = build_mesh((2, 4), devices=jax.devices("cpu")[:8])
+    meshed = MeshBackend(mesh=mesh, slab_loader=loader)
+    single = MeshBackend(mesh=None, slab_loader=loader)
+    # the REAL known-answer gate runs against the spec twin: both builds
+    # must pass it (no _self_check override — that's the production gate)
+    assert meshed.build_epoch(0) is not None
+    assert single.build_epoch(0) is not None
+    assert meshed.path_for(0) == PATH_MESH
+    assert single.path_for(0) == PATH_SINGLE
+    yield meshed, single, spec_hash, l1, dag
+    mp.undo()
+
+
+@pytest.mark.slow
+def test_parity_verify_headers(parity_rig):
+    meshed, single, spec_hash, l1, dag = parity_rig
+    header = bytes((i * 3 + 1) % 256 for i in range(32))
+    hh = int.from_bytes(header[::-1], "little")
+    height, nonce = 77, 0xBEEF
+    final, mix = spec_hash(height, hh, nonce)
+    entries = [
+        (hh, nonce, height, mix, 1 << 256),       # valid
+        (hh, nonce, height, mix ^ 2, 1 << 256),   # tampered mix
+        (hh, nonce, height, mix, final - 1),      # boundary miss
+        (hh, nonce, height, mix, final),          # boundary exact
+    ]
+    res_m, path_m = meshed.verify_headers(0, entries)
+    res_s, path_s = single.verify_headers(0, entries)
+    assert path_m == PATH_MESH and path_s == PATH_SINGLE
+    assert res_m == res_s
+    assert [ok for ok, _ in res_m] == [True, False, False, True]
+    assert res_m[0][1] == final  # bit-exact final vs the spec
+
+
+@pytest.mark.slow
+def test_parity_search_winner_and_miss(parity_rig):
+    meshed, single, spec_hash, l1, dag = parity_rig
+    header = bytes((i * 7 + 3) % 256 for i in range(32))
+    height = 100
+    batch = 64
+    per_shard = batch // 8
+    verifier = meshed.verifier(0)
+    # window-min winner placed off shard 0: a shard-0-only sweep cannot
+    # pass, and target==min means exactly one winner
+    start = 10_000
+    for _ in range(8):
+        window = [start + i for i in range(batch)]
+        wf, _ = verifier.hash_batch([header] * batch, window,
+                                    [height] * batch)
+        vals = [int.from_bytes(f[::-1], "little") for f in wf]
+        i_min = min(range(batch), key=vals.__getitem__)
+        if i_min // per_shard > 0:
+            break
+        start += batch
+    else:
+        pytest.fail("could not place a window-min winner off shard 0")
+    (hit_m, width_m), path_m = meshed.search_sweep(
+        header, height, vals[i_min], start, batch=batch)
+    (hit_s, width_s), path_s = single.search_sweep(
+        header, height, vals[i_min], start, batch=batch)
+    assert path_m == PATH_MESH and path_s == PATH_SINGLE
+    assert hit_m is not None and hit_s is not None
+    assert hit_m == hit_s
+    assert hit_m[0] == start + i_min
+    assert (hit_m[0] - start) // per_shard > 0
+    want = spec_hash(height, int.from_bytes(header[::-1], "little"),
+                     hit_m[0])
+    assert (hit_m[1], hit_m[2]) == want, "search diverged from the spec"
+    assert width_m >= batch // 8 and width_s >= 1
+    # miss: impossible target comes back clean on both paths
+    (miss_m, _), _ = meshed.search_sweep(header, height, 1, start,
+                                         batch=batch)
+    (miss_s, _), _ = single.search_sweep(header, height, 1, start,
+                                         batch=batch)
+    assert miss_m is None and miss_s is None
+
+
+@pytest.mark.slow
+def test_parity_share_verdict_taxonomy(parity_rig):
+    """SharePipeline verdicts (accepted / bad-mix / low-diff / block)
+    must be identical on the mesh, single-device, and scalar-spec paths,
+    and the share-batch histogram must carry all three path labels."""
+    from nodexa_chain_core_tpu.pool import shares as sh
+    from nodexa_chain_core_tpu.pool.shares import Share, SharePipeline
+    from nodexa_chain_core_tpu.telemetry import g_metrics
+
+    meshed, single, spec_hash, l1, dag = parity_rig
+    header = bytes((i * 5 + 11) % 256 for i in range(32))
+    hh_le = int.from_bytes(header[::-1], "little")
+    height = 200
+    verifier = meshed.verifier(0)
+    nonces = [1000 + i for i in range(8)]
+    finals, mixes = verifier.hash_batch([header] * len(nonces), nonces,
+                                        [height] * len(nonces))
+    cands = [
+        (n, int.from_bytes(f[::-1], "little"),
+         int.from_bytes(m[::-1], "little"))
+        for n, f, m in zip(nonces, finals, mixes)
+    ]
+    # share target between the min and max final: some accept, some
+    # reject low-diff; network target 0 suppresses block submission
+    vals = sorted(f for _, f, _ in cands)
+    share_target = vals[len(vals) // 2]
+    job = SimpleNamespace(epoch=0, header_hash_disp=header,
+                          header_hash_le=hh_le, height=height, target=0)
+
+    def run(node):
+        out = []
+        pipe = SharePipeline(node)
+        batch = []
+        for i, (n, _f, m) in enumerate(cands):
+            mix = m ^ 1 if i == 0 else m  # share 0: fabricated mix
+            batch.append(Share(
+                None, i, "w", job, n, mix, share_target,
+                lambda s, ok, r: out.append((s.req_id, ok, r))))
+        pipe.validate_batch(batch)
+        return sorted(out)
+
+    mesh_node = SimpleNamespace(mesh_backend=meshed, epoch_manager=None)
+    single_node = SimpleNamespace(mesh_backend=single, epoch_manager=None)
+    scalar_node = SimpleNamespace(mesh_backend=None, epoch_manager=None)
+    r_mesh = run(mesh_node)
+    r_single = run(single_node)
+    r_scalar = run(scalar_node)
+    assert r_mesh == r_single == r_scalar, "verdict taxonomy diverged"
+    reasons = {r for _, _, r in r_mesh}
+    assert sh.R_BAD_MIX in reasons
+    assert sh.R_ACCEPTED in reasons
+    assert sh.R_LOW_DIFF in reasons
+    hist = g_metrics.get("nodexa_pool_share_batch_seconds")
+    for path in (PATH_MESH, PATH_SINGLE, PATH_SCALAR):
+        snap = hist.snapshot(path=path)
+        assert snap is not None and snap["count"] >= 1, path

@@ -463,7 +463,9 @@ def test_batched_vs_scalar_share_parity(pool_node):
     from nodexa_chain_core_tpu.telemetry import g_metrics
 
     hist = g_metrics.get("nodexa_pool_share_batch_seconds")
-    assert hist.snapshot(path="batched")["count"] >= 1
+    # device path label is the serving-backend path: a bare verifier
+    # (no mesh backend on the node) is the single-device path
+    assert hist.snapshot(path="single")["count"] >= 1
     assert hist.snapshot(path="scalar")["count"] >= 1
 
 

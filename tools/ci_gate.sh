@@ -24,38 +24,45 @@
 #                      accepted shares on the batched device path AND the
 #                      scalar fallback, plus a winning share landing a
 #                      block through ConnectTip, all asserted
-#   7. tx admission    bench/txflood.py --assert-fast-path — a concurrent
+#   7. mesh backend    bench/mesh.py --assert-mesh — the mesh serving
+#                      backend on a forced 8-host-device mesh: known-
+#                      answer pins vs the executable spec, then verify/
+#                      share/search throughput at n_devices=8 vs 1,
+#                      asserting the backend actually served path=mesh
+#                      (the bit-exact parity suite itself runs in the
+#                      pytest stage: tests/test_mesh_backend.py)
+#   8. tx admission    bench/txflood.py --assert-fast-path — a concurrent
 #                      pre-signed tx flood through both admission paths,
 #                      asserting staged >= 2x inline accepts/s, cs_main
 #                      hold p99 below the off-lock scripts-stage mean
 #                      (ECDSA demonstrably outside the lock), and an
 #                      identical reject taxonomy on both paths
-#   8. fault tolerance tests/test_fault_tolerance.py (fast subset) —
+#   9. fault tolerance tests/test_fault_tolerance.py (fast subset) —
 #                      deterministic fault-injection specs, a kill-at-
 #                      site crash-recovery pair per tier-1 site asserting
 #                      restart converges to the uninterrupted tip, the
 #                      safe-mode degradation surface, and the startup
 #                      self-check refusing a corrupted undo journal
 #                      (full matrix + daemon e2e run under -m slow)
-#   9. vectors         generate_x16r_vectors.py --check — the committed
+#  10. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  10. native build    compiles the C++ engine (also feeds the wheel)
-#  11. static checks   tools/typecheck.py over the consensus-critical
+#  11. native build    compiles the C++ engine (also feeds the wheel)
+#  12. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  12. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  13. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  13. pytest          unit suite (functional suite with --full)
-#  14. wheel           platform-tagged wheel incl. the native .so,
+#  14. pytest          unit suite (functional suite with --full)
+#  15. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/13] lint"
+echo "== [1/15] lint"
 python tools/lint.py
 
-echo "== [2/13] import graph"
+echo "== [2/15] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -73,13 +80,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/13] rpc mapping parity"
+echo "== [3/15] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/13] telemetry exposition"
+echo "== [4/15] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/13] IBD fast path (synthetic)"
+echo "== [5/15] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -91,7 +98,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/13] pool stratum e2e (loopback)"
+echo "== [6/15] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -102,7 +109,18 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/13] tx admission fast path (flood)"
+echo "== [7/15] mesh serving backend (forced 8-device mesh)"
+# same no-pipe discipline: the assert's exit status must reach set -e
+# and the per-device JSON diagnostics must surface on failure
+MESH_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
+        --assert-mesh > "$MESH_LOG" 2>&1; then
+    cat "$MESH_LOG"; rm -f "$MESH_LOG"
+    exit 1
+fi
+tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
+
+echo "== [8/15] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -113,7 +131,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [8/14] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [9/15] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -124,24 +142,24 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [9/14] crypto vector regeneration"
+echo "== [10/15] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [10/14] native engine build"
+echo "== [11/15] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [11/14] static checks (consensus-critical packages)"
+echo "== [12/15] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [12/14] native hardening (security-check analog)"
+echo "== [13/15] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [13/14] pytest"
-# telemetry + fault-tolerance suites already ran as stages 4/8: don't
+echo "== [14/15] pytest"
+# telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
     python -m pytest tests/ -q --ignore=tests/test_telemetry.py \
@@ -152,7 +170,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [14/14] wheel"
+echo "== [15/15] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -46,9 +46,10 @@ miners connect and after a share interval, then read the delta's
   nodexa_pool_shares_total{result=accepted|duplicate|stale-job|...}
       — the share ledger by verdict; low-diff climbing means vardiff
       lags the fleet, stale-job climbing means notify fanout is slow
-  nodexa_pool_share_batch_seconds{path=batched|scalar}
+  nodexa_pool_share_batch_seconds{path=mesh|single|scalar}
       — validation latency per micro-batch; `scalar` samples mean the
-      epoch's device slab wasn't ready (check -tpukawpow / epoch logs)
+      epoch's device slab wasn't ready (check -tpukawpow / epoch logs),
+      `single` on a multi-device node means the mesh path was demoted
   nodexa_pool_share_batch_size
       — how full micro-batches run (1-share batches = light load)
   nodexa_pool_notify_seconds / nodexa_pool_vardiff_retargets_total
@@ -61,6 +62,27 @@ miners connect and after a share interval, then read the delta's
   ... miners hammer the stratum port ...
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_pool.json | python -m json.tool | grep -A4 nodexa_pool
+
+Diffing a mesh-serving interval (-tpukawpow on a multi-device node):
+snapshot before and after a sync/mining/pool interval, then read the
+delta's
+
+  nodexa_headers_pow_verified_total{path=mesh|single|scalar} and
+  nodexa_pool_share_batch_seconds{path=...}
+      — which serving path actually carried the load; `single` growing
+      on a multi-device node means an epoch's mesh self-check demoted
+      (check nodexa_mesh_demotions_total and the epoch logs)
+  nodexa_mesh_shard_size{axis=headers|lanes}
+      — per-device shard of each sharded call (shards of 1 mean batches
+      too small to spread; raise the batch or shrink the mesh)
+  nodexa_dag_residency{epoch=...} (gauge pair)
+      — slab residency across an epoch rollover: the outgoing epoch
+      should drop to 0 only after the incoming one reached 1
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_mesh.json
+  ... sync headers / mine / serve shares ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_mesh.json | python -m json.tool | grep -E "mesh|residency"
 
 Diffing a tx flood (the PR-4 staged-admission proof): snapshot before
 relaying a burst of transactions at the node and after the mempool
