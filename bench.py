@@ -538,6 +538,27 @@ def bench_sha256d(on_tpu: bool) -> dict:
     }
 
 
+def bench_startup() -> dict:
+    """Restart-to-first-sweep (ROADMAP item 2's headline): a cold child
+    process imports the package, compiles the verify + search kernels
+    over a small synthetic epoch and completes one sweep; a second child
+    against the same persistent compile cache measures the warm restart.
+    Details in nodexa_chain_core_tpu/bench/startup.py."""
+    from nodexa_chain_core_tpu.bench.startup import measure
+
+    t = time.perf_counter()
+    res = measure()
+    warm = res.get("startup_to_first_sweep_warm_s")
+    log(f"[startup] cold restart to first sweep "
+        f"{res['startup_to_first_sweep_s']:.1f}s (import "
+        f"{res['startup_import_s']:.1f}s, first verify "
+        f"{res['startup_first_verify_s']:.1f}s, "
+        f"{res['startup_jit_compiles']} attributed compiles); warm "
+        f"{warm if warm is not None else float('nan'):.1f}s "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return res
+
+
 def bench_mesh() -> dict:
     """Mesh serving backend (parallel/backend.py): headers-verify,
     pool-share, and search throughput at n_devices=8 vs 1, measured in
@@ -652,6 +673,8 @@ def main() -> None:
         extra.update(bench_pool())
     if not os.environ.get("NODEXA_BENCH_SKIP_MESH"):
         extra.update(bench_mesh())
+    if not os.environ.get("NODEXA_BENCH_SKIP_STARTUP"):
+        extra.update(bench_startup())
 
     value = extra.pop("kawpow_search_tpu_hs")
     baseline = extra["kawpow_native_cpu_hs"]

@@ -1,0 +1,156 @@
+"""Restart-to-first-sweep bench: the ROADMAP item-2 headline number.
+
+BENCH_r05's probe showed a fresh process paying 54-65 s before its
+first sweep; this module turns that observation into a tracked metric.
+A CHILD process is spawned cold (fresh interpreter, the real import
+path), builds the serving kernels over a small synthetic epoch —
+``BatchVerifier`` (the jitted header/share-verify program, the
+startup-critical compile on every backend) and ``SearchKernel`` — and
+runs one verify batch plus one nonce sweep.  The parent's wall clock
+from spawn to the child's completion line IS ``startup_to_first_sweep_s``.
+
+Run twice against one persistent-compile-cache directory, the second
+child measures the warm restart (``startup_to_first_sweep_warm_s``) —
+the number that must approach zero once the AOT cache work lands, and
+today documents exactly how little the cache helps.
+
+The child also asserts the compile-attribution ledger fired: a cold
+process must report per-kernel ``nodexa_jit_compiles_total`` entries,
+pinning the ops-layer wiring end to end.
+
+CLI (the ci_gate observability stage):
+
+  python -m nodexa_chain_core_tpu.bench.startup --skip-warm --assert-finite
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_CHILD = r"""
+import os, sys, time
+t0 = time.perf_counter()
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from nodexa_chain_core_tpu.utils.jitcache import enable_persistent_cache
+enable_persistent_cache({cache!r})
+import numpy as np
+from nodexa_chain_core_tpu.ops.progpow_jax import BatchVerifier
+from nodexa_chain_core_tpu.ops.progpow_search import SearchKernel
+t_import = time.perf_counter() - t0
+l1 = np.zeros(4096, np.uint32)
+dag = np.zeros(({rows}, 64), np.uint32)
+verifier = BatchVerifier(l1, dag)
+verifier.hash_batch([bytes(range(32))], [0xC0FFEE], [{height}])
+t_verify = time.perf_counter() - t0
+kern = SearchKernel.from_verifier(verifier)
+kern.sweep(bytes(range(32)), {height}, 1, 0, {batch})
+t_sweep = time.perf_counter() - t0
+from nodexa_chain_core_tpu.telemetry import g_metrics
+c = g_metrics.get("nodexa_jit_compiles_total")
+kernels = sorted({{dict(k).get("kernel") for k, _ in c.collect()}}) if c else []
+total = sum(v for _, v in c.collect()) if c else 0
+assert total >= 1, "cold process recorded no jit compiles"
+print("STARTUP_CHILD", __import__("json").dumps({{
+    "import_s": round(t_import, 3),
+    "first_verify_s": round(t_verify, 3),
+    "first_sweep_s": round(t_sweep, 3),
+    "jit_compiles": int(total),
+    "jit_kernels": kernels,
+}}))
+"""
+
+
+def _repo_root() -> str:
+    """The import root of THIS package — not cwd: the bench must work
+    when the parent was launched from outside the repository."""
+    import nodexa_chain_core_tpu as pkg
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(pkg.__file__)))
+
+
+def _run_child(cache_dir: str, rows: int = 256, batch: int = 64,
+               height: int = 1_000_000, timeout: float = 900.0) -> dict:
+    code = _CHILD.format(repo=_repo_root(), cache=cache_dir, rows=rows,
+                         batch=batch, height=height)
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    wall = time.perf_counter() - t0
+    for line in proc.stdout.splitlines():
+        if line.startswith("STARTUP_CHILD "):
+            out = json.loads(line[len("STARTUP_CHILD "):])
+            out["total_s"] = round(wall, 3)
+            return out
+    raise RuntimeError(
+        f"startup child failed (rc={proc.returncode}): "
+        f"{proc.stderr[-800:]}")
+
+
+def measure(skip_warm: bool = False, rows: int = 256,
+            batch: int = 64) -> dict:
+    """Cold (and optionally warm) restart-to-first-sweep, in seconds."""
+    cache = tempfile.mkdtemp(prefix="nxk_startup_jit_")
+    try:
+        cold = _run_child(cache, rows=rows, batch=batch)
+        out = {
+            "startup_to_first_sweep_s": cold["total_s"],
+            "startup_import_s": cold["import_s"],
+            "startup_first_verify_s": cold["first_verify_s"],
+            "startup_jit_compiles": cold["jit_compiles"],
+            "startup_jit_kernels": cold["jit_kernels"],
+        }
+        if not skip_warm:
+            warm = _run_child(cache, rows=rows, batch=batch)
+            out["startup_to_first_sweep_warm_s"] = warm["total_s"]
+            out["startup_warm_vs_cold"] = round(
+                warm["total_s"] / max(cold["total_s"], 1e-9), 3)
+        return out
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--skip-warm", action="store_true",
+                    help="measure only the cold child (ci_gate lane)")
+    ap.add_argument("--rows", type=int, default=256,
+                    help="synthetic slab rows (shape, not contents)")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--assert-finite", action="store_true",
+                    help="fail unless startup_to_first_sweep_s is a "
+                         "finite positive number and the cold child "
+                         "recorded per-kernel jit compiles")
+    args = ap.parse_args(argv)
+
+    res = measure(skip_warm=args.skip_warm, rows=args.rows,
+                  batch=args.batch)
+    print(json.dumps(res))
+    if args.assert_finite:
+        v = res["startup_to_first_sweep_s"]
+        assert isinstance(v, (int, float)) and math.isfinite(v) and v > 0, (
+            f"startup_to_first_sweep_s not finite/positive: {v!r}")
+        assert res["startup_jit_compiles"] >= 1, (
+            "cold child recorded no jit compiles — the compile "
+            "attribution wiring regressed")
+        print(f"startup bench OK: first sweep in {v:.1f}s, "
+              f"{res['startup_jit_compiles']} attributed compiles "
+              f"({', '.join(res['startup_jit_kernels'])})",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,10 +24,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import weakref
 from typing import Callable, List, Optional
 
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
 
 # -par observability: worker count is a config gauge, queue depth samples
 # the in-flight check backlog at scrape time (zero hot-path cost), and the
@@ -52,13 +53,22 @@ class CheckSession:
     in flight on one queue at once.
     """
 
-    __slots__ = ("_q", "_cond", "_pending", "_failed")
+    __slots__ = ("_q", "_cond", "_pending", "_failed", "_trace",
+                 "_trace_t0", "_trace_n", "_trace_threads")
 
     def __init__(self, q: "CheckQueue"):
         self._q = q
         self._cond = threading.Condition()
         self._pending = 0
         self._failed: Optional[str] = None
+        # causal tracing: a session created inside a traced request
+        # (block connect / staged admission) reports its whole fan-out as
+        # ONE child span at wait() — per-check instrumentation would cost
+        # a clock read per signature, this costs a set-add per completion
+        self._trace = tracing.current_span()
+        self._trace_t0: Optional[float] = None
+        self._trace_n = 0
+        self._trace_threads: set = set()
 
     def add(self, checks: List[Callable[[], Optional[str]]]) -> None:
         if not checks:
@@ -66,6 +76,9 @@ class CheckSession:
         # counted at enqueue, one locked add per BATCH — the per-check
         # fast path (workers and _run_one) stays uninstrumented
         _CHECKS_QUEUED.inc(len(checks))
+        if self._trace is not None and self._trace_t0 is None:
+            self._trace_t0 = time.perf_counter()
+        self._trace_n += len(checks)
         with self._cond:
             self._pending += len(checks)
         q = self._q
@@ -80,6 +93,8 @@ class CheckSession:
         with self._cond:
             if err and self._failed is None:
                 self._failed = err
+            if self._trace is not None:
+                self._trace_threads.add(threading.current_thread().name)
             self._pending -= 1
             if self._pending <= 0:
                 self._cond.notify_all()
@@ -98,7 +113,20 @@ class CheckSession:
             with self._cond:
                 if not self._pending:
                     failed, self._failed = self._failed, None
-                    return failed
+                    done = True
+                else:
+                    done = False
+            if done:
+                if self._trace is not None and self._trace_n:
+                    tracing.record_span(
+                        "scriptcheck.fanout", self._trace, self._trace_t0,
+                        checks=self._trace_n,
+                        threads=",".join(sorted(self._trace_threads)),
+                        status="error" if failed else "ok")
+                    self._trace_t0 = None
+                    self._trace_n = 0
+                    self._trace_threads.clear()
+                return failed
             try:
                 item = q._tasks.get_nowait()
             except queue.Empty:

@@ -50,7 +50,8 @@ from ..script.interpreter import (
     verify_script_fast,
 )
 from ..script.script import Script
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
+from ..telemetry.tracing import trace_span
 from .checkqueue import CheckQueueControl
 from .coins import Coin, CoinsViewCache
 from .mempool import CoinsViewMemPool, MempoolEntry, TxMemPool
@@ -138,31 +139,47 @@ def accept_to_memory_pool(
     if staged is None:
         staged = getattr(chainstate, "staged_mempool", True)
     path = "staged" if staged else "inline"
+    # causal trace: one root per submission; the staged stage bodies and
+    # the CheckQueue fan-out nest under it via the attached context
+    # (enabled() guard: the disabled path must not even pay the txid
+    # hex format — the -telemetryspans=0 zero-cost contract)
+    root = tracing.start_trace(
+        "mempool.accept", txid=f"{tx.txid:064x}"[:16], path=path,
+    ) if tracing.enabled() else None
     t0 = _time.perf_counter()
     try:
-        if staged:
-            entry = _accept_staged(
-                chainstate, pool, tx, bypass_limits, require_standard
-            )
-        else:
-            with chainstate.cs_main:
-                # hold time, not wait time: the clock starts once the
-                # lock is OURS (the histogram answers "how long do we
-                # keep everyone else out", not "how contended is it")
-                t_lock = _time.perf_counter()
-                entry = _accept_inline_locked(
+        with tracing.attach(root):
+            if staged:
+                entry = _accept_staged(
                     chainstate, pool, tx, bypass_limits, require_standard
                 )
-                hold = _time.perf_counter() - t_lock
-            _M_CSMAIN_HOLD.observe(hold, stage="inline")
+            else:
+                with chainstate.cs_main:
+                    # hold time, not wait time: the clock starts once the
+                    # lock is OURS (the histogram answers "how long do we
+                    # keep everyone else out", not "how contended is it")
+                    t_lock = _time.perf_counter()
+                    entry = _accept_inline_locked(
+                        chainstate, pool, tx, bypass_limits, require_standard
+                    )
+                    hold = _time.perf_counter() - t_lock
+                _M_CSMAIN_HOLD.observe(hold, stage="inline")
     except MempoolAcceptError as e:
         _M_REJECTED.inc(reason=e.code)
         _M_ACCEPTS.inc(result="rejected", path=path)
+        if root is not None:
+            root.finish(status="rejected", reason=e.code)
+        raise
+    except BaseException as e:
+        if root is not None:
+            root.finish(status="error", error=repr(e))
         raise
     finally:
         _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t0)
     _M_ACCEPTED.inc()
     _M_ACCEPTS.inc(result="accepted", path=path)
+    if root is not None:
+        root.finish(status="ok")
     return entry
 
 
@@ -555,34 +572,37 @@ def _accept_staged(
     require_standard: Optional[bool] = None,
 ) -> MempoolEntry:
     t = _time.perf_counter()
-    size = _stateless_checks(chainstate, tx, require_standard)
+    with trace_span("mempool.prechecks"):
+        size = _stateless_checks(chainstate, tx, require_standard)
     _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="prechecks")
 
     t = _time.perf_counter()
-    with chainstate.cs_main:
-        t_hold = _time.perf_counter()  # hold time: clock starts owned
-        ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
-        # claim the outpoints before dropping the lock: two mutually
-        # conflicting txs must not both reach commit with valid scripts
-        if not pool.reserve_outpoints(tx):
-            raise MempoolAcceptError(
-                "txn-mempool-conflict",
-                "input reserved by a concurrent admission",
-            )
-        hold = _time.perf_counter() - t_hold
+    with trace_span("mempool.snapshot"):
+        with chainstate.cs_main:
+            t_hold = _time.perf_counter()  # hold time: clock starts owned
+            ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
+            # claim the outpoints before dropping the lock: two mutually
+            # conflicting txs must not both reach commit with valid scripts
+            if not pool.reserve_outpoints(tx):
+                raise MempoolAcceptError(
+                    "txn-mempool-conflict",
+                    "input reserved by a concurrent admission",
+                )
+            hold = _time.perf_counter() - t_hold
     _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="snapshot")
     _M_CSMAIN_HOLD.observe(hold, stage="snapshot")
 
     try:
         t = _time.perf_counter()
-        _script_checks_parallel(chainstate, tx, ctx)
+        with trace_span("mempool.scripts"):
+            _script_checks_parallel(chainstate, tx, ctx)
         _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="scripts")
 
         if _test_hook_after_scripts is not None:
             _test_hook_after_scripts(tx)
 
         t = _time.perf_counter()
-        with chainstate.cs_main:
+        with trace_span("mempool.commit"), chainstate.cs_main:
             t_hold = _time.perf_counter()
             if (getattr(chainstate, "tip_generation", 0) != ctx.generation
                     or pool.removal_generation != ctx.pool_generation):

@@ -57,7 +57,8 @@ from ..script.interpreter import (
     verify_script_fast,
 )
 from ..script.script import Script
-from ..telemetry import g_metrics, span
+from ..telemetry import g_metrics, span, tracing
+from ..telemetry.tracing import trace_span
 from ..utils.logging import LogFlags, log_print
 from .blockindex import BlockIndex, BlockStatus, Chain
 from .blockstore import (
@@ -1029,7 +1030,7 @@ class ChainState:
                     "bad-cb-amount",
                     f"{block.vtx[0].total_output_value()} > {fees + subsidy}",
                 )
-            with span("connectblock.scripts"):
+            with trace_span("connectblock.scripts"):
                 err = control.wait()
             if err:
                 raise BlockValidationError("blk-bad-inputs", err)
@@ -1163,47 +1164,69 @@ class ChainState:
         read-ahead worker for ``block`` (0 when the block arrived with the
         request or read synchronously below); ``prefetched_coins`` counts
         the spent outpoints the worker pre-touched in the coins DB."""
+        # causal trace: one root per tip connect; the stage children are
+        # recorded from the SAME perf-counter reads the histogram uses
+        # (zero extra clocks), and spans created inside connect_block
+        # (connectblock.scripts, the CheckQueue fan-out) nest under it
+        # (enabled() guard: -reindex/-loadblock with -telemetryspans=0
+        # must not pay the u256 hex format per block)
+        root = tracing.start_trace(
+            "block.connect", height=idx.height,
+            block=u256_hex(idx.block_hash)[:16],
+        ) if tracing.enabled() else None
         t0 = time.perf_counter()
-        if block is None:
-            # a read failure here is the node's storage failing, never the
-            # block's fault: escalate instead of invalidating the block
-            # ("no-data"/PrunedError keep their candidate-drop semantics)
-            block = guarded_io(
-                "blockstore.read_block", lambda: self.read_block(idx),
-                chainstate=self,
-                passthrough=(BlockValidationError, PrunedError),
-            )
-        t_read = time.perf_counter()
-        view = CoinsViewCache(self.coins)
-        undo = self.connect_block(block, idx, view)
-        t_connect = time.perf_counter()
-        upos = guarded_io(
-            "blockstore.write_undo",
-            lambda: self.block_store.write_undo(undo), chainstate=self)
-        dpos, _ = self.positions[idx.block_hash]
-        self.positions[idx.block_hash] = (dpos, upos)
-        idx.status |= BlockStatus.HAVE_UNDO
-        self._dirty_index.add(idx)
-        # index records go in BEFORE the coin flush: a crash in between
-        # replays this block on restart and the puts are idempotent, so
-        # the coins write remains the single commit point
-        if getattr(self, "indexes", None) is not None:
-            self.indexes.index_block(block, idx, undo)
-        view.flush()
-        t_flush = time.perf_counter()
-        idx.raise_validity(BlockStatus.VALID_SCRIPTS)
-        self.active.set_tip(idx)
-        self.tip_generation += 1
-        # estimator first (Record needs its tracked entries), then the
-        # pool removal notifies remove_tx for already-erased txids — a
-        # no-op — matching ref removeForBlock's processBlock-then-remove
-        from .fees import fee_estimator
+        try:
+            with tracing.attach(root):
+                if block is None:
+                    # a read failure here is the node's storage failing,
+                    # never the block's fault: escalate instead of
+                    # invalidating the block ("no-data"/PrunedError keep
+                    # their candidate-drop semantics)
+                    block = guarded_io(
+                        "blockstore.read_block",
+                        lambda: self.read_block(idx),
+                        chainstate=self,
+                        passthrough=(BlockValidationError, PrunedError),
+                    )
+                t_read = time.perf_counter()
+                view = CoinsViewCache(self.coins)
+                undo = self.connect_block(block, idx, view)
+                t_connect = time.perf_counter()
+                upos = guarded_io(
+                    "blockstore.write_undo",
+                    lambda: self.block_store.write_undo(undo),
+                    chainstate=self)
+                dpos, _ = self.positions[idx.block_hash]
+                self.positions[idx.block_hash] = (dpos, upos)
+                idx.status |= BlockStatus.HAVE_UNDO
+                self._dirty_index.add(idx)
+                # index records go in BEFORE the coin flush: a crash in
+                # between replays this block on restart and the puts are
+                # idempotent, so the coins write remains the single
+                # commit point
+                if getattr(self, "indexes", None) is not None:
+                    self.indexes.index_block(block, idx, undo)
+                view.flush()
+                t_flush = time.perf_counter()
+                idx.raise_validity(BlockStatus.VALID_SCRIPTS)
+                self.active.set_tip(idx)
+                self.tip_generation += 1
+                # estimator first (Record needs its tracked entries),
+                # then the pool removal notifies remove_tx for
+                # already-erased txids — a no-op — matching ref
+                # removeForBlock's processBlock-then-remove
+                from .fees import fee_estimator
 
-        fee_estimator.process_block(idx.height, [t.txid for t in block.vtx])
-        if self.mempool is not None:
-            self.mempool.remove_for_block(block.vtx)
-        main_signals.block_connected(block, idx, [])
-        t_done = time.perf_counter()
+                fee_estimator.process_block(
+                    idx.height, [t.txid for t in block.vtx])
+                if self.mempool is not None:
+                    self.mempool.remove_for_block(block.vtx)
+                main_signals.block_connected(block, idx, [])
+                t_done = time.perf_counter()
+        except BaseException as e:
+            if root is not None:
+                root.finish(status="error", error=repr(e))
+            raise
         _M_CONNECT_STAGE.observe(prefetch_wait, stage="prefetch")
         if prefetched_coins:
             _M_PREFETCH_COINS.inc(prefetched_coins)
@@ -1212,6 +1235,12 @@ class ChainState:
         _M_CONNECT_STAGE.observe(t_flush - t_connect, stage="flush")
         _M_CONNECT_STAGE.observe(t_done - t_flush, stage="post")
         _M_CONNECT_STAGE.observe(t_done - t0, stage="total")
+        if root is not None:
+            tracing.record_span("connect.read", root, t0, t_read)
+            tracing.record_span("connect.block", root, t_read, t_connect)
+            tracing.record_span("connect.flush", root, t_connect, t_flush)
+            tracing.record_span("connect.post", root, t_flush, t_done)
+            root.finish(txs=len(block.vtx))
         _M_BLOCKS_CONNECTED.inc()
         _M_TXS_CONNECTED.inc(len(block.vtx))
         log_print(

@@ -16,6 +16,8 @@ import time
 from typing import Optional
 
 from ..telemetry import g_metrics
+from ..telemetry.flight_recorder import record_event
+from ..telemetry.startup import g_startup
 from ..utils.logging import log_printf
 from .assembler import BlockAssembler, mine_block_cpu
 
@@ -216,6 +218,9 @@ class BackgroundMiner:
                 asm = BlockAssembler(node.chainstate)
                 block = asm.create_new_block(spk, extra_nonce=extra)
                 found, covered = self._search_slice(block, tip_gen)
+                if covered:
+                    # restart-to-first-sweep, the ROADMAP item-2 metric
+                    g_startup.mark_once("first_sweep")
                 self._count(covered if not found else max(covered // 2, 1))
                 if self._stop.is_set():
                     return
@@ -227,6 +232,10 @@ class BackgroundMiner:
                     continue
                 node.chainstate.process_new_block(block)
                 _M_BLOCKS_FOUND.inc()
+                record_event(
+                    "block_found", source="miner",
+                    height=node.chainstate.tip().height,
+                    block=block.hash_hex[:16])
                 log_printf(
                     "miner: found block %s at height %d",
                     block.hash_hex[:16],

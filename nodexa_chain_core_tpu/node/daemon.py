@@ -24,6 +24,13 @@ DEFAULT_RPC_PORTS = {
 
 
 def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
+    # boot attribution starts before anything else: every stage below is
+    # timed onto nodexa_startup_stage_seconds / getstartupinfo, and the
+    # one-shot marks (first_device_call, first_sweep, first_share) are
+    # measured from this instant
+    from ..telemetry import flight_recorder, g_startup
+
+    g_startup.begin()
     # Steps 1-3: parameters + config (ref init.cpp AppInitBasicSetup/
     # ParameterInteraction)
     g_args.parse_parameters(argv)
@@ -35,6 +42,9 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     if g_args.is_set("debug"):
         g_logger.enable_categories(g_args.get("debug", "all"))
     log_printf("Nodexa TPU daemon starting: network=%s datadir=%s", network, datadir)
+    # flight-recorder dumps (safe-mode entry, dumpflightrecorder RPC)
+    # land next to the debug log, where the operator already looks
+    flight_recorder.set_dump_dir(datadir)
 
     # span kill switch BEFORE any chainstate work: -reindex/-loadblock/
     # verify_db below are exactly the high-volume connect windows the
@@ -87,22 +97,23 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     from .health import NodeCriticalError
 
     try:
-        node = NodeContext(
-            network=network,
-            datadir=datadir,
-            script_check_threads=g_args.get_int("par", 0),
-            # debug/test knob: small chunks let functional prune tests run
-            # on short chains (ref feature_pruning.py's large-block
-            # approach)
-            block_chunk_bytes=g_args.get_int(
-                "blockchunksize", 16 * 1024 * 1024),
-            # -dbcache=<MiB>: persistent coins-cache budget; coins hit disk
-            # only on size pressure, the periodic interval, or shutdown
-            # (ref init.cpp -dbcache / nCoinCacheUsage)
-            dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
-            coins_flush_interval_s=float(
-                g_args.get_int("dbcacheinterval", 300)),
-        )
+        with g_startup.stage("chainstate_load"):
+            node = NodeContext(
+                network=network,
+                datadir=datadir,
+                script_check_threads=g_args.get_int("par", 0),
+                # debug/test knob: small chunks let functional prune
+                # tests run on short chains (ref feature_pruning.py's
+                # large-block approach)
+                block_chunk_bytes=g_args.get_int(
+                    "blockchunksize", 16 * 1024 * 1024),
+                # -dbcache=<MiB>: persistent coins-cache budget; coins
+                # hit disk only on size pressure, the periodic interval,
+                # or shutdown (ref init.cpp -dbcache / nCoinCacheUsage)
+                dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
+                coins_flush_interval_s=float(
+                    g_args.get_int("dbcacheinterval", 300)),
+            )
     except BlockValidationError as e:
         raise SystemExit(
             f"Error: chainstate load failed: {e}. The databases are "
@@ -191,8 +202,9 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     check_level = g_args.get_int("checklevel", 3)
     if check_blocks > 0:
         try:
-            node.chainstate.verify_db(
-                check_level=check_level, check_blocks=check_blocks)
+            with g_startup.stage("selfcheck"):
+                node.chainstate.verify_db(
+                    check_level=check_level, check_blocks=check_blocks)
         except BlockValidationError as e:
             g_health.record_selfcheck(
                 check_level, check_blocks, ok=False, error=str(e))
@@ -276,52 +288,56 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     # sweeps, and pool share validation across all of them; -meshshape
     # pins the (headers x lanes) grid, -tpudevices caps the device count.
     if node.params.consensus.kawpow_activation_time < (1 << 62):
-        from .epoch_manager import EpochManager
+        with g_startup.stage("mesh_init"):
+            from .epoch_manager import EpochManager
 
-        tpu_verify = g_args.get_bool("tpukawpow")
-        if tpu_verify:
-            from ..parallel.backend import MeshBackend
+            tpu_verify = g_args.get_bool("tpukawpow")
+            if tpu_verify:
+                from ..parallel.backend import MeshBackend
 
-            try:
-                node.mesh_backend = MeshBackend.from_args(
-                    mesh_shape=g_args.get("meshshape", ""),
-                    max_devices=g_args.get_int("tpudevices", 0),
-                    slab_threads=g_args.get_int("slabthreads", 0),
-                )
-            except ValueError as e:  # bad -meshshape must not boot blind
-                raise SystemExit(f"Error: {e}")
-        node.epoch_manager = EpochManager(
-            tpu_verify=tpu_verify,
-            slab_threads=g_args.get_int("slabthreads", 0),
-            backend=getattr(node, "mesh_backend", None),
-        )
-        node.chainstate.kawpow_batch_factory = node.epoch_manager.verifier
-        # header sync routes its batches through the backend directly
-        # (sharded over the headers axis, path label + shard telemetry
-        # owned by the backend); the factory stays as the availability
-        # contract for tests and the no-backend configuration
-        node.chainstate.mesh_backend = getattr(node, "mesh_backend", None)
+                try:
+                    node.mesh_backend = MeshBackend.from_args(
+                        mesh_shape=g_args.get("meshshape", ""),
+                        max_devices=g_args.get_int("tpudevices", 0),
+                        slab_threads=g_args.get_int("slabthreads", 0),
+                    )
+                except ValueError as e:  # bad -meshshape: refuse boot
+                    raise SystemExit(f"Error: {e}")
+            node.epoch_manager = EpochManager(
+                tpu_verify=tpu_verify,
+                slab_threads=g_args.get_int("slabthreads", 0),
+                backend=getattr(node, "mesh_backend", None),
+            )
+            node.chainstate.kawpow_batch_factory = node.epoch_manager.verifier
+            # header sync routes its batches through the backend directly
+            # (sharded over the headers axis, path label + shard telemetry
+            # owned by the backend); the factory stays as the availability
+            # contract for tests and the no-backend configuration
+            node.chainstate.mesh_backend = getattr(node, "mesh_backend", None)
 
-        def _warm_epochs():
-            tip = node.chainstate.tip()
-            sched = node.params.algo_schedule
-            if tip is not None and sched.is_kawpow(tip.header.time):
-                node.epoch_manager.ensure_for_height(tip.height)
+            def _warm_epochs():
+                tip = node.chainstate.tip()
+                sched = node.params.algo_schedule
+                if tip is not None and sched.is_kawpow(tip.header.time):
+                    node.epoch_manager.ensure_for_height(tip.height)
 
-        _warm_epochs()
-        node.scheduler.schedule_every(_warm_epochs, 60.0)
+            _warm_epochs()
+            node.scheduler.schedule_every(_warm_epochs, 60.0)
 
     # Step 8: wallet
     if not g_args.get_bool("disablewallet"):
         try:
-            from ..wallet.wallet import Wallet
+            with g_startup.stage("wallet"):
+                from ..wallet.wallet import Wallet
 
-            node.wallet = Wallet.load_or_create(node)
-            log_printf("wallet loaded: %d keys", len(node.wallet.keystore.keys()))
-            # periodic writer for chain-driven wallet state (ref
-            # init.cpp wallet-flush scheduleEvery; per-block flushes
-            # were O(wallet) each — see Wallet.block_connected)
-            node.scheduler.schedule_every(node.wallet.flush_if_dirty, 5.0)
+                node.wallet = Wallet.load_or_create(node)
+                log_printf("wallet loaded: %d keys",
+                           len(node.wallet.keystore.keys()))
+                # periodic writer for chain-driven wallet state (ref
+                # init.cpp wallet-flush scheduleEvery; per-block flushes
+                # were O(wallet) each — see Wallet.block_connected)
+                node.scheduler.schedule_every(
+                    node.wallet.flush_if_dirty, 5.0)
         except ImportError:
             pass
 
@@ -351,7 +367,8 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             log_printf("outbound via SOCKS5 proxy %s:%d", *node.connman.proxy)
         if g_args.is_set("onion"):
             node.connman.onion_proxy = _parse_hostport(g_args.get("onion"))
-        node.connman.start()
+        with g_startup.stage("network"):
+            node.connman.start()
 
         # -listenonion: publish the P2P port as a v3 onion service through
         # the Tor control port (ref torcontrol.cpp StartTorControl)
@@ -404,15 +421,16 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     # push-based jobs off the validation bus, TPU micro-batched share
     # validation, winning shares into the normal ConnectTip path
     if g_args.get_bool("pool"):
-        from ..pool import start_pool
+        with g_startup.stage("pool"):
+            from ..pool import start_pool
 
-        node.pool_server = start_pool(
-            node,
-            host=g_args.get("poolbind", "127.0.0.1"),
-            port=g_args.get_int("poolport", 3333),
-            start_difficulty=g_args.get_int("pooldiff", 1),
-            max_connections=g_args.get_int("poolmaxconn", 256),
-        )
+            node.pool_server = start_pool(
+                node,
+                host=g_args.get("poolbind", "127.0.0.1"),
+                port=g_args.get_int("poolport", 3333),
+                start_difficulty=g_args.get_int("pooldiff", 1),
+                max_connections=g_args.get_int("poolmaxconn", 256),
+            )
 
     # -gen/-genproclimit: built-in miner (ref GenerateClores at init)
     if g_args.get_bool("gen") and getattr(node, "wallet", None) is not None:
@@ -441,9 +459,12 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         node.rest_handler = make_rest_handler(node)
     except ImportError:
         pass
-    rpc.start()
+    with g_startup.stage("rpc"):
+        rpc.start()
     g_rpc_table.set_warmup_finished()
-    log_printf("init complete: height=%d", node.chainstate.tip().height)
+    g_startup.mark_once("init_complete")
+    log_printf("init complete: height=%d (boot %.2fs)",
+               node.chainstate.tip().height, g_startup.elapsed())
     return node, rpc
 
 

@@ -215,6 +215,20 @@ class NodeHealth:
         from ..rpc.safemode import set_safe_mode
 
         set_safe_mode(f"critical error at {source}: {exc}")
+        # post-mortem first, while the process is still coherent: the
+        # flight recorder holds the last few thousand completed spans
+        # and events LEADING UP to this failure — dump them before
+        # producers are torn down, and record where the dump landed so
+        # getnodehealth can point the operator at it
+        from ..telemetry import flight_recorder
+
+        flight_recorder.record_event(
+            "safe_mode_entered", source=source, error=repr(exc))
+        dump_path = flight_recorder.auto_dump("safe-mode")
+        if dump_path is not None:
+            with self._lock:
+                if self.last_error is not None:
+                    self.last_error["flight_recorder_dump"] = dump_path
         self._flush_safe_point(chainstate)
         t = threading.Thread(
             target=self._halt_producers, args=(node,),

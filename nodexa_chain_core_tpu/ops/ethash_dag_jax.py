@@ -193,6 +193,9 @@ class DagBuilder:
         # jit on every backend: the tensor/scan keccak keeps XLA:CPU
         # compiles sane (the unrolled per-lane form did not)
         self._fn = jax.jit(dataset_items_512)
+        from ..telemetry.compileattr import CompileTracker
+
+        self._compiles = CompileTracker()
 
     @classmethod
     def from_epoch(cls, epoch: int) -> "DagBuilder":
@@ -207,7 +210,9 @@ class DagBuilder:
         """Slab rows [start_row, start_row+rows) as (rows, 64) u32."""
         idx = (np.arange(rows * 4, dtype=np.uint32)
                + np.uint32(start_row * 4))
-        out = self._fn(self.light, jnp.asarray(idx))
+        out = self._compiles.run(
+            "ethash.dag_build", rows, str(rows),
+            self._fn, self.light, jnp.asarray(idx))
         return np.asarray(out).reshape(rows, 64)
 
     def build_slab(self, n2048: int, rows_per_launch: int = 262144,

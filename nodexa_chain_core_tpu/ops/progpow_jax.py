@@ -730,6 +730,13 @@ class BatchVerifier:
         self.dag = jnp.asarray(dag, dtype=_U32)
         self.mesh = mesh
         self._plan_cache: dict = {}
+        # compile attribution: the first dispatch of every (kernel,
+        # shape-bucket) pair lands on nodexa_jit_compiles_total /
+        # nodexa_jit_compile_seconds — the per-kernel ledger the restart
+        # cold-start audit (ROADMAP item 2) reads
+        from ..telemetry.compileattr import CompileTracker
+
+        self._compiles = CompileTracker()
         # jit everywhere, XLA:CPU included: with keccak_f800 in tensor/scan
         # form the whole-graph CPU compile is ~1 min per shape bucket and
         # steady-state batches run ~400x faster than the eager dispatch
@@ -954,7 +961,9 @@ class BatchVerifier:
             [height // ref.PERIOD_LENGTH] * batch, bb
         )
         tw = target_swapped_words(target_le_int)
-        found, win, final, mix = self._jit_search(
+        pb = int(plans[0].shape[0])
+        found, win, final, mix = self._compiles.run(
+            "progpow.search_scan", (bb, pb), f"{bb}x{pb}", self._jit_search,
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), jnp.asarray(tw), self.l1, self.dag,
         )
@@ -998,7 +1007,9 @@ class BatchVerifier:
             nhi[i] = (n >> 32) & 0xFFFFFFFF
         periods = [h // ref.PERIOD_LENGTH for h in heights]
         plans, pidx = self._plans_padded(periods, bb)
-        final, mix = self._jit(
+        pb = int(plans[0].shape[0])
+        final, mix = self._compiles.run(
+            "progpow.verify", (bb, pb), f"{bb}x{pb}", self._jit,
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), self.l1, self.dag,
         )

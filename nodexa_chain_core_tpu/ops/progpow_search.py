@@ -528,6 +528,9 @@ class SearchKernel:
         self._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
         )
+        from ..telemetry.compileattr import CompileTracker
+
+        self._compiles = CompileTracker()
 
     @classmethod
     def from_epoch(cls, epoch: int, threads: int = 0) -> "SearchKernel":
@@ -551,6 +554,9 @@ class SearchKernel:
         obj._extract = (
             jax.jit(_extract) if jax.default_backend() != "cpu" else _extract
         )
+        from ..telemetry.compileattr import CompileTracker
+
+        obj._compiles = CompileTracker()
         return obj
 
     def pin(self, period: int, batch: int) -> None:
@@ -602,7 +608,8 @@ class SearchKernel:
         header_hash is display-order bytes (the native engine's convention).
         Returns (nonce64, final_le_int, mix_le_int) or None.
         """
-        fn = self._fn(height // ref.PERIOD_LENGTH, batch)
+        period = height // ref.PERIOD_LENGTH
+        fn = self._fn(period, batch)
         hw = jnp.asarray(np.frombuffer(header_hash[:32], dtype="<u4").copy())
         tw = jnp.asarray(pj.target_swapped_words(target_le_int))
         lo = _U32(start_nonce & 0xFFFFFFFF)
@@ -610,7 +617,9 @@ class SearchKernel:
         if self.mesh is not None:
             # one (found, local-win, final, mix) row per shard; take the
             # first shard that found a winner (lowest nonce range)
-            found, win, final, mix = fn(hw, lo, hi, tw, self.l1, self.dag)
+            found, win, final, mix = self._compiles.run(
+                "progpow.search_period", (period, batch), str(batch),
+                fn, hw, lo, hi, tw, self.l1, self.dag)
             found = np.asarray(found)
             hits = np.nonzero(found)[0]
             if len(hits) == 0:
@@ -625,7 +634,9 @@ class SearchKernel:
                 pj.digest_words_to_le_int(np.asarray(final)[d]),
                 pj.digest_words_to_le_int(np.asarray(mix)[d]),
             )
-        final_all, mix_all = fn(hw, lo, hi, self.l1, self.dag)
+        final_all, mix_all = self._compiles.run(
+            "progpow.search_period", (period, batch), str(batch),
+            fn, hw, lo, hi, self.l1, self.dag)
         found, win, final, mix = self._extract(final_all, mix_all, tw)
         if not bool(found):
             return None

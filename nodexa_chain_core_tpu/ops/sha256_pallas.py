@@ -142,6 +142,9 @@ def _compiled_search(batch, sublanes):
     return jax.jit(run)
 
 
+_sha_compiles = None
+
+
 def pow_search_tiles(mid, tail3, nonce0, target_le, *, batch, sublanes=512):
     """Scan `batch` nonces from nonce0; per-tile (count, first-lane) arrays.
 
@@ -149,7 +152,14 @@ def pow_search_tiles(mid, tail3, nonce0, target_le, *, batch, sublanes=512):
     nonce (if any) is nonce0 + tile*tile_size + firsts[tile] for the first
     tile with counts>0.
     """
-    return _compiled_search(batch, sublanes)(mid, tail3, nonce0, target_le)
+    global _sha_compiles
+    if _sha_compiles is None:
+        from ..telemetry.compileattr import CompileTracker
+
+        _sha_compiles = CompileTracker()
+    return _sha_compiles.run(
+        "sha256d.search", (batch, sublanes), str(batch),
+        _compiled_search(batch, sublanes), mid, tail3, nonce0, target_le)
 
 
 def pow_search_step(mid, tail3, nonce0, target_le, batch, sublanes=512):

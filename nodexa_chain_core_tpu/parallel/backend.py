@@ -46,7 +46,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
+from ..telemetry.flight_recorder import record_event
 from ..utils.logging import log_printf
 
 PATH_MESH = "mesh"
@@ -317,36 +318,51 @@ class MeshBackend:
             return v
         if not paths:
             return None  # all device paths memoized failed
-        l1, dag = self._slab_loader(epoch, self.slab_threads)
-        factory = self._verifier_factory
-        if factory is None:
-            from ..ops.progpow_jax import BatchVerifier
+        # one causal trace per epoch build — slab load and each path's
+        # verifier build/self-check land in the flight recorder, so a
+        # slow or demoted rollover is diagnosable after the fact
+        root = tracing.start_trace("epoch.build", epoch=epoch)
+        with tracing.attach(root):
+            with tracing.trace_span("epoch.slab_load", epoch=epoch):
+                l1, dag = self._slab_loader(epoch, self.slab_threads)
+            factory = self._verifier_factory
+            if factory is None:
+                from ..ops.progpow_jax import BatchVerifier
 
-            factory = BatchVerifier
+                factory = BatchVerifier
 
-        for path in paths:
-            mesh = self.mesh if path == PATH_MESH else None
-            try:
-                verifier = factory(l1, dag, mesh=mesh)
-                if not self._self_check(verifier, epoch):
-                    raise RuntimeError(
-                        f"epoch {epoch} {path}-path verifier failed the "
-                        "known-answer cross-check against the native engine"
-                    )
-            except Exception as e:
-                # fail CLOSED and memoize per (epoch, path): a broken
-                # mesh lowering must not cost a slab rebuild every
-                # scheduler tick — and must not block the next path
-                log_printf(
-                    "mesh: epoch %d %s path failed self-check, demoting "
-                    "(restart to retry): %r", epoch, path, e)
-                _M_DEMOTIONS.inc(path=path)
-                with self._lock:
-                    self._failed.add((epoch, path))
-                continue
-            verifier.backend_path = path
-            self._install(epoch, verifier, path)
-            return verifier
+            for path in paths:
+                mesh = self.mesh if path == PATH_MESH else None
+                try:
+                    with tracing.trace_span("epoch.verifier_build",
+                                            epoch=epoch, path=path):
+                        verifier = factory(l1, dag, mesh=mesh)
+                        if not self._self_check(verifier, epoch):
+                            raise RuntimeError(
+                                f"epoch {epoch} {path}-path verifier "
+                                "failed the known-answer cross-check "
+                                "against the native engine"
+                            )
+                except Exception as e:
+                    # fail CLOSED and memoize per (epoch, path): a broken
+                    # mesh lowering must not cost a slab rebuild every
+                    # scheduler tick — and must not block the next path
+                    log_printf(
+                        "mesh: epoch %d %s path failed self-check, "
+                        "demoting (restart to retry): %r", epoch, path, e)
+                    _M_DEMOTIONS.inc(path=path)
+                    record_event("mesh_demotion", epoch=epoch, path=path,
+                                 error=repr(e))
+                    with self._lock:
+                        self._failed.add((epoch, path))
+                    continue
+                verifier.backend_path = path
+                self._install(epoch, verifier, path)
+                if root is not None:
+                    root.finish(path=path)
+                return verifier
+        if root is not None:
+            root.finish(status="error", error="all device paths failed")
         return None
 
     def _install(self, epoch: int, verifier, path: str) -> None:

@@ -32,7 +32,8 @@ from typing import Dict, Optional
 from ..core.uint256 import u256_hex
 from ..node.faults import g_faults
 from ..node.health import g_health
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
+from ..telemetry.flight_recorder import record_event
 from ..utils.logging import log_printf
 from . import shares as sh
 from .jobs import Job, JobManager
@@ -487,28 +488,62 @@ class StratumServer:
     # -- submit path -------------------------------------------------------
 
     def _on_submit(self, sess: StratumSession, req_id, params) -> None:
+        """Causal-trace shell around the submit checks: a submission
+        that passes the cheap abuse gates opens a root span; a share
+        that reaches the pipeline hands the root to its
+        :class:`~.shares.Share` (the pipeline thread closes it with the
+        verdict), every synchronous reject closes it here."""
+        queued, root = self._submit_checked(sess, req_id, params)
+        if root is not None and not queued:
+            root.finish(status="rejected")
+
+    def _submit_checked(self, sess: StratumSession, req_id, params):
+        """The submit pipeline's synchronous prefix; returns
+        ``(queued, root_span)`` — queued=True once the share is handed
+        to the validation pipeline (the async path owns the trace then).
+
+        The trace opens only AFTER the subscription/authorization gates:
+        those rejects carry no misbehavior score, so pre-auth spam could
+        otherwise rotate the whole flight-recorder ring and evict the
+        post-mortem evidence it exists to keep."""
         if not sess.subscribed:
             sess.reply_error(req_id, sh.E_NOT_SUBSCRIBED, "not subscribed")
-            return
+            return False, None
         if not g_health.allow_mutations():
             # safe mode: share production stops (the health layer is also
             # stopping this server asynchronously) — no misbehavior score,
             # the miner did nothing wrong
             sess.reply_error(req_id, sh.E_OTHER, "node in safe mode")
-            return
+            return False, None
         # [worker, job_id, nonce, mix] or the wider GPU-miner shape
         # [worker, job_id, nonce, header_hash, mix]
         if len(params) not in (4, 5):
             self._misbehave(sess, 5, "bad-submit-arity")
             sess.reply_error(req_id, sh.E_OTHER, "bad submit params")
-            return
+            return False, None
         worker = str(params[0])
         job_id = str(params[1])
         nonce_hex = str(params[2])
         mix_hex = str(params[-1])
         if worker not in sess.workers:
             sess.reply_error(req_id, sh.E_UNAUTHORIZED, "unauthorized worker")
-            return
+            return False, None
+        root = tracing.start_trace(
+            "stratum.share", session=f"{sess.key:x}", worker=worker,
+            job=job_id,
+        ) if tracing.enabled() else None
+        pre = tracing.child_span("share.precheck", root)
+        try:
+            return self._submit_authorized(
+                sess, req_id, root, worker, job_id, nonce_hex, mix_hex,
+            ), root
+        finally:
+            if pre is not None:
+                pre.finish()
+
+    def _submit_authorized(self, sess: StratumSession, req_id, root,
+                           worker: str, job_id: str, nonce_hex: str,
+                           mix_hex: str) -> bool:
         try:
             nonce = int(nonce_hex.removeprefix("0x"), 16)
             mix = int(mix_hex.removeprefix("0x"), 16)
@@ -517,21 +552,21 @@ class StratumServer:
         except ValueError:
             self._misbehave(sess, 10, "unparseable-share")
             self._reject(sess, req_id, sh.E_OTHER, sh.R_BAD_NONCE)
-            return
+            return False
         job = self.jobs.get(job_id)
         if job is None:
             self._reject(sess, req_id, sh.E_STALE, sh.R_UNKNOWN_JOB)
             self._misbehave(sess, 1, sh.R_UNKNOWN_JOB)
-            return
+            return False
         if self.jobs.is_stale(job):
             self._reject(sess, req_id, sh.E_STALE, sh.R_STALE)
-            return
+            return False
         if (nonce >> 48) != sess.extranonce1:
             # a miner ignoring its nonce partition is either broken or
             # replaying another session's shares: score it harder
             self._misbehave(sess, 10, sh.R_BAD_NONCE)
             self._reject(sess, req_id, sh.E_OTHER, sh.R_BAD_NONCE)
-            return
+            return False
         # backpressure BEFORE the nonce claim: a shed share must stay
         # resubmittable, not burn its nonce into a later duplicate.
         # A session streaming raw hashes as shares (each costing a full
@@ -544,23 +579,29 @@ class StratumServer:
         if over:
             self._misbehave(sess, 1, "share-flood")
             sess.reply_error(req_id, sh.E_OTHER, "busy")
-            return
+            return False
         if not self.jobs.claim_nonce(job, nonce):
             with sess._wlock:
                 sess.inflight -= 1
             self._misbehave(sess, 5, sh.R_DUPLICATE)
             self._reject(sess, req_id, sh.E_DUPLICATE, sh.R_DUPLICATE)
-            return
-        accepted = self.pipeline.submit(Share(
+            return False
+        share = Share(
             sess, req_id, worker, job, nonce, mix,
             max(sess.pushed_targets or [self.share_target(sess)]),
-            self._on_share_result,
-        ))
+            self._on_share_result, trace=root,
+        )
+        share.queue_span = tracing.child_span("share.queue", root)
+        accepted = self.pipeline.submit(share)
         if not accepted:  # pipeline queue saturated (global backpressure)
             with sess._wlock:
                 sess.inflight -= 1
             self.jobs.release_nonce(job, nonce)  # resubmittable later
             sess.reply_error(req_id, sh.E_OTHER, "busy")
+            if share.queue_span is not None:
+                share.queue_span.finish(status="shed")
+            return False
+        return True
 
     def _reject(self, sess: StratumSession, req_id, code: int,
                 reason: str) -> None:
@@ -609,6 +650,8 @@ class StratumServer:
         if sess.misbehavior >= BAN_THRESHOLD:
             with self._banned_lock:
                 self.banned[sess.ip] = time.time() + self.ban_time_s
+            record_event("pool_ban", ip=sess.ip, reason=reason,
+                         score=sess.misbehavior)
             log_printf("pool: banning %s for %ds (%s, score %d)",
                        sess.ip, int(self.ban_time_s), reason,
                        sess.misbehavior)

@@ -32,7 +32,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
+from ..telemetry.startup import g_startup
 from ..utils.logging import log_printf
 
 # stratum error codes (the de-facto pool convention)
@@ -72,11 +73,12 @@ class Share:
     """One queued submission awaiting batch validation."""
 
     __slots__ = ("session", "req_id", "worker", "job", "nonce", "mix",
-                 "share_target", "on_result", "done")
+                 "share_target", "on_result", "done", "trace", "queue_span")
 
     def __init__(self, session, req_id, worker: str, job, nonce: int,
                  mix: int, share_target: int,
-                 on_result: Callable[["Share", bool, str], None]):
+                 on_result: Callable[["Share", bool, str], None],
+                 trace=None):
         self.session = session
         self.req_id = req_id
         self.worker = worker
@@ -86,6 +88,12 @@ class Share:
         self.share_target = share_target
         self.on_result = on_result
         self.done = False  # verdict dispatched (guards double replies)
+        # causal trace: the root span the stratum server opened for this
+        # submission (None when constructed outside a traced session,
+        # e.g. bench rigs); queue_span covers submit -> batch pickup
+        # across the IO-thread -> pipeline-thread hop
+        self.trace = trace
+        self.queue_span = None
 
 
 class SharePipeline:
@@ -175,6 +183,10 @@ class SharePipeline:
                 if s is None:
                     break
                 batch.append(s)
+            for s in batch:  # queue wait ends where the batch forms
+                if s.queue_span is not None:
+                    s.queue_span.finish()
+                    s.queue_span = None
             try:
                 self.validate_batch(batch)
             except Exception as e:  # noqa: BLE001 — keep the worker alive
@@ -215,10 +227,20 @@ class SharePipeline:
         for s in batch:
             by_epoch.setdefault(s.job.epoch, []).append(s)
         for epoch, shares in by_epoch.items():
+            # one validate child per traced share: causally honest — the
+            # whole group rides ONE device call, so each span carries the
+            # batch size and the serving path it shared
+            vspans = [
+                tracing.child_span("share.validate", s.trace, epoch=epoch)
+                for s in shares
+            ]
             finals_mixes, path = self._device_hashes(epoch, shares)
             if finals_mixes is None:
                 finals_mixes = self._scalar_hashes(shares)
                 path = "scalar"
+            for vs in vspans:
+                if vs is not None:
+                    vs.finish(path=path, batch=len(shares))
             for s, (final, mix) in zip(shares, finals_mixes):
                 self._judge(s, final, mix, path)
 
@@ -267,7 +289,17 @@ class SharePipeline:
         if s.done:
             return
         s.done = True
-        s.on_result(s, ok, reason)
+        rs = tracing.child_span("share.reply", s.trace)
+        try:
+            s.on_result(s, ok, reason)
+        finally:
+            if rs is not None:
+                rs.finish()
+            if s.trace is not None:
+                # the root closes with the verdict: the trace is now
+                # complete and retrievable via gettrace
+                s.trace.finish(
+                    status="ok" if ok else "rejected", verdict=reason)
 
     def _judge(self, s: Share, final: int, mix: int, path: str) -> None:
         if mix != s.mix:
@@ -280,6 +312,7 @@ class SharePipeline:
         # window) — low-diff must never discard a chain extension
         if final <= s.job.target:
             self.count(R_ACCEPTED)
+            g_startup.mark_once("first_share")
             self._submit_block(s)
             self._dispatch(s, True, R_ACCEPTED)
             return
@@ -288,6 +321,7 @@ class SharePipeline:
             self._dispatch(s, False, R_LOW_DIFF)
             return
         self.count(R_ACCEPTED)
+        g_startup.mark_once("first_share")
         self._dispatch(s, True, R_ACCEPTED)
 
     def _submit_block(self, s: Share) -> None:
@@ -313,6 +347,11 @@ class SharePipeline:
             return
         self.count(R_BLOCK)
         _M_BLOCKS.inc()
+        from ..telemetry import flight_recorder
+
+        flight_recorder.record_event(
+            "block_found", source="pool", worker=s.worker,
+            height=block.header.height, block=block.hash_hex[:16])
         log_printf(
             "pool: block %s found by %s at height %d",
             block.hash_hex[:16], s.worker, block.header.height,

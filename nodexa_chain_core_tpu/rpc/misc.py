@@ -135,14 +135,56 @@ def getmemoryinfo(node, params: List[Any]):
 def getmetrics(node, params: List[Any]):
     """Node-wide telemetry registry as JSON (the RPC twin of the REST
     ``/metrics`` Prometheus endpoint).  Optional first param filters
-    metric names by substring."""
+    metric names by PREFIX — fleet-scale scrapers pull one subsystem
+    (e.g. ``nodexa_pool``) without shipping the full exposition
+    payload."""
     from ..telemetry import registry_snapshot
 
     snap = registry_snapshot()
     if params and params[0]:
-        needle = str(params[0])
-        snap = {k: v for k, v in snap.items() if needle in k}
+        prefix = str(params[0])
+        snap = {k: v for k, v in snap.items() if k.startswith(prefix)}
     return {"metrics": snap}
+
+
+def gettrace(node, params: List[Any]):
+    """One causal trace from the flight recorder: the span tree of a
+    single request (stratum share, block connect, mempool admission).
+    Optional first param is a trace id (as carried on every span record);
+    without it, the most recently completed trace is returned."""
+    from ..telemetry import flight_recorder
+
+    trace_id = str(params[0]) if params and params[0] else None
+    trace = flight_recorder.get_trace(trace_id)
+    if trace is None:
+        raise RPCError(
+            RPC_INVALID_PARAMETER,
+            f"trace {trace_id} not found in the flight recorder"
+            if trace_id else "no completed traces recorded")
+    return trace
+
+
+def dumpflightrecorder(node, params: List[Any]):
+    """Write the flight recorder (bounded ring of completed trace spans
+    + structured events) to disk and return {path, spans, events,
+    complete_traces}.  Optional first param overrides the target path
+    (default: a timestamped file in -datadir).  Deliberately answers in
+    safe mode — post-mortems are its whole point."""
+    from ..telemetry import flight_recorder
+
+    path = str(params[0]) if params and params[0] else None
+    return flight_recorder.dump(path=path, reason="rpc")
+
+
+def getstartupinfo(node, params: List[Any]):
+    """Daemon boot attribution: per-stage durations (chainstate load,
+    self-check, mesh init, wallet, network, pool, rpc), one-shot marks
+    (first_device_call / first_sweep / first_share, elapsed from boot),
+    and ``startup_to_first_sweep_s`` — the restart-cost headline the
+    compilation-cache work is graded on."""
+    from ..telemetry import g_startup
+
+    return g_startup.snapshot()
 
 
 def getnodehealth(node, params: List[Any]):
@@ -292,7 +334,10 @@ def register(table: RPCTable) -> None:
         ("util", "signmessagewithprivkey", signmessagewithprivkey,
          ["privkey", "message"]),
         ("control", "getmemoryinfo", getmemoryinfo, []),
-        ("control", "getmetrics", getmetrics, ["filter"]),
+        ("control", "getmetrics", getmetrics, ["prefix"]),
+        ("control", "gettrace", gettrace, ["trace_id"]),
+        ("control", "dumpflightrecorder", dumpflightrecorder, ["path"]),
+        ("control", "getstartupinfo", getstartupinfo, []),
         ("control", "getnodehealth", getnodehealth, []),
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),

@@ -24,6 +24,17 @@ from .registry import (
 )
 from .spans import span, set_spans_enabled, spans_enabled
 from .exposition import prometheus_text, registry_snapshot, summary_lines
+from . import flight_recorder, tracing
+from .tracing import (
+    attach,
+    child_span,
+    current_span,
+    start_span,
+    start_trace,
+    trace_span,
+)
+from .startup import g_startup
+from .compileattr import CompileTracker, compile_span
 
 __all__ = [
     "Counter",
@@ -38,4 +49,15 @@ __all__ = [
     "prometheus_text",
     "registry_snapshot",
     "summary_lines",
+    "flight_recorder",
+    "tracing",
+    "attach",
+    "child_span",
+    "current_span",
+    "start_span",
+    "start_trace",
+    "trace_span",
+    "g_startup",
+    "CompileTracker",
+    "compile_span",
 ]

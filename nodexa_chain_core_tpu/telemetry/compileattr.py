@@ -1,0 +1,128 @@
+"""Per-kernel JIT compile attribution.
+
+BENCH_r05 showed a "warm" restart with disk-cached executables running
+SLOWER than an in-process cold compile — but the aggregate jitcache
+hit/miss counters can't say *which* kernel or *which shape* missed.
+This module attributes every first dispatch of a compiled kernel:
+
+- ``nodexa_jit_compiles_total{kernel,shape_bucket}`` — how many
+  distinct lowerings each kernel family actually produced (a kernel
+  whose shape discipline is tight shows ONE bucket per entry point; a
+  proliferating label set here is the shape-mismatch smoking gun
+  ROADMAP item 2 hunts);
+- ``nodexa_jit_compile_seconds{kernel}`` — where compile wall time
+  went (first dispatch, so on-device execution of that first batch is
+  included — the restart-relevant quantity);
+- ``nodexa_jit_persistent_cache_total{kernel,result=hit|miss}`` — the
+  per-kernel split of the global persistent-cache counters (attributed
+  by delta around the compile window, via ``jax.monitoring``).
+
+Each compile also lands in the flight recorder as a ``jit_compile``
+event, nests as a ``jit.compile`` child span when a trace is active,
+and the first one marks ``first_device_call`` on the startup timeline.
+
+Usage — wrap ONLY the first dispatch per (kernel, shape) key, so
+steady-state calls pay one set lookup:
+
+    self._compiles = CompileTracker()
+    ...
+    out = self._compiles.run("progpow.verify", (bb, pb), f"{bb}x{pb}",
+                             self._jit, *args)
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from . import flight_recorder, tracing
+from .registry import g_metrics
+from .startup import g_startup
+
+# compile latencies live on a much coarser scale than request latencies
+COMPILE_BUCKETS = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    120.0, 300.0,
+)
+
+_M_COMPILES = g_metrics.counter(
+    "nodexa_jit_compiles_total",
+    "JIT kernel compiles (first dispatch per shape bucket), labeled by "
+    "kernel and shape_bucket")
+_M_COMPILE_SECONDS = g_metrics.histogram(
+    "nodexa_jit_compile_seconds",
+    "JIT compile + first-dispatch wall time, labeled by kernel",
+    buckets=COMPILE_BUCKETS)
+_M_PCACHE = g_metrics.counter(
+    "nodexa_jit_persistent_cache_total",
+    "Persistent XLA compile-cache outcomes attributed per kernel "
+    "(result=hit|miss)")
+
+
+def _jitcache_counts():
+    """(hits, misses) from the global jax.monitoring listener; (0, 0)
+    when the jitcache module (and so jax) was never touched."""
+    import sys
+
+    mod = sys.modules.get("nodexa_chain_core_tpu.utils.jitcache")
+    if mod is None:
+        return 0, 0
+    return mod.hits, mod.misses
+
+
+@contextmanager
+def compile_span(kernel: str, shape_bucket: str = ""):
+    """Attribute one compile window to ``kernel``.  Wrap the FIRST call
+    of a jitted entry point (callers guard recurrence; see
+    :class:`CompileTracker`)."""
+    h0, m0 = _jitcache_counts()
+    sp = tracing.start_span("jit.compile", kernel=kernel,
+                            shape_bucket=shape_bucket)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        h1, m1 = _jitcache_counts()
+        _M_COMPILES.inc(kernel=kernel, shape_bucket=shape_bucket)
+        _M_COMPILE_SECONDS.observe(dt, kernel=kernel)
+        if h1 > h0:
+            _M_PCACHE.inc(h1 - h0, kernel=kernel, result="hit")
+        if m1 > m0:
+            _M_PCACHE.inc(m1 - m0, kernel=kernel, result="miss")
+        if m1 > m0:
+            cache = "miss"
+        elif h1 > h0:
+            cache = "hit"
+        else:
+            cache = "off"
+        flight_recorder.record_event(
+            "jit_compile", kernel=kernel, shape_bucket=shape_bucket,
+            seconds=round(dt, 4), persistent_cache=cache)
+        if sp is not None:
+            sp.finish(seconds=round(dt, 4))
+        g_startup.mark_once("first_device_call")
+
+
+class CompileTracker:
+    """First-call-per-key gate in front of :func:`compile_span`.
+
+    Steady-state cost is one set lookup; the key should encode every
+    axis that forces a fresh XLA lowering (shape bucket, period, mesh).
+    A key evicted-and-rebuilt elsewhere recompiles without recounting —
+    acceptable drift for an attribution counter.
+    """
+
+    __slots__ = ("_seen",)
+
+    def __init__(self) -> None:
+        self._seen: set = set()
+
+    def run(self, kernel: str, key, shape_bucket: str, fn, *args):
+        k = (kernel, key)
+        if k in self._seen:
+            return fn(*args)
+        with compile_span(kernel, shape_bucket):
+            out = fn(*args)
+        self._seen.add(k)
+        return out

@@ -165,6 +165,12 @@ _SUMMARY_GROUPS = (
     ("nodexa_jitcache", "cache"),
     ("nodexa_kvstore", "cache"),
     ("nodexa_span", "spans"),
+    ("nodexa_pool", "pool"),
+    ("nodexa_mesh", "mesh"),
+    ("nodexa_dag_residency", "mesh"),
+    ("nodexa_jit_", "jit"),
+    ("nodexa_startup", "startup"),
+    ("nodexa_flight_recorder", "recorder"),
 )
 
 

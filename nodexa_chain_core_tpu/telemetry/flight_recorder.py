@@ -1,0 +1,229 @@
+"""Always-on flight recorder: a bounded ring of completed trace spans
+and structured events.
+
+The registry answers "how much / how fast in aggregate"; the flight
+recorder answers "what just happened, in order" — the last few thousand
+completed spans (:mod:`.tracing`) and the rare structured events (safe
+mode entry, JIT compiles, mesh demotions, pool bans, blocks found) that
+give a post-mortem its narrative.  It is always on, so a degraded node
+can be diagnosed after the fact without having had ``-debug`` enabled.
+
+Three exits:
+
+- automatic dump on safe-mode entry (:mod:`..node.health` calls
+  :func:`auto_dump` before producers are halted);
+- the ``dumpflightrecorder`` RPC (operator-requested snapshot to disk);
+- the ``gettrace`` RPC (assemble one trace's span tree in place).
+
+Cost discipline: the rings are ``collections.deque(maxlen=...)`` —
+append is O(1) and GIL-atomic, so recording takes no lock; snapshots
+copy via ``list(deque)`` which is likewise safe under CPython.  Span
+records only exist at all when spans are enabled (``-telemetryspans=0``
+turns the producers off at the source).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .registry import g_metrics
+
+DEFAULT_SPAN_CAPACITY = 4096
+DEFAULT_EVENT_CAPACITY = 1024
+
+_spans: "deque" = deque(maxlen=DEFAULT_SPAN_CAPACITY)
+_events: "deque" = deque(maxlen=DEFAULT_EVENT_CAPACITY)
+_dump_dir: Optional[str] = None
+
+_M_DUMPS = g_metrics.counter(
+    "nodexa_flight_recorder_dumps_total",
+    "Flight-recorder dumps written, labeled by reason "
+    "(safe-mode|rpc|manual)")
+_M_EVENTS = g_metrics.counter(
+    "nodexa_flight_recorder_events_total",
+    "Structured flight-recorder events, labeled by kind")
+g_metrics.gauge_fn(
+    "nodexa_flight_recorder_spans",
+    "Completed trace spans currently held in the flight-recorder ring",
+    lambda: float(len(_spans)))
+
+
+def set_capacity(spans: int = DEFAULT_SPAN_CAPACITY,
+                 events: int = DEFAULT_EVENT_CAPACITY) -> None:
+    """Re-bound the rings (tests); keeps the newest records."""
+    global _spans, _events
+    _spans = deque(list(_spans)[-spans:], maxlen=spans)
+    _events = deque(list(_events)[-events:], maxlen=events)
+
+
+def set_dump_dir(path: Optional[str]) -> None:
+    """Where :func:`auto_dump` lands (the daemon points this at
+    ``-datadir``; ``None`` unsets, falling back to the attached node's
+    datadir, then the system temp dir)."""
+    global _dump_dir
+    _dump_dir = path
+
+
+def record_span(rec: dict) -> None:
+    """Completed-span intake (called by TraceSpan.finish; lock-free)."""
+    _spans.append(rec)
+
+
+def record_event(kind: str, **fields) -> None:
+    """Structured event intake — rare, narrative-level occurrences only
+    (safe mode, compiles, demotions, bans, blocks found)."""
+    _M_EVENTS.inc(kind=kind)
+    evt = {
+        "kind": kind,
+        "time": time.time(),
+        "thread": threading.current_thread().name,
+    }
+    evt.update(fields)
+    _events.append(evt)
+
+
+def spans_snapshot() -> List[dict]:
+    return list(_spans)
+
+
+def events_snapshot() -> List[dict]:
+    return list(_events)
+
+
+def clear() -> None:
+    """Test isolation only — production never forgets."""
+    _spans.clear()
+    _events.clear()
+
+
+# ----------------------------------------------------------- trace assembly
+
+
+def traces() -> Dict[str, List[dict]]:
+    """trace_id -> spans (each list ordered by span start time)."""
+    out: Dict[str, List[dict]] = {}
+    for rec in list(_spans):
+        out.setdefault(rec["trace_id"], []).append(rec)
+    for spans in out.values():
+        spans.sort(key=lambda r: r["start"])
+    return out
+
+
+def _is_complete(spans: List[dict]) -> bool:
+    """A complete trace has its root span (no parent) recorded — roots
+    finish last, so their presence means the request ran end to end."""
+    return any(r.get("parent_id") is None for r in spans)
+
+
+def complete_traces() -> Dict[str, List[dict]]:
+    return {tid: s for tid, s in traces().items() if _is_complete(s)}
+
+
+def get_trace(trace_id: Optional[str] = None) -> Optional[dict]:
+    """One assembled trace: ``{"trace_id", "complete", "spans": [...]}``.
+
+    ``trace_id=None`` returns the most recently *completed* trace (the
+    one whose root finished last).  None when nothing matches."""
+    all_traces = traces()
+    if trace_id is None:
+        best, best_end = None, -1.0
+        for tid, spans in all_traces.items():
+            if not _is_complete(spans):
+                continue
+            end = max(r["start"] + r["duration_s"] for r in spans)
+            if end > best_end:
+                best, best_end = tid, end
+        trace_id = best
+    if trace_id is None or trace_id not in all_traces:
+        return None
+    spans = all_traces[trace_id]
+    return {
+        "trace_id": trace_id,
+        "complete": _is_complete(spans),
+        "spans": spans,
+    }
+
+
+# ------------------------------------------------------------------- dumps
+
+
+def _health_mode() -> str:
+    try:  # lazy: node.health imports this module
+        from ..node.health import g_health
+
+        return g_health.mode_name()
+    except Exception:  # noqa: BLE001 — dump must not die on a half-built
+        return "unknown"  # process (early init, teardown)
+
+
+def dump(path: Optional[str] = None, reason: str = "manual") -> dict:
+    """Write the whole recorder as JSON; returns a summary dict
+    (path/spans/events/complete trace count)."""
+    spans = spans_snapshot()
+    events = events_snapshot()
+    complete = complete_traces()
+    if path is None:
+        path = _default_dump_path(reason)
+    payload = {
+        "meta": {
+            "time": time.time(),
+            "pid": os.getpid(),
+            "reason": reason,
+            "health_mode": _health_mode(),
+            "complete_traces": len(complete),
+        },
+        "spans": spans,
+        "events": events,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    _M_DUMPS.inc(reason=reason)
+    return {
+        "path": os.path.abspath(path),
+        "spans": len(spans),
+        "events": len(events),
+        "complete_traces": len(complete),
+    }
+
+
+def _default_dump_path(reason: str) -> str:
+    import tempfile
+
+    d = _dump_dir
+    if d is None:
+        try:
+            from ..node.health import g_health
+
+            node = g_health._node
+            d = getattr(node, "datadir", None) if node is not None else None
+        except Exception:  # noqa: BLE001 — fall through to tempdir
+            d = None
+    if d is None:
+        d = tempfile.gettempdir()
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+    return os.path.join(
+        d, f"flightrecorder-{stamp}-{os.getpid()}-{reason}.json")
+
+
+def auto_dump(reason: str) -> Optional[str]:
+    """Best-effort dump (safe-mode entry: the disk may be the thing that
+    just failed).  Returns the path or None; never raises."""
+    from ..utils.logging import log_printf
+
+    try:
+        out = dump(reason=reason)
+    except Exception as e:  # noqa: BLE001 — best-effort by contract
+        log_printf("flight recorder: auto-dump failed: %r", e)
+        return None
+    log_printf(
+        "flight recorder: dumped %d spans / %d events (%d complete "
+        "traces) to %s", out["spans"], out["events"],
+        out["complete_traces"], out["path"])
+    return out["path"]

@@ -86,10 +86,26 @@ _bound_cache: Dict[str, object] = {}
 
 
 def span(name: str):
-    """Context manager timing one named span (no-op when disabled)."""
+    """Context manager timing one named span (no-op when disabled).
+
+    KILL-SWITCH CONTRACT (``-telemetryspans=0``): the disabled path is
+    exactly one module-global bool check returning a shared no-op
+    context manager — no contextvar read, no clock read, no allocation.
+    tests/test_telemetry.py carries a microbench pinning this.
+    """
     if not _enabled:
         return _NULL_SPAN
     bound = _bound_cache.get(name)
     if bound is None:
         bound = _bound_cache[name] = span_hist.labels(span=name)
     return _Span(bound)
+
+
+def observe_span(name: str, seconds: float) -> None:
+    """Record one observation into the aggregate span histogram (the
+    trace layer funnels through here so ``span()`` and ``trace_span()``
+    feed the same ``nodexa_span_duration_seconds`` series)."""
+    bound = _bound_cache.get(name)
+    if bound is None:
+        bound = _bound_cache[name] = span_hist.labels(span=name)
+    bound.observe(seconds)

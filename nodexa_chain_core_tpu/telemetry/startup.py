@@ -1,0 +1,109 @@
+"""Daemon boot attribution: a stage timeline plus time-to-first-X marks.
+
+BENCH_r05's restart probe showed 54-65 s from process start to first
+sweep — but nothing said *where* that minute goes.  This module makes
+restart cost a first-class, queryable quantity:
+
+- ``g_startup.stage(name)`` wraps one boot stage (chainstate load,
+  self-check, mesh init, wallet, network, pool, rpc) and records its
+  duration;
+- ``g_startup.mark_once(name)`` records elapsed-since-boot for
+  one-shot milestones reached later (``first_device_call`` — the first
+  JIT compile/dispatch, fed by :mod:`.compileattr`; ``first_sweep`` —
+  the built-in miner's first completed nonce slice; ``first_share`` —
+  the pool's first judged share);
+- everything lands on ``nodexa_startup_stage_seconds{stage=...}``
+  (stages as durations, marks as elapsed-from-boot) and the
+  ``getstartupinfo`` RPC, and each stage is pushed to the flight
+  recorder as a ``startup_stage`` event so a post-mortem dump carries
+  the boot narrative too.
+
+``startup_to_first_sweep_s`` — the metric ROADMAP item 2 needs before
+the compilation-cache work can be graded — is the ``first_sweep`` mark
+(also measured process-external by ``bench/startup.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List
+
+from . import flight_recorder
+from .registry import g_metrics
+
+_M_STAGE = g_metrics.gauge(
+    "nodexa_startup_stage_seconds",
+    "Daemon boot attribution: stage durations (stage=chainstate_load|"
+    "selfcheck|mesh_init|...) and elapsed-from-boot one-shot marks "
+    "(stage=first_device_call|first_sweep|first_share)")
+
+
+class StartupTimeline:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """(Re)anchor the boot clock — the daemon calls :meth:`begin`
+        at the top of app_init_main; module import time is the fallback
+        anchor for in-process embedders."""
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._stages: List[dict] = []
+        self._marks: Dict[str, float] = {}
+
+    def begin(self) -> None:
+        self.reset()
+
+    @contextmanager
+    def stage(self, name: str):
+        """Time one boot stage; records even when the body raises (the
+        failed stage is exactly the one worth attributing)."""
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t
+            at = t - self._t0
+            with self._lock:
+                self._stages.append(
+                    {"stage": name, "seconds": dt, "at": at})
+            _M_STAGE.set(dt, stage=name)
+            flight_recorder.record_event(
+                "startup_stage", stage=name, seconds=round(dt, 4),
+                at=round(at, 4))
+
+    def mark_once(self, name: str) -> None:
+        """First occurrence of a one-shot milestone; later calls no-op
+        (one dict probe), so hot paths may call this unconditionally."""
+        with self._lock:
+            if name in self._marks:
+                return
+            elapsed = time.perf_counter() - self._t0
+            self._marks[name] = elapsed
+        _M_STAGE.set(elapsed, stage=name)
+        flight_recorder.record_event(
+            "startup_mark", mark=name, at=round(elapsed, 4))
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def snapshot(self) -> dict:
+        """getstartupinfo RPC payload."""
+        with self._lock:
+            stages = [dict(s) for s in self._stages]
+            marks = dict(self._marks)
+        return {
+            "started_at": self._wall0,
+            "uptime_s": self.elapsed(),
+            "stages": stages,
+            "marks": marks,
+            # the ROADMAP item-2 headline number; null until the first
+            # sweep completes (or forever, on a non-mining node)
+            "startup_to_first_sweep_s": marks.get("first_sweep"),
+        }
+
+
+g_startup = StartupTimeline()

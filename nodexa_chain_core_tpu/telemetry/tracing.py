@@ -1,0 +1,245 @@
+"""Request-scoped causal traces over the span layer.
+
+:func:`..spans.span` answers "how long does stage X take in aggregate";
+this module answers "where did *this* share / block / transaction spend
+its time" — a trace ID plus a parent/child span tree, propagated through
+a ``contextvars.ContextVar`` on one thread and by explicit handles
+across thread hops (the pool IO thread -> share pipeline thread, the
+ConnectBlock master -> CheckQueue workers).
+
+Every finished trace span does double duty: its duration lands in the
+same ``nodexa_span_duration_seconds{span=name}`` histogram the flat
+``span()`` feeds (one instrumentation point serves both views), and the
+completed record is pushed into the :mod:`.flight_recorder` ring for
+``gettrace`` / post-mortem dumps.
+
+API shape (all functions no-op and return ``None`` when spans are
+disabled via ``-telemetryspans=0`` — the kill-switch check is the FIRST
+thing every entry point does, before any contextvar or clock work):
+
+- ``start_trace(name, **attrs)`` — new root span handle (new trace id).
+- ``start_span(name, **attrs)`` — child of the current context span
+  (or a new root when there is none).
+- ``child_span(name, parent, **attrs)`` — explicitly-parented child;
+  ``None`` parent means "caller isn't traced", so it no-ops.  This is
+  the cross-thread form: pass the handle with the work item.
+- ``trace_span(name, **attrs)`` — context manager: child of the current
+  context span, installed as the context for its body.
+- ``attach(handle)`` — context manager installing an existing handle as
+  the current context (thread-hop continuation).
+- ``record_span(name, parent, started_perf, ...)`` — record an
+  already-elapsed interval (stage timings measured with raw
+  ``perf_counter`` reads).
+
+Handles must be finished exactly once (``finish()`` is idempotent);
+unfinished spans simply never reach the recorder.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import os
+import threading
+import time
+from typing import Optional
+
+from . import spans as _spans
+from . import flight_recorder
+
+_counter = itertools.count(1)
+_PROC = f"{os.getpid() & 0xFFFFFF:06x}"
+
+_current: "contextvars.ContextVar[Optional[TraceSpan]]" = (
+    contextvars.ContextVar("nodexa_trace_span", default=None)
+)
+
+
+def _new_trace_id() -> str:
+    return f"{_PROC}-{next(_counter):08x}"
+
+
+class TraceSpan:
+    """One live span handle.  Cheap: slots only, two clock reads total."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "thread",
+                 "start", "_t0", "attrs", "_done")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[int],
+                 attrs: Optional[dict]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(_counter)
+        self.parent_id = parent_id
+        self.thread = threading.current_thread().name
+        self.attrs = attrs or {}
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        self._done = False
+
+    def set(self, **attrs) -> "TraceSpan":
+        self.attrs.update(attrs)
+        return self
+
+    def finish(self, status: str = "ok", **attrs) -> None:
+        """Record the span (idempotent: the first finish wins)."""
+        if self._done:
+            return
+        self._done = True
+        dt = time.perf_counter() - self._t0
+        if attrs:
+            self.attrs.update(attrs)
+        _spans.observe_span(self.name, dt)
+        rec = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "thread": self.thread,
+            "start": self.start,
+            "duration_s": dt,
+            "status": status,
+        }
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        flight_recorder.record_span(rec)
+
+
+def enabled() -> bool:
+    """Live kill-switch state — guard attr-construction at call sites
+    (``root = start_trace(..., expensive_attr) if enabled() else None``)
+    so the disabled path never pays string formatting either."""
+    return _spans._enabled
+
+
+def current_span() -> Optional[TraceSpan]:
+    if not _spans._enabled:
+        return None
+    return _current.get()
+
+
+def start_trace(name: str, **attrs) -> Optional[TraceSpan]:
+    """New root span (fresh trace id).  Does NOT install itself as the
+    context — use :func:`attach` for that."""
+    if not _spans._enabled:
+        return None
+    return TraceSpan(name, _new_trace_id(), None, attrs)
+
+
+def start_span(name: str, **attrs) -> Optional[TraceSpan]:
+    """Child of the current context span (a new root when uncontexted)."""
+    if not _spans._enabled:
+        return None
+    parent = _current.get()
+    if parent is None:
+        return TraceSpan(name, _new_trace_id(), None, attrs)
+    return TraceSpan(name, parent.trace_id, parent.span_id, attrs)
+
+
+def child_span(name: str, parent: Optional[TraceSpan],
+               **attrs) -> Optional[TraceSpan]:
+    """Explicitly-parented child (the cross-thread form); no-ops when
+    the parent is None — an untraced caller must stay untraced."""
+    if not _spans._enabled or parent is None:
+        return None
+    return TraceSpan(name, parent.trace_id, parent.span_id, attrs)
+
+
+def record_span(name: str, parent: Optional[TraceSpan], started_perf: float,
+                ended_perf: Optional[float] = None, status: str = "ok",
+                **attrs) -> None:
+    """Record an interval measured with raw ``perf_counter`` reads (the
+    stage-timing pattern): zero extra clock reads on the hot path."""
+    if not _spans._enabled or parent is None:
+        return
+    end = ended_perf if ended_perf is not None else time.perf_counter()
+    dt = max(end - started_perf, 0.0)
+    _spans.observe_span(name, dt)
+    rec = {
+        "trace_id": parent.trace_id,
+        "span_id": next(_counter),
+        "parent_id": parent.span_id,
+        "name": name,
+        "thread": threading.current_thread().name,
+        # wall start anchored to the PARENT's (wall, perf) pair: all of
+        # a request's after-the-fact stage recordings share one clock
+        # origin, so their relative ordering is exact
+        "start": parent.start + (started_perf - parent._t0),
+        "duration_s": dt,
+        "status": status,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    flight_recorder.record_span(rec)
+
+
+class _Null:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _Null()
+
+
+class _TraceSpanCtx:
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span: TraceSpan):
+        self._span = span
+        self._token = None
+
+    def __enter__(self) -> TraceSpan:
+        self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        _current.reset(self._token)
+        if exc_type is not None:
+            self._span.finish(status="error", error=repr(exc))
+        else:
+            self._span.finish()
+        return False
+
+
+def trace_span(name: str, **attrs):
+    """Context manager: child of the current context span, installed as
+    the context for its body (nested ``trace_span``/``start_span`` calls
+    parent to it).  Exceptions mark the span ``error`` and propagate."""
+    sp = start_span(name, **attrs)
+    if sp is None:  # disabled (possibly flipped mid-call: one check)
+        return _NULL
+    return _TraceSpanCtx(sp)
+
+
+class _Attach:
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span: Optional[TraceSpan]):
+        self._span = span
+        self._token = None
+
+    def __enter__(self):
+        if self._span is not None:
+            self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
+
+
+def attach(span: Optional[TraceSpan]):
+    """Install an existing handle as the current context (does NOT
+    finish it on exit — the owner does).  ``None`` no-ops, so thread-hop
+    call sites never need their own disabled check."""
+    if span is None or not _spans._enabled:
+        return _NULL
+    return _Attach(span)

@@ -35,9 +35,13 @@ def _fault_and_health_isolation():
     yield
     from nodexa_chain_core_tpu.node.faults import g_faults
     from nodexa_chain_core_tpu.node.health import g_health
+    from nodexa_chain_core_tpu.telemetry import flight_recorder
 
     if g_faults.enabled:
         g_faults.disarm_all()
     # unconditional: retry/error counters and the self-check verdict leak
     # even when the mode never left normal
     g_health.reset_for_tests()
+    # a test that pointed flight-recorder dumps at its tmp_path must not
+    # leave later safe-mode auto-dumps aiming at a deleted directory
+    flight_recorder.set_dump_dir(None)

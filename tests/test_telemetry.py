@@ -239,6 +239,162 @@ def test_prometheus_label_escaping():
     assert r't_total{reason="has \"quotes\" and \\slash\\"} 1' in text
 
 
+def _parse_exposition(text: str):
+    """Round-trip parser for the classic Prometheus text format.
+
+    Returns (families, samples): families maps name -> {"type", "help"},
+    samples is a list of (name, labels_dict, raw_value) with the label
+    escaping DECODED — so a value that survives this parse is provably
+    scrapeable.
+    """
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    name_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$")
+    families, samples = {}, []
+    last_help = None
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            last_help = name
+            families.setdefault(name, {})["help"] = line.split(" ", 3)[3]
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            # HELP (when present) must directly precede TYPE
+            if name in families and "help" in families[name]:
+                assert last_help == name, f"HELP/TYPE adjacency for {name}"
+            families.setdefault(name, {})["type"] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        m = name_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labelstr, raw = m.groups()
+        labels = {}
+        if labelstr:
+            consumed = 0
+            for lm in label_re.finditer(labelstr):
+                labels[lm.group(1)] = (
+                    lm.group(2)
+                    .replace("\\n", "\n")
+                    .replace('\\"', '"')
+                    .replace("\\\\", "\\")
+                )
+                consumed = lm.end()
+            rest = labelstr[consumed:].strip(", ")
+            assert not rest, f"unparsed label residue {rest!r} in {line!r}"
+        float(raw.replace("+Inf", "inf"))  # value must be numeric
+        samples.append((name, labels, raw))
+    return families, samples
+
+
+def test_exposition_conformance_round_trip():
+    """Satellite: parse the FULL global /metrics output back and assert
+    label escaping, HELP/TYPE lines, bucket monotonicity and the +Inf
+    terminal bucket for every registered series."""
+    # plant a hostile label value and histogram traffic first
+    g_metrics.counter(
+        "t_conformance_total", "escaping probe").inc(
+        1, reason='quote " slash \\ newline \n end')
+    g_metrics.histogram(
+        "t_conformance_seconds", "hist probe",
+        buckets=(0.01, 0.1, 1.0)).observe(0.05, op="probe")
+    text = prometheus_text()
+    families, samples = _parse_exposition(text)
+
+    # every sample belongs to a TYPE-declared family (histograms via
+    # their _bucket/_sum/_count suffixes)
+    def family_of(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                return name[: -len(suffix)]
+        return name
+
+    for name, labels, _ in samples:
+        fam = family_of(name)
+        assert fam in families and "type" in families[fam], name
+
+    # the hostile label value survives the escape/unescape round trip
+    escaped = [lv for n, ls, _ in samples if n == "t_conformance_total"
+               for lv in ls.values()]
+    assert 'quote " slash \\ newline \n end' in escaped
+
+    # no duplicate series: (name, labelset) is unique across the payload
+    seen = set()
+    for name, labels, _ in samples:
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"duplicate series {key}"
+        seen.add(key)
+
+    # every histogram family: per-labelset buckets are monotone in le,
+    # carry a terminal +Inf bucket equal to _count, and have a _sum
+    hists = {n for n, f in families.items() if f.get("type") == "histogram"}
+    assert "t_conformance_seconds" in hists
+    for fam in hists:
+        series = {}
+        sums, counts = set(), {}
+        for name, labels, raw in samples:
+            base = {k: v for k, v in labels.items() if k != "le"}
+            key = tuple(sorted(base.items()))
+            if name == fam + "_bucket":
+                series.setdefault(key, []).append(
+                    (float(labels["le"].replace("+Inf", "inf")),
+                     int(float(raw))))
+            elif name == fam + "_sum":
+                sums.add(key)
+            elif name == fam + "_count":
+                counts[key] = int(float(raw))
+        assert series, f"histogram {fam} exposed no buckets"
+        for key, buckets in series.items():
+            buckets.sort()
+            les = [le for le, _ in buckets]
+            cums = [c for _, c in buckets]
+            assert les[-1] == float("inf"), f"{fam}{key} missing +Inf"
+            assert cums == sorted(cums), f"{fam}{key} not monotone"
+            assert key in sums, f"{fam}{key} missing _sum"
+            assert counts.get(key) == cums[-1], \
+                f"{fam}{key} +Inf bucket != _count"
+
+
+def test_disabled_span_overhead_is_noise():
+    """Satellite: the -telemetryspans=0 kill switch must early-exit in
+    span() before any contextvar/clock work.  Pin it with a microbench:
+    the disabled path must cost well under the enabled path and stay
+    within a small multiple of a bare function call."""
+    import timeit
+
+    def spin():
+        with span("kill.switch.bench"):
+            pass
+
+    def baseline():
+        spans_enabled()
+
+    n, reps = 20000, 5
+    set_spans_enabled(False)
+    try:
+        disabled = min(timeit.repeat(spin, number=n, repeat=reps))
+    finally:
+        set_spans_enabled(True)
+    enabled = min(timeit.repeat(spin, number=n, repeat=reps))
+    base = min(timeit.repeat(baseline, number=n, repeat=reps))
+    # a clock read + lock + histogram insert dwarfs a bool check: if the
+    # disabled path ever grows contextvar/clock work these collapse
+    assert disabled < enabled * 0.7, (disabled, enabled)
+    assert disabled < base * 25, (disabled, base)
+    # and the tracing layer honors the same switch (no recorder growth)
+    from nodexa_chain_core_tpu.telemetry import flight_recorder, tracing
+
+    set_spans_enabled(False)
+    try:
+        before = len(flight_recorder.spans_snapshot())
+        with tracing.trace_span("kill.switch.traced"):
+            pass
+        assert len(flight_recorder.spans_snapshot()) == before
+    finally:
+        set_spans_enabled(True)
+
+
 def test_snapshot_is_json_serializable_and_mirrors_registry():
     r = MetricsRegistry()
     r.counter("t_total").inc(2, k="v")
@@ -279,8 +435,11 @@ def test_getmetrics_rpc_shape(node):
         assert entry["type"] in ("counter", "gauge", "histogram")
         assert isinstance(entry["values"], list)
     json.dumps(out)  # RPC result must be JSON-clean
-    filtered = getmetrics(node, ["sigcache"])["metrics"]
-    assert filtered and all("sigcache" in k for k in filtered)
+    # the filter is a PREFIX (fleet scrapers pull one subsystem without
+    # the full payload): a prefixed query matches, a substring does not
+    filtered = getmetrics(node, ["nodexa_sigcache"])["metrics"]
+    assert filtered and all(k.startswith("nodexa_sigcache") for k in filtered)
+    assert getmetrics(node, ["sigcache"])["metrics"] == {}
 
 
 def test_getmetrics_registered_in_rpc_table():

@@ -1,0 +1,494 @@
+"""Causal tracing, flight recorder, startup attribution, compile
+attribution, and the nodexa_top dashboard renderer.
+
+The acceptance scenario lives here: one stratum share submitted through
+a real loopback session must yield a retrievable trace (via the
+``gettrace`` RPC) with >=5 causally-linked spans spanning at least two
+threads; forced safe-mode entry must write a flight-recorder dump; and
+a cold compile must land on the per-kernel attribution counters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nodexa_chain_core_tpu.telemetry import (
+    flight_recorder,
+    g_metrics,
+    g_startup,
+    set_spans_enabled,
+    tracing,
+)
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+@pytest.fixture(autouse=True)
+def _spans_on():
+    set_spans_enabled(True)
+    yield
+    set_spans_enabled(True)
+
+
+# ------------------------------------------------------------ tracing core
+
+
+def test_trace_tree_assembly_across_threads():
+    root = tracing.start_trace("req", kind="test")
+    with tracing.attach(root):
+        with tracing.trace_span("stage.a"):
+            inner = tracing.start_span("stage.a.inner")
+            inner.finish()
+    handoff = tracing.child_span("stage.b", root)
+
+    def worker():
+        grand = tracing.child_span("stage.b.inner", handoff)
+        grand.finish()
+        handoff.finish()
+
+    t = threading.Thread(target=worker, name="trace-worker")
+    t.start()
+    t.join()
+    root.finish(status="ok")
+
+    trace = flight_recorder.get_trace(root.trace_id)
+    assert trace is not None and trace["complete"]
+    spans = trace["spans"]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {
+        "req", "stage.a", "stage.a.inner", "stage.b", "stage.b.inner"}
+    # parent links form a tree rooted at `req`
+    ids = {s["span_id"] for s in spans}
+    root_rec = by_name["req"]
+    assert root_rec["parent_id"] is None
+    for s in spans:
+        if s is not root_rec:
+            assert s["parent_id"] in ids
+    assert by_name["stage.a.inner"]["parent_id"] == \
+        by_name["stage.a"]["span_id"]
+    assert by_name["stage.b.inner"]["parent_id"] == \
+        by_name["stage.b"]["span_id"]
+    # the thread hop is visible on the records
+    assert by_name["stage.b.inner"]["thread"] == "trace-worker"
+    assert by_name["req"]["thread"] != "trace-worker"
+
+
+def test_tracing_disabled_is_total_noop():
+    set_spans_enabled(False)
+    before = len(flight_recorder.spans_snapshot())
+    assert tracing.start_trace("x") is None
+    assert tracing.start_span("x") is None
+    assert tracing.child_span("x", None) is None
+    assert tracing.current_span() is None
+    with tracing.trace_span("x") as sp:
+        assert sp is None
+    with tracing.attach(None):
+        pass
+    tracing.record_span("x", None, 0.0)
+    assert len(flight_recorder.spans_snapshot()) == before
+
+
+def test_trace_span_marks_error_and_propagates():
+    root = tracing.start_trace("boom")
+    with pytest.raises(ValueError):
+        with tracing.attach(root):
+            with tracing.trace_span("will.fail"):
+                raise ValueError("nope")
+    root.finish(status="error")
+    trace = flight_recorder.get_trace(root.trace_id)
+    failed = [s for s in trace["spans"] if s["name"] == "will.fail"]
+    assert failed and failed[0]["status"] == "error"
+
+
+def test_finish_is_idempotent_and_records_span_histogram():
+    from nodexa_chain_core_tpu.telemetry.spans import span_hist
+
+    before = (span_hist.snapshot(span="idem.span") or {"count": 0})["count"]
+    sp = tracing.start_trace("idem.span")
+    sp.finish()
+    sp.finish(status="error")  # second finish must not double-record
+    after = span_hist.snapshot(span="idem.span")["count"]
+    assert after == before + 1
+    trace = flight_recorder.get_trace(sp.trace_id)
+    assert len(trace["spans"]) == 1 and trace["spans"][0]["status"] == "ok"
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_is_bounded():
+    flight_recorder.set_capacity(spans=16, events=4)
+    try:
+        for i in range(64):
+            sp = tracing.start_trace(f"ring.{i}")
+            sp.finish()
+            flight_recorder.record_event("ring_event", i=i)
+        assert len(flight_recorder.spans_snapshot()) == 16
+        assert len(flight_recorder.events_snapshot()) == 4
+        # the newest records survive
+        assert flight_recorder.spans_snapshot()[-1]["name"] == "ring.63"
+    finally:
+        flight_recorder.set_capacity()
+
+
+def test_flight_recorder_dump_round_trips(tmp_path):
+    sp = tracing.start_trace("dump.me")
+    sp.finish()
+    flight_recorder.record_event("test_event", detail="x")
+    out = flight_recorder.dump(path=str(tmp_path / "fr.json"))
+    assert out["spans"] >= 1 and out["complete_traces"] >= 1
+    with open(out["path"]) as f:
+        payload = json.load(f)
+    assert payload["meta"]["reason"] == "manual"
+    assert any(s["name"] == "dump.me" for s in payload["spans"])
+    assert any(e["kind"] == "test_event" for e in payload["events"])
+
+
+def test_safe_mode_entry_auto_dumps(tmp_path):
+    from nodexa_chain_core_tpu.node.health import g_health
+
+    flight_recorder.set_dump_dir(str(tmp_path))
+    sp = tracing.start_trace("pre.failure")
+    sp.finish()
+    g_health.critical_error("kvstore.write_batch", OSError(5, "boom"))
+    dumps = list(tmp_path.glob("flightrecorder-*-safe-mode.json"))
+    assert dumps, "safe-mode entry must auto-dump the flight recorder"
+    with open(dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["meta"]["health_mode"] == "safe"
+    assert any(
+        e["kind"] == "safe_mode_entered" for e in payload["events"])
+    snap = g_health.snapshot()
+    assert snap["last_critical_error"]["flight_recorder_dump"] == (
+        str(dumps[0]))
+    g_health.join_halt()
+
+
+# --------------------------------------------- the acceptance share trace
+
+
+def _drain_trace(name: str, timeout: float = 10.0) -> dict:
+    """Poll the recorder until a complete trace rooted at `name` lands
+    (the root finishes just after the reply is dispatched); returns the
+    NEWEST such trace — earlier tests share the process-global ring."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        best, best_end = None, -1.0
+        for tid, spans in flight_recorder.complete_traces().items():
+            for s in spans:
+                if s["name"] == name and s["parent_id"] is None:
+                    end = s["start"] + s["duration_s"]
+                    if end > best_end:
+                        best, best_end = tid, end
+        if best is not None:
+            return flight_recorder.get_trace(best)
+        time.sleep(0.02)
+    raise TimeoutError(f"no complete {name} trace recorded")
+
+
+def test_stratum_share_loopback_trace(monkeypatch):
+    """One share through a real loopback session -> >=5 causally-linked
+    spans across >=2 threads, retrievable via the gettrace RPC."""
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.crypto import kawpow
+    from nodexa_chain_core_tpu.node import chainparams
+    from nodexa_chain_core_tpu.pool import (
+        JobManager,
+        SharePipeline,
+        StratumServer,
+    )
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+    from tests.test_pool_stratum import Client
+
+    # scalar-path validation against a deterministic fake hash: the
+    # claimed mix never matches, so the share runs the FULL pipeline
+    # (precheck -> queue -> validate -> judge -> reply) without needing
+    # an epoch slab or landing a block
+    monkeypatch.setattr(
+        kawpow, "kawpow_hash",
+        lambda height, hh_le, nonce: (1 << 200, 0xFEED))
+
+    params = chainparams.select_params("kawpowregtest")
+    try:
+        cs = ChainState(params)
+        spk = p2pkh_script(KeyID(KeyStore().add_key(0xBEEF))).raw
+        node = SimpleNamespace(
+            params=params, chainstate=cs, mempool=None,
+            epoch_manager=None, wallet=None, connman=None,
+        )
+        jobs = JobManager(node, spk)
+        pipeline = SharePipeline(node, batch_window_s=0.002)
+        srv = StratumServer(node, jobs, pipeline, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            c = Client(srv.port)
+            extranonce1 = c.subscribe_authorize("tracer")
+            job_id = c.wait_notify()["params"][0]
+            nonce = (extranonce1 << 48) | 0x1234
+            rsp = c.rpc(5, "mining.submit", [
+                "tracer", job_id, f"{nonce:016x}", f"{0xABCD:064x}"])
+            assert rsp["result"] is False  # bad-mix: full pipeline ran
+            c.close()
+        finally:
+            srv.stop()
+    finally:
+        chainparams.select_params("regtest")
+
+    trace = _drain_trace("stratum.share")
+    spans = trace["spans"]
+    names = [s["name"] for s in spans]
+    assert len(spans) >= 5, names
+    assert {"stratum.share", "share.precheck", "share.queue",
+            "share.validate", "share.reply"} <= set(names)
+    # causally linked: every non-root span's parent is in the trace
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "stratum.share"
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+    assert roots[0]["attrs"]["verdict"] == "bad-mix"
+    # at least two threads took part (pool IO loop + share pipeline)
+    threads = {s["thread"] for s in spans}
+    assert len(threads) >= 2, threads
+    assert {"pool-io", "pool-shares"} <= threads
+    # retrievable through the RPC surface, by id and as "latest"
+    via_rpc = rpc_misc.gettrace(None, [trace["trace_id"]])
+    assert via_rpc["trace_id"] == trace["trace_id"]
+    assert len(via_rpc["spans"]) == len(spans)
+
+
+# ------------------------------------------------- block & mempool traces
+
+
+def _mine_one(cs, params):
+    from nodexa_chain_core_tpu.mining.assembler import (
+        BlockAssembler,
+        mine_block_cpu,
+    )
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+
+    spk = p2pkh_script(KeyID(KeyStore().add_key(0xD00D))).raw
+    h = cs.tip().height
+    blk = BlockAssembler(cs).create_new_block(
+        spk, ntime=params.genesis_time + 60 * (h + 1))
+    assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 22)
+    cs.process_new_block(blk)
+
+
+def test_block_connect_trace_records_stages():
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.node.chainparams import select_params
+
+    params = select_params("regtest")
+    cs = ChainState(params)
+    _mine_one(cs, params)
+    trace = _drain_trace("block.connect", timeout=2.0)
+    names = {s["name"] for s in trace["spans"]}
+    assert {"block.connect", "connect.read", "connect.block",
+            "connect.flush", "connect.post",
+            "connectblock.scripts"} <= names
+    root = next(s for s in trace["spans"] if s["parent_id"] is None)
+    assert root["attrs"]["height"] == 1 and root["attrs"]["txs"] == 1
+    # stage children keep chronological order via back-derived starts
+    order = [s["name"] for s in trace["spans"]
+             if s["name"].startswith("connect.")]
+    assert order == ["connect.read", "connect.block", "connect.flush",
+                     "connect.post"]
+
+
+def test_mempool_reject_trace():
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.chain.mempool_accept import (
+        MempoolAcceptError,
+        accept_to_memory_pool,
+    )
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.node.chainparams import select_params
+    from nodexa_chain_core_tpu.primitives.transaction import Transaction
+
+    params = select_params("regtest")
+    cs = ChainState(params)
+    with pytest.raises(MempoolAcceptError):
+        accept_to_memory_pool(cs, TxMemPool(), Transaction())
+    trace = _drain_trace("mempool.accept", timeout=2.0)
+    root = next(s for s in trace["spans"] if s["parent_id"] is None)
+    assert root["status"] == "rejected"
+    assert root["attrs"]["reason"]
+    assert any(
+        s["name"] == "mempool.prechecks" for s in trace["spans"])
+
+
+# -------------------------------------------------- compile & startup
+
+
+def test_compile_attribution_counts_first_dispatch_only():
+    from nodexa_chain_core_tpu.telemetry.compileattr import CompileTracker
+
+    compiles = g_metrics.get("nodexa_jit_compiles_total")
+    before = compiles.value(kernel="test.kernel", shape_bucket="64")
+    calls = []
+    tracker = CompileTracker()
+    for _ in range(3):
+        out = tracker.run("test.kernel", 64, "64",
+                          lambda x: calls.append(x) or x * 2, 21)
+        assert out == 42
+    assert len(calls) == 3
+    assert compiles.value(
+        kernel="test.kernel", shape_bucket="64") == before + 1
+    hist = g_metrics.get("nodexa_jit_compile_seconds")
+    assert hist.snapshot(kernel="test.kernel")["count"] >= 1
+    # the first attributed dispatch marks the startup timeline
+    assert "first_device_call" in g_startup.snapshot()["marks"]
+    # and the recorder carries the jit_compile event
+    assert any(e["kind"] == "jit_compile" and e["kernel"] == "test.kernel"
+               for e in flight_recorder.events_snapshot())
+
+
+def test_compile_attribution_on_real_jit():
+    import jax
+
+    from nodexa_chain_core_tpu.telemetry.compileattr import CompileTracker
+
+    tracker = CompileTracker()
+    fn = jax.jit(lambda x: x + 1)
+    out = tracker.run("test.realjit", 1, "1", fn, 41)
+    assert int(out) == 42
+    compiles = g_metrics.get("nodexa_jit_compiles_total")
+    assert compiles.value(kernel="test.realjit", shape_bucket="1") == 1
+
+
+def test_startup_timeline_stages_and_marks():
+    from nodexa_chain_core_tpu.telemetry.startup import StartupTimeline
+
+    tl = StartupTimeline()
+    with tl.stage("chainstate_load"):
+        pass
+    with pytest.raises(RuntimeError):
+        with tl.stage("selfcheck"):
+            raise RuntimeError("x")  # failing stage still recorded
+    tl.mark_once("first_sweep")
+    tl.mark_once("first_sweep")  # idempotent
+    snap = tl.snapshot()
+    assert [s["stage"] for s in snap["stages"]] == [
+        "chainstate_load", "selfcheck"]
+    assert snap["startup_to_first_sweep_s"] == snap["marks"]["first_sweep"]
+    assert snap["uptime_s"] >= snap["marks"]["first_sweep"]
+
+
+def test_startup_and_trace_rpcs():
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.server import RPCError, RPCTable
+
+    table = register_all(RPCTable())
+    for name in ("gettrace", "dumpflightrecorder", "getstartupinfo"):
+        assert name in table.commands(), name
+    info = rpc_misc.getstartupinfo(None, [])
+    assert {"started_at", "uptime_s", "stages", "marks",
+            "startup_to_first_sweep_s"} <= set(info)
+    with pytest.raises(RPCError):
+        rpc_misc.gettrace(None, ["no-such-trace-id"])
+
+
+def test_dumpflightrecorder_rpc(tmp_path):
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+
+    sp = tracing.start_trace("rpc.dump")
+    sp.finish()
+    out = rpc_misc.dumpflightrecorder(
+        None, [str(tmp_path / "dump.json")])
+    assert os.path.exists(out["path"]) and out["spans"] >= 1
+    json.load(open(out["path"]))
+
+
+# ----------------------------------------------------- nodexa_top renderer
+
+
+def test_nodexa_top_renders_synthetic_snapshot():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "nodexa_top", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "nodexa_top.py"))
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+
+    def counter(value, **labels):
+        return {"values": [{"labels": labels, "value": value}]}
+
+    snap = {
+        "nodexa_node_health": counter(1.0),
+        "nodexa_mesh_devices": counter(8),
+        "nodexa_pool_shares_total": {
+            "values": [
+                {"labels": {"result": "accepted"}, "value": 90},
+                {"labels": {"result": "low-diff"}, "value": 7},
+            ]
+        },
+        "nodexa_pool_worker_hashrate_hs": counter(1.5e6, worker="rig0"),
+        "nodexa_jit_compiles_total": counter(
+            3, kernel="progpow.verify", shape_bucket="64x32"),
+        "nodexa_critical_errors_total": counter(
+            2, source="chainstate.coins_flush"),
+        "nodexa_connectblock_stage_seconds": {
+            "values": [{
+                "labels": {"stage": "total"},
+                "buckets": {"0.01": 5, "0.1": 9, "10.0": 10},
+                "sum": 1.0, "count": 10,
+            }]
+        },
+    }
+    prev = {"nodexa_pool_shares_total": {
+        "values": [{"labels": {"result": "accepted"}, "value": 50}]}}
+    frame = top.render(snap, prev, 2.0)
+    assert "SAFE MODE" in frame
+    assert "accepted=90" in frame and "low-diff=7" in frame
+    assert "progpow.verify=3" in frame
+    assert "chainstate.coins_flush=2" in frame
+    assert "20/s" in frame  # (90-50)/2
+    # histogram stats: mean 0.1s, p99 lands in the 10s bucket
+    assert "100.0ms" in frame
+    c, mean, p99 = top.hist_stats(
+        snap, "nodexa_connectblock_stage_seconds", stage="total")
+    assert c == 10 and abs(mean - 0.1) < 1e-9 and p99 == 10.0
+
+
+def test_metrics_snapshot_watch_mode(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_snapshot", os.path.join(
+            os.path.dirname(__file__), "..", "tools",
+            "metrics_snapshot.py"))
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    seq = [
+        {"m": {"type": "counter", "help": "", "values": [
+            {"labels": {}, "value": 1}]}},
+        {"m": {"type": "counter", "help": "", "values": [
+            {"labels": {}, "value": 4}]}},
+        {"m": {"type": "counter", "help": "", "values": [
+            {"labels": {}, "value": 9}]}},
+    ]
+    calls = iter(seq)
+    rc = ms.watch_loop(lambda: next(calls), 0.01, iterations=2)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("--- delta @") == 2
+    import re
+
+    nums = [int(m) for m in re.findall(r'"value": (\d+)', out)]
+    assert nums == [3, 5]  # two re-diff iterations: +3 then +5

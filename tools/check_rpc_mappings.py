@@ -43,6 +43,9 @@ EXPECTED_EXTRAS = {
     "generatetoaddresstpu",
     # node-wide telemetry registry (REST /metrics twin)
     "getmetrics",
+    # causal observability: trace retrieval, flight-recorder dump, boot
+    # attribution (telemetry/tracing + flight_recorder + startup)
+    "gettrace", "dumpflightrecorder", "getstartupinfo",
     # fault-tolerance surface: health mode, critical errors, self-check
     "getnodehealth",
     # stratum work-server subsystem (pool/)

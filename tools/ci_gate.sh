@@ -44,25 +44,33 @@
 #                      safe-mode degradation surface, and the startup
 #                      self-check refusing a corrupted undo journal
 #                      (full matrix + daemon e2e run under -m slow)
-#  10. vectors         generate_x16r_vectors.py --check — the committed
+#  10. observability   tools/flight_check.py — forced safe-mode entry
+#                      under -faultinject must auto-dump a flight-
+#                      recorder file carrying >=1 complete causal trace
+#                      (block.connect tree retrievable via gettrace);
+#                      bench/startup.py --assert-finite then measures
+#                      restart-to-first-sweep in a cold child and
+#                      asserts startup_to_first_sweep_s is finite with
+#                      per-kernel jit-compile attribution recorded
+#  11. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  11. native build    compiles the C++ engine (also feeds the wheel)
-#  12. static checks   tools/typecheck.py over the consensus-critical
+#  12. native build    compiles the C++ engine (also feeds the wheel)
+#  13. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  13. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  14. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  14. pytest          unit suite (functional suite with --full)
-#  15. wheel           platform-tagged wheel incl. the native .so,
+#  15. pytest          unit suite (functional suite with --full)
+#  16. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/15] lint"
+echo "== [1/16] lint"
 python tools/lint.py
 
-echo "== [2/15] import graph"
+echo "== [2/16] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -80,13 +88,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/15] rpc mapping parity"
+echo "== [3/16] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/15] telemetry exposition"
+echo "== [4/16] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/15] IBD fast path (synthetic)"
+echo "== [5/16] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -98,7 +106,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/15] pool stratum e2e (loopback)"
+echo "== [6/16] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -109,7 +117,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/15] mesh serving backend (forced 8-device mesh)"
+echo "== [7/16] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -120,7 +128,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [8/15] tx admission fast path (flood)"
+echo "== [8/16] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -131,7 +139,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [9/15] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [9/16] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -142,23 +150,38 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [10/15] crypto vector regeneration"
+echo "== [10/16] observability (flight recorder + startup attribution)"
+# forced safe-mode under a -faultinject spec must leave a usable
+# post-mortem: a flight-recorder dump with >=1 complete trace
+python tools/flight_check.py
+# restart-to-first-sweep measured in a cold child; the key must exist,
+# be finite, and carry per-kernel compile attribution (same no-pipe
+# discipline as the other bench stages)
+SUP_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
+        --assert-finite > "$SUP_LOG" 2>&1; then
+    cat "$SUP_LOG"; rm -f "$SUP_LOG"
+    exit 1
+fi
+tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
+
+echo "== [11/16] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [11/15] native engine build"
+echo "== [12/16] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [12/15] static checks (consensus-critical packages)"
+echo "== [13/16] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [13/15] native hardening (security-check analog)"
+echo "== [14/16] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [14/15] pytest"
+echo "== [15/16] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -170,7 +193,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [15/15] wheel"
+echo "== [16/16] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -1,0 +1,109 @@
+"""CI gate: forced safe-mode entry under -faultinject must produce a
+flight-recorder dump containing at least one complete causal trace.
+
+The scenario, in-process (the same spec grammar as the daemon flag /
+NODEXA_FAULTINJECT env):
+
+1. build a regtest chainstate in a temp datadir and connect one mined
+   block — the ConnectTip pipeline records a complete ``block.connect``
+   trace into the flight recorder;
+2. arm ``chainstate.coins_flush:errno=ENOSPC,count=-1`` via the env
+   var (exactly what ``-faultinject`` parses) and flush — the health
+   layer escalates to safe mode and auto-dumps the recorder;
+3. assert the dump file exists, parses, carries >=1 complete trace and
+   the safe_mode_entered event, and that ``gettrace`` can retrieve the
+   block-connect trace with its stage children;
+4. assert the node still shuts down cleanly with the fault armed.
+"""
+
+from __future__ import annotations
+
+import json
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    os.environ["NODEXA_FAULTINJECT"] = (
+        "chainstate.coins_flush:errno=ENOSPC,count=-1")
+
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.mining.assembler import (
+        BlockAssembler,
+        mine_block_cpu,
+    )
+    from nodexa_chain_core_tpu.node.chainparams import select_params
+    from nodexa_chain_core_tpu.node.faults import g_faults
+    from nodexa_chain_core_tpu.node.health import NodeCriticalError, g_health
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+    from nodexa_chain_core_tpu.telemetry import flight_recorder
+
+    tmp = tempfile.mkdtemp(prefix="nxk_flight_check_")
+    flight_recorder.set_dump_dir(tmp)
+    assert g_faults.arm_from_env() == 1, "-faultinject env spec did not arm"
+
+    params = select_params("regtest")
+    cs = ChainState(params, datadir=os.path.join(tmp, "n"))
+
+    # 1. one real block through ConnectTip -> a complete causal trace
+    spk = p2pkh_script(KeyID(KeyStore().add_key(0xF11E))).raw
+    blk = BlockAssembler(cs).create_new_block(
+        spk, ntime=params.genesis_time + 60)
+    assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 22)
+    cs.process_new_block(blk)
+
+    trace = flight_recorder.get_trace()
+    assert trace is not None and trace["complete"], "no complete trace"
+    names = {s["name"] for s in trace["spans"]}
+    assert "block.connect" in names, names
+    assert {"connect.read", "connect.block", "connect.flush",
+            "connect.post"} <= names, names
+    # gettrace (the RPC the operator uses) retrieves the same tree
+    via_rpc = rpc_misc.gettrace(None, [trace["trace_id"]])
+    assert via_rpc["trace_id"] == trace["trace_id"]
+    assert len(via_rpc["spans"]) >= 5, via_rpc["spans"]
+
+    # 2. the armed injection fires on the coins flush -> safe mode
+    try:
+        cs.flush_state_to_disk()
+        raise AssertionError("armed coins_flush fault did not escalate")
+    except NodeCriticalError:
+        pass
+    assert g_health.mode_name() == "safe", g_health.mode_name()
+
+    # 3. the auto-dump landed, parses, and carries the evidence
+    dumps = glob.glob(os.path.join(tmp, "flightrecorder-*-safe-mode.json"))
+    assert dumps, f"no flight-recorder dump in {tmp}"
+    with open(dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["meta"]["complete_traces"] >= 1, payload["meta"]
+    assert payload["meta"]["reason"] == "safe-mode"
+    kinds = {e["kind"] for e in payload["events"]}
+    assert "safe_mode_entered" in kinds, kinds
+    dumped_names = {s["name"] for s in payload["spans"]}
+    assert "block.connect" in dumped_names, dumped_names
+    health = g_health.snapshot()
+    assert health["last_critical_error"]["flight_recorder_dump"] == (
+        os.path.abspath(dumps[0]))
+
+    # 4. clean shutdown with the fault still armed
+    cs.close()
+
+    print(
+        f"flight recorder check OK: safe-mode entry under -faultinject "
+        f"dumped {payload['meta']['complete_traces']} complete trace(s), "
+        f"{len(payload['spans'])} spans and {len(payload['events'])} "
+        f"events to {dumps[0]}; gettrace served the block.connect tree "
+        f"({len(via_rpc['spans'])} spans)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,15 +118,20 @@ import json
 import os
 import sys
 import urllib.request
+from typing import Optional
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
 
 
-def fetch_rpc(host: str, port: int, auth: str) -> dict:
+def fetch_rpc(host: str, port: int, auth: str,
+              prefix: Optional[str] = None) -> dict:
+    """getmetrics over JSON-RPC (shared with tools/nodexa_top.py);
+    ``prefix`` maps to the RPC's name-prefix filter."""
     req = urllib.request.Request(
         f"http://{host}:{port}/",
         data=json.dumps(
-            {"id": 0, "method": "getmetrics", "params": []}
+            {"id": 0, "method": "getmetrics",
+             "params": [prefix] if prefix else []}
         ).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -141,6 +146,12 @@ def fetch_rpc(host: str, port: int, auth: str) -> dict:
     if body.get("error"):
         raise SystemExit(f"rpc error: {body['error']}")
     return body["result"]["metrics"]
+
+
+def cookie_auth(datadir: str) -> str:
+    """Read `<datadir>/.cookie` credentials (shared helper)."""
+    with open(os.path.join(datadir, ".cookie")) as f:
+        return f.read().strip()
 
 
 def local_snapshot() -> dict:
@@ -201,6 +212,33 @@ def diff_snapshots(before: dict, after: dict) -> dict:
     return out
 
 
+def watch_loop(fetch, interval_s: float, out=sys.stdout,
+               iterations: Optional[int] = None) -> int:
+    """Periodic re-diff: every ``interval_s`` take a fresh snapshot and
+    print the delta against the previous one (the --diff logic on a
+    timer).  ``iterations`` bounds the loop for tests; None runs until
+    interrupted."""
+    import time
+
+    prev = fetch()
+    done = 0
+    try:
+        while iterations is None or done < iterations:
+            time.sleep(interval_s)
+            snap = fetch()
+            delta = diff_snapshots(prev, snap)
+            prev = snap
+            done += 1
+            out.write(f"--- delta @ {time.strftime('%H:%M:%S')} "
+                      f"(+{interval_s:g}s) ---\n")
+            json.dump(delta, out, indent=1, sort_keys=True)
+            out.write("\n")
+            out.flush()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rpc", action="store_true",
@@ -214,19 +252,30 @@ def main() -> int:
                     help="user:password (overrides --datadir cookie)")
     ap.add_argument("--diff", default=None, metavar="BEFORE_JSON",
                     help="emit the delta against an earlier snapshot file")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="periodic re-diff mode: every SECS print the "
+                         "delta since the previous snapshot (^C stops)")
     args = ap.parse_args()
 
-    if args.rpc:
-        auth = args.auth
-        if auth is None and args.datadir:
-            with open(os.path.join(args.datadir, ".cookie")) as f:
-                auth = f.read().strip()
-        if auth is None:
-            ap.error("--rpc needs --auth or --datadir for credentials")
-        snap = fetch_rpc(args.host, args.port, auth)
-    else:
-        snap = local_snapshot()
+    def fetch():
+        if args.rpc:
+            auth = args.auth
+            if auth is None and args.datadir:
+                auth = cookie_auth(args.datadir)
+            if auth is None:
+                ap.error("--rpc needs --auth or --datadir for credentials")
+            return fetch_rpc(args.host, args.port, auth)
+        return local_snapshot()
 
+    if args.watch is not None:
+        if args.watch <= 0:
+            ap.error("--watch needs a positive interval")
+        if args.diff:
+            ap.error("--watch and --diff are mutually exclusive: watch "
+                     "re-diffs against its own previous interval")
+        return watch_loop(fetch, args.watch)
+
+    snap = fetch()
     if args.diff:
         with open(args.diff) as f:
             snap = diff_snapshots(json.load(f), snap)

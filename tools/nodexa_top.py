@@ -1,0 +1,257 @@
+"""nodexa_top — live terminal dashboard over a running node's metrics.
+
+Polls the ``getmetrics`` RPC (prefix-filtered to ``nodexa_``) and
+renders one screenful per interval: health mode, serving paths
+(mesh/single/scalar), hashrate, the stratum share ledger with
+per-interval rates, block-connect and mempool-admission latencies,
+cs_main holds, and JIT compile attribution — the operator's
+at-a-glance view of everything the telemetry layer measures.
+
+Usage:
+
+  python tools/nodexa_top.py --datadir /tmp/n1                # regtest
+  python tools/nodexa_top.py --port 8766 --auth user:pass -i 5
+  python tools/nodexa_top.py --datadir /tmp/n1 --once         # one frame
+
+Reads nothing but ``getmetrics``; works against a node in safe mode
+(read-only RPC stays up — that is exactly when you want this open).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+sys.path.insert(0, os.path.abspath(os.path.dirname(__file__)))
+
+# one getmetrics JSON-RPC client for both operator tools
+from metrics_snapshot import cookie_auth, fetch_rpc  # noqa: E402
+
+CLEAR = "\x1b[H\x1b[2J"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RED = "\x1b[31m"
+GREEN = "\x1b[32m"
+YELLOW = "\x1b[33m"
+RESET = "\x1b[0m"
+
+HEALTH_NAMES = {0: "normal", 1: "SAFE MODE", 2: "shutting down"}
+
+
+def fetch(host: str, port: int, auth: str) -> dict:
+    return fetch_rpc(host, port, auth, prefix="nodexa_")
+
+
+# ------------------------------------------------------- snapshot readers
+
+
+def _values(snap: dict, name: str):
+    return snap.get(name, {}).get("values", [])
+
+
+def series_total(snap: dict, name: str, **labels) -> float:
+    """Sum of a counter/gauge family's samples matching ``labels``."""
+    total = 0.0
+    for v in _values(snap, name):
+        lv = v.get("labels", {})
+        if all(lv.get(k) == want for k, want in labels.items()):
+            total += v.get("value", 0.0)
+    return total
+
+
+def by_label(snap: dict, name: str, label: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for v in _values(snap, name):
+        key = v.get("labels", {}).get(label, "")
+        out[key] = out.get(key, 0.0) + v.get("value", 0.0)
+    return out
+
+
+def hist_stats(snap: dict, name: str,
+               **labels) -> Tuple[int, float, float]:
+    """(count, mean_s, p99_s) over matching histogram samples; the p99
+    is the smallest bucket boundary whose cumulative count covers 99%."""
+    count, total = 0, 0.0
+    merged: Dict[float, int] = {}
+    for v in _values(snap, name):
+        lv = v.get("labels", {})
+        if not all(lv.get(k) == want for k, want in labels.items()):
+            continue
+        count += v.get("count", 0)
+        total += v.get("sum", 0.0)
+        prev = 0
+        for le_str, cum in sorted(
+                v.get("buckets", {}).items(), key=lambda kv: float(kv[0])):
+            le = float(le_str)
+            merged[le] = merged.get(le, 0) + (cum - prev)
+            prev = cum
+    if not count:
+        return 0, 0.0, 0.0
+    goal = 0.99 * count
+    cum, p99 = 0, 0.0
+    for le in sorted(merged):
+        cum += merged[le]
+        p99 = le
+        if cum >= goal:
+            break
+    return count, total / count, p99
+
+
+def fmt_rate(n: float) -> str:
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{unit}"
+    return f"{n:.0f}"
+
+
+def fmt_ms(s: float) -> str:
+    return f"{s * 1e3:.1f}ms"
+
+
+# --------------------------------------------------------------- rendering
+
+
+def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
+    """One dashboard frame from a getmetrics snapshot (pure: testable)."""
+    lines = []
+
+    def rate(name, **labels) -> str:
+        """Per-second delta vs the previous frame, or '-' on frame 1."""
+        if prev is None or interval_s <= 0:
+            return "-"
+        d = series_total(snap, name, **labels) - series_total(
+            prev, name, **labels)
+        return fmt_rate(d / interval_s) + "/s"
+
+    mode = int(series_total(snap, "nodexa_node_health"))
+    mode_str = HEALTH_NAMES.get(mode, str(mode))
+    color = {0: GREEN, 1: RED}.get(mode, YELLOW)
+    lines.append(
+        f"{BOLD}nodexa_top{RESET}  {time.strftime('%H:%M:%S')}   "
+        f"health: {color}{mode_str}{RESET}")
+
+    # serving geometry + path mix
+    devices = int(series_total(snap, "nodexa_mesh_devices"))
+    shape = by_label(snap, "nodexa_mesh_shape", "axis")
+    pow_paths = by_label(snap, "nodexa_pow_batches_total", "path")
+    hdr_paths = by_label(
+        snap, "nodexa_headers_pow_verified_total", "path")
+    path_mix = ", ".join(
+        f"{k or '?'}={int(v)}" for k, v in sorted(pow_paths.items())
+    ) or "none"
+    hdr_mix = ", ".join(
+        f"{k or '?'}={int(v)}" for k, v in sorted(hdr_paths.items())
+    ) or "none"
+    lines.append(
+        f"  mesh: {devices or 1} device(s) "
+        f"{int(shape.get('headers', 1))}x{int(shape.get('lanes', 1))}  "
+        f"pow batches [{path_mix}]  headers [{hdr_mix}]")
+
+    # hashrate: built-in miner + pool fleet estimate
+    miner_hs = series_total(snap, "nodexa_miner_hashes_per_second")
+    pool_hs = sum(
+        by_label(snap, "nodexa_pool_worker_hashrate_hs", "worker").values())
+    lines.append(
+        f"  hashrate: miner {fmt_rate(miner_hs)}H/s   "
+        f"pool fleet {fmt_rate(pool_hs)}H/s   blocks: "
+        f"miner {int(series_total(snap, 'nodexa_miner_blocks_found_total'))}"
+        f" / pool "
+        f"{int(series_total(snap, 'nodexa_pool_blocks_found_total'))}")
+
+    # stratum ledger
+    sessions = int(series_total(snap, "nodexa_pool_sessions"))
+    workers = int(series_total(snap, "nodexa_pool_workers"))
+    verdicts = by_label(snap, "nodexa_pool_shares_total", "result")
+    share_line = "  ".join(
+        f"{k}={int(v)}" for k, v in sorted(verdicts.items()) if v
+    ) or "no shares yet"
+    _, bmean, bp99 = hist_stats(snap, "nodexa_pool_share_batch_seconds")
+    lines.append(
+        f"  pool: {sessions} sessions / {workers} workers   "
+        f"accepted {rate('nodexa_pool_shares_total', result='accepted')}   "
+        f"batch mean {fmt_ms(bmean)} p99 {fmt_ms(bp99)}")
+    lines.append(f"  shares: {share_line}")
+
+    # chain: connect latency + throughput
+    ccount, cmean, cp99 = hist_stats(
+        snap, "nodexa_connectblock_stage_seconds", stage="total")
+    lines.append(
+        f"  chain: {int(series_total(snap, 'nodexa_blocks_connected_total'))}"
+        f" blocks connected ({rate('nodexa_blocks_connected_total')})   "
+        f"connect mean {fmt_ms(cmean)} p99 {fmt_ms(cp99)} (n={ccount})")
+
+    # mempool: outcomes + the off-lock proof pair
+    accepts = by_label(snap, "nodexa_mempool_accepts_total", "result")
+    _, smean, _ = hist_stats(
+        snap, "nodexa_mempool_accept_seconds", stage="scripts")
+    _, _, hp99 = hist_stats(snap, "nodexa_mempool_csmain_hold_seconds")
+    lines.append(
+        f"  mempool: accepted {int(accepts.get('accepted', 0))} "
+        f"rejected {int(accepts.get('rejected', 0))} "
+        f"({rate('nodexa_mempool_accepts_total', result='accepted')})   "
+        f"cs_main hold p99 {fmt_ms(hp99)} vs scripts mean {fmt_ms(smean)}")
+
+    # compile attribution + flight recorder depth
+    compiles = by_label(snap, "nodexa_jit_compiles_total", "kernel")
+    comp_line = "  ".join(
+        f"{k}={int(v)}" for k, v in sorted(compiles.items()) if v
+    ) or "none"
+    pc = by_label(snap, "nodexa_jit_persistent_cache_total", "result")
+    lines.append(
+        f"  jit: compiles [{comp_line}]  persistent-cache "
+        f"hit={int(pc.get('hit', 0))} miss={int(pc.get('miss', 0))}   "
+        f"recorder spans="
+        f"{int(series_total(snap, 'nodexa_flight_recorder_spans'))}")
+
+    if mode == 1:
+        errs = by_label(snap, "nodexa_critical_errors_total", "source")
+        worst = ", ".join(f"{k}={int(v)}" for k, v in sorted(errs.items()))
+        lines.append(f"  {RED}critical errors: {worst or 'unknown'} — "
+                     f"run dumpflightrecorder / gettrace{RESET}")
+    lines.append(f"{DIM}  interval {interval_s:g}s — ^C quits{RESET}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=19443,
+                    help="rpc port (default: regtest 19443)")
+    ap.add_argument("--datadir", default=None,
+                    help="read .cookie auth from this datadir")
+    ap.add_argument("--auth", default=None,
+                    help="user:password (overrides --datadir cookie)")
+    ap.add_argument("-i", "--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    args = ap.parse_args()
+
+    auth = args.auth
+    if auth is None and args.datadir:
+        auth = cookie_auth(args.datadir)
+    if auth is None:
+        ap.error("need --auth or --datadir for credentials")
+
+    prev = None
+    try:
+        while True:
+            snap = fetch(args.host, args.port, auth)
+            frame = render(snap, prev, args.interval)
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write(CLEAR + frame + "\n")
+            sys.stdout.flush()
+            prev = snap
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
