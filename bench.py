@@ -626,6 +626,30 @@ def bench_txflood() -> dict:
     }
 
 
+def bench_netsim() -> dict:
+    """Block propagation across a simulated 50-node network (net/netsim
+    harness: real NodeContexts, in-memory links, deterministic clock).
+    Reports median/p95 announcement-to-acceptance delay in SIMULATED ms
+    (protocol relay efficiency) plus harness wall throughput.  Details
+    in nodexa_chain_core_tpu/bench/netsim.py."""
+    from nodexa_chain_core_tpu.bench.netsim import measure_propagation
+
+    t = time.perf_counter()
+    res = measure_propagation(n_nodes=50, degree=4, blocks=3)
+    log(f"[netsim] N={res['netsim_nodes']} propagation: median "
+        f"{res['block_propagation_ms']}ms p95 "
+        f"{res['block_propagation_p95_ms']}ms over "
+        f"{res['netsim_links']} links; harness "
+        f"{res['netsim_events_per_s']:,} events/s "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return {
+        "block_propagation_ms": res["block_propagation_ms"],
+        "block_propagation_p95_ms": res["block_propagation_p95_ms"],
+        "netsim_nodes": res["netsim_nodes"],
+        "netsim_events_per_s": res["netsim_events_per_s"],
+    }
+
+
 def bench_ibd() -> dict:
     """Synthetic IBD (node fast path, CPU-side): headers-first + out-of-
     order data into a datadir-backed ChainState, dbcache vs per-block
@@ -667,6 +691,8 @@ def main() -> None:
         extra.update(bench_sha256d(on_tpu))
     if not os.environ.get("NODEXA_BENCH_SKIP_IBD"):
         extra.update(bench_ibd())
+    if not os.environ.get("NODEXA_BENCH_SKIP_NETSIM"):
+        extra.update(bench_netsim())
     if not os.environ.get("NODEXA_BENCH_SKIP_TXFLOOD"):
         extra.update(bench_txflood())
     if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):

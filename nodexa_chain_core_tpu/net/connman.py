@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..node.faults import g_faults
 from ..telemetry import g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from . import protocol
@@ -26,6 +27,43 @@ _M_MSGS = g_metrics.counter(
 _M_BYTES = g_metrics.counter(
     "nodexa_p2p_bytes_total",
     "P2P wire bytes (header + payload), labeled by command and direction")
+# why a peer actually left: stall/timeout come from the sync-stall
+# detectors (never banned), evict from inbound slot pressure, misbehavior
+# from the ban threshold, fault from injected net.* faults; anything
+# else (EOF, send error, operator disconnect) collapses into "other" so
+# the label set stays bounded
+_M_DISCONNECTS = g_metrics.counter(
+    "nodexa_peer_disconnects_total",
+    "Peer disconnects, labeled by reason "
+    "(stall|timeout|evict|misbehavior|fault|other)")
+_M_RETRIES = g_metrics.counter(
+    "nodexa_io_retries_total",
+    "Transient I/O errors retried before succeeding or escalating")
+
+# outbound reconnect backoff (per address, ref nRetries-style spacing):
+# first failure waits BASE, doubling to MAX; a successful TCP connect
+# clears the slate.  Keeps the 2 s open-connections loop from hammering
+# a dead seed every tick.
+CONNECT_BACKOFF_BASE_S = 2.0
+CONNECT_BACKOFF_MAX_S = 600.0
+
+
+class _SockTornWriter:
+    """File-like adapter so ``kill@<n>`` fault specs can leave n bytes on
+    the wire before the process dies — the socket twin of a torn disk
+    record (fsync on a socket fd fails; the registry ignores that)."""
+
+    def __init__(self, sock):
+        self._sock = sock
+
+    def write(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def flush(self) -> None:
+        pass
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
 # the command label is attacker-controlled wire input: unknown commands
 # collapse into one bucket, or a peer spraying random 12-byte commands
 # would grow the label set (and node memory) without bound
@@ -59,13 +97,15 @@ class Peer:
 
     _next_id = 0
 
-    def __init__(self, sock: socket.socket, addr: Tuple[str, int], inbound: bool):
+    def __init__(self, sock: Optional[socket.socket], addr: Tuple[str, int],
+                 inbound: bool, clock=time.time):
         Peer._next_id += 1
         self.id = Peer._next_id
         self.sock = sock
+        self._clock = clock
         self.ip, self.port = addr[0], addr[1]
         self.inbound = inbound
-        self.connected_at = time.time()
+        self.connected_at = clock()
         self.version = 0
         self.services = 0
         self.user_agent = ""
@@ -73,6 +113,7 @@ class Peer:
         self.handshake_done = False
         self.verack_received = False
         self.disconnect = False
+        self.disconnect_reason: Optional[str] = None
         self.misbehavior = 0
         self.bytes_sent = 0
         self.bytes_recv = 0
@@ -84,6 +125,8 @@ class Peer:
         self.known_txs: set = set()
         self.known_blocks: set = set()
         self.blocks_in_flight: set = set()
+        self.block_request_times: Dict[int, float] = {}
+        self.headers_sync_deadline: Optional[float] = None
         self.sync_started = False
         self.prefer_headers = False
         # BIP152 state (ref CNodeState fProvidesHeaderAndIDs /
@@ -97,18 +140,31 @@ class Peer:
         try:
             data = protocol.pack_message(magic, command, payload)
             with self._send_lock:
+                if g_faults.enabled:
+                    # net.peer_send: errno specs raise (peer drops with
+                    # reason=fault), kill@<n> puts n wire bytes on the
+                    # socket first — a mid-send connection cut.  Under
+                    # the lock: the torn prefix must not interleave with
+                    # a concurrent send from another thread
+                    g_faults.check("net.peer_send",
+                                   torn_file=_SockTornWriter(self.sock),
+                                   torn_data=data)
                 self.sock.sendall(data)
-            self.last_send = time.time()
+            self.last_send = self._clock()
             self.bytes_sent += len(data)
             msgs, nbytes = _wire_counters(command, "sent")
             msgs.inc()
             nbytes.inc(len(data))
             return True
-        except OSError:
+        except OSError as e:
+            if getattr(e, "fault_injected", False):
+                self.disconnect_reason = self.disconnect_reason or "fault"
             self.disconnect = True
             return False
 
     def close(self) -> None:
+        if self.sock is None:
+            return
         try:
             self.sock.close()
         except OSError:
@@ -121,16 +177,20 @@ class ConnMan:
     MAX_OUTBOUND = 8
     MAX_CONNECTIONS = 125
 
-    def __init__(self, node, port: int = 0, listen: bool = True):
+    def __init__(self, node, port: int = 0, listen: bool = True,
+                 clock=time.time):
         self.node = node
         self.magic = node.params.message_start
         self.port = port
         self.listen = listen
+        self.clock = clock
         self.peers: Dict[int, Peer] = {}
         self._peers_lock = threading.Lock()
         self.inbound_queue: "queue.Queue" = queue.Queue()
         self.banned: Dict[str, float] = {}
         self.addrman = AddrMan()
+        # per-address outbound backoff: key -> [next_ok_ts, current_delay]
+        self._conn_backoff: Dict[str, list] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listen_sock: Optional[socket.socket] = None
@@ -148,7 +208,7 @@ class ConnMan:
         self.local_addresses: List[tuple] = []
         from .net_processing import NetProcessor
 
-        self.processor = NetProcessor(node, self)
+        self.processor = NetProcessor(node, self, clock=clock)
         # scrape-time peer gauges (no hot-path cost; last node wins when a
         # test harness runs several in-process nodes).  weakref: the
         # registry outlives every node, and a strong capture would pin the
@@ -221,12 +281,22 @@ class ConnMan:
         if not host:
             host, port_s = port_s, ""
         port = int(port_s or self.node.params.default_port)
+        key = f"{host}:{port}"
         if self.is_banned(host):
             return False
         if not self.network_active:
             return False  # ref CConnman::OpenNetworkConnection gate
         if (host, port) in self.local_addresses:
             return False  # never dial ourselves (ref IsLocal check)
+        if not manual:
+            # exponential backoff gate: the open-connections loop ticks
+            # every 2 s and addrman keeps reselecting a dead seed —
+            # without this the node hammers it in a tight retry cycle.
+            # Manual (-addnode/RPC) connects express operator intent and
+            # bypass the gate.
+            b = self._conn_backoff.get(key)
+            if b is not None and self.clock() < b[0]:
+                return False
         is_onion = host.endswith(".onion")
         proxy = self.onion_proxy if is_onion else self.proxy
         if is_onion and proxy is None:
@@ -235,6 +305,7 @@ class ConnMan:
             self.addrman.attempt(host, port)
             return False
         try:
+            g_faults.check("net.connect")
             if proxy is not None:
                 from .torcontrol import socks5_connect
 
@@ -243,9 +314,10 @@ class ConnMan:
                 sock = socket.create_connection((host, port), timeout=5)
         except OSError as e:
             log_print(LogFlags.NET, "connect to %s failed: %s", addr, e)
-            self.addrman.attempt(host, port)
+            self._note_connect_failure(host, port)
             return False
-        peer = Peer(sock, (host, port), inbound=False)
+        self._conn_backoff.pop(key, None)  # proven reachable again
+        peer = Peer(sock, (host, port), inbound=False, clock=self.clock)
         peer.manual = manual
         with self._peers_lock:
             self.peers[peer.id] = peer
@@ -254,6 +326,21 @@ class ConnMan:
         if not manual:
             self.addrman.attempt(host, port)
         return True
+
+    def _note_connect_failure(self, host: str, port: int) -> None:
+        """Feed the backoff ladder + addrman's attempt counter.  The
+        second-and-later failures count as retries in
+        ``nodexa_io_retries_total{source=net.connect}`` — the same series
+        the disk-retry path uses, so one dashboard shows both."""
+        key = f"{host}:{port}"
+        b = self._conn_backoff.get(key)
+        if b is None:
+            delay = CONNECT_BACKOFF_BASE_S
+        else:
+            delay = min(b[1] * 2, CONNECT_BACKOFF_MAX_S)
+            _M_RETRIES.inc(source="net.connect")
+        self._conn_backoff[key] = [self.clock() + delay, delay]
+        self.addrman.attempt(host, port)
 
     def disconnect(self, addr: str) -> bool:
         """Flag matching peers for disconnect; True if any matched."""
@@ -280,7 +367,7 @@ class ConnMan:
                 if not self.attempt_evict_inbound():
                     sock.close()
                     continue
-            peer = Peer(sock, addr, inbound=True)
+            peer = Peer(sock, addr, inbound=True, clock=self.clock)
             with self._peers_lock:
                 self.peers[peer.id] = peer
             self._spawn(lambda p=peer: self._reader_loop(p), f"net.peer{peer.id}")
@@ -301,6 +388,19 @@ class ConnMan:
                 break
             if not chunk:
                 break
+            if g_faults.enabled:
+                # net.peer_recv: torn=<n> truncates the chunk (stream
+                # desync -> checksum/header failure downstream, exactly
+                # what a half-delivered read produces); errno specs drop
+                # the connection with reason=fault
+                try:
+                    chunk = g_faults.filter_read("net.peer_recv", chunk)
+                except OSError:
+                    peer.disconnect_reason = (
+                        peer.disconnect_reason or "fault")
+                    break
+                if not chunk:
+                    continue
             peer.bytes_recv += len(chunk)
             buf += chunk
             while len(buf) >= 24:
@@ -319,7 +419,7 @@ class ConnMan:
                 if not protocol.verify_checksum(payload, checksum):
                     self.processor.misbehaving(peer, 10, "bad-checksum")
                     continue
-                peer.last_recv = time.time()
+                peer.last_recv = self.clock()
                 msgs, nbytes = _wire_counters(command, "recv")
                 msgs.inc()
                 nbytes.inc(24 + length)
@@ -336,6 +436,8 @@ class ConnMan:
                 # and handler-loop cleanup can both land here)
                 self._closed_bytes_sent += peer.bytes_sent
                 self._closed_bytes_recv += peer.bytes_recv
+                reason = getattr(peer, "disconnect_reason", None) or "other"
+                _M_DISCONNECTS.inc(reason=reason)
         self.processor.finalize_peer(peer)
         hook = getattr(self.processor, "peer_disconnected", None)
         if hook is not None:
@@ -366,6 +468,8 @@ class ConnMan:
             return False
         victim = max(evictable, key=lambda p: p.connected_at)  # youngest
         log_printf("evicting inbound peer %d (%s)", victim.id, victim.ip)
+        victim.disconnect_reason = (
+            getattr(victim, "disconnect_reason", None) or "evict")
         victim.disconnect = True
         self._remove_peer(victim)
         return True
@@ -407,6 +511,9 @@ class ConnMan:
                 seen.add(id(peer))
                 if peer.misbehavior >= 100:
                     self.ban(peer.ip)
+                    peer.disconnect_reason = (
+                        getattr(peer, "disconnect_reason", None)
+                        or "misbehavior")
                     peer.disconnect = True
                 if peer.disconnect:
                     self._remove_peer(peer)
@@ -516,7 +623,7 @@ class ConnMan:
             log_printf("local address: %s:%d", host, port)
 
     def ban(self, ip: str, duration: float = 24 * 3600) -> None:
-        self.banned[ip] = time.time() + duration
+        self.banned[ip] = self.clock() + duration
         log_printf("banned %s", ip)
 
     def unban(self, ip: str) -> None:
@@ -526,7 +633,7 @@ class ConnMan:
         until = self.banned.get(ip)
         if until is None:
             return False
-        if until < time.time():
+        if until < self.clock():
             del self.banned[ip]
             return False
         return True

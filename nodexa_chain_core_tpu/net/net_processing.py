@@ -68,6 +68,18 @@ MAX_HEADERS_RESULTS = 2000
 MAX_BLOCKS_IN_FLIGHT_PER_PEER = 16
 MAX_INV_SIZE = 50_000
 
+# -- sync-stall hardening tunables (instance attributes on NetProcessor so
+# the netsim harness and tests can tighten them to simulated timescales;
+# the defaults are the live-node values, documented in README "Network
+# robustness & netsim") -------------------------------------------------
+BLOCK_DOWNLOAD_TIMEOUT_S = 60.0   # oldest outstanding getdata before the
+                                  # peer counts as stalling the download
+HEADERS_SYNC_TIMEOUT_S = 120.0    # getheaders sent -> headers progress
+HANDSHAKE_TIMEOUT_S = 60.0        # connect -> verack
+TIP_STALE_RESYNC_S = 150.0        # tip unchanged this long -> re-getheaders
+                                  # one peer per interval (partition heal)
+_FIRST_SEEN_CAP = 4096            # propagation-tracking map bound
+
 _M_MISBEHAVING = g_metrics.counter(
     "nodexa_p2p_misbehavior_total",
     "Misbehavior score assignments, labeled by reason")
@@ -89,21 +101,54 @@ _M_HEADERS_BATCH = g_metrics.histogram(
     "nodexa_headers_batch_size",
     "Headers per HEADERS message handed to process_new_block_headers",
     buckets=(1, 10, 50, 100, 500, 1000, 2000, 4000))
+# block relay latency as one node observes it: first announcement of an
+# unknown block (inv/headers/cmpctblock) -> local acceptance.  The netsim
+# harness reads the same series under its deterministic clock, and
+# bench/netsim.py reports the N=50 aggregate as block_propagation_ms.
+_M_BLOCK_PROP = g_metrics.histogram(
+    "nodexa_block_propagation_seconds",
+    "First announcement of a block to local acceptance",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
+_M_ROTATED = g_metrics.counter(
+    "nodexa_block_downloads_rotated_total",
+    "In-flight block downloads re-assigned away from a stalling peer")
 
 
 class NetProcessor:
     """ref PeerLogicValidation (net_processing.cpp:2986)."""
 
-    def __init__(self, node, connman):
+    def __init__(self, node, connman, clock=time.time, rand=None):
         self.node = node
         self.connman = connman
         self.magic = node.params.message_start
-        self._local_nonce = _rand.rand64()
+        # injectable clock (netsim's SimClock; time.time in the live
+        # node).  When a custom clock is driving, the global adjusted-
+        # time machinery (g_timedata) is bypassed: simulated timestamps
+        # must neither read nor poison the process-wide wall samples.
+        self._clock = clock
+        self._uses_wall_clock = clock is time.time
+        self._rand = rand if rand is not None else _rand
+        self._local_nonce = self._rand.rand64()
         from .orphanage import TxOrphanage, TxRequestTracker
 
-        self.orphanage = TxOrphanage()
-        self.tx_requests = TxRequestTracker()
+        self.orphanage = TxOrphanage(clock=clock, rand=self._rand)
+        self.tx_requests = TxRequestTracker(clock=clock)
         self._fee_rounder = None
+        # sync-stall hardening state (tunables are instance attrs so the
+        # netsim harness can tighten them to simulated timescales)
+        self.block_download_timeout_s = BLOCK_DOWNLOAD_TIMEOUT_S
+        self.headers_sync_timeout_s = HEADERS_SYNC_TIMEOUT_S
+        self.handshake_timeout_s = HANDSHAKE_TIMEOUT_S
+        self.tip_stale_resync_s = TIP_STALE_RESYNC_S
+        # node-wide in-flight block map (ref mapBlocksInFlight): one
+        # outstanding download per block across ALL peers, so a stalling
+        # peer can't be silently masked by duplicate-bandwidth requests
+        # and rotation has something concrete to re-assign
+        self._blocks_in_flight: dict = {}   # block_hash -> (peer_id, t)
+        self._block_first_seen: dict = {}   # block_hash -> announce time
+        self._last_tip_hash = None
+        self._last_tip_time = self._clock()
+        self._resync_rotation = 0
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -127,7 +172,7 @@ class NetProcessor:
     def _send_version(self, peer) -> None:
         v = VersionPayload(
             version=PROTOCOL_VERSION,
-            timestamp=int(time.time()),
+            timestamp=int(self._clock()),
             addr_recv=NetAddr(ip=peer.ip, port=peer.port),
             nonce=self._local_nonce,
             start_height=self.node.chainstate.tip().height,
@@ -247,9 +292,11 @@ class NetProcessor:
         peer.services = v.services
         peer.user_agent = v.user_agent
         peer.start_height = v.start_height
-        if not peer.inbound:
+        if not peer.inbound and self._uses_wall_clock:
             # outbound-only, deduped per address: inbound floods must not
-            # steer the adjusted clock (ref AddTimeData + setKnown)
+            # steer the adjusted clock (ref AddTimeData + setKnown).
+            # Skipped under an injected clock: simulated timestamps must
+            # not poison the process-wide wall-time samples.
             from ..utils.timedata import g_timedata
 
             g_timedata.add_sample(v.timestamp, source=peer.ip)
@@ -295,6 +342,10 @@ class NetProcessor:
             self.node.chainstate.active, tip=from_index
         ).serialize(w)
         w.hash256(0)
+        # arm the headers-sync deadline: progress (any HEADERS reply)
+        # re-arms or clears it; check_stalls() disconnects a peer that
+        # claims more chain than ours but never delivers headers
+        peer.headers_sync_deadline = self._clock() + self.headers_sync_timeout_s
         peer.send_msg(self.magic, MSG_GETHEADERS, w.getvalue())
 
     # -- keepalive ---------------------------------------------------------
@@ -303,9 +354,9 @@ class NetProcessor:
         for peer in self.connman.all_peers():
             if not peer.handshake_done:
                 continue
-            nonce = _rand.rand64()
+            nonce = self._rand.rand64()
             peer.last_ping_nonce = nonce
-            peer._ping_sent = time.time()
+            peer._ping_sent = self._clock()
             w = ByteWriter()
             w.u64(nonce)
             peer.send_msg(self.magic, MSG_PING, w.getvalue())
@@ -319,7 +370,9 @@ class NetProcessor:
     def _on_pong(self, peer, r: ByteReader) -> None:
         nonce = r.u64() if r.remaining() else 0
         if nonce and nonce == peer.last_ping_nonce:
-            peer.ping_time_ms = (time.time() - getattr(peer, "_ping_sent", time.time())) * 1000
+            now = self._clock()
+            peer.ping_time_ms = (
+                now - getattr(peer, "_ping_sent", now)) * 1000
 
     # -- inventory / relay -------------------------------------------------
 
@@ -341,6 +394,7 @@ class NetProcessor:
             elif inv.type == INV_BLOCK:
                 peer.known_blocks.add(inv.hash)
                 if self.node.chainstate.lookup(inv.hash) is None:
+                    self._note_block_announced(inv.hash)
                     # headers-first: learn about the chain before the block
                     self._send_getheaders(peer)
         if want:
@@ -438,15 +492,22 @@ class NetProcessor:
             h = BlockHeader.deserialize(r, self.node.params.algo_schedule)
             r.compact_size()
             headers.append(h)
+        # any HEADERS reply is sync progress: an empty one means the peer
+        # has nothing past our locator, so the deadline no longer applies
+        peer.headers_sync_deadline = None
         if not headers:
             return
         _M_HEADERS_BATCH.observe(len(headers))
         cs = self.node.chainstate
         try:
-            from ..utils.timedata import g_timedata
+            if self._uses_wall_clock:
+                from ..utils.timedata import g_timedata
 
+                adjusted = g_timedata.adjusted_time()
+            else:
+                adjusted = int(self._clock())
             indexes = cs.process_new_block_headers(
-                headers, adjusted_time=g_timedata.adjusted_time()
+                headers, adjusted_time=adjusted
             )
         except BlockValidationError as e:
             if e.code == "prev-blk-not-found":
@@ -468,6 +529,12 @@ class NetProcessor:
             best = getattr(peer, "best_known_header", None)
             if best is None or idx.chain_work >= best.chain_work:
                 peer.best_known_header = idx
+            # propagation tracking covers tip RELAY (1-few header
+            # announcements), not IBD catch-up: a 2000-header batch
+            # would stamp minutes-scale download latencies into the
+            # announcement-to-acceptance histogram
+            if count < 10 and not (idx.status & 8):
+                self._note_block_announced(idx.block_hash)
         self._request_missing_blocks(peer)
         if count == MAX_HEADERS_RESULTS:
             # continue from the last received header, not the active tip
@@ -520,12 +587,53 @@ class NetProcessor:
                 break
             if (idx.status & 8) or idx.block_hash in peer.blocks_in_flight:
                 continue
-            peer.blocks_in_flight.add(idx.block_hash)
+            # node-wide dedup (ref mapBlocksInFlight): a block already
+            # outstanding toward ANOTHER peer is not re-requested here —
+            # the stall detector releases and rotates it if that peer
+            # never delivers
+            holder = self._blocks_in_flight.get(idx.block_hash)
+            if holder is not None and holder[0] != peer.id:
+                continue
+            self._mark_block_requested(peer, idx.block_hash)
             want.append(Inv(INV_BLOCK, idx.block_hash))
         if want:
             w = ByteWriter()
             w.vector(want, lambda wr, i: i.serialize(wr))
             peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
+
+    # -- in-flight block accounting (ref mapBlocksInFlight) ---------------
+
+    def _mark_block_requested(self, peer, block_hash: int) -> None:
+        now = self._clock()
+        peer.blocks_in_flight.add(block_hash)
+        times = peer.__dict__.setdefault("block_request_times", {})
+        times[block_hash] = now
+        self._blocks_in_flight[block_hash] = (peer.id, now)
+
+    def _clear_block_request(self, peer, block_hash: int) -> None:
+        peer.blocks_in_flight.discard(block_hash)
+        times = peer.__dict__.get("block_request_times")
+        if times is not None:
+            times.pop(block_hash, None)
+        holder = self._blocks_in_flight.get(block_hash)
+        if holder is not None and holder[0] == peer.id:
+            del self._blocks_in_flight[block_hash]
+
+    def _note_block_announced(self, block_hash: int) -> None:
+        """First-announcement timestamp for the propagation histogram."""
+        fs = self._block_first_seen
+        if block_hash not in fs:
+            if len(fs) >= _FIRST_SEEN_CAP:
+                # drop the oldest half; announcements this stale are IBD
+                # backlog, not tip relay
+                for k in sorted(fs, key=fs.get)[: _FIRST_SEEN_CAP // 2]:
+                    del fs[k]
+            fs[block_hash] = self._clock()
+
+    def _observe_propagation(self, block_hash: int) -> None:
+        t0 = self._block_first_seen.pop(block_hash, None)
+        if t0 is not None:
+            _M_BLOCK_PROP.observe(max(0.0, self._clock() - t0))
 
     # -- blocks / txs ------------------------------------------------------
 
@@ -535,7 +643,7 @@ class NetProcessor:
 
     def _accept_block_from_peer(self, peer, block, punish: bool) -> bool:
         h = block.get_hash(self.node.params.algo_schedule)
-        peer.blocks_in_flight.discard(h)
+        self._clear_block_request(peer, h)
         peer.known_blocks.add(h)
         cs = self.node.chainstate
         old_tip = cs.tip().block_hash
@@ -556,6 +664,7 @@ class NetProcessor:
             if punish:
                 self.misbehaving(peer, 100, f"bad-block:{e.code}")
             return False
+        self._observe_propagation(h)
         if cs.tip().block_hash != old_tip:
             self.announce_block(cs.tip().block_hash)
         # keep the download window full toward the peer's best header
@@ -611,7 +720,7 @@ class NetProcessor:
                 self.misbehaving(peer, 10, "bad-tx:undeserializable")
                 continue
             peer.known_txs.add(tx.txid)
-            peer.last_tx_time = time.time()  # eviction protection signal
+            peer.last_tx_time = self._clock()  # eviction protection signal
             self.tx_requests.received(tx.txid)
             entries.append((peer, tx))
         accepted: List[int] = []
@@ -708,10 +817,153 @@ class NetProcessor:
 
     def periodic(self) -> None:
         """Maintenance-tick work (called from the connman maintenance
-        thread): orphan expiry + request-tracker sweeps + feefilter."""
-        self.orphanage.expire()
-        self.tx_requests.expire()
+        thread, and from the netsim harness's deterministic tick):
+        orphan expiry + request-tracker sweeps + feefilter + the
+        sync-stall detectors."""
+        now = self._clock()
+        self.orphanage.expire(now)
+        self.tx_requests.expire(now)
         self._send_feefilters()
+        self.check_stalls(now)
+        self._check_tip_staleness(now)
+
+    # -- sync-stall hardening ----------------------------------------------
+
+    def _disconnect_peer(self, peer, reason: str) -> None:
+        """Flag a peer for disconnect WITHOUT misbehavior score: stall/
+        timeout peers may simply be slow or partitioned — they are
+        dropped and their work re-assigned, never banned (a ban would
+        eclipse-lock us out of honest-but-congested peers)."""
+        if peer.disconnect:
+            return
+        peer.disconnect_reason = getattr(peer, "disconnect_reason",
+                                         None) or reason
+        peer.disconnect = True
+        log_print(LogFlags.NET, "disconnecting peer %d (%s)",
+                  peer.id, reason)
+
+    def check_stalls(self, now=None) -> None:
+        """ref the BLOCK_STALLING / headers-sync-timeout machinery in
+        SendMessages: detect peers wedging the pipeline and rotate their
+        outstanding work to someone else.
+
+        Three detectors:
+        - handshake: no verack within ``handshake_timeout_s``;
+        - headers sync: a getheaders went unanswered past
+          ``headers_sync_timeout_s`` while the peer claims more chain
+          than we have;
+        - block download: the peer's OLDEST outstanding getdata is older
+          than ``block_download_timeout_s`` — the classic black-hole/
+          stalling peer.  Its in-flight blocks are released from the
+          node-wide map and re-requested from other peers (rotation),
+          and the staller is disconnected (not banned).
+        """
+        now = self._clock() if now is None else now
+        cs = self.node.chainstate
+        tip_height = cs.tip().height
+        stalled: List[int] = []
+        for peer in self.connman.all_peers():
+            if peer.disconnect:
+                continue
+            if not peer.handshake_done:
+                if now - peer.connected_at > self.handshake_timeout_s:
+                    self._disconnect_peer(peer, "timeout")
+                continue
+            ddl = getattr(peer, "headers_sync_deadline", None)
+            if ddl is not None and now > ddl:
+                if peer.start_height > tip_height:
+                    self._disconnect_peer(peer, "timeout")
+                    continue
+                # claims nothing beyond us: quietly drop the deadline
+                peer.headers_sync_deadline = None
+            times = getattr(peer, "block_request_times", None)
+            if times:
+                # lazily purge entries whose block already arrived via
+                # another path, or whose node-wide ownership moved to a
+                # different peer (a cmpctblock push can supersede an
+                # older getdata): they must not count toward THIS peer's
+                # stall verdict, or an honest peer gets evicted over a
+                # block the node already has
+                for h in list(times):
+                    idx_h = cs.lookup(h)
+                    holder = self._blocks_in_flight.get(h)
+                    if ((idx_h is not None and idx_h.status & 8)
+                            or (holder is not None
+                                and holder[0] != peer.id)):
+                        times.pop(h, None)
+                        peer.blocks_in_flight.discard(h)
+            if times:
+                oldest = min(times.values())
+                if now - oldest > self.block_download_timeout_s:
+                    stalled.extend(times)
+                    self._disconnect_peer(peer, "stall")
+        # sweep node-wide in-flight entries whose owner is gone (covers
+        # any removal path that bypassed peer_disconnected)
+        live = {p.id for p in self.connman.all_peers() if not p.disconnect}
+        for h, (pid, t) in list(self._blocks_in_flight.items()):
+            if pid not in live and now - t > self.block_download_timeout_s:
+                del self._blocks_in_flight[h]
+                if h not in stalled:
+                    stalled.append(h)
+        if stalled:
+            self._rotate_downloads(stalled)
+
+    def _rotate_downloads(self, hashes, exclude=None) -> None:
+        """Re-request released blocks from other peers, preferring ones
+        whose announced best chain actually contains each block."""
+        cs = self.node.chainstate
+        peers = [p for p in self.connman.all_peers()
+                 if p.handshake_done and not p.disconnect
+                 and p is not exclude]
+        if not peers:
+            return
+        rotated = 0
+        for i, h in enumerate(hashes):
+            holder = self._blocks_in_flight.get(h)
+            if holder is not None:
+                if any(p.id == holder[0] for p in peers):
+                    continue  # a healthy live peer is already on it
+                del self._blocks_in_flight[h]
+            idx = cs.lookup(h)
+            if idx is not None and idx.status & 8:
+                continue  # arrived through another path meanwhile
+            target = None
+            for p in peers:
+                best = getattr(p, "best_known_header", None)
+                if (idx is not None and best is not None
+                        and best.height >= idx.height
+                        and best.get_ancestor(idx.height) is idx):
+                    target = p
+                    break
+            if target is None:
+                target = peers[i % len(peers)]
+            self._getdata_block(target, h)
+            rotated += 1
+        if rotated:
+            _M_ROTATED.inc(rotated)
+            log_print(LogFlags.NET,
+                      "rotated %d stalled block downloads", rotated)
+
+    def _check_tip_staleness(self, now: float) -> None:
+        """Partition-heal / sync-stall recovery: if the tip has not moved
+        for ``tip_stale_resync_s``, re-getheaders ONE peer per interval
+        (rotating), so a node that missed announcements during a
+        partition pulls the other side's chain without operator help."""
+        tip = self.node.chainstate.tip()
+        if tip.block_hash != self._last_tip_hash:
+            self._last_tip_hash = tip.block_hash
+            self._last_tip_time = now
+            return
+        if now - self._last_tip_time < self.tip_stale_resync_s:
+            return
+        self._last_tip_time = now  # one probe per interval
+        peers = [p for p in self.connman.all_peers()
+                 if p.handshake_done and not p.disconnect]
+        if not peers:
+            return
+        peer = peers[self._resync_rotation % len(peers)]
+        self._resync_rotation += 1
+        self._send_getheaders(peer)
 
     _FEEFILTER_INTERVAL = 10 * 60  # ref AVG_FEEFILTER_BROADCAST_INTERVAL
 
@@ -725,7 +977,7 @@ class NetProcessor:
 
             self._fee_rounder = FeeFilterRounder(
                 float(DEFAULT_MIN_RELAY_TX_FEE))
-        now = time.time()
+        now = self._clock()
         pool = self.node.mempool
         current = float(pool.get_min_fee()) if pool is not None else 0.0
         for peer in self.connman.all_peers():
@@ -744,12 +996,18 @@ class NetProcessor:
                 peer.last_sent_feefilter = send
             # Poisson-ish spacing around the broadcast interval
             peer.next_feefilter_send = now + self._FEEFILTER_INTERVAL * (
-                0.5 + _rand.random()
+                0.5 + self._rand.random()
             )
 
     def peer_disconnected(self, peer) -> None:
         self.orphanage.erase_for_peer(peer.id)
         self.tx_requests.forget_peer(peer.id)
+        # release the peer's outstanding block downloads and rotate them
+        # to surviving peers so a dropped connection can't wedge IBD
+        mine = [h for h, (pid, _) in self._blocks_in_flight.items()
+                if pid == peer.id]
+        if mine:
+            self._rotate_downloads(mine, exclude=peer)
 
     def _on_mempool(self, peer, r: ByteReader) -> None:
         invs = [Inv(INV_TX, txid) for txid in self.node.mempool.txids()]
@@ -848,6 +1106,7 @@ class NetProcessor:
         idx = cs.lookup(h)
         if idx is not None and idx.status & 8:  # already have it
             return
+        self._note_block_announced(h)
         if cs.lookup(cmpct.header.hash_prev) is None:
             # can't connect: fall back to headers sync (ref cmpctblock
             # handling when prev is unknown)
@@ -864,7 +1123,7 @@ class NetProcessor:
         # a newer compact announcement supersedes any stalled one: release
         # the stale in-flight slot so the download window can't be wedged
         if peer.partial_block is not None:
-            peer.blocks_in_flight.discard(peer.partial_block.block_hash)
+            self._clear_block_request(peer, peer.partial_block.block_hash)
             peer.partial_block = None
         partial = PartiallyDownloadedBlock(schedule)
         try:
@@ -885,7 +1144,7 @@ class NetProcessor:
         req = BlockTransactionsRequest(block_hash=h, indexes=missing)
         w = ByteWriter()
         req.serialize(w)
-        peer.blocks_in_flight.add(h)
+        self._mark_block_requested(peer, h)
         peer.send_msg(self.magic, MSG_GETBLOCKTXN, w.getvalue())
 
     def _on_getblocktxn(self, peer, r: ByteReader) -> None:
@@ -911,7 +1170,7 @@ class NetProcessor:
 
     def _on_blocktxn(self, peer, r: ByteReader) -> None:
         resp = BlockTransactions.deserialize(r)
-        peer.blocks_in_flight.discard(resp.block_hash)
+        self._clear_block_request(peer, resp.block_hash)
         partial = peer.partial_block
         if partial is None or partial.block_hash != resp.block_hash:
             return
@@ -930,7 +1189,7 @@ class NetProcessor:
         # (ref READ_STATUS_CHECKBLOCK_FAILED vs invalid-block paths)
         cs = self.node.chainstate
         old_tip = cs.tip().block_hash
-        peer.blocks_in_flight.discard(block_hash)
+        self._clear_block_request(peer, block_hash)
         peer.known_blocks.add(block_hash)
         try:
             cs.process_new_block(block)
@@ -940,6 +1199,7 @@ class NetProcessor:
             else:
                 self.misbehaving(peer, 100, f"bad-block:{e.code}")
             return
+        self._observe_propagation(block_hash)
         if cs.tip().block_hash != old_tip:
             self.announce_block(cs.tip().block_hash)
         self._request_missing_blocks(peer)
@@ -947,7 +1207,7 @@ class NetProcessor:
     def _getdata_block(self, peer, block_hash: int) -> None:
         w = ByteWriter()
         w.vector([Inv(INV_BLOCK, block_hash)], lambda wr, i: i.serialize(wr))
-        peer.blocks_in_flight.add(block_hash)
+        self._mark_block_requested(peer, block_hash)
         peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
 
     def _on_feefilter(self, peer, r: ByteReader) -> None:

@@ -1,0 +1,567 @@
+"""Adversarial multi-node network simulation harness.
+
+Spins N full regtest ``NodeContext``s — each with its real ``ConnMan`` /
+``NetProcessor`` / chainstate — into a configurable topology over
+in-memory transports, driven by ONE thread from a priority queue of
+timed events under a **deterministic injectable clock** (``SimClock``,
+threaded through connman/net_processing/orphanage via their ``clock=``
+hooks).  Same seed + same topology + same scenario script => same final
+tip hashes and the same event order (``digest()`` pins both).
+
+Per-link fault model (``LinkSpec``): latency, jitter, probabilistic
+drop, bandwidth cap (serialization delay), **partition/heal**, and
+selective command blackholing (``drop_commands`` — the classic stalling
+peer that serves headers but never block data).  The PR 5 fault
+registry composes directly: the harness consults the same
+``net.peer_send`` / ``net.peer_recv`` sites the real socket paths do,
+so one ``-faultinject`` spec drives both.
+
+This is what the sync-stall hardening in :mod:`.net_processing` is
+proven against: stall rotation, headers-sync deadlines, and
+tip-staleness re-sync are all exercisable here in simulated seconds
+instead of wall-clock minutes (see tests/test_netsim.py and
+bench/netsim.py).
+
+The harness is single-threaded by design: handlers run inline at event
+dispatch, so there is no cross-node concurrency to order — determinism
+comes for free and a scenario's full causal history lands in
+``event_log``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..crypto.chacha20 import FastRandomContext
+from ..core.uint256 import u256_hex
+from ..node.faults import g_faults
+from ..utils.logging import LogFlags, log_print
+from .connman import ConnMan, Peer, _wire_counters
+
+# simulated-timescale defaults for the sync-stall tunables: scenarios
+# measure seconds of SIM time, so the live-node minutes-scale deadlines
+# are tightened to keep event counts small
+SIM_BLOCK_DOWNLOAD_TIMEOUT_S = 5.0
+SIM_HEADERS_SYNC_TIMEOUT_S = 8.0
+SIM_HANDSHAKE_TIMEOUT_S = 8.0
+SIM_TIP_STALE_RESYNC_S = 10.0
+RECONNECT_BASE_S = 1.0     # outbound redial backoff: base, doubling
+RECONNECT_MAX_S = 16.0     # ...to this cap
+
+
+class SimClock:
+    """Deterministic monotone clock; callable so it plugs straight into
+    the ``clock=`` hooks (``clock()`` == ``time.time()`` shape)."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        assert dt >= 0
+        self.t += dt
+
+
+@dataclass
+class LinkSpec:
+    """Per-direction link fault model."""
+
+    latency_s: float = 0.02
+    jitter_s: float = 0.0
+    drop_rate: float = 0.0
+    bandwidth_bps: Optional[float] = None  # None = infinite
+    drop_commands: FrozenSet[str] = frozenset()  # blackhole these
+
+
+class _Link:
+    __slots__ = ("a", "b", "specs", "partitioned", "busy_until",
+                 "reconnect_delay", "reconnect_pending", "endpoints")
+
+    def __init__(self, a: int, b: int, spec_ab: LinkSpec, spec_ba: LinkSpec):
+        self.a = a
+        self.b = b
+        self.specs = {a: spec_ab, b: spec_ba}  # keyed by SENDING node
+        self.partitioned = False
+        self.busy_until = {a: 0.0, b: 0.0}
+        # outbound-reconnect backoff (the sim analogue of the
+        # open-connections loop redialing from addrman): doubles per
+        # attempt, reset on a completed handshake
+        self.reconnect_delay = RECONNECT_BASE_S
+        self.reconnect_pending = False
+        self.endpoints: tuple = ()
+
+
+class SimPeer(Peer):
+    """One node's endpoint of a simulated link: a real :class:`Peer`
+    minus the socket — ``send_msg`` enqueues into the harness."""
+
+    def __init__(self, net: "SimNet", owner_index: int, remote_index: int,
+                 addr: Tuple[str, int], inbound: bool):
+        super().__init__(None, addr, inbound, clock=net.clock)
+        self._net = net
+        self._owner_index = owner_index
+        self._remote_index = remote_index
+        self._link: Optional[_Link] = None
+        self._twin: Optional["SimPeer"] = None
+        self._closed = False
+
+    def send_msg(self, magic: bytes, command: str, payload: bytes = b"") -> bool:
+        if self.disconnect or self._closed:
+            return False
+        if g_faults.enabled:
+            try:
+                g_faults.check("net.peer_send")
+            except OSError:
+                self.disconnect_reason = self.disconnect_reason or "fault"
+                self.disconnect = True
+                return False
+        size = len(payload) + 24  # header-equivalent wire cost
+        self.bytes_sent += size
+        self.last_send = self._net.clock()
+        msgs, nbytes = _wire_counters(command, "sent")
+        msgs.inc()
+        nbytes.inc(size)
+        self._net._enqueue_msg(self, command, payload, size)
+        return True
+
+    def close(self) -> None:  # no socket to close
+        self._closed = True
+
+
+class SimNode:
+    """One full node in the simulation: NodeContext + real ConnMan (never
+    ``start()``ed — the harness drives delivery instead of its threads)."""
+
+    def __init__(self, net: "SimNet", index: int):
+        from ..node.context import NodeContext
+        from ..node.events import main_signals
+
+        self.index = index
+        self.ip = f"10.{index // 250}.{index % 250}.1"
+        self.node = NodeContext(network="regtest")
+        # the validation bus is process-global and not multi-node aware:
+        # leaving every sim node's asset/rewards indexers registered
+        # makes each connected block fan out to N stores (quadratic and
+        # cross-contaminating).  Netsim exercises the P2P layer, so the
+        # indexers are detached; NodeContext.shutdown's unregister is a
+        # no-op afterwards.
+        main_signals.unregister(self.node.message_store)
+        main_signals.unregister(self.node.rewards)
+        self.connman = ConnMan(self.node, port=0, listen=False,
+                               clock=net.clock)
+        self.node.connman = self.connman
+        self.processor = self.connman.processor
+        # deterministic per-node protocol randomness (ping nonces,
+        # feefilter jitter, self-connection nonce)
+        self.processor._rand = FastRandomContext(
+            seed=net.seed.to_bytes(8, "little") + index.to_bytes(8, "little"))
+        self.processor._local_nonce = self.processor._rand.rand64()
+        self.processor.orphanage._rand = self.processor._rand
+        for attr, val in net.tunables.items():
+            setattr(self.processor, attr, val)
+
+    @property
+    def chainstate(self):
+        return self.node.chainstate
+
+    def tip_hash(self) -> int:
+        return self.node.chainstate.tip().block_hash
+
+
+@dataclass(order=True)
+class _Event:
+    t: float
+    seq: int
+    kind: str = field(compare=False)
+    data: tuple = field(compare=False)
+
+
+class SimNet:
+    """The harness: owns the clock, the nodes, the links, and the event
+    queue.  See the module docstring and README "Network robustness &
+    netsim" for the scenario runbook."""
+
+    def __init__(self, n_nodes: int, seed: int = 0,
+                 default_spec: Optional[LinkSpec] = None,
+                 periodic_interval_s: float = 1.0,
+                 ping_interval_s: float = 30.0,
+                 auto_reconnect: bool = True,
+                 tunables: Optional[dict] = None):
+        from ..node.chainparams import select_params
+
+        self.seed = seed
+        self.rng = FastRandomContext(seed=seed.to_bytes(8, "little") + b"net")
+        params = select_params("regtest")
+        self.clock = SimClock(params.genesis_time + 3600.0)
+        self.default_spec = default_spec or LinkSpec()
+        self.auto_reconnect = auto_reconnect
+        self.tunables = {
+            "block_download_timeout_s": SIM_BLOCK_DOWNLOAD_TIMEOUT_S,
+            "headers_sync_timeout_s": SIM_HEADERS_SYNC_TIMEOUT_S,
+            "handshake_timeout_s": SIM_HANDSHAKE_TIMEOUT_S,
+            "tip_stale_resync_s": SIM_TIP_STALE_RESYNC_S,
+        }
+        if tunables:
+            self.tunables.update(tunables)
+        self._events: List[_Event] = []
+        self._seq = 0
+        self.event_log: List[tuple] = []
+        self.links: List[_Link] = []
+        self.block_times: Dict[int, float] = {}      # hash -> mined-at
+        self.tip_times: Dict[Tuple[int, int], float] = {}  # (node,hash)->t
+        self.events_dispatched = 0
+        self.nodes = [SimNode(self, i) for i in range(n_nodes)]
+        for i in range(n_nodes):
+            self._push(self.clock() + periodic_interval_s,
+                       "periodic", (i, periodic_interval_s))
+            self._push(self.clock() + ping_interval_s,
+                       "ping", (i, ping_interval_s))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "SimNet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            try:
+                n.node.shutdown()
+            except Exception:  # noqa: BLE001 — teardown must not mask tests
+                pass
+
+    # -- topology ----------------------------------------------------------
+
+    def connect(self, i: int, j: int, spec: Optional[LinkSpec] = None,
+                spec_back: Optional[LinkSpec] = None) -> _Link:
+        """Create a bidirectional link; node ``i`` is the outbound side.
+        ``spec`` shapes i->j traffic, ``spec_back`` j->i (defaults to
+        ``spec``)."""
+        assert i != j
+        spec = spec or self.default_spec
+        link = _Link(i, j, spec, spec_back or spec)
+        self.links.append(link)
+        self._establish(link)
+        return link
+
+    def _establish(self, link: _Link) -> None:
+        """(Re-)create the peer pair for a link; the outbound side
+        (``link.a``) speaks first, exactly like ``connect_to``."""
+        # a reconnect may find one side's old endpoint still registered
+        # (e.g. only the remote half closed during a partition): cull it
+        # first or the node carries a zombie peer whose sends route to a
+        # dead twin
+        for old in link.endpoints:
+            if not old._closed:
+                old.disconnect = True
+                old._twin = None  # no close propagation: both sides die here
+                self.nodes[old._owner_index].connman._remove_peer(old)
+        i, j = link.a, link.b
+        a, b = self.nodes[i], self.nodes[j]
+        pa = SimPeer(self, i, j, (b.ip, b.node.params.default_port),
+                     inbound=False)
+        pb = SimPeer(self, j, i, (a.ip, a.node.params.default_port),
+                     inbound=True)
+        pa._link = pb._link = link
+        pa._twin, pb._twin = pb, pa
+        link.endpoints = (pa, pb)
+        with a.connman._peers_lock:
+            a.connman.peers[pa.id] = pa
+        with b.connman._peers_lock:
+            b.connman.peers[pb.id] = pb
+        a.processor.init_peer(pa)  # outbound speaks first (VERSION)
+        self._sweep(a)
+
+    def connect_ring(self, spec: Optional[LinkSpec] = None) -> None:
+        n = len(self.nodes)
+        for i in range(n):
+            self.connect(i, (i + 1) % n, spec)
+
+    def connect_full(self, spec: Optional[LinkSpec] = None) -> None:
+        n = len(self.nodes)
+        for i in range(n):
+            for j in range(i + 1, n):
+                self.connect(i, j, spec)
+
+    def connect_random(self, degree: int = 4,
+                       spec: Optional[LinkSpec] = None) -> None:
+        """Ring (connectivity guarantee) + random chords up to ~degree."""
+        n = len(self.nodes)
+        self.connect_ring(spec)
+        have: Set[Tuple[int, int]] = {(l.a, l.b) for l in self.links}
+        have |= {(b, a) for a, b in have}
+        for i in range(n):
+            deg = sum(1 for l in self.links if i in (l.a, l.b))
+            tries = 0
+            while deg < degree and tries < 8 * degree:
+                tries += 1
+                j = self.rng.randrange(n)
+                if j == i or (i, j) in have:
+                    continue
+                self.connect(i, j, spec)
+                have.add((i, j))
+                have.add((j, i))
+                deg += 1
+
+    def partition(self, group_a) -> None:
+        """Cut every link crossing the boundary between ``group_a`` and
+        the rest.  In-flight events already queued still deliver (packets
+        on the wire); everything sent after this is dropped."""
+        ga = set(group_a)
+        for link in self.links:
+            link.partitioned = (link.a in ga) != (link.b in ga)
+
+    def heal(self) -> None:
+        for link in self.links:
+            link.partitioned = False
+            # a link whose endpoints died during the partition (stall/
+            # timeout disconnects) redials once connectivity is back
+            if self.auto_reconnect and not self._link_alive(link):
+                self._schedule_reconnect(link)
+
+    def _link_alive(self, link: _Link) -> bool:
+        return bool(link.endpoints) and not any(
+            p._closed or p.disconnect for p in link.endpoints)
+
+    def _schedule_reconnect(self, link: _Link) -> None:
+        if link.reconnect_pending:
+            return
+        link.reconnect_pending = True
+        self._push(self.clock() + link.reconnect_delay, "reconnect", (link,))
+        link.reconnect_delay = min(link.reconnect_delay * 2, RECONNECT_MAX_S)
+
+    # -- event queue -------------------------------------------------------
+
+    def _push(self, t: float, kind: str, data: tuple) -> None:
+        self._seq += 1
+        heapq.heappush(self._events, _Event(t, self._seq, kind, data))
+
+    def _enqueue_msg(self, src_peer: SimPeer, command: str,
+                     payload: bytes, size: int) -> None:
+        link = src_peer._link
+        if link is None or link.partitioned:
+            return
+        spec = link.specs[src_peer._owner_index]
+        if command in spec.drop_commands:
+            return
+        if spec.drop_rate and self.rng.random() < spec.drop_rate:
+            return
+        now = self.clock()
+        delay = spec.latency_s
+        if spec.jitter_s:
+            delay += self.rng.random() * spec.jitter_s
+        if spec.bandwidth_bps:
+            start = max(now, link.busy_until[src_peer._owner_index])
+            tx = size * 8.0 / spec.bandwidth_bps
+            link.busy_until[src_peer._owner_index] = start + tx
+            deliver = start + tx + delay
+        else:
+            deliver = now + delay
+        self._push(deliver, "msg",
+                   (src_peer._twin, command, payload, size))
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, ev: _Event) -> None:
+        self.events_dispatched += 1
+        if ev.kind == "msg":
+            peer, command, payload, size = ev.data
+            self._deliver(peer, command, payload, size)
+        elif ev.kind == "close":
+            (peer,) = ev.data
+            if not peer._closed:
+                peer.disconnect = True
+                self._close_endpoint(peer)
+        elif ev.kind == "periodic":
+            i, interval = ev.data
+            node = self.nodes[i]
+            node.processor.periodic()
+            self._sweep(node)
+            self._push(self.clock() + interval, "periodic", ev.data)
+        elif ev.kind == "ping":
+            i, interval = ev.data
+            node = self.nodes[i]
+            node.processor.send_pings()
+            self._sweep(node)
+            self._push(self.clock() + interval, "ping", ev.data)
+        elif ev.kind == "reconnect":
+            (link,) = ev.data
+            link.reconnect_pending = False
+            if link.partitioned or self._link_alive(link):
+                return
+            a, b = self.nodes[link.a], self.nodes[link.b]
+            if a.connman.is_banned(b.ip) or b.connman.is_banned(a.ip):
+                return  # a banned peer is not redialed
+            self._establish(link)
+
+    def _deliver(self, peer: SimPeer, command: str, payload: bytes,
+                 size: int) -> None:
+        node = self.nodes[peer._owner_index]
+        if peer._closed or peer.disconnect or peer.id not in node.connman.peers:
+            return
+        if g_faults.enabled:
+            try:
+                payload = g_faults.filter_read("net.peer_recv", payload)
+            except OSError:
+                peer.disconnect_reason = peer.disconnect_reason or "fault"
+                peer.disconnect = True
+                self._sweep(node)
+                return
+        peer.bytes_recv += size
+        peer.last_recv = self.clock()
+        msgs, nbytes = _wire_counters(command, "recv")
+        msgs.inc()
+        nbytes.inc(size)
+        self.event_log.append((round(self.clock(), 6), peer._remote_index,
+                               peer._owner_index, command, size))
+        tip_before = node.tip_hash()
+        node.processor.process_messages([(peer, command, payload)])
+        tip_after = node.tip_hash()
+        if tip_after != tip_before:
+            self.tip_times[(node.index, tip_after)] = self.clock()
+        if peer.handshake_done and peer._link is not None:
+            peer._link.reconnect_delay = RECONNECT_BASE_S  # good() signal
+        self._sweep(node)
+
+    def _sweep(self, node: SimNode) -> None:
+        """The _message_handler_loop postlude: ban on threshold, tear
+        down flagged endpoints (and notify the remote side)."""
+        for peer in node.connman.all_peers():
+            if peer.misbehavior >= 100 and not peer.disconnect:
+                node.connman.ban(peer.ip)
+                peer.disconnect_reason = (
+                    peer.disconnect_reason or "misbehavior")
+                peer.disconnect = True
+            if peer.disconnect and not peer._closed:
+                self._close_endpoint(peer)
+
+    def _close_endpoint(self, peer: SimPeer) -> None:
+        node = self.nodes[peer._owner_index]
+        node.connman._remove_peer(peer)  # sets _closed via peer.close()
+        link = peer._link
+        twin = peer._twin
+        if twin is not None and not twin._closed and link is not None:
+            # the remote side observes the close one latency later —
+            # unless the link is partitioned (it learns via its own
+            # stall/handshake timers instead, like a real half-open TCP)
+            if not link.partitioned:
+                spec = link.specs[peer._owner_index]
+                self._push(self.clock() + spec.latency_s, "close", (twin,))
+        if link is not None and self.auto_reconnect and not link.partitioned:
+            self._schedule_reconnect(link)
+
+    # -- running -----------------------------------------------------------
+
+    def run(self, duration_s: float) -> None:
+        self.run_until(None, timeout_s=duration_s)
+
+    def run_until(self, cond, timeout_s: float = 60.0) -> bool:
+        """Drain events in time order until ``cond()`` is true or
+        ``timeout_s`` of SIMULATED time elapses.  Returns cond's final
+        verdict (True when cond is None)."""
+        deadline = self.clock() + timeout_s
+        if cond is not None and cond():
+            return True
+        while self._events:
+            ev = self._events[0]
+            if ev.t > deadline:
+                break
+            heapq.heappop(self._events)
+            if ev.t > self.clock():
+                self.clock.t = ev.t
+            self._dispatch(ev)
+            if cond is not None and cond():
+                return True
+        self.clock.t = max(self.clock.t, deadline)
+        return cond() if cond is not None else True
+
+    def settle(self, timeout_s: float = 30.0) -> bool:
+        """Run until every live link's handshake completed."""
+
+        def done() -> bool:
+            for n in self.nodes:
+                for p in n.connman.all_peers():
+                    if not p.handshake_done:
+                        return False
+            return True
+
+        return self.run_until(done, timeout_s)
+
+    # -- scenario actions --------------------------------------------------
+
+    def mine_block(self, node_index: int, advance_s: float = 30.0) -> int:
+        """Advance the clock, mine one regtest block on ``node_index``,
+        connect it locally and announce it into the simulated network.
+        Returns the new tip hash (mined-at time lands in
+        ``block_times``)."""
+        from ..mining.assembler import BlockAssembler, mine_block_cpu
+
+        self.clock.advance(advance_s)
+        node = self.nodes[node_index]
+        cs = node.node.chainstate
+        blk = BlockAssembler(cs).create_new_block(
+            b"\x51", ntime=int(self.clock()))
+        assert mine_block_cpu(blk, node.node.params.algo_schedule,
+                              max_tries=1 << 22), "regtest PoW failed"
+        cs.process_new_block(blk)
+        h = cs.tip().block_hash
+        self.block_times[h] = self.clock()
+        self.tip_times[(node_index, h)] = self.clock()
+        node.processor.announce_block(h)
+        self._sweep(node)
+        log_print(LogFlags.NET, "netsim: node %d mined %s at t=%.3f",
+                  node_index, u256_hex(h)[:16], self.clock())
+        return h
+
+    def mine_chain(self, node_index: int, n_blocks: int,
+                   advance_s: float = 30.0) -> List[int]:
+        return [self.mine_block(node_index, advance_s) for _ in range(n_blocks)]
+
+    # -- inspection --------------------------------------------------------
+
+    def tips(self) -> List[int]:
+        return [n.tip_hash() for n in self.nodes]
+
+    def converged(self) -> bool:
+        return len(set(self.tips())) == 1
+
+    def ban_count(self) -> int:
+        return sum(len(n.connman.banned) for n in self.nodes)
+
+    def max_misbehavior(self) -> int:
+        scores = [p.misbehavior for n in self.nodes
+                  for p in n.connman.all_peers()]
+        return max(scores, default=0)
+
+    def propagation_times(self, block_hash: int) -> Dict[int, float]:
+        """Per-node (accept_time - mined_time) for ``block_hash``; nodes
+        that never accepted it are absent."""
+        t0 = self.block_times.get(block_hash)
+        if t0 is None:
+            return {}
+        out = {}
+        for (idx, h), t in self.tip_times.items():
+            if h == block_hash:
+                out[idx] = t - t0
+        return out
+
+    def digest(self) -> str:
+        """Determinism pin: hashes the full delivery order + final tips."""
+        hsh = hashlib.sha256()
+        for entry in self.event_log:
+            hsh.update(repr(entry).encode())
+        for t in self.tips():
+            hsh.update(u256_hex(t).encode())
+        return hsh.hexdigest()

@@ -34,10 +34,18 @@ class _Orphan:
 
 
 class TxOrphanage:
-    """ref mapOrphanTransactions + mapOrphanTransactionsByPrev."""
+    """ref mapOrphanTransactions + mapOrphanTransactionsByPrev.
 
-    def __init__(self, max_orphans: int = MAX_ORPHAN_TRANSACTIONS):
+    ``clock`` is the injectable time source (netsim's deterministic
+    SimClock in tests; ``time.time`` in the live node) — expiry and the
+    sweep throttle read it, so the timeout branches are exercisable
+    without wall-clock sleeps."""
+
+    def __init__(self, max_orphans: int = MAX_ORPHAN_TRANSACTIONS,
+                 clock=time.time, rand=None):
         self.max_orphans = max_orphans
+        self._clock = clock
+        self._rand = rand if rand is not None else _rand
         self._orphans: Dict[int, _Orphan] = {}
         self._by_prev: Dict[int, Set[int]] = {}  # parent txid -> orphan txids
         self._next_sweep = 0.0
@@ -56,13 +64,14 @@ class TxOrphanage:
         if len(tx.to_bytes()) > MAX_ORPHAN_TX_SIZE:
             return False
         self._orphans[txid] = _Orphan(
-            tx=tx, from_peer=from_peer, expire_at=time.time() + ORPHAN_TX_EXPIRE_TIME
+            tx=tx, from_peer=from_peer,
+            expire_at=self._clock() + ORPHAN_TX_EXPIRE_TIME
         )
         for txin in tx.vin:
             self._by_prev.setdefault(txin.prevout.txid, set()).add(txid)
         # bound the pool: evict random orphans (ref LimitOrphanTxSize)
         while len(self._orphans) > self.max_orphans:
-            victim = _rand.choice(list(self._orphans))
+            victim = self._rand.choice(list(self._orphans))
             self.erase(victim)
         return txid in self._orphans
 
@@ -104,7 +113,7 @@ class TxOrphanage:
 
     def expire(self, now: Optional[float] = None) -> int:
         """Sweep expired orphans (rate-limited, ref ORPHAN_TX_EXPIRE_*)."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         if now < self._next_sweep:
             return 0
         self._next_sweep = now + ORPHAN_TX_EXPIRE_INTERVAL
@@ -127,13 +136,14 @@ class TxRequestTracker:
     become fallbacks only after the request times out.
     """
 
-    def __init__(self, timeout: float = TX_REQUEST_TIMEOUT):
+    def __init__(self, timeout: float = TX_REQUEST_TIMEOUT, clock=time.time):
         self.timeout = timeout
+        self._clock = clock
         self._inflight: Dict[int, _Request] = {}
 
     def should_request(self, txid: int, peer_id: int,
                        now: Optional[float] = None) -> bool:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         req = self._inflight.get(txid)
         if req is not None and now - req.at < self.timeout:
             return False
@@ -149,7 +159,7 @@ class TxRequestTracker:
             del self._inflight[t]
 
     def expire(self, now: Optional[float] = None) -> None:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         stale = [t for t, r in self._inflight.items() if now - r.at >= self.timeout * 4]
         for t in stale:
             del self._inflight[t]

@@ -69,6 +69,14 @@ KNOWN_SITES: Dict[str, dict] = {
     "blockstore.rev.sync":  {"ibd": False, "help": "undo fsync"},
     "chainstate.coins_flush": {"ibd": True, "help": "coins+assets cache disk flush"},
     "pool.socket_send":     {"ibd": False, "help": "stratum session socket send"},
+    # network sites: errno/torn/kill specs behave on sockets exactly as
+    # they do on disk (kill@<n> sends n wire bytes first — a mid-send
+    # connection cut; torn=<n> truncates the received chunk).  The
+    # netsim harness consults the same sites, so one -faultinject spec
+    # drives both the real socket paths and simulated links.
+    "net.peer_send":        {"ibd": False, "help": "p2p peer socket send"},
+    "net.peer_recv":        {"ibd": False, "help": "p2p peer socket recv"},
+    "net.connect":          {"ibd": False, "help": "outbound p2p connect"},
 }
 
 KILL_EXIT_CODE = 137  # what a SIGKILLed process reports; greppable in CI

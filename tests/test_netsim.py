@@ -1,0 +1,372 @@
+"""Adversarial multi-node netsim: determinism, partition-and-heal,
+reorg storms, stalling/black-hole peers, fault-injected links, and the
+sync-stall hardening they prove (stall rotation, headers-sync deadline,
+handshake timeout, connect backoff).
+
+The harness (net/netsim.py) runs N full regtest NodeContexts over
+in-memory links from ONE thread under a deterministic SimClock, so
+every timeout branch in net_processing is exercisable in simulated
+seconds — no wall-clock sleeps anywhere in this file.
+"""
+
+from nodexa_chain_core_tpu.net.netsim import LinkSpec, SimClock, SimNet
+from nodexa_chain_core_tpu.node.faults import g_faults
+from nodexa_chain_core_tpu.telemetry import g_metrics
+
+DISC = g_metrics.counter("nodexa_peer_disconnects_total")
+ROT = g_metrics.counter("nodexa_block_downloads_rotated_total")
+
+
+# ---------------------------------------------------------- determinism
+
+
+def _scripted_run(seed):
+    net = SimNet(3, seed=seed)
+    try:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        net.mine_block(1)
+        assert net.run_until(net.converged, 60.0)
+        net.run(3.0)  # drain trailing pings/periodics into the log
+        return net.digest(), net.tips()
+    finally:
+        net.stop()
+
+
+def test_same_seed_same_digest_and_tips():
+    d1, t1 = _scripted_run(seed=21)
+    d2, t2 = _scripted_run(seed=21)
+    assert d1 == d2
+    assert t1 == t2
+
+
+def test_different_seed_different_event_order():
+    # jitterless links make event ORDER depend only on the scripted
+    # actions, but per-node protocol randomness (nonces -> ping payload
+    # sizes are fixed; feefilter jitter differs) and the rng-fed
+    # topology helpers key off the seed; assert the digest captures tips
+    # either way and the runs are self-consistent
+    d1, t1 = _scripted_run(seed=1)
+    d2, t2 = _scripted_run(seed=2)
+    assert t1 == t2 or len(set(t1)) == 1 == len(set(t2))
+    assert d1 != d2 or t1 == t2
+
+
+# ----------------------------------------------- block relay / topology
+
+
+def test_block_propagates_full_mesh():
+    with SimNet(4, seed=4) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        h = net.mine_block(2)
+        assert net.run_until(net.converged, 60.0)
+        prop = net.propagation_times(h)
+        assert set(prop) == {0, 1, 2, 3}
+        assert prop[2] == 0.0  # the miner itself
+        # direct links: one compact-block flight (+ possible getblocktxn
+        # round trip) — well under 10 simulated link latencies
+        assert all(v < 10 * net.default_spec.latency_s
+                   for k, v in prop.items() if k != 2)
+        assert net.max_misbehavior() == 0
+
+
+def test_propagation_respects_link_latency():
+    slow = LinkSpec(latency_s=0.5)
+    with SimNet(3, seed=6) as net:
+        net.connect(0, 1)                 # default 20 ms
+        net.connect(1, 2, spec=slow)      # half-second hop
+        assert net.settle(30.0)
+        h = net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        prop = net.propagation_times(h)
+        assert prop[1] < 0.2
+        assert prop[2] >= 0.5  # had to cross the slow hop
+
+
+# ------------------------------------------------- partition-and-heal
+
+
+def test_partition_and_heal_converges_to_heavy_tip():
+    with SimNet(5, seed=3) as net:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        net.partition({0, 1})
+        net.mine_block(0)       # light side: +1
+        net.mine_chain(2, 2)    # heavy side: +2
+        net.run(8.0)
+        assert len(set(net.tips())) == 2, "partition did not fork"
+        net.heal()
+        # convergence comes from the tip-staleness re-sync — no manual
+        # kick, no new block needed
+        assert net.run_until(net.converged, 180.0)
+        heavy = net.nodes[2].tip_hash()
+        assert all(t == heavy for t in net.tips())
+        assert net.ban_count() == 0
+        assert net.max_misbehavior() == 0
+
+
+def test_reorg_storm_across_competing_tips():
+    """Repeated partition/mine-on-both-sides/heal rounds: every round
+    must re-converge with zero honest bans, flip-flopping the winning
+    side."""
+    with SimNet(4, seed=8) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        for rnd in range(3):
+            left = {0, 1} if rnd % 2 == 0 else {0, 3}
+            net.partition(left)
+            light, heavy = (min(left), min(set(range(4)) - left))
+            net.mine_block(light)
+            net.mine_chain(heavy, 2)   # other side wins this round
+            net.run(5.0)
+            net.heal()
+            assert net.run_until(net.converged, 240.0), \
+                f"round {rnd} did not converge"
+            assert net.tips()[0] == net.nodes[heavy].tip_hash()
+        assert net.ban_count() == 0
+        assert net.max_misbehavior() == 0
+
+
+# ------------------------------------------- stalling / black-hole peer
+
+
+def test_stalling_peer_rotated_within_deadline():
+    disc0 = DISC.value(reason="stall")
+    rot0 = ROT.total()
+    net = SimNet(3, seed=5, auto_reconnect=False)
+    try:
+        net.connect(0, 1)
+        assert net.settle(30.0)
+        net.mine_chain(0, 8)
+        assert net.run_until(
+            lambda: net.nodes[1].tip_hash() == net.nodes[0].tip_hash(),
+            60.0)
+        # node2 joins: the staller (node1) is FASTER, so its headers win
+        # the race and the global in-flight map assigns it the downloads
+        blackhole = LinkSpec(latency_s=0.005, drop_commands=frozenset(
+            {"block", "cmpctblock", "blocktxn"}))
+        net.connect(2, 1, spec=LinkSpec(latency_s=0.005),
+                    spec_back=blackhole)
+        net.connect(2, 0, spec=LinkSpec(latency_s=0.05))
+        t0 = net.clock()
+        assert net.run_until(
+            lambda: net.nodes[2].tip_hash() == net.nodes[0].tip_hash(),
+            60.0), "IBD never completed past the stalling peer"
+        ibd_s = net.clock() - t0
+        deadline = net.tunables["block_download_timeout_s"]
+        # rotation fired within one periodic tick of the stall deadline
+        # and the re-download finished promptly after
+        assert ibd_s < deadline + 5.0
+        assert DISC.value(reason="stall") > disc0
+        assert ROT.total() > rot0
+        # the staller was dropped, never banned (slow != malicious)
+        assert net.ban_count() == 0
+        live = {p._remote_index for p in net.nodes[2].connman.all_peers()}
+        assert live == {0}
+    finally:
+        net.stop()
+
+
+def test_headers_sync_deadline_drops_dead_claimer():
+    """A peer that claims more chain (start_height) but never answers
+    getheaders is disconnected with reason=timeout — and a peer with
+    nothing to offer is NOT."""
+    from nodexa_chain_core_tpu.net.net_processing import NetProcessor
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.node.chainparams import select_params
+
+    class P:
+        _n = 9000
+
+        def __init__(self):
+            P._n += 1
+            self.id = P._n
+            self.ip = "10.9.9.9"
+            self.inbound = True
+            self.handshake_done = True
+            self.disconnect = False
+            self.disconnect_reason = None
+            self.misbehavior = 0
+            self.connected_at = 0.0
+            self.start_height = 0
+            self.sync_started = True
+            self.blocks_in_flight = set()
+            self.known_blocks = set()
+            self.known_txs = set()
+            self.sent = []
+
+        def send_msg(self, magic, command, payload=b""):
+            self.sent.append(command)
+            return True
+
+    params = select_params("regtest")
+    cs = ChainState(params)
+    cs.mempool = TxMemPool()
+    node = type("N", (), {"chainstate": cs, "mempool": cs.mempool,
+                          "params": params})()
+    clock = SimClock(100.0)
+    claimer, honest = P(), P()
+    claimer.start_height = 50          # promises chain, delivers nothing
+    honest.start_height = 0
+    conn = type("C", (), {"all_peers": lambda self: [claimer, honest],
+                          "addrman": None})()
+    proc = NetProcessor(node, conn, clock=clock)
+    proc.headers_sync_timeout_s = 10.0
+    for p in (claimer, honest):
+        proc._send_getheaders(p)
+        assert p.headers_sync_deadline is not None
+    clock.advance(11.0)
+    proc.check_stalls()
+    assert claimer.disconnect and claimer.disconnect_reason == "timeout"
+    assert claimer.misbehavior == 0    # dropped, not punished
+    assert not honest.disconnect       # claims nothing: deadline waived
+    assert honest.headers_sync_deadline is None
+    # handshake timeout: a never-completing handshake is cut too
+    late = P()
+    late.handshake_done = False
+    late.connected_at = clock()
+    conn2 = type("C", (), {"all_peers": lambda self: [late],
+                           "addrman": None})()
+    proc2 = NetProcessor(node, conn2, clock=clock)
+    proc2.handshake_timeout_s = 5.0
+    clock.advance(6.0)
+    proc2.check_stalls()
+    assert late.disconnect and late.disconnect_reason == "timeout"
+
+
+# ----------------------------------------------- fault-injection compose
+
+
+def test_fault_injected_sends_mid_sync_recover_via_reconnect():
+    inj = g_metrics.counter("nodexa_fault_injections_total")
+    i0 = inj.value(site="net.peer_send")
+    f0 = DISC.value(reason="fault")
+    with SimNet(4, seed=9) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 30.0)
+        # the next 3 sends ANYWHERE in the sim die with ECONNRESET —
+        # they land on node0's announce fan-out, tearing all its links
+        g_faults.arm_from_string("net.peer_send:errno=ECONNRESET,count=3")
+        net.mine_chain(0, 3)
+        assert net.run_until(net.converged, 120.0), \
+            "network did not recover from injected send faults"
+        assert inj.value(site="net.peer_send") - i0 == 3
+        assert DISC.value(reason="fault") - f0 == 3
+        assert net.ban_count() == 0
+        assert net.max_misbehavior() == 0
+
+
+def test_torn_recv_scores_misbehavior_not_crash():
+    """net.peer_recv torn=8 truncates a delivered payload: the handler
+    must contain the deserialization blow-up as peer misbehavior (the
+    same class as a checksum failure), not an exception escape."""
+    with SimNet(2, seed=12) as net:
+        net.connect(0, 1)
+        assert net.settle(30.0)
+        mis0 = net.max_misbehavior()
+        g_faults.arm_from_string("net.peer_recv:torn=8,count=1")
+        net.mine_block(0)  # announcement gets torn on delivery
+        net.run(10.0)
+        assert net.max_misbehavior() > mis0 or net.converged()
+        # the net must still be able to finish syncing afterwards
+        g_faults.disarm_all()
+        net.mine_block(0)
+        assert net.run_until(net.converged, 120.0)
+
+
+def test_heal_reconnects_half_closed_link_without_zombies():
+    """A link whose endpoints died asymmetrically during a partition
+    (one side's detector fired, the other never heard the close) must
+    redial on heal WITHOUT leaving the surviving stale endpoint
+    registered as a zombie peer."""
+    with SimNet(2, seed=15) as net:
+        link = net.connect(0, 1)
+        assert net.settle(30.0)
+        net.partition({0})
+        pa, pb = link.endpoints
+        pa.disconnect = True          # local detector drops its side
+        net._sweep(net.nodes[pa._owner_index])
+        assert pa._closed and not pb._closed  # remote half-open
+        net.heal()
+        assert net.run_until(lambda: net._link_alive(link), 60.0)
+        # exactly one live peer per node: the stale half was culled
+        assert [len(n.connman.all_peers()) for n in net.nodes] == [1, 1]
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+
+
+# ------------------------------------------------- connect backoff (real)
+
+
+def test_connect_backoff_on_dead_address():
+    """ConnMan.connect_to backs off per address exponentially and counts
+    the retries; a manual connect bypasses the gate."""
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    retries = g_metrics.counter("nodexa_io_retries_total")
+    r0 = retries.value(source="net.connect")
+    clock = SimClock(1000.0)
+    node = NodeContext(network="regtest")
+    cm = ConnMan(node, port=0, listen=False, clock=clock)
+    try:
+        dead = "127.0.0.1:1"  # nothing listens on port 1
+        assert not cm.connect_to(dead, manual=False)
+        b1 = dict(cm._conn_backoff)
+        assert f"{dead}" in b1 and b1[dead][1] == 2.0
+        # inside the backoff window: gated out WITHOUT a dial attempt
+        assert not cm.connect_to(dead, manual=False)
+        assert cm._conn_backoff[dead] == b1[dead]
+        assert retries.value(source="net.connect") == r0
+        # past the window: a real retry, counted, delay doubled
+        clock.advance(3.0)
+        assert not cm.connect_to(dead, manual=False)
+        assert cm._conn_backoff[dead][1] == 4.0
+        assert retries.value(source="net.connect") == r0 + 1
+        # manual connects bypass the gate (and still fail honestly)
+        assert not cm.connect_to(dead, manual=True)
+    finally:
+        node.shutdown()
+
+
+def test_connect_fault_site_feeds_backoff():
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    inj = g_metrics.counter("nodexa_fault_injections_total")
+    i0 = inj.value(site="net.connect")
+    clock = SimClock(50.0)
+    node = NodeContext(network="regtest")
+    cm = ConnMan(node, port=0, listen=False, clock=clock)
+    try:
+        g_faults.arm_from_string("net.connect:errno=ENETUNREACH,count=1")
+        assert not cm.connect_to("203.0.113.7:9", manual=True)
+        assert inj.value(site="net.connect") == i0 + 1
+        assert "203.0.113.7:9" in cm._conn_backoff
+    finally:
+        node.shutdown()
+
+
+# -------------------------------------------------- bench smoke (tier-1)
+
+
+def test_bench_netsim_small_propagation():
+    """The bench harness itself stays healthy at a tier-1-friendly size
+    and emits the block_propagation_ms keys bench.py merges."""
+    from nodexa_chain_core_tpu.bench.netsim import measure_propagation
+
+    res = measure_propagation(n_nodes=8, degree=3, blocks=2, seed=13)
+    assert res["netsim_nodes"] == 8
+    assert res["block_propagation_ms"] > 0
+    assert res["block_propagation_p95_ms"] >= res["block_propagation_ms"]
+    assert res["netsim_events_per_s"] > 0

@@ -244,6 +244,58 @@ def test_mempool_full_evicts_lowest_feerate(rig):
     assert not pool.contains(low.txid)  # worst feerate evicted
 
 
+def test_orphanage_expiry_under_injected_clock():
+    """The timeout branches run on the injectable clock — no wall-clock
+    sleeps: park, advance SIM time past the deadline, sweep."""
+    from nodexa_chain_core_tpu.net.netsim import SimClock
+
+    clock = SimClock(1000.0)
+    o = TxOrphanage(max_orphans=10, clock=clock)
+    txs = []
+    for i in range(3):
+        tx = Transaction(
+            version=1,
+            vin=[TxIn(prevout=OutPoint(i + 1, 0))],
+            vout=[TxOut(value=1, script_pubkey=b"\x51")],
+        )
+        txs.append(tx)
+        o.add(tx, from_peer=7)
+    assert o.size() == 3
+    # inside the expiry window: the sweep (throttle starts disarmed at
+    # t=0, so the first call runs) removes nothing
+    clock.advance(60.0)
+    assert o.expire() == 0
+    assert o.size() == 3
+    # sweep throttle: even past the deadline, a sweep inside the
+    # rate-limit interval is a no-op
+    clock.advance(25 * 60)
+    o._next_sweep = clock() + 100.0
+    assert o.expire() == 0
+    # past the throttle: everything expired at once
+    clock.advance(200.0)
+    assert o.expire() == 3
+    assert o.size() == 0
+
+
+def test_tx_request_tracker_timeout_under_injected_clock():
+    """Re-request and expiry paths driven purely by the internal clock
+    (no explicit now= threading needed at the call sites)."""
+    from nodexa_chain_core_tpu.net.netsim import SimClock
+
+    clock = SimClock(5000.0)
+    tr = TxRequestTracker(timeout=30.0, clock=clock)
+    assert tr.should_request(0xAB, peer_id=1)
+    assert not tr.should_request(0xAB, peer_id=2)   # in flight
+    clock.advance(31.0)
+    assert tr.should_request(0xAB, peer_id=2)       # timed out -> fallback
+    # expire() garbage-collects abandoned entries at 4x the timeout
+    assert tr.should_request(0xCD, peer_id=3)
+    clock.advance(4 * 30.0 + 1)
+    tr.expire()
+    assert not tr._inflight  # both swept
+    assert tr.should_request(0xCD, peer_id=4)
+
+
 def test_inbound_eviction_prefers_youngest_unprotected():
     from nodexa_chain_core_tpu.net.connman import ConnMan
 

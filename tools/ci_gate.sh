@@ -52,25 +52,30 @@
 #                      restart-to-first-sweep in a cold child and
 #                      asserts startup_to_first_sweep_s is finite with
 #                      per-kernel jit-compile attribution recorded
-#  11. vectors         generate_x16r_vectors.py --check — the committed
+#  11. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
+#                      partition-and-heal converging every node to ONE
+#                      tip with zero honest bans, a digest-pinned
+#                      determinism replay, and a stalling-peer IBD run
+#                      asserting stall rotation beats the deadline
+#  12. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  12. native build    compiles the C++ engine (also feeds the wheel)
-#  13. static checks   tools/typecheck.py over the consensus-critical
+#  13. native build    compiles the C++ engine (also feeds the wheel)
+#  14. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  14. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  15. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  15. pytest          unit suite (functional suite with --full)
-#  16. wheel           platform-tagged wheel incl. the native .so,
+#  16. pytest          unit suite (functional suite with --full)
+#  17. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/16] lint"
+echo "== [1/17] lint"
 python tools/lint.py
 
-echo "== [2/16] import graph"
+echo "== [2/17] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -88,13 +93,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/16] rpc mapping parity"
+echo "== [3/17] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/16] telemetry exposition"
+echo "== [4/17] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/16] IBD fast path (synthetic)"
+echo "== [5/17] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -106,7 +111,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/16] pool stratum e2e (loopback)"
+echo "== [6/17] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -117,7 +122,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/16] mesh serving backend (forced 8-device mesh)"
+echo "== [7/17] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -128,7 +133,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [8/16] tx admission fast path (flood)"
+echo "== [8/17] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -139,7 +144,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [9/16] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [9/17] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -150,7 +155,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [10/16] observability (flight recorder + startup attribution)"
+echo "== [10/17] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -165,23 +170,36 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [11/16] crypto vector regeneration"
+echo "== [11/17] netsim smoke (multi-node adversarial scenarios)"
+# deterministic in-process 5-node partition-and-heal (must converge all
+# nodes to ONE tip with zero honest bans), a digest-pinned determinism
+# replay, and a stalling-peer IBD run asserting the black-hole peer is
+# rotated away within the stall deadline (same no-pipe discipline)
+NS_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
+        > "$NS_LOG" 2>&1; then
+    cat "$NS_LOG"; rm -f "$NS_LOG"
+    exit 1
+fi
+tail -6 "$NS_LOG"; rm -f "$NS_LOG"
+
+echo "== [12/17] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [12/16] native engine build"
+echo "== [13/17] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [13/16] static checks (consensus-critical packages)"
+echo "== [14/17] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [14/16] native hardening (security-check analog)"
+echo "== [15/17] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [15/16] pytest"
+echo "== [16/17] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -193,7 +211,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [16/16] wheel"
+echo "== [17/17] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -184,6 +184,22 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f" blocks connected ({rate('nodexa_blocks_connected_total')})   "
         f"connect mean {fmt_ms(cmean)} p99 {fmt_ms(cp99)} (n={ccount})")
 
+    # network: peer census, why peers left, block relay latency
+    peers_in = int(series_total(snap, "nodexa_peers", direction="inbound"))
+    peers_out = int(series_total(snap, "nodexa_peers", direction="outbound"))
+    disc = by_label(snap, "nodexa_peer_disconnects_total", "reason")
+    disc_line = " ".join(
+        f"{k}={int(v)}" for k, v in sorted(disc.items()) if v
+    ) or "none"
+    pcount, pmean, pp99 = hist_stats(
+        snap, "nodexa_block_propagation_seconds")
+    rotated = int(series_total(
+        snap, "nodexa_block_downloads_rotated_total"))
+    lines.append(
+        f"  net: {peers_in} in / {peers_out} out   disconnects "
+        f"[{disc_line}]   rotated {rotated}   block prop mean "
+        f"{fmt_ms(pmean)} p99 {fmt_ms(pp99)} (n={pcount})")
+
     # mempool: outcomes + the off-lock proof pair
     accepts = by_label(snap, "nodexa_mempool_accepts_total", "result")
     _, smean, _ = hist_stats(
