@@ -550,11 +550,15 @@ def bench_startup() -> dict:
     res = measure()
     warm = res.get("startup_to_first_sweep_warm_s")
     log(f"[startup] cold restart to first sweep "
-        f"{res['startup_to_first_sweep_s']:.1f}s (import "
-        f"{res['startup_import_s']:.1f}s, first verify "
-        f"{res['startup_first_verify_s']:.1f}s, "
-        f"{res['startup_jit_compiles']} attributed compiles); warm "
-        f"{warm if warm is not None else float('nan'):.1f}s "
+        f"{res['startup_to_first_sweep_s']:.1f}s / first share "
+        f"{res['startup_to_first_share_s']:.1f}s (import "
+        f"{res['startup_import_s']:.1f}s, "
+        f"{res['startup_jit_compiles']} attributed compiles, "
+        f"{res['startup_steady_new_compiles']} steady-state); warm "
+        f"{warm if warm is not None else float('nan'):.1f}s sweep / "
+        f"{res.get('startup_to_first_share_warm_s', float('nan')):.1f}s "
+        f"share, {res.get('startup_warm_aot', {}).get('restored', 0)} "
+        f"AOT artifacts restored "
         f"({time.perf_counter()-t:.1f}s total)")
     return res
 

@@ -1,26 +1,34 @@
-"""Restart-to-first-sweep bench: the ROADMAP item-2 headline number.
+"""Restart-to-first-sweep/-share bench: the ROADMAP item-2 headline.
 
 BENCH_r05's probe showed a fresh process paying 54-65 s before its
-first sweep; this module turns that observation into a tracked metric.
-A CHILD process is spawned cold (fresh interpreter, the real import
-path), builds the serving kernels over a small synthetic epoch —
-``BatchVerifier`` (the jitted header/share-verify program, the
-startup-critical compile on every backend) and ``SearchKernel`` — and
-runs one verify batch plus one nonce sweep.  The parent's wall clock
-from spawn to the child's completion line IS ``startup_to_first_sweep_s``.
+first sweep — and a "warm" restart with the JAX persistent compile cache
+LOSING to a cold one (64.5 s vs 54.4 s).  This module turns both into
+tracked, assertable metrics.  A CHILD process is spawned cold (fresh
+interpreter, the real import path), builds the serving kernels over a
+small synthetic epoch and measures, in order:
 
-Run twice against one persistent-compile-cache directory, the second
-child measures the warm restart (``startup_to_first_sweep_warm_s``) —
-the number that must approach zero once the AOT cache work lands, and
-today documents exactly how little the cache helps.
+- ``startup_to_first_share_s`` — the POOL path: a synthetic share judged
+  through the real ``SharePipeline.validate_batch`` device path (the
+  ROADMAP "restart-to-first-share" number; the judged verdict is
+  ``bad-mix``, which still runs the full device verify);
+- ``startup_first_verify_s`` — a direct ``BatchVerifier.hash_batch``;
+- ``startup_to_first_sweep_s`` — one ``SearchKernel`` nonce sweep;
+- ``steady_new_compiles`` — a second share + verify + sweep at the SAME
+  bucketed shapes must record ZERO new ``nodexa_jit_compiles_total``
+  increments: post-warmup steady state compiles nothing, or the shape
+  discipline regressed.
 
-The child also asserts the compile-attribution ledger fired: a cold
-process must report per-kernel ``nodexa_jit_compiles_total`` entries,
-pinning the ops-layer wiring end to end.
+Run twice against one persistent cache directory, the second child
+measures the warm restart.  With the AOT executable artifacts
+(ops/compile_cache) the warm child deserializes the kernels instead of
+re-tracing/lowering/compiling them, so warm must now strictly BEAT cold
+(``--assert-warm`` gates it; the old inversion is the regression this
+bench exists to catch).
 
-CLI (the ci_gate observability stage):
+CLI (the ci_gate observability + cold-start stages):
 
   python -m nodexa_chain_core_tpu.bench.startup --skip-warm --assert-finite
+  python -m nodexa_chain_core_tpu.bench.startup --assert-warm
 """
 
 from __future__ import annotations
@@ -48,22 +56,78 @@ t_import = time.perf_counter() - t0
 l1 = np.zeros(4096, np.uint32)
 dag = np.zeros(({rows}, 64), np.uint32)
 verifier = BatchVerifier(l1, dag)
+
+# pool path FIRST (restart-to-first-share): a stub node wires the real
+# SharePipeline onto this verifier; the share's device verify is the
+# startup-critical compile, judged verdict bad-mix (mix=0 never matches)
+from nodexa_chain_core_tpu.pool.shares import Share, SharePipeline
+
+class _Mgr:
+    def verifier(self, epoch):
+        return verifier
+
+class _Obj:
+    pass
+
+node = _Obj()
+node.epoch_manager = _Mgr()
+node.mesh_backend = None
+pipe = SharePipeline(node)
+job = _Obj()
+job.epoch = 0
+job.height = {height}
+job.header_hash_disp = bytes(range(32))
+job.header_hash_le = int.from_bytes(bytes(range(32))[::-1], "little")
+job.target = 0
+
+def _judged(verdicts):
+    def on_result(s, ok, reason):
+        verdicts.append(reason)
+    return on_result
+
+v1 = []
+pipe.validate_batch(
+    [Share(None, 1, "bench", job, 0xC0FFEE, 0, 1 << 255, _judged(v1))])
+assert v1, "share was not judged"
+t_share = time.perf_counter() - t0
+
 verifier.hash_batch([bytes(range(32))], [0xC0FFEE], [{height}])
 t_verify = time.perf_counter() - t0
 kern = SearchKernel.from_verifier(verifier)
 kern.sweep(bytes(range(32)), {height}, 1, 0, {batch})
 t_sweep = time.perf_counter() - t0
+
 from nodexa_chain_core_tpu.telemetry import g_metrics
 c = g_metrics.get("nodexa_jit_compiles_total")
 kernels = sorted({{dict(k).get("kernel") for k, _ in c.collect()}}) if c else []
 total = sum(v for _, v in c.collect()) if c else 0
 assert total >= 1, "cold process recorded no jit compiles"
+
+# post-warmup steady state: the SAME bucketed shapes again must compile
+# NOTHING — zero unexpected nodexa_jit_compiles_total increments across
+# the share/verify/sweep kernels, or the shape discipline regressed
+v2 = []
+pipe.validate_batch(
+    [Share(None, 2, "bench", job, 0xC0FFEF, 0, 1 << 255, _judged(v2))])
+verifier.hash_batch([bytes(range(32))], [0xC0FFEE], [{height}])
+kern.sweep(bytes(range(32)), {height}, 1, 0, {batch})
+steady = (sum(v for _, v in c.collect()) if c else 0) - total
+
+a = g_metrics.get("nodexa_aot_artifacts_total")
+aot = {{}}
+if a:
+    for k, v in a.collect():
+        r = dict(k).get("result")
+        aot[r] = aot.get(r, 0) + int(v)
 print("STARTUP_CHILD", __import__("json").dumps({{
     "import_s": round(t_import, 3),
+    "first_share_s": round(t_share, 3),
     "first_verify_s": round(t_verify, 3),
     "first_sweep_s": round(t_sweep, 3),
     "jit_compiles": int(total),
     "jit_kernels": kernels,
+    "steady_new_compiles": int(steady),
+    "aot": aot,
 }}))
 """
 
@@ -99,22 +163,30 @@ def _run_child(cache_dir: str, rows: int = 256, batch: int = 64,
 
 def measure(skip_warm: bool = False, rows: int = 256,
             batch: int = 64) -> dict:
-    """Cold (and optionally warm) restart-to-first-sweep, in seconds."""
+    """Cold (and optionally warm) restart-to-first-sweep/-share, in
+    seconds, plus the steady-state compile counts."""
     cache = tempfile.mkdtemp(prefix="nxk_startup_jit_")
     try:
         cold = _run_child(cache, rows=rows, batch=batch)
         out = {
             "startup_to_first_sweep_s": cold["total_s"],
+            "startup_to_first_share_s": cold["first_share_s"],
             "startup_import_s": cold["import_s"],
             "startup_first_verify_s": cold["first_verify_s"],
             "startup_jit_compiles": cold["jit_compiles"],
             "startup_jit_kernels": cold["jit_kernels"],
+            "startup_steady_new_compiles": cold["steady_new_compiles"],
+            "startup_aot": cold.get("aot", {}),
         }
         if not skip_warm:
             warm = _run_child(cache, rows=rows, batch=batch)
             out["startup_to_first_sweep_warm_s"] = warm["total_s"]
+            out["startup_to_first_share_warm_s"] = warm["first_share_s"]
             out["startup_warm_vs_cold"] = round(
                 warm["total_s"] / max(cold["total_s"], 1e-9), 3)
+            out["startup_warm_steady_new_compiles"] = (
+                warm["steady_new_compiles"])
+            out["startup_warm_aot"] = warm.get("aot", {})
         return out
     finally:
         shutil.rmtree(cache, ignore_errors=True)
@@ -133,10 +205,18 @@ def main(argv=None) -> int:
                     help="fail unless startup_to_first_sweep_s is a "
                          "finite positive number and the cold child "
                          "recorded per-kernel jit compiles")
+    ap.add_argument("--assert-warm", action="store_true",
+                    help="fail unless the warm restart strictly beats "
+                         "the cold one, stays under --warm-ceiling of "
+                         "it, restored AOT artifacts, and BOTH children "
+                         "recorded zero steady-state compiles")
+    ap.add_argument("--warm-ceiling", type=float, default=0.6,
+                    help="max allowed warm/cold ratio (default 0.6; the "
+                         "acceptance target is 0.5 plus noise headroom)")
     args = ap.parse_args(argv)
 
-    res = measure(skip_warm=args.skip_warm, rows=args.rows,
-                  batch=args.batch)
+    res = measure(skip_warm=args.skip_warm and not args.assert_warm,
+                  rows=args.rows, batch=args.batch)
     print(json.dumps(res))
     if args.assert_finite:
         v = res["startup_to_first_sweep_s"]
@@ -145,10 +225,39 @@ def main(argv=None) -> int:
         assert res["startup_jit_compiles"] >= 1, (
             "cold child recorded no jit compiles — the compile "
             "attribution wiring regressed")
-        print(f"startup bench OK: first sweep in {v:.1f}s, "
+        print(f"startup bench OK: first sweep in {v:.1f}s, first share "
+              f"in {res['startup_to_first_share_s']:.1f}s, "
               f"{res['startup_jit_compiles']} attributed compiles "
               f"({', '.join(res['startup_jit_kernels'])})",
               file=sys.stderr)
+    if args.assert_warm:
+        # explicit raises, not assert: the gate must also gate under -O
+        cold = res["startup_to_first_sweep_s"]
+        warm = res["startup_to_first_sweep_warm_s"]
+        gates = (
+            (warm < cold,
+             f"warm restart {warm:.1f}s is not strictly faster than "
+             f"cold {cold:.1f}s — the BENCH_r05 inversion is back"),
+            (warm <= args.warm_ceiling * cold,
+             f"warm restart {warm:.1f}s exceeds the "
+             f"{args.warm_ceiling:.2f}x ceiling of cold {cold:.1f}s"),
+            (res.get("startup_warm_aot", {}).get("restored", 0) >= 1,
+             "warm child restored no AOT artifacts — the executable "
+             "serialization path regressed to re-compiling"),
+            (res["startup_steady_new_compiles"] == 0
+             and res["startup_warm_steady_new_compiles"] == 0,
+             f"steady-state compiles not zero (cold "
+             f"{res['startup_steady_new_compiles']}, warm "
+             f"{res['startup_warm_steady_new_compiles']}) — a shape "
+             "escaped the bucket discipline"),
+        )
+        for ok, msg in gates:
+            if not ok:
+                raise SystemExit(f"cold-start AOT cache FAILED: {msg}")
+        print(f"cold-start AOT cache OK: warm {warm:.1f}s vs cold "
+              f"{cold:.1f}s ({res['startup_warm_vs_cold']}x), "
+              f"{res['startup_warm_aot'].get('restored', 0)} artifacts "
+              f"restored, zero steady-state compiles", file=sys.stderr)
     return 0
 
 

@@ -229,10 +229,11 @@ def _hybrid_searcher(verifier, fallback_batch: int):
         searcher = getattr(verifier, "_hybrid_search", None)
         if searcher is None or searcher.fallback_batch != fallback_batch:
             from ..ops.progpow_search import HybridSearch
-            from ..utils.jitcache import enable_persistent_cache
 
-            # per-period kernel compiles persist across miner restarts
-            enable_persistent_cache()
+            # compile persistence (XLA cache + AOT artifacts) is enabled
+            # at daemon startup (node/daemon.py compile_warmup stage, so
+            # verify/share/DAG kernels benefit too, not just this miner
+            # path) or explicitly by bench rigs — not lazily here
             searcher = HybridSearch(verifier, fallback_batch=fallback_batch)
             verifier._hybrid_search = searcher
         return searcher

@@ -287,6 +287,19 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     # (parallel/backend.py) shards header verify, the miner's nonce
     # sweeps, and pool share validation across all of them; -meshshape
     # pins the (headers x lanes) grid, -tpudevices caps the device count.
+    # durable compile caches BEFORE any device kernel can compile: the
+    # persistent XLA cache plus the AOT executable artifact store
+    # (ops/compile_cache) serve EVERY device kernel — kawpow verify/
+    # shares/DAG build AND the sha256d-era serving kernels — not just
+    # the miner path that used to enable them lazily (-jitcache=0 opts
+    # out; deliberately OUTSIDE the kawpow gate below so non-kawpow
+    # chains keep compile persistence too)
+    if g_args.get_bool("jitcache", True):
+        from ..utils.jitcache import enable_persistent_cache
+
+        jit_dir = g_args.get("jitcachedir", "")
+        enable_persistent_cache(jit_dir or None)
+
     if node.params.consensus.kawpow_activation_time < (1 << 62):
         with g_startup.stage("mesh_init"):
             from .epoch_manager import EpochManager
@@ -323,6 +336,34 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
             _warm_epochs()
             node.scheduler.schedule_every(_warm_epochs, 60.0)
+
+    # eager kernel prewarm: restore-or-build the declared shape
+    # buckets BEFORE the pool/miner/RPC stages open, then arm audit
+    # mode (only when something actually warmed) — any later compile
+    # at an unwarmed bucket is a counted shape-discipline regression
+    # (nodexa_compile_unexpected_total), never an error.  -warmupwait
+    # bounds how long to wait for the background epoch slab (default
+    # 0: warm only if already resident); -warmbuckets picks the batch
+    # buckets; -compileaudit=0 leaves audit off.
+    if g_args.get_bool("jitcache", True):
+        with g_startup.stage("compile_warmup"):
+            from ..ops.compile_cache import daemon_warmup
+
+            try:
+                warm_buckets = tuple(
+                    int(b) for b in
+                    g_args.get("warmbuckets", "64").split(",") if b)
+                warmup_wait = float(g_args.get("warmupwait", "0") or 0)
+            except ValueError:
+                raise SystemExit(
+                    "Error: -warmbuckets wants a comma-separated list "
+                    "of batch sizes (e.g. -warmbuckets=64,2048) and "
+                    "-warmupwait a number of seconds")
+            daemon_warmup(
+                node,
+                wait_s=warmup_wait,
+                buckets=warm_buckets,
+                audit=g_args.get_bool("compileaudit", True))
 
     # Step 8: wallet
     if not g_args.get_bool("disablewallet"):

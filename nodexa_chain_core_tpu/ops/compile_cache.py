@@ -1,0 +1,553 @@
+"""AOT compile cache: the single compile choke point (ROADMAP item 2).
+
+BENCH_r05's restart probe made cold start a headline problem: a fresh
+process paid 54.4 s to its first sweep and a "warm" restart with the JAX
+persistent compile cache was *slower* (64.5 s) than an in-process cold
+compile.  The audit (README "Cold start & AOT cache") found the
+persistent cache only skips the XLA backend compile — every warm process
+still pays full Python tracing + StableHLO lowering per kernel (measured
+~2.4 s of the ~8.7 s verify-kernel build on this image, and a service
+round trip per lookup on remote-compile backends), and with
+``jax_persistent_cache_min_compile_time_secs=0`` hundreds of trivial
+compiles each paid a key-fingerprint + disk read that costs more than
+recompiling them.  Shape discipline was not the in-bench culprit (the
+probe reuses identical shapes) but unpinned shapes multiply the artifact
+set in production, so both fixes live here:
+
+- **Shape discipline.**  Every hot kernel family declares its bucket set
+  (the same ``shape_bucket`` labels the PR-8 compile-attribution ledger
+  uses).  Call sites pad to the bucket, so each (kernel, bucket, mesh)
+  pair has exactly ONE lowering per machine instead of one per process
+  per ad-hoc batch size.
+
+- **AOT artifact serialization.**  Kernels stage through
+  ``jit(fn).lower(shaped_avals).compile()`` and the serialized XLA
+  executable (``jax.experimental.serialize_executable`` — probed once,
+  fail closed to the plain JIT path) persists on disk keyed on (kernel,
+  jax/jaxlib/XLA fingerprint, aval signature, static key incl. mesh
+  shape, donation/layout signature).  A warm restart deserializes the
+  executable directly — no tracing, no lowering, no compile.  Corrupt or
+  stale artifacts are discarded and counted, never trusted.
+
+- **Warmup ledger + audit.**  ``daemon_warmup`` restores-or-builds the
+  configured buckets during the daemon's ``compile_warmup`` boot stage
+  (visible in ``getstartupinfo``); ``seal_warmup`` then arms audit mode,
+  after which any further compile is logged and counted on
+  ``nodexa_compile_unexpected_total{kernel,shape_bucket}`` as a
+  shape-discipline regression.
+
+Consumers: ``ops.progpow_jax.BatchVerifier`` (verify + scan-tier search;
+also the pool share batch and headers sync, which route through it),
+``ops.progpow_search.SearchKernel`` (per-period fast tier),
+``ops.ethash_dag_jax.DagBuilder`` (DAG build), and
+``parallel.pow_search`` (sha256d header verify + midstate search).  The
+sighash/ECDSA batch path is the native C++ engine (no XLA compile), so
+it needs no bucket here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..telemetry import g_metrics
+from ..telemetry.compileattr import compile_span
+from ..telemetry.flight_recorder import record_event
+from ..utils.logging import log_printf
+
+ARTIFACT_VERSION = "nxk-aot-1"
+
+# ------------------------------------------------------ declared buckets
+#
+# The shape-bucket spec: every hot kernel family pins its call shapes to
+# one of these, so the per-machine artifact set stays small and a warm
+# restart restores a handful of executables, not an open-ended set.
+
+# verify / scan-search / pool-share batches (BatchVerifier): small
+# (mining slices, pool micro-batches, tests), the 2000-header HEADERS
+# sync shape, and a deep mining sweep
+BATCH_BUCKETS = (64, 2048, 32768)
+# padded per-batch period-plan table sizes (BatchVerifier)
+PERIOD_BUCKETS = (32, 688)
+# sha256d header-verify batches (parallel.pow_search)
+HEADER_BATCH_BUCKETS = (64, 512, 2048)
+# DAG slab build launches (DagBuilder.build_rows): powers of two so the
+# padded remainder launch of an epoch build wastes at most 2x compute
+DAG_ROWS_BUCKETS = tuple(64 << i for i in range(13))  # 64 .. 262144
+
+# kernel family -> the declared shape_bucket label set; labels outside
+# this set are off-bucket (a shape-discipline violation worth counting
+# even before audit mode arms).  Kernels not listed are exempt.
+KERNEL_BUCKETS: Dict[str, frozenset] = {
+    "progpow.verify": frozenset(
+        f"{b}x{p}" for b in BATCH_BUCKETS for p in PERIOD_BUCKETS),
+    "progpow.search_scan": frozenset(
+        f"{b}x{p}" for b in BATCH_BUCKETS for p in PERIOD_BUCKETS),
+    "progpow.search_period": frozenset(str(b) for b in BATCH_BUCKETS),
+    "ethash.dag_build": frozenset(str(r) for r in DAG_ROWS_BUCKETS),
+    "sha256d.verify": frozenset(str(b) for b in HEADER_BATCH_BUCKETS),
+}
+
+
+def bucket_for(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest declared bucket >= n; n itself when it exceeds the
+    largest bucket (an off-bucket shape: it still runs, the audit layer
+    counts it)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+def mesh_sig(mesh) -> str:
+    """Stable mesh identity for artifact keys: axis names x extents and
+    the device kind (a 2x4 v5e mesh must never feed a 1x8 artifact)."""
+    if mesh is None:
+        return "none"
+    try:
+        axes = "x".join(f"{a}{mesh.shape[a]}" for a in mesh.axis_names)
+        kind = getattr(mesh.devices.flat[0], "device_kind", "?")
+        return f"{axes}:{kind}"
+    except Exception:  # pragma: no cover - defensive
+        return "mesh-unknown"
+
+
+_fingerprint: Optional[str] = None
+
+
+def fingerprint() -> str:
+    """Toolchain identity an artifact is only valid under: jax + jaxlib
+    versions, backend platform and its XLA runtime version, and the
+    device kind.  Any change invalidates every key (the artifacts are
+    simply never found; a GC policy can reap them by age)."""
+    global _fingerprint
+    if _fingerprint is None:
+        import jax
+
+        try:
+            import jaxlib
+
+            jl = jaxlib.__version__
+        except Exception:  # pragma: no cover - vendored jaxlib
+            jl = "unknown"
+        try:
+            backend = jax.extend.backend.get_backend()
+            plat = f"{backend.platform}:{backend.platform_version}"
+            kind = jax.local_devices()[0].device_kind
+        except Exception:  # pragma: no cover - backend init failure
+            plat, kind = "unknown", "unknown"
+        raw = f"{jax.__version__}|{jl}|{plat}|{kind}"
+        _fingerprint = hashlib.sha256(raw.encode()).hexdigest()[:16]
+    return _fingerprint
+
+
+def _serialize_mod():
+    """The executable-serialization module, or None when this jax can't
+    (the probe the AOT path fails closed on)."""
+    try:
+        from jax.experimental import serialize_executable
+
+        return serialize_executable
+    except ImportError:  # pragma: no cover - older/newer jax
+        return None
+
+
+# ------------------------------------------------------------- telemetry
+
+_M_ARTIFACTS = g_metrics.counter(
+    "nodexa_aot_artifacts_total",
+    "AOT executable artifact outcomes (result=restored|built|corrupt|"
+    "stale|write_error|jit_fallback), labeled by kernel")
+_M_UNEXPECTED = g_metrics.counter(
+    "nodexa_compile_unexpected_total",
+    "Kernel compiles after warmup sealed (shape-discipline regressions), "
+    "labeled by kernel and shape_bucket")
+_M_OFFBUCKET = g_metrics.counter(
+    "nodexa_compile_offbucket_total",
+    "Compiles whose shape_bucket is outside the kernel's declared "
+    "bucket set")
+_M_RESTORE_AGE = g_metrics.gauge(
+    "nodexa_aot_restore_age_seconds",
+    "Age of the most recently restored AOT artifact at restore time")
+
+
+class CompileCache:
+    """Artifact store + warmup/audit ledger behind every CachedKernel.
+
+    One process-global instance (``g_compile_cache``); tests construct
+    their own to keep artifact state isolated.
+    """
+
+    def __init__(self) -> None:
+        self._dir: Optional[str] = None
+        self._lock = threading.Lock()
+        # mirror of the artifact counters for cheap RPC snapshots
+        self.stats: Dict[str, int] = {}
+        self._audit = False
+        self._expected: set = set()  # {(kernel, label)} sealed at warmup
+        self._unexpected = 0
+        self._warmup_info: dict = {}
+
+    # -- configuration -----------------------------------------------------
+
+    def enable(self, aot_dir: Optional[str]) -> Optional[str]:
+        """Point the artifact store at a durable directory (None
+        disables persistence; compiles fall back to plain JIT) and reap
+        artifacts older than $NXK_AOT_CACHE_MAX_AGE_DAYS (default 30) —
+        per-epoch aval signatures and toolchain-fingerprint changes mint
+        new keys nothing ever re-derives, so without age GC the store
+        grows without bound."""
+        if aot_dir is not None:
+            os.makedirs(aot_dir, exist_ok=True)
+            try:
+                max_age = 86400.0 * float(
+                    os.environ.get("NXK_AOT_CACHE_MAX_AGE_DAYS", "30"))
+                cutoff = time.time() - max_age
+                for root, _dirs, files in os.walk(aot_dir):
+                    for f in files:
+                        p = os.path.join(root, f)
+                        if os.path.getmtime(p) < cutoff:
+                            os.unlink(p)
+                            with self._lock:
+                                self.stats["expired"] = (
+                                    self.stats.get("expired", 0) + 1)
+            except OSError:  # pragma: no cover - racing reapers
+                pass
+        self._dir = aot_dir
+        return aot_dir
+
+    @property
+    def dir(self) -> Optional[str]:
+        return self._dir
+
+    def wrap(self, kernel: str, fn: Callable, label=None,
+             static_key: Tuple = ()) -> "CachedKernel":
+        """The choke point: returns the cached-kernel callable every hot
+        entry point routes through.  ``fn`` is the un-jitted callable;
+        ``label`` is a shape_bucket string or a fn(args)->str;
+        ``static_key`` carries every non-aval axis that forces a fresh
+        lowering (period constants, mesh signature, static batch)."""
+        return CachedKernel(self, kernel, fn, label=label,
+                            static_key=static_key)
+
+    # -- warmup ledger / audit --------------------------------------------
+
+    def seal_warmup(self, audit: bool = True) -> None:
+        """Mark every (kernel, bucket) compiled so far as expected and —
+        when ``audit`` — treat any later compile as a shape-discipline
+        regression (counted + flight-recorded, never fatal)."""
+        with self._lock:
+            self._audit = bool(audit)
+
+    @property
+    def audit_armed(self) -> bool:
+        return self._audit
+
+    @property
+    def unexpected_compiles(self) -> int:
+        return self._unexpected
+
+    def note_compile(self, kernel: str, label: str) -> None:
+        """Ledger entry for one real compile/restore window (called by
+        CachedKernel and the eager-path CompileTracker shim)."""
+        declared = KERNEL_BUCKETS.get(kernel)
+        if declared is not None and label and label not in declared:
+            _M_OFFBUCKET.inc(kernel=kernel, shape_bucket=label)
+        with self._lock:
+            known = (kernel, label) in self._expected
+            # record the label either way: pre-seal it builds the
+            # expected set, post-seal it dedups the alarm — one alarm
+            # per (kernel, bucket), not one per period/epoch rotation
+            # minting a fresh executable at the same label
+            self._expected.add((kernel, label))
+            if not self._audit or known:
+                return
+            self._unexpected += 1
+        _M_UNEXPECTED.inc(kernel=kernel, shape_bucket=label)
+        record_event("unexpected_compile", kernel=kernel,
+                     shape_bucket=label)
+        log_printf(
+            "compile_cache: UNEXPECTED post-warmup compile %s[%s] — a "
+            "shape escaped the bucket discipline or warmup missed a "
+            "bucket", kernel, label)
+
+    def _count(self, kernel: str, result: str) -> None:
+        _M_ARTIFACTS.inc(kernel=kernel, result=result)
+        with self._lock:
+            self.stats[result] = self.stats.get(result, 0) + 1
+
+    def snapshot(self) -> dict:
+        """getstartupinfo payload."""
+        with self._lock:
+            return {
+                "aot_dir": self._dir,
+                "enabled": self._dir is not None,
+                "artifacts": dict(self.stats),
+                "audit_armed": self._audit,
+                "unexpected_compiles": self._unexpected,
+                "expected_buckets": sorted(
+                    f"{k}[{b}]" for k, b in self._expected),
+                "warmup": dict(self._warmup_info),
+            }
+
+    # -- artifact store ----------------------------------------------------
+
+    def _path(self, kernel: str, key_hash: str) -> Optional[str]:
+        if self._dir is None:
+            return None
+        return os.path.join(self._dir, kernel, key_hash + ".aot")
+
+    def restore(self, kernel: str, key_hash: str):
+        """Deserialize a persisted executable, or None.  A corrupt or
+        stale artifact is deleted and counted — never trusted."""
+        path = self._path(kernel, key_hash)
+        if path is None or not os.path.exists(path):
+            return None
+        se = _serialize_mod()
+        if se is None:
+            return None
+        try:
+            with open(path, "rb") as fh:
+                blob = pickle.loads(fh.read())
+            if (blob.get("magic") != ARTIFACT_VERSION
+                    or blob.get("kernel") != kernel
+                    or blob.get("fingerprint") != fingerprint()):
+                self._count(kernel, "stale")
+                os.unlink(path)
+                return None
+            exe = se.deserialize_and_load(*blob["payload"])
+        except Exception as e:  # corrupt pickle/payload, runtime reject
+            self._count(kernel, "corrupt")
+            log_printf("compile_cache: discarding corrupt artifact %s "
+                       "(%r)", path, e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        self._count(kernel, "restored")
+        try:
+            _M_RESTORE_AGE.set(max(0.0, time.time()
+                                   - os.path.getmtime(path)))
+        except OSError:
+            pass
+        return exe
+
+    def persist(self, kernel: str, key_hash: str, compiled) -> None:
+        path = self._path(kernel, key_hash)
+        if path is None:
+            return
+        se = _serialize_mod()
+        if se is None:
+            self._count(kernel, "unsupported")
+            return
+        try:
+            payload = se.serialize(compiled)
+            blob = pickle.dumps({
+                "magic": ARTIFACT_VERSION,
+                "kernel": kernel,
+                "fingerprint": fingerprint(),
+                "payload": payload,
+            })
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)  # atomic: multi-process safe
+        except Exception as e:  # serialization gap on this backend
+            self._count(kernel, "write_error")
+            log_printf("compile_cache: could not persist %s[%s]: %r",
+                       kernel, key_hash[:12], e)
+
+
+class CachedKernel:
+    """One kernel family's per-shape executable cache.
+
+    First call per aval signature acquires an executable — restored from
+    the artifact store when possible, else ``lower().compile()`` and
+    persisted — inside a :func:`compile_span` attribution window (so the
+    PR-8 ``nodexa_jit_compiles_total`` ledger keeps working unchanged).
+    Steady-state calls are one dict probe ahead of the executable.
+
+    Anything that fails (no serialization support, un-lowerable callable,
+    a restored executable rejecting its first batch) falls closed to the
+    plain ``jax.jit`` dispatch path, counted as ``jit_fallback``.
+    """
+
+    def __init__(self, cache: CompileCache, kernel: str, fn: Callable,
+                 label=None, static_key: Tuple = ()):
+        import jax
+
+        self.cache = cache
+        self.kernel = kernel
+        self._jit = jax.jit(fn)
+        self._label = label
+        self._static_key = tuple(static_key)
+        self._exe: Dict[Tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    # -- keys --------------------------------------------------------------
+
+    @staticmethod
+    def _aval_key(args) -> Tuple:
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (
+            tuple((tuple(np.shape(x)), str(getattr(x, "dtype", type(x))))
+                  for x in leaves),
+            str(treedef),
+        )
+
+    def _key_hash(self, key: Tuple) -> str:
+        # donation/layout signature pinned explicitly: these kernels
+        # donate nothing and use default layouts today — encoding that
+        # means a future donating variant can never alias an old artifact
+        raw = repr((ARTIFACT_VERSION, self.kernel, self._static_key, key,
+                    "donate:none", "layout:default", fingerprint()))
+        return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+    def bucket_label(self, args) -> str:
+        if callable(self._label):
+            try:
+                return str(self._label(args))
+            except Exception:  # pragma: no cover - label fn bug
+                return ""
+        return self._label or ""
+
+    # -- dispatch ----------------------------------------------------------
+
+    def __call__(self, *args):
+        key = self._aval_key(args)
+        exe = self._exe.get(key)
+        if exe is not None:
+            return exe(*args)
+        return self._first_call(key, args)
+
+    def _first_call(self, key: Tuple, args):
+        # the lock serializes concurrent first compiles of one shape
+        # (HybridSearch warms on background threads while the pool and
+        # sync paths share the same verifier) — holding it across the
+        # build is intentional, racing threads would compile twice
+        with self._lock:
+            exe = self._exe.get(key)
+            if exe is not None:
+                return exe(*args)
+            label = self.bucket_label(args)
+            self.cache.note_compile(self.kernel, label)
+            with compile_span(self.kernel, label):
+                exe, out = self._acquire_and_run(key, args)
+            self._exe[key] = exe
+            return out
+
+    def _acquire_and_run(self, key: Tuple, args):
+        import jax
+
+        key_hash = self._key_hash(key)
+        exe = self.cache.restore(self.kernel, key_hash)
+        built = False
+        if exe is None:
+            try:
+                avals = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        jax.numpy.shape(x), jax.numpy.result_type(x)),
+                    args)
+                exe = self._jit.lower(*avals).compile()
+                built = True
+            except Exception as e:
+                # fail CLOSED to the plain jit path: AOT is an
+                # accelerant, never a correctness gate
+                self.cache._count(self.kernel, "jit_fallback")
+                log_printf("compile_cache: %s AOT staging failed (%r); "
+                           "plain jit path", self.kernel, e)
+                return self._jit, self._jit(*args)
+        try:
+            out = exe(*args)
+        except Exception as e:
+            # a restored/compiled executable rejecting its own avals
+            # (layout/weak-type drift): discard it, run the jit path
+            self.cache._count(self.kernel, "jit_fallback")
+            log_printf("compile_cache: %s executable rejected its first "
+                       "batch (%r); plain jit path", self.kernel, e)
+            return self._jit, self._jit(*args)
+        if built:
+            self.cache._count(self.kernel, "built")
+            self.cache.persist(self.kernel, key_hash, exe)
+        return exe, out
+
+
+g_compile_cache = CompileCache()
+
+
+# --------------------------------------------------------- daemon warmup
+
+
+def daemon_warmup(node, wait_s: float = 0.0,
+                  buckets: Tuple[int, ...] = (64,),
+                  audit: bool = True) -> dict:
+    """The ``compile_warmup`` boot stage: restore-or-build the configured
+    verify/search buckets for the tip epoch before the RPC/pool/miner
+    stages open, then seal the warmup ledger (arming audit mode).
+
+    The epoch slab itself builds on the EpochManager's background thread
+    (the PR-6 contract keeps multi-minute slab builds off the blocking
+    boot path); ``wait_s`` bounds how long warmup will wait for that
+    verifier — 0 warms only if one is already resident.  Returns the
+    summary that lands in ``getstartupinfo``.
+    """
+    info: dict = {"warmed_buckets": [], "waited_s": 0.0,
+                  "verifier_ready": False}
+    mgr = getattr(node, "epoch_manager", None)
+    tip = node.chainstate.tip() if node.chainstate is not None else None
+    sched = node.params.algo_schedule
+    verifier = None
+    height = 0
+    if (mgr is not None and tip is not None
+            and sched.is_kawpow(tip.header.time)):
+        from ..crypto.kawpow import epoch_number
+
+        epoch = epoch_number(tip.height)
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, wait_s)
+        while True:
+            verifier = mgr.verifier(epoch)
+            if verifier is not None or time.monotonic() >= deadline:
+                break
+            time.sleep(0.25)
+        info["waited_s"] = round(time.monotonic() - t0, 3)
+        height = tip.height + 1
+    if verifier is not None:
+        info["verifier_ready"] = True
+        probe = bytes(32)
+        for b in buckets:
+            try:
+                # one padded batch per bucket: hash_batch pads to the
+                # bucket internally, so b entries pin bucket b exactly;
+                # the impossible-target search pins the scan-tier sweep
+                verifier.hash_batch([probe] * b, [0] * b, [height] * b)
+                verifier.search(probe, height, 1, batch=b)
+                info["warmed_buckets"].append(b)
+            except Exception as e:  # pragma: no cover - device hiccup
+                log_printf("compile_cache: warmup bucket %d failed: %r",
+                           b, e)
+    # arm audit only when warmup actually warmed: sealing an EMPTY
+    # ledger (slab still building in the background, or a non-kawpow
+    # chain with nothing to warm) would flag every legitimate first
+    # compile as a regression — permanent false alarms on a healthy
+    # node.  The off-bucket counter stays live either way.
+    effective_audit = audit and bool(info["warmed_buckets"])
+    g_compile_cache.seal_warmup(audit=effective_audit)
+    g_compile_cache._warmup_info = info
+    log_printf(
+        "compile_cache: warmup %s (buckets %s, waited %.1fs); audit %s",
+        "warmed " + str(info["warmed_buckets"]) if info["warmed_buckets"]
+        else "no resident verifier",
+        list(buckets), info["waited_s"],
+        "armed" if effective_audit else
+        ("off (nothing warmed)" if audit else "off"))
+    return info

@@ -191,11 +191,16 @@ class DagBuilder:
         assert light.ndim == 2 and light.shape[1] == 16
         self.light = jnp.asarray(light, _U32)
         # jit on every backend: the tensor/scan keccak keeps XLA:CPU
-        # compiles sane (the unrolled per-lane form did not)
-        self._fn = jax.jit(dataset_items_512)
-        from ..telemetry.compileattr import CompileTracker
+        # compiles sane (the unrolled per-lane form did not).  Staged
+        # through the AOT choke point so a restart restores the build
+        # executable instead of re-tracing the 512-parent scan; the
+        # light-cache shape rides the aval key, so each epoch size gets
+        # its own artifact while same-size epochs share one.
+        from .compile_cache import g_compile_cache
 
-        self._compiles = CompileTracker()
+        self._fn = g_compile_cache.wrap(
+            "ethash.dag_build", dataset_items_512,
+            label=lambda args: str(args[1].shape[0] // 4))
 
     @classmethod
     def from_epoch(cls, epoch: int) -> "DagBuilder":
@@ -207,13 +212,19 @@ class DagBuilder:
         return cls(light)
 
     def build_rows(self, start_row: int, rows: int) -> np.ndarray:
-        """Slab rows [start_row, start_row+rows) as (rows, 64) u32."""
-        idx = (np.arange(rows * 4, dtype=np.uint32)
+        """Slab rows [start_row, start_row+rows) as (rows, 64) u32.
+
+        The launch is padded to a declared row bucket (shape discipline:
+        one lowering per bucket per machine, not one per remainder); the
+        surplus items index past the requested range, which is harmless
+        — item generation wraps via ``% n`` — and are sliced off."""
+        from .compile_cache import DAG_ROWS_BUCKETS, bucket_for
+
+        bb = bucket_for(rows, DAG_ROWS_BUCKETS)
+        idx = (np.arange(bb * 4, dtype=np.uint32)
                + np.uint32(start_row * 4))
-        out = self._compiles.run(
-            "ethash.dag_build", rows, str(rows),
-            self._fn, self.light, jnp.asarray(idx))
-        return np.asarray(out).reshape(rows, 64)
+        out = self._fn(self.light, jnp.asarray(idx))
+        return np.asarray(out)[: rows * 4].reshape(rows, 64)
 
     def build_slab(self, n2048: int, rows_per_launch: int = 262144,
                    progress=None) -> np.ndarray:

@@ -730,25 +730,35 @@ class BatchVerifier:
         self.dag = jnp.asarray(dag, dtype=_U32)
         self.mesh = mesh
         self._plan_cache: dict = {}
-        # compile attribution: the first dispatch of every (kernel,
-        # shape-bucket) pair lands on nodexa_jit_compiles_total /
-        # nodexa_jit_compile_seconds — the per-kernel ledger the restart
-        # cold-start audit (ROADMAP item 2) reads
-        from ..telemetry.compileattr import CompileTracker
-
-        self._compiles = CompileTracker()
         # jit everywhere, XLA:CPU included: with keccak_f800 in tensor/scan
         # form the whole-graph CPU compile is ~1 min per shape bucket and
         # steady-state batches run ~400x faster than the eager dispatch
         # loop (the r1/r2 eager-on-cpu fallback predated that fix; the old
         # unrolled per-lane keccak was what made XLA:CPU choke).
+        #
+        # Both entry points stage through the AOT compile choke point
+        # (ops/compile_cache): per-(shape, mesh) executables restore from
+        # disk on a warm restart — no re-trace, no re-lower, no compile —
+        # and every first acquire lands on the nodexa_jit_compiles_total
+        # ledger exactly as the old per-call tracker did.
+        from .compile_cache import g_compile_cache, mesh_sig
+
         hash_fn = kawpow_hash_batch
         if mesh is not None:
             hash_fn = self._shard_over_mesh(mesh)
-            self._jit_search = jax.jit(self._shard_search_over_mesh(mesh))
+            search_fn = self._shard_search_over_mesh(mesh)
         else:
-            self._jit_search = jax.jit(kawpow_search_batch)
-        self._jit = jax.jit(hash_fn)
+            search_fn = kawpow_search_batch
+        msig = ("mesh", mesh_sig(mesh))
+
+        def _label(args):  # (hw, nlo, nhi, plans, pidx, ...)
+            return f"{args[0].shape[0]}x{args[3].cache_src.shape[0]}"
+
+        self._jit = g_compile_cache.wrap(
+            "progpow.verify", hash_fn, label=_label, static_key=msig)
+        self._jit_search = g_compile_cache.wrap(
+            "progpow.search_scan", search_fn, label=_label,
+            static_key=msig)
 
     @staticmethod
     def _shard_over_mesh(mesh):
@@ -898,9 +908,11 @@ class BatchVerifier:
     # Shape buckets: every distinct (batch, periods) shape pair costs a
     # fresh XLA compile (~minutes on TPU), so batches and period tables are
     # padded to fixed sizes — small (mining/tests), the 2000-header
-    # HEADERS-message sync shape, and a deep mining sweep.
-    _BATCH_BUCKETS = (64, 2048, 32768)
-    _PERIOD_BUCKETS = (32, 688)
+    # HEADERS-message sync shape, and a deep mining sweep.  The bucket
+    # spec itself lives in ops/compile_cache (the one shape-discipline
+    # declaration the AOT artifact store and the audit layer share).
+    from .compile_cache import BATCH_BUCKETS as _BATCH_BUCKETS
+    from .compile_cache import PERIOD_BUCKETS as _PERIOD_BUCKETS
 
     @staticmethod
     def _bucket(n, buckets):
@@ -961,9 +973,7 @@ class BatchVerifier:
             [height // ref.PERIOD_LENGTH] * batch, bb
         )
         tw = target_swapped_words(target_le_int)
-        pb = int(plans[0].shape[0])
-        found, win, final, mix = self._compiles.run(
-            "progpow.search_scan", (bb, pb), f"{bb}x{pb}", self._jit_search,
+        found, win, final, mix = self._jit_search(
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), jnp.asarray(tw), self.l1, self.dag,
         )
@@ -1007,9 +1017,7 @@ class BatchVerifier:
             nhi[i] = (n >> 32) & 0xFFFFFFFF
         periods = [h // ref.PERIOD_LENGTH for h in heights]
         plans, pidx = self._plans_padded(periods, bb)
-        pb = int(plans[0].shape[0])
-        final, mix = self._compiles.run(
-            "progpow.verify", (bb, pb), f"{bb}x{pb}", self._jit,
+        final, mix = self._jit(
             jnp.asarray(hw), jnp.asarray(nlo), jnp.asarray(nhi), plans,
             jnp.asarray(pidx), self.l1, self.dag,
         )

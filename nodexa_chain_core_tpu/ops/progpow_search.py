@@ -575,11 +575,21 @@ class SearchKernel:
         with self._cache_lock:
             fn = self._jit_cache.pop(key, None)
             if fn is None:
+                from .compile_cache import g_compile_cache, mesh_sig
+
                 if self.mesh is not None:
                     # always jitted: the CPU variant is scan-form (small
-                    # graph), so XLA:CPU handles it fine under shard_map
-                    fn = jax.jit(_search_kernel_sharded(
-                        period, batch, self.mesh))
+                    # graph), so XLA:CPU handles it fine under shard_map.
+                    # The period selectors are baked into the graph as
+                    # constants, so the AOT artifact key must carry the
+                    # period explicitly — identical avals, different
+                    # program.
+                    fn = g_compile_cache.wrap(
+                        "progpow.search_period",
+                        _search_kernel_sharded(period, batch, self.mesh),
+                        label=str(batch),
+                        static_key=("period", period, batch,
+                                    mesh_sig(self.mesh)))
                 else:
                     fn = _search_kernel(period, batch)
                     # XLA:CPU cannot digest the ~17k-op unrolled mix
@@ -589,9 +599,12 @@ class SearchKernel:
                     # kernel's whole point is the unroll).  Eager CPU
                     # runs the identical trace op-by-op, which is what
                     # the correctness tests need; real backends get the
-                    # jit.
+                    # AOT-staged jit.
                     if jax.default_backend() != "cpu":
-                        fn = jax.jit(fn)
+                        fn = g_compile_cache.wrap(
+                            "progpow.search_period", fn,
+                            label=str(batch),
+                            static_key=("period", period, batch))
                 evictable = [
                     k for k in self._jit_cache if k not in self._pinned
                 ]
@@ -610,6 +623,19 @@ class SearchKernel:
         """
         period = height // ref.PERIOD_LENGTH
         fn = self._fn(period, batch)
+
+        def run(*args):
+            # CachedKernel (mesh / real-backend tiers) attributes its own
+            # compiles through the choke point; only the eager CPU path
+            # still needs the per-call tracker
+            from .compile_cache import CachedKernel
+
+            if isinstance(fn, CachedKernel):
+                return fn(*args)
+            return self._compiles.run(
+                "progpow.search_period", (period, batch), str(batch),
+                fn, *args)
+
         hw = jnp.asarray(np.frombuffer(header_hash[:32], dtype="<u4").copy())
         tw = jnp.asarray(pj.target_swapped_words(target_le_int))
         lo = _U32(start_nonce & 0xFFFFFFFF)
@@ -617,9 +643,7 @@ class SearchKernel:
         if self.mesh is not None:
             # one (found, local-win, final, mix) row per shard; take the
             # first shard that found a winner (lowest nonce range)
-            found, win, final, mix = self._compiles.run(
-                "progpow.search_period", (period, batch), str(batch),
-                fn, hw, lo, hi, tw, self.l1, self.dag)
+            found, win, final, mix = run(hw, lo, hi, tw, self.l1, self.dag)
             found = np.asarray(found)
             hits = np.nonzero(found)[0]
             if len(hits) == 0:
@@ -634,9 +658,7 @@ class SearchKernel:
                 pj.digest_words_to_le_int(np.asarray(final)[d]),
                 pj.digest_words_to_le_int(np.asarray(mix)[d]),
             )
-        final_all, mix_all = self._compiles.run(
-            "progpow.search_period", (period, batch), str(batch),
-            fn, hw, lo, hi, self.l1, self.dag)
+        final_all, mix_all = run(hw, lo, hi, self.l1, self.dag)
         found, win, final, mix = self._extract(final_all, mix_all, tw)
         if not bool(found):
             return None

@@ -66,6 +66,35 @@ def _search_jit(mid, tail3, nonce0, target_le, batch: int, mesh: Optional[Mesh])
     return found, nonces[idx], hash_le[idx]
 
 
+def _search_plain(batch: int):
+    """Single-device sha256d search body for the AOT choke point (the
+    mesh variant keeps the static-mesh jit above — sharded executables
+    carry device assignments that don't round-trip serialization on
+    every backend)."""
+
+    def fn(mid, tail3, nonce0, target_le):
+        return _search_jit.__wrapped__(mid, tail3, nonce0, target_le,
+                                       batch, None)
+
+    return fn
+
+
+_search_cached: dict = {}
+_verify_cached = None
+
+
+def _search_exe(batch: int):
+    exe = _search_cached.get(batch)
+    if exe is None:
+        from ..ops.compile_cache import g_compile_cache
+
+        exe = g_compile_cache.wrap(
+            "sha256d.search", _search_plain(batch), label=str(batch),
+            static_key=("batch", batch))
+        _search_cached[batch] = exe
+    return exe
+
+
 class Sha256dMiner:
     """Midstate-cached sharded nonce scanner for one header prefix."""
 
@@ -84,14 +113,22 @@ class Sha256dMiner:
     def scan(self, nonce0: int) -> Tuple[bool, int, int]:
         """Scan [nonce0, nonce0+batch). Returns (found, nonce, hash_int)."""
         t0 = time.perf_counter()
-        found, nonce, hash_le = _search_jit(
-            self._mid,
-            self._tail3,
-            jnp.uint32(nonce0 & 0xFFFFFFFF),
-            self._target,
-            self.batch,
-            self._mesh,
-        )
+        if self._mesh is None:
+            found, nonce, hash_le = _search_exe(self.batch)(
+                self._mid,
+                self._tail3,
+                jnp.uint32(nonce0 & 0xFFFFFFFF),
+                self._target,
+            )
+        else:
+            found, nonce, hash_le = _search_jit(
+                self._mid,
+                self._tail3,
+                jnp.uint32(nonce0 & 0xFFFFFFFF),
+                self._target,
+                self.batch,
+                self._mesh,
+            )
         found_host = bool(found)  # device sync point: batch is complete
         record_search_batch(
             time.perf_counter() - t0,
@@ -123,6 +160,13 @@ def _verify_jit(headers, target_le, mesh: Optional[Mesh]):
     return s256.le256_leq(hash_le, target_le), hash_le
 
 
+def _verify_fn(headers, target_le):
+    """Single-device sha256d header-verify body (AOT choke point)."""
+    digest = s256.sha256d_headers(headers)
+    hash_le = s256.digest_le_words(digest)
+    return s256.le256_leq(hash_le, target_le), hash_le
+
+
 def batch_verify_headers(
     headers80: list[bytes], target: int, mesh: Optional[Mesh] = None
 ):
@@ -131,11 +175,32 @@ def batch_verify_headers(
     Replaces the reference's one-at-a-time CheckProofOfWork calls during
     headers-first sync (ref src/validation.cpp ProcessNewBlockHeaders): a
     2000-header HEADERS message becomes one sharded device batch.
+
+    The batch is padded to a declared header bucket (shape discipline:
+    one lowering per bucket per machine, not one per message size) by
+    repeating the first header; the pad rows' verdicts are sliced off.
     """
-    words = jnp.stack([s256.header_bytes_to_words(h) for h in headers80])
-    ok, hash_le = _verify_jit(words, s256.target_to_le_words(target), mesh)
-    ok = jax.device_get(ok)
-    hashes = jax.device_get(hash_le)
+    from ..ops.compile_cache import HEADER_BATCH_BUCKETS, bucket_for
+
+    global _verify_cached
+    b = len(headers80)
+    bb = bucket_for(b, HEADER_BATCH_BUCKETS)
+    padded = headers80 + [headers80[0]] * (bb - b)
+    words = jnp.stack([s256.header_bytes_to_words(h) for h in padded])
+    if mesh is None:
+        if _verify_cached is None:
+            from ..ops.compile_cache import g_compile_cache
+
+            _verify_cached = g_compile_cache.wrap(
+                "sha256d.verify", _verify_fn,
+                label=lambda args: str(args[0].shape[0]))
+        ok, hash_le = _verify_cached(
+            words, s256.target_to_le_words(target))
+    else:
+        ok, hash_le = _verify_jit(
+            words, s256.target_to_le_words(target), mesh)
+    ok = jax.device_get(ok)[:b]
+    hashes = jax.device_get(hash_le)[:b]
     ints = [
         sum(int(limb) << (32 * j) for j, limb in enumerate(row)) for row in hashes
     ]

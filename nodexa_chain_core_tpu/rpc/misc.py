@@ -178,13 +178,24 @@ def dumpflightrecorder(node, params: List[Any]):
 
 def getstartupinfo(node, params: List[Any]):
     """Daemon boot attribution: per-stage durations (chainstate load,
-    self-check, mesh init, wallet, network, pool, rpc), one-shot marks
-    (first_device_call / first_sweep / first_share, elapsed from boot),
-    and ``startup_to_first_sweep_s`` — the restart-cost headline the
-    compilation-cache work is graded on."""
+    self-check, mesh init, compile warmup, wallet, network, pool, rpc),
+    one-shot marks (first_device_call / first_sweep / first_share,
+    elapsed from boot), ``startup_to_first_sweep_s`` — the restart-cost
+    headline the compilation-cache work is graded on — and the compile
+    caches: the active persistent XLA cache dir, the AOT artifact store
+    (restored/built/corrupt counts, warmed buckets) and the audit-mode
+    ledger of unexpected post-warmup compiles."""
+    from ..ops.compile_cache import g_compile_cache
     from ..telemetry import g_startup
+    from ..utils import jitcache
 
-    return g_startup.snapshot()
+    out = g_startup.snapshot()
+    cc = g_compile_cache.snapshot()
+    cc["persistent_cache_dir"] = jitcache.cache_dir()
+    cc["persistent_cache_hits"] = jitcache.hits
+    cc["persistent_cache_misses"] = jitcache.misses
+    out["compile_cache"] = cc
+    return out
 
 
 def getnodehealth(node, params: List[Any]):

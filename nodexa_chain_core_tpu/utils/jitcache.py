@@ -76,8 +76,15 @@ def _install_cache_listener() -> None:
     _listener_installed = True
 
 
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None (getstartupinfo)."""
+    return _enabled
+
+
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
-    """Point JAX's compilation cache at a durable directory.
+    """Point JAX's compilation cache at a durable directory and enable
+    the AOT executable-artifact store under it (``<dir>/aot`` — the
+    ops/compile_cache choke point this module is now the thin shim of).
 
     Priority: explicit arg > $NXK_JIT_CACHE > ~/.cache/nodexa_tpu_jit.
     Returns the directory in use."""
@@ -95,9 +102,21 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # persist EVERY compile: on a remote-compile backend (the axon
-    # tunnel) even a sub-second compile costs a multi-second service
-    # round trip, so a restart wants the trivial jits cached too
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # do NOT persist trivial compiles: the ROADMAP-2 restart audit found
+    # min_compile_time=0 is why the "warm" restart LOST to a cold one
+    # (BENCH_r05: 64.5 s vs 54.4 s) — hundreds of sub-threshold eager-op
+    # compiles each paid a key-fingerprint + disk read (+ a service
+    # round trip on remote-compile backends) that costs more than just
+    # recompiling them.  The big kernels now restart through serialized
+    # AOT executables (ops/compile_cache), which skip tracing/lowering
+    # entirely; this cache is the safety net for everything else.
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("NXK_JIT_CACHE_MIN_COMPILE_S", "0.5")))
+    # the AOT artifact store rides under the same durable root
+    from ..ops.compile_cache import g_compile_cache
+
+    if g_compile_cache.dir is None:
+        g_compile_cache.enable(os.path.join(cache_dir, "aot"))
     _enabled = cache_dir
     return cache_dir

@@ -1,0 +1,251 @@
+"""ops/compile_cache: bucket discipline, AOT artifact round-trips,
+key invalidation, and the warmup/audit ledger (ROADMAP item 2)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from nodexa_chain_core_tpu.ops import compile_cache as cc
+
+
+# ------------------------------------------------------- bucket selection
+
+
+def test_bucket_for_selects_smallest_covering():
+    assert cc.bucket_for(1, cc.BATCH_BUCKETS) == 64
+    assert cc.bucket_for(64, cc.BATCH_BUCKETS) == 64
+    assert cc.bucket_for(65, cc.BATCH_BUCKETS) == 2048
+    assert cc.bucket_for(32768, cc.BATCH_BUCKETS) == 32768
+    # past the largest bucket: the shape runs off-bucket, not an error
+    assert cc.bucket_for(99999, cc.BATCH_BUCKETS) == 99999
+
+
+def test_declared_bucket_tables_cover_the_serving_shapes():
+    # the pool micro-batch (batch_max 64), the HEADERS sync shape (2000)
+    # and the deep sweep must all land on declared buckets
+    assert cc.bucket_for(64, cc.BATCH_BUCKETS) in cc.BATCH_BUCKETS
+    assert cc.bucket_for(2000, cc.BATCH_BUCKETS) in cc.BATCH_BUCKETS
+    assert "64x32" in cc.KERNEL_BUCKETS["progpow.verify"]
+    assert "2048x688" in cc.KERNEL_BUCKETS["progpow.search_scan"]
+
+
+# ------------------------------------------------ padding bit-exactness
+
+
+@pytest.fixture(scope="module")
+def synthetic_verifier():
+    from nodexa_chain_core_tpu.ops.progpow_jax import BatchVerifier
+
+    rng = np.random.default_rng(0xC0)
+    l1 = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    dag = rng.integers(0, 1 << 32, size=(128, 64), dtype=np.uint32)
+    return BatchVerifier(l1, dag), l1, dag
+
+
+def test_padded_verify_bitexact_vs_scalar_spec(synthetic_verifier):
+    """A 3-entry batch (padded to the 64 bucket) must agree bit-for-bit
+    with the executable-spec scalar hash over the same synthetic slab —
+    pad rows can never leak into real results."""
+    from nodexa_chain_core_tpu.crypto import progpow_ref as ppref
+
+    verifier, l1, dag = synthetic_verifier
+    header = bytes((i * 7 + 1) % 256 for i in range(32))
+    nonces = [0xC0FFEE, 0xC0FFEF, 0x12345678AB]
+    height = 4242
+    finals, mixes = verifier.hash_batch([header] * 3, nonces, [height] * 3)
+    for i, n64 in enumerate(nonces):
+        want_final, want_mix = ppref.kawpow_hash(
+            height, header, n64, [int(x) for x in l1], dag.shape[0],
+            lambda j: dag[j].astype("<u4").tobytes(),
+        )
+        assert finals[i] == want_final, f"final {i} diverged from spec"
+        assert mixes[i] == want_mix, f"mix {i} diverged from spec"
+
+
+def test_dag_build_rows_padding_bitexact():
+    """build_rows pads the launch to a row bucket; the sliced result
+    must equal the unpadded item math."""
+    import jax.numpy as jnp
+
+    from nodexa_chain_core_tpu.ops import ethash_dag_jax as ed
+
+    rng = np.random.default_rng(7)
+    light = rng.integers(0, 1 << 32, size=(32, 16), dtype=np.uint32)
+    b = ed.DagBuilder(light)
+    got = b.build_rows(2, 3)  # padded to the 64-row bucket internally
+    idx = np.arange(3 * 4, dtype=np.uint32) + np.uint32(2 * 4)
+    want = np.asarray(
+        ed.dataset_items_512(jnp.asarray(light, jnp.uint32),
+                             jnp.asarray(idx))
+    ).reshape(3, 64)
+    assert np.array_equal(got, want)
+
+
+# --------------------------------------------------- artifact round-trip
+
+
+def _double_plus_one(x):
+    return x * 2 + 1
+
+
+def test_artifact_roundtrip_restore(tmp_path):
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    x = np.arange(8, dtype=np.float32)
+
+    k1 = cache.wrap("test.roundtrip", _double_plus_one, label="8")
+    out1 = np.asarray(k1(x))
+    assert np.array_equal(out1, x * 2 + 1)
+    assert cache.stats.get("built", 0) == 1
+    # exactly one artifact on disk
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(cache.dir) for f in fs
+    ]
+    assert len(files) == 1 and files[0].endswith(".aot")
+
+    # a FRESH kernel (new process stand-in) must restore, not rebuild
+    k2 = cache.wrap("test.roundtrip", _double_plus_one, label="8")
+    out2 = np.asarray(k2(x))
+    assert np.array_equal(out2, out1)
+    assert cache.stats.get("restored", 0) == 1
+    assert cache.stats.get("built", 0) == 1  # unchanged
+
+
+def test_corrupt_artifact_discarded_and_rebuilt(tmp_path):
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    x = np.arange(4, dtype=np.float32)
+    k1 = cache.wrap("test.corrupt", _double_plus_one, label="4")
+    k1(x)
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(cache.dir) for f in fs
+    ]
+    assert len(files) == 1
+    with open(files[0], "wb") as fh:
+        fh.write(b"not a pickle at all")
+
+    k2 = cache.wrap("test.corrupt", _double_plus_one, label="4")
+    out = np.asarray(k2(x))
+    assert np.array_equal(out, x * 2 + 1)  # fell back to a clean build
+    assert cache.stats.get("corrupt", 0) == 1
+    assert cache.stats.get("built", 0) == 2
+
+
+def test_stale_fingerprint_artifact_discarded(tmp_path):
+    """An artifact whose recorded toolchain fingerprint mismatches must
+    be discarded as stale, never deserialized."""
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    x = np.arange(4, dtype=np.float32)
+    cache.wrap("test.stale", _double_plus_one, label="4")(x)
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(cache.dir) for f in fs
+    ]
+    blob = pickle.loads(open(files[0], "rb").read())
+    blob["fingerprint"] = "deadbeefdeadbeef"
+    with open(files[0], "wb") as fh:
+        fh.write(pickle.dumps(blob))
+
+    out = np.asarray(
+        cache.wrap("test.stale", _double_plus_one, label="4")(x))
+    assert np.array_equal(out, x * 2 + 1)
+    assert cache.stats.get("stale", 0) == 1
+    assert cache.stats.get("built", 0) == 2  # discarded, rebuilt fresh
+    rewritten = pickle.loads(open(files[0], "rb").read())
+    assert rewritten["fingerprint"] == cc.fingerprint()
+
+
+def test_key_invalidation_on_fingerprint_change(tmp_path, monkeypatch):
+    """A toolchain fingerprint change must change every artifact key —
+    the old executable is simply never found."""
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    x = np.arange(4, dtype=np.float32)
+    cache.wrap("test.fpr", _double_plus_one, label="4")(x)
+    assert cache.stats.get("built", 0) == 1
+
+    monkeypatch.setattr(cc, "_fingerprint", "0123456789abcdef")
+    out = np.asarray(cache.wrap("test.fpr", _double_plus_one, label="4")(x))
+    assert np.array_equal(out, x * 2 + 1)
+    assert cache.stats.get("built", 0) == 2  # miss under the new key
+    assert cache.stats.get("restored", 0) == 0
+
+
+def test_static_key_distinguishes_same_aval_programs(tmp_path):
+    """Two kernels with identical avals but different baked-in constants
+    (the per-period search case) must never share an artifact."""
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    x = np.arange(4, dtype=np.float32)
+
+    def times(k):
+        return lambda v: v * k
+
+    a = cache.wrap("test.static", times(2), label="4", static_key=(2,))
+    b = cache.wrap("test.static", times(3), label="4", static_key=(3,))
+    assert np.array_equal(np.asarray(a(x)), x * 2)
+    assert np.array_equal(np.asarray(b(x)), x * 3)
+    # and a restore honors the static key
+    a2 = cache.wrap("test.static", times(2), label="4", static_key=(2,))
+    assert np.array_equal(np.asarray(a2(x)), x * 2)
+    assert cache.stats.get("restored", 0) == 1
+
+
+# ------------------------------------------------- warmup/audit ledger
+
+
+def test_warmup_ledger_flags_post_seal_compiles(tmp_path):
+    from nodexa_chain_core_tpu.telemetry import g_metrics
+
+    cache = cc.CompileCache()
+    cache.enable(str(tmp_path / "aot"))
+    k = cache.wrap("test.audit", _double_plus_one,
+                   label=lambda args: str(args[0].shape[0]))
+    k(np.arange(8, dtype=np.float32))  # pre-seal: becomes expected
+    cache.seal_warmup(audit=True)
+    assert cache.audit_armed
+
+    m = g_metrics.get("nodexa_compile_unexpected_total")
+    before = sum(v for _, v in m.collect()) if m else 0
+    k(np.arange(8, dtype=np.float32))  # same shape: dict hit, no event
+    assert cache.unexpected_compiles == 0
+
+    k(np.arange(16, dtype=np.float32))  # NEW shape after seal
+    assert cache.unexpected_compiles == 1
+    after = sum(v for _, v in m.collect())
+    assert after == before + 1
+    snap = cache.snapshot()
+    assert snap["audit_armed"] and snap["unexpected_compiles"] == 1
+
+
+def test_offbucket_label_counted():
+    from nodexa_chain_core_tpu.telemetry import g_metrics
+
+    cache = cc.CompileCache()  # persistence disabled: ledger still works
+    m = g_metrics.get("nodexa_compile_offbucket_total")
+    before = sum(v for _, v in m.collect()) if m else 0
+    cache.note_compile("progpow.verify", "100x32")  # undeclared bucket
+    after = sum(v for _, v in g_metrics.get(
+        "nodexa_compile_offbucket_total").collect())
+    assert after == before + 1
+    cache.note_compile("progpow.verify", "64x32")  # declared: no count
+    assert sum(v for _, v in g_metrics.get(
+        "nodexa_compile_offbucket_total").collect()) == after
+
+
+def test_jitcache_enables_aot_store(tmp_path, monkeypatch):
+    """enable_persistent_cache (the absorbed shim) must bring up the AOT
+    artifact dir under the same durable root."""
+    from nodexa_chain_core_tpu.utils import jitcache
+
+    monkeypatch.setattr(jitcache, "_enabled", None)
+    monkeypatch.setattr(cc.g_compile_cache, "_dir", None)
+    d = str(tmp_path / "jit")
+    assert jitcache.enable_persistent_cache(d) == d
+    assert cc.g_compile_cache.dir == os.path.join(d, "aot")
+    assert os.path.isdir(cc.g_compile_cache.dir)

@@ -223,6 +223,20 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f"recorder spans="
         f"{int(series_total(snap, 'nodexa_flight_recorder_spans'))}")
 
+    # AOT compile cache: artifact hits vs builds, last-restore age, and
+    # the audit ledger (any unexpected count is a shape-discipline
+    # regression — a kernel compiled after warmup sealed)
+    aot = by_label(snap, "nodexa_aot_artifacts_total", "result")
+    unexpected = int(series_total(snap, "nodexa_compile_unexpected_total"))
+    age = series_total(snap, "nodexa_aot_restore_age_seconds")
+    warn = f"  {RED}unexpected={unexpected}{RESET}" if unexpected else ""
+    lines.append(
+        f"  aot: restored={int(aot.get('restored', 0))} "
+        f"built={int(aot.get('built', 0))} "
+        f"corrupt={int(aot.get('corrupt', 0) + aot.get('stale', 0))} "
+        f"fallback={int(aot.get('jit_fallback', 0))}   "
+        f"last-restore age {age/3600:.1f}h{warn}")
+
     if mode == 1:
         errs = by_label(snap, "nodexa_critical_errors_total", "source")
         worst = ", ".join(f"{k}={int(v)}" for k, v in sorted(errs.items()))
