@@ -57,126 +57,25 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-# Analytic per-hash work (documented constants, not measurements):
-# kawpow: 64 rounds x 16 lanes x (11 cache merges ~5 ops + 18 math ~7 ops
-# + 4 epilogue merges ~5 ops) + 2 keccak-f800 (~22*120) ~= 2.1e5 u32 ops.
-KAWPOW_OPS_PER_HASH = 210_000
-KAWPOW_DAG_BYTES_PER_HASH = 64 * 256
-KAWPOW_L1_WORDS_PER_HASH = 64 * 11 * 16
-# sha256d on an 80-byte header with the first-block midstate precomputed:
-# 2 compressions, each ~64 rounds x ~20 ops + schedule ~48 x 12 ~= 1.9e3.
-SHA256D_OPS_PER_HASH = 3_800
-V5E_U32_OPS_PEAK = 4.0e12  # approx: 8 sublanes x 128 lanes x ~4 ALUs x 940MHz
+# Analytic per-hash work: the documented constants now live in
+# telemetry/utilization.py — ONE source for this bench's roofline block
+# and the daemon's live nodexa_kernel_frac_of_ceiling gauges, so the
+# two can never disagree on the model.
+from nodexa_chain_core_tpu.telemetry.utilization import (  # noqa: E402
+    KAWPOW_DAG_BYTES_PER_HASH,
+    KAWPOW_L1_WORDS_PER_HASH,
+    KAWPOW_OPS_PER_HASH,
+    SHA256D_OPS_PER_HASH,
+    V5E_U32_OPS_PEAK,
+)
 
 
 def _measure_gather_ceilings(dag_jnp, l1_np) -> dict:
-    """In-jit chained-loop rooflines for the two consensus access shapes."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    """Shared probes (ops/roofline.py — the daemon's -calibrate runs
+    the same code) plus the bench-only Pallas DMA hypothesis probe."""
+    from nodexa_chain_core_tpu.ops.roofline import measure_gather_ceilings
 
-    out = {}
-    # random 256-B row gather: 32 chained rounds of (32768,) row fetches,
-    # indices fed from gathered data so nothing hoists or elides
-    K, B = 32, 32768
-    nrows = dag_jnp.shape[0]
-
-    @jax.jit
-    def row_chain(d, seed):
-        def body(i, ix):
-            rows = jnp.take(d, (ix % nrows).astype(jnp.int32), axis=0)
-            return rows[:, 0] + rows[:, 63] + i
-
-        return jax.lax.fori_loop(
-            0, K, body, seed + jnp.arange(B, dtype=jnp.uint32)
-        )[0]
-
-    t = time.perf_counter()
-    float(np.asarray(row_chain(dag_jnp, jnp.uint32(1))))
-    compile_s = time.perf_counter() - t
-
-    def run(n, salt):
-        t = time.perf_counter()
-        o = None
-        for i in range(n):
-            o = row_chain(dag_jnp, jnp.uint32(salt + i))
-        np.asarray(o)
-        return time.perf_counter() - t
-
-    # a ceiling is a max-capability figure and tunnel hiccups are
-    # one-sided: take min PER POINT within an estimate, then the MAX
-    # over independent slope estimates (one corrupted estimate would
-    # otherwise under-report the ceiling below the kernel's own
-    # achieved rate, which r5 observed)
-    def slope_estimate(salt):
-        t1 = min(run(1, 10 + salt + a) for a in range(2))
-        t5 = min(run(5, 50 + 10 * (salt + a)) for a in range(2))
-        return (t5 - t1) / 4
-
-    dt = min(slope_estimate(100 * e) for e in range(3))
-    out["dag_row_gather_GBps"] = round(K * B * 256 / dt / 1e9, 2)
-    log(f"[roofline] random 256-B row gather: "
-        f"{out['dag_row_gather_GBps']} GB/s (compile {compile_s:.0f}s)")
-
-    # L1 word gather: the Pallas 32-pass lane-gather decomposition the
-    # kernel uses, measured standalone (tools/l1_gather32_bench.py form)
-    from nodexa_chain_core_tpu.ops import progpow_search as ps
-
-    R = 4096
-    tbl32 = jnp.asarray(l1_np.reshape(32, 128))
-    idx = jnp.asarray(
-        np.random.default_rng(3).integers(
-            0, 1 << 32, size=(R, 128), dtype=np.uint32)
-    )
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    BLK = 512
-
-    def kern(tbl_ref, idx_ref, out_ref):
-        out_ref[...] = ps._l1_gather32(
-            tbl_ref[...], idx_ref[...] & jnp.uint32(4095))
-
-    call = pl.pallas_call(
-        kern,
-        grid=(R // BLK,),
-        in_specs=[
-            pl.BlockSpec((32, 128), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLK, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((BLK, 128), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((R, 128), jnp.uint32),
-    )
-
-    @jax.jit
-    def l1_chain(ix, salt):
-        def body(i, v):
-            return call(tbl32, v) + i
-
-        return jax.lax.fori_loop(0, 64, body, ix + salt)[0, 0]
-
-    float(np.asarray(l1_chain(idx, jnp.uint32(0))))
-
-    def run2(n, salt):
-        t = time.perf_counter()
-        o = None
-        for i in range(n):
-            o = l1_chain(idx, jnp.uint32(salt + i))
-        np.asarray(o)
-        return time.perf_counter() - t
-
-    def slope_estimate2(salt):
-        t1 = min(run2(1, 10 + salt + a) for a in range(2))
-        t5 = min(run2(5, 50 + 10 * (salt + a)) for a in range(2))
-        return (t5 - t1) / 4
-
-    dt = min(slope_estimate2(100 * e) for e in range(3))
-    out["l1_word_gather_Geps"] = round(R * 128 * 64 / dt / 1e9, 2)
-    log(f"[roofline] L1 lane-gather (Pallas 32-pass): "
-        f"{out['l1_word_gather_Geps']} G elem/s")
+    out = measure_gather_ceilings(dag_jnp, l1_np, log=log)
 
     # Pallas async-DMA random row fetch — the r3/r4 hypothesis that
     # double-buffered per-row DMA beats the XLA gather engine.  Measured
@@ -450,6 +349,46 @@ def bench_kawpow(on_tpu: bool) -> dict:
             dag_gbps / ceilings["dag_row_gather_GBps"], 3)
         util["l1_frac_of_measured_lane_gather_ceiling"] = round(
             l1_geps / ceilings["l1_word_gather_Geps"], 3)
+        # fraction-of-measured-ceiling for EVERY kernel variant (not
+        # just the per-period search): each variant's achieved rate
+        # through the SAME shared model + ceilings (utilization.py), so
+        # the live nodexa_kernel_frac_of_ceiling gauges and these keys
+        # share one denominator by construction
+        from nodexa_chain_core_tpu.telemetry import utilization as uz
+
+        calib = dict(ceilings)
+        calib["alu_u32_ops_per_s"] = V5E_U32_OPS_PEAK
+        per_kernel = {}
+        for variant, rate_hs in (
+            ("kawpow_search_period", search_hs),  # the Pallas kernel
+            ("kawpow_verify", verify_hs),
+        ):
+            per_kernel[variant] = {
+                "dag_frac_of_ceiling": round(uz.frac_of_ceiling(
+                    uz.COMP_DAG, rate_hs * KAWPOW_DAG_BYTES_PER_HASH,
+                    calib), 3),
+                "l1_frac_of_ceiling": round(uz.frac_of_ceiling(
+                    uz.COMP_L1, rate_hs * KAWPOW_L1_WORDS_PER_HASH,
+                    calib), 3),
+            }
+        if "dag_device_build_rows_per_s" in out:
+            calib["dag_build_rows_per_s"] = float(
+                out["dag_device_build_rows_per_s"])
+            per_kernel["ethash_dag_build"] = {
+                "rows_frac_of_ceiling": 1.0}  # self-calibrating probe
+        util["per_kernel_frac_of_ceiling"] = per_kernel
+        # persist the measured ceilings: the daemon's live gauges load
+        # THIS file (keyed on the toolchain fingerprint), so bench and
+        # daemon literally read the same denominators
+        try:
+            from nodexa_chain_core_tpu.ops.compile_cache import fingerprint
+
+            path = uz.save_calibration(
+                calib, fingerprint=fingerprint(), source="bench")
+            util["calibration_file"] = path
+            log(f"[roofline] calibration persisted to {path}")
+        except Exception as e:  # pragma: no cover - bench must not die
+            log(f"[roofline] calibration persist failed: {e!r}")
         # The components are SERIALIZED on one core (XLA runs one kernel
         # at a time; in-kernel DMA overlap is issue-rate-infeasible for
         # 256-B rows — see dma_row_fetch probe), so the honest composite

@@ -53,6 +53,21 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
     set_spans_enabled(g_args.get_bool("telemetryspans", True))
 
+    # always-on sampling profiler (-profilehz, default ~25 Hz): started
+    # this early so boot itself is profiled; -profilehz=0 is the kill
+    # switch (same zero-cost discipline as -telemetryspans=0 — no
+    # sampler thread, every entry point one bool check)
+    from ..telemetry.profiler import g_profiler
+
+    try:
+        profile_hz = float(g_args.get("profilehz", "25") or 0)
+    except ValueError:
+        raise SystemExit("Error: -profilehz wants a number (0 disables)")
+    if profile_hz > 0:
+        g_profiler.start(profile_hz)
+        log_printf("sampling profiler on at %.0f Hz (getprofile RPC; "
+                   "-profilehz=0 disables)", profile_hz)
+
     # -faultinject=<site>:<spec> (repeatable): arm deterministic faults
     # BEFORE any store opens so chainstate-load choke points are covered
     # too.  Unknown sites are a hard startup error — a typo must not
@@ -364,6 +379,64 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
                 wait_s=warmup_wait,
                 buckets=warm_buckets,
                 audit=g_args.get_bool("compileaudit", True))
+
+    # live roofline attribution (-utilization, default on): the device-
+    # time ledger at the compile-cache choke point feeds
+    # nodexa_device_busy_frac / nodexa_kernel_frac_of_ceiling /
+    # nodexa_kernel_bytes_per_s.  Ceilings come from a persisted
+    # calibration file (bench.py writes one; -calibrationfile points
+    # elsewhere) keyed on the toolchain fingerprint, or from a one-shot
+    # -calibrate probe against the resident epoch slab (the same
+    # row-gather / lane-gather probes bench runs — ops/roofline.py).
+    if g_args.get_bool("utilization", True):
+        from ..telemetry.utilization import (
+            g_utilization,
+            load_calibration,
+        )
+
+        g_utilization.set_enabled(True)
+        calib_path = g_args.get("calibrationfile", "") or None
+        if calib_path is not None and not os.path.exists(calib_path):
+            # same discipline as -faultinject: an explicit flag with a
+            # typo must not silently configure nothing
+            raise SystemExit(
+                f"Error: -calibrationfile={calib_path} does not exist")
+        calib = None
+        if calib_path is not None:
+            candidates = (calib_path,)
+        else:
+            from ..telemetry.utilization import default_calibration_path
+
+            candidates = (os.path.join(datadir, "calibration.json"),
+                          default_calibration_path())
+        for candidate in candidates:
+            if not os.path.exists(candidate):
+                continue  # don't pay the jax fingerprint for a miss
+            try:
+                from ..ops.compile_cache import fingerprint
+
+                calib = load_calibration(candidate,
+                                         fingerprint=fingerprint())
+            except Exception:  # noqa: BLE001 — backend probe failure
+                calib = load_calibration(candidate)
+            if calib is not None:
+                g_utilization.set_calibration(calib, source=candidate)
+                log_printf("utilization: calibration loaded from %s "
+                           "(%s)", candidate,
+                           ", ".join(f"{k}={v}" for k, v in calib.items()))
+                break
+        if calib is None and g_args.get_bool("calibrate"):
+            from ..ops.roofline import calibrate_node
+
+            with g_startup.stage("calibration"):
+                calib = calibrate_node(
+                    node,
+                    path=os.path.join(datadir, "calibration.json"),
+                    log=lambda m: log_printf("%s", m))
+        if calib is None:
+            log_printf("utilization: no ceiling calibration — busy/idle "
+                       "ledger live, frac-of-ceiling gauges read 0 "
+                       "(run bench.py or start with -calibrate)")
 
     # Step 8: wallet
     if not g_args.get_bool("disablewallet"):

@@ -229,6 +229,15 @@ class NodeHealth:
             with self._lock:
                 if self.last_error is not None:
                     self.last_error["flight_recorder_dump"] = dump_path
+        # the sampling profiler dumps beside it (where every thread was
+        # standing as the failure hit) — one bool check when it's off
+        from ..telemetry import profiler as _profiler
+
+        prof_path = _profiler.auto_dump("safe-mode")
+        if prof_path is not None:
+            with self._lock:
+                if self.last_error is not None:
+                    self.last_error["profile_dump"] = prof_path
         self._flush_safe_point(chainstate)
         t = threading.Thread(
             target=self._halt_producers, args=(node,),

@@ -57,6 +57,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..telemetry import g_metrics
 from ..telemetry.compileattr import compile_span
 from ..telemetry.flight_recorder import record_event
+from ..telemetry import utilization as _util
 from ..utils.logging import log_printf
 
 ARTIFACT_VERSION = "nxk-aot-1"
@@ -426,8 +427,30 @@ class CachedKernel:
         key = self._aval_key(args)
         exe = self._exe.get(key)
         if exe is not None:
-            return exe(*args)
+            if not _util.g_utilization.enabled:
+                # utilization off (the default outside the daemon): one
+                # bool read, then straight to the executable
+                return exe(*args)
+            return self._timed_call(exe, args)
         return self._first_call(key, args)
+
+    def _timed_call(self, exe, args):
+        """Steady-state call under the utilization ledger: the window is
+        SYNCHRONIZED (block_until_ready) so it measures device time, not
+        dispatch time — every consumer of these kernels fetches the
+        result to host right after anyway, so the pipelining this gives
+        up was already being given up one line later."""
+        import jax
+
+        t0 = time.monotonic()
+        out = exe(*args)
+        try:
+            jax.block_until_ready(out)
+        except Exception:  # pragma: no cover - non-array pytree leaves
+            pass
+        _util.g_utilization.record(
+            self.kernel, self.bucket_label(args), t0, time.monotonic())
+        return out
 
     def _first_call(self, key: Tuple, args):
         # the lock serializes concurrent first compiles of one shape
@@ -479,6 +502,29 @@ class CachedKernel:
             self.cache._count(self.kernel, "built")
             self.cache.persist(self.kernel, key_hash, exe)
         return exe, out
+
+
+def instrumented_eager(kernel: str, label: str, fn: Callable) -> Callable:
+    """Utilization-ledger shim for the few hot paths that bypass the
+    CachedKernel dispatch (today: the per-period search kernel's
+    eager-on-CPU fallback).  Disabled, it adds one bool read per call;
+    enabled, the same synchronized timing window _timed_call uses —
+    so the CPU-image ledger still sees search traffic."""
+    def wrapped(*args):
+        if not _util.g_utilization.enabled:
+            return fn(*args)
+        import jax
+
+        t0 = time.monotonic()
+        out = fn(*args)
+        try:
+            jax.block_until_ready(out)
+        except Exception:  # pragma: no cover - non-array pytree leaves
+            pass
+        _util.g_utilization.record(kernel, label, t0, time.monotonic())
+        return out
+
+    return wrapped
 
 
 g_compile_cache = CompileCache()

@@ -605,6 +605,14 @@ class SearchKernel:
                             "progpow.search_period", fn,
                             label=str(batch),
                             static_key=("period", period, batch))
+                    else:
+                        # the eager path bypasses CachedKernel, so the
+                        # utilization ledger needs its own shim (one
+                        # bool read per call while disabled)
+                        from .compile_cache import instrumented_eager
+
+                        fn = instrumented_eager(
+                            "progpow.search_period", str(batch), fn)
                 evictable = [
                     k for k in self._jit_cache if k not in self._pinned
                 ]

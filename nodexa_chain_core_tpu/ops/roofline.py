@@ -1,0 +1,187 @@
+"""Measured gather-roofline probes (the utilization denominators).
+
+bench.py's utilization block and the daemon's live gauges must never
+disagree on the ceiling a kernel is graded against, so the probes live
+here — imported by bench.py for the offline roofline section and by the
+daemon's one-shot ``-calibrate`` path — and the measured numbers persist
+through ``telemetry.utilization.save_calibration`` keyed on the
+toolchain fingerprint (``ops.compile_cache.fingerprint``).
+
+Two probes, both in-jit chained loops so nothing hoists or elides and
+no per-dispatch tunnel latency pollutes the slope:
+
+- **random 256-B DAG-row gather** (GB/s) — the ceiling the KawPow DAG
+  read (64 random rows per hash) is graded against; the r3/r4 Pallas
+  per-row DMA alternative measured issue-rate-bound ~10x below this,
+  so the XLA take IS the honest ceiling on this hardware;
+- **L1 lane-gather** (G elem/s) — the Pallas 32-pass decomposition the
+  search kernel actually uses, measured standalone.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+
+def _noop_log(msg: str) -> None:  # pragma: no cover - default sink
+    pass
+
+
+def measure_gather_ceilings(dag_jnp, l1_np,
+                            log: Callable[[str], None] = _noop_log) -> dict:
+    """In-jit chained-loop rooflines for the two consensus access shapes.
+    ``dag_jnp`` is the device-resident (rows, 64) u32 slab, ``l1_np``
+    the 4096-word L1 cache.  Returns the CEILING_SPEC calibration keys
+    {"dag_row_gather_GBps", "l1_word_gather_Geps"}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = {}
+    # random 256-B row gather: 32 chained rounds of (32768,) row fetches,
+    # indices fed from gathered data so nothing hoists or elides
+    K, B = 32, 32768
+    nrows = dag_jnp.shape[0]
+
+    @jax.jit
+    def row_chain(d, seed):
+        def body(i, ix):
+            rows = jnp.take(d, (ix % nrows).astype(jnp.int32), axis=0)
+            return rows[:, 0] + rows[:, 63] + i
+
+        return jax.lax.fori_loop(
+            0, K, body, seed + jnp.arange(B, dtype=jnp.uint32)
+        )[0]
+
+    t = time.perf_counter()
+    float(np.asarray(row_chain(dag_jnp, jnp.uint32(1))))
+    compile_s = time.perf_counter() - t
+
+    def run(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = row_chain(dag_jnp, jnp.uint32(salt + i))
+        np.asarray(o)
+        return time.perf_counter() - t
+
+    # a ceiling is a max-capability figure and tunnel hiccups are
+    # one-sided: take min PER POINT within an estimate, then the MAX
+    # over independent slope estimates (one corrupted estimate would
+    # otherwise under-report the ceiling below the kernel's own
+    # achieved rate, which r5 observed)
+    def slope_estimate(salt):
+        t1 = min(run(1, 10 + salt + a) for a in range(2))
+        t5 = min(run(5, 50 + 10 * (salt + a)) for a in range(2))
+        return (t5 - t1) / 4
+
+    dt = min(slope_estimate(100 * e) for e in range(3))
+    out["dag_row_gather_GBps"] = round(K * B * 256 / dt / 1e9, 2)
+    log(f"[roofline] random 256-B row gather: "
+        f"{out['dag_row_gather_GBps']} GB/s (compile {compile_s:.0f}s)")
+
+    # L1 word gather: the Pallas 32-pass lane-gather decomposition the
+    # kernel uses, measured standalone (tools/l1_gather32_bench.py form)
+    from . import progpow_search as ps
+
+    R = 4096
+    tbl32 = jnp.asarray(np.asarray(l1_np).reshape(32, 128))
+    idx = jnp.asarray(
+        np.random.default_rng(3).integers(
+            0, 1 << 32, size=(R, 128), dtype=np.uint32)
+    )
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BLK = 512
+
+    def kern(tbl_ref, idx_ref, out_ref):
+        out_ref[...] = ps._l1_gather32(
+            tbl_ref[...], idx_ref[...] & jnp.uint32(4095))
+
+    call = pl.pallas_call(
+        kern,
+        grid=(R // BLK,),
+        in_specs=[
+            pl.BlockSpec((32, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 128), jnp.uint32),
+    )
+
+    @jax.jit
+    def l1_chain(ix, salt):
+        def body(i, v):
+            return call(tbl32, v) + i
+
+        return jax.lax.fori_loop(0, 64, body, ix + salt)[0, 0]
+
+    float(np.asarray(l1_chain(idx, jnp.uint32(0))))
+
+    def run2(n, salt):
+        t = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = l1_chain(idx, jnp.uint32(salt + i))
+        np.asarray(o)
+        return time.perf_counter() - t
+
+    def slope_estimate2(salt):
+        t1 = min(run2(1, 10 + salt + a) for a in range(2))
+        t5 = min(run2(5, 50 + 10 * (salt + a)) for a in range(2))
+        return (t5 - t1) / 4
+
+    dt = min(slope_estimate2(100 * e) for e in range(3))
+    out["l1_word_gather_Geps"] = round(R * 128 * 64 / dt / 1e9, 2)
+    log(f"[roofline] L1 lane-gather (Pallas 32-pass): "
+        f"{out['l1_word_gather_Geps']} G elem/s")
+    return out
+
+
+def calibrate_node(node, path: Optional[str] = None,
+                   log: Callable[[str], None] = _noop_log) -> Optional[dict]:
+    """One-shot daemon calibration (the ``-calibrate`` flag): probe the
+    tip epoch's resident device slab/L1 with the SAME probes bench.py
+    runs, persist the result for every later boot, and hand the
+    ceilings to the live ledger.  Returns the ceilings dict or None
+    (no resident verifier / probe failure — never fatal, the gauges
+    just stay uncalibrated)."""
+    from ..telemetry.utilization import (
+        V5E_U32_OPS_PEAK,
+        g_utilization,
+        save_calibration,
+    )
+    from .compile_cache import fingerprint
+
+    mgr = getattr(node, "epoch_manager", None)
+    tip = node.chainstate.tip() if node.chainstate is not None else None
+    if mgr is None or tip is None:
+        return None
+    from ..crypto.kawpow import epoch_number
+
+    verifier = mgr.verifier(epoch_number(tip.height))
+    dag = getattr(verifier, "dag", None)
+    l1 = getattr(verifier, "l1_host", None)
+    if l1 is None:
+        l1 = getattr(verifier, "l1", None)
+    if dag is None or l1 is None:
+        return None
+    try:
+        import numpy as np
+
+        ceilings = measure_gather_ceilings(dag, np.asarray(l1).ravel(),
+                                           log=log)
+    except Exception as e:  # noqa: BLE001 — probes must not kill boot
+        log(f"[roofline] calibration probe failed: {e!r}")
+        return None
+    ceilings["alu_u32_ops_per_s"] = V5E_U32_OPS_PEAK
+    out_path = save_calibration(
+        ceilings, path=path, fingerprint=fingerprint(), source="daemon")
+    g_utilization.set_calibration(ceilings, source="daemon-probe")
+    log(f"[roofline] calibration persisted to {out_path}")
+    return ceilings

@@ -176,6 +176,27 @@ def dumpflightrecorder(node, params: List[Any]):
     return flight_recorder.dump(path=path, reason="rpc")
 
 
+def getprofile(node, params: List[Any]):
+    """The always-on sampling profiler's snapshot: per-thread-role
+    sample counts, an on-CPU share estimate, and the top collapsed
+    stacks (flamegraph.pl-ready lines under ``collapsed``).  Optional
+    first param bounds stacks per role (default 10).  Deliberately
+    readable in safe mode — a degraded node is exactly when you need
+    to know where every thread is standing (``-profilehz=0`` leaves
+    the profiler off; the RPC then reports running=false)."""
+    from ..telemetry.profiler import g_profiler
+
+    try:
+        max_stacks = int(params[0]) if params and params[0] else 10
+    except (TypeError, ValueError):
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "max_stacks must be an integer")
+    max_stacks = max(1, min(max_stacks, 500))
+    out = g_profiler.snapshot(max_stacks=max_stacks)
+    out["collapsed"] = g_profiler.collapsed(max_stacks=max_stacks)
+    return out
+
+
 def getstartupinfo(node, params: List[Any]):
     """Daemon boot attribution: per-stage durations (chainstate load,
     self-check, mesh init, compile warmup, wallet, network, pool, rpc),
@@ -195,6 +216,9 @@ def getstartupinfo(node, params: List[Any]):
     cc["persistent_cache_hits"] = jitcache.hits
     cc["persistent_cache_misses"] = jitcache.misses
     out["compile_cache"] = cc
+    from ..telemetry.utilization import g_utilization
+
+    out["utilization"] = g_utilization.snapshot()
     return out
 
 
@@ -346,6 +370,7 @@ def register(table: RPCTable) -> None:
          ["privkey", "message"]),
         ("control", "getmemoryinfo", getmemoryinfo, []),
         ("control", "getmetrics", getmetrics, ["prefix"]),
+        ("control", "getprofile", getprofile, ["max_stacks"]),
         ("control", "gettrace", gettrace, ["trace_id"]),
         ("control", "dumpflightrecorder", dumpflightrecorder, ["path"]),
         ("control", "getstartupinfo", getstartupinfo, []),

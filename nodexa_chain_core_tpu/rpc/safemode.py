@@ -68,6 +68,21 @@ MUTATING_COMMANDS = frozenset({
 })
 
 
+# Diagnostic surface that must stay answerable in EVERY safe mode: a
+# degraded node is exactly when the operator needs metrics, traces, the
+# profiler, and the flight recorder.  This allowlist is the explicit
+# contract (tested) — none of these may ever migrate into
+# MUTATING_COMMANDS, and reject_if_locked_down short-circuits on them
+# before any health-layer consultation.
+READONLY_DIAGNOSTIC_COMMANDS = frozenset({
+    "getmetrics", "getprofile", "gettrace", "dumpflightrecorder",
+    "getstartupinfo", "getnodehealth", "help", "uptime", "stop",
+})
+
+assert not (READONLY_DIAGNOSTIC_COMMANDS & MUTATING_COMMANDS), (
+    "a diagnostic RPC may never be classed mutating")
+
+
 def reject_if_locked_down(method: str) -> None:
     """Dispatch-table gate: refuse mutating RPCs while the HEALTH layer's
     safe mode holds (a critical disk/DB error).  Read-only methods (and
@@ -80,6 +95,13 @@ def reject_if_locked_down(method: str) -> None:
     its narrower wallet-only ``observe_safe_mode`` guard — locking down
     ``invalidateblock``/``reconsiderblock``/``submitblock`` there would
     refuse the very RPCs an operator needs to resolve the fork."""
+    # Defense in depth, not a behavior change: every diagnostic command
+    # is already outside MUTATING_COMMANDS (import-time assert), but
+    # that assert vanishes under `python -O` — this branch keeps the
+    # "diagnostics always answer" guarantee unconditional even if a
+    # future edit wrongly classes one as mutating.
+    if method in READONLY_DIAGNOSTIC_COMMANDS:
+        return
     if method not in MUTATING_COMMANDS:
         return
     from ..node.health import g_health

@@ -35,6 +35,9 @@ from .tracing import (
 )
 from .startup import g_startup
 from .compileattr import CompileTracker, compile_span
+from . import profiler, utilization
+from .profiler import g_profiler, role_of_thread
+from .utilization import g_utilization
 
 __all__ = [
     "Counter",
@@ -60,4 +63,9 @@ __all__ = [
     "g_startup",
     "CompileTracker",
     "compile_span",
+    "profiler",
+    "utilization",
+    "g_profiler",
+    "g_utilization",
+    "role_of_thread",
 ]

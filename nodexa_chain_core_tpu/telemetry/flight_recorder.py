@@ -168,7 +168,7 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> dict:
     events = events_snapshot()
     complete = complete_traces()
     if path is None:
-        path = _default_dump_path(reason)
+        path = default_dump_path(reason)
     payload = {
         "meta": {
             "time": time.time(),
@@ -193,7 +193,11 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> dict:
     }
 
 
-def _default_dump_path(reason: str) -> str:
+def default_dump_path(reason: str, prefix: str = "flightrecorder") -> str:
+    """Dump-file path under the configured dump dir (daemon: -datadir),
+    falling back to the attached node's datadir, then the system temp
+    dir.  Shared with the sampling profiler (prefix="profile") so both
+    post-mortem artifacts land side by side."""
     import tempfile
 
     d = _dump_dir
@@ -209,7 +213,7 @@ def _default_dump_path(reason: str) -> str:
         d = tempfile.gettempdir()
     stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
     return os.path.join(
-        d, f"flightrecorder-{stamp}-{os.getpid()}-{reason}.json")
+        d, f"{prefix}-{stamp}-{os.getpid()}-{reason}.json")
 
 
 def auto_dump(reason: str) -> Optional[str]:

@@ -1,0 +1,379 @@
+"""Always-on low-overhead sampling profiler (thread-role attribution).
+
+The registry says how much work each subsystem did; the flight recorder
+says what happened in order; neither can answer "which THREAD is the
+bottleneck right now" — the question the 10k-session stratum work
+(ROADMAP item 1) and any single-threaded-loop scaling effort lives on.
+This module samples ``sys._current_frames()`` on a background thread at
+``-profilehz`` (default ~25 Hz), folds each thread's stack into a
+collapsed-stack counter, and attributes every sample to a **thread
+role** derived from the thread's name (the daemon names every worker it
+spawns: ``pool-io``, ``pool-shares``, ``pool-jobs``, ``scriptcheck.N``,
+``blk-readahead``, ``net.*``, ``miner-N``, ``epoch-N``, ...).
+
+Four surfaces:
+
+- the ``getprofile`` RPC — per-role sample counts, CPU-share estimates
+  and top collapsed stacks (flamegraph.pl-ready lines), readable in
+  safe mode (a degraded node is exactly when you want this);
+- ``nodexa_profiler_role_share{role}`` — a live per-role CPU-share
+  gauge (EWMA over *active* samples; threads parked in a blocking call
+  are classified idle by their leaf frame) for nodexa_top;
+- an automatic JSON dump alongside the flight recorder on safe-mode
+  entry (:func:`auto_dump`, called from ``node.health``);
+- ``SamplingProfiler.dump`` for operator-requested snapshots.
+
+Cost discipline (the PR-8 span-switch contract applies): when the
+profiler is off there is NO sampler thread and every entry point
+(``sample_once``, the health-layer ``auto_dump`` shim) is one
+module-level bool check — no allocation, no clock read, no frame walk.
+When on, one 25 Hz tick over a ~15-thread daemon costs a few hundred
+microseconds (< 1% of one core); ``nodexa_profiler_self_seconds_total``
+meters the profiler's own spend so the overhead claim is checkable, and
+ci_gate pins pool shares/s with the profiler on at >= 0.95x off.
+
+Stdlib only, like the rest of ``telemetry/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+from .registry import g_metrics
+
+DEFAULT_HZ = 25.0
+MAX_STACK_DEPTH = 24
+# unique-stack cap per role: a pathological workload cannot grow the
+# profiler's memory without bound — overflow folds into one bucket
+MAX_STACKS_PER_ROLE = 512
+OVERFLOW_STACK = "(other-stacks)"
+
+# ------------------------------------------------------------ thread roles
+#
+# Longest-prefix match over the names every subsystem gives its threads.
+# net.msghand is where block connect / tx admission actually run, so it
+# reports as the "validation" role; the remaining net.* threads are
+# socket plumbing.
+ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("pool-io", "pool-io"),
+    ("pool-shares", "pool-shares"),
+    ("pool-jobs", "pool-jobs"),
+    ("scriptcheck", "scriptcheck"),
+    ("blk-readahead", "readahead"),
+    ("net.msghand", "validation"),
+    ("net.", "net"),
+    ("miner", "mining"),
+    ("epoch", "epoch-build"),
+    ("httprpc", "rpc"),
+    ("scheduler", "scheduler"),
+    ("health-halt", "health"),
+    ("pubsrv", "notify"),
+    ("MainThread", "main"),
+)
+
+
+def role_of_thread(name: str) -> str:
+    """Thread name -> role label (shared with the utilization ledger's
+    idle-gap attribution, so "which role burned the idle time" and
+    "which role burned the CPU" use one vocabulary)."""
+    for prefix, role in ROLE_PREFIXES:
+        if name.startswith(prefix):
+            return role
+    return "other"
+
+
+# A sample whose LEAF frame is one of these is a thread parked in a
+# blocking call (lock/select/queue/socket), not CPU work: it still
+# counts as a sample (wall-clock attribution) but not as an *active*
+# sample (the CPU-share estimate).
+_IDLE_LEAVES = frozenset({
+    "wait", "select", "poll", "accept", "recv", "recvfrom", "recv_into",
+    "readinto", "sleep", "join", "_wait_for_tstate_lock", "park",
+    "epoll", "kqueue", "get", "acquire", "serve_forever", "settimeout",
+})
+
+_M_SAMPLES = g_metrics.counter(
+    "nodexa_profiler_samples_total",
+    "Stack samples taken by the sampling profiler, labeled by thread "
+    "role (active=yes samples caught the thread on-CPU rather than "
+    "parked in a blocking leaf call)")
+_M_SELF = g_metrics.counter(
+    "nodexa_profiler_self_seconds_total",
+    "Wall seconds the sampling profiler spent taking its own samples "
+    "(the overhead meter for the always-on claim)")
+_G_SHARE = g_metrics.gauge(
+    "nodexa_profiler_role_share",
+    "Estimated share of total on-CPU samples per thread role (EWMA "
+    "over active samples; sums to ~1 across roles under load)")
+
+# Module-global kill-switch bool: tracks the GLOBAL profiler only (the
+# zero-cost check auto_dump and the daemon hot paths read).  Secondary
+# instances (tests) carry their own per-instance flag so their
+# start()/stop() can never switch g_profiler's sampling off.
+_enabled = False
+
+
+def profiler_enabled() -> bool:
+    return _enabled
+
+
+def _is_global(p: "SamplingProfiler") -> bool:
+    return globals().get("g_profiler") is p
+
+
+class SamplingProfiler:
+    """One process-wide sampler (``g_profiler``); tests may construct
+    their own with ``register_metrics=False`` to keep the global gauge
+    untouched."""
+
+    def __init__(self, register_metrics: bool = True,
+                 time_fn=time.monotonic) -> None:
+        self._lock = threading.Lock()
+        self._time = time_fn
+        self._register = register_metrics
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.hz = 0.0
+        self._sampling = False  # per-instance twin of the module bool
+        self._reset_locked()
+
+    # -- state -------------------------------------------------------------
+
+    def _reset_locked(self) -> None:
+        self._role_stacks: Dict[str, Counter] = {}
+        self._role_samples: Dict[str, int] = {}
+        self._role_active: Dict[str, int] = {}
+        self._role_ewma: Dict[str, float] = {}
+        self._total_samples = 0
+        self._ticks = 0
+        self._started_at = self._time()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, hz: float = DEFAULT_HZ) -> bool:
+        """Spawn the sampler thread at ``hz``.  hz <= 0 is the kill
+        switch: nothing starts, nothing is allocated, and every later
+        entry point early-exits on one bool."""
+        global _enabled
+        if hz is None or hz <= 0 or self.running:
+            return False
+        with self._lock:
+            self.hz = float(hz)
+            self._started_at = self._time()
+        self._stop.clear()
+        self._sampling = True
+        if _is_global(self):
+            _enabled = True
+        self._thread = threading.Thread(
+            target=self._run, name="profiler", daemon=True)
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        global _enabled
+        self._sampling = False
+        if _is_global(self):
+            _enabled = False
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — the profiler must never
+                pass  # take the daemon down
+            spent = time.perf_counter() - t0
+            if self._register:
+                _M_SELF.inc(spent)
+            self._stop.wait(max(interval - spent, interval * 0.1))
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_once(self, frames=None, names=None) -> int:
+        """Fold one sample of every thread's stack.  Returns the number
+        of threads sampled.  KILL-SWITCH CONTRACT: when this profiler is
+        disabled this is exactly one bool check (tests pin it with a
+        microbench, like the span switch).  Explicit ``frames`` bypass
+        the switch — tests drive sampling without starting a thread."""
+        if frames is None and not self._sampling:
+            return 0
+        if frames is None:
+            frames = sys._current_frames()
+        if names is None:
+            names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        per_role_active: Dict[str, int] = {}
+        folded: List[Tuple[str, str, bool]] = []
+        n = 0
+        for ident, frame in frames.items():
+            if ident == me:
+                continue  # never profile the profiler
+            name = names.get(ident, "?")
+            role = role_of_thread(name)
+            stack, active = _fold_stack(frame)
+            folded.append((role, stack, active))
+            if active:
+                per_role_active[role] = per_role_active.get(role, 0) + 1
+            n += 1
+        with self._lock:
+            self._ticks += 1
+            for role, stack, active in folded:
+                stacks = self._role_stacks.setdefault(role, Counter())
+                if (len(stacks) >= MAX_STACKS_PER_ROLE
+                        and stack not in stacks):
+                    stack = OVERFLOW_STACK
+                stacks[stack] += 1
+                self._role_samples[role] = (
+                    self._role_samples.get(role, 0) + 1)
+                if active:
+                    self._role_active[role] = (
+                        self._role_active.get(role, 0) + 1)
+            self._total_samples += n
+            # EWMA of per-tick active counts -> the CPU-share estimate
+            alpha = 0.1
+            seen = set(per_role_active)
+            for role in set(self._role_ewma) | seen:
+                cur = float(per_role_active.get(role, 0))
+                prev = self._role_ewma.get(role, cur)
+                self._role_ewma[role] = prev + alpha * (cur - prev)
+            ewma_total = sum(self._role_ewma.values())
+            shares = {
+                role: (v / ewma_total if ewma_total > 0 else 0.0)
+                for role, v in self._role_ewma.items()
+            }
+        if self._register:
+            for role, stack, active in folded:
+                _M_SAMPLES.inc(role=role, active="yes" if active else "no")
+            for role, share in shares.items():
+                _G_SHARE.set(share, role=role)
+        return n
+
+    # -- readout -----------------------------------------------------------
+
+    def snapshot(self, max_stacks: int = 10) -> dict:
+        """The ``getprofile`` payload: per-role sample/active counts,
+        the EWMA CPU-share estimate, and the top collapsed stacks
+        (leaf-last, ``;``-joined — flamegraph collapsed format)."""
+        with self._lock:
+            ewma_total = sum(self._role_ewma.values())
+            roles = {}
+            for role in sorted(self._role_stacks):
+                stacks = self._role_stacks[role]
+                roles[role] = {
+                    "samples": self._role_samples.get(role, 0),
+                    "active_samples": self._role_active.get(role, 0),
+                    "share": round(
+                        self._role_ewma.get(role, 0.0) / ewma_total, 4)
+                    if ewma_total > 0 else 0.0,
+                    "stacks": [
+                        {"stack": s, "count": c}
+                        for s, c in stacks.most_common(max_stacks)
+                    ],
+                }
+            return {
+                "running": self.running,
+                "hz": self.hz,
+                "duration_s": round(self._time() - self._started_at, 3),
+                "samples_total": self._total_samples,
+                "ticks": self._ticks,
+                "roles": roles,
+            }
+
+    def collapsed(self, max_stacks: int = 50) -> List[str]:
+        """``role;frame;...;leaf count`` lines, ready for flamegraph.pl
+        or speedscope's collapsed-stack importer."""
+        out: List[str] = []
+        with self._lock:
+            for role in sorted(self._role_stacks):
+                for stack, count in self._role_stacks[role].most_common(
+                        max_stacks):
+                    out.append(f"{role};{stack} {count}")
+        return out
+
+    # -- dumps -------------------------------------------------------------
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> dict:
+        """Write the profile (snapshot + full collapsed stacks) as JSON;
+        returns {path, samples, roles}."""
+        snap = self.snapshot(max_stacks=MAX_STACKS_PER_ROLE)
+        if path is None:
+            from . import flight_recorder
+
+            path = flight_recorder.default_dump_path(
+                reason, prefix="profile")
+        payload = {
+            "meta": {"time": time.time(), "pid": os.getpid(),
+                     "reason": reason},
+            "profile": snap,
+            "collapsed": self.collapsed(max_stacks=MAX_STACKS_PER_ROLE),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return {
+            "path": os.path.abspath(path),
+            "samples": snap["samples_total"],
+            "roles": sorted(snap["roles"]),
+        }
+
+
+def _fold_stack(frame) -> Tuple[str, bool]:
+    """(collapsed stack root-first leaf-last, active?) for one frame."""
+    parts: List[str] = []
+    leaf_name = ""
+    f = frame
+    for _ in range(MAX_STACK_DEPTH):
+        if f is None:
+            break
+        code = f.f_code
+        if not parts:
+            leaf_name = code.co_name
+        parts.append(
+            f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts), leaf_name not in _IDLE_LEAVES
+
+
+g_profiler = SamplingProfiler()
+
+
+def auto_dump(reason: str) -> Optional[str]:
+    """Best-effort profile dump for safe-mode entry (mirrors
+    flight_recorder.auto_dump; rides next to its dump so the post-mortem
+    has both the narrative AND where every thread was standing).  One
+    bool check when the profiler is off."""
+    if not _enabled:
+        return None
+    from ..utils.logging import log_printf
+
+    try:
+        out = g_profiler.dump(reason=reason)
+    except Exception as e:  # noqa: BLE001 — best-effort by contract
+        log_printf("profiler: auto-dump failed: %r", e)
+        return None
+    log_printf("profiler: dumped %d samples over %d roles to %s",
+               out["samples"], len(out["roles"]), out["path"])
+    return out["path"]

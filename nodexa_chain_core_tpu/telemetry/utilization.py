@@ -1,0 +1,493 @@
+"""Live roofline attribution: the device-time utilization ledger.
+
+BENCH_r05's roofline block is the map for the next 3x (DAG gather at
+28.6% of its measured ceiling) — but until now it existed only in
+offline bench runs.  This module makes the same accounting LIVE in the
+running daemon: every hot kernel already routes through the
+``ops/compile_cache.py`` choke point (verify, scan/period search, pool
+shares, DAG build, sha256d), so wrapping that one dispatch site yields
+a complete device-time ledger:
+
+- **per-call device-seconds** — the choke point times each executable
+  call (synchronized, so the window covers device execution, not just
+  dispatch) and reports it here with the kernel family + shape bucket;
+- **a bytes-moved / items-processed model per kernel**
+  (:func:`kernel_traffic`) — the same analytic per-hash constants
+  bench.py's utilization block uses (64 random 256-B DAG rows + 11,264
+  random L1 words per KawPow hash, 3.8k u32 ops per sha256d), shared
+  from here so bench and daemon can never disagree on the numerator;
+- **idle-gap attribution** — wall time between consecutive device
+  calls, attributed to the thread role (``telemetry.profiler``
+  vocabulary) that issued the *next* call: whose serving path let the
+  device sit;
+- **ceiling calibration** — measured row-gather / lane-gather ceilings
+  (bench.py's probes, relocated to ``ops/roofline.py``) persisted to a
+  calibration file keyed on the toolchain fingerprint; the daemon loads
+  it at warmup (or measures one-shot under ``-calibrate``) so the live
+  denominators are the very numbers bench measured on this image.
+
+Live gauges (computed at scrape time over a rolling window, so they
+decay honestly when the device goes quiet):
+
+- ``nodexa_device_busy_frac`` — fraction of the last window the device
+  spent inside kernel calls (in [0, 1] by construction);
+- ``nodexa_kernel_frac_of_ceiling{kernel=...}`` — achieved rate over
+  the calibrated ceiling per roofline component (``kawpow_dag_read``,
+  ``kawpow_l1_gather``, ``sha256d_alu``, ``ethash_dag_build``);
+- ``nodexa_kernel_bytes_per_s{kernel=...}`` — achieved bytes moved per
+  second per component.
+
+A **utilization-collapse watchdog** tracks a slow per-component
+baseline and flight-records a ``utilization_collapse`` event (plus
+``nodexa_utilization_collapse_total``) when the live fraction drops
+sharply below it — the "a straggler just halved the mesh" alarm the
+multi-host work (ROADMAP item 4) needs.
+
+Cost discipline: disabled (the default outside the daemon), the choke
+point checks one module-level bool and calls the executable directly —
+no clock reads, no synchronization.  Enabled, each call pays two clock
+reads, one ``block_until_ready`` (consumers fetch results right after
+anyway) and a few deque appends.
+
+Stdlib only, like the rest of ``telemetry/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from .registry import g_metrics
+
+# ------------------------------------------------- analytic traffic model
+#
+# Documented per-hash constants (NOT measurements) — the single source
+# for bench.py's utilization block and the live ledger.
+#
+# kawpow: 64 rounds x 16 lanes x (11 cache merges ~5 ops + 18 math ~7
+# ops + 4 epilogue merges ~5 ops) + 2 keccak-f800 ~= 2.1e5 u32 ops.
+KAWPOW_OPS_PER_HASH = 210_000
+KAWPOW_DAG_BYTES_PER_HASH = 64 * 256
+KAWPOW_L1_WORDS_PER_HASH = 64 * 11 * 16
+# sha256d on an 80-byte header with the first-block midstate
+# precomputed: 2 compressions ~64 rounds x ~20 ops + schedule ~= 1.9e3.
+SHA256D_OPS_PER_HASH = 3_800
+# approx: 8 sublanes x 128 lanes x ~4 ALUs x 940MHz (v5e)
+V5E_U32_OPS_PEAK = 4.0e12
+DAG_ROW_BYTES = 256
+
+# Roofline components: the `kernel` label on the live gauges and the
+# per-variant keys in bench.py's roofline block.
+COMP_DAG = "kawpow_dag_read"
+COMP_L1 = "kawpow_l1_gather"
+COMP_SHA_ALU = "sha256d_alu"
+COMP_DAG_BUILD = "ethash_dag_build"
+COMPONENTS = (COMP_DAG, COMP_L1, COMP_SHA_ALU, COMP_DAG_BUILD)
+
+# component -> (calibration key, unit scale to base-units/s, bytes per
+# base unit for the bytes_per_s gauge; 0 = not byte-denominated)
+CEILING_SPEC: Dict[str, Tuple[str, float, float]] = {
+    COMP_DAG: ("dag_row_gather_GBps", 1e9, 1.0),       # bytes
+    COMP_L1: ("l1_word_gather_Geps", 1e9, 4.0),        # u32 words
+    COMP_SHA_ALU: ("alu_u32_ops_per_s", 1.0, 0.0),     # ops
+    COMP_DAG_BUILD: ("dag_build_rows_per_s", 1.0, 256.0),  # rows
+}
+
+
+def _batch_of(label: str) -> int:
+    """Leading integer of a shape-bucket label ("2048x688" -> 2048,
+    "512" -> 512); 0 when the label carries no batch."""
+    head = label.split("x", 1)[0]
+    try:
+        return max(int(head), 0)
+    except ValueError:
+        return 0
+
+
+def kernel_traffic(kernel: str, label: str) -> Optional[dict]:
+    """The per-call traffic model for one choke-point kernel at one
+    shape bucket: ``{"items": n, "components": {component: quantity}}``
+    in base units (bytes / words / ops / rows).  The label carries the
+    PADDED bucket size — the device does the padded work, so that is
+    the honest quantity.  None for kernels outside the model."""
+    b = _batch_of(label)
+    if b <= 0:
+        return None
+    if kernel in ("progpow.verify", "progpow.search_scan",
+                  "progpow.search_period"):
+        return {"items": b, "components": {
+            COMP_DAG: b * KAWPOW_DAG_BYTES_PER_HASH,
+            COMP_L1: b * KAWPOW_L1_WORDS_PER_HASH,
+        }}
+    if kernel in ("sha256d.verify", "sha256d.search"):
+        return {"items": b, "components": {
+            COMP_SHA_ALU: b * SHA256D_OPS_PER_HASH,
+        }}
+    if kernel == "ethash.dag_build":
+        return {"items": b, "components": {COMP_DAG_BUILD: float(b)}}
+    return None
+
+
+def frac_of_ceiling(component: str, rate: float,
+                    calibration: Optional[dict]) -> Optional[float]:
+    """``rate`` (base units/s) over the calibrated ceiling, or None when
+    the calibration doesn't carry this component's ceiling.  The ONE
+    denominator both bench.py and the live gauges use."""
+    if not calibration:
+        return None
+    key, scale, _bpu = CEILING_SPEC[component]
+    ceiling = calibration.get(key)
+    if not ceiling or ceiling <= 0:
+        return None
+    return rate / (float(ceiling) * scale)
+
+
+# --------------------------------------------------- calibration persistence
+
+CALIBRATION_VERSION = "nxk-calib-1"
+CALIBRATION_BASENAME = "calibration.json"
+
+
+def default_calibration_path() -> str:
+    """$NODEXA_CALIBRATION_FILE, else the bench cache location bench.py
+    persists to (so a daemon started from the repo root after a bench
+    run picks the measured ceilings up with zero configuration)."""
+    env = os.environ.get("NODEXA_CALIBRATION_FILE")
+    if env:
+        return env
+    return os.path.join(".bench_cache", CALIBRATION_BASENAME)
+
+
+def save_calibration(values: dict, path: Optional[str] = None,
+                     fingerprint: Optional[str] = None,
+                     source: str = "probe") -> str:
+    """Persist measured ceilings (the CEILING_SPEC keys) atomically.
+    ``fingerprint`` is the toolchain identity (ops.compile_cache) the
+    numbers were measured under — a loader with a different fingerprint
+    refuses them (different hardware, different physics)."""
+    if path is None:
+        path = default_calibration_path()
+    payload = {
+        "magic": CALIBRATION_VERSION,
+        "time": time.time(),
+        "source": source,
+        "fingerprint": fingerprint,
+        "ceilings": {k: v for k, v in values.items() if v},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_calibration(path: Optional[str] = None,
+                     fingerprint: Optional[str] = None) -> Optional[dict]:
+    """The persisted ceilings dict, or None (missing/corrupt/stale/
+    fingerprint mismatch — never trusted blindly)."""
+    if path is None:
+        path = default_calibration_path()
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if payload.get("magic") != CALIBRATION_VERSION:
+        return None
+    if (fingerprint is not None
+            and payload.get("fingerprint") is not None
+            and payload["fingerprint"] != fingerprint):
+        return None
+    ceilings = payload.get("ceilings")
+    return dict(ceilings) if isinstance(ceilings, dict) else None
+
+
+# --------------------------------------------------------------- telemetry
+
+_M_DEVICE_SECONDS = g_metrics.counter(
+    "nodexa_kernel_device_seconds_total",
+    "Synchronized wall seconds spent inside device-kernel calls at the "
+    "compile-cache choke point, labeled by kernel family")
+_M_CALLS = g_metrics.counter(
+    "nodexa_kernel_calls_total",
+    "Device-kernel calls through the compile-cache choke point, "
+    "labeled by kernel family")
+_M_ITEMS = g_metrics.counter(
+    "nodexa_kernel_items_total",
+    "Items processed (hashes/headers/rows, padded-bucket sized) per "
+    "kernel family")
+_M_IDLE = g_metrics.counter(
+    "nodexa_device_idle_seconds_total",
+    "Wall seconds the device sat idle between consecutive kernel "
+    "calls, attributed to the thread role issuing the NEXT call "
+    "(gaps are capped at the ledger window so long quiet spells "
+    "don't drown the serving-path signal)")
+_H_IDLE_GAP = g_metrics.histogram(
+    "nodexa_device_idle_gap_seconds",
+    "Idle-gap distribution between consecutive device calls, labeled "
+    "by the thread role issuing the next call")
+_M_COLLAPSE = g_metrics.counter(
+    "nodexa_utilization_collapse_total",
+    "Watchdog events: a roofline component's live fraction-of-ceiling "
+    "dropped sharply below its slow baseline")
+
+
+class UtilizationLedger:
+    """Rolling-window device-time accounting behind the live gauges.
+
+    One process-global instance (``g_utilization``) registers the
+    scrape-time gauges; tests construct their own with
+    ``register_metrics=False`` and read :meth:`busy_frac` /
+    :meth:`component_rate` directly.  ``register_metrics`` gates ONLY
+    the gauge-callback registration (last-writer-wins on the global
+    registry) — the counter families and watchdog events are
+    process-global by design, like every other g_metrics counter, so
+    tests asserting on them must use before/after deltas."""
+
+    WINDOW_S = 60.0
+
+    def __init__(self, register_metrics: bool = True,
+                 time_fn=time.monotonic) -> None:
+        self._lock = threading.Lock()
+        self._time = time_fn
+        self.enabled = False
+        self.calibration: Optional[dict] = None
+        self.calibration_source: str = "none"
+        # (end_t, busy_s) per call — busy_frac's evidence.  Deques are
+        # time-pruned on intake (entries older than the window drop),
+        # with a hard cap as a memory backstop; a cap eviction raises
+        # ``_floor`` so the window math shrinks its span rather than
+        # silently under-counting (a truncated numerator over the full
+        # 60 s span would read as a utilization collapse at high call
+        # rates — exactly the false alarm the watchdog must not fire).
+        self._calls: deque = deque()
+        # component -> deque[(end_t, quantity)]
+        self._traffic: Dict[str, deque] = {
+            c: deque() for c in COMPONENTS}
+        self.max_samples = 65536
+        self._floor: float = 0.0
+        self._last_end: Optional[float] = None
+        self._enabled_at: Optional[float] = None
+        # watchdog state: component -> (baseline_frac, n_obs, last_alarm)
+        self._watchdog: Dict[str, list] = {}
+        self.collapse_ratio = 0.4
+        self.collapse_min_baseline = 0.02
+        self.collapse_cooldown_s = 60.0
+        self._bound_idle: Dict[str, object] = {}
+        if register_metrics:
+            g_metrics.gauge_fn(
+                "nodexa_device_busy_frac",
+                "Fraction of the rolling window the device spent inside "
+                "kernel calls (0 when the ledger is disabled or idle)",
+                self.busy_frac)
+            for comp in COMPONENTS:
+                g_metrics.gauge_fn(
+                    "nodexa_kernel_frac_of_ceiling",
+                    "Live achieved rate over the calibrated roofline "
+                    "ceiling, per component (0 when uncalibrated)",
+                    self._frac_fn(comp), kernel=comp)
+                g_metrics.gauge_fn(
+                    "nodexa_kernel_bytes_per_s",
+                    "Live bytes moved per second per roofline component "
+                    "over the rolling window",
+                    self._bytes_fn(comp), kernel=comp)
+
+    # -- configuration -----------------------------------------------------
+
+    def set_enabled(self, on: bool) -> None:
+        with self._lock:
+            self.enabled = bool(on)
+            self._enabled_at = self._time() if on else None
+            self._floor = 0.0
+            if not on:
+                self._calls.clear()
+                for dq in self._traffic.values():
+                    dq.clear()
+                self._last_end = None
+                self._watchdog.clear()
+
+    def set_calibration(self, ceilings: Optional[dict],
+                        source: str = "file") -> None:
+        with self._lock:
+            self.calibration = dict(ceilings) if ceilings else None
+            self.calibration_source = source if ceilings else "none"
+
+    # -- intake ------------------------------------------------------------
+
+    def record(self, kernel: str, label: str, start: float, end: float,
+               role: Optional[str] = None) -> None:
+        """One synchronized device call: [start, end) in this ledger's
+        clock domain (time.monotonic by default — the choke point reads
+        the same clock)."""
+        if not self.enabled:
+            return
+        busy = max(end - start, 0.0)
+        _M_DEVICE_SECONDS.inc(busy, kernel=kernel)
+        _M_CALLS.inc(kernel=kernel)
+        traffic = kernel_traffic(kernel, label)
+        if traffic is not None:
+            _M_ITEMS.inc(traffic["items"], kernel=kernel)
+        if role is None:
+            from .profiler import role_of_thread
+
+            role = role_of_thread(threading.current_thread().name)
+        alarm = None
+        with self._lock:
+            if self._last_end is not None:
+                gap = start - self._last_end
+                if gap > 0:
+                    bound = self._bound_idle.get(role)
+                    if bound is None:
+                        bound = self._bound_idle[role] = (
+                            _M_IDLE.labels(path=role),
+                            _H_IDLE_GAP.labels(path=role))
+                    bound[0].inc(min(gap, self.WINDOW_S))
+                    bound[1].observe(gap)
+            if end > (self._last_end or 0.0):
+                self._last_end = end
+            self._append_pruned(self._calls, end, busy)
+            if traffic is not None:
+                for comp, qty in traffic["components"].items():
+                    self._append_pruned(
+                        self._traffic[comp], end, float(qty))
+                    alarm = self._watchdog_check(comp, end) or alarm
+        if alarm is not None:
+            comp, frac, baseline = alarm
+            _M_COLLAPSE.inc(kernel=comp)
+            from .flight_recorder import record_event
+
+            record_event("utilization_collapse", kernel=comp,
+                         frac=round(frac, 4), baseline=round(baseline, 4))
+
+    def _append_pruned(self, dq: deque, end: float, value: float) -> None:
+        """Under self._lock: append and drop entries that left the
+        window; a cap eviction raises the coverage floor so windowed
+        rates divide by the span the deque actually covers."""
+        dq.append((end, value))
+        cutoff = end - self.WINDOW_S
+        while dq and dq[0][0] <= cutoff:
+            dq.popleft()
+        while len(dq) > self.max_samples:
+            evicted_end, _v = dq.popleft()
+            if evicted_end > self._floor:
+                self._floor = evicted_end
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _watchdog_check(self, comp: str, now: float):
+        """Under self._lock.  Returns (comp, frac, baseline) when the
+        component's live fraction collapsed below the slow baseline."""
+        frac = self._component_frac_locked(comp, now)
+        if frac is None:
+            return None
+        st = self._watchdog.get(comp)
+        if st is None:
+            st = self._watchdog[comp] = [frac, 1, -1e18]
+            return None
+        baseline, n, last_alarm = st
+        fired = None
+        if (n >= 16 and baseline > self.collapse_min_baseline
+                and frac < self.collapse_ratio * baseline
+                and now - last_alarm > self.collapse_cooldown_s):
+            st[2] = now
+            fired = (comp, frac, baseline)
+        # slow EWMA so one bad batch can't drag the baseline down to
+        # meet the collapse it should be alarming on
+        st[0] = baseline + 0.02 * (frac - baseline)
+        st[1] = n + 1
+        return fired
+
+    # -- readout (scrape-time) --------------------------------------------
+
+    def _window_start(self, now: float) -> float:
+        start = now - self.WINDOW_S
+        if self._enabled_at is not None:
+            start = max(start, self._enabled_at)
+        return max(start, self._floor)
+
+    def busy_frac(self) -> float:
+        """Busy fraction over the rolling window, clamped to [0, 1]."""
+        with self._lock:
+            if not self.enabled:
+                return 0.0
+            now = self._time()
+            w0 = self._window_start(now)
+            span = now - w0
+            if span <= 0:
+                return 0.0
+            busy = 0.0
+            for end, b in self._calls:
+                if end <= w0:
+                    continue
+                busy += min(b, end - w0)
+            return min(max(busy / span, 0.0), 1.0)
+
+    def component_rate(self, comp: str) -> float:
+        """Base units per second over the rolling window."""
+        with self._lock:
+            return self._component_rate_locked(comp, self._time())
+
+    def _component_rate_locked(self, comp: str, now: float) -> float:
+        if not self.enabled:
+            return 0.0
+        w0 = self._window_start(now)
+        span = now - w0
+        if span <= 0:
+            return 0.0
+        total = sum(q for end, q in self._traffic[comp] if end > w0)
+        return total / span
+
+    def _component_frac_locked(self, comp: str,
+                               now: float) -> Optional[float]:
+        rate = self._component_rate_locked(comp, now)
+        return frac_of_ceiling(comp, rate, self.calibration)
+
+    def component_frac(self, comp: str) -> Optional[float]:
+        with self._lock:
+            return self._component_frac_locked(comp, self._time())
+
+    def _frac_fn(self, comp: str):
+        def fn() -> float:
+            v = self.component_frac(comp)
+            return 0.0 if v is None else v
+        return fn
+
+    def _bytes_fn(self, comp: str):
+        bpu = CEILING_SPEC[comp][2]
+
+        def fn() -> float:
+            return self.component_rate(comp) * bpu
+        return fn
+
+    def snapshot(self) -> dict:
+        """Operator summary (rides getstartupinfo's compile_cache dict
+        sibling and tools)."""
+        out = {
+            "enabled": self.enabled,
+            "busy_frac": round(self.busy_frac(), 4),
+            "calibration_source": self.calibration_source,
+            "calibration": dict(self.calibration)
+            if self.calibration else None,
+            "components": {},
+        }
+        for comp in COMPONENTS:
+            frac = self.component_frac(comp)
+            out["components"][comp] = {
+                "rate_units_per_s": round(self.component_rate(comp), 2),
+                "frac_of_ceiling": round(frac, 4)
+                if frac is not None else None,
+            }
+        return out
+
+
+g_utilization = UtilizationLedger()
+
+
+def utilization_enabled() -> bool:
+    """The choke point's fast-path check (one attribute read)."""
+    return g_utilization.enabled

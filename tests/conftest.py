@@ -45,3 +45,14 @@ def _fault_and_health_isolation():
     # a test that pointed flight-recorder dumps at its tmp_path must not
     # leave later safe-mode auto-dumps aiming at a deleted directory
     flight_recorder.set_dump_dir(None)
+    # profiler/utilization are process-global like g_metrics: a test
+    # that started the sampler or enabled the device-time ledger must
+    # not bill its threads/calls to the next test
+    from nodexa_chain_core_tpu.telemetry.profiler import g_profiler
+    from nodexa_chain_core_tpu.telemetry.utilization import g_utilization
+
+    if g_profiler.running:
+        g_profiler.stop()
+    if g_utilization.enabled:
+        g_utilization.set_enabled(False)
+        g_utilization.set_calibration(None)

@@ -1,0 +1,691 @@
+"""Live roofline attribution + sampling profiler (ISSUE 11).
+
+Covers the device-time ledger's math against known synthetic kernel
+calls, calibration persistence round-trips, the traffic model shared
+with bench.py, idle-gap attribution, the utilization-collapse watchdog,
+the profiler's thread-role attribution during a loopback pool session,
+the kill-switch zero-cost early-exit (the PR-8 span-switch contract),
+the getprofile RPC + safe-mode allowlist, exposition conformance for
+every new series, and both nodexa_top layouts (with and without the
+pool/mesh metric families).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import timeit
+from types import SimpleNamespace
+
+import pytest
+
+from nodexa_chain_core_tpu.telemetry import flight_recorder, g_metrics
+from nodexa_chain_core_tpu.telemetry import utilization as uz
+from nodexa_chain_core_tpu.telemetry.profiler import (
+    SamplingProfiler,
+    g_profiler,
+    role_of_thread,
+)
+from nodexa_chain_core_tpu.telemetry.utilization import (
+    COMP_DAG,
+    COMP_L1,
+    COMP_SHA_ALU,
+    KAWPOW_DAG_BYTES_PER_HASH,
+    KAWPOW_L1_WORDS_PER_HASH,
+    SHA256D_OPS_PER_HASH,
+    UtilizationLedger,
+    frac_of_ceiling,
+    kernel_traffic,
+    load_calibration,
+    save_calibration,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_ledger(clock=None, calibration=None):
+    led = UtilizationLedger(register_metrics=False,
+                            time_fn=clock or FakeClock())
+    led.set_enabled(True)
+    if calibration:
+        led.set_calibration(calibration, source="test")
+    return led
+
+
+# ------------------------------------------------------------ traffic model
+
+
+def test_kernel_traffic_model_matches_bench_constants():
+    t = kernel_traffic("progpow.verify", "2048x688")
+    assert t["items"] == 2048
+    assert t["components"][COMP_DAG] == 2048 * KAWPOW_DAG_BYTES_PER_HASH
+    assert t["components"][COMP_L1] == 2048 * KAWPOW_L1_WORDS_PER_HASH
+    t = kernel_traffic("progpow.search_period", "32768")
+    assert t["items"] == 32768
+    t = kernel_traffic("sha256d.verify", "512")
+    assert t["components"][COMP_SHA_ALU] == 512 * SHA256D_OPS_PER_HASH
+    t = kernel_traffic("ethash.dag_build", "16384")
+    assert t["items"] == 16384
+    assert kernel_traffic("unknown.kernel", "64") is None
+    assert kernel_traffic("progpow.verify", "") is None
+
+
+def test_frac_of_ceiling_units():
+    calib = {"dag_row_gather_GBps": 20.85, "l1_word_gather_Geps": 11.0,
+             "alu_u32_ops_per_s": 4.0e12}
+    # 5.96 GB/s against a 20.85 GB/s ceiling: the BENCH_r05 0.286
+    assert frac_of_ceiling(COMP_DAG, 5.96e9, calib) == pytest.approx(
+        0.286, abs=0.001)
+    assert frac_of_ceiling(COMP_L1, 11.0e9, calib) == pytest.approx(1.0)
+    assert frac_of_ceiling(COMP_SHA_ALU, 2.0e12, calib) == pytest.approx(0.5)
+    assert frac_of_ceiling(COMP_DAG, 1.0, None) is None
+    assert frac_of_ceiling(COMP_DAG, 1.0, {}) is None
+
+
+# ------------------------------------------------------------- ledger math
+
+
+def test_ledger_busy_frac_and_rates_from_synthetic_calls():
+    clock = FakeClock(1000.0)
+    calib = {"dag_row_gather_GBps": 10.0, "l1_word_gather_Geps": 10.0}
+    led = make_ledger(clock, calib)
+    # 3 verify calls of 1s each inside a 10s window -> busy 0.3
+    for i in range(3):
+        start = 1000.0 + 1 + i * 3
+        led.record("progpow.verify", "2048x688", start, start + 1.0,
+                   role="pool-shares")
+    clock.t = 1010.0
+    assert led.busy_frac() == pytest.approx(0.3, abs=0.01)
+    # windowed DAG rate: 3 * 2048 * 16384 bytes over the 10s window
+    want = 3 * 2048 * KAWPOW_DAG_BYTES_PER_HASH / 10.0
+    assert led.component_rate(COMP_DAG) == pytest.approx(want, rel=1e-6)
+    assert led.component_frac(COMP_DAG) == pytest.approx(
+        want / 10.0e9, rel=1e-6)
+    # counters moved under the right kernel label
+    assert g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="progpow.verify") >= 3
+    assert g_metrics.get("nodexa_kernel_device_seconds_total").value(
+        kernel="progpow.verify") >= 3.0
+    assert g_metrics.get("nodexa_kernel_items_total").value(
+        kernel="progpow.verify") >= 3 * 2048
+
+
+def test_ledger_busy_frac_clamped_and_decays():
+    clock = FakeClock(2000.0)
+    led = make_ledger(clock)
+    # overlapping/adjacent calls can't push the fraction past 1
+    for i in range(100):
+        led.record("progpow.verify", "64x32", 2000.0, 2001.0, role="x")
+    clock.t = 2001.0
+    assert 0.0 <= led.busy_frac() <= 1.0
+    # far outside the window the fraction decays to 0
+    clock.t = 2000.0 + led.WINDOW_S * 3
+    assert led.busy_frac() == 0.0
+    assert led.component_rate(COMP_DAG) == 0.0
+
+
+def test_ledger_disabled_records_nothing():
+    clock = FakeClock()
+    led = UtilizationLedger(register_metrics=False, time_fn=clock)
+    before = g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="progpow.verify")
+    led.record("progpow.verify", "64x32", 1.0, 2.0, role="x")
+    assert g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="progpow.verify") == before
+    assert led.busy_frac() == 0.0
+
+
+def test_idle_gap_attributed_to_next_caller_role():
+    clock = FakeClock(3000.0)
+    led = make_ledger(clock)
+    idle = g_metrics.get("nodexa_device_idle_seconds_total")
+    base_pool = idle.value(path="pool-shares")
+    base_val = idle.value(path="validation")
+    led.record("progpow.verify", "64x32", 3000.0, 3001.0, role="mining")
+    # 2s gap, next call issued by pool-shares -> billed to pool-shares
+    led.record("progpow.verify", "64x32", 3003.0, 3004.0,
+               role="pool-shares")
+    # 0.5s gap, next call from validation
+    led.record("sha256d.verify", "512", 3004.5, 3005.0, role="validation")
+    assert idle.value(path="pool-shares") - base_pool == pytest.approx(2.0)
+    assert idle.value(path="validation") - base_val == pytest.approx(0.5)
+    hist = g_metrics.get("nodexa_device_idle_gap_seconds")
+    snap = hist.snapshot(path="pool-shares")
+    assert snap is not None and snap["count"] >= 1
+
+
+def test_ledger_derives_role_from_thread_name():
+    clock = FakeClock(4000.0)
+    led = make_ledger(clock)
+    idle = g_metrics.get("nodexa_device_idle_seconds_total")
+    base = idle.value(path="pool-io")
+    done = threading.Event()
+
+    def work():
+        led.record("progpow.verify", "64x32", 4000.0, 4001.0)
+        led.record("progpow.verify", "64x32", 4002.0, 4003.0)
+        done.set()
+
+    t = threading.Thread(target=work, name="pool-io", daemon=True)
+    t.start()
+    assert done.wait(5.0)
+    assert idle.value(path="pool-io") - base == pytest.approx(1.0)
+
+
+# ----------------------------------------------------- calibration persist
+
+
+def test_calibration_round_trip(tmp_path):
+    path = str(tmp_path / "calibration.json")
+    values = {"dag_row_gather_GBps": 20.85, "l1_word_gather_Geps": 11.29,
+              "alu_u32_ops_per_s": 4.0e12}
+    out = save_calibration(values, path=path, fingerprint="abc123",
+                           source="test")
+    assert out == path and os.path.exists(path)
+    assert load_calibration(path, fingerprint="abc123") == values
+    # fingerprint mismatch -> refused (different hardware)
+    assert load_calibration(path, fingerprint="zzz") is None
+    # no fingerprint requirement -> accepted
+    assert load_calibration(path) == values
+
+
+def test_calibration_corrupt_and_missing(tmp_path):
+    assert load_calibration(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert load_calibration(str(bad)) is None
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"magic": "other", "ceilings": {"x": 1}}))
+    assert load_calibration(str(wrong)) is None
+
+
+def test_default_calibration_path_env(monkeypatch, tmp_path):
+    p = str(tmp_path / "c.json")
+    monkeypatch.setenv("NODEXA_CALIBRATION_FILE", p)
+    assert uz.default_calibration_path() == p
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_flight_records_collapse():
+    clock = FakeClock(5000.0)
+    calib = {"dag_row_gather_GBps": 1.0}  # tiny ceiling: fracs are high
+    led = make_ledger(clock, calib)
+    led.collapse_cooldown_s = 0.0
+    counter = g_metrics.get("nodexa_utilization_collapse_total")
+    base = counter.value(kernel=COMP_DAG)
+    # healthy phase: steady 1s calls, builds a baseline over >=16 obs
+    for i in range(20):
+        start = clock.t + 0.01
+        led.record("progpow.verify", "32768x688", start, start + 1.0,
+                   role="mining")
+        clock.t = start + 1.0
+    healthy = led.component_frac(COMP_DAG)
+    assert healthy is not None and healthy > led.collapse_min_baseline
+    # collapse: jump the clock so the windowed rate craters, then one
+    # straggler call triggers the check
+    clock.t += led.WINDOW_S * 0.95
+    led.record("progpow.verify", "64x688", clock.t, clock.t + 0.001,
+               role="mining")
+    assert counter.value(kernel=COMP_DAG) - base >= 1
+    evts = [e for e in flight_recorder.events_snapshot()
+            if e["kind"] == "utilization_collapse"]
+    assert evts and evts[-1]["kernel"] == COMP_DAG
+    assert evts[-1]["frac"] < evts[-1]["baseline"]
+
+
+# ------------------------------------------------------ choke-point hookup
+
+
+def test_compile_cache_choke_point_feeds_ledger():
+    """A real CachedKernel call with the global ledger enabled must land
+    device-seconds + items under its kernel label."""
+    jnp = pytest.importorskip("jax.numpy")
+    from nodexa_chain_core_tpu.ops.compile_cache import CompileCache
+    from nodexa_chain_core_tpu.telemetry.utilization import g_utilization
+
+    cache = CompileCache()
+    kern = cache.wrap("progpow.verify", lambda x: x * 2,
+                      label=lambda args: f"{args[0].shape[0]}x688")
+    x = jnp.arange(64, dtype=jnp.uint32)
+    kern(x)  # first call: compile window, not billed to the ledger
+    calls = g_metrics.get("nodexa_kernel_calls_total")
+    secs = g_metrics.get("nodexa_kernel_device_seconds_total")
+    base_calls = calls.value(kernel="progpow.verify")
+    base_secs = secs.value(kernel="progpow.verify")
+    g_utilization.set_enabled(True)
+    try:
+        kern(x)
+        kern(x)
+    finally:
+        g_utilization.set_enabled(False)
+    assert calls.value(kernel="progpow.verify") - base_calls == 2
+    assert secs.value(kernel="progpow.verify") >= base_secs
+    assert g_metrics.get("nodexa_kernel_items_total").value(
+        kernel="progpow.verify") >= 128
+
+
+def test_choke_point_disabled_is_direct_dispatch():
+    """Utilization off: steady-state CachedKernel calls must not read
+    clocks or touch the ledger (one bool check)."""
+    jnp = pytest.importorskip("jax.numpy")
+    from nodexa_chain_core_tpu.ops.compile_cache import CompileCache
+    from nodexa_chain_core_tpu.telemetry.utilization import g_utilization
+
+    assert not g_utilization.enabled
+    cache = CompileCache()
+    kern = cache.wrap("sha256d.verify", lambda x: x + 1, label="64")
+    x = jnp.arange(64, dtype=jnp.uint32)
+    kern(x)
+    before = g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="sha256d.verify")
+    kern(x)
+    assert g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="sha256d.verify") == before
+
+
+# ---------------------------------------------------------------- profiler
+
+
+def test_role_of_thread_mapping():
+    assert role_of_thread("pool-io") == "pool-io"
+    assert role_of_thread("pool-shares") == "pool-shares"
+    assert role_of_thread("pool-jobs") == "pool-jobs"
+    assert role_of_thread("scriptcheck.3") == "scriptcheck"
+    assert role_of_thread("blk-readahead") == "readahead"
+    assert role_of_thread("net.msghand") == "validation"
+    assert role_of_thread("net.peer7") == "net"
+    assert role_of_thread("miner-0") == "mining"
+    assert role_of_thread("epoch-412") == "epoch-build"
+    assert role_of_thread("httprpc") == "rpc"
+    assert role_of_thread("MainThread") == "main"
+    assert role_of_thread("weird-thread") == "other"
+
+
+def _spin_and_wait_threads(stop: threading.Event):
+    """Named worker threads: two busy (on-CPU leaves), one parked in a
+    blocking wait (idle leaf)."""
+    def busy():
+        x = 0
+        while not stop.is_set():
+            x += 1
+        return x
+
+    def parked():
+        stop.wait(30.0)
+
+    threads = [
+        threading.Thread(target=busy, name="pool-shares", daemon=True),
+        threading.Thread(target=busy, name="scriptcheck.0", daemon=True),
+        threading.Thread(target=parked, name="pool-io", daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def test_profiler_role_attribution_and_idle_classification():
+    prof = SamplingProfiler(register_metrics=False)
+    stop = threading.Event()
+    threads = _spin_and_wait_threads(stop)
+    try:
+        time.sleep(0.05)  # let the threads reach their loops
+        import sys as _sys
+
+        for _ in range(25):
+            # explicit frames bypass the module kill switch: the test
+            # drives sampling without starting the global sampler
+            prof.sample_once(frames=_sys._current_frames(),
+                             names={t.ident: t.name
+                                    for t in threading.enumerate()})
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+    snap = prof.snapshot(max_stacks=5)
+    roles = snap["roles"]
+    assert {"pool-shares", "scriptcheck", "pool-io"} <= set(roles)
+    assert roles["pool-shares"]["samples"] > 0
+    assert roles["scriptcheck"]["samples"] > 0
+    # the busy threads must be classified active; the parked one idle
+    assert roles["pool-shares"]["active_samples"] > 0
+    assert roles["pool-io"]["active_samples"] == 0, roles["pool-io"]
+    # collapsed lines: "role;frames... count"
+    lines = prof.collapsed(max_stacks=3)
+    assert lines and all(" " in ln and ";" in ln for ln in lines)
+    assert any(ln.startswith("pool-shares;") for ln in lines)
+    # shares: only the busy roles split the CPU estimate
+    assert roles["pool-io"]["share"] == 0.0
+    total_share = sum(r["share"] for r in roles.values())
+    assert total_share == pytest.approx(1.0, abs=0.05)
+
+
+def test_profiler_loopback_pool_session(monkeypatch):
+    """Role attribution during a REAL loopback stratum session: the
+    pool-io/pool-shares/pool-jobs threads plus the client's main thread
+    must all collect samples (the acceptance's >=4 distinct roles)."""
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.crypto import kawpow
+    from nodexa_chain_core_tpu.node import chainparams
+    from nodexa_chain_core_tpu.pool import (
+        JobManager,
+        SharePipeline,
+        StratumServer,
+    )
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+    from tests.test_pool_stratum import Client
+
+    monkeypatch.setattr(
+        kawpow, "kawpow_hash",
+        lambda height, hh_le, nonce: (1 << 200, 0xFEED))
+    params = chainparams.select_params("kawpowregtest")
+    try:
+        cs = ChainState(params)
+        spk = p2pkh_script(KeyID(KeyStore().add_key(0xFACE))).raw
+        node = SimpleNamespace(
+            params=params, chainstate=cs, mempool=None,
+            epoch_manager=None, wallet=None, connman=None,
+        )
+        jobs = JobManager(node, spk)
+        pipeline = SharePipeline(node, batch_window_s=0.002)
+        srv = StratumServer(node, jobs, pipeline, host="127.0.0.1", port=0)
+        srv.start()
+        assert g_profiler.start(200.0)  # fast ticks: short session
+        try:
+            c = Client(srv.port)
+            extranonce1 = c.subscribe_authorize("prof")
+            job_id = c.wait_notify()["params"][0]
+            for i in range(5):
+                nonce = (extranonce1 << 48) | (0x1000 + i)
+                c.rpc(10 + i, "mining.submit",
+                      ["prof", job_id, f"{nonce:016x}", f"{0xABCD:064x}"])
+            time.sleep(0.1)  # a few more sampler ticks over the threads
+            c.close()
+        finally:
+            prof = rpc_misc.getprofile(None, [5])
+            g_profiler.stop()
+            srv.stop()
+    finally:
+        chainparams.select_params("regtest")
+    roles = {r for r, d in prof["roles"].items() if d["samples"] > 0}
+    assert {"pool-io", "pool-shares", "pool-jobs", "main"} <= roles, roles
+    assert len(roles) >= 4
+    assert prof["samples_total"] > 0
+    assert prof["collapsed"]
+
+
+def test_profiler_kill_switch_zero_cost_early_exit():
+    """-profilehz=0 contract (the PR-8 span-switch discipline): start()
+    refuses, no sampler thread exists, and sample_once() early-exits on
+    one module bool — microbenched well under the enabled cost."""
+    assert not g_profiler.running
+    assert g_profiler.start(0) is False
+    assert g_profiler.start(-5) is False
+    assert not g_profiler.running
+
+    prof = SamplingProfiler(register_metrics=False)
+
+    def disabled():
+        g_profiler.sample_once()
+
+    import sys as _sys
+
+    frames = _sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+
+    def enabled():
+        prof.sample_once(frames=frames, names=names)
+
+    n, reps = 2000, 5
+    dis = min(timeit.repeat(disabled, number=n, repeat=reps))
+    ena = min(timeit.repeat(enabled, number=n, repeat=reps))
+    # the disabled path must be FAR cheaper than a real sample fold
+    assert dis < ena * 0.2, (dis, ena)
+
+
+def test_secondary_profiler_stop_does_not_kill_global_sampling():
+    """Review fix: a test-local profiler's start()/stop() must not flip
+    the GLOBAL profiler's kill switch (the module bool tracks g_profiler
+    only; instances carry their own flag)."""
+    from nodexa_chain_core_tpu.telemetry import profiler as pmod
+
+    assert g_profiler.start(100.0)
+    try:
+        local = SamplingProfiler(register_metrics=False)
+        assert local.start(50.0)
+        local.stop()
+        # the global switch must still be on and samples still accrue
+        assert pmod.profiler_enabled()
+        before = g_profiler.snapshot(1)["samples_total"]
+        time.sleep(0.1)
+        assert g_profiler.snapshot(1)["samples_total"] > before
+    finally:
+        g_profiler.stop()
+    assert not pmod.profiler_enabled()
+
+
+def test_ledger_cap_eviction_raises_floor_not_rate():
+    """Review fix: when the sample cap evicts in-window entries, the
+    window span shrinks to what the deque covers — a sustained high
+    call rate must NOT read as a utilization collapse."""
+    clock = FakeClock(9000.0)
+    led = make_ledger(clock, {"dag_row_gather_GBps": 1000.0})
+    led.max_samples = 50
+    # 500 back-to-back calls, far more than the cap, all inside 10s
+    for i in range(500):
+        start = 9000.0 + i * 0.02
+        led.record("progpow.verify", "64x688", start, start + 0.02,
+                   role="mining")
+    clock.t = 9000.0 + 500 * 0.02
+    # only the newest 50 calls survive, but the span shrank with them:
+    # the busy fraction still reads ~1.0, not 50/500
+    assert led.busy_frac() > 0.9
+    rate = led.component_rate(COMP_DAG)
+    per_call = 64 * KAWPOW_DAG_BYTES_PER_HASH
+    assert rate == pytest.approx(per_call / 0.02, rel=0.1)
+
+
+def test_profiler_dump_and_safe_mode_autodump(tmp_path):
+    from nodexa_chain_core_tpu.node.health import g_health
+    from nodexa_chain_core_tpu.telemetry import profiler
+
+    flight_recorder.set_dump_dir(str(tmp_path))
+    assert g_profiler.start(100.0)
+    try:
+        time.sleep(0.05)
+        g_health.critical_error("kvstore.write_batch", OSError(5, "boom"))
+        snap = g_health.snapshot()
+        prof_path = snap["last_critical_error"].get("profile_dump")
+        assert prof_path and os.path.exists(prof_path)
+        with open(prof_path) as f:
+            payload = json.load(f)
+        assert payload["meta"]["reason"] == "safe-mode"
+        assert "profile" in payload and "collapsed" in payload
+        # and it landed NEXT TO the flight-recorder dump
+        assert os.path.dirname(prof_path) == str(tmp_path)
+        assert list(tmp_path.glob("flightrecorder-*-safe-mode.json"))
+    finally:
+        g_profiler.stop()
+        g_health.join_halt()
+    # off: auto_dump is a single bool check returning None
+    assert profiler.auto_dump("safe-mode") is None
+
+
+# ------------------------------------------------------------ RPC surface
+
+
+def test_getprofile_rpc_registered_and_safe_mode_readable():
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.safemode import (
+        MUTATING_COMMANDS,
+        READONLY_DIAGNOSTIC_COMMANDS,
+        reject_if_locked_down,
+    )
+    from nodexa_chain_core_tpu.rpc.server import RPCError, RPCTable
+
+    table = register_all(RPCTable())
+    assert "getprofile" in table.commands()
+    out = rpc_misc.getprofile(None, [])
+    assert set(out) >= {"running", "hz", "samples_total", "roles",
+                        "collapsed"}
+    with pytest.raises(RPCError):
+        rpc_misc.getprofile(None, ["not-a-number"])
+    # the read-only allowlist keeps the diagnostic surface out of every
+    # lockdown: disjoint from the mutating set, and the dispatch gate
+    # passes them regardless of health mode
+    assert {"getprofile", "getmetrics", "gettrace"} <= (
+        READONLY_DIAGNOSTIC_COMMANDS)
+    assert not (READONLY_DIAGNOSTIC_COMMANDS & MUTATING_COMMANDS)
+    for cmd in ("getprofile", "getmetrics", "gettrace"):
+        reject_if_locked_down(cmd)  # must not raise in ANY mode
+
+
+def test_getstartupinfo_carries_utilization_snapshot():
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+
+    info = rpc_misc.getstartupinfo(None, [])
+    u = info["utilization"]
+    assert set(u) >= {"enabled", "busy_frac", "components",
+                      "calibration_source"}
+    assert set(u["components"]) == set(uz.COMPONENTS)
+
+
+# -------------------------------------------------- exposition conformance
+
+
+def test_new_series_exposition_conformance():
+    """Every new family round-trips the strict Prometheus parser from
+    test_telemetry (labels decoded, histogram buckets monotone)."""
+    from nodexa_chain_core_tpu.telemetry import prometheus_text
+    from tests.test_telemetry import _parse_exposition
+    from nodexa_chain_core_tpu.telemetry.utilization import g_utilization
+
+    # touch every new family so it has samples
+    g_utilization.set_enabled(True)
+    try:
+        g_utilization.record("progpow.verify", "64x32", 1.0, 2.0,
+                             role="pool-shares")
+        g_utilization.record("sha256d.verify", "64", 3.0, 3.5,
+                             role="validation")
+    finally:
+        g_utilization.set_enabled(False)
+    prof = SamplingProfiler(register_metrics=True)
+    import sys as _sys
+
+    prof.sample_once(frames=_sys._current_frames(),
+                     names={t.ident: t.name
+                            for t in threading.enumerate()})
+    text = prometheus_text()
+    families, samples = _parse_exposition(text)
+    names = {n for n, _ls, _v in samples}
+    for want in (
+        "nodexa_kernel_device_seconds_total",
+        "nodexa_kernel_calls_total",
+        "nodexa_kernel_items_total",
+        "nodexa_device_idle_seconds_total",
+        "nodexa_device_busy_frac",
+        "nodexa_kernel_frac_of_ceiling",
+        "nodexa_kernel_bytes_per_s",
+        "nodexa_profiler_samples_total",
+        "nodexa_profiler_role_share",
+    ):
+        base = want
+        assert any(n == base or n.startswith(base + "_")
+                   for n in names), (want, sorted(
+                       n for n in names if "kernel" in n or "prof" in n))
+    # the busy-frac gauge is a scrape-time callback: finite, in [0,1]
+    busy = [float(v) for n, _ls, v in samples
+            if n == "nodexa_device_busy_frac"]
+    assert busy and all(math.isfinite(v) and 0 <= v <= 1 for v in busy)
+
+
+# -------------------------------------------------------- nodexa_top panes
+
+
+def _load_top():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "nodexa_top_uptest", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "nodexa_top.py"))
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    return top
+
+
+def test_nodexa_top_full_layout_with_utilization_and_profiler():
+    top = _load_top()
+
+    def g(value, **labels):
+        return {"values": [{"labels": labels, "value": value}]}
+
+    snap = {
+        "nodexa_node_health": g(0.0),
+        "nodexa_mesh_devices": g(8),
+        "nodexa_pool_sessions": g(3),
+        "nodexa_pool_workers": g(3),
+        "nodexa_pool_shares_total": g(10, result="accepted"),
+        "nodexa_device_busy_frac": g(0.42),
+        "nodexa_kernel_frac_of_ceiling": {
+            "values": [
+                {"labels": {"kernel": "kawpow_dag_read"}, "value": 0.29},
+                {"labels": {"kernel": "kawpow_l1_gather"}, "value": 0.95},
+            ]
+        },
+        "nodexa_kernel_bytes_per_s": g(5.9e9, kernel="kawpow_dag_read"),
+        "nodexa_device_idle_seconds_total": g(12.0, path="pool-shares"),
+        "nodexa_utilization_collapse_total": g(1),
+        "nodexa_profiler_role_share": {
+            "values": [
+                {"labels": {"role": "pool-shares"}, "value": 0.6},
+                {"labels": {"role": "validation"}, "value": 0.4},
+            ]
+        },
+        "nodexa_profiler_samples_total": g(500, role="pool-shares",
+                                           active="yes"),
+    }
+    frame = top.render(snap, None, 2.0)
+    assert "busy 42%" in frame
+    assert "kawpow_dag_read=29%" in frame
+    assert "pool-shares=12s" in frame
+    assert "collapse=1" in frame
+    assert "pool-shares=60%" in frame and "validation=40%" in frame
+    assert "500 samples" in frame
+
+
+def test_nodexa_top_minimal_layout_renders_dashes():
+    """A daemon without -pool/-tpukawpow/-profilehz: the panes whose
+    families are absent must render '-', and render() must not raise."""
+    top = _load_top()
+    snap = {"nodexa_node_health": {
+        "values": [{"labels": {}, "value": 0.0}]}}
+    frame = top.render(snap, None, 2.0)
+    assert "mesh: -" in frame
+    assert "pool: -" in frame
+    assert "shares: -" in frame
+    assert "device: -" in frame
+    assert "prof: -" in frame
+    # and a frame against a COMPLETELY empty snapshot still renders
+    assert top.render({}, None, 2.0)
+
+
+def test_have_helper_detects_families():
+    top = _load_top()
+    snap = {"nodexa_pool_sessions": {"values": []}}
+    assert top.have(snap, "nodexa_pool_sessions")
+    assert top.have(snap, "nodexa_missing", "nodexa_pool_sessions")
+    assert not top.have(snap, "nodexa_missing")

@@ -46,6 +46,9 @@ EXPECTED_EXTRAS = {
     # causal observability: trace retrieval, flight-recorder dump, boot
     # attribution (telemetry/tracing + flight_recorder + startup)
     "gettrace", "dumpflightrecorder", "getstartupinfo",
+    # always-on sampling profiler (telemetry/profiler; safe-mode
+    # readable via rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS)
+    "getprofile",
     # fault-tolerance surface: health mode, critical errors, self-check
     "getnodehealth",
     # stratum work-server subsystem (pool/)

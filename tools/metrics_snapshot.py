@@ -84,6 +84,35 @@ delta's
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_mesh.json | python -m json.tool | grep -E "mesh|residency"
 
+Diffing a utilization interval (the live roofline ledger): snapshot
+before and after a serving interval, then read the delta's
+`nodexa_kernel_*` prefix —
+
+  nodexa_kernel_device_seconds_total{kernel=...} and
+  nodexa_kernel_calls_total{kernel=...}
+      — where the device-seconds actually went, per kernel family
+      (verify vs scan vs per-period search vs DAG build vs sha256d);
+      divide seconds by calls for the per-dispatch cost
+  nodexa_kernel_items_total{kernel=...}
+      — padded-bucket items processed; items/second against
+      nodexa_kernel_device_seconds is the achieved per-kernel rate
+  nodexa_kernel_frac_of_ceiling{kernel=kawpow_dag_read|kawpow_l1_gather
+      |sha256d_alu|ethash_dag_build} (gauge pair)
+      — the LIVE roofline fractions against the calibrated ceilings
+      (bench.py's dag_frac_of_measured_row_gather_ceiling, live);
+      kawpow_dag_read far below its bench twin means the serving path
+      is dispatch-bound, not gather-bound
+  nodexa_device_idle_seconds_total{path=...}
+      — idle gaps between device calls attributed to the thread role
+      issuing the next call: whose serving path let the device sit
+  nodexa_device_busy_frac (gauge pair)
+      — device duty cycle over the rolling window
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_util.json
+  ... serve shares / sync headers for a minute ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_util.json | python -m json.tool | grep -A6 nodexa_kernel
+
 Diffing a tx flood (the PR-4 staged-admission proof): snapshot before
 relaying a burst of transactions at the node and after the mempool
 settles, then read the delta's

@@ -53,6 +53,14 @@ def _values(snap: dict, name: str):
     return snap.get(name, {}).get("values", [])
 
 
+def have(snap: dict, *names: str) -> bool:
+    """True when ANY of the metric families is present in the snapshot.
+    A daemon running without -pool / -tpukawpow never registers those
+    subsystems' families: render() shows '-' for the whole pane instead
+    of fabricating zeros (or raising)."""
+    return any(name in snap for name in names)
+
+
 def series_total(snap: dict, name: str, **labels) -> float:
     """Sum of a counter/gauge family's samples matching ``labels``."""
     total = 0.0
@@ -134,22 +142,26 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f"{BOLD}nodexa_top{RESET}  {time.strftime('%H:%M:%S')}   "
         f"health: {color}{mode_str}{RESET}")
 
-    # serving geometry + path mix
-    devices = int(series_total(snap, "nodexa_mesh_devices"))
-    shape = by_label(snap, "nodexa_mesh_shape", "axis")
-    pow_paths = by_label(snap, "nodexa_pow_batches_total", "path")
-    hdr_paths = by_label(
-        snap, "nodexa_headers_pow_verified_total", "path")
-    path_mix = ", ".join(
-        f"{k or '?'}={int(v)}" for k, v in sorted(pow_paths.items())
-    ) or "none"
-    hdr_mix = ", ".join(
-        f"{k or '?'}={int(v)}" for k, v in sorted(hdr_paths.items())
-    ) or "none"
-    lines.append(
-        f"  mesh: {devices or 1} device(s) "
-        f"{int(shape.get('headers', 1))}x{int(shape.get('lanes', 1))}  "
-        f"pow batches [{path_mix}]  headers [{hdr_mix}]")
+    # serving geometry + path mix (absent without -tpukawpow: '-')
+    if have(snap, "nodexa_mesh_devices", "nodexa_pow_batches_total",
+            "nodexa_headers_pow_verified_total"):
+        devices = int(series_total(snap, "nodexa_mesh_devices"))
+        shape = by_label(snap, "nodexa_mesh_shape", "axis")
+        pow_paths = by_label(snap, "nodexa_pow_batches_total", "path")
+        hdr_paths = by_label(
+            snap, "nodexa_headers_pow_verified_total", "path")
+        path_mix = ", ".join(
+            f"{k or '?'}={int(v)}" for k, v in sorted(pow_paths.items())
+        ) or "none"
+        hdr_mix = ", ".join(
+            f"{k or '?'}={int(v)}" for k, v in sorted(hdr_paths.items())
+        ) or "none"
+        lines.append(
+            f"  mesh: {devices or 1} device(s) "
+            f"{int(shape.get('headers', 1))}x{int(shape.get('lanes', 1))}  "
+            f"pow batches [{path_mix}]  headers [{hdr_mix}]")
+    else:
+        lines.append("  mesh: -")
 
     # hashrate: built-in miner + pool fleet estimate
     miner_hs = series_total(snap, "nodexa_miner_hashes_per_second")
@@ -162,19 +174,62 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f" / pool "
         f"{int(series_total(snap, 'nodexa_pool_blocks_found_total'))}")
 
-    # stratum ledger
-    sessions = int(series_total(snap, "nodexa_pool_sessions"))
-    workers = int(series_total(snap, "nodexa_pool_workers"))
-    verdicts = by_label(snap, "nodexa_pool_shares_total", "result")
-    share_line = "  ".join(
-        f"{k}={int(v)}" for k, v in sorted(verdicts.items()) if v
-    ) or "no shares yet"
-    _, bmean, bp99 = hist_stats(snap, "nodexa_pool_share_batch_seconds")
-    lines.append(
-        f"  pool: {sessions} sessions / {workers} workers   "
-        f"accepted {rate('nodexa_pool_shares_total', result='accepted')}   "
-        f"batch mean {fmt_ms(bmean)} p99 {fmt_ms(bp99)}")
-    lines.append(f"  shares: {share_line}")
+    # stratum ledger (absent without -pool: '-')
+    if have(snap, "nodexa_pool_sessions", "nodexa_pool_shares_total"):
+        sessions = int(series_total(snap, "nodexa_pool_sessions"))
+        workers = int(series_total(snap, "nodexa_pool_workers"))
+        verdicts = by_label(snap, "nodexa_pool_shares_total", "result")
+        share_line = "  ".join(
+            f"{k}={int(v)}" for k, v in sorted(verdicts.items()) if v
+        ) or "no shares yet"
+        _, bmean, bp99 = hist_stats(snap, "nodexa_pool_share_batch_seconds")
+        lines.append(
+            f"  pool: {sessions} sessions / {workers} workers   accepted "
+            f"{rate('nodexa_pool_shares_total', result='accepted')}   "
+            f"batch mean {fmt_ms(bmean)} p99 {fmt_ms(bp99)}")
+        lines.append(f"  shares: {share_line}")
+    else:
+        lines.append("  pool: -")
+        lines.append("  shares: -")
+
+    # live roofline attribution: device busy fraction + per-component
+    # fraction-of-calibrated-ceiling (the bench.py utilization block,
+    # live) and where idle time went by serving role
+    if have(snap, "nodexa_device_busy_frac"):
+        busy = series_total(snap, "nodexa_device_busy_frac")
+        fracs = by_label(snap, "nodexa_kernel_frac_of_ceiling", "kernel")
+        bps = by_label(snap, "nodexa_kernel_bytes_per_s", "kernel")
+        frac_line = "  ".join(
+            f"{k}={v:.0%}" + (
+                f" ({fmt_rate(bps[k])}B/s)" if bps.get(k) else "")
+            for k, v in sorted(fracs.items()) if v
+        ) or "uncalibrated"
+        idle = by_label(snap, "nodexa_device_idle_seconds_total", "path")
+        idle_line = " ".join(
+            f"{k}={v:.0f}s" for k, v in sorted(idle.items()) if v >= 1
+        ) or "-"
+        collapses = int(series_total(
+            snap, "nodexa_utilization_collapse_total"))
+        warn = (f"  {RED}collapse={collapses}{RESET}" if collapses else "")
+        lines.append(f"  device: busy {busy:.0%}   {frac_line}{warn}")
+        lines.append(f"  idle by role: {idle_line}")
+    else:
+        lines.append("  device: -")
+
+    # sampling profiler: per-role on-CPU share (nodexa_profiler_role_share
+    # sums to ~1 across roles under load; absent at -profilehz=0)
+    if have(snap, "nodexa_profiler_role_share"):
+        shares = by_label(snap, "nodexa_profiler_role_share", "role")
+        top_roles = sorted(shares.items(), key=lambda kv: -kv[1])[:6]
+        prof_line = "  ".join(
+            f"{k}={v:.0%}" for k, v in top_roles if v >= 0.005
+        ) or "all idle"
+        nsamples = int(series_total(snap, "nodexa_profiler_samples_total"))
+        lines.append(
+            f"  prof: {prof_line}   ({nsamples} samples — getprofile "
+            f"for stacks)")
+    else:
+        lines.append("  prof: -")
 
     # chain: connect latency + throughput
     ccount, cmean, cp99 = hist_stats(

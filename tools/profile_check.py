@@ -1,0 +1,213 @@
+"""CI gate: live utilization + profiler surface checks.
+
+Three assertions, all in-process (same discipline as flight_check.py —
+the loopback rig IS the live daemon's serving stack: real StratumServer
+with its pool-io/pool-shares/pool-jobs threads, real SharePipeline, the
+real compile-cache choke point):
+
+1. **getprofile round-trip on a live serving node.**  With the sampling
+   profiler running at the daemon default (-profilehz=25), a loopback
+   stratum session (subscribe/authorize/submit against a real
+   StratumServer) must leave >= 4 distinct thread roles with non-zero
+   samples retrievable through the ``getprofile`` RPC handler, with
+   collapsed-stack lines present — and the RPC must pass the safe-mode
+   read-only allowlist.
+
+2. **Profiler overhead bound.**  Pool share validation throughput with
+   the profiler at 25 Hz must stay >= 0.95x the profiler-off figure
+   (max-of-3 rounds each, interleaved, measured on the same warmed
+   rig) — the "always-on" claim, enforced.
+
+3. **Utilization ledger sanity.**  With the ledger enabled during the
+   share traffic, ``nodexa_device_busy_frac`` must read finite and in
+   [0, 1], the per-kernel device-seconds/calls counters must have
+   moved, and with a synthetic calibration installed the
+   ``nodexa_kernel_frac_of_ceiling{kernel="kawpow_dag_read"}`` gauge
+   must read finite and positive.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PROFILE_HZ = 25.0
+OVERHEAD_FLOOR = 0.95
+ROUNDS = 5
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _shares_per_s(pipeline, make_shares, batch: int, rounds: int) -> float:
+    """Max-of-N share-validation throughput (max: the bound is about the
+    profiler's steady cost, not scheduler noise)."""
+    best = 0.0
+    for _ in range(rounds):
+        shares = make_shares(batch)
+        t = time.perf_counter()
+        pipeline.validate_batch(shares)
+        best = max(best, batch / (time.perf_counter() - t))
+    return best
+
+
+def main() -> int:
+    from nodexa_chain_core_tpu.bench.pool import _plant, build_rig
+    from nodexa_chain_core_tpu.pool import (
+        JobManager,
+        SharePipeline,
+        StratumServer,
+    )
+    from nodexa_chain_core_tpu.pool.shares import Share
+    from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+    from nodexa_chain_core_tpu.rpc.safemode import (
+        MUTATING_COMMANDS,
+        READONLY_DIAGNOSTIC_COMMANDS,
+        reject_if_locked_down,
+    )
+    from nodexa_chain_core_tpu.telemetry import g_metrics
+    from nodexa_chain_core_tpu.telemetry.profiler import g_profiler
+    from nodexa_chain_core_tpu.telemetry.utilization import (
+        COMP_DAG,
+        g_utilization,
+    )
+    from tests.test_pool_stratum import Client
+
+    node, spk, verifier, _native = build_rig()
+    jobs = JobManager(node, spk)
+    pipeline = SharePipeline(node)
+    job = jobs.new_job(clean=True)
+    assert job is not None
+    job.target = 0  # suppress block submission: validation only
+    share_target = (1 << 256) - 1
+
+    t0 = time.perf_counter()
+    cands = _plant(verifier, job.header_hash_disp, job.height, 0xB, 64)
+    log(f"[profile_check] rig + device compile {time.perf_counter()-t0:.1f}s")
+
+    def make_shares(count):
+        picked = [cands[i % len(cands)] for i in range(count)]
+        return [
+            Share(None, i, "bench", job, nonce, mix, share_target,
+                  lambda s, ok, r: None)
+            for i, (nonce, _f, mix) in enumerate(picked)
+        ]
+
+    # warm the validation path before any timing
+    pipeline.validate_batch(make_shares(64))
+
+    # ---- 2. overhead bound (interleaved off/on rounds: max-of-N each,
+    # so machine drift between the two configurations cancels and the
+    # bound measures the PROFILER, not the scheduler) ------------------
+    assert not g_profiler.running
+
+    def measure_pair() -> tuple:
+        off = on = 0.0
+        for _ in range(ROUNDS):
+            assert not g_profiler.running
+            off = max(off, _shares_per_s(pipeline, make_shares, 64, 1))
+            assert g_profiler.start(PROFILE_HZ), "profiler failed to start"
+            on = max(on, _shares_per_s(pipeline, make_shares, 64, 1))
+            g_profiler.stop()
+        return off, on
+
+    off_hs, on_hs = measure_pair()
+    ratio = on_hs / off_hs
+    log(f"[profile_check] shares/s: off {off_hs:,.0f} vs on "
+        f"{on_hs:,.0f} @ {PROFILE_HZ:.0f}Hz -> {ratio:.3f}x")
+    if ratio < OVERHEAD_FLOOR:
+        # one retry: a scheduler stall across every on-round of the
+        # first pass can still invert a 5% bound on a busy CI host; a
+        # REAL overhead regression reproduces
+        off_hs, on_hs = measure_pair()
+        ratio = on_hs / off_hs
+        log(f"[profile_check] retry shares/s: off {off_hs:,.0f} vs on "
+            f"{on_hs:,.0f} -> {ratio:.3f}x")
+    assert ratio >= OVERHEAD_FLOOR, (
+        f"profiler overhead bound violated: {ratio:.3f}x < "
+        f"{OVERHEAD_FLOOR}x (off {off_hs:,.0f}, on {on_hs:,.0f})")
+    assert g_profiler.start(PROFILE_HZ), "profiler failed to restart"
+
+    # ---- 3. utilization ledger during live share traffic --------------
+    g_utilization.set_enabled(True)
+    g_utilization.set_calibration(
+        {"dag_row_gather_GBps": 20.85, "l1_word_gather_Geps": 11.0,
+         "alu_u32_ops_per_s": 4.0e12}, source="profile_check")
+    for _ in range(3):
+        pipeline.validate_batch(make_shares(64))
+    busy = g_metrics.get("nodexa_device_busy_frac").collect()
+    assert busy, "nodexa_device_busy_frac not registered"
+    busy_v = busy[0][1]
+    assert math.isfinite(busy_v) and 0.0 <= busy_v <= 1.0, busy_v
+    calls = g_metrics.get("nodexa_kernel_calls_total").value(
+        kernel="progpow.verify")
+    secs = g_metrics.get("nodexa_kernel_device_seconds_total").value(
+        kernel="progpow.verify")
+    assert calls >= 3 and secs > 0, (calls, secs)
+    dag_frac = g_utilization.component_frac(COMP_DAG)
+    assert dag_frac is not None and math.isfinite(dag_frac) and \
+        dag_frac > 0, dag_frac
+    log(f"[profile_check] busy_frac {busy_v:.3f}, "
+        f"{COMP_DAG} frac {dag_frac:.4f} over {int(calls)} verify calls")
+
+    # ---- 1. getprofile round-trip over a loopback stratum session -----
+    srv = StratumServer(node, jobs, pipeline, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        c = Client(srv.port)
+        extranonce1 = c.subscribe_authorize("prof")
+        notif = c.wait_notify()["params"]
+        job_id, hh_hex, _e, target_hex, _c, height, _b = notif
+        live = _plant(verifier, bytes.fromhex(hh_hex), height,
+                      extranonce1, 16)
+        tgt = int(target_hex, 16)
+        req = 10
+        for n, f, m in live:
+            if f > tgt:
+                continue
+            req += 1
+            c.rpc(req, "mining.submit",
+                  ["prof", job_id, f"{n:016x}", f"{m:064x}"])
+        # let the sampler observe the serving threads for a few ticks
+        time.sleep(max(8.0 / PROFILE_HZ, 0.3))
+        c.close()
+    finally:
+        srv.stop()
+
+    prof = rpc_misc.getprofile(None, [])
+    g_profiler.stop()
+    roles_with_samples = [
+        r for r, d in prof["roles"].items() if d["samples"] > 0]
+    log(f"[profile_check] getprofile: {prof['samples_total']} samples, "
+        f"roles {sorted(roles_with_samples)}")
+    assert prof["running"] is True or prof["samples_total"] > 0
+    assert len(roles_with_samples) >= 4, (
+        f"want >= 4 thread roles with samples, got {roles_with_samples}")
+    for want in ("pool-io", "pool-shares"):
+        assert want in roles_with_samples, (want, roles_with_samples)
+    assert prof["collapsed"], "no collapsed-stack lines"
+    assert any(";" in line for line in prof["collapsed"])
+
+    # safe-mode readability contract: the diagnostic allowlist is
+    # disjoint from the mutating set and getprofile passes the gate
+    assert "getprofile" in READONLY_DIAGNOSTIC_COMMANDS
+    assert not (READONLY_DIAGNOSTIC_COMMANDS & MUTATING_COMMANDS)
+    reject_if_locked_down("getprofile")  # must not raise, any mode
+
+    print(
+        f"profile check OK: getprofile served "
+        f"{len(roles_with_samples)} thread roles "
+        f"({prof['samples_total']} samples), profiler overhead "
+        f"{ratio:.3f}x (floor {OVERHEAD_FLOOR}x), busy_frac "
+        f"{busy_v:.3f} in [0,1], {COMP_DAG} frac {dag_frac:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
