@@ -585,12 +585,20 @@ def bench_netsim() -> dict:
         f"{res['netsim_links']} links; harness "
         f"{res['netsim_events_per_s']:,} events/s "
         f"({time.perf_counter()-t:.1f}s total)")
-    return {
+    out = {
         "block_propagation_ms": res["block_propagation_ms"],
         "block_propagation_p95_ms": res["block_propagation_p95_ms"],
         "netsim_nodes": res["netsim_nodes"],
         "netsim_events_per_s": res["netsim_events_per_s"],
     }
+    # cross-node trace attribution (FleetObserver): the p95 above as a
+    # per-hop stage table (sim ms; validate is measured wall time) plus
+    # the digest-replay determinism pin with tracing enabled
+    for k in ("block_propagation_stage_ms", "block_propagation_mean_hops",
+              "block_propagation_stage_recon_err", "netsim_digest_replay_ok"):
+        if k in res:
+            out[k] = res[k]
+    return out
 
 
 def bench_ibd() -> dict:

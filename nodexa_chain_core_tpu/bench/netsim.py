@@ -31,12 +31,10 @@ def _pct(sorted_vals, q: float) -> float:
     return sorted_vals[i]
 
 
-def measure_propagation(n_nodes: int = 50, degree: int = 4, seed: int = 1,
-                        blocks: int = 3, latency_s: float = 0.02,
-                        jitter_s: float = 0.005) -> dict:
-    """Mine ``blocks`` blocks at rotating origins through a random
-    degree-``degree`` topology and aggregate per-node propagation delay
-    (mined-at -> accepted-at, sim seconds) across all of them."""
+def _propagation_run(n_nodes: int, degree: int, seed: int, blocks: int,
+                     latency_s: float, jitter_s: float) -> dict:
+    """One scripted propagation scenario; returns delays, the replay
+    digest, and (when tracing is on) the FleetObserver stage table."""
     from ..net.netsim import LinkSpec, SimNet
 
     t_wall = time.perf_counter()
@@ -46,33 +44,84 @@ def measure_propagation(n_nodes: int = 50, degree: int = 4, seed: int = 1,
     net.connect_random(degree)
     if not net.settle(timeout_s=60.0):
         raise AssertionError("netsim: handshakes did not settle")
-    log(f"[netsim] {n_nodes} nodes / {len(net.links)} links settled "
-        f"({net.events_dispatched} events)")
-    delays = []
+    delays, hashes = [], []
     for b in range(blocks):
         origin = (b * 7) % n_nodes
         h = net.mine_block(origin)
+        hashes.append(h)
         if not net.run_until(net.converged, timeout_s=120.0):
             raise AssertionError(f"netsim: block {b} did not converge")
         pt = net.propagation_times(h)
         delays.extend(v for k, v in pt.items() if k != origin)
     delays.sort()
-    wall = time.perf_counter() - t_wall
+    out = {
+        "delays": delays,
+        "links": len(net.links),
+        "events": net.events_dispatched,
+        "wall_s": time.perf_counter() - t_wall,
+        "digest": net.digest(),
+        "stages": (net.observer.aggregate(hashes)
+                   if net.observer is not None else None),
+    }
+    net.stop()
+    return out
+
+
+def measure_propagation(n_nodes: int = 50, degree: int = 4, seed: int = 1,
+                        blocks: int = 3, latency_s: float = 0.02,
+                        jitter_s: float = 0.005, replay: bool = True) -> dict:
+    """Mine ``blocks`` blocks at rotating origins through a random
+    degree-``degree`` topology and aggregate per-node propagation delay
+    (mined-at -> accepted-at, sim seconds) across all of them.
+
+    With tracing on (the in-process default) the FleetObserver
+    decomposes the p95 into per-hop stages — queue / serialize /
+    latency / validate / relay — whose sim-time sum reconciles with the
+    end-to-end delay, and ``replay=True`` re-runs the identical
+    scenario asserting ``SimNet.digest()`` equality WITH tracing
+    enabled (observability must not perturb the simulation)."""
+    from ..telemetry.spans import spans_enabled
+
+    r1 = _propagation_run(n_nodes, degree, seed, blocks,
+                          latency_s, jitter_s)
+    delays = r1["delays"]
+    log(f"[netsim] {n_nodes} nodes / {r1['links']} links, "
+        f"{r1['events']} events")
     out = {
         "netsim_nodes": n_nodes,
         "netsim_degree": degree,
-        "netsim_links": len(net.links),
+        "netsim_links": r1["links"],
         "block_propagation_ms": round(_pct(delays, 0.5) * 1000, 2),
         "block_propagation_p95_ms": round(_pct(delays, 0.95) * 1000, 2),
         "block_propagation_max_ms": round(delays[-1] * 1000, 2),
-        "netsim_events_per_s": round(net.events_dispatched / max(wall, 1e-9)),
-        "netsim_wall_s": round(wall, 2),
+        "netsim_events_per_s": round(r1["events"] / max(r1["wall_s"], 1e-9)),
+        "netsim_wall_s": round(r1["wall_s"], 2),
+        "netsim_tracing": spans_enabled(),
     }
-    net.stop()
+    if r1["stages"] and r1["stages"].get("chains"):
+        st = r1["stages"]
+        out["block_propagation_stage_ms"] = st.get("stage_ms")
+        out["block_propagation_mean_hops"] = st.get("mean_hops")
+        out["block_propagation_max_hops"] = st.get("max_hops")
+        out["block_propagation_stage_recon_err"] = st.get("recon_err_max")
+    if replay:
+        r2 = _propagation_run(n_nodes, degree, seed, blocks,
+                              latency_s, jitter_s)
+        if r1["digest"] != r2["digest"]:
+            raise AssertionError(
+                f"netsim: propagation replay diverged: "
+                f"{r1['digest'][:16]} != {r2['digest'][:16]}")
+        out["netsim_digest_replay_ok"] = True
     log(f"[netsim] propagation over {blocks} blocks x {n_nodes - 1} nodes: "
         f"median {out['block_propagation_ms']}ms "
         f"p95 {out['block_propagation_p95_ms']}ms "
         f"(harness {out['netsim_events_per_s']:,} events/s)")
+    if "block_propagation_stage_ms" in out:
+        log(f"[netsim] per-hop stages (mean ms over "
+            f"{r1['stages']['chains']} chains, "
+            f"{out['block_propagation_mean_hops']} hops avg): "
+            f"{out['block_propagation_stage_ms']} "
+            f"recon_err_max={out['block_propagation_stage_recon_err']}")
     return out
 
 
@@ -172,6 +221,131 @@ def smoke(seed: int = 2) -> dict:
     return out
 
 
+def trace_smoke(seed: int = 5) -> dict:
+    """The ci_gate cross-node tracing lane (hard asserts):
+
+    1. an N=5 chain topology must assemble >=1 cluster-wide
+       block-propagation trace spanning >=3 hops, with every per-hop
+       stage (queue/serialize/latency/validate/relay) finite and the
+       sim-time stage sum reconciling with end-to-end within 10%;
+    2. ``SimNet.digest()`` replay equality: traced replay == traced
+       run == UNTRACED run (tracing cannot perturb the simulation);
+    3. the kill-switch contract extended to the wire: tracing-OFF
+       message throughput >= 0.95x a lean baseline with the whole
+       wire-observability layer bypassed (interleaved max-of-3).
+    """
+    import math
+
+    from ..net.netsim import LinkSpec, SimNet
+    from ..telemetry import flight_recorder
+    from ..telemetry.spans import set_spans_enabled, spans_enabled
+
+    out = {}
+    was_enabled = spans_enabled()
+    spec = LinkSpec(latency_s=0.02, bandwidth_bps=2_000_000)
+
+    def chain_run():
+        net = SimNet(5, seed=seed, default_spec=spec)
+        try:
+            for i in range(4):
+                net.connect(i, i + 1)  # chain: 0-1-2-3-4
+            assert net.settle(30.0), "handshakes did not settle"
+            h = net.mine_block(0)
+            assert net.run_until(net.converged, 120.0), \
+                "chain topology did not converge"
+            stages = (net.observer.chain_stages(h, 4)
+                      if net.observer is not None else None)
+            return net.digest(), stages
+        finally:
+            net.stop()
+
+    try:
+        # -- 1: traced run with stage assembly
+        set_spans_enabled(True)
+        flight_recorder.clear()
+        d_traced, stages = chain_run()
+        assert stages is not None, "FleetObserver assembled no chain"
+        assert stages["hops"] >= 3, \
+            f"expected >=3 hops, got {stages['hops']}"
+        for name, v in stages["stages"].items():
+            assert math.isfinite(v) and v >= 0.0, \
+                f"stage {name} not finite: {v}"
+        assert stages["recon_err"] < 0.10, \
+            f"stage sum vs e2e off by {stages['recon_err']:.1%}"
+        out["netsim_trace_hops"] = stages["hops"]
+        out["netsim_trace_stage_ms"] = {
+            k: round(v * 1000, 3) for k, v in stages["stages"].items()}
+        out["netsim_trace_recon_err"] = round(stages["recon_err"], 4)
+        # the cluster-wide trace itself: root + causally-linked hop
+        # spans across >=3 nodes, assembled from the shared ring
+        best_depth = 0
+        for spans in flight_recorder.complete_traces().values():
+            names = {s["name"] for s in spans}
+            if "block.propagation" not in names or "block.hop" not in names:
+                continue
+            by_id = {s["span_id"]: s for s in spans}
+            for s in spans:
+                if s["name"] != "block.hop":
+                    continue
+                depth, cur = 0, s
+                while cur.get("parent_id") in by_id:
+                    cur = by_id[cur["parent_id"]]
+                    depth += 1
+                best_depth = max(best_depth, depth)
+        assert best_depth >= 3, \
+            f"no cross-node trace spanning >=3 hops (deepest {best_depth})"
+        out["netsim_trace_depth"] = best_depth
+        log(f"[netsim] cross-node trace: {stages['hops']} hops, depth "
+            f"{best_depth}, stages {out['netsim_trace_stage_ms']} "
+            f"(recon err {out['netsim_trace_recon_err']})")
+
+        # -- 2: digest replay equality, traced and untraced
+        d_traced2, _ = chain_run()
+        assert d_traced == d_traced2, "traced replay diverged"
+        set_spans_enabled(False)
+        d_plain, _ = chain_run()
+        assert d_traced == d_plain, \
+            "tracing changed the simulation (digest mismatch)"
+        out["netsim_trace_digest"] = d_traced[:16]
+        log(f"[netsim] digest replay equality holds with tracing on "
+            f"({d_traced[:16]})")
+
+        # -- 3: wire kill-switch contract (interleaved max-of-3):
+        # tracing-off throughput vs the lean baseline that bypasses the
+        # per-peer ledger + observer entirely
+        def throughput(wire_stats: bool) -> float:
+            net = SimNet(4, seed=seed + 1, wire_stats=wire_stats,
+                         observe=False, ping_interval_s=0.2)
+            try:
+                net.connect_full()
+                net.settle(30.0)
+                t0 = time.perf_counter()
+                net.run(30.0)
+                return net.events_dispatched / max(
+                    time.perf_counter() - t0, 1e-9)
+            finally:
+                net.stop()
+
+        set_spans_enabled(False)
+        lean, instrumented = 0.0, 0.0
+        for _ in range(5):  # interleaved max-of-5: the measured overhead
+            # is ~2%, so the floor only fails on real regressions, not
+            # scheduler noise in a 3-sample max
+            lean = max(lean, throughput(wire_stats=False))
+            instrumented = max(instrumented, throughput(wire_stats=True))
+        ratio = instrumented / lean
+        out["netsim_events_per_s_lean"] = round(lean)
+        out["netsim_events_per_s_tracing_off"] = round(instrumented)
+        out["netsim_tracing_off_ratio"] = round(ratio, 3)
+        assert ratio >= 0.95, \
+            f"tracing-off throughput {ratio:.3f}x lean baseline (< 0.95)"
+        log(f"[netsim] tracing-off throughput {round(instrumented):,} ev/s "
+            f"= {ratio:.3f}x lean baseline ({round(lean):,} ev/s)")
+    finally:
+        set_spans_enabled(was_enabled)
+    return out
+
+
 def main(argv: Optional[list] = None) -> int:
     import argparse
 
@@ -184,12 +358,23 @@ def main(argv: Optional[list] = None) -> int:
                    help="run the gate scenarios (partition-and-heal, "
                         "determinism replay, stalling-peer IBD) with "
                         "hard asserts instead of the propagation bench")
+    p.add_argument("--trace-smoke", action="store_true",
+                   help="run the cross-node tracing gate: >=3-hop trace "
+                        "assembly with finite per-hop stages, digest "
+                        "replay equality with tracing on, and the "
+                        "tracing-off wire throughput pin")
+    p.add_argument("--no-replay", action="store_true",
+                   help="skip the digest-equality replay pass of the "
+                        "propagation bench")
     args = p.parse_args(argv)
     if args.smoke:
         res = smoke()
+    elif args.trace_smoke:
+        res = trace_smoke()
     else:
         res = measure_propagation(n_nodes=args.nodes, degree=args.degree,
-                                  seed=args.seed, blocks=args.blocks)
+                                  seed=args.seed, blocks=args.blocks,
+                                  replay=not args.no_replay)
     print(json.dumps(res, indent=1))
     return 0
 

@@ -16,7 +16,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..node.faults import g_faults
-from ..telemetry import g_metrics
+from ..telemetry import flight_recorder, g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from . import protocol
 from .addrman import AddrMan
@@ -64,6 +64,12 @@ class _SockTornWriter:
 
     def fileno(self) -> int:
         return self._sock.fileno()
+# per-peer relay-efficiency ledger fields (Peer attributes), aggregated
+# across live + closed peers by net_stats()
+_RELAY_FIELDS = (
+    "invs_sent", "invs_recv", "dup_invs_recv", "invs_wanted",
+    "cmpct_announced", "cmpct_from_mempool", "blocktxn_roundtrips",
+)
 # the command label is attacker-controlled wire input: unknown commands
 # collapse into one bucket, or a peer spraying random 12-byte commands
 # would grow the label set (and node memory) without bound
@@ -134,7 +140,52 @@ class Peer:
         self.prefer_cmpct = False
         self.cmpct_version = 0
         self.partial_block = None
+        # getpeerinfo-grade per-peer wire ledger (ref CNode's
+        # mapSendBytesPerMsgCmd / mapRecvBytesPerMsgCmd + nMinPingUsecTime):
+        # direction -> command -> [msgs, bytes].  Plain dict ops, no lock:
+        # each direction is only written by one thread (sender holds
+        # _send_lock; recv by the reader loop / sim dispatch).
+        self.msg_stats = {"sent": {}, "recv": {}}
+        self.last_cmd_sent = ""
+        self.last_cmd_recv = ""
+        self.ping_min_ms: Optional[float] = None
+        # a wedged remote TCP window blocks sendall mid-call: nonzero
+        # while a send is in flight, so getpeerinfo can surface "this
+        # peer has had a send stuck for N seconds" (the synchronous-send
+        # twin of the pool server's queue-depth gauge)
+        self._send_started = 0.0
+        # relay-efficiency ledger (announcements offered vs wanted,
+        # duplicate-inv pressure, compact-block reconstruction readiness)
+        self.invs_sent = 0            # tx/block invs we announced to the peer
+        self.invs_recv = 0            # invs the peer announced to us
+        self.dup_invs_recv = 0        # ...of which we already knew
+        self.invs_wanted = 0          # our announcements the peer fetched
+        self.cmpct_announced = 0      # compact blocks we pushed to it
+        self.cmpct_from_mempool = 0   # its cmpct we rebuilt with no round trip
+        self.blocktxn_roundtrips = 0  # its cmpct that needed getblocktxn
+        # -tracepeers capability (set when the peer advertised
+        # sendtracectx AND we run with trace propagation enabled)
+        self.trace_ctx_ok = False
         self._send_lock = threading.Lock()
+
+    def note_msg(self, command: str, direction: str, nbytes: int) -> None:
+        """Fold one wire message into the per-peer per-command ledger."""
+        stats = self.msg_stats[direction]
+        st = stats.get(command)
+        if st is None:
+            st = stats[command] = [0, 0]
+        st[0] += 1
+        st[1] += nbytes
+        if direction == "sent":
+            self.last_cmd_sent = command
+        else:
+            self.last_cmd_recv = command
+
+    def send_stall_age(self, now: float) -> float:
+        """Seconds the CURRENT in-flight send has been blocked (0.0 when
+        no send is mid-call)."""
+        t0 = self._send_started
+        return max(0.0, now - t0) if t0 else 0.0
 
     def send_msg(self, magic: bytes, command: str, payload: bytes = b"") -> bool:
         try:
@@ -149,9 +200,14 @@ class Peer:
                     g_faults.check("net.peer_send",
                                    torn_file=_SockTornWriter(self.sock),
                                    torn_data=data)
-                self.sock.sendall(data)
+                self._send_started = self._clock()
+                try:
+                    self.sock.sendall(data)
+                finally:
+                    self._send_started = 0.0
             self.last_send = self._clock()
             self.bytes_sent += len(data)
+            self.note_msg(command, "sent", len(data))
             msgs, nbytes = _wire_counters(command, "sent")
             msgs.inc()
             nbytes.inc(len(data))
@@ -203,6 +259,11 @@ class ConnMan:
         self.network_active = True
         self._closed_bytes_sent = 0
         self._closed_bytes_recv = 0
+        # getnetstats keeps node-lifetime per-command and relay ledgers:
+        # closed peers fold their per-peer stats here so the aggregate
+        # survives churn (live peers are summed at read time)
+        self._closed_msg_stats = {"sent": {}, "recv": {}}
+        self._closed_relay = dict.fromkeys(_RELAY_FIELDS, 0)
         # our own reachable addresses (ref AddLocal/GetLocalAddress): they
         # are advertised to peers, never dialed, never put in our addrman
         self.local_addresses: List[tuple] = []
@@ -420,6 +481,7 @@ class ConnMan:
                     self.processor.misbehaving(peer, 10, "bad-checksum")
                     continue
                 peer.last_recv = self.clock()
+                peer.note_msg(command, "recv", 24 + length)
                 msgs, nbytes = _wire_counters(command, "recv")
                 msgs.inc()
                 nbytes.inc(24 + length)
@@ -436,8 +498,39 @@ class ConnMan:
                 # and handler-loop cleanup can both land here)
                 self._closed_bytes_sent += peer.bytes_sent
                 self._closed_bytes_recv += peer.bytes_recv
+                # getattr-defensive: test harnesses drive this path with
+                # peer stubs that carry no wire ledger
+                stats = getattr(peer, "msg_stats", None)
+                if stats is not None:
+                    for direction in ("sent", "recv"):
+                        closed = self._closed_msg_stats[direction]
+                        for cmd, (n, b) in stats[direction].items():
+                            st = closed.get(cmd)
+                            if st is None:
+                                st = closed[cmd] = [0, 0]
+                            st[0] += n
+                            st[1] += b
+                    for f in _RELAY_FIELDS:
+                        self._closed_relay[f] += getattr(peer, f, 0)
                 reason = getattr(peer, "disconnect_reason", None) or "other"
                 _M_DISCONNECTS.inc(reason=reason)
+                # structured post-mortem trail: stall rotations and ban
+                # decisions leave more than a counter bump (satellite of
+                # the wire-observability PR) — who left, why, what it
+                # was doing, and what downloads it still owed us
+                flight_recorder.record_event(
+                    "peer_disconnect",
+                    peer=peer.id,
+                    addr=f"{peer.ip}:{getattr(peer, 'port', 0)}",
+                    inbound=peer.inbound,
+                    reason=reason,
+                    last_command_recv=getattr(peer, "last_cmd_recv", ""),
+                    last_command_sent=getattr(peer, "last_cmd_sent", ""),
+                    inflight_blocks=[
+                        f"{h:064x}"[:16] for h in
+                        list(getattr(peer, "blocks_in_flight", ()))[:8]],
+                    misbehavior=peer.misbehavior,
+                )
         self.processor.finalize_peer(peer)
         hook = getattr(self.processor, "peer_disconnected", None)
         if hook is not None:
@@ -654,8 +747,10 @@ class ConnMan:
             return list(self.peers.values())
 
     def peer_info(self) -> List[dict]:
+        now = self.clock()
         out = []
         for p in self.all_peers():
+            dup_ratio = (p.dup_invs_recv / p.invs_recv) if p.invs_recv else 0.0
             out.append(
                 {
                     "id": p.id,
@@ -667,8 +762,106 @@ class ConnMan:
                     "banscore": p.misbehavior,
                     "conntime": int(p.connected_at),
                     "pingtime": p.ping_time_ms,
+                    # getpeerinfo-grade wire ledger (ref getpeerinfo's
+                    # bytessent_per_msg/bytesrecv_per_msg + minping)
+                    "minping": p.ping_min_ms,
+                    "bytessent": p.bytes_sent,
+                    "bytesrecv": p.bytes_recv,
+                    "lastsend": int(p.last_send),
+                    "lastrecv": int(p.last_recv),
+                    "last_command_sent": p.last_cmd_sent,
+                    "last_command_recv": p.last_cmd_recv,
+                    "sendstall_s": round(p.send_stall_age(now), 3),
+                    "inflight": len(p.blocks_in_flight),
+                    "msgssent_per_msg": {
+                        c: n for c, (n, _) in sorted(
+                            p.msg_stats["sent"].items())},
+                    "bytessent_per_msg": {
+                        c: b for c, (_, b) in sorted(
+                            p.msg_stats["sent"].items())},
+                    "msgsrecv_per_msg": {
+                        c: n for c, (n, _) in sorted(
+                            p.msg_stats["recv"].items())},
+                    "bytesrecv_per_msg": {
+                        c: b for c, (_, b) in sorted(
+                            p.msg_stats["recv"].items())},
+                    "relay": {
+                        **{f: getattr(p, f, 0) for f in _RELAY_FIELDS},
+                        "dup_inv_ratio": round(dup_ratio, 4),
+                    },
+                    "tracectx": p.trace_ctx_ok,
                 }
             )
+        return out
+
+    def net_stats(self) -> dict:
+        """Node-wide wire aggregate for the ``getnetstats`` RPC: peer
+        census, per-command msg/byte totals (live + closed peers), the
+        relay-efficiency ledger, and the processor's propagation/trace
+        state.  Read-only — answers in safe mode."""
+        peers = self.all_peers()
+        now = self.clock()
+        with self._peers_lock:
+            per_cmd: Dict[str, dict] = {}
+            for direction in ("sent", "recv"):
+                for cmd, (n, b) in self._closed_msg_stats[direction].items():
+                    d = per_cmd.setdefault(cmd, {
+                        "sent_msgs": 0, "sent_bytes": 0,
+                        "recv_msgs": 0, "recv_bytes": 0})
+                    d[f"{direction}_msgs"] += n
+                    d[f"{direction}_bytes"] += b
+            relay = dict(self._closed_relay)
+        for p in peers:
+            for direction in ("sent", "recv"):
+                for cmd, (n, b) in list(p.msg_stats[direction].items()):
+                    d = per_cmd.setdefault(cmd, {
+                        "sent_msgs": 0, "sent_bytes": 0,
+                        "recv_msgs": 0, "recv_bytes": 0})
+                    d[f"{direction}_msgs"] += n
+                    d[f"{direction}_bytes"] += b
+            for f in _RELAY_FIELDS:
+                relay[f] += getattr(p, f, 0)
+        relay["dup_inv_ratio"] = round(
+            relay["dup_invs_recv"] / relay["invs_recv"], 4
+        ) if relay["invs_recv"] else 0.0
+        relay["inv_wanted_ratio"] = round(
+            relay["invs_wanted"] / relay["invs_sent"], 4
+        ) if relay["invs_sent"] else 0.0
+        cmpct_total = relay["cmpct_from_mempool"] + relay["blocktxn_roundtrips"]
+        relay["cmpct_mempool_hit_ratio"] = round(
+            relay["cmpct_from_mempool"] / cmpct_total, 4
+        ) if cmpct_total else 0.0
+        sent, recv = self.total_bytes()
+        pings = [p.ping_time_ms for p in peers if p.ping_time_ms is not None]
+        stalled = [
+            {"id": p.id, "addr": f"{p.ip}:{p.port}",
+             "sendstall_s": round(p.send_stall_age(now), 3)}
+            for p in peers if p.send_stall_age(now) > 1.0
+        ]
+        out = {
+            "peers": {
+                "total": len(peers),
+                "inbound": sum(1 for p in peers if p.inbound),
+                "outbound": sum(1 for p in peers if not p.inbound),
+            },
+            "totalbytessent": sent,
+            "totalbytesrecv": recv,
+            "ping_ms": {
+                "min": round(min(pings), 3) if pings else None,
+                "max": round(max(pings), 3) if pings else None,
+            },
+            "send_stalls": stalled,
+            "per_command": per_cmd,
+            "relay": relay,
+            "disconnects": {
+                (dict(key).get("reason") or "other"): int(v)
+                for key, v in _M_DISCONNECTS.collect()
+            },
+            "banned": len(self.banned),
+        }
+        prop = getattr(self.processor, "propagation_stats", None)
+        if prop is not None:
+            out["propagation"] = prop()
         return out
 
     def relay_transaction(self, tx) -> None:

@@ -5,7 +5,7 @@
 from __future__ import annotations
 
 import time
-from typing import List
+from typing import List, Optional
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
 from ..chain.validation import BlockValidationError
@@ -14,7 +14,7 @@ from ..core.serialize import ByteReader, ByteWriter
 from ..core.uint256 import u256_hex
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
-from ..telemetry import g_metrics
+from ..telemetry import g_metrics, tracing
 from ..utils.logging import LogFlags, log_print
 from . import protocol
 from ..crypto.chacha20 import FastRandomContext
@@ -48,6 +48,8 @@ from .protocol import (
     MSG_REJECT,
     MSG_SENDHEADERS,
     MSG_SENDCMPCT,
+    MSG_SENDTRACECTX,
+    MSG_TRACECTX,
     MSG_CMPCTBLOCK,
     MSG_GETBLOCKTXN,
     MSG_BLOCKTXN,
@@ -112,6 +114,28 @@ _M_BLOCK_PROP = g_metrics.histogram(
 _M_ROTATED = g_metrics.counter(
     "nodexa_block_downloads_rotated_total",
     "In-flight block downloads re-assigned away from a stalling peer")
+# the propagation bookkeeping maps (first-seen stamps, remote trace
+# contexts, live propagation spans) are bounded at first_seen_cap
+# (-propmapsize): silent eviction during a long IBD would quietly stop
+# feeding the propagation histogram, so every eviction is counted
+_M_PROP_EVICT = g_metrics.counter(
+    "nodexa_propagation_map_evictions_total",
+    "Entries evicted from the bounded propagation-tracking maps, "
+    "labeled by map (first_seen|trace_ctx|spans)")
+# relay-efficiency ledger: announcements offered vs wanted and the
+# duplicate-inv pressure peers put on us (dedup=duplicate means the
+# inv named something we already had)
+_M_RELAY_INVS = g_metrics.counter(
+    "nodexa_relay_invs_total",
+    "Inventory announcements, labeled by direction (sent|recv) and "
+    "dedup (new|duplicate)")
+# compact-block reconstruction readiness: mempool = rebuilt with zero
+# round trips, roundtrip = needed getblocktxn, full_fallback = short-id
+# collision forced a full-block getdata
+_M_CMPCT_RECON = g_metrics.counter(
+    "nodexa_cmpct_reconstructions_total",
+    "Compact-block reconstruction outcomes, labeled by result "
+    "(mempool|roundtrip|full_fallback)")
 
 
 class NetProcessor:
@@ -149,6 +173,14 @@ class NetProcessor:
         self._last_tip_hash = None
         self._last_tip_time = self._clock()
         self._resync_rotation = 0
+        # cross-node trace propagation (-tracepeers on real sockets;
+        # netsim ships the context as side-band link metadata so digest
+        # replay equality is preserved).  first_seen_cap bounds ALL the
+        # propagation maps (-propmapsize; evictions are counted).
+        self.trace_peers = False
+        self.first_seen_cap = _FIRST_SEEN_CAP
+        self._remote_trace_ctx: dict = {}   # block_hash -> (trace_id, span)
+        self._prop_spans: dict = {}         # block_hash -> TraceSpan
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -263,6 +295,8 @@ class NetProcessor:
             MSG_ADDR: self._on_addr,
             MSG_SENDHEADERS: self._on_sendheaders,
             MSG_SENDCMPCT: self._on_sendcmpct,
+            MSG_SENDTRACECTX: self._on_sendtracectx,
+            MSG_TRACECTX: self._on_tracectx,
             MSG_CMPCTBLOCK: self._on_cmpctblock,
             MSG_GETBLOCKTXN: self._on_getblocktxn,
             MSG_BLOCKTXN: self._on_blocktxn,
@@ -324,6 +358,13 @@ class NetProcessor:
         w.u8(1)  # announce via cmpctblock (high-bandwidth mode)
         w.u64(1)  # compact block version 1
         peer.send_msg(self.magic, MSG_SENDCMPCT, w.getvalue())
+        if self.trace_peers:
+            # experimental capability advertisement: a vanilla peer
+            # ignores the unknown command; only a peer that advertises
+            # back ever receives tracectx carriers
+            w = ByteWriter()
+            w.u8(1)  # trace-context version 1
+            peer.send_msg(self.magic, MSG_SENDTRACECTX, w.getvalue())
         self._start_sync(peer)
 
     def _start_sync(self, peer) -> None:
@@ -373,6 +414,9 @@ class NetProcessor:
             now = self._clock()
             peer.ping_time_ms = (
                 now - getattr(peer, "_ping_sent", now)) * 1000
+            best = getattr(peer, "ping_min_ms", None)
+            if best is None or peer.ping_time_ms < best:
+                peer.ping_min_ms = peer.ping_time_ms
 
     # -- inventory / relay -------------------------------------------------
 
@@ -382,6 +426,7 @@ class NetProcessor:
             self.misbehaving(peer, 20, "oversized-inv")
             return
         want: List[Inv] = []
+        fresh = 0
         for inv in invs:
             if inv.type == INV_TX:
                 peer.known_txs.add(inv.hash)
@@ -391,12 +436,21 @@ class NetProcessor:
                     and self.tx_requests.should_request(inv.hash, peer.id)
                 ):
                     want.append(inv)
+                    fresh += 1
             elif inv.type == INV_BLOCK:
                 peer.known_blocks.add(inv.hash)
                 if self.node.chainstate.lookup(inv.hash) is None:
-                    self._note_block_announced(inv.hash)
+                    fresh += 1
+                    self._note_block_announced(inv.hash, peer)
                     # headers-first: learn about the chain before the block
                     self._send_getheaders(peer)
+        peer.invs_recv = getattr(peer, "invs_recv", 0) + len(invs)
+        dup = len(invs) - fresh
+        if dup:
+            peer.dup_invs_recv = getattr(peer, "dup_invs_recv", 0) + dup
+            _M_RELAY_INVS.inc(dup, direction="recv", dedup="duplicate")
+        if fresh:
+            _M_RELAY_INVS.inc(fresh, direction="recv", dedup="new")
         if want:
             w = ByteWriter()
             w.vector(want, lambda wr, i: i.serialize(wr))
@@ -407,6 +461,17 @@ class NetProcessor:
         if len(invs) > MAX_INV_SIZE:
             self.misbehaving(peer, 20, "oversized-getdata")
             return
+        # relay-efficiency ledger: a getdata is the peer saying "I
+        # wanted that announcement" — but only for hashes the peer
+        # actually knows through the announcement flow (known_txs/
+        # known_blocks).  Headers-driven IBD getdata fetches blocks we
+        # never announced; counting those would push inv_wanted_ratio
+        # past 1 and make the usefulness signal meaningless.
+        wanted = sum(1 for inv in invs
+                     if inv.hash in peer.known_txs
+                     or inv.hash in peer.known_blocks)
+        if wanted:
+            peer.invs_wanted = getattr(peer, "invs_wanted", 0) + wanted
         notfound: List[Inv] = []
         for inv in invs:
             if inv.type == INV_TX:
@@ -534,7 +599,7 @@ class NetProcessor:
             # would stamp minutes-scale download latencies into the
             # announcement-to-acceptance histogram
             if count < 10 and not (idx.status & 8):
-                self._note_block_announced(idx.block_hash)
+                self._note_block_announced(idx.block_hash, peer)
         self._request_missing_blocks(peer)
         if count == MAX_HEADERS_RESULTS:
             # continue from the last received header, not the active tip
@@ -619,21 +684,142 @@ class NetProcessor:
         if holder is not None and holder[0] == peer.id:
             del self._blocks_in_flight[block_hash]
 
-    def _note_block_announced(self, block_hash: int) -> None:
-        """First-announcement timestamp for the propagation histogram."""
+    def _evicting_insert(self, mapping: dict, key, value, label: str) -> None:
+        """Insert with the shared ``first_seen_cap`` bound: on overflow
+        drop the oldest half (insertion order — dicts preserve it) and
+        COUNT the evictions, so a long IBD quietly exhausting the map is
+        visible on ``nodexa_propagation_map_evictions_total{map=...}``
+        instead of silently starving the propagation histogram."""
+        if key not in mapping and len(mapping) >= self.first_seen_cap:
+            drop = list(mapping)[: max(1, self.first_seen_cap // 2)]
+            for k in drop:
+                del mapping[k]
+            _M_PROP_EVICT.inc(len(drop), map=label)
+        mapping[key] = value
+
+    def _note_block_announced(self, block_hash: int, peer=None) -> None:
+        """First-announcement timestamp for the propagation histogram —
+        and, when the announcement carried a remote trace context, the
+        receiving end of a cross-node propagation trace: a ``block.hop``
+        span parented to the SENDER's span opens here and closes at
+        local acceptance."""
         fs = self._block_first_seen
         if block_hash not in fs:
-            if len(fs) >= _FIRST_SEEN_CAP:
-                # drop the oldest half; announcements this stale are IBD
-                # backlog, not tip relay
-                for k in sorted(fs, key=fs.get)[: _FIRST_SEEN_CAP // 2]:
-                    del fs[k]
-            fs[block_hash] = self._clock()
+            self._evicting_insert(
+                fs, block_hash, self._clock(), "first_seen")
+        if tracing.enabled() and block_hash not in self._prop_spans:
+            ctx = self._remote_trace_ctx.get(block_hash)
+            if ctx is not None:
+                sp = tracing.remote_span(
+                    "block.hop", ctx,
+                    block=f"{block_hash:064x}"[:16],
+                    peer=peer.id if peer is not None else -1,
+                    peer_addr=getattr(peer, "ip", ""),
+                )
+                if sp is not None:
+                    self._evicting_insert(
+                        self._prop_spans, block_hash, sp, "spans")
 
-    def _observe_propagation(self, block_hash: int) -> None:
+    def _observe_propagation(self, block_hash: int,
+                             validate_t0: Optional[float] = None,
+                             validate_t1: Optional[float] = None) -> None:
         t0 = self._block_first_seen.pop(block_hash, None)
+        self._remote_trace_ctx.pop(block_hash, None)  # consumed (or moot)
+        delay = None
         if t0 is not None:
-            _M_BLOCK_PROP.observe(max(0.0, self._clock() - t0))
+            delay = max(0.0, self._clock() - t0)
+            _M_BLOCK_PROP.observe(delay)
+        sp = self._prop_spans.get(block_hash)
+        if sp is not None:
+            # the hop ends at local acceptance; validate rides under it
+            # with the wall-clock cost of process_new_block.  The span
+            # stays in _prop_spans so announce_block can parent this
+            # node's relay fan-out (and the NEXT hop's context) to it.
+            if validate_t0 is not None:
+                tracing.record_span(
+                    "hop.validate", sp, validate_t0, validate_t1)
+            sp.finish(propagation_s=round(delay, 6) if delay is not None
+                      else None)
+
+    def note_remote_trace_ctx(self, block_hash: int, ctx) -> None:
+        """Store a remote trace context for ``block_hash`` (from a
+        tracectx wire message, or the netsim side-band).  Last writer
+        wins: on an ordered stream the context immediately preceding
+        the announcement is the delivering peer's, so a later announcer
+        supersedes a stale context whose announcement never arrived."""
+        if ctx is None:
+            return
+        self._evicting_insert(
+            self._remote_trace_ctx, block_hash, ctx, "trace_ctx")
+
+    def _prune_prop_spans(self, keep: int = 64) -> None:
+        """Consume FINISHED propagation spans beyond a small recent
+        window (they stay briefly so a re-announcement of a fresh tip
+        continues the same trace).  Without this the map only ever
+        grows and the ``map=spans`` eviction counter — documented as a
+        histogram-starvation alarm — would false-fire forever on a
+        long-lived daemon.  Unfinished spans (still propagating) are
+        left for the cap/eviction backstop."""
+        spans = self._prop_spans
+        while len(spans) > keep:
+            oldest = next(iter(spans))
+            if not getattr(spans[oldest], "_done", True):
+                break
+            del spans[oldest]
+
+    def _ship_trace_ctx(self, peer, block_hash: int, ctx,
+                        command: str) -> None:
+        """Hand the trace context to one peer ahead of its block
+        announcement (``command`` = the announcement about to follow).
+        SimPeers carry a ``send_trace_ctx`` side-band (link metadata,
+        not wire traffic — replay digests are preserved); real sockets
+        get a tracectx message, but ONLY when the peer advertised the
+        -tracepeers capability (vanilla wire compat untouched)."""
+        sideband = getattr(peer, "send_trace_ctx", None)
+        if sideband is not None:
+            sideband(block_hash, ctx, command)
+            return
+        if not getattr(peer, "trace_ctx_ok", False):
+            return
+        w = ByteWriter()
+        w.hash256(block_hash)
+        w.var_str(str(ctx[0]))
+        w.u64(int(ctx[1]))
+        peer.send_msg(self.magic, MSG_TRACECTX, w.getvalue())
+
+    def _on_sendtracectx(self, peer, r: ByteReader) -> None:
+        # capability is mutual: mark the peer only when WE participate,
+        # so a -tracepeers=0 node never emits tracectx traffic
+        peer.trace_ctx_ok = self.trace_peers
+
+    def _on_tracectx(self, peer, r: ByteReader) -> None:
+        if not self.trace_peers:
+            return  # we never advertised; ignore, don't punish
+        block_hash = r.hash256()
+        trace_id = r.var_str()
+        span_id = r.u64()
+        if len(trace_id) > 64:
+            self.misbehaving(peer, 1, "oversized-tracectx")
+            return
+        self.note_remote_trace_ctx(block_hash, (trace_id, span_id))
+
+    def propagation_stats(self) -> dict:
+        """Propagation/trace bookkeeping snapshot for ``getnetstats``."""
+        hist = _M_BLOCK_PROP.snapshot()
+        return {
+            "first_seen": len(self._block_first_seen),
+            "map_cap": self.first_seen_cap,
+            "evictions": {
+                (dict(key).get("map") or "?"): int(v)
+                for key, v in _M_PROP_EVICT.collect()
+            },
+            "in_flight_blocks": len(self._blocks_in_flight),
+            "observed": int(hist["count"]) if hist else 0,
+            "observed_sum_s": round(hist["sum"], 6) if hist else 0.0,
+            "trace_peers": self.trace_peers,
+            "remote_trace_ctx": len(self._remote_trace_ctx),
+            "propagation_spans": len(self._prop_spans),
+        }
 
     # -- blocks / txs ------------------------------------------------------
 
@@ -647,6 +833,7 @@ class NetProcessor:
         peer.known_blocks.add(h)
         cs = self.node.chainstate
         old_tip = cs.tip().block_hash
+        v_t0 = time.perf_counter() if tracing.enabled() else None
         try:
             cs.process_new_block(block)
         except NodeCriticalError as e:
@@ -664,7 +851,8 @@ class NetProcessor:
             if punish:
                 self.misbehaving(peer, 100, f"bad-block:{e.code}")
             return False
-        self._observe_propagation(h)
+        self._observe_propagation(
+            h, v_t0, time.perf_counter() if v_t0 is not None else None)
         if cs.tip().block_hash != old_tip:
             self.announce_block(cs.tip().block_hash)
         # keep the download window full toward the peer's best header
@@ -1106,7 +1294,7 @@ class NetProcessor:
         idx = cs.lookup(h)
         if idx is not None and idx.status & 8:  # already have it
             return
-        self._note_block_announced(h)
+        self._note_block_announced(h, peer)
         if cs.lookup(cmpct.header.hash_prev) is None:
             # can't connect: fall back to headers sync (ref cmpctblock
             # handling when prev is unknown)
@@ -1130,16 +1318,23 @@ class NetProcessor:
             missing = partial.init_data(cmpct, self.node.mempool)
         except CompactBlockError:
             # short-id collision: request the full block
+            _M_CMPCT_RECON.inc(result="full_fallback")
             self._getdata_block(peer, h)
             return
         if not missing:
             block = partial.fill_block([])
+            peer.cmpct_from_mempool = getattr(
+                peer, "cmpct_from_mempool", 0) + 1
+            _M_CMPCT_RECON.inc(result="mempool")
             log_print(LogFlags.NET, "cmpctblock %s reconstructed from mempool",
                       u256_hex(h)[:16])
             self._finish_compact(peer, block, h)
             return
         log_print(LogFlags.NET, "cmpctblock %s missing %d txs, getblocktxn",
                   u256_hex(h)[:16], len(missing))
+        peer.blocktxn_roundtrips = getattr(
+            peer, "blocktxn_roundtrips", 0) + 1
+        _M_CMPCT_RECON.inc(result="roundtrip")
         peer.partial_block = partial
         req = BlockTransactionsRequest(block_hash=h, indexes=missing)
         w = ByteWriter()
@@ -1191,6 +1386,7 @@ class NetProcessor:
         old_tip = cs.tip().block_hash
         self._clear_block_request(peer, block_hash)
         peer.known_blocks.add(block_hash)
+        v_t0 = time.perf_counter() if tracing.enabled() else None
         try:
             cs.process_new_block(block)
         except BlockValidationError as e:
@@ -1199,7 +1395,9 @@ class NetProcessor:
             else:
                 self.misbehaving(peer, 100, f"bad-block:{e.code}")
             return
-        self._observe_propagation(block_hash)
+        self._observe_propagation(
+            block_hash, v_t0,
+            time.perf_counter() if v_t0 is not None else None)
         if cs.tip().block_hash != old_tip:
             self.announce_block(cs.tip().block_hash)
         self._request_missing_blocks(peer)
@@ -1250,12 +1448,21 @@ class NetProcessor:
             if filt is not None and not filt.matches_tx(tx):
                 continue
             peer.known_txs.add(tx.txid)
+            peer.invs_sent = getattr(peer, "invs_sent", 0) + 1
+            _M_RELAY_INVS.inc(direction="sent", dedup="new")
             w = ByteWriter()
             w.vector([inv], lambda wr, i: i.serialize(wr))
             peer.send_msg(self.magic, MSG_INV, w.getvalue())
 
     def announce_block(self, block_hash: int) -> None:
-        """New-tip announcement: headers to sendheaders peers, inv otherwise."""
+        """New-tip announcement: headers to sendheaders peers, inv
+        otherwise.  With tracing on this is also where the cross-node
+        propagation trace grows: a block WE originated roots a new
+        ``block.propagation`` trace; a relayed block continues the
+        ``block.hop`` span opened when it was announced to us.  The
+        span's wire context ships with each announcement (side-band in
+        netsim, tracectx on -tracepeers sockets), so the receiving hop
+        parents to this one and the assembled trace spans the cluster."""
         cs = self.node.chainstate
         idx = cs.lookup(block_hash)
         # one shared compact encoding serves every high-bandwidth peer
@@ -1269,15 +1476,53 @@ class NetProcessor:
             w = ByteWriter()
             cmpct.serialize(w, self.node.params.algo_schedule)
             cmpct_payload = w.getvalue()
+        sp = ctx = None
+        relay_t0 = 0.0
+        if tracing.enabled():
+            sp = self._prop_spans.get(block_hash)
+            if sp is None:
+                # no hop span: this node is the trace origin (mined
+                # locally, submitblock, or an untraced announcement)
+                sp = tracing.start_trace(
+                    "block.propagation",
+                    block=f"{block_hash:064x}"[:16],
+                    height=idx.height if idx is not None else -1,
+                )
+                if sp is not None:
+                    self._evicting_insert(
+                        self._prop_spans, block_hash, sp, "spans")
+            ctx = tracing.wire_context(sp)
+            relay_t0 = time.perf_counter()
+        fanout = 0
         for peer in self.connman.all_peers():
             if not peer.handshake_done or block_hash in peer.known_blocks:
                 continue
             peer.known_blocks.add(block_hash)
+            peer.invs_sent = getattr(peer, "invs_sent", 0) + 1
+            _M_RELAY_INVS.inc(direction="sent", dedup="new")
+            # pick the announcement form FIRST: the trace context is
+            # shipped against that command, so a netsim link that
+            # blackholes it also withholds the context (a hop must not
+            # parent to a peer whose announcement never arrived)
             if peer.prefer_cmpct and cmpct_payload is not None:
+                command = MSG_CMPCTBLOCK
+            elif peer.prefer_headers and idx is not None:
+                command = MSG_HEADERS
+            else:
+                command = MSG_INV
+            if ctx is not None:
+                # context BEFORE the announcement: ordered delivery means
+                # the receiver holds the parent handle when it processes
+                # the announcement itself
+                self._ship_trace_ctx(peer, block_hash, ctx, command)
+            fanout += 1
+            if command == MSG_CMPCTBLOCK:
                 # high-bandwidth mode: push the compact block directly
                 # (ref net_processing.cpp SendMessages cmpctblock announce)
+                peer.cmpct_announced = getattr(
+                    peer, "cmpct_announced", 0) + 1
                 peer.send_msg(self.magic, MSG_CMPCTBLOCK, cmpct_payload)
-            elif peer.prefer_headers and idx is not None:
+            elif command == MSG_HEADERS:
                 w = ByteWriter()
                 w.compact_size(1)
                 idx.header.serialize(w, self.node.params.algo_schedule)
@@ -1289,3 +1534,13 @@ class NetProcessor:
                     [Inv(INV_BLOCK, block_hash)], lambda wr, i: i.serialize(wr)
                 )
                 peer.send_msg(self.magic, MSG_INV, w.getvalue())
+        if sp is not None:
+            if fanout:
+                tracing.record_span("hop.relay", sp, relay_t0,
+                                    peers=fanout)
+            # roots close here (the origin's story is "accepted, fanned
+            # out"); hop spans already closed at acceptance — finish()
+            # is idempotent so this is a no-op for them, and children
+            # recorded above only borrow the ids, not the liveness
+            sp.finish()
+            self._prune_prop_spans()

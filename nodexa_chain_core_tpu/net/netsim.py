@@ -32,12 +32,14 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from ..crypto.chacha20 import FastRandomContext
 from ..core.uint256 import u256_hex
 from ..node.faults import g_faults
+from ..telemetry import tracing
 from ..utils.logging import LogFlags, log_print
 from .connman import ConnMan, Peer, _wire_counters
 
@@ -85,7 +87,8 @@ class LinkSpec:
 
 class _Link:
     __slots__ = ("a", "b", "specs", "partitioned", "busy_until",
-                 "reconnect_delay", "reconnect_pending", "endpoints")
+                 "reconnect_delay", "reconnect_pending", "endpoints",
+                 "faults")
 
     def __init__(self, a: int, b: int, spec_ab: LinkSpec, spec_ba: LinkSpec):
         self.a = a
@@ -99,6 +102,14 @@ class _Link:
         self.reconnect_delay = RECONNECT_BASE_S
         self.reconnect_pending = False
         self.endpoints: tuple = ()
+        # per-direction fault ledger (keyed by SENDING node): how many
+        # messages this link's fault model actually ate — surfaced via
+        # SimNet.link_stats() and the propagation report so "the graph
+        # is lossy HERE" is a number, not an inference
+        self.faults = {
+            a: {"dropped": 0, "blackholed": 0, "partitioned": 0},
+            b: {"dropped": 0, "blackholed": 0, "partitioned": 0},
+        }
 
 
 class SimPeer(Peer):
@@ -128,11 +139,36 @@ class SimPeer(Peer):
         size = len(payload) + 24  # header-equivalent wire cost
         self.bytes_sent += size
         self.last_send = self._net.clock()
+        if self._net.wire_stats:
+            self.note_msg(command, "sent", size)
         msgs, nbytes = _wire_counters(command, "sent")
         msgs.inc()
         nbytes.inc(size)
         self._net._enqueue_msg(self, command, payload, size)
         return True
+
+    def send_trace_ctx(self, block_hash: int, ctx,
+                       command: Optional[str] = None) -> None:
+        """Side-band trace-context delivery: LINK METADATA, not wire
+        traffic — nothing is enqueued, logged, or hashed into the replay
+        digest, so tracing on vs off cannot perturb event order.  The
+        metadata still rides the link's availability: a partitioned or
+        dead link — or one that blackholes ``command``, the
+        announcement this context precedes — carries no context, like
+        the announcement itself.  (Probabilistic ``drop_rate`` is NOT
+        consulted: that would draw from the shared RNG and perturb the
+        replay digest; a dropped announcement's stale context is
+        superseded by the next announcer's — note_remote_trace_ctx is
+        last-writer-wins.)"""
+        link = self._link
+        if (link is None or link.partitioned or self._closed
+                or self.disconnect):
+            return
+        spec = link.specs[self._owner_index]
+        if command is not None and command in spec.drop_commands:
+            return
+        remote = self._net.nodes[self._remote_index]
+        remote.processor.note_remote_trace_ctx(block_hash, ctx)
 
     def close(self) -> None:  # no socket to close
         self._closed = True
@@ -196,7 +232,9 @@ class SimNet:
                  periodic_interval_s: float = 1.0,
                  ping_interval_s: float = 30.0,
                  auto_reconnect: bool = True,
-                 tunables: Optional[dict] = None):
+                 tunables: Optional[dict] = None,
+                 observe: Optional[bool] = None,
+                 wire_stats: bool = True):
         from ..node.chainparams import select_params
 
         self.seed = seed
@@ -205,6 +243,17 @@ class SimNet:
         self.clock = SimClock(params.genesis_time + 3600.0)
         self.default_spec = default_spec or LinkSpec()
         self.auto_reconnect = auto_reconnect
+        # observability plumbing — PASSIVE by construction (reads the
+        # link model, writes nothing the digest hashes), so a traced run
+        # replays to the same digest as an untraced one.
+        #   observe=None: follow the tracing kill switch;
+        #   wire_stats=False: the "lean" baseline the throughput gate
+        #   compares against (skips even the per-peer msg ledger).
+        self.wire_stats = wire_stats
+        if observe is None:
+            observe = tracing.enabled() and wire_stats
+        self.observer: Optional[FleetObserver] = (
+            FleetObserver(self) if observe else None)
         self.tunables = {
             "block_download_timeout_s": SIM_BLOCK_DOWNLOAD_TIMEOUT_S,
             "headers_sync_timeout_s": SIM_HEADERS_SYNC_TIMEOUT_S,
@@ -351,34 +400,47 @@ class SimNet:
     def _enqueue_msg(self, src_peer: SimPeer, command: str,
                      payload: bytes, size: int) -> None:
         link = src_peer._link
-        if link is None or link.partitioned:
+        sender = src_peer._owner_index
+        if link is None:
             return
-        spec = link.specs[src_peer._owner_index]
+        if link.partitioned:
+            link.faults[sender]["partitioned"] += 1
+            return
+        spec = link.specs[sender]
         if command in spec.drop_commands:
+            link.faults[sender]["blackholed"] += 1
             return
         if spec.drop_rate and self.rng.random() < spec.drop_rate:
+            link.faults[sender]["dropped"] += 1
             return
         now = self.clock()
         delay = spec.latency_s
         if spec.jitter_s:
             delay += self.rng.random() * spec.jitter_s
+        queue_s = 0.0
         if spec.bandwidth_bps:
-            start = max(now, link.busy_until[src_peer._owner_index])
+            start = max(now, link.busy_until[sender])
+            queue_s = start - now
             tx = size * 8.0 / spec.bandwidth_bps
-            link.busy_until[src_peer._owner_index] = start + tx
+            link.busy_until[sender] = start + tx
             deliver = start + tx + delay
         else:
+            tx = 0.0
             deliver = now + delay
+        # the exact per-message wire decomposition rides the event (the
+        # observer's raw material); None when nobody is watching.  The
+        # event LOG (what the digest hashes) never sees it.
+        wire = (queue_s, tx, delay) if self.observer is not None else None
         self._push(deliver, "msg",
-                   (src_peer._twin, command, payload, size))
+                   (src_peer._twin, command, payload, size, wire))
 
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, ev: _Event) -> None:
         self.events_dispatched += 1
         if ev.kind == "msg":
-            peer, command, payload, size = ev.data
-            self._deliver(peer, command, payload, size)
+            peer, command, payload, size, wire = ev.data
+            self._deliver(peer, command, payload, size, wire)
         elif ev.kind == "close":
             (peer,) = ev.data
             if not peer._closed:
@@ -407,7 +469,7 @@ class SimNet:
             self._establish(link)
 
     def _deliver(self, peer: SimPeer, command: str, payload: bytes,
-                 size: int) -> None:
+                 size: int, wire=None) -> None:
         node = self.nodes[peer._owner_index]
         if peer._closed or peer.disconnect or peer.id not in node.connman.peers:
             return
@@ -421,16 +483,28 @@ class SimNet:
                 return
         peer.bytes_recv += size
         peer.last_recv = self.clock()
+        if self.wire_stats:
+            peer.note_msg(command, "recv", size)
         msgs, nbytes = _wire_counters(command, "recv")
         msgs.inc()
         nbytes.inc(size)
         self.event_log.append((round(self.clock(), 6), peer._remote_index,
                                peer._owner_index, command, size))
         tip_before = node.tip_hash()
+        obs = self.observer
+        t_wall = time.perf_counter() if obs is not None else 0.0
         node.processor.process_messages([(peer, command, payload)])
         tip_after = node.tip_hash()
         if tip_after != tip_before:
             self.tip_times[(node.index, tip_after)] = self.clock()
+            if obs is not None:
+                # the delivering message IS the hop's final wire leg:
+                # its exact (queue, serialize, latency) plus the wall
+                # time validation just took decompose this hop
+                obs.note_accept(
+                    node.index, tip_after, self.clock(),
+                    src=peer._remote_index, command=command, wire=wire,
+                    validate_wall_s=time.perf_counter() - t_wall)
         if peer.handshake_done and peer._link is not None:
             peer._link.reconnect_delay = RECONNECT_BASE_S  # good() signal
         self._sweep(node)
@@ -519,6 +593,8 @@ class SimNet:
         h = cs.tip().block_hash
         self.block_times[h] = self.clock()
         self.tip_times[(node_index, h)] = self.clock()
+        if self.observer is not None:
+            self.observer.note_origin(node_index, h, self.clock())
         node.processor.announce_block(h)
         self._sweep(node)
         log_print(LogFlags.NET, "netsim: node %d mined %s at t=%.3f",
@@ -557,6 +633,19 @@ class SimNet:
                 out[idx] = t - t0
         return out
 
+    def link_stats(self) -> List[dict]:
+        """Per-link fault ledger: what each direction's fault model ate
+        (drop_rate losses, blackholed commands, partition drops)."""
+        out = []
+        for link in self.links:
+            out.append({
+                "a": link.a, "b": link.b,
+                "partitioned": link.partitioned,
+                "alive": self._link_alive(link),
+                "faults": {str(k): dict(v) for k, v in link.faults.items()},
+            })
+        return out
+
     def digest(self) -> str:
         """Determinism pin: hashes the full delivery order + final tips."""
         hsh = hashlib.sha256()
@@ -565,3 +654,158 @@ class SimNet:
         for t in self.tips():
             hsh.update(u256_hex(t).encode())
         return hsh.hexdigest()
+
+
+class FleetObserver:
+    """Cluster-wide propagation-trace assembly over the harness.
+
+    Purely passive: it reads the link model's EXACT per-message wire
+    decomposition (queue wait behind ``bandwidth_bps`` serialization,
+    the serialization time itself, link latency+jitter) and the
+    harness's acceptance events, and assembles, per (block, receiving
+    node), the causal hop chain back to the mining origin.  Each hop
+    decomposes into the stages the tentpole asks for:
+
+    - ``queue_s`` / ``serialize_s`` / ``latency_s`` — the delivering
+      message's exact wire stages from the link model (sim seconds);
+    - ``validate_s`` — wall-clock time ``process_new_block`` took on
+      the receiving node (handlers run inline at dispatch, so this
+      stage's SIM-time contribution is zero by construction — it is
+      reported as measured wall time and excluded from the sim-time
+      reconciliation);
+    - ``relay_s`` — the residual: relay fan-out wait on the sender plus
+      any request round-trips (getheaders/getdata/getblocktxn legs)
+      that preceded the final data message.
+
+    total = queue + serialize + latency + relay holds per hop by
+    construction, and hop totals telescope to the end-to-end
+    mined-at -> accepted-at delay, so the bench's stage table
+    reconciles with ``block_propagation_p95_ms`` exactly (the ci_gate
+    trace smoke asserts the error stays under 10% even across broken
+    chains)."""
+
+    def __init__(self, net: SimNet):
+        self.net = net
+        # (node, block_hash) -> acceptance record; first acceptance wins
+        self.accepts: Dict[Tuple[int, int], dict] = {}
+        self.origins: Dict[int, Tuple[int, float]] = {}  # hash -> (node, t)
+
+    def note_origin(self, node: int, block_hash: int, t: float) -> None:
+        self.origins.setdefault(block_hash, (node, t))
+
+    def note_accept(self, node: int, block_hash: int, t: float, src: int,
+                    command: str, wire, validate_wall_s: float) -> None:
+        key = (node, block_hash)
+        if key in self.accepts:
+            return
+        queue_s, tx_s, lat_s = wire if wire is not None else (0.0, 0.0, 0.0)
+        self.accepts[key] = {
+            "node": node, "block": block_hash, "t": t, "from": src,
+            "command": command, "queue_s": queue_s, "serialize_s": tx_s,
+            "latency_s": lat_s, "validate_s": validate_wall_s,
+        }
+
+    # -- assembly ----------------------------------------------------------
+
+    def _parent_time(self, block_hash: int, src: int) -> Optional[float]:
+        org = self.origins.get(block_hash)
+        if org is not None and org[0] == src:
+            return org[1]
+        rec = self.accepts.get((src, block_hash))
+        return rec["t"] if rec is not None else None
+
+    def hop(self, block_hash: int, node: int) -> Optional[dict]:
+        """One receiving node's final hop for a block, stage-decomposed."""
+        rec = self.accepts.get((node, block_hash))
+        if rec is None:
+            return None
+        t_src = self._parent_time(block_hash, rec["from"])
+        wire = rec["queue_s"] + rec["serialize_s"] + rec["latency_s"]
+        total = (rec["t"] - t_src) if t_src is not None else wire
+        return {
+            "block": f"{block_hash:064x}"[:16],
+            "from": rec["from"], "to": node, "command": rec["command"],
+            "t_accept": rec["t"], "total_s": total,
+            "stages": {
+                "queue": rec["queue_s"],
+                "serialize": rec["serialize_s"],
+                "latency": rec["latency_s"],
+                "validate": rec["validate_s"],   # wall; sim-time cost 0
+                "relay": max(0.0, total - wire),
+            },
+            "chained": t_src is not None,
+        }
+
+    def chain(self, block_hash: int, node: int) -> List[dict]:
+        """The causal hop chain origin -> ... -> ``node`` (origin-first);
+        empty when the node never accepted the block."""
+        org = self.origins.get(block_hash)
+        hops: List[dict] = []
+        seen = set()
+        cur = node
+        while cur not in seen:
+            seen.add(cur)
+            if org is not None and cur == org[0]:
+                break  # reached the miner
+            h = self.hop(block_hash, cur)
+            if h is None:
+                return []  # never accepted: no chain to report
+            hops.append(h)
+            if not h["chained"]:
+                break  # sender's acceptance unobserved: partial chain
+            cur = h["from"]
+        hops.reverse()
+        return hops
+
+    def chain_stages(self, block_hash: int, node: int) -> Optional[dict]:
+        """Aggregate stage sums along the chain + the reconciliation
+        against the end-to-end mined-at -> accepted-at measurement."""
+        hops = self.chain(block_hash, node)
+        if not hops:
+            return None
+        stages = {k: 0.0 for k in
+                  ("queue", "serialize", "latency", "validate", "relay")}
+        for h in hops:
+            for k in stages:
+                stages[k] += h["stages"][k]
+        sim_sum = (stages["queue"] + stages["serialize"]
+                   + stages["latency"] + stages["relay"])
+        org = self.origins.get(block_hash)
+        rec = self.accepts.get((node, block_hash))
+        e2e = (rec["t"] - org[1]) if (org and rec) else sim_sum
+        err = abs(sim_sum - e2e) / e2e if e2e > 0 else 0.0
+        return {"hops": len(hops), "stages": stages, "stage_sum_s": sim_sum,
+                "e2e_s": e2e, "recon_err": err}
+
+    def aggregate(self, block_hashes=None) -> dict:
+        """Fleet-wide stage table over every observed (block, node)
+        chain: mean per-stage milliseconds, hop depth, and the WORST
+        reconciliation error (a broken chain — an acceptance whose
+        sender the observer never saw accept — shows up here instead of
+        silently skewing the means)."""
+        hashes = set(block_hashes) if block_hashes is not None else {
+            b for (_, b) in self.accepts}
+        chains = []
+        for h in hashes:
+            org = self.origins.get(h)
+            for (node, bh) in list(self.accepts):
+                if bh != h or (org is not None and node == org[0]):
+                    continue
+                cs = self.chain_stages(h, node)
+                if cs is not None:
+                    chains.append(cs)
+        if not chains:
+            return {"chains": 0}
+        n = len(chains)
+        stage_ms = {
+            k: round(sum(c["stages"][k] for c in chains) / n * 1000, 3)
+            for k in ("queue", "serialize", "latency", "validate", "relay")}
+        return {
+            "chains": n,
+            "mean_hops": round(sum(c["hops"] for c in chains) / n, 2),
+            "max_hops": max(c["hops"] for c in chains),
+            "stage_ms": stage_ms,
+            "e2e_mean_ms": round(
+                sum(c["e2e_s"] for c in chains) / n * 1000, 3),
+            "recon_err_max": round(max(c["recon_err"] for c in chains), 4),
+        }

@@ -52,6 +52,14 @@ MSG_SENDCMPCT = "sendcmpct"
 MSG_CMPCTBLOCK = "cmpctblock"
 MSG_GETBLOCKTXN = "getblocktxn"
 MSG_BLOCKTXN = "blocktxn"
+# experimental cross-node trace propagation (-tracepeers): capability
+# advertisement after verack + the side-band trace-context carrier sent
+# BEFORE a block announcement.  Only ever sent to peers that advertised
+# the capability themselves, so vanilla peers never see either command
+# (and would ignore the unknown commands if they did) — wire compat
+# with untraced peers is untouched.
+MSG_SENDTRACECTX = "sendtracectx"
+MSG_TRACECTX = "tracectx"
 # asset wire messages (ref protocol.cpp:45-47: "getassetdata"/"assetdata"
 # but — reference quirk — the not-found reply really is "asstnotfound")
 MSG_GETASSETDATA = "getassetdata"

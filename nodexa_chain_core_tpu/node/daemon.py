@@ -481,6 +481,21 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             log_printf("outbound via SOCKS5 proxy %s:%d", *node.connman.proxy)
         if g_args.is_set("onion"):
             node.connman.onion_proxy = _parse_hostport(g_args.get("onion"))
+        # -tracepeers: experimental cross-node trace propagation (wire
+        # compat untouched — the tracectx carrier only ever goes to peers
+        # that advertised the capability back); -propmapsize bounds the
+        # propagation-tracking maps (evictions are counted on
+        # nodexa_propagation_map_evictions_total)
+        node.connman.processor.trace_peers = g_args.get_bool("tracepeers")
+        if g_args.is_set("propmapsize"):
+            # explicit-flag typo discipline (same as -faultinject /
+            # -calibrationfile): a set flag with a bad value — including
+            # 0 — must refuse startup, not silently keep the default
+            prop_cap = g_args.get_int("propmapsize", 0)
+            if prop_cap < 16:
+                raise SystemExit(
+                    "Error: -propmapsize wants a bound >= 16")
+            node.connman.processor.first_seen_cap = prop_cap
         with g_startup.stage("network"):
             node.connman.start()
 

@@ -94,6 +94,10 @@ class JobManager(ValidationInterface):
         self._pending_clean = False
         self._pending_refresh = False
         self._thread: Optional[threading.Thread] = None
+        # wall time the tip last moved: a stale-share reject's age
+        # against this stamp attributes the loss to propagation +
+        # notify latency (nodexa_pool_stale_share_lag_seconds)
+        self.tip_changed_at = time.time()
 
     def start(self) -> None:
         main_signals.register(self)
@@ -125,6 +129,10 @@ class JobManager(ValidationInterface):
     # -- validation interface (the push triggers; flag-and-wake only) ------
 
     def updated_block_tip(self, new_tip, fork_tip, initial_download) -> None:
+        # stamped UNCONDITIONALLY (before the sync gates): the moment
+        # the tip moved is when every outstanding job went stale, and
+        # that is the zero point stale-share lag is measured from
+        self.tip_changed_at = time.time()
         if initial_download or self._syncing():
             return  # don't spray jobs while syncing; tip isn't ours yet
         with self._lock:  # vs _run's consume: a tip flag set in the

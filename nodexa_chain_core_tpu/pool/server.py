@@ -54,6 +54,14 @@ _M_MISBEHAVIOR = g_metrics.counter(
 _M_NOTIFY_SECONDS = g_metrics.histogram(
     "nodexa_pool_notify_seconds",
     "Job-notify fanout latency (one observation per broadcast)")
+# stale-share attribution: submit time minus the tip change that staled
+# the job.  Small lags are notify/miner-restart latency; lags tracking
+# nodexa_block_propagation_seconds mean the POOL's losses are network
+# propagation — the cross-node trace layer tells you which hop.
+_M_STALE_LAG = g_metrics.histogram(
+    "nodexa_pool_stale_share_lag_seconds",
+    "Stale-share submit time minus the tip change that staled its job",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
 _M_VARDIFF = g_metrics.counter(
     "nodexa_pool_vardiff_retargets_total",
     "Vardiff retargets, labeled direction=up/down")
@@ -559,6 +567,12 @@ class StratumServer:
             self._misbehave(sess, 1, sh.R_UNKNOWN_JOB)
             return False
         if self.jobs.is_stale(job):
+            # attribute the loss: how long after the tip moved did this
+            # share still arrive on the superseded job?
+            lag = max(0.0, time.time() - self.jobs.tip_changed_at)
+            _M_STALE_LAG.observe(lag)
+            if root is not None:
+                root.set(stale_lag_s=round(lag, 3))
             self._reject(sess, req_id, sh.E_STALE, sh.R_STALE)
             return False
         if (nonce >> 48) != sess.extranonce1:

@@ -270,6 +270,21 @@ def getpeerinfo(node, params: List[Any]):
     return node.connman.peer_info()
 
 
+def getnetstats(node, params: List[Any]):
+    """Node-wide wire observability in one read: peer census, per-command
+    msg/byte totals across live AND closed peers, the relay-efficiency
+    ledger (announcements offered vs wanted, duplicate-inv ratio,
+    compact-block reconstruction hit rate), send-stall watch, disconnect
+    reasons, and the block-propagation bookkeeping (first-seen map
+    depth/evictions, in-flight downloads, trace-propagation state).
+    Deliberately readable in safe mode — a degraded node's network story
+    is exactly what a post-mortem starts with."""
+    if node.connman is None:
+        return {"peers": {"total": 0, "inbound": 0, "outbound": 0},
+                "p2p": False}
+    return node.connman.net_stats()
+
+
 def getconnectioncount(node, params: List[Any]):
     return node.connman.connection_count() if node.connman else 0
 
@@ -377,6 +392,7 @@ def register(table: RPCTable) -> None:
         ("control", "getnodehealth", getnodehealth, []),
         ("network", "getnetworkinfo", getnetworkinfo, []),
         ("network", "getpeerinfo", getpeerinfo, []),
+        ("network", "getnetstats", getnetstats, []),
         ("network", "getconnectioncount", getconnectioncount, []),
         ("network", "addpeeraddress", addpeeraddress, ["address", "port", "tried"]),
         ("network", "addnode", addnode, ["node", "command"]),

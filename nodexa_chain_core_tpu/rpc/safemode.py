@@ -76,7 +76,8 @@ MUTATING_COMMANDS = frozenset({
 # before any health-layer consultation.
 READONLY_DIAGNOSTIC_COMMANDS = frozenset({
     "getmetrics", "getprofile", "gettrace", "dumpflightrecorder",
-    "getstartupinfo", "getnodehealth", "help", "uptime", "stop",
+    "getstartupinfo", "getnodehealth", "getnetstats", "help", "uptime",
+    "stop",
 })
 
 assert not (READONLY_DIAGNOSTIC_COMMANDS & MUTATING_COMMANDS), (

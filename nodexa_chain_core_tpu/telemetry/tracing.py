@@ -49,6 +49,17 @@ from . import flight_recorder
 
 _counter = itertools.count(1)
 _PROC = f"{os.getpid() & 0xFFFFFF:06x}"
+# span ids must be unique CLUSTER-wide, not just process-wide: remote
+# spans parent to ids minted on other nodes, and flight-recorder dumps
+# from several nodes merge into one tree (tools/propagation_report.py).
+# A bare counter collides across processes (every node starts at 1), so
+# ids carry a random 32-bit process tag in the high bits — still an int
+# that fits the tracectx wire field (u64).
+_SPAN_TAG = int.from_bytes(os.urandom(4), "big") << 32
+
+
+def _next_span_id() -> int:
+    return _SPAN_TAG | (next(_counter) & 0xFFFFFFFF)
 
 _current: "contextvars.ContextVar[Optional[TraceSpan]]" = (
     contextvars.ContextVar("nodexa_trace_span", default=None)
@@ -69,7 +80,7 @@ class TraceSpan:
                  attrs: Optional[dict]):
         self.name = name
         self.trace_id = trace_id
-        self.span_id = next(_counter)
+        self.span_id = _next_span_id()
         self.parent_id = parent_id
         self.thread = threading.current_thread().name
         self.attrs = attrs or {}
@@ -145,6 +156,33 @@ def child_span(name: str, parent: Optional[TraceSpan],
     return TraceSpan(name, parent.trace_id, parent.span_id, attrs)
 
 
+def wire_context(span: Optional[TraceSpan]) -> Optional[tuple]:
+    """The cross-NODE continuation handle: a ``(trace_id, span_id)``
+    pair small enough to ride a wire message (or netsim side-band link
+    metadata) with a block/tx announcement.  ``None`` span (untraced
+    sender, or tracing disabled) stays ``None`` so receivers never open
+    remote spans for untraced work."""
+    if span is None or not _spans._enabled:
+        return None
+    return (span.trace_id, span.span_id)
+
+
+def remote_span(name: str, ctx: Optional[tuple], **attrs) -> Optional[TraceSpan]:
+    """Open a span whose parent lives on ANOTHER node: ``ctx`` is the
+    ``wire_context`` the announcement carried.  The returned handle
+    joins the remote trace (same trace id, parent = the remote span),
+    so a cluster-wide propagation tree assembles from per-node rings.
+    No-ops on ``None`` ctx — an untraced announcement must stay
+    untraced on the receiving side too."""
+    if not _spans._enabled or ctx is None:
+        return None
+    try:
+        trace_id, parent_id = str(ctx[0]), int(ctx[1])
+    except (TypeError, ValueError, IndexError):
+        return None  # malformed wire input: never let it break relay
+    return TraceSpan(name, trace_id, parent_id, attrs)
+
+
 def record_span(name: str, parent: Optional[TraceSpan], started_perf: float,
                 ended_perf: Optional[float] = None, status: str = "ok",
                 **attrs) -> None:
@@ -157,7 +195,7 @@ def record_span(name: str, parent: Optional[TraceSpan], started_perf: float,
     _spans.observe_span(name, dt)
     rec = {
         "trace_id": parent.trace_id,
-        "span_id": next(_counter),
+        "span_id": _next_span_id(),
         "parent_id": parent.span_id,
         "name": name,
         "thread": threading.current_thread().name,

@@ -1,0 +1,655 @@
+"""Cluster-wide causal propagation tracing + per-peer wire observability.
+
+Covers the PR-12 tentpole and satellites: remote-parent spans joining a
+trace across node boundaries (side-band in netsim, so ``SimNet.digest()``
+replay equality is asserted with tracing ON vs OFF), the FleetObserver's
+per-hop stage decomposition (queue/serialize/latency/validate/relay)
+reconciling with the end-to-end propagation delay, the bounded
+propagation maps' eviction accounting, the structured ``peer_disconnect``
+flight-recorder event, the getpeerinfo-grade per-peer ledger +
+``getnetstats`` surface, exposition conformance for every new metric
+family, and the propagation-report renderers.
+
+All netsim scenarios run in simulated time — no wall-clock sleeps.
+"""
+
+import importlib.util
+import json
+import math
+import os
+
+import pytest
+
+from nodexa_chain_core_tpu.net.netsim import LinkSpec, SimNet
+from nodexa_chain_core_tpu.telemetry import flight_recorder, g_metrics, tracing
+from nodexa_chain_core_tpu.telemetry.spans import (
+    set_spans_enabled,
+    spans_enabled,
+)
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    """These tests exercise both switch states; leave it as found."""
+    was = spans_enabled()
+    set_spans_enabled(True)
+    yield
+    set_spans_enabled(was)
+
+
+def _chain_net(n=5, seed=7, **kw):
+    """A line topology 0-1-...-(n-1): every block from node 0 crosses
+    n-1 hops, the shape the >=3-hop assembly assertions need."""
+    net = SimNet(n, seed=seed,
+                 default_spec=LinkSpec(latency_s=0.02,
+                                       bandwidth_bps=2_000_000), **kw)
+    for i in range(n - 1):
+        net.connect(i, i + 1)
+    assert net.settle(30.0)
+    return net
+
+
+# ------------------------------------------------------ tracing primitives
+
+
+def test_wire_context_and_remote_span_round_trip():
+    root = tracing.start_trace("block.propagation", block="ab")
+    ctx = tracing.wire_context(root)
+    assert ctx == (root.trace_id, root.span_id)
+    hop = tracing.remote_span("block.hop", ctx, peer=3)
+    assert hop is not None
+    assert hop.trace_id == root.trace_id
+    assert hop.parent_id == root.span_id
+    hop.finish()
+    root.finish()
+
+
+def test_remote_span_noops_on_none_and_malformed_ctx():
+    assert tracing.remote_span("block.hop", None) is None
+    assert tracing.remote_span("block.hop", ("id",)) is None
+    assert tracing.remote_span("block.hop", ("id", "not-an-int")) is None
+
+
+def test_wire_context_disabled_is_none():
+    root = tracing.start_trace("t")
+    set_spans_enabled(False)
+    assert tracing.wire_context(root) is None
+    assert tracing.remote_span("h", ("a", 1)) is None
+    set_spans_enabled(True)
+
+
+# ------------------------------------- determinism: tracing cannot perturb
+
+
+def test_digest_replay_equality_tracing_on_vs_off():
+    """Satellite: same seed+topology+script produces an identical
+    SimNet.digest() with tracing enabled vs disabled (the side-band
+    trace context is link metadata, not wire traffic)."""
+
+    def run(traced):
+        set_spans_enabled(traced)
+        net = _chain_net(n=4, seed=11)
+        try:
+            net.mine_block(0)
+            assert net.run_until(net.converged, 120.0)
+            return net.digest()
+        finally:
+            net.stop()
+
+    d_on = run(True)
+    d_on2 = run(True)
+    d_off = run(False)
+    assert d_on == d_on2, "traced replay diverged"
+    assert d_on == d_off, "tracing changed the simulation"
+
+
+# --------------------------------------------- cross-node trace assembly
+
+
+def test_cross_node_trace_spans_at_least_three_hops():
+    flight_recorder.clear()
+    net = _chain_net(n=5, seed=7)
+    try:
+        net.mine_block(0)
+        assert net.run_until(net.converged, 120.0)
+    finally:
+        net.stop()
+    best_depth = 0
+    best_names = set()
+    for spans in flight_recorder.complete_traces().values():
+        names = {s["name"] for s in spans}
+        if "block.propagation" not in names:
+            continue
+        by_id = {s["span_id"]: s for s in spans}
+        for s in spans:
+            if s["name"] != "block.hop":
+                continue
+            depth, cur = 0, s
+            while cur.get("parent_id") in by_id:
+                cur = by_id[cur["parent_id"]]
+                depth += 1
+            if depth > best_depth:
+                best_depth = depth
+                best_names = names
+    assert best_depth >= 3, f"deepest hop chain {best_depth}"
+    # the hop decomposition spans ride in the same tree
+    assert "hop.validate" in best_names
+    assert "hop.relay" in best_names
+
+
+def test_fleet_observer_stage_decomposition_reconciles():
+    net = _chain_net(n=5, seed=9)
+    try:
+        h = net.mine_block(0)
+        assert net.run_until(net.converged, 120.0)
+        obs = net.observer
+        assert obs is not None
+        cs = obs.chain_stages(h, 4)
+        assert cs is not None and cs["hops"] == 4
+        for name, v in cs["stages"].items():
+            assert math.isfinite(v) and v >= 0.0, (name, v)
+        # bandwidth_bps set => serialization time is nonzero and exact
+        assert cs["stages"]["serialize"] > 0.0
+        assert cs["stages"]["latency"] >= 4 * 0.02 - 1e-9
+        # sim-time stage sum telescopes to the end-to-end delay exactly
+        assert cs["recon_err"] < 0.10
+        agg = obs.aggregate([h])
+        assert agg["chains"] == 4
+        assert agg["max_hops"] == 4
+        assert agg["recon_err_max"] < 0.10
+        assert all(math.isfinite(v) for v in agg["stage_ms"].values())
+    finally:
+        net.stop()
+
+
+def test_observer_disabled_when_tracing_off_and_lean_mode():
+    set_spans_enabled(False)
+    net = SimNet(2, seed=3)
+    assert net.observer is None
+    net.stop()
+    set_spans_enabled(True)
+    net = SimNet(2, seed=3, wire_stats=False)
+    assert net.observer is None  # lean baseline bypasses the layer
+    assert not net.wire_stats
+    net.stop()
+
+
+def test_link_fault_counters_count_blackholed_commands():
+    blackhole = LinkSpec(latency_s=0.01,
+                         drop_commands=frozenset({"cmpctblock", "block"}))
+    with SimNet(2, seed=5) as net:
+        link = net.connect(0, 1, spec=blackhole, spec_back=blackhole)
+        assert net.settle(30.0)
+        net.mine_block(0)
+        net.run(5.0)
+        stats = net.link_stats()
+        assert stats[0]["a"] == 0 and stats[0]["b"] == 1
+        eaten = sum(f["blackholed"] for f in link.faults.values())
+        assert eaten >= 1
+
+
+# ------------------------------------------ bounded maps + eviction count
+
+
+def test_first_seen_eviction_counter_and_configurable_cap():
+    evict = g_metrics.counter("nodexa_propagation_map_evictions_total")
+    with SimNet(2, seed=2) as net:
+        proc = net.nodes[0].processor
+        proc.first_seen_cap = 8
+        before = evict.value(map="first_seen")
+        for h in range(1, 30):
+            proc._note_block_announced(h)
+        assert len(proc._block_first_seen) <= 8
+        assert evict.value(map="first_seen") > before
+        # the hash noted AFTER an eviction round still lands
+        assert 29 in proc._block_first_seen
+
+
+def test_remote_ctx_map_bounded_with_evictions_counted():
+    evict = g_metrics.counter("nodexa_propagation_map_evictions_total")
+    with SimNet(2, seed=2) as net:
+        proc = net.nodes[0].processor
+        proc.first_seen_cap = 8
+        before = evict.value(map="trace_ctx")
+        for h in range(1, 30):
+            proc.note_remote_trace_ctx(h, ("tid", h))
+        assert len(proc._remote_trace_ctx) <= 8
+        assert evict.value(map="trace_ctx") > before
+
+
+def test_finished_prop_spans_are_pruned_after_fanout():
+    """Review regression: finished propagation spans must be consumed
+    (small recent window) instead of accumulating to the cap and firing
+    the map=spans eviction alarm forever on a long-lived daemon."""
+    with SimNet(2, seed=12) as net:
+        net.connect(0, 1)
+        assert net.settle(30.0)
+        for _ in range(70):  # > the keep-window of 64
+            net.mine_block(0, advance_s=1.0)
+        net.run_until(net.converged, 120.0)
+        proc = net.nodes[0].processor
+        assert len(proc._prop_spans) <= 65
+        evict = g_metrics.counter("nodexa_propagation_map_evictions_total")
+        assert evict.value(map="spans") == 0
+
+
+def test_sideband_ctx_withheld_on_blackholed_announcement():
+    """Review regression: a link that blackholes the announcement
+    command must withhold the trace context too — a hop span must not
+    parent to a peer whose announcement never arrived."""
+    blackhole = LinkSpec(latency_s=0.005, drop_commands=frozenset(
+        {"cmpctblock", "headers", "inv", "block"}))
+    with SimNet(3, seed=14) as net:
+        net.connect(0, 1, spec=blackhole)          # 0->1 blackholed
+        net.connect(2, 1, spec=LinkSpec(latency_s=0.05))  # honest, slower
+        net.connect(0, 2)
+        assert net.settle(30.0)
+        h = net.mine_block(0)
+        assert net.run_until(
+            lambda: net.nodes[1].tip_hash() == h, 120.0)
+        # node 1 got the block via node 2; its hop must say so
+        hop = net.observer.hop(h, 1)
+        assert hop is not None and hop["from"] == 2
+        # and the blackholed link never delivered node 0's context: the
+        # ctx node 1 consumed names node 2 as the announcing peer
+        hops1 = [s for spans in flight_recorder.traces().values()
+                 for s in spans if s["name"] == "block.hop"
+                 and s["attrs"].get("peer_addr") == net.nodes[2].ip]
+        assert hops1, "node 1's hop did not attribute the honest peer"
+
+
+def test_invs_wanted_ignores_unannounced_getdata():
+    """Review regression: headers-driven IBD getdata for blocks we
+    never announced must not inflate invs_wanted past invs_sent."""
+    from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
+    from nodexa_chain_core_tpu.net.protocol import INV_BLOCK, Inv
+
+    with SimNet(2, seed=16) as net:
+        net.connect(0, 1)
+        assert net.settle(30.0)
+        h = net.mine_block(0)
+        net.run_until(net.converged, 60.0)
+        proc = net.nodes[0].processor
+        peer = net.nodes[0].connman.all_peers()[0]
+        base = peer.invs_wanted
+        w = ByteWriter()
+        w.vector([Inv(INV_BLOCK, 0xDEAD)], lambda wr, i: i.serialize(wr))
+        proc._on_getdata(peer, ByteReader(w.getvalue()))
+        assert peer.invs_wanted == base  # unannounced: not counted
+        w = ByteWriter()
+        w.vector([Inv(INV_BLOCK, h)], lambda wr, i: i.serialize(wr))
+        proc._on_getdata(peer, ByteReader(w.getvalue()))
+        assert peer.invs_wanted == base + 1  # announced block: counted
+
+
+# ------------------------------------------- peer_disconnect event trail
+
+
+def test_peer_disconnect_emits_flight_recorder_event():
+    flight_recorder.clear()
+    with SimNet(2, seed=4) as net:
+        assert net.connect(0, 1)
+        assert net.settle(30.0)
+        node = net.nodes[0]
+        peer = node.connman.all_peers()[0]
+        peer.disconnect_reason = "stall"
+        peer.disconnect = True
+        node.connman._remove_peer(peer)
+    events = [e for e in flight_recorder.events_snapshot()
+              if e["kind"] == "peer_disconnect"]
+    assert events, "no peer_disconnect event recorded"
+    ev = events[-1]
+    assert ev["reason"] == "stall"
+    assert ev["peer"] == peer.id
+    assert "last_command_recv" in ev and "inflight_blocks" in ev
+
+
+# ----------------------------------- per-peer ledger + getnetstats surface
+
+
+def test_peer_info_carries_wire_ledger_and_relay_fields():
+    with SimNet(3, seed=6) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        info = net.nodes[0].connman.peer_info()
+        assert info, "no peers"
+        p = info[0]
+        for key in ("minping", "bytessent", "bytesrecv", "sendstall_s",
+                    "inflight", "msgssent_per_msg", "bytesrecv_per_msg",
+                    "last_command_recv", "relay", "tracectx"):
+            assert key in p, key
+        assert p["msgssent_per_msg"].get("version") == 1
+        assert sum(p["bytesrecv_per_msg"].values()) == p["bytesrecv"]
+        assert set(p["relay"]) >= {"invs_sent", "dup_invs_recv",
+                                   "dup_inv_ratio"}
+
+
+def test_net_stats_aggregate_shape_and_propagation_block():
+    with SimNet(3, seed=8) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        net.mine_block(1)
+        assert net.run_until(net.converged, 60.0)
+        stats = net.nodes[0].connman.net_stats()
+        assert stats["peers"]["total"] == 2
+        assert stats["totalbytessent"] > 0
+        assert stats["per_command"].get("version", {}).get("sent_msgs") >= 1
+        relay = stats["relay"]
+        assert 0.0 <= relay["dup_inv_ratio"] <= 1.0
+        prop = stats["propagation"]
+        assert prop["map_cap"] >= 16
+        assert "evictions" in prop and "in_flight_blocks" in prop
+        assert prop["trace_peers"] is False
+        # closed peers keep feeding the aggregate
+        peer = net.nodes[0].connman.all_peers()[0]
+        sent_before = net.nodes[0].connman.net_stats()[
+            "per_command"]["version"]["sent_msgs"]
+        net.nodes[0].connman._remove_peer(peer)
+        sent_after = net.nodes[0].connman.net_stats()[
+            "per_command"]["version"]["sent_msgs"]
+        assert sent_after == sent_before
+
+
+def test_getnetstats_registered_and_safe_mode_readable():
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.safemode import (
+        MUTATING_COMMANDS,
+        READONLY_DIAGNOSTIC_COMMANDS,
+    )
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    table = register_all(RPCTable())
+    assert "getnetstats" in set(table.commands())
+    assert "getnetstats" in READONLY_DIAGNOSTIC_COMMANDS
+    assert "getnetstats" not in MUTATING_COMMANDS
+
+
+def test_getnetstats_rpc_without_p2p():
+    from nodexa_chain_core_tpu.rpc.misc import getnetstats
+
+    class _N:
+        connman = None
+
+    out = getnetstats(_N(), [])
+    assert out["p2p"] is False
+    assert out["peers"]["total"] == 0
+
+
+# ----------------------------------- -tracepeers over real loopback sockets
+
+
+def test_tracepeers_capability_and_tracectx_on_real_sockets():
+    """The wire form of the tentpole: two real nodes over loopback TCP,
+    both running -tracepeers, complete the sendtracectx capability
+    handshake; a block announced by one opens a remote-parented
+    block.hop span on the other, fed by an actual tracectx message."""
+    import time as _t
+
+    from nodexa_chain_core_tpu.mining.assembler import (
+        BlockAssembler,
+        mine_block_cpu,
+    )
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    flight_recorder.clear()
+    msgs = g_metrics.get("nodexa_p2p_messages_total")
+    ctx_recv0 = msgs.value(command="tracectx", direction="recv")
+    n1 = NodeContext(network="regtest")
+    n2 = NodeContext(network="regtest")
+    c1 = ConnMan(n1, port=0)
+    c2 = ConnMan(n2, port=0)
+    c1.processor.trace_peers = True
+    c2.processor.trace_peers = True
+    n1.connman, n2.connman = c1, c2
+    try:
+        c1.start()
+        c2.start()
+        assert c2.connect_to(f"127.0.0.1:{c1.port}")
+
+        def _wait(cond, msg, timeout=10.0):
+            deadline = _t.time() + timeout
+            while _t.time() < deadline:
+                if cond():
+                    return
+                _t.sleep(0.05)
+            pytest.fail(msg)
+
+        _wait(lambda: any(p.handshake_done and p.trace_ctx_ok
+                          for p in c2.all_peers()),
+              "capability handshake did not complete")
+        # mine on n1 and announce: n2 must accept it and open a hop span
+        blk = BlockAssembler(n1.chainstate).create_new_block(b"\x51")
+        assert mine_block_cpu(blk, n1.params.algo_schedule,
+                              max_tries=1 << 22)
+        n1.chainstate.process_new_block(blk)
+        tip = n1.chainstate.tip().block_hash
+        c1.relay_block_hash(tip)
+        _wait(lambda: n2.chainstate.tip().block_hash == tip,
+              "block did not relay")
+        assert msgs.value(command="tracectx", direction="recv") > ctx_recv0
+        _wait(lambda: any(
+            s["name"] == "block.hop"
+            for spans in flight_recorder.traces().values() for s in spans),
+            "no remote-parented hop span recorded")
+        hops = [s for spans in flight_recorder.traces().values()
+                for s in spans if s["name"] == "block.hop"]
+        roots = [s for spans in flight_recorder.traces().values()
+                 for s in spans if s["name"] == "block.propagation"]
+        assert roots, "origin root span missing"
+        assert any(h["trace_id"] == r["trace_id"]
+                   for h in hops for r in roots), \
+            "hop did not join the origin's trace"
+    finally:
+        c1.stop()
+        c2.stop()
+        n1.shutdown()
+        n2.shutdown()
+
+
+def test_tracepeers_off_sends_no_trace_commands():
+    """Wire-compat boundary: without -tracepeers neither sendtracectx
+    nor tracectx ever hits the wire (per-peer ledger asserted)."""
+    import time as _t
+
+    from nodexa_chain_core_tpu.net.connman import ConnMan
+    from nodexa_chain_core_tpu.node.context import NodeContext
+
+    n1 = NodeContext(network="regtest")
+    n2 = NodeContext(network="regtest")
+    c1 = ConnMan(n1, port=0)
+    c2 = ConnMan(n2, port=0)
+    try:
+        c1.start()
+        c2.start()
+        assert c2.connect_to(f"127.0.0.1:{c1.port}")
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            if any(p.handshake_done for p in c2.all_peers()):
+                break
+            _t.sleep(0.05)
+        else:
+            pytest.fail("handshake did not complete")
+        for cm in (c1, c2):
+            for p in cm.all_peers():
+                assert not p.trace_ctx_ok
+                assert "sendtracectx" not in p.msg_stats["sent"]
+                assert "tracectx" not in p.msg_stats["sent"]
+    finally:
+        c1.stop()
+        c2.stop()
+        n1.shutdown()
+        n2.shutdown()
+
+
+# ------------------------------------------------- stale-share attribution
+
+
+def test_job_manager_stamps_tip_change_for_stale_attribution():
+    from nodexa_chain_core_tpu.pool.jobs import JobManager
+
+    class _Params:
+        mining_requires_peers = True
+
+    class _Node:
+        params = _Params()
+        chainstate = None
+
+    jm = JobManager(_Node(), b"\x51")
+    before = jm.tip_changed_at
+    # even a tip observed mid-IBD must move the stamp (that is the
+    # moment outstanding jobs went stale)
+    jm.updated_block_tip(object(), None, initial_download=True)
+    assert jm.tip_changed_at >= before
+    hist = g_metrics.get("nodexa_pool_stale_share_lag_seconds")
+    assert hist is not None and hist.kind == "histogram"
+
+
+# ------------------------------------------------ exposition conformance
+
+
+def test_new_metric_families_expose_conformant():
+    from nodexa_chain_core_tpu.telemetry.exposition import prometheus_text
+
+    g_metrics.counter("nodexa_propagation_map_evictions_total").inc(
+        map="first_seen")
+    g_metrics.counter("nodexa_relay_invs_total").inc(
+        direction="sent", dedup="new")
+    g_metrics.counter("nodexa_cmpct_reconstructions_total").inc(
+        result="mempool")
+    g_metrics.histogram("nodexa_pool_stale_share_lag_seconds").observe(0.3)
+    text = prometheus_text()
+    lines = text.splitlines()
+    for fam, kind in (
+        ("nodexa_propagation_map_evictions_total", "counter"),
+        ("nodexa_relay_invs_total", "counter"),
+        ("nodexa_cmpct_reconstructions_total", "counter"),
+        ("nodexa_pool_stale_share_lag_seconds", "histogram"),
+    ):
+        assert f"# TYPE {fam} {kind}" in text, fam
+        assert any(ln.startswith(f"# HELP {fam} ") for ln in lines), fam
+    # histogram conformance: cumulative buckets monotone, +Inf == count
+    buckets = []
+    count = None
+    for ln in lines:
+        if ln.startswith("nodexa_pool_stale_share_lag_seconds_bucket"):
+            buckets.append(float(ln.rsplit(" ", 1)[1]))
+        if ln.startswith("nodexa_pool_stale_share_lag_seconds_count"):
+            count = float(ln.rsplit(" ", 1)[1])
+    assert buckets == sorted(buckets) and buckets, "buckets not monotone"
+    assert count is not None and buckets[-1] == count
+
+
+# ------------------------------------------------ propagation_report tool
+
+
+def _load_report():
+    spec = importlib.util.spec_from_file_location(
+        "propagation_report", os.path.join(
+            os.path.dirname(__file__), "..", "tools",
+            "propagation_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_render_block_waterfall_columns():
+    rep = _load_report()
+    hops = [{
+        "block": "ab" * 8, "from": 0, "to": 1, "command": "cmpctblock",
+        "t_accept": 10.025, "total_s": 0.025,
+        "stages": {"queue": 0.001, "serialize": 0.002, "latency": 0.02,
+                   "validate": 0.003, "relay": 0.002},
+        "chained": True,
+    }]
+    lines = rep.render_block("ab" * 8, 0, 10.0, hops)
+    joined = "\n".join(lines)
+    assert "origin node 0" in joined
+    assert "cmpctblock" in joined
+    assert "20.00ms" in joined  # latency column
+    assert "|" in joined        # the bar
+    assert rep.render_block("cd" * 8, 1, 0.0, [])[-1].startswith(
+        "  (no observed")
+
+
+def test_render_trace_tree_and_dump_report(tmp_path):
+    rep = _load_report()
+    spans = [
+        {"trace_id": "t1", "span_id": 1, "parent_id": None,
+         "name": "block.propagation", "thread": "n0", "start": 100.0,
+         "duration_s": 0.01, "status": "ok", "attrs": {"block": "ab"}},
+        {"trace_id": "t1", "span_id": 2, "parent_id": 1,
+         "name": "block.hop", "thread": "n1", "start": 100.02,
+         "duration_s": 0.02, "status": "ok",
+         "attrs": {"peer": 1, "propagation_s": 0.02}},
+        {"trace_id": "t1", "span_id": 3, "parent_id": 2,
+         "name": "hop.validate", "thread": "n1", "start": 100.03,
+         "duration_s": 0.003, "status": "ok"},
+    ]
+    lines = rep.render_trace("t1", spans)
+    assert lines[0].startswith("trace t1")
+    # child indented deeper than parent
+    hop_line = next(ln for ln in lines if "block.hop" in ln)
+    val_line = next(ln for ln in lines if "hop.validate" in ln)
+    assert len(val_line) - len(val_line.lstrip()) > \
+        len(hop_line) - len(hop_line.lstrip())
+    # dump round trip: two dumps (two "nodes") merge into one trace
+    d1 = tmp_path / "fr1.json"
+    d2 = tmp_path / "fr2.json"
+    d1.write_text(json.dumps({"spans": spans[:1], "events": []}))
+    d2.write_text(json.dumps({"spans": spans[1:], "events": []}))
+    out = rep.report_from_dumps([str(d1), str(d2)])
+    joined = "\n".join(out)
+    assert "1 propagation trace(s) across 2 dump(s)" in joined
+    assert "block.hop" in joined
+
+
+def test_render_aggregate_lines():
+    rep = _load_report()
+    agg = {"chains": 4, "mean_hops": 2.5, "max_hops": 4,
+           "stage_ms": {"queue": 0.1, "serialize": 1.7, "latency": 50.0,
+                        "validate": 2.2, "relay": 40.9},
+           "e2e_mean_ms": 92.8, "recon_err_max": 0.0}
+    lines = rep.render_aggregate(agg)
+    assert "4 chains" in lines[0]
+    assert "latency=50.0ms" in lines[1]
+    assert rep.render_aggregate({}) == ["no chains observed"]
+
+
+# ------------------------------------------------------- nodexa_top pane
+
+
+def _load_top():
+    spec = importlib.util.spec_from_file_location(
+        "nodexa_top_netobs", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "nodexa_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_nodexa_top_relay_pane_present_and_absent():
+    top = _load_top()
+    snap = {
+        "nodexa_relay_invs_total": {"values": [
+            {"labels": {"direction": "recv", "dedup": "new"}, "value": 60},
+            {"labels": {"direction": "recv", "dedup": "duplicate"},
+             "value": 40},
+            {"labels": {"direction": "sent", "dedup": "new"}, "value": 9},
+        ]},
+        "nodexa_cmpct_reconstructions_total": {"values": [
+            {"labels": {"result": "mempool"}, "value": 5},
+            {"labels": {"result": "roundtrip"}, "value": 2},
+        ]},
+        "nodexa_propagation_map_evictions_total": {"values": [
+            {"labels": {"map": "first_seen"}, "value": 3},
+        ]},
+    }
+    frame = top.render(snap, None, 2.0)
+    assert "dup 40%" in frame
+    assert "mempool=5" in frame and "roundtrip=2" in frame
+    assert "prop-evictions=3" in frame
+    # absent families: the pane renders '-' instead of fabricated zeros
+    assert "relay: -" in top.render({}, None, 2.0)

@@ -44,6 +44,17 @@ REFERENCE_COMMANDS = {
     "MSG_ASSETNOTFOUND": "asstnotfound",
 }
 
+# Commands this node speaks BEYOND the reference surface, pinned exactly
+# like the RPC extras in tools/check_rpc_mappings.py.  Both are the
+# experimental -tracepeers cross-node trace propagation (README "Network
+# observability"): capability-gated, never sent to a peer that did not
+# advertise the capability back, so the reference-parity wire surface
+# above is what vanilla peers observe.
+EXTENSION_COMMANDS = {
+    "MSG_SENDTRACECTX": "sendtracectx",
+    "MSG_TRACECTX": "tracectx",
+}
+
 
 def test_every_command_string_matches_reference():
     for const, wire in REFERENCE_COMMANDS.items():
@@ -54,11 +65,24 @@ def test_every_command_string_matches_reference():
 
 def test_no_unpinned_commands():
     """Any new MSG_* constant must be added to the reference table above
-    (with a reference citation) before it ships."""
+    (with a reference citation) or pinned as an extension before it
+    ships."""
     ours = {n for n in dir(p) if n.startswith("MSG_")}
-    assert ours == set(REFERENCE_COMMANDS), (
-        f"unpinned commands: {ours.symmetric_difference(REFERENCE_COMMANDS)}"
+    pinned = set(REFERENCE_COMMANDS) | set(EXTENSION_COMMANDS)
+    assert ours == pinned, (
+        f"unpinned commands: {ours.symmetric_difference(pinned)}"
     )
+
+
+def test_extension_commands_fit_the_wire_and_never_collide():
+    """Extensions must still fit the 12-byte NUL-padded command field
+    and must not shadow any reference command string."""
+    for const, wire in EXTENSION_COMMANDS.items():
+        assert getattr(p, const) == wire
+        assert len(wire.encode()) <= 12, f"{const} overflows the header"
+        assert wire not in REFERENCE_COMMANDS.values(), (
+            f"{const} collides with a reference command"
+        )
 
 
 def test_message_header_layout():

@@ -46,6 +46,10 @@ EXPECTED_EXTRAS = {
     # causal observability: trace retrieval, flight-recorder dump, boot
     # attribution (telemetry/tracing + flight_recorder + startup)
     "gettrace", "dumpflightrecorder", "getstartupinfo",
+    # node-wide wire observability: per-peer/per-command ledger, relay
+    # efficiency, propagation + trace-propagation state (rpc/misc.py,
+    # safe-mode readable via READONLY_DIAGNOSTIC_COMMANDS)
+    "getnetstats",
     # always-on sampling profiler (telemetry/profiler; safe-mode
     # readable via rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS)
     "getprofile",

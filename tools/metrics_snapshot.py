@@ -113,6 +113,40 @@ before and after a serving interval, then read the delta's
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_util.json | python -m json.tool | grep -A6 nodexa_kernel
 
+Diffing a relay/propagation interval (the wire-observability layer):
+snapshot before and after a block interval (or a netsim run), then
+read the delta's
+
+  nodexa_block_propagation_seconds
+      — first announcement -> local acceptance; the netsim N=50
+      aggregate of this series is block_propagation_p95_ms in bench.py,
+      and the FleetObserver decomposes it per hop into
+      queue/serialize/latency/validate/relay stages
+  nodexa_relay_invs_total{direction=sent|recv,dedup=new|duplicate}
+      — announcement pressure both ways; a climbing duplicate share
+      means peers waste your bandwidth re-announcing what you have
+  nodexa_cmpct_reconstructions_total{result=mempool|roundtrip|
+      full_fallback}
+      — compact-block readiness: `mempool` hits cost zero round trips,
+      `roundtrip` pays a getblocktxn RTT, `full_fallback` means
+      short-id collisions forced a full block
+  nodexa_propagation_map_evictions_total{map=first_seen|trace_ctx|spans}
+      — nonzero means the propagation maps hit their -propmapsize
+      bound and the histogram is under-fed (raise the bound)
+  nodexa_peer_disconnects_total{reason=...} and the flight recorder's
+      `peer_disconnect` events — why peers left, with last command +
+      in-flight blocks per departure (dumpflightrecorder)
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_net.json
+  ... let blocks relay / run the netsim bench ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_net.json | python -m json.tool \
+      | grep -E "propagation|relay_invs|cmpct"
+
+getnetstats is the RPC twin of this delta for the per-peer view:
+per-command msg/byte ledgers, relay-efficiency ratios, send-stall
+watch, and the trace-propagation state in one safe-mode-readable call.
+
 Diffing a tx flood (the PR-4 staged-admission proof): snapshot before
 relaying a burst of transactions at the node and after the mempool
 settles, then read the delta's

@@ -255,6 +255,35 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f"[{disc_line}]   rotated {rotated}   block prop mean "
         f"{fmt_ms(pmean)} p99 {fmt_ms(pp99)} (n={pcount})")
 
+    # relay efficiency: inv dedup pressure, compact-block reconstruction
+    # readiness, and propagation-map health (families absent until the
+    # node has relayed anything: render '-')
+    if have(snap, "nodexa_relay_invs_total",
+            "nodexa_cmpct_reconstructions_total"):
+        inv_new = series_total(snap, "nodexa_relay_invs_total",
+                               direction="recv", dedup="new")
+        inv_dup = series_total(snap, "nodexa_relay_invs_total",
+                               direction="recv", dedup="duplicate")
+        inv_sent = series_total(snap, "nodexa_relay_invs_total",
+                                direction="sent")
+        dup_ratio = inv_dup / (inv_new + inv_dup) if (inv_new + inv_dup) \
+            else 0.0
+        recon = by_label(snap, "nodexa_cmpct_reconstructions_total",
+                         "result")
+        recon_line = " ".join(
+            f"{k}={int(v)}" for k, v in sorted(recon.items()) if v
+        ) or "none"
+        evics = int(series_total(
+            snap, "nodexa_propagation_map_evictions_total"))
+        warn = f"  {YELLOW}prop-evictions={evics}{RESET}" if evics else ""
+        lines.append(
+            f"  relay: invs sent {int(inv_sent)} recv {int(inv_new + inv_dup)} "
+            f"(dup {dup_ratio:.0%})   inv rate "
+            f"{rate('nodexa_relay_invs_total', direction='sent')}   "
+            f"cmpct [{recon_line}]{warn}")
+    else:
+        lines.append("  relay: -")
+
     # mempool: outcomes + the off-lock proof pair
     accepts = by_label(snap, "nodexa_mempool_accepts_total", "result")
     _, smean, _ = hist_stats(

@@ -1,0 +1,220 @@
+"""propagation_report — per-hop block-propagation waterfalls.
+
+Two sources:
+
+  --netsim           run a deterministic in-process netsim scenario and
+                     render the FleetObserver's exact per-hop stage
+                     decomposition (queue / serialize / latency /
+                     validate / relay) per block, plus the fleet
+                     aggregate and any lossy links;
+  --dump f [f ...]   assemble cross-node ``block.propagation`` traces
+                     from one or more flight-recorder dumps.  Trace ids
+                     are minted once at the ORIGIN node and ride the
+                     wire with announcements, so dumps taken on
+                     different nodes (``dumpflightrecorder`` on each)
+                     merge into one cluster-wide tree per block.
+
+Examples:
+
+  python tools/propagation_report.py --netsim --nodes 20 --blocks 2
+  python tools/propagation_report.py --dump /tmp/n1/flightrecorder-*.json \
+      /tmp/n2/flightrecorder-*.json
+
+The renderers are pure functions over plain dicts (unit-tested in
+tests/test_net_observability.py); the harness/dump plumbing only feeds
+them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+BAR_WIDTH = 36
+
+
+def _fmt_ms(s: float) -> str:
+    return f"{s * 1000:7.2f}ms"
+
+
+def render_block(block_hex: str, origin: int, t0: float,
+                 hops: List[dict]) -> List[str]:
+    """Waterfall for one block: every receiving node's final hop,
+    sorted by acceptance time, with the stage split per hop.  ``hops``
+    are FleetObserver.hop() dicts; ``t0`` the mined-at sim time."""
+    lines = [f"block {block_hex}  origin node {origin}"]
+    if not hops:
+        lines.append("  (no observed acceptances)")
+        return lines
+    t_end = max(h["t_accept"] for h in hops) - t0
+    lines.append(
+        f"  {'node':>5} {'via':>4} {'command':<11} {'accept':>10}  "
+        f"{'queue':>9} {'serial':>9} {'latency':>9} {'relay':>9} "
+        f"{'validate*':>10}")
+    for h in sorted(hops, key=lambda x: (x["t_accept"], x["to"])):
+        off = h["t_accept"] - t0
+        fill = int(round((off / t_end) * BAR_WIDTH)) if t_end > 0 else 0
+        st = h["stages"]
+        lines.append(
+            f"  {h['to']:>5} {h['from']:>4} {h['command']:<11} "
+            f"{_fmt_ms(off):>10}  {_fmt_ms(st['queue']):>9} "
+            f"{_fmt_ms(st['serialize']):>9} {_fmt_ms(st['latency']):>9} "
+            f"{_fmt_ms(st['relay']):>9} {_fmt_ms(st['validate']):>10}  "
+            f"|{'#' * fill}{'.' * (BAR_WIDTH - fill)}|")
+    lines.append("  (* validate is measured wall time; the sim-time "
+                 "stages sum to each hop)")
+    return lines
+
+
+def render_aggregate(agg: dict) -> List[str]:
+    if not agg or not agg.get("chains"):
+        return ["no chains observed"]
+    st = agg["stage_ms"]
+    return [
+        f"fleet aggregate over {agg['chains']} chains "
+        f"(mean {agg['mean_hops']} hops, max {agg['max_hops']}):",
+        "  " + "  ".join(f"{k}={st[k]}ms" for k in
+                         ("queue", "serialize", "latency", "relay",
+                          "validate")),
+        f"  e2e mean {agg['e2e_mean_ms']}ms   "
+        f"stage-sum reconciliation err(max) {agg['recon_err_max']}",
+    ]
+
+
+def render_trace(trace_id: str, spans: List[dict]) -> List[str]:
+    """One assembled trace as an indented tree (parent/child links),
+    each line: name, node thread, start offset, duration, key attrs."""
+    by_parent: Dict[object, List[dict]] = {}
+    ids = {s["span_id"] for s in spans}
+    roots = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is None or pid not in ids:
+            roots.append(s)  # true root, or an orphaned remote child
+        else:
+            by_parent.setdefault(pid, []).append(s)
+    t0 = min(s["start"] for s in spans)
+    lines = [f"trace {trace_id}  ({len(spans)} spans)"]
+    seen: set = set()  # cycle guard: malformed/colliding ids in a dump
+    # must degrade to a truncated tree, never a hang
+
+    def walk(span: dict, depth: int) -> None:
+        if id(span) in seen or depth > 64:
+            return
+        seen.add(id(span))
+        attrs = span.get("attrs", {})
+        keys = ("block", "peer", "peer_addr", "height", "propagation_s",
+                "peers", "status")
+        extra = "  ".join(f"{k}={attrs[k]}" for k in keys if k in attrs
+                          and attrs[k] not in (None, ""))
+        lines.append(
+            f"  {'  ' * depth}{span['name']:<18} "
+            f"+{(span['start'] - t0) * 1000:8.2f}ms "
+            f"{span['duration_s'] * 1000:8.2f}ms  "
+            f"[{span.get('thread', '?')}]  {extra}".rstrip())
+        for child in sorted(by_parent.get(span["span_id"], []),
+                            key=lambda s: s["start"]):
+            walk(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s["start"]):
+        walk(root, 0)
+    for span in sorted(spans, key=lambda s: s["start"]):
+        if id(span) not in seen:  # unreachable fragments still print
+            walk(span, 0)
+    return lines
+
+
+def report_from_dumps(paths: List[str]) -> List[str]:
+    """Merge flight-recorder dumps and render every propagation trace."""
+    spans: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            payload = json.load(f)
+        spans.extend(payload.get("spans", []))
+    traces: Dict[str, List[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["trace_id"], []).append(s)
+    lines: List[str] = []
+    n = 0
+    for tid, tspans in sorted(traces.items(),
+                              key=lambda kv: min(s["start"]
+                                                 for s in kv[1])):
+        names = {s["name"] for s in tspans}
+        if "block.propagation" not in names and "block.hop" not in names:
+            continue
+        n += 1
+        lines.extend(render_trace(tid, tspans))
+    lines.append(f"{n} propagation trace(s) across {len(paths)} dump(s)")
+    return lines
+
+
+def report_from_netsim(nodes: int, blocks: int, degree: int,
+                       seed: int) -> List[str]:
+    """Run a deterministic scenario and waterfall every block."""
+    from nodexa_chain_core_tpu.net.netsim import LinkSpec, SimNet
+    from nodexa_chain_core_tpu.telemetry.spans import set_spans_enabled
+
+    set_spans_enabled(True)
+    net = SimNet(nodes, seed=seed, observe=True,
+                 default_spec=LinkSpec(latency_s=0.02, jitter_s=0.005,
+                                       bandwidth_bps=2_000_000))
+    lines: List[str] = []
+    try:
+        net.connect_random(degree)
+        if not net.settle(60.0):
+            raise SystemExit("netsim handshakes did not settle")
+        hashes = []
+        for b in range(blocks):
+            h = net.mine_block((b * 7) % nodes)
+            if not net.run_until(net.converged, 120.0):
+                raise SystemExit(f"block {b} did not converge")
+            hashes.append(h)
+        obs = net.observer
+        for h in hashes:
+            origin, t0 = obs.origins[h]
+            hops = [obs.hop(h, node) for (node, bh) in sorted(obs.accepts)
+                    if bh == h]
+            lines.extend(render_block(f"{h:064x}"[:16], origin, t0,
+                                      [x for x in hops if x]))
+            lines.append("")
+        lines.extend(render_aggregate(obs.aggregate(hashes)))
+        lossy = [ls for ls in net.link_stats()
+                 if any(sum(f.values()) for f in ls["faults"].values())]
+        if lossy:
+            lines.append(f"lossy links: {len(lossy)}")
+            for ls in lossy[:10]:
+                lines.append(f"  {ls['a']}<->{ls['b']}: {ls['faults']}")
+    finally:
+        net.stop()
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--netsim", action="store_true",
+                    help="run an in-process scenario and waterfall it")
+    ap.add_argument("--nodes", type=int, default=10)
+    ap.add_argument("--blocks", type=int, default=2)
+    ap.add_argument("--degree", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--dump", nargs="+", metavar="FILE",
+                    help="flight-recorder dump(s) to assemble instead")
+    args = ap.parse_args()
+    if args.dump:
+        lines = report_from_dumps(args.dump)
+    elif args.netsim:
+        lines = report_from_netsim(args.nodes, args.blocks, args.degree,
+                                   args.seed)
+    else:
+        ap.error("pick a source: --netsim or --dump <file...>")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
