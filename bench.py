@@ -601,6 +601,27 @@ def bench_netsim() -> dict:
     return out
 
 
+def bench_snapshot() -> dict:
+    """Instant bootstrap (assumeUTXO snapshots, chain/snapshot.py):
+    snapshot load-to-tip vs replaying the same blocks through
+    process_new_block, plus the downloader's verified-ingest throughput.
+    Details in nodexa_chain_core_tpu/bench/snapshot.py."""
+    from nodexa_chain_core_tpu.bench.snapshot import measure
+
+    t = time.perf_counter()
+    res = measure()
+    log(f"[snapshot] load-to-tip {res['snapshot_load_to_tip_s']*1e3:.1f}ms "
+        f"vs IBD replay {res['snapshot_ibd_replay_s']*1e3:.1f}ms = "
+        f"{res['snapshot_ibd_speedup']}x over {res['snapshot_blocks']} "
+        f"blocks; transfer ingest {res['snapshot_transfer_mbps']} Mbit/s "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return {
+        "snapshot_load_to_tip_s": res["snapshot_load_to_tip_s"],
+        "snapshot_ibd_speedup": res["snapshot_ibd_speedup"],
+        "snapshot_transfer_mbps": res["snapshot_transfer_mbps"],
+    }
+
+
 def bench_ibd() -> dict:
     """Synthetic IBD (node fast path, CPU-side): headers-first + out-of-
     order data into a datadir-backed ChainState, dbcache vs per-block
@@ -644,6 +665,8 @@ def main() -> None:
         extra.update(bench_ibd())
     if not os.environ.get("NODEXA_BENCH_SKIP_NETSIM"):
         extra.update(bench_netsim())
+    if not os.environ.get("NODEXA_BENCH_SKIP_SNAPSHOT"):
+        extra.update(bench_snapshot())
     if not os.environ.get("NODEXA_BENCH_SKIP_TXFLOOD"):
         extra.update(bench_txflood())
     if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):

@@ -1,0 +1,253 @@
+"""Snapshot bootstrap bench: instant-boot vs block-by-block IBD, the
+transfer ingest throughput, and the adversarial lying-provider smoke.
+
+Measures (merged into bench.py):
+
+- ``snapshot_load_to_tip_s`` — wall time for a fresh headers-only node
+  to reach the source tip by loading + activating a hash-committed UTXO
+  snapshot (chain/snapshot.py).
+- ``snapshot_ibd_speedup`` — that time vs replaying the SAME blocks
+  through ``process_new_block`` one by one (the pre-snapshot road to
+  the same chainstate).  The ci_gate lane (``--assert-fast``) floors
+  this at 10x.
+- ``snapshot_transfer_mbps`` — downloader ingest throughput (wire
+  framing round-trip + per-chunk sha256d verification + crash-safe
+  persist), megabits/s of snapshot payload.
+- ``--assert-fast`` additionally runs the lying-provider netsim smoke:
+  a fresh node bootstrapping from a mixed honest/lying provider set
+  must converge to the honest tip, catch the liar at its FIRST bad
+  chunk (typed disconnect, zero honest bans), and replay digest-equal.
+
+Usage::
+
+    python -m nodexa_chain_core_tpu.bench.snapshot               # report
+    python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast # gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import Optional
+
+BLOCKDATA = frozenset({"block", "cmpctblock", "blocktxn"})
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _mine_chain(cs, params, blocks: int) -> None:
+    from ..mining.assembler import BlockAssembler, mine_block_cpu
+    from ..script.sign import KeyStore
+    from ..script.standard import KeyID, p2pkh_script
+
+    spk = p2pkh_script(KeyID(KeyStore().add_key(0xD00D)))
+    while cs.tip().height < blocks:
+        h = cs.tip().height
+        blk = BlockAssembler(cs).create_new_block(
+            spk.raw, ntime=params.genesis_time + 60 * (h + 1))
+        assert mine_block_cpu(blk, params.algo_schedule, max_tries=1 << 22)
+        cs.process_new_block(blk)
+
+
+def measure(blocks: int = 96, chunk_bytes: int = 4096,
+            workdir: Optional[str] = None) -> dict:
+    """Build one synthetic chain, then reach its tip two ways: replaying
+    every block (IBD) vs loading the snapshot.  Equality of the final
+    coins digest is asserted, not assumed."""
+    from ..chain import snapshot as snap
+    from ..chain.validation import ChainState
+    from ..node.chainparams import select_params
+
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="nxsnapbench-")
+    params = select_params("regtest")
+    try:
+        t = time.perf_counter()
+        src = ChainState(params, datadir=os.path.join(workdir, "src"))
+        _mine_chain(src, params, blocks)
+        log(f"[snapshot] chain built: {blocks} blocks "
+            f"({time.perf_counter()-t:.1f}s)")
+        headers = [src.active.at(h).header
+                   for h in range(1, src.tip().height + 1)]
+        adj = params.genesis_time + 1_000_000
+        src_digest = snap.coins_digest(src)
+
+        # -- baseline: block-by-block IBD into a fresh chainstate
+        ibd = ChainState(params, datadir=os.path.join(workdir, "ibd"))
+        ibd.process_new_block_headers(headers, adjusted_time=adj)
+        src_blocks = [src.read_block(src.active.at(h))
+                      for h in range(1, src.tip().height + 1)]
+        t0 = time.perf_counter()
+        for blk in src_blocks:
+            ibd.process_new_block(blk)
+        ibd.flush_state_to_disk()
+        ibd_s = time.perf_counter() - t0
+        assert ibd.tip().block_hash == src.tip().block_hash
+        ibd.close()
+
+        # -- snapshot boot: dump once, load + activate into a fresh node
+        path = os.path.join(workdir, "snap.dat")
+        t0 = time.perf_counter()
+        manifest = snap.write_snapshot(src, path, chunk_bytes=chunk_bytes)
+        dump_s = time.perf_counter() - t0
+        dst = ChainState(params, datadir=os.path.join(workdir, "dst"))
+        dst.process_new_block_headers(headers, adjusted_time=adj)
+        mgr = snap.SnapshotManager(dst)
+        t0 = time.perf_counter()
+        mgr.load_file(path)
+        load_s = time.perf_counter() - t0
+        assert dst.tip().block_hash == src.tip().block_hash, \
+            "snapshot boot missed the tip"
+        assert snap.coins_digest(dst) == src_digest, \
+            "snapshot boot produced a different UTXO set"
+        dst.close()
+        src.close()
+
+        # -- transfer ingest throughput: wire framing + verification +
+        # crash-safe persist, the downloader's per-chunk hot path
+        from ..net.protocol import pack_message, unpack_header
+
+        fetch = snap.SnapshotFetch(os.path.join(workdir, "incoming"))
+        fetch.ingest_manifest(manifest.serialize())
+        payloads = [snap.read_chunk(path, manifest, i)
+                    for i in range(manifest.n_chunks)]
+        magic = params.message_start
+        nbytes = 0
+        t0 = time.perf_counter()
+        for i, payload in enumerate(payloads):
+            wire = pack_message(magic, "snapchunk", payload)
+            _cmd, length, _ck = unpack_header(magic, wire[:24])
+            res = fetch.ingest_chunk(i, wire[24:24 + length])
+            assert res == "ok", res
+            nbytes += len(payload)
+        xfer_s = time.perf_counter() - t0
+        assert fetch.complete()
+
+        speedup = ibd_s / max(load_s, 1e-9)
+        out = {
+            "snapshot_blocks": blocks,
+            "snapshot_coins": manifest.n_coins,
+            "snapshot_chunks": manifest.n_chunks,
+            "snapshot_dump_s": round(dump_s, 4),
+            "snapshot_load_to_tip_s": round(load_s, 4),
+            "snapshot_ibd_replay_s": round(ibd_s, 4),
+            "snapshot_ibd_speedup": round(speedup, 2),
+            "snapshot_transfer_mbps": round(
+                nbytes * 8 / 1e6 / max(xfer_s, 1e-9), 2),
+        }
+        log(f"[snapshot] load-to-tip {load_s*1e3:.1f}ms vs IBD replay "
+            f"{ibd_s*1e3:.1f}ms = {speedup:.1f}x; transfer ingest "
+            f"{out['snapshot_transfer_mbps']} Mbit/s over "
+            f"{manifest.n_chunks} chunks")
+        return out
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def smoke(seed: int = 11) -> dict:
+    """The ci_gate adversarial lane (hard asserts): lying provider among
+    honest ones — convergence to the honest tip, first-bad-chunk
+    detection, zero honest bans, digest replay equality."""
+    from ..chain import snapshot as snap
+    from ..net.netsim import LinkSpec, SimNet
+    from ..telemetry import g_metrics
+
+    chunks_m = g_metrics.counter("nodexa_snapshot_chunks_total")
+    disc_m = g_metrics.counter("nodexa_peer_disconnects_total")
+
+    def run(workdir: str) -> str:
+        net = SimNet(3, seed=seed)
+        try:
+            net.enable_snapshots()
+            net.connect(0, 1)
+            assert net.settle(30.0), "handshakes did not settle"
+            net.mine_chain(0, 10)
+            assert net.run_until(
+                lambda: net.nodes[1].tip_hash() == net.nodes[0].tip_hash(),
+                60.0)
+            net.nodes[0].node.snapshot_mgr.make_snapshot(
+                os.path.join(workdir, "p0.dat"), chunk_bytes=128)
+            net.nodes[1].node.snapshot_mgr.make_snapshot(
+                os.path.join(workdir, "p1.dat"), chunk_bytes=128)
+            net.nodes[1].processor._snapshot_test_corrupt = True
+            mgr2 = net.nodes[2].node.snapshot_mgr
+            mgr2.start_fetch(os.path.join(workdir, "incoming"))
+            blackhole = LinkSpec(latency_s=0.05, drop_commands=BLOCKDATA)
+            links = (
+                net.connect(2, 0, spec=LinkSpec(latency_s=0.05),
+                            spec_back=blackhole),
+                net.connect(2, 1, spec=LinkSpec(latency_s=0.005),
+                            spec_back=LinkSpec(latency_s=0.005,
+                                               drop_commands=BLOCKDATA)),
+            )
+            honest = net.nodes[0].tip_hash()
+            assert net.run_until(
+                lambda: net.nodes[2].tip_hash() == honest, 120.0), \
+                "bootstrap never reached the honest tip"
+            assert mgr2.state == snap.STATE_ASSUMED
+            banned = net.nodes[2].connman.banned
+            assert net.nodes[1].ip in banned, "liar not banned"
+            assert net.nodes[0].ip not in banned, "honest provider banned"
+            for link in links:
+                for k in link.specs:
+                    link.specs[k] = LinkSpec(
+                        latency_s=link.specs[k].latency_s)
+            assert net.run_until(
+                lambda: mgr2.state == snap.STATE_VALIDATED, 300.0), \
+                "back-validation did not confirm"
+            return net.digest()
+        finally:
+            net.stop()
+
+    bad0 = chunks_m.value(result="bad_hash")
+    fraud0 = disc_m.value(reason="snapshot_fraud")
+    w1 = tempfile.mkdtemp(prefix="nxsnapsmoke-")
+    w2 = tempfile.mkdtemp(prefix="nxsnapsmoke-")
+    try:
+        d1 = run(w1)
+        bad_after_first = chunks_m.value(result="bad_hash")
+        assert bad_after_first > bad0, "liar never detected"
+        assert disc_m.value(reason="snapshot_fraud") > fraud0, \
+            "no typed snapshot_fraud disconnect"
+        d2 = run(w2)
+        assert d1 == d2, "snapshot transfer broke digest replay equality"
+    finally:
+        shutil.rmtree(w1, ignore_errors=True)
+        shutil.rmtree(w2, ignore_errors=True)
+    log("[snapshot] lying-provider smoke: honest tip reached, liar "
+        "caught at the first bad chunk, 0 honest bans, digest replay "
+        f"equal ({d1[:16]})")
+    return {
+        "snapshot_liar_bad_chunks": int(bad_after_first - bad0),
+        "snapshot_smoke_digest": d1[:16],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--blocks", type=int, default=96)
+    p.add_argument("--chunk-bytes", type=int, default=4096)
+    p.add_argument("--assert-fast", action="store_true",
+                   help="ci_gate lane: floor snapshot_ibd_speedup at 10x "
+                        "and run the lying-provider netsim smoke")
+    args = p.parse_args(argv)
+    out = measure(blocks=args.blocks, chunk_bytes=args.chunk_bytes)
+    if args.assert_fast:
+        assert out["snapshot_ibd_speedup"] >= 10.0, (
+            f"snapshot boot only {out['snapshot_ibd_speedup']}x faster "
+            "than IBD replay (floor 10x)")
+        out.update(smoke())
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -307,7 +307,15 @@ def Script_is_unspendable(raw: bytes) -> bool:
 
 
 class CoinsViewDB(CoinsView):
-    """KV-backed bottom view (ref txdb.h:73 CCoinsViewDB)."""
+    """KV-backed bottom view (ref txdb.h:73 CCoinsViewDB).
+
+    ``KEY_PREFIX``/``BEST_BLOCK_KEY`` are class attributes and commits
+    route through :meth:`_commit` so alternate persisted views (the
+    snapshot back-validation scratch set, chain/snapshot.py) share ONE
+    flush/serialization implementation and can never drift from it."""
+
+    KEY_PREFIX = _KEY_PREFIX
+    BEST_BLOCK_KEY = _BEST_BLOCK_KEY
 
     def __init__(self, db: KVStore):
         self.db = db
@@ -316,9 +324,9 @@ class CoinsViewDB(CoinsView):
         # never split the coins from the state snapshotted with them
         self.pending_extra: Dict[bytes, bytes] = {}
 
-    @staticmethod
-    def _key(outpoint: OutPoint) -> bytes:
-        return _KEY_PREFIX + outpoint.txid.to_bytes(32, "little") + outpoint.n.to_bytes(
+    @classmethod
+    def _key(cls, outpoint: OutPoint) -> bytes:
+        return cls.KEY_PREFIX + outpoint.txid.to_bytes(32, "little") + outpoint.n.to_bytes(
             4, "little"
         )
 
@@ -332,7 +340,7 @@ class CoinsViewDB(CoinsView):
         return self.db.exists(self._key(outpoint))
 
     def get_best_block(self) -> int:
-        raw = self.db.get(_BEST_BLOCK_KEY)
+        raw = self.db.get(self.BEST_BLOCK_KEY)
         return int.from_bytes(raw, "little") if raw else 0
 
     def batch_write(self, entries, best_block: int) -> None:
@@ -349,11 +357,15 @@ class CoinsViewDB(CoinsView):
         for k, v in self.pending_extra.items():
             batch.put(k, v)
         self.pending_extra.clear()
-        batch.put(_BEST_BLOCK_KEY, best_block.to_bytes(32, "little"))
+        batch.put(self.BEST_BLOCK_KEY, best_block.to_bytes(32, "little"))
+        self._commit(batch)
+
+    def _commit(self, batch: WriteBatch) -> None:
+        """Subclass hook: the one write path for a finished batch."""
         self.db.write_batch(batch)
 
     def cursor(self) -> Iterator[Tuple[OutPoint, Coin]]:
-        for k, v in self.db.iterate(_KEY_PREFIX):
+        for k, v in self.db.iterate(self.KEY_PREFIX):
             txid = int.from_bytes(k[1:33], "little")
             n = int.from_bytes(k[33:37], "little")
             yield OutPoint(txid, n), Coin.deserialize(ByteReader(v))

@@ -1,0 +1,1395 @@
+"""Hash-committed UTXO snapshots: trust-minimized instant bootstrap.
+
+The reference lineage's assumeUTXO design is the map (ref the
+assumeutxo design notes + dumptxoutset/loadtxoutset in later Core):
+instead of replaying the whole chain, a fresh node loads a serialized
+copy of the UTXO set bound to a (height, block_hash) **base block**,
+starts serving from that *assumed-valid* tip within seconds, and
+re-earns full trust by **back-validating** the chain from genesis
+toward the base in the background.  This module owns every piece of
+that story:
+
+- **Format** (:func:`write_snapshot` / :func:`read_manifest` /
+  :func:`read_chunk`): the coins set is serialized in sorted key order
+  into fixed-size **chunks**, each CRC-framed on disk and committed by
+  its sha256d hash in the **manifest**; the manifest binds the chunk
+  hash list, a rolling commitment over every coin record
+  (``coins_digest``), the asset-state blob, and the base
+  (height, hash).  ``sha256d(manifest)`` is the **snapshot id** — one
+  32-byte value commits the entire set, so a lying provider is caught
+  at the FIRST chunk whose hash disagrees.
+
+- **Load + activation** (:meth:`SnapshotManager.load_file`): chunks are
+  applied to the coins DB through the kvstore's atomic batch path
+  under a ``snapshot!loading`` marker; the **single commit point** is
+  the activation batch that flips the coins best-block to the base and
+  records the assumed manifest.  A crash anywhere in between is healed
+  by :func:`recover_on_load` (wired into ``ChainState._load_or_init``):
+  the partially-applied coins are wiped and replayed from block data —
+  restart never serves a half-loaded view.
+
+- **Back-validation** (:meth:`SnapshotManager.backvalidate_step`):
+  while the node serves from the assumed tip, history is validated
+  from genesis toward the base in a scratch coins view persisted IN
+  the chainstate kvstore (prefix ``V`` + a watermark key, flushed
+  through the same batch path) — a node killed mid-back-validation
+  resumes from the watermark instead of genesis.  Reaching the base,
+  the scratch set's digest must equal the manifest's commitment; any
+  mismatch (or an invalid historical block) fires the PR 5 health
+  ladder: flight-record ``snapshot_fraud_detected``, persist a fraud
+  marker, enter safe mode (producers halt, mutating RPC refuses).  The
+  next restart discards the assumed chainstate and falls back to full
+  IBD — a fraudulent tip is never served twice.
+
+- **P2P transfer** (:class:`SnapshotFetch`, driven by
+  ``net_processing``): resumable chunked download with per-chunk
+  verification against the committed hashes; verified chunks persist
+  to disk (fault site ``snapshot.chunk_recv``) so a torn transfer or a
+  process kill resumes where it stopped, and a provider caught lying
+  is disconnected with a typed reason while the download continues
+  from the remaining providers.
+
+Fault sites (``node/faults.py`` grammar): ``snapshot.write`` (dump
+chunk + back-validation watermark writes), ``snapshot.read`` (chunk
+reads, load + serving), ``snapshot.chunk_recv`` (downloaded chunk /
+manifest persist), ``snapshot.activate`` (coins-DB apply + activation
+commit).  tests/test_snapshot.py kills at every one of them and
+asserts restart converges.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core.serialize import ByteReader, ByteWriter
+from ..core.uint256 import u256_hex
+from ..crypto.hashes import sha256d
+from ..node.faults import g_faults
+from ..telemetry import flight_recorder, g_metrics
+from ..utils.logging import LogFlags, log_print, log_printf
+from .coins import CoinsViewCache, CoinsViewDB
+from .kvstore import WriteBatch
+
+SNAPSHOT_MAGIC = b"NXSNAP01"
+DEFAULT_CHUNK_BYTES = 256 * 1024
+MAX_SNAPSHOT_CHUNKS = 1 << 16  # manifest must fit one wire message
+
+# coins-DB key layout (mirrors coins.CoinsViewDB; the scratch view uses
+# prefix V so both sets iterate in the same relative order)
+_COIN_PREFIX = b"C"
+_BEST_BLOCK_KEY = b"B"
+_ASSETS_KEY = b"A"
+_BV_PREFIX = b"V"
+
+# snapshot bookkeeping keys in the chainstate kvstore
+_K_LOADING = b"snapshot!loading"      # set while chunks apply; cleared at
+                                      # activation (the crash marker)
+_K_ASSUMED = b"snapshot!assumed"      # manifest bytes while assumed-valid
+_K_VALIDATED = b"snapshot!validated"  # base hash after back-validation
+_K_FRAUD = b"snapshot!fraud"          # reason; restart discards + full IBD
+_K_BV_NEXT = b"snapshot!bv_next"      # back-validation watermark (next h)
+_K_BV_BEST = b"snapshot!bv_best"      # scratch view's best block
+
+# manager states (exported on nodexa_snapshot_state)
+STATE_NONE = 0
+STATE_LOADING = 1
+STATE_ASSUMED = 2
+STATE_VALIDATED = 3
+STATE_FAILED = 4
+STATE_NAMES = {
+    STATE_NONE: "none", STATE_LOADING: "loading", STATE_ASSUMED: "assumed",
+    STATE_VALIDATED: "validated", STATE_FAILED: "failed",
+}
+
+_M_CHUNKS = g_metrics.counter(
+    "nodexa_snapshot_chunks_total",
+    "Snapshot chunks processed by the downloader, labeled by result "
+    "(ok|bad_hash|timeout)")
+_M_SERVED = g_metrics.counter(
+    "nodexa_snapshot_chunks_served_total",
+    "Snapshot chunks served to peers, labeled by result "
+    "(ok|throttled|unknown)")
+_M_STATE = g_metrics.gauge(
+    "nodexa_snapshot_state",
+    "Snapshot bootstrap state (0=none 1=loading 2=assumed 3=validated "
+    "4=failed)")
+_M_BV_HEIGHT = g_metrics.gauge(
+    "nodexa_backvalidation_height",
+    "Next height the background back-validation will verify")
+
+
+class SnapshotError(Exception):
+    """Typed snapshot failure; ``code`` mirrors BlockValidationError."""
+
+    def __init__(self, code: str, reason: str = ""):
+        super().__init__(f"{code}: {reason}" if reason else code)
+        self.code = code
+        self.reason = reason
+
+
+# ----------------------------------------------------------------- format
+
+
+@dataclass
+class SnapshotManifest:
+    """Everything a verifier needs before the first chunk arrives."""
+
+    base_height: int
+    base_hash: int
+    n_coins: int
+    chunk_bytes: int
+    coins_digest: bytes           # rolling commitment over every record
+    assets_blob: bytes            # asset snapshot riding with the coins
+    chunk_hashes: List[bytes] = field(default_factory=list)
+    chunk_lengths: List[int] = field(default_factory=list)
+    _raw: Optional[bytes] = field(default=None, repr=False)
+    _id: Optional[bytes] = field(default=None, repr=False)
+
+    def serialize(self) -> bytes:
+        if self._raw is not None:
+            return self._raw
+        w = ByteWriter()
+        w.u8(1)  # manifest version
+        w.u32(self.base_height)
+        w.hash256(self.base_hash)
+        w.u64(self.n_coins)
+        w.u32(self.chunk_bytes)
+        w.write(self.coins_digest)
+        w.var_bytes(self.assets_blob)
+        w.compact_size(len(self.chunk_hashes))
+        for h, ln in zip(self.chunk_hashes, self.chunk_lengths):
+            w.u32(ln)
+            w.write(h)
+        self._raw = w.getvalue()
+        return self._raw
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "SnapshotManifest":
+        r = ByteReader(raw)
+        if r.u8() != 1:
+            raise SnapshotError("snapshot-bad-manifest", "unknown version")
+        m = cls(
+            base_height=r.u32(),
+            base_hash=r.hash256(),
+            n_coins=r.u64(),
+            chunk_bytes=r.u32(),
+            coins_digest=bytes(r.read(32)),
+            assets_blob=r.var_bytes(),
+        )
+        n = r.compact_size()
+        if n > MAX_SNAPSHOT_CHUNKS:
+            raise SnapshotError("snapshot-bad-manifest", "too many chunks")
+        for _ in range(n):
+            m.chunk_lengths.append(r.u32())
+            m.chunk_hashes.append(bytes(r.read(32)))
+        m._raw = bytes(raw)
+        return m
+
+    def snapshot_id(self) -> bytes:
+        # memoized: the provider compares it on EVERY getsnapchunk, and
+        # re-hashing a 65536-chunk manifest per request would be
+        # O(n_chunks * manifest_size) across one full serve
+        if self._id is None:
+            self._id = sha256d(self.serialize())
+        return self._id
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunk_hashes)
+
+
+class _CoinsDigest:
+    """Rolling commitment over the coin records in sorted key order,
+    bound to the (height, hash) base — chunking-independent, so the
+    back-validated scratch set recomputes it without knowing how the
+    provider chunked the transfer."""
+
+    def __init__(self, base_height: int, base_hash: int):
+        self._h = hashlib.sha256()
+        self._h.update(b"NXSNAPDIG1")
+        self._h.update(base_hash.to_bytes(32, "little"))
+        self._h.update(base_height.to_bytes(8, "little"))
+
+    def add_record(self, record: bytes) -> None:
+        self._h.update(record)
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(self._h.digest()).digest()
+
+
+def _pack_record(coin_key: bytes, coin_val: bytes) -> bytes:
+    """One coin record: the raw coins-DB key body (txid||n, 36 bytes)
+    plus the length-prefixed serialized Coin — byte-identical in and
+    out of the store, so round-trips are bit-exact by construction."""
+    return coin_key + struct.pack("<I", len(coin_val)) + coin_val
+
+
+def _iter_chunk_records(payload: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    """Yield (key_body_36B, coin_bytes) records out of a chunk payload."""
+    off = 0
+    n = len(payload)
+    while off < n:
+        if off + 40 > n:
+            raise SnapshotError("snapshot-bad-chunk", "truncated record")
+        key = payload[off:off + 36]
+        (ln,) = struct.unpack_from("<I", payload, off + 36)
+        off += 40
+        if off + ln > n:
+            raise SnapshotError("snapshot-bad-chunk", "truncated coin")
+        yield key, payload[off:off + ln]
+        off += ln
+
+
+def write_snapshot(chainstate, path: str,
+                   chunk_bytes: int = DEFAULT_CHUNK_BYTES
+                   ) -> SnapshotManifest:
+    """Serialize the chainstate's full coins set at its current tip into
+    a chunked, hash-committed snapshot file.  Atomic: written to a temp
+    name and os.replace'd into place; every chunk write consults the
+    ``snapshot.write`` fault site (kill@<n> leaves a torn temp file the
+    next dump simply overwrites)."""
+    with chainstate.cs_main:
+        chainstate.flush_state_to_disk()  # coins down to the DB at the tip
+        tip = chainstate.tip()
+        if tip is None:
+            raise SnapshotError("snapshot-no-tip")
+        w = ByteWriter()
+        chainstate.assets.serialize(w)
+        assets_blob = w.getvalue()
+        digest = _CoinsDigest(tip.height, tip.block_hash)
+        chunk_hashes: List[bytes] = []
+        chunk_lengths: List[int] = []
+        chunks: List[bytes] = []
+        cur: List[bytes] = []
+        cur_len = 0
+        n_coins = 0
+        for key, val in chainstate.metadata_db.iterate(_COIN_PREFIX):
+            rec = _pack_record(key[1:], val)
+            digest.add_record(rec)
+            cur.append(rec)
+            cur_len += len(rec)
+            n_coins += 1
+            if cur_len >= chunk_bytes:
+                payload = b"".join(cur)
+                chunks.append(payload)
+                chunk_hashes.append(sha256d(payload))
+                chunk_lengths.append(len(payload))
+                cur, cur_len = [], 0
+        if cur:
+            payload = b"".join(cur)
+            chunks.append(payload)
+            chunk_hashes.append(sha256d(payload))
+            chunk_lengths.append(len(payload))
+        if len(chunks) > MAX_SNAPSHOT_CHUNKS:
+            raise SnapshotError("snapshot-too-many-chunks",
+                                f"{len(chunks)} > {MAX_SNAPSHOT_CHUNKS}")
+        manifest = SnapshotManifest(
+            base_height=tip.height, base_hash=tip.block_hash,
+            n_coins=n_coins, chunk_bytes=chunk_bytes,
+            coins_digest=digest.digest(), assets_blob=assets_blob,
+            chunk_hashes=chunk_hashes, chunk_lengths=chunk_lengths,
+        )
+    raw = manifest.serialize()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(SNAPSHOT_MAGIC)
+        f.write(struct.pack("<I", len(raw)))
+        f.write(raw)
+        f.write(struct.pack("<I", zlib.crc32(raw)))
+        for payload in chunks:
+            framed = payload + struct.pack("<I", zlib.crc32(payload))
+            if g_faults.enabled:
+                g_faults.check("snapshot.write", torn_file=f,
+                               torn_data=framed)
+            f.write(framed)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    log_print(
+        LogFlags.NONE,
+        "snapshot: wrote %s — base h=%d %s, %d coins in %d chunks, id %s",
+        path, manifest.base_height, u256_hex(manifest.base_hash)[:16],
+        n_coins, manifest.n_chunks, manifest.snapshot_id().hex()[:16],
+    )
+    return manifest
+
+
+def read_manifest(path: str) -> SnapshotManifest:
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != SNAPSHOT_MAGIC:
+            raise SnapshotError("snapshot-bad-magic", path)
+        (mlen,) = struct.unpack("<I", f.read(4))
+        raw = f.read(mlen)
+        (crc,) = struct.unpack("<I", f.read(4))
+    if len(raw) != mlen or zlib.crc32(raw) != crc:
+        raise SnapshotError("snapshot-bad-manifest", "manifest CRC failed")
+    return SnapshotManifest.deserialize(raw)
+
+
+def _chunk_offset(manifest: SnapshotManifest, idx: int) -> int:
+    # cumulative offsets cached per manifest: a per-call prefix sum
+    # would make a full serve/load O(n_chunks^2)
+    offsets = getattr(manifest, "_offsets", None)
+    if offsets is None:
+        base = 8 + 4 + len(manifest.serialize()) + 4
+        offsets = [base]
+        for ln in manifest.chunk_lengths:
+            offsets.append(offsets[-1] + ln + 4)
+        manifest._offsets = offsets  # type: ignore[attr-defined]
+    return offsets[idx]
+
+
+def read_chunk(path: str, manifest: SnapshotManifest, idx: int) -> bytes:
+    """Read + verify one chunk: CRC (torn-file detection) then the
+    committed sha256d hash.  Consults the ``snapshot.read`` fault site
+    (torn=<n> truncates, tripping the CRC)."""
+    if not 0 <= idx < manifest.n_chunks:
+        raise SnapshotError("snapshot-bad-chunk-index", str(idx))
+    ln = manifest.chunk_lengths[idx]
+    with open(path, "rb") as f:
+        f.seek(_chunk_offset(manifest, idx))
+        data = f.read(ln + 4)
+    if g_faults.enabled:
+        data = g_faults.filter_read("snapshot.read", data)
+    if len(data) != ln + 4:
+        raise SnapshotError("snapshot-torn-chunk", f"chunk {idx}")
+    payload, (crc,) = data[:ln], struct.unpack("<I", data[ln:])
+    if zlib.crc32(payload) != crc:
+        raise SnapshotError("snapshot-torn-chunk", f"chunk {idx} CRC")
+    if sha256d(payload) != manifest.chunk_hashes[idx]:
+        raise SnapshotError("snapshot-chunk-hash", f"chunk {idx}")
+    return payload
+
+
+# ------------------------------------------------------- crash recovery
+
+
+def recover_on_load(chainstate) -> bool:
+    """Heal an interrupted snapshot load or discard a fraudulent assumed
+    chainstate — called from ``ChainState._load_or_init`` BEFORE crash
+    replay, so ``_replay_blocks`` rebuilds the coins from block data
+    afterwards.  Returns True when anything was healed."""
+    db = chainstate.metadata_db
+    loading = db.get(_K_LOADING)
+    fraud = db.get(_K_FRAUD)
+    if loading is None and fraud is None:
+        return _restore_assumed_marks(chainstate)
+    assumed = db.get(_K_ASSUMED)
+    batch = WriteBatch()
+    for k, _ in db.iterate(_COIN_PREFIX):
+        batch.delete(k)
+    for k, _ in db.iterate(_BV_PREFIX):
+        batch.delete(k)
+    for k in (_BEST_BLOCK_KEY, _ASSETS_KEY, _K_LOADING):
+        batch.delete(k)
+    if fraud is not None:
+        for k in (_K_ASSUMED, _K_FRAUD, _K_VALIDATED, _K_BV_NEXT,
+                  _K_BV_BEST):
+            batch.delete(k)
+    db.write_batch(batch)
+    # the in-memory asset cache was deserialized from the blob we just
+    # deleted; replay re-applies asset transitions from block data
+    # (in place — construction order means nothing else holds the
+    # reference yet, but stay consistent with _activate's discipline)
+    from ..assets.cache import AssetsCache
+
+    chainstate.assets.__dict__.clear()
+    chainstate.assets.__dict__.update(AssetsCache().__dict__)
+    if fraud is not None and assumed is not None:
+        # discard the assumed chain: keep the longest genesis-anchored
+        # prefix whose block DATA is present (back-validation may have
+        # downloaded part of history — that much is replayable), demote
+        # everything above it back to headers-only, and fall to full IBD
+        try:
+            manifest = SnapshotManifest.deserialize(assumed)
+            base_idx = chainstate.block_index.get(manifest.base_hash)
+        except SnapshotError:
+            base_idx = None
+        if base_idx is not None:
+            from .blockindex import BlockStatus
+
+            chain: List = []
+            walk = base_idx
+            while walk is not None:
+                chain.append(walk)
+                walk = walk.prev
+            chain.reverse()
+            h_star = -1
+            for idx in chain:
+                if not idx.status & BlockStatus.HAVE_DATA:
+                    break
+                h_star = idx.height
+            for idx in chain:
+                if idx.height > h_star:
+                    idx.status = BlockStatus(
+                        (idx.status & ~BlockStatus.VALID_MASK)
+                        | BlockStatus.VALID_TREE)
+                    idx.chain_tx_count = 0
+                    chainstate.candidates.discard(idx)
+            new_tip = chain[h_star] if h_star >= 0 else None
+            chainstate.active.set_tip(new_tip)
+            if new_tip is not None:
+                chainstate.blocktree.write_tip(new_tip.block_hash)
+            chainstate._full_index_flush = True
+        log_printf(
+            "snapshot: FRAUDULENT assumed chainstate discarded (%s) — "
+            "falling back to full IBD", fraud.decode(errors="replace"))
+    else:
+        log_printf("snapshot: interrupted load healed — partially applied "
+                   "coins wiped, replaying from block data")
+    return True
+
+
+def _mark_assumed_chain(chainstate, base_idx) -> None:
+    """Shared by activation and its crash-recovery twin: raise every
+    genesis..base ancestor to VALID_SCRIPTS (pruned-chain semantics) and
+    keep the nChainTx candidacy cascade alive with synthetic counts —
+    existing nonzero counts (real, from downloaded data) are preserved;
+    every touched entry lands in the dirty-index set."""
+    from .blockindex import BlockStatus
+
+    chain: List = []
+    walk = base_idx
+    while walk is not None:
+        chain.append(walk)
+        walk = walk.prev
+    chain.reverse()
+    running = 0
+    for idx in chain:
+        idx.raise_validity(BlockStatus.VALID_SCRIPTS)
+        if idx.tx_count <= 0:
+            idx.tx_count = 1
+        if idx.chain_tx_count <= 0:
+            idx.chain_tx_count = running + idx.tx_count
+        running = idx.chain_tx_count
+        chainstate._dirty_index.add(idx)
+
+
+def _restore_assumed_marks(chainstate) -> bool:
+    """Idempotent restore of the activation's index marks + tip from the
+    persisted assumed manifest.  The activation BATCH is the single
+    commit point; the index/tip writes after it are re-derived here on
+    every load, so a kill landing between the batch and the flush still
+    restarts straight into the assumed tip (the coins best-block at the
+    base is the witness that the batch committed)."""
+    db = chainstate.metadata_db
+    assumed = db.get(_K_ASSUMED)
+    if assumed is None:
+        return False
+    try:
+        manifest = SnapshotManifest.deserialize(assumed)
+    except SnapshotError:
+        return False
+    chainstate.assumed_base_height = manifest.base_height
+    base_idx = chainstate.block_index.get(manifest.base_hash)
+    coins_best = db.get(_BEST_BLOCK_KEY)
+    if base_idx is None or coins_best is None:
+        return False
+    _mark_assumed_chain(chainstate, base_idx)
+    healed = False
+    tip = chainstate.tip()
+    if (int.from_bytes(coins_best, "little") == manifest.base_hash
+            and (tip is None or tip.height < base_idx.height)):
+        # the kill window: activation committed but the tip write never
+        # landed — re-point the chain at the base
+        chainstate.active.set_tip(base_idx)
+        chainstate.blocktree.write_tip(base_idx.block_hash)
+        chainstate._full_index_flush = True
+        healed = True
+        log_printf("snapshot: restored assumed tip h=%d after interrupted "
+                   "activation", base_idx.height)
+    return healed
+
+
+# ------------------------------------------------ back-validation scratch
+
+
+class _ScratchCoinsDB(CoinsViewDB):
+    """Coins view persisted under prefix ``V`` in the chainstate kvstore:
+    the back-validation working set.  Everything rides the REAL
+    CoinsViewDB implementation (one flush/serialization path — the
+    digest compare at the base must never fail because the scratch view
+    drifted from the live one); only the key space and the commit hook
+    differ.  Flushes ride ONE atomic batch with the watermark
+    (``pending_extra``), through the ``snapshot.write`` fault site — a
+    kill leaves either the old watermark + old coins or the new pair,
+    never a mix."""
+
+    KEY_PREFIX = _BV_PREFIX
+    BEST_BLOCK_KEY = _K_BV_BEST
+
+    def _commit(self, batch: WriteBatch) -> None:
+        if g_faults.enabled:
+            g_faults.check("snapshot.write")
+        self.db.write_batch(batch)
+
+
+# --------------------------------------------------------- p2p download
+
+
+class SnapshotFetch:
+    """Resumable chunked download state.  Verified chunks persist as one
+    file each under ``directory`` (fault site ``snapshot.chunk_recv``),
+    so a kill mid-transfer resumes from what's on disk; a chunk whose
+    re-scan hash fails (torn write) is unlinked and re-fetched."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.manifest: Optional[SnapshotManifest] = None
+        self.snapshot_id: Optional[bytes] = None
+        self.have: set = set()
+        self.inflight: Dict[int, Tuple[int, float]] = {}  # idx -> (peer, t)
+        self.bad_providers: set = set()   # peer ids caught serving fraud
+        self.hdr_asked: Dict[int, float] = {}
+        self.started_at: Optional[float] = None
+        self.adopted_at: Optional[float] = None  # manifest adoption time
+        mf = os.path.join(directory, "manifest.dat")
+        if os.path.exists(mf):
+            try:
+                with open(mf, "rb") as f:
+                    raw = f.read()
+                self._adopt_manifest(SnapshotManifest.deserialize(raw))
+            except (SnapshotError, OSError):
+                os.unlink(mf)
+
+    # -- manifest ---------------------------------------------------------
+
+    def _chunk_path(self, idx: int) -> str:
+        return os.path.join(self.dir, f"chunk_{idx:06d}")
+
+    def _adopt_manifest(self, manifest: SnapshotManifest) -> None:
+        self.manifest = manifest
+        self.snapshot_id = manifest.snapshot_id()
+        self.have.clear()
+        for idx in range(manifest.n_chunks):
+            p = self._chunk_path(idx)
+            if not os.path.exists(p):
+                continue
+            try:
+                with open(p, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                continue
+            if sha256d(payload) == manifest.chunk_hashes[idx]:
+                self.have.add(idx)
+            else:
+                os.unlink(p)  # torn by a crash mid-write: re-fetch
+
+    def ingest_manifest(self, raw: bytes) -> str:
+        """Adopt the first well-formed manifest offered: 'ok' | 'dup'
+        (identical re-offer) | 'different' (another provider's valid
+        manifest — ignored, NOT punishable: providers legitimately dump
+        at different tips; the transfer in progress keeps its
+        commitment) | 'bad' (malformed)."""
+        try:
+            manifest = SnapshotManifest.deserialize(raw)
+        except Exception:  # noqa: BLE001 — wire bytes are untrusted
+            return "bad"
+        if self.manifest is not None:
+            return ("dup" if manifest.snapshot_id() == self.snapshot_id
+                    else "different")
+        tmp = os.path.join(self.dir, "manifest.tmp")
+        with open(tmp, "wb") as f:
+            if g_faults.enabled:
+                g_faults.check("snapshot.chunk_recv", torn_file=f,
+                               torn_data=raw)
+            f.write(raw)
+        os.replace(tmp, os.path.join(self.dir, "manifest.dat"))
+        self._adopt_manifest(manifest)
+        return "ok"
+
+    def abandon_manifest(self) -> None:
+        """Drop the adopted manifest + its partial chunks (a commitment
+        whose base never materialized in the header index): the next
+        snaphdr re-solicitation starts fresh."""
+        for idx in list(self.have):
+            try:
+                os.unlink(self._chunk_path(idx))
+            except OSError:
+                pass
+        try:
+            os.unlink(os.path.join(self.dir, "manifest.dat"))
+        except OSError:
+            pass
+        self.manifest = None
+        self.snapshot_id = None
+        self.have.clear()
+        self.inflight.clear()
+        self.hdr_asked.clear()
+        self.adopted_at = None
+
+    # -- chunks -----------------------------------------------------------
+
+    def ingest_chunk(self, idx: int, payload: bytes) -> str:
+        """Verify + persist one chunk: 'ok' | 'bad' | 'dup' | 'nomanifest'."""
+        m = self.manifest
+        if m is None:
+            return "nomanifest"
+        if not 0 <= idx < m.n_chunks:
+            return "bad"
+        if idx in self.have:
+            return "dup"
+        if sha256d(payload) != m.chunk_hashes[idx]:
+            return "bad"
+        tmp = self._chunk_path(idx) + ".tmp"
+        with open(tmp, "wb") as f:
+            if g_faults.enabled:
+                # kill@<n> leaves a torn temp file; a torn FINAL file can
+                # also exist if the kill lands between write and replace —
+                # the manifest re-scan unlinks either on restart
+                g_faults.check("snapshot.chunk_recv", torn_file=f,
+                               torn_data=payload)
+            f.write(payload)
+        os.replace(tmp, self._chunk_path(idx))
+        self.have.add(idx)
+        return "ok"
+
+    def complete(self) -> bool:
+        m = self.manifest
+        return m is not None and len(self.have) == m.n_chunks
+
+    def iter_chunks(self) -> Iterator[bytes]:
+        assert self.manifest is not None
+        for idx in range(self.manifest.n_chunks):
+            with open(self._chunk_path(idx), "rb") as f:
+                payload = f.read()
+            if sha256d(payload) != self.manifest.chunk_hashes[idx]:
+                raise SnapshotError("snapshot-chunk-hash",
+                                    f"chunk {idx} changed on disk")
+            yield payload
+
+
+# ------------------------------------------------------------- manager
+
+
+class SnapshotManager:
+    """Per-node owner of snapshot state: serving, loading, the assumed/
+    validated lifecycle, and background back-validation.  One instance
+    per NodeContext (``node.snapshot_mgr``); every entry point is safe
+    under the internal lock, and chainstate mutations happen under
+    cs_main."""
+
+    def __init__(self, chainstate):
+        self.chainstate = chainstate
+        self._lock = threading.RLock()
+        self.state = STATE_NONE
+        self.manifest: Optional[SnapshotManifest] = None
+        self.serving: Optional[Tuple[str, SnapshotManifest, bytes]] = None
+        self.fetcher: Optional[SnapshotFetch] = None
+        self.stopped = False
+        # tunables (netsim tightens these to sim seconds)
+        self.chunk_timeout_s = 10.0
+        self.manifest_timeout_s = 60.0  # adopted but base never indexed
+        self.max_chunks_in_flight = 8
+        self.bv_blocks_per_tick = 4
+        self.hist_blocks_per_tick = 4
+        self._rr = 0                   # provider round-robin cursor
+        self._hist_cursor = 0          # lowest height still missing data
+        self._bv_next = 0
+        self._bv_cache: Optional[CoinsViewCache] = None
+        self._bv_since_flush = 0
+        self.bv_flush_interval = 32    # blocks between watermark flushes
+        self._bv_thread: Optional[threading.Thread] = None
+        self._restore()
+
+    # -- persisted-state restore ------------------------------------------
+
+    def _restore(self) -> None:
+        db = self.chainstate.metadata_db
+        validated = db.get(_K_VALIDATED)
+        assumed = db.get(_K_ASSUMED)
+        if validated is not None:
+            self._set_state(STATE_VALIDATED)
+            return
+        if assumed is not None:
+            try:
+                self.manifest = SnapshotManifest.deserialize(assumed)
+            except SnapshotError:
+                return
+            raw = db.get(_K_BV_NEXT)
+            self._bv_next = int.from_bytes(raw, "little") if raw else 0
+            self._set_state(STATE_ASSUMED)
+            _M_BV_HEIGHT.set(float(self._bv_next))
+
+    def _set_state(self, state: int) -> None:
+        self.state = state
+        _M_STATE.set(float(state))
+
+    def stop(self) -> None:
+        """Halt the back-validation loop and persist its watermark so a
+        clean shutdown resumes exactly where it stopped (a crash resumes
+        from the last periodic flush — at most ``bv_flush_interval``
+        blocks re-validated)."""
+        self.stopped = True
+        t = self._bv_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
+        self.flush_backvalidation()
+
+    def ensure_backvalidation_thread(self) -> None:
+        """Spawn (once) the dedicated back-validation worker: steps the
+        sweep whenever the state is assumed, idles while a fetch is
+        still in flight, and exits when validated/failed/stopped.  Used
+        by the daemon at boot AND by a runtime ``loadtxoutset`` — the
+        5-second connman maintenance tick alone would back-validate at
+        ~0.8 blk/s, and a ``-nolisten`` node has no tick at all.  Never
+        called from netsim/tests (a live thread would break SimClock
+        determinism); they drive :meth:`backvalidate_step` directly."""
+        with self._lock:
+            t = self._bv_thread
+            if t is not None and t.is_alive():
+                return
+
+            def _loop() -> None:
+                import time as _time
+
+                while not self.stopped:
+                    if self.state == STATE_ASSUMED:
+                        progressed = self.backvalidate_step(64)
+                        _time.sleep(0.005 if progressed else 0.5)
+                    elif self.fetcher is not None:
+                        _time.sleep(0.5)  # downloading; periodic drives it
+                    else:
+                        break  # validated, failed, or never armed
+
+            self._bv_thread = threading.Thread(
+                target=_loop, name="snapshot-backval", daemon=True)
+            self._bv_thread.start()
+
+    def flush_backvalidation(self) -> None:
+        with self._lock:
+            if self.state != STATE_ASSUMED or self._bv_cache is None:
+                return
+        with self.chainstate.cs_main:
+            try:
+                self._flush_bv()
+            except Exception as e:  # noqa: BLE001 — shutdown best-effort
+                log_printf("snapshot: back-validation flush failed: %r", e)
+
+    # -- serving ----------------------------------------------------------
+
+    def register_serving(self, path: str) -> SnapshotManifest:
+        manifest = read_manifest(path)
+        with self._lock:
+            self.serving = (path, manifest, manifest.serialize())
+        return manifest
+
+    def make_snapshot(self, path: str,
+                      chunk_bytes: int = DEFAULT_CHUNK_BYTES
+                      ) -> SnapshotManifest:
+        manifest = write_snapshot(self.chainstate, path, chunk_bytes)
+        with self._lock:
+            self.serving = (path, manifest, manifest.serialize())
+        return manifest
+
+    # -- loading ----------------------------------------------------------
+
+    def load_file(self, path: str) -> SnapshotManifest:
+        """Load + activate a snapshot file (the ``loadtxoutset`` /
+        ``-loadsnapshot=<path>`` path)."""
+        manifest = read_manifest(path)
+
+        def chunks() -> Iterator[bytes]:
+            for idx in range(manifest.n_chunks):
+                yield read_chunk(path, manifest, idx)
+
+        self._load_and_activate(manifest, chunks())
+        return manifest
+
+    def start_fetch(self, directory: Optional[str] = None) -> SnapshotFetch:
+        """Arm the P2P downloader (``-loadsnapshot=p2p``); actual traffic
+        is driven from ``NetProcessor.periodic`` via :meth:`periodic`."""
+        with self._lock:
+            if self.fetcher is None:
+                if directory is None:
+                    datadir = self.chainstate.datadir
+                    if datadir is not None:
+                        directory = os.path.join(
+                            datadir, "snapshots", "incoming")
+                    else:
+                        import tempfile
+
+                        directory = tempfile.mkdtemp(prefix="nxsnap-")
+                self.fetcher = SnapshotFetch(directory)
+                if self.state == STATE_NONE:
+                    self._set_state(STATE_LOADING)
+            return self.fetcher
+
+    def _load_and_activate(self, manifest: SnapshotManifest,
+                           chunk_iter: Iterator[bytes]) -> None:
+        cs = self.chainstate
+        with cs.cs_main:
+            self._check_base(manifest)
+            with self._lock:
+                self._set_state(STATE_LOADING)
+            db = cs.metadata_db
+            snap_id = manifest.snapshot_id()
+            cs.flush_state_to_disk()  # nothing dirty may survive the wipe
+            try:
+                # marker + wipe of any pre-existing coins in ONE batch:
+                # from here until activation the coins DB is marked
+                # poisoned — recover_on_load heals a crash anywhere
+                # inside the window, _heal_failed_load an in-process
+                # failure (bad chunk file, injected error)
+                batch = WriteBatch()
+                for k, _ in db.iterate(_COIN_PREFIX):
+                    batch.delete(k)
+                batch.put(_K_LOADING, snap_id)
+                if g_faults.enabled:
+                    g_faults.check("snapshot.activate")
+                db.write_batch(batch)
+                cs.coins._cache.clear()
+                cs.coins._mem_bytes = 0
+                digest = _CoinsDigest(
+                    manifest.base_height, manifest.base_hash)
+                n_coins = 0
+                for payload in chunk_iter:
+                    batch = WriteBatch()
+                    for key, val in _iter_chunk_records(payload):
+                        digest.add_record(_pack_record(key, val))
+                        batch.put(_COIN_PREFIX + key, val)
+                        n_coins += 1
+                    if g_faults.enabled:
+                        g_faults.check("snapshot.activate")
+                    db.write_batch(batch)
+                if n_coins != manifest.n_coins:
+                    raise SnapshotError(
+                        "snapshot-coin-count",
+                        f"{n_coins} records, manifest claims "
+                        f"{manifest.n_coins}")
+                if digest.digest() != manifest.coins_digest:
+                    raise SnapshotError(
+                        "snapshot-digest-mismatch",
+                        "chunk contents do not match the manifest "
+                        "commitment")
+                self._activate(manifest)
+            except Exception:
+                self._heal_failed_load()
+                with self._lock:
+                    self._set_state(STATE_FAILED)
+                raise
+
+    def _heal_failed_load(self) -> None:
+        """In-process twin of :func:`recover_on_load`: an exception after
+        the loading marker went down leaves the coins DB poisoned — wipe
+        the partial apply and replay from block data so the SAME process
+        keeps a consistent view (and a later retry can run)."""
+        cs = self.chainstate
+        db = cs.metadata_db
+        try:
+            batch = WriteBatch()
+            for k, _ in db.iterate(_COIN_PREFIX):
+                batch.delete(k)
+            for k in (_K_LOADING, _BEST_BLOCK_KEY, _ASSETS_KEY):
+                batch.delete(k)
+            db.write_batch(batch)
+            from ..assets.cache import AssetsCache
+
+            cs.assets.__dict__.clear()
+            cs.assets.__dict__.update(AssetsCache().__dict__)
+            cs.coins._cache.clear()
+            cs.coins._mem_bytes = 0
+            cs.coins._best_block = 0
+            if cs._replay_blocks():
+                cs.flush_state_to_disk()
+        except Exception as e:  # noqa: BLE001 — restart replays the marker
+            log_printf("snapshot: in-process load heal incomplete (%r); "
+                       "restart recovery will finish it", e)
+
+    def _check_base(self, manifest: SnapshotManifest) -> None:
+        """Activation preconditions — raised as typed SnapshotError so a
+        base-block reorg mid-load refuses activation instead of serving
+        a tip the header chain no longer supports."""
+        cs = self.chainstate
+        if cs.metadata_db.get(_K_ASSUMED) is not None:
+            # one snapshot lifecycle at a time: a second load while the
+            # first is still assumed-unvalidated would wipe coins that
+            # no block data below the old base can replay
+            raise SnapshotError(
+                "snapshot-already-assumed",
+                "back-validation of a previous snapshot is still running")
+        base_idx = cs.block_index.get(manifest.base_hash)
+        if base_idx is None:
+            raise SnapshotError(
+                "snapshot-base-unknown",
+                f"base {u256_hex(manifest.base_hash)[:16]} not in the "
+                "header index — sync headers first")
+        if base_idx in cs.invalid or (
+                base_idx.status & 96):  # FAILED_MASK
+            raise SnapshotError("snapshot-base-invalid")
+        tip = cs.tip()
+        if tip is not None and tip.height >= base_idx.height:
+            raise SnapshotError(
+                "snapshot-behind-tip",
+                f"tip h={tip.height} already at/past base "
+                f"h={base_idx.height}")
+        # the best known header chain must still contain the base: a
+        # reorg past the base during the transfer refuses activation
+        best = None
+        for idx in cs.block_index.values():
+            if idx in cs.invalid:
+                continue
+            if best is None or idx.chain_work > best.chain_work:
+                best = idx
+        if best is not None and best.get_ancestor(
+                base_idx.height) is not base_idx:
+            raise SnapshotError(
+                "snapshot-base-reorged",
+                "best known header chain no longer contains the base")
+
+    def _activate(self, manifest: SnapshotManifest) -> None:
+        """The single commit point: flip the coins best-block to the
+        base, adopt the asset snapshot, record the assumed manifest, and
+        re-point the active chain — all under cs_main, the DB flip in
+        one atomic batch behind the ``snapshot.activate`` fault site."""
+        from ..node.events import main_signals
+
+        cs = self.chainstate
+        db = cs.metadata_db
+        base_idx = cs.block_index[manifest.base_hash]
+        batch = WriteBatch()
+        batch.put(_ASSETS_KEY, manifest.assets_blob)
+        batch.put(_BEST_BLOCK_KEY,
+                  manifest.base_hash.to_bytes(32, "little"))
+        batch.put(_K_ASSUMED, manifest.serialize())
+        batch.put(_K_BV_NEXT, (0).to_bytes(8, "little"))
+        batch.delete(_K_LOADING)
+        # a previous snapshot's validated marker must not survive: on
+        # restart _restore checks it FIRST and would skip back-validating
+        # THIS snapshot forever
+        batch.delete(_K_VALIDATED)
+        if g_faults.enabled:
+            g_faults.check("snapshot.activate")
+        db.write_batch(batch)
+        # index marks: the assumed chain is treated like a pruned one —
+        # VALID_SCRIPTS without HAVE_DATA; synthetic tx counts keep the
+        # nChainTx candidacy cascade alive for blocks landing on top
+        # (real counts replace them as history downloads).  Shared with
+        # the crash-recovery twin so the two can never drift.
+        _mark_assumed_chain(cs, base_idx)
+        cs._full_index_flush = True
+        # the in-memory caches must reflect the freshly-written DB.
+        # Adopt the snapshot's asset state IN PLACE: the rewards engine
+        # and other subscribers hold a reference to the cache object, so
+        # replacing it would leave them reading a stale state.
+        from ..assets.cache import AssetsCache
+        from ..core.serialize import ByteReader as _BR
+
+        new_assets = (AssetsCache.deserialize(_BR(manifest.assets_blob))
+                      if manifest.assets_blob else AssetsCache())
+        cs.assets.__dict__.clear()
+        cs.assets.__dict__.update(new_assets.__dict__)
+        cs.coins._cache.clear()
+        cs.coins._mem_bytes = 0
+        cs.coins.set_best_block(manifest.base_hash)
+        cs.active.set_tip(base_idx)
+        cs.candidates.add(base_idx)
+        cs.tip_generation += 1
+        # verify_db treats heights at/below this as the assumed region
+        # (data may exist before its undo does, while back-validation
+        # is still reconstructing the journal)
+        cs.assumed_base_height = manifest.base_height
+        cs.flush_state_to_disk()
+        with self._lock:
+            self.manifest = manifest
+            self._bv_next = 0
+            self._bv_cache = None
+            self._hist_cursor = 0
+            self._set_state(STATE_ASSUMED)
+        _M_BV_HEIGHT.set(0.0)
+        flight_recorder.record_event(
+            "snapshot_activated",
+            height=manifest.base_height,
+            block=u256_hex(manifest.base_hash)[:16],
+            coins=manifest.n_coins,
+            snapshot_id=manifest.snapshot_id().hex()[:16],
+        )
+        main_signals.updated_block_tip(base_idx, None, False)
+        log_print(
+            LogFlags.NONE,
+            "snapshot: ACTIVATED assumed tip h=%d %s (%d coins) — "
+            "back-validation from genesis begins",
+            manifest.base_height, u256_hex(manifest.base_hash)[:16],
+            manifest.n_coins,
+        )
+
+    # -- p2p drive (called from NetProcessor.periodic) --------------------
+
+    def periodic(self, processor, now: float) -> None:
+        with self._lock:
+            fetcher = self.fetcher
+            state = self.state
+        if fetcher is not None and state == STATE_LOADING:
+            self._drive_fetch(processor, fetcher, now)
+        if state == STATE_ASSUMED:
+            self._drive_history(processor)
+            self.backvalidate_step(self.bv_blocks_per_tick)
+
+    def _snap_peers(self, processor, fetcher) -> list:
+        return [p for p in processor.connman.all_peers()
+                if p.handshake_done and not p.disconnect
+                and getattr(p, "snap_ok", False)
+                and p.id not in fetcher.bad_providers]
+
+    def _drive_fetch(self, processor, fetcher: SnapshotFetch,
+                     now: float) -> None:
+        peers = self._snap_peers(processor, fetcher)
+        if fetcher.started_at is None:
+            fetcher.started_at = now
+        if fetcher.manifest is None:
+            for p in peers:
+                if now - fetcher.hdr_asked.get(p.id, -1e18) > 5.0:
+                    fetcher.hdr_asked[p.id] = now
+                    from ..net.protocol import MSG_GETSNAPHDR
+
+                    p.send_msg(processor.magic, MSG_GETSNAPHDR, b"")
+            return
+        if fetcher.adopted_at is None:
+            fetcher.adopted_at = now
+        # base header still unknown: nudge the header sync along before
+        # asking for (more) chunks — activation needs the base indexed.
+        # A manifest whose base NEVER materializes (e.g. an unsolicited
+        # forgery adopted before the capability gate, or a provider on a
+        # dead fork) must not wedge the bootstrap forever: abandon it
+        # after manifest_timeout_s and re-solicit fresh.
+        base_known = self.chainstate.lookup(
+            fetcher.manifest.base_hash) is not None
+        if not base_known:
+            if now - fetcher.adopted_at > self.manifest_timeout_s:
+                log_printf("snapshot: abandoning manifest %s — base never "
+                           "appeared in the header index",
+                           (fetcher.snapshot_id or b"").hex()[:16])
+                fetcher.abandon_manifest()
+                return
+            if peers:
+                processor._send_getheaders(peers[self._rr % len(peers)])
+        # timeouts: a provider that sat on a chunk past the deadline
+        # loses the assignment; the chunk rotates to the next provider
+        for idx, (pid, t) in list(fetcher.inflight.items()):
+            if now - t > self.chunk_timeout_s:
+                del fetcher.inflight[idx]
+                _M_CHUNKS.inc(result="timeout")
+        live_ids = {p.id for p in peers}
+        for idx, (pid, _) in list(fetcher.inflight.items()):
+            if pid not in live_ids:
+                del fetcher.inflight[idx]
+        if peers:
+            for idx in range(fetcher.manifest.n_chunks):
+                if len(fetcher.inflight) >= self.max_chunks_in_flight:
+                    break
+                if idx in fetcher.have or idx in fetcher.inflight:
+                    continue
+                p = peers[self._rr % len(peers)]
+                self._rr += 1
+                from ..net.protocol import MSG_GETSNAPCHUNK
+
+                w = ByteWriter()
+                w.write(fetcher.snapshot_id)
+                w.u32(idx)
+                p.send_msg(processor.magic, MSG_GETSNAPCHUNK, w.getvalue())
+                fetcher.inflight[idx] = (p.id, now)
+        # normal IBD can win the race on short chains: once the tip is
+        # at/past the base the snapshot is simply no longer needed —
+        # stand down instead of tripping the behind-tip refusal
+        tip = self.chainstate.tip()
+        if (tip is not None
+                and tip.height >= fetcher.manifest.base_height):
+            log_printf("snapshot: tip h=%d reached the base h=%d via "
+                       "normal sync — download no longer needed",
+                       tip.height, fetcher.manifest.base_height)
+            with self._lock:
+                self.fetcher = None
+                if self.state == STATE_LOADING:
+                    self._set_state(STATE_NONE)
+            return
+        if fetcher.complete() and base_known:
+            try:
+                self._load_and_activate(fetcher.manifest,
+                                        fetcher.iter_chunks())
+            except Exception as e:  # noqa: BLE001 — the maintenance
+                # thread drives this; ANY escape (disk-full OSError out
+                # of the batch writes, a chunk file racing iter_chunks)
+                # would kill it for the process's life
+                log_printf("snapshot: p2p load failed: %r", e)
+                with self._lock:
+                    self._set_state(STATE_FAILED)
+            finally:
+                with self._lock:
+                    self.fetcher = None
+
+    def _drive_history(self, processor) -> None:
+        """Pull block data below the base for back-validation — bounded
+        getdata toward any live peer, lowest heights first (monotone
+        cursor; arrived data advances it, so total work is O(chain))."""
+        from .blockindex import BlockStatus
+
+        manifest = self.manifest
+        if manifest is None:
+            return
+        cs = self.chainstate
+        peers = [p for p in processor.connman.all_peers()
+                 if p.handshake_done and not p.disconnect]
+        if not peers:
+            return
+        with cs.cs_main:
+            h = max(self._hist_cursor, 1)
+            while h <= manifest.base_height:
+                idx = cs.active.at(h)
+                if idx is None:
+                    return
+                if idx.status & BlockStatus.HAVE_DATA:
+                    h += 1
+                    self._hist_cursor = h
+                    continue
+                break
+            requested = 0
+            while (h <= manifest.base_height
+                   and requested < self.hist_blocks_per_tick):
+                idx = cs.active.at(h)
+                h += 1
+                if idx is None or idx.status & BlockStatus.HAVE_DATA:
+                    continue
+                if idx.block_hash in processor._blocks_in_flight:
+                    continue
+                p = peers[self._rr % len(peers)]
+                self._rr += 1
+                processor._getdata_block(p, idx.block_hash)
+                requested += 1
+
+    # -- back-validation ---------------------------------------------------
+
+    def backvalidate_step(self, max_blocks: int = 16) -> bool:
+        """Validate up to ``max_blocks`` of history toward the base in
+        the persisted scratch view.  Returns True when progress was
+        made.  Runs under cs_main (bounded, small steps) so it can share
+        the process with live serving."""
+        with self._lock:
+            if self.state != STATE_ASSUMED or self.manifest is None:
+                return False
+            manifest = self.manifest
+        cs = self.chainstate
+        from .blockindex import BlockStatus
+
+        done = 0
+        with cs.cs_main:
+            # TWO drivers step this on a live daemon (the dedicated bv
+            # thread + the connman maintenance tick): re-check the state
+            # now that cs_main is held, or the loser of the race re-runs
+            # _finish_bv over the already-deleted scratch set and falsely
+            # declares fraud on a just-validated node
+            with self._lock:
+                if self.state != STATE_ASSUMED:
+                    return False
+            if self._bv_cache is None:
+                self._bv_view = _ScratchCoinsDB(cs.metadata_db)
+                self._bv_cache = CoinsViewCache(self._bv_view)
+            while done < max_blocks and self._bv_next <= manifest.base_height:
+                idx = cs.active.at(self._bv_next)
+                if idx is None or not idx.status & BlockStatus.HAVE_DATA:
+                    break  # waiting for history to download
+                try:
+                    block = cs.read_block(idx)
+                    undo = self._backvalidate_block(block, idx)
+                except Exception as e:  # noqa: BLE001 — fraud boundary
+                    self._declare_fraud(
+                        f"invalid historical block h={idx.height}: {e!r}")
+                    return True
+                # persist the undo journal as validation advances: once
+                # the base is reached the assumed region is a NORMAL
+                # chain segment (verify_db's undo round-trip included)
+                dpos, upos = cs.positions.get(idx.block_hash, (-1, -1))
+                if upos < 0 and idx.height > 0:
+                    upos = cs.block_store.write_undo(undo)
+                    cs.positions[idx.block_hash] = (dpos, upos)
+                    from .blockindex import BlockStatus as _BS
+
+                    idx.status |= _BS.HAVE_UNDO
+                    cs._dirty_index.add(idx)
+                self._bv_next += 1
+                done += 1
+                self._bv_since_flush += 1
+            if done:
+                _M_BV_HEIGHT.set(float(self._bv_next))
+                if (self._bv_since_flush >= self.bv_flush_interval
+                        or self._bv_next > manifest.base_height):
+                    self._flush_bv()
+            if self._bv_next > manifest.base_height:
+                self._finish_bv()
+        return done > 0
+
+    def _backvalidate_block(self, block, idx):
+        """Full re-validation of one historical block against the scratch
+        view: structure, merkle, PoW, input existence + amounts, and the
+        subsidy rule.  Scripts are skipped (the base commitment is the
+        trust anchor, exactly the assumevalid trade) and asset state is
+        covered by the digest over the coins the asset rules produced.
+        Returns the reconstructed :class:`BlockUndo` (coin undos only —
+        asset undos below an assumed base are not reconstructed; a reorg
+        that deep is already refused by max_reorg_depth)."""
+        from ..consensus import pow as powrules
+        from ..consensus.tx_verify import TxValidationError, check_tx_inputs
+        from .blockstore import BlockUndo, TxUndo
+        from .validation import BlockValidationError
+
+        cs = self.chainstate
+        cs.check_block(block, check_pow=True)
+        view = self._bv_cache
+        undo = BlockUndo()
+        fees = 0
+        for i, tx in enumerate(block.vtx):
+            if not tx.is_coinbase():
+                try:
+                    fees += check_tx_inputs(tx, view, idx.height)
+                except TxValidationError as e:
+                    raise BlockValidationError(e.code, f"tx {i}")
+                txundo = TxUndo()
+                for txin in tx.vin:
+                    txundo.prevouts.append(view.spend_coin(txin.prevout))
+                undo.vtxundo.append(txundo)
+            view.add_tx_outputs(tx, idx.height)
+        subsidy = powrules.get_block_subsidy(idx.height, cs.params.consensus)
+        if block.vtx[0].total_output_value() > fees + subsidy:
+            raise BlockValidationError("bad-cb-amount")
+        view.set_best_block(idx.block_hash)
+        return undo
+
+    def _flush_bv(self) -> None:
+        """Persist scratch coins + the watermark in ONE batch so a kill
+        between them is impossible — the crash-resume regression test
+        kills inside this write and asserts restart resumes here.
+
+        ORDER MATTERS: the dirty block index (the undo positions this
+        sweep reconstructed) goes down FIRST.  The reverse order could
+        persist a watermark past blocks whose undo positions were lost
+        — the resumed sweep would skip them and the journal would stay
+        holey forever."""
+        assert self._bv_view is not None and self._bv_cache is not None
+        self.chainstate.flush_state_to_disk("if_needed")
+        self._bv_view.pending_extra[_K_BV_NEXT] = self._bv_next.to_bytes(
+            8, "little")
+        self._bv_cache.sync()
+        self._bv_since_flush = 0
+
+    def _finish_bv(self) -> None:
+        manifest = self.manifest
+        db = self.chainstate.metadata_db
+        # undo positions must be durable BEFORE the assumed marker clears:
+        # once it's gone, verify_db holds this chain to full strength
+        self.chainstate.flush_state_to_disk("if_needed")
+        digest = _CoinsDigest(manifest.base_height, manifest.base_hash)
+        for k, v in db.iterate(_BV_PREFIX):
+            digest.add_record(_pack_record(k[1:], v))
+        if digest.digest() != manifest.coins_digest:
+            self._declare_fraud(
+                "back-validation reached the base with a different UTXO "
+                f"set than the snapshot committed "
+                f"(h={manifest.base_height})")
+            return
+        batch = WriteBatch()
+        for k, _ in db.iterate(_BV_PREFIX):
+            batch.delete(k)
+        for k in (_K_ASSUMED, _K_BV_NEXT, _K_BV_BEST):
+            batch.delete(k)
+        batch.put(_K_VALIDATED,
+                  manifest.base_hash.to_bytes(32, "little"))
+        db.write_batch(batch)
+        self.chainstate.assumed_base_height = None
+        with self._lock:
+            self._bv_cache = None
+            self._bv_view = None
+            self._set_state(STATE_VALIDATED)
+        flight_recorder.record_event(
+            "snapshot_validated",
+            height=manifest.base_height,
+            block=u256_hex(manifest.base_hash)[:16])
+        log_print(
+            LogFlags.NONE,
+            "snapshot: back-validation CONFIRMED the assumed chainstate "
+            "(genesis..h=%d matches the commitment) — fully validated",
+            manifest.base_height,
+        )
+
+    def _declare_fraud(self, reason: str) -> None:
+        """The health ladder: flight-record the fraud, persist the
+        marker (restart discards the assumed state and falls back to
+        full IBD), and escalate to safe mode so the fraudulent tip is
+        never served to producers or mutating RPC again."""
+        manifest = self.manifest
+        flight_recorder.record_event(
+            "snapshot_fraud_detected",
+            height=manifest.base_height if manifest else -1,
+            reason=reason)
+        try:
+            self.chainstate.metadata_db.put(_K_FRAUD, reason.encode())
+        except Exception:  # noqa: BLE001 — escalation still must run
+            pass
+        with self._lock:
+            self._set_state(STATE_FAILED)
+        log_print(LogFlags.NONE, "snapshot: FRAUD DETECTED: %s", reason)
+        from ..node.health import g_health
+
+        g_health.critical_error(
+            "snapshot.backvalidation", SnapshotError("snapshot-fraud", reason),
+            chainstate=self.chainstate)
+
+    # -- introspection -----------------------------------------------------
+
+    def info(self) -> dict:
+        """``getsnapshotinfo`` payload."""
+        with self._lock:
+            out: dict = {"state": STATE_NAMES[self.state]}
+            m = self.manifest
+            if m is None and self.fetcher is not None:
+                m = self.fetcher.manifest
+            if m is not None:
+                out["base_height"] = m.base_height
+                out["base_hash"] = u256_hex(m.base_hash)
+                out["snapshot_id"] = m.snapshot_id().hex()
+                out["coins"] = m.n_coins
+                out["chunks"] = m.n_chunks
+            if self.fetcher is not None:
+                out["download"] = {
+                    "chunks_have": len(self.fetcher.have),
+                    "chunks_total": (self.fetcher.manifest.n_chunks
+                                     if self.fetcher.manifest else 0),
+                    "in_flight": len(self.fetcher.inflight),
+                    "bad_providers": len(self.fetcher.bad_providers),
+                }
+            if self.state == STATE_ASSUMED and m is not None:
+                out["backvalidation"] = {
+                    "next_height": self._bv_next,
+                    "base_height": m.base_height,
+                    "progress": round(
+                        self._bv_next / max(1, m.base_height + 1), 4),
+                }
+            if self.serving is not None:
+                path, sm, _ = self.serving
+                out["serving"] = {
+                    "path": path,
+                    "base_height": sm.base_height,
+                    "chunks": sm.n_chunks,
+                    "snapshot_id": sm.snapshot_id().hex(),
+                }
+            return out
+
+
+def coins_digest(chainstate) -> bytes:
+    """Digest of the chainstate's CURRENT coins set at its tip — the
+    bit-exact round-trip check used by tests and bench: dump -> load ->
+    equal digests."""
+    with chainstate.cs_main:
+        chainstate.flush_state_to_disk()
+        tip = chainstate.tip()
+        d = _CoinsDigest(tip.height, tip.block_hash)
+        for k, v in chainstate.metadata_db.iterate(_COIN_PREFIX):
+            d.add_record(_pack_record(k[1:], v))
+        return d.digest()

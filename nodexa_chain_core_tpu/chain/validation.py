@@ -308,6 +308,14 @@ class ChainState:
             raw_ph = self._chainstate_db.get(b"prunedheight")
             if raw_ph:
                 self.pruned_height = int.from_bytes(raw_ph, "little", signed=True)
+            # snapshot bootstrap recovery BEFORE crash replay: heal an
+            # interrupted snapshot load (wipe the partial coins apply),
+            # discard a fraud-marked assumed chainstate (fall back to
+            # full IBD), or re-derive the assumed-tip index marks after
+            # a kill mid-activation (chain/snapshot.py)
+            from .snapshot import recover_on_load
+
+            recover_on_load(self)
             # deferred coin flushing means a crash can leave the coins DB
             # behind (or on a stale branch vs) the block index — heal it
             # before serving anything (ref ReplayBlocks, validation.cpp)
@@ -486,6 +494,14 @@ class ChainState:
             if check_level >= 2 and i.height > 0:
                 _, upos = self.positions.get(i.block_hash, (-1, -1))
                 if upos < 0:
+                    # assumed-snapshot region: block DATA can arrive
+                    # (for back-validation) before its undo journal is
+                    # reconstructed — everything at/below the assumed
+                    # base without undo is simply not yet verifiable,
+                    # like a pruned boundary, never corruption
+                    ab = getattr(self, "assumed_base_height", None)
+                    if ab is not None and i.height <= ab:
+                        break
                     raise BlockValidationError(
                         "verifydb-no-undo", u256_hex(i.block_hash)
                     )

@@ -8,6 +8,7 @@ import time
 from typing import List, Optional
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+from ..chain.snapshot import STATE_ASSUMED as _SNAPSHOT_ASSUMED
 from ..chain.validation import BlockValidationError
 from ..node.health import NodeCriticalError
 from ..core.serialize import ByteReader, ByteWriter
@@ -48,6 +49,11 @@ from .protocol import (
     MSG_REJECT,
     MSG_SENDHEADERS,
     MSG_SENDCMPCT,
+    MSG_SENDSNAP,
+    MSG_GETSNAPHDR,
+    MSG_SNAPHDR,
+    MSG_GETSNAPCHUNK,
+    MSG_SNAPCHUNK,
     MSG_SENDTRACECTX,
     MSG_TRACECTX,
     MSG_CMPCTBLOCK,
@@ -137,6 +143,11 @@ _M_CMPCT_RECON = g_metrics.counter(
     "Compact-block reconstruction outcomes, labeled by result "
     "(mempool|roundtrip|full_fallback)")
 
+# provider-side snapshot chunk budget: a peer draining chunks faster
+# than this is throttled (requests dropped, counted) — one bootstrapping
+# fleet must not monopolize the provider's disk bandwidth
+SNAPSHOT_CHUNKS_PER_S = 64.0
+
 
 class NetProcessor:
     """ref PeerLogicValidation (net_processing.cpp:2986)."""
@@ -181,6 +192,13 @@ class NetProcessor:
         self.first_seen_cap = _FIRST_SEEN_CAP
         self._remote_trace_ctx: dict = {}   # block_hash -> (trace_id, span)
         self._prop_spans: dict = {}         # block_hash -> TraceSpan
+        # -snapshotpeers: assumeUTXO snapshot transfer capability (serve
+        # AND fetch); the manager itself lives on node.snapshot_mgr
+        self.snapshot_peers = False
+        self.snapshot_chunks_per_s = SNAPSHOT_CHUNKS_PER_S
+        # test knob: a registered provider serves deliberately corrupted
+        # chunk payloads — the netsim lying-provider scenarios flip this
+        self._snapshot_test_corrupt = False
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -297,6 +315,11 @@ class NetProcessor:
             MSG_SENDCMPCT: self._on_sendcmpct,
             MSG_SENDTRACECTX: self._on_sendtracectx,
             MSG_TRACECTX: self._on_tracectx,
+            MSG_SENDSNAP: self._on_sendsnap,
+            MSG_GETSNAPHDR: self._on_getsnaphdr,
+            MSG_SNAPHDR: self._on_snaphdr,
+            MSG_GETSNAPCHUNK: self._on_getsnapchunk,
+            MSG_SNAPCHUNK: self._on_snapchunk,
             MSG_CMPCTBLOCK: self._on_cmpctblock,
             MSG_GETBLOCKTXN: self._on_getblocktxn,
             MSG_BLOCKTXN: self._on_blocktxn,
@@ -365,6 +388,13 @@ class NetProcessor:
             w = ByteWriter()
             w.u8(1)  # trace-context version 1
             peer.send_msg(self.magic, MSG_SENDTRACECTX, w.getvalue())
+        if self.snapshot_peers:
+            # same mutual-advertisement pattern for snapshot transfer:
+            # manifest/chunk traffic only ever flows between peers that
+            # BOTH advertised the capability
+            w = ByteWriter()
+            w.u8(1)  # snapshot-transfer version 1
+            peer.send_msg(self.magic, MSG_SENDSNAP, w.getvalue())
         self._start_sync(peer)
 
     def _start_sync(self, peer) -> None:
@@ -803,6 +833,139 @@ class NetProcessor:
             return
         self.note_remote_trace_ctx(block_hash, (trace_id, span_id))
 
+    # -- assumeUTXO snapshot transfer (-snapshotpeers; chain/snapshot.py
+    # owns the state, this is the wire surface) ---------------------------
+
+    def _snapshot_mgr(self):
+        return getattr(self.node, "snapshot_mgr", None)
+
+    def _on_sendsnap(self, peer, r: ByteReader) -> None:
+        # capability is mutual: mark the peer only when WE participate,
+        # so a -snapshotpeers=0 node never emits snapshot traffic
+        peer.snap_ok = self.snapshot_peers
+
+    def _on_getsnaphdr(self, peer, r: ByteReader) -> None:
+        mgr = self._snapshot_mgr()
+        if (mgr is None or not self.snapshot_peers
+                or not getattr(peer, "snap_ok", False)):
+            return
+        serving = mgr.serving
+        if serving is None:
+            return  # nothing to offer; the requester times out and moves on
+        _path, _manifest, raw = serving
+        peer.send_msg(self.magic, MSG_SNAPHDR, raw)
+
+    def _on_snaphdr(self, peer, r: ByteReader) -> None:
+        mgr = self._snapshot_mgr()
+        if (mgr is None or mgr.fetcher is None or not self.snapshot_peers
+                or not getattr(peer, "snap_ok", False)):
+            # the capability gate holds on the RECEIVE side too: an
+            # unsolicited manifest from a peer outside the handshake
+            # must never be adopted (it would pin the whole transfer
+            # to a commitment nobody honest serves)
+            return
+        raw = bytes(r.read(r.remaining()))
+        res = mgr.fetcher.ingest_manifest(raw)
+        if res == "bad":
+            self.misbehaving(peer, 10, "bad-snaphdr")
+            return
+        # "different" is NOT punishable: providers legitimately dump at
+        # different tips; the adopted transfer keeps its commitment
+        # activation needs the base header indexed: nudge the header
+        # sync along immediately instead of waiting for the periodic
+        m = mgr.fetcher.manifest
+        if m is not None and self.node.chainstate.lookup(m.base_hash) is None:
+            self._send_getheaders(peer)
+
+    def _snap_rate_ok(self, peer, now: float) -> bool:
+        """Provider-side token bucket, clock-driven (deterministic under
+        the netsim SimClock): ``snapshot_chunks_per_s`` refill, 2x
+        burst.  Over-budget requests are dropped and counted — never
+        scored (an aggressive bootstrapper is load, not malice)."""
+        rate = self.snapshot_chunks_per_s
+        burst = rate * 2.0
+        tokens, t_last = getattr(peer, "_snap_bucket", (burst, now))
+        tokens = min(burst, tokens + (now - t_last) * rate)
+        if tokens < 1.0:
+            peer._snap_bucket = (tokens, now)
+            return False
+        peer._snap_bucket = (tokens - 1.0, now)
+        return True
+
+    def _on_getsnapchunk(self, peer, r: ByteReader) -> None:
+        from ..chain import snapshot as snapshot_mod
+
+        mgr = self._snapshot_mgr()
+        if (mgr is None or not self.snapshot_peers
+                or not getattr(peer, "snap_ok", False)):
+            return
+        snap_id = bytes(r.read(32))
+        idx = r.u32()
+        serving = mgr.serving
+        if serving is None or serving[1].snapshot_id() != snap_id:
+            snapshot_mod._M_SERVED.inc(result="unknown")
+            return
+        if not self._snap_rate_ok(peer, self._clock()):
+            snapshot_mod._M_SERVED.inc(result="throttled")
+            return
+        path, manifest, _raw = serving
+        try:
+            payload = snapshot_mod.read_chunk(path, manifest, idx)
+        except snapshot_mod.SnapshotError as e:
+            log_print(LogFlags.NET, "snapshot: cannot serve chunk %d: %s",
+                      idx, e)
+            return
+        if self._snapshot_test_corrupt:
+            # netsim lying-provider knob: flip one byte mid-payload
+            flip = len(payload) // 2
+            payload = (payload[:flip]
+                       + bytes([payload[flip] ^ 0xFF])
+                       + payload[flip + 1:])
+        w = ByteWriter()
+        w.write(snap_id)
+        w.u32(idx)
+        w.var_bytes(payload)
+        peer.send_msg(self.magic, MSG_SNAPCHUNK, w.getvalue())
+        snapshot_mod._M_SERVED.inc(result="ok")
+
+    def _on_snapchunk(self, peer, r: ByteReader) -> None:
+        from ..chain import snapshot as snapshot_mod
+
+        mgr = self._snapshot_mgr()
+        if (mgr is None or not self.snapshot_peers
+                or not getattr(peer, "snap_ok", False)):
+            return
+        fetcher = mgr.fetcher
+        if fetcher is None or fetcher.manifest is None:
+            return
+        snap_id = bytes(r.read(32))
+        idx = r.u32()
+        payload = r.var_bytes()
+        if snap_id != fetcher.snapshot_id:
+            return
+        fetcher.inflight.pop(idx, None)
+        res = fetcher.ingest_chunk(idx, payload)
+        if res == "ok":
+            snapshot_mod._M_CHUNKS.inc(result="ok")
+        elif res == "bad":
+            # a lying provider is detected at the FIRST bad chunk:
+            # typed disconnect + ban; its other in-flight assignments
+            # release so the download resumes from the remaining
+            # providers without restarting
+            snapshot_mod._M_CHUNKS.inc(result="bad_hash")
+            fetcher.bad_providers.add(peer.id)
+            for i, (pid, _) in list(fetcher.inflight.items()):
+                if pid == peer.id:
+                    del fetcher.inflight[i]
+            peer.disconnect_reason = (peer.disconnect_reason
+                                      or "snapshot_fraud")
+            self.misbehaving(peer, 100, "snapshot-fraud")
+            self._disconnect_peer(peer, "snapshot_fraud")
+            log_print(LogFlags.NET,
+                      "snapshot: peer %d served a fraudulent chunk %d — "
+                      "disconnected, download continues from other "
+                      "providers", peer.id, idx)
+
     def propagation_stats(self) -> dict:
         """Propagation/trace bookkeeping snapshot for ``getnetstats``."""
         hist = _M_BLOCK_PROP.snapshot()
@@ -1014,6 +1177,21 @@ class NetProcessor:
         self._send_feefilters()
         self.check_stalls(now)
         self._check_tip_staleness(now)
+        # snapshot bootstrap drive: chunk requests/timeouts, historical
+        # block fetch below the base, and bounded back-validation steps
+        # (deterministic under the netsim SimClock — the manager never
+        # reads a wall clock of its own)
+        mgr = getattr(self.node, "snapshot_mgr", None)
+        if mgr is not None and (mgr.fetcher is not None
+                                or mgr.state == _SNAPSHOT_ASSUMED):
+            try:
+                mgr.periodic(self, now)
+            except Exception as e:  # noqa: BLE001 — the connman
+                # maintenance thread calls periodic() unguarded; a
+                # snapshot-drive bug must degrade the bootstrap, never
+                # kill pings/stall-detection for the process's life
+                log_print(LogFlags.NET,
+                          "snapshot periodic failed (contained): %r", e)
 
     # -- sync-stall hardening ----------------------------------------------
 

@@ -364,6 +364,20 @@ class SimNet:
                 have.add((j, i))
                 deg += 1
 
+    def enable_snapshots(self, chunk_timeout_s: float = 3.0,
+                         bv_blocks_per_tick: int = 4) -> None:
+        """Flip every node into -snapshotpeers mode with sim-seconds
+        snapshot tunables (chunk timeout, back-validation step budget).
+        Providers register a snapshot with
+        ``node.processor``'s manager (``node.node.snapshot_mgr``);
+        fetchers call ``start_fetch`` — see tests/test_snapshot.py for
+        the scenario runbook."""
+        for n in self.nodes:
+            n.processor.snapshot_peers = True
+            mgr = n.node.snapshot_mgr
+            mgr.chunk_timeout_s = chunk_timeout_s
+            mgr.bv_blocks_per_tick = bv_blocks_per_tick
+
     def partition(self, group_a) -> None:
         """Cut every link crossing the boundary between ``group_a`` and
         the rest.  In-flight events already queued still deliver (packets
@@ -513,7 +527,10 @@ class SimNet:
         """The _message_handler_loop postlude: ban on threshold, tear
         down flagged endpoints (and notify the remote side)."""
         for peer in node.connman.all_peers():
-            if peer.misbehavior >= 100 and not peer.disconnect:
+            # ban on threshold even if some handler already flagged the
+            # disconnect (e.g. snapshot fraud: typed reason + score),
+            # exactly like the real _message_handler_loop postlude
+            if peer.misbehavior >= 100:
                 node.connman.ban(peer.ip)
                 peer.disconnect_reason = (
                     peer.disconnect_reason or "misbehavior")

@@ -60,6 +60,16 @@ MSG_BLOCKTXN = "blocktxn"
 # with untraced peers is untouched.
 MSG_SENDTRACECTX = "sendtracectx"
 MSG_TRACECTX = "tracectx"
+# assumeUTXO snapshot transfer (-snapshotpeers): capability advertisement
+# after verack (same mutual-advertisement pattern as sendtracectx), then
+# manifest/chunk request-reply pairs.  Only ever exchanged between peers
+# that BOTH advertised the capability, so vanilla peers never see any of
+# these commands — wire compat with snapshot-less peers is untouched.
+MSG_SENDSNAP = "sendsnap"
+MSG_GETSNAPHDR = "getsnaphdr"
+MSG_SNAPHDR = "snaphdr"
+MSG_GETSNAPCHUNK = "getsnapchunk"
+MSG_SNAPCHUNK = "snapchunk"
 # asset wire messages (ref protocol.cpp:45-47: "getassetdata"/"assetdata"
 # but — reference quirk — the not-found reply really is "asstnotfound")
 MSG_GETASSETDATA = "getassetdata"

@@ -46,6 +46,12 @@ class NodeContext:
         self.rewards.attach(self.chainstate.assets, self.params)
         main_signals.register(self.message_store)
         main_signals.register(self.rewards)
+        # assumeUTXO snapshot lifecycle owner (chain/snapshot.py):
+        # restores a persisted assumed/validated state at construction;
+        # serving/fetching are armed by the daemon flags or RPC
+        from ..chain.snapshot import SnapshotManager
+
+        self.snapshot_mgr = SnapshotManager(self.chainstate)
         self.wallet = None  # attached by wallet/init when enabled
         self.connman = None  # attached by net layer when enabled
         self.rest_handler = None
@@ -74,6 +80,11 @@ class NodeContext:
         # miner/pool on its own thread; let it finish so the stop()s
         # below don't race it
         g_health.join_halt()
+        # halt snapshot back-validation + persist its watermark before
+        # the stores close (restart resumes instead of re-validating)
+        mgr = getattr(self, "snapshot_mgr", None)
+        if mgr is not None:
+            mgr.stop()
         self.scheduler.stop()
         miner = getattr(self, "background_miner", None)
         if miner is not None:

@@ -208,6 +208,55 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     if g_args.is_set("assumevalid"):
         node.chainstate.assume_valid_hash = int(g_args.get("assumevalid"), 16)
 
+    # assumeUTXO snapshots (chain/snapshot.py; README "Instant
+    # bootstrap").  -makesnapshot dumps + serves the current tip's UTXO
+    # set; -loadsnapshot=<path> activates a snapshot file at boot (the
+    # base header must already be indexed); -loadsnapshot=p2p arms the
+    # chunked download from -snapshotpeers-capable peers.
+    from ..chain.snapshot import (
+        STATE_ASSUMED,
+        STATE_VALIDATED,
+        SnapshotError,
+    )
+
+    snap_mgr = node.snapshot_mgr
+    if g_args.is_set("makesnapshot"):
+        target = g_args.get("makesnapshot")
+        if target in ("", "1", "auto"):
+            tip = node.chainstate.tip()
+            target = os.path.join(
+                datadir, "snapshots", f"utxo-{tip.height}.dat")
+        try:
+            manifest = snap_mgr.make_snapshot(target)
+        except (SnapshotError, OSError) as e:
+            raise SystemExit(f"Error: -makesnapshot: {e}")
+        log_printf("-makesnapshot: %s (base h=%d, %d chunks) — serving to "
+                   "-snapshotpeers peers", target, manifest.base_height,
+                   manifest.n_chunks)
+    if g_args.is_set("loadsnapshot"):
+        spec = g_args.get("loadsnapshot")
+        if snap_mgr.state in (STATE_ASSUMED, STATE_VALIDATED):
+            # restart with the flag still in the conf: the snapshot is
+            # already active — nothing to do.  Checked BEFORE the p2p
+            # branch: re-arming the fetcher on an already-assumed node
+            # would leave it forever undriven (periodic only drives it
+            # in the loading state) yet still ingesting manifests
+            log_printf("-loadsnapshot: snapshot already %s; skipping",
+                       "assumed" if snap_mgr.state == STATE_ASSUMED
+                       else "validated")
+        elif spec == "p2p":
+            snap_mgr.start_fetch(
+                os.path.join(datadir, "snapshots", "incoming"))
+            log_printf("-loadsnapshot=p2p: snapshot download armed "
+                       "(requires -snapshotpeers providers)")
+        else:
+            try:
+                manifest = snap_mgr.load_file(spec)
+                log_printf("-loadsnapshot: assumed tip h=%d activated from "
+                           "%s", manifest.base_height, spec)
+            except (SnapshotError, OSError) as e:
+                raise SystemExit(f"Error: -loadsnapshot: {e}")
+
     # Step 7b: CVerifyDB-style startup sanity sweep (ref validation.cpp:
     # 12564).  A failure is a refusal to start: serving (or extending) a
     # chain whose recent blocks don't round-trip corrupts further — the
@@ -487,6 +536,11 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         # propagation-tracking maps (evictions are counted on
         # nodexa_propagation_map_evictions_total)
         node.connman.processor.trace_peers = g_args.get_bool("tracepeers")
+        # -snapshotpeers: assumeUTXO snapshot transfer capability (serve
+        # a -makesnapshot dump AND fetch under -loadsnapshot=p2p); the
+        # commands are capability-gated, so vanilla peers never see them
+        node.connman.processor.snapshot_peers = g_args.get_bool(
+            "snapshotpeers")
         if g_args.is_set("propmapsize"):
             # explicit-flag typo discipline (same as -faultinject /
             # -calibrationfile): a set flag with a bad value — including
@@ -560,6 +614,15 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
                 start_difficulty=g_args.get_int("pooldiff", 1),
                 max_connections=g_args.get_int("poolmaxconn", 256),
             )
+
+    # snapshot back-validation worker: while the node serves from an
+    # assumed tip, history is re-validated from genesis toward the base
+    # on a dedicated thread (bounded steps under cs_main); reaching the
+    # base either confirms the commitment (state: validated) or fires
+    # the fraud ladder (safe mode + discard on the next restart).  A
+    # runtime `loadtxoutset` spawns the same worker from the RPC.
+    if snap_mgr.state == STATE_ASSUMED or snap_mgr.fetcher is not None:
+        snap_mgr.ensure_backvalidation_thread()
 
     # -gen/-genproclimit: built-in miner (ref GenerateClores at init)
     if g_args.get_bool("gen") and getattr(node, "wallet", None) is not None:

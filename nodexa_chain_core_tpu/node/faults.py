@@ -77,6 +77,17 @@ KNOWN_SITES: Dict[str, dict] = {
     "net.peer_send":        {"ibd": False, "help": "p2p peer socket send"},
     "net.peer_recv":        {"ibd": False, "help": "p2p peer socket recv"},
     "net.connect":          {"ibd": False, "help": "outbound p2p connect"},
+    # snapshot (assumeUTXO-style bootstrap) sites; not flagged ibd — the
+    # PR 5 IBD crash matrix is unchanged, the snapshot matrix in
+    # tests/test_snapshot.py iterates exactly these four instead.
+    "snapshot.write":       {"ibd": False, "help": "snapshot dump chunk / "
+                             "back-validation watermark write"},
+    "snapshot.read":        {"ibd": False, "help": "snapshot chunk read "
+                             "(load + p2p serving)"},
+    "snapshot.chunk_recv":  {"ibd": False, "help": "downloaded snapshot "
+                             "chunk / manifest persist"},
+    "snapshot.activate":    {"ibd": False, "help": "snapshot coins-DB "
+                             "apply + activation commit"},
 }
 
 KILL_EXIT_CODE = 137  # what a SIGKILLed process reports; greppable in CI

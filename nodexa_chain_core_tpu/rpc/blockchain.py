@@ -575,6 +575,76 @@ def preciousblock(node, params: List[Any]):
     return None
 
 
+def dumptxoutset(node, params: List[Any]):
+    """Serialize the full UTXO set at the current tip into a
+    hash-committed snapshot file and register it for -snapshotpeers
+    serving (the assumeUTXO dumptxoutset analogue)."""
+    import os
+
+    from ..chain.snapshot import SnapshotError
+
+    if not params or not str(params[0]):
+        raise RPCError(RPC_INVALID_PARAMETER, "path required")
+    path = str(params[0])
+    mgr = getattr(node, "snapshot_mgr", None)
+    if mgr is None:
+        raise RPCError(RPC_MISC_ERROR, "snapshot manager unavailable")
+    try:
+        manifest = mgr.make_snapshot(path)
+    except (SnapshotError, OSError) as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    return {
+        "path": os.path.abspath(path),
+        "base_height": manifest.base_height,
+        "base_hash": u256_hex(manifest.base_hash),
+        "coins": manifest.n_coins,
+        "nchunks": manifest.n_chunks,
+        "snapshot_id": manifest.snapshot_id().hex(),
+    }
+
+
+def loadtxoutset(node, params: List[Any]):
+    """Load + activate a UTXO snapshot file: the node starts serving
+    from the assumed base within seconds and back-validates history in
+    the background (the assumeUTXO loadtxoutset analogue).  The base
+    block's header must already be in the index."""
+    from ..chain.snapshot import SnapshotError
+
+    if not params or not str(params[0]):
+        raise RPCError(RPC_INVALID_PARAMETER, "path required")
+    mgr = getattr(node, "snapshot_mgr", None)
+    if mgr is None:
+        raise RPCError(RPC_MISC_ERROR, "snapshot manager unavailable")
+    try:
+        manifest = mgr.load_file(str(params[0]))
+    except SnapshotError as e:
+        raise RPCError(RPC_INVALID_PARAMETER, str(e))
+    except OSError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e))
+    # a runtime load needs its own back-validation worker: the daemon
+    # only spawns one at boot when -loadsnapshot was set, and a
+    # -nolisten node has no maintenance tick to lean on at all
+    mgr.ensure_backvalidation_thread()
+    return {
+        "base_height": manifest.base_height,
+        "base_hash": u256_hex(manifest.base_hash),
+        "coins": manifest.n_coins,
+        "snapshot_id": manifest.snapshot_id().hex(),
+        "state": mgr.info()["state"],
+    }
+
+
+def getsnapshotinfo(node, params: List[Any]):
+    """Snapshot bootstrap state: none/loading/assumed/validated/failed,
+    download + back-validation progress, and the serving registration.
+    Safe-mode readable (rpc/safemode.py READONLY_DIAGNOSTIC_COMMANDS) —
+    a fraud-tripped node is exactly when the operator needs this."""
+    mgr = getattr(node, "snapshot_mgr", None)
+    if mgr is None:
+        return {"state": "none"}
+    return mgr.info()
+
+
 def register(table: RPCTable) -> None:
     for name, fn, args in [
         ("getblockcount", getblockcount, []),
@@ -601,5 +671,8 @@ def register(table: RPCTable) -> None:
         ("invalidateblock", invalidateblock, ["blockhash"]),
         ("reconsiderblock", reconsiderblock, ["blockhash"]),
         ("preciousblock", preciousblock, ["blockhash"]),
+        ("dumptxoutset", dumptxoutset, ["path"]),
+        ("loadtxoutset", loadtxoutset, ["path"]),
+        ("getsnapshotinfo", getsnapshotinfo, []),
     ]:
         table.register("blockchain", name, fn, args)

@@ -65,6 +65,10 @@ MUTATING_COMMANDS = frozenset({
     "sendmessage", "subscribetochannel", "unsubscribefromchannel",
     "clearmessages", "requestsnapshot", "cancelsnapshotrequest",
     "purgesnapshot",
+    # assumeUTXO bootstrap: loading a snapshot rewrites the whole coins
+    # DB — a node that can no longer persist state must refuse it
+    # (dumptxoutset stays allowed: exporting is how you evacuate)
+    "loadtxoutset",
 })
 
 
@@ -76,8 +80,8 @@ MUTATING_COMMANDS = frozenset({
 # before any health-layer consultation.
 READONLY_DIAGNOSTIC_COMMANDS = frozenset({
     "getmetrics", "getprofile", "gettrace", "dumpflightrecorder",
-    "getstartupinfo", "getnodehealth", "getnetstats", "help", "uptime",
-    "stop",
+    "getstartupinfo", "getnodehealth", "getnetstats", "getsnapshotinfo",
+    "help", "uptime", "stop",
 })
 
 assert not (READONLY_DIAGNOSTIC_COMMANDS & MUTATING_COMMANDS), (

@@ -53,6 +53,15 @@ REFERENCE_COMMANDS = {
 EXTENSION_COMMANDS = {
     "MSG_SENDTRACECTX": "sendtracectx",
     "MSG_TRACECTX": "tracectx",
+    # assumeUTXO snapshot transfer (-snapshotpeers, README "Instant
+    # bootstrap"): sendsnap is the mutual capability advertisement;
+    # manifest/chunk request-reply pairs only ever flow between peers
+    # that BOTH advertised it — vanilla peers never see any of these.
+    "MSG_SENDSNAP": "sendsnap",
+    "MSG_GETSNAPHDR": "getsnaphdr",
+    "MSG_SNAPHDR": "snaphdr",
+    "MSG_GETSNAPCHUNK": "getsnapchunk",
+    "MSG_SNAPCHUNK": "snapchunk",
 }
 
 

@@ -57,6 +57,12 @@ EXPECTED_EXTRAS = {
     "getnodehealth",
     # stratum work-server subsystem (pool/)
     "getpoolinfo",
+    # assumeUTXO snapshot bootstrap (chain/snapshot.py): dump/load the
+    # hash-committed UTXO snapshot + the bootstrap state surface
+    # (getsnapshotinfo is safe-mode readable via
+    # rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS; loadtxoutset is in
+    # MUTATING_COMMANDS)
+    "dumptxoutset", "loadtxoutset", "getsnapshotinfo",
 }
 
 

@@ -75,25 +75,32 @@
 #                      stage-sum reconciliation error), digest replay
 #                      equality with tracing on/off, and the tracing-off
 #                      wire-throughput pin (>= 0.95x lean baseline)
-#  15. vectors         generate_x16r_vectors.py --check — the committed
+#  15. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
+#                      instant bootstrap: snapshot load-to-tip >= 10x
+#                      faster than replaying the same blocks, bit-exact
+#                      coins digest, and the lying-provider netsim smoke
+#                      (liar caught at the first bad chunk, typed
+#                      disconnect, zero honest bans, digest replay
+#                      equality with transfer enabled)
+#  16. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  16. native build    compiles the C++ engine (also feeds the wheel)
-#  17. static checks   tools/typecheck.py over the consensus-critical
+#  17. native build    compiles the C++ engine (also feeds the wheel)
+#  18. static checks   tools/typecheck.py over the consensus-critical
 #                      packages (undefined names, module attrs, arity)
-#  18. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  19. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  19. pytest          unit suite (functional suite with --full)
-#  20. wheel           platform-tagged wheel incl. the native .so,
+#  20. pytest          unit suite (functional suite with --full)
+#  21. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/20] lint"
+echo "== [1/21] lint"
 python tools/lint.py
 
-echo "== [2/20] import graph"
+echo "== [2/21] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -111,13 +118,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/20] rpc mapping parity"
+echo "== [3/21] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/20] telemetry exposition"
+echo "== [4/21] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/20] IBD fast path (synthetic)"
+echo "== [5/21] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -129,7 +136,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/20] pool stratum e2e (loopback)"
+echo "== [6/21] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -140,7 +147,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/20] mesh serving backend (forced 8-device mesh)"
+echo "== [7/21] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -151,7 +158,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [8/20] tx admission fast path (flood)"
+echo "== [8/21] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -162,7 +169,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [9/20] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [9/21] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -173,7 +180,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [10/20] observability (flight recorder + startup attribution)"
+echo "== [10/21] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -188,7 +195,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [11/20] cold start (AOT executable cache + shape discipline)"
+echo "== [11/21] cold start (AOT executable cache + shape discipline)"
 # cold + warm restart children against ONE cache dir: the warm child
 # must strictly beat the cold one (the BENCH_r05 64.5s-warm-vs-54.4s-
 # cold inversion is the regression this stage exists to catch), stay
@@ -203,7 +210,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --assert-warm \
 fi
 tail -2 "$CS_LOG"; rm -f "$CS_LOG"
 
-echo "== [12/20] utilization + profiler (live roofline attribution)"
+echo "== [12/21] utilization + profiler (live roofline attribution)"
 # a loopback serving rig with the sampling profiler at the daemon
 # default (25 Hz): getprofile must round-trip >= 4 thread roles with
 # samples, pool shares/s with the profiler ON must stay >= 0.95x OFF
@@ -216,7 +223,7 @@ if ! python tools/profile_check.py > "$PC_LOG" 2>&1; then
 fi
 tail -2 "$PC_LOG"; rm -f "$PC_LOG"
 
-echo "== [13/20] netsim smoke (multi-node adversarial scenarios)"
+echo "== [13/21] netsim smoke (multi-node adversarial scenarios)"
 # deterministic in-process 5-node partition-and-heal (must converge all
 # nodes to ONE tip with zero honest bans), a digest-pinned determinism
 # replay, and a stalling-peer IBD run asserting the black-hole peer is
@@ -229,7 +236,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
 fi
 tail -6 "$NS_LOG"; rm -f "$NS_LOG"
 
-echo "== [14/20] net observability (cross-node trace smoke)"
+echo "== [14/21] net observability (cross-node trace smoke)"
 # the wire extension of the PR 8/11 kill-switch contract: an N=5 chain
 # topology must assemble >=1 cluster-wide block-propagation trace
 # spanning >=3 hops with every per-hop stage finite and the stage sum
@@ -245,23 +252,39 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --trace-smoke \
 fi
 tail -6 "$NO_LOG"; rm -f "$NO_LOG"
 
-echo "== [15/20] crypto vector regeneration"
+echo "== [15/21] snapshot bootstrap (assumeUTXO + lying provider)"
+# instant bootstrap must actually be instant: snapshot load-to-tip at
+# least 10x faster than replaying the same blocks via process_new_block,
+# bit-exact coins digest asserted, and the adversarial netsim smoke — a
+# fresh node bootstrapping from a mixed honest/lying provider set
+# converges to the honest tip, catches the liar at its FIRST bad chunk
+# (typed disconnect, zero honest-peer bans), back-validates to
+# `validated`, and replays digest-equal (same no-pipe discipline)
+SNAP_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast \
+        > "$SNAP_LOG" 2>&1; then
+    cat "$SNAP_LOG"; rm -f "$SNAP_LOG"
+    exit 1
+fi
+tail -12 "$SNAP_LOG"; rm -f "$SNAP_LOG"
+
+echo "== [16/21] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [16/20] native engine build"
+echo "== [17/21] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [17/20] static checks (consensus-critical packages)"
+echo "== [18/21] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [18/20] native hardening (security-check analog)"
+echo "== [19/21] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [19/20] pytest"
+echo "== [20/21] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -273,7 +296,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [20/20] wheel"
+echo "== [21/21] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -284,6 +284,33 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
     else:
         lines.append("  relay: -")
 
+    # snapshot bootstrap: state machine, back-validation progress, and
+    # the downloader's chunk verdicts (family absent until a node dumps,
+    # loads, or fetches a snapshot: render '-')
+    if have(snap, "nodexa_snapshot_state"):
+        snap_state = int(series_total(snap, "nodexa_snapshot_state"))
+        state_name = {0: "none", 1: "loading", 2: "assumed",
+                      3: "validated", 4: "failed"}.get(snap_state, "?")
+        bv_h = int(series_total(snap, "nodexa_backvalidation_height"))
+        chunks = by_label(snap, "nodexa_snapshot_chunks_total", "result")
+        served = by_label(snap, "nodexa_snapshot_chunks_served_total",
+                          "result")
+        chunk_line = " ".join(
+            f"{k}={int(v)}" for k, v in sorted(chunks.items()) if v
+        ) or "none"
+        bad = int(chunks.get("bad_hash", 0))
+        state_col = (RED if snap_state == 4
+                     else YELLOW if snap_state == 2 else "")
+        warn = f"  {RED}bad_hash={bad}{RESET}" if bad else ""
+        lines.append(
+            f"  snap: state={state_col}{state_name}{RESET if state_col else ''} "
+            f"backval h={bv_h}   chunks [{chunk_line}] "
+            f"({rate('nodexa_snapshot_chunks_total', result='ok')})   "
+            f"served ok={int(served.get('ok', 0))} "
+            f"throttled={int(served.get('throttled', 0))}{warn}")
+    else:
+        lines.append("  snap: -")
+
     # mempool: outcomes + the off-lock proof pair
     accepts = by_label(snap, "nodexa_mempool_accepts_total", "result")
     _, smean, _ = hist_stats(
