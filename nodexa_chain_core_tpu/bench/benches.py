@@ -162,6 +162,7 @@ def bench_coins(state=None):
 def bench_mempool_trim(state=None):
     from ..chain.mempool import MempoolEntry, TxMemPool
     from ..primitives.transaction import OutPoint, Transaction, TxIn, TxOut
+    from ..utils.sync import DebugLock
 
     if state is None:
         txs = []
@@ -175,9 +176,13 @@ def bench_mempool_trim(state=None):
             )
         return txs
     pool = TxMemPool()
-    for i, tx in enumerate(state):
-        pool.add(MempoolEntry(tx=tx, fee=1000 + i, time=i, height=1))
-    pool.trim_to_size(pool.total_size_bytes() // 2)
+    # standalone pool: hold a cs_main-role lock the way every production
+    # trim/add caller does (keeps the bench honest under -debuglockorder)
+    cs_main = DebugLock("cs_main")
+    with cs_main:
+        for i, tx in enumerate(state):
+            pool.add(MempoolEntry(tx=tx, fee=1000 + i, time=i, height=1))
+        pool.trim_to_size(pool.total_size_bytes() // 2)
     return state
 
 

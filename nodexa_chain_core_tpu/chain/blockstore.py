@@ -22,6 +22,7 @@ from ..primitives.block import AlgoSchedule, Block
 from ..telemetry import g_metrics
 from ..utils.logging import log_printf
 from .coins import Coin
+from ..utils.sync import DebugLock, requires_lock
 
 # read-ahead misses force the connect loop back onto a synchronous read:
 # the reason label separates real worker errors from consumer-side
@@ -207,7 +208,7 @@ class ChunkedRecordFile:
         # one lock serializes handle-cache mutation AND record IO: peers,
         # RPC threads and the wallet all read concurrently, and the LRU
         # close below must never yank a file out from under a reader
-        self._lock = threading.RLock()
+        self._lock = DebugLock("blockstore")
         nums = self.chunk_numbers()
         self._tail = nums[-1] if nums else 0
 
@@ -224,6 +225,7 @@ class ChunkedRecordFile:
                     out.append(int(mid))
         return sorted(out)
 
+    @requires_lock("blockstore")
     def _file(self, n: int) -> AppendFile:
         f = self._files.pop(n, None)
         if f is None:

@@ -40,13 +40,13 @@ from __future__ import annotations
 import os
 import re
 import struct
-import threading
 import time as _time
 import zlib
 from bisect import bisect_right
 from collections import OrderedDict
 from heapq import merge as _heap_merge
 from typing import Dict, Iterator, List, Optional, Tuple
+from ..utils.sync import DebugLock, requires_lock
 
 _MAGIC_V1 = b"NXKV"  # r3 full-table snapshot (read-supported for upgrade)
 _MAGIC_V2 = b"NXK2"  # r4 block-structured snapshot (read-supported)
@@ -157,7 +157,7 @@ class _Table:
         # OrderedDict for O(1) LRU touch under the lock
         self._cache: "OrderedDict[int, list]" = OrderedDict()
         self._cache_blocks = cache_blocks
-        self._cache_lock = threading.Lock()
+        self._cache_lock = DebugLock("kvstore.cache", reentrant=False)
         if os.path.exists(path):
             self._open()
 
@@ -340,7 +340,7 @@ class KVStore:
         self._log = None
         self._log_size = 0
         self._compact_threshold = compact_threshold
-        self._write_lock = threading.RLock()
+        self._write_lock = DebugLock("kvstore.write")
         self._seg_counter = 0
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -595,11 +595,13 @@ class KVStore:
                     (_Table(path, _SEG_CACHE_BLOCKS),) + tables, {})
             self._reset_wal()
 
+    @requires_lock("kvstore.write")
     def _reset_wal(self) -> None:
         self._log.close()
         self._log = open(self._log_path, "wb")
         self._log_size = 0
 
+    @requires_lock("kvstore.write")
     def _maybe_major(self) -> None:
         """Run a major compaction when L0 outgrows the policy bounds."""
         tables = self._state[0]

@@ -10,7 +10,6 @@ index maintenance).
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set
@@ -19,6 +18,7 @@ from ..consensus.tx_verify import get_legacy_sigop_count
 from ..primitives.transaction import OutPoint, Transaction
 from .policy import DEFAULT_MIN_RELAY_TX_FEE as _INCREMENTAL_RELAY_FEERATE
 from .coins import Coin, CoinsView, CoinsViewBacked, CoinsViewCache
+from ..utils.sync import DebugLock, requires_lock
 
 DEFAULT_ANCESTOR_LIMIT = 25
 DEFAULT_DESCENDANT_LIMIT = 25
@@ -93,7 +93,7 @@ class TxMemPool:
         # one reference, so one twin's reject can't strip the claim out
         # from under the other mid-scripts.
         self._reserved: Dict[OutPoint, List] = {}  # outpoint -> [txid, refs]
-        self._reserved_lock = threading.Lock()
+        self._reserved_lock = DebugLock("mempool.reserved", reentrant=False)
         # bumped on every entry removal (replacement, eviction, expiry,
         # block): the staged admission commit re-runs its context checks
         # when this moved, because a removal can take an in-pool parent
@@ -132,6 +132,7 @@ class TxMemPool:
 
     # -- in-flight outpoint reservations (staged admission) ----------------
 
+    @requires_lock("cs_main")
     def reserve_outpoints(self, tx: Transaction) -> bool:
         """Claim tx's inputs against concurrent in-flight admissions.
 
@@ -263,6 +264,7 @@ class TxMemPool:
                 ae.size_with_descendants -= e.size
                 ae.fees_with_descendants -= e.fee
 
+    @requires_lock("cs_main")
     def remove_for_block(self, vtx: List[Transaction]) -> None:
         """ref removeForBlock: drop included + conflicted txs."""
         for tx in vtx:
@@ -272,10 +274,12 @@ class TxMemPool:
                 if conflict is not None and conflict != tx.txid:
                     self.remove(conflict, "conflict")
 
+    @requires_lock("cs_main")
     def add_disconnected_txs(self, vtx: List[Transaction]) -> None:
         """Queue reorged-out txs for resubmission (ref DisconnectedBlockTransactions)."""
         self._disconnected.extend(t for t in vtx if not t.is_coinbase())
 
+    @requires_lock("cs_main")
     def take_disconnected(self) -> List[Transaction]:
         out, self._disconnected = self._disconnected, []
         return out
@@ -308,6 +312,7 @@ class TxMemPool:
             key=lambda e: e.fees_with_descendants / max(e.size_with_descendants, 1),
         )
 
+    @requires_lock("cs_main")
     def trim_to_size(self, max_bytes: int) -> List[int]:
         """Evict lowest descendant-score packages (ref TrimToSize); each
         eviction raises the rolling minimum feerate new entries must

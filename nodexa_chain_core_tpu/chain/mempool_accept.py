@@ -27,7 +27,6 @@ full script verification with STANDARD flags -> asset rules -> pool insert.
 
 from __future__ import annotations
 
-import threading
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Set
@@ -57,6 +56,7 @@ from .coins import Coin, CoinsViewCache
 from .mempool import CoinsViewMemPool, MempoolEntry, TxMemPool
 from .policy import MAX_STANDARD_TX_SIGOPS_COST, MIN_RELAY_FEE, is_standard_tx
 from .validation import ChainState
+from ..utils.sync import DebugLock, excludes_lock, requires_lock
 
 
 class MempoolAcceptError(TxValidationError):
@@ -214,6 +214,7 @@ def _stateless_checks(
     return size
 
 
+@requires_lock("cs_main")
 def _context_checks(
     chainstate: ChainState,
     pool: TxMemPool,
@@ -386,10 +387,11 @@ def _script_checks_inline(tx: Transaction, ctx: _AdmissionContext) -> None:
 
 # concurrent stage-3 admissions currently verifying scripts: steers the
 # fan-out decision below (own lock — read/written outside cs_main)
-_script_stage_lock = threading.Lock()
+_script_stage_lock = DebugLock("mempool.script_stage", reentrant=False)
 _script_stages_active = 0
 
 
+@excludes_lock("cs_main")
 def _script_checks_parallel(
     chainstate: ChainState, tx: Transaction, ctx: _AdmissionContext
 ) -> None:
@@ -476,6 +478,7 @@ def _script_checks_parallel(
         raise MempoolAcceptError("mandatory-script-verify-flag-failed", err)
 
 
+@requires_lock("cs_main")
 def _commit_locked(
     chainstate: ChainState,
     pool: TxMemPool,
@@ -550,6 +553,7 @@ def _commit_locked(
 # ---------------------------------------------------------------- the paths
 
 
+@requires_lock("cs_main")
 def _accept_inline_locked(
     chainstate: ChainState,
     pool: TxMemPool,
@@ -680,10 +684,19 @@ def load_mempool(chainstate: ChainState, pool: TxMemPool, path: str) -> int:
     return count
 
 
+@requires_lock("cs_main")
 def resubmit_disconnected(chainstate: ChainState, pool: TxMemPool) -> None:
-    """After a reorg, try to re-add disconnected txs (ref UpdateMempoolForReorg)."""
+    """After a reorg, try to re-add disconnected txs (ref UpdateMempoolForReorg).
+
+    Runs INSIDE the reorg's cs_main hold, so the staged pipeline would
+    verify scripts with the lock still held — exactly what its
+    @excludes_lock("cs_main") contract forbids (the runtime annotation
+    check caught this path running staged).  The inline path is the
+    correct shape here: one hold already exists, there is nothing to
+    overlap with."""
     for tx in pool.take_disconnected():
         try:
-            accept_to_memory_pool(chainstate, pool, tx, bypass_limits=True)
+            accept_to_memory_pool(chainstate, pool, tx, bypass_limits=True,
+                                  staged=False)
         except TxValidationError:
             pass

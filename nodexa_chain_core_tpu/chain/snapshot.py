@@ -75,6 +75,7 @@ from ..telemetry import flight_recorder, g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from .coins import CoinsViewCache, CoinsViewDB
 from .kvstore import WriteBatch
+from ..utils.sync import DebugLock, requires_lock
 
 SNAPSHOT_MAGIC = b"NXSNAP01"
 DEFAULT_CHUNK_BYTES = 256 * 1024
@@ -372,6 +373,7 @@ def read_chunk(path: str, manifest: SnapshotManifest, idx: int) -> bytes:
 # ------------------------------------------------------- crash recovery
 
 
+@requires_lock("cs_main")
 def recover_on_load(chainstate) -> bool:
     """Heal an interrupted snapshot load or discard a fraudulent assumed
     chainstate — called from ``ChainState._load_or_init`` BEFORE crash
@@ -448,6 +450,7 @@ def recover_on_load(chainstate) -> bool:
     return True
 
 
+@requires_lock("cs_main")
 def _mark_assumed_chain(chainstate, base_idx) -> None:
     """Shared by activation and its crash-recovery twin: raise every
     genesis..base ancestor to VALID_SCRIPTS (pruned-chain semantics) and
@@ -473,6 +476,7 @@ def _mark_assumed_chain(chainstate, base_idx) -> None:
         chainstate._dirty_index.add(idx)
 
 
+@requires_lock("cs_main")
 def _restore_assumed_marks(chainstate) -> bool:
     """Idempotent restore of the activation's index marks + tip from the
     persisted assumed manifest.  The activation BATCH is the single
@@ -680,7 +684,7 @@ class SnapshotManager:
 
     def __init__(self, chainstate):
         self.chainstate = chainstate
-        self._lock = threading.RLock()
+        self._lock = DebugLock("snapshot")
         self.state = STATE_NONE
         self.manifest: Optional[SnapshotManifest] = None
         self.serving: Optional[Tuple[str, SnapshotManifest, bytes]] = None
@@ -878,6 +882,7 @@ class SnapshotManager:
                     self._set_state(STATE_FAILED)
                 raise
 
+    @requires_lock("cs_main")
     def _heal_failed_load(self) -> None:
         """In-process twin of :func:`recover_on_load`: an exception after
         the loading marker went down leaves the coins DB poisoned — wipe
@@ -905,6 +910,7 @@ class SnapshotManager:
             log_printf("snapshot: in-process load heal incomplete (%r); "
                        "restart recovery will finish it", e)
 
+    @requires_lock("cs_main")
     def _check_base(self, manifest: SnapshotManifest) -> None:
         """Activation preconditions — raised as typed SnapshotError so a
         base-block reorg mid-load refuses activation instead of serving
@@ -946,6 +952,7 @@ class SnapshotManager:
                 "snapshot-base-reorged",
                 "best known header chain no longer contains the base")
 
+    @requires_lock("cs_main")
     def _activate(self, manifest: SnapshotManifest) -> None:
         """The single commit point: flip the coins best-block to the
         base, adopt the asset snapshot, record the assumed manifest, and
@@ -1262,6 +1269,7 @@ class SnapshotManager:
         view.set_best_block(idx.block_hash)
         return undo
 
+    @requires_lock("cs_main")
     def _flush_bv(self) -> None:
         """Persist scratch coins + the watermark in ONE batch so a kill
         between them is impossible — the crash-resume regression test
@@ -1279,6 +1287,7 @@ class SnapshotManager:
         self._bv_cache.sync()
         self._bv_since_flush = 0
 
+    @requires_lock("cs_main")
     def _finish_bv(self) -> None:
         manifest = self.manifest
         db = self.chainstate.metadata_db
@@ -1318,6 +1327,7 @@ class SnapshotManager:
             manifest.base_height,
         )
 
+    @requires_lock("cs_main")
     def _declare_fraud(self, reason: str) -> None:
         """The health ladder: flight-record the fraud, persist the
         marker (restart discards the assumed state and falls back to

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Set, Tuple
@@ -60,6 +59,7 @@ from ..script.script import Script
 from ..telemetry import g_metrics, span, tracing
 from ..telemetry.tracing import trace_span
 from ..utils.logging import LogFlags, log_print
+from ..utils.sync import DebugLock, requires_lock
 from .blockindex import BlockIndex, BlockStatus, Chain
 from .blockstore import (
     BlockReadAhead,
@@ -158,8 +158,11 @@ class ChainState:
         self.dbcache_bytes = dbcache_bytes
         self.coins_flush_interval_s = coins_flush_interval_s
         self._last_coins_write = time.monotonic()
-        # ref sync.h cs_main: one recursive lock over chainstate mutation
-        self.cs_main = threading.RLock()
+        # ref sync.h cs_main: one recursive lock over chainstate mutation.
+        # A named DebugLock: under -debuglockorder (tests arm it by
+        # default) every acquisition participates in lock-order cycle
+        # detection against the declared partial order in utils/sync.py
+        self.cs_main = DebugLock("cs_main")
         # bumped on every tip move (connect AND disconnect) under cs_main:
         # the staged mempool admission snapshots it, verifies scripts off
         # the lock, and re-runs its cheap context checks at commit iff the
@@ -251,6 +254,7 @@ class ChainState:
 
     # ------------------------------------------------------------------ init
 
+    @_with_cs_main
     def _load_or_init(self) -> None:
         """ref init.cpp Step 7 LoadBlockIndexDB / genesis bootstrap."""
         loaded = self.blocktree.load_index()
@@ -349,6 +353,7 @@ class ChainState:
 
     # ----------------------------------------------- crash-replay on load
 
+    @requires_lock("cs_main")
     def _roll_forward_block(
         self, block: Block, idx: BlockIndex, view: CoinsViewCache
     ) -> None:
@@ -378,6 +383,7 @@ class ChainState:
             view.add_tx_outputs(tx, idx.height)
         view.set_best_block(idx.block_hash)
 
+    @requires_lock("cs_main")
     def _replay_blocks(self) -> int:
         """Roll the persisted coins view forward (and, after a crash
         mid-reorg, first backward via the undo journal) to the block-index
@@ -579,6 +585,7 @@ class ChainState:
         sched = self.params.algo_schedule
         from ..core.serialize import ByteReader as _BR
 
+        @requires_lock("cs_main")
         def _install(block: Block, pos: int) -> None:
             nonlocal count
             h = block.get_hash(sched)
@@ -863,6 +870,7 @@ class ChainState:
         ):
             raise BlockValidationError("high-hash", "proof of work failed")
 
+    @requires_lock("cs_main")
     def contextual_check_block_header(
         self, header: BlockHeader, prev: BlockIndex, adjusted_time: int
     ) -> None:
@@ -916,6 +924,7 @@ class ChainState:
         if sigops * 4 > MAX_BLOCK_SIGOPS_COST:
             raise BlockValidationError("bad-blk-sigops")
 
+    @requires_lock("cs_main")
     def contextual_check_block(self, block: Block, prev: Optional[BlockIndex]) -> None:
         """ref validation.cpp:11877 ContextualCheckBlock (BIP34/finality)."""
         height = prev.height + 1 if prev else 0
@@ -932,6 +941,7 @@ class ChainState:
 
     # ------------------------------------------------------------- connect
 
+    @requires_lock("cs_main")
     def connect_block(
         self,
         block: Block,
@@ -1064,6 +1074,7 @@ class ChainState:
         view.set_best_block(idx.block_hash)
         return undo
 
+    @requires_lock("cs_main")
     def disconnect_block(
         self, block: Block, idx: BlockIndex, view: CoinsViewCache,
         touch_assets: bool = True, undo: Optional[BlockUndo] = None,
@@ -1099,6 +1110,7 @@ class ChainState:
                     view.add_coin(tx.vin[j].prevout, txundo.prevouts[j], overwrite=True)
         view.set_best_block(idx.prev.block_hash if idx.prev else 0)
 
+    @requires_lock("cs_main")
     def _script_checks_required(self, idx: BlockIndex) -> bool:
         """-assumevalid (ref validation.cpp fScriptChecks): blocks that are
         ancestors of a configured known-good block skip per-input script
@@ -1166,6 +1178,7 @@ class ChainState:
                     n += 1
         return n
 
+    @requires_lock("cs_main")
     def _connect_tip(
         self,
         idx: BlockIndex,
@@ -1273,6 +1286,7 @@ class ChainState:
             (t_done - t0) * 1e3,
         )
 
+    @requires_lock("cs_main")
     def _disconnect_tip(self) -> Block:
         """ref DisconnectTip; returns the disconnected block."""
         idx = self.tip()
@@ -1295,6 +1309,7 @@ class ChainState:
 
     # --------------------------------------------------- best-chain logic
 
+    @requires_lock("cs_main")
     def _received_block_data(self, idx: BlockIndex) -> None:
         """First-data-arrival bookkeeping: the equal-work tie break uses
         the order block DATA arrived, not header order (ref
@@ -1310,6 +1325,7 @@ class ChainState:
         (ref validation.cpp CBlockIndexWorkComparator)."""
         return (idx.chain_work, -idx.sequence_id)
 
+    @requires_lock("cs_main")
     def _find_most_work_chain(self) -> Optional[BlockIndex]:
         best: Optional[BlockIndex] = None
         for cand in self.candidates:
@@ -1444,6 +1460,7 @@ class ChainState:
             main_signals.updated_block_tip(self.tip(), None, False)
             self.flush_state_to_disk("if_needed")
 
+    @requires_lock("cs_main")
     def _resubmit_disconnected(self) -> None:
         """Re-add reorged-out transactions to the mempool (ref
         UpdateMempoolForReorg's disconnectpool drain)."""
@@ -1454,6 +1471,7 @@ class ChainState:
 
         resubmit_disconnected(self, pool)
 
+    @requires_lock("cs_main")
     def _invalidate(self, idx: BlockIndex) -> None:
         self._full_index_flush = True
         idx.status |= BlockStatus.FAILED_VALID
@@ -1469,6 +1487,7 @@ class ChainState:
                     break
                 walk = walk.prev
 
+    @requires_lock("cs_main")
     def _prune_candidates(self) -> None:
         tip = self.tip()
         if tip is None:
@@ -1575,6 +1594,7 @@ class ChainState:
 
     # ------------------------------------------------------- public entry
 
+    @requires_lock("cs_main")
     def _batch_verify_kawpow(self, headers: List[BlockHeader]) -> set:
         """Pre-verify KawPow PoW for a whole HEADERS message on the device.
 
@@ -1838,6 +1858,7 @@ class ChainState:
             self._last_autoprune_height = tip.height
             self.prune_block_files()
 
+    @requires_lock("cs_main")
     def _write_coins(self, drop_cache: bool = False) -> None:
         """Commit the coins cache (+ the asset snapshot, riding IN the
         same kvstore batch so both always reflect the same best block —

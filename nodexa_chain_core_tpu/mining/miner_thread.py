@@ -20,6 +20,7 @@ from ..telemetry.flight_recorder import record_event
 from ..telemetry.startup import g_startup
 from ..utils.logging import log_printf
 from .assembler import BlockAssembler, mine_block_cpu
+from ..utils.sync import DebugLock
 
 SLICE_TRIES = 50_000  # nonces per template round before staleness re-check
 
@@ -38,7 +39,7 @@ class BackgroundMiner:
         self._workers: list = []
         self._hashes = 0
         self._window_start = time.time()
-        self._lock = threading.Lock()
+        self._lock = DebugLock("miner.stats", reentrant=False)
         # bumped by the validation bus when the tip moves (a pool- or
         # p2p-found block): workers abandon the current template slice
         # instead of finishing up to SLICE_TRIES nonces of stale work.

@@ -38,7 +38,10 @@ class AddrInfo:
 
 
 class AddrMan:
-    def __init__(self, key: Optional[int] = None):
+    def __init__(self, key: Optional[int] = None, clock=time.time):
+        # injectable clock (netsim determinism): last_try/last_success
+        # stamps follow the driving node's clock, never the wall
+        self._clock = clock
         # ref CAddrMan: nKey + insecure_rand are FastRandomContext-backed
         # (src/addrman.h:223) so bucket placement and selection jitter are
         # not observable-PRNG (eclipse hardening)
@@ -110,7 +113,7 @@ class AddrMan:
             info = self._addrs.get(key)
             if info is None:
                 return
-        info.last_success = int(time.time())
+        info.last_success = int(self._clock())
         info.attempts = 0
         if info.in_tried:
             return
@@ -128,7 +131,7 @@ class AddrMan:
     def attempt(self, ip: str, port: int) -> None:
         info = self._addrs.get(f"{ip}:{port}")
         if info:
-            info.last_try = int(time.time())
+            info.last_try = int(self._clock())
             info.attempts += 1
 
     # -- selection --------------------------------------------------------

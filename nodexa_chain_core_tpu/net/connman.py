@@ -20,6 +20,7 @@ from ..telemetry import flight_recorder, g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from . import protocol
 from .addrman import AddrMan
+from ..utils.sync import DebugLock, excludes_lock
 
 _M_MSGS = g_metrics.counter(
     "nodexa_p2p_messages_total",
@@ -92,7 +93,10 @@ def _wire_counters(command: str, direction: str) -> tuple:
     bound = _bound_cache.get(key)
     if bound is None:
         bound = _bound_cache[key] = (
+            # nxlint: allow(label-bound) -- bounded: command was just
+            # normalized to _KNOWN_COMMANDS + "other" above
             _M_MSGS.labels(command=command, direction=direction),
+            # nxlint: allow(label-bound) -- bounded: same normalization
             _M_BYTES.labels(command=command, direction=direction),
         )
     return bound
@@ -166,7 +170,7 @@ class Peer:
         # -tracepeers capability (set when the peer advertised
         # sendtracectx AND we run with trace propagation enabled)
         self.trace_ctx_ok = False
-        self._send_lock = threading.Lock()
+        self._send_lock = DebugLock("peer.send", reentrant=False)
 
     def note_msg(self, command: str, direction: str, nbytes: int) -> None:
         """Fold one wire message into the per-peer per-command ledger."""
@@ -241,10 +245,10 @@ class ConnMan:
         self.listen = listen
         self.clock = clock
         self.peers: Dict[int, Peer] = {}
-        self._peers_lock = threading.Lock()
+        self._peers_lock = DebugLock("connman.peers", reentrant=False)
         self.inbound_queue: "queue.Queue" = queue.Queue()
         self.banned: Dict[str, float] = {}
-        self.addrman = AddrMan()
+        self.addrman = AddrMan(clock=clock)
         # per-address outbound backoff: key -> [next_ok_ts, current_delay]
         self._conn_backoff: Dict[str, list] = {}
         self._stop = threading.Event()
@@ -488,6 +492,7 @@ class ConnMan:
                 self.inbound_queue.put((peer, command, payload))
         self._remove_peer(peer)
 
+    @excludes_lock("connman.peers")
     def _remove_peer(self, peer: Peer) -> None:
         peer.close()
         with self._peers_lock:
@@ -648,7 +653,7 @@ class ConnMan:
         addrman, plus periodic feeler connections that test NEW-table
         entries and promote them to tried (ref net.cpp feeler logic)."""
         last_seed_try = 0.0
-        last_feeler = time.time()
+        last_feeler = self.clock()
         while not self._stop.is_set():
             time.sleep(2)
             if self._stop.is_set():
@@ -661,9 +666,9 @@ class ConnMan:
             if (
                 self.addrman.size() == 0
                 and outbound == 0
-                and time.time() - last_seed_try >= 60.0
+                and self.clock() - last_seed_try >= 60.0
             ):
-                last_seed_try = time.time()
+                last_seed_try = self.clock()
                 self._dns_seed()
             if outbound < self.MAX_OUTBOUND:
                 info = self.addrman.select()
@@ -673,7 +678,7 @@ class ConnMan:
                     and not self.is_banned(info.ip)
                 ):
                     self.connect_to(info.key(), manual=False)
-            now = time.time()
+            now = self.clock()
             if now - last_feeler >= self.FEELER_INTERVAL:
                 last_feeler = now
                 info = self.addrman.select(new_only=True)

@@ -17,6 +17,7 @@ from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
 from ..telemetry import g_metrics, tracing
 from ..utils.logging import LogFlags, log_print
+from ..utils.sync import excludes_lock
 from . import protocol
 from ..crypto.chacha20 import FastRandomContext
 from .blockencodings import (
@@ -289,6 +290,7 @@ class NetProcessor:
                 self.misbehaving(peer, 10, "processing-error")
         return touched
 
+    @excludes_lock("cs_main")
     def process_message(self, peer, command: str, payload: bytes) -> None:
         """ref net_processing.cpp:1527 ProcessMessage."""
         r = ByteReader(payload)
@@ -990,6 +992,7 @@ class NetProcessor:
         block = Block.deserialize(r, self.node.params.algo_schedule)
         self._accept_block_from_peer(peer, block, punish=True)
 
+    @excludes_lock("cs_main")
     def _accept_block_from_peer(self, peer, block, punish: bool) -> bool:
         h = block.get_hash(self.node.params.algo_schedule)
         self._clear_block_request(peer, h)
@@ -1058,6 +1061,7 @@ class NetProcessor:
                         order.append(by_txid[cur])
         return order
 
+    @excludes_lock("cs_main")
     def _on_tx_batch(self, items) -> None:
         """Admit a drained run of TX messages as one batch: deserialize,
         topologically order, accept in order, then run ONE deduplicated
@@ -1166,6 +1170,7 @@ class NetProcessor:
                 self.relay_transaction(otx)
                 work.append(otx.txid)
 
+    @excludes_lock("cs_main")
     def periodic(self) -> None:
         """Maintenance-tick work (called from the connman maintenance
         thread, and from the netsim harness's deterministic tick):
@@ -1614,6 +1619,7 @@ class NetProcessor:
 
     # -- outbound relay ----------------------------------------------------
 
+    @excludes_lock("cs_main")
     def relay_transaction(self, tx, exclude=None) -> None:
         """ref RelayTransaction -> ForEachNode INV push (BIP37-aware)."""
         inv = Inv(INV_TX, tx.txid)
@@ -1632,6 +1638,7 @@ class NetProcessor:
             w.vector([inv], lambda wr, i: i.serialize(wr))
             peer.send_msg(self.magic, MSG_INV, w.getvalue())
 
+    @excludes_lock("cs_main")
     def announce_block(self, block_hash: int) -> None:
         """New-tip announcement: headers to sendheaders peers, inv
         otherwise.  With tracing on this is also where the cross-node

@@ -123,6 +123,8 @@ class NetAddr:
 
     def serialize(self, w: ByteWriter, with_time: bool = True) -> None:
         if with_time:
+            # nxlint: allow(wall-clock) -- wire timestamp: addr relay
+            # carries WALL time by protocol definition (ref CAddress)
             w.u32(self.time or int(time.time()))
         w.u64(self.services)
         w.write(_ip_to_bytes16(self.ip))
@@ -182,6 +184,8 @@ class VersionPayload:
     relay: bool = True
 
     def serialize(self, w: ByteWriter) -> None:
+        # nxlint: allow(wall-clock) -- wire timestamp: the version
+        # handshake advertises wall time by protocol definition
         w.i32(self.version).u64(self.services).i64(self.timestamp or int(time.time()))
         self.addr_recv.serialize(w, with_time=False)
         self.addr_from.serialize(w, with_time=False)

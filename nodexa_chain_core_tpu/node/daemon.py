@@ -81,6 +81,17 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         except ValueError as e:
             raise SystemExit(f"Error: -faultinject: {e}")
 
+    # -debuglockorder: runtime lock-order cycle detection over the named
+    # production DebugLocks (ref DEBUG_LOCKORDER, sync.cpp).  Armed this
+    # early so chainstate load / replay / snapshot recovery are inside
+    # the soak too.  The tier-1 suite runs with this on by default
+    # (tests/conftest.py); the daemon opts in per-run.
+    if g_args.get_bool("debuglockorder"):
+        from ..utils.sync import enable_lockorder_debug
+
+        enable_lockorder_debug(True)
+        log_printf("lock-order deadlock detection armed (-debuglockorder)")
+
     reindexing = g_args.get_bool("reindex")
     # -prune parameter interaction is validated BEFORE the -reindex wipe so
     # a rejected configuration never destroys the derived databases
@@ -307,9 +318,15 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     expiry_s = g_args.get_int("mempoolexpiry", DEFAULT_MEMPOOL_EXPIRY_HOURS) * 3600
 
     def _sweep_mempool():
-        removed = node.mempool.expire(time.time() - expiry_s)
-        if node.mempool.total_size_bytes() > node.mempool.max_size_bytes:
-            removed += len(node.mempool.trim_to_size(node.mempool.max_size_bytes))
+        # under cs_main: expiry/eviction mutate entries and the spender
+        # index concurrently with admissions and block connection (found
+        # by nxlint's lock-held pass — the scheduler thread ran this
+        # unlocked since PR 4)
+        with node.chainstate.cs_main:
+            removed = node.mempool.expire(time.time() - expiry_s)
+            if node.mempool.total_size_bytes() > node.mempool.max_size_bytes:
+                removed += len(
+                    node.mempool.trim_to_size(node.mempool.max_size_bytes))
         if removed:
             log_printf("mempool sweep: removed %d txs", removed)
 
@@ -590,11 +607,19 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
 
         class _PeerNotifier(ValidationInterface):
             """Announce locally-found tips to peers (ref the
-            PeerLogicValidation subscriber wiring)."""
+            PeerLogicValidation subscriber wiring).
+
+            The bus fires under cs_main, and announce_block fans out
+            real socket sendall()s — one wedged peer's TCP window would
+            stall block connection for the whole node.  Flag-and-defer
+            to the scheduler thread instead (the PR 3 rule, caught live
+            by @excludes_lock("cs_main") under -debuglockorder)."""
 
             def updated_block_tip(self, new_tip, fork_tip, initial_download):
                 if node.connman is not None and new_tip is not None:
-                    node.connman.relay_block_hash(new_tip.block_hash)
+                    h = new_tip.block_hash
+                    node.scheduler.schedule(
+                        lambda: node.connman.relay_block_hash(h), 0.0)
 
         main_signals.register(_PeerNotifier())
         for addr in g_args.get_all("addnode") + g_args.get_all("connect"):

@@ -32,6 +32,7 @@ from typing import Dict, Optional, Tuple
 
 from ..crypto import kawpow
 from ..utils.logging import g_logger
+from ..utils.sync import DebugLock
 
 # the legacy (no-backend) build route has exactly one device path
 _SINGLE = "single"
@@ -43,7 +44,7 @@ class EpochManager:
         self.tpu_verify = tpu_verify
         self.slab_threads = slab_threads
         self.backend = backend
-        self._lock = threading.Lock()
+        self._lock = DebugLock("epoch_manager", reentrant=False)
         self._warm: set = set()
         self._building: set = set()
         self._failed: set = set()  # {(epoch, path)} — never epoch alone

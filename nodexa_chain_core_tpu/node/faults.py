@@ -45,12 +45,12 @@ from __future__ import annotations
 
 import errno as _errno
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..telemetry import g_metrics
 from ..utils.logging import log_printf
+from ..utils.sync import DebugLock
 
 # Every site threaded through the tree, with a flag marking the ones a
 # block-import (IBD) run exercises — the crash-recovery matrix test
@@ -166,7 +166,7 @@ class FaultRegistry:
     def __init__(self) -> None:
         self.enabled = False  # fast-path gate, read without the lock
         self._specs: Dict[str, FaultSpec] = {}
-        self._lock = threading.Lock()
+        self._lock = DebugLock("faults", reentrant=False)
 
     # -- arming -----------------------------------------------------------
 

@@ -34,6 +34,7 @@ from typing import Callable, Dict, Optional
 
 from ..telemetry import g_metrics
 from ..utils.logging import log_printf
+from ..utils.sync import DebugLock
 
 MODE_NORMAL = 0
 MODE_SAFE = 1
@@ -89,7 +90,7 @@ def guarded_io(source: str, fn: Callable, chainstate=None, attempts: int = 3,
 
 class NodeHealth:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = DebugLock("health")
         self.mode = MODE_NORMAL
         self.last_error: Optional[dict] = None
         self.retry_counts: Dict[str, int] = {}

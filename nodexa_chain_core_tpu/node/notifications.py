@@ -19,11 +19,14 @@ import socket
 import struct
 import subprocess
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from ..core.serialize import ByteWriter
 from ..utils.logging import log_printf
 from .events import ValidationInterface, main_signals
+from ..utils.sync import DebugLock
 
 TOPICS = ("hashblock", "hashtx", "rawblock", "rawtx", "newassetmessage")
 
@@ -36,13 +39,26 @@ def _hash_bytes(h: int) -> bytes:
 class PubServer(ValidationInterface):
     """Localhost pub socket fed by the validation signal bus."""
 
+    # bound the publish backlog: a stalled subscriber costs at most this
+    # many buffered messages before the writer starts dropping oldest
+    MAX_QUEUE = 4096
+
     def __init__(self, port: int, host: str = "127.0.0.1",
                  schedule=None):
         self.schedule = schedule
         self._seq: Dict[str, int] = {t: 0 for t in TOPICS}
         self._subs: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = DebugLock("notifications", reentrant=False)
         self._stop = threading.Event()
+        # _publish is called from the validation bus INSIDE cs_main
+        # (block_connected fires under activate_best_chain's hold): a
+        # blocking sendall there would let one wedged subscriber stall
+        # block connection for the whole node (found by the nxlint
+        # blocking-under-cs-main discipline).  Publishing only frames the
+        # message and appends to this deque; a dedicated writer thread
+        # owns every socket write.
+        self._queue: "deque[bytes]" = deque(maxlen=self.MAX_QUEUE)
+        self._wake = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -50,6 +66,9 @@ class PubServer(ValidationInterface):
         self.port = self._listener.getsockname()[1]
         t = threading.Thread(target=self._accept_loop, name="pubsrv", daemon=True)
         t.start()
+        w = threading.Thread(target=self._write_loop, name="pubsrv-write",
+                             daemon=True)
+        w.start()
         main_signals.register(self)
         log_printf("notification publisher on %s:%d", host, self.port)
 
@@ -68,28 +87,56 @@ class PubServer(ValidationInterface):
                 self._subs.append(sock)
 
     def _publish(self, topic: str, payload: bytes) -> None:
+        """Frame + enqueue; never blocks (bus callers hold cs_main)."""
         seq = self._seq[topic]
         self._seq[topic] = (seq + 1) & 0xFFFFFFFF
         parts = [topic.encode(), payload, struct.pack("<I", seq)]
         msg = bytes([len(parts)]) + b"".join(
             struct.pack("<I", len(p)) + p for p in parts
         )
-        with self._lock:
-            dead = []
-            for sock in self._subs:
+        self._queue.append(msg)  # deque append: atomic, maxlen-bounded
+        self._wake.set()
+
+    def _write_loop(self) -> None:
+        """The only thread that writes subscriber sockets."""
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            while True:
                 try:
-                    sock.sendall(msg)
-                except OSError:
-                    dead.append(sock)
-            for sock in dead:
-                self._subs.remove(sock)
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                    msg = self._queue.popleft()
+                except IndexError:
+                    break
+                with self._lock:
+                    subs = list(self._subs)
+                dead = []
+                for sock in subs:
+                    try:
+                        sock.sendall(msg)
+                    except OSError:
+                        dead.append(sock)
+                if dead:
+                    with self._lock:
+                        for sock in dead:
+                            if sock in self._subs:
+                                self._subs.remove(sock)
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort drain (tests + close): wait until the writer has
+        consumed everything queued so far."""
+        deadline = time.monotonic() + timeout
+        while self._queue and time.monotonic() < deadline:
+            self._wake.set()
+            time.sleep(0.005)
 
     def close(self) -> None:
+        self.flush(timeout=1.0)
         self._stop.set()
+        self._wake.set()
         main_signals.unregister(self)
         try:
             self._listener.close()

@@ -40,7 +40,6 @@ multi-device serving:
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -49,6 +48,7 @@ import numpy as np
 from ..telemetry import g_metrics, tracing
 from ..telemetry.flight_recorder import record_event
 from ..utils.logging import log_printf
+from ..utils.sync import DebugLock
 
 PATH_MESH = "mesh"
 PATH_SINGLE = "single"
@@ -170,7 +170,7 @@ class MeshBackend:
         # (l1, dag, mesh) -> verifier; injectable so residency/demotion
         # tests run without paying a BatchVerifier XLA compile
         self._verifier_factory = verifier_factory
-        self._lock = threading.Lock()
+        self._lock = DebugLock("mesh.epochs", reentrant=False)
         # mesh construction may be DEFERRED (mesh_factory): touching the
         # device runtime (jax init, seconds to tens of seconds on real
         # hardware) must stay off the daemon's blocking startup path —
@@ -178,7 +178,7 @@ class MeshBackend:
         # an RPC describe) resolves it once
         self._mesh = mesh
         self._mesh_factory = mesh_factory
-        self._mesh_lock = threading.Lock()
+        self._mesh_lock = DebugLock("mesh.build", reentrant=False)
         # epoch -> ready verifier (BatchVerifier tagged .backend_path);
         # ordered by last ensure so eviction drops the stalest epoch
         self._resident: "OrderedDict[int, object]" = OrderedDict()
@@ -374,9 +374,11 @@ class MeshBackend:
                 old, _ = self._resident.popitem(last=False)
                 evicted.append(old)
         _M_BUILDS.inc(path=path)
+        # nxlint: allow(label-bound) -- bounded: at most resident_epochs
+        # live keys; evicted epochs are remove()d below, never left at 0
         _M_RESIDENCY.set(1, epoch=str(epoch))
         for old in evicted:
-            _M_RESIDENCY.set(0, epoch=str(old))
+            _M_RESIDENCY.remove(epoch=str(old))
             log_printf("mesh: evicted epoch %d slab (rollover)", old)
             cb = self.on_evict
             if cb is not None:
@@ -389,7 +391,7 @@ class MeshBackend:
         with self._lock:
             gone = self._resident.pop(epoch, None) is not None
         if gone:
-            _M_RESIDENCY.set(0, epoch=str(epoch))
+            _M_RESIDENCY.remove(epoch=str(epoch))
             cb = self.on_evict
             if cb is not None:
                 cb(epoch)

@@ -28,6 +28,7 @@ from ..crypto.kawpow import epoch_number
 from ..node.events import ValidationInterface, main_signals
 from ..telemetry import g_metrics
 from ..utils.logging import log_printf
+from ..utils.sync import DebugLock, excludes_lock
 
 _M_JOBS = g_metrics.counter(
     "nodexa_pool_jobs_total",
@@ -45,7 +46,8 @@ class Job:
         "clean", "seen_nonces",
     )
 
-    def __init__(self, job_id: str, block, schedule, clean: bool):
+    def __init__(self, job_id: str, block, schedule, clean: bool,
+                 now: Optional[float] = None):
         self.job_id = job_id
         self.block = block
         self.height = block.header.height
@@ -57,7 +59,9 @@ class Job:
         self.header_hash_disp = hh[::-1]  # display order (stratum wire)
         self.header_hash_le = int.from_bytes(hh, "little")
         self.prev_hash = block.header.hash_prev
-        self.created = time.time()
+        # nxlint: allow(wall-clock) -- fallback for direct construction;
+        # JobManager.new_job always passes its injected clock's now=
+        self.created = time.time() if now is None else now
         self.clean = clean
         # nonces claimed by any session on this job (duplicate rejection
         # is job-wide: two workers handing in the same nonce is the same
@@ -78,12 +82,16 @@ class JobManager(ValidationInterface):
     a background scheduler)."""
 
     def __init__(self, node, payout_script: bytes,
-                 refresh_interval_s: float = 10.0):
+                 refresh_interval_s: float = 10.0, clock=time.time):
         self.node = node
+        # injectable clock (the PR 9 clock= discipline: job lineage,
+        # refresh throttling and stale-lag stamps must follow the
+        # driving node's clock, never the wall, under netsim)
+        self._clock = clock
         self.payout_script = payout_script
         self.refresh_interval_s = refresh_interval_s
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = DebugLock("pool.jobs")
         self._counter = 0
         self._last_refresh = 0.0
         self._warned_era = False
@@ -97,7 +105,7 @@ class JobManager(ValidationInterface):
         # wall time the tip last moved: a stale-share reject's age
         # against this stamp attributes the loss to propagation +
         # notify latency (nodexa_pool_stale_share_lag_seconds)
-        self.tip_changed_at = time.time()
+        self.tip_changed_at = self._clock()
 
     def start(self) -> None:
         main_signals.register(self)
@@ -124,7 +132,7 @@ class JobManager(ValidationInterface):
         if not self.node.params.mining_requires_peers:
             return False
         tip = self.node.chainstate.tip()
-        return tip is None or tip.time < time.time() - MAX_TIP_AGE_S
+        return tip is None or tip.time < self._clock() - MAX_TIP_AGE_S
 
     # -- validation interface (the push triggers; flag-and-wake only) ------
 
@@ -132,7 +140,7 @@ class JobManager(ValidationInterface):
         # stamped UNCONDITIONALLY (before the sync gates): the moment
         # the tip moved is when every outstanding job went stale, and
         # that is the zero point stale-share lag is measured from
-        self.tip_changed_at = time.time()
+        self.tip_changed_at = self._clock()
         if initial_download or self._syncing():
             return  # don't spray jobs while syncing; tip isn't ours yet
         with self._lock:  # vs _run's consume: a tip flag set in the
@@ -156,7 +164,7 @@ class JobManager(ValidationInterface):
             if self._stop.is_set():
                 return
             self._wake.clear()
-            now = time.time()
+            now = self._clock()
             with self._lock:
                 clean = self._pending_clean
                 refresh_due = self._pending_refresh and (
@@ -172,6 +180,7 @@ class JobManager(ValidationInterface):
 
     # -- job lifecycle -----------------------------------------------------
 
+    @excludes_lock("cs_main")
     def new_job(self, clean: bool = True) -> Optional[Job]:
         """Assemble a template on the current tip and register it.
 
@@ -200,7 +209,8 @@ class JobManager(ValidationInterface):
             # id from the CAPTURED counter: two concurrent new_job calls
             # (tip signal racing a mempool refresh) re-reading the live
             # counter would mint two different jobs under one id
-            job = Job(f"{extra:04x}", block, sched, clean)
+            job = Job(f"{extra:04x}", block, sched, clean,
+                      now=self._clock())
             self._jobs[job.job_id] = job
             while len(self._jobs) > MAX_JOBS:
                 self._jobs.popitem(last=False)

@@ -38,6 +38,7 @@ from ..utils.logging import log_printf
 from . import shares as sh
 from .jobs import Job, JobManager
 from .shares import Share, SharePipeline
+from ..utils.sync import DebugLock, excludes_lock
 
 MAX_LINE = 8192          # one stratum message never legitimately nears this
 MAX_BUFFER = 65536       # unframed garbage cap before the connection drops
@@ -137,7 +138,7 @@ class StratumSession:
         self.rejected = 0
         self.inflight = 0  # shares queued for validation, not yet judged
         self.connected_at = time.time()
-        self._wlock = threading.Lock()
+        self._wlock = DebugLock("pool.session.send", reentrant=False)
         self._out = bytearray()
         # last TWO pushed share targets: in-flight shares mined against
         # the pre-retarget target stay acceptable (stratum convention)
@@ -219,12 +220,12 @@ class StratumServer:
         self._hashes_per_diff1 = (1 << 256) / float(self.diff1_target + 1)
 
         self.sessions: Dict[int, StratumSession] = {}
-        self._sessions_lock = threading.Lock()
+        self._sessions_lock = DebugLock("pool.sessions", reentrant=False)
         # written from the IO thread (_accept/prune), the share pipeline
         # and the bus (_misbehave via send failures), read by info():
         # every touch goes through _banned_lock
         self.banned: Dict[str, float] = {}
-        self._banned_lock = threading.Lock()
+        self._banned_lock = DebugLock("pool.banned", reentrant=False)
         self._extranonce_ctr = secrets.randbelow(1 << 16)
         self._worker_labels: set = set()
         self.started_at = time.time()
@@ -483,6 +484,7 @@ class StratumServer:
         sess.last_job_id = job.job_id
         sess.send_json(self._notify_msg(sess, job, clean=clean))
 
+    @excludes_lock("cs_main")
     def broadcast_job(self, job: Job) -> None:
         """Fan a fresh job out to every subscribed session (JobManager's
         on_new_job hook — fires on tip updates and mempool refreshes)."""
@@ -495,6 +497,7 @@ class StratumServer:
 
     # -- submit path -------------------------------------------------------
 
+    @excludes_lock("cs_main")
     def _on_submit(self, sess: StratumSession, req_id, params) -> None:
         """Causal-trace shell around the submit checks: a submission
         that passes the cheap abuse gates opens a root span; a share
@@ -569,7 +572,10 @@ class StratumServer:
         if self.jobs.is_stale(job):
             # attribute the loss: how long after the tip moved did this
             # share still arrive on the superseded job?
-            lag = max(0.0, time.time() - self.jobs.tip_changed_at)
+            # read through the JOB MANAGER's clock: tip_changed_at is
+            # stamped from jobs._clock, and mixing domains would report
+            # epoch-scale lags under an injected sim clock
+            lag = max(0.0, self.jobs._clock() - self.jobs.tip_changed_at)
             _M_STALE_LAG.observe(lag)
             if root is not None:
                 root.set(stale_lag_s=round(lag, 3))
@@ -652,6 +658,8 @@ class StratumServer:
                 worker = "other"
             else:
                 self._worker_labels.add(worker)
+        # nxlint: allow(label-bound) -- bounded: worker was folded to
+        # "other" above once _MAX_WORKER_LABELS distinct names exist
         _M_HASHRATE.update(
             difficulty * self._hashes_per_diff1, worker=worker)
 

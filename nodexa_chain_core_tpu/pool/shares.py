@@ -35,6 +35,7 @@ from typing import Callable, List, Optional
 from ..telemetry import g_metrics, tracing
 from ..telemetry.startup import g_startup
 from ..utils.logging import log_printf
+from ..utils.sync import DebugLock, excludes_lock
 
 # stratum error codes (the de-facto pool convention)
 E_OTHER = 20
@@ -113,7 +114,7 @@ class SharePipeline:
         self.counts = {k: 0 for k in (
             R_ACCEPTED, R_BLOCK, R_BAD_MIX, R_LOW_DIFF, R_STALE,
             R_UNKNOWN_JOB, R_DUPLICATE, R_BAD_NONCE, R_ERROR)}
-        self._counts_lock = threading.Lock()
+        self._counts_lock = DebugLock("pool.share_counts", reentrant=False)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -211,6 +212,7 @@ class SharePipeline:
             return None
         return mgr.verifier(epoch)
 
+    @excludes_lock("cs_main")
     def validate_batch(self, batch: List[Share]) -> None:
         """Validate a micro-batch and dispatch each share's verdict.
 
@@ -244,6 +246,7 @@ class SharePipeline:
             for s, (final, mix) in zip(shares, finals_mixes):
                 self._judge(s, final, mix, path)
 
+    @excludes_lock("cs_main")
     def _device_hashes(self, epoch: int, shares: List[Share]):
         """((final, mix) ints, path) via the mesh backend when attached,
         else the epoch manager's verifier; (None, None) = no device slab

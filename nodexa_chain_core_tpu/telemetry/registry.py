@@ -125,6 +125,13 @@ class Gauge(Metric):
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
 
+    def remove(self, **labels) -> None:
+        """Drop one label key entirely (bounded-cardinality discipline:
+        gauges labeled by a rolling identity — a resident DAG epoch —
+        must retire dead keys, not accumulate zeros forever)."""
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)

@@ -1,27 +1,50 @@
-"""Lock-order deadlock detection (ref src/sync.{h,cpp}).
+"""Lock-order deadlock detection + thread-safety annotations
+(ref src/sync.{h,cpp} and clang -Wthread-safety).
 
-The reference compiles a runtime lock-order cycle detector under
-DEBUG_LOCKORDER (sync.cpp:25-183): every (lock A held while taking lock B)
-pair is recorded, and taking them in the opposite order anywhere in the
-process aborts with both stacks.  This is the Python analogue: enable it
-with ``enable_lockorder_debug()`` (tests / -debuglockorder) and wrap
-shared locks in :class:`DebugLock`.
+The reference ships two complementary layers:
 
-The wrapper is a context manager compatible with ``threading.Lock`` usage
-(acquire/release/with); with detection disabled it delegates with no
-bookkeeping overhead beyond one attribute check.
+1. a *runtime* lock-order cycle detector compiled under DEBUG_LOCKORDER
+   (sync.cpp:25-183): every (lock A held while taking lock B) pair is
+   recorded, and taking them in the opposite order anywhere in the
+   process aborts with both stacks; and
+2. *compile-time* thread-safety annotations
+   (EXCLUSIVE_LOCKS_REQUIRED / LOCKS_EXCLUDED, threadsafety.h) that
+   clang verifies at every call site.
+
+This module is the Python analogue of both:
+
+- :class:`DebugLock` wraps a shared production lock with a **role name**
+  (``cs_main``, ``kvstore.write``, ...) and participates in order
+  tracking when ``enable_lockorder_debug()`` is on (tests arm it by
+  default; the daemon arms it via ``-debuglockorder``).  Disabled, it
+  delegates with one attribute check and no bookkeeping.
+- :func:`declare_lock_order` registers the **declared partial order**
+  (outermost → innermost chains).  Acquiring against a declared chain
+  raises :class:`PotentialDeadlock` immediately — no second thread
+  needed to first observe the inverse pair.
+- :func:`requires_lock` / :func:`excludes_lock` annotate functions the
+  way EXCLUSIVE_LOCKS_REQUIRED / LOCKS_EXCLUDED do.  ``tools/nxlint.py``
+  reads them from the AST and verifies the lock context at every call
+  site across the whole program; at runtime (under debug) the decorator
+  is ``AssertLockHeld`` / ``AssertLockNotHeld``.
+
+The canonical production lock order lives in :data:`LOCK_ORDER` below —
+README "Concurrency discipline" documents each level.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 import traceback
-from typing import Dict, List, Tuple
+from typing import Dict, List, Set, Tuple
 
 _enabled = False
 _global = threading.Lock()
 # (A, B) -> formatted stacks at the time A-then-B was first observed
 _order_seen: Dict[Tuple[str, str], str] = {}
+# (outer, inner) pairs implied by declare_lock_order chains
+_declared_before: Set[Tuple[str, str]] = set()
 _tls = threading.local()
 
 
@@ -37,6 +60,10 @@ def enable_lockorder_debug(on: bool = True) -> None:
             _order_seen.clear()
 
 
+def lockorder_debug_enabled() -> bool:
+    return _enabled
+
+
 def _held() -> List["DebugLock"]:
     stack = getattr(_tls, "stack", None)
     if stack is None:
@@ -44,44 +71,105 @@ def _held() -> List["DebugLock"]:
     return stack
 
 
+def held_lock_names() -> Tuple[str, ...]:
+    """Role names of every DebugLock the calling thread holds (innermost
+    last).  Only meaningful while lock-order debug is enabled."""
+    return tuple(l.name for l in _held())
+
+
 def reset_lockorder_state() -> None:
-    """Test helper: forget observed orders (fresh process semantics)."""
+    """Test helper: forget observed orders (fresh process semantics).
+    The *declared* order survives — it is program structure, not runtime
+    observation."""
     with _global:
         _order_seen.clear()
 
 
+def declare_lock_order(*names: str) -> None:
+    """Declare one outermost→innermost chain of lock role names.
+
+    Multiple calls compose into a partial order (only the pairs implied
+    by some declared chain are constrained; everything else falls back
+    to the dynamic first-observation detector).  Acquiring ``outer``
+    while holding ``inner`` raises :class:`PotentialDeadlock` on the
+    spot when debug is armed.
+    """
+    with _global:
+        for i, outer in enumerate(names):
+            for inner in names[i + 1:]:
+                _declared_before.add((outer, inner))
+
+
+def declared_order_pairs() -> Set[Tuple[str, str]]:
+    """(outer, inner) pairs of the declared partial order (for tooling)."""
+    with _global:
+        return set(_declared_before)
+
+
 class DebugLock:
-    """Named lock participating in order tracking (ref CCriticalSection)."""
+    """Named lock participating in order tracking (ref CCriticalSection).
+
+    ``name`` is the lock's *role* — two instances may share a role (every
+    ``KVStore`` write lock is ``kvstore.write``) and are then mutually
+    unordered, exactly like same-class locks in the reference.  With
+    detection off, acquire/release delegate with a single ``if``.
+    """
+
+    __slots__ = ("name", "reentrant", "_lock")
 
     def __init__(self, name: str, reentrant: bool = True):
         self.name = name
+        self.reentrant = reentrant
         self._lock = threading.RLock() if reentrant else threading.Lock()
 
     def _check_order(self) -> None:
         me = self.name
         stack = _held()
+        for l in stack:
+            if l is self and not self.reentrant:
+                # about to deadlock on ourselves: report instead of hang
+                raise PotentialDeadlock(
+                    f"recursive acquisition of non-reentrant lock {me} at:\n"
+                    + "".join(traceback.format_stack(limit=8)))
         if any(l.name == me for l in stack):
             return  # re-entrant acquisition: no new order pair
-        frames = "".join(traceback.format_stack(limit=8))
         with _global:
+            fresh = []
             for prior in stack:
                 pair = (prior.name, me)
+                if pair in _order_seen:
+                    continue
                 inverse = (me, prior.name)
+                if inverse in _declared_before:
+                    raise PotentialDeadlock(
+                        f"declared lock order violated: {me} is declared "
+                        f"outside {prior.name}, but {prior.name} is held "
+                        f"while acquiring {me} at:\n"
+                        + "".join(traceback.format_stack(limit=8)))
                 if inverse in _order_seen:
                     raise PotentialDeadlock(
                         f"lock order violation: {me} -> {prior.name} was "
                         f"established at:\n{_order_seen[inverse]}\n"
-                        f"now acquiring {prior.name} -> {me} at:\n{frames}"
-                    )
-                _order_seen.setdefault(pair, frames)
+                        f"now acquiring {prior.name} -> {me} at:\n"
+                        + "".join(traceback.format_stack(limit=8)))
+                fresh.append(pair)
+            if fresh:
+                # stacks are formatted only when a NEW pair is recorded:
+                # steady-state acquisition (every pair already seen) costs
+                # dict lookups, not traceback walks — the tier-1 suite
+                # runs with detection armed, so this is a hot path
+                frames = "".join(traceback.format_stack(limit=8))
+                for pair in fresh:
+                    _order_seen.setdefault(pair, frames)
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _enabled:
             self._check_order()
-        got = self._lock.acquire(blocking, timeout)
-        if got:
-            _held().append(self)
-        return got
+            got = self._lock.acquire(blocking, timeout)
+            if got:
+                _held().append(self)
+            return got
+        return self._lock.acquire(blocking, timeout)
 
     def release(self) -> None:
         stack = _held()
@@ -98,8 +186,140 @@ class DebugLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<DebugLock {self.name}>"
 
-def assert_lock_held(lock: DebugLock) -> None:
-    """ref AssertLockHeld (threadsafety annotations' runtime twin)."""
-    if _enabled and all(l is not lock for l in _held()):
+
+def assert_lock_held(lock) -> None:
+    """ref AssertLockHeld (threadsafety annotations' runtime twin).
+
+    Accepts a :class:`DebugLock` or a role name string.  No-op unless
+    lock-order debug is armed."""
+    if not _enabled:
+        return
+    if isinstance(lock, str):
+        if lock not in (l.name for l in _held()):
+            raise AssertionError(f"lock {lock} not held where required")
+    elif all(l is not lock for l in _held()):
         raise AssertionError(f"lock {lock.name} not held where required")
+
+
+def assert_lock_not_held(lock) -> None:
+    """ref AssertLockNotHeld: the LOCKS_EXCLUDED runtime twin."""
+    if not _enabled:
+        return
+    name = lock if isinstance(lock, str) else lock.name
+    if name in (l.name for l in _held()):
+        raise AssertionError(f"lock {name} held where it must not be")
+
+
+def _lock_annotation(kind: str, names: Tuple[str, ...]):
+    """Shared body of requires_lock/excludes_lock: prepend ``names`` to
+    the right metadata tuple and install ONE runtime checker that
+    asserts both tuples (stacked decorators compose either way)."""
+
+    def deco(fn):
+        inherited_req = tuple(getattr(fn, "__nx_requires__", ()))
+        inherited_exc = tuple(getattr(fn, "__nx_excludes__", ()))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if _enabled:
+                held = held_lock_names()
+                for n in wrapper.__nx_requires__:
+                    if n not in held:
+                        raise AssertionError(
+                            f"{fn.__qualname__} requires lock {n}; held: "
+                            f"{list(held) or 'none'}")
+                for n in wrapper.__nx_excludes__:
+                    if n in held:
+                        raise AssertionError(
+                            f"{fn.__qualname__} excludes lock {n} but it "
+                            "is held")
+            return fn(*args, **kwargs)
+
+        wrapper.__nx_requires__ = (
+            names + inherited_req if kind == "requires" else inherited_req)
+        wrapper.__nx_excludes__ = (
+            names + inherited_exc if kind == "excludes" else inherited_exc)
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco
+
+
+def requires_lock(*names: str):
+    """Annotate: every caller must hold the named locks
+    (ref EXCLUSIVE_LOCKS_REQUIRED).  ``tools/nxlint.py`` statically
+    verifies the lock context at each call site across the program's
+    call graph; under ``-debuglockorder`` the wrapper also asserts at
+    runtime.  Disabled cost: one bool check per call."""
+    return _lock_annotation("requires", tuple(names))
+
+
+def excludes_lock(*names: str):
+    """Annotate: callers must NOT hold the named locks
+    (ref LOCKS_EXCLUDED) — the machine-checked form of "ECDSA/device
+    work stays outside cs_main"."""
+    return _lock_annotation("excludes", tuple(names))
+
+
+# --------------------------------------------------------------------------
+# The canonical production lock order (outermost → innermost).  Chains, not
+# one total order: locks appearing in no common chain are unordered and
+# constrained only by the dynamic detector.  README "Concurrency
+# discipline" documents each level; tools/nxlint.py cross-checks that
+# every DebugLock role name constructed in the tree appears here.
+# --------------------------------------------------------------------------
+
+#: every DebugLock role name in the tree (nxlint cross-checks construction
+#: sites against this list so a typo'd role can't silently opt out of the
+#: declared order)
+KNOWN_LOCKS = (
+    "cs_main",
+    "snapshot",
+    "mempool.reserved",
+    "mempool.script_stage",
+    "kvstore.write",
+    "kvstore.cache",
+    "blockstore",
+    "health",
+    "notifications",
+    "connman.peers",
+    "peer.send",
+    "pool.sessions",
+    "pool.session.send",
+    "pool.banned",
+    "pool.jobs",
+    "pool.share_counts",
+    "mesh.epochs",
+    "mesh.build",
+    "epoch_manager",
+    "miner.stats",
+    "faults",
+    "wallet",
+)
+
+# chainstate spine: block connection flushes coins/index under cs_main,
+# through the health layer's guarded_io, into the kvstore/blockstore
+declare_lock_order("cs_main", "health", "kvstore.write", "kvstore.cache")
+declare_lock_order("cs_main", "health", "blockstore")
+declare_lock_order("cs_main", "mempool.reserved")
+# snapshot manager: activation/back-validation take cs_main FIRST, then
+# the manager lock for state flips inside (backvalidate_step re-checks
+# its state under cs_main+_lock; flush_backvalidation deliberately
+# RELEASES _lock before taking cs_main to keep this order)
+declare_lock_order("cs_main", "snapshot")
+# validation bus fanout runs under cs_main; subscribers (pool job cutter,
+# notification sinks) take their own locks inside the callback
+declare_lock_order("cs_main", "notifications")
+declare_lock_order("cs_main", "pool.jobs")
+# wallet processes block/tx signals under cs_main
+declare_lock_order("cs_main", "wallet")
+# net: fanout iterates the peer table then writes per-peer
+declare_lock_order("connman.peers", "peer.send")
+# pool: notify fanout iterates sessions then queues per-session writes
+declare_lock_order("pool.sessions", "pool.session.send")
+declare_lock_order("pool.jobs", "pool.sessions")
+# mesh backend: epoch residency decisions wrap per-epoch builds
+declare_lock_order("mesh.epochs", "mesh.build")

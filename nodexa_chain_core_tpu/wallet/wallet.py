@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -32,6 +31,7 @@ from ..script.standard import (
 )
 from ..wallet.bip32 import ExtKey
 from ..wallet.bip39 import generate_mnemonic, mnemonic_to_seed
+from ..utils.sync import DebugLock
 
 KEYPOOL_SIZE = 100
 
@@ -60,7 +60,7 @@ class Wallet(ValidationInterface):
         self.node = node
         self.path = path
         self.keystore = KeyStore()
-        self.lock = threading.RLock()
+        self.lock = DebugLock("wallet")
         self._dirty = False  # deferred-flush marker (see flush_if_dirty)
         self.mnemonic: Optional[str] = None
         self.master: Optional[ExtKey] = None

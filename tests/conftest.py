@@ -28,6 +28,24 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _lockorder_soak():
+    """DEBUG_LOCKORDER on by default for every test: the tier-1 suite
+    doubles as a lock-order soak over the named production DebugLocks
+    (cs_main, kvstore.write, connman.peers, ...).  Observed-order state
+    resets per test (fresh-process semantics) so unrelated tests can't
+    poison each other's pair tables; the declared partial order in
+    utils/sync.py persists.  NODEXA_TEST_LOCKORDER=0 disarms (perf
+    triage only — CI runs armed)."""
+    from nodexa_chain_core_tpu.utils import sync
+
+    sync.reset_lockorder_state()
+    sync.enable_lockorder_debug(
+        os.environ.get("NODEXA_TEST_LOCKORDER", "1") != "0")
+    yield
+    sync.enable_lockorder_debug(False)
+
+
+@pytest.fixture(autouse=True)
 def _fault_and_health_isolation():
     """The fault registry and health state are process-global (like
     g_metrics): a test that arms an injection or trips safe mode must not

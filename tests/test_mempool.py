@@ -157,30 +157,39 @@ def test_reorg_resubmits_transactions(chain100):
         cs.process_new_block(b2)
     assert cs.tip().height == tip_height + 1
     assert cs.tip().block_hash == branch[-1].get_hash()
-    # the reorged-out spend gets resubmitted
-    resubmit_disconnected(cs, pool)
+    # the reorged-out spend gets resubmitted (under cs_main, as the
+    # production caller _resubmit_disconnected holds it)
+    with cs.cs_main:
+        resubmit_disconnected(cs, pool)
     assert pool.contains(tx.txid)
 
 
 def test_trim_and_expire():
+    from nodexa_chain_core_tpu.utils.sync import DebugLock
+
     pool = TxMemPool()
+    # standalone pool: mutations hold a cs_main-role lock exactly like
+    # every production caller (the @requires_lock runtime check is armed
+    # suite-wide by conftest)
+    cs_main = DebugLock("cs_main")
     txs = []
-    for i in range(5):
-        tx = Transaction(
-            version=2,
-            vin=[TxIn(prevout=OutPoint(txid=1000 + i, n=0))],
-            vout=[TxOut(value=1000, script_pubkey=b"\x51")],
-        )
-        pool.add(MempoolEntry(tx=tx, fee=1000 * (i + 1), time=i, height=1))
-        txs.append(tx)
-    assert pool.size() == 5
-    total = pool.total_size_bytes()
-    removed = pool.trim_to_size(total - 1)
-    assert removed and pool.size() < 5
-    # lowest feerate went first
-    assert removed[0] == txs[0].txid
-    n = pool.expire(cutoff_time=3)
-    assert n >= 1
+    with cs_main:
+        for i in range(5):
+            tx = Transaction(
+                version=2,
+                vin=[TxIn(prevout=OutPoint(txid=1000 + i, n=0))],
+                vout=[TxOut(value=1000, script_pubkey=b"\x51")],
+            )
+            pool.add(MempoolEntry(tx=tx, fee=1000 * (i + 1), time=i, height=1))
+            txs.append(tx)
+        assert pool.size() == 5
+        total = pool.total_size_bytes()
+        removed = pool.trim_to_size(total - 1)
+        assert removed and pool.size() < 5
+        # lowest feerate went first
+        assert removed[0] == txs[0].txid
+        n = pool.expire(cutoff_time=3)
+        assert n >= 1
 
 
 def rbf_tx(ks, spk, inputs, value_out):

@@ -307,18 +307,26 @@ def test_reservation_refcount_same_txid_twins():
     """Concurrent submissions of the SAME tx each hold one claim: one
     twin's release must not free the outpoints the other is still
     verifying against (a rival conflict must stay locked out)."""
+    from nodexa_chain_core_tpu.utils.sync import DebugLock
+
     pool = TxMemPool()
     tx = _arbitrary_tx(2, 1)
     rival = _arbitrary_tx(2, 1)  # same prevouts, different txid
     rival.vout[0].value += 1
     assert tx.txid != rival.txid
-    assert pool.reserve_outpoints(tx)
-    assert pool.reserve_outpoints(tx)  # the in-flight twin
+    # claims are taken under cs_main (the snapshot hold) — model that
+    # context; releases legitimately happen off-lock and stay bare here
+    cs_main = DebugLock("cs_main")
+    with cs_main:
+        assert pool.reserve_outpoints(tx)
+        assert pool.reserve_outpoints(tx)  # the in-flight twin
     pool.release_outpoints(tx)  # first twin rejected at its commit
-    assert not pool.reserve_outpoints(rival)  # live twin still holds
+    with cs_main:
+        assert not pool.reserve_outpoints(rival)  # live twin still holds
     pool.release_outpoints(tx)
     assert pool.reserved_count() == 0
-    assert pool.reserve_outpoints(rival)  # now genuinely free
+    with cs_main:
+        assert pool.reserve_outpoints(rival)  # now genuinely free
     pool.release_outpoints(rival)
     assert pool.reserved_count() == 0
 

@@ -1,0 +1,259 @@
+"""tools/nxlint.py — the whole-program concurrency lint.
+
+Fixture snippets per rule (violation caught / allowlist honored /
+call-graph propagation incl. a two-hop caller), plus the repo
+self-check: HEAD must lint clean, which is exactly the ci_gate
+contract."""
+
+import importlib.util
+import os
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_spec = importlib.util.spec_from_file_location(
+    "nxlint", os.path.join(REPO, "tools", "nxlint.py"))
+nxlint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(nxlint)
+
+
+LIB = '''
+from ..utils.sync import DebugLock, requires_lock, excludes_lock
+
+class ChainState:
+    def __init__(self):
+        self.cs_main = DebugLock("cs_main")
+
+@requires_lock("cs_main")
+def needs_main(x):
+    return x
+
+@excludes_lock("cs_main")
+def off_lock_only(x):
+    return x
+'''
+
+
+def run(sources, **kw):
+    kw.setdefault("known_locks", {"cs_main", "kvstore.write"})
+    kw.setdefault("known_sites", {"kvstore.wal_append"})
+    an = nxlint.Analyzer(sources, **kw)
+    return an.run()
+
+
+def rules_of(findings, path=None):
+    return {f.rule for f in findings if path is None or f.path == path}
+
+
+# ------------------------------------------------------------- per-rule
+
+
+def test_lock_held_unannotated_caller_caught():
+    findings = run({
+        "m/lib.py": LIB,
+        "m/bad.py": "from .lib import needs_main\n"
+                    "def caller():\n"
+                    "    return needs_main(1)\n",
+    })
+    assert "lock-held" in rules_of(findings, "m/bad.py")
+
+
+def test_lock_held_two_hop_propagation():
+    """mid() is annotated, so its own call into needs_main passes — but
+    the two-hop caller outer() that lost the context is caught at ITS
+    call site."""
+    src = (
+        "from .lib import needs_main\n"
+        "from ..utils.sync import requires_lock\n"
+        "@requires_lock(\"cs_main\")\n"
+        "def mid():\n"
+        "    return needs_main(1)\n"
+        "def outer():\n"
+        "    return mid()\n"
+    )
+    findings = run({"m/lib.py": LIB, "m/two.py": src})
+    hits = [f for f in findings if f.rule == "lock-held"]
+    assert len(hits) == 1
+    assert "outer" in hits[0].msg and "mid" in hits[0].msg
+
+
+def test_local_lock_survives_nested_def():
+    """A nested def between a function-local DebugLock assignment and
+    its with-region must not wipe the enclosing resolution (regression:
+    _check_function resets the local-lock map)."""
+    findings = run({
+        "m/lib.py": LIB,
+        "m/ok.py": "from .lib import needs_main\n"
+                   "from ..utils.sync import DebugLock\n"
+                   "def outer():\n"
+                   "    cs = DebugLock(\"cs_main\")\n"
+                   "    def helper():\n"
+                   "        return 1\n"
+                   "    with cs:\n"
+                   "        return needs_main(helper())\n",
+    })
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_lock_held_satisfied_by_with_region():
+    findings = run({
+        "m/lib.py": LIB,
+        "m/ok.py": "from .lib import needs_main\n"
+                   "def caller(chainstate):\n"
+                   "    with chainstate.cs_main:\n"
+                   "        return needs_main(1)\n",
+    })
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_lock_excluded_and_blocking_under_cs_main():
+    findings = run({
+        "m/lib.py": LIB,
+        "m/bad.py": "from .lib import off_lock_only\n"
+                    "def f(chainstate, dev):\n"
+                    "    with chainstate.cs_main:\n"
+                    "        off_lock_only(1)\n"
+                    "        dev.block_until_ready()\n"
+                    "        dev.hash_batch([])\n",
+    })
+    rules = rules_of(findings, "m/bad.py")
+    assert "lock-excluded" in rules
+    blocking = [f for f in findings if f.rule == "blocking-under-cs-main"]
+    assert len(blocking) == 2  # block_until_ready + the batch dispatch
+
+
+def test_requires_annotation_satisfies_own_body():
+    """An annotated function's body counts its declared locks as held."""
+    findings = run({
+        "m/lib.py": LIB,
+        "m/ok.py": "from .lib import needs_main\n"
+                   "from ..utils.sync import requires_lock\n"
+                   "@requires_lock(\"cs_main\")\n"
+                   "def annotated():\n"
+                   "    return needs_main(2)\n",
+    })
+    assert not any(f.rule == "lock-held" and f.path == "m/ok.py"
+                   for f in findings)
+
+
+def test_wall_clock_in_clocked_module_and_allowlist():
+    bad = "import time\ndef f():\n    return time.time()\n"
+    ok = ("import time\n"
+          "def f():\n"
+          "    # nxlint: allow(wall-clock) -- wire timestamp fixture\n"
+          "    return time.time()\n")
+    findings = run({"m/bad.py": bad, "m/ok.py": ok},
+                   clocked_modules={"m/bad.py", "m/ok.py"})
+    assert rules_of(findings, "m/bad.py") == {"wall-clock"}
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_wall_clock_not_flagged_outside_clocked_modules():
+    src = "import time\ndef f():\n    return time.time()\n"
+    findings = run({"m/free.py": src}, clocked_modules={"m/other.py"})
+    assert not findings
+
+
+def test_trace_guard_unguarded_fstring_flagged():
+    bad = ("from ..telemetry import tracing\n"
+           "def f(tx):\n"
+           "    tracing.start_trace('x', txid=f'{tx:064x}')\n")
+    ok = ("from ..telemetry import tracing\n"
+          "def f(tx):\n"
+          "    root = tracing.start_trace('x', txid=f'{tx:064x}') "
+          "if tracing.enabled() else None\n"
+          "    if tracing.enabled():\n"
+          "        tracing.start_span('y', a=f'{tx}')\n")
+    findings = run({"m/bad.py": bad, "m/ok.py": ok})
+    assert rules_of(findings, "m/bad.py") == {"trace-guard"}
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_label_bound_dynamic_unknown_label_flagged():
+    bad = ("_M_X = object()\n"
+           "def f(peer):\n"
+           "    _M_X.inc(worker=peer)\n")
+    ok = ("_M_X = object()\n"
+          "def f(res):\n"
+          "    _M_X.inc(result=res)\n"      # bounded label name
+          "    _M_X.inc(worker='fixed')\n")  # literal value
+    findings = run({"m/bad.py": bad, "m/ok.py": ok})
+    assert rules_of(findings, "m/bad.py") == {"label-bound"}
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_fault_site_literal_cross_checked():
+    bad = "def f(g_faults):\n    g_faults.check('no.such.site')\n"
+    ok = "def f(g_faults):\n    g_faults.check('kvstore.wal_append')\n"
+    findings = run({"m/bad.py": bad, "m/ok.py": ok})
+    assert rules_of(findings, "m/bad.py") == {"fault-site"}
+    assert not rules_of(findings, "m/ok.py")
+
+
+def test_lock_name_unknown_role_flagged():
+    findings = run({
+        "m/bad.py": "from ..utils.sync import DebugLock\n"
+                    "L = DebugLock('typo.role')\n",
+    })
+    assert rules_of(findings, "m/bad.py") == {"lock-name"}
+
+
+def test_allow_requires_justification_and_no_stale():
+    bare = ("import time\n"
+            "def f():\n"
+            "    return time.time()  # nxlint: allow(wall-clock)\n")
+    findings = run({"m/bare.py": bare}, clocked_modules={"m/bare.py"})
+    rules = rules_of(findings, "m/bare.py")
+    # the allow is rejected (no justification) AND the finding stands
+    assert rules == {"allow-syntax", "wall-clock"}
+
+    stale = ("def f():\n"
+             "    # nxlint: allow(wall-clock) -- nothing here anymore\n"
+             "    return 1\n")
+    findings = run({"m/stale.py": stale}, clocked_modules={"m/stale.py"})
+    assert rules_of(findings, "m/stale.py") == {"allow-syntax"}
+
+
+# --------------------------------------------------------- repo contract
+
+
+def test_repo_head_lints_clean():
+    """The acceptance bar: zero findings on HEAD (every suppression in
+    the tree carries an inline justification, checked by the rule
+    itself)."""
+    findings = nxlint.run_repo()
+    assert findings == [], "\n".join(map(repr, findings))
+
+
+def test_self_test_harness_green():
+    assert nxlint.run_self_test() == 0
+
+
+def test_repo_known_locks_cover_all_constructed_roles():
+    """Every DebugLock role constructed in the tree is declared in
+    utils.sync.KNOWN_LOCKS (lock-name rule is live, not vestigial)."""
+    locks = nxlint._load_known_locks()
+    assert "cs_main" in locks and "kvstore.write" in locks
+    sources = nxlint.load_package_sources()
+    an = nxlint.Analyzer(sources, known_locks=locks)
+    an.build_index()
+    constructed = {role for mi in an.modules.values()
+                   for _, role in mi.lock_literals}
+    assert constructed, "no DebugLock constructions indexed?"
+    assert constructed <= locks
+
+
+def test_shared_traversal_with_lint():
+    """lint.py and nxlint share one file walk (the satellite contract)."""
+    files = nxlint.iter_py_files(REPO, ["nodexa_chain_core_tpu"])
+    assert any(f.endswith("chain/validation.py") for f in files)
+    assert not any("__pycache__" in f for f in files)
+    import importlib.util as iu
+
+    spec = iu.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = iu.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    # lint.py must IMPORT the walk from nxlint, not carry its own copy
+    # (module identity differs across load mechanisms; the defining file
+    # is the contract)
+    assert lint.iter_py_files.__code__.co_filename.endswith("nxlint.py")

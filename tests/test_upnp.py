@@ -27,7 +27,7 @@ class FakeIGD:
                 pass
 
             def do_GET(self):
-                desc = f"""<?xml version="1.0"?>
+                desc = """<?xml version="1.0"?>
 <root><device><serviceList><service>
 <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
 <controlURL>/ctl</controlURL>

@@ -8,45 +8,52 @@
 # Stages:
 #   1. lint            tools/lint.py (no ruff/flake8 in-image; the gate
 #                      carries its own checks: syntax, unused imports,
+#                      shadowed imports, placeholder-less f-strings,
 #                      tabs/trailing-ws, bare except, mutable defaults)
-#   2. import graph    every package module imports cleanly on CPU
-#   3. rpc parity      tools/check_rpc_mappings.py — all 168 reference
+#   2. concurrency     tools/nxlint.py — thread-safety annotations
+#                      verified across the whole-program call graph,
+#                      blocking-under-cs_main / wall-clock / trace-guard
+#                      / label-cardinality / fault-site rules, plus the
+#                      seeded-violation --self-test (incl. a reversed
+#                      lock pair against the runtime detector)
+#   3. import graph    every package module imports cleanly on CPU
+#   4. rpc parity      tools/check_rpc_mappings.py — all 168 reference
 #                      CRPCCommand names have handlers + extras pinned
-#   4. telemetry       tests/test_telemetry.py — registry semantics,
+#   5. telemetry       tests/test_telemetry.py — registry semantics,
 #                      Prometheus exposition, getmetrics/REST surfaces
-#   5. ibd fast path   bench/ibd.py --assert-fast-path — short synthetic
+#   6. ibd fast path   bench/ibd.py --assert-fast-path — short synthetic
 #                      IBD (headers-first, out-of-order data) asserting
 #                      blocks/s is emitted, the connect_stage histogram
 #                      carries the new `prefetch` stage, and the deferred
 #                      coins flush beats per-block flushing >= 2.5x
 #                      (floor recalibrated to this container)
-#   6. pool stratum    bench/pool.py --e2e — a loopback stratum client
+#   7. pool stratum    bench/pool.py --e2e — a loopback stratum client
 #                      runs subscribe/authorize/submit end to end:
 #                      accepted shares on the batched device path AND the
 #                      scalar fallback, plus a winning share landing a
 #                      block through ConnectTip, all asserted
-#   7. mesh backend    bench/mesh.py --assert-mesh — the mesh serving
+#   8. mesh backend    bench/mesh.py --assert-mesh — the mesh serving
 #                      backend on a forced 8-host-device mesh: known-
 #                      answer pins vs the executable spec, then verify/
 #                      share/search throughput at n_devices=8 vs 1,
 #                      asserting the backend actually served path=mesh
 #                      (the bit-exact parity suite itself runs in the
 #                      pytest stage: tests/test_mesh_backend.py)
-#   8. tx admission    bench/txflood.py --assert-fast-path — a concurrent
+#   9. tx admission    bench/txflood.py --assert-fast-path — a concurrent
 #                      pre-signed tx flood through both admission paths,
 #                      asserting staged >= 1.05x inline accepts/s (floor
 #                      recalibrated to this container), cs_main
 #                      hold p99 below the off-lock scripts-stage mean
 #                      (ECDSA demonstrably outside the lock), and an
 #                      identical reject taxonomy on both paths
-#   9. fault tolerance tests/test_fault_tolerance.py (fast subset) —
+#  10. fault tolerance tests/test_fault_tolerance.py (fast subset) —
 #                      deterministic fault-injection specs, a kill-at-
 #                      site crash-recovery pair per tier-1 site asserting
 #                      restart converges to the uninterrupted tip, the
 #                      safe-mode degradation surface, and the startup
 #                      self-check refusing a corrupted undo journal
 #                      (full matrix + daemon e2e run under -m slow)
-#  10. observability   tools/flight_check.py — forced safe-mode entry
+#  11. observability   tools/flight_check.py — forced safe-mode entry
 #                      under -faultinject must auto-dump a flight-
 #                      recorder file carrying >=1 complete causal trace
 #                      (block.connect tree retrievable via gettrace);
@@ -54,53 +61,70 @@
 #                      restart-to-first-sweep in a cold child and
 #                      asserts startup_to_first_sweep_s is finite with
 #                      per-kernel jit-compile attribution recorded
-#  11. cold start      bench/startup.py --assert-warm — cold + warm
+#  12. cold start      bench/startup.py --assert-warm — cold + warm
 #                      restart children against one cache dir: warm
 #                      must strictly beat cold, stay under the 0.6x
 #                      ceiling, restore serialized AOT executables, and
 #                      both children must record ZERO steady-state jit
 #                      compiles (the shape-bucket discipline holds)
-#  12. utilization     tools/profile_check.py — getprofile round-trip
+#  13. utilization     tools/profile_check.py — getprofile round-trip
 #                      over a loopback serving rig (>=4 thread roles
 #                      with samples), profiler-on pool throughput
 #                      >= 0.95x profiler-off, and the live
 #                      nodexa_device_busy_frac gauge finite in [0,1]
-#  13. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
+#  14. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
 #                      partition-and-heal converging every node to ONE
 #                      tip with zero honest bans, a digest-pinned
 #                      determinism replay, and a stalling-peer IBD run
 #                      asserting stall rotation beats the deadline
-#  14. net obs         bench/netsim.py --trace-smoke — cross-node trace
+#  15. net obs         bench/netsim.py --trace-smoke — cross-node trace
 #                      assembly (>=3 hops, finite per-hop stages, <10%
 #                      stage-sum reconciliation error), digest replay
 #                      equality with tracing on/off, and the tracing-off
 #                      wire-throughput pin (>= 0.95x lean baseline)
-#  15. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
+#  16. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
 #                      instant bootstrap: snapshot load-to-tip >= 10x
 #                      faster than replaying the same blocks, bit-exact
 #                      coins digest, and the lying-provider netsim smoke
 #                      (liar caught at the first bad chunk, typed
 #                      disconnect, zero honest bans, digest replay
 #                      equality with transfer enabled)
-#  16. vectors         generate_x16r_vectors.py --check — the committed
+#  17. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  17. native build    compiles the C++ engine (also feeds the wheel)
-#  18. static checks   tools/typecheck.py over the consensus-critical
-#                      packages (undefined names, module attrs, arity)
-#  19. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  18. native build    compiles the C++ engine (also feeds the wheel)
+#  19. static checks   tools/typecheck.py over the consensus-critical
+#                      packages PLUS pool/net/telemetry (undefined
+#                      names, module attrs, arity)
+#  20. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  20. pytest          unit suite (functional suite with --full)
-#  21. wheel           platform-tagged wheel incl. the native .so,
+#  21. pytest          unit suite (functional suite with --full) —
+#                      runs with DEBUG_LOCKORDER armed on the named
+#                      production locks (tests/conftest.py default), so
+#                      the whole suite doubles as a lock-order soak
+#  22. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/21] lint"
+echo "== [1/22] lint"
 python tools/lint.py
 
-echo "== [2/21] import graph"
+echo "== [2/22] concurrency lint (thread-safety annotations)"
+# tools/nxlint.py: whole-program AST/call-graph verification of the
+# @requires_lock/@excludes_lock annotations, the no-blocking-under-
+# cs_main rule, the clock=/trace-guard/label-cardinality/fault-site
+# disciplines, and the DebugLock role registry.  Zero findings on HEAD
+# (every suppression carries an inline justification — the allowlist
+# grammar itself enforces that), then the seeded-violation self-test:
+# a reversed lock pair at runtime, an unannotated caller into a
+# @requires_lock callee, a block_until_ready under cs_main, and a bare
+# time.time() in a clocked module must each be caught
+python tools/nxlint.py
+python tools/nxlint.py --self-test
+
+echo "== [3/22] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -118,13 +142,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [3/21] rpc mapping parity"
+echo "== [4/22] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [4/21] telemetry exposition"
+echo "== [5/22] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [5/21] IBD fast path (synthetic)"
+echo "== [6/22] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -136,7 +160,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [6/21] pool stratum e2e (loopback)"
+echo "== [7/22] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -147,7 +171,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [7/21] mesh serving backend (forced 8-device mesh)"
+echo "== [8/22] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -158,7 +182,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [8/21] tx admission fast path (flood)"
+echo "== [9/22] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -169,7 +193,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [9/21] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [10/22] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -180,7 +204,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [10/21] observability (flight recorder + startup attribution)"
+echo "== [11/22] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -195,7 +219,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [11/21] cold start (AOT executable cache + shape discipline)"
+echo "== [12/22] cold start (AOT executable cache + shape discipline)"
 # cold + warm restart children against ONE cache dir: the warm child
 # must strictly beat the cold one (the BENCH_r05 64.5s-warm-vs-54.4s-
 # cold inversion is the regression this stage exists to catch), stay
@@ -210,7 +234,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --assert-warm \
 fi
 tail -2 "$CS_LOG"; rm -f "$CS_LOG"
 
-echo "== [12/21] utilization + profiler (live roofline attribution)"
+echo "== [13/22] utilization + profiler (live roofline attribution)"
 # a loopback serving rig with the sampling profiler at the daemon
 # default (25 Hz): getprofile must round-trip >= 4 thread roles with
 # samples, pool shares/s with the profiler ON must stay >= 0.95x OFF
@@ -223,7 +247,7 @@ if ! python tools/profile_check.py > "$PC_LOG" 2>&1; then
 fi
 tail -2 "$PC_LOG"; rm -f "$PC_LOG"
 
-echo "== [13/21] netsim smoke (multi-node adversarial scenarios)"
+echo "== [14/22] netsim smoke (multi-node adversarial scenarios)"
 # deterministic in-process 5-node partition-and-heal (must converge all
 # nodes to ONE tip with zero honest bans), a digest-pinned determinism
 # replay, and a stalling-peer IBD run asserting the black-hole peer is
@@ -236,7 +260,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
 fi
 tail -6 "$NS_LOG"; rm -f "$NS_LOG"
 
-echo "== [14/21] net observability (cross-node trace smoke)"
+echo "== [15/22] net observability (cross-node trace smoke)"
 # the wire extension of the PR 8/11 kill-switch contract: an N=5 chain
 # topology must assemble >=1 cluster-wide block-propagation trace
 # spanning >=3 hops with every per-hop stage finite and the stage sum
@@ -252,7 +276,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --trace-smoke \
 fi
 tail -6 "$NO_LOG"; rm -f "$NO_LOG"
 
-echo "== [15/21] snapshot bootstrap (assumeUTXO + lying provider)"
+echo "== [16/22] snapshot bootstrap (assumeUTXO + lying provider)"
 # instant bootstrap must actually be instant: snapshot load-to-tip at
 # least 10x faster than replaying the same blocks via process_new_block,
 # bit-exact coins digest asserted, and the adversarial netsim smoke — a
@@ -268,23 +292,23 @@ if ! python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast \
 fi
 tail -12 "$SNAP_LOG"; rm -f "$SNAP_LOG"
 
-echo "== [16/21] crypto vector regeneration"
+echo "== [17/22] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [17/21] native engine build"
+echo "== [18/22] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [18/21] static checks (consensus-critical packages)"
+echo "== [19/22] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [19/21] native hardening (security-check analog)"
+echo "== [20/22] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [20/21] pytest"
+echo "== [21/22] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -296,7 +320,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [21/21] wheel"
+echo "== [22/22] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -4,9 +4,17 @@ this image has no ruff/flake8/mypy, so the gate carries its own checks).
 Checks, per Python file:
   - parses (syntax)
   - no unused imports (names imported but never referenced)
+  - no shadowed imports (an imported name rebound by a later import,
+    def, class, or module-level assignment — the first binding is dead
+    weight at best, a silent behavior change at worst)
+  - no f-strings with no placeholders (an ``f""`` literal with nothing
+    interpolated is a typo'd format or a stray prefix)
   - no tabs in indentation, no trailing whitespace
   - no `except:` bare handlers
   - no mutable default arguments (def f(x=[]) / {} / set())
+
+The file walk is tools/nxlint.py's ``iter_py_files`` — the lint and the
+concurrency lint gate share one traversal (and one skip-list).
 
 Run: python tools/lint.py [paths...]   (default: package + tests + tools)
 Exit 1 with findings listed.
@@ -17,6 +25,9 @@ from __future__ import annotations
 import ast
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from nxlint import iter_py_files  # noqa: E402 — shared traversal
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 DEFAULT_PATHS = ["nodexa_chain_core_tpu", "tests", "tools", "bench.py",
@@ -83,6 +94,12 @@ def lint_file(path: str) -> list:
         if uses == 0 and attr_uses == 0 and string_uses == 0:
             problems.append(f"{path}:{lineno}: unused import '{name}'")
 
+    nested_js = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.JoinedStr):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.JoinedStr) and sub is not node:
+                    nested_js.add(id(sub))
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             problems.append(f"{path}:{node.lineno}: bare 'except:'")
@@ -92,24 +109,53 @@ def lint_file(path: str) -> list:
                     problems.append(
                         f"{path}:{d.lineno}: mutable default argument"
                     )
+        if isinstance(node, ast.JoinedStr) and id(node) not in nested_js:
+            # implicit concatenation nests component JoinedStrs inside
+            # the merged node (3.10 ast): judge only the OUTERMOST one,
+            # over its whole subtree
+            if not any(isinstance(sub, ast.FormattedValue)
+                       for sub in ast.walk(node)):
+                problems.append(
+                    f"{path}:{node.lineno}: f-string without placeholders")
+
+    # shadowed imports: a module-level import whose name is rebound by a
+    # LATER module-level import/def/class/assignment
+    bound: dict = {}  # name -> (lineno, "import"|other)
+    for node in tree.body:
+        names = []
+        if isinstance(node, ast.Import):
+            names = [((a.asname or a.name).split(".")[0], "import",
+                      node.lineno) for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                names = []
+            else:
+                names = [(a.asname or a.name, "import", node.lineno)
+                         for a in node.names if a.name != "*"]
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names = [(node.name, "def", node.lineno)]
+        elif isinstance(node, ast.Assign):
+            names = [(t.id, "assign", node.lineno) for t in node.targets
+                     if isinstance(t, ast.Name)]
+        for name, kind, lineno in names:
+            prev = bound.get(name)
+            if prev is not None and prev[1] == "import":
+                if "noqa" in src_lines[lineno - 1]:
+                    bound[name] = (lineno, kind)
+                    continue
+                problems.append(
+                    f"{path}:{lineno}: {kind} of {name!r} shadows the "
+                    f"import at line {prev[0]}")
+            bound[name] = (lineno, kind)
     return problems
 
 
 def main() -> int:
     paths = sys.argv[1:] or DEFAULT_PATHS
-    files = []
-    for p in paths:
-        full = os.path.join(REPO, p) if not os.path.isabs(p) else p
-        if os.path.isfile(full):
-            files.append(full)
-        else:
-            for root, _dirs, names in os.walk(full):
-                files += [
-                    os.path.join(root, n) for n in names
-                    if n.endswith(".py")
-                ]
+    files = iter_py_files(REPO, paths)
     problems = []
-    for f in sorted(files):
+    for f in files:
         problems += lint_file(f)
     for p in problems:
         print(p)

@@ -1,0 +1,919 @@
+"""nxlint — whole-program concurrency + discipline linter.
+
+The Python analogue of the reference's clang ``-Wthread-safety`` lane
+(threadsafety.h annotations checked at every call site) plus the
+project-specific invariants that have so far been enforced by review
+only.  Pure stdlib ``ast`` — nothing is imported from the package, so
+the lint runs in milliseconds and can't be perturbed by import-time
+side effects.
+
+Rules (slugs are what the allowlist grammar takes):
+
+  lock-held             a call site does not provably hold every lock the
+                        callee's @requires_lock(...) demands.  The check
+                        walks the intra-package call graph, so a two-hop
+                        caller that lost the lock context is caught at
+                        its own call site (annotate it or take the lock).
+  lock-excluded         a call site holds a lock the callee's
+                        @excludes_lock(...) forbids (device/ECDSA work
+                        under cs_main is the canonical instance).
+  blocking-under-cs-main a blocking primitive (fsync / sendall / sleep /
+                        block_until_ready / device batch dispatch) is
+                        invoked inside a region that holds cs_main.
+  wall-clock            a direct time.time() in a clock=-disciplined
+                        module (netsim determinism: ConnMan, NetProcessor,
+                        protocol, addrman, pool JobManager must route
+                        through their injected clock).
+  trace-guard           trace-span attribute construction (f-strings,
+                        .hex()/.format() args to the tracing API) outside
+                        a tracing.enabled()/span-is-not-None guard — the
+                        -telemetryspans=0 zero-cost contract.
+  label-bound           a telemetry label whose value is a runtime
+                        expression and whose label NAME is not in the
+                        known-bounded vocabulary (cardinality bomb
+                        guard); caps must be proven and allowlisted.
+  fault-site            a string-literal fault site passed to
+                        g_faults.check()/filter_read()/arm_from_string()
+                        that faults.KNOWN_SITES does not define.
+  lock-name             a DebugLock(...) constructed with, or an
+                        annotation naming, a role absent from
+                        utils.sync.KNOWN_LOCKS (a typo'd role silently
+                        opts out of the declared partial order).
+  allow-syntax          an ``# nxlint: allow(...)`` with no justification
+                        text, an unknown rule slug, or one that
+                        suppresses nothing (stale suppressions rot).
+
+Allowlist grammar — on the flagged line or the line directly above::
+
+    # nxlint: allow(rule[,rule2]) -- why this is safe
+
+The justification after ``--`` is mandatory; an allow with no live
+finding under it is itself an error, so suppressions can't outlive the
+code they excuse.
+
+Run:  python tools/nxlint.py            (exit 1 with findings listed)
+      python tools/nxlint.py --self-test (seeded violations must fire)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = "nodexa_chain_core_tpu"
+
+# modules whose time sources are injected (clock= threaded by netsim /
+# the daemon); a bare time.time() here bypasses the discipline
+CLOCKED_MODULES = {
+    "net/connman.py",
+    "net/net_processing.py",
+    "net/protocol.py",
+    "net/addrman.py",
+    "pool/jobs.py",
+}
+
+# attribute names whose invocation blocks the calling thread: disk
+# commits, socket writes, sleeps, and device-batch dispatch (the
+# CachedKernel entry points).  Flagged only under cs_main.
+BLOCKING_ATTRS = {"fsync", "sendall", "sleep", "block_until_ready"}
+DEVICE_DISPATCH_ATTRS = {"hash_batch", "search_sweep", "validate_shares"}
+
+TRACE_FNS = {
+    "start_trace", "start_span", "child_span", "trace_span",
+    "remote_span", "record_span",
+}
+
+# label names whose value sets are closed by construction (reject/result
+# taxonomies, path/stage/direction enums, literal site/kernel tables).
+# A dynamic value under any OTHER label name needs a proven cap and an
+# allowlist entry naming it.
+BOUNDED_LABELS = {
+    "result", "path", "stage", "mode", "direction", "reason", "site",
+    "clean", "event", "kernel", "shape_bucket", "axis", "role", "map",
+    "source", "span", "kind", "active", "level",
+}
+
+RULES = {
+    "lock-held", "lock-excluded", "blocking-under-cs-main", "wall-clock",
+    "trace-guard", "label-bound", "fault-site", "lock-name",
+    "allow-syntax",
+}
+
+_ALLOW_RE = re.compile(
+    r"#\s*nxlint:\s*allow\(([\w\-, ]+)\)(\s*--\s*(.*))?")
+
+
+def iter_py_files(root: str, rel_prefixes: Optional[List[str]] = None
+                  ) -> List[str]:
+    """One traversal shared by lint.py and nxlint: every .py under the
+    given relative prefixes (default: the package + tests + tools +
+    top-level scripts), sorted, __pycache__ skipped."""
+    prefixes = rel_prefixes or [PKG, "tests", "tools", "bench.py",
+                                "__graft_entry__.py"]
+    out: List[str] = []
+    for p in prefixes:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, names in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out += [os.path.join(dirpath, n) for n in sorted(names)
+                    if n.endswith(".py")]
+    return sorted(out)
+
+
+def _load_known_sites() -> Set[str]:
+    """Parse faults.KNOWN_SITES keys from the AST (no package import)."""
+    path = os.path.join(REPO, PKG, "node", "faults.py")
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == "KNOWN_SITES"
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets) and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+    raise RuntimeError("KNOWN_SITES not found in node/faults.py")
+
+
+def _load_known_locks() -> Set[str]:
+    """Parse utils.sync.KNOWN_LOCKS from the AST."""
+    path = os.path.join(REPO, PKG, "utils", "sync.py")
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_LOCKS"
+                for t in node.targets) and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)}
+    raise RuntimeError("KNOWN_LOCKS not found in utils/sync.py")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "msg")
+
+    def __init__(self, path: str, line: int, rule: str, msg: str):
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+class FuncInfo:
+    __slots__ = ("module", "cls", "name", "node", "requires", "excludes",
+                 "acquires_cs_main")
+
+    def __init__(self, module: str, cls: Optional[str], name: str,
+                 node: ast.AST):
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.requires: Tuple[str, ...] = ()
+        self.excludes: Tuple[str, ...] = ()
+        # @_with_cs_main: the wrapper TAKES the lock, so the body runs
+        # with it held but callers need not hold it
+        self.acquires_cs_main = False
+
+    @property
+    def qualname(self) -> str:
+        return (f"{self.module}:{self.cls}.{self.name}" if self.cls
+                else f"{self.module}:{self.name}")
+
+
+class ModuleIndex:
+    __slots__ = ("rel", "tree", "src_lines", "functions", "classes",
+                 "class_bases", "lock_attrs", "module_locks",
+                 "imports_from", "module_aliases", "time_aliases",
+                 "lock_literals")
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        # class -> attr -> lock role (self.X = DebugLock("role"))
+        self.lock_attrs: Dict[str, Dict[str, str]] = {}
+        self.module_locks: Dict[str, str] = {}  # module-level Name -> role
+        self.imports_from: Dict[str, Tuple[str, str]] = {}
+        self.module_aliases: Dict[str, str] = {}  # local alias -> module rel
+        self.time_aliases: Set[str] = set()  # names bound to the time module
+        # (lineno, role) of every DebugLock("role") literal
+        self.lock_literals: List[Tuple[int, str]] = []
+
+
+def _decorator_lock_names(dec: ast.expr) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """(kind, names) for @requires_lock("a")/@excludes_lock("b") decorators."""
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = dec.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name not in ("requires_lock", "excludes_lock"):
+        return None
+    names = tuple(a.value for a in dec.args if isinstance(a, ast.Constant))
+    return ("requires" if name == "requires_lock" else "excludes", names)
+
+
+def _is_with_cs_main_decorator(dec: ast.expr) -> bool:
+    name = dec.id if isinstance(dec, ast.Name) else (
+        dec.attr if isinstance(dec, ast.Attribute) else None)
+    return name == "_with_cs_main"
+
+
+class Analyzer:
+    def __init__(self, sources: Dict[str, str],
+                 clocked_modules: Optional[Set[str]] = None,
+                 known_sites: Optional[Set[str]] = None,
+                 known_locks: Optional[Set[str]] = None):
+        """``sources``: rel-path -> source text for the whole program."""
+        self.sources = sources
+        self.clocked = (CLOCKED_MODULES if clocked_modules is None
+                        else clocked_modules)
+        self.known_sites = known_sites
+        self.known_locks = known_locks
+        self.modules: Dict[str, ModuleIndex] = {}
+        self.findings: List[Finding] = []
+        # attr name -> set of roles it is bound to anywhere (for
+        # resolving `<expr>.cs_main` when the attr is globally unique)
+        self.global_lock_attrs: Dict[str, Set[str]] = {}
+        # method name -> [FuncInfo] across every class (annotated only)
+        self.annotated_methods: Dict[str, List[FuncInfo]] = {}
+        self._local_locks: Dict[str, str] = {}
+
+    # ---------------------------------------------------------- indexing
+
+    def build_index(self) -> None:
+        for rel, src in sorted(self.sources.items()):
+            try:
+                tree = ast.parse(src)
+            except SyntaxError as e:
+                self.findings.append(Finding(
+                    rel, e.lineno or 0, "allow-syntax",
+                    f"syntax error: {e.msg}"))
+                continue
+            mi = ModuleIndex(rel)
+            mi.tree = tree
+            mi.src_lines = src.split("\n")
+            self.modules[rel] = mi
+            self._index_module(mi, tree)
+        for mi in self.modules.values():
+            for cls, attrs in mi.lock_attrs.items():
+                for attr, role in attrs.items():
+                    self.global_lock_attrs.setdefault(attr, set()).add(role)
+            for name, role in mi.module_locks.items():
+                self.global_lock_attrs.setdefault(name, set()).add(role)
+        for mi in self.modules.values():
+            for cls, methods in mi.classes.items():
+                for m, fi in methods.items():
+                    if fi.requires or fi.excludes:
+                        self.annotated_methods.setdefault(m, []).append(fi)
+            for f, fi in mi.functions.items():
+                if fi.requires or fi.excludes:
+                    self.annotated_methods.setdefault(f, []).append(fi)
+
+    def _index_module(self, mi: ModuleIndex, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name == "time":
+                        mi.time_aliases.add(local)
+                    if a.name.startswith(PKG):
+                        mi.module_aliases[local] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    mi.imports_from[local] = (mod, a.name,
+                                              node.level)  # type: ignore
+            elif isinstance(node, ast.FunctionDef):
+                mi.functions[node.name] = self._func_info(
+                    mi, None, node)
+            elif isinstance(node, ast.ClassDef):
+                bases = []
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        bases.append(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        bases.append(b.attr)
+                mi.class_bases[node.name] = bases
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        methods[item.name] = self._func_info(
+                            mi, node.name, item)
+                mi.classes[node.name] = methods
+            if isinstance(node, ast.Assign):
+                self._maybe_module_lock(mi, node)
+        # DebugLock attribute bindings + literals anywhere in the module
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                fn = node.value.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname == "DebugLock" and node.value.args and isinstance(
+                        node.value.args[0], ast.Constant):
+                    role = node.value.args[0].value
+                    mi.lock_literals.append((node.lineno, role))
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            cls = self._enclosing_class(mi, node)
+                            if cls:
+                                mi.lock_attrs.setdefault(cls, {})[
+                                    t.attr] = role
+
+    def _maybe_module_lock(self, mi: ModuleIndex, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        fn = node.value.func
+        fname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if fname == "DebugLock" and node.value.args and isinstance(
+                node.value.args[0], ast.Constant):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mi.module_locks[t.id] = node.value.args[0].value
+
+    def _enclosing_class(self, mi: ModuleIndex, target: ast.AST
+                         ) -> Optional[str]:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return node.name
+        return None
+
+    def _func_info(self, mi: ModuleIndex, cls: Optional[str],
+                   node: ast.FunctionDef) -> FuncInfo:
+        fi = FuncInfo(mi.rel, cls, node.name, node)
+        req: List[str] = []
+        exc: List[str] = []
+        for dec in node.decorator_list:
+            got = _decorator_lock_names(dec)
+            if got:
+                kind, names = got
+                (req if kind == "requires" else exc).extend(names)
+            elif _is_with_cs_main_decorator(dec):
+                fi.acquires_cs_main = True
+        fi.requires = tuple(req)
+        fi.excludes = tuple(exc)
+        return fi
+
+    # ------------------------------------------------------- lock naming
+
+    def _resolve_lock_expr(self, mi: ModuleIndex, cls: Optional[str],
+                           expr: ast.expr) -> Optional[str]:
+        """with-item expression -> lock role name, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self._local_locks:
+                return self._local_locks[expr.id]
+            if expr.id in mi.module_locks:
+                return mi.module_locks[expr.id]
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                # class-scoped first (the many `self._lock`s), bases next
+                for c in [cls] + (mi.class_bases.get(cls or "", [])):
+                    role = mi.lock_attrs.get(c or "", {}).get(attr)
+                    if role:
+                        return role
+            roles = self.global_lock_attrs.get(attr, set())
+            if len(roles) == 1:
+                return next(iter(roles))
+        return None
+
+    # ----------------------------------------------------- call resolution
+
+    def _resolve_callee(self, mi: ModuleIndex, cls: Optional[str],
+                        call: ast.Call) -> Optional[FuncInfo]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mi.functions:
+                return mi.functions[f.id]
+            imp = mi.imports_from.get(f.id)
+            if imp:
+                _, name, _level = imp
+                for other in self.modules.values():
+                    if name in other.functions and (
+                            other.functions[name].requires
+                            or other.functions[name].excludes):
+                        return other.functions[name]
+            return None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                base = f.value.id
+                if base in ("self", "cls") and cls is not None:
+                    fi = self._method_lookup(mi, cls, f.attr)
+                    if fi is not None:
+                        return fi
+                alias = mi.module_aliases.get(base)
+                if alias:
+                    rel = alias[len(PKG) + 1:].replace(".", "/") + ".py"
+                    other = self.modules.get(rel)
+                    if other and f.attr in other.functions:
+                        return other.functions[f.attr]
+            # fallback: a method name annotated in exactly one place in
+            # the whole program is assumed to be that method (names in
+            # the annotation vocabulary are kept distinctive on purpose)
+            cands = self.annotated_methods.get(f.attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _method_lookup(self, mi: ModuleIndex, cls: str, name: str
+                       ) -> Optional[FuncInfo]:
+        seen = set()
+        queue = [cls]
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            methods = mi.classes.get(c)
+            if methods and name in methods:
+                return methods[name]
+            queue.extend(mi.class_bases.get(c, []))
+        return None
+
+    # ----------------------------------------------------------- checking
+
+    def run(self) -> List[Finding]:
+        self.build_index()
+        for mi in self.modules.values():
+            self._check_lock_names(mi)
+            for fi in mi.functions.values():
+                self._check_function(mi, fi)
+            for methods in mi.classes.values():
+                for fi in methods.values():
+                    self._check_function(mi, fi)
+        self._apply_allowlist()
+        return self.findings
+
+    def _check_lock_names(self, mi: ModuleIndex) -> None:
+        if self.known_locks is None:
+            return
+        for lineno, role in mi.lock_literals:
+            if role not in self.known_locks:
+                self.findings.append(Finding(
+                    mi.rel, lineno, "lock-name",
+                    f"DebugLock role {role!r} is not in "
+                    "utils.sync.KNOWN_LOCKS"))
+
+    def _check_function(self, mi: ModuleIndex, fi: FuncInfo) -> None:
+        self._local_locks: Dict[str, str] = {}
+        held = set(fi.requires)
+        if fi.acquires_cs_main:
+            held.add("cs_main")
+        if self.known_locks is not None:
+            for role in fi.requires + fi.excludes:
+                if role not in self.known_locks:
+                    self.findings.append(Finding(
+                        mi.rel, fi.node.lineno, "lock-name",
+                        f"annotation on {fi.qualname} names unknown lock "
+                        f"role {role!r}"))
+        body = fi.node.body
+        self._walk(mi, fi, body, frozenset(held), False)
+
+    def _walk(self, mi: ModuleIndex, fi: FuncInfo, stmts, held: frozenset,
+              guarded: bool) -> None:
+        for node in stmts:
+            self._walk_node(mi, fi, node, held, guarded)
+
+    def _walk_node(self, mi: ModuleIndex, fi: FuncInfo, node, held, guarded
+                   ) -> None:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            # function-local `x = DebugLock("role")`: make `with x:`
+            # resolvable (bench/test harnesses model production context)
+            f = node.value.func
+            fname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if (fname == "DebugLock" and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)):
+                role = node.value.args[0].value
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._local_locks[t.id] = role
+        if isinstance(node, ast.With):
+            new_held = set(held)
+            for item in node.items:
+                role = self._resolve_lock_expr(mi, fi.cls,
+                                               item.context_expr)
+                if role:
+                    new_held.add(role)
+                else:
+                    self._visit_expr(mi, fi, item.context_expr, held,
+                                     guarded)
+            self._walk(mi, fi, node.body, frozenset(new_held), guarded)
+            return
+        if isinstance(node, ast.If):
+            self._visit_expr(mi, fi, node.test, held, guarded)
+            body_guard = guarded or _is_trace_guard(node.test)
+            self._walk(mi, fi, node.body, held, body_guard)
+            self._walk(mi, fi, node.orelse, held, guarded)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later in an unknown lock context — analyze
+            # it against its own annotations only.  _check_function
+            # resets the per-function local-lock map, so save/restore the
+            # ENCLOSING function's view around the recursion (a local
+            # `x = DebugLock(...)` before the nested def must still
+            # resolve in statements after it)
+            nested = self._func_info(mi, fi.cls, node)
+            saved = self._local_locks
+            self._check_function(mi, nested)
+            self._local_locks = saved
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        # statements: visit their expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._visit_expr(mi, fi, child, held, guarded)
+            elif isinstance(child, (ast.stmt,)):
+                self._walk_node(mi, fi, child, held, guarded)
+            elif isinstance(child, (ast.excepthandler,)):
+                self._walk(mi, fi, child.body, held, guarded)
+
+    def _visit_expr(self, mi: ModuleIndex, fi: FuncInfo, expr, held,
+                    guarded) -> None:
+        if isinstance(expr, ast.IfExp):
+            self._visit_expr(mi, fi, expr.test, held, guarded)
+            body_guard = guarded or _is_trace_guard(expr.test)
+            self._visit_expr(mi, fi, expr.body, held, body_guard)
+            self._visit_expr(mi, fi, expr.orelse, held, guarded)
+            return
+        if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.And):
+            # `tracing.enabled() and root.set(...)` style short-circuit
+            self._visit_expr(mi, fi, expr.values[0], held, guarded)
+            g = guarded or _is_trace_guard(expr.values[0])
+            for v in expr.values[1:]:
+                self._visit_expr(mi, fi, v, held, g)
+            return
+        if isinstance(expr, ast.Lambda):
+            # lambdas here are overwhelmingly immediately-invoked
+            # (guarded_io thunks): they inherit the enclosing context
+            self._visit_expr(mi, fi, expr.body, held, guarded)
+            return
+        if isinstance(expr, ast.Call):
+            self._check_call(mi, fi, expr, held, guarded)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._visit_expr(mi, fi, child, held, guarded)
+            elif isinstance(child, ast.keyword):
+                self._visit_expr(mi, fi, child.value, held, guarded)
+            elif isinstance(child, (ast.comprehension,)):
+                self._visit_expr(mi, fi, child.iter, held, guarded)
+                for cond in child.ifs:
+                    self._visit_expr(mi, fi, cond, held, guarded)
+
+    # ------------------------------------------------------ per-call rules
+
+    def _check_call(self, mi: ModuleIndex, fi: FuncInfo, call: ast.Call,
+                    held: frozenset, guarded: bool) -> None:
+        f = call.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        name = f.id if isinstance(f, ast.Name) else None
+
+        # lock-held / lock-excluded against the call graph
+        callee = self._resolve_callee(mi, fi.cls, call)
+        if callee is not None and callee is not fi:
+            for role in callee.requires:
+                if role not in held:
+                    self.findings.append(Finding(
+                        mi.rel, call.lineno, "lock-held",
+                        f"call to {callee.qualname} requires lock "
+                        f"{role!r}, not provably held in {fi.qualname} "
+                        f"(held: {sorted(held) or 'none'})"))
+            for role in callee.excludes:
+                if role in held:
+                    self.findings.append(Finding(
+                        mi.rel, call.lineno, "lock-excluded",
+                        f"call to {callee.qualname} excludes lock "
+                        f"{role!r}, but {fi.qualname} holds it here"))
+
+        # blocking primitives under cs_main
+        if "cs_main" in held and attr in (
+                BLOCKING_ATTRS | DEVICE_DISPATCH_ATTRS):
+            self.findings.append(Finding(
+                mi.rel, call.lineno, "blocking-under-cs-main",
+                f".{attr}() called while cs_main is held in "
+                f"{fi.qualname}"))
+
+        # wall clock in clock-disciplined modules
+        if (mi.rel in self.clocked and attr == "time"
+                and isinstance(f.value, ast.Name)
+                and (f.value.id in mi.time_aliases
+                     or f.value.id in ("time", "_time"))):
+            self.findings.append(Finding(
+                mi.rel, call.lineno, "wall-clock",
+                f"direct {f.value.id}.time() in clock=-disciplined "
+                f"module (route through the injected clock)"))
+
+        # trace-attr construction outside the enabled() guard
+        if ((attr in TRACE_FNS or name in TRACE_FNS)
+                and not guarded
+                and not mi.rel.endswith("telemetry/tracing.py")):
+            argexprs = list(call.args) + [k.value for k in call.keywords]
+            if any(_is_formatting_expr(a) for a in argexprs):
+                self.findings.append(Finding(
+                    mi.rel, call.lineno, "trace-guard",
+                    f"trace-attr formatting passed to {attr or name}() "
+                    f"outside a tracing.enabled() guard in {fi.qualname} "
+                    "(-telemetryspans=0 must cost zero)"))
+
+        # telemetry label cardinality
+        if attr in ("inc", "observe", "set", "update", "labels"):
+            recv = f.value
+            is_metric = (isinstance(recv, ast.Name)
+                         and re.match(r"^_[MGH]_[A-Z0-9_]+$", recv.id))
+            if is_metric:
+                for kw in call.keywords:
+                    if kw.arg is None or kw.arg in BOUNDED_LABELS:
+                        continue
+                    if not isinstance(kw.value, ast.Constant):
+                        self.findings.append(Finding(
+                            mi.rel, call.lineno, "label-bound",
+                            f"label {kw.arg!r} on {recv.id} takes a "
+                            "runtime value and is not a known-bounded "
+                            "label name (cardinality cap required)"))
+
+        # fault-site literal cross-check
+        if (self.known_sites is not None
+                and attr in ("check", "filter_read", "arm_from_string")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("g_faults", "_g_faults")
+                and call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            site = call.args[0].value
+            if attr == "arm_from_string":
+                site = site.split(":", 1)[0]
+            if site not in self.known_sites:
+                self.findings.append(Finding(
+                    mi.rel, call.lineno, "fault-site",
+                    f"fault site {site!r} is not declared in "
+                    "faults.KNOWN_SITES"))
+
+    # ----------------------------------------------------------- allowlist
+
+    def _apply_allowlist(self) -> None:
+        # an allow() covers its own line and the next statement line
+        # (continuation comment lines in between are skipped, so a
+        # multi-line justification still lands on the flagged statement)
+        allows: Dict[Tuple[str, int], Tuple[Set[str], bool, bool]] = {}
+        covers: Dict[Tuple[str, int], Tuple[str, int]] = {}
+        for rel, mi in self.modules.items():
+            for i, line in enumerate(mi.src_lines, 1):
+                m = _ALLOW_RE.search(line)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(1).split(",")}
+                justified = bool(m.group(3) and m.group(3).strip())
+                allows[(rel, i)] = (rules, justified, False)
+                covers[(rel, i)] = (rel, i)
+                j = i + 1
+                while j <= len(mi.src_lines) and (
+                        not mi.src_lines[j - 1].strip()
+                        or mi.src_lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                if j <= len(mi.src_lines):
+                    covers[(rel, j)] = (rel, i)
+                for r in rules:
+                    if r not in RULES:
+                        self.findings.append(Finding(
+                            rel, i, "allow-syntax",
+                            f"unknown rule {r!r} in allow()"))
+                if not justified:
+                    self.findings.append(Finding(
+                        rel, i, "allow-syntax",
+                        "allow() without a '-- justification'"))
+        kept: List[Finding] = []
+        for fnd in self.findings:
+            suppressed = False
+            if fnd.rule != "allow-syntax":
+                src = covers.get((fnd.path, fnd.line))
+                ent = allows.get(src) if src else None
+                if ent and fnd.rule in ent[0] and ent[1]:
+                    allows[src] = (ent[0], ent[1], True)
+                    suppressed = True
+            if not suppressed:
+                kept.append(fnd)
+        for (rel, ln), (rules, justified, used) in sorted(allows.items()):
+            if justified and not used:
+                kept.append(Finding(
+                    rel, ln, "allow-syntax",
+                    f"stale allow({','.join(sorted(rules))}): suppresses "
+                    "no finding"))
+        self.findings = kept
+
+
+def _is_trace_guard(test: ast.expr) -> bool:
+    """True for `X.enabled()` / `enabled()` / `span is not None` /
+    plain-name truthiness tests that gate trace-attr work."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            f = node.func
+            nm = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if nm == "enabled":
+                return True
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.IsNot, ast.Is))
+                for op in node.ops):
+            return True
+    return isinstance(test, ast.Name)
+
+
+def _is_formatting_expr(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in node.values):
+            return True
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr in (
+                    "hex", "format"):
+            return True
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ driver
+
+
+def load_package_sources() -> Dict[str, str]:
+    """rel-path (inside the package) -> source, one shared traversal."""
+    out: Dict[str, str] = {}
+    pkg_root = os.path.join(REPO, PKG)
+    for path in iter_py_files(REPO, [PKG]):
+        rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+        out[rel] = open(path, encoding="utf-8").read()
+    return out
+
+
+def run_repo() -> List[Finding]:
+    an = Analyzer(load_package_sources(),
+                  known_sites=_load_known_sites(),
+                  known_locks=_load_known_locks())
+    return an.run()
+
+
+# ---------------------------------------------------------------- self-test
+
+_SELFTEST_LIB = '''
+from ..utils.sync import DebugLock, requires_lock, excludes_lock
+
+class ChainState:
+    def __init__(self):
+        self.cs_main = DebugLock("cs_main")
+
+@requires_lock("cs_main")
+def needs_main(x):
+    return x
+
+@excludes_lock("cs_main")
+def device_entry(x):
+    return x
+'''
+
+_SELFTEST_BAD = '''
+import time
+from .lib import needs_main, device_entry
+from ..utils.sync import DebugLock
+
+mylock = DebugLock("not-a-declared-role")
+
+def unannotated_caller():
+    # two-hop: no annotation, no acquisition -> lock-held
+    return needs_main(1)
+
+def holds_and_dispatches(chainstate, dev):
+    with chainstate.cs_main:
+        dev.block_until_ready()      # blocking-under-cs-main
+        device_entry(2)              # lock-excluded
+
+def wall_clock_straggler():
+    return time.time()               # wall-clock (module is clocked)
+
+def bad_fault_site(g_faults):
+    g_faults.check("no.such.site")
+'''
+
+_SELFTEST_OK = '''
+from .lib import needs_main
+
+def fine(chainstate):
+    with chainstate.cs_main:
+        return needs_main(1)
+
+def allowed():
+    import time
+    return time.time()  # nxlint: allow(wall-clock) -- self-test fixture
+'''
+
+
+def run_self_test() -> int:
+    """Seeded violations MUST each be caught; the clean module must not
+    fire.  Also arms the runtime detector and asserts a reversed lock
+    pair raises PotentialDeadlock (the ci_gate runtime seed)."""
+    sources = {
+        "fix/lib.py": _SELFTEST_LIB,
+        "fix/bad.py": _SELFTEST_BAD,
+        "fix/ok.py": _SELFTEST_OK,
+    }
+    an = Analyzer(sources,
+                  clocked_modules={"fix/bad.py", "fix/ok.py"},
+                  known_sites={"kvstore.wal_append"},
+                  known_locks={"cs_main"})
+    findings = an.run()
+    by_rule: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    expect = {
+        "lock-held": "fix/bad.py",           # unannotated two-hop caller
+        "blocking-under-cs-main": "fix/bad.py",
+        "lock-excluded": "fix/bad.py",
+        "wall-clock": "fix/bad.py",
+        "fault-site": "fix/bad.py",
+        "lock-name": "fix/bad.py",
+    }
+    failures = []
+    for rule, path in expect.items():
+        hits = [f for f in by_rule.get(rule, []) if f.path == path]
+        if not hits:
+            failures.append(f"seeded {rule} violation NOT caught")
+    wrong = [f for f in findings if f.path == "fix/ok.py"]
+    if wrong:
+        failures.append(f"clean fixture flagged: {wrong}")
+
+    # runtime seed: a reversed lock pair must raise PotentialDeadlock
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_nx_sync", os.path.join(REPO, PKG, "utils", "sync.py"))
+    sync = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sync)
+    sync.enable_lockorder_debug(True)
+    a, b = sync.DebugLock("cs_a"), sync.DebugLock("cs_b")
+    with a:
+        with b:
+            pass
+    try:
+        with b:
+            with a:
+                pass
+        failures.append("runtime reversed lock pair NOT detected")
+    except sync.PotentialDeadlock:
+        pass
+    # declared partial order: violating a declared chain fires on FIRST
+    # acquisition, no prior observation needed
+    sync.reset_lockorder_state()
+    sync.declare_lock_order("outer_x", "inner_y")
+    outer, inner = sync.DebugLock("outer_x"), sync.DebugLock("inner_y")
+    try:
+        with inner:
+            with outer:
+                pass
+        failures.append("declared-order violation NOT detected")
+    except sync.PotentialDeadlock:
+        pass
+    sync.enable_lockorder_debug(False)
+
+    for msg in failures:
+        print("SELF-TEST FAIL:", msg)
+    n = len(expect) + 2
+    print(f"nxlint --self-test: {n - len(failures)}/{n} seeded checks "
+          f"{'pass' if not failures else 'FAILED'}")
+    return 1 if failures else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--self-test" in argv:
+        return run_self_test()
+    findings = run_repo()
+    for f in sorted(findings, key=lambda x: (x.path, x.line)):
+        print(f"{PKG}/{f.path}:{f.line}: [{f.rule}] {f.msg}")
+    print(f"nxlint: {len(load_package_sources())} files, "
+          f"{len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,9 @@ its own three checks built on stdlib ast/symtable/inspect:
    same module* must pass an acceptable number of positional args.
 
 Scope: the packages whose bugs are consensus/funds-affecting —
-core, consensus, chain, script, primitives, crypto, assets.
+core, consensus, chain, script, primitives, crypto, assets — plus the
+serving surfaces the concurrency lint (tools/nxlint.py) annotates:
+pool, net, telemetry.
 
 Run: python tools/typecheck.py   (exit 1 on findings)
 """
@@ -33,7 +35,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 PKG = "nodexa_chain_core_tpu"
 SUBPKGS = ("core", "consensus", "chain", "script", "primitives", "crypto",
-           "assets")
+           "assets", "pool", "net", "telemetry")
 
 _BUILTINS = set(dir(builtins)) | {"__file__", "__name__", "__doc__",
                                   "__package__", "__spec__", "__loader__",
@@ -88,7 +90,14 @@ def check_module_attrs(path: str, tree: ast.Module, mod, errors: list) -> None:
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
-                imported[a.asname or a.name.split(".")[0]] = a.name
+                if a.asname:
+                    # `import a.b as c` binds c -> the a.b module itself
+                    imported[a.asname] = a.name
+                else:
+                    # `import a.b` binds only the ROOT package `a`; an
+                    # attribute walk starts from there (found when the
+                    # net/ scope flagged urllib.request.urlopen)
+                    imported[a.name.split(".")[0]] = a.name.split(".")[0]
         elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
             for a in node.names:
                 if a.name == "*":
