@@ -10,6 +10,26 @@ Short-ID scheme per BIP152: SipHash-2-4 of the txid keyed by the first two
 little-endian uint64s of ``SHA256(header || nonce)``, truncated to 48 bits
 (ref blockencodings.cpp CBlockHeaderAndShortTxIDs::FillShortTxIDSelector /
 GetShortID).
+
+Adversarial surface: every deserializer here parses attacker-controlled
+bytes, so every malformed input — truncated payloads, length prefixes
+that exceed the remaining bytes, absurd index sets — raises the TYPED
+:class:`CompactBlockError` (never a bare ``SerializationError`` escaping
+into the generic processing-error path), and every length prefix is
+validated against the bytes actually present BEFORE any allocation is
+sized from it (bounded resource use: a 5-byte payload cannot make us
+build a million-slot list).
+
+Collision semantics (ref ``READ_STATUS_FAILED`` vs the mempool-match
+loop in PartiallyDownloadedBlock::InitData): a short-id collision is a
+FALLBACK condition, never peer misbehavior — an honest block can contain
+two txids that collide under the announcement's siphash key, and an
+honest mempool can hold a tx colliding with a block tx.  ``init_data``
+distinguishes the two recoverable shapes (ambiguous mempool match →
+leave the slot for the getblocktxn roundtrip; duplicate short ids in the
+announcement itself → unusable, full-block fallback) and reports
+``collisions`` so the caller can label the degradation
+(``nodexa_cmpct_reconstructions_total{result=collision}``).
 """
 
 from __future__ import annotations
@@ -17,7 +37,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..core.serialize import ByteReader, ByteWriter
+from ..core.serialize import ByteReader, ByteWriter, SerializationError
 from ..crypto.hashes import sha256, siphash
 from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
@@ -27,9 +47,22 @@ _rand = FastRandomContext()
 
 SHORTTXIDS_LENGTH = 6  # 48-bit short ids
 
+# hard caps on attacker-sizable lists (a block cannot plausibly carry
+# more transactions than this; the reference bounds the same way via
+# MAX_BLOCK_WEIGHT / MIN_SERIALIZABLE_TRANSACTION_WEIGHT)
+MAX_CMPCT_TXS = 1_000_000
+
 
 class CompactBlockError(Exception):
     pass
+
+
+class ShortIdCollisionError(CompactBlockError):
+    """A short-id collision made the encoding unusable (duplicate short
+    ids in the announcement).  Distinct from structural garbage because
+    BIP152 treats collision as a FALLBACK condition: an honest block can
+    legitimately contain two txids colliding under the announcement key,
+    so the caller degrades to the full-block path and never scores."""
 
 
 def _shortid_keys(header: BlockHeader, nonce: int, schedule) -> Tuple[int, int]:
@@ -67,16 +100,28 @@ class HeaderAndShortIDs:
 
     @classmethod
     def from_block(
-        cls, block: Block, schedule, nonce: Optional[int] = None
+        cls, block: Block, schedule, nonce: Optional[int] = None,
+        prefill_txids=(),
     ) -> "HeaderAndShortIDs":
-        """Prefills only the coinbase, as the reference does when not given
-        extra prefill hints (blockencodings.cpp constructor)."""
+        """Announce-side encoding.  Always prefills the coinbase (the
+        one tx no mempool ever holds); ``prefill_txids`` adds the txs
+        the announcer predicts receivers are missing — typically the
+        ones IT had to fetch through its own getblocktxn roundtrip
+        (ref the constructor's extra-prefill hints in
+        blockencodings.cpp; the reference ships only the coinbase for
+        the shared high-bandwidth encoding, we ship the measured miss
+        set so downstream hops skip the roundtrip entirely)."""
         if nonce is None:
             nonce = _rand.rand64()
         obj = cls(header=block.header, nonce=nonce)
         k0, k1 = _shortid_keys(block.header, nonce, schedule)
-        obj.prefilled = [PrefilledTransaction(0, block.vtx[0])]
-        obj.short_ids = [get_short_id(k0, k1, tx.txid) for tx in block.vtx[1:]]
+        hints = set(prefill_txids)
+        pre = {0} | {i for i, tx in enumerate(block.vtx) if tx.txid in hints}
+        obj.prefilled = [
+            PrefilledTransaction(i, block.vtx[i]) for i in sorted(pre)]
+        obj.short_ids = [
+            get_short_id(k0, k1, tx.txid)
+            for i, tx in enumerate(block.vtx) if i not in pre]
         return obj
 
     def keys(self, schedule) -> Tuple[int, int]:
@@ -100,25 +145,42 @@ class HeaderAndShortIDs:
 
     @classmethod
     def deserialize(cls, r: ByteReader, schedule) -> "HeaderAndShortIDs":
-        header = BlockHeader.deserialize(r, schedule)
-        nonce = r.u64()
-        n = r.compact_size()
-        if n > 1_000_000:
-            raise CompactBlockError("too many short ids")
-        short_ids = [
-            int.from_bytes(r.read(SHORTTXIDS_LENGTH), "little") for _ in range(n)
-        ]
-        prefilled = []
-        last = -1
-        for _ in range(r.compact_size()):
-            delta = r.compact_size()
-            idx = last + delta + 1
-            if idx > 1_000_000:
-                raise CompactBlockError("prefilled index overflow")
-            tx = Transaction.deserialize(r)
-            prefilled.append(PrefilledTransaction(idx, tx))
-            last = idx
-        return cls(header=header, nonce=nonce, short_ids=short_ids, prefilled=prefilled)
+        try:
+            header = BlockHeader.deserialize(r, schedule)
+            nonce = r.u64()
+            n = r.compact_size()
+            if n > MAX_CMPCT_TXS:
+                raise CompactBlockError("too many short ids")
+            # length prefix vs bytes present BEFORE sizing anything
+            if n * SHORTTXIDS_LENGTH > r.remaining():
+                raise CompactBlockError(
+                    f"short-id list truncated: {n} ids, "
+                    f"{r.remaining()} bytes left")
+            short_ids = [
+                int.from_bytes(r.read(SHORTTXIDS_LENGTH), "little")
+                for _ in range(n)
+            ]
+            n_pre = r.compact_size()
+            if n_pre > r.remaining():  # each prefilled tx is >= 1 byte
+                raise CompactBlockError(
+                    f"prefilled list truncated: {n_pre} entries, "
+                    f"{r.remaining()} bytes left")
+            prefilled = []
+            last = -1
+            for _ in range(n_pre):
+                delta = r.compact_size()
+                idx = last + delta + 1
+                if idx > MAX_CMPCT_TXS:
+                    raise CompactBlockError("prefilled index overflow")
+                tx = Transaction.deserialize(r)
+                prefilled.append(PrefilledTransaction(idx, tx))
+                last = idx
+        except SerializationError as e:
+            # truncated/garbage wire bytes are the same typed reject as
+            # a structurally absurd message — never an unhandled error
+            raise CompactBlockError(f"undecodable cmpctblock: {e}") from e
+        return cls(header=header, nonce=nonce, short_ids=short_ids,
+                   prefilled=prefilled)
 
 
 @dataclass
@@ -138,15 +200,26 @@ class BlockTransactionsRequest:
 
     @classmethod
     def deserialize(cls, r: ByteReader) -> "BlockTransactionsRequest":
-        block_hash = r.hash256()
-        indexes = []
-        last = -1
-        for _ in range(r.compact_size()):
-            idx = last + r.compact_size() + 1
-            if idx > 1_000_000:
-                raise CompactBlockError("getblocktxn index overflow")
-            indexes.append(idx)
-            last = idx
+        try:
+            block_hash = r.hash256()
+            n = r.compact_size()
+            if n > MAX_CMPCT_TXS or n > r.remaining():
+                # each differential index is >= 1 byte on the wire: a
+                # count exceeding the remaining payload is absurd by
+                # construction, reject before looping
+                raise CompactBlockError(
+                    f"getblocktxn index count absurd: {n} indexes, "
+                    f"{r.remaining()} bytes left")
+            indexes = []
+            last = -1
+            for _ in range(n):
+                idx = last + r.compact_size() + 1
+                if idx > MAX_CMPCT_TXS:
+                    raise CompactBlockError("getblocktxn index overflow")
+                indexes.append(idx)
+                last = idx
+        except SerializationError as e:
+            raise CompactBlockError(f"undecodable getblocktxn: {e}") from e
         return cls(block_hash=block_hash, indexes=indexes)
 
 
@@ -163,7 +236,11 @@ class BlockTransactions:
 
     @classmethod
     def deserialize(cls, r: ByteReader) -> "BlockTransactions":
-        return cls(block_hash=r.hash256(), txs=r.vector(Transaction.deserialize))
+        try:
+            return cls(block_hash=r.hash256(),
+                       txs=r.vector(Transaction.deserialize))
+        except SerializationError as e:
+            raise CompactBlockError(f"undecodable blocktxn: {e}") from e
 
 
 class PartiallyDownloadedBlock:
@@ -175,11 +252,27 @@ class PartiallyDownloadedBlock:
         self.header: Optional[BlockHeader] = None
         self.block_hash: int = 0
         self._slots: List[Optional[Transaction]] = []
+        # reconstruction provenance, read by the caller's telemetry:
+        # how many slots the live mempool filled, and how many short-id
+        # collisions degraded the attempt (ambiguous mempool matches)
+        self.mempool_filled = 0
+        self.collisions = 0
 
     def init_data(self, cmpct: HeaderAndShortIDs, mempool) -> List[int]:
         """Fill what the mempool has; returns the missing indexes
-        (ref PartiallyDownloadedBlock::InitData).  Raises on short-id
-        collisions the way the reference returns READ_STATUS_FAILED."""
+        (ref PartiallyDownloadedBlock::InitData).
+
+        Collision handling follows the reference's two shapes:
+
+        - duplicate short ids in the ANNOUNCEMENT itself make the whole
+          encoding unusable (we cannot know which slot a matching tx
+          belongs to) — raises, caller falls back to a full block;
+        - two or more MEMPOOL txs matching one announced short id is
+          ambiguous for that slot only — the slot is left missing (the
+          getblocktxn roundtrip resolves it) and counted in
+          ``collisions``, because committing to either candidate would
+          poison the reconstruction with a merkle mismatch.
+        """
         self.header = cmpct.header
         self.block_hash = cmpct.header.get_hash(self.schedule)
         n = cmpct.total_tx_count()
@@ -188,11 +281,13 @@ class PartiallyDownloadedBlock:
         for p in cmpct.prefilled:
             if p.index >= n:
                 raise CompactBlockError("prefilled index out of range")
+            if p.index in prefilled_idx:
+                raise CompactBlockError("duplicate prefilled index")
             self._slots[p.index] = p.tx
             prefilled_idx.add(p.index)
 
         k0, k1 = cmpct.keys(self.schedule)
-        # map short id -> mempool tx; a duplicate short id in the block is
+        # map short id -> slot; a duplicate short id in the block is
         # unusable (collision), matching the reference's failure path
         want: Dict[int, int] = {}  # short id -> slot
         slot = 0
@@ -201,15 +296,32 @@ class PartiallyDownloadedBlock:
                 continue
             sid = cmpct.short_ids[slot]
             if sid in want:
-                raise CompactBlockError("duplicate short id")
+                raise ShortIdCollisionError("duplicate short id")
             want[sid] = i
             slot += 1
 
+        ambiguous: set = set()  # slots with >=2 mempool matches
         for txid in mempool.txids():
             sid = get_short_id(k0, k1, txid)
             i = want.get(sid)
-            if i is not None and self._slots[i] is None:
-                self._slots[i] = mempool.get_tx(txid)
+            if i is None:
+                continue
+            if self._slots[i] is not None:
+                # a second mempool tx collides into an already-matched
+                # slot: neither candidate can be trusted (ref InitData
+                # clearing the slot on a second match).  ``want`` only
+                # maps non-prefilled slots, so the filled entry here is
+                # always a mempool match, never a prefill.
+                if i not in ambiguous:
+                    self._slots[i] = None
+                    self.mempool_filled -= 1
+                    ambiguous.add(i)
+                    self.collisions += 1
+                continue
+            if i in ambiguous:
+                continue  # already voided; further matches stay out
+            self._slots[i] = mempool.get_tx(txid)
+            self.mempool_filled += 1
 
         return [i for i, t in enumerate(self._slots) if t is None]
 

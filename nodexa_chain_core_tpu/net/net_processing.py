@@ -17,7 +17,7 @@ from ..primitives.block import Block, BlockHeader
 from ..primitives.transaction import Transaction
 from ..telemetry import g_metrics, tracing
 from ..utils.logging import LogFlags, log_print
-from ..utils.sync import excludes_lock
+from ..utils.sync import DebugLock, excludes_lock
 from . import protocol
 from ..crypto.chacha20 import FastRandomContext
 from .blockencodings import (
@@ -26,6 +26,7 @@ from .blockencodings import (
     CompactBlockError,
     HeaderAndShortIDs,
     PartiallyDownloadedBlock,
+    ShortIdCollisionError,
 )
 from .protocol import (
     INV_BLOCK,
@@ -128,7 +129,7 @@ _M_ROTATED = g_metrics.counter(
 _M_PROP_EVICT = g_metrics.counter(
     "nodexa_propagation_map_evictions_total",
     "Entries evicted from the bounded propagation-tracking maps, "
-    "labeled by map (first_seen|trace_ctx|spans)")
+    "labeled by map (first_seen|trace_ctx|spans|prefill)")
 # relay-efficiency ledger: announcements offered vs wanted and the
 # duplicate-inv pressure peers put on us (dedup=duplicate means the
 # inv named something we already had)
@@ -137,12 +138,32 @@ _M_RELAY_INVS = g_metrics.counter(
     "Inventory announcements, labeled by direction (sent|recv) and "
     "dedup (new|duplicate)")
 # compact-block reconstruction readiness: mempool = rebuilt with zero
-# round trips, roundtrip = needed getblocktxn, full_fallback = short-id
-# collision forced a full-block getdata
+# round trips, roundtrip = needed getblocktxn, collision = a short-id
+# collision degraded the attempt (duplicate ids in the announcement,
+# an ambiguous mempool match, or a merkle mismatch after mempool fill —
+# BIP152 semantics: collision is FALLBACK, never misbehavior),
+# full_fallback = any other full-block fallback (unusable blocktxn)
 _M_CMPCT_RECON = g_metrics.counter(
     "nodexa_cmpct_reconstructions_total",
     "Compact-block reconstruction outcomes, labeled by result "
-    "(mempool|roundtrip|full_fallback)")
+    "(mempool|roundtrip|collision|full_fallback)")
+# announce-side prefill selection effectiveness: how many txs beyond the
+# coinbase each compact announcement carried inline (the predicted miss
+# set — 0 steady-state when peers' mempools are warm)
+_M_CMPCT_PREFILL = g_metrics.histogram(
+    "nodexa_cmpct_prefilled_txs",
+    "Transactions prefilled per compact-block announcement (beyond "
+    "the coinbase)",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64))
+
+# announce-side caps: how many predicted-missing txs ride inline in a
+# compact announcement, and how many recent encodings stay cached for
+# getdata re-serves (ref most_recent_compact_block, depth-bounded)
+MAX_CMPCT_PREFILL = 16
+CMPCT_CACHE_DEPTH = 8
+# serve getblocktxn only for recent blocks; deeper requests get the
+# full block instead (ref MAX_BLOCKTXN_DEPTH = 10)
+MAX_BLOCKTXN_DEPTH = 10
 
 # provider-side snapshot chunk budget: a peer draining chunks faster
 # than this is throttled (requests dropped, counted) — one bootstrapping
@@ -193,6 +214,18 @@ class NetProcessor:
         self.first_seen_cap = _FIRST_SEEN_CAP
         self._remote_trace_ctx: dict = {}   # block_hash -> (trace_id, span)
         self._prop_spans: dict = {}         # block_hash -> TraceSpan
+        # compact-relay state: the shared encoding cache (one serialize
+        # per block serves every high-bandwidth announce AND every
+        # getdata(MSG_CMPCT_BLOCK) re-request — ref
+        # most_recent_compact_block) and the announce-side prefill
+        # hints: the txids THIS node had to fetch to reconstruct a
+        # block, i.e. the measured miss set its downstream peers most
+        # likely share.  The cache is written on the validation/msghand
+        # announce path and read on the msghand getdata path — in the
+        # live daemon those are different threads, hence the lock.
+        self._cmpct_cache_lock = DebugLock("net.cmpct_cache")
+        self._cmpct_cache: dict = {}        # block_hash -> payload bytes
+        self._cmpct_prefill: dict = {}      # block_hash -> tuple(txids)
         # -snapshotpeers: assumeUTXO snapshot transfer capability (serve
         # AND fetch); the manager itself lives on node.snapshot_mgr
         self.snapshot_peers = False
@@ -533,16 +566,37 @@ class NetProcessor:
                         peer.known_txs.add(tx.txid)
                         peer.send_msg(self.magic, MSG_TX, tx.to_bytes())
             elif inv.type in (INV_BLOCK, INV_CMPCT_BLOCK):
+                if inv.type == INV_CMPCT_BLOCK:
+                    # the announce path cached its shared encoding: a
+                    # re-request costs a dict hit, not a block read +
+                    # re-serialize (ref most_recent_compact_block)
+                    with self._cmpct_cache_lock:
+                        cached = self._cmpct_cache.get(inv.hash)
+                    if cached is not None:
+                        peer.send_msg(self.magic, MSG_CMPCTBLOCK, cached)
+                        continue
                 idx = self.node.chainstate.lookup(inv.hash)
                 if idx is not None and idx.status & 8:  # HAVE_DATA
                     block = self.node.chainstate.read_block(idx)
                     w = ByteWriter()
                     if inv.type == INV_CMPCT_BLOCK:
+                        # cache miss (evicted, or never announced by
+                        # us): build with the same prefill hints the
+                        # announce path would use and cache the result,
+                        # so both paths serve one consistent encoding
                         cmpct = HeaderAndShortIDs.from_block(
-                            block, self.node.params.algo_schedule
+                            block, self.node.params.algo_schedule,
+                            prefill_txids=self._cmpct_prefill.get(
+                                inv.hash, ()),
                         )
                         cmpct.serialize(w, self.node.params.algo_schedule)
-                        peer.send_msg(self.magic, MSG_CMPCTBLOCK, w.getvalue())
+                        payload = w.getvalue()
+                        with self._cmpct_cache_lock:
+                            self._cmpct_cache[inv.hash] = payload
+                            while len(self._cmpct_cache) > CMPCT_CACHE_DEPTH:
+                                del self._cmpct_cache[
+                                    next(iter(self._cmpct_cache))]
+                        peer.send_msg(self.magic, MSG_CMPCTBLOCK, payload)
                     else:
                         block.serialize(w, self.node.params.algo_schedule)
                         peer.send_msg(self.magic, MSG_BLOCK, w.getvalue())
@@ -700,8 +754,12 @@ class NetProcessor:
 
     # -- in-flight block accounting (ref mapBlocksInFlight) ---------------
 
-    def _mark_block_requested(self, peer, block_hash: int) -> None:
-        now = self._clock()
+    def _mark_block_requested(self, peer, block_hash: int,
+                              since=None) -> None:
+        """``since``: carry an EARLIER request's timestamp onto the
+        replacement (a superseding compact announcement must not reset
+        the sender's own stall clock)."""
+        now = self._clock() if since is None else min(since, self._clock())
         peer.blocks_in_flight.add(block_hash)
         times = peer.__dict__.setdefault("block_request_times", {})
         times[block_hash] = now
@@ -998,6 +1056,20 @@ class NetProcessor:
         self._clear_block_request(peer, h)
         peer.known_blocks.add(h)
         cs = self.node.chainstate
+        # prefill hint capture must happen BEFORE connect (connecting
+        # removes the block's txs from the mempool, after which every
+        # tx looks missing): the txs we did NOT have are what our own
+        # compact announcement of this block should carry inline
+        mempool = self.node.mempool
+        hint = []
+        for tx in block.vtx[1:]:
+            if not mempool.contains(tx.txid):
+                hint.append(tx.txid)
+                if len(hint) >= MAX_CMPCT_PREFILL:
+                    break
+        if hint:
+            self._evicting_insert(
+                self._cmpct_prefill, h, tuple(hint), "prefill")
         old_tip = cs.tip().block_hash
         v_t0 = time.perf_counter() if tracing.enabled() else None
         try:
@@ -1280,8 +1352,11 @@ class NetProcessor:
             self._rotate_downloads(stalled)
 
     def _rotate_downloads(self, hashes, exclude=None) -> None:
-        """Re-request released blocks from other peers, preferring ones
-        whose announced best chain actually contains each block."""
+        """Re-request released blocks from other peers, preferring
+        ANNOUNCERS (peers that told us about the block — the withheld-
+        blocktxn adversary's replacement must be someone who actually
+        claims to have the data), then peers whose announced best chain
+        contains the block, then round-robin."""
         cs = self.node.chainstate
         peers = [p for p in self.connman.all_peers()
                  if p.handshake_done and not p.disconnect
@@ -1300,12 +1375,17 @@ class NetProcessor:
                 continue  # arrived through another path meanwhile
             target = None
             for p in peers:
-                best = getattr(p, "best_known_header", None)
-                if (idx is not None and best is not None
-                        and best.height >= idx.height
-                        and best.get_ancestor(idx.height) is idx):
+                if h in p.known_blocks:
                     target = p
                     break
+            if target is None:
+                for p in peers:
+                    best = getattr(p, "best_known_header", None)
+                    if (idx is not None and best is not None
+                            and best.height >= idx.height
+                            and best.get_ancestor(idx.height) is idx):
+                        target = p
+                        break
             if target is None:
                 target = peers[i % len(peers)]
             self._getdata_block(target, h)
@@ -1492,17 +1572,39 @@ class NetProcessor:
             self.misbehaving(peer, 100, f"bad-cmpctblock-header:{e.code}")
             return
         # a newer compact announcement supersedes any stalled one: release
-        # the stale in-flight slot so the download window can't be wedged
+        # the stale in-flight slot so the download window can't be wedged.
+        # The stall clock CARRIES OVER to the replacement request: a
+        # withholding adversary that re-announces (same hash, or
+        # alternating phantoms) every few seconds would otherwise reset
+        # its own stall timer forever and never be rotated away
+        stall_since = None
         if peer.partial_block is not None:
-            self._clear_block_request(peer, peer.partial_block.block_hash)
+            old_h = peer.partial_block.block_hash
+            if old_h == h:
+                # duplicate announcement: the getblocktxn is already
+                # outstanding and its stall clock keeps aging — nothing
+                # to redo (and nothing for the sender to reset)
+                return
+            stall_since = peer.block_request_times.get(old_h)
+            self._clear_block_request(peer, old_h)
             peer.partial_block = None
         partial = PartiallyDownloadedBlock(schedule)
         try:
             missing = partial.init_data(cmpct, self.node.mempool)
-        except CompactBlockError:
-            # short-id collision: request the full block
-            _M_CMPCT_RECON.inc(result="full_fallback")
-            self._getdata_block(peer, h)
+        except ShortIdCollisionError:
+            # duplicate short ids in the announcement: the encoding is
+            # unusable, degrade to the full block.  NEVER scored — an
+            # honest block can collide two txids under the key, and a
+            # nonce-grinding adversary forcing this path is only buying
+            # itself the bandwidth of a full block (BIP152 semantics:
+            # collision is fallback, not misbehavior)
+            _M_CMPCT_RECON.inc(result="collision")
+            self._getdata_block(peer, h, since=stall_since)
+            return
+        except CompactBlockError as e:
+            # structural garbage (out-of-range / duplicate prefilled
+            # indices): no honest encoder produces this — typed reject
+            self.misbehaving(peer, 100, f"bad-cmpctblock-structure:{e}")
             return
         if not missing:
             block = partial.fill_block([])
@@ -1511,18 +1613,23 @@ class NetProcessor:
             _M_CMPCT_RECON.inc(result="mempool")
             log_print(LogFlags.NET, "cmpctblock %s reconstructed from mempool",
                       u256_hex(h)[:16])
-            self._finish_compact(peer, block, h)
+            self._finish_compact(peer, block, h,
+                                 mempool_filled=partial.mempool_filled)
             return
         log_print(LogFlags.NET, "cmpctblock %s missing %d txs, getblocktxn",
                   u256_hex(h)[:16], len(missing))
         peer.blocktxn_roundtrips = getattr(
             peer, "blocktxn_roundtrips", 0) + 1
-        _M_CMPCT_RECON.inc(result="roundtrip")
+        # ambiguous mempool matches degraded the attempt into (extra)
+        # roundtrip legs: label the degradation so a collision flood is
+        # visible as a collision-rate spike, not a mystery roundtrip rise
+        _M_CMPCT_RECON.inc(
+            result="collision" if partial.collisions else "roundtrip")
         peer.partial_block = partial
         req = BlockTransactionsRequest(block_hash=h, indexes=missing)
         w = ByteWriter()
         req.serialize(w)
-        self._mark_block_requested(peer, h)
+        self._mark_block_requested(peer, h, since=stall_since)
         peer.send_msg(self.magic, MSG_GETBLOCKTXN, w.getvalue())
 
     def _on_getblocktxn(self, peer, r: ByteReader) -> None:
@@ -1534,20 +1641,39 @@ class NetProcessor:
         cs = self.node.chainstate
         idx = cs.lookup(req.block_hash)
         if idx is None or not (idx.status & 8):
+            # we never announced a block we don't have: a getblocktxn
+            # for an unknown hash is the peer probing or confused —
+            # typed reject, small score (ref the reference's
+            # peer-sent-us-nonsense handling), bounded cost (no read)
+            self.misbehaving(peer, 10, "getblocktxn-unknown-block")
+            return
+        if cs.tip().height - idx.height > MAX_BLOCKTXN_DEPTH:
+            # deep historical requests would make us an index-serving
+            # oracle; the reference answers with the full block instead
+            # (ref MAX_BLOCKTXN_DEPTH handling in ProcessGetBlockTxn)
+            block = cs.read_block(idx)
+            w = ByteWriter()
+            block.serialize(w, self.node.params.algo_schedule)
+            peer.send_msg(self.magic, MSG_BLOCK, w.getvalue())
             return
         block = cs.read_block(idx)
-        try:
-            txs = [block.vtx[i] for i in req.indexes]
-        except IndexError:
+        if req.indexes and req.indexes[-1] >= len(block.vtx):
+            # indexes are strictly increasing by construction: checking
+            # the last bounds them all (typed reject, no partial serve)
             self.misbehaving(peer, 100, "getblocktxn-index-oob")
             return
+        txs = [block.vtx[i] for i in req.indexes]
         resp = BlockTransactions(block_hash=req.block_hash, txs=txs)
         w = ByteWriter()
         resp.serialize(w)
         peer.send_msg(self.magic, MSG_BLOCKTXN, w.getvalue())
 
     def _on_blocktxn(self, peer, r: ByteReader) -> None:
-        resp = BlockTransactions.deserialize(r)
+        try:
+            resp = BlockTransactions.deserialize(r)
+        except CompactBlockError as e:
+            self.misbehaving(peer, 100, f"bad-blocktxn:{e}")
+            return
         self._clear_block_request(peer, resp.block_hash)
         partial = peer.partial_block
         if partial is None or partial.block_hash != resp.block_hash:
@@ -1556,11 +1682,27 @@ class NetProcessor:
         try:
             block = partial.fill_block(resp.txs)
         except CompactBlockError:
-            self._getdata_block(peer, resp.block_hash)
+            # the peer answered our getblocktxn with the wrong NUMBER of
+            # transactions: its data is unusable.  Not scored (ref the
+            # reference re-requesting the full block on READ_STATUS
+            # failures), but the full-block request ROTATES to another
+            # announcer — re-asking the peer that just answered wrong
+            # hands a withholding adversary a second stall window
+            _M_CMPCT_RECON.inc(result="full_fallback")
+            self._fallback_full_block(resp.block_hash, bad_peer=peer)
             return
-        self._finish_compact(peer, block, resp.block_hash)
+        # the fetched txids are this node's measured miss set: the best
+        # available prediction of what ITS peers are missing too — ship
+        # them prefilled in our own announcement of this block
+        self._evicting_insert(
+            self._cmpct_prefill, resp.block_hash,
+            tuple(tx.txid for tx in resp.txs[:MAX_CMPCT_PREFILL]),
+            "prefill")
+        self._finish_compact(peer, block, resp.block_hash,
+                             mempool_filled=partial.mempool_filled)
 
-    def _finish_compact(self, peer, block, block_hash: int) -> None:
+    def _finish_compact(self, peer, block, block_hash: int,
+                        mempool_filled: int = 0) -> None:
         # only a merkle mismatch (mempool reconstruction hit a short-id
         # collision) is excusable — re-request the full block; any other
         # invalidity is the block itself and punishes like MSG_BLOCK
@@ -1574,7 +1716,19 @@ class NetProcessor:
             cs.process_new_block(block)
         except BlockValidationError as e:
             if e.code in ("bad-txnmrklroot", "bad-txns-duplicate"):
-                self._getdata_block(peer, block_hash)
+                if mempool_filled:
+                    # a mempool tx short-id-collided into a slot the
+                    # block's real tx should have held: OUR
+                    # reconstruction is poisoned, the peer may be
+                    # blameless — degrade to the full block, never
+                    # score, and label the collision
+                    _M_CMPCT_RECON.inc(result="collision")
+                    self._getdata_block(peer, block_hash)
+                else:
+                    # nothing came from our mempool, so the mismatch is
+                    # in the peer's own data: unusable, rotate away
+                    _M_CMPCT_RECON.inc(result="full_fallback")
+                    self._fallback_full_block(block_hash, bad_peer=peer)
             else:
                 self.misbehaving(peer, 100, f"bad-block:{e.code}")
             return
@@ -1585,10 +1739,25 @@ class NetProcessor:
             self.announce_block(cs.tip().block_hash)
         self._request_missing_blocks(peer)
 
-    def _getdata_block(self, peer, block_hash: int) -> None:
+    def _fallback_full_block(self, block_hash: int, bad_peer) -> None:
+        """Request the full block, preferring a DIFFERENT announcer than
+        the peer whose compact data just proved unusable (PR 9 stall
+        machinery owns the case where the replacement also never
+        answers: the in-flight entry this marks is what check_stalls
+        rotates)."""
+        target = None
+        for p in self.connman.all_peers():
+            if (p is not bad_peer and p.handshake_done and not p.disconnect
+                    and block_hash in p.known_blocks):
+                target = p
+                break
+        self._getdata_block(target if target is not None else bad_peer,
+                            block_hash)
+
+    def _getdata_block(self, peer, block_hash: int, since=None) -> None:
         w = ByteWriter()
         w.vector([Inv(INV_BLOCK, block_hash)], lambda wr, i: i.serialize(wr))
-        self._mark_block_requested(peer, block_hash)
+        self._mark_block_requested(peer, block_hash, since=since)
         peer.send_msg(self.magic, MSG_GETDATA, w.getvalue())
 
     def _on_feefilter(self, peer, r: ByteReader) -> None:
@@ -1651,16 +1820,28 @@ class NetProcessor:
         cs = self.node.chainstate
         idx = cs.lookup(block_hash)
         # one shared compact encoding serves every high-bandwidth peer
-        # (ref most_recent_compact_block caching in net_processing.cpp)
+        # AND later getdata(MSG_CMPCT_BLOCK) re-requests (ref
+        # most_recent_compact_block caching in net_processing.cpp).
+        # Prefill selection: the coinbase plus the txids THIS node had
+        # to fetch through its own reconstruction roundtrip (or found
+        # absent from its mempool on a full-block receive) — the
+        # measured miss set its downstream peers most likely share.
         cmpct_payload = None
         if idx is not None and idx.status & 8:
             block = cs.read_block(idx)
+            hints = self._cmpct_prefill.get(block_hash, ())
             cmpct = HeaderAndShortIDs.from_block(
-                block, self.node.params.algo_schedule
+                block, self.node.params.algo_schedule,
+                prefill_txids=hints,
             )
+            _M_CMPCT_PREFILL.observe(len(cmpct.prefilled) - 1)
             w = ByteWriter()
             cmpct.serialize(w, self.node.params.algo_schedule)
             cmpct_payload = w.getvalue()
+            with self._cmpct_cache_lock:
+                self._cmpct_cache[block_hash] = cmpct_payload
+                while len(self._cmpct_cache) > CMPCT_CACHE_DEPTH:
+                    del self._cmpct_cache[next(iter(self._cmpct_cache))]
         sp = ctx = None
         relay_t0 = 0.0
         if tracing.enabled():

@@ -33,7 +33,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from ..crypto.chacha20 import FastRandomContext
@@ -85,12 +85,53 @@ class LinkSpec:
     drop_commands: FrozenSet[str] = frozenset()  # blackhole these
 
 
+def random_topology(n: int, degree: int, rng: FastRandomContext):
+    """Ring + random chords up to ~``degree`` per node, as an ordered
+    pair list.  Factored out of ``SimNet.connect_random`` so the sharded
+    harness and the single-threaded baseline build the IDENTICAL graph
+    from the same seed (the pair list, in order, is the topology's
+    deterministic identity)."""
+    pairs = [(i, (i + 1) % n) for i in range(n)]
+    have: Set[Tuple[int, int]] = set(pairs) | {(b, a) for a, b in pairs}
+    deg: Dict[int, int] = {}
+    for a, b in pairs:
+        deg[a] = deg.get(a, 0) + 1
+        deg[b] = deg.get(b, 0) + 1
+    for i in range(n):
+        d = deg.get(i, 0)
+        tries = 0
+        while d < degree and tries < 8 * degree:
+            tries += 1
+            j = rng.randrange(n)
+            if j == i or (i, j) in have:
+                continue
+            pairs.append((i, j))
+            have.add((i, j))
+            have.add((j, i))
+            deg[i] = deg.get(i, 0) + 1
+            deg[j] = deg.get(j, 0) + 1
+            d += 1
+    return pairs
+
+
+def link_rng(seed: int, a: int, b: int) -> FastRandomContext:
+    """Per-link-direction RNG for jitter/drop draws, seeded purely by
+    (net seed, sender, receiver): a link's wire randomness is identical
+    no matter which harness — or which SHARD of the sharded harness —
+    executes the send, which is what makes the sharded run's delivery
+    times comparable to the single-threaded run's."""
+    return FastRandomContext(
+        seed=seed.to_bytes(8, "little") + a.to_bytes(4, "little")
+        + b.to_bytes(4, "little") + b"link")
+
+
 class _Link:
     __slots__ = ("a", "b", "specs", "partitioned", "busy_until",
                  "reconnect_delay", "reconnect_pending", "endpoints",
-                 "faults")
+                 "faults", "rngs", "last_deliver")
 
-    def __init__(self, a: int, b: int, spec_ab: LinkSpec, spec_ba: LinkSpec):
+    def __init__(self, a: int, b: int, spec_ab: LinkSpec, spec_ba: LinkSpec,
+                 seed: int = 0):
         self.a = a
         self.b = b
         self.specs = {a: spec_ab, b: spec_ba}  # keyed by SENDING node
@@ -102,6 +143,14 @@ class _Link:
         self.reconnect_delay = RECONNECT_BASE_S
         self.reconnect_pending = False
         self.endpoints: tuple = ()
+        # per-direction deterministic wire randomness (see link_rng)
+        self.rngs = {a: link_rng(seed, a, b), b: link_rng(seed, b, a)}
+        # per-direction FIFO watermark: P2P links are TCP streams, so a
+        # jittered message must never overtake an earlier one in the
+        # same direction (reordering would, e.g., land sendcmpct before
+        # verack and fabricate handshake misbehavior that no real
+        # socket can produce)
+        self.last_deliver = {a: 0.0, b: 0.0}
         # per-direction fault ledger (keyed by SENDING node): how many
         # messages this link's fault model actually ate — surfaced via
         # SimNet.link_stats() and the propagation report so "the graph
@@ -203,6 +252,16 @@ class SimNode:
             seed=net.seed.to_bytes(8, "little") + index.to_bytes(8, "little"))
         self.processor._local_nonce = self.processor._rand.rand64()
         self.processor.orphanage._rand = self.processor._rand
+        # addrman randomness too: its unseeded nKey steers bucket
+        # placement/eviction, so an unseeded addrman makes ADDR gossip
+        # payload SIZES run-dependent at N>=100 — the one determinism
+        # hole the small-N suites never tripped (safe to re-key here:
+        # nothing has been added yet)
+        am = self.connman.addrman
+        am._rand = FastRandomContext(
+            seed=net.seed.to_bytes(8, "little")
+            + index.to_bytes(8, "little") + b"addrman")
+        am._key = am._rand.rand64()
         for attr, val in net.tunables.items():
             setattr(self.processor, attr, val)
 
@@ -214,12 +273,22 @@ class SimNode:
         return self.node.chainstate.tip().block_hash
 
 
-@dataclass(order=True)
-class _Event:
-    t: float
-    seq: int
-    kind: str = field(compare=False)
-    data: tuple = field(compare=False)
+# events are plain tuples (t, seq, kind, data): tuple comparison is
+# C-speed, which matters when the heap churns hundreds of thousands of
+# entries in an N=500 run (the old order=True dataclass paid a Python-
+# level __lt__ per sift)
+_EV_T, _EV_SEQ, _EV_KIND, _EV_DATA = 0, 1, 2, 3
+
+
+class _NodeMap(dict):
+    """Node registry keyed by GLOBAL node index that still iterates
+    like the list it replaced (``for node in net.nodes``): a plain
+    SimNet holds indices 0..n-1, a shard of the sharded harness holds
+    only its own group's indices — either way ``net.nodes[i]`` is the
+    node with global index ``i``."""
+
+    def __iter__(self):
+        return iter(self.values())
 
 
 class SimNet:
@@ -234,7 +303,8 @@ class SimNet:
                  auto_reconnect: bool = True,
                  tunables: Optional[dict] = None,
                  observe: Optional[bool] = None,
-                 wire_stats: bool = True):
+                 wire_stats: bool = True,
+                 node_indices=None):
         from ..node.chainparams import select_params
 
         self.seed = seed
@@ -262,15 +332,25 @@ class SimNet:
         }
         if tunables:
             self.tunables.update(tunables)
-        self._events: List[_Event] = []
+        self._events: List[tuple] = []
         self._seq = 0
         self.event_log: List[tuple] = []
         self.links: List[_Link] = []
         self.block_times: Dict[int, float] = {}      # hash -> mined-at
         self.tip_times: Dict[Tuple[int, int], float] = {}  # (node,hash)->t
         self.events_dispatched = 0
-        self.nodes = [SimNode(self, i) for i in range(n_nodes)]
-        for i in range(n_nodes):
+        # tip-change listeners: (node_index, new_tip_hash, sim_t) fired
+        # at the exact dispatch moment a node's tip moves — the sharded
+        # coordinator's O(1) convergence tally and the pool share-
+        # traffic model both ride this instead of polling every node
+        self.tip_listeners: List = []
+        # node_indices: the GLOBAL indices this instance owns (the
+        # sharded harness builds one SimNet-alike per node group);
+        # default = the whole network 0..n-1
+        indices = (list(node_indices) if node_indices is not None
+                   else list(range(n_nodes)))
+        self.nodes = _NodeMap((i, SimNode(self, i)) for i in indices)
+        for i in indices:
             self._push(self.clock() + periodic_interval_s,
                        "periodic", (i, periodic_interval_s))
             self._push(self.clock() + ping_interval_s,
@@ -300,7 +380,7 @@ class SimNet:
         ``spec``)."""
         assert i != j
         spec = spec or self.default_spec
-        link = _Link(i, j, spec, spec_back or spec)
+        link = _Link(i, j, spec, spec_back or spec, seed=self.seed)
         self.links.append(link)
         self._establish(link)
         return link
@@ -347,22 +427,8 @@ class SimNet:
     def connect_random(self, degree: int = 4,
                        spec: Optional[LinkSpec] = None) -> None:
         """Ring (connectivity guarantee) + random chords up to ~degree."""
-        n = len(self.nodes)
-        self.connect_ring(spec)
-        have: Set[Tuple[int, int]] = {(l.a, l.b) for l in self.links}
-        have |= {(b, a) for a, b in have}
-        for i in range(n):
-            deg = sum(1 for l in self.links if i in (l.a, l.b))
-            tries = 0
-            while deg < degree and tries < 8 * degree:
-                tries += 1
-                j = self.rng.randrange(n)
-                if j == i or (i, j) in have:
-                    continue
-                self.connect(i, j, spec)
-                have.add((i, j))
-                have.add((j, i))
-                deg += 1
+        for i, j in random_topology(len(self.nodes), degree, self.rng):
+            self.connect(i, j, spec)
 
     def enable_snapshots(self, chunk_timeout_s: float = 3.0,
                          bv_blocks_per_tick: int = 4) -> None:
@@ -409,7 +475,15 @@ class SimNet:
 
     def _push(self, t: float, kind: str, data: tuple) -> None:
         self._seq += 1
-        heapq.heappush(self._events, _Event(t, self._seq, kind, data))
+        heapq.heappush(self._events, (t, self._seq, kind, data))
+
+    def call_at(self, t: float, fn) -> None:
+        """Schedule ``fn()`` at sim time ``t`` — the scenario-side timer
+        primitive (share arrivals, delayed job cuts).  Runs inside the
+        dispatch loop, so anything it does lands at exactly ``t`` on
+        the deterministic timeline; never logged into the digest's
+        event log (only wire deliveries are)."""
+        self._push(t, "call", (fn,))
 
     def _enqueue_msg(self, src_peer: SimPeer, command: str,
                      payload: bytes, size: int) -> None:
@@ -424,13 +498,13 @@ class SimNet:
         if command in spec.drop_commands:
             link.faults[sender]["blackholed"] += 1
             return
-        if spec.drop_rate and self.rng.random() < spec.drop_rate:
+        if spec.drop_rate and link.rngs[sender].random() < spec.drop_rate:
             link.faults[sender]["dropped"] += 1
             return
         now = self.clock()
         delay = spec.latency_s
         if spec.jitter_s:
-            delay += self.rng.random() * spec.jitter_s
+            delay += link.rngs[sender].random() * spec.jitter_s
         queue_s = 0.0
         if spec.bandwidth_bps:
             start = max(now, link.busy_until[sender])
@@ -441,6 +515,9 @@ class SimNet:
         else:
             tx = 0.0
             deliver = now + delay
+        # TCP FIFO: never overtake an earlier message in this direction
+        deliver = max(deliver, link.last_deliver[sender])
+        link.last_deliver[sender] = deliver
         # the exact per-message wire decomposition rides the event (the
         # observer's raw material); None when nobody is watching.  The
         # event LOG (what the digest hashes) never sees it.
@@ -450,30 +527,34 @@ class SimNet:
 
     # -- dispatch ----------------------------------------------------------
 
-    def _dispatch(self, ev: _Event) -> None:
+    def _dispatch(self, ev: tuple) -> None:
         self.events_dispatched += 1
-        if ev.kind == "msg":
-            peer, command, payload, size, wire = ev.data
+        kind, data = ev[_EV_KIND], ev[_EV_DATA]
+        if kind == "msg":
+            peer, command, payload, size, wire = data
             self._deliver(peer, command, payload, size, wire)
-        elif ev.kind == "close":
-            (peer,) = ev.data
+        elif kind == "close":
+            (peer,) = data
             if not peer._closed:
                 peer.disconnect = True
                 self._close_endpoint(peer)
-        elif ev.kind == "periodic":
-            i, interval = ev.data
+        elif kind == "periodic":
+            i, interval = data
             node = self.nodes[i]
             node.processor.periodic()
             self._sweep(node)
-            self._push(self.clock() + interval, "periodic", ev.data)
-        elif ev.kind == "ping":
-            i, interval = ev.data
+            self._push(self.clock() + interval, "periodic", data)
+        elif kind == "ping":
+            i, interval = data
             node = self.nodes[i]
             node.processor.send_pings()
             self._sweep(node)
-            self._push(self.clock() + interval, "ping", ev.data)
-        elif ev.kind == "reconnect":
-            (link,) = ev.data
+            self._push(self.clock() + interval, "ping", data)
+        elif kind == "call":
+            (fn,) = data
+            fn()
+        elif kind == "reconnect":
+            (link,) = data
             link.reconnect_pending = False
             if link.partitioned or self._link_alive(link):
                 return
@@ -511,6 +592,8 @@ class SimNet:
         tip_after = node.tip_hash()
         if tip_after != tip_before:
             self.tip_times[(node.index, tip_after)] = self.clock()
+            for cb in self.tip_listeners:
+                cb(node.index, tip_after, self.clock())
             if obs is not None:
                 # the delivering message IS the hop's final wire leg:
                 # its exact (queue, serialize, latency) plus the wall
@@ -567,11 +650,11 @@ class SimNet:
             return True
         while self._events:
             ev = self._events[0]
-            if ev.t > deadline:
+            if ev[_EV_T] > deadline:
                 break
             heapq.heappop(self._events)
-            if ev.t > self.clock():
-                self.clock.t = ev.t
+            if ev[_EV_T] > self.clock():
+                self.clock.t = ev[_EV_T]
             self._dispatch(ev)
             if cond is not None and cond():
                 return True
@@ -610,6 +693,8 @@ class SimNet:
         h = cs.tip().block_hash
         self.block_times[h] = self.clock()
         self.tip_times[(node_index, h)] = self.clock()
+        for cb in self.tip_listeners:
+            cb(node_index, h, self.clock())
         if self.observer is not None:
             self.observer.note_origin(node_index, h, self.clock())
         node.processor.announce_block(h)
@@ -621,6 +706,35 @@ class SimNet:
     def mine_chain(self, node_index: int, n_blocks: int,
                    advance_s: float = 30.0) -> List[int]:
         return [self.mine_block(node_index, advance_s) for _ in range(n_blocks)]
+
+    def feed_chain(self, blocks, node_indices=None) -> None:
+        """Connect a pre-built block sequence directly into each node's
+        chainstate (no wire traffic): the cheap way to stand a fleet on
+        a deep common chain — e.g. one with matured coinbases so
+        mempool-warm scenarios have real spendable transactions —
+        without simulating a 100-block IBD per node.  Advances the sim
+        clock past the fed tip's timestamp so subsequently mined blocks
+        pass median-time-past."""
+        max_time = 0
+        targets = (self.nodes if node_indices is None
+                   else [self.nodes[i] for i in node_indices])
+        for node in targets:
+            for blk in blocks:
+                node.chainstate.process_new_block(blk)
+            max_time = max(max_time, node.chainstate.tip().header.time)
+        if self.clock() <= max_time:
+            self.clock.advance(max_time + 60.0 - self.clock())
+
+    def inject_tx(self, node_index: int, tx) -> None:
+        """Submit a transaction at a node through the PRODUCTION
+        admission path and relay it into the simulated network (the
+        local-wallet-broadcast analogue)."""
+        from ..chain.mempool_accept import accept_to_memory_pool
+
+        node = self.nodes[node_index]
+        accept_to_memory_pool(node.node.chainstate, node.node.mempool, tx)
+        node.processor.relay_transaction(tx)
+        self._sweep(node)
 
     # -- inspection --------------------------------------------------------
 
@@ -826,3 +940,188 @@ class FleetObserver:
                 sum(c["e2e_s"] for c in chains) / n * 1000, 3),
             "recon_err_max": round(max(c["recon_err"] for c in chains), 4),
         }
+
+
+def peer_toward(node: SimNode, remote_index: int):
+    """The SimPeer endpoint ``node`` holds toward ``remote_index``
+    (None when no live link exists) — scenario-side plumbing for
+    crafting traffic from a specific node."""
+    for p in node.connman.all_peers():
+        if getattr(p, "_remote_index", None) == remote_index:
+            return p
+    return None
+
+
+def craft_compact_announcement(node: SimNode, short_txids,
+                               nonce: int = 7,
+                               time_skew: int = 0) -> bytes:
+    """Adversary-side tooling: a CMPCTBLOCK payload whose header is a
+    REAL freshly-mined (regtest-PoW-valid, contextually connectable)
+    block on ``node``'s tip, but whose short-id list is whatever the
+    attacker wants — here, the short ids of ``short_txids`` under the
+    announcement's own siphash key.  Pointing those at a victim's
+    mempool txids is the BIP152 collision flood: the victim's
+    reconstruction fills plausible-looking transactions, the merkle
+    root refutes them, and the relay path must degrade to the full-
+    block fallback without scoring anyone."""
+    from ..mining.assembler import BlockAssembler, mine_block_cpu
+    from .blockencodings import (
+        HeaderAndShortIDs, PrefilledTransaction, get_short_id)
+    from ..core.serialize import ByteWriter
+
+    sched = node.node.params.algo_schedule
+    blk = BlockAssembler(node.chainstate).create_new_block(
+        b"\x51", ntime=int(node.node.chainstate.tip().header.time)
+        + 60 + time_skew)
+    assert mine_block_cpu(blk, sched, max_tries=1 << 22), \
+        "regtest PoW failed"
+    cmpct = HeaderAndShortIDs(header=blk.header, nonce=nonce)
+    cmpct.prefilled = [PrefilledTransaction(0, blk.vtx[0])]
+    k0, k1 = cmpct.keys(sched)
+    cmpct.short_ids = [get_short_id(k0, k1, t) for t in short_txids]
+    w = ByteWriter()
+    cmpct.serialize(w, sched)
+    return w.getvalue()
+
+
+class PoolShareTraffic:
+    """Pool-facing share traffic over the harness: what stale-share
+    dynamics look like at network scale.
+
+    Each sampled node gets a REAL :class:`..pool.jobs.JobManager`
+    (``clock=net.clock``, ``era_gate=False``, never ``start()``ed — no
+    thread, no process-global bus registration; the harness drives its
+    tip updates per node), and a deterministic miner model submits one
+    share per ``share_interval_s`` of sim time against the job that the
+    pool last *notified* (not the freshest assemblable one — a real
+    miner works the job it was handed).  Tip changes ride the harness's
+    ``tip_listeners`` hook, so ``JobManager.tip_changed_at`` is stamped
+    at the exact sim moment the node's tip moved, and the job cut
+    reaches the miner one ``notify_latency_s`` later — the window in
+    which submitted shares are STALE, judged by the production
+    ``JobManager.is_stale`` lineage and observed on the production
+    ``nodexa_pool_stale_share_lag_seconds`` histogram.
+
+    Two loss classes come out of one run:
+
+    - ``stale``: shares rejected because the local tip had already
+      moved (notify latency + miner turnaround) — what the stratum
+      server's reject path measures;
+    - ``wasted`` (:meth:`wasted_count`): shares *accepted* by the local
+      pool while a newer block was already mined elsewhere and still in
+      flight — work the network will orphan, the loss class that scales
+      with PROPAGATION DELAY and that the N=500 harness exists to
+      measure.
+    """
+
+    def __init__(self, net: SimNet, node_indices,
+                 share_interval_s: float = 0.5,
+                 notify_latency_s: float = 0.05):
+        from ..pool.jobs import JobManager
+
+        self.net = net
+        self.share_interval_s = share_interval_s
+        self.notify_latency_s = notify_latency_s
+        self.mgrs: Dict[int, object] = {}
+        self.live_job: Dict[int, object] = {}   # what the miner works on
+        self.stats: Dict[int, Dict[str, int]] = {}
+        self.share_log: List[tuple] = []        # (t, node, verdict)
+        for i in node_indices:
+            node = net.nodes[i]
+            mgr = JobManager(node.node, b"\x51", clock=net.clock,
+                             era_gate=False)
+            self.mgrs[i] = mgr
+            self.live_job[i] = mgr.new_job(clean=True)
+            self.stats[i] = {"accepted": 0, "stale": 0}
+            self._schedule_share(i)
+        net.tip_listeners.append(self._on_tip)
+
+    def detach(self) -> None:
+        """Stop producing events (pending timers become no-ops)."""
+        if self._on_tip in self.net.tip_listeners:
+            self.net.tip_listeners.remove(self._on_tip)
+        self.mgrs = {}
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _on_tip(self, node_index: int, tip_hash: int, t: float) -> None:
+        mgr = self.mgrs.get(node_index)
+        if mgr is None:
+            return
+        # the production stamp: every outstanding job went stale NOW
+        mgr.updated_block_tip(None, None, False)
+        # the miner keeps hammering the superseded job until the notify
+        # fanout reaches it — exactly the stale window the stratum
+        # server attributes with the lag histogram
+        self.net.call_at(t + self.notify_latency_s,
+                         lambda i=node_index: self._cut_job(i))
+
+    def _cut_job(self, i: int) -> None:
+        mgr = self.mgrs.get(i)
+        if mgr is None:
+            return
+        job = mgr.new_job(clean=True)
+        if job is not None:
+            self.live_job[i] = job
+
+    def _schedule_share(self, i: int) -> None:
+        self.net.call_at(self.net.clock() + self.share_interval_s,
+                         lambda: self._submit(i))
+
+    def _submit(self, i: int) -> None:
+        mgr = self.mgrs.get(i)
+        if mgr is None:
+            return  # detached; let the timer chain die
+        self._schedule_share(i)
+        job = self.live_job.get(i)
+        if job is None:
+            return
+        now = self.net.clock()
+        if mgr.is_stale(job):
+            # the server's reject path: observe the production lag
+            # histogram through the job manager's clock domain
+            from ..pool.server import _M_STALE_LAG
+
+            lag = max(0.0, mgr._clock() - mgr.tip_changed_at)
+            _M_STALE_LAG.observe(lag)
+            self.stats[i]["stale"] += 1
+            self.share_log.append((now, i, "stale"))
+        else:
+            self.stats[i]["accepted"] += 1
+            self.share_log.append((now, i, "accepted"))
+
+    # -- analysis ----------------------------------------------------------
+
+    def totals(self) -> dict:
+        acc = sum(s["accepted"] for s in self.stats.values())
+        stale = sum(s["stale"] for s in self.stats.values())
+        total = acc + stale
+        return {
+            "accepted": acc,
+            "stale": stale,
+            "stale_rate": (stale / total) if total else 0.0,
+        }
+
+    def wasted_count(self) -> int:
+        """Accepted shares that were already doomed when submitted: a
+        newer block existed (mined somewhere) that the submitting node
+        had not accepted yet — work on a tip the network had already
+        superseded.  This is the loss class proportional to propagation
+        delay."""
+        wasted = 0
+        blocks = list(self.net.block_times.items())
+        for t, i, verdict in self.share_log:
+            if verdict != "accepted":
+                continue
+            for bh, t_mine in blocks:
+                if t_mine <= t:
+                    t_loc = self.net.tip_times.get((i, bh))
+                    # only blocks the node EVENTUALLY accepted count —
+                    # a share is wasted when the superseding block was
+                    # in flight toward this node, not when the other
+                    # side of a reorg race (which this node's chain
+                    # beat) was still wandering the graph
+                    if t_loc is not None and t_loc > t:
+                        wasted += 1
+                        break
+        return wasted

@@ -82,12 +82,19 @@ class JobManager(ValidationInterface):
     a background scheduler)."""
 
     def __init__(self, node, payout_script: bytes,
-                 refresh_interval_s: float = 10.0, clock=time.time):
+                 refresh_interval_s: float = 10.0, clock=time.time,
+                 era_gate: bool = True):
         self.node = node
         # injectable clock (the PR 9 clock= discipline: job lineage,
         # refresh throttling and stale-lag stamps must follow the
         # driving node's clock, never the wall, under netsim)
         self._clock = clock
+        # era_gate=False: the netsim pool suites study job lineage and
+        # stale-share dynamics on plain-regtest chains whose clock never
+        # reaches the KawPow era — everything else (assembler, lineage,
+        # stale judgment, nonce claims, lag stamps) stays the production
+        # path.  The live daemon always constructs with the gate on.
+        self.era_gate = era_gate
         self.payout_script = payout_script
         self.refresh_interval_s = refresh_interval_s
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()
@@ -197,7 +204,7 @@ class JobManager(ValidationInterface):
         block = BlockAssembler(node.chainstate).create_new_block(
             self.payout_script, extra_nonce=extra
         )
-        if not sched.is_kawpow(block.header.time):
+        if self.era_gate and not sched.is_kawpow(block.header.time):
             if not self._warned_era:
                 self._warned_era = True
                 log_printf(

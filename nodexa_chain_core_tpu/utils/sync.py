@@ -287,6 +287,7 @@ KNOWN_LOCKS = (
     "notifications",
     "connman.peers",
     "peer.send",
+    "net.cmpct_cache",
     "pool.sessions",
     "pool.session.send",
     "pool.banned",

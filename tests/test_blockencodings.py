@@ -7,11 +7,13 @@ import pytest
 from nodexa_chain_core_tpu.chain.mempool import MempoolEntry, TxMemPool
 from nodexa_chain_core_tpu.core.serialize import ByteReader, ByteWriter
 from nodexa_chain_core_tpu.net.blockencodings import (
+    SHORTTXIDS_LENGTH,
     BlockTransactions,
     BlockTransactionsRequest,
     CompactBlockError,
     HeaderAndShortIDs,
     PartiallyDownloadedBlock,
+    ShortIdCollisionError,
     get_short_id,
 )
 from nodexa_chain_core_tpu.node.chainparams import regtest_params
@@ -148,3 +150,162 @@ def test_differential_index_encoding():
 def test_get_short_id_deterministic():
     assert get_short_id(1, 2, 0xABCDEF) == get_short_id(1, 2, 0xABCDEF)
     assert get_short_id(1, 2, 0xABCDEF) != get_short_id(1, 3, 0xABCDEF)
+
+
+# -- adversarial wire surface: every malformed input is a TYPED reject
+# (CompactBlockError), never an unhandled SerializationError -------------
+
+
+def test_truncated_shortid_list_typed_reject(setup):
+    """A count prefix claiming more short ids than the payload carries
+    must reject BEFORE sizing any allocation from it."""
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=9)
+    w = ByteWriter()
+    cmpct.serialize(w, sched)
+    raw = bytearray(w.getvalue())
+    # locate the short-id count byte (compact size, < 253 here) right
+    # after header+nonce, and inflate it wildly
+    hdr_w = ByteWriter()
+    block.header.serialize(hdr_w, sched)
+    off = len(hdr_w.getvalue()) + 8
+    assert raw[off] == len(cmpct.short_ids)
+    raw[off : off + 1] = b"\xfe\x40\x42\x0f\x00"  # claim 1,000,000 ids
+    with pytest.raises(CompactBlockError):
+        HeaderAndShortIDs.deserialize(ByteReader(bytes(raw)), sched)
+
+
+def test_truncated_payload_typed_reject(setup):
+    """Chopping the payload anywhere still raises the typed error."""
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=9)
+    w = ByteWriter()
+    cmpct.serialize(w, sched)
+    raw = w.getvalue()
+    for cut in (10, len(raw) // 2, len(raw) - 3):
+        with pytest.raises(CompactBlockError):
+            HeaderAndShortIDs.deserialize(ByteReader(raw[:cut]), sched)
+    with pytest.raises(CompactBlockError):
+        BlockTransactions.deserialize(ByteReader(b"\x00" * 10))
+    with pytest.raises(CompactBlockError):
+        BlockTransactionsRequest.deserialize(ByteReader(b"\x00" * 5))
+
+
+def test_getblocktxn_absurd_index_count_typed_reject():
+    """An index count exceeding the remaining payload bytes (each index
+    is >= 1 wire byte) is absurd by construction."""
+    w = ByteWriter()
+    w.hash256(7)
+    w.write(b"\xfe\x40\x42\x0f\x00")  # claims 1,000,000 indexes
+    w.write(b"\x00" * 4)              # ...with 4 bytes of payload
+    with pytest.raises(CompactBlockError):
+        BlockTransactionsRequest.deserialize(ByteReader(w.getvalue()))
+
+
+def test_duplicate_prefilled_index_typed_reject(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=9)
+    # two prefilled entries landing on the same slot (delta encoding
+    # cannot produce this from an honest encoder; init_data must still
+    # reject it without an unhandled exception)
+    cmpct.prefilled = [
+        type(cmpct.prefilled[0])(0, block.vtx[0]),
+        type(cmpct.prefilled[0])(0, block.vtx[1]),
+    ]
+    partial = PartiallyDownloadedBlock(sched)
+    with pytest.raises(CompactBlockError):
+        partial.init_data(cmpct, TxMemPool())
+
+
+def test_prefilled_index_out_of_range_typed_reject(setup):
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=9)
+    cmpct.prefilled[0].index = cmpct.total_tx_count() + 5
+    partial = PartiallyDownloadedBlock(sched)
+    with pytest.raises(CompactBlockError):
+        partial.init_data(cmpct, TxMemPool())
+
+
+def test_duplicate_short_id_is_collision_not_structure(setup):
+    """The duplicate-short-id failure is the TYPED collision subclass —
+    the caller's cue to fall back without scoring."""
+    params, block, txs = setup
+    sched = params.algo_schedule
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    cmpct.short_ids[1] = cmpct.short_ids[0]
+    partial = PartiallyDownloadedBlock(sched)
+    with pytest.raises(ShortIdCollisionError):
+        partial.init_data(cmpct, TxMemPool())
+
+
+# -- collision semantics: ambiguous mempool matches -----------------------
+
+
+def test_ambiguous_mempool_match_leaves_slot_for_roundtrip(setup,
+                                                           monkeypatch):
+    """Two mempool txs colliding into one announced short id: the slot
+    must be left MISSING (the getblocktxn roundtrip resolves it), the
+    collision counted, and the roundtrip must reconstruct the block
+    bit-exact — the honest-collision path that must never punish."""
+    params, block, txs = setup
+    sched = params.algo_schedule
+    # coarse short ids make collisions constructible: 8-bit space
+    from nodexa_chain_core_tpu.net import blockencodings as be
+
+    monkeypatch.setattr(be, "get_short_id",
+                        lambda k0, k1, txid: txid & 0xFF)
+    pool = TxMemPool()
+    for tx in txs:
+        pool.add(MempoolEntry(tx=tx, fee=100, time=0, height=1))
+    # a decoy whose txid collides with txs[0] under the coarse id
+    # (txids are hashes: grind seeds until the low byte matches)
+    decoy = next(
+        tx for tx in (make_tx(1000 + i) for i in range(4096))
+        if tx.txid & 0xFF == txs[0].txid & 0xFF and tx.txid != txs[0].txid)
+    pool.add(MempoolEntry(tx=decoy, fee=100, time=0, height=1))
+
+    cmpct = be.HeaderAndShortIDs.from_block(block, sched, nonce=7)
+    partial = be.PartiallyDownloadedBlock(sched)
+    missing = partial.init_data(cmpct, pool)
+    assert missing == [1], f"ambiguous slot not left missing: {missing}"
+    assert partial.collisions == 1
+    assert partial.mempool_filled == len(txs) - 1
+    rebuilt = partial.fill_block([block.vtx[1]])
+    assert rebuilt.get_hash() == block.get_hash()
+
+
+# -- announce-side prefill selection --------------------------------------
+
+
+def test_prefill_selection_roundtrip(setup):
+    """from_block(prefill_txids=...) ships the predicted miss set
+    inline; the receiver's init_data honors arbitrary prefilled slots
+    and the short-id list covers exactly the rest."""
+    params, block, txs = setup
+    sched = params.algo_schedule
+    hint = {txs[1].txid, txs[3].txid}
+    cmpct = HeaderAndShortIDs.from_block(block, sched, nonce=5,
+                                         prefill_txids=hint)
+    assert [p.index for p in cmpct.prefilled] == [0, 2, 4]
+    assert len(cmpct.short_ids) == len(block.vtx) - 3
+    w = ByteWriter()
+    cmpct.serialize(w, sched)
+    c2 = HeaderAndShortIDs.deserialize(ByteReader(w.getvalue()), sched)
+    assert [p.index for p in c2.prefilled] == [0, 2, 4]
+    assert c2.short_ids == cmpct.short_ids
+    # a cold mempool now only misses the NON-prefilled txs
+    partial = PartiallyDownloadedBlock(sched)
+    missing = partial.init_data(c2, TxMemPool())
+    assert missing == [1, 3, 5]
+    rebuilt = partial.fill_block([block.vtx[i] for i in missing])
+    assert rebuilt.get_hash() == block.get_hash()
+
+
+def test_wire_size_bounds():
+    """Sanity: the short-id list length prefix is validated against
+    SHORTTXIDS_LENGTH-sized entries, not trusted."""
+    assert SHORTTXIDS_LENGTH == 6

@@ -19,7 +19,10 @@ from nodexa_chain_core_tpu.core.serialize import (
     ByteWriter,
     SerializationError,
 )
-from nodexa_chain_core_tpu.net.blockencodings import HeaderAndShortIDs
+from nodexa_chain_core_tpu.net.blockencodings import (
+    CompactBlockError,
+    HeaderAndShortIDs,
+)
 from nodexa_chain_core_tpu.net.protocol import Inv, NetAddr, VersionPayload
 from nodexa_chain_core_tpu.primitives.block import Block, BlockHeader
 from nodexa_chain_core_tpu.primitives.transaction import Transaction
@@ -27,6 +30,7 @@ from nodexa_chain_core_tpu.script.script import Script
 
 OK_ERRORS = (
     SerializationError,
+    CompactBlockError,  # blockencodings' typed reject for hostile bytes
     ValueError,
     EOFError,
     IndexError,

@@ -1,0 +1,181 @@
+"""Pool-facing netsim scenarios (ISSUE 15 tentpole b).
+
+Drives the PRODUCTION ``JobManager`` (clock-disciplined, threadless,
+``era_gate=False`` — everything else is the live code path) over the
+harness: stale-share rate as a function of propagation delay, pool
+behavior across competing tips, and safe-mode entry with live peers
+(the PR 5 ladder must never ban the peer set).
+"""
+
+from nodexa_chain_core_tpu.net.netsim import (
+    LinkSpec,
+    PoolShareTraffic,
+    SimNet,
+    peer_toward,
+)
+from nodexa_chain_core_tpu.net.protocol import MSG_TX
+from nodexa_chain_core_tpu.primitives.transaction import (
+    OutPoint,
+    Transaction,
+    TxIn,
+    TxOut,
+)
+from nodexa_chain_core_tpu.telemetry import g_metrics
+
+# pool/server owns nodexa_pool_stale_share_lag_seconds (help text AND
+# bucket layout): import it before any bare histogram handle so a
+# collection-order accident can't re-register the family bare
+from nodexa_chain_core_tpu.pool import server as _pool_server  # noqa: F401
+
+
+def _pool_run(latency_s: float, seed: int, blocks: int = 3) -> dict:
+    """One scripted run: shares arrive continuously at every node while
+    blocks propagate across a ring with the given latency."""
+    with SimNet(6, seed=seed,
+                default_spec=LinkSpec(latency_s=latency_s)) as net:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.run(2.0)
+        pool = PoolShareTraffic(net, range(6), share_interval_s=0.25,
+                                notify_latency_s=0.05)
+        for b in range(blocks):
+            net.mine_block(b % 6, advance_s=0.5)
+            assert net.run_until(net.converged, 120.0)
+            net.run(6.0)  # steady state between blocks
+        out = dict(pool.totals())
+        out["wasted"] = pool.wasted_count()
+        out["jobs_fresh"] = all(
+            not mgr.is_stale(pool.live_job[i])
+            for i, mgr in pool.mgrs.items())
+        pool.detach()
+        return out
+
+
+def test_stale_share_rate_tracks_propagation_delay():
+    """Higher link latency => more doomed work: the stale+wasted share
+    loss must grow with propagation delay, and after steady state every
+    pool's live job must be built on the converged tip."""
+    fast = _pool_run(latency_s=0.01, seed=61)
+    slow = _pool_run(latency_s=0.4, seed=61)
+    for r in (fast, slow):
+        assert r["accepted"] > 0
+        assert r["jobs_fresh"], "a pool kept serving a stale job"
+    loss_fast = (fast["stale"] + fast["wasted"]) / (
+        fast["accepted"] + fast["stale"])
+    loss_slow = (slow["stale"] + slow["wasted"]) / (
+        slow["accepted"] + slow["stale"])
+    assert loss_slow > loss_fast, (
+        f"share loss did not grow with latency: "
+        f"fast={loss_fast:.3f} slow={loss_slow:.3f}")
+
+
+def test_stale_lag_histogram_observed():
+    """Stale rejects ride the production lag histogram
+    (nodexa_pool_stale_share_lag_seconds), stamped through the job
+    manager's injected sim clock."""
+    lag = g_metrics.histogram("nodexa_pool_stale_share_lag_seconds")
+    snap0 = lag.snapshot()
+    c0 = snap0["count"] if snap0 else 0
+    with SimNet(4, seed=62,
+                default_spec=LinkSpec(latency_s=0.05)) as net:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.run(2.0)
+        # a LONG notify latency guarantees shares land in the stale
+        # window right after each tip flip
+        pool = PoolShareTraffic(net, range(4), share_interval_s=0.1,
+                                notify_latency_s=1.0)
+        for b in range(2):
+            net.mine_block(b, advance_s=0.5)
+            assert net.run_until(net.converged, 60.0)
+            net.run(3.0)
+        totals = pool.totals()
+        pool.detach()
+    assert totals["stale"] > 0
+    snap1 = lag.snapshot()
+    assert snap1 is not None and snap1["count"] - c0 >= totals["stale"]
+    # lags are sim-scale (sub-notify-latency-ish), not wall-epoch junk:
+    # the mean of the new observations must be small sim seconds
+    mean = (snap1["sum"] - (snap0["sum"] if snap0 else 0)) / (
+        snap1["count"] - c0)
+    assert 0.0 <= mean < 10.0, f"stale lag mean {mean} not sim-scale"
+
+
+def test_pool_across_competing_tips():
+    """A partitioned network mines competing tips; pools on both sides
+    serve their OWN tip's jobs, and after the heal every pool flips to
+    the winning chain (clean job on the unified tip) — with the losing
+    side's shares going stale, never anyone banned."""
+    with SimNet(6, seed=63) as net:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.run(2.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        pool = PoolShareTraffic(net, range(6), share_interval_s=0.25)
+        net.run(4.0)
+        net.partition({0, 1})
+        net.mine_block(0, advance_s=1.0)     # light side: 1 block
+        net.mine_chain(3, 2, advance_s=1.0)  # heavy side: 2 blocks
+        net.run(6.0)
+        # both sides' pools serve their own tip while forked
+        assert not pool.mgrs[0].is_stale(pool.live_job[0])
+        assert not pool.mgrs[3].is_stale(pool.live_job[3])
+        tip_light = net.nodes[0].tip_hash()
+        tip_heavy = net.nodes[3].tip_hash()
+        assert tip_light != tip_heavy
+        net.heal()
+        assert net.run_until(net.converged, 240.0), "heal did not converge"
+        net.run(4.0)  # let the notify latency pass everywhere
+        heavy = net.nodes[3].tip_hash()
+        for i, mgr in pool.mgrs.items():
+            job = pool.live_job[i]
+            assert job.prev_hash == heavy, \
+                f"pool {i} still serving a job off the losing tip"
+            assert not mgr.is_stale(job)
+        totals = pool.totals()
+        pool.detach()
+        assert totals["stale"] > 0, \
+            "the reorg produced no stale shares (nothing was measured)"
+        assert net.ban_count() == 0
+        assert net.max_misbehavior() == 0
+
+
+def test_safe_mode_with_live_peers():
+    """PR 5 ladder under netsim: a degraded node keeps its whole peer
+    set alive — relayed txs are refused without scoring, pings flow,
+    nobody is banned — and the fleet converges after recovery."""
+    from nodexa_chain_core_tpu.node.health import g_health
+
+    with SimNet(5, seed=64) as net:
+        net.connect_ring()
+        assert net.settle(30.0)
+        net.run(2.0)
+        net.mine_block(0)
+        assert net.run_until(net.converged, 60.0)
+        magic = net.nodes[0].node.params.message_start
+        try:
+            g_health.critical_error("netsim.pool-suite",
+                                    OSError(28, "injected"))
+            # live peers keep relaying txs into the degraded fleet:
+            # admission refuses (safe-mode) and must never score them
+            tx = Transaction(
+                vin=[TxIn(prevout=OutPoint(txid=0x51, n=0))],
+                vout=[TxOut(value=1, script_pubkey=b"\x51")])
+            for i in (1, 3):
+                p = peer_toward(net.nodes[i], (i + 1) % 5)
+                if p is not None:
+                    p.send_msg(magic, MSG_TX, tx.to_bytes())
+            net.run(12.0)  # pings + periodics while degraded
+            assert net.ban_count() == 0, "safe mode banned a live peer"
+            assert net.max_misbehavior() == 0, \
+                "safe mode scored a live peer"
+            alive = [len(n.connman.all_peers()) for n in net.nodes]
+            assert all(c >= 2 for c in alive), \
+                f"the peer set shrank while degraded: {alive}"
+        finally:
+            g_health.reset_for_tests()
+        net.mine_block(2)
+        assert net.run_until(net.converged, 60.0), \
+            "fleet did not converge after safe-mode recovery"
+        assert net.ban_count() == 0

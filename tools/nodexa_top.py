@@ -273,6 +273,16 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         recon_line = " ".join(
             f"{k}={int(v)}" for k, v in sorted(recon.items()) if v
         ) or "none"
+        # reconstruction hit rate: zero-roundtrip rebuilds over all
+        # attempts (mempool-warm readiness; collisions are the
+        # adversarial/bad-luck degradation, never misbehavior)
+        recon_total = sum(recon.values())
+        hit = (recon.get("mempool", 0.0) / recon_total
+               if recon_total else 0.0)
+        hit_s = f"{hit:.0%}" if recon_total else "-"
+        colls = int(recon.get("collision", 0))
+        coll_warn = (f"  {YELLOW}collisions={colls}{RESET}"
+                     if colls else "")
         evics = int(series_total(
             snap, "nodexa_propagation_map_evictions_total"))
         warn = f"  {YELLOW}prop-evictions={evics}{RESET}" if evics else ""
@@ -280,7 +290,7 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
             f"  relay: invs sent {int(inv_sent)} recv {int(inv_new + inv_dup)} "
             f"(dup {dup_ratio:.0%})   inv rate "
             f"{rate('nodexa_relay_invs_total', direction='sent')}   "
-            f"cmpct [{recon_line}]{warn}")
+            f"cmpct hit {hit_s} [{recon_line}]{coll_warn}{warn}")
     else:
         lines.append("  relay: -")
 
