@@ -569,6 +569,35 @@ def bench_txflood() -> dict:
     }
 
 
+def bench_contention() -> dict:
+    """Lock-contention ledger lane: the admission flood + compact-relay +
+    pool job-cutter + share-check threads storm cs_main concurrently
+    with the ledger armed, proving wait/hold/blame attribution, plus an
+    interleaved ledger-on/off overhead pin on the quiet flood (CI floor
+    0.95x).  Details in nodexa_chain_core_tpu/bench/contention.py."""
+    from nodexa_chain_core_tpu.bench.contention import storm
+
+    t = time.perf_counter()
+    threads = min(2, max(1, os.cpu_count() or 1))
+    res = storm(threads=threads)
+    top = res["blame_top"] or {}
+    log(f"[contention] cs_main wait share {res['cs_main_wait_share']} "
+        f"across {len(res['contention_roles'])} roles "
+        f"({res['cs_main_acquisitions']} acquisitions); top blame "
+        f"{top.get('waiter_role')}<-{top.get('holder_role')}"
+        f"@{top.get('holder_site')}; ledger overhead "
+        f"{res['lockstats_overhead_ratio']}x "
+        f"({time.perf_counter()-t:.1f}s total)")
+    return {
+        "csmain_wait_share": res["cs_main_wait_share"],
+        "csmain_wait_share_by_role": res["cs_main_wait_share_by_role"],
+        "csmain_hold_by_site": res["cs_main_hold_by_site"],
+        "contention_roles": len(res["contention_roles"]),
+        "lockstats_overhead_ratio": res["lockstats_overhead_ratio"],
+        "lock_blame_edges": res["blame_edges"],
+    }
+
+
 def bench_netsim() -> dict:
     """Block propagation across a simulated 50-node network (net/netsim
     harness: real NodeContexts, in-memory links, deterministic clock).
@@ -700,6 +729,8 @@ def main() -> None:
         extra.update(bench_snapshot())
     if not os.environ.get("NODEXA_BENCH_SKIP_TXFLOOD"):
         extra.update(bench_txflood())
+    if not os.environ.get("NODEXA_BENCH_SKIP_CONTENTION"):
+        extra.update(bench_contention())
     if not os.environ.get("NODEXA_BENCH_SKIP_POOL"):
         extra.update(bench_pool())
     if not os.environ.get("NODEXA_BENCH_SKIP_MESH"):

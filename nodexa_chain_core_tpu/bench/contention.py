@@ -1,0 +1,313 @@
+"""Concurrent lock-contention storm: the ledger's calibration lane and
+the before/after oracle for ROADMAP item 5 (shard cs_main).
+
+Reuses the txflood chain builder, then runs the admission flood together
+with the other cs_main customers *concurrently*, each on a thread named
+for its production role (the PR 11 profiler prefixes):
+
+- ``net.msghand-N``   staged mempool admission (role ``validation``)
+- ``pool-jobs-storm`` job-template cutting via BlockAssembler (the
+  stratum cutter's CreateNewBlock path, role ``pool-jobs``)
+- ``pool-shares-storm`` share-validation tip reads under cs_main (the
+  job-freshness / prevhash check, role ``pool-shares``)
+- ``net.relay-storm`` compact-relay tip reads under cs_main (role
+  ``net``)
+
+Two phases share the lane:
+
+1. **Overhead pin** — the plain admission flood (no aux storm, stock
+   switch interval) runs ``--repeats`` times per ledger mode,
+   INTERLEAVED (off, on, off, on, ...) with max-of-N per mode — same
+   discipline as txflood: clock drift is one-sided noise.  The storm
+   itself is too scheduler-noisy (±10% per-run walls) to resolve a few
+   percent of instrumentation cost; the quiet flood is the same
+   acquisition mix per tx and resolves it cleanly.
+2. **Attribution storm** — the flood + relay + pool-shares + job-cutter
+   threads run concurrently with the ledger ARMED, proving wait/hold/
+   blame attribution under real cross-role contention.
+
+Reported (also used by bench.py and tools/ci_gate.sh):
+
+- ``cs_main_wait_share``          total cs_main wait seconds / armed
+  storm wall (0.38 reads "38% of a wall-second spent blocked")
+- ``cs_main_wait_share_by_role``  the same, per waiter role
+- ``cs_main_hold_by_site``        hold-seconds decomposition by
+  acquisition site (top sites first)
+- ``contention_roles``            roles that acquired cs_main under storm
+- ``lockstats_overhead_ratio``    ledger-on / ledger-off accepts/s on
+  the pin flood — CI floor >= 0.95x (the ledger must be cheap enough
+  to stay armed by default)
+- ``blame_top``                   the heaviest getlockstats blame edge
+
+Run: ``python -m nodexa_chain_core_tpu.bench.contention [--assert-observed]``
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+
+from ..telemetry import g_metrics
+
+
+def _storm_once(cs, lists, spk_raw, ntime: int, threads: int,
+                aux: bool = True) -> dict:
+    """One concurrent run: the admission flood, plus (``aux``) the
+    relay / pool-shares / job-cutter threads riding on cs_main.
+    Returns the admission throughput — the workload metric the
+    overhead pin compares across ledger modes (``aux=False``)."""
+    from ..chain.mempool import TxMemPool
+    from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
+    from ..mining.assembler import BlockAssembler
+    from ..script.sigcache import signature_cache
+
+    signature_cache.clear()
+    pool = TxMemPool()
+    cs.mempool = pool  # the cutter assembles from the flood's mempool
+    asm = BlockAssembler(cs)
+    n_total = sum(len(tl) for tl in lists)
+    errors = []
+    stop = threading.Event()
+    n_aux = 3 if aux else 0
+    start = threading.Barrier(threads + n_aux + 1)
+
+    def submit(txs):
+        start.wait()
+        for tx in txs:
+            try:
+                accept_to_memory_pool(cs, pool, tx, staged=True)
+            except MempoolAcceptError as e:  # flood txs are all valid
+                errors.append((tx.txid, e.code))
+
+    def cut_jobs():
+        start.wait()
+        while not stop.is_set():
+            asm.create_new_block(spk_raw, ntime=ntime)
+            time.sleep(0.002)
+
+    def check_shares():
+        start.wait()
+        while not stop.is_set():
+            with cs.cs_main:
+                cs.tip()  # job-freshness / share-prevhash check
+            time.sleep(0.001)
+
+    def relay_reads():
+        start.wait()
+        while not stop.is_set():
+            with cs.cs_main:
+                cs.tip()  # compact-relay prefill check
+            time.sleep(0.001)
+
+    workers = [threading.Thread(target=submit, args=(tl,), daemon=True,
+                                name=f"net.msghand-{i}")
+               for i, tl in enumerate(lists)]
+    if aux:
+        workers += [
+            threading.Thread(target=cut_jobs, daemon=True,
+                             name="pool-jobs-storm"),
+            threading.Thread(target=check_shares, daemon=True,
+                             name="pool-shares-storm"),
+            threading.Thread(target=relay_reads, daemon=True,
+                             name="net.relay-storm"),
+        ]
+    for w in workers:
+        w.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for w in workers[:threads]:  # the flood bounds the storm
+        w.join()
+    stop.set()
+    wall = time.perf_counter() - t0
+    for w in workers[threads:]:
+        w.join()
+    if errors:
+        raise RuntimeError(
+            f"storm rejects: {errors[:4]} (+{max(0, len(errors) - 4)})")
+    if pool.size() != n_total:
+        raise RuntimeError(f"pool holds {pool.size()} != {n_total} accepted")
+    return {
+        "txs": n_total,
+        "wall_s": round(wall, 4),
+        "accepts_per_s": round(n_total / wall, 1),
+    }
+
+
+def _family_sums(name: str, group_label: str, lock: str = "cs_main"):
+    """(total, {group_label value -> sum-seconds}) over one histogram or
+    counter family, filtered to ``lock``."""
+    fam = g_metrics.get(name)
+    total, by = 0.0, {}
+    if fam is None:
+        return total, by
+    for key, val in fam.collect():
+        d = dict(key)
+        if d.get("lock") != lock:
+            continue
+        v = val[1] if isinstance(val, tuple) else val  # histogram: sum
+        total += v
+        g = d.get(group_label, "unknown")
+        by[g] = by.get(g, 0.0) + v
+    return total, by
+
+
+def storm(n_txs: int = 192, threads: int = 2, repeats: int = 5) -> dict:
+    from ..rpc.misc import getlockstats
+    from ..telemetry.lockstats import (
+        enable_lockstats, reset_lockstats_for_tests)
+    from .txflood import build_flood
+
+    import sys
+
+    params, cs, lists, _fixtures = build_flood(n_txs, threads)
+    spk_raw = lists[0][0].vout[0].script_pubkey
+    ntime = cs.tip().header.time + 60
+
+    # ---- phase 1: overhead pin on the quiet admission flood ----------
+    def measure_pin() -> dict:
+        best = {"off": None, "on": None}
+        for rep in range(max(1, repeats)):
+            # alternate the pair order so a monotonic machine slowdown
+            # (thermal, noisy neighbor) biases neither mode
+            for mode in (("off", "on") if rep % 2 == 0 else ("on", "off")):
+                enable_lockstats(mode == "on")
+                try:
+                    r = _storm_once(cs, lists, spk_raw, ntime, threads,
+                                    aux=False)
+                finally:
+                    enable_lockstats(False)
+                if best[mode] is None or \
+                        r["accepts_per_s"] > best[mode]["accepts_per_s"]:
+                    best[mode] = r
+        return best
+
+    best = measure_pin()
+
+    def ratio_of(b: dict) -> float:
+        return (b["on"]["accepts_per_s"]
+                / max(b["off"]["accepts_per_s"], 1e-9))
+
+    if ratio_of(best) < 0.95:
+        # one retry, same discipline as tools/profile_check.py: a
+        # scheduler stall across every on-round of the first pass can
+        # invert a 5% bound on a busy CI host; a REAL overhead
+        # regression reproduces
+        best = measure_pin()
+
+    # ---- phase 2: armed attribution storm ----------------------------
+    reset_lockstats_for_tests()  # families measure the storm, not phase 1
+    on_wall = 0.0
+    storm_runs = []
+    lockstats_rpc = None
+    # CPython's default 5ms switch interval hides sub-ms holds from the
+    # other threads entirely (a waiter only observes contention if the
+    # scheduler preempts mid-hold); a daemon does real blocking I/O under
+    # its locks, so storm with an aggressive interval to make preemption
+    # — and thus genuine lock contention — representative.  The overhead
+    # pin is NOT measured here (phase 1 ran at the stock interval), so
+    # the extra scheduler churn only helps attribution coverage.
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0002)
+    try:
+        enable_lockstats(True)
+        for _ in range(2):
+            r = _storm_once(cs, lists, spk_raw, ntime, threads)
+            storm_runs.append(r)
+            on_wall += r["wall_s"]
+        # round-trip THROUGH the RPC handler while armed: the lane
+        # proves getlockstats itself, not just the ledger internals
+        lockstats_rpc = getlockstats(None, [])
+    finally:
+        enable_lockstats(False)
+        sys.setswitchinterval(old_switch)
+
+    wait_total, wait_by_role = _family_sums(
+        "nodexa_lock_wait_seconds", "role")
+    hold_total, hold_by_site = _family_sums(
+        "nodexa_lock_hold_seconds", "site")
+    acq_total, acq_by_role = _family_sums(
+        "nodexa_lock_acquisitions_total", "role")
+    on_wall = max(on_wall, 1e-9)
+    ranked_sites = sorted(hold_by_site.items(), key=lambda kv: -kv[1])
+    blame = (lockstats_rpc or {}).get("blame", [])
+    return {
+        "pin_flood_on": best["on"],
+        "pin_flood_off": best["off"],
+        "storm": max(storm_runs, key=lambda r: r["accepts_per_s"]),
+        "cs_main_wait_share": round(wait_total / on_wall, 4),
+        "cs_main_wait_share_by_role": {
+            r: round(s / on_wall, 4)
+            for r, s in sorted(wait_by_role.items())},
+        "cs_main_hold_seconds": round(hold_total, 4),
+        "cs_main_hold_by_site": {
+            s: round(sec, 4) for s, sec in ranked_sites[:8]},
+        "cs_main_acquisitions": int(acq_total),
+        "contention_roles": sorted(acq_by_role),
+        "lockstats_overhead_ratio": round(ratio_of(best), 3),
+        "blame_edges": len(blame),
+        "blame_top": blame[0] if blame else None,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    # 192: the pin floods run ~200ms each — long enough that shared-CPU
+    # scheduler noise (±10% on ~100ms walls) stops masking a few percent
+    # of instrumentation cost under the interleaved max-of-N discipline
+    p.add_argument("--txs", type=int, default=192)
+    p.add_argument(
+        "--threads", type=int, default=0,
+        help="admission submitter threads; 0 = min(2, cores) — the aux "
+        "storm roles ride on top")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument(
+        "--assert-observed",
+        action="store_true",
+        help="CI gate: cs_main wait share finite and > 0 under the "
+        "storm, >= 3 roles attributed, non-empty blame matrix through "
+        "getlockstats, and ledger-on throughput >= 0.95x ledger-off",
+    )
+    args = p.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    threads = args.threads or min(2, max(1, os.cpu_count() or 1))
+    res = storm(args.txs, threads, args.repeats)
+    print(json.dumps(res, indent=1))
+    if args.assert_observed:
+        # explicit raises, not assert: the gate must also gate under -O
+        share = res["cs_main_wait_share"]
+        gates = (
+            (math.isfinite(share) and share > 0.0,
+             f"cs_main wait share {share} is not a finite positive "
+             "number — the storm produced no attributable contention"),
+            (len(res["contention_roles"]) >= 3,
+             f"only {res['contention_roles']} acquired cs_main — the "
+             "storm must attribute >= 3 roles"),
+            (res["blame_edges"] > 0,
+             "getlockstats served an empty blame matrix under the storm"),
+            (res["lockstats_overhead_ratio"] >= 0.95,
+             f"ledger-on throughput is "
+             f"{res['lockstats_overhead_ratio']}x ledger-off "
+             "(< 0.95x floor) — the ledger is too expensive to stay "
+             "armed by default"),
+        )
+        for ok, msg in gates:
+            if not ok:
+                raise SystemExit(f"lock contention ledger FAILED: {msg}")
+        top = res["blame_top"]
+        print(
+            f"lock contention ledger OK: cs_main wait share {share} "
+            f"({', '.join(f'{r}={s}' for r, s in res['cs_main_wait_share_by_role'].items())}), "
+            f"{len(res['contention_roles'])} roles attributed, top blame "
+            f"{top['waiter_role']}<-{top['holder_role']}@{top['holder_site']} "
+            f"{top['seconds']}s, overhead {res['lockstats_overhead_ratio']}x"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

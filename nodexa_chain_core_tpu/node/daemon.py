@@ -68,6 +68,17 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         log_printf("sampling profiler on at %.0f Hz (getprofile RPC; "
                    "-profilehz=0 disables)", profile_hz)
 
+    # -lockstats (default ON): the lock-contention ledger over every
+    # named DebugLock — wait/hold histograms, blame matrix, long-hold
+    # watchdog (getlockstats RPC).  Same kill-switch discipline as
+    # -telemetryspans: =0 restores the one-pointer-check fast path.
+    if g_args.get_bool("lockstats", True):
+        from ..telemetry.lockstats import enable_lockstats
+
+        enable_lockstats(True)
+        log_printf("lock-contention ledger armed (getlockstats RPC; "
+                   "-lockstats=0 disables)")
+
     # -faultinject=<site>:<spec> (repeatable): arm deterministic faults
     # BEFORE any store opens so chainstate-load choke points are covered
     # too.  Unknown sites are a hard startup error — a typo must not

@@ -197,6 +197,27 @@ def getprofile(node, params: List[Any]):
     return out
 
 
+def getlockstats(node, params: List[Any]):
+    """The lock-contention ledger's snapshot: per-lock acquisition
+    counts by thread role, contended-wait totals and wall-time shares,
+    hold-time decomposition by acquisition site (top holder-sites
+    first), live waiter depths, long-hold counts, and the blame matrix —
+    (lock, waiter_role, holder_role, holder_site) -> seconds blocked.
+    Optional first param bounds top_sites per lock (default 5).
+    Deliberately readable in safe mode: a wedged node is exactly when
+    you need to know who holds cs_main (``-lockstats=0`` leaves the
+    ledger off; the RPC then reports enabled=false)."""
+    from ..telemetry.lockstats import g_lockstats
+
+    try:
+        top_sites = int(params[0]) if params and params[0] else 5
+    except (TypeError, ValueError):
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "top_sites must be an integer")
+    top_sites = max(1, min(top_sites, 100))
+    return g_lockstats.snapshot(top_sites=top_sites)
+
+
 def getstartupinfo(node, params: List[Any]):
     """Daemon boot attribution: per-stage durations (chainstate load,
     self-check, mesh init, compile warmup, wallet, network, pool, rpc),
@@ -386,6 +407,7 @@ def register(table: RPCTable) -> None:
         ("control", "getmemoryinfo", getmemoryinfo, []),
         ("control", "getmetrics", getmetrics, ["prefix"]),
         ("control", "getprofile", getprofile, ["max_stacks"]),
+        ("control", "getlockstats", getlockstats, ["top_sites"]),
         ("control", "gettrace", gettrace, ["trace_id"]),
         ("control", "dumpflightrecorder", dumpflightrecorder, ["path"]),
         ("control", "getstartupinfo", getstartupinfo, []),

@@ -79,8 +79,9 @@ MUTATING_COMMANDS = frozenset({
 # MUTATING_COMMANDS, and reject_if_locked_down short-circuits on them
 # before any health-layer consultation.
 READONLY_DIAGNOSTIC_COMMANDS = frozenset({
-    "getmetrics", "getprofile", "gettrace", "dumpflightrecorder",
-    "getstartupinfo", "getnodehealth", "getnetstats", "getsnapshotinfo",
+    "getmetrics", "getprofile", "getlockstats", "gettrace",
+    "dumpflightrecorder", "getstartupinfo", "getnodehealth",
+    "getnetstats", "getsnapshotinfo",
     "help", "uptime", "stop",
 })
 

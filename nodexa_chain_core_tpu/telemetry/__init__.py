@@ -35,7 +35,8 @@ from .tracing import (
 )
 from .startup import g_startup
 from .compileattr import CompileTracker, compile_span
-from . import profiler, utilization
+from . import lockstats, profiler, utilization
+from .lockstats import enable_lockstats, g_lockstats, lockstats_enabled
 from .profiler import g_profiler, role_of_thread
 from .utilization import g_utilization
 
@@ -63,8 +64,12 @@ __all__ = [
     "g_startup",
     "CompileTracker",
     "compile_span",
+    "lockstats",
     "profiler",
     "utilization",
+    "enable_lockstats",
+    "g_lockstats",
+    "lockstats_enabled",
     "g_profiler",
     "g_utilization",
     "role_of_thread",

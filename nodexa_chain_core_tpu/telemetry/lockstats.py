@@ -1,0 +1,830 @@
+"""Lock-contention ledger: wait/hold/blame attribution for every named
+DebugLock (ref Bitcoin Core's DEBUG_LOCKCONTENTION + the lock-spin
+telemetry that drove the historical cs_main decomposition).
+
+The ledger is the measurement layer for ROADMAP item 5 (shard cs_main):
+before the split can be argued, ``cs_main: validation blocks pool-shares
+38% of its wall time`` must be a scrapeable series rather than a guess.
+It instruments :class:`utils.sync.DebugLock` by REBINDING the class's
+``acquire``/``release``/``__enter__`` methods to armed twins at
+install time (and restoring the plain originals on disarm), so the
+disarmed fast path carries zero ledger branches — the PR 8/11
+kill-switch contract taken to its limit — and the armed cycle costs one
+Python frame per call instead of a delegation chain.  Armed by default
+on the daemon (``-lockstats=0`` disables).
+
+Exported families (all labeled by the *role name* of the lock, never the
+instance, so multi-instance roles such as ``kvstore.write`` aggregate):
+
+``nodexa_lock_acquisitions_total{lock,role,site}``
+    every successful acquire, attributed to the PR 11 thread role and to
+    the acquisition *site* (``module.function`` of the acquiring frame,
+    cardinality-capped below).
+``nodexa_lock_wait_seconds{lock,role}`` (histogram)
+    time spent blocked per CONTENDED acquire; uncontended acquires do
+    not observe (count == contended acquisitions by construction).
+``nodexa_lock_hold_seconds{lock,site}`` (histogram)
+    outermost hold duration per site (reentrant re-acquires fold into
+    the enclosing hold, ref RecursiveMutex semantics).
+``nodexa_lock_waiters{lock}`` (gauge)
+    live waiter-queue depth; returns to 0 when contention drains.
+``nodexa_lock_blame_seconds_total{lock,waiter_role,holder_role,holder_site}``
+    the blame matrix: wait seconds attributed to the (role, site) that
+    held the lock when the waiter arrived.
+``nodexa_lock_long_holds_total{lock}`` + a ``long_lock_hold`` flight-
+    recorder event with the holder's sampled stack (the PR 11 profiler's
+    folded frames) whenever a hold crosses the pathological threshold.
+``nodexa_lock_site_evictions_total{lock}``
+    acquisitions folded into ``site="other"`` once a lock's site table
+    hits the cardinality cap (ref the profiler's per-role stack cap).
+"""
+
+import sys
+import threading
+import time
+from bisect import bisect_left
+from threading import get_ident as _get_ident
+from typing import Dict, List, Optional, Tuple
+
+from .registry import Counter, Histogram, _HistData, _label_key, g_metrics
+from .profiler import _fold_stack, role_of_thread
+from .flight_recorder import record_event
+
+# Per-lock cap on distinct acquisition-site labels; sites beyond the cap
+# fold into OVERFLOW_SITE and bump the eviction counter (same shape as
+# the profiler's MAX_STACKS_PER_ROLE bound).
+MAX_SITES_PER_LOCK = 32
+OVERFLOW_SITE = "other"
+
+# Holds crossing this many seconds flight-record a long_lock_hold event
+# with the holder's folded stack.  1s is ~100x a healthy ConnectTip
+# flush; tests lower it via set_long_hold_threshold().
+LONG_HOLD_THRESHOLD_S = 1.0
+
+#: Every production DebugLock role the ledger pre-registers at arm time
+#: (waiter gauges exist before first contention).  nxlint's lock-ledger
+#: rule parses this tuple from the AST: a DebugLock role missing here
+#: cannot ship — a new named lock must opt INTO observability.  Keep in
+#: lockstep with utils.sync.KNOWN_LOCKS (cross-checked by tests).
+LEDGER_LOCKS = (
+    "cs_main",
+    "snapshot",
+    "mempool.reserved",
+    "mempool.script_stage",
+    "kvstore.write",
+    "kvstore.cache",
+    "blockstore",
+    "health",
+    "notifications",
+    "connman.peers",
+    "peer.send",
+    "net.cmpct_cache",
+    "pool.sessions",
+    "pool.session.send",
+    "pool.banned",
+    "pool.jobs",
+    "pool.share_counts",
+    "mesh.epochs",
+    "mesh.build",
+    "epoch_manager",
+    "miner.stats",
+    "faults",
+    "wallet",
+)
+
+_UNKNOWN = "unknown"
+
+# role_of_thread resolved once per thread (thread names are fixed before
+# start; prefix matching + two Thread properties per acquire is real
+# money inside a critical section)
+_tls = threading.local()
+
+# ---------------------------------------------------------------------------
+# Per-thread stat buffers.  The armed acquire/release cycle runs INSIDE
+# the caller's critical section and, under the GIL, every instruction of
+# it taxes total node throughput — so the hot path may not take the
+# registry family locks, canonicalize kwargs, or allocate per call.
+# Instead each thread owns a stats list (one TLS fetch) whose cells it
+# alone mutates; readers (the family collect() overrides below) merge
+# the cumulative per-thread cells at scrape time.  Owner-only writes +
+# GIL-atomic list/dict ops make this race-free up to a torn read of one
+# in-flight observation, which a scrape can tolerate.
+#
+#   st = [gen, ident, role, cache, freelist, acq, hold]
+#     cache: {code: {lock_name: (site, acq_cell, hold_acc)}}
+#     acq:   {(lock_name, site): [count]}
+#     hold:  {(lock_name, site): [sum, count, b0..bN]}  (bisect buckets)
+# ---------------------------------------------------------------------------
+S_GEN, S_IDENT, S_ROLE, S_CACHE, S_FREE, S_ACQ, S_HOLD = range(7)
+
+_stats_lock = threading.Lock()
+_all_stats: Dict[int, list] = {}   # thread ident -> st (survives thread
+                                   # death: counters are cumulative)
+_gen = object()                    # token; replaced on reset so stale
+                                   # TLS buffers orphan themselves
+
+
+def _new_thread_stats() -> list:
+    ident = _get_ident()
+    role = role_of_thread(threading.current_thread().name)
+    st = [_gen, ident, role, {}, [], {}, {}]
+    with _stats_lock:
+        old = _all_stats.get(ident)
+        _all_stats[ident] = st
+    if old is not None and old[S_GEN] is _gen:
+        # a dead thread's ident was recycled by the OS: bank its
+        # cumulative cells into the family base storage before this
+        # thread's buffer displaces them (counters never go backwards)
+        _fold_displaced(old)
+    _tls.st = st
+    return st
+
+
+def _fold_displaced(st: list) -> None:
+    role = st[S_ROLE]
+    with _M_ACQ._lock:
+        vals = _M_ACQ._values
+        for (lk, site), cell in st[S_ACQ].items():
+            key = (("lock", lk), ("role", role), ("site", site))
+            vals[key] = vals.get(key, 0.0) + cell[0]
+    with _M_HOLD._lock:
+        data = _M_HOLD._data
+        for (lk, site), acc in st[S_HOLD].items():
+            key = (("lock", lk), ("site", site))
+            d = data.get(key)
+            if d is None:
+                d = data[key] = _HistData(len(_HOLD_BUCKETS) + 1)
+            counts = acc[2:]
+            for i, c in enumerate(counts):
+                d.bucket_counts[i] += c
+            d.sum += acc[0]
+            d.count += sum(counts)
+
+
+def _thread_stats() -> list:
+    try:
+        st = _tls.st
+    except AttributeError:
+        return _new_thread_stats()
+    if st[S_GEN] is not _gen:
+        return _new_thread_stats()
+    return st
+
+
+def _stats_snapshot() -> list:
+    with _stats_lock:
+        return list(_all_stats.values())
+
+
+def _reset_thread_stats() -> None:
+    global _gen
+    with _stats_lock:
+        _gen = object()
+        _all_stats.clear()
+
+
+def _thread_role() -> str:
+    return _thread_stats()[S_ROLE]
+
+
+class _TLSCounter(Counter):
+    """Counter whose hot-path increments live in the per-thread buffers
+    (``st[S_ACQ]`` cells); direct ``inc(**labels)`` still works and both
+    sources merge at collect time."""
+
+    def _merged(self) -> dict:
+        with self._lock:
+            base = dict(self._values)
+        for st in _stats_snapshot():
+            role = st[S_ROLE]
+            for (lk, site), cell in list(st[S_ACQ].items()):
+                key = (("lock", lk), ("role", role), ("site", site))
+                base[key] = base.get(key, 0.0) + cell[0]
+        return base
+
+    def collect(self):
+        return sorted(self._merged().items())
+
+    def value(self, **labels) -> float:
+        return self._merged().get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self._merged().values())
+
+    def clear(self) -> None:
+        super().clear()
+        _reset_thread_stats()
+
+
+class _TLSHistogram(Histogram):
+    """Histogram merging the per-thread ``st[S_HOLD]`` accumulators; the
+    merged count is recomputed from the bucket cells so cumulative
+    buckets stay internally consistent even across a torn read."""
+
+    def collect(self):
+        with self._lock:
+            merged = {k: (list(d.bucket_counts), d.sum, d.count)
+                      for k, d in self._data.items()}
+        for st in _stats_snapshot():
+            for (lk, site), acc in list(st[S_HOLD].items()):
+                key = (("lock", lk), ("site", site))
+                counts = acc[2:]
+                n = sum(counts)
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = (counts, acc[0], n)
+                else:
+                    merged[key] = (
+                        [a + b for a, b in zip(cur[0], counts)],
+                        cur[1] + acc[0], cur[2] + n)
+        return sorted(merged.items())
+
+    def snapshot(self, **labels) -> Optional[dict]:
+        key = _label_key(labels)
+        for k, (counts, s, n) in self.collect():
+            if k == key:
+                cum, out = 0, {}
+                for b, c in zip(self.buckets, counts):
+                    cum += c
+                    out[b] = cum
+                return {"buckets": out, "sum": s, "count": n}
+        return None
+
+    def clear(self) -> None:
+        super().clear()
+        _reset_thread_stats()
+
+
+def _register(name: str, help_text: str, cls):
+    return g_metrics._get_or_create(name, lambda: cls(name, help_text))
+
+
+_M_ACQ = _register(
+    "nodexa_lock_acquisitions_total",
+    "successful DebugLock acquisitions by lock role, thread role and "
+    "acquisition site", _TLSCounter)
+_M_WAIT = g_metrics.histogram(
+    "nodexa_lock_wait_seconds",
+    "time spent blocked per contended DebugLock acquisition")
+_M_HOLD = _register(
+    "nodexa_lock_hold_seconds",
+    "outermost DebugLock hold duration by acquisition site",
+    _TLSHistogram)
+_G_WAITERS = g_metrics.gauge(
+    "nodexa_lock_waiters",
+    "threads currently blocked waiting for the lock")
+_M_BLAME = g_metrics.counter(
+    "nodexa_lock_blame_seconds_total",
+    "wait seconds attributed to the (role, site) holding the lock when "
+    "the waiter arrived")
+_M_LONG = g_metrics.counter(
+    "nodexa_lock_long_holds_total",
+    "holds that crossed the pathological long-hold threshold")
+_M_EVICT = g_metrics.counter(
+    "nodexa_lock_site_evictions_total",
+    "acquisitions folded into site=other past the per-lock site cap")
+
+_HOLD_BUCKETS = _M_HOLD.buckets
+
+# code objects of the lock machinery itself, skipped when walking to the
+# acquiring frame (identity checks beat filename endswith by ~5x on this
+# path); filled lazily by _skip_codes() once sync.py is importable
+_SKIP_CODES: set = set()
+# DebugLock.__enter__ code objects (the plain original and the armed
+# twin), the one-step fast-path skip in the armed acquire
+_E_PLAIN = None
+_E_ARMED = None
+# the plain (acquire, release, __enter__) originals, captured before the
+# first rebind so disarm can restore them
+_PLAIN_METHODS = None
+
+
+def _plain_methods() -> tuple:
+    global _PLAIN_METHODS
+    if _PLAIN_METHODS is None:
+        from ..utils.sync import DebugLock
+        _PLAIN_METHODS = (DebugLock.acquire, DebugLock.release,
+                          DebugLock.__enter__)
+    return _PLAIN_METHODS
+
+
+def _skip_codes() -> set:
+    global _E_PLAIN
+    if not _SKIP_CODES:
+        plain_acquire, _plain_release, plain_enter = _plain_methods()
+        _E_PLAIN = plain_enter.__code__
+        _SKIP_CODES.update({
+            plain_acquire.__code__,
+            plain_enter.__code__,
+            ContentionLedger._contended_acquire.__code__,
+        })
+    return _SKIP_CODES
+
+
+def _site_of_code(code) -> str:
+    """``module.function`` of an acquiring frame's code object — the
+    acquisition site the @requires_lock annotations talk about, derived
+    instead of hand-registered.  Cold path: results are cached per code
+    object by the ledger."""
+    if code is None:
+        return _UNKNOWN
+    mod = code.co_filename.rsplit("/", 1)[-1]
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    return f"{mod}.{code.co_name}"
+
+
+# Holder record: who holds one DebugLock instance right now.  A plain
+# list, not a class — the record lives on EVERY armed outermost acquire,
+# inside the critical section, and is recycled through the owning
+# thread's freelist (slot H_FREE) so steady state allocates nothing.
+# Written only by the owning thread; read racily (GIL-atomic index
+# loads) by waiters building blame edges and by the long-hold flagger.
+H_ROLE, H_SITE, H_T0, H_IDENT, H_DEPTH, H_FLAGGED = range(6)
+H_ACQ_CELL, H_HOLD_ACC, H_FREE, H_GEN = 6, 7, 8, 9
+
+
+class ContentionLedger:
+    """The instrumented acquire/release path DebugLock delegates to when
+    armed.  ``time_fn`` is injectable (SimClock in tests) per the repo's
+    clock-discipline; the wall clock never leaks in."""
+
+    def __init__(self, time_fn=time.monotonic) -> None:
+        self._time = time_fn
+        self._lock = threading.Lock()  # guards _sites only
+        # lock role -> {site -> canonical label} (cap enforced here)
+        self._sites: Dict[str, Dict[str, str]] = {}
+        self._armed_at: Optional[float] = None
+        self.long_hold_threshold_s = LONG_HOLD_THRESHOLD_S
+
+    # ----------------------------------------------------------- arming
+
+    def arm(self) -> None:
+        if self._armed_at is None:
+            self._armed_at = self._time()
+        for name in LEDGER_LOCKS:
+            _G_WAITERS.set(0.0, lock=name)
+
+    def disarm(self) -> None:
+        self._armed_at = None
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._sites.clear()
+        self._armed_at = None
+        self.long_hold_threshold_s = LONG_HOLD_THRESHOLD_S
+        for fam in (_M_ACQ, _M_WAIT, _M_HOLD, _G_WAITERS, _M_BLAME,
+                    _M_LONG, _M_EVICT):
+            fam.clear()
+        _reset_thread_stats()
+
+    def set_long_hold_threshold(self, seconds: float) -> None:
+        self.long_hold_threshold_s = max(float(seconds), 0.001)
+
+    # ------------------------------------------------- DebugLock hooks
+    # The armed acquire/release/__enter__ bodies live in _bind_armed()
+    # below — install() rebinds them onto DebugLock, so the hot path is
+    # a single closure frame.  Only the contended path stays a method.
+
+    def _contended_acquire(self, lock, raw, blocking: bool,
+                           timeout: float) -> bool:
+        """The rare path: somebody holds the lock.  Contended waits run
+        in threshold-sized slices so a waiter can flag a pathological
+        holder *while still blocked* (and sample the holder's live
+        stack, which a plain blocking acquire never could)."""
+        if not blocking:
+            return False
+        name = lock.name
+        me = _thread_role()
+        # blame snapshot at ARRIVAL: the record may be recycled through
+        # the holder's freelist before our wait ends
+        holder = lock._rec
+        if holder is not None:
+            holder_role, holder_site = holder[H_ROLE], holder[H_SITE]
+        else:
+            # holder acquired before arming (or raced release): keep the
+            # wait accounted rather than dropping the edge
+            holder_role = holder_site = _UNKNOWN
+        _G_WAITERS.inc(1.0, lock=name)
+        t0 = self._time()
+        deadline = None if timeout is None or timeout < 0 else t0 + timeout
+        got = False
+        try:
+            while True:
+                slice_s = self.long_hold_threshold_s
+                if deadline is not None:
+                    remaining = deadline - self._time()
+                    if remaining <= 0:
+                        break
+                    slice_s = min(slice_s, remaining)
+                got = raw.acquire(True, slice_s)
+                if got:
+                    break
+                self._flag_long_hold_from_waiter(lock)
+        finally:
+            waited = self._time() - t0
+            _G_WAITERS.dec(1.0, lock=name)
+        _M_WAIT.observe(waited, lock=name, role=me)
+        _M_BLAME.inc(waited, lock=name, waiter_role=me,
+                     holder_role=holder_role, holder_site=holder_site)
+        if got:
+            self._note_acquired(lock)
+        return got
+
+    # ------------------------------------------------------- internals
+
+    def _cache_miss(self, st: list, name: str, code) -> tuple:
+        """Resolve (site, acq cell, hold acc) for one (lock, caller
+        code) pair and memoize it in the thread's cache.  Keyed by the
+        code OBJECT (kept alive by the cache) so ids can't be recycled
+        under us; the nested dict avoids a per-acquire key tuple."""
+        site = self._canon_site(name, _site_of_code(code))
+        skey = (name, site)
+        acq = st[S_ACQ]
+        cell = acq.get(skey)
+        if cell is None:
+            cell = acq[skey] = [0]
+        hold = st[S_HOLD]
+        acc = hold.get(skey)
+        if acc is None:
+            acc = hold[skey] = [0.0, 0] + [0] * (len(_HOLD_BUCKETS) + 1)
+        ent = (site, cell, acc)
+        by_name = st[S_CACHE].get(code)
+        if by_name is None:
+            by_name = st[S_CACHE][code] = {}
+        by_name[name] = ent
+        return ent
+
+    def _note_acquired(self, lock) -> None:
+        """Close of the contended path: record the acquisition exactly
+        like the inlined fast path, but walk past the ledger's own
+        frames to find the acquiring site."""
+        st = _thread_stats()
+        rec = lock._rec
+        if rec is not None and rec[H_IDENT] == st[S_IDENT] \
+                and rec[H_GEN] is st[S_GEN]:
+            rec[H_DEPTH] += 1  # reentrant: fold into the enclosing hold
+            rec[H_ACQ_CELL][0] += 1
+            return
+        skip = _SKIP_CODES
+        f = sys._getframe(1)
+        code = f.f_code
+        while code in skip:
+            f = f.f_back
+            if f is None:
+                code = None
+                break
+            code = f.f_code
+        name = lock.name
+        by_name = st[S_CACHE].get(code)
+        ent = by_name.get(name) if by_name is not None else None
+        if ent is None:
+            ent = self._cache_miss(st, name, code)
+        ent[1][0] += 1
+        lock._rec = [
+            st[S_ROLE], ent[0], self._time(), st[S_IDENT], 1, False,
+            ent[1], ent[2], st[S_FREE], st[S_GEN]]
+
+    def _canon_site(self, lock_name: str, site: str) -> str:
+        with self._lock:
+            table = self._sites.get(lock_name)
+            if table is None:
+                table = self._sites[lock_name] = {}
+            got = table.get(site)
+            if got is not None:
+                return got
+            if len(table) >= MAX_SITES_PER_LOCK:
+                _M_EVICT.inc(1.0, lock=lock_name)
+                return OVERFLOW_SITE
+            table[site] = site
+            return site
+
+    def _flag_long_hold_from_waiter(self, lock) -> None:
+        rec = lock._rec
+        if rec is None or rec[H_FLAGGED]:
+            return
+        rec[H_FLAGGED] = True
+        frames = sys._current_frames().get(rec[H_IDENT])
+        stack = _fold_stack(frames)[0] if frames is not None else ""
+        self._record_long_hold(
+            lock.name, rec, self._time() - rec[H_T0], stack)
+
+    def _record_long_hold(self, name: str, rec: list, held: float,
+                          stack: str) -> None:
+        rec[H_FLAGGED] = True
+        _M_LONG.inc(1.0, lock=name)
+        record_event("long_lock_hold", lock=name,
+                     holder_role=rec[H_ROLE], holder_site=rec[H_SITE],
+                     held_s=round(held, 4), stack=stack)
+
+    # -------------------------------------------------------- snapshot
+
+    def snapshot(self, top_sites: int = 5) -> dict:
+        """The ``getlockstats`` payload, rebuilt from the metric families
+        (single source of truth — the ledger keeps no parallel tallies).
+        ``wait_share`` is wait-seconds / seconds-armed, so `0.38` reads
+        as "38% of wall time spent blocked on this lock"."""
+        now = self._time()
+        duration = (now - self._armed_at) if self._armed_at is not None \
+            else 0.0
+        duration = max(duration, 1e-9)
+        locks: Dict[str, dict] = {}
+
+        def entry(name: str) -> dict:
+            e = locks.get(name)
+            if e is None:
+                e = locks[name] = {
+                    "acquisitions": 0, "by_role": {},
+                    "contended": 0, "wait_seconds": 0.0,
+                    "wait_seconds_by_role": {}, "wait_share": 0.0,
+                    "wait_share_by_role": {},
+                    "holds": 0, "hold_seconds": 0.0,
+                    "hold_seconds_by_site": {},
+                    "waiters": 0, "long_holds": 0, "top_sites": [],
+                }
+            return e
+
+        for key, val in _M_ACQ.collect():
+            d = dict(key)
+            e = entry(d["lock"])
+            e["acquisitions"] += int(val)
+            role = d.get("role", _UNKNOWN)
+            e["by_role"][role] = e["by_role"].get(role, 0) + int(val)
+        for key, (_bc, total, count) in _M_WAIT.collect():
+            d = dict(key)
+            e = entry(d["lock"])
+            e["contended"] += int(count)
+            e["wait_seconds"] += total
+            role = d.get("role", _UNKNOWN)
+            e["wait_seconds_by_role"][role] = (
+                e["wait_seconds_by_role"].get(role, 0.0) + total)
+        for key, (_bc, total, count) in _M_HOLD.collect():
+            d = dict(key)
+            e = entry(d["lock"])
+            e["holds"] += int(count)
+            e["hold_seconds"] += total
+            site = d.get("site", _UNKNOWN)
+            e["hold_seconds_by_site"][site] = (
+                e["hold_seconds_by_site"].get(site, 0.0) + total)
+        for key, val in _G_WAITERS.collect():
+            d = dict(key)
+            if d.get("lock") in locks:
+                locks[d["lock"]]["waiters"] = int(val)
+        for key, val in _M_LONG.collect():
+            d = dict(key)
+            entry(d["lock"])["long_holds"] = int(val)
+
+        for e in locks.values():
+            e["wait_seconds"] = round(e["wait_seconds"], 6)
+            e["hold_seconds"] = round(e["hold_seconds"], 6)
+            e["wait_share"] = round(e["wait_seconds"] / duration, 4)
+            e["wait_share_by_role"] = {
+                r: round(s / duration, 4)
+                for r, s in sorted(e["wait_seconds_by_role"].items())}
+            e["wait_seconds_by_role"] = {
+                r: round(s, 6)
+                for r, s in sorted(e["wait_seconds_by_role"].items())}
+            ranked = sorted(e["hold_seconds_by_site"].items(),
+                            key=lambda kv: -kv[1])
+            e["top_sites"] = [
+                {"site": s, "seconds": round(sec, 6)}
+                for s, sec in ranked[:max(int(top_sites), 1)]]
+            e["hold_seconds_by_site"] = {
+                s: round(sec, 6) for s, sec in ranked}
+
+        blame: List[dict] = []
+        for key, val in _M_BLAME.collect():
+            d = dict(key)
+            blame.append({
+                "lock": d.get("lock", _UNKNOWN),
+                "waiter_role": d.get("waiter_role", _UNKNOWN),
+                "holder_role": d.get("holder_role", _UNKNOWN),
+                "holder_site": d.get("holder_site", _UNKNOWN),
+                "seconds": round(val, 6),
+            })
+        blame.sort(key=lambda b: -b["seconds"])
+        evictions = sum(v for _k, v in _M_EVICT.collect())
+        with self._lock:
+            registered = sum(len(t) for t in self._sites.values())
+        return {
+            "enabled": lockstats_enabled(),
+            "duration_s": round(duration, 3),
+            "long_hold_threshold_s": self.long_hold_threshold_s,
+            "locks": {k: locks[k] for k in sorted(locks)},
+            "blame": blame,
+            "sites": {"registered": registered,
+                      "evicted": int(evictions)},
+        }
+
+
+def _bind_armed(ledger: ContentionLedger) -> tuple:
+    """Build the armed (acquire, release, __enter__) twins bound to
+    ``ledger``.  install() rebinds them onto DebugLock, so the armed
+    cycle costs ONE closure frame per call — no delegation chain, no
+    per-call hook checks.  The bodies run on every armed acquire,
+    inside the caller's critical section, and under the GIL every
+    instruction taxes node throughput: one TLS fetch, one frame read,
+    two dict hits, zero locks, zero allocations in the steady state.
+
+    ``acquire`` and ``__enter__`` duplicate the uncontended bookkeeping
+    on purpose: the ``with lock:`` form (the dominant production
+    pattern) reads its acquisition site straight from ``_getframe(1)``
+    with no hop, and neither form pays an extra Python call."""
+    global _E_PLAIN, _E_ARMED
+    from ..utils import sync
+    _skip_codes()
+    contended = ledger._contended_acquire
+    cache_miss = ledger._cache_miss
+    now = ledger._time
+    getframe = sys._getframe
+    ident = _get_ident
+    bisect = bisect_left
+    held_stack = sync._held
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if sync._enabled:
+            self._check_order()
+        raw = self._lock
+        if not raw.acquire(False):
+            got = contended(self, raw, blocking, timeout)
+            if got and sync._enabled:
+                held_stack().append(self)
+            return got
+        try:
+            st = _tls.st
+        except AttributeError:
+            st = _new_thread_stats()
+        if st[0] is not _gen:          # S_GEN: buffers were reset
+            st = _new_thread_stats()
+        rec = self._rec
+        # reentrant iff same thread AND same arm epoch (H_GEN): a record
+        # left behind across disarm/re-arm must not fake an open hold
+        if rec is not None and rec[3] == st[1] and rec[9] is st[0]:
+            rec[4] += 1                # H_DEPTH: reentrant re-acquire
+            rec[6][0] += 1             # H_ACQ_CELL
+        else:
+            # the caller's frame is the acquisition site, unless the
+            # call came through a __enter__ (plain or armed twin)
+            f = getframe(1)
+            code = f.f_code
+            if code is _E_ARMED or code is _E_PLAIN:
+                code = f.f_back.f_code
+            name = self.name
+            by_name = st[3].get(code)  # S_CACHE: {code: {name: entry}}
+            ent = by_name.get(name) if by_name is not None else None
+            if ent is None:
+                ent = cache_miss(st, name, code)
+            ent[1][0] += 1             # acq cell
+            free = st[4]               # S_FREE
+            if free:
+                rec = free.pop()
+                rec[0] = st[2]         # H_ROLE = S_ROLE
+                rec[1] = ent[0]        # H_SITE
+                rec[2] = now()         # H_T0
+                rec[3] = st[1]         # H_IDENT
+                rec[4] = 1             # H_DEPTH
+                rec[5] = False         # H_FLAGGED
+                rec[6] = ent[1]        # H_ACQ_CELL
+                rec[7] = ent[2]        # H_HOLD_ACC
+                rec[9] = st[0]         # H_GEN
+            else:
+                rec = [st[2], ent[0], now(), st[1], 1, False,
+                       ent[1], ent[2], free, st[0]]
+            self._rec = rec
+        if sync._enabled:
+            held_stack().append(self)
+        return True
+
+    def release(self) -> None:
+        # close the hold BEFORE releasing so waiters building blame
+        # edges never read a released holder record
+        rec = self._rec
+        if rec is not None and rec[3] == ident():  # H_IDENT
+            if rec[9] is _gen:                     # H_GEN
+                depth = rec[4] - 1                 # H_DEPTH
+                if depth:
+                    rec[4] = depth  # reentrant inner release
+                else:
+                    held = now() - rec[2]          # H_T0
+                    self._rec = None
+                    acc = rec[7]                   # H_HOLD_ACC
+                    acc[2 + bisect(_HOLD_BUCKETS, held)] += 1
+                    acc[0] += held
+                    acc[1] += 1
+                    if held >= ledger.long_hold_threshold_s \
+                            and not rec[5]:
+                        # nobody waited long enough to flag it mid-hold;
+                        # the release path IS the holder, so its own
+                        # frames name the culprit
+                        stack, _ = _fold_stack(getframe())
+                        ledger._record_long_hold(
+                            self.name, rec, held, stack)
+                    rec[8].append(rec)             # H_FREE: recycle
+            else:
+                # stale record from a previous arm epoch: heal rather
+                # than fake a giant hold
+                self._rec = None
+        stack = held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def __enter__(self):
+        if sync._enabled:
+            acquire(self)  # rare combo: order checks + ledger together
+            return self
+        raw = self._lock
+        if not raw.acquire(False):
+            contended(self, raw, True, -1)
+            return self
+        try:
+            st = _tls.st
+        except AttributeError:
+            st = _new_thread_stats()
+        if st[0] is not _gen:
+            st = _new_thread_stats()
+        rec = self._rec
+        if rec is not None and rec[3] == st[1] and rec[9] is st[0]:
+            rec[4] += 1
+            rec[6][0] += 1
+            return self
+        code = getframe(1).f_code      # the with-statement's own frame
+        name = self.name
+        by_name = st[3].get(code)
+        ent = by_name.get(name) if by_name is not None else None
+        if ent is None:
+            ent = cache_miss(st, name, code)
+        ent[1][0] += 1
+        free = st[4]
+        if free:
+            rec = free.pop()
+            rec[0] = st[2]
+            rec[1] = ent[0]
+            rec[2] = now()
+            rec[3] = st[1]
+            rec[4] = 1
+            rec[5] = False
+            rec[6] = ent[1]
+            rec[7] = ent[2]
+            rec[9] = st[0]
+        else:
+            rec = [st[2], ent[0], now(), st[1], 1, False,
+                   ent[1], ent[2], free, st[0]]
+        self._rec = rec
+        return self
+
+    _E_ARMED = __enter__.__code__
+    _SKIP_CODES.update({acquire.__code__, _E_ARMED})
+    return acquire, release, __enter__
+
+
+g_lockstats = ContentionLedger()
+
+_enabled = False
+
+
+def lockstats_enabled() -> bool:
+    return _enabled
+
+
+def install(ledger: Optional[ContentionLedger]) -> None:
+    """Arm ``ledger`` by rebinding DebugLock's acquire/release/__enter__
+    to its armed twins (None restores the plain originals).  Tests use
+    this to inject a SimClock-backed ledger; the daemon goes through
+    enable_lockstats()."""
+    global _enabled
+    from ..utils import sync
+    D = sync.DebugLock
+    plain_acquire, plain_release, plain_enter = _plain_methods()
+    if ledger is not None:
+        ledger.arm()
+        acq, rel, ent = _bind_armed(ledger)
+        sync._contention = ledger
+        # release first: a thread racing the swap may run the armed
+        # acquire, and its holder record must find an armed release
+        D.release = rel
+        D.acquire = acq
+        D.__enter__ = ent
+        _enabled = True
+    else:
+        # mirror-image order on disarm: stop creating records before
+        # the armed release (which closes them) is unbound
+        D.acquire = plain_acquire
+        D.__enter__ = plain_enter
+        D.release = plain_release
+        sync._contention = None
+        _enabled = False
+
+
+def enable_lockstats(on: bool = True) -> None:
+    """Arm/disarm the global contention ledger (the ``-lockstats`` kill
+    switch; armed by default on the daemon)."""
+    install(g_lockstats if on else None)
+
+
+def reset_lockstats_for_tests() -> None:
+    """Disarm and wipe ledger state + the nodexa_lock_* families."""
+    install(None)
+    g_lockstats.reset_for_tests()

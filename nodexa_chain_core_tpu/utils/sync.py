@@ -40,6 +40,13 @@ import traceback
 from typing import Dict, List, Set, Tuple
 
 _enabled = False
+# Contention-ledger hook: telemetry.lockstats sets this to the armed
+# ContentionLedger (the daemon arms it by default; ``-lockstats=0``
+# disarms).  The ledger instruments DebugLock by REBINDING the class's
+# acquire/release/__enter__ methods — the disarmed path below carries
+# zero ledger branches, which is the PR 8/11 kill-switch contract taken
+# to its limit; this global exists so tooling can see what is armed.
+_contention = None
 _global = threading.Lock()
 # (A, B) -> formatted stacks at the time A-then-B was first observed
 _order_seen: Dict[Tuple[str, str], str] = {}
@@ -115,12 +122,17 @@ class DebugLock:
     detection off, acquire/release delegate with a single ``if``.
     """
 
-    __slots__ = ("name", "reentrant", "_lock")
+    __slots__ = ("name", "reentrant", "_lock", "_rec")
 
     def __init__(self, name: str, reentrant: bool = True):
         self.name = name
         self.reentrant = reentrant
         self._lock = threading.RLock() if reentrant else threading.Lock()
+        # contention-ledger holder record (None when unheld or
+        # disarmed); lives on the instance so the armed hot path costs
+        # slot loads, not id()-keyed dict traffic — see
+        # telemetry.lockstats for the record layout and write rules
+        self._rec = None
 
     def _check_order(self) -> None:
         me = self.name
@@ -161,6 +173,11 @@ class DebugLock:
                 frames = "".join(traceback.format_stack(limit=8))
                 for pair in fresh:
                     _order_seen.setdefault(pair, frames)
+
+    # NOTE: when the contention ledger is armed, telemetry.lockstats
+    # rebinds acquire/release/__enter__ on this class to instrumented
+    # twins (and restores these originals on disarm) — the bodies below
+    # are the DISARMED path and must stay ledger-free.
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _enabled:

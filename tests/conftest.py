@@ -74,3 +74,9 @@ def _fault_and_health_isolation():
     if g_utilization.enabled:
         g_utilization.set_enabled(False)
         g_utilization.set_calibration(None)
+    # the contention ledger rebinds DebugLock's class methods when armed:
+    # a test that armed it (or installed a SimClock ledger) must restore
+    # the plain methods and wipe the nodexa_lock_* families
+    from nodexa_chain_core_tpu.telemetry import lockstats
+
+    lockstats.reset_lockstats_for_tests()

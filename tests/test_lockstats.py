@@ -1,0 +1,329 @@
+"""Lock-contention ledger tests (telemetry/lockstats.py).
+
+Blame attribution runs under an injected SimClock — two *named* threads
+contend one DebugLock and the test asserts the exact
+(waiter_role, holder_role, holder_site) blame edge, the wait/hold
+histograms, the waiter gauge draining back to 0, and the getlockstats
+round-trip — so the numbers are deterministic, not sleep-calibrated.
+Real-clock threads appear only where wall time is the point (the
+waiter-side long-hold flagger) or where the subject is overhead
+(the zero-cost microbench pins, same harness as the span-switch
+contract in test_telemetry.py).
+"""
+
+import threading
+import time
+import timeit
+
+import pytest
+
+from nodexa_chain_core_tpu.net.netsim import SimClock
+from nodexa_chain_core_tpu.rpc import misc as rpc_misc
+from nodexa_chain_core_tpu.telemetry import flight_recorder, lockstats
+from nodexa_chain_core_tpu.telemetry.lockstats import (
+    ContentionLedger,
+    LEDGER_LOCKS,
+    MAX_SITES_PER_LOCK,
+    OVERFLOW_SITE,
+)
+from nodexa_chain_core_tpu.utils import sync
+from nodexa_chain_core_tpu.utils.sync import DebugLock
+
+
+def _wait_for(cond, timeout: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.001)
+    return False
+
+
+def _long_hold_events():
+    return [e for e in flight_recorder.events_snapshot()
+            if e["kind"] == "long_lock_hold"]
+
+
+# ---------------------------------------------------------------------------
+# blame attribution under SimClock
+# ---------------------------------------------------------------------------
+
+def test_blame_edge_between_named_threads_under_simclock():
+    clock = SimClock(100.0)
+    ledger = ContentionLedger(time_fn=clock)
+    # wait slices are REAL-time seconds; a big threshold keeps the
+    # watchdog quiet while sim time does the measuring
+    ledger.set_long_hold_threshold(30.0)
+    lockstats.install(ledger)
+
+    lock = DebugLock("cs_main")
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder_body():
+        with lock:
+            acquired.set()
+            assert release.wait(10)
+
+    def waiter_body():
+        assert lock.acquire()
+        lock.release()
+
+    # thread NAMES drive attribution: pool-jobs-* -> "pool-jobs",
+    # net.msghand* -> "validation" (the PR 11 role map)
+    holder = threading.Thread(target=holder_body, name="pool-jobs-hold")
+    holder.start()
+    assert acquired.wait(5)
+    waiter = threading.Thread(target=waiter_body, name="net.msghand-test")
+    waiter.start()
+
+    # live waiter-depth gauge reads 1 while the waiter is parked
+    assert _wait_for(
+        lambda: lockstats._G_WAITERS.value(lock="cs_main") == 1.0)
+    time.sleep(0.05)  # let the waiter reach its blocking slice
+    clock.advance(0.25)
+    release.set()
+    holder.join(5)
+    waiter.join(5)
+    assert not holder.is_alive() and not waiter.is_alive()
+
+    # ...and drains back to 0 once contention resolves
+    assert lockstats._G_WAITERS.value(lock="cs_main") == 0.0
+
+    snap = ledger.snapshot()
+    cs = snap["locks"]["cs_main"]
+    assert cs["acquisitions"] == 2
+    assert cs["by_role"] == {"pool-jobs": 1, "validation": 1}
+    assert cs["contended"] == 1
+    assert cs["wait_seconds"] == pytest.approx(0.25)
+    assert cs["wait_seconds_by_role"] == {
+        "validation": pytest.approx(0.25)}
+    # armed at t=100.0, snapshot at t=100.25: the lock blocked someone
+    # for 100% of the armed window
+    assert cs["wait_share"] == pytest.approx(1.0)
+    assert cs["holds"] == 2  # holder's 0.25 s + waiter's 0.0 s
+    assert cs["hold_seconds_by_site"]["test_lockstats.holder_body"] == \
+        pytest.approx(0.25)
+    assert "test_lockstats.waiter_body" in cs["hold_seconds_by_site"]
+
+    # THE deliverable: the blame edge names who blocked whom, and where
+    # the holder took the lock
+    assert [b for b in snap["blame"] if b["lock"] == "cs_main"] == [{
+        "lock": "cs_main",
+        "waiter_role": "validation",
+        "holder_role": "pool-jobs",
+        "holder_site": "test_lockstats.holder_body",
+        "seconds": pytest.approx(0.25),
+    }]
+
+    # getlockstats round-trips the same edge (the RPC rebuilds from the
+    # same metric families)
+    out = rpc_misc.getlockstats(None, [3])
+    assert out["enabled"] is True
+    edge = next(b for b in out["blame"]
+                if b["holder_site"] == "test_lockstats.holder_body")
+    assert edge["waiter_role"] == "validation"
+    assert edge["holder_role"] == "pool-jobs"
+    assert edge["seconds"] == pytest.approx(0.25)
+
+
+def test_reentrant_acquire_folds_into_outer_hold():
+    clock = SimClock()
+    ledger = ContentionLedger(time_fn=clock)
+    lockstats.install(ledger)
+    lock = DebugLock("wallet")
+
+    def outer():
+        with lock:
+            clock.advance(0.1)
+            with lock:  # RecursiveMutex semantics: no new hold
+                clock.advance(0.1)
+
+    outer()
+    w = ledger.snapshot()["locks"]["wallet"]
+    assert w["acquisitions"] == 2  # both acquires count...
+    assert w["holds"] == 1         # ...but one outermost hold
+    assert w["hold_seconds"] == pytest.approx(0.2)
+    assert w["hold_seconds_by_site"] == {
+        "test_lockstats.outer": pytest.approx(0.2)}
+
+
+def test_getlockstats_reports_disabled_when_disarmed():
+    lockstats.reset_lockstats_for_tests()
+    out = rpc_misc.getlockstats(None, [])
+    assert out["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# long-hold watchdog
+# ---------------------------------------------------------------------------
+
+def test_long_hold_flight_records_holder_stack_on_release():
+    flight_recorder.clear()
+    clock = SimClock()
+    ledger = ContentionLedger(time_fn=clock)
+    ledger.set_long_hold_threshold(0.2)
+    lockstats.install(ledger)
+    lock = DebugLock("blockstore")
+
+    def slow_flush():
+        with lock:
+            clock.advance(0.5)
+
+    slow_flush()
+    events = _long_hold_events()
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["lock"] == "blockstore"
+    assert ev["holder_site"] == "test_lockstats.slow_flush"
+    assert ev["held_s"] == pytest.approx(0.5)
+    # the release path IS the holder: its own frames name the culprit
+    assert "slow_flush" in ev["stack"]
+    assert lockstats._M_LONG.value(lock="blockstore") == 1.0
+    assert ledger.snapshot()["locks"]["blockstore"]["long_holds"] == 1
+
+
+def test_long_hold_flagged_by_live_waiter_with_sampled_stack():
+    # real clock: the waiter's threshold-sized wait slices time out while
+    # the holder is wedged, and the FLAGGER samples the holder's live
+    # stack via sys._current_frames — before the hold even ends
+    flight_recorder.clear()
+    ledger = ContentionLedger()
+    ledger.set_long_hold_threshold(0.05)
+    lockstats.install(ledger)
+    lock = DebugLock("cs_main")
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def wedged_holder():
+        with lock:
+            acquired.set()
+            release.wait(10)
+
+    holder = threading.Thread(target=wedged_holder, name="net.msghand-0")
+    holder.start()
+    assert acquired.wait(5)
+    waiter = threading.Thread(
+        target=lambda: (lock.acquire(), lock.release()), name="miner-0")
+    waiter.start()
+    try:
+        assert _wait_for(
+            lambda: lockstats._M_LONG.value(lock="cs_main") >= 1.0)
+    finally:
+        release.set()
+        holder.join(5)
+        waiter.join(5)
+    events = _long_hold_events()
+    assert len(events) == 1  # flagged once, not once per slice
+    ev = events[0]
+    assert ev["holder_role"] == "validation"
+    assert ev["holder_site"] == "test_lockstats.wedged_holder"
+    assert "wedged_holder" in ev["stack"]
+
+
+def test_reset_mid_hold_heals_stale_record():
+    flight_recorder.clear()
+    clock = SimClock()
+    ledger = ContentionLedger(time_fn=clock)
+    lockstats.install(ledger)
+    lock = DebugLock("health")
+    lock.acquire()
+    clock.advance(5.0)
+    # reset while the lock is HELD: new generation token, families wiped,
+    # methods stay armed — the release must heal the stale record, not
+    # close a phantom 5 s hold or fire the watchdog
+    ledger.reset_for_tests()
+    lock.release()
+    assert "health" not in ledger.snapshot()["locks"]
+    assert _long_hold_events() == []
+    assert lock._rec is None
+
+
+# ---------------------------------------------------------------------------
+# site cardinality + bookkeeping invariants
+# ---------------------------------------------------------------------------
+
+def test_site_cardinality_cap_folds_overflow_into_other():
+    ledger = ContentionLedger(time_fn=SimClock())
+    lockstats.install(ledger)
+    lock = DebugLock("kvstore.cache")
+    ns = {"lock": lock}
+    n = MAX_SITES_PER_LOCK + 8
+    for i in range(n):
+        src = f"def site_{i}():\n    with lock:\n        pass\n"
+        exec(compile(src, f"gen_site_{i}.py", "exec"), ns)
+        ns[f"site_{i}"]()
+
+    snap = ledger.snapshot(top_sites=100)
+    e = snap["locks"]["kvstore.cache"]
+    assert e["acquisitions"] == n
+    assert snap["sites"]["registered"] == MAX_SITES_PER_LOCK
+    assert snap["sites"]["evicted"] == 8
+    sites = set(e["hold_seconds_by_site"])
+    assert OVERFLOW_SITE in sites
+    assert len(sites) == MAX_SITES_PER_LOCK + 1
+
+
+def test_ledger_locks_stay_in_lockstep_with_known_locks():
+    # nxlint enforces both memberships statically; this pins the two
+    # tuples to the same SET so a lock can't ship half-registered
+    assert set(LEDGER_LOCKS) == set(sync.KNOWN_LOCKS)
+    assert len(set(LEDGER_LOCKS)) == len(LEDGER_LOCKS)
+
+
+def test_displaced_thread_buffers_fold_into_base_storage():
+    # a dead thread's OS ident can be recycled; the new thread's buffer
+    # displaces the old one and its cumulative cells must be banked, not
+    # dropped (counters never go backwards)
+    lockstats.reset_lockstats_for_tests()
+    acc = [1.5, 2] + [0] * (len(lockstats._HOLD_BUCKETS) + 1)
+    acc[2 + 5] = 2
+    st = [lockstats._gen, 12345, "mining", {}, [],
+          {("cs_main", "x.y"): [7]},
+          {("cs_main", "x.y"): acc}]
+    lockstats._fold_displaced(st)
+    assert lockstats._M_ACQ.value(
+        lock="cs_main", role="mining", site="x.y") == 7.0
+    hist = lockstats._M_HOLD.snapshot(lock="cs_main", site="x.y")
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------------
+# zero-cost pins (same harness as the span-switch contract)
+# ---------------------------------------------------------------------------
+
+def test_disarmed_lock_cycle_overhead_is_noise():
+    # the kill-switch contract for the ledger's entry points: with the
+    # ledger disarmed the acquire/release cycle runs the SEED method
+    # bodies (rebinding, not branching), so disarmed must be well under
+    # armed — not "a bit cheaper"
+    lock = DebugLock("cs_main")
+
+    def spin():
+        with lock:
+            pass
+
+    # lock-order debug off on BOTH sides: this pins the LEDGER's cost
+    sync.enable_lockorder_debug(False)
+    n, reps = 20000, 5
+    lockstats.install(ContentionLedger())
+    armed = min(timeit.repeat(spin, number=n, repeat=reps))
+    lockstats.install(None)
+    disarmed = min(timeit.repeat(spin, number=n, repeat=reps))
+    assert disarmed < armed * 0.7, (disarmed, armed)
+
+
+def test_assert_lock_held_disarmed_overhead_is_noise():
+    lock = DebugLock("cs_main")
+    sync.enable_lockorder_debug(True)
+    lock.acquire()  # while armed, so the held stack records it
+    try:
+        n, reps = 20000, 5
+        check = lambda: sync.assert_lock_held(lock)  # noqa: E731
+        armed = min(timeit.repeat(check, number=n, repeat=reps))
+        sync.enable_lockorder_debug(False)
+        disarmed = min(timeit.repeat(check, number=n, repeat=reps))
+    finally:
+        lock.release()
+    assert disarmed < armed * 0.7, (disarmed, armed)

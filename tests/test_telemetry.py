@@ -356,6 +356,89 @@ def test_exposition_conformance_round_trip():
                 f"{fam}{key} +Inf bucket != _count"
 
 
+def test_lock_ledger_families_exposition_conformance():
+    """Satellite: the nodexa_lock_* families — including the TLS-merged
+    acquisitions counter and hold histogram, whose collect() overrides
+    merge per-thread buffers at scrape time — survive the exposition
+    round trip with the expected types, label sets and histogram
+    invariants while the ledger is ARMED and carrying live data."""
+    from nodexa_chain_core_tpu.telemetry import lockstats
+    from nodexa_chain_core_tpu.utils.sync import DebugLock
+
+    lockstats.enable_lockstats(True)
+    lock = DebugLock("cs_main")
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def scrape_holder():
+        with lock:
+            acquired.set()
+            release.wait(10)
+
+    holder = threading.Thread(target=scrape_holder, name="pool-jobs-x")
+    holder.start()
+    assert acquired.wait(5)
+    # one contended acquire so wait + blame families carry data too
+    waiter = threading.Thread(
+        target=lambda: (lock.acquire(), lock.release()),
+        name="net.msghand-x")
+    waiter.start()
+    deadline = 5.0
+    import time as _time
+    t0 = _time.monotonic()
+    while lockstats._G_WAITERS.value(lock="cs_main") < 1.0:
+        assert _time.monotonic() - t0 < deadline
+        _time.sleep(0.001)
+    release.set()
+    holder.join(5)
+    waiter.join(5)
+
+    families, samples = _parse_exposition(prometheus_text())
+    expected = {
+        "nodexa_lock_acquisitions_total": "counter",
+        "nodexa_lock_wait_seconds": "histogram",
+        "nodexa_lock_hold_seconds": "histogram",
+        "nodexa_lock_waiters": "gauge",
+        "nodexa_lock_blame_seconds_total": "counter",
+        "nodexa_lock_long_holds_total": "counter",
+        "nodexa_lock_site_evictions_total": "counter",
+    }
+    for name, kind in expected.items():
+        assert families.get(name, {}).get("type") == kind, name
+
+    by_name = {}
+    for name, labels, raw in samples:
+        by_name.setdefault(name, []).append((labels, raw))
+
+    acq = [(ls, r) for ls, r in by_name["nodexa_lock_acquisitions_total"]
+           if ls.get("lock") == "cs_main"]
+    assert acq and all(set(ls) == {"lock", "role", "site"}
+                       for ls, _ in acq)
+    assert {ls["role"] for ls, _ in acq} >= {"pool-jobs", "validation"}
+
+    blame = [(ls, r) for ls, r
+             in by_name["nodexa_lock_blame_seconds_total"]
+             if ls.get("lock") == "cs_main"]
+    assert blame and all(
+        set(ls) == {"lock", "waiter_role", "holder_role", "holder_site"}
+        for ls, _ in blame)
+
+    # the waiter gauge drained: every cs_main sample reads 0
+    waiters = [float(r) for ls, r in by_name["nodexa_lock_waiters"]
+               if ls.get("lock") == "cs_main"]
+    assert waiters == [0.0]
+
+    # TLS-merged hold histogram: +Inf bucket == _count per labelset
+    hold_counts = {tuple(sorted(ls.items())): int(float(r))
+                   for ls, r in by_name["nodexa_lock_hold_seconds_count"]}
+    assert any(dict(k).get("lock") == "cs_main" for k in hold_counts)
+    for ls, raw in by_name["nodexa_lock_hold_seconds_bucket"]:
+        if ls.get("le") == "+Inf":
+            base = tuple(sorted((k, v) for k, v in ls.items()
+                                if k != "le"))
+            assert int(float(raw)) == hold_counts[base], ls
+
+
 def test_disabled_span_overhead_is_noise():
     """Satellite: the -telemetryspans=0 kill switch must early-exit in
     span() before any contextvar/clock work.  Pin it with a microbench:

@@ -55,6 +55,10 @@ EXPECTED_EXTRAS = {
     "getprofile",
     # fault-tolerance surface: health mode, critical errors, self-check
     "getnodehealth",
+    # lock-contention ledger: per-lock wait/hold attribution + blame
+    # matrix (telemetry/lockstats; safe-mode readable via
+    # rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS)
+    "getlockstats",
     # stratum work-server subsystem (pool/)
     "getpoolinfo",
     # assumeUTXO snapshot bootstrap (chain/snapshot.py): dump/load the

@@ -72,19 +72,26 @@
 #                      with samples), profiler-on pool throughput
 #                      >= 0.95x profiler-off, and the live
 #                      nodexa_device_busy_frac gauge finite in [0,1]
-#  14. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
+#  14. contention     bench/contention.py --assert-observed — the
+#                      admission flood + relay + pool job-cutter +
+#                      share-check threads storm cs_main with the
+#                      contention ledger armed: wait share finite and
+#                      > 0, >= 3 roles attributed, blame matrix served
+#                      non-empty through getlockstats, and ledger-on
+#                      >= 0.95x ledger-off on the interleaved pin flood
+#  15. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
 #                      partition-and-heal converging every node to ONE
 #                      tip with zero honest bans, a digest-pinned
 #                      determinism replay, and a stalling-peer IBD run
 #                      asserting stall rotation beats the deadline
-#  15. net obs         bench/netsim.py --trace-smoke — cross-node trace
+#  16. net obs         bench/netsim.py --trace-smoke — cross-node trace
 #                      assembly (>=3 hops, finite per-hop stages, <10%
 #                      stage-sum reconciliation error), digest replay
 #                      equality with tracing on/off, and the tracing-off
 #                      wire-throughput pin (>= 0.9x lean baseline;
 #                      recalibrated when PR 15's tuple-event loop
 #                      shrank the denominator)
-#  16. relay+scale     bench/netsim.py --adversary + --scale — the
+#  17. relay+scale     bench/netsim.py --adversary + --scale — the
 #                      compact-block relay path against hostile peers
 #                      (collision flood degrades without scoring,
 #                      undecodable cmpctblock = one typed ban, withheld
@@ -93,36 +100,36 @@
 #                      converge + digest replay equality + tips match
 #                      the single-threaded baseline + >=3x events/s +
 #                      propagation-p95/share-loss floors
-#  17. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
+#  18. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
 #                      instant bootstrap: snapshot load-to-tip >= 10x
 #                      faster than replaying the same blocks, bit-exact
 #                      coins digest, and the lying-provider netsim smoke
 #                      (liar caught at the first bad chunk, typed
 #                      disconnect, zero honest bans, digest replay
 #                      equality with transfer enabled)
-#  18. vectors         generate_x16r_vectors.py --check — the committed
+#  19. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  19. native build    compiles the C++ engine (also feeds the wheel)
-#  20. static checks   tools/typecheck.py over the consensus-critical
+#  20. native build    compiles the C++ engine (also feeds the wheel)
+#  21. static checks   tools/typecheck.py over the consensus-critical
 #                      packages PLUS pool/net/telemetry (undefined
 #                      names, module attrs, arity)
-#  21. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  22. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  22. pytest          unit suite (functional suite with --full) —
+#  23. pytest          unit suite (functional suite with --full) —
 #                      runs with DEBUG_LOCKORDER armed on the named
 #                      production locks (tests/conftest.py default), so
 #                      the whole suite doubles as a lock-order soak
-#  23. wheel           platform-tagged wheel incl. the native .so,
+#  24. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/23] lint"
+echo "== [1/24] lint"
 python tools/lint.py
 
-echo "== [2/23] concurrency lint (thread-safety annotations)"
+echo "== [2/24] concurrency lint (thread-safety annotations)"
 # tools/nxlint.py: whole-program AST/call-graph verification of the
 # @requires_lock/@excludes_lock annotations, the no-blocking-under-
 # cs_main rule, the clock=/trace-guard/label-cardinality/fault-site
@@ -135,7 +142,7 @@ echo "== [2/23] concurrency lint (thread-safety annotations)"
 python tools/nxlint.py
 python tools/nxlint.py --self-test
 
-echo "== [3/23] import graph"
+echo "== [3/24] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -153,13 +160,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [4/23] rpc mapping parity"
+echo "== [4/24] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [5/23] telemetry exposition"
+echo "== [5/24] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [6/23] IBD fast path (synthetic)"
+echo "== [6/24] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -171,7 +178,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [7/23] pool stratum e2e (loopback)"
+echo "== [7/24] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -182,7 +189,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [8/23] mesh serving backend (forced 8-device mesh)"
+echo "== [8/24] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -193,7 +200,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [9/23] tx admission fast path (flood)"
+echo "== [9/24] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -204,7 +211,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [10/23] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [10/24] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -215,7 +222,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [11/23] observability (flight recorder + startup attribution)"
+echo "== [11/24] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -230,7 +237,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [12/23] cold start (AOT executable cache + shape discipline)"
+echo "== [12/24] cold start (AOT executable cache + shape discipline)"
 # cold + warm restart children against ONE cache dir: the warm child
 # must strictly beat the cold one (the BENCH_r05 64.5s-warm-vs-54.4s-
 # cold inversion is the regression this stage exists to catch), stay
@@ -245,7 +252,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --assert-warm \
 fi
 tail -2 "$CS_LOG"; rm -f "$CS_LOG"
 
-echo "== [13/23] utilization + profiler (live roofline attribution)"
+echo "== [13/24] utilization + profiler (live roofline attribution)"
 # a loopback serving rig with the sampling profiler at the daemon
 # default (25 Hz): getprofile must round-trip >= 4 thread roles with
 # samples, pool shares/s with the profiler ON must stay >= 0.95x OFF
@@ -258,7 +265,22 @@ if ! python tools/profile_check.py > "$PC_LOG" 2>&1; then
 fi
 tail -2 "$PC_LOG"; rm -f "$PC_LOG"
 
-echo "== [14/23] netsim smoke (multi-node adversarial scenarios)"
+echo "== [14/24] lock contention (ledger attribution + overhead pin)"
+# the admission flood + compact-relay + pool job-cutter + share-check
+# threads storm cs_main with the contention ledger armed: cs_main wait
+# share must be finite and > 0, >= 3 thread roles attributed, the blame
+# matrix non-empty THROUGH the getlockstats RPC handler, and ledger-on
+# throughput >= 0.95x ledger-off on the interleaved pin flood (the
+# ledger must stay cheap enough to ship armed by default)
+LC_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.contention --assert-observed \
+        > "$LC_LOG" 2>&1; then
+    cat "$LC_LOG"; rm -f "$LC_LOG"
+    exit 1
+fi
+tail -1 "$LC_LOG"; rm -f "$LC_LOG"
+
+echo "== [15/24] netsim smoke (multi-node adversarial scenarios)"
 # deterministic in-process 5-node partition-and-heal (must converge all
 # nodes to ONE tip with zero honest bans), a digest-pinned determinism
 # replay, and a stalling-peer IBD run asserting the black-hole peer is
@@ -271,7 +293,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
 fi
 tail -6 "$NS_LOG"; rm -f "$NS_LOG"
 
-echo "== [15/23] net observability (cross-node trace smoke)"
+echo "== [16/24] net observability (cross-node trace smoke)"
 # the wire extension of the PR 8/11 kill-switch contract: an N=5 chain
 # topology must assemble >=1 cluster-wide block-propagation trace
 # spanning >=3 hops with every per-hop stage finite and the stage sum
@@ -287,7 +309,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --trace-smoke \
 fi
 tail -6 "$NO_LOG"; rm -f "$NO_LOG"
 
-echo "== [16/23] relay adversary + internet-scale netsim (sharded)"
+echo "== [17/24] relay adversary + internet-scale netsim (sharded)"
 # the relay path against hostile peers, and the harness at N=500:
 # (a) adversary lane on the SHARDED harness at N=100 — a short-id
 #     collision flood must degrade to the full-block path with the
@@ -318,7 +340,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --scale --assert-floors \
 fi
 tail -14 "$SC_LOG"; rm -f "$SC_LOG"
 
-echo "== [17/23] snapshot bootstrap (assumeUTXO + lying provider)"
+echo "== [18/24] snapshot bootstrap (assumeUTXO + lying provider)"
 # instant bootstrap must actually be instant: snapshot load-to-tip at
 # least 10x faster than replaying the same blocks via process_new_block,
 # bit-exact coins digest asserted, and the adversarial netsim smoke — a
@@ -334,23 +356,23 @@ if ! python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast \
 fi
 tail -12 "$SNAP_LOG"; rm -f "$SNAP_LOG"
 
-echo "== [18/23] crypto vector regeneration"
+echo "== [19/24] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [19/23] native engine build"
+echo "== [20/24] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [20/23] static checks (consensus-critical packages)"
+echo "== [21/24] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [21/23] native hardening (security-check analog)"
+echo "== [22/24] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [22/23] pytest"
+echo "== [23/24] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -362,7 +384,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [23/23] wheel"
+echo "== [24/24] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

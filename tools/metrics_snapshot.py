@@ -84,6 +84,32 @@ delta's
   python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
       --diff pre_mesh.json | python -m json.tool | grep -E "mesh|residency"
 
+Diffing a contention interval (the lock ledger, armed by default;
+-lockstats=0 disarms): snapshot before and after a load interval, then
+read the delta's `nodexa_lock_*` families —
+
+  nodexa_lock_wait_seconds{lock=...,role=...}
+      — histogram of time threads spent BLOCKED, per lock and waiter
+      role; divide a lock's wait-sum by the interval for its wait share
+      (the cs_main number that gates the ROADMAP item 5 split)
+  nodexa_lock_hold_seconds{lock=...,site=...}
+      — outermost hold duration decomposed by acquisition site; the
+      sites that dominate cs_main holds are the split candidates
+  nodexa_lock_blame_seconds_total{lock,waiter_role,holder_role,holder_site}
+      — the blame matrix: whose waits are charged to which holder;
+      a single hot (waiter, holder_site) edge is a surgical fix,
+      uniform blame means the lock itself is oversubscribed
+  nodexa_lock_waiters{lock=...} (gauge pair)
+      — live queue depth; nonzero at rest means a stuck holder
+  nodexa_lock_long_holds_total{lock=...}
+      — pathological holds; each one flight-records a `long_lock_hold`
+      event with the holder's sampled stack (dumpflightrecorder)
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_lock.json
+  ... drive load (or just let the daemon serve) ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_lock.json | python -m json.tool | grep -A6 nodexa_lock
+
 Diffing a utilization interval (the live roofline ledger): snapshot
 before and after a serving interval, then read the delta's
 `nodexa_kernel_*` prefix —

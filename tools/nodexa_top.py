@@ -231,6 +231,37 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
     else:
         lines.append("  prof: -")
 
+    # contention ledger: where threads block and who they blame
+    # (nodexa_lock_* families; absent at -lockstats=0)
+    if have(snap, "nodexa_lock_acquisitions_total"):
+        wait_by_lock: Dict[str, float] = {}
+        for v in _values(snap, "nodexa_lock_wait_seconds"):
+            lk = v.get("labels", {}).get("lock", "")
+            wait_by_lock[lk] = wait_by_lock.get(lk, 0.0) + v.get("sum", 0.0)
+        waiters = by_label(snap, "nodexa_lock_waiters", "lock")
+        hot = sorted(wait_by_lock.items(), key=lambda kv: -kv[1])[:4]
+        lock_line = "  ".join(
+            f"{lk}={sec:.2f}s" + (
+                f" ({int(waiters[lk])}w)" if waiters.get(lk) else "")
+            for lk, sec in hot if sec > 0
+        ) or "uncontended"
+        blame = [
+            (v.get("labels", {}), v.get("value", 0.0))
+            for v in _values(snap, "nodexa_lock_blame_seconds_total")]
+        blame.sort(key=lambda kv: -kv[1])
+        if blame and blame[0][1] > 0:
+            b, sec = blame[0]
+            blame_part = (
+                f"   blame: {b.get('waiter_role')}<-{b.get('holder_role')}"
+                f"@{b.get('holder_site')} {sec:.2f}s")
+        else:
+            blame_part = ""
+        longs = int(series_total(snap, "nodexa_lock_long_holds_total"))
+        warn = f"  {RED}long_holds={longs}{RESET}" if longs else ""
+        lines.append(f"  locks: {lock_line}{blame_part}{warn}")
+    else:
+        lines.append("  locks: -")
+
     # chain: connect latency + throughput
     ccount, cmean, cp99 = hist_stats(
         snap, "nodexa_connectblock_stage_seconds", stage="total")

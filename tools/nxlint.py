@@ -39,6 +39,12 @@ Rules (slugs are what the allowlist grammar takes):
                         annotation naming, a role absent from
                         utils.sync.KNOWN_LOCKS (a typo'd role silently
                         opts out of the declared partial order).
+  lock-ledger           a DebugLock(...) constructed in production code
+                        whose role is absent from
+                        telemetry.lockstats.LEDGER_LOCKS — every named
+                        lock must opt INTO the contention ledger (waiter
+                        gauges pre-registered at arm time); a lock that
+                        ships unregistered is invisible to getlockstats.
   allow-syntax          an ``# nxlint: allow(...)`` with no justification
                         text, an unknown rule slug, or one that
                         suppresses nothing (stale suppressions rot).
@@ -95,12 +101,16 @@ BOUNDED_LABELS = {
     "result", "path", "stage", "mode", "direction", "reason", "site",
     "clean", "event", "kernel", "shape_bucket", "axis", "role", "map",
     "source", "span", "kind", "active", "level",
+    # contention-ledger vocabulary: lock roles are closed by
+    # LEDGER_LOCKS, *_role by the profiler's prefix table, holder_site
+    # by the MAX_SITES_PER_LOCK fold-to-"other" cap
+    "lock", "waiter_role", "holder_role", "holder_site",
 }
 
 RULES = {
     "lock-held", "lock-excluded", "blocking-under-cs-main", "wall-clock",
     "trace-guard", "label-bound", "fault-site", "lock-name",
-    "allow-syntax",
+    "lock-ledger", "allow-syntax",
 }
 
 _ALLOW_RE = re.compile(
@@ -158,6 +168,20 @@ def _load_known_locks() -> Set[str]:
             return {e.value for e in node.value.elts
                     if isinstance(e, ast.Constant)}
     raise RuntimeError("KNOWN_LOCKS not found in utils/sync.py")
+
+
+def _load_ledger_locks() -> Set[str]:
+    """Parse telemetry.lockstats.LEDGER_LOCKS from the AST."""
+    path = os.path.join(REPO, PKG, "telemetry", "lockstats.py")
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "LEDGER_LOCKS"
+                for t in node.targets) and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)}
+    raise RuntimeError("LEDGER_LOCKS not found in telemetry/lockstats.py")
 
 
 class Finding:
@@ -236,13 +260,15 @@ class Analyzer:
     def __init__(self, sources: Dict[str, str],
                  clocked_modules: Optional[Set[str]] = None,
                  known_sites: Optional[Set[str]] = None,
-                 known_locks: Optional[Set[str]] = None):
+                 known_locks: Optional[Set[str]] = None,
+                 ledger_locks: Optional[Set[str]] = None):
         """``sources``: rel-path -> source text for the whole program."""
         self.sources = sources
         self.clocked = (CLOCKED_MODULES if clocked_modules is None
                         else clocked_modules)
         self.known_sites = known_sites
         self.known_locks = known_locks
+        self.ledger_locks = ledger_locks
         self.modules: Dict[str, ModuleIndex] = {}
         self.findings: List[Finding] = []
         # attr name -> set of roles it is bound to anywhere (for
@@ -466,14 +492,22 @@ class Analyzer:
         return self.findings
 
     def _check_lock_names(self, mi: ModuleIndex) -> None:
-        if self.known_locks is None:
-            return
-        for lineno, role in mi.lock_literals:
-            if role not in self.known_locks:
-                self.findings.append(Finding(
-                    mi.rel, lineno, "lock-name",
-                    f"DebugLock role {role!r} is not in "
-                    "utils.sync.KNOWN_LOCKS"))
+        if self.known_locks is not None:
+            for lineno, role in mi.lock_literals:
+                if role not in self.known_locks:
+                    self.findings.append(Finding(
+                        mi.rel, lineno, "lock-name",
+                        f"DebugLock role {role!r} is not in "
+                        "utils.sync.KNOWN_LOCKS"))
+        if self.ledger_locks is not None:
+            for lineno, role in mi.lock_literals:
+                if role not in self.ledger_locks:
+                    self.findings.append(Finding(
+                        mi.rel, lineno, "lock-ledger",
+                        f"DebugLock role {role!r} is not registered with "
+                        "the contention ledger (telemetry.lockstats."
+                        "LEDGER_LOCKS) — named locks must opt into "
+                        "wait/hold attribution"))
 
     def _check_function(self, mi: ModuleIndex, fi: FuncInfo) -> None:
         self._local_locks: Dict[str, str] = {}
@@ -770,7 +804,8 @@ def load_package_sources() -> Dict[str, str]:
 def run_repo() -> List[Finding]:
     an = Analyzer(load_package_sources(),
                   known_sites=_load_known_sites(),
-                  known_locks=_load_known_locks())
+                  known_locks=_load_known_locks(),
+                  ledger_locks=_load_ledger_locks())
     return an.run()
 
 
@@ -798,6 +833,10 @@ from .lib import needs_main, device_entry
 from ..utils.sync import DebugLock
 
 mylock = DebugLock("not-a-declared-role")
+
+# known to sync.KNOWN_LOCKS (self-test table below) but NOT registered
+# with the contention ledger -> lock-ledger
+ledgerless = DebugLock("cs_ledgerless")
 
 def unannotated_caller():
     # two-hop: no annotation, no acquisition -> lock-held
@@ -840,7 +879,8 @@ def run_self_test() -> int:
     an = Analyzer(sources,
                   clocked_modules={"fix/bad.py", "fix/ok.py"},
                   known_sites={"kvstore.wal_append"},
-                  known_locks={"cs_main"})
+                  known_locks={"cs_main", "cs_ledgerless"},
+                  ledger_locks={"cs_main"})
     findings = an.run()
     by_rule: Dict[str, List[Finding]] = {}
     for f in findings:
@@ -852,6 +892,7 @@ def run_self_test() -> int:
         "wall-clock": "fix/bad.py",
         "fault-site": "fix/bad.py",
         "lock-name": "fix/bad.py",
+        "lock-ledger": "fix/bad.py",
     }
     failures = []
     for rule, path in expect.items():
