@@ -551,21 +551,26 @@ def bench_txflood() -> dict:
 
     t = time.perf_counter()
     threads = min(4, max(2, os.cpu_count() or 2))
-    res = flood(threads=threads, repeats=3)
+    res = flood(threads=threads, repeats=3, shards=4)
     log(f"[txflood] {res['staged']['txs']} txs x {threads} threads: "
         f"{res['mempool_accepts_per_s']:,.0f} accepts/s staged vs "
         f"{res['mempool_accepts_per_s_inline']:,.0f} inline -> "
-        f"{res['mempool_staged_vs_inline']}x; cs_main hold p99 "
+        f"{res['mempool_staged_vs_inline']}x; sharded "
+        f"{res['mempool_accepts_per_s_sharded']:,.0f} -> "
+        f"{res['coins_shard_speedup']}x staged; cs_main hold p99 "
         f"{res['csmain_hold_p99_s']*1e3:.1f}ms vs scripts mean "
         f"{res['scripts_stage_mean_s']*1e3:.1f}ms "
         f"({time.perf_counter()-t:.1f}s total)")
     return {
         "mempool_accepts_per_s": res["mempool_accepts_per_s"],
         "mempool_accepts_per_s_inline": res["mempool_accepts_per_s_inline"],
+        "mempool_accepts_per_s_sharded": res["mempool_accepts_per_s_sharded"],
         "mempool_staged_vs_inline": res["mempool_staged_vs_inline"],
+        "coins_shard_speedup": res["coins_shard_speedup"],
         "mempool_csmain_hold_p99_s": res["csmain_hold_p99_s"],
         "mempool_scripts_stage_mean_s": res["scripts_stage_mean_s"],
-        "mempool_taxonomy_match": res["taxonomy"]["match"],
+        "mempool_taxonomy_match": (res["taxonomy"]["match"]
+                                   and res["taxonomy_sharded_match"]),
     }
 
 
@@ -583,15 +588,20 @@ def bench_contention() -> dict:
     top = res["blame_top"] or {}
     log(f"[contention] cs_main wait share {res['cs_main_wait_share']} "
         f"across {len(res['contention_roles'])} roles "
-        f"({res['cs_main_acquisitions']} acquisitions); top blame "
+        f"({res['cs_main_acquisitions']} acquisitions); sharded "
+        f"{res['cs_main_wait_share_sharded']} over "
+        f"{res['coins_shards_acquired']} shards; top blame "
         f"{top.get('waiter_role')}<-{top.get('holder_role')}"
         f"@{top.get('holder_site')}; ledger overhead "
         f"{res['lockstats_overhead_ratio']}x "
         f"({time.perf_counter()-t:.1f}s total)")
     return {
         "csmain_wait_share": res["cs_main_wait_share"],
+        "csmain_wait_share_sharded": res["cs_main_wait_share_sharded"],
         "csmain_wait_share_by_role": res["cs_main_wait_share_by_role"],
         "csmain_hold_by_site": res["cs_main_hold_by_site"],
+        "coins_shard_wait_share": res["coins_shard_wait_share"],
+        "coins_shard_acquisitions": res["coins_shard_acquisitions"],
         "contention_roles": len(res["contention_roles"]),
         "lockstats_overhead_ratio": res["lockstats_overhead_ratio"],
         "lock_blame_edges": res["blame_edges"],

@@ -38,6 +38,11 @@ Reported (also used by bench.py and tools/ci_gate.sh):
   the pin flood — CI floor >= 0.95x (the ledger must be cheap enough
   to stay armed by default)
 - ``blame_top``                   the heaviest getlockstats blame edge
+- ``cs_main_wait_share_sharded``  the same storm rerun with the
+  chainstate resharded to ``--shards`` coins shards — the tentpole's
+  before/after oracle (must sit strictly below the unsharded share),
+  with ``coins_shard_wait_by_lock`` / ``shard_blame_top`` carrying the
+  per-shard wait and rolled-up ``coins.shard*`` blame attribution
 
 Run: ``python -m nodexa_chain_core_tpu.bench.contention [--assert-observed]``
 """
@@ -137,14 +142,19 @@ def _storm_once(cs, lists, spk_raw, ntime: int, threads: int,
 
 def _family_sums(name: str, group_label: str, lock: str = "cs_main"):
     """(total, {group_label value -> sum-seconds}) over one histogram or
-    counter family, filtered to ``lock``."""
+    counter family, filtered to ``lock`` (a trailing ``*`` makes it a
+    prefix match — ``coins.shard*`` sums the whole shard family)."""
     fam = g_metrics.get(name)
     total, by = 0.0, {}
     if fam is None:
         return total, by
     for key, val in fam.collect():
         d = dict(key)
-        if d.get("lock") != lock:
+        have = d.get("lock", "")
+        if lock.endswith("*"):
+            if not have.startswith(lock[:-1]):
+                continue
+        elif have != lock:
             continue
         v = val[1] if isinstance(val, tuple) else val  # histogram: sum
         total += v
@@ -153,7 +163,8 @@ def _family_sums(name: str, group_label: str, lock: str = "cs_main"):
     return total, by
 
 
-def storm(n_txs: int = 192, threads: int = 2, repeats: int = 5) -> dict:
+def storm(n_txs: int = 192, threads: int = 2, repeats: int = 5,
+          shards: int = 4) -> dict:
     from ..rpc.misc import getlockstats
     from ..telemetry.lockstats import (
         enable_lockstats, reset_lockstats_for_tests)
@@ -232,6 +243,56 @@ def storm(n_txs: int = 192, threads: int = 2, repeats: int = 5) -> dict:
     on_wall = max(on_wall, 1e-9)
     ranked_sites = sorted(hold_by_site.items(), key=lambda kv: -kv[1])
     blame = (lockstats_rpc or {}).get("blame", [])
+
+    # ---- phase 3: the SAME armed storm, chainstate sharded -----------
+    # the before/after oracle the tentpole is gated on: with the
+    # snapshot stage moved onto per-touched-shard locks, the share of a
+    # wall-second the storm spends blocked on cs_main must drop
+    sharded: dict = {}
+    if shards > 1:
+        cs.set_coins_shards(shards)
+        reset_lockstats_for_tests()
+        sh_runs = []
+        sh_wall = 0.0
+        sh_rpc = None
+        sys.setswitchinterval(0.0002)
+        try:
+            enable_lockstats(True)
+            for _ in range(2):
+                r = _storm_once(cs, lists, spk_raw, ntime, threads)
+                sh_runs.append(r)
+                sh_wall += r["wall_s"]
+            sh_rpc = getlockstats(None, [])
+        finally:
+            enable_lockstats(False)
+            sys.setswitchinterval(old_switch)
+        sh_wall = max(sh_wall, 1e-9)
+        sh_wait, sh_wait_role = _family_sums(
+            "nodexa_lock_wait_seconds", "role")
+        shard_wait, shard_wait_by = _family_sums(
+            "nodexa_lock_wait_seconds", "lock", lock="coins.shard*")
+        shard_acq, shard_acq_by = _family_sums(
+            "nodexa_lock_acquisitions_total", "lock", lock="coins.shard*")
+        sh_blame = (sh_rpc or {}).get("blame", [])
+        shard_edges = [b for b in sh_blame
+                       if b.get("lock") == "coins.shard*"]
+        sharded = {
+            "coins_shards": shards,
+            "storm_sharded": max(sh_runs,
+                                 key=lambda r: r["accepts_per_s"]),
+            "cs_main_wait_share_sharded": round(sh_wait / sh_wall, 4),
+            "cs_main_wait_share_by_role_sharded": {
+                r: round(s / sh_wall, 4)
+                for r, s in sorted(sh_wait_role.items())},
+            "coins_shard_wait_share": round(shard_wait / sh_wall, 4),
+            "coins_shard_wait_by_lock": {
+                k: round(s, 6) for k, s in sorted(shard_wait_by.items())},
+            "coins_shard_acquisitions": int(shard_acq),
+            "coins_shards_acquired": len(shard_acq_by),
+            "shard_blame_edges": len(shard_edges),
+            "shard_blame_top": shard_edges[0] if shard_edges else None,
+        }
+
     return {
         "pin_flood_on": best["on"],
         "pin_flood_off": best["off"],
@@ -248,6 +309,7 @@ def storm(n_txs: int = 192, threads: int = 2, repeats: int = 5) -> dict:
         "lockstats_overhead_ratio": round(ratio_of(best), 3),
         "blame_edges": len(blame),
         "blame_top": blame[0] if blame else None,
+        **sharded,
     }
 
 
@@ -266,16 +328,23 @@ def main(argv=None) -> int:
         "storm roles ride on top")
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument(
+        "--shards", type=int, default=4,
+        help="rerun the armed storm with the chainstate resharded to "
+        "this many coins shards for the before/after wait-share "
+        "comparison; 0 disables the sharded phase")
+    p.add_argument(
         "--assert-observed",
         action="store_true",
         help="CI gate: cs_main wait share finite and > 0 under the "
         "storm, >= 3 roles attributed, non-empty blame matrix through "
-        "getlockstats, and ledger-on throughput >= 0.95x ledger-off",
+        "getlockstats, ledger-on throughput >= 0.95x ledger-off, and "
+        "(with --shards) sharded cs_main wait share strictly below the "
+        "unsharded storm's with the shard-lock family exercised",
     )
     args = p.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     threads = args.threads or min(2, max(1, os.cpu_count() or 1))
-    res = storm(args.txs, threads, args.repeats)
+    res = storm(args.txs, threads, args.repeats, args.shards)
     print(json.dumps(res, indent=1))
     if args.assert_observed:
         # explicit raises, not assert: the gate must also gate under -O
@@ -295,16 +364,39 @@ def main(argv=None) -> int:
              "(< 0.95x floor) — the ledger is too expensive to stay "
              "armed by default"),
         )
+        if args.shards > 1:
+            sh = res["cs_main_wait_share_sharded"]
+            gates += (
+                # the tentpole's acceptance oracle: moving the snapshot
+                # stage onto per-touched-shard locks must shrink the
+                # storm's cs_main wait share, not merely relocate it
+                (math.isfinite(sh) and sh < share,
+                 f"sharded cs_main wait share {sh} is not strictly "
+                 f"below the unsharded storm's {share} — sharding did "
+                 "not relieve the lock"),
+                (res["coins_shard_acquisitions"] > 0
+                 and res["coins_shards_acquired"] >= 2,
+                 f"shard-lock family barely exercised "
+                 f"({res['coins_shard_acquisitions']} acquisitions over "
+                 f"{res['coins_shards_acquired']} shards) — the storm "
+                 "is not going through the sharded snapshot"),
+            )
         for ok, msg in gates:
             if not ok:
                 raise SystemExit(f"lock contention ledger FAILED: {msg}")
         top = res["blame_top"]
+        sharded = (
+            f"; sharded wait share {res['cs_main_wait_share_sharded']} < "
+            f"{share} across {res['coins_shards_acquired']} shards "
+            f"({res['coins_shard_acquisitions']} shard acquisitions)"
+            if args.shards > 1 else "")
         print(
             f"lock contention ledger OK: cs_main wait share {share} "
             f"({', '.join(f'{r}={s}' for r, s in res['cs_main_wait_share_by_role'].items())}), "
             f"{len(res['contention_roles'])} roles attributed, top blame "
             f"{top['waiter_role']}<-{top['holder_role']}@{top['holder_site']} "
             f"{top['seconds']}s, overhead {res['lockstats_overhead_ratio']}x"
+            + sharded
         )
     return 0
 

@@ -275,6 +275,18 @@ class CoinsViewCache(CoinsViewBacked):
         """Approximate heap footprint — the -dbcache accounting unit."""
         return self._mem_bytes
 
+    def cache_contains(self, outpoint: OutPoint) -> bool:
+        """True iff the entry is already resident (no parent fetch) —
+        the warm-check the block-connect prefetcher keys off."""
+        return outpoint in self._cache
+
+    def purge(self) -> None:
+        """Drop every cached entry WITHOUT writing anything — dirty
+        state included.  Only for snapshot activation/teardown, where
+        the cache's contents are being abandoned wholesale."""
+        self._cache.clear()
+        self._mem_bytes = 0
+
     # -- tx helpers --------------------------------------------------------
 
     def add_tx_outputs(self, tx: Transaction, height: int) -> None:

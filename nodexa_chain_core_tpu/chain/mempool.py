@@ -132,9 +132,15 @@ class TxMemPool:
 
     # -- in-flight outpoint reservations (staged admission) ----------------
 
-    @requires_lock("cs_main")
     def reserve_outpoints(self, tx: Transaction) -> bool:
         """Claim tx's inputs against concurrent in-flight admissions.
+
+        Self-synchronizing: the whole body runs under the internal
+        ``mempool.reserved`` lock, so callers need no outer lock for
+        correctness — the classic staged path calls it under cs_main,
+        the sharded path under the touched coins-shard locks (which is
+        what makes same-outpoint races settle first-wins), and the
+        all-or-nothing refcounted claim keeps either ordering sound.
 
         All-or-nothing: returns False (claiming nothing) if any input is
         already reserved by a DIFFERENT transaction.  Same-txid claims

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import time as _time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional, Set
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from ..consensus.tx_verify import (
     TxValidationError,
@@ -225,7 +225,34 @@ def _context_checks(
     """Stage 2 (under cs_main): every check that reads tip or pool state,
     ending in a coins snapshot the off-lock script stage verifies against.
     Also the commit-stage re-check when the tip moved mid-flight."""
-    tip = chainstate.tip()
+    return _context_checks_at(
+        chainstate, pool, tx, bypass_limits, size,
+        tip=chainstate.tip(),
+        generation=getattr(chainstate, "tip_generation", 0),
+        pool_generation=pool.removal_generation,
+    )
+
+
+def _context_checks_at(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool,
+    size: int,
+    tip,
+    generation: int,
+    pool_generation: int,
+) -> _AdmissionContext:
+    """The stage-2 body, lock-agnostic: the inline/staged paths run it
+    under cs_main via :func:`_context_checks`; the SHARDED staged path
+    runs it holding only the touched coins shards, against a tip context
+    (``tip``/``generation``/``pool_generation``) captured under a brief
+    cs_main hold BEFORE any state read.  That inversion is safe because
+    block connect applies its coin batches under the shard locks before
+    bumping ``tip_generation``, and every pool removal bumps
+    ``removal_generation`` — any interleaving this stage could observe
+    forces the commit-stage generation re-check to re-run these checks
+    under full cs_main."""
     height = (tip.height if tip else 0) + 1
     mtp = tip.median_time_past() if tip else 0
     if not is_final_tx(tx, height, mtp):
@@ -364,8 +391,8 @@ def _context_checks(
         coins=coins,
         conflicts=conflicts,
         direct_conflicts=direct_conflicts,
-        generation=getattr(chainstate, "tip_generation", 0),
-        pool_generation=pool.removal_generation,
+        generation=generation,
+        pool_generation=pool_generation,
     )
 
 
@@ -568,6 +595,66 @@ def _accept_inline_locked(
     return _commit_locked(chainstate, pool, tx, ctx, bypass_limits)
 
 
+def _snapshot_sharded(
+    chainstate: ChainState,
+    pool: TxMemPool,
+    tx: Transaction,
+    bypass_limits: bool,
+    size: int,
+) -> Tuple[_AdmissionContext, float]:
+    """Stage 2, sharded (-coinsshards > 1): the global snapshot hold
+    shrinks to (a) one BRIEF cs_main hold capturing the tip context —
+    tip index entry plus the two generation counters, read before any
+    state — and (b) short holds of only the shards this tx touches, so
+    admissions of shard-disjoint transactions run their context checks
+    concurrently.  Outpoint reservation happens inside the shard guard:
+    two admissions racing the same outpoint share that outpoint's shard
+    lock, so the first reservation wins and the loser rejects cleanly.
+
+    Returns ``(ctx, cs_main_hold_seconds)``."""
+    coins = chainstate.coins
+    with chainstate.cs_main:
+        t_hold = _time.perf_counter()
+        tip = chainstate.tip()
+        generation = getattr(chainstate, "tip_generation", 0)
+        pool_generation = pool.removal_generation
+        hold = _time.perf_counter() - t_hold
+    touched = coins.shards_of_tx(tx)
+    ctx: Optional[_AdmissionContext] = None
+    with coins.shard_guard(touched):
+        try:
+            ctx = _context_checks_at(
+                chainstate, pool, tx, bypass_limits, size,
+                tip=tip, generation=generation,
+                pool_generation=pool_generation,
+            )
+        except MempoolAcceptError:
+            raise
+        except Exception:  # noqa: BLE001 — torn off-lock pool read
+            # a concurrent commit mutated pool structures mid-iteration;
+            # fall through to the classic full-hold snapshot (rare, and
+            # never silent: ctx stays None)
+            ctx = None
+        if ctx is not None and not pool.reserve_outpoints(tx):
+            raise MempoolAcceptError(
+                "txn-mempool-conflict",
+                "input reserved by a concurrent admission",
+            )
+    if ctx is None:
+        # NB: outside the shard guard — cs_main precedes the shard locks
+        # in the declared order, so it must never be acquired inside one
+        with chainstate.cs_main:
+            t_hold = _time.perf_counter()
+            ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
+            if not pool.reserve_outpoints(tx):
+                raise MempoolAcceptError(
+                    "txn-mempool-conflict",
+                    "input reserved by a concurrent admission",
+                )
+            hold += _time.perf_counter() - t_hold
+    return ctx, hold
+
+
 def _accept_staged(
     chainstate: ChainState,
     pool: TxMemPool,
@@ -582,17 +669,23 @@ def _accept_staged(
 
     t = _time.perf_counter()
     with trace_span("mempool.snapshot"):
-        with chainstate.cs_main:
-            t_hold = _time.perf_counter()  # hold time: clock starts owned
-            ctx = _context_checks(chainstate, pool, tx, bypass_limits, size)
-            # claim the outpoints before dropping the lock: two mutually
-            # conflicting txs must not both reach commit with valid scripts
-            if not pool.reserve_outpoints(tx):
-                raise MempoolAcceptError(
-                    "txn-mempool-conflict",
-                    "input reserved by a concurrent admission",
-                )
-            hold = _time.perf_counter() - t_hold
+        if getattr(chainstate, "coins_shards", 1) > 1:
+            ctx, hold = _snapshot_sharded(
+                chainstate, pool, tx, bypass_limits, size)
+        else:
+            with chainstate.cs_main:
+                t_hold = _time.perf_counter()  # hold time: clock starts owned
+                ctx = _context_checks(
+                    chainstate, pool, tx, bypass_limits, size)
+                # claim the outpoints before dropping the lock: two mutually
+                # conflicting txs must not both reach commit with valid
+                # scripts
+                if not pool.reserve_outpoints(tx):
+                    raise MempoolAcceptError(
+                        "txn-mempool-conflict",
+                        "input reserved by a concurrent admission",
+                    )
+                hold = _time.perf_counter() - t_hold
     _M_ACCEPT_SECONDS.observe(_time.perf_counter() - t, stage="snapshot")
     _M_CSMAIN_HOLD.observe(hold, stage="snapshot")
 

@@ -74,6 +74,7 @@ from ..node.faults import g_faults
 from ..telemetry import flight_recorder, g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
 from .coins import CoinsViewCache, CoinsViewDB
+from .coins_shards import _SHARD_BEST_PREFIX
 from .kvstore import WriteBatch
 from ..utils.sync import DebugLock, requires_lock
 
@@ -847,12 +848,17 @@ class SnapshotManager:
                 batch = WriteBatch()
                 for k, _ in db.iterate(_COIN_PREFIX):
                     batch.delete(k)
+                # per-shard best markers die WITH the coins they
+                # describe (same batch): a stale coins.shard marker over
+                # snapshot-loaded records would poison crash replay
+                for k, _ in db.iterate(_SHARD_BEST_PREFIX):
+                    if len(k) == 2:
+                        batch.delete(k)
                 batch.put(_K_LOADING, snap_id)
                 if g_faults.enabled:
                     g_faults.check("snapshot.activate")
                 db.write_batch(batch)
-                cs.coins._cache.clear()
-                cs.coins._mem_bytes = 0
+                cs.coins.purge()
                 digest = _CoinsDigest(
                     manifest.base_height, manifest.base_hash)
                 n_coins = 0
@@ -894,6 +900,9 @@ class SnapshotManager:
             batch = WriteBatch()
             for k, _ in db.iterate(_COIN_PREFIX):
                 batch.delete(k)
+            for k, _ in db.iterate(_SHARD_BEST_PREFIX):
+                if len(k) == 2:
+                    batch.delete(k)
             for k in (_K_LOADING, _BEST_BLOCK_KEY, _ASSETS_KEY):
                 batch.delete(k)
             db.write_batch(batch)
@@ -901,9 +910,8 @@ class SnapshotManager:
 
             cs.assets.__dict__.clear()
             cs.assets.__dict__.update(AssetsCache().__dict__)
-            cs.coins._cache.clear()
-            cs.coins._mem_bytes = 0
-            cs.coins._best_block = 0
+            cs.coins.purge()
+            cs.coins.set_best_block(0)
             if cs._replay_blocks():
                 cs.flush_state_to_disk()
         except Exception as e:  # noqa: BLE001 — restart replays the marker
@@ -995,8 +1003,7 @@ class SnapshotManager:
                       if manifest.assets_blob else AssetsCache())
         cs.assets.__dict__.clear()
         cs.assets.__dict__.update(new_assets.__dict__)
-        cs.coins._cache.clear()
-        cs.coins._mem_bytes = 0
+        cs.coins.purge()
         cs.coins.set_best_block(manifest.base_hash)
         cs.active.set_tip(base_idx)
         cs.candidates.add(base_idx)

@@ -70,6 +70,13 @@ from .blockstore import (
 )
 from .checkqueue import CheckQueue, CheckQueueControl
 from .coins import Coin, CoinsViewCache, CoinsViewDB
+from .coins_shards import (
+    ShardedCoinsDB,
+    ShardedCoinsView,
+    normalize_shard_markers,
+    read_shard_markers,
+    shard_count_ok,
+)
 from .kvstore import KVError, KVStore
 from .txdb import BlockTreeDB
 
@@ -147,6 +154,7 @@ class ChainState:
         block_chunk_bytes: int = 16 * 1024 * 1024,
         dbcache_bytes: int = 64 * 1024 * 1024,
         coins_flush_interval_s: float = 300.0,
+        coins_shards: int = 1,
     ):
         self.params = params
         self.datadir = datadir
@@ -211,21 +219,8 @@ class ChainState:
             self.block_store = BlockStore_InMemory()
             self.blocktree = BlockTreeDB(self._blocktree_db, params.algo_schedule)
 
-        self.coins_db = CoinsViewDB(self._chainstate_db)
-        self.coins = CoinsViewCache(self.coins_db)
-        # weakref: the registry callback is last-writer-wins and outlives
-        # this ChainState — a closure over self.coins would pin a closed
-        # chainstate's whole cache (up to -dbcache) for the process life
-        coins_ref = weakref.ref(self.coins)
-        g_metrics.gauge_fn(
-            "nodexa_coins_cache_entries",
-            "Entries resident in the persistent coins cache",
-            lambda: float(c.cache_size()) if (c := coins_ref()) else 0.0)
-        g_metrics.gauge_fn(
-            "nodexa_coins_cache_bytes",
-            "Approximate heap bytes of the persistent coins cache "
-            "(-dbcache accounting)",
-            lambda: float(c.cache_bytes()) if (c := coins_ref()) else 0.0)
+        self.coins_shards = 1
+        self._build_coins_stack(coins_shards)
         if script_check_threads == 0:
             # -par=0 -> auto (ref init.cpp:1125): worker threads pay off only
             # with the GIL-free native ECDSA engine; pure Python stays inline.
@@ -239,6 +234,10 @@ class ChainState:
         self.checkqueue = (
             CheckQueue(script_check_threads) if script_check_threads > 0 else None
         )
+        if self.coins_shards > 1:
+            # connect-time per-shard batch application fans across the
+            # same worker pool as script checks (sequential when absent)
+            self.coins._checkqueue = self.checkqueue
         # asset state (ref CAssetsCache wired through ConnectBlock,
         # validation.cpp:10052)
         from ..assets.cache import AssetsCache
@@ -251,6 +250,58 @@ class ChainState:
         else:
             self.assets = AssetsCache()
         self._load_or_init()
+
+    # --------------------------------------------------------- coins stack
+
+    def _build_coins_stack(self, n_shards: int) -> None:
+        """(Re)build ``coins_db``/``coins`` at ``n_shards`` shards.
+
+        ``n_shards == 1`` is the classic unsharded stack, bit-identical
+        to every prior release; ``> 1`` is the outpoint-sharded stack of
+        chain/coins_shards.py.  The on-disk coin records are
+        shard-count-invariant, so the count is free to differ from the
+        one that wrote the current chainstate — replay interprets any
+        leftover per-shard markers with the count their WRITER recorded."""
+        if not shard_count_ok(n_shards):
+            raise ValueError(
+                f"-coinsshards must be a power of two 1..16, got {n_shards}")
+        self.coins_shards = n_shards
+        if n_shards == 1:
+            self.coins_db = CoinsViewDB(self._chainstate_db)
+            self.coins = CoinsViewCache(self.coins_db)
+        else:
+            self.coins_db = ShardedCoinsDB(self._chainstate_db, n_shards)
+            self.coins = ShardedCoinsView(
+                self.coins_db, checkqueue=getattr(self, "checkqueue", None))
+        # weakref: the registry callback is last-writer-wins and outlives
+        # this ChainState — a closure over self.coins would pin a closed
+        # chainstate's whole cache (up to -dbcache) for the process life
+        coins_ref = weakref.ref(self.coins)
+        g_metrics.gauge_fn(
+            "nodexa_coins_cache_entries",
+            "Entries resident in the persistent coins cache",
+            lambda: float(c.cache_size()) if (c := coins_ref()) else 0.0)
+        g_metrics.gauge_fn(
+            "nodexa_coins_cache_bytes",
+            "Approximate heap bytes of the persistent coins cache "
+            "(-dbcache accounting)",
+            lambda: float(c.cache_bytes()) if (c := coins_ref()) else 0.0)
+
+    @_with_cs_main
+    def set_coins_shards(self, n_shards: int) -> None:
+        """Reconfigure the shard count on a live chainstate.
+
+        Flushes the current stack to disk (so no dirty state straddles
+        the swap), rebuilds the view stack, and re-stamps the per-shard
+        markers at the running count — everything is at the tip after
+        the flush, which is true under any partition."""
+        if n_shards == self.coins_shards:
+            return
+        self.flush_state_to_disk(mode="always")
+        self._build_coins_stack(n_shards)
+        tip = self.active.tip()
+        normalize_shard_markers(
+            self._chainstate_db, n_shards, tip.block_hash if tip else 0)
 
     # ------------------------------------------------------------------ init
 
@@ -355,33 +406,61 @@ class ChainState:
 
     @requires_lock("cs_main")
     def _roll_forward_block(
-        self, block: Block, idx: BlockIndex, view: CoinsViewCache
+        self, block: Block, idx: BlockIndex, view: CoinsViewCache,
+        shard_filter=None, touch_assets: bool = True,
     ) -> None:
         """Re-apply an already-validated block's coin + asset transitions
         (ref ReplayBlocks' RollforwardBlock): no PoW/script/amount checks
         re-run — the block was fully validated before the crash; only the
-        state transition is replayed."""
+        state transition is replayed.
+
+        ``shard_filter`` (sharded crash replay) restricts the coin
+        mutations to one shard component's outpoints; slices outside it
+        are at a DIFFERENT height and must not be touched.  When the
+        asset replay needs a spent coin a filtered-out slice has already
+        consumed, the undo journal supplies it — the journal records
+        exactly the pre-spend coin.  ``touch_assets=False`` replays a
+        component the asset state is already ahead of."""
         cons = self.params.consensus
-        assets_active = (
+        assets_active = touch_assets and (
             idx.height >= cons.asset_activation_height
             or versionbits_cache.is_active(idx.prev, cons, DEPLOYMENT_ASSETS)
         )
-        for tx in block.vtx:
+        undo: Optional[BlockUndo] = None
+        for i, tx in enumerate(block.vtx):
             spent_pairs = []
             if not tx.is_coinbase():
-                for txin in tx.vin:
-                    coin = view.get_coin(txin.prevout)
-                    if coin is None:
-                        raise BlockValidationError(
-                            "replay-missing-input",
-                            f"h={idx.height} {txin.prevout}",
-                        )
-                    spent_pairs.append((coin.out.script_pubkey, coin))
-                    view.spend_coin(txin.prevout)
+                for j, txin in enumerate(tx.vin):
+                    mine = shard_filter is None or shard_filter(txin.prevout)
+                    coin = (view.get_coin(txin.prevout)
+                            if (mine or assets_active) else None)
+                    if mine:
+                        if coin is None:
+                            raise BlockValidationError(
+                                "replay-missing-input",
+                                f"h={idx.height} {txin.prevout}",
+                            )
+                        view.spend_coin(txin.prevout)
+                    if assets_active:
+                        if coin is None:
+                            # that slice already spent it; the journal
+                            # holds the pre-spend coin verbatim
+                            if undo is None:
+                                undo = self._read_undo_for(idx)
+                            coin = undo.vtxundo[i - 1].prevouts[j]
+                        spent_pairs.append((coin.out.script_pubkey, coin))
             if assets_active:
                 self.assets.check_and_apply_tx(tx, spent_pairs, idx.height)
-            view.add_tx_outputs(tx, idx.height)
+            if shard_filter is None or shard_filter(OutPoint(tx.txid, 0)):
+                view.add_tx_outputs(tx, idx.height)
         view.set_best_block(idx.block_hash)
+
+    def _read_undo_for(self, idx: BlockIndex) -> BlockUndo:
+        _, upos = self.positions.get(idx.block_hash, (-1, -1))
+        if upos < 0:
+            raise BlockValidationError(
+                "replay-no-undo", u256_hex(idx.block_hash))
+        return self.block_store.read_undo(upos)
 
     @requires_lock("cs_main")
     def _replay_blocks(self) -> int:
@@ -396,45 +475,81 @@ class ChainState:
         if tip is None:
             return 0
         coins_best = self.coins.get_best_block()
-        if coins_best == tip.block_hash:
+        # sharded crash healing: a flush that died between shard batches
+        # leaves individual shard slices AHEAD of the global marker (never
+        # behind an advanced one).  Group the persisted per-shard markers
+        # into components by best-hash — the writer's recorded shard count
+        # tells us which mask its markers partition by, independent of the
+        # RUNNING -coinsshards — and heal each component over exactly its
+        # own outpoint slice.  Asset state commits with the global marker,
+        # so it rides the coins_best component (possibly alone).
+        writer_n, raw_markers = read_shard_markers(self._chainstate_db)
+        comps: Dict[int, set] = {}
+        for k in range(writer_n):
+            comps.setdefault(raw_markers.get(k, coins_best), set()).add(k)
+        comps.setdefault(coins_best, set())  # assets anchor
+        if all(s == tip.block_hash for s in comps):
+            # consistent; drop marker leftovers that no longer match the
+            # running config (count switch, or a now-unsharded node)
+            if raw_markers and (self.coins_shards == 1
+                                or writer_n != self.coins_shards):
+                normalize_shard_markers(
+                    self._chainstate_db, self.coins_shards, tip.block_hash)
             return 0
+        mask = writer_n - 1
+        legacy = len(comps) == 1 and writer_n == 1
         view = CoinsViewCache(self.coins)
         n = 0
-        start_height = 0
-        if coins_best:
-            start = self.block_index.get(coins_best)
-            if start is None:
-                raise BlockValidationError(
-                    "replay-unknown-coins-tip", u256_hex(coins_best)
-                )
-            fork = (
-                start if start in self.active
-                else self.active.find_fork(start)
-            )
-            walk: Optional[BlockIndex] = start
-            while walk is not None and walk is not fork:
-                block = self.read_block(walk)
-                _, upos = self.positions.get(walk.block_hash, (-1, -1))
-                if upos < 0:
+        for comp_best in sorted(comps):
+            slices = frozenset(comps[comp_best])
+            touch_assets = comp_best == coins_best
+            if legacy:
+                shard_filter = None
+            else:
+                shard_filter = (lambda op, s=slices:
+                                (op.txid & mask) in s)
+            start_height = 0
+            if comp_best:
+                start = self.block_index.get(comp_best)
+                if start is None:
                     raise BlockValidationError(
-                        "replay-no-undo", u256_hex(walk.block_hash)
+                        "replay-unknown-coins-tip", u256_hex(comp_best)
                     )
-                self.disconnect_block(
-                    block, walk, view, undo=self.block_store.read_undo(upos)
+                fork = (
+                    start if start in self.active
+                    else self.active.find_fork(start)
                 )
+                walk: Optional[BlockIndex] = start
+                while walk is not None and walk is not fork:
+                    self.disconnect_block(
+                        self.read_block(walk), walk, view,
+                        touch_assets=touch_assets,
+                        undo=self._read_undo_for(walk),
+                        shard_filter=shard_filter,
+                    )
+                    n += 1
+                    walk = walk.prev
+                start_height = fork.height + 1 if fork is not None else 0
+            for h in range(start_height, tip.height + 1):
+                idx = self.active.at(h)
+                assert idx is not None
+                self._roll_forward_block(
+                    self.read_block(idx), idx, view,
+                    shard_filter=shard_filter, touch_assets=touch_assets)
                 n += 1
-                walk = walk.prev
-            start_height = fork.height + 1 if fork is not None else 0
-        for h in range(start_height, tip.height + 1):
-            idx = self.active.at(h)
-            assert idx is not None
-            self._roll_forward_block(self.read_block(idx), idx, view)
-            n += 1
+        view.set_best_block(tip.block_hash)
         view.flush()
+        # push the healed state to DISK before re-stamping markers — a
+        # marker claiming tip over records still behind it would poison
+        # the NEXT replay
+        self._write_coins(drop_cache=False)
+        normalize_shard_markers(
+            self._chainstate_db, self.coins_shards, tip.block_hash)
         log_print(
             LogFlags.NONE,
-            "replay: healed coins view over %d blocks to %s h=%d",
-            n,
+            "replay: healed coins view over %d blocks (%d component%s) "
+            "to %s h=%d",
+            n, len(comps), "" if len(comps) == 1 else "s",
             u256_hex(tip.block_hash)[:16],
             tip.height,
         )
@@ -1078,12 +1193,16 @@ class ChainState:
     def disconnect_block(
         self, block: Block, idx: BlockIndex, view: CoinsViewCache,
         touch_assets: bool = True, undo: Optional[BlockUndo] = None,
+        shard_filter=None,
     ) -> None:
         """Replay the undo journal backwards (ref DisconnectBlock).
 
         ``touch_assets=False`` runs a coins-only dry run (verify_db's
         scratch sweep) without mutating the live asset cache; a pre-read
-        ``undo`` skips the disk fetch.
+        ``undo`` skips the disk fetch.  ``shard_filter`` (sharded crash
+        replay) restricts the coin mutations to one shard component's
+        outpoints — slices outside it sit at a different height and are
+        healed by their own component's pass.
         """
         if undo is None:
             _, upos = self.positions.get(idx.block_hash, (-1, -1))
@@ -1099,15 +1218,19 @@ class ChainState:
         # remove outputs created by this block, restore spent coins
         for i in range(len(block.vtx) - 1, -1, -1):
             tx = block.vtx[i]
-            for j, out in enumerate(tx.vout):
-                if not Script(out.script_pubkey).is_unspendable():
-                    view.spend_coin(OutPoint(tx.txid, j))
+            if shard_filter is None or shard_filter(OutPoint(tx.txid, 0)):
+                for j, out in enumerate(tx.vout):
+                    if not Script(out.script_pubkey).is_unspendable():
+                        view.spend_coin(OutPoint(tx.txid, j))
             if i > 0:
                 txundo = undo.vtxundo[i - 1]
                 if len(txundo.prevouts) != len(tx.vin):
                     raise BlockValidationError("bad-undo-data")
                 for j in range(len(tx.vin) - 1, -1, -1):
-                    view.add_coin(tx.vin[j].prevout, txundo.prevouts[j], overwrite=True)
+                    if (shard_filter is None
+                            or shard_filter(tx.vin[j].prevout)):
+                        view.add_coin(tx.vin[j].prevout,
+                                      txundo.prevouts[j], overwrite=True)
         view.set_best_block(idx.prev.block_hash if idx.prev else 0)
 
     @requires_lock("cs_main")
@@ -1166,11 +1289,11 @@ class ChainState:
         reader contract, and no cache mutation means no consistency
         hazard."""
         db = self.coins_db
-        resident = self.coins._cache
+        resident = self.coins.cache_contains  # lock-free racy peek
         n = 0
         for tx in block.vtx[1:]:
             for txin in tx.vin:
-                if txin.prevout in resident:
+                if resident(txin.prevout):
                     continue
                 # have_coin: the raw kvstore read does the warming; skip
                 # the per-coin deserialization a get_coin would pay

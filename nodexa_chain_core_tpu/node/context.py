@@ -21,6 +21,7 @@ class NodeContext:
         block_chunk_bytes: int = 16 * 1024 * 1024,
         dbcache_bytes: int = 64 * 1024 * 1024,
         coins_flush_interval_s: float = 300.0,
+        coins_shards: int = 1,
     ):
         self.params: NetworkParams = select_params(network)
         self.datadir = datadir
@@ -31,6 +32,7 @@ class NodeContext:
             block_chunk_bytes=block_chunk_bytes,
             dbcache_bytes=dbcache_bytes,
             coins_flush_interval_s=coins_flush_interval_s,
+            coins_shards=coins_shards,
         )
         self.mempool = TxMemPool()
         self.chainstate.mempool = self.mempool

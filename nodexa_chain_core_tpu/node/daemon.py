@@ -150,6 +150,13 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
                 dbcache_bytes=g_args.get_int("dbcache", 450) * 1024 * 1024,
                 coins_flush_interval_s=float(
                     g_args.get_int("dbcacheinterval", 300)),
+                # -coinsshards=N: split the UTXO set into N lock-sharded
+                # slices (clamped to a power of two, 1..16; 1 = classic
+                # unsharded).  Independent admissions then hold only the
+                # shards they touch instead of serializing on cs_main
+                coins_shards=1 << (
+                    max(1, min(16, g_args.get_int("coinsshards", 4)))
+                    .bit_length() - 1),
             )
     except BlockValidationError as e:
         raise SystemExit(
@@ -168,10 +175,13 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
     g_health.attach_node(node)
     cq = node.chainstate.checkqueue
     log_printf(
-        "script verification: %s; coins cache: %d MiB budget",
+        "script verification: %s; coins cache: %d MiB budget; "
+        "coins shards: %s",
         f"{cq.n_threads} -par worker threads" if cq is not None
         else "inline (single-threaded)",
         node.chainstate.dbcache_bytes // (1024 * 1024),
+        (f"{node.chainstate.coins_shards} (per-shard locks)"
+         if node.chainstate.coins_shards > 1 else "off (unsharded)"),
     )
     # -stagedmempool=0 forces the legacy whole-pipeline-under-cs_main
     # admission; default is the staged fast path (short snapshot/commit

@@ -68,6 +68,11 @@ KNOWN_SITES: Dict[str, dict] = {
     "blockstore.rev.read":  {"ibd": False, "help": "undo record read"},
     "blockstore.rev.sync":  {"ibd": False, "help": "undo fsync"},
     "chainstate.coins_flush": {"ibd": True, "help": "coins+assets cache disk flush"},
+    # fires BETWEEN per-shard coins batches (-coinsshards > 1): a kill
+    # here strands some shards at the new best with the rest — and the
+    # global commit marker — still behind, the exact partial state the
+    # per-shard crash replay must heal
+    "chainstate.shard_flush": {"ibd": False, "help": "sharded coins flush, between shard batches"},
     "pool.socket_send":     {"ibd": False, "help": "stratum session socket send"},
     # network sites: errno/torn/kill specs behave on sockets exactly as
     # they do on disk (kill@<n> sends n wire bytes first — a mid-send

@@ -316,13 +316,45 @@ KNOWN_LOCKS = (
     "miner.stats",
     "faults",
     "wallet",
+    # coins shard family (chain/coins_shards.py): one lock per UTXO
+    # shard, enumerated to the MAX_COINS_SHARDS cap so the ledger and
+    # nxlint see a closed set even though construction is parameterized
+    "coins.shard0",
+    "coins.shard1",
+    "coins.shard2",
+    "coins.shard3",
+    "coins.shard4",
+    "coins.shard5",
+    "coins.shard6",
+    "coins.shard7",
+    "coins.shard8",
+    "coins.shard9",
+    "coins.shard10",
+    "coins.shard11",
+    "coins.shard12",
+    "coins.shard13",
+    "coins.shard14",
+    "coins.shard15",
 )
+
+#: the shard lock family in ascending index order — multi-shard regions
+#: MUST acquire in this order (ShardGuard enforces it; the declared
+#: chain below makes any other interleaving a PotentialDeadlock)
+COINS_SHARD_LOCKS = tuple(f"coins.shard{k}" for k in range(16))
 
 # chainstate spine: block connection flushes coins/index under cs_main,
 # through the health layer's guarded_io, into the kvstore/blockstore
 declare_lock_order("cs_main", "health", "kvstore.write", "kvstore.cache")
 declare_lock_order("cs_main", "health", "blockstore")
 declare_lock_order("cs_main", "mempool.reserved")
+# sharded chainstate: shard locks nest inside cs_main (connect/flush) in
+# ascending index order; a shard flush commits through the kvstore with
+# the shard lock held, and the kvstore's escalation path takes "health"
+# inside that hold — so shards sit BEFORE health/kvstore.  Sharded
+# admission takes shard locks then the outpoint reservation table.
+declare_lock_order("cs_main", *COINS_SHARD_LOCKS, "health",
+                   "kvstore.write", "kvstore.cache")
+declare_lock_order(*COINS_SHARD_LOCKS, "mempool.reserved")
 # snapshot manager: activation/back-validation take cs_main FIRST, then
 # the manager lock for state flips inside (backvalidate_step re-checks
 # its state under cs_main+_lock; flush_backvalidation deliberately

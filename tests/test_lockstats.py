@@ -127,6 +127,72 @@ def test_blame_edge_between_named_threads_under_simclock():
     assert edge["seconds"] == pytest.approx(0.25)
 
 
+def test_coins_shard_blame_rolls_up_to_one_family_row():
+    """Contention on DIFFERENT coins.shard<k> locks keeps per-shard
+    resolution in the locks table but collapses into a single
+    ``coins.shard*`` blame row (summed seconds) — 16 near-identical
+    shard edges would bury the real top offender in getlockstats."""
+    clock = SimClock(100.0)
+    ledger = ContentionLedger(time_fn=clock)
+    ledger.set_long_hold_threshold(30.0)
+    lockstats.install(ledger)
+
+    def contend(lock_name, seconds):
+        lock = DebugLock(lock_name)
+        acquired = threading.Event()
+        release = threading.Event()
+
+        def holder_body():
+            with lock:
+                acquired.set()
+                assert release.wait(10)
+
+        def waiter_body():
+            assert lock.acquire()
+            lock.release()
+
+        holder = threading.Thread(target=holder_body, name="pool-jobs-hold")
+        holder.start()
+        assert acquired.wait(5)
+        waiter = threading.Thread(target=waiter_body, name="net.msghand-w")
+        waiter.start()
+        assert _wait_for(
+            lambda: lockstats._G_WAITERS.value(lock=lock_name) == 1.0)
+        time.sleep(0.05)  # let the waiter reach its blocking slice
+        clock.advance(seconds)
+        release.set()
+        holder.join(5)
+        waiter.join(5)
+        assert not holder.is_alive() and not waiter.is_alive()
+
+    contend("coins.shard1", 0.25)
+    contend("coins.shard3", 0.5)
+
+    snap = ledger.snapshot()
+    # per-lock table: full per-shard resolution survives the rollup
+    assert snap["locks"]["coins.shard1"]["wait_seconds"] == \
+        pytest.approx(0.25)
+    assert snap["locks"]["coins.shard3"]["wait_seconds"] == \
+        pytest.approx(0.5)
+    # blame: ONE family row, seconds summed across the member locks
+    fam = [b for b in snap["blame"] if b["lock"].startswith("coins.shard")]
+    assert fam == [{
+        "lock": "coins.shard*",
+        "waiter_role": "validation",
+        "holder_role": "pool-jobs",
+        "holder_site": "test_lockstats.holder_body",
+        "seconds": pytest.approx(0.75),
+    }]
+
+    # getlockstats serves the same rolled-up row
+    out = rpc_misc.getlockstats(None, [5])
+    rows = [b for b in out["blame"] if b["lock"] == "coins.shard*"]
+    assert len(rows) == 1
+    assert rows[0]["seconds"] == pytest.approx(0.75)
+    assert not any(b["lock"].startswith("coins.shard")
+                   for b in out["blame"] if b["lock"] != "coins.shard*")
+
+
 def test_reentrant_acquire_folds_into_outer_hold():
     clock = SimClock()
     ledger = ContentionLedger(time_fn=clock)

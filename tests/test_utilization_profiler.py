@@ -679,6 +679,7 @@ def test_nodexa_top_minimal_layout_renders_dashes():
     assert "shares: -" in frame
     assert "device: -" in frame
     assert "prof: -" in frame
+    assert "shards: -" in frame  # unsharded node registers no shard family
     # and a frame against a COMPLETELY empty snapshot still renders
     assert top.render({}, None, 2.0)
 

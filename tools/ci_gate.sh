@@ -13,9 +13,13 @@
 #   2. concurrency     tools/nxlint.py — thread-safety annotations
 #                      verified across the whole-program call graph,
 #                      blocking-under-cs_main / wall-clock / trace-guard
-#                      / label-cardinality / fault-site rules, plus the
-#                      seeded-violation --self-test (incl. a reversed
-#                      lock pair against the runtime detector)
+#                      / label-cardinality / fault-site rules, the
+#                      parameterized lock-family rule (DebugLock
+#                      f-strings must enumerate every member in both
+#                      registries), plus the seeded-violation
+#                      --self-test (incl. a reversed lock pair and an
+#                      out-of-order coins-shard acquisition against the
+#                      runtime detector)
 #   3. import graph    every package module imports cleanly on CPU
 #   4. rpc parity      tools/check_rpc_mappings.py — all 168 reference
 #                      CRPCCommand names have handlers + extras pinned
@@ -46,14 +50,22 @@
 #                      hold p99 below the off-lock scripts-stage mean
 #                      (ECDSA demonstrably outside the lock), and an
 #                      identical reject taxonomy on both paths
-#  10. fault tolerance tests/test_fault_tolerance.py (fast subset) —
+#  10. sharded coins   bench/txflood.py --shards 4 --assert-fast-path —
+#                      the same flood with the chainstate resharded to 4
+#                      outpoint shards: the snapshot stage swaps cs_main
+#                      for per-touched-shard locks; asserts sharded
+#                      >= 0.85x staged accepts/s (no-regression floor —
+#                      one core cannot parallelize ECDSA; stage 15
+#                      carries the wait-share proof) and a 3-way
+#                      identical reject taxonomy
+#  11. fault tolerance tests/test_fault_tolerance.py (fast subset) —
 #                      deterministic fault-injection specs, a kill-at-
 #                      site crash-recovery pair per tier-1 site asserting
 #                      restart converges to the uninterrupted tip, the
 #                      safe-mode degradation surface, and the startup
 #                      self-check refusing a corrupted undo journal
 #                      (full matrix + daemon e2e run under -m slow)
-#  11. observability   tools/flight_check.py — forced safe-mode entry
+#  12. observability   tools/flight_check.py — forced safe-mode entry
 #                      under -faultinject must auto-dump a flight-
 #                      recorder file carrying >=1 complete causal trace
 #                      (block.connect tree retrievable via gettrace);
@@ -61,37 +73,40 @@
 #                      restart-to-first-sweep in a cold child and
 #                      asserts startup_to_first_sweep_s is finite with
 #                      per-kernel jit-compile attribution recorded
-#  12. cold start      bench/startup.py --assert-warm — cold + warm
+#  13. cold start      bench/startup.py --assert-warm — cold + warm
 #                      restart children against one cache dir: warm
 #                      must strictly beat cold, stay under the 0.6x
 #                      ceiling, restore serialized AOT executables, and
 #                      both children must record ZERO steady-state jit
 #                      compiles (the shape-bucket discipline holds)
-#  13. utilization     tools/profile_check.py — getprofile round-trip
+#  14. utilization     tools/profile_check.py — getprofile round-trip
 #                      over a loopback serving rig (>=4 thread roles
 #                      with samples), profiler-on pool throughput
 #                      >= 0.95x profiler-off, and the live
 #                      nodexa_device_busy_frac gauge finite in [0,1]
-#  14. contention     bench/contention.py --assert-observed — the
+#  15. contention     bench/contention.py --assert-observed — the
 #                      admission flood + relay + pool job-cutter +
 #                      share-check threads storm cs_main with the
 #                      contention ledger armed: wait share finite and
 #                      > 0, >= 3 roles attributed, blame matrix served
-#                      non-empty through getlockstats, and ledger-on
-#                      >= 0.95x ledger-off on the interleaved pin flood
-#  15. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
+#                      non-empty through getlockstats, ledger-on
+#                      >= 0.95x ledger-off on the interleaved pin flood,
+#                      then the SAME storm resharded to 4 coins shards:
+#                      cs_main wait share must land strictly below the
+#                      unsharded storm's with >= 2 shard locks exercised
+#  16. netsim smoke    bench/netsim.py --smoke — deterministic 5-node
 #                      partition-and-heal converging every node to ONE
 #                      tip with zero honest bans, a digest-pinned
 #                      determinism replay, and a stalling-peer IBD run
 #                      asserting stall rotation beats the deadline
-#  16. net obs         bench/netsim.py --trace-smoke — cross-node trace
+#  17. net obs         bench/netsim.py --trace-smoke — cross-node trace
 #                      assembly (>=3 hops, finite per-hop stages, <10%
 #                      stage-sum reconciliation error), digest replay
 #                      equality with tracing on/off, and the tracing-off
 #                      wire-throughput pin (>= 0.9x lean baseline;
 #                      recalibrated when PR 15's tuple-event loop
 #                      shrank the denominator)
-#  17. relay+scale     bench/netsim.py --adversary + --scale — the
+#  18. relay+scale     bench/netsim.py --adversary + --scale — the
 #                      compact-block relay path against hostile peers
 #                      (collision flood degrades without scoring,
 #                      undecodable cmpctblock = one typed ban, withheld
@@ -100,36 +115,36 @@
 #                      converge + digest replay equality + tips match
 #                      the single-threaded baseline + >=3x events/s +
 #                      propagation-p95/share-loss floors
-#  18. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
+#  19. snapshot        bench/snapshot.py --assert-fast — assumeUTXO
 #                      instant bootstrap: snapshot load-to-tip >= 10x
 #                      faster than replaying the same blocks, bit-exact
 #                      coins digest, and the lying-provider netsim smoke
 #                      (liar caught at the first bad chunk, typed
 #                      disconnect, zero honest bans, digest replay
 #                      equality with transfer enabled)
-#  19. vectors         generate_x16r_vectors.py --check — the committed
+#  20. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  20. native build    compiles the C++ engine (also feeds the wheel)
-#  21. static checks   tools/typecheck.py over the consensus-critical
+#  21. native build    compiles the C++ engine (also feeds the wheel)
+#  22. static checks   tools/typecheck.py over the consensus-critical
 #                      packages PLUS pool/net/telemetry (undefined
 #                      names, module attrs, arity)
-#  22. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  23. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  23. pytest          unit suite (functional suite with --full) —
+#  24. pytest          unit suite (functional suite with --full) —
 #                      runs with DEBUG_LOCKORDER armed on the named
 #                      production locks (tests/conftest.py default), so
 #                      the whole suite doubles as a lock-order soak
-#  24. wheel           platform-tagged wheel incl. the native .so,
+#  25. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/24] lint"
+echo "== [1/25] lint"
 python tools/lint.py
 
-echo "== [2/24] concurrency lint (thread-safety annotations)"
+echo "== [2/25] concurrency lint (thread-safety annotations)"
 # tools/nxlint.py: whole-program AST/call-graph verification of the
 # @requires_lock/@excludes_lock annotations, the no-blocking-under-
 # cs_main rule, the clock=/trace-guard/label-cardinality/fault-site
@@ -142,7 +157,7 @@ echo "== [2/24] concurrency lint (thread-safety annotations)"
 python tools/nxlint.py
 python tools/nxlint.py --self-test
 
-echo "== [3/24] import graph"
+echo "== [3/25] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -160,13 +175,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [4/24] rpc mapping parity"
+echo "== [4/25] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [5/24] telemetry exposition"
+echo "== [5/25] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [6/24] IBD fast path (synthetic)"
+echo "== [6/25] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -178,7 +193,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [7/24] pool stratum e2e (loopback)"
+echo "== [7/25] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -189,7 +204,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [8/24] mesh serving backend (forced 8-device mesh)"
+echo "== [8/25] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -200,7 +215,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [9/24] tx admission fast path (flood)"
+echo "== [9/25] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -211,7 +226,23 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [10/24] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [10/25] sharded chainstate admission (-coinsshards=4 flood)"
+# the tentpole's throughput lane: the identical flood with the coins
+# set resharded to 4 outpoint shards, the snapshot stage holding
+# per-touched-shard locks instead of cs_main.  Floor is 0.85x staged —
+# a NO-REGRESSION bound, not a speedup claim: this container has one
+# core, so shard locks cannot buy parallel ECDSA; the contention stage
+# below proves the cs_main wait share actually drops.  The 3-way reject
+# taxonomy (inline/staged/sharded) must be identical.
+SHF_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
+        --shards 4 --assert-fast-path > "$SHF_LOG" 2>&1; then
+    cat "$SHF_LOG"; rm -f "$SHF_LOG"
+    exit 1
+fi
+tail -2 "$SHF_LOG"; rm -f "$SHF_LOG"
+
+echo "== [11/25] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -222,7 +253,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [11/24] observability (flight recorder + startup attribution)"
+echo "== [12/25] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -237,7 +268,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [12/24] cold start (AOT executable cache + shape discipline)"
+echo "== [13/25] cold start (AOT executable cache + shape discipline)"
 # cold + warm restart children against ONE cache dir: the warm child
 # must strictly beat the cold one (the BENCH_r05 64.5s-warm-vs-54.4s-
 # cold inversion is the regression this stage exists to catch), stay
@@ -252,7 +283,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --assert-warm \
 fi
 tail -2 "$CS_LOG"; rm -f "$CS_LOG"
 
-echo "== [13/24] utilization + profiler (live roofline attribution)"
+echo "== [14/25] utilization + profiler (live roofline attribution)"
 # a loopback serving rig with the sampling profiler at the daemon
 # default (25 Hz): getprofile must round-trip >= 4 thread roles with
 # samples, pool shares/s with the profiler ON must stay >= 0.95x OFF
@@ -265,13 +296,17 @@ if ! python tools/profile_check.py > "$PC_LOG" 2>&1; then
 fi
 tail -2 "$PC_LOG"; rm -f "$PC_LOG"
 
-echo "== [14/24] lock contention (ledger attribution + overhead pin)"
+echo "== [15/25] lock contention (ledger attribution + overhead pin)"
 # the admission flood + compact-relay + pool job-cutter + share-check
 # threads storm cs_main with the contention ledger armed: cs_main wait
 # share must be finite and > 0, >= 3 thread roles attributed, the blame
 # matrix non-empty THROUGH the getlockstats RPC handler, and ledger-on
 # throughput >= 0.95x ledger-off on the interleaved pin flood (the
-# ledger must stay cheap enough to ship armed by default)
+# ledger must stay cheap enough to ship armed by default).  The storm
+# then reruns with the chainstate resharded to 4 coins shards — the
+# tentpole's before/after oracle: sharded cs_main wait share must land
+# STRICTLY below the unsharded storm's, with the coins.shard<k> family
+# exercised and its blame edges rolled up into one coins.shard* row
 LC_LOG=$(mktemp)
 if ! python -m nodexa_chain_core_tpu.bench.contention --assert-observed \
         > "$LC_LOG" 2>&1; then
@@ -280,7 +315,7 @@ if ! python -m nodexa_chain_core_tpu.bench.contention --assert-observed \
 fi
 tail -1 "$LC_LOG"; rm -f "$LC_LOG"
 
-echo "== [15/24] netsim smoke (multi-node adversarial scenarios)"
+echo "== [16/25] netsim smoke (multi-node adversarial scenarios)"
 # deterministic in-process 5-node partition-and-heal (must converge all
 # nodes to ONE tip with zero honest bans), a digest-pinned determinism
 # replay, and a stalling-peer IBD run asserting the black-hole peer is
@@ -293,7 +328,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
 fi
 tail -6 "$NS_LOG"; rm -f "$NS_LOG"
 
-echo "== [16/24] net observability (cross-node trace smoke)"
+echo "== [17/25] net observability (cross-node trace smoke)"
 # the wire extension of the PR 8/11 kill-switch contract: an N=5 chain
 # topology must assemble >=1 cluster-wide block-propagation trace
 # spanning >=3 hops with every per-hop stage finite and the stage sum
@@ -309,7 +344,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --trace-smoke \
 fi
 tail -6 "$NO_LOG"; rm -f "$NO_LOG"
 
-echo "== [17/24] relay adversary + internet-scale netsim (sharded)"
+echo "== [18/25] relay adversary + internet-scale netsim (sharded)"
 # the relay path against hostile peers, and the harness at N=500:
 # (a) adversary lane on the SHARDED harness at N=100 — a short-id
 #     collision flood must degrade to the full-block path with the
@@ -340,7 +375,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --scale --assert-floors \
 fi
 tail -14 "$SC_LOG"; rm -f "$SC_LOG"
 
-echo "== [18/24] snapshot bootstrap (assumeUTXO + lying provider)"
+echo "== [19/25] snapshot bootstrap (assumeUTXO + lying provider)"
 # instant bootstrap must actually be instant: snapshot load-to-tip at
 # least 10x faster than replaying the same blocks via process_new_block,
 # bit-exact coins digest asserted, and the adversarial netsim smoke — a
@@ -356,23 +391,23 @@ if ! python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast \
 fi
 tail -12 "$SNAP_LOG"; rm -f "$SNAP_LOG"
 
-echo "== [19/24] crypto vector regeneration"
+echo "== [20/25] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [20/24] native engine build"
+echo "== [21/25] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [21/24] static checks (consensus-critical packages)"
+echo "== [22/25] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [22/24] native hardening (security-check analog)"
+echo "== [23/25] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [23/24] pytest"
+echo "== [24/25] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -384,7 +419,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [24/24] wheel"
+echo "== [25/25] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

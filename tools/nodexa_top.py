@@ -363,6 +363,26 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
         f"({rate('nodexa_mempool_accepts_total', result='accepted')})   "
         f"cs_main hold p99 {fmt_ms(hp99)} vs scripts mean {fmt_ms(smean)}")
 
+    # sharded chainstate: shard count, per-shard cache residency, flush
+    # latency, and the family's aggregate lock wait (nodexa_coins_shard_*
+    # families register only at -coinsshards > 1: render '-' otherwise)
+    if have(snap, "nodexa_coins_shard_bytes"):
+        per = by_label(snap, "nodexa_coins_shard_bytes", "shard")
+        fcount, fmean, fp99 = hist_stats(
+            snap, "nodexa_coins_shard_flush_seconds")
+        shard_wait = 0.0
+        for v in _values(snap, "nodexa_lock_wait_seconds"):
+            if v.get("labels", {}).get("lock", "").startswith("coins.shard"):
+                shard_wait += v.get("sum", 0.0)
+        hot = max(per.items(), key=lambda kv: kv[1]) if per else ("-", 0.0)
+        lines.append(
+            f"  shards: {len(per)} x coins   cache "
+            f"{fmt_rate(sum(per.values()))}B (hot shard {hot[0]}: "
+            f"{fmt_rate(hot[1])}B)   flush mean {fmt_ms(fmean)} "
+            f"p99 {fmt_ms(fp99)} (n={fcount})   lock wait {shard_wait:.2f}s")
+    else:
+        lines.append("  shards: -")
+
     # compile attribution + flight recorder depth
     compiles = by_label(snap, "nodexa_jit_compiles_total", "kernel")
     comp_line = "  ".join(

@@ -105,7 +105,16 @@ BOUNDED_LABELS = {
     # LEDGER_LOCKS, *_role by the profiler's prefix table, holder_site
     # by the MAX_SITES_PER_LOCK fold-to-"other" cap
     "lock", "waiter_role", "holder_role", "holder_site",
+    # coins-shard index: bounded by chain.coins_shards.MAX_COINS_SHARDS
+    "shard",
 }
+
+# A DebugLock(f"prefix{...}") family must have every member prefix0..
+# prefix<N-1> enumerated in KNOWN_LOCKS/LEDGER_LOCKS — N is pinned to
+# chain.coins_shards.MAX_COINS_SHARDS (nxlint stays import-free of the
+# package, so the cap is mirrored here; test_coins_shards pins them
+# equal).
+LOCK_FAMILY_SIZE = 16
 
 RULES = {
     "lock-held", "lock-excluded", "blocking-under-cs-main", "wall-clock",
@@ -220,7 +229,7 @@ class ModuleIndex:
     __slots__ = ("rel", "tree", "src_lines", "functions", "classes",
                  "class_bases", "lock_attrs", "module_locks",
                  "imports_from", "module_aliases", "time_aliases",
-                 "lock_literals")
+                 "lock_literals", "lock_families")
 
     def __init__(self, rel: str):
         self.rel = rel
@@ -235,6 +244,10 @@ class ModuleIndex:
         self.time_aliases: Set[str] = set()  # names bound to the time module
         # (lineno, role) of every DebugLock("role") literal
         self.lock_literals: List[Tuple[int, str]] = []
+        # (lineno, prefix) of every DebugLock(f"prefix{...}") family
+        # construction — a parameterized role like coins.shard<k>; the
+        # enumerated members prefix0..prefix<MAX-1> must ALL be declared
+        self.lock_families: List[Tuple[int, str]] = []
 
 
 def _decorator_lock_names(dec: ast.expr) -> Optional[Tuple[str, Tuple[str, ...]]]:
@@ -362,6 +375,21 @@ class Analyzer:
                             if cls:
                                 mi.lock_attrs.setdefault(cls, {})[
                                     t.attr] = role
+            # parameterized lock families: DebugLock(f"prefix{...}") in
+            # ANY expression position (comprehensions included) — the
+            # static prefix names the family; a prefix-less dynamic name
+            # yields "" and fails the membership check below
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname == "DebugLock" and node.args and isinstance(
+                        node.args[0], ast.JoinedStr):
+                    js = node.args[0]
+                    prefix = (js.values[0].value
+                              if js.values and isinstance(
+                                  js.values[0], ast.Constant) else "")
+                    mi.lock_families.append((node.lineno, prefix))
 
     def _maybe_module_lock(self, mi: ModuleIndex, node: ast.Assign) -> None:
         if not isinstance(node.value, ast.Call):
@@ -499,6 +527,17 @@ class Analyzer:
                         mi.rel, lineno, "lock-name",
                         f"DebugLock role {role!r} is not in "
                         "utils.sync.KNOWN_LOCKS"))
+            for lineno, prefix in mi.lock_families:
+                missing = [f"{prefix}{k}" for k in range(LOCK_FAMILY_SIZE)
+                           if f"{prefix}{k}" not in self.known_locks]
+                if missing:
+                    self.findings.append(Finding(
+                        mi.rel, lineno, "lock-name",
+                        f"DebugLock family {prefix!r}<k> is not fully "
+                        "enumerated in utils.sync.KNOWN_LOCKS (missing "
+                        f"{missing[0]!r}"
+                        + (f" and {len(missing) - 1} more" if len(missing) > 1
+                           else "") + ")"))
         if self.ledger_locks is not None:
             for lineno, role in mi.lock_literals:
                 if role not in self.ledger_locks:
@@ -508,6 +547,18 @@ class Analyzer:
                         "the contention ledger (telemetry.lockstats."
                         "LEDGER_LOCKS) — named locks must opt into "
                         "wait/hold attribution"))
+            for lineno, prefix in mi.lock_families:
+                missing = [f"{prefix}{k}" for k in range(LOCK_FAMILY_SIZE)
+                           if f"{prefix}{k}" not in self.ledger_locks]
+                if missing:
+                    self.findings.append(Finding(
+                        mi.rel, lineno, "lock-ledger",
+                        f"DebugLock family {prefix!r}<k> is not fully "
+                        "registered with the contention ledger "
+                        "(telemetry.lockstats.LEDGER_LOCKS) — missing "
+                        f"{missing[0]!r}"
+                        + (f" and {len(missing) - 1} more" if len(missing) > 1
+                           else "")))
 
     def _check_function(self, mi: ModuleIndex, fi: FuncInfo) -> None:
         self._local_locks: Dict[str, str] = {}
@@ -852,14 +903,25 @@ def wall_clock_straggler():
 
 def bad_fault_site(g_faults):
     g_faults.check("no.such.site")
+
+def family_typo():
+    # parameterized lock family whose prefix is in neither registry ->
+    # one lock-name + one lock-ledger "family" finding
+    return [DebugLock(f"typo.shard{k}") for k in range(4)]
 '''
 
 _SELFTEST_OK = '''
 from .lib import needs_main
+from ..utils.sync import DebugLock
 
 def fine(chainstate):
     with chainstate.cs_main:
         return needs_main(1)
+
+def fine_family():
+    # every member selftest.shard0..15 is enumerated in the self-test
+    # registries below -> no finding
+    return [DebugLock(f"selftest.shard{k}") for k in range(16)]
 
 def allowed():
     import time
@@ -876,11 +938,12 @@ def run_self_test() -> int:
         "fix/bad.py": _SELFTEST_BAD,
         "fix/ok.py": _SELFTEST_OK,
     }
+    shard_family = {f"selftest.shard{k}" for k in range(LOCK_FAMILY_SIZE)}
     an = Analyzer(sources,
                   clocked_modules={"fix/bad.py", "fix/ok.py"},
                   known_sites={"kvstore.wal_append"},
-                  known_locks={"cs_main", "cs_ledgerless"},
-                  ledger_locks={"cs_main"})
+                  known_locks={"cs_main", "cs_ledgerless"} | shard_family,
+                  ledger_locks={"cs_main"} | shard_family)
     findings = an.run()
     by_rule: Dict[str, List[Finding]] = {}
     for f in findings:
@@ -899,6 +962,13 @@ def run_self_test() -> int:
         hits = [f for f in by_rule.get(rule, []) if f.path == path]
         if not hits:
             failures.append(f"seeded {rule} violation NOT caught")
+    # the family seeds share rule names with the literal seeds above, so
+    # pin them separately by the "family" wording
+    for rule in ("lock-name", "lock-ledger"):
+        fam = [f for f in by_rule.get(rule, [])
+               if f.path == "fix/bad.py" and "family" in f.msg]
+        if not fam:
+            failures.append(f"seeded {rule} FAMILY violation NOT caught")
     wrong = [f for f in findings if f.path == "fix/ok.py"]
     if wrong:
         failures.append(f"clean fixture flagged: {wrong}")
@@ -934,11 +1004,26 @@ def run_self_test() -> int:
         failures.append("declared-order violation NOT detected")
     except sync.PotentialDeadlock:
         pass
+    # shard-family order: the per-shard locks are declared as one
+    # ascending chain; grabbing a higher-index shard first must fire on
+    # the spot, exactly what ShardGuard's sorted acquisition prevents
+    sync.reset_lockorder_state()
+    sync.declare_lock_order("selftest.shard0", "selftest.shard1",
+                            "selftest.shard2")
+    s0 = sync.DebugLock("selftest.shard0")
+    s2 = sync.DebugLock("selftest.shard2")
+    try:
+        with s2:
+            with s0:
+                pass
+        failures.append("shard-order violation NOT detected")
+    except sync.PotentialDeadlock:
+        pass
     sync.enable_lockorder_debug(False)
 
     for msg in failures:
         print("SELF-TEST FAIL:", msg)
-    n = len(expect) + 2
+    n = len(expect) + 5  # + 2 family seeds + 3 runtime seeds
     print(f"nxlint --self-test: {n - len(failures)}/{n} seeded checks "
           f"{'pass' if not failures else 'FAILED'}")
     return 1 if failures else 0
