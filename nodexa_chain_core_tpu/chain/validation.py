@@ -1358,6 +1358,8 @@ class ChainState:
                 # commit point
                 if getattr(self, "indexes", None) is not None:
                     self.indexes.index_block(block, idx, undo)
+                if getattr(self, "filter_index", None) is not None:
+                    self.filter_index.index_block(block, idx, undo)
                 view.flush()
                 t_flush = time.perf_counter()
                 idx.raise_validity(BlockStatus.VALID_SCRIPTS)
@@ -1423,6 +1425,8 @@ class ChainState:
             _, upos = self.positions.get(idx.block_hash, (-1, -1))
             undo = self.block_store.read_undo(upos) if upos >= 0 else None
             self.indexes.unindex_block(block, idx, undo)
+        if getattr(self, "filter_index", None) is not None:
+            self.filter_index.unindex_block(block, idx, None)
         self.active.set_tip(idx.prev)
         self.tip_generation += 1
         if self.mempool is not None:

@@ -9,6 +9,7 @@ from typing import List, Optional
 
 from ..chain.mempool_accept import MempoolAcceptError, accept_to_memory_pool
 from ..chain.snapshot import STATE_ASSUMED as _SNAPSHOT_ASSUMED
+from ..serve.filterindex import MAX_CFILTERS
 from ..chain.validation import BlockValidationError
 from ..node.health import NodeCriticalError
 from ..core.serialize import ByteReader, ByteWriter
@@ -56,6 +57,11 @@ from .protocol import (
     MSG_SNAPHDR,
     MSG_GETSNAPCHUNK,
     MSG_SNAPCHUNK,
+    MSG_SENDCF,
+    MSG_GETCFHEADERS,
+    MSG_CFHEADERS,
+    MSG_GETCFILTERS,
+    MSG_CFILTER,
     MSG_SENDTRACECTX,
     MSG_TRACECTX,
     MSG_CMPCTBLOCK,
@@ -170,6 +176,17 @@ MAX_BLOCKTXN_DEPTH = 10
 # fleet must not monopolize the provider's disk bandwidth
 SNAPSHOT_CHUNKS_PER_S = 64.0
 
+# provider-side compact-filter range budget: each getcfheaders/getcfilters
+# answers up to 2000/1000 blocks, so a modest request rate already covers
+# any honest wallet's cold sync; over-budget ranges are dropped and
+# counted, never scored (same policy as the snapshot chunk budget)
+CF_RANGES_PER_S = 8.0
+
+_M_CF_WIRE = g_metrics.counter(
+    "nodexa_cf_wire_total",
+    "Compact-filter wire range requests served, labeled msg "
+    "(cfheaders|cfilters) and result (ok|unknown|throttled)")
+
 
 class NetProcessor:
     """ref PeerLogicValidation (net_processing.cpp:2986)."""
@@ -233,6 +250,10 @@ class NetProcessor:
         # test knob: a registered provider serves deliberately corrupted
         # chunk payloads — the netsim lying-provider scenarios flip this
         self._snapshot_test_corrupt = False
+        # -cfilterpeers: compact-filter transfer capability (serve AND
+        # fetch); the index itself lives on node.chainstate.filter_index
+        self.cfilter_peers = False
+        self.cf_ranges_per_s = CF_RANGES_PER_S
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -355,6 +376,11 @@ class NetProcessor:
             MSG_SNAPHDR: self._on_snaphdr,
             MSG_GETSNAPCHUNK: self._on_getsnapchunk,
             MSG_SNAPCHUNK: self._on_snapchunk,
+            MSG_SENDCF: self._on_sendcf,
+            MSG_GETCFHEADERS: self._on_getcfheaders,
+            MSG_CFHEADERS: self._on_cfheaders,
+            MSG_GETCFILTERS: self._on_getcfilters,
+            MSG_CFILTER: self._on_cfilter,
             MSG_CMPCTBLOCK: self._on_cmpctblock,
             MSG_GETBLOCKTXN: self._on_getblocktxn,
             MSG_BLOCKTXN: self._on_blocktxn,
@@ -430,6 +456,13 @@ class NetProcessor:
             w = ByteWriter()
             w.u8(1)  # snapshot-transfer version 1
             peer.send_msg(self.magic, MSG_SENDSNAP, w.getvalue())
+        if self.cfilter_peers:
+            # compact-filter capability, same mutual-advertisement
+            # pattern: filter-header/filter traffic only ever flows
+            # between peers that BOTH advertised
+            w = ByteWriter()
+            w.u8(1)  # compact-filter transfer version 1
+            peer.send_msg(self.magic, MSG_SENDCF, w.getvalue())
         self._start_sync(peer)
 
     def _start_sync(self, peer) -> None:
@@ -1025,6 +1058,110 @@ class NetProcessor:
                       "snapshot: peer %d served a fraudulent chunk %d — "
                       "disconnected, download continues from other "
                       "providers", peer.id, idx)
+
+    # -- compact block filters (-cfilterpeers; serve/filterindex.py owns
+    # the index, this is the wire surface) --------------------------------
+
+    def _filter_index(self):
+        return getattr(self.node.chainstate, "filter_index", None)
+
+    def _on_sendcf(self, peer, r: ByteReader) -> None:
+        # capability is mutual: mark the peer only when WE participate,
+        # so a -cfilterpeers=0 node never emits filter traffic
+        peer.cf_ok = self.cfilter_peers
+
+    def _cf_rate_ok(self, peer, now: float) -> bool:
+        """Provider-side token bucket, clock-driven (deterministic under
+        the netsim SimClock): ``cf_ranges_per_s`` refill, 2x burst.
+        Over-budget requests are dropped and counted — never scored (a
+        cold wallet fleet syncing hard is load, not malice)."""
+        rate = self.cf_ranges_per_s
+        burst = rate * 2.0
+        tokens, t_last = getattr(peer, "_cf_bucket", (burst, now))
+        tokens = min(burst, tokens + (now - t_last) * rate)
+        if tokens < 1.0:
+            peer._cf_bucket = (tokens, now)
+            return False
+        peer._cf_bucket = (tokens - 1.0, now)
+        return True
+
+    def _on_getcfheaders(self, peer, r: ByteReader) -> None:
+        fi = self._filter_index()
+        if (fi is None or not self.cfilter_peers
+                or not getattr(peer, "cf_ok", False)):
+            return
+        start_height = r.u32()
+        stop_hash = r.hash256()
+        if not self._cf_rate_ok(peer, self._clock()):
+            _M_CF_WIRE.inc(msg="cfheaders", result="throttled")
+            return
+        res = fi.headers_range(start_height, stop_hash)
+        if res is None:
+            # unknown/off-chain stop hash or unindexed range: no reply
+            # (the requester times out and retries elsewhere, as with
+            # an unknown snapshot id) — not punishable, reorgs race
+            _M_CF_WIRE.inc(msg="cfheaders", result="unknown")
+            return
+        start, headers = res
+        w = ByteWriter()
+        w.u32(start)
+        w.hash256(stop_hash)
+        w.vector(headers, lambda wr, h: wr.write(h))
+        peer.send_msg(self.magic, MSG_CFHEADERS, w.getvalue())
+        _M_CF_WIRE.inc(msg="cfheaders", result="ok")
+
+    def _on_getcfilters(self, peer, r: ByteReader) -> None:
+        fi = self._filter_index()
+        if (fi is None or not self.cfilter_peers
+                or not getattr(peer, "cf_ok", False)):
+            return
+        start_height = r.u32()
+        stop_hash = r.hash256()
+        if not self._cf_rate_ok(peer, self._clock()):
+            _M_CF_WIRE.inc(msg="cfilters", result="throttled")
+            return
+        res = fi.filters_range(start_height, stop_hash)
+        if res is None:
+            _M_CF_WIRE.inc(msg="cfilters", result="unknown")
+            return
+        _start, filters = res
+        # one cfilter message per block (the BIP157 shape: a filter can
+        # be large, and per-block replies let the requester pipeline)
+        for block_hash, fbytes in filters:
+            w = ByteWriter()
+            w.hash256(block_hash)
+            w.var_bytes(fbytes)
+            peer.send_msg(self.magic, MSG_CFILTER, w.getvalue())
+        _M_CF_WIRE.inc(msg="cfilters", result="ok")
+
+    def _on_cfheaders(self, peer, r: ByteReader) -> None:
+        if not self.cfilter_peers or not getattr(peer, "cf_ok", False):
+            # receive-side capability gate: unsolicited filter headers
+            # from outside the handshake are never recorded
+            return
+        start = r.u32()
+        stop_hash = r.hash256()
+        headers = r.vector(lambda rr: bytes(rr.read(32)))
+        if len(headers) > 2000:
+            self.misbehaving(peer, 20, "oversized-cfheaders")
+            return
+        # light-client bookkeeping: the latest batch is kept on the peer
+        # for the fetch driver (netsim wallets / tests) to consume
+        peer.cf_headers = (start, stop_hash, headers)
+
+    def _on_cfilter(self, peer, r: ByteReader) -> None:
+        if not self.cfilter_peers or not getattr(peer, "cf_ok", False):
+            return
+        block_hash = r.hash256()
+        fbytes = r.var_bytes()
+        pending = getattr(peer, "cf_filters", None)
+        if pending is None:
+            pending = peer.cf_filters = {}
+        if len(pending) >= 2 * MAX_CFILTERS:
+            # bound the per-peer stash: a flood of unsolicited filters
+            # must not grow memory without limit
+            pending.clear()
+        pending[block_hash] = fbytes
 
     def propagation_stats(self) -> dict:
         """Propagation/trace bookkeeping snapshot for ``getnetstats``."""

@@ -444,6 +444,23 @@ class SimNet:
             mgr.chunk_timeout_s = chunk_timeout_s
             mgr.bv_blocks_per_tick = bv_blocks_per_tick
 
+    def enable_cfilters(self, node_indices=None) -> None:
+        """Attach a compact-filter index to the given nodes (default:
+        all) and flip them into -cfilterpeers mode.  Any existing chain
+        is backfilled synchronously — the sim is single-threaded, so the
+        background indexer thread never runs here and the index is
+        always tip-current when a scenario reads it."""
+        from ..serve.filterindex import FilterIndex
+
+        targets = (self.nodes if node_indices is None
+                   else [self.nodes[i] for i in node_indices])
+        for n in targets:
+            n.processor.cfilter_peers = True
+            if getattr(n.chainstate, "filter_index", None) is None:
+                n.chainstate.filter_index = FilterIndex(n.chainstate)
+            while not n.chainstate.filter_index.backfill_step(64):
+                pass
+
     def partition(self, group_a) -> None:
         """Cut every link crossing the boundary between ``group_a`` and
         the rest.  In-flight events already queued still deliver (packets
@@ -675,18 +692,20 @@ class SimNet:
 
     # -- scenario actions --------------------------------------------------
 
-    def mine_block(self, node_index: int, advance_s: float = 30.0) -> int:
+    def mine_block(self, node_index: int, advance_s: float = 30.0,
+                   coinbase_spk: bytes = b"\x51") -> int:
         """Advance the clock, mine one regtest block on ``node_index``,
         connect it locally and announce it into the simulated network.
         Returns the new tip hash (mined-at time lands in
-        ``block_times``)."""
+        ``block_times``).  ``coinbase_spk`` lets wallet-fleet scenarios
+        fund simulated wallets by mining to their scripts."""
         from ..mining.assembler import BlockAssembler, mine_block_cpu
 
         self.clock.advance(advance_s)
         node = self.nodes[node_index]
         cs = node.node.chainstate
         blk = BlockAssembler(cs).create_new_block(
-            b"\x51", ntime=int(self.clock()))
+            coinbase_spk, ntime=int(self.clock()))
         assert mine_block_cpu(blk, node.node.params.algo_schedule,
                               max_tries=1 << 22), "regtest PoW failed"
         cs.process_new_block(blk)
@@ -1125,3 +1144,256 @@ class PoolShareTraffic:
                         wasted += 1
                         break
         return wasted
+
+
+class WalletTraffic:
+    """Light-wallet fleet over the query plane: what a population of
+    BIP157-style cold wallets costs the serving node, and proof that the
+    filter path needs ZERO server-side address scans.
+
+    Each wallet is a pure client-side state machine (one key, one P2PKH
+    watch script) syncing from ONE serving node's
+    :class:`..serve.filterindex.FilterIndex` through exactly the read
+    APIs the wire/RPC/REST surfaces expose — ``headers_range`` /
+    ``filters_range`` / ``read_block`` for matched blocks — never a
+    server-side scan.  Every downloaded filter is verified against the
+    filter-header chain (``header_mismatches`` stays 0 against an honest
+    server), matched blocks are fetched and scanned CLIENT-side for the
+    wallet's outputs/spends, and non-matching filters are never followed
+    by a block fetch (the bandwidth win the filters exist for).
+
+    Tip changes ride the harness's ``tip_listeners`` hook: one fleet-wide
+    sync lands ``sync_latency_s`` after each tip move, and a reorg shows
+    up as a fork-point rewind + client-side rescan (``rescans``).  With
+    ``payment_interval_s`` set, funded wallets also pay each other
+    through the PRODUCTION mempool admission path (``inject_tx``), so a
+    recipient detecting the payment via a later block's filter closes
+    the full light-client loop.  Fund wallets by mining to
+    :meth:`spk_for` (``net.mine_block(i, coinbase_spk=...)``); coinbase
+    maturity is respected client-side.
+
+    Everything is timer-driven through ``call_at`` on the deterministic
+    clock, so a traced run replays to the same digest.
+    """
+
+    def __init__(self, net: SimNet, server_index: int, n_wallets: int,
+                 sync_latency_s: float = 0.25,
+                 payment_interval_s: Optional[float] = None,
+                 payment_fee: int = 10000):
+        from ..script.sign import KeyStore
+        from ..script.standard import KeyID, p2pkh_script
+
+        self.net = net
+        self.server_index = server_index
+        self._server = net.nodes[server_index]
+        fi = getattr(self._server.chainstate, "filter_index", None)
+        assert fi is not None, "serving node needs enable_cfilters()"
+        self.fi = fi
+        self.sync_latency_s = sync_latency_s
+        self.payment_interval_s = payment_interval_s
+        self.payment_fee = payment_fee
+        self.wallets: List[dict] = []
+        for w in range(n_wallets):
+            ks = KeyStore()
+            kid = ks.add_key(0x57A11E70000 + w)  # deterministic per wallet
+            spk = p2pkh_script(KeyID(kid))
+            self.wallets.append({
+                "ks": ks, "spk": spk, "watch": [bytes(spk.raw)],
+                # synced filter-header chain: chain[h] = (block_hash, header)
+                "chain": [],
+                "utxos": {},        # OutPoint -> (value, height, coinbase)
+                "pending": set(),   # outpoints spent by in-flight payments
+                "cold_done": False,
+            })
+        self.totals_ = {
+            "cold_synced": 0, "filters_downloaded": 0,
+            "filter_matches": 0, "blocks_fetched": 0,
+            "false_positives": 0, "payments_sent": 0,
+            "payments_rejected": 0, "payments_seen": 0,
+            "rescans": 0, "header_mismatches": 0, "sync_lagged": 0,
+        }
+        net.tip_listeners.append(self._on_tip)
+        if payment_interval_s is not None:
+            for w in range(n_wallets):
+                self._schedule_payment(w)
+
+    def detach(self) -> None:
+        """Stop producing events (pending timers become no-ops)."""
+        if self._on_tip in self.net.tip_listeners:
+            self.net.tip_listeners.remove(self._on_tip)
+        self.wallets = []
+
+    def spk_for(self, w: int) -> bytes:
+        """Wallet ``w``'s raw scriptPubKey — mine to it to fund the
+        wallet."""
+        return bytes(self.wallets[w]["spk"].raw)
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _on_tip(self, node_index: int, tip_hash: int, t: float) -> None:
+        if node_index != self.server_index or not self.wallets:
+            return
+        self.net.call_at(t + self.sync_latency_s, self.sync_all)
+
+    def _schedule_payment(self, w: int) -> None:
+        # per-wallet phase stagger keeps the fleet from synchronizing
+        # into one burst (deterministic: a function of the index alone)
+        jitter = (w % 7) * self.payment_interval_s / 7.0
+        self.net.call_at(
+            self.net.clock() + self.payment_interval_s + jitter,
+            lambda: self._pay(w))
+
+    # -- filter sync (the client side of BIP157) ---------------------------
+
+    def sync_all(self) -> None:
+        for w in range(len(self.wallets)):
+            self.sync_wallet(w)
+
+    def sync_wallet(self, w: int) -> None:
+        """Sync wallet ``w`` to the serving node's tip via the filter
+        chain.  The block-header chain stands in for P2P headers sync
+        (wallets trust-minimally verify FILTER headers; block headers
+        arrive over the normal headers protocol not modeled here)."""
+        from ..serve.filterindex import MAX_CFILTERS
+        from ..serve.filters import (filter_hash, filter_header,
+                                     filter_key, match_any)
+
+        st = self.wallets[w]
+        cs = self._server.chainstate
+        with cs.cs_main:
+            tip = cs.tip()
+            start = len(st["chain"])
+            # fork-point walk: drop any synced suffix the server reorged
+            while start > 0:
+                idx = (cs.active.at(start - 1)
+                       if start - 1 <= tip.height else None)
+                if idx is not None and idx.block_hash == st["chain"][start - 1][0]:
+                    break
+                start -= 1
+            hashes = [cs.active.at(h).block_hash
+                      for h in range(start, tip.height + 1)]
+        if start < len(st["chain"]):
+            st["chain"] = st["chain"][:start]
+            dropped = [op for op, (_v, h, _c) in st["utxos"].items()
+                       if h >= start]
+            for op in dropped:
+                del st["utxos"][op]
+                st["pending"].discard(op)
+            self.totals_["rescans"] += 1
+        if not hashes:
+            return
+        cold = not st["cold_done"]
+        # chunked by the serving bound, exactly like a wire client
+        pos = start
+        while pos <= start + len(hashes) - 1:
+            stop_i = min(pos + MAX_CFILTERS - 1, start + len(hashes) - 1)
+            stop_hash = hashes[stop_i - start]
+            hres = self.fi.headers_range(pos, stop_hash)
+            fres = self.fi.filters_range(pos, stop_hash)
+            if hres is None or fres is None or hres[0] != pos or fres[0] != pos:
+                # index lagging or mid-reorg: retry at the next tip event
+                self.totals_["sync_lagged"] += 1
+                return
+            prev = st["chain"][pos - 1][1] if pos > 0 else bytes(32)
+            for off, (hdr, (fbh, fbytes)) in enumerate(
+                    zip(hres[1], fres[1])):
+                height = pos + off
+                bh = hashes[height - start]
+                if (fbh != bh
+                        or filter_header(filter_hash(fbytes), prev) != hdr):
+                    self.totals_["header_mismatches"] += 1
+                    return  # refuse the chain; an honest server never hits this
+                prev = hdr
+                st["chain"].append((bh, hdr))
+                self.totals_["filters_downloaded"] += 1
+                if match_any(fbytes, filter_key(bh), st["watch"]):
+                    self.totals_["filter_matches"] += 1
+                    self._scan_block(w, bh, height)
+            pos = stop_i + 1
+        if cold:
+            st["cold_done"] = True
+            self.totals_["cold_synced"] += 1
+
+    def _scan_block(self, w: int, block_hash: int, height: int) -> None:
+        """CLIENT-side scan of one filter-matched block: credit outputs
+        paying the watch script, debit tracked outpoints being spent."""
+        from ..primitives.transaction import OutPoint
+
+        st = self.wallets[w]
+        cs = self._server.chainstate
+        with cs.cs_main:
+            idx = cs.block_index.get(block_hash)
+            block = cs.read_block(idx)
+        self.totals_["blocks_fetched"] += 1
+        watch = st["watch"][0]
+        hit = False
+        for tx in block.vtx:
+            if not tx.is_coinbase():
+                for txin in tx.vin:
+                    if st["utxos"].pop(txin.prevout, None) is not None:
+                        st["pending"].discard(txin.prevout)
+                        hit = True
+            for n, out in enumerate(tx.vout):
+                if bytes(out.script_pubkey) == watch:
+                    st["utxos"][OutPoint(tx.txid, n)] = (
+                        out.value, height, tx.is_coinbase())
+                    hit = True
+                    if not tx.is_coinbase():
+                        self.totals_["payments_seen"] += 1
+        if not hit:
+            # the GCS false-positive class: a block downloaded for
+            # nothing (rate ~1/M per filter item; tiny but nonzero)
+            self.totals_["false_positives"] += 1
+
+    # -- payments (through the production admission path) ------------------
+
+    def _pay(self, w: int) -> None:
+        from ..chain.mempool_accept import MempoolAcceptError
+        from ..consensus.consensus import COINBASE_MATURITY
+        from ..primitives.transaction import Transaction, TxIn, TxOut
+        from ..script.sign import sign_tx_input
+
+        if not self.wallets:
+            return  # detached; let the timer chain die
+        self._schedule_payment(w)
+        st = self.wallets[w]
+        tip_height = len(st["chain"]) - 1
+        spendable = None
+        for op, (value, height, coinbase) in sorted(
+                st["utxos"].items(), key=lambda kv: (kv[1][1], str(kv[0]))):
+            if op in st["pending"]:
+                continue
+            if coinbase and tip_height - height + 1 < COINBASE_MATURITY:
+                continue
+            if value > self.payment_fee:
+                spendable = (op, value)
+                break
+        if spendable is None:
+            return
+        op, value = spendable
+        dest = self.wallets[(w + 1) % len(self.wallets)]
+        tx = Transaction(
+            version=2,
+            vin=[TxIn(prevout=op)],
+            vout=[TxOut(value=value - self.payment_fee,
+                        script_pubkey=dest["spk"].raw)],
+        )
+        sign_tx_input(st["ks"], tx, 0, st["spk"])
+        try:
+            self.net.inject_tx(self.server_index, tx)
+            # held out of the spendable set until the confirming block's
+            # filter-matched scan removes it (that scan, not the send,
+            # is how a light wallet learns its spend confirmed)
+            st["pending"].add(op)
+            self.totals_["payments_sent"] += 1
+        except MempoolAcceptError:
+            self.totals_["payments_rejected"] += 1
+
+    # -- analysis ----------------------------------------------------------
+
+    def totals(self) -> dict:
+        return dict(self.totals_)
+
+    def balances(self) -> List[int]:
+        return [sum(v for v, _h, _c in st["utxos"].values())
+                for st in self.wallets]

@@ -70,6 +70,17 @@ MSG_GETSNAPHDR = "getsnaphdr"
 MSG_SNAPHDR = "snaphdr"
 MSG_GETSNAPCHUNK = "getsnapchunk"
 MSG_SNAPCHUNK = "snapchunk"
+# compact block filters (-cfilterpeers): capability advertisement after
+# verack (the sendtracectx/sendsnap mutual-advertisement pattern), then
+# BIP157-shaped request/reply pairs for the filter-header chain and the
+# per-block filters.  Only ever exchanged between peers that BOTH
+# advertised the capability, so vanilla peers never see any of these
+# commands — wire compat with filter-less peers is untouched.
+MSG_SENDCF = "sendcf"
+MSG_GETCFHEADERS = "getcfheaders"
+MSG_CFHEADERS = "cfheaders"
+MSG_GETCFILTERS = "getcfilters"
+MSG_CFILTER = "cfilter"
 # asset wire messages (ref protocol.cpp:45-47: "getassetdata"/"assetdata"
 # but — reference quirk — the not-found reply really is "asstnotfound")
 MSG_GETASSETDATA = "getassetdata"

@@ -96,6 +96,9 @@ class NodeContext:
         pool = getattr(self, "pool_server", None)
         if pool is not None:
             pool.stop()
+        qp = getattr(self, "queryplane", None)
+        if qp is not None:
+            qp.stop()
         tor = getattr(self, "tor_controller", None)
         if tor is not None:
             tor.stop()

@@ -223,6 +223,16 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
             address=want_ai, spent=want_si, timestamp=want_ti,
         )
 
+    # -cfilters: the compact-filter index (serve/filterindex.py) — new
+    # blocks index at connect time; existing history is backfilled by a
+    # background indexer that resumes from its watermark after a crash.
+    # -cfilterpeers implies the index (serving without it is nothing).
+    if g_args.get_bool("cfilters") or g_args.get_bool("cfilterpeers"):
+        from ..serve.filterindex import FilterIndex
+
+        node.chainstate.filter_index = FilterIndex(node.chainstate)
+        node.chainstate.filter_index.start_backfill()
+
     if reindexing:
         n = node.chainstate.reindex()
         log_printf("-reindex: reconnected %d blocks, height %d", n,
@@ -579,6 +589,10 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         # commands are capability-gated, so vanilla peers never see them
         node.connman.processor.snapshot_peers = g_args.get_bool(
             "snapshotpeers")
+        # -cfilterpeers: compact-filter transfer capability (BIP157-
+        # shaped, capability-gated like the snapshot commands)
+        node.connman.processor.cfilter_peers = g_args.get_bool(
+            "cfilterpeers")
         if g_args.is_set("propmapsize"):
             # explicit-flag typo discipline (same as -faultinject /
             # -calibrationfile): a set flag with a bad value — including
@@ -699,6 +713,25 @@ def app_init_main(argv) -> tuple[NodeContext, HTTPRPCServer]:
         pass
     with g_startup.stage("rpc"):
         rpc.start()
+    # -queryplane: the evented serving front end (serve/frontend.py) —
+    # RPC+REST behind bounded per-method queues, a worker pool, per-
+    # client rate limits, and typed load shedding.  Runs BESIDE the
+    # thread-per-connection HTTPRPCServer (same dispatch table, same
+    # rest handler), so the legacy surface keeps its exact semantics.
+    if g_args.get_bool("queryplane"):
+        from ..serve.frontend import QueryPlaneServer
+
+        node.queryplane = QueryPlaneServer(
+            node,
+            g_rpc_table,
+            host=g_args.get("queryplanebind", "127.0.0.1"),
+            port=g_args.get_int("queryplaneport", rpc_port + 1),
+            workers=g_args.get_int("queryplaneworkers", 4),
+            max_connections=g_args.get_int("queryplanemaxconn", 512),
+            rate_qps=float(g_args.get("queryplaneqps", "50") or 50),
+        )
+        with g_startup.stage("queryplane"):
+            node.queryplane.start()
     g_rpc_table.set_warmup_finished()
     g_startup.mark_once("init_complete")
     log_printf("init complete: height=%d (boot %.2fs)",

@@ -93,6 +93,10 @@ KNOWN_SITES: Dict[str, dict] = {
                              "chunk / manifest persist"},
     "snapshot.activate":    {"ibd": False, "help": "snapshot coins-DB "
                              "apply + activation commit"},
+    "queryindex.write":     {"ibd": False, "help": "compact-filter index "
+                             "put (connect-time + backfill watermark)"},
+    "queryindex.read":      {"ibd": False, "help": "compact-filter index "
+                             "read (RPC/REST/P2P serving + backfill)"},
 }
 
 KILL_EXIT_CODE = 137  # what a SIGKILLed process reports; greppable in CI

@@ -79,6 +79,9 @@ HEADER_BATCH_BUCKETS = (64, 512, 2048)
 # DAG slab build launches (DagBuilder.build_rows): powers of two so the
 # padded remainder launch of an epoch build wastes at most 2x compute
 DAG_ROWS_BUCKETS = tuple(64 << i for i in range(13))  # 64 .. 262144
+# compact-filter item-hash batches (serve.filters): one padded
+# single-block sha256 per scriptPubKey a block touches
+CF_ITEM_BUCKETS = (64, 512, 4096)
 
 # kernel family -> the declared shape_bucket label set; labels outside
 # this set are off-bucket (a shape-discipline violation worth counting
@@ -91,6 +94,7 @@ KERNEL_BUCKETS: Dict[str, frozenset] = {
     "progpow.search_period": frozenset(str(b) for b in BATCH_BUCKETS),
     "ethash.dag_build": frozenset(str(r) for r in DAG_ROWS_BUCKETS),
     "sha256d.verify": frozenset(str(b) for b in HEADER_BATCH_BUCKETS),
+    "cf.itemhash": frozenset(str(b) for b in CF_ITEM_BUCKETS),
 }
 
 

@@ -1,0 +1,86 @@
+"""Query-plane RPC surface: compact-filter serving (the BIP157 RPC
+analogues) plus the front-end diagnostic.
+
+``getcfheaders``/``getcfilters`` are how a cold light wallet syncs: it
+downloads the filter-header chain, verifies linkage, downloads filters,
+and matches its own scripts client-side — the server never runs an
+address scan on its behalf.
+"""
+
+from __future__ import annotations
+
+from ..core.uint256 import u256_from_hex, u256_hex
+from .server import RPC_INVALID_PARAMETER, RPC_MISC_ERROR, RPCError
+
+
+def _filter_index(node):
+    fi = getattr(node.chainstate, "filter_index", None)
+    if fi is None:
+        raise RPCError(RPC_MISC_ERROR,
+                       "compact filters disabled (start with -cfilters)")
+    return fi
+
+
+def getcfheaders(node, params):
+    if len(params) != 2:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "getcfheaders start_height stop_hash")
+    fi = _filter_index(node)
+    res = fi.headers_range(int(params[0]), u256_from_hex(str(params[1])))
+    if res is None:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "stop block unknown, off the active chain, or not "
+                       "indexed yet")
+    start_height, headers = res
+    return {
+        "start_height": start_height,
+        "headers": [h.hex() for h in headers],
+    }
+
+
+def getcfilters(node, params):
+    if len(params) != 2:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "getcfilters start_height stop_hash")
+    fi = _filter_index(node)
+    res = fi.filters_range(int(params[0]), u256_from_hex(str(params[1])))
+    if res is None:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "stop block unknown, off the active chain, or not "
+                       "indexed yet")
+    start_height, filters = res
+    return {
+        "start_height": start_height,
+        "filters": [
+            {"block_hash": u256_hex(h), "filter": f.hex()}
+            for h, f in filters
+        ],
+    }
+
+
+def getqueryplaneinfo(node, params):
+    """Front-end + filter-index state (safe-mode readable diagnostic)."""
+    qp = getattr(node, "queryplane", None)
+    fi = getattr(node.chainstate, "filter_index", None)
+    out = {
+        "queryplane": qp.info() if qp is not None else {"enabled": False},
+        "cfilters": {"enabled": fi is not None},
+    }
+    if fi is not None:
+        tip = node.chainstate.tip()
+        wm_h, wm_hash = fi.watermark()
+        out["cfilters"].update({
+            "watermark_height": wm_h,
+            "watermark_hash": u256_hex(wm_hash) if wm_h >= 0 else None,
+            "tip_height": tip.height if tip is not None else -1,
+            "synced": tip is not None and wm_h >= tip.height,
+        })
+    return out
+
+
+def register(table) -> None:
+    table.register("queryplane", "getcfheaders", getcfheaders,
+                   ["start_height", "stop_hash"])
+    table.register("queryplane", "getcfilters", getcfilters,
+                   ["start_height", "stop_hash"])
+    table.register("queryplane", "getqueryplaneinfo", getqueryplaneinfo, [])

@@ -38,4 +38,7 @@ def register_all(table: RPCTable = g_rpc_table) -> RPCTable:
     from . import compat as compat_rpc
 
     compat_rpc.register(table)
+    from . import queryplane as queryplane_rpc
+
+    queryplane_rpc.register(table)
     return table

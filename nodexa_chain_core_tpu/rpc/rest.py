@@ -59,6 +59,27 @@ def make_rest_handler(node):
                     if res is not None:
                         utxos.append(res)
                 return 200, {"utxos": utxos}
+            if parts[1] == "cfheaders":
+                # /rest/cfheaders/<start_height>/<stop_hash>
+                from .queryplane import getcfheaders
+
+                return 200, getcfheaders(
+                    node, [int(parts[2]), parts[3].split(".")[0]])
+            if parts[1] == "cfilter":
+                # /rest/cfilter/<block_hash>
+                from .server import RPCError
+
+                fi = getattr(node.chainstate, "filter_index", None)
+                if fi is None:
+                    return 404, {"error": "compact filters disabled"}
+                try:
+                    f = fi.get_filter(
+                        u256_from_hex(parts[2].split(".")[0]))
+                except RPCError as e:
+                    return 400, {"error": e.message}
+                if f is None:
+                    return 404, {"error": "filter not indexed"}
+                return 200, {"filter": f.hex()}
             if parts[1].startswith("headers"):
                 count = int(parts[2])
                 start = u256_from_hex(parts[3].split(".")[0])

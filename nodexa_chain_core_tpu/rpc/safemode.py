@@ -81,7 +81,7 @@ MUTATING_COMMANDS = frozenset({
 READONLY_DIAGNOSTIC_COMMANDS = frozenset({
     "getmetrics", "getprofile", "getlockstats", "gettrace",
     "dumpflightrecorder", "getstartupinfo", "getnodehealth",
-    "getnetstats", "getsnapshotinfo",
+    "getnetstats", "getsnapshotinfo", "getqueryplaneinfo",
     "help", "uptime", "stop",
 })
 

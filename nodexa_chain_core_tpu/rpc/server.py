@@ -18,7 +18,22 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..telemetry import g_metrics
 from ..utils.logging import LogFlags, log_print, log_printf
+
+# per-method dispatch observability, shared by every front end (the
+# legacy ThreadingHTTPServer and the serve/ query plane both route
+# through RPCTable.execute).  ``method`` is bounded by the registered
+# command table: unknown names fold to "unknown" before labeling.
+_M_RPC_REQUESTS = g_metrics.counter(
+    "nodexa_rpc_requests_total",
+    "RPC dispatches, labeled by method and "
+    "result=ok/rpc_error/internal_error/warmup/not_found")
+_M_RPC_LATENCY = g_metrics.histogram(
+    "nodexa_rpc_latency_seconds",
+    "RPC dispatch latency (execute entry to return), labeled by method")
+_M_RPC_INFLIGHT = g_metrics.gauge(
+    "nodexa_rpc_inflight", "RPC requests currently executing")
 
 # JSON-RPC error codes (ref src/rpc/protocol.h)
 RPC_INVALID_REQUEST = -32600
@@ -75,16 +90,37 @@ class RPCTable:
 
     def execute(self, node, method: str, params: List[Any]) -> Any:
         cmd = self._commands.get(method)
+        # unknown methods fold to "unknown"; registered names are the
+        # closed command table, so the method label stays bounded
+        label = method if cmd is not None else "unknown"
         if cmd is None:
+            _M_RPC_REQUESTS.inc(method=label, result="not_found")
             raise RPCError(RPC_METHOD_NOT_FOUND, f"Method not found: {method}")
         if self.warmup is not None and method not in ("help", "stop", "uptime"):
+            _M_RPC_REQUESTS.inc(method=label, result="warmup")
             raise RPCError(RPC_IN_WARMUP, self.warmup)
         # safe-mode lockdown (health layer / fork warning): mutating
         # commands refuse with a structured error, read-only RPC stays up
         from .safemode import reject_if_locked_down
 
-        reject_if_locked_down(method)
-        return cmd.fn(node, params)
+        import time as _time
+
+        t0 = _time.monotonic()
+        _M_RPC_INFLIGHT.inc()
+        result = "ok"
+        try:
+            reject_if_locked_down(method)
+            return cmd.fn(node, params)
+        except RPCError:
+            result = "rpc_error"
+            raise
+        except Exception:
+            result = "internal_error"
+            raise
+        finally:
+            _M_RPC_INFLIGHT.dec()
+            _M_RPC_REQUESTS.inc(method=label, result=result)
+            _M_RPC_LATENCY.observe(_time.monotonic() - t0, method=label)
 
     def help_text(self, topic: Optional[str] = None) -> str:
         if topic:

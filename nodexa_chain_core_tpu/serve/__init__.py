@@ -1,0 +1,13 @@
+"""The public query plane (``-queryplane``): evented serving front end,
+compact block filters, and the filter-header chain light clients sync by.
+
+Layers:
+
+- :mod:`.filters` — per-block Golomb-coded filters over scriptPubKeys
+  (BIP157/158 analogue) plus the committed filter-header chain.
+- :mod:`.filterindex` — the filter index riding the chainstate's connect
+  path, with a watermark-resumable background backfill.
+- :mod:`.frontend` — the selectors-based RPC+REST front end: bounded
+  per-method queues, a small worker pool, per-client token buckets, and
+  typed load shedding.
+"""

@@ -1,0 +1,275 @@
+"""The compact-filter index: filters + the filter-header chain, committed
+block-by-block on the connect path and backfilled by a background indexer.
+
+Key layout over the chainstate's shared metadata KV store:
+
+  b"cf" + hash(32 BE) -> filter bytes                 [per-block filter]
+  b"ch" + hash(32 BE) -> filter header (32)           [header chain]
+  b"cw"               -> height(4 BE) + hash(32 BE)   [backfill watermark]
+
+The watermark is the highest height H such that every active-chain block
+at height <= H has both its filter and its header committed.  Connect-time
+indexing advances it only when the new tip extends the watermark (the
+steady state); an index enabled on a node with history lags behind, and
+:meth:`FilterIndex.backfill_step` walks the gap from the watermark — a
+crash mid-backfill resumes exactly there (the PR 13 back-validation
+pattern), which the fault-injection matrix proves via the
+``queryindex.write`` kill site.
+
+Every put routes through the ``queryindex.write`` fault site and every
+serving read through ``queryindex.read``, so torn-write/kill/error
+behavior is testable end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..node.faults import g_faults
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+from ..utils.sync import DebugLock
+from .filters import (
+    build_filter,
+    filter_hash,
+    filter_header,
+    filter_items,
+    filter_key,
+    hash_items_device,
+    hash_items_scalar,
+)
+
+# serving bounds (the BIP157 analogues)
+MAX_CFHEADERS = 2000
+MAX_CFILTERS = 1000
+
+# below this many items the device round trip costs more than hashlib
+DEVICE_MIN_ITEMS = 32
+
+_M_BUILT = g_metrics.counter(
+    "nodexa_cf_filters_built_total",
+    "Compact filters built, labeled path=device/scalar and "
+    "origin=connect/backfill")
+_M_BACKFILL = g_metrics.gauge(
+    "nodexa_cf_backfill_height",
+    "Compact-filter index watermark height (-1 = nothing indexed)")
+_M_SERVED = g_metrics.counter(
+    "nodexa_cf_served_total",
+    "Compact-filter serving reads, labeled kind=filter/header")
+
+
+class FilterIndex:
+    """Enabled by ``-cfilters``; owned by the chainstate (the connect and
+    disconnect tip transitions call :meth:`index_block` /
+    :meth:`unindex_block` under ``cs_main``)."""
+
+    def __init__(self, chainstate, use_device: bool = True):
+        self.chainstate = chainstate
+        self.db = chainstate.metadata_db
+        self.use_device = use_device
+        self._lock = DebugLock("cfindex", reentrant=False)
+        _M_BACKFILL.set(self.watermark()[0])
+
+    # ------------------------------------------------------------ hashing
+
+    def _hash_items(self, key16: bytes, scripts) -> List[int]:
+        if self.use_device and len(scripts) >= DEVICE_MIN_ITEMS:
+            try:
+                values = hash_items_device(key16, scripts)
+                self._path = "device"
+                return values
+            except Exception as e:  # device/toolchain gap: fail closed
+                self.use_device = False
+                log_printf("filterindex: device item-hash failed (%r); "
+                           "scalar path from here on", e)
+        self._path = "scalar"
+        return hash_items_scalar(key16, scripts)
+
+    def _build(self, block, idx, undo, origin: str) -> bytes:
+        key16 = filter_key(idx.block_hash)
+        fbytes = build_filter(key16, filter_items(block, undo),
+                              hasher=self._hash_items)
+        _M_BUILT.inc(path=self._path, origin=origin)
+        return fbytes
+
+    # ------------------------------------------------------------- writes
+
+    def _put(self, key: bytes, value: bytes) -> None:
+        if g_faults.enabled:
+            g_faults.check("queryindex.write")
+        self.db.put(key, value)
+
+    def _set_watermark(self, height: int, block_hash: int) -> None:
+        self._put(b"cw", (height & 0xFFFFFFFF).to_bytes(4, "big")
+                  + block_hash.to_bytes(32, "big"))
+        _M_BACKFILL.set(height)
+
+    def watermark(self) -> Tuple[int, int]:
+        """(height, block_hash); (-1, 0) when nothing is indexed yet."""
+        v = self.db.get(b"cw")
+        if v is None:
+            return -1, 0
+        return int.from_bytes(v[:4], "big"), int.from_bytes(v[4:36], "big")
+
+    def index_block(self, block, idx, undo) -> None:
+        """Connect-time hook (under cs_main).  Writes the filter always;
+        the header and watermark only when this block extends the
+        already-committed header chain (else the backfill catches up)."""
+        with self._lock:
+            h32 = idx.block_hash.to_bytes(32, "big")
+            fbytes = self._build(block, idx, undo, origin="connect")
+            self._put(b"cf" + h32, fbytes)
+            prev = self._prev_header(idx)
+            if prev is None:
+                return  # header chain not there yet; backfill's job
+            self._put(b"ch" + h32,
+                      filter_header(filter_hash(fbytes), prev))
+            wm_h, _ = self.watermark()
+            if idx.height == wm_h + 1 or idx.height == 0:
+                self._set_watermark(idx.height, idx.block_hash)
+
+    def unindex_block(self, block, idx, undo) -> None:
+        """Disconnect-time hook (under cs_main): the reorged block's
+        records go away and the watermark retreats below it."""
+        with self._lock:
+            h32 = idx.block_hash.to_bytes(32, "big")
+            if g_faults.enabled:
+                g_faults.check("queryindex.write")
+            self.db.delete(b"cf" + h32)
+            self.db.delete(b"ch" + h32)
+            wm_h, _ = self.watermark()
+            if wm_h >= idx.height and idx.prev is not None:
+                self._set_watermark(idx.prev.height, idx.prev.block_hash)
+
+    def _prev_header(self, idx) -> Optional[bytes]:
+        if idx.height == 0:
+            return bytes(32)
+        return self.db.get(
+            b"ch" + idx.prev.block_hash.to_bytes(32, "big"))
+
+    # ----------------------------------------------------------- backfill
+
+    def backfill_step(self, max_blocks: int = 16) -> bool:
+        """Index up to ``max_blocks`` blocks above the watermark; returns
+        True when the watermark has reached the active tip.  Called from
+        the background indexer thread (takes cs_main per step, bounded
+        work per hold) and restartable at any kill point: the watermark
+        only advances after the records below it are committed."""
+        cs = self.chainstate
+        with cs.cs_main:
+            tip = cs.tip()
+            if tip is None:
+                return True
+            with self._lock:
+                wm_h, _ = self.watermark()
+                for h in range(wm_h + 1,
+                               min(tip.height, wm_h + max_blocks) + 1):
+                    idx = cs.active.at(h)
+                    self._backfill_one(idx)
+                wm_h, _ = self.watermark()
+                return wm_h >= tip.height
+
+    def _backfill_one(self, idx) -> None:
+        h32 = idx.block_hash.to_bytes(32, "big")
+        fbytes = self.db.get(b"cf" + h32)
+        if fbytes is not None and g_faults.enabled:
+            fbytes = g_faults.filter_read("queryindex.read", fbytes) or None
+        if fbytes is None:
+            block = self.chainstate.read_block(idx)
+            undo = (self.chainstate._read_undo_for(idx)
+                    if idx.height > 0 else None)
+            fbytes = self._build(block, idx, undo, origin="backfill")
+            self._put(b"cf" + h32, fbytes)
+        prev = self._prev_header(idx)
+        assert prev is not None  # backfill walks in height order
+        self._put(b"ch" + h32, filter_header(filter_hash(fbytes), prev))
+        self._set_watermark(idx.height, idx.block_hash)
+
+    def start_backfill(self, batch: int = 16,
+                       interval_s: float = 0.05) -> threading.Thread:
+        """Spawn the background indexer (daemon thread); it exits once
+        the watermark reaches the tip and re-checks are the connect
+        path's job from then on."""
+        def _run():
+            while True:
+                try:
+                    if self.backfill_step(batch):
+                        return
+                except Exception as e:  # pragma: no cover - IO failure
+                    log_printf("filterindex: backfill error: %r", e)
+                    return
+                threading.Event().wait(interval_s)
+
+        t = threading.Thread(target=_run, name="cf-backfill", daemon=True)
+        t.start()
+        return t
+
+    # ------------------------------------------------------------ serving
+
+    def _read(self, key: bytes) -> Optional[bytes]:
+        v = self.db.get(key)
+        if v is not None and g_faults.enabled:
+            v = g_faults.filter_read("queryindex.read", v)
+        return v
+
+    def get_filter(self, block_hash: int) -> Optional[bytes]:
+        v = self._read(b"cf" + block_hash.to_bytes(32, "big"))
+        if v is not None:
+            _M_SERVED.inc(kind="filter")
+        return v
+
+    def get_header(self, block_hash: int) -> Optional[bytes]:
+        v = self._read(b"ch" + block_hash.to_bytes(32, "big"))
+        if v is not None:
+            _M_SERVED.inc(kind="header")
+        return v
+
+    def headers_range(self, start_height: int,
+                      stop_hash: int) -> Optional[Tuple[int, List[bytes]]]:
+        """(start_height, [headers...]) for the active-chain range ending
+        at ``stop_hash`` (None when the stop block is unknown/unindexed
+        or the range is malformed).  Bounded at MAX_CFHEADERS."""
+        cs = self.chainstate
+        with cs.cs_main:
+            stop = cs.block_index.get(stop_hash)
+            if stop is None or cs.active.at(stop.height) is not stop:
+                return None
+            start_height = max(0, start_height)
+            if start_height > stop.height:
+                return None
+            start_height = max(start_height,
+                               stop.height - MAX_CFHEADERS + 1)
+            idxs = [cs.active.at(h)
+                    for h in range(start_height, stop.height + 1)]
+        headers = []
+        for idx in idxs:
+            hdr = self.get_header(idx.block_hash)
+            if hdr is None:
+                return None  # range not fully indexed yet
+            headers.append(hdr)
+        return start_height, headers
+
+    def filters_range(self, start_height: int, stop_hash: int
+                      ) -> Optional[Tuple[int, List[Tuple[int, bytes]]]]:
+        """(start_height, [(block_hash, filter)...]); bounds and
+        None-semantics as :meth:`headers_range`, capped at MAX_CFILTERS."""
+        cs = self.chainstate
+        with cs.cs_main:
+            stop = cs.block_index.get(stop_hash)
+            if stop is None or cs.active.at(stop.height) is not stop:
+                return None
+            start_height = max(0, start_height)
+            if start_height > stop.height:
+                return None
+            start_height = max(start_height,
+                               stop.height - MAX_CFILTERS + 1)
+            idxs = [cs.active.at(h)
+                    for h in range(start_height, stop.height + 1)]
+        out = []
+        for idx in idxs:
+            f = self.get_filter(idx.block_hash)
+            if f is None:
+                return None
+            out.append((idx.block_hash, f))
+        return start_height, out

@@ -1,0 +1,284 @@
+"""Per-block compact filters (BIP157/158 analogue).
+
+A block's filter is a Golomb-Rice-coded set over every scriptPubKey the
+block touches: each output's scriptPubKey plus every spent prevout's
+scriptPubKey (recovered from the block's undo data, the same source the
+optional indexes use — the filter writer never re-fetches coins).  Empty
+scripts and provably unspendable ``OP_RETURN`` outputs are excluded,
+mirroring BIP158's basic filter.
+
+Items hash to 64-bit values and are mapped uniformly into ``[0, N*M)``
+(BIP158's fast-range reduction), sorted, delta-encoded, and Golomb-Rice
+coded with parameter ``P``.  Where BIP158 uses SipHash keyed on the
+block hash, this chain's item hash is the first 8 bytes of
+``sha256(key16 || sha256(script))`` with ``key16`` the first 16 bytes of
+the block hash's wire serialization: the outer message is exactly 48
+bytes — ONE padded SHA-256 block — so hashing a whole block's item set
+batches through the existing :func:`..ops.sha256_jax.sha256_words`
+kernel on device (the ``cf.itemhash`` compile-cache family) with a
+byte-identical ``hashlib`` scalar fallback.
+
+The filter-header chain commits filters block-by-block exactly like
+BIP157: ``header = sha256d(sha256d(filter) || prev_header)``, genesis
+prev-header all zeros — a light client that trusts one header checkpoint
+can verify every filter it downloads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Iterable, List, Optional, Sequence
+
+from ..core.serialize import ByteReader, ByteWriter, SerializationError
+
+# Golomb-Rice parameters (BIP158's basic filter values: a false-positive
+# rate of 1/M with remainder width P ~= log2(M))
+GCS_P = 19
+GCS_M = 784931
+
+OP_RETURN = 0x6A
+
+
+def filter_key(block_hash: int) -> bytes:
+    """Per-block hash key: first 16 bytes of the wire (LE) block hash."""
+    return block_hash.to_bytes(32, "little")[:16]
+
+
+def filter_items(block, undo) -> List[bytes]:
+    """The distinct scriptPubKeys a block touches (outputs + spent
+    prevouts from undo), excluding empty and OP_RETURN scripts."""
+    items = set()
+    for ti, tx in enumerate(block.vtx):
+        for out in tx.vout:
+            spk = out.script_pubkey
+            if spk and spk[0] != OP_RETURN:
+                items.add(bytes(spk))
+        if tx.is_coinbase():
+            continue
+        txundo = undo.vtxundo[ti - 1] if undo is not None else None
+        if txundo is None:
+            continue
+        for prev in txundo.prevouts:
+            spk = prev.out.script_pubkey
+            if spk and spk[0] != OP_RETURN:
+                items.add(bytes(spk))
+    return sorted(items)
+
+
+# ------------------------------------------------------------ item hash
+
+def _item_message(key16: bytes, script: bytes) -> bytes:
+    """The 48-byte outer message: key16 || sha256(script)."""
+    return key16 + hashlib.sha256(script).digest()
+
+
+def hash_items_scalar(key16: bytes, scripts: Sequence[bytes]) -> List[int]:
+    """64-bit item values via hashlib (the always-available path)."""
+    return [
+        int.from_bytes(
+            hashlib.sha256(_item_message(key16, s)).digest()[:8], "big")
+        for s in scripts
+    ]
+
+
+# device path: the 48-byte message pads to exactly one 64-byte SHA-256
+# block (12 message words, 0x80000000, two zero words, bit length 384),
+# so a block's whole item set is one (B, 16) uint32 batch through the
+# shared sha256_words kernel.
+_cf_kernel = None
+
+
+def _get_cf_kernel():
+    global _cf_kernel
+    if _cf_kernel is None:
+        from ..ops.compile_cache import g_compile_cache
+        from ..ops.sha256_jax import sha256_words
+
+        def _fn(blocks):  # (B, 16) BE words -> (B, 2) leading digest words
+            return sha256_words(blocks[:, None, :])[:, :2]
+
+        _cf_kernel = g_compile_cache.wrap(
+            "cf.itemhash", _fn, label=lambda args: str(args[0].shape[0]))
+    return _cf_kernel
+
+
+def hash_items_device(key16: bytes, scripts: Sequence[bytes]) -> List[int]:
+    """64-bit item values batched on device; bit-identical to
+    :func:`hash_items_scalar`.  Raises on any device/toolchain trouble —
+    callers fall back to the scalar path."""
+    import numpy as np
+
+    n = len(scripts)
+    if n == 0:
+        return []
+    from ..ops.compile_cache import CF_ITEM_BUCKETS, bucket_for
+
+    b = bucket_for(n, CF_ITEM_BUCKETS)
+    blocks = np.zeros((b, 16), dtype=np.uint32)
+    key_words = struct.unpack(">4I", key16)
+    blocks[:, 0:4] = key_words
+    for i, s in enumerate(scripts):
+        blocks[i, 4:12] = struct.unpack(">8I", hashlib.sha256(s).digest())
+    blocks[:, 12] = 0x80000000
+    blocks[:, 15] = 384  # bit length of the 48-byte message
+    out = np.asarray(_get_cf_kernel()(blocks))
+    return [(int(out[i, 0]) << 32) | int(out[i, 1]) for i in range(n)]
+
+
+def map_values(values: Iterable[int], n: int, m: int = GCS_M) -> List[int]:
+    """Fast-range reduction of 64-bit hashes into [0, n*m), sorted."""
+    f = n * m
+    return sorted((v * f) >> 64 for v in values)
+
+
+# ------------------------------------------------------- Golomb-Rice IO
+
+class _BitWriter:
+    __slots__ = ("out", "acc", "nbits")
+
+    def __init__(self) -> None:
+        self.out = bytearray()
+        self.acc = 0
+        self.nbits = 0
+
+    def write(self, value: int, nbits: int) -> None:
+        self.acc = (self.acc << nbits) | (value & ((1 << nbits) - 1))
+        self.nbits += nbits
+        while self.nbits >= 8:
+            self.nbits -= 8
+            self.out.append((self.acc >> self.nbits) & 0xFF)
+        self.acc &= (1 << self.nbits) - 1
+
+    def getvalue(self) -> bytes:
+        if self.nbits:
+            return bytes(self.out) + bytes(
+                [(self.acc << (8 - self.nbits)) & 0xFF])
+        return bytes(self.out)
+
+
+class _BitReader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0  # bit cursor
+
+    def read(self, nbits: int) -> int:
+        if self.pos + nbits > len(self.data) * 8:
+            raise SerializationError("gcs: read past end")
+        v = 0
+        for _ in range(nbits):
+            byte = self.data[self.pos >> 3]
+            v = (v << 1) | ((byte >> (7 - (self.pos & 7))) & 1)
+            self.pos += 1
+        return v
+
+    def read_unary(self) -> int:
+        q = 0
+        while True:
+            if self.pos >= len(self.data) * 8:
+                raise SerializationError("gcs: unary past end")
+            bit = (self.data[self.pos >> 3] >> (7 - (self.pos & 7))) & 1
+            self.pos += 1
+            if not bit:
+                return q
+            q += 1
+            if q > 1 << 16:
+                raise SerializationError("gcs: unreasonable quotient")
+
+
+def encode_gcs(sorted_values: Sequence[int], p: int = GCS_P) -> bytes:
+    w = _BitWriter()
+    prev = 0
+    for v in sorted_values:
+        delta = v - prev
+        prev = v
+        q, r = delta >> p, delta & ((1 << p) - 1)
+        w.write((1 << (q + 1)) - 2, q + 1)  # q ones then a zero
+        w.write(r, p)
+    return w.getvalue()
+
+
+def decode_gcs(data: bytes, n: int, p: int = GCS_P) -> List[int]:
+    # Hot on the light-client sync path (every filter a wallet matches
+    # is decoded).  A per-bit cursor costs ~p+q Python iterations per
+    # item; rendering the buffer once as a text bitstring instead makes
+    # the unary scan a C-speed str.find and the remainder a C-speed
+    # int(str, 2).
+    total = len(data) * 8
+    bits = bin(int.from_bytes(data, "big"))[2:].zfill(total)
+    out = []
+    pos = 0
+    v = 0
+    for _ in range(n):
+        z = bits.find("0", pos)
+        if z < 0:
+            raise SerializationError("gcs: unary past end")
+        if z - pos > 1 << 16:
+            raise SerializationError("gcs: unreasonable quotient")
+        q = z - pos
+        pos = z + 1
+        if pos + p > total:
+            raise SerializationError("gcs: read past end")
+        v += (q << p) | int(bits[pos:pos + p], 2)
+        pos += p
+        out.append(v)
+    return out
+
+
+# --------------------------------------------------------- whole filter
+
+def build_filter(key16: bytes, scripts: Sequence[bytes],
+                 hasher=hash_items_scalar) -> bytes:
+    """CompactSize(N) || Golomb-Rice bits over the mapped item values."""
+    scripts = sorted(set(bytes(s) for s in scripts))
+    mapped = map_values(hasher(key16, scripts), len(scripts))
+    w = ByteWriter()
+    w.compact_size(len(scripts))
+    w.write(encode_gcs(mapped))
+    return w.getvalue()
+
+
+def decode_filter(filter_bytes: bytes) -> List[int]:
+    """The filter's sorted mapped-value set (raises SerializationError
+    on malformed input)."""
+    r = ByteReader(filter_bytes)
+    n = r.compact_size()
+    return decode_gcs(r.read(r.remaining()), n)
+
+
+def match_any(filter_bytes: bytes, key16: bytes,
+              scripts: Sequence[bytes]) -> bool:
+    """True when any of ``scripts`` may be in the filter (false
+    positives at ~1/M per query; never false negatives)."""
+    scripts = [bytes(s) for s in scripts if s]
+    if not scripts:
+        return False
+    r = ByteReader(filter_bytes)
+    n = r.compact_size()
+    if n == 0:
+        return False
+    f = n * GCS_M
+    queries = sorted(
+        (v * f) >> 64 for v in hash_items_scalar(key16, scripts))
+    values = decode_gcs(r.read(r.remaining()), n)
+    vi = 0
+    for q in queries:
+        while vi < len(values) and values[vi] < q:
+            vi += 1
+        if vi < len(values) and values[vi] == q:
+            return True
+    return False
+
+
+def filter_hash(filter_bytes: bytes) -> bytes:
+    from ..crypto.hashes import sha256d
+
+    return sha256d(filter_bytes)
+
+
+def filter_header(fhash: bytes, prev_header: Optional[bytes]) -> bytes:
+    from ..crypto.hashes import sha256d
+
+    return sha256d(fhash + (prev_header or bytes(32)))

@@ -1,0 +1,581 @@
+"""The evented query-plane front end (``-queryplane``).
+
+One selectors IO thread (the :mod:`..pool.server` pattern) owns every
+client socket: it accepts, frames HTTP/1.1 requests (Content-Length
+bodies, keep-alive), and feeds complete requests into bounded per-method
+work queues that a small worker pool drains through the same
+:class:`..rpc.server.RPCTable` dispatch and REST handler the legacy
+front end uses — same answers, same error taxonomy, different front
+door.
+
+Overload never grows a queue: a full method queue or an over-budget
+client is answered immediately with a typed ``busy`` reply
+(HTTP 503, JSON-RPC code :data:`RPC_BUSY`) and counted on
+``nodexa_query_shed_total{reason}``.  Honest clients are never scored —
+misbehavior (the pool's ban machinery) is reserved for protocol garbage:
+unframed floods, oversized requests, unparseable HTTP/JSON.  In safe
+mode only the read-only diagnostic commands run (the PR 5/11 contract);
+everything else sheds with ``reason="safe_mode"`` so a recovering node
+is never buried under a backlog it cannot serve.
+
+Requests on one connection are answered in order: a session has at most
+one request in flight; pipelined bytes wait buffered until the reply is
+queued.  Writes never block (per-session send buffer with a
+slow-consumer cap, flushed opportunistically and from the IO loop).
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from ..node.health import g_health
+from ..rpc.safemode import READONLY_DIAGNOSTIC_COMMANDS
+from ..rpc.server import (
+    RPC_INTERNAL_ERROR,
+    RPC_PARSE_ERROR,
+    RPCError,
+    _error_envelope,
+)
+from ..telemetry import g_metrics
+from ..utils.logging import log_printf
+from ..utils.sync import DebugLock
+
+RPC_BUSY = -32005            # typed shed: retry later, nothing is wrong
+MAX_HEADER = 8192            # request line + headers cap
+MAX_BODY = 1 << 20           # JSON-RPC body cap
+MAX_BUFFER = MAX_HEADER + MAX_BODY
+MAX_SEND_BUFFER = 262144     # slow-consumer cap, as the pool's
+BAN_THRESHOLD = 100
+QUEUE_DEPTH = 32             # per-method bound
+SHED_RETRY_AFTER_S = 1       # advisory Retry-After on busy replies
+
+_M_CONNECTIONS = g_metrics.counter(
+    "nodexa_query_connections_total",
+    "Query-plane connections, labeled event=accepted/refused_banned/full")
+_M_SHED = g_metrics.counter(
+    "nodexa_query_shed_total",
+    "Query-plane typed busy replies, labeled "
+    "reason=queue_full/rate_limited/safe_mode")
+_M_MISBEHAVIOR = g_metrics.counter(
+    "nodexa_query_misbehavior_total",
+    "Query-plane misbehavior score, labeled by reason")
+_M_QUEUE_DEPTH = g_metrics.gauge(
+    "nodexa_query_queue_depth",
+    "Queued query-plane requests, labeled by method")
+
+
+class TokenBucket:
+    """Per-client budget: ``rate`` requests/s with ``burst`` headroom.
+    Over-budget requests are shed with a typed reply, never scored."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.t_last = now
+
+    def take(self, now: float) -> bool:
+        self.tokens = min(
+            self.burst, self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens < 1.0:
+            return False
+        self.tokens -= 1.0
+        return True
+
+
+class QuerySession:
+    _next_key = 0
+
+    def __init__(self, sock: socket.socket, addr):
+        QuerySession._next_key += 1
+        self.key = QuerySession._next_key
+        self.sock = sock
+        self.ip = addr[0]
+        self.buffer = b""
+        self.dead = False
+        self.closing = False        # close once the send buffer drains
+        self.busy = False           # one request in flight per session
+        self.misbehavior = 0
+        self._wlock = DebugLock("serve.session.send", reentrant=False)
+        self._out = bytearray()
+
+    def queue_response(self, data: bytes) -> bool:
+        with self._wlock:
+            if len(self._out) + len(data) > MAX_SEND_BUFFER:
+                self.dead = True
+                return False
+            self._out += data
+            return self._flush_locked()
+
+    def flush(self) -> None:
+        with self._wlock:
+            if self._out:
+                self._flush_locked()
+
+    def done(self) -> bool:
+        with self._wlock:
+            return not self._out
+
+    def _flush_locked(self) -> bool:
+        try:
+            while self._out:
+                n = self.sock.send(self._out)
+                if n <= 0:
+                    break
+                del self._out[:n]
+        except (BlockingIOError, InterruptedError):
+            pass  # kernel buffer full; the IO loop retries
+        except OSError:
+            self.dead = True
+            return False
+        return True
+
+
+def _http_response(code: int, payload, ctype: Optional[str] = None,
+                   keep_alive: bool = True,
+                   extra_headers: Tuple[str, ...] = ()) -> bytes:
+    if isinstance(payload, bytes):
+        body = payload
+        ctype = ctype or "application/octet-stream"
+    elif isinstance(payload, str):
+        body = payload.encode()
+        ctype = ctype or "text/html; charset=utf-8"
+    else:
+        body = json.dumps(payload).encode()
+        ctype = ctype or "application/json"
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              429: "Too Many Requests", 500: "Internal Server Error",
+              503: "Service Unavailable"}.get(code, "OK")
+    head = [f"HTTP/1.1 {code} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+            "Connection: " + ("keep-alive" if keep_alive else "close")]
+    head.extend(extra_headers)
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+class QueryPlaneServer:
+    """The public query front door; one instance per node
+    (``-queryplane``)."""
+
+    def __init__(self, node, table, host: str = "127.0.0.1", port: int = 0,
+                 workers: int = 4, max_connections: int = 512,
+                 queue_depth: int = QUEUE_DEPTH,
+                 rate_qps: float = 50.0, rate_burst: float = 100.0,
+                 ban_time_s: float = 600.0, clock=time.monotonic):
+        self.node = node
+        self.table = table
+        self.host = host
+        self.max_connections = max_connections
+        self.queue_depth = queue_depth
+        self.rate_qps = rate_qps
+        self.rate_burst = rate_burst
+        self.ban_time_s = ban_time_s
+        self._clock = clock
+
+        self.sessions: Dict[int, QuerySession] = {}
+        self._sessions_lock = DebugLock("serve.sessions", reentrant=False)
+        self.banned: Dict[str, float] = {}
+        self._banned_lock = DebugLock("serve.banned", reentrant=False)
+        self._buckets: Dict[str, TokenBucket] = {}
+
+        # bounded per-method queues drained by the worker pool; _qcond
+        # guards both the queue map and the round-robin cursor
+        self._queues: Dict[str, deque] = {}
+        self._qcond = threading.Condition()
+        self._rr: deque = deque()  # round-robin order of non-empty queues
+        self.shed_counts: Dict[str, int] = {
+            "queue_full": 0, "rate_limited": 0, "safe_mode": 0}
+        self.served = 0
+
+        self._stop = threading.Event()
+        self._sel = selectors.DefaultSelector()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self._sel.register(self._listener, selectors.EVENT_READ, None)
+        self._io_thread: Optional[threading.Thread] = None
+        self._workers = [
+            threading.Thread(target=self._worker, name=f"query-w{i}",
+                             daemon=True)
+            for i in range(max(1, workers))
+        ]
+        g_metrics.gauge_fn(
+            "nodexa_query_sessions", "Connected query-plane sessions",
+            lambda: len(self.sessions))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._io_thread is not None:
+            return
+        for w in self._workers:
+            w.start()
+        self._io_thread = threading.Thread(
+            target=self._io_loop, name="query-io", daemon=True)
+        self._io_thread.start()
+        log_printf("query plane listening on %s:%d (%d workers)",
+                   self.host, self.port, len(self._workers))
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._qcond:
+            self._qcond.notify_all()
+        t = self._io_thread
+        if t is not None:
+            t.join(timeout=10)
+        self._io_thread = None
+        for w in self._workers:
+            w.join(timeout=5)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._sessions_lock:
+            sessions = list(self.sessions.values())
+            self.sessions.clear()
+        for s in sessions:
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+    # -- IO loop (the only thread that closes/unregisters sockets) --------
+
+    def _io_loop(self) -> None:
+        self._last_prune = self._clock()
+        while not self._stop.is_set():
+            try:
+                self._io_pass()
+            except Exception as e:  # noqa: BLE001 — the ONE io thread
+                # must survive anything a hostile client provokes
+                log_printf("query: io loop error: %r", e)
+                time.sleep(0.05)
+
+    def _io_pass(self) -> None:
+        events = self._sel.select(timeout=0.2)
+        for key, _ in events:
+            if key.data is None:
+                self._accept()
+            else:
+                self._read(key.data)
+        with self._sessions_lock:
+            sessions = list(self.sessions.values())
+        for s in sessions:
+            if not s.dead:
+                s.flush()  # drain bytes queued by worker threads
+            if not s.dead and not s.busy and s.buffer:
+                self._parse(s)  # pipelined request waiting its turn
+            if s.closing and not s.busy and s.done():
+                # busy guards the Connection: close race: the response
+                # is queued before the worker clears busy, so a closing
+                # session is only reaped after its reply hit the buffer
+                s.dead = True
+        for s in sessions:
+            if s.dead:
+                self._drop(s)
+        now = self._clock()
+        if now - self._last_prune > 60.0:
+            self._last_prune = now
+            with self._banned_lock:
+                for ip in [ip for ip, t in self.banned.items()
+                           if t <= now]:
+                    del self.banned[ip]
+            # bucket table is per-IP remote input: prune idle entries
+            for ip in [ip for ip, b in self._buckets.items()
+                       if now - b.t_last > 300.0]:
+                del self._buckets[ip]
+
+    def _accept(self) -> None:
+        try:
+            sock, addr = self._listener.accept()
+        except OSError:
+            return
+        now = self._clock()
+        with self._banned_lock:
+            until = self.banned.get(addr[0], 0)
+            if until and until <= now:
+                del self.banned[addr[0]]
+        if until > now:
+            _M_CONNECTIONS.inc(event="refused_banned")
+            sock.close()
+            return
+        if len(self.sessions) >= self.max_connections:
+            _M_CONNECTIONS.inc(event="full")
+            sock.close()
+            return
+        sock.setblocking(False)
+        sess = QuerySession(sock, addr)
+        with self._sessions_lock:
+            self.sessions[sess.key] = sess
+        self._sel.register(sock, selectors.EVENT_READ, sess)
+        _M_CONNECTIONS.inc(event="accepted")
+
+    def _drop(self, sess: QuerySession) -> None:
+        with self._sessions_lock:
+            self.sessions.pop(sess.key, None)
+        try:
+            self._sel.unregister(sess.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            sess.sock.close()
+        except OSError:
+            pass
+
+    def _read(self, sess: QuerySession) -> None:
+        try:
+            chunk = sess.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            chunk = b""
+        if not chunk:
+            self._drop(sess)
+            return
+        sess.buffer += chunk
+        if len(sess.buffer) > MAX_BUFFER:
+            self._misbehave(sess, BAN_THRESHOLD, "unframed-flood")
+            self._drop(sess)
+            return
+        if not sess.busy:
+            self._parse(sess)
+        if sess.dead:
+            self._drop(sess)
+
+    # -- HTTP framing ------------------------------------------------------
+
+    def _parse(self, sess: QuerySession) -> None:
+        """Frame ONE request off the buffer (a session serves in order:
+        while a request is in flight the rest of the buffer waits)."""
+        end = sess.buffer.find(b"\r\n\r\n")
+        if end < 0:
+            if len(sess.buffer) > MAX_HEADER:
+                self._misbehave(sess, BAN_THRESHOLD, "oversized-header")
+            return
+        head = sess.buffer[:end]
+        try:
+            lines = head.decode("latin-1").split("\r\n")
+            verb, target, _version = lines[0].split(" ", 2)
+            headers = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            length = int(headers.get("content-length", "0"))
+            if length < 0 or length > MAX_BODY:
+                raise ValueError("bad length")
+        except (ValueError, IndexError):
+            self._misbehave(sess, 20, "malformed-http")
+            sess.queue_response(_http_response(
+                400, {"error": "malformed request"}, keep_alive=False))
+            sess.closing = True
+            return
+        total = end + 4 + length
+        if len(sess.buffer) < total:
+            return  # body still arriving
+        body = sess.buffer[end + 4:total]
+        sess.buffer = sess.buffer[total:]
+        if headers.get("connection", "").lower() == "close":
+            sess.closing = True
+        sess.busy = True
+        self._route(sess, verb.upper(), target, body)
+
+    # -- routing / shedding ------------------------------------------------
+
+    def _rate_ok(self, ip: str) -> bool:
+        now = self._clock()
+        bucket = self._buckets.get(ip)
+        if bucket is None:
+            bucket = self._buckets[ip] = TokenBucket(
+                self.rate_qps, self.rate_burst, now)
+        return bucket.take(now)
+
+    def _shed(self, sess: QuerySession, reason: str, rid=None) -> None:
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+        _M_SHED.inc(reason=reason)
+        sess.queue_response(_http_response(
+            503, _error_envelope(rid, RPC_BUSY, f"busy: {reason}"),
+            extra_headers=(f"Retry-After: {SHED_RETRY_AFTER_S}",)))
+        sess.busy = False
+
+    def _route(self, sess: QuerySession, verb: str, target: str,
+               body: bytes) -> None:
+        if verb == "GET":
+            if not self._rate_ok(sess.ip):
+                self._shed(sess, "rate_limited")
+                return
+            if not g_health.allow_mutations():
+                # REST is not on the diagnostic allow-list: shed typed
+                self._shed(sess, "safe_mode")
+                return
+            self._enqueue(sess, "rest", {"path": target}, rid=None)
+            return
+        if verb != "POST":
+            self._misbehave(sess, 5, "bad-verb")
+            sess.queue_response(_http_response(
+                400, {"error": "unsupported method"}, keep_alive=False))
+            sess.closing = True
+            sess.busy = False
+            return
+        try:
+            req = json.loads(body)
+            if not isinstance(req, dict):
+                raise ValueError("batch not supported on the query plane")
+            method = req.get("method")
+            if not isinstance(method, str):
+                raise ValueError("missing method")
+        except (ValueError, json.JSONDecodeError):
+            self._misbehave(sess, 10, "garbage-json")
+            sess.queue_response(_http_response(
+                400, _error_envelope(None, RPC_PARSE_ERROR, "Parse error")))
+            sess.busy = False
+            return
+        rid = req.get("id")
+        if not self._rate_ok(sess.ip):
+            self._shed(sess, "rate_limited", rid)
+            return
+        if (not g_health.allow_mutations()
+                and method not in READONLY_DIAGNOSTIC_COMMANDS):
+            self._shed(sess, "safe_mode", rid)
+            return
+        # unregistered names share ONE queue lane: method strings are
+        # remote input, and letting them mint queues (and queue-depth
+        # gauge labels) would hand a hostile client an unbounded map —
+        # the dispatch table still answers each with its not-found error
+        lane = (method if method in self.table._commands else "unknown")
+        self._enqueue(sess, lane,
+                      {"params": req.get("params") or [],
+                       "method": method}, rid=rid)
+
+    def _enqueue(self, sess: QuerySession, method: str, work: dict,
+                 rid) -> None:
+        with self._qcond:
+            q = self._queues.get(method)
+            if q is None:
+                q = self._queues[method] = deque()
+            if len(q) >= self.queue_depth:
+                shed = True
+            else:
+                shed = False
+                q.append((sess, method, work, rid))
+                if method not in self._rr:
+                    self._rr.append(method)
+                # queue lanes are the registered command table plus
+                # "rest" and the shared "unknown" lane (_route folds
+                # unregistered remote-supplied names into it), so the
+                # method label stays bounded
+                _M_QUEUE_DEPTH.set(len(q), method=method)
+                self._qcond.notify()
+        if shed:
+            self._shed(sess, "queue_full", rid)
+
+    # -- worker pool -------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = None
+            with self._qcond:
+                while item is None and not self._stop.is_set():
+                    while self._rr:
+                        method = self._rr[0]
+                        q = self._queues.get(method)
+                        if not q:
+                            self._rr.popleft()
+                            continue
+                        item = q.popleft()
+                        _M_QUEUE_DEPTH.set(len(q), method=method)
+                        self._rr.rotate(-1)
+                        break
+                    if item is None:
+                        self._qcond.wait(timeout=0.2)
+            if item is None:
+                continue
+            sess, method, work, rid = item
+            try:
+                self._execute(sess, method, work, rid)
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                log_printf("query: worker error in %s: %r", method, e)
+                sess.queue_response(_http_response(
+                    500, _error_envelope(rid, RPC_INTERNAL_ERROR, "internal error")))
+            finally:
+                self.served += 1
+                sess.busy = False
+
+    def _execute(self, sess: QuerySession, method: str, work: dict,
+                 rid) -> None:
+        if method == "rest":
+            handler = getattr(self.node, "rest_handler", None)
+            if handler is None:
+                sess.queue_response(_http_response(
+                    404, {"error": "REST disabled"}))
+                return
+            res = handler(work["path"])
+            code, payload = res[0], res[1]
+            ctype = res[2] if len(res) > 2 else None
+            sess.queue_response(_http_response(code, payload, ctype))
+            return
+        rpc_method = work.get("method", method)
+        try:
+            result = self.table.execute(
+                self.node, rpc_method, work["params"])
+            envelope = {"result": result, "error": None, "id": rid}
+            code = 200
+        except RPCError as e:
+            envelope = _error_envelope(rid, e.code, e.message)
+            code = 500
+        except Exception as e:  # noqa: BLE001 — RPC boundary
+            log_printf("query: internal error in %s: %r", rpc_method, e)
+            envelope = _error_envelope(rid, RPC_INTERNAL_ERROR, str(e))
+            code = 500
+        sess.queue_response(_http_response(code, envelope))
+
+    # -- abuse handling ----------------------------------------------------
+
+    def _misbehave(self, sess: QuerySession, score: int,
+                   reason: str) -> None:
+        sess.misbehavior += score
+        _M_MISBEHAVIOR.inc(score, reason=reason)
+        if sess.misbehavior >= BAN_THRESHOLD:
+            with self._banned_lock:
+                self.banned[sess.ip] = self._clock() + self.ban_time_s
+            log_printf("query: banning %s for %ds (%s, score %d)",
+                       sess.ip, int(self.ban_time_s), reason,
+                       sess.misbehavior)
+            sess.dead = True
+
+    # -- introspection (getqueryplaneinfo) ---------------------------------
+
+    def info(self) -> dict:
+        with self._qcond:
+            depths = {m: len(q) for m, q in self._queues.items() if q}
+        with self._sessions_lock:
+            n_sessions = len(self.sessions)
+        with self._banned_lock:
+            now = self._clock()
+            n_banned = sum(1 for t in self.banned.values() if t > now)
+        return {
+            "enabled": True,
+            "bind": f"{self.host}:{self.port}",
+            "sessions": n_sessions,
+            "workers": len(self._workers),
+            "queue_depth_limit": self.queue_depth,
+            "queued": depths,
+            "served": self.served,
+            "shed": dict(self.shed_counts),
+            "rate_qps": self.rate_qps,
+            "rate_burst": self.rate_burst,
+            "banned": n_banned,
+        }

@@ -91,6 +91,10 @@ LEDGER_LOCKS = (
     "miner.stats",
     "faults",
     "wallet",
+    "cfindex",
+    "serve.sessions",
+    "serve.session.send",
+    "serve.banned",
     # coins shard family (chain/coins_shards.py) — enumerated to the
     # MAX_COINS_SHARDS cap; the blame matrix rolls these up into one
     # "coins.shard*" row (site-cap discipline), but per-lock stats keep

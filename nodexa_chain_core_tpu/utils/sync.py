@@ -316,6 +316,10 @@ KNOWN_LOCKS = (
     "miner.stats",
     "faults",
     "wallet",
+    "cfindex",
+    "serve.sessions",
+    "serve.session.send",
+    "serve.banned",
     # coins shard family (chain/coins_shards.py): one lock per UTXO
     # shard, enumerated to the MAX_COINS_SHARDS cap so the ledger and
     # nxlint see a closed set even though construction is parameterized
@@ -371,5 +375,10 @@ declare_lock_order("connman.peers", "peer.send")
 # pool: notify fanout iterates sessions then queues per-session writes
 declare_lock_order("pool.sessions", "pool.session.send")
 declare_lock_order("pool.jobs", "pool.sessions")
+# compact-filter index: connect-time writes and the backfill both hold
+# cs_main first, then the index lock for header-chain/watermark updates
+declare_lock_order("cs_main", "cfindex")
+# query plane: session-table iteration wraps per-session write queues
+declare_lock_order("serve.sessions", "serve.session.send")
 # mesh backend: epoch residency decisions wrap per-epoch builds
 declare_lock_order("mesh.epochs", "mesh.build")

@@ -62,6 +62,17 @@ EXTENSION_COMMANDS = {
     "MSG_SNAPHDR": "snaphdr",
     "MSG_GETSNAPCHUNK": "getsnapchunk",
     "MSG_SNAPCHUNK": "snapchunk",
+    # compact block filters (-cfilterpeers, README "The query plane"):
+    # sendcf is the mutual capability advertisement; the BIP157-shaped
+    # header/filter request-reply pairs only ever flow between peers
+    # that BOTH advertised it — vanilla peers never see any of these.
+    # (BIP157 proper uses cfcheckpt and NODE_COMPACT_FILTERS service
+    # bits; this chain's reference predates that, hence the extension.)
+    "MSG_SENDCF": "sendcf",
+    "MSG_GETCFHEADERS": "getcfheaders",
+    "MSG_CFHEADERS": "cfheaders",
+    "MSG_GETCFILTERS": "getcfilters",
+    "MSG_CFILTER": "cfilter",
 }
 
 

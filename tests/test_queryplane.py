@@ -1,0 +1,969 @@
+"""The query plane: compact filters (GCS codec, per-block filter index,
+header chain, backfill), the evented serving front end, RPC parity
+through both front doors, the optional-index reorg contract, the new
+metric families' exposition conformance, and the wallet-fleet netsim
+workload."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nodexa_chain_core_tpu.serve.filters import (
+    GCS_M,
+    build_filter,
+    decode_filter,
+    decode_gcs,
+    encode_gcs,
+    filter_hash,
+    filter_header,
+    filter_items,
+    filter_key,
+    hash_items_device,
+    hash_items_scalar,
+    match_any,
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ------------------------------------------------------------ GCS codec
+
+
+def test_gcs_round_trip_various_sets():
+    for vals in (
+        [],
+        [0],
+        [5],
+        [0, 1, 2, 3],
+        sorted({(i * i * 2654435761) % (1 << 30) for i in range(300)}),
+        [7, 7 + (1 << 19), 7 + (1 << 25)],  # large deltas (long unary)
+    ):
+        enc = encode_gcs(vals)
+        assert decode_gcs(enc, len(vals)) == vals, vals
+
+
+def test_gcs_decode_error_paths():
+    from nodexa_chain_core_tpu.core.serialize import SerializationError
+
+    vals = list(range(0, 4000, 7))
+    enc = encode_gcs(vals)
+    with pytest.raises(SerializationError):
+        decode_gcs(enc[: len(enc) // 2], len(vals))
+    with pytest.raises(SerializationError):
+        decode_gcs(b"\xff" * 8200, 1)  # runaway unary quotient
+    with pytest.raises(SerializationError):
+        decode_gcs(b"", 1)
+
+
+def test_hash_items_device_matches_scalar():
+    """The cf.itemhash device batch must be byte-identical to the
+    hashlib scalar fallback for every batch size around the bucket
+    boundaries."""
+    key16 = bytes(range(16))
+    for n in (1, 31, 32, 33, 64, 100):
+        scripts = [bytes([i % 251]) * (20 + i % 9) for i in range(n)]
+        assert hash_items_device(key16, scripts) == \
+            hash_items_scalar(key16, scripts), n
+
+
+def test_filter_no_false_negatives_and_header_chain():
+    key16 = b"\xab" * 16
+    scripts = [b"\x76\xa9\x14" + bytes([i]) * 20 + b"\x88\xac"
+               for i in range(50)]
+    f = build_filter(key16, scripts)
+    for s in scripts:
+        assert match_any(f, key16, [s])
+    assert match_any(f, key16, scripts)
+    # false positives stay rare: probe many absent scripts
+    absent = [b"\x51" + bytes([i, j]) for i in range(40) for j in range(25)]
+    fp = sum(match_any(f, key16, [a]) for a in absent)
+    assert fp <= 3, f"false-positive rate wildly off: {fp}/1000"
+    # header chain: genesis anchors at 32 zero bytes and linkage is
+    # order-sensitive
+    h0 = filter_header(filter_hash(f), bytes(32))
+    h1 = filter_header(filter_hash(f), h0)
+    assert h0 != h1
+    assert len(h0) == 32
+    # decode_filter exposes the sorted mapped set
+    vals = decode_filter(f)
+    assert vals == sorted(vals) and len(vals) == len(set(vals))
+    assert all(0 <= v < len(scripts) * GCS_M for v in vals)
+
+
+# ------------------------------------------------ chain-building helpers
+
+
+def _mine_chain(cs, params, n_blocks, spk=b"\x51", spends_from=None,
+                ks=None, t0=None):
+    """Mine ``n_blocks`` onto ``cs`` paying ``spk``; when ``spends_from``
+    (a list of matured coinbase txs) is given, each block also spends
+    one of them back to ``spk``.  Returns the mined blocks."""
+    from nodexa_chain_core_tpu.consensus.merkle import merkle_root
+    from nodexa_chain_core_tpu.mining.assembler import (
+        BlockAssembler, mine_block_cpu)
+    from nodexa_chain_core_tpu.primitives.transaction import (
+        OutPoint, Transaction, TxIn, TxOut)
+    from nodexa_chain_core_tpu.script.script import Script
+    from nodexa_chain_core_tpu.script.sign import sign_tx_input
+
+    raw = bytes(spk.raw) if hasattr(spk, "raw") else bytes(spk)
+    t = t0 if t0 is not None else (
+        cs.tip().header.time + 60 if cs.tip() else params.genesis_time + 60)
+    blocks = []
+    for _ in range(n_blocks):
+        extra = []
+        if spends_from:
+            src = spends_from.pop(0)
+            tx = Transaction(
+                version=2,
+                vin=[TxIn(prevout=OutPoint(src.txid, 0))],
+                vout=[TxOut(src.vout[0].value - 10000, raw)],
+            )
+            sign_tx_input(ks, tx, 0, Script(src.vout[0].script_pubkey))
+            extra = [tx]
+        blk = BlockAssembler(cs).create_new_block(raw, ntime=t)
+        if extra:
+            blk.vtx.extend(extra)
+            blk.header.hash_merkle_root = merkle_root(
+                [tx.txid for tx in blk.vtx])[0]
+        if not mine_block_cpu(blk, params.algo_schedule):
+            raise RuntimeError("regtest mining failed")
+        assert cs.process_new_block(blk)
+        blocks.append(blk)
+        t += 60
+    return blocks
+
+
+def _fresh_indexed_chainstate():
+    """(params, cs, ks, spk) with OptionalIndexes + FilterIndex attached
+    BEFORE any non-genesis block connects."""
+    from nodexa_chain_core_tpu.chain.indexes import OptionalIndexes
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+    from nodexa_chain_core_tpu.script.sign import KeyStore
+    from nodexa_chain_core_tpu.script.standard import KeyID, p2pkh_script
+    from nodexa_chain_core_tpu.serve.filterindex import FilterIndex
+
+    params = regtest_params()
+    cs = ChainState(params)
+    cs.indexes = OptionalIndexes(cs.metadata_db)
+    cs.filter_index = FilterIndex(cs)
+    while not cs.filter_index.backfill_step(4):  # cover genesis
+        pass
+    ks = KeyStore()
+    spk = p2pkh_script(KeyID(ks.add_key(0xA11CE)))
+    return params, cs, ks, spk
+
+
+@pytest.fixture(scope="module")
+def spend_chain():
+    """A maturity warmup + 5 spend-carrying blocks, mined once and
+    replayable into fresh chainstates (blocks are self-contained)."""
+    from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+
+    params, cs, ks, spk = _fresh_indexed_chainstate()
+    warmup = _mine_chain(cs, params, COINBASE_MATURITY + 1, spk=spk.raw,
+                         t0=params.genesis_time + 60)
+    matured = [b.vtx[0] for b in warmup[:5]]
+    spends = _mine_chain(cs, params, 5, spk=spk.raw,
+                         spends_from=matured, ks=ks)
+    return {
+        "params": params, "cs": cs, "ks": ks, "spk": spk,
+        "blocks": warmup + spends,
+        "spent_coinbases": [b.vtx[0] for b in warmup[:5]],
+        "spend_txs": [b.vtx[1] for b in spends],
+    }
+
+
+# ---------------------------------------------------------- filter index
+
+
+def test_filterindex_connect_builds_contiguous_chain(spend_chain):
+    cs = spend_chain["cs"]
+    fi = cs.filter_index
+    tip = cs.tip()
+    wm_h, wm_hash = fi.watermark()
+    assert (wm_h, wm_hash) == (tip.height, tip.block_hash)
+    res = fi.headers_range(0, tip.block_hash)
+    assert res is not None and res[0] == 0
+    headers = res[1]
+    assert len(headers) == tip.height + 1
+    # recompute the whole chain client-side: commitment linkage holds
+    prev = bytes(32)
+    fres = fi.filters_range(0, tip.block_hash)
+    assert fres is not None and fres[0] == 0
+    for (bh, fbytes), hdr in zip(fres[1], headers):
+        assert filter_header(filter_hash(fbytes), prev) == hdr
+        prev = hdr
+    # a spend block's filter matches BOTH the paying script and the
+    # spent prevout's script (both are the same spk here — assert via
+    # the spent coinbase's output)
+    spk = spend_chain["spk"].raw
+    bh, fbytes = fres[1][-1]
+    assert match_any(fbytes, filter_key(bh), [bytes(spk)])
+
+
+def test_filterindex_items_include_spent_prevouts(spend_chain):
+    """filter_items sources spent prevout scripts from undo data."""
+    cs = spend_chain["cs"]
+    idx = cs.tip()
+    block = cs.read_block(idx)
+    undo = cs._read_undo_for(idx)
+    items = filter_items(block, undo)
+    assert bytes(spend_chain["spk"].raw) in items
+    # OP_RETURN and empty scripts never enter the item set
+    assert not any(i[:1] == b"\x6a" for i in items)
+    assert b"" not in items
+
+
+def test_filterindex_serving_range_bounds(spend_chain):
+    cs = spend_chain["cs"]
+    fi = cs.filter_index
+    tip = cs.tip()
+    assert fi.headers_range(0, 0xDEAD) is None          # unknown stop
+    assert fi.headers_range(tip.height + 1, tip.block_hash) is None
+    assert fi.filters_range(tip.height + 1, tip.block_hash) is None
+    start, hdrs = fi.headers_range(tip.height - 3, tip.block_hash)
+    assert start == tip.height - 3 and len(hdrs) == 4
+    # negative start folds to 0
+    start, _ = fi.headers_range(-5, cs.active.at(2).block_hash)
+    assert start == 0
+
+
+def test_filterindex_backfill_resumes_from_watermark():
+    """An index attached to a node WITH history lags; backfill walks the
+    gap in bounded steps, and a fresh index instance over the same db
+    (the restart) resumes from the persisted watermark."""
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+    from nodexa_chain_core_tpu.serve.filterindex import FilterIndex
+
+    params = regtest_params()
+    cs = ChainState(params)
+    _mine_chain(cs, params, 9)
+    fi = FilterIndex(cs)
+    assert fi.watermark()[0] == -1
+    assert not fi.backfill_step(4)      # 0..3
+    assert fi.watermark()[0] == 3
+    # restart: a NEW instance over the same metadata db picks up at 3
+    fi2 = FilterIndex(cs)
+    assert fi2.watermark()[0] == 3
+    while not fi2.backfill_step(4):
+        pass
+    tip = cs.tip()
+    assert fi2.watermark() == (tip.height, tip.block_hash)
+    assert fi2.headers_range(0, tip.block_hash) is not None
+
+
+def test_filterindex_unindex_on_reorg():
+    """Disconnecting a block removes its filter + header and retreats
+    the watermark; the replacing chain re-indexes cleanly."""
+    from nodexa_chain_core_tpu.chain.validation import ChainState
+    from nodexa_chain_core_tpu.node.chainparams import regtest_params
+    from nodexa_chain_core_tpu.serve.filterindex import FilterIndex
+
+    params = regtest_params()
+    cs = ChainState(params)
+    cs.filter_index = FilterIndex(cs)
+    while not cs.filter_index.backfill_step(4):
+        pass
+    _mine_chain(cs, params, 4)
+    doomed = cs.tip()
+    assert cs.filter_index.get_filter(doomed.block_hash) is not None
+    cs.invalidate_block(doomed)
+    assert cs.tip().height == 3
+    assert cs.filter_index.get_filter(doomed.block_hash) is None
+    assert cs.filter_index.get_header(doomed.block_hash) is None
+    assert cs.filter_index.watermark()[0] == 3
+    # the chain keeps growing and the index follows contiguously
+    # (offset ntime so the replacement differs from the invalidated block)
+    _mine_chain(cs, params, 2, t0=doomed.header.time + 30)
+    tip = cs.tip()
+    assert cs.filter_index.watermark() == (tip.height, tip.block_hash)
+    assert cs.filter_index.headers_range(0, tip.block_hash) is not None
+
+
+# ----------------------- satellite: optional-index reorg byte-equality
+
+
+def _index_dump(cs):
+    out = {}
+    for prefix in (b"ai", b"si", b"ti"):
+        for k, v in cs.metadata_db.iterate(prefix):
+            out[bytes(k)] = bytes(v)
+    return out
+
+
+def test_unindex_block_leaves_byte_identical_state(spend_chain):
+    """Reorging out spend-carrying blocks must leave the address/spent/
+    timestamp indexes BYTE-equal to a control chainstate that never saw
+    them — no stale receive rows, no orphaned spent-index entries."""
+    params = spend_chain["params"]
+    blocks = spend_chain["blocks"]
+
+    _, cs_full, _, _ = _fresh_indexed_chainstate()
+    for b in blocks:
+        assert cs_full.process_new_block(b)
+    full_dump = _index_dump(cs_full)
+    assert full_dump, "indexes recorded nothing"
+
+    # control: never connects the last 3 (spend-carrying) blocks
+    _, cs_ctrl, _, _ = _fresh_indexed_chainstate()
+    for b in blocks[:-3]:
+        assert cs_ctrl.process_new_block(b)
+    ctrl_dump = _index_dump(cs_ctrl)
+    assert ctrl_dump != full_dump
+
+    # reorg the last 3 off cs_full: index state must match the control
+    # byte for byte (and the filter index must agree too)
+    target = cs_full.active.at(cs_full.tip().height - 2)
+    cs_full.invalidate_block(target)
+    assert cs_full.tip().height == cs_ctrl.tip().height
+    assert _index_dump(cs_full) == ctrl_dump
+    for prefix in (b"cf", b"ch"):
+        assert {bytes(k): bytes(v)
+                for k, v in cs_full.metadata_db.iterate(prefix)} == \
+               {bytes(k): bytes(v)
+                for k, v in cs_ctrl.metadata_db.iterate(prefix)}, prefix
+
+
+# -------------------------------------------------- front-end machinery
+
+
+def _recv_http(sock):
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    length = 0
+    for ln in head.split(b"\r\n"):
+        if ln.lower().startswith(b"content-length:"):
+            length = int(ln.split(b":")[1])
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed")
+        rest += chunk
+    return status, head, json.loads(rest[:length]) if length else None
+
+
+def _post(sock, method, params=None, rid=1):
+    body = json.dumps(
+        {"method": method, "params": params or [], "id": rid}).encode()
+    sock.sendall((
+        f"POST / HTTP/1.1\r\nHost: t\r\nContent-Type: application/json"
+        f"\r\nContent-Length: {len(body)}\r\n\r\n").encode() + body)
+    return _recv_http(sock)
+
+
+def _get(sock, path):
+    sock.sendall(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    return _recv_http(sock)
+
+
+@pytest.fixture()
+def query_node(spend_chain):
+    """A node-shaped object + registered table over the spend chain."""
+    from types import SimpleNamespace
+
+    from nodexa_chain_core_tpu.chain.mempool import TxMemPool
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.rest import make_rest_handler
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    node = SimpleNamespace(
+        params=spend_chain["params"],
+        chainstate=spend_chain["cs"],
+        mempool=TxMemPool(),
+        wallet=None,
+        connman=None,
+        start_time=time.time(),
+    )
+    node.rest_handler = make_rest_handler(node)
+    table = register_all(RPCTable())
+    table.set_warmup_finished()
+    return node, table
+
+
+def _server(node, table, **kw):
+    from nodexa_chain_core_tpu.serve.frontend import QueryPlaneServer
+
+    defaults = dict(port=0, workers=2, rate_qps=10000.0, rate_burst=10000.0)
+    defaults.update(kw)
+    s = QueryPlaneServer(node, table, **defaults)
+    s.start()
+    return s
+
+
+def test_frontend_rpc_keepalive_and_rest(query_node):
+    node, table = query_node
+    s = _server(node, table)
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        status, _, resp = _post(c, "getblockcount")
+        assert status == 200 and resp["error"] is None
+        assert resp["result"] == node.chainstate.tip().height
+        # keep-alive: same socket serves a second method
+        status, _, resp = _post(c, "getbestblockhash", rid=2)
+        assert status == 200 and resp["id"] == 2
+        # REST rides the same port
+        status, _, body = _get(c, "/rest/chaininfo.json")
+        assert status == 200
+        assert body["blocks"] == node.chainstate.tip().height
+        # REST compact-filter routes
+        status, _, body = _get(
+            c, f"/rest/cfheaders/0/{body['bestblockhash']}")
+        assert status == 200 and body["start_height"] == 0
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_frontend_connection_close_gets_a_reply(query_node):
+    """A `Connection: close` request (urllib-style one-shot client) must
+    receive its response BEFORE the server closes — the reply is queued
+    by a worker after the io loop saw the close flag, so reaping must
+    wait for the in-flight request."""
+    node, table = query_node
+    s = _server(node, table)
+    try:
+        for _ in range(5):  # a few rounds: the race is timing-dependent
+            c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+            body = json.dumps({"method": "getblockcount", "params": [],
+                               "id": 1}).encode()
+            c.sendall((
+                "POST / HTTP/1.1\r\nHost: t\r\nConnection: close\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+            status, _, resp = _recv_http(c)
+            assert status == 200
+            assert resp["result"] == node.chainstate.tip().height
+            # and the server side actually closes the socket after
+            assert c.recv(4096) == b""
+            c.close()
+    finally:
+        s.stop()
+
+
+def test_frontend_unknown_method_folds_to_shared_lane(query_node):
+    node, table = query_node
+    s = _server(node, table)
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        for i, name in enumerate(["nope_%d" % j for j in range(5)]):
+            status, _, resp = _post(c, name, rid=i)
+            assert status == 500
+            assert resp["error"]["code"] == -32601  # method not found
+        with s._qcond:
+            lanes = set(s._queues)
+        assert {m for m in lanes if m.startswith("nope_")} == set(), \
+            "remote-minted method names must not create queue lanes"
+        assert "unknown" in lanes
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_frontend_rate_limit_shed_is_typed(query_node):
+    node, table = query_node
+    s = _server(node, table, rate_qps=2.0, rate_burst=2.0)
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        seen_busy = False
+        for i in range(6):
+            status, head, resp = _post(c, "getblockcount", rid=i)
+            if status == 503:
+                assert resp["error"]["code"] == -32005
+                assert b"Retry-After" in head
+                seen_busy = True
+        assert seen_busy
+        assert s.shed_counts["rate_limited"] > 0
+        # a shed is never misbehavior: the honest client is not banned
+        assert s.info()["banned"] == 0
+        status, _, _ = _post(c, "getblockcount", rid=99)
+        assert status in (200, 503)  # connection still serviced
+        c.close()
+    finally:
+        s.stop()
+
+
+def test_frontend_queue_full_shed(query_node):
+    node, table = query_node
+    gate = threading.Event()
+
+    def stall(n, p):
+        gate.wait(10)
+        return "ok"
+
+    table.register("test", "teststall", stall, [])
+    try:
+        s = _server(node, table, workers=1, queue_depth=2)
+        try:
+            conns = []
+            for i in range(6):
+                c = socket.create_connection(
+                    ("127.0.0.1", s.port), timeout=10)
+                body = json.dumps({"method": "teststall", "params": [],
+                                   "id": i}).encode()
+                c.sendall((
+                    "POST / HTTP/1.1\r\nHost: t\r\nContent-Type: "
+                    "application/json\r\nContent-Length: "
+                    f"{len(body)}\r\n\r\n").encode() + body)
+                conns.append(c)
+                time.sleep(0.05)
+            deadline = time.time() + 5
+            while s.shed_counts["queue_full"] == 0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert s.shed_counts["queue_full"] > 0
+            with s._qcond:
+                assert all(len(q) <= s.queue_depth
+                           for q in s._queues.values())
+            gate.set()
+            for c in conns:
+                c.close()
+        finally:
+            gate.set()
+            s.stop()
+    finally:
+        table._commands.pop("teststall", None)
+
+
+def test_frontend_safe_mode_sheds_except_diagnostics(query_node):
+    from nodexa_chain_core_tpu.node import health
+
+    node, table = query_node
+    s = _server(node, table)
+    try:
+        health.g_health.mode = health.MODE_SAFE
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        status, _, resp = _post(c, "getblockcount")
+        assert status == 503 and resp["error"]["code"] == -32005
+        assert "safe_mode" in resp["error"]["message"]
+        # the diagnostics keep answering — that is what they are FOR
+        status, _, resp = _post(c, "getqueryplaneinfo", rid=2)
+        assert status == 200 and resp["error"] is None
+        c.close()
+    finally:
+        health.g_health.mode = health.MODE_NORMAL
+        s.stop()
+
+
+def test_frontend_garbage_is_scored_and_banned(query_node):
+    node, table = query_node
+    s = _server(node, table, ban_time_s=60.0)
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        # repeated unparseable JSON: score 10 each, threshold 100
+        for i in range(10):
+            body = b"\x00\x01 not json"
+            try:
+                c.sendall((
+                    "POST / HTTP/1.1\r\nHost: t\r\nContent-Type: "
+                    "application/json\r\nContent-Length: "
+                    f"{len(body)}\r\n\r\n").encode() + body)
+                _recv_http(c)
+            except (ConnectionError, OSError):
+                break
+        deadline = time.time() + 5
+        while s.info()["banned"] == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert s.info()["banned"] == 1
+        # a new connection from the banned ip is refused
+        c2 = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        try:
+            got = c2.recv(4096)
+            assert got == b"" or b"403" in got
+        except (ConnectionError, OSError):
+            pass
+        c2.close()
+        c.close()
+    finally:
+        s.stop()
+
+
+# ------------------------- satellite: parity through both front doors
+
+
+PARITY_CASES = [
+    ("getblockcount", lambda env: []),
+    ("getbestblockhash", lambda env: []),
+    ("getblockchaininfo", lambda env: []),
+    ("getaddressbalance", lambda env: [env["addr"]]),
+    ("getaddresstxids", lambda env: [{"addresses": [env["addr"]]}]),
+    ("getaddressdeltas", lambda env: [env["addr"]]),
+    ("getaddressutxos", lambda env: [env["addr"]]),
+    ("getaddressmempool", lambda env: [{"addresses": [env["addr"]]}]),
+    ("getspentinfo", lambda env: [{"txid": env["spent_txid"],
+                                   "index": 0}]),
+    ("getblockdeltas", lambda env: [env["tip_hash"]]),
+    ("getblockhashes", lambda env: [env["t_high"], env["t_low"]]),
+    ("getcfheaders", lambda env: [0, env["tip_hash"]]),
+    ("getcfilters", lambda env: [env["tip_height"] - 3, env["tip_hash"]]),
+    ("getqueryplaneinfo", lambda env: []),
+]
+
+
+def test_rpc_parity_direct_vs_query_plane(query_node, spend_chain):
+    """Satellite: every legacy addressindex-compat method (and the new
+    query-plane family) returns the SAME payload through a direct
+    dispatch-table call and through a live query-plane socket."""
+    from nodexa_chain_core_tpu.core.uint256 import u256_hex
+    from nodexa_chain_core_tpu.script.standard import (
+        KeyID, encode_destination, p2pkh_script)
+
+    node, table = query_node
+    spk = spend_chain["spk"]
+    dest = KeyID(spk.raw[3:23])
+    assert p2pkh_script(dest).raw == spk.raw
+    tip = node.chainstate.tip()
+    env = {
+        "addr": encode_destination(dest, node.params),
+        "spent_txid": spend_chain["spent_coinbases"][0].txid_hex,
+        "tip_hash": u256_hex(tip.block_hash),
+        "tip_height": tip.height,
+        "t_high": tip.header.time,
+        "t_low": tip.header.time - 600,
+    }
+    s = _server(node, table)
+    node.queryplane = s
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        for method, mk in PARITY_CASES:
+            params = mk(env)
+            direct = table.execute(node, method, params)
+            status, _, resp = _post(c, method, params)
+            assert status == 200, (method, resp)
+            assert resp["error"] is None, (method, resp)
+            if method == "getqueryplaneinfo":
+                # served/queued counters move between the two calls;
+                # compare the stable shape instead
+                assert resp["result"]["cfilters"] == direct["cfilters"]
+                assert resp["result"]["queryplane"]["enabled"]
+                continue
+            assert resp["result"] == json.loads(
+                json.dumps(direct)), method
+        c.close()
+    finally:
+        del node.queryplane
+        s.stop()
+
+
+def test_parity_taxonomy_covers_compat_surface():
+    """Every addressindex-family method registered in the dispatch table
+    appears in PARITY_CASES — extending the family forces the parity
+    test to grow with it."""
+    from nodexa_chain_core_tpu.rpc.register import register_all
+    from nodexa_chain_core_tpu.rpc.server import RPCTable
+
+    table = register_all(RPCTable())
+    tested = {m for m, _ in PARITY_CASES}
+    family = {name for name, cmd in table._commands.items()
+              if cmd.category in ("addressindex", "queryplane")}
+    assert family <= tested, f"untested: {sorted(family - tested)}"
+
+
+# ------------------- satellite: metric families + exposition + top pane
+
+
+def test_query_metric_families_exposition_conformance(query_node):
+    """The nodexa_rpc_* / nodexa_query_* / nodexa_cf_* families survive
+    the Prometheus text round trip with the expected types and label
+    sets while carrying live traffic."""
+    from nodexa_chain_core_tpu.telemetry import prometheus_text
+
+    from .test_telemetry import _parse_exposition
+
+    node, table = query_node
+    s = _server(node, table)
+    try:
+        c = socket.create_connection(("127.0.0.1", s.port), timeout=10)
+        _post(c, "getblockcount")
+        _post(c, "definitely_not_registered", rid=2)
+        c.close()
+        # serving reads so the cf family carries data
+        tip = node.chainstate.tip()
+        node.chainstate.filter_index.get_filter(tip.block_hash)
+        node.chainstate.filter_index.get_header(tip.block_hash)
+    finally:
+        s.stop()
+
+    families, samples = _parse_exposition(prometheus_text())
+    expected = {
+        "nodexa_rpc_requests_total": "counter",
+        "nodexa_rpc_latency_seconds": "histogram",
+        "nodexa_rpc_inflight": "gauge",
+        "nodexa_query_connections_total": "counter",
+        "nodexa_query_shed_total": "counter",
+        "nodexa_query_queue_depth": "gauge",
+        "nodexa_cf_filters_built_total": "counter",
+        "nodexa_cf_served_total": "counter",
+        "nodexa_cf_backfill_height": "gauge",
+    }
+    for name, kind in expected.items():
+        assert families.get(name, {}).get("type") == kind, name
+
+    by_name = {}
+    for name, labels, raw in samples:
+        by_name.setdefault(name, []).append((labels, raw))
+    reqs = by_name["nodexa_rpc_requests_total"]
+    assert all(set(ls) == {"method", "result"} for ls, _ in reqs)
+    # the unregistered probe folded to method="unknown"
+    assert any(ls["method"] == "unknown" and ls["result"] == "not_found"
+               for ls, _ in reqs)
+    assert not any("definitely" in ls["method"] for ls, _ in reqs)
+    assert any(ls["method"] == "getblockcount" and ls["result"] == "ok"
+               for ls, _ in reqs)
+    served = by_name["nodexa_cf_served_total"]
+    assert {ls["kind"] for ls, _ in served} >= {"filter", "header"}
+    # histogram invariant: +Inf bucket equals _count per labelset
+    counts = {tuple(sorted(ls.items())): int(float(r))
+              for ls, r in by_name["nodexa_rpc_latency_seconds_count"]}
+    for ls, raw in by_name["nodexa_rpc_latency_seconds_bucket"]:
+        if ls.get("le") == "+Inf":
+            base = tuple(sorted((k, v) for k, v in ls.items()
+                                if k != "le"))
+            assert int(float(raw)) == counts[base], ls
+
+
+def _load_top():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "nodexa_top_qp", os.path.join(REPO, "tools", "nodexa_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_nodexa_top_query_pane_renders_and_hardens():
+    top = _load_top()
+    snap = {
+        "nodexa_node_health": {"values": [{"value": 0}]},
+        "nodexa_rpc_requests_total": {"values": [
+            {"labels": {"method": "getblockcount", "result": "ok"},
+             "value": 40},
+            {"labels": {"method": "unknown", "result": "not_found"},
+             "value": 2},
+        ]},
+        "nodexa_rpc_latency_seconds": {"values": [
+            {"labels": {"method": "getblockcount"}, "count": 40,
+             "sum": 0.2, "buckets": {"0.005": 30, "0.1": 40}},
+        ]},
+        "nodexa_rpc_inflight": {"values": [{"value": 1}]},
+        "nodexa_query_sessions": {"values": [{"value": 3}]},
+        "nodexa_query_queue_depth": {"values": [
+            {"labels": {"method": "getblockcount"}, "value": 2}]},
+        "nodexa_query_shed_total": {"values": [
+            {"labels": {"reason": "rate_limited"}, "value": 7}]},
+        "nodexa_cf_served_total": {"values": [
+            {"labels": {"kind": "filter"}, "value": 5},
+            {"labels": {"kind": "header"}, "value": 9}]},
+    }
+    frame = top.render(snap, None, 2.0)
+    q = [ln for ln in frame.splitlines() if "query:" in ln][0]
+    p = [ln for ln in frame.splitlines() if "plane:" in ln][0]
+    assert "ok=40" in q and "not_found=2" in q
+    assert "getblockcount=40" in q and "inflight 1" in q
+    assert "3 sessions" in p and "rate_limited=7" in p
+    assert "flt=5" in p and "hdr=9" in p
+    # absent families: the pane degrades to '-' instead of raising
+    empty = top.render({}, None, 2.0)
+    assert any(ln.strip() == "query: -" for ln in empty.splitlines())
+    assert any(ln.strip() == "plane: -" for ln in empty.splitlines())
+
+
+# ------------------------------------------- wallet fleet over netsim
+
+
+def test_wallet_fleet_cold_sync_zero_scans_and_deterministic():
+    """Three wallets cold-sync via filters, receive mined funds, pay
+    each other through production mempool admission, and detect the
+    payments via later filters — with zero false positives, zero header
+    mismatches, and a replay-stable digest."""
+    from nodexa_chain_core_tpu.consensus.consensus import COINBASE_MATURITY
+    from nodexa_chain_core_tpu.net.netsim import SimNet, WalletTraffic
+    from nodexa_chain_core_tpu.node.health import g_health
+
+    def run():
+        g_health.reset_for_tests()
+        with SimNet(2, seed=21) as net:
+            net.connect_full()
+            assert net.settle(30.0)
+            net.enable_cfilters()
+            fleet = WalletTraffic(net, server_index=0, n_wallets=3,
+                                  payment_interval_s=20.0)
+            for w in range(3):
+                net.mine_block(0, coinbase_spk=fleet.spk_for(w))
+            for _ in range(COINBASE_MATURITY):
+                net.mine_block(0)
+            net.run(5.0)
+            for _ in range(4):
+                net.run(25.0)
+                net.mine_block(0)
+            net.run(5.0)
+            totals = fleet.totals()
+            balances = fleet.balances()
+            fleet.detach()
+            return totals, balances, net.digest(), net.tips()
+
+    t1, b1, d1, tips1 = run()
+    assert t1["cold_synced"] == 3
+    assert t1["filters_downloaded"] > 0
+    assert t1["filter_matches"] >= 3
+    assert t1["blocks_fetched"] == t1["filter_matches"], \
+        "a non-matching filter must never trigger a block fetch"
+    assert t1["payments_sent"] > 0 and t1["payments_seen"] > 0
+    assert t1["header_mismatches"] == 0
+    assert t1["false_positives"] == 0
+    assert t1["sync_lagged"] == 0
+    t2, b2, d2, tips2 = run()
+    assert (t1, b1, d1, tips1) == (t2, b2, d2, tips2), \
+        "wallet-fleet workload must replay to the same digest"
+
+
+def test_wallet_fleet_reorg_triggers_rescan():
+    """A partition reorg rewinds wallet chains to the fork point and
+    client-side rescans recover a consistent view — received coins on
+    the orphaned side vanish, the surviving chain's stay."""
+    from nodexa_chain_core_tpu.net.netsim import SimNet, WalletTraffic
+    from nodexa_chain_core_tpu.node.health import g_health
+
+    g_health.reset_for_tests()
+    with SimNet(3, seed=22) as net:
+        net.connect_full()
+        assert net.settle(30.0)
+        net.enable_cfilters()
+        fleet = WalletTraffic(net, server_index=0, n_wallets=2)
+        net.mine_block(0, coinbase_spk=fleet.spk_for(0))
+        net.run(2.0)
+        assert fleet.totals()["filter_matches"] >= 1
+        net.partition({0})
+        # orphan side: node 0 pays wallet 1; heavy side mines 2 deep
+        net.mine_block(0, coinbase_spk=fleet.spk_for(1))
+        net.run(2.0)
+        orphan_bal = fleet.balances()
+        assert orphan_bal[1] > 0
+        net.mine_chain(1, 2)
+        net.heal()
+        assert net.run_until(net.converged, 120.0)
+        net.run(5.0)
+        totals = fleet.totals()
+        balances = fleet.balances()
+        assert totals["rescans"] >= 1, "reorg must trigger a rescan"
+        assert totals["header_mismatches"] == 0
+        assert balances[1] == 0, "orphaned coinbase must vanish"
+        assert balances[0] > 0, "pre-fork coinbase must survive"
+        fleet.detach()
+
+
+# ----------------------- satellite: queryindex kill-at-site fault matrix
+
+
+_DRIVER = r"""
+import sys
+from nodexa_chain_core_tpu.chain.validation import ChainState
+from nodexa_chain_core_tpu.mining.assembler import BlockAssembler, \
+    mine_block_cpu
+from nodexa_chain_core_tpu.node.chainparams import regtest_params
+from nodexa_chain_core_tpu.serve.filterindex import FilterIndex
+
+work, target = sys.argv[1], int(sys.argv[2])
+params = regtest_params()
+cs = ChainState(params, datadir=work)
+t = (cs.tip().header.time if cs.tip() and cs.tip().height else
+     params.genesis_time) + 60
+while cs.tip().height < target:
+    blk = BlockAssembler(cs).create_new_block(b"\x51", ntime=t)
+    assert mine_block_cpu(blk, params.algo_schedule)
+    assert cs.process_new_block(blk)
+    t += 60
+fi = FilterIndex(cs)
+print("RESUME %d" % fi.watermark()[0])
+while not fi.backfill_step(2):     # queryindex.write fires per put
+    pass
+res = fi.headers_range(0, cs.tip().block_hash)  # queryindex.read fires
+assert res is not None and res[0] == 0
+import hashlib
+print("WATERMARK %d" % fi.watermark()[0])
+print("HEADERS %s" % hashlib.sha256(b"".join(res[1])).hexdigest())
+cs.close()
+"""
+
+_TARGET = 6
+
+_KILL_MATRIX = {
+    "queryindex.write": "kill,after=4",   # mid-backfill, torn index put
+    "queryindex.read": "kill,after=2",    # mid serving/backfill read
+}
+
+
+def _run_driver(work, faultinject=None, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("NODEXA_FAULTINJECT", None)
+    if faultinject:
+        env["NODEXA_FAULTINJECT"] = faultinject
+    return subprocess.run(
+        [sys.executable, "-c", _DRIVER, work, str(_TARGET)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=timeout)
+
+
+def _parse(proc, tag):
+    for line in proc.stdout.splitlines():
+        if line.startswith(tag + " "):
+            return line.split()[1:]
+    raise AssertionError(
+        f"driver printed no {tag}\nstdout: {proc.stdout}\n"
+        f"stderr: {proc.stderr}")
+
+
+def test_queryindex_sites_are_known_and_not_in_ibd_matrix():
+    from nodexa_chain_core_tpu.node.faults import KNOWN_SITES
+
+    for site in _KILL_MATRIX:
+        assert site in KNOWN_SITES
+        assert not KNOWN_SITES[site]["ibd"], \
+            "queryindex sites must not perturb the IBD crash matrix"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", sorted(_KILL_MATRIX))
+def test_queryindex_kill_matrix_resumes_from_watermark(tmp_path, site):
+    """Hard-kill mid-backfill at each queryindex site: the restart must
+    RESUME from the persisted watermark (not from scratch) and converge
+    to the uninterrupted run's filter-header chain."""
+    from nodexa_chain_core_tpu.node.faults import KILL_EXIT_CODE
+
+    base = _run_driver(str(tmp_path / "baseline"))
+    assert base.returncode == 0, base.stderr
+    base_wm = int(_parse(base, "WATERMARK")[0])
+    base_headers = _parse(base, "HEADERS")[0]
+    assert base_wm == _TARGET
+
+    work = str(tmp_path / "node")
+    killed = _run_driver(work, faultinject=f"{site}:{_KILL_MATRIX[site]}")
+    assert killed.returncode == KILL_EXIT_CODE, (
+        f"{site} injection never fired (exit {killed.returncode})\n"
+        f"stderr: {killed.stderr}")
+
+    healed = _run_driver(work)
+    assert healed.returncode == 0, (
+        f"restart after {site} kill failed\nstdout: {healed.stdout}\n"
+        f"stderr: {healed.stderr}")
+    assert int(_parse(healed, "WATERMARK")[0]) == base_wm
+    assert _parse(healed, "HEADERS")[0] == base_headers
+    if site == "queryindex.write":
+        # the kill landed after some puts committed: restart must pick
+        # up mid-stream, not re-index from -1
+        assert int(_parse(healed, "RESUME")[0]) >= 0

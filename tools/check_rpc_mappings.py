@@ -67,6 +67,10 @@ EXPECTED_EXTRAS = {
     # rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS; loadtxoutset is in
     # MUTATING_COMMANDS)
     "dumptxoutset", "loadtxoutset", "getsnapshotinfo",
+    # query plane (serve/): compact-filter serving for light wallets +
+    # the front-end diagnostic (getqueryplaneinfo is safe-mode readable
+    # via rpc.safemode.READONLY_DIAGNOSTIC_COMMANDS)
+    "getcfheaders", "getcfilters", "getqueryplaneinfo",
 }
 
 

@@ -122,29 +122,39 @@
 #                      (liar caught at the first bad chunk, typed
 #                      disconnect, zero honest bans, digest replay
 #                      equality with transfer enabled)
-#  20. vectors         generate_x16r_vectors.py --check — the committed
+#  20. queryplane      bench/queryplane.py --smoke — the query plane's
+#                      two load-bearing claims: a cold wallet syncs via
+#                      compact filters faster than a server-side rescan
+#                      reading ONLY filter-matched blocks (zero scans by
+#                      construction), and the evented front end under a
+#                      10x-overload storm answers with finite p99, typed
+#                      -32005 sheds, bounded queues, zero honest bans,
+#                      and no safe-mode trip; plus the wallet-fleet
+#                      netsim digest-replay pin (two identical fleet
+#                      runs must produce equal digests and totals)
+#  21. vectors         generate_x16r_vectors.py --check — the committed
 #                      crypto vectors regenerate bit-for-bit (only when
 #                      the reference tree is mounted)
-#  21. native build    compiles the C++ engine (also feeds the wheel)
-#  22. static checks   tools/typecheck.py over the consensus-critical
+#  22. native build    compiles the C++ engine (also feeds the wheel)
+#  23. static checks   tools/typecheck.py over the consensus-critical
 #                      packages PLUS pool/net/telemetry (undefined
 #                      names, module attrs, arity)
-#  23. hardening       tools/security_check.py asserts NX/RELRO/no-
+#  24. hardening       tools/security_check.py asserts NX/RELRO/no-
 #                      TEXTREL on the built .so (security-check analog)
-#  24. pytest          unit suite (functional suite with --full) —
+#  25. pytest          unit suite (functional suite with --full) —
 #                      runs with DEBUG_LOCKORDER armed on the named
 #                      production locks (tests/conftest.py default), so
 #                      the whole suite doubles as a lock-order soak
-#  25. wheel           platform-tagged wheel incl. the native .so,
+#  26. wheel           platform-tagged wheel incl. the native .so,
 #                      install-tested from the built artifact
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== [1/25] lint"
+echo "== [1/26] lint"
 python tools/lint.py
 
-echo "== [2/25] concurrency lint (thread-safety annotations)"
+echo "== [2/26] concurrency lint (thread-safety annotations)"
 # tools/nxlint.py: whole-program AST/call-graph verification of the
 # @requires_lock/@excludes_lock annotations, the no-blocking-under-
 # cs_main rule, the clock=/trace-guard/label-cardinality/fault-site
@@ -157,7 +167,7 @@ echo "== [2/25] concurrency lint (thread-safety annotations)"
 python tools/nxlint.py
 python tools/nxlint.py --self-test
 
-echo "== [3/25] import graph"
+echo "== [3/26] import graph"
 python - <<'EOF'
 import importlib, os, pkgutil
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -175,13 +185,13 @@ raise SystemExit(1 if bad else 0)
 EOF
 echo "   all modules import"
 
-echo "== [4/25] rpc mapping parity"
+echo "== [4/26] rpc mapping parity"
 python tools/check_rpc_mappings.py
 
-echo "== [5/25] telemetry exposition"
+echo "== [5/26] telemetry exposition"
 python -m pytest tests/test_telemetry.py -q -p no:cacheprovider
 
-echo "== [6/25] IBD fast path (synthetic)"
+echo "== [6/26] IBD fast path (synthetic)"
 # no pipe: a pipeline would launder the gate's exit status through tail
 # and set -e could never fire on an --assert-fast-path failure; the
 # temp file keeps the per-mode JSON diagnostics visible when it DOES fail
@@ -193,7 +203,7 @@ if ! python -m nodexa_chain_core_tpu.bench.ibd --blocks 16 --assert-fast-path \
 fi
 tail -2 "$IBD_LOG"; rm -f "$IBD_LOG"
 
-echo "== [7/25] pool stratum e2e (loopback)"
+echo "== [7/26] pool stratum e2e (loopback)"
 # same no-pipe discipline as stage 5: keep the assert's exit status and
 # the JSON diagnostics visible on failure
 POOL_LOG=$(mktemp)
@@ -204,7 +214,7 @@ if ! python -m nodexa_chain_core_tpu.bench.pool --e2e --shares 5 \
 fi
 tail -2 "$POOL_LOG"; rm -f "$POOL_LOG"
 
-echo "== [8/25] mesh serving backend (forced 8-device mesh)"
+echo "== [8/26] mesh serving backend (forced 8-device mesh)"
 # same no-pipe discipline: the assert's exit status must reach set -e
 # and the per-device JSON diagnostics must surface on failure
 MESH_LOG=$(mktemp)
@@ -215,7 +225,7 @@ if ! python -m nodexa_chain_core_tpu.bench.mesh --devices 8 --rounds 2 \
 fi
 tail -2 "$MESH_LOG"; rm -f "$MESH_LOG"
 
-echo "== [9/25] tx admission fast path (flood)"
+echo "== [9/26] tx admission fast path (flood)"
 # no-pipe discipline again: the gate's exit status must reach set -e and
 # the per-path JSON diagnostics must surface when the floor fails
 TXF_LOG=$(mktemp)
@@ -226,7 +236,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$TXF_LOG"; rm -f "$TXF_LOG"
 
-echo "== [10/25] sharded chainstate admission (-coinsshards=4 flood)"
+echo "== [10/26] sharded chainstate admission (-coinsshards=4 flood)"
 # the tentpole's throughput lane: the identical flood with the coins
 # set resharded to 4 outpoint shards, the snapshot stage holding
 # per-touched-shard locks instead of cs_main.  Floor is 0.85x staged —
@@ -242,7 +252,7 @@ if ! python -m nodexa_chain_core_tpu.bench.txflood --txs 120 --repeats 2 \
 fi
 tail -2 "$SHF_LOG"; rm -f "$SHF_LOG"
 
-echo "== [11/25] fault tolerance (crash-recovery matrix + safe mode)"
+echo "== [11/26] fault tolerance (crash-recovery matrix + safe mode)"
 # kill-at-site crash pairs, safe-mode degradation, and the startup
 # self-check refusing corrupted undo data; the full site matrix and the
 # daemon-level safe-mode e2e run under the slow marker (--full lane)
@@ -253,7 +263,7 @@ else
         -p no:cacheprovider
 fi
 
-echo "== [12/25] observability (flight recorder + startup attribution)"
+echo "== [12/26] observability (flight recorder + startup attribution)"
 # forced safe-mode under a -faultinject spec must leave a usable
 # post-mortem: a flight-recorder dump with >=1 complete trace
 python tools/flight_check.py
@@ -268,7 +278,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --skip-warm \
 fi
 tail -2 "$SUP_LOG"; rm -f "$SUP_LOG"
 
-echo "== [13/25] cold start (AOT executable cache + shape discipline)"
+echo "== [13/26] cold start (AOT executable cache + shape discipline)"
 # cold + warm restart children against ONE cache dir: the warm child
 # must strictly beat the cold one (the BENCH_r05 64.5s-warm-vs-54.4s-
 # cold inversion is the regression this stage exists to catch), stay
@@ -283,7 +293,7 @@ if ! python -m nodexa_chain_core_tpu.bench.startup --assert-warm \
 fi
 tail -2 "$CS_LOG"; rm -f "$CS_LOG"
 
-echo "== [14/25] utilization + profiler (live roofline attribution)"
+echo "== [14/26] utilization + profiler (live roofline attribution)"
 # a loopback serving rig with the sampling profiler at the daemon
 # default (25 Hz): getprofile must round-trip >= 4 thread roles with
 # samples, pool shares/s with the profiler ON must stay >= 0.95x OFF
@@ -296,7 +306,7 @@ if ! python tools/profile_check.py > "$PC_LOG" 2>&1; then
 fi
 tail -2 "$PC_LOG"; rm -f "$PC_LOG"
 
-echo "== [15/25] lock contention (ledger attribution + overhead pin)"
+echo "== [15/26] lock contention (ledger attribution + overhead pin)"
 # the admission flood + compact-relay + pool job-cutter + share-check
 # threads storm cs_main with the contention ledger armed: cs_main wait
 # share must be finite and > 0, >= 3 thread roles attributed, the blame
@@ -315,7 +325,7 @@ if ! python -m nodexa_chain_core_tpu.bench.contention --assert-observed \
 fi
 tail -1 "$LC_LOG"; rm -f "$LC_LOG"
 
-echo "== [16/25] netsim smoke (multi-node adversarial scenarios)"
+echo "== [16/26] netsim smoke (multi-node adversarial scenarios)"
 # deterministic in-process 5-node partition-and-heal (must converge all
 # nodes to ONE tip with zero honest bans), a digest-pinned determinism
 # replay, and a stalling-peer IBD run asserting the black-hole peer is
@@ -328,7 +338,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --smoke \
 fi
 tail -6 "$NS_LOG"; rm -f "$NS_LOG"
 
-echo "== [17/25] net observability (cross-node trace smoke)"
+echo "== [17/26] net observability (cross-node trace smoke)"
 # the wire extension of the PR 8/11 kill-switch contract: an N=5 chain
 # topology must assemble >=1 cluster-wide block-propagation trace
 # spanning >=3 hops with every per-hop stage finite and the stage sum
@@ -344,7 +354,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --trace-smoke \
 fi
 tail -6 "$NO_LOG"; rm -f "$NO_LOG"
 
-echo "== [18/25] relay adversary + internet-scale netsim (sharded)"
+echo "== [18/26] relay adversary + internet-scale netsim (sharded)"
 # the relay path against hostile peers, and the harness at N=500:
 # (a) adversary lane on the SHARDED harness at N=100 — a short-id
 #     collision flood must degrade to the full-block path with the
@@ -375,7 +385,7 @@ if ! python -m nodexa_chain_core_tpu.bench.netsim --scale --assert-floors \
 fi
 tail -14 "$SC_LOG"; rm -f "$SC_LOG"
 
-echo "== [19/25] snapshot bootstrap (assumeUTXO + lying provider)"
+echo "== [19/26] snapshot bootstrap (assumeUTXO + lying provider)"
 # instant bootstrap must actually be instant: snapshot load-to-tip at
 # least 10x faster than replaying the same blocks via process_new_block,
 # bit-exact coins digest asserted, and the adversarial netsim smoke — a
@@ -391,23 +401,49 @@ if ! python -m nodexa_chain_core_tpu.bench.snapshot --assert-fast \
 fi
 tail -12 "$SNAP_LOG"; rm -f "$SNAP_LOG"
 
-echo "== [20/25] crypto vector regeneration"
+echo "== [20/26] query plane (compact-filter sync + front-end storm)"
+# the query plane's two claims, asserted: a cold wallet syncing via
+# compact filters reads ONLY filter-matched blocks (zero server-side
+# scans by construction) and beats a server-side rescan outright; the
+# evented front end under a constructed 10x-overload storm keeps p99
+# finite, sheds with typed -32005/503 answers, never overflows a
+# bounded queue, bans nobody honest, and never trips safe mode
+# (same no-pipe discipline as the other bench stages)
+QP_LOG=$(mktemp)
+if ! python -m nodexa_chain_core_tpu.bench.queryplane --smoke \
+        > "$QP_LOG" 2>&1; then
+    cat "$QP_LOG"; rm -f "$QP_LOG"
+    exit 1
+fi
+tail -6 "$QP_LOG"; rm -f "$QP_LOG"
+# wallet-fleet digest-replay pin: two identical netsim fleet runs must
+# produce byte-equal digests/totals, and a partition reorg must drive
+# the client-side rescan path
+QPF_LOG=$(mktemp)
+if ! python -m pytest tests/test_queryplane.py -q -k "wallet_fleet" \
+        > "$QPF_LOG" 2>&1; then
+    cat "$QPF_LOG"; rm -f "$QPF_LOG"
+    exit 1
+fi
+tail -3 "$QPF_LOG"; rm -f "$QPF_LOG"
+
+echo "== [21/26] crypto vector regeneration"
 if [ -d "${NODEXA_REFERENCE:-/root/reference}" ]; then
     python tools/generate_x16r_vectors.py --check
 else
     echo "   reference tree not mounted; committed vectors still exercised by pytest"
 fi
 
-echo "== [21/25] native engine build"
+echo "== [22/26] native engine build"
 python -c "from nodexa_chain_core_tpu import native; native.load(); print('   .so ready:', native._LIB_PATH)"
 
-echo "== [22/25] static checks (consensus-critical packages)"
+echo "== [23/26] static checks (consensus-critical packages)"
 python tools/typecheck.py
 
-echo "== [23/25] native hardening (security-check analog)"
+echo "== [24/26] native hardening (security-check analog)"
 python tools/security_check.py
 
-echo "== [24/25] pytest"
+echo "== [25/26] pytest"
 # telemetry + fault-tolerance suites already ran as stages 4/9: don't
 # pay for them twice
 if [ "$1" = "--full" ]; then
@@ -419,7 +455,7 @@ else
         --ignore=tests/test_fault_tolerance.py
 fi
 
-echo "== [25/25] wheel"
+echo "== [26/26] wheel"
 rm -rf build/ dist/ ./*.egg-info
 python -m pip wheel --no-build-isolation --no-deps -w dist . -q
 python - <<'EOF'

@@ -173,6 +173,37 @@ getnetstats is the RPC twin of this delta for the per-peer view:
 per-command msg/byte ledgers, relay-efficiency ratios, send-stall
 watch, and the trace-propagation state in one safe-mode-readable call.
 
+Diffing a query-plane session (-queryplane -cfilters serving a wallet
+fleet): snapshot before the wallets connect and after a sync interval,
+then read the delta's
+
+  nodexa_rpc_requests_total{method=...,result=ok|rpc_error|...}
+      — the dispatch ledger by method (both front ends share it);
+      method=unknown climbing means clients probe unregistered names
+  nodexa_rpc_latency_seconds{method=...}
+      — per-method dispatch latency; a fat getcfilters tail with a
+      thin getblockcount tail is the per-method queue isolation working
+  nodexa_query_shed_total{reason=queue_full|rate_limited|safe_mode}
+      — typed load shedding; rate_limited means the per-IP bucket
+      (-queryplaneqps) is the binding constraint, queue_full means the
+      worker pool (-queryplaneworkers) is
+  nodexa_query_queue_depth{method=...} (gauge pair) and
+  nodexa_query_sessions / nodexa_rpc_inflight (gauge pairs)
+      — standing depth per lane and live session/dispatch counts
+  nodexa_cf_filters_built_total{path=device|scalar,origin=connect|
+      backfill} and nodexa_cf_backfill_height (gauge pair)
+      — filter build attribution (device vs fallback, connect-time vs
+      the background indexer) and how far the backfill watermark moved
+  nodexa_cf_served_total{kind=filter|header}
+      — what the fleet actually downloaded; a healthy cold sync is
+      header-heavy with filter fetches tracking wallet count
+
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 > pre_qp.json
+  ... wallets cold-sync / dashboards poll the query plane ...
+  python tools/metrics_snapshot.py --rpc --datadir /tmp/n1 \
+      --diff pre_qp.json | python -m json.tool \
+      | grep -E "nodexa_(rpc|query|cf)_"
+
 Diffing a tx flood (the PR-4 staged-admission proof): snapshot before
 relaying a burst of transactions at the node and after the mempool
 settles, then read the delta's

@@ -352,6 +352,42 @@ def render(snap: dict, prev: Optional[dict], interval_s: float) -> str:
     else:
         lines.append("  snap: -")
 
+    # query plane: RPC dispatch outcomes/latency + front-end sessions,
+    # queue depth, typed sheds, and compact-filter serving (the
+    # nodexa_rpc_* families register on first dispatch and the
+    # nodexa_query_* families only with -queryplane: render '-')
+    if have(snap, "nodexa_rpc_requests_total", "nodexa_query_sessions"):
+        results = by_label(snap, "nodexa_rpc_requests_total", "result")
+        res_line = " ".join(
+            f"{k}={int(v)}" for k, v in sorted(results.items()) if v
+        ) or "none"
+        methods = by_label(snap, "nodexa_rpc_requests_total", "method")
+        top = sorted(methods.items(), key=lambda kv: -kv[1])[:4]
+        top_line = " ".join(f"{k}={int(v)}" for k, v in top if v) or "-"
+        qcount, qmean, qp99 = hist_stats(snap, "nodexa_rpc_latency_seconds")
+        inflight = int(series_total(snap, "nodexa_rpc_inflight"))
+        sessions = int(series_total(snap, "nodexa_query_sessions"))
+        depth = int(sum(
+            by_label(snap, "nodexa_query_queue_depth", "method").values()))
+        sheds = by_label(snap, "nodexa_query_shed_total", "reason")
+        shed_line = " ".join(
+            f"{k}={int(v)}" for k, v in sorted(sheds.items()) if v
+        ) or "none"
+        served = by_label(snap, "nodexa_cf_served_total", "kind")
+        cf_part = (
+            f"   cf served flt={int(served.get('filter', 0))} "
+            f"hdr={int(served.get('header', 0))}" if served else "")
+        lines.append(
+            f"  query: {rate('nodexa_rpc_requests_total')} "
+            f"[{res_line}]   top [{top_line}]   lat mean {fmt_ms(qmean)} "
+            f"p99 {fmt_ms(qp99)} (n={qcount})   inflight {inflight}")
+        lines.append(
+            f"  plane: {sessions} sessions   queued {depth}   "
+            f"shed [{shed_line}]{cf_part}")
+    else:
+        lines.append("  query: -")
+        lines.append("  plane: -")
+
     # mempool: outcomes + the off-lock proof pair
     accepts = by_label(snap, "nodexa_mempool_accepts_total", "result")
     _, smean, _ = hist_stats(

@@ -107,6 +107,13 @@ BOUNDED_LABELS = {
     "lock", "waiter_role", "holder_role", "holder_site",
     # coins-shard index: bounded by chain.coins_shards.MAX_COINS_SHARDS
     "shard",
+    # query-plane vocabulary: method is bounded by the registered RPC
+    # command table plus the "rest" and fold-to-"unknown" lanes (remote
+    # names never mint labels — rpc/server.py and serve/frontend.py
+    # both fold unregistered methods)
+    "method", "msg",
+    # filter-index build origin: closed {"connect", "backfill"} set
+    "origin",
 }
 
 # A DebugLock(f"prefix{...}") family must have every member prefix0..
